@@ -1,0 +1,127 @@
+// Native IO/packing hot loops for sctools-tpu.
+//
+// The reference framework keeps its loader/packer hot paths native;
+// here the two host-side hot loops are (1) CSR -> padded-ELL packing
+// (the device-upload format, see sctools_tpu/data/sparse.py) and
+// (2) MatrixMarket text parsing.  Exposed via plain C symbols for
+// ctypes (no pybind11 in this image).
+//
+// Build: make -C csrc   (produces libscio.so)
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// CSR -> padded-ELL.  out_idx must be pre-filled with `sentinel`,
+// out_val with zeros (caller allocates; we only touch occupied slots).
+void scio_pack_ell_f32(const int64_t* indptr, const int32_t* indices,
+                       const float* data, int64_t n_rows,
+                       int64_t rows_padded, int64_t capacity,
+                       int32_t sentinel, int32_t* out_idx,
+                       float* out_val) {
+  (void)rows_padded;
+  (void)sentinel;
+  for (int64_t r = 0; r < n_rows; ++r) {
+    const int64_t lo = indptr[r], hi = indptr[r + 1];
+    // Clamp to capacity: an oversized row must not overwrite its
+    // neighbours (the Python layer validates capacity up front; this
+    // is the memory-safety backstop, matching the numpy fallback's
+    // IndexError in spirit — corrupting the heap is never acceptable).
+    const int64_t n = hi - lo > capacity ? capacity : hi - lo;
+    int32_t* oi = out_idx + r * capacity;
+    float* ov = out_val + r * capacity;
+    std::memcpy(oi, indices + lo, sizeof(int32_t) * n);
+    std::memcpy(ov, data + lo, sizeof(float) * n);
+  }
+}
+
+// ---------------------------------------------------------------------
+// MatrixMarket parser.  Two-call protocol: scio_parse_mtx reads the
+// file into an internal buffer and returns a handle (>= 0) plus the
+// dims/nnz; scio_fetch_mtx copies the triplets out and frees the
+// buffer.  Only "coordinate real/integer/pattern general" headers are
+// supported (the 10x format).
+// ---------------------------------------------------------------------
+
+struct MtxBuf {
+  std::vector<int32_t> rows, cols;
+  std::vector<float> vals;
+};
+
+static MtxBuf* g_bufs[16] = {nullptr};
+
+int64_t scio_parse_mtx(const char* path, int64_t* n_rows, int64_t* n_cols,
+                       int64_t* nnz) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  char line[65536];
+  bool pattern = false;
+  // Header
+  if (!std::fgets(line, sizeof line, f)) { std::fclose(f); return -2; }
+  if (std::strncmp(line, "%%MatrixMarket", 14) != 0 ||
+      !std::strstr(line, "coordinate") || std::strstr(line, "complex") ||
+      std::strstr(line, "symmetric") || std::strstr(line, "hermitian") ||
+      std::strstr(line, "skew")) {
+    std::fclose(f);
+    return -3;
+  }
+  pattern = std::strstr(line, "pattern") != nullptr;
+  // Comments
+  long pos;
+  do {
+    pos = std::ftell(f);
+    if (!std::fgets(line, sizeof line, f)) { std::fclose(f); return -2; }
+  } while (line[0] == '%');
+  std::fseek(f, pos, SEEK_SET);
+  long long nr, nc, nz;
+  if (std::fscanf(f, "%lld %lld %lld", &nr, &nc, &nz) != 3) {
+    std::fclose(f);
+    return -2;
+  }
+  auto* buf = new MtxBuf;
+  buf->rows.reserve(nz);
+  buf->cols.reserve(nz);
+  if (!pattern) buf->vals.reserve(nz);
+  for (long long i = 0; i < nz; ++i) {
+    long long r, c;
+    if (pattern) {
+      if (std::fscanf(f, "%lld %lld", &r, &c) != 2) { delete buf; std::fclose(f); return -2; }
+      buf->rows.push_back((int32_t)(r - 1));
+      buf->cols.push_back((int32_t)(c - 1));
+    } else {
+      double v;
+      if (std::fscanf(f, "%lld %lld %lf", &r, &c, &v) != 3) { delete buf; std::fclose(f); return -2; }
+      buf->rows.push_back((int32_t)(r - 1));
+      buf->cols.push_back((int32_t)(c - 1));
+      buf->vals.push_back((float)v);
+    }
+  }
+  std::fclose(f);
+  if (pattern) buf->vals.assign(buf->rows.size(), 1.0f);
+  int64_t handle = -1;
+  for (int64_t h = 0; h < 16; ++h) {
+    if (!g_bufs[h]) { g_bufs[h] = buf; handle = h; break; }
+  }
+  if (handle < 0) { delete buf; return -4; }
+  *n_rows = nr;
+  *n_cols = nc;
+  *nnz = (int64_t)g_bufs[handle]->rows.size();
+  return handle;
+}
+
+void scio_fetch_mtx(int64_t handle, int32_t* rows, int32_t* cols,
+                    float* vals) {
+  if (handle < 0 || handle >= 16 || !g_bufs[handle]) return;
+  MtxBuf* buf = g_bufs[handle];
+  std::memcpy(rows, buf->rows.data(), buf->rows.size() * sizeof(int32_t));
+  std::memcpy(cols, buf->cols.data(), buf->cols.size() * sizeof(int32_t));
+  std::memcpy(vals, buf->vals.data(), buf->vals.size() * sizeof(float));
+  delete buf;
+  g_bufs[handle] = nullptr;
+}
+
+}  // extern "C"

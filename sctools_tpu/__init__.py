@@ -1,0 +1,40 @@
+"""sctools-tpu: a TPU-native single-cell analysis framework.
+
+Built from scratch on JAX/XLA/Pallas with the capabilities of
+dpeerlab/sctools (reference source unavailable — see SURVEY.md; the
+capability contract is BASELINE.json's north star): a ``Transform``
+operator registry with pluggable ``backend=`` execution, an
+AnnData/CSR loader that materialises device-resident sparse blocks,
+vmapped per-cell preprocessing, Seurat-v3 HVG selection, randomized
+PCA, tiled distance/kNN kernels, and multi-chip neighbour-graph
+construction over a ``jax.sharding.Mesh``.
+
+Quick start::
+
+    import sctools_tpu as sct
+
+    ds = sct.data.synthetic.synthetic_counts(10_000, 2_000, n_clusters=5)
+    dev = ds.device_put()
+    out = sct.Pipeline([
+        ("qc.per_cell_metrics", {}),
+        ("normalize.library_size", {"target_sum": 1e4}),
+        ("normalize.log1p", {}),
+        ("hvg.select", {"n_top": 1000, "subset": True}),
+        ("pca.randomized", {"n_components": 50}),
+        ("neighbors.knn", {"k": 15, "metric": "cosine"}),
+    ]).run(dev, backend="tpu")
+"""
+
+from . import data, ops  # noqa: F401  (ops import registers transforms)
+from .config import config, configure
+from .data import CellData, SparseCells
+from .data.io import from_dense, from_scipy, read_10x_mtx, read_h5ad, write_h5ad
+from .registry import Pipeline, Transform, apply, backends, get, names, register
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "CellData", "SparseCells", "Pipeline", "Transform", "apply", "register",
+    "get", "names", "backends", "config", "configure",
+    "read_h5ad", "write_h5ad", "read_10x_mtx", "from_scipy", "from_dense",
+]

@@ -1,0 +1,78 @@
+"""Global configuration for sctools-tpu.
+
+Capability parity target: the reference (dpeerlab/sctools) exposes a
+``Transform`` operator registry with a ``backend=`` kwarg (see
+BASELINE.json ``north_star``; the reference source itself was not
+available — /root/reference was empty, see SURVEY.md §0).  This module
+holds the knobs that govern how the TPU backend lays data out on the
+device: block sizes aligned to the MXU/VPU tiling (128 lanes), compute
+dtypes, and interpret-mode fallbacks for running Pallas kernels on CPU
+in tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from contextlib import contextmanager
+
+import jax
+
+
+@dataclasses.dataclass
+class Config:
+    # Row/lane alignment.  TPU vector lanes are 128 wide; float32 tiles
+    # are (8, 128).  All padded dimensions round up to these.
+    lane: int = 128
+    sublane: int = 8
+
+    # Default row-block size for tiled kernels (queries per tile).
+    row_block: int = 1024
+    # Candidate-block size for blocked kNN (columns of the score tile).
+    col_block: int = 2048
+
+    # Compute dtypes.  Stats/accumulation stay float32; matmul inputs
+    # may be bfloat16 (MXU native) with float32 accumulation.
+    dtype: str = "float32"
+    matmul_dtype: str = "float32"  # set to "bfloat16" for speed
+
+    # Run Pallas kernels in interpreter mode (required off-TPU).
+    # "auto" => interpret unless the default backend is a real TPU.
+    pallas_interpret: str = "auto"
+
+    # Capacity rounding for the padded-ELL sparse format.
+    capacity_multiple: int = 128
+
+    def interpret_mode(self) -> bool:
+        if self.pallas_interpret == "auto":
+            return jax.default_backend() not in ("tpu", "axon")
+        return self.pallas_interpret in ("1", "true", "True", True)
+
+
+config = Config()
+
+if os.environ.get("SCTOOLS_TPU_MATMUL_DTYPE"):
+    config.matmul_dtype = os.environ["SCTOOLS_TPU_MATMUL_DTYPE"]
+if os.environ.get("SCTOOLS_TPU_PALLAS_INTERPRET"):
+    config.pallas_interpret = os.environ["SCTOOLS_TPU_PALLAS_INTERPRET"]
+
+
+@contextmanager
+def configure(**kw):
+    """Temporarily override config fields.
+
+    >>> with configure(matmul_dtype="bfloat16"):
+    ...     ...
+    """
+    old = {k: getattr(config, k) for k in kw}
+    try:
+        for k, v in kw.items():
+            setattr(config, k, v)
+        yield config
+    finally:
+        for k, v in old.items():
+            setattr(config, k, v)
+
+
+def round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m

@@ -1,0 +1,8 @@
+from .dataset import CellData
+from .sparse import SparseCells, gene_stats, gene_sum, row_sum, spmm, spmm_t
+from . import io, synthetic
+
+__all__ = [
+    "CellData", "SparseCells", "spmm", "spmm_t", "row_sum", "gene_sum",
+    "gene_stats", "io", "synthetic",
+]

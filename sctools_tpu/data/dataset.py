@@ -1,0 +1,179 @@
+"""``CellData`` — the AnnData-shaped container transforms operate on.
+
+Mirrors the reference's (and AnnData's) field layout so sctools users
+find what they expect:
+
+    X     — counts: SparseCells (device, padded-ELL) / scipy CSR (cpu
+            backend) / dense array
+    obs   — per-cell annotations (dict of (n_cells,) arrays)
+    var   — per-gene annotations (dict of (n_genes,) arrays)
+    obsm  — per-cell matrices (e.g. "X_pca": (n_cells, 50))
+    varm  — per-gene matrices (e.g. "PCs": (n_genes, 50))
+    obsp  — pairwise/graph data (e.g. "knn_indices", "knn_distances",
+            "connectivities")
+    uns   — unstructured results (scalars/small arrays)
+
+Unlike AnnData it is **functional**: transforms return a new CellData
+(``replace``/``with_*`` helpers share unchanged fields).  It is a
+registered pytree — dict keys and X's static metadata ride in the
+treedef — so entire pipelines jit end-to-end on TPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping
+
+import jax
+import numpy as np
+
+from .sparse import SparseCells
+
+
+def _freeze(d: Mapping | None) -> dict:
+    return dict(d) if d else {}
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class CellData:
+    X: Any
+    obs: dict = dataclasses.field(default_factory=dict)
+    var: dict = dataclasses.field(default_factory=dict)
+    obsm: dict = dataclasses.field(default_factory=dict)
+    varm: dict = dataclasses.field(default_factory=dict)
+    obsp: dict = dataclasses.field(default_factory=dict)
+    uns: dict = dataclasses.field(default_factory=dict)
+
+    # ------------------------------------------------------------------
+    def tree_flatten(self):
+        dicts = (self.obs, self.var, self.obsm, self.varm, self.obsp, self.uns)
+        keys = tuple(tuple(sorted(d)) for d in dicts)
+        children = [self.X] + [
+            d[k] for d, ks in zip(dicts, keys) for k in ks
+        ]
+        return children, keys
+
+    @classmethod
+    def tree_unflatten(cls, keys, children):
+        X = children[0]
+        rest = list(children[1:])
+        dicts = []
+        for ks in keys:
+            dicts.append({k: rest.pop(0) for k in ks})
+        return cls(X, *dicts)
+
+    # ------------------------------------------------------------------
+    @property
+    def n_cells(self) -> int:
+        X = self.X
+        if isinstance(X, SparseCells):
+            return X.n_cells
+        return X.shape[0]
+
+    @property
+    def n_genes(self) -> int:
+        X = self.X
+        if isinstance(X, SparseCells):
+            return X.n_genes
+        return X.shape[1]
+
+    @property
+    def shape(self):
+        return (self.n_cells, self.n_genes)
+
+    def replace(self, **kw) -> "CellData":
+        return dataclasses.replace(self, **kw)
+
+    def with_X(self, X) -> "CellData":
+        return self.replace(X=X)
+
+    def with_obs(self, **entries) -> "CellData":
+        return self.replace(obs={**self.obs, **entries})
+
+    def with_var(self, **entries) -> "CellData":
+        return self.replace(var={**self.var, **entries})
+
+    def with_obsm(self, **entries) -> "CellData":
+        return self.replace(obsm={**self.obsm, **entries})
+
+    def with_varm(self, **entries) -> "CellData":
+        return self.replace(varm={**self.varm, **entries})
+
+    def with_obsp(self, **entries) -> "CellData":
+        return self.replace(obsp={**self.obsp, **entries})
+
+    def with_uns(self, **entries) -> "CellData":
+        return self.replace(uns={**self.uns, **entries})
+
+    # ------------------------------------------------------------------
+    def device_put(self, sharding=None) -> "CellData":
+        """Move to device: scipy CSR X is packed to SparseCells first."""
+        import scipy.sparse as sp
+
+        X = self.X
+        if sp.issparse(X):
+            X = SparseCells.from_scipy_csr(X)
+        if isinstance(X, SparseCells):
+            X = X.device_put(sharding)
+        else:
+            X = jax.device_put(np.asarray(X), sharding)
+
+        def put(d):
+            out = {}
+            for k, v in d.items():
+                arr = np.asarray(v) if not isinstance(v, jax.Array) else v
+                if getattr(arr, "dtype", None) is not None and arr.dtype.kind in "biufc":
+                    out[k] = jax.device_put(arr)
+                else:
+                    out[k] = arr  # strings/objects stay host-side
+            return out
+
+        return CellData(
+            X, put(self.obs), put(self.var), put(self.obsm),
+            put(self.varm), put(self.obsp), dict(self.uns),
+        )
+
+    def to_host(self) -> "CellData":
+        """Fetch to numpy.  Per-cell arrays produced by TPU ops carry
+        the padded row count; they are trimmed back to ``n_cells``."""
+        n = self.n_cells
+
+        def fetch(v, trim=False):
+            if isinstance(v, SparseCells):
+                return v.to_scipy_csr()
+            if isinstance(v, jax.Array):
+                v = np.asarray(v)
+            # Per-cell arrays from TPU ops may be padded to any block
+            # multiple (rows_padded, kNN row_block, …) — anything
+            # longer than n_cells is padding.
+            if (trim and isinstance(v, np.ndarray) and v.ndim >= 1
+                    and v.shape[0] > n):
+                v = v[:n]
+            return v
+
+        return CellData(
+            fetch(self.X),
+            {k: fetch(v, trim=True) for k, v in self.obs.items()},
+            {k: fetch(v) for k, v in self.var.items()},
+            {k: fetch(v, trim=True) for k, v in self.obsm.items()},
+            {k: fetch(v) for k, v in self.varm.items()},
+            {k: fetch(v, trim=True) for k, v in self.obsp.items()},
+            {k: fetch(v) for k, v in self.uns.items()},
+        )
+
+    def __repr__(self):
+        def ks(d):
+            return ", ".join(sorted(d)) or "-"
+
+        return (
+            f"CellData(n_cells={self.n_cells}, n_genes={self.n_genes},\n"
+            f"  X={type(self.X).__name__},\n"
+            f"  obs: {ks(self.obs)}\n  var: {ks(self.var)}\n"
+            f"  obsm: {ks(self.obsm)}\n  varm: {ks(self.varm)}\n"
+            f"  obsp: {ks(self.obsp)}\n  uns: {ks(self.uns)})"
+        )
+
+
+def _is_arraylike(v) -> bool:
+    return isinstance(v, (np.ndarray, jax.Array)) or np.isscalar(v)

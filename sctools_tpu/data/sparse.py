@@ -1,0 +1,285 @@
+"""Device-resident sparse count matrices, TPU-first.
+
+The reference (dpeerlab/sctools; source unavailable — SURVEY.md §0)
+stores counts as AnnData CSR shards and the north star asks for
+"device-resident BCOO blocks".  A literal BCOO (coordinate list) is a
+poor fit for the TPU: every op would become a gather/scatter over an
+unpredictable index stream, which XLA cannot tile onto the VPU/MXU.
+
+Instead we use a **padded-ELL** layout, the TPU-native equivalent:
+
+    indices : (rows_padded, capacity) int32  — gene ids, row-major
+    data    : (rows_padded, capacity) float32 — counts
+
+Each cell's nonzeros occupy the leading slots of its row; the rest of
+the row is padding (``index == n_genes`` sentinel, ``value == 0``).
+``capacity`` is the max nnz/row rounded up to a lane multiple (128) and
+``rows_padded`` rounds up to a sublane/sharding multiple.  Benefits:
+
+* **static shapes** — one XLA compilation for any batch of shards;
+* per-cell reductions (library size, QC, normalisation) are dense
+  vectorised ops over the rows — pure VPU work, no scatter;
+* ``X @ V`` (PCA matvec) is a gather of V rows + an einsum — and V is
+  small enough to live in VMEM;
+* ``Xᵀ @ W`` / per-gene stats are a single ``segment_sum`` over the
+  flattened slot array;
+* rows shard cleanly across a device mesh for multi-chip pipelines.
+
+Interop: ``from_scipy_csr``/``to_scipy_csr`` round-trip exactly, and
+``to_bcoo`` produces a ``jax.experimental.sparse.BCOO`` for users who
+want the stock JAX sparse type.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import config, round_up
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class SparseCells:
+    """Padded-ELL sparse matrix of shape ``(n_cells, n_genes)``.
+
+    ``indices``/``data`` may be numpy (host) or jax (device) arrays;
+    ``device_put`` moves them.  Padding slots have ``indices ==
+    n_genes`` (one-past-the-end sentinel) and ``data == 0`` — so a
+    gather from a ``(n_genes+1, d)`` table whose final row is zero
+    silently annihilates padding, and ``segment_sum`` with
+    ``num_segments == n_genes + 1`` accumulates padding into a bin that
+    is then dropped.
+    """
+
+    indices: jax.Array  # (rows_padded, capacity) int32
+    data: jax.Array  # (rows_padded, capacity) float
+    n_cells: int  # static
+    n_genes: int  # static
+
+    # -- pytree protocol (n_cells/n_genes are static aux data) --------
+    def tree_flatten(self):
+        return (self.indices, self.data), (self.n_cells, self.n_genes)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        indices, data = children
+        return cls(indices, data, *aux)
+
+    # -- basic properties ---------------------------------------------
+    @property
+    def shape(self):
+        return (self.n_cells, self.n_genes)
+
+    @property
+    def rows_padded(self) -> int:
+        return self.indices.shape[0]
+
+    @property
+    def capacity(self) -> int:
+        return self.indices.shape[1]
+
+    @property
+    def sentinel(self) -> int:
+        return self.n_genes
+
+    def valid_mask(self) -> jax.Array:
+        """(rows_padded, capacity) bool — True at real nonzero slots."""
+        return self.indices != self.n_genes
+
+    def row_mask(self) -> jax.Array:
+        """(rows_padded,) bool — True for real (non-padding) cells."""
+        return jnp.arange(self.rows_padded) < self.n_cells
+
+    def with_data(self, data: jax.Array) -> "SparseCells":
+        """Same sparsity pattern, new values (functional update)."""
+        return SparseCells(self.indices, data, self.n_cells, self.n_genes)
+
+    def nnz_per_row(self) -> jax.Array:
+        return jnp.sum(self.valid_mask(), axis=1, dtype=jnp.int32)
+
+    def device_put(self, sharding=None) -> "SparseCells":
+        ind = jax.device_put(jnp.asarray(self.indices), sharding)
+        dat = jax.device_put(jnp.asarray(self.data), sharding)
+        return SparseCells(ind, dat, self.n_cells, self.n_genes)
+
+    # -- conversions ---------------------------------------------------
+    @classmethod
+    def from_scipy_csr(
+        cls,
+        csr,
+        capacity: int | None = None,
+        rows_multiple: int | None = None,
+        dtype=None,
+    ) -> "SparseCells":
+        """Pack a ``scipy.sparse.csr_matrix`` into padded-ELL.
+
+        Uses the native C++ packer when available (csrc/scio.cpp),
+        falling back to a vectorised numpy pack.
+        """
+        import scipy.sparse as sp
+
+        if not sp.issparse(csr):
+            raise TypeError(f"expected scipy sparse matrix, got {type(csr)}")
+        csr = csr.tocsr()
+        csr.sort_indices()
+        n_cells, n_genes = csr.shape
+        dtype = dtype or config.dtype
+        nnz = np.diff(csr.indptr)
+        max_nnz = int(nnz.max()) if len(nnz) else 0
+        if capacity is None:
+            capacity = max(round_up(max(max_nnz, 1), config.capacity_multiple),
+                           config.capacity_multiple)
+        elif max_nnz > capacity:
+            raise ValueError(
+                f"capacity={capacity} < max nnz/row={max_nnz}; "
+                "refusing to drop counts"
+            )
+        rows_multiple = rows_multiple or config.sublane
+        rows_padded = round_up(max(n_cells, 1), rows_multiple)
+
+        from ..native import pack_ell  # numpy fallback inside
+
+        indices, data = pack_ell(
+            csr.indptr.astype(np.int64),
+            csr.indices.astype(np.int32),
+            csr.data.astype(dtype),
+            rows_padded,
+            capacity,
+            sentinel=n_genes,
+        )
+        return cls(indices, data, n_cells, n_genes)
+
+    def to_scipy_csr(self):
+        import scipy.sparse as sp
+
+        ind = np.asarray(self.indices)
+        dat = np.asarray(self.data)
+        mask = ind != self.n_genes
+        nnz = mask.sum(axis=1)[: self.n_cells]
+        indptr = np.zeros(self.n_cells + 1, dtype=np.int64)
+        np.cumsum(nnz, out=indptr[1:])
+        rows = np.repeat(np.arange(self.rows_padded), mask.sum(axis=1))
+        keep = rows < self.n_cells
+        return sp.csr_matrix(
+            (dat[mask][keep], ind[mask][keep], indptr),
+            shape=(self.n_cells, self.n_genes),
+        )
+
+    def to_bcoo(self):
+        """Stock ``jax.experimental.sparse.BCOO`` view (padding kept as
+        explicit zeros at column ``n_genes - 1`` is avoided by clamping
+        then relying on zero data)."""
+        from jax.experimental import sparse as jsparse
+
+        rows = jnp.broadcast_to(
+            jnp.arange(self.rows_padded)[:, None], self.indices.shape
+        )
+        cols = jnp.minimum(self.indices, self.n_genes - 1)
+        idx = jnp.stack([rows.ravel(), cols.ravel()], axis=1)
+        return jsparse.BCOO(
+            (self.data.ravel(), idx), shape=(self.rows_padded, self.n_genes)
+        )[: self.n_cells]
+
+    def to_dense(self) -> jax.Array:
+        """Densify (small matrices / tests only)."""
+        table = jnp.zeros((self.rows_padded, self.n_genes + 1), self.data.dtype)
+        table = jax.vmap(lambda t, i, d: t.at[i].add(d))(
+            table, self.indices, self.data
+        )
+        return table[: self.n_cells, : self.n_genes]
+
+    def pad_rows_to(self, rows_padded: int) -> "SparseCells":
+        if rows_padded < self.rows_padded:
+            raise ValueError("cannot shrink row padding below current")
+        if rows_padded == self.rows_padded:
+            return self
+        extra = rows_padded - self.rows_padded
+        ind = jnp.concatenate(
+            [jnp.asarray(self.indices),
+             jnp.full((extra, self.capacity), self.sentinel, jnp.int32)]
+        )
+        dat = jnp.concatenate(
+            [jnp.asarray(self.data),
+             jnp.zeros((extra, self.capacity), self.data.dtype)]
+        )
+        return SparseCells(ind, dat, self.n_cells, self.n_genes)
+
+    def __repr__(self):
+        return (
+            f"SparseCells(shape=({self.n_cells}, {self.n_genes}), "
+            f"padded={self.rows_padded}x{self.capacity}, "
+            f"dtype={self.data.dtype})"
+        )
+
+
+# ----------------------------------------------------------------------
+# Core sparse linear algebra primitives (jittable).
+# ----------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("precision",))
+def spmm(x: SparseCells, v: jax.Array, precision=None) -> jax.Array:
+    """``X @ V`` for padded-ELL ``X`` and dense ``V`` of shape (G, d).
+
+    TPU mapping: gather V rows (V padded with a zero row so sentinel
+    indices vanish), then a slot-reduction einsum — VPU-bound with V
+    resident in VMEM for typical d ≤ 512.
+    """
+    vp = jnp.concatenate([v, jnp.zeros((1, v.shape[1]), v.dtype)], axis=0)
+    gathered = jnp.take(vp, x.indices, axis=0)  # (R, C, d)
+    return jnp.einsum(
+        "rc,rcd->rd", x.data.astype(v.dtype), gathered, precision=precision
+    )
+
+
+@jax.jit
+def spmm_t(x: SparseCells, w: jax.Array) -> jax.Array:
+    """``Xᵀ @ W`` for dense ``W`` of shape (rows_padded, d) → (G, d).
+
+    Padding rows of W must be zero, or use ``x.row_mask()`` upstream.
+    Implemented as one segment-sum over the flattened slot array; the
+    sentinel bin (index G) is dropped.
+    """
+    contrib = x.data[:, :, None] * w[:, None, :]  # (R, C, d)
+    flat_idx = x.indices.ravel()
+    flat = contrib.reshape(-1, w.shape[-1])
+    out = jax.ops.segment_sum(flat, flat_idx, num_segments=x.n_genes + 1)
+    return out[: x.n_genes]
+
+
+@jax.jit
+def row_sum(x: SparseCells) -> jax.Array:
+    """Per-cell total counts, (rows_padded,)."""
+    return jnp.sum(x.data, axis=1)
+
+
+@jax.jit
+def gene_sum(x: SparseCells) -> jax.Array:
+    """Per-gene total counts, (n_genes,)."""
+    flat = x.data.ravel()
+    out = jax.ops.segment_sum(flat, x.indices.ravel(), num_segments=x.n_genes + 1)
+    return out[: x.n_genes]
+
+
+@jax.jit
+def gene_stats(x: SparseCells) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-gene (sum, sum of squares, nnz count) across *valid* cells.
+
+    One fused pass: three segment-sums over the same index stream.
+    Padding rows contribute zeros (their data is zero) except for the
+    nnz count, which masks explicitly.
+    """
+    idx = x.indices.ravel()
+    d = x.data.ravel()
+    valid = (x.valid_mask() & x.row_mask()[:, None]).ravel()
+    stacked = jnp.stack(
+        [d, d * d, valid.astype(d.dtype)], axis=1
+    )  # (R*C, 3)
+    out = jax.ops.segment_sum(stacked, idx, num_segments=x.n_genes + 1)
+    out = out[: x.n_genes]
+    return out[:, 0], out[:, 1], out[:, 2]

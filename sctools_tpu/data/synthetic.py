@@ -1,0 +1,116 @@
+"""Synthetic single-cell count data for tests and benchmarks.
+
+Generates negative-binomial-ish sparse count matrices with realistic
+structure: per-gene mean rates drawn from a lognormal (a few highly
+expressed genes, a long tail), per-cell library-size variation, and a
+configurable fraction of mitochondrial genes (names prefixed "MT-") so
+QC metrics have something to measure.  Cluster structure (for kNN /
+clustering tests) comes from mixing ``n_clusters`` distinct gene-program
+rate vectors.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.sparse as sp
+
+from .dataset import CellData
+
+
+def synthetic_counts(
+    n_cells: int,
+    n_genes: int,
+    *,
+    density: float = 0.05,
+    n_clusters: int = 1,
+    mito_frac: float = 0.01,
+    seed: int = 0,
+    dtype=np.float32,
+) -> CellData:
+    """Host-side CellData with scipy CSR counts + gene names.
+
+    ``density`` is the expected nnz fraction per cell.
+    """
+    rng = np.random.default_rng(seed)
+    n_mito = max(1, int(n_genes * mito_frac)) if mito_frac > 0 else 0
+
+    # Per-cluster gene programs: lognormal base rates, cluster-specific
+    # multipliers on a random subset of genes.
+    base = rng.lognormal(mean=0.0, sigma=1.5, size=n_genes)
+    programs = np.tile(base, (n_clusters, 1))
+    for c in range(1, n_clusters):
+        boost = rng.choice(n_genes, size=max(1, n_genes // 20), replace=False)
+        programs[c, boost] *= rng.uniform(3.0, 10.0, size=len(boost))
+    programs /= programs.sum(axis=1, keepdims=True)
+
+    labels = rng.integers(0, n_clusters, size=n_cells)
+    lib = rng.lognormal(mean=0.0, sigma=0.4, size=n_cells)
+
+    target_nnz = int(density * n_genes)
+    rows, cols, vals = [], [], []
+    # Vectorised generation in chunks to bound memory.
+    chunk = max(1, min(n_cells, 200_000_000 // max(target_nnz, 1) // 8))
+    for start in range(0, n_cells, chunk):
+        stop = min(n_cells, start + chunk)
+        m = stop - start
+        nnz = np.maximum(
+            1, rng.poisson(target_nnz * lib[start:stop])
+        ).astype(np.int64)
+        nnz = np.minimum(nnz, n_genes)
+        total = int(nnz.sum())
+        row_idx = np.repeat(np.arange(start, stop), nnz)
+        # Sample gene ids per cell from its cluster's program, with ONE
+        # flat searchsorted: each row's cdf lives in [0,1], so shifting
+        # row r's cdf (and its uniforms) by 2r keeps rows sorted and
+        # disjoint in a single global array — no Python-level per-cell
+        # loop (10M cells would take hours otherwise).
+        p = programs[labels[start:stop]]  # (m, n_genes)
+        cdf = np.cumsum(p, axis=1)
+        local_row = np.repeat(np.arange(m), nnz)
+        flat_cdf = (cdf + 2.0 * np.arange(m)[:, None]).ravel()
+        u = rng.random(total) + 2.0 * local_row
+        gene_idx = (np.searchsorted(flat_cdf, u) - local_row * n_genes).astype(
+            np.int32
+        )
+        gene_idx = np.clip(gene_idx, 0, n_genes - 1)
+        count = rng.geometric(0.4, size=total).astype(dtype)
+        rows.append(row_idx)
+        cols.append(gene_idx)
+        vals.append(count)
+
+    coo = sp.coo_matrix(
+        (np.concatenate(vals), (np.concatenate(rows), np.concatenate(cols))),
+        shape=(n_cells, n_genes),
+    )
+    coo.sum_duplicates()
+    X = coo.tocsr()
+
+    gene_names = np.array(
+        [f"MT-{i}" if i < n_mito else f"GENE{i}" for i in range(n_genes)]
+    )
+    return CellData(
+        X,
+        obs={"cluster_true": labels.astype(np.int32)},
+        var={"gene_name": gene_names,
+             "mito": (np.arange(n_genes) < n_mito)},
+    )
+
+
+def gaussian_blobs(
+    n_points: int,
+    dim: int,
+    n_clusters: int = 5,
+    *,
+    spread: float = 0.2,
+    seed: int = 0,
+    dtype=np.float32,
+):
+    """Dense clustered points for kNN/kmeans tests.
+
+    Returns (points (n, dim), labels (n,)).
+    """
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(n_clusters, dim)).astype(dtype)
+    labels = rng.integers(0, n_clusters, size=n_points)
+    pts = centers[labels] + spread * rng.normal(size=(n_points, dim)).astype(dtype)
+    return pts.astype(dtype), labels.astype(np.int32)

@@ -1,0 +1,142 @@
+"""Native (C++) runtime components with pure-numpy fallbacks.
+
+The reference framework's IO/packing hot loops are native; here the
+C++ library lives in ``csrc/`` and is loaded via ctypes.  Every entry
+point has a numpy fallback so the package works before the library is
+built (``make -C csrc``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+_LIB = None
+_LIB_TRIED = False
+
+
+def _load_lib():
+    global _LIB, _LIB_TRIED
+    if _LIB_TRIED:
+        return _LIB
+    _LIB_TRIED = True
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    for cand in (
+        os.path.join(here, "csrc", "libscio.so"),
+        os.path.join(os.path.dirname(__file__), "libscio.so"),
+    ):
+        if os.path.exists(cand):
+            try:
+                lib = ctypes.CDLL(cand)
+                lib.scio_pack_ell_f32.restype = None
+                lib.scio_pack_ell_f32.argtypes = [
+                    ctypes.POINTER(ctypes.c_int64),  # indptr
+                    ctypes.POINTER(ctypes.c_int32),  # col indices
+                    ctypes.POINTER(ctypes.c_float),  # data
+                    ctypes.c_int64,  # n_rows
+                    ctypes.c_int64,  # rows_padded
+                    ctypes.c_int64,  # capacity
+                    ctypes.c_int32,  # sentinel
+                    ctypes.POINTER(ctypes.c_int32),  # out indices
+                    ctypes.POINTER(ctypes.c_float),  # out data
+                ]
+                _LIB = lib
+                break
+            except OSError:
+                continue
+    return _LIB
+
+
+def have_native() -> bool:
+    return _load_lib() is not None
+
+
+def pack_ell(indptr, col_indices, data, rows_padded, capacity, sentinel):
+    """CSR → padded-ELL.  Returns (indices, values) numpy arrays of
+    shape (rows_padded, capacity)."""
+    n_rows = len(indptr) - 1
+    lib = _load_lib()
+    if lib is not None and data.dtype == np.float32:
+        out_idx = np.full((rows_padded, capacity), sentinel, dtype=np.int32)
+        out_val = np.zeros((rows_padded, capacity), dtype=np.float32)
+        indptr = np.ascontiguousarray(indptr, dtype=np.int64)
+        col_indices = np.ascontiguousarray(col_indices, dtype=np.int32)
+        data = np.ascontiguousarray(data, dtype=np.float32)
+        lib.scio_pack_ell_f32(
+            indptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            col_indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n_rows,
+            rows_padded,
+            capacity,
+            sentinel,
+            out_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            out_val.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        )
+        return out_idx, out_val
+    return _pack_ell_numpy(indptr, col_indices, data, rows_padded, capacity, sentinel)
+
+
+def _pack_ell_numpy(indptr, col_indices, data, rows_padded, capacity, sentinel):
+    n_rows = len(indptr) - 1
+    nnz = np.diff(indptr)
+    out_idx = np.full((rows_padded, capacity), sentinel, dtype=np.int32)
+    out_val = np.zeros((rows_padded, capacity), dtype=data.dtype)
+    # Vectorised scatter: slot position of each nonzero within its row.
+    rows = np.repeat(np.arange(n_rows), nnz)
+    slots = np.arange(len(col_indices)) - np.repeat(indptr[:-1], nnz)
+    out_idx[rows, slots] = col_indices
+    out_val[rows, slots] = data
+    return out_idx, out_val
+
+
+def parse_mtx(path):
+    """Parse a MatrixMarket .mtx file → (n_rows, n_cols, rows, cols, vals).
+
+    Native fast path when built; numpy/scipy fallback otherwise.
+    """
+    lib = _load_lib()
+    if lib is not None and hasattr(lib, "scio_parse_mtx"):
+        return _parse_mtx_native(lib, path)
+    import scipy.io
+
+    m = scipy.io.mmread(path).tocoo()
+    return m.shape[0], m.shape[1], m.row, m.col, m.data
+
+
+def _parse_mtx_native(lib, path):
+    lib.scio_parse_mtx.restype = ctypes.c_int64
+    lib.scio_parse_mtx.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    nr = ctypes.c_int64()
+    nc = ctypes.c_int64()
+    nnz = ctypes.c_int64()
+    handle = lib.scio_parse_mtx(
+        path.encode(), ctypes.byref(nr), ctypes.byref(nc), ctypes.byref(nnz)
+    )
+    if handle < 0:
+        raise IOError(f"native mtx parse failed for {path}")
+    n = nnz.value
+    rows = np.empty(n, dtype=np.int32)
+    cols = np.empty(n, dtype=np.int32)
+    vals = np.empty(n, dtype=np.float32)
+    lib.scio_fetch_mtx.restype = None
+    lib.scio_fetch_mtx.argtypes = [
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_float),
+    ]
+    lib.scio_fetch_mtx(
+        handle,
+        rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    return nr.value, nc.value, rows, cols, vals

@@ -1,0 +1,4 @@
+"""Operator implementations.  Importing this package registers every
+transform with the registry (both cpu and tpu backends)."""
+
+from . import distance, hvg, knn, normalize, pca, qc  # noqa: F401

@@ -1,0 +1,75 @@
+"""Pairwise distance matrices: ``distance.pairwise``.
+
+Reference parity: the ``distance.pairwise`` (cosine/Euclidean) op named
+in BASELINE.json's north star.  Materialises the full (n_query ×
+n_cand) matrix, so it is meant for small/medium n; the kNN path
+(``neighbors.knn``) never materialises it.  The compute is one blocked
+MXU matmul either way.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import config
+from ..data.dataset import CellData
+from ..registry import register
+from .knn import _get_rep, _get_rep_cpu
+
+
+def pairwise_arrays(query, cand, metric: str = "cosine"):
+    """Full distance matrix (n_query, n_cand), float32.  Resolves the
+    matmul dtype from config outside jit (see knn_arrays)."""
+    return _pairwise_jit(query, cand, metric=metric,
+                         mm_dtype=str(jnp.dtype(config.matmul_dtype)))
+
+
+@partial(jax.jit, static_argnames=("metric", "mm_dtype"))
+def _pairwise_jit(query, cand, *, metric, mm_dtype):
+    q = jnp.asarray(query, jnp.dtype(mm_dtype))
+    c = jnp.asarray(cand, jnp.dtype(mm_dtype))
+    if metric == "cosine":
+        q = q / jnp.maximum(jnp.linalg.norm(q, axis=1, keepdims=True), 1e-12)
+        c = c / jnp.maximum(jnp.linalg.norm(c, axis=1, keepdims=True), 1e-12)
+        return 1.0 - jnp.dot(q, c.T, preferred_element_type=jnp.float32)
+    if metric == "euclidean":
+        qn2 = jnp.sum(q.astype(jnp.float32) ** 2, axis=1)
+        cn2 = jnp.sum(c.astype(jnp.float32) ** 2, axis=1)
+        d2 = qn2[:, None] - 2.0 * jnp.dot(
+            q, c.T, preferred_element_type=jnp.float32
+        ) + cn2[None, :]
+        return jnp.sqrt(jnp.maximum(d2, 0.0))
+    raise ValueError(f"unknown metric {metric!r}")
+
+
+@register("distance.pairwise", backend="tpu")
+def pairwise_tpu(data: CellData, metric: str = "cosine",
+                 use_rep: str = "X_pca") -> CellData:
+    """Adds obsp["pairwise_distances"]."""
+    rep = _get_rep(data, use_rep)
+    D = pairwise_arrays(rep, rep, metric=metric)
+    D = D[: data.n_cells, : data.n_cells]
+    return data.with_obsp(pairwise_distances=D).with_uns(
+        pairwise_metric=metric
+    )
+
+
+@register("distance.pairwise", backend="cpu")
+def pairwise_cpu(data: CellData, metric: str = "cosine",
+                 use_rep: str = "X_pca") -> CellData:
+    rep = np.asarray(_get_rep_cpu(data, use_rep), np.float64)
+    if metric == "cosine":
+        rn = rep / np.maximum(np.linalg.norm(rep, axis=1, keepdims=True), 1e-12)
+        D = 1.0 - rn @ rn.T
+    elif metric == "euclidean":
+        n2 = (rep**2).sum(axis=1)
+        D = np.sqrt(np.maximum(n2[:, None] - 2 * rep @ rep.T + n2[None, :], 0.0))
+    else:
+        raise ValueError(f"unknown metric {metric!r}")
+    return data.with_obsp(pairwise_distances=D.astype(np.float32)).with_uns(
+        pairwise_metric=metric
+    )

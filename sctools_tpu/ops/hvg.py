@@ -1,0 +1,309 @@
+"""Highly-variable-gene selection: ``hvg.select``.
+
+Reference parity: BASELINE.json configs[2] — Seurat-v3 flavor on raw
+counts.  Flavors:
+
+* ``"seurat_v3"`` — variance-stabilising: per-gene mean/variance of raw
+  counts, a quadratic fit of log10(var) vs log10(mean) replaces the
+  reference loess (documented divergence: loess is not expressible as a
+  fixed-shape XLA program; the quadratic fit tracks it closely on
+  log-log scale and both backends implement the *same* math so parity
+  is exact between cpu and tpu), then clipped standardised variance
+  ranks genes.
+* ``"dispersion"`` (Seurat v1) — on log-normalised data: dispersion =
+  var/mean, z-scored within 20 mean-bins.
+
+On TPU the per-gene moments come from one fused ``segment_sum`` pass
+over the ELL slots (``gene_stats``); the clipped second pass is a
+second segment-sum.  Everything else is O(G) work.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import config, round_up
+from ..data.dataset import CellData
+from ..data.sparse import SparseCells
+from ..registry import register
+
+
+# ----------------------------------------------------------------------
+# Gene subsetting (shared with qc.filter_genes).
+# ----------------------------------------------------------------------
+
+
+def subset_genes_sparse(x: SparseCells, gene_idx: np.ndarray,
+                        capacity: int | None = None) -> SparseCells:
+    """Device-side gene subset of a padded-ELL matrix.
+
+    Builds an old→new gene-id map (dropped genes → sentinel) and
+    remaps the slot indices.  The sparsity pattern only loses entries,
+    so existing capacity always suffices; pass ``capacity`` to re-pack
+    tighter (host round-trip is avoided by keeping slots in place and
+    relying on sentinel annihilation).
+    """
+    gene_idx = np.asarray(gene_idx)
+    g_new = len(gene_idx)
+    mapping = np.full(x.n_genes + 1, g_new, dtype=np.int32)  # new sentinel
+    mapping[gene_idx] = np.arange(g_new, dtype=np.int32)
+    mapping = jnp.asarray(mapping)
+    new_ind = jnp.take(mapping, x.indices, axis=0)
+    new_dat = jnp.where(new_ind == g_new, 0.0, x.data)
+    out = SparseCells(new_ind, new_dat, x.n_cells, g_new)
+    if capacity is not None and capacity < x.capacity:
+        out = _compact_capacity(out, capacity)
+    return out
+
+
+def _compact_capacity(x: SparseCells, capacity: int) -> SparseCells:
+    """Shift valid slots left (stable) and truncate to ``capacity``.
+
+    Jittable: an argsort on the "is padding" flag per row is a stable
+    left-compaction.
+    """
+    capacity = round_up(capacity, config.capacity_multiple)
+    is_pad = (x.indices == x.sentinel).astype(jnp.int32)
+    order = jnp.argsort(is_pad, axis=1, stable=True)
+    ind = jnp.take_along_axis(x.indices, order, axis=1)[:, :capacity]
+    dat = jnp.take_along_axis(x.data, order, axis=1)[:, :capacity]
+    return SparseCells(ind, dat, x.n_cells, x.n_genes)
+
+
+def select_genes_device(data: CellData, gene_idx: np.ndarray,
+                        compact: bool = False) -> CellData:
+    """Subset a CellData to ``gene_idx`` (device path)."""
+    X = data.X
+    gene_idx = np.asarray(gene_idx)
+    if isinstance(X, SparseCells):
+        cap = None
+        if compact:
+            # safe upper bound on new nnz/row: min(old capacity, g_new)
+            cap = min(X.capacity, round_up(max(len(gene_idx), 1),
+                                           config.capacity_multiple))
+        newX = subset_genes_sparse(X, gene_idx, capacity=cap)
+    else:
+        newX = jnp.take(jnp.asarray(X), jnp.asarray(gene_idx), axis=1)
+    def take(v):
+        if isinstance(v, jax.Array) or np.asarray(v).dtype.kind in "biufc":
+            return jnp.take(jnp.asarray(v), jnp.asarray(gene_idx), axis=0)
+        return np.asarray(v)[gene_idx]  # strings/objects stay host-side
+    var = {k: take(v) for k, v in data.var.items()}
+    varm = {k: take(v) for k, v in data.varm.items()}
+    return data.replace(X=newX, var=var, varm=varm)
+
+
+# ----------------------------------------------------------------------
+# Moments
+# ----------------------------------------------------------------------
+
+
+def _gene_moments_tpu(X):
+    """Per-gene mean, (ddof=1) variance, and nnz over cells;
+    sparse-aware.  One segment-sum pass covers all three."""
+    if isinstance(X, SparseCells):
+        from ..data.sparse import gene_stats
+
+        s, ss, nnz = gene_stats(X)
+        n = X.n_cells
+        mean = s / n
+        var = (ss - n * mean**2) / max(n - 1, 1)
+    else:
+        X = jnp.asarray(X)
+        n = X.shape[0]
+        mean = jnp.mean(X, axis=0)
+        var = jnp.var(X, axis=0, ddof=1)
+        nnz = jnp.sum(X != 0, axis=0).astype(mean.dtype)
+    return mean, jnp.maximum(var, 0.0), nnz
+
+
+def _gene_moments_cpu(X) -> tuple[np.ndarray, np.ndarray]:
+    import scipy.sparse as sp
+
+    if sp.issparse(X):
+        X = X.tocsr()
+        n = X.shape[0]
+        s = np.asarray(X.sum(axis=0)).ravel()
+        ss = np.asarray(X.multiply(X).sum(axis=0)).ravel()
+        mean = s / n
+        var = (ss - n * mean**2) / max(n - 1, 1)
+    else:
+        X = np.asarray(X)
+        mean = X.mean(axis=0)
+        var = X.var(axis=0, ddof=1)
+    return mean.astype(np.float64), np.maximum(var, 0.0).astype(np.float64)
+
+
+# ----------------------------------------------------------------------
+# seurat_v3 standardised variance (shared math, two array namespaces)
+# ----------------------------------------------------------------------
+
+
+def _fit_mean_var_trend(mean, var, xp):
+    """Quadratic fit of log10(var) ~ log10(mean) over expressed genes.
+
+    Returns predicted variance per gene (clipped positive).
+    """
+    expressed = (mean > 0) & (var > 0)
+    lm = xp.log10(xp.where(mean > 0, mean, 1.0))
+    lv = xp.log10(xp.where(var > 0, var, 1.0))
+    w = expressed.astype(lm.dtype)
+    # Standardise the regressor first: the raw [1, lm, lm²] normal
+    # equations are too ill-conditioned for float32 (TPU) to match the
+    # float64 oracle.
+    wsum = xp.maximum(xp.sum(w), 1.0)
+    m0 = xp.sum(lm * w) / wsum
+    s0 = xp.sqrt(xp.maximum(xp.sum(w * (lm - m0) ** 2) / wsum, 1e-12))
+    t = (lm - m0) / s0
+    A = xp.stack([xp.ones_like(t), t, t * t], axis=1)
+    Aw = A * w[:, None]
+    G = Aw.T @ A
+    b = Aw.T @ lv
+    coef = xp.linalg.solve(G + 1e-6 * xp.eye(3, dtype=lm.dtype), b)
+    pred = A @ coef
+    return xp.power(10.0, pred)
+
+
+def _seurat_v3_scores_from_stats(mean, var, clipped_ssq, n, xp):
+    """Standardised variance given the clipped second moment."""
+    std_var = clipped_ssq / max(n - 1, 1)
+    return xp.where((mean > 0) & (var > 0), std_var, 0.0)
+
+
+@register("hvg.select", backend="tpu")
+def hvg_select_tpu(data: CellData, n_top: int = 2000,
+                   flavor: str = "seurat_v3", subset: bool = False,
+                   compact: bool = True) -> CellData:
+    """Rank genes by variability; adds var: ``highly_variable``,
+    ``hvg_rank``, ``hvg_score`` (+ ``means``/``variances``).  With
+    ``subset=True`` returns the gene-subset CellData (materialisation
+    point, like the reference's shard repack)."""
+    X = data.X
+    if flavor == "seurat_v3":
+        mean, var, nnz = _gene_moments_tpu(X)
+        n = data.n_cells
+        reg_var = _fit_mean_var_trend(mean, var, jnp)
+        reg_std = jnp.sqrt(reg_var)
+        clip = jnp.sqrt(jnp.asarray(float(n)))
+        if isinstance(X, SparseCells):
+            # clipped standardised second moment via one segment pass:
+            # sum_c min(clip, (x - mu)/sigma)^2 =
+            #   [nnz terms] + (n - nnz) * (mu/sigma)^2   (zeros clip too,
+            #   but mu/sigma is tiny so the zero term is (0-mu)/sigma).
+            std = jnp.maximum(reg_std, 1e-12)
+            table_mu = jnp.concatenate([mean / std, jnp.zeros((1,))])
+            table_inv = jnp.concatenate([1.0 / std, jnp.zeros((1,))])
+            zval = jnp.take(table_inv, X.indices, axis=0) * X.data - jnp.take(
+                table_mu, X.indices, axis=0
+            )
+            zval = jnp.clip(zval, -clip, clip)
+            contrib = jnp.where(
+                X.valid_mask() & X.row_mask()[:, None], zval * zval, 0.0
+            )
+            ssq_nnz = jax.ops.segment_sum(
+                contrib.ravel(), X.indices.ravel(), num_segments=X.n_genes + 1
+            )[: X.n_genes]
+            zero_term = jnp.clip(-mean / std, -clip, clip) ** 2
+            ssq = ssq_nnz + (n - nnz) * zero_term
+        else:
+            Xd = jnp.asarray(X)
+            z = (Xd - mean) / jnp.maximum(reg_std, 1e-12)
+            z = jnp.clip(z, -clip, clip)
+            ssq = jnp.sum(z * z, axis=0)
+        score = _seurat_v3_scores_from_stats(mean, var, ssq, n, jnp)
+    elif flavor == "dispersion":
+        mean, var, _ = _gene_moments_tpu(X)
+        score = _dispersion_scores(mean, var, jnp)
+    else:
+        raise ValueError(f"unknown hvg flavor {flavor!r}")
+
+    order = jnp.argsort(-score, stable=True)
+    rank = jnp.empty_like(order).at[order].set(jnp.arange(data.n_genes))
+    highly = rank < n_top
+    out = data.with_var(
+        highly_variable=highly, hvg_rank=rank.astype(jnp.int32),
+        hvg_score=score, means=mean, variances=var,
+    )
+    if subset:
+        top_idx = np.sort(np.asarray(order[:n_top]))
+        out = select_genes_device(out, top_idx, compact=compact)
+    return out
+
+
+@register("hvg.select", backend="cpu")
+def hvg_select_cpu(data: CellData, n_top: int = 2000,
+                   flavor: str = "seurat_v3", subset: bool = False,
+                   compact: bool = True) -> CellData:
+    import scipy.sparse as sp
+
+    X = data.X
+    mean, var = _gene_moments_cpu(X)
+    n = data.n_cells
+    if flavor == "seurat_v3":
+        reg_var = _fit_mean_var_trend(mean, var, np)
+        reg_std = np.sqrt(reg_var)
+        clip = np.sqrt(float(n))
+        std = np.maximum(reg_std, 1e-12)
+        if sp.issparse(X):
+            Xc = X.tocsc()
+            nnz = np.diff(Xc.indptr)
+            zero_term = np.clip(-mean / std, -clip, clip) ** 2
+            z = (Xc.data - np.repeat(mean, nnz)) / np.repeat(std, nnz)
+            z = np.clip(z, -clip, clip)
+            ssq = np.zeros(data.n_genes)
+            np.add.at(ssq, np.repeat(np.arange(data.n_genes), nnz), z * z)
+            ssq += (n - nnz) * zero_term
+        else:
+            Xd = np.asarray(X)
+            z = np.clip((Xd - mean) / std, -clip, clip)
+            ssq = (z * z).sum(axis=0)
+        score = _seurat_v3_scores_from_stats(mean, var, ssq, n, np)
+    elif flavor == "dispersion":
+        score = _dispersion_scores(mean, var, np)
+    else:
+        raise ValueError(f"unknown hvg flavor {flavor!r}")
+
+    order = np.argsort(-score, kind="stable")
+    rank = np.empty_like(order)
+    rank[order] = np.arange(data.n_genes)
+    highly = rank < n_top
+    out = data.with_var(
+        highly_variable=highly, hvg_rank=rank.astype(np.int32),
+        hvg_score=score.astype(np.float32),
+        means=mean.astype(np.float32), variances=var.astype(np.float32),
+    )
+    if subset:
+        idx = np.sort(order[:n_top])
+        Xs = X[:, idx] if not sp.issparse(X) else X.tocsc()[:, idx].tocsr()
+        var_d = {k: np.asarray(v)[idx] for k, v in out.var.items()}
+        varm = {k: np.asarray(v)[idx] for k, v in out.varm.items()}
+        out = out.replace(X=Xs, var=var_d, varm=varm)
+    return out
+
+
+def _dispersion_scores(mean, var, xp, n_bins: int = 20):
+    """Seurat-v1 dispersion: var/mean, z-scored within mean bins."""
+    disp = xp.where(mean > 0, var / xp.maximum(mean, 1e-12), 0.0)
+    logm = xp.log1p(mean)
+    lo = xp.min(logm)
+    hi = xp.max(logm) + 1e-6
+    bins = xp.clip(((logm - lo) / (hi - lo) * n_bins).astype(xp.int32), 0, n_bins - 1)
+    if xp is np:
+        m = np.zeros(n_bins)
+        s = np.zeros(n_bins)
+        cnt = np.zeros(n_bins)
+        np.add.at(cnt, bins, 1.0)
+        np.add.at(m, bins, disp)
+        np.add.at(s, bins, disp * disp)
+    else:
+        one = xp.ones_like(disp)
+        cnt = jax.ops.segment_sum(one, bins, num_segments=n_bins)
+        m = jax.ops.segment_sum(disp, bins, num_segments=n_bins)
+        s = jax.ops.segment_sum(disp * disp, bins, num_segments=n_bins)
+    cnt = xp.maximum(cnt, 1.0)
+    bmean = m / cnt
+    bvar = xp.maximum(s / cnt - bmean**2, 1e-12)
+    bstd = xp.sqrt(bvar)
+    return (disp - bmean[bins]) / bstd[bins]

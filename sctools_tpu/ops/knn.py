@@ -1,0 +1,253 @@
+"""k-nearest-neighbour graph construction: ``neighbors.knn``.
+
+Reference parity: BASELINE.json configs[3] — "cosine kNN(k=15) on 1.3M
+cells, single chip"; configs[4] extends to multi-chip
+(``sctools_tpu.parallel``).
+
+TPU design (single chip): brute-force blocked kNN.  The score tile
+``Q_blk @ C_blkᵀ`` is an MXU matmul (optionally bfloat16 inputs with
+float32 accumulation); the running top-k merge per candidate block is
+``lax.top_k`` over ``k + col_block`` columns.  ``lax.map`` over query
+blocks bounds live memory to one (row_block × col_block) tile, so the
+full N×N distance matrix never exists in HBM.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import config, round_up
+from ..data.dataset import CellData
+from ..registry import register
+
+
+def _prep(points, metric, dtype):
+    points = jnp.asarray(points)
+    if metric == "cosine":
+        norms = jnp.linalg.norm(points, axis=1, keepdims=True)
+        points = points / jnp.maximum(norms, 1e-12)
+    return points.astype(dtype)
+
+
+def knn_arrays(
+    query: jax.Array,
+    cand: jax.Array,
+    *,
+    k: int = 15,
+    metric: str = "cosine",
+    n_query: int | None = None,
+    n_cand: int | None = None,
+    query_block: int | None = None,
+    cand_block: int | None = None,
+    exclude_self: bool = False,
+):
+    """Exact kNN of ``query`` rows against ``cand`` rows.
+
+    Returns (indices (n_query_padded, k) int32, distances (…, k)).
+    Distances: cosine -> 1 - cos_sim, euclidean -> L2 distance; sorted
+    ascending.  Padding queries return index -1 rows at the end.
+    ``exclude_self`` drops matches where global ids coincide (use only
+    when query is cand).
+
+    Config (block sizes, matmul dtype) is resolved *here*, outside
+    jit, and passed down as static arguments — so ``configure(...)``
+    changes take effect instead of being baked into a cached trace.
+    """
+    if metric not in ("cosine", "euclidean"):
+        raise ValueError(f"unknown metric {metric!r}")
+    return _knn_jit(
+        query, cand, k=k, metric=metric,
+        n_query=n_query or query.shape[0],
+        n_cand=n_cand or cand.shape[0],
+        qb=query_block or config.row_block,
+        cb=cand_block or config.col_block,
+        mm_dtype=str(jnp.dtype(config.matmul_dtype)),
+        exclude_self=exclude_self,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("k", "metric", "qb", "cb", "n_query", "n_cand",
+                     "mm_dtype", "exclude_self"),
+)
+def _knn_jit(query, cand, *, k, metric, n_query, n_cand, qb, cb,
+             mm_dtype, exclude_self):
+    mm_dtype = jnp.dtype(mm_dtype)
+    d = query.shape[1]
+    nq_pad = round_up(n_query, qb)
+    nc_pad = round_up(n_cand, cb)
+    q = jnp.zeros((nq_pad, d), query.dtype).at[: query.shape[0]].set(query)
+    c = jnp.zeros((nc_pad, d), cand.dtype).at[: cand.shape[0]].set(cand)
+    q = _prep(q, metric, mm_dtype)
+    c = _prep(c, metric, mm_dtype)
+
+    c_blocks = c.reshape(nc_pad // cb, cb, d)
+    if metric == "euclidean":
+        cn2_blocks = jnp.sum(
+            c_blocks.astype(jnp.float32) ** 2, axis=2
+        )  # (ncb, cb)
+    else:
+        cn2_blocks = jnp.zeros((nc_pad // cb, cb), jnp.float32)
+    offsets = jnp.arange(nc_pad // cb, dtype=jnp.int32) * cb
+    col_iota = jnp.arange(cb, dtype=jnp.int32)
+
+    def per_qblock(args):
+        qblk, q_ids = args  # (qb, d), (qb,)
+        if metric == "euclidean":
+            qn2 = jnp.sum(qblk.astype(jnp.float32) ** 2, axis=1)
+
+        def body(carry, inp):
+            bvals, bidx = carry
+            cblk, cn2, off = inp
+            s = jnp.dot(
+                qblk, cblk.T, preferred_element_type=jnp.float32
+            )  # (qb, cb) similarity-like
+            if metric == "euclidean":
+                s = -(qn2[:, None] - 2.0 * s + cn2[None, :])
+            gcol = off + col_iota  # (cb,)
+            invalid = gcol >= n_cand
+            s = jnp.where(invalid[None, :], -jnp.inf, s)
+            if exclude_self:
+                s = jnp.where(gcol[None, :] == q_ids[:, None], -jnp.inf, s)
+            allv = jnp.concatenate([bvals, s], axis=1)
+            alli = jnp.concatenate(
+                [bidx, jnp.broadcast_to(gcol[None, :], s.shape)], axis=1
+            )
+            v, sel = jax.lax.top_k(allv, k)
+            i = jnp.take_along_axis(alli, sel, axis=1)
+            return (v, i), None
+
+        init = (
+            jnp.full((qb, k), -jnp.inf, jnp.float32),
+            jnp.full((qb, k), -1, jnp.int32),
+        )
+        (v, i), _ = jax.lax.scan(body, init, (c_blocks, cn2_blocks, offsets))
+        return v, i
+
+    q_ids_all = jnp.arange(nq_pad, dtype=jnp.int32)
+    vals, idxs = jax.lax.map(
+        per_qblock,
+        (q.reshape(nq_pad // qb, qb, d), q_ids_all.reshape(nq_pad // qb, qb)),
+    )
+    vals = vals.reshape(nq_pad, k)
+    idxs = idxs.reshape(nq_pad, k)
+    if metric == "cosine":
+        dists = 1.0 - vals
+    else:
+        dists = jnp.sqrt(jnp.maximum(-vals, 0.0))
+    qvalid = jnp.arange(nq_pad) < n_query
+    idxs = jnp.where(qvalid[:, None], idxs, -1)
+    return idxs, dists
+
+
+@register("neighbors.knn", backend="tpu")
+def knn_tpu(data: CellData, k: int = 15, metric: str = "cosine",
+            use_rep: str = "X_pca", exclude_self: bool = False,
+            query_block: int | None = None,
+            cand_block: int | None = None) -> CellData:
+    """Adds obsp["knn_indices"], obsp["knn_distances"]; uns["knn_k"],
+    uns["knn_metric"]."""
+    rep = _get_rep(data, use_rep)
+    idx, dist = knn_arrays(
+        rep, rep, k=k, metric=metric, n_query=data.n_cells,
+        n_cand=data.n_cells, exclude_self=exclude_self,
+        query_block=query_block, cand_block=cand_block,
+    )
+    return data.with_obsp(knn_indices=idx, knn_distances=dist).with_uns(
+        knn_k=k, knn_metric=metric
+    )
+
+
+def _get_rep(data: CellData, use_rep: str):
+    if use_rep == "X":
+        X = data.X
+        from ..data.sparse import SparseCells
+
+        if isinstance(X, SparseCells):
+            raise ValueError(
+                "neighbors.knn on raw sparse X is not supported; run "
+                "pca.randomized first (use_rep='X_pca')"
+            )
+        return jnp.asarray(X) if not isinstance(X, np.ndarray) else X
+    if use_rep not in data.obsm:
+        raise ValueError(
+            f"use_rep={use_rep!r} not in obsm ({sorted(data.obsm)}); "
+            "run pca.randomized first"
+        )
+    return data.obsm[use_rep]
+
+
+@register("neighbors.knn", backend="cpu")
+def knn_cpu(data: CellData, k: int = 15, metric: str = "cosine",
+            use_rep: str = "X_pca", exclude_self: bool = False,
+            **_ignored) -> CellData:
+    """Brute-force numpy oracle (chunked; exact)."""
+    rep = np.asarray(_get_rep_cpu(data, use_rep), dtype=np.float64)
+    idx, dist = knn_numpy(rep, rep, k=k, metric=metric,
+                          exclude_self=exclude_self)
+    return data.with_obsp(knn_indices=idx, knn_distances=dist).with_uns(
+        knn_k=k, knn_metric=metric
+    )
+
+
+def _get_rep_cpu(data: CellData, use_rep: str):
+    import scipy.sparse as sp
+
+    if use_rep == "X":
+        X = data.X
+        return np.asarray(X.todense()) if sp.issparse(X) else np.asarray(X)
+    return np.asarray(data.obsm[use_rep])
+
+
+def knn_numpy(query, cand, k=15, metric="cosine", exclude_self=False,
+              chunk=4096):
+    """Exact brute-force kNN in numpy — the recall oracle."""
+    query = np.asarray(query, np.float64)
+    cand = np.asarray(cand, np.float64)
+    if metric == "cosine":
+        qn = query / np.maximum(np.linalg.norm(query, axis=1, keepdims=True), 1e-12)
+        cn = cand / np.maximum(np.linalg.norm(cand, axis=1, keepdims=True), 1e-12)
+    n = len(query)
+    out_i = np.empty((n, k), np.int32)
+    out_d = np.empty((n, k), np.float32)
+    cn2 = (cand**2).sum(axis=1)
+    for s in range(0, n, chunk):
+        e = min(n, s + chunk)
+        if metric == "cosine":
+            score = qn[s:e] @ cn.T
+        else:
+            qn2 = (query[s:e] ** 2).sum(axis=1)
+            score = -(qn2[:, None] - 2 * (query[s:e] @ cand.T) + cn2[None, :])
+        if exclude_self:
+            rows = np.arange(s, e)
+            valid = rows < len(cand)
+            score[np.arange(e - s)[valid], rows[valid]] = -np.inf
+        part = np.argpartition(-score, k - 1, axis=1)[:, :k]
+        ps = np.take_along_axis(score, part, axis=1)
+        order = np.argsort(-ps, axis=1, kind="stable")
+        idx = np.take_along_axis(part, order, axis=1)
+        sc = np.take_along_axis(ps, order, axis=1)
+        out_i[s:e] = idx
+        out_d[s:e] = (1.0 - sc) if metric == "cosine" else np.sqrt(
+            np.maximum(-sc, 0.0)
+        )
+    return out_i, out_d
+
+
+def recall_at_k(pred_idx, true_idx, k: int | None = None) -> float:
+    """Mean fraction of true k neighbours recovered (order-insensitive)."""
+    pred_idx = np.asarray(pred_idx)
+    true_idx = np.asarray(true_idx)
+    n = min(len(pred_idx), len(true_idx))
+    if k is not None:
+        pred_idx = pred_idx[:, :k]
+        true_idx = true_idx[:, :k]
+    hits = 0
+    for i in range(n):
+        hits += len(set(pred_idx[i].tolist()) & set(true_idx[i].tolist()))
+    return hits / (n * true_idx.shape[1])

@@ -1,0 +1,174 @@
+"""PCA transforms: ``pca.randomized`` (Halko randomized SVD) and
+``pca.exact`` (small-data oracle).
+
+Reference parity: BASELINE.json configs[3] — "50-PC randomized PCA".
+
+TPU design: the only large ops are the two sparse matmul primitives
+(``spmm``: gather+einsum, ``spmm_t``: segment-sum) plus small QR/SVD
+factorizations of (n × L) / (L × G) matrices that XLA handles on-chip.
+Mean-centering never densifies X — it is applied as a rank-1
+correction inside the iteration:
+
+    (X - 1 μᵀ) Ω      = X Ω - 1 (μᵀ Ω)
+    (X - 1 μᵀ)ᵀ Q     = Xᵀ Q - μ (1ᵀ Q)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.dataset import CellData
+from ..data.sparse import SparseCells, gene_sum, row_sum, spmm, spmm_t
+from ..registry import register
+
+
+def _center_matvec(X, mu, V):
+    """(X - 1 μᵀ) @ V with padded rows forced to zero."""
+    if isinstance(X, SparseCells):
+        out = spmm(X, V) - jnp.outer(jnp.ones(X.rows_padded, V.dtype), mu @ V)
+        return jnp.where(X.row_mask()[:, None], out, 0.0)
+    return X @ V - jnp.outer(jnp.ones(X.shape[0], V.dtype), mu @ V)
+
+
+def _center_rmatvec(X, mu, Q):
+    """(X - 1 μᵀ)ᵀ @ Q; assumes padded rows of Q are zero."""
+    if isinstance(X, SparseCells):
+        colsum = jnp.sum(jnp.where(X.row_mask()[:, None], Q, 0.0), axis=0)
+        return spmm_t(X, Q) - jnp.outer(mu, colsum)
+    return X.T @ Q - jnp.outer(mu, jnp.sum(Q, axis=0))
+
+
+def _gene_mean(X) -> jax.Array:
+    if isinstance(X, SparseCells):
+        return gene_sum(X) / X.n_cells
+    return jnp.mean(X, axis=0)
+
+
+@partial(jax.jit, static_argnames=("n_components", "oversample", "n_iter", "center"))
+def randomized_pca_arrays(X, key, n_components: int = 50, oversample: int = 10,
+                          n_iter: int = 2, center: bool = True):
+    """Core randomized PCA.  X: SparseCells or dense (n, G).
+
+    Returns (scores (rows, k), components (G, k), explained_var (k,),
+    mean (G,)).
+    """
+    G = X.n_genes if isinstance(X, SparseCells) else X.shape[1]
+    n = X.n_cells if isinstance(X, SparseCells) else X.shape[0]
+    L = n_components + oversample
+    dtype = X.data.dtype if isinstance(X, SparseCells) else X.dtype
+    mu = _gene_mean(X) if center else jnp.zeros((G,), dtype)
+
+    omega = jax.random.normal(key, (G, L), dtype)
+    Y = _center_matvec(X, mu, omega)  # (rows, L)
+    Q, _ = jnp.linalg.qr(Y)
+    for _ in range(n_iter):
+        Z = _center_rmatvec(X, mu, Q)  # (G, L)
+        Qz, _ = jnp.linalg.qr(Z)
+        Y = _center_matvec(X, mu, Qz)
+        Q, _ = jnp.linalg.qr(Y)
+    B = _center_rmatvec(X, mu, Q).T  # (L, G)
+    U_b, S, Vt = jnp.linalg.svd(B, full_matrices=False)
+    k = n_components
+    scores = (Q @ U_b[:, :k]) * S[:k]
+    components = Vt[:k].T  # (G, k)
+    explained = (S[:k] ** 2) / max(n - 1, 1)
+    return scores, components, explained, mu
+
+
+@register("pca.randomized", backend="tpu")
+def pca_randomized_tpu(data: CellData, n_components: int = 50,
+                       oversample: int = 10, n_iter: int = 2,
+                       center: bool = True, seed: int = 0) -> CellData:
+    """Adds obsm["X_pca"], varm["PCs"], uns["pca_explained_variance"]."""
+    key = jax.random.PRNGKey(seed)
+    scores, comps, expl, mu = randomized_pca_arrays(
+        data.X, key, n_components=n_components, oversample=oversample,
+        n_iter=n_iter, center=center,
+    )
+    return data.with_obsm(X_pca=scores).with_varm(PCs=comps).with_uns(
+        pca_explained_variance=expl, pca_mean=mu,
+    )
+
+
+@register("pca.randomized", backend="cpu")
+def pca_randomized_cpu(data: CellData, n_components: int = 50,
+                       oversample: int = 10, n_iter: int = 4,
+                       center: bool = True, seed: int = 0) -> CellData:
+    import scipy.sparse as sp
+
+    X = data.X
+    rng = np.random.default_rng(seed)
+    n, G = X.shape
+    L = n_components + oversample
+    if sp.issparse(X):
+        mu = np.asarray(X.mean(axis=0)).ravel() if center else np.zeros(G)
+        mv = lambda V: X @ V - np.outer(np.ones(n), mu @ V)
+        rmv = lambda Q: X.T @ Q - np.outer(mu, Q.sum(axis=0))
+    else:
+        X = np.asarray(X, dtype=np.float64)
+        mu = X.mean(axis=0) if center else np.zeros(G)
+        mv = lambda V: (X - mu) @ V
+        rmv = lambda Q: (X - mu).T @ Q
+    omega = rng.standard_normal((G, L))
+    Q, _ = np.linalg.qr(mv(omega))
+    for _ in range(n_iter):
+        Qz, _ = np.linalg.qr(rmv(Q))
+        Q, _ = np.linalg.qr(mv(Qz))
+    B = rmv(Q).T
+    U_b, S, Vt = np.linalg.svd(B, full_matrices=False)
+    k = n_components
+    scores = (Q @ U_b[:, :k]) * S[:k]
+    comps = Vt[:k].T
+    expl = (S[:k] ** 2) / max(n - 1, 1)
+    return data.with_obsm(X_pca=scores.astype(np.float32)).with_varm(
+        PCs=comps.astype(np.float32)
+    ).with_uns(
+        pca_explained_variance=expl.astype(np.float32),
+        pca_mean=mu.astype(np.float32),
+    )
+
+
+@register("pca.exact", backend="cpu")
+def pca_exact_cpu(data: CellData, n_components: int = 50,
+                  center: bool = True) -> CellData:
+    """Full-SVD oracle for tests (densifies; small data only)."""
+    import scipy.sparse as sp
+
+    X = data.X
+    if sp.issparse(X):
+        X = np.asarray(X.todense())
+    X = np.asarray(X, dtype=np.float64)
+    mu = X.mean(axis=0) if center else np.zeros(X.shape[1])
+    U, S, Vt = np.linalg.svd(X - mu, full_matrices=False)
+    k = n_components
+    scores = U[:, :k] * S[:k]
+    return data.with_obsm(X_pca=scores.astype(np.float32)).with_varm(
+        PCs=Vt[:k].T.astype(np.float32)
+    ).with_uns(
+        pca_explained_variance=((S[:k] ** 2) / max(X.shape[0] - 1, 1)).astype(
+            np.float32
+        ),
+        pca_mean=mu.astype(np.float32),
+    )
+
+
+@register("pca.exact", backend="tpu")
+def pca_exact_tpu(data: CellData, n_components: int = 50,
+                  center: bool = True) -> CellData:
+    X = data.X
+    if isinstance(X, SparseCells):
+        Xd = X.to_dense()
+    else:
+        Xd = jnp.asarray(X)
+    mu = jnp.mean(Xd, axis=0) if center else jnp.zeros(Xd.shape[1], Xd.dtype)
+    U, S, Vt = jnp.linalg.svd(Xd - mu, full_matrices=False)
+    k = n_components
+    scores = U[:, :k] * S[:k]
+    return data.with_obsm(X_pca=scores).with_varm(PCs=Vt[:k].T).with_uns(
+        pca_explained_variance=(S[:k] ** 2) / max(data.n_cells - 1, 1),
+        pca_mean=mu,
+    )

@@ -1,0 +1,239 @@
+"""QC transforms: ``qc.per_cell_metrics``, ``qc.per_gene_metrics``,
+``qc.filter_cells``, ``qc.filter_genes``.
+
+Reference parity: BASELINE.json configs[1] — per-cell n_genes,
+pct_mito, total_counts.  On TPU, per-cell metrics are row reductions
+over the padded-ELL slots (VPU); the mito percentage gathers a boolean
+gene mask by the slot indices — a (G+1,) table lookup, no scatter.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import config, round_up
+from ..data.dataset import CellData
+from ..data.sparse import SparseCells, gene_stats
+from ..registry import register
+
+
+def _mito_mask(data: CellData):
+    if "mito" in data.var:
+        return data.var["mito"]
+    if "gene_name" in data.var:
+        names = np.asarray(data.var["gene_name"])
+        return np.char.startswith(np.char.upper(names.astype(str)), "MT-")
+    return None
+
+
+@register("qc.per_cell_metrics", backend="tpu")
+def per_cell_metrics_tpu(data: CellData, mito_mask=None) -> CellData:
+    """Adds obs: ``n_genes``, ``total_counts``, ``pct_counts_mt``."""
+    X = data.X
+    if mito_mask is None:
+        mito_mask = _mito_mask(data)
+    if isinstance(X, SparseCells):
+        valid = X.valid_mask()
+        n_genes = jnp.sum(valid, axis=1).astype(jnp.int32)
+        total = jnp.sum(X.data, axis=1)
+        if mito_mask is not None:
+            table = jnp.concatenate(
+                [jnp.asarray(mito_mask, X.data.dtype), jnp.zeros((1,), X.data.dtype)]
+            )
+            mito_per_slot = jnp.take(table, X.indices, axis=0)
+            mito_counts = jnp.sum(X.data * mito_per_slot, axis=1)
+        else:
+            mito_counts = jnp.zeros_like(total)
+    else:
+        X = jnp.asarray(X)
+        n_genes = jnp.sum(X > 0, axis=1).astype(jnp.int32)
+        total = jnp.sum(X, axis=1)
+        if mito_mask is not None:
+            mito_counts = X @ jnp.asarray(mito_mask, X.dtype)
+        else:
+            mito_counts = jnp.zeros_like(total)
+    pct_mt = 100.0 * mito_counts / jnp.maximum(total, 1e-12)
+    return data.with_obs(
+        n_genes=n_genes, total_counts=total, pct_counts_mt=pct_mt
+    )
+
+
+@register("qc.per_cell_metrics", backend="cpu")
+def per_cell_metrics_cpu(data: CellData, mito_mask=None) -> CellData:
+    import scipy.sparse as sp
+
+    X = data.X
+    if mito_mask is None:
+        mito_mask = _mito_mask(data)
+    if sp.issparse(X):
+        X = X.tocsr()
+        n_genes = np.diff(X.indptr).astype(np.int32)
+        total = np.asarray(X.sum(axis=1)).ravel().astype(np.float32)
+        if mito_mask is not None:
+            mito_counts = np.asarray(
+                X[:, np.asarray(mito_mask, bool)].sum(axis=1)
+            ).ravel()
+        else:
+            mito_counts = np.zeros_like(total)
+    else:
+        X = np.asarray(X)
+        n_genes = (X > 0).sum(axis=1).astype(np.int32)
+        total = X.sum(axis=1).astype(np.float32)
+        mito_counts = (
+            X[:, np.asarray(mito_mask, bool)].sum(axis=1)
+            if mito_mask is not None else np.zeros_like(total)
+        )
+    pct_mt = 100.0 * mito_counts / np.maximum(total, 1e-12)
+    return data.with_obs(
+        n_genes=n_genes, total_counts=total,
+        pct_counts_mt=pct_mt.astype(np.float32),
+    )
+
+
+@register("qc.per_gene_metrics", backend="tpu")
+def per_gene_metrics_tpu(data: CellData) -> CellData:
+    """Adds var: ``n_cells``, ``total_counts``, ``mean_counts``."""
+    X = data.X
+    if isinstance(X, SparseCells):
+        s, _, n = gene_stats(X)
+        n_cells_by = n.astype(jnp.int32)
+        total = s
+        mean = s / X.n_cells
+    else:
+        X = jnp.asarray(X)
+        n_cells_by = jnp.sum(X > 0, axis=0).astype(jnp.int32)
+        total = jnp.sum(X, axis=0)
+        mean = total / X.shape[0]
+    return data.with_var(n_cells=n_cells_by, total_counts=total, mean_counts=mean)
+
+
+@register("qc.per_gene_metrics", backend="cpu")
+def per_gene_metrics_cpu(data: CellData) -> CellData:
+    import scipy.sparse as sp
+
+    X = data.X
+    if sp.issparse(X):
+        Xc = X.tocsc()
+        n_cells_by = np.diff(Xc.indptr).astype(np.int32)
+        total = np.asarray(X.sum(axis=0)).ravel().astype(np.float32)
+    else:
+        X = np.asarray(X)
+        n_cells_by = (X > 0).sum(axis=0).astype(np.int32)
+        total = X.sum(axis=0).astype(np.float32)
+    mean = total / data.n_cells
+    return data.with_var(n_cells=n_cells_by, total_counts=total, mean_counts=mean)
+
+
+# ----------------------------------------------------------------------
+# Filtering.  Subsetting changes shapes, so on the TPU backend this is
+# a *materialisation point*: the keep-mask is computed on device, the
+# row gather happens with a host-chosen new padded size.  Not jittable
+# end-to-end by design (XLA needs static shapes); pipelines place
+# filters between jitted segments, exactly like the reference places
+# them between shard passes.
+# ----------------------------------------------------------------------
+
+
+def _cell_keep_mask(data: CellData, min_genes, min_counts, max_pct_mt, xp):
+    obs = data.obs
+    need = [k for k in ("n_genes", "total_counts") if k not in obs]
+    if need:
+        raise ValueError(
+            f"qc.filter_cells requires qc.per_cell_metrics first (missing {need})"
+        )
+    keep = xp.ones(obs["n_genes"].shape, bool)
+    if min_genes is not None:
+        keep &= obs["n_genes"] >= min_genes
+    if min_counts is not None:
+        keep &= obs["total_counts"] >= min_counts
+    if max_pct_mt is not None and "pct_counts_mt" in obs:
+        keep &= obs["pct_counts_mt"] <= max_pct_mt
+    return keep
+
+
+@register("qc.filter_cells", backend="tpu")
+def filter_cells_tpu(
+    data: CellData,
+    min_genes: int | None = None,
+    min_counts: float | None = None,
+    max_pct_mt: float | None = None,
+) -> CellData:
+    X = data.X
+    keep = _cell_keep_mask(data, min_genes, min_counts, max_pct_mt, jnp)
+    if isinstance(X, SparseCells):
+        keep = keep & X.row_mask()
+    keep_host = np.asarray(keep)
+    idx = np.nonzero(keep_host)[0]
+    n_new = len(idx)
+    if isinstance(X, SparseCells):
+        rows_padded = round_up(max(n_new, 1), config.sublane)
+        gidx = jnp.asarray(
+            np.pad(idx, (0, rows_padded - n_new), constant_values=X.rows_padded - 1)
+        )
+        ind = jnp.take(X.indices, gidx, axis=0)
+        dat = jnp.take(X.data, gidx, axis=0)
+        if rows_padded > n_new:  # ensure padding rows are empty
+            pad_row = jnp.arange(rows_padded) >= n_new
+            ind = jnp.where(pad_row[:, None], X.sentinel, ind)
+            dat = jnp.where(pad_row[:, None], 0.0, dat)
+        newX = SparseCells(ind, dat, n_new, X.n_genes)
+        num_idx = gidx
+    else:
+        newX = jnp.take(jnp.asarray(X), jnp.asarray(idx), axis=0)
+        num_idx = jnp.asarray(idx)
+
+    def take(v):
+        if isinstance(v, jax.Array) or np.asarray(v).dtype.kind in "biufc":
+            return jnp.take(jnp.asarray(v), num_idx, axis=0)
+        return np.asarray(v)[idx]  # strings/objects stay host-side
+    obs = {k: take(v) for k, v in data.obs.items()}
+    obsm = {k: take(v) for k, v in data.obsm.items()}
+    return data.replace(X=newX, obs=obs, obsm=obsm, obsp={})
+
+
+@register("qc.filter_cells", backend="cpu")
+def filter_cells_cpu(
+    data: CellData,
+    min_genes: int | None = None,
+    min_counts: float | None = None,
+    max_pct_mt: float | None = None,
+) -> CellData:
+    keep = np.asarray(_cell_keep_mask(data, min_genes, min_counts, max_pct_mt, np))
+    X = data.X[keep]
+    obs = {k: np.asarray(v)[keep] for k, v in data.obs.items()}
+    obsm = {k: np.asarray(v)[keep] for k, v in data.obsm.items()}
+    return data.replace(X=X, obs=obs, obsm=obsm, obsp={})
+
+
+@register("qc.filter_genes", backend="tpu")
+def filter_genes_tpu(data: CellData, min_cells: int | None = 3,
+                     min_counts: float | None = None) -> CellData:
+    from .hvg import select_genes_device  # shared gene-subset machinery
+
+    if "n_cells" not in data.var:
+        data = per_gene_metrics_tpu(data)
+    keep = jnp.ones(data.n_genes, bool)
+    if min_cells is not None:
+        keep &= data.var["n_cells"] >= min_cells
+    if min_counts is not None:
+        keep &= data.var["total_counts"] >= min_counts
+    idx = np.nonzero(np.asarray(keep))[0]
+    return select_genes_device(data, idx)
+
+
+@register("qc.filter_genes", backend="cpu")
+def filter_genes_cpu(data: CellData, min_cells: int | None = 3,
+                     min_counts: float | None = None) -> CellData:
+    if "n_cells" not in data.var:
+        data = per_gene_metrics_cpu(data)
+    keep = np.ones(data.n_genes, bool)
+    if min_cells is not None:
+        keep &= np.asarray(data.var["n_cells"]) >= min_cells
+    if min_counts is not None:
+        keep &= np.asarray(data.var["total_counts"]) >= min_counts
+    X = data.X[:, keep]
+    var = {k: np.asarray(v)[keep] for k, v in data.var.items()}
+    varm = {k: np.asarray(v)[keep] for k, v in data.varm.items()}
+    return data.replace(X=X, var=var, varm=varm)

@@ -1,0 +1,156 @@
+"""The ``Transform`` operator registry.
+
+The reference framework (dpeerlab/sctools — source unavailable, see
+SURVEY.md) organises all per-cell/per-gene operations as named
+transforms in a registry, selected at call time with a ``backend=``
+kwarg (BASELINE.json ``north_star``).  This module provides that
+surface, TPU-first:
+
+* ops register under dotted names (``"normalize.log1p"``) per backend
+  (``"cpu"`` = numpy/scipy oracle, ``"tpu"`` = JAX/XLA/Pallas);
+* ``apply(name, data, backend=...)`` dispatches a single op;
+* ``Transform(name, backend=..., **params)`` is a bound, reusable op;
+* ``Pipeline([...])`` composes transforms sequentially; each TPU op is
+  itself jit-compiled, and device arrays flow between ops without
+  host round-trips (materialisation points like ``subset=True``
+  filters excepted).
+
+The ``"tpu"`` backend is pure JAX: it runs on whatever
+``jax.default_backend()`` is (real TPU chips in production, the CPU
+emulator in tests) — semantics are identical, the name records the
+design target.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+_REGISTRY: dict[str, dict[str, Callable]] = {}
+_DOCS: dict[str, str] = {}
+
+DEFAULT_BACKEND = "tpu"
+
+
+class UnknownTransformError(KeyError):
+    pass
+
+
+class UnknownBackendError(KeyError):
+    pass
+
+
+def register(name: str, backend: str = "tpu") -> Callable[[Callable], Callable]:
+    """Decorator: register ``fn`` as the implementation of ``name`` for
+    ``backend``.
+
+    >>> @register("normalize.log1p", backend="tpu")
+    ... def log1p_tpu(data, **kw): ...
+    """
+
+    def deco(fn: Callable) -> Callable:
+        _REGISTRY.setdefault(name, {})[backend] = fn
+        if fn.__doc__ and name not in _DOCS:
+            _DOCS[name] = fn.__doc__
+        return fn
+
+    return deco
+
+
+def get(name: str, backend: str = DEFAULT_BACKEND) -> Callable:
+    try:
+        impls = _REGISTRY[name]
+    except KeyError:
+        raise UnknownTransformError(
+            f"no transform named {name!r}; known: {sorted(_REGISTRY)}"
+        ) from None
+    try:
+        return impls[backend]
+    except KeyError:
+        raise UnknownBackendError(
+            f"transform {name!r} has no {backend!r} backend; "
+            f"available: {sorted(impls)}"
+        ) from None
+
+
+def names(backend: str | None = None) -> list[str]:
+    if backend is None:
+        return sorted(_REGISTRY)
+    return sorted(n for n, impls in _REGISTRY.items() if backend in impls)
+
+
+def backends(name: str) -> list[str]:
+    return sorted(_REGISTRY.get(name, {}))
+
+
+def describe(name: str) -> str:
+    return _DOCS.get(name, "")
+
+
+def apply(name: str, data, *args, backend: str = DEFAULT_BACKEND, **kw):
+    """Apply a registered transform to ``data`` and return the result."""
+    return get(name, backend)(data, *args, **kw)
+
+
+class Transform:
+    """A named operator bound to a backend and fixed parameters.
+
+    Mirrors the reference's ``Transform`` objects: construct once,
+    apply to many datasets.
+
+    >>> t = Transform("normalize.library_size", backend="tpu", target_sum=1e4)
+    >>> out = t(celldata)
+    """
+
+    def __init__(self, name: str, backend: str = DEFAULT_BACKEND, **params):
+        self.name = name
+        self.backend = backend
+        self.params = params
+        self._fn = get(name, backend)  # fail fast on unknown name/backend
+
+    def __call__(self, data, **overrides):
+        kw = {**self.params, **overrides}
+        return self._fn(data, **kw)
+
+    def with_backend(self, backend: str) -> "Transform":
+        return Transform(self.name, backend=backend, **self.params)
+
+    def __repr__(self):
+        ps = ", ".join(f"{k}={v!r}" for k, v in self.params.items())
+        return f"Transform({self.name!r}, backend={self.backend!r}{', ' + ps if ps else ''})"
+
+
+class Pipeline:
+    """An ordered chain of transforms applied to a dataset.
+
+    Steps are ``(name, params)`` tuples or ``Transform`` objects.  The
+    same pipeline runs on any backend: ``backend=`` at ``run()`` time
+    overrides per-step backends, which is how the CPU oracle validates
+    the TPU path in tests.
+    """
+
+    def __init__(self, steps, backend: str | None = None):
+        self.steps: list[Transform] = []
+        for step in steps:
+            if isinstance(step, Transform):
+                self.steps.append(step)
+            elif isinstance(step, str):
+                self.steps.append(Transform(step, backend=backend or DEFAULT_BACKEND))
+            else:
+                name, params = step
+                self.steps.append(
+                    Transform(name, backend=backend or DEFAULT_BACKEND, **params)
+                )
+
+    def run(self, data, backend: str | None = None):
+        for t in self.steps:
+            if backend is not None and backend != t.backend:
+                t = t.with_backend(backend)
+            data = t(data)
+        return data
+
+    def __iter__(self):
+        return iter(self.steps)
+
+    def __repr__(self):
+        return "Pipeline([\n  " + ",\n  ".join(map(repr, self.steps)) + "\n])"

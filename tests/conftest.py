@@ -1,0 +1,33 @@
+"""Test configuration: force an 8-device virtual CPU mesh so multi-chip
+sharding paths compile and execute without TPU hardware (the driver's
+dryrun does the same).
+
+Note: the session's sitecustomize imports jax at interpreter startup
+and registers the real-TPU (axon) PJRT plugin, so env vars set here are
+too late — jax has already captured JAX_PLATFORMS.  ``jax.config
+.update`` still works because no backend has been *initialised* yet
+when conftest runs.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+
+def pytest_sessionstart(session):
+    assert jax.default_backend() == "cpu", (
+        f"tests must run on the virtual CPU mesh, got {jax.default_backend()}"
+    )
+    assert jax.device_count() >= 8, (
+        f"expected >=8 virtual devices, got {jax.device_count()}"
+    )

@@ -1,0 +1,145 @@
+"""IO round-trips, native C++ packer/parser parity, regression tests."""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import sctools_tpu as sct
+from sctools_tpu.data.sparse import SparseCells
+from sctools_tpu.data.synthetic import synthetic_counts
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_h5ad_roundtrip(tmp_path):
+    ds = synthetic_counts(60, 80, seed=9)
+    path = str(tmp_path / "x.h5ad")
+    sct.write_h5ad(ds, path)
+    back = sct.read_h5ad(path)
+    assert back.shape == ds.shape
+    assert (back.X != ds.X).nnz == 0
+    np.testing.assert_array_equal(back.var["gene_name"], ds.var["gene_name"])
+    np.testing.assert_array_equal(back.obs["cluster_true"],
+                                  ds.obs["cluster_true"])
+
+
+def test_h5ad_roundtrip_from_device(tmp_path):
+    ds = synthetic_counts(40, 50, seed=10).device_put()
+    ds = sct.apply("qc.per_cell_metrics", ds, backend="tpu")
+    path = str(tmp_path / "dev.h5ad")
+    sct.write_h5ad(ds, path)
+    back = sct.read_h5ad(path)
+    assert back.shape == ds.shape
+
+
+def test_shard_iter(tmp_path):
+    ds = synthetic_counts(100, 64, seed=11)
+    path = str(tmp_path / "big.h5ad")
+    sct.write_h5ad(ds, path)
+    from sctools_tpu.data.io import shard_iter
+
+    shards = list(shard_iter(path, shard_rows=32))
+    assert sum(s.n_cells for s in shards) == 100
+    # one global capacity across shards (single-compilation contract)
+    assert len({s.capacity for s in shards}) == 1
+    rebuilt = sp.vstack([s.to_scipy_csr() for s in shards])
+    assert (rebuilt != ds.X).nnz == 0
+
+
+def test_mtx_reader(tmp_path):
+    rng = np.random.default_rng(3)
+    m = sp.random(30, 20, density=0.2, random_state=rng).tocoo()
+    d = tmp_path / "tenx"
+    d.mkdir()
+    from scipy.io import mmwrite
+
+    mmwrite(str(d / "matrix.mtx"), m)  # genes x cells on disk
+    with open(d / "genes.tsv", "w") as fh:
+        for i in range(30):
+            fh.write(f"ENSG{i}\tGENE{i}\n")
+    with open(d / "barcodes.tsv", "w") as fh:
+        for i in range(20):
+            fh.write(f"BC{i}\n")
+    ds = sct.read_10x_mtx(str(d))
+    assert ds.shape == (20, 30)  # transposed to cells x genes
+    np.testing.assert_allclose(ds.X.toarray(), m.toarray().T, rtol=1e-5)
+    assert len(ds.var["gene_name"]) == 30
+    assert len(ds.obs["barcode"]) == 20
+
+
+@pytest.fixture(scope="module")
+def native_lib():
+    lib = os.path.join(REPO, "csrc", "libscio.so")
+    if not os.path.exists(lib):
+        r = subprocess.run(["make", "-C", os.path.join(REPO, "csrc")],
+                           capture_output=True, text=True)
+        if r.returncode != 0:
+            pytest.skip(f"native build failed: {r.stderr[-500:]}")
+    import sctools_tpu.native as native
+
+    native._LIB_TRIED = False
+    native._LIB = None
+    if not native.have_native():
+        pytest.skip("native lib not loadable")
+    return native
+
+
+def test_native_pack_matches_numpy(native_lib):
+    rng = np.random.default_rng(4)
+    csr = sp.random(50, 40, density=0.3, format="csr",
+                    random_state=rng).astype(np.float32)
+    csr.sort_indices()
+    a_idx, a_val = native_lib.pack_ell(
+        csr.indptr.astype(np.int64), csr.indices.astype(np.int32),
+        csr.data, 56, 128, sentinel=40)
+    b_idx, b_val = native_lib._pack_ell_numpy(
+        csr.indptr.astype(np.int64), csr.indices.astype(np.int32),
+        csr.data, 56, 128, sentinel=40)
+    np.testing.assert_array_equal(a_idx, b_idx)
+    np.testing.assert_array_equal(a_val, b_val)
+
+
+def test_native_mtx_parse(native_lib, tmp_path):
+    rng = np.random.default_rng(5)
+    m = sp.random(25, 15, density=0.3, random_state=rng).tocoo()
+    path = str(tmp_path / "m.mtx")
+    from scipy.io import mmwrite
+
+    mmwrite(path, m)
+    nr, nc, rows, cols, vals = native_lib.parse_mtx(path)
+    assert (nr, nc) == (25, 15)
+    got = sp.coo_matrix((vals, (rows, cols)), shape=(25, 15))
+    np.testing.assert_allclose(got.toarray(), m.toarray(), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------
+# Regression tests from code review
+# ---------------------------------------------------------------------
+
+
+def test_filter_cells_with_string_obs():
+    """filter_cells must keep non-numeric obs columns host-side."""
+    ds = synthetic_counts(50, 40, seed=12)
+    ds.obs["barcode"] = np.array([f"BC{i}" for i in range(50)])
+    dev = ds.device_put()
+    dev = sct.apply("qc.per_cell_metrics", dev, backend="tpu")
+    out = sct.apply("qc.filter_cells", dev, backend="tpu", min_genes=1)
+    host = out.to_host()
+    assert len(host.obs["barcode"]) == host.n_cells
+    assert host.obs["barcode"][0].startswith("BC")
+
+
+def test_to_host_trims_knn_padding():
+    """kNN outputs are padded to the row_block; to_host must trim."""
+    ds = synthetic_counts(100, 60, n_clusters=2, seed=13)
+    dev = ds.device_put()
+    dev = sct.apply("pca.exact", dev, backend="tpu", n_components=5)
+    dev = sct.apply("neighbors.knn", dev, backend="tpu", k=5,
+                    metric="euclidean", query_block=256, cand_block=128)
+    host = dev.to_host()
+    assert host.obsp["knn_indices"].shape == (100, 5)
+    assert host.obsp["knn_distances"].shape == (100, 5)
+    assert (host.obsp["knn_indices"] >= 0).all()

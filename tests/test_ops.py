@@ -1,0 +1,112 @@
+"""TPU ops vs CPU oracle: normalize, QC, HVG, filters."""
+
+import numpy as np
+import pytest
+
+import sctools_tpu as sct
+from sctools_tpu.data.synthetic import synthetic_counts
+
+
+@pytest.fixture(scope="module")
+def ds():
+    return synthetic_counts(200, 300, density=0.1, n_clusters=3,
+                            mito_frac=0.03, seed=7)
+
+
+def both(ds, name, **kw):
+    cpu = sct.apply(name, ds, backend="cpu", **kw)
+    tpu = sct.apply(name, ds.device_put(), backend="tpu", **kw).to_host()
+    return cpu, tpu
+
+
+def test_library_size(ds):
+    cpu, tpu = both(ds, "normalize.library_size", target_sum=1e4)
+    np.testing.assert_allclose(tpu.X.toarray(), cpu.X.toarray(),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(tpu.obs["library_size"],
+                               cpu.obs["library_size"], rtol=1e-5)
+
+
+def test_library_size_median(ds):
+    cpu, tpu = both(ds, "normalize.library_size", target_sum=None)
+    np.testing.assert_allclose(tpu.X.toarray(), cpu.X.toarray(),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_log1p(ds):
+    cpu, tpu = both(ds, "normalize.log1p")
+    np.testing.assert_allclose(tpu.X.toarray(), cpu.X.toarray(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_scale(ds):
+    cpu, tpu = both(ds, "normalize.scale", max_value=10.0)
+    np.testing.assert_allclose(np.asarray(tpu.X)[: ds.n_cells],
+                               cpu.X, rtol=2e-3, atol=2e-3)
+
+
+def test_per_cell_metrics(ds):
+    cpu, tpu = both(ds, "qc.per_cell_metrics")
+    np.testing.assert_array_equal(tpu.obs["n_genes"], cpu.obs["n_genes"])
+    np.testing.assert_allclose(tpu.obs["total_counts"],
+                               cpu.obs["total_counts"], rtol=1e-5)
+    np.testing.assert_allclose(tpu.obs["pct_counts_mt"],
+                               cpu.obs["pct_counts_mt"], rtol=1e-4)
+    assert np.asarray(cpu.obs["pct_counts_mt"]).max() > 0
+
+
+def test_per_gene_metrics(ds):
+    cpu, tpu = both(ds, "qc.per_gene_metrics")
+    np.testing.assert_array_equal(tpu.var["n_cells"], cpu.var["n_cells"])
+    np.testing.assert_allclose(tpu.var["total_counts"],
+                               cpu.var["total_counts"], rtol=1e-5)
+
+
+def test_filter_cells(ds):
+    cpu = sct.apply("qc.per_cell_metrics", ds, backend="cpu")
+    cpu = sct.apply("qc.filter_cells", cpu, backend="cpu",
+                    min_genes=10, max_pct_mt=50.0)
+    dev = sct.apply("qc.per_cell_metrics", ds.device_put(), backend="tpu")
+    dev = sct.apply("qc.filter_cells", dev, backend="tpu",
+                    min_genes=10, max_pct_mt=50.0)
+    tpu = dev.to_host()
+    assert tpu.n_cells == cpu.n_cells
+    np.testing.assert_allclose(tpu.X.toarray(), cpu.X.toarray(), rtol=1e-5)
+    np.testing.assert_array_equal(tpu.obs["n_genes"], cpu.obs["n_genes"])
+
+
+def test_filter_genes(ds):
+    cpu = sct.apply("qc.filter_genes", ds, backend="cpu", min_cells=5)
+    dev = sct.apply("qc.filter_genes", ds.device_put(), backend="tpu",
+                    min_cells=5)
+    tpu = dev.to_host()
+    assert tpu.n_genes == cpu.n_genes
+    np.testing.assert_allclose(tpu.X.toarray(), cpu.X.toarray(), rtol=1e-5)
+
+
+@pytest.mark.parametrize("flavor", ["seurat_v3", "dispersion"])
+def test_hvg_parity(ds, flavor):
+    base = ds
+    if flavor == "dispersion":
+        base = sct.apply("normalize.library_size", base, backend="cpu")
+        base = sct.apply("normalize.log1p", base, backend="cpu")
+    cpu = sct.apply("hvg.select", base, backend="cpu", n_top=50, flavor=flavor)
+    tpu = sct.apply("hvg.select", base.device_put(), backend="tpu",
+                    n_top=50, flavor=flavor).to_host()
+    # scores agree
+    np.testing.assert_allclose(tpu.var["hvg_score"], cpu.var["hvg_score"],
+                               rtol=5e-3, atol=5e-3)
+    # selected sets agree almost entirely (ties near cutoff may differ)
+    a = set(np.nonzero(cpu.var["highly_variable"])[0].tolist())
+    b = set(np.nonzero(tpu.var["highly_variable"])[0].tolist())
+    assert len(a & b) >= 48
+
+
+def test_hvg_subset(ds):
+    cpu = sct.apply("hvg.select", ds, backend="cpu", n_top=40, subset=True)
+    tpu = sct.apply("hvg.select", ds.device_put(), backend="tpu",
+                    n_top=40, subset=True).to_host()
+    assert cpu.n_genes == 40
+    assert tpu.n_genes == cpu.n_genes
+    np.testing.assert_allclose(tpu.X.toarray(), cpu.X.toarray(),
+                               rtol=1e-4, atol=1e-4)

@@ -1,0 +1,63 @@
+"""Transform registry: registration, dispatch, pipelines."""
+
+import numpy as np
+import pytest
+
+import sctools_tpu as sct
+from sctools_tpu import registry
+
+
+def test_known_transforms_present():
+    names = sct.names()
+    for expected in [
+        "normalize.library_size", "normalize.log1p", "qc.per_cell_metrics",
+        "hvg.select", "distance.pairwise", "neighbors.knn", "pca.randomized",
+    ]:
+        assert expected in names, f"{expected} missing from registry"
+        assert set(sct.backends(expected)) >= {"cpu", "tpu"}
+
+
+def test_unknown_name():
+    with pytest.raises(registry.UnknownTransformError):
+        sct.get("no.such.op")
+
+
+def test_unknown_backend():
+    with pytest.raises(registry.UnknownBackendError):
+        sct.get("normalize.log1p", backend="cuda")
+
+
+def test_transform_binding():
+    t = sct.Transform("normalize.library_size", backend="cpu", target_sum=100.0)
+    ds = sct.data.synthetic.synthetic_counts(30, 40, seed=1)
+    out = t(ds)
+    totals = np.asarray(out.X.sum(axis=1)).ravel()
+    np.testing.assert_allclose(totals, 100.0, rtol=1e-5)
+
+
+def test_custom_registration():
+    @sct.register("test.double", backend="cpu")
+    def _double(data):
+        return data.with_X(data.X * 2)
+
+    ds = sct.from_dense(np.ones((3, 4), np.float32))
+    out = sct.apply("test.double", ds, backend="cpu")
+    np.testing.assert_allclose(out.X, 2.0)
+
+
+def test_pipeline_runs_both_backends():
+    ds = sct.data.synthetic.synthetic_counts(64, 128, seed=2)
+    pipe = sct.Pipeline([
+        ("qc.per_cell_metrics", {}),
+        ("normalize.library_size", {"target_sum": 1e4}),
+        ("normalize.log1p", {}),
+    ])
+    cpu_out = pipe.run(ds, backend="cpu")
+    dev = ds.device_put()
+    tpu_out = pipe.run(dev, backend="tpu").to_host()
+    np.testing.assert_allclose(
+        tpu_out.obs["total_counts"], cpu_out.obs["total_counts"], rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        tpu_out.X.toarray(), cpu_out.X.toarray(), rtol=1e-4, atol=1e-5
+    )

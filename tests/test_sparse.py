@@ -1,0 +1,119 @@
+"""Padded-ELL sparse format: round-trips and linear-algebra primitives
+vs scipy."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from sctools_tpu.data.sparse import (
+    SparseCells, gene_stats, gene_sum, row_sum, spmm, spmm_t,
+)
+
+
+def random_csr(n, g, density=0.1, seed=0):
+    rng = np.random.default_rng(seed)
+    m = sp.random(n, g, density=density, format="csr", random_state=rng,
+                  data_rvs=lambda k: rng.integers(1, 20, k).astype(np.float32))
+    m.sort_indices()
+    return m.astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def mats():
+    csr = random_csr(137, 251, density=0.12)
+    return csr, SparseCells.from_scipy_csr(csr)
+
+
+def test_roundtrip(mats):
+    csr, x = mats
+    back = x.to_scipy_csr()
+    assert (back != csr).nnz == 0
+    assert x.shape == csr.shape
+    assert x.capacity % 128 == 0
+
+
+def test_empty_rows():
+    csr = sp.csr_matrix((5, 10), dtype=np.float32)
+    x = SparseCells.from_scipy_csr(csr)
+    assert x.nnz_per_row().sum() == 0
+    assert (x.to_scipy_csr() != csr).nnz == 0
+
+
+def test_to_dense(mats):
+    csr, x = mats
+    np.testing.assert_allclose(np.asarray(x.to_dense()),
+                               csr.toarray(), rtol=1e-6)
+
+
+def test_row_sum(mats):
+    csr, x = mats
+    got = np.asarray(row_sum(x))[: x.n_cells]
+    np.testing.assert_allclose(got, np.asarray(csr.sum(axis=1)).ravel(),
+                               rtol=1e-5)
+
+
+def test_gene_sum(mats):
+    csr, x = mats
+    np.testing.assert_allclose(np.asarray(gene_sum(x)),
+                               np.asarray(csr.sum(axis=0)).ravel(), rtol=1e-5)
+
+
+def test_gene_stats(mats):
+    csr, x = mats
+    s, ss, n = gene_stats(x)
+    np.testing.assert_allclose(np.asarray(s),
+                               np.asarray(csr.sum(axis=0)).ravel(), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(ss), np.asarray(csr.multiply(csr).sum(axis=0)).ravel(),
+        rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(n),
+                               np.diff(csr.tocsc().indptr), rtol=0)
+
+
+def test_spmm(mats):
+    csr, x = mats
+    rng = np.random.default_rng(1)
+    v = rng.normal(size=(csr.shape[1], 16)).astype(np.float32)
+    got = np.asarray(spmm(x, v))[: x.n_cells]
+    np.testing.assert_allclose(got, csr @ v, rtol=2e-4, atol=2e-4)
+
+
+def test_spmm_t(mats):
+    csr, x = mats
+    rng = np.random.default_rng(2)
+    w = np.zeros((x.rows_padded, 8), np.float32)
+    w[: x.n_cells] = rng.normal(size=(x.n_cells, 8))
+    got = np.asarray(spmm_t(x, w))
+    np.testing.assert_allclose(got, csr.T @ w[: x.n_cells],
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_bcoo(mats):
+    csr, x = mats
+    b = x.to_bcoo()
+    np.testing.assert_allclose(np.asarray(b.todense()), csr.toarray(),
+                               rtol=1e-6)
+
+
+def test_capacity_too_small():
+    csr = random_csr(10, 50, density=0.5)
+    with pytest.raises(ValueError):
+        SparseCells.from_scipy_csr(csr, capacity=1)
+
+
+def test_pytree():
+    import jax
+
+    csr = random_csr(8, 16, density=0.3)
+    x = SparseCells.from_scipy_csr(csr).device_put()
+    leaves, treedef = jax.tree_util.tree_flatten(x)
+    assert len(leaves) == 2
+    x2 = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert x2.n_cells == x.n_cells
+
+    @jax.jit
+    def double(s: SparseCells):
+        return s.with_data(s.data * 2)
+
+    y = double(x)
+    assert (y.to_scipy_csr() != csr * 2).nnz == 0
