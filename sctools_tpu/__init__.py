@@ -25,7 +25,7 @@ Quick start::
     ]).run(dev, backend="tpu")
 """
 
-from . import data, ops  # noqa: F401  (ops import registers transforms)
+from . import data, ops, parallel  # noqa: F401  (imports register transforms)
 from .config import config, configure
 from .data import CellData, SparseCells
 from .data.io import from_dense, from_scipy, read_10x_mtx, read_h5ad, write_h5ad

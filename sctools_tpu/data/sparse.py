@@ -219,37 +219,97 @@ class SparseCells:
 
 # ----------------------------------------------------------------------
 # Core sparse linear algebra primitives (jittable).
+#
+# Everything that expands a (rows, capacity) slot array by a feature
+# dimension d is CHUNKED over row blocks with a lax.scan/lax.map:
+# materialising (rows, capacity, d) at atlas scale is tens of GB, while
+# one (block, capacity, d) tile stays ~100 MB and the scan carry for
+# gene-axis reductions is only (n_genes+1, d).  These ops are
+# bandwidth-bound, so sequential blocks cost nothing.
 # ----------------------------------------------------------------------
 
+_ROW_CHUNK = 2048
 
-@partial(jax.jit, static_argnames=("precision",))
-def spmm(x: SparseCells, v: jax.Array, precision=None) -> jax.Array:
+
+def _blocked_pair(x: "SparseCells", block: int):
+    """Block indices/data with proper padding (sentinel idx, zero val)."""
+    R, C = x.indices.shape
+    nb = (R + block - 1) // block
+    pad = nb * block - R
+    ind, dat = x.indices, x.data
+    if pad:
+        ind = jnp.concatenate(
+            [ind, jnp.full((pad, C), x.sentinel, ind.dtype)])
+        dat = jnp.concatenate([dat, jnp.zeros((pad, C), dat.dtype)])
+    return ind.reshape(nb, block, C), dat.reshape(nb, block, C), nb, pad
+
+
+def segment_reduce(x: "SparseCells", slot_values_fn, d: int,
+                   dtype=None, block: int = _ROW_CHUNK) -> jax.Array:
+    """Generic gene-axis reduction: accumulates
+    ``segment_sum(slot_values_fn(ind_blk, dat_blk, row_offset))`` over
+    row blocks into a (n_genes, d) result.
+
+    ``slot_values_fn(ind, dat, row_offset) -> (block, capacity, d)``.
+    """
+    dtype = dtype or x.data.dtype
+    ind_b, dat_b, nb, _ = _blocked_pair(x, block)
+    G1 = x.n_genes + 1
+
+    def body(acc, inp):
+        i, (ind, dat) = inp
+        vals = slot_values_fn(ind, dat, i * block)  # (block, C, d)
+        acc = acc + jax.ops.segment_sum(
+            vals.reshape(-1, d), ind.ravel(), num_segments=G1
+        )
+        return acc, None
+
+    acc0 = jnp.zeros((G1, d), dtype)
+    acc, _ = jax.lax.scan(
+        body, acc0, (jnp.arange(nb), (ind_b, dat_b)))
+    return acc[: x.n_genes]
+
+
+@partial(jax.jit, static_argnames=("precision", "block"))
+def spmm(x: SparseCells, v: jax.Array, precision=None,
+         block: int = _ROW_CHUNK) -> jax.Array:
     """``X @ V`` for padded-ELL ``X`` and dense ``V`` of shape (G, d).
 
-    TPU mapping: gather V rows (V padded with a zero row so sentinel
-    indices vanish), then a slot-reduction einsum — VPU-bound with V
-    resident in VMEM for typical d ≤ 512.
+    TPU mapping: per row-block, gather V rows (V padded with a zero
+    row so sentinel indices vanish) and contract slots — VPU-bound
+    with V resident in VMEM for typical d ≤ 512.
     """
     vp = jnp.concatenate([v, jnp.zeros((1, v.shape[1]), v.dtype)], axis=0)
-    gathered = jnp.take(vp, x.indices, axis=0)  # (R, C, d)
-    return jnp.einsum(
-        "rc,rcd->rd", x.data.astype(v.dtype), gathered, precision=precision
-    )
+    ind_b, dat_b, nb, pad = _blocked_pair(x, block)
+
+    def per_block(args):
+        ind, dat = args
+        gathered = jnp.take(vp, ind, axis=0)  # (block, C, d)
+        return jnp.einsum("rc,rcd->rd", dat.astype(v.dtype), gathered,
+                          precision=precision)
+
+    out = jax.lax.map(per_block, (ind_b, dat_b))  # (nb, block, d)
+    out = out.reshape(nb * block, v.shape[1])
+    return out[: x.rows_padded]
 
 
-@jax.jit
-def spmm_t(x: SparseCells, w: jax.Array) -> jax.Array:
+@partial(jax.jit, static_argnames=("block",))
+def spmm_t(x: SparseCells, w: jax.Array, block: int = _ROW_CHUNK) -> jax.Array:
     """``Xᵀ @ W`` for dense ``W`` of shape (rows_padded, d) → (G, d).
 
     Padding rows of W must be zero, or use ``x.row_mask()`` upstream.
-    Implemented as one segment-sum over the flattened slot array; the
-    sentinel bin (index G) is dropped.
+    Chunked segment-sum; the sentinel bin (index G) is dropped.
     """
-    contrib = x.data[:, :, None] * w[:, None, :]  # (R, C, d)
-    flat_idx = x.indices.ravel()
-    flat = contrib.reshape(-1, w.shape[-1])
-    out = jax.ops.segment_sum(flat, flat_idx, num_segments=x.n_genes + 1)
-    return out[: x.n_genes]
+    d = w.shape[-1]
+    # dynamic_slice needs in-range offsets: pad w to the blocked size.
+    pad = (-x.rows_padded) % block
+    wp = jnp.concatenate([w, jnp.zeros((pad, d), w.dtype)]) if pad else w
+
+    def slot_vals(ind, dat, row_offset):
+        wblk = jax.lax.dynamic_slice_in_dim(wp, row_offset, ind.shape[0])
+        return dat[:, :, None] * wblk[:, None, :]
+
+    return segment_reduce(x, slot_vals, d, dtype=w.dtype, block=block)
 
 
 @jax.jit
@@ -261,25 +321,23 @@ def row_sum(x: SparseCells) -> jax.Array:
 @jax.jit
 def gene_sum(x: SparseCells) -> jax.Array:
     """Per-gene total counts, (n_genes,)."""
-    flat = x.data.ravel()
-    out = jax.ops.segment_sum(flat, x.indices.ravel(), num_segments=x.n_genes + 1)
-    return out[: x.n_genes]
+    return gene_stats(x)[0]
 
 
 @jax.jit
 def gene_stats(x: SparseCells) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Per-gene (sum, sum of squares, nnz count) across *valid* cells.
 
-    One fused pass: three segment-sums over the same index stream.
-    Padding rows contribute zeros (their data is zero) except for the
-    nnz count, which masks explicitly.
+    One fused chunked pass: three segment-sums over the same index
+    stream.  Padding rows contribute zeros (their data is zero) except
+    for the nnz count, which masks explicitly.
     """
-    idx = x.indices.ravel()
-    d = x.data.ravel()
-    valid = (x.valid_mask() & x.row_mask()[:, None]).ravel()
-    stacked = jnp.stack(
-        [d, d * d, valid.astype(d.dtype)], axis=1
-    )  # (R*C, 3)
-    out = jax.ops.segment_sum(stacked, idx, num_segments=x.n_genes + 1)
-    out = out[: x.n_genes]
+    n_cells = x.n_cells
+
+    def slot_vals(ind, dat, row_offset):
+        rows = row_offset + jnp.arange(ind.shape[0])
+        valid = (ind != x.sentinel) & (rows < n_cells)[:, None]
+        return jnp.stack([dat, dat * dat, valid.astype(dat.dtype)], axis=2)
+
+    out = segment_reduce(x, slot_vals, 3)
     return out[:, 0], out[:, 1], out[:, 2]

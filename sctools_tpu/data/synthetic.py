@@ -45,6 +45,7 @@ def synthetic_counts(
 
     labels = rng.integers(0, n_clusters, size=n_cells)
     lib = rng.lognormal(mean=0.0, sigma=0.4, size=n_cells)
+    cdfs = np.cumsum(programs, axis=1)
 
     target_nnz = int(density * n_genes)
     rows, cols, vals = [], [], []
@@ -59,19 +60,16 @@ def synthetic_counts(
         nnz = np.minimum(nnz, n_genes)
         total = int(nnz.sum())
         row_idx = np.repeat(np.arange(start, stop), nnz)
-        # Sample gene ids per cell from its cluster's program, with ONE
-        # flat searchsorted: each row's cdf lives in [0,1], so shifting
-        # row r's cdf (and its uniforms) by 2r keeps rows sorted and
-        # disjoint in a single global array — no Python-level per-cell
-        # loop (10M cells would take hours otherwise).
-        p = programs[labels[start:stop]]  # (m, n_genes)
-        cdf = np.cumsum(p, axis=1)
-        local_row = np.repeat(np.arange(m), nnz)
-        flat_cdf = (cdf + 2.0 * np.arange(m)[:, None]).ravel()
-        u = rng.random(total) + 2.0 * local_row
-        gene_idx = (np.searchsorted(flat_cdf, u) - local_row * n_genes).astype(
-            np.int32
-        )
+        # Sample gene ids per draw from the cell's cluster program.
+        # The distribution depends only on the cluster, so one
+        # vectorised searchsorted per cluster suffices — no
+        # Python-level per-cell loop (10M cells would take hours).
+        draw_cluster = labels[row_idx]
+        u = rng.random(total)
+        gene_idx = np.empty(total, dtype=np.int32)
+        for c in range(n_clusters):
+            sel = draw_cluster == c
+            gene_idx[sel] = np.searchsorted(cdfs[c], u[sel])
         gene_idx = np.clip(gene_idx, 0, n_genes - 1)
         count = rng.geometric(0.4, size=total).astype(dtype)
         rows.append(row_idx)
@@ -94,6 +92,61 @@ def synthetic_counts(
         var={"gene_name": gene_names,
              "mito": (np.arange(n_genes) < n_mito)},
     )
+
+
+def synthetic_ell(
+    n_cells: int,
+    n_genes: int,
+    *,
+    nnz_per_cell: int = 600,
+    n_clusters: int = 8,
+    seed: int = 0,
+    rows_padded: int | None = None,
+    capacity: int | None = None,
+    dtype=np.float32,
+):
+    """Benchmark-scale generator: writes padded-ELL arrays directly,
+    skipping COO/CSR assembly entirely (no global sort; a 10M-cell
+    matrix generates in minutes on one core).
+
+    Duplicate gene ids within a cell are possible and harmless for
+    linear ops (they act as summed counts).  Returns
+    (SparseCells-ready dict: indices, data, n_cells, n_genes, labels).
+    """
+    from ..config import config, round_up
+
+    rng = np.random.default_rng(seed)
+    capacity = capacity or round_up(int(nnz_per_cell * 2), config.capacity_multiple)
+    rows_padded = rows_padded or round_up(n_cells, config.sublane)
+
+    base = rng.lognormal(mean=0.0, sigma=1.5, size=n_genes)
+    programs = np.tile(base, (n_clusters, 1))
+    for c in range(1, n_clusters):
+        boost = rng.choice(n_genes, size=max(1, n_genes // 20), replace=False)
+        programs[c, boost] *= rng.uniform(3.0, 10.0, size=len(boost))
+    programs /= programs.sum(axis=1, keepdims=True)
+    cdfs = np.cumsum(programs, axis=1)
+    labels = rng.integers(0, n_clusters, size=n_cells).astype(np.int32)
+
+    lib = rng.lognormal(mean=0.0, sigma=0.4, size=n_cells)
+    nnz = np.clip(rng.poisson(nnz_per_cell * lib), 1, capacity).astype(np.int64)
+
+    indices = np.full((rows_padded, capacity), n_genes, dtype=np.int32)
+    data = np.zeros((rows_padded, capacity), dtype=dtype)
+    total = int(nnz.sum())
+    row_of = np.repeat(np.arange(n_cells), nnz)
+    slot_of = np.arange(total) - np.repeat(np.cumsum(nnz) - nnz, nnz)
+    u = rng.random(total)
+    gene_idx = np.empty(total, dtype=np.int32)
+    draw_cluster = labels[row_of]
+    for c in range(n_clusters):
+        sel = draw_cluster == c
+        gene_idx[sel] = np.searchsorted(cdfs[c], u[sel])
+    np.clip(gene_idx, 0, n_genes - 1, out=gene_idx)
+    indices[row_of, slot_of] = gene_idx
+    data[row_of, slot_of] = rng.geometric(0.4, size=total).astype(dtype)
+    return dict(indices=indices, data=data, n_cells=n_cells,
+                n_genes=n_genes, labels=labels)
 
 
 def gaussian_blobs(

@@ -188,23 +188,27 @@ def hvg_select_tpu(data: CellData, n_top: int = 2000,
         reg_std = jnp.sqrt(reg_var)
         clip = jnp.sqrt(jnp.asarray(float(n)))
         if isinstance(X, SparseCells):
-            # clipped standardised second moment via one segment pass:
-            # sum_c min(clip, (x - mu)/sigma)^2 =
-            #   [nnz terms] + (n - nnz) * (mu/sigma)^2   (zeros clip too,
-            #   but mu/sigma is tiny so the zero term is (0-mu)/sigma).
+            # clipped standardised second moment via one chunked
+            # segment pass: sum_c min(clip, (x - mu)/sigma)^2 =
+            #   [nnz terms] + (n - nnz) * (mu/sigma)^2   (zeros clip
+            #   too, their term is (0-mu)/sigma).
+            from ..data.sparse import segment_reduce
+
             std = jnp.maximum(reg_std, 1e-12)
             table_mu = jnp.concatenate([mean / std, jnp.zeros((1,))])
             table_inv = jnp.concatenate([1.0 / std, jnp.zeros((1,))])
-            zval = jnp.take(table_inv, X.indices, axis=0) * X.data - jnp.take(
-                table_mu, X.indices, axis=0
-            )
-            zval = jnp.clip(zval, -clip, clip)
-            contrib = jnp.where(
-                X.valid_mask() & X.row_mask()[:, None], zval * zval, 0.0
-            )
-            ssq_nnz = jax.ops.segment_sum(
-                contrib.ravel(), X.indices.ravel(), num_segments=X.n_genes + 1
-            )[: X.n_genes]
+            n_cells = X.n_cells
+            sentinel = X.sentinel
+
+            def slot_vals(ind, dat, row_offset):
+                zval = jnp.take(table_inv, ind, axis=0) * dat - jnp.take(
+                    table_mu, ind, axis=0)
+                zval = jnp.clip(zval, -clip, clip)
+                rows = row_offset + jnp.arange(ind.shape[0])
+                ok = (ind != sentinel) & (rows < n_cells)[:, None]
+                return jnp.where(ok, zval * zval, 0.0)[:, :, None]
+
+            ssq_nnz = segment_reduce(X, slot_vals, 1)[:, 0]
             zero_term = jnp.clip(-mean / std, -clip, clip) ** 2
             ssq = ssq_nnz + (n - nnz) * zero_term
         else:

@@ -44,6 +44,7 @@ def knn_arrays(
     query_block: int | None = None,
     cand_block: int | None = None,
     exclude_self: bool = False,
+    refine: int = 0,
 ):
     """Exact kNN of ``query`` rows against ``cand`` rows.
 
@@ -56,18 +57,40 @@ def knn_arrays(
     Config (block sizes, matmul dtype) is resolved *here*, outside
     jit, and passed down as static arguments — so ``configure(...)``
     changes take effect instead of being baked into a cached trace.
+
+    ``refine``: search ``refine`` candidates with the fast (bfloat16
+    MXU) score path, then exactly re-rank them in float32
+    (Precision.HIGHEST) and keep ``k``.  This recovers float64-oracle
+    recall at bfloat16 search speed — the classic coarse-search +
+    refine split.  0 disables refinement.
+
+    Note TPU matmul precision: with float32 inputs XLA still runs the
+    MXU in bfloat16 passes unless Precision.HIGHEST is requested, so
+    ``matmul_dtype="float32"`` alone does NOT buy exact scores —
+    we map it to HIGHEST explicitly.
     """
     if metric not in ("cosine", "euclidean"):
         raise ValueError(f"unknown metric {metric!r}")
-    return _knn_jit(
-        query, cand, k=k, metric=metric,
-        n_query=n_query or query.shape[0],
-        n_cand=n_cand or cand.shape[0],
+    n_query = n_query or query.shape[0]
+    n_cand = n_cand or cand.shape[0]
+    k_search = max(k, refine) if refine else k
+    idx, dist = _knn_jit(
+        query, cand, k=k_search, metric=metric,
+        n_query=n_query, n_cand=n_cand,
         qb=query_block or config.row_block,
         cb=cand_block or config.col_block,
         mm_dtype=str(jnp.dtype(config.matmul_dtype)),
         exclude_self=exclude_self,
     )
+    if refine:
+        # Any refine > 0 runs the exact pass — even refine <= k still
+        # re-scores the k candidates in f32 (caller asked for exact
+        # distances, not just a wider search).
+        idx, dist = _refine_jit(query, cand, idx, k=k, metric=metric,
+                                qb=query_block or config.row_block)
+        qvalid = jnp.arange(idx.shape[0]) < n_query
+        idx = jnp.where(qvalid[:, None], idx, -1)
+    return idx, dist
 
 
 @partial(
@@ -78,6 +101,9 @@ def knn_arrays(
 def _knn_jit(query, cand, *, k, metric, n_query, n_cand, qb, cb,
              mm_dtype, exclude_self):
     mm_dtype = jnp.dtype(mm_dtype)
+    # float32 inputs need HIGHEST or the MXU silently drops to bf16.
+    precision = (jax.lax.Precision.HIGHEST if mm_dtype == jnp.float32
+                 else jax.lax.Precision.DEFAULT)
     d = query.shape[1]
     nq_pad = round_up(n_query, qb)
     nc_pad = round_up(n_cand, cb)
@@ -105,7 +131,8 @@ def _knn_jit(query, cand, *, k, metric, n_query, n_cand, qb, cb,
             bvals, bidx = carry
             cblk, cn2, off = inp
             s = jnp.dot(
-                qblk, cblk.T, preferred_element_type=jnp.float32
+                qblk, cblk.T, preferred_element_type=jnp.float32,
+                precision=precision,
             )  # (qb, cb) similarity-like
             if metric == "euclidean":
                 s = -(qn2[:, None] - 2.0 * s + cn2[None, :])
@@ -145,18 +172,63 @@ def _knn_jit(query, cand, *, k, metric, n_query, n_cand, qb, cb,
     return idxs, dists
 
 
+@partial(jax.jit, static_argnames=("k", "metric", "qb"))
+def _refine_jit(query, cand, cand_idx, *, k, metric, qb):
+    """Exact float32 re-rank of per-query candidate lists.
+
+    query: (nq_pad, d); cand: (nc, d); cand_idx: (nq_pad, k') from the
+    coarse search (may contain -1 padding).  Returns (idx, dist) of
+    the top ``k`` by exact score.  Chunked over query blocks.
+    """
+    nq_pad = cand_idx.shape[0]
+    d = query.shape[1]
+    kp = cand_idx.shape[1]
+    q = jnp.zeros((nq_pad, d), jnp.float32).at[: query.shape[0]].set(
+        query.astype(jnp.float32))
+    c = cand.astype(jnp.float32)
+    if metric == "cosine":
+        q = q / jnp.maximum(jnp.linalg.norm(q, axis=1, keepdims=True), 1e-12)
+        c = c / jnp.maximum(jnp.linalg.norm(c, axis=1, keepdims=True), 1e-12)
+
+    def per_block(args):
+        qblk, iblk = args  # (qb, d), (qb, kp); iblk may contain -1
+        # jnp.take clips out-of-range under jit, and -1 rows are masked
+        # to -inf below, so no explicit sanitising is needed.
+        g = jnp.take(c, iblk, axis=0)  # (qb, kp, d)
+        s = jnp.einsum("qd,qkd->qk", qblk, g,
+                       precision=jax.lax.Precision.HIGHEST)
+        if metric == "euclidean":
+            qn2 = jnp.sum(qblk * qblk, axis=1)
+            cn2 = jnp.sum(g * g, axis=2)
+            s = -(qn2[:, None] - 2.0 * s + cn2)
+        s = jnp.where(iblk < 0, -jnp.inf, s)
+        v, sel = jax.lax.top_k(s, k)
+        return v, jnp.take_along_axis(iblk, sel, axis=1)
+
+    nqb = nq_pad // qb
+    vals, idxs = jax.lax.map(
+        per_block,
+        (q.reshape(nqb, qb, d), cand_idx.reshape(nqb, qb, kp)),
+    )
+    vals = vals.reshape(nq_pad, k)
+    idxs = idxs.reshape(nq_pad, k)  # -1 padding propagates via iblk
+    dists = (1.0 - vals) if metric == "cosine" else jnp.sqrt(
+        jnp.maximum(-vals, 0.0))
+    return idxs, dists
+
+
 @register("neighbors.knn", backend="tpu")
 def knn_tpu(data: CellData, k: int = 15, metric: str = "cosine",
             use_rep: str = "X_pca", exclude_self: bool = False,
             query_block: int | None = None,
-            cand_block: int | None = None) -> CellData:
+            cand_block: int | None = None, refine: int = 0) -> CellData:
     """Adds obsp["knn_indices"], obsp["knn_distances"]; uns["knn_k"],
     uns["knn_metric"]."""
     rep = _get_rep(data, use_rep)
     idx, dist = knn_arrays(
         rep, rep, k=k, metric=metric, n_query=data.n_cells,
         n_cand=data.n_cells, exclude_self=exclude_self,
-        query_block=query_block, cand_block=cand_block,
+        query_block=query_block, cand_block=cand_block, refine=refine,
     )
     return data.with_obsp(knn_indices=idx, knn_distances=dist).with_uns(
         knn_k=k, knn_metric=metric

@@ -48,13 +48,47 @@ def _gene_mean(X) -> jax.Array:
     return jnp.mean(X, axis=0)
 
 
-@partial(jax.jit, static_argnames=("n_components", "oversample", "n_iter", "center"))
+def cholesky_qr(Y: jax.Array, iters: int = 2) -> jax.Array:
+    """Orthonormalise the columns of ``Y`` via CholeskyQR2.
+
+    Distributed-friendly alternative to Householder QR: the only
+    cross-row reduction is the (L, L) Gram matrix, which GSPMD turns
+    into a single ``psum`` when Y is row-sharded over the mesh — no
+    all-gather of the tall matrix.  Two iterations recover Householder-
+    level orthogonality for the moderately conditioned iterates that
+    arise inside randomized PCA.
+    """
+    for _ in range(iters):
+        # HIGHEST: TPU would otherwise run the f32 Gram matmul in
+        # bf16 passes; CholeskyQR error ~ κ(Y)²·ε, and bf16-level ε
+        # drives the Gram matrix indefinite → NaN factorisation.
+        G = jnp.dot(Y.T, Y, preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST)
+        G = G + 1e-7 * jnp.trace(G) / G.shape[0] * jnp.eye(G.shape[0], dtype=G.dtype)
+        R = jnp.linalg.cholesky(G, upper=True)
+        Y = jax.lax.linalg.triangular_solve(
+            R, Y, left_side=False, lower=False
+        )
+    return Y
+
+
+def _orthonormalize(Y, method: str):
+    if method == "cholesky":
+        return cholesky_qr(Y)
+    Q, _ = jnp.linalg.qr(Y)
+    return Q
+
+
+@partial(jax.jit, static_argnames=("n_components", "oversample", "n_iter",
+                                   "center", "qr_method"))
 def randomized_pca_arrays(X, key, n_components: int = 50, oversample: int = 10,
-                          n_iter: int = 2, center: bool = True):
+                          n_iter: int = 2, center: bool = True,
+                          qr_method: str = "cholesky"):
     """Core randomized PCA.  X: SparseCells or dense (n, G).
 
     Returns (scores (rows, k), components (G, k), explained_var (k,),
-    mean (G,)).
+    mean (G,)).  ``qr_method``: "cholesky" (CholeskyQR2; row-sharding
+    friendly, default) or "householder" (jnp.linalg.qr).
     """
     G = X.n_genes if isinstance(X, SparseCells) else X.shape[1]
     n = X.n_cells if isinstance(X, SparseCells) else X.shape[0]
@@ -64,12 +98,12 @@ def randomized_pca_arrays(X, key, n_components: int = 50, oversample: int = 10,
 
     omega = jax.random.normal(key, (G, L), dtype)
     Y = _center_matvec(X, mu, omega)  # (rows, L)
-    Q, _ = jnp.linalg.qr(Y)
+    Q = _orthonormalize(Y, qr_method)
     for _ in range(n_iter):
         Z = _center_rmatvec(X, mu, Q)  # (G, L)
-        Qz, _ = jnp.linalg.qr(Z)
+        Qz = _orthonormalize(Z, qr_method)
         Y = _center_matvec(X, mu, Qz)
-        Q, _ = jnp.linalg.qr(Y)
+        Q = _orthonormalize(Y, qr_method)
     B = _center_rmatvec(X, mu, Q).T  # (L, G)
     U_b, S, Vt = jnp.linalg.svd(B, full_matrices=False)
     k = n_components
@@ -82,12 +116,13 @@ def randomized_pca_arrays(X, key, n_components: int = 50, oversample: int = 10,
 @register("pca.randomized", backend="tpu")
 def pca_randomized_tpu(data: CellData, n_components: int = 50,
                        oversample: int = 10, n_iter: int = 2,
-                       center: bool = True, seed: int = 0) -> CellData:
+                       center: bool = True, seed: int = 0,
+                       qr_method: str = "cholesky") -> CellData:
     """Adds obsm["X_pca"], varm["PCs"], uns["pca_explained_variance"]."""
     key = jax.random.PRNGKey(seed)
     scores, comps, expl, mu = randomized_pca_arrays(
         data.X, key, n_components=n_components, oversample=oversample,
-        n_iter=n_iter, center=center,
+        n_iter=n_iter, center=center, qr_method=qr_method,
     )
     return data.with_obsm(X_pca=scores).with_varm(PCs=comps).with_uns(
         pca_explained_variance=expl, pca_mean=mu,

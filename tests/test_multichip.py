@@ -1,0 +1,114 @@
+"""Multi-chip paths on the 8-device virtual CPU mesh: ring kNN vs
+single-chip / exact oracle, sharded pipeline parity."""
+
+import jax
+import numpy as np
+import pytest
+
+import sctools_tpu as sct
+from sctools_tpu.data.synthetic import gaussian_blobs, synthetic_counts
+from sctools_tpu.ops.knn import knn_numpy, recall_at_k
+from sctools_tpu.parallel import knn_multichip_arrays, make_mesh, shard_celldata
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert jax.device_count() >= 8
+    return make_mesh(8)
+
+
+@pytest.mark.parametrize("metric", ["cosine", "euclidean"])
+@pytest.mark.parametrize("strategy", ["ring", "all_gather"])
+def test_multichip_knn_matches_oracle(mesh8, metric, strategy):
+    pts, _ = gaussian_blobs(500, 16, n_clusters=5, seed=6)
+    idx, dist = knn_multichip_arrays(
+        pts, k=10, metric=metric, mesh=mesh8, n_valid=500, block=32,
+        strategy=strategy,
+    )
+    ref_idx, ref_dist = knn_numpy(pts, pts, k=10, metric=metric)
+    r = recall_at_k(np.asarray(idx)[:500], ref_idx)
+    assert r >= 0.999, f"recall {r} ({metric}/{strategy})"
+    # atol: f32 cancellation in ‖q‖²-2q·c+‖c‖² for nearby points
+    np.testing.assert_allclose(
+        np.sort(np.asarray(dist)[:500], axis=1), np.sort(ref_dist, axis=1),
+        rtol=1e-3, atol=5e-3,
+    )
+
+
+def test_multichip_knn_exclude_self(mesh8):
+    pts, _ = gaussian_blobs(200, 8, n_clusters=3, seed=7)
+    idx, _ = knn_multichip_arrays(
+        pts, k=5, metric="euclidean", mesh=mesh8, n_valid=200, block=16,
+        exclude_self=True,
+    )
+    idx = np.asarray(idx)[:200]
+    assert not np.any(idx == np.arange(200)[:, None])
+
+
+def test_multichip_uneven_padding(mesh8):
+    """n not divisible by devices*block: padded rows must not pollute."""
+    pts, _ = gaussian_blobs(333, 12, n_clusters=4, seed=8)
+    idx, dist = knn_multichip_arrays(
+        pts, k=7, metric="cosine", mesh=mesh8, n_valid=333, block=16,
+    )
+    ref_idx, _ = knn_numpy(pts, pts, k=7, metric="cosine")
+    r = recall_at_k(np.asarray(idx)[:333], ref_idx)
+    assert r >= 0.999, f"recall {r}"
+    # no padded candidate (>= 333) ever appears
+    assert np.asarray(idx)[:333].max() < 333
+
+
+def test_multichip_transform(mesh8):
+    ds = synthetic_counts(300, 200, n_clusters=3, seed=9)
+    dev = ds.device_put()
+    dev = sct.apply("pca.exact", dev, backend="tpu", n_components=10)
+    out = sct.apply("neighbors.knn_multichip", dev, backend="tpu", k=8,
+                    metric="cosine", block=16).to_host()
+    assert out.obsp["knn_indices"].shape == (300, 8)
+    cpu = sct.apply("pca.exact", ds, backend="cpu", n_components=10)
+    cpu = sct.apply("neighbors.knn", cpu, backend="cpu", k=8, metric="cosine")
+    # same-subspace embeddings (both exact PCA) -> same graph
+    r = recall_at_k(out.obsp["knn_indices"], cpu.obsp["knn_indices"])
+    assert r >= 0.99, f"recall {r}"
+
+
+def test_sharded_pipeline_matches_single_device(mesh8):
+    """The jitted ops are sharding-agnostic: running them on a
+    cell-sharded CellData must give identical results (GSPMD inserts
+    the collectives)."""
+    ds = synthetic_counts(256, 128, n_clusters=2, seed=10)
+    pipe = sct.Pipeline([
+        ("qc.per_cell_metrics", {}),
+        ("normalize.library_size", {"target_sum": 1e4}),
+        ("normalize.log1p", {}),
+        ("hvg.select", {"n_top": 64}),
+    ])
+    single = pipe.run(ds.device_put(), backend="tpu").to_host()
+    sharded = pipe.run(shard_celldata(ds, mesh8), backend="tpu").to_host()
+    np.testing.assert_allclose(sharded.obs["total_counts"],
+                               single.obs["total_counts"], rtol=1e-4)
+    np.testing.assert_allclose(sharded.var["hvg_score"],
+                               single.var["hvg_score"], rtol=1e-3, atol=1e-3)
+    np.testing.assert_array_equal(sharded.var["highly_variable"],
+                                  single.var["highly_variable"])
+
+
+def test_sharded_pca_cholesky_qr(mesh8):
+    """Distributed PCA via CholeskyQR2 on sharded rows matches the
+    exact oracle's subspace."""
+    ds = synthetic_counts(256, 128, n_clusters=3, seed=11)
+    prep = sct.Pipeline([
+        ("normalize.library_size", {"target_sum": 1e4}),
+        ("normalize.log1p", {}),
+    ]).run(ds, backend="cpu")
+    sharded = shard_celldata(prep, mesh8)
+    out = sct.apply("pca.randomized", sharded, backend="tpu",
+                    n_components=10, n_iter=4, qr_method="cholesky").to_host()
+    exact = sct.apply("pca.exact", prep, backend="cpu", n_components=10)
+    ev_e = np.asarray(exact.uns["pca_explained_variance"])
+    ev_r = np.asarray(out.uns["pca_explained_variance"])
+    np.testing.assert_allclose(ev_r, ev_e, rtol=5e-2)
+    Ve = np.asarray(exact.varm["PCs"])[:, :5]
+    Vr = np.asarray(out.varm["PCs"])[:, :5]
+    s = np.linalg.svd(Ve.T @ Vr, compute_uv=False)
+    assert s.min() > 0.95, f"subspace misaligned: {s}"

@@ -60,6 +60,33 @@ def test_knn_exact_recall(metric):
     )
 
 
+def test_knn_refine_matches_direct():
+    """Coarse-search + exact refine must equal (or beat) the direct
+    search — on CPU both are exact, so the graphs coincide."""
+    pts, _ = gaussian_blobs(400, 24, n_clusters=5, seed=14)
+    direct_i, direct_d = knn_arrays(pts, pts, k=8, metric="cosine",
+                                    n_query=400, n_cand=400,
+                                    query_block=128, cand_block=128)
+    ref_i, ref_d = knn_arrays(pts, pts, k=8, metric="cosine",
+                              n_query=400, n_cand=400,
+                              query_block=128, cand_block=128, refine=32)
+    r = recall_at_k(np.asarray(ref_i)[:400], np.asarray(direct_i)[:400])
+    assert r >= 0.999, f"refine recall {r}"
+    np.testing.assert_allclose(np.asarray(ref_d)[:400],
+                               np.asarray(direct_d)[:400], rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_knn_refine_euclidean():
+    pts, _ = gaussian_blobs(300, 16, n_clusters=4, seed=15)
+    ref_i, _ = knn_arrays(pts, pts, k=6, metric="euclidean", n_query=300,
+                          n_cand=300, query_block=64, cand_block=128,
+                          refine=24)
+    oracle_i, _ = knn_numpy(pts, pts, k=6, metric="euclidean")
+    r = recall_at_k(np.asarray(ref_i)[:300], oracle_i)
+    assert r >= 0.999, f"recall {r}"
+
+
 def test_knn_exclude_self():
     pts, _ = gaussian_blobs(200, 8, n_clusters=3, seed=5)
     idx, _ = knn_arrays(pts, pts, k=5, metric="euclidean", n_query=200,
