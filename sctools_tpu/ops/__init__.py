@@ -1,4 +1,6 @@
 """Operator implementations.  Importing this package registers every
 transform with the registry (both cpu and tpu backends)."""
 
-from . import distance, hvg, knn, normalize, pca, qc  # noqa: F401
+from . import (  # noqa: F401
+    cluster, distance, graph, hvg, knn, normalize, pca, qc,
+)

@@ -1,0 +1,354 @@
+"""Clustering: ``cluster.kmeans`` (minibatch-free Lloyd on MXU) and
+``cluster.leiden_like`` (graph label propagation over the kNN graph).
+
+TPU design: k-means assignment is the same blocked score-matmul as
+kNN (centroids replicated in VMEM, argmax over MXU scores); the
+update step is one ``segment_sum`` per iteration.  Everything runs
+under one ``lax.scan`` over iterations — no host round-trips.
+
+The Leiden-like transform is a deterministic label-propagation scheme
+over the kNN graph (argmax over neighbour-label votes, iterated).
+True Leiden's refinement phase is data-dependent sequential work that
+does not map to XLA; label propagation reaches comparable modularity
+on kNN graphs and is embarrassingly parallel.  Documented divergence
+from the reference's louvain/leiden.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+from ..data.dataset import CellData
+from ..registry import register
+
+
+@partial(jax.jit, static_argnames=("n_clusters", "n_iter", "block"))
+def kmeans_arrays(points, key, n_clusters: int = 8, n_iter: int = 25,
+                  block: int = 4096):
+    """Lloyd's algorithm.  points: (n, d) dense.  Returns (labels (n,),
+    centroids (k, d), inertia ())."""
+    n, d = points.shape
+    pts = jnp.asarray(points, jnp.float32)
+
+    # k-means++-lite init: sample k points with probability ∝ squared
+    # distance to the running centroid set, approximated by one
+    # D²-weighted draw round (full k-means++ is sequential in k; one
+    # weighted round captures most of the benefit and stays parallel).
+    i0 = jax.random.choice(key, n, (1,))
+    c0 = pts[i0]  # (1, d)
+    d2 = jnp.sum((pts - c0) ** 2, axis=1)
+    probs = d2 / jnp.maximum(d2.sum(), 1e-12)
+    rest = jax.random.choice(key, n, (n_clusters - 1,), replace=False, p=probs)
+    centroids = jnp.concatenate([c0, pts[rest]], axis=0)  # (k, d)
+
+    nb = -(-n // block)
+    pad = nb * block - n
+    pts_pad = jnp.concatenate([pts, jnp.zeros((pad, d), pts.dtype)]) if pad else pts
+    valid = jnp.arange(nb * block) < n
+
+    def assign(centroids):
+        cn2 = jnp.sum(centroids**2, axis=1)  # (k,)
+
+        def per_block(args):
+            p = args  # (block, d)
+            s = jnp.dot(p, centroids.T, preferred_element_type=jnp.float32,
+                        precision=jax.lax.Precision.HIGHEST)
+            d2 = cn2[None, :] - 2.0 * s  # + ||p||² (constant per row)
+            lab = jnp.argmin(d2, axis=1).astype(jnp.int32)
+            best = jnp.min(d2, axis=1) + jnp.sum(p * p, axis=1)
+            return lab, best
+
+        labs, best = jax.lax.map(per_block, pts_pad.reshape(nb, block, d))
+        return labs.reshape(-1), best.reshape(-1)
+
+    def step(centroids, _):
+        labels, best = assign(centroids)
+        labels_v = jnp.where(valid, labels, n_clusters)  # padding → dropped bin
+        sums = jax.ops.segment_sum(
+            jnp.where(valid[:, None], pts_pad, 0.0), labels_v,
+            num_segments=n_clusters + 1)[:n_clusters]
+        counts = jax.ops.segment_sum(
+            valid.astype(jnp.float32), labels_v,
+            num_segments=n_clusters + 1)[:n_clusters]
+        new_c = jnp.where(counts[:, None] > 0,
+                          sums / jnp.maximum(counts[:, None], 1.0),
+                          centroids)
+        inertia = jnp.sum(jnp.where(valid, best, 0.0))
+        return new_c, inertia
+
+    centroids, inertias = jax.lax.scan(step, centroids, None, length=n_iter)
+    labels, best = assign(centroids)
+    inertia = jnp.sum(jnp.where(valid, best, 0.0))
+    return labels[:n], centroids, inertia
+
+
+@register("cluster.kmeans", backend="tpu")
+def kmeans_tpu(data: CellData, n_clusters: int = 8, n_iter: int = 25,
+               use_rep: str = "X_pca", seed: int = 0) -> CellData:
+    """Adds obs["kmeans"], uns["kmeans_centroids"], uns["kmeans_inertia"]."""
+    from .knn import _get_rep
+
+    rep = _get_rep(data, use_rep)
+    labels, centroids, inertia = kmeans_arrays(
+        jnp.asarray(rep)[: data.n_cells], jax.random.PRNGKey(seed),
+        n_clusters=n_clusters, n_iter=n_iter)
+    return data.with_obs(kmeans=labels).with_uns(
+        kmeans_centroids=centroids, kmeans_inertia=inertia)
+
+
+@register("cluster.kmeans", backend="cpu")
+def kmeans_cpu(data: CellData, n_clusters: int = 8, n_iter: int = 25,
+               use_rep: str = "X_pca", seed: int = 0) -> CellData:
+    """numpy Lloyd oracle (same init scheme family, own RNG)."""
+    from .knn import _get_rep_cpu
+
+    rep = np.asarray(_get_rep_cpu(data, use_rep), np.float64)[: data.n_cells]
+    rng = np.random.default_rng(seed)
+    n = len(rep)
+    # full sequential k-means++ (the numpy oracle can afford it)
+    centroids = rep[rng.choice(n, 1)]
+    for _ in range(n_clusters - 1):
+        d2 = np.min(((rep[:, None, :] - centroids[None]) ** 2).sum(-1), axis=1)
+        p = d2 / max(d2.sum(), 1e-12)
+        centroids = np.concatenate([centroids, rep[rng.choice(n, 1, p=p)]])
+    labels = np.zeros(n, np.int32)
+    for _ in range(n_iter):
+        d2 = ((rep[:, None, :] - centroids[None, :, :]) ** 2).sum(-1) \
+            if n * n_clusters * rep.shape[1] < 5e7 else None
+        if d2 is None:
+            s = rep @ centroids.T
+            d2 = (centroids**2).sum(1)[None, :] - 2 * s
+        labels = np.argmin(d2, axis=1).astype(np.int32)
+        for j in range(n_clusters):
+            m = labels == j
+            if m.any():
+                centroids[j] = rep[m].mean(axis=0)
+    inertia = float(((rep - centroids[labels]) ** 2).sum())
+    return data.with_obs(kmeans=labels).with_uns(
+        kmeans_centroids=centroids.astype(np.float32),
+        kmeans_inertia=np.float32(inertia))
+
+
+# ----------------------------------------------------------------------
+# Label propagation over the kNN graph ("leiden-like" communities).
+# ----------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("n_iter",))
+def label_propagation_arrays(knn_idx, weights, n_iter: int = 30):
+    """Weighted label propagation on a kNN graph.
+
+    knn_idx: (n, k) int32 neighbour ids (-1 = missing); weights:
+    (n, k) edge weights.  Starts from singleton labels; each round a
+    node adopts the best-supported neighbour label, but only when its
+    support STRICTLY beats the node's current label (monotone — plain
+    synchronous propagation oscillates), with support ties resolved
+    toward the lower label id (also monotone).  Self-edges never vote.
+    Fully deterministic.
+    """
+    n, k = knn_idx.shape
+    labels0 = jnp.arange(n, dtype=jnp.int32)
+    safe_idx = jnp.where(knn_idx < 0, 0, knn_idx)
+    # self-edges must not vote: a self-weight of 1.0 (distance 0 in
+    # the UMAP kernel) would pin every node to its own singleton label
+    row_ids = jnp.arange(n, dtype=knn_idx.dtype)[:, None]
+    dead = (knn_idx < 0) | (knn_idx == row_ids)
+    w = jnp.where(dead, 0.0, weights.astype(jnp.float32))
+
+    block = 8192
+    nb = -(-n // block)
+    pad = nb * block - n
+
+    def step(labels, _):
+        neigh_labels = jnp.take(labels, safe_idx, axis=0)  # (n, k)
+        nl = jnp.where(dead, -1, neigh_labels)
+        wv = w
+        cur = labels
+        if pad:
+            nl = jnp.concatenate([nl, jnp.full((pad, k), -1, nl.dtype)])
+            wv = jnp.concatenate([wv, jnp.zeros((pad, k), wv.dtype)])
+            cur = jnp.concatenate([cur, jnp.full((pad,), -1, cur.dtype)])
+
+        def per_block(args):
+            sl, sw, cl = args  # (block, k), (block, k), (block,)
+            # vote weight of each position's label: O(k²) pairwise
+            # equality mask — k is small, so this is trivial VPU work
+            # and avoids any scatter into (n, n_labels).
+            same = sl[:, None, :] == sl[:, :, None]  # (block, k, k)
+            acc = jnp.sum(jnp.where(same, sw[:, None, :], 0.0), axis=2)
+            acc = jnp.where(sl < 0, -1.0, acc)
+            # tie-break: highest weight, then lowest label id — as two
+            # exact passes (a combined scalar key would let label ids
+            # override genuine weight differences)
+            bw = jnp.max(acc, axis=1)
+            cand = jnp.where(acc == bw[:, None], sl,
+                             jnp.iinfo(jnp.int32).max)
+            lab = jnp.min(cand, axis=1)
+            # support for the CURRENT label among neighbours
+            cur_support = jnp.sum(
+                jnp.where(sl == cl[:, None], sw, 0.0), axis=1)
+            return lab, bw, cur_support
+
+        lab, bw, cur_sup = jax.lax.map(
+            per_block, (nl.reshape(nb, block, k), wv.reshape(nb, block, k),
+                        cur.reshape(nb, block)))
+        lab = lab.reshape(-1)[:n]
+        bw = bw.reshape(-1)[:n]
+        cur_sup = cur_sup.reshape(-1)[:n]
+        # monotone update: adopt a STRICTLY better-supported label
+        # (synchronous best-of-all updates oscillate and fragment);
+        # on support ties adopt the LOWER id — label ids then only
+        # decrease, which merges equal-support plateau fragments
+        # without reintroducing oscillation.
+        valid_lab = (lab >= 0) & (lab < jnp.iinfo(jnp.int32).max)
+        better = bw > cur_sup + 1e-12
+        tie_lower = (jnp.abs(bw - cur_sup) <= 1e-12) & (lab < labels)
+        adopt = (better | tie_lower) & valid_lab
+        return jnp.where(adopt, lab, labels), None
+
+    labels, _ = jax.lax.scan(step, labels0, None, length=n_iter)
+    return labels
+
+
+def _compact_labels(labels: np.ndarray) -> np.ndarray:
+    uniq, inv = np.unique(labels, return_inverse=True)
+    return inv.astype(np.int32)
+
+
+def _modularity_merge(labels: np.ndarray, knn_idx: np.ndarray,
+                      weights: np.ndarray) -> np.ndarray:
+    """Leiden-style aggregation phase: greedily merge communities of
+    the coarse label graph while modularity increases.
+
+    Pure LPA leaves stable same-cluster fragments (a fragment's
+    internal support beats boundary votes); merging on the aggregated
+    graph is exactly how Louvain/Leiden escape that.  The coarse graph
+    has only #labels nodes, so this is negligible host-side work.
+    """
+    labels = _compact_labels(labels)
+    m = labels.max() + 1 if len(labels) else 0
+    if m <= 1:
+        return labels
+    n, k = knn_idx.shape
+    li = np.repeat(labels, k)
+    cols = knn_idx.reshape(-1)
+    keep = cols >= 0
+    lj = labels[np.clip(cols, 0, n - 1)]
+    w = np.asarray(weights, np.float64).reshape(-1)
+    A = np.zeros((m, m))
+    np.add.at(A, (li[keep], lj[keep]), w[keep])
+    A = 0.5 * (A + A.T)
+    total = A.sum()
+    if total <= 0:
+        return labels
+    group = np.arange(m)
+    while True:
+        deg = A.sum(axis=1)
+        # modularity gain of merging i,j: 2*(A_ij/total - deg_i*deg_j/total²)
+        gain = 2.0 * (A / total - np.outer(deg, deg) / (total * total))
+        np.fill_diagonal(gain, -np.inf)
+        i, j = np.unravel_index(np.argmax(gain), gain.shape)
+        if gain[i, j] <= 1e-12:
+            break
+        # merge j into i
+        A[i] += A[j]
+        A[:, i] += A[:, j]
+        A[i, i] += 0.0
+        A = np.delete(np.delete(A, j, axis=0), j, axis=1)
+        group[group == j] = i
+        group[group > j] -= 1
+        m -= 1
+        if m <= 1:
+            break
+    return _compact_labels(group[labels])
+
+
+@register("cluster.leiden_like", backend="tpu")
+def leiden_like_tpu(data: CellData, n_iter: int = 30,
+                    weight_key: str = "connectivities") -> CellData:
+    """Community labels from label propagation over the kNN graph
+    (deterministic) plus a modularity merge of the coarse label graph.
+    Requires neighbors.knn (+ optionally graph.connectivities for
+    weighted votes).  Adds obs["leiden_like"]."""
+    if "knn_indices" not in data.obsp:
+        raise ValueError("run neighbors.knn first")
+    idx = jnp.asarray(data.obsp["knn_indices"])[: data.n_cells]
+    if weight_key in data.obsp:
+        w = jnp.asarray(data.obsp[weight_key])[: data.n_cells]
+    else:
+        w = jnp.ones_like(idx, dtype=jnp.float32)
+    labels = label_propagation_arrays(idx, w, n_iter=n_iter)
+    # the merge phase must see the same self-edge-free weights the
+    # propagation used (CPU oracle masks identically)
+    idx_h = np.asarray(idx)
+    dead = (idx_h < 0) | (idx_h == np.arange(data.n_cells)[:, None])
+    w_h = np.where(dead, 0.0, np.asarray(w))
+    labels = _modularity_merge(np.asarray(labels), idx_h, w_h)
+    return data.with_obs(leiden_like=jnp.asarray(labels))
+
+
+@register("cluster.leiden_like", backend="cpu")
+def leiden_like_cpu(data: CellData, n_iter: int = 30,
+                    weight_key: str = "connectivities") -> CellData:
+    """numpy oracle of the same propagation scheme."""
+    if "knn_indices" not in data.obsp:
+        raise ValueError("run neighbors.knn first")
+    idx = np.asarray(data.obsp["knn_indices"])[: data.n_cells]
+    n, k = idx.shape
+    if weight_key in data.obsp:
+        w = np.asarray(data.obsp[weight_key], np.float64)[: data.n_cells]
+    else:
+        w = np.ones_like(idx, np.float64)
+    dead = (idx < 0) | (idx == np.arange(n)[:, None])  # no self-votes
+    w = np.where(dead, 0.0, w)
+    safe = np.where(idx < 0, 0, idx)
+    labels = np.arange(n, dtype=np.int64)
+    for _ in range(n_iter):
+        nl = np.where(dead, -1, labels[safe])
+        new = labels.copy()
+        for i in range(n):
+            votes: dict = {}
+            for j in range(k):
+                if w[i, j] > 0:
+                    votes[nl[i, j]] = votes.get(nl[i, j], 0.0) + w[i, j]
+            if votes:
+                # highest weight, then lowest label id (mirror TPU)
+                best = min(votes, key=lambda L: (-votes[L], L))
+                cur_sup = votes.get(labels[i], 0.0)
+                if votes[best] > cur_sup + 1e-12 or (
+                        abs(votes[best] - cur_sup) <= 1e-12
+                        and best < labels[i]):
+                    new[i] = best
+        if (new == labels).all():
+            break
+        labels = new
+    labels = _modularity_merge(labels, idx, w)
+    return data.with_obs(leiden_like=labels)
+
+
+def adjusted_rand_index(a, b) -> float:
+    """ARI between two labelings (test/bench metric)."""
+    a = np.asarray(a)
+    b = np.asarray(b)
+    n = len(a)
+    ua, ia = np.unique(a, return_inverse=True)
+    ub, ib = np.unique(b, return_inverse=True)
+    m = np.zeros((len(ua), len(ub)), np.int64)
+    np.add.at(m, (ia, ib), 1)
+    ai = m.sum(1)
+    bj = m.sum(0)
+    comb = lambda x: x * (x - 1) / 2.0
+    s_ij = comb(m).sum()
+    s_a = comb(ai).sum()
+    s_b = comb(bj).sum()
+    s_n = comb(np.float64(n))
+    expected = s_a * s_b / s_n
+    max_idx = 0.5 * (s_a + s_b)
+    if max_idx == expected:
+        return 1.0
+    return float((s_ij - expected) / (max_idx - expected))

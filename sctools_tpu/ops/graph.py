@@ -1,0 +1,474 @@
+"""Neighbour-graph ops: ``graph.connectivities`` (UMAP-style fuzzy
+weights / gaussian kernel), ``graph.diffusion_operator`` (row-
+normalised transition matrix), ``impute.magic`` (diffusion
+imputation), ``embed.spectral`` (diffusion-map embedding),
+``dpt.pseudotime`` (diffusion pseudotime from a root cell).
+
+TPU design: the kNN graph is kept in its padded (n, k) edge-list form
+— exactly the shape ``neighbors.knn`` produces — and every graph
+operation is either per-edge VPU work or a gather+reduce along the k
+axis.  ``P @ X`` (diffusion steps) is a k-sparse matvec: gather k
+rows of X, weight, sum — O(n·k·d), chunked over rows.  The symmetric
+normalised operator uses the edge-reversed weights via one
+segment-sum.  Spectral embedding reuses the randomized eigensolver
+machinery from PCA (subspace iteration with CholeskyQR2) on the
+diffusion operator — matrix-free, multi-chip-sharding friendly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.dataset import CellData
+from ..data.sparse import SparseCells
+from ..registry import register
+
+
+def _require_knn(data: CellData):
+    if "knn_indices" not in data.obsp:
+        raise ValueError("run neighbors.knn (or knn_multichip) first")
+    n = data.n_cells
+    idx = jnp.asarray(data.obsp["knn_indices"])[:n]
+    dist = jnp.asarray(data.obsp["knn_distances"])[:n]
+    return idx, dist
+
+
+# ----------------------------------------------------------------------
+# graph.connectivities
+# ----------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def connectivities_arrays(knn_idx, knn_dist, mode: str = "umap"):
+    """Edge weights from distances.
+
+    "umap": the fuzzy-simplicial-set weights exp(-(d - rho)/sigma)
+    with rho = distance to nearest neighbour and sigma calibrated so
+    the weights sum to log2(k) per row (binary search, fixed 20
+    iterations — the smooth-kNN calibration of UMAP).
+    "gaussian": exp(-d² / (2 σ²)) with σ = mean kNN distance per row.
+
+    Self-edges (``neighbors.knn`` includes self at distance 0 by
+    default) are excluded: they get weight 0 and do not enter rho/σ —
+    otherwise rho would always be 0 and the self-weight 1.0 would eat
+    part of the log2(k) calibration budget.
+    """
+    n = knn_idx.shape[0]
+    is_self = knn_idx == jnp.arange(n, dtype=knn_idx.dtype)[:, None]
+    d = jnp.where((knn_idx < 0) | is_self, jnp.inf,
+                  knn_dist.astype(jnp.float32))
+    if mode == "gaussian":
+        finite = jnp.isfinite(d)
+        sigma = jnp.sum(jnp.where(finite, d, 0.0), axis=1) / jnp.maximum(
+            jnp.sum(finite, axis=1), 1)
+        w = jnp.exp(-(d**2) / jnp.maximum(2.0 * sigma[:, None] ** 2, 1e-12))
+        return jnp.where(finite, w, 0.0)
+    if mode != "umap":
+        raise ValueError(f"unknown connectivity mode {mode!r}")
+    k = knn_idx.shape[1]
+    target = jnp.log2(jnp.float32(max(k, 2)))
+    rho = jnp.min(jnp.where(jnp.isfinite(d), d, jnp.inf), axis=1)
+    shifted = jnp.maximum(d - rho[:, None], 0.0)
+
+    def weight_sum(sigma):
+        w = jnp.exp(-shifted / jnp.maximum(sigma[:, None], 1e-12))
+        return jnp.sum(jnp.where(jnp.isfinite(d), w, 0.0), axis=1)
+
+    lo = jnp.full(d.shape[0], 1e-6)
+    hi = jnp.full(d.shape[0], 1e3)
+
+    def bisect(_, carry):
+        lo, hi = carry
+        mid = 0.5 * (lo + hi)
+        too_small = weight_sum(mid) < target  # need larger sigma
+        lo = jnp.where(too_small, mid, lo)
+        hi = jnp.where(too_small, hi, mid)
+        return lo, hi
+
+    lo, hi = jax.lax.fori_loop(0, 20, bisect, (lo, hi))
+    sigma = 0.5 * (lo + hi)
+    w = jnp.exp(-shifted / jnp.maximum(sigma[:, None], 1e-12))
+    return jnp.where(jnp.isfinite(d), w, 0.0)
+
+
+@register("graph.connectivities", backend="tpu")
+def connectivities_tpu(data: CellData, mode: str = "umap") -> CellData:
+    """Adds obsp["connectivities"] (aligned with knn_indices)."""
+    idx, dist = _require_knn(data)
+    w = connectivities_arrays(idx, dist, mode=mode)
+    return data.with_obsp(connectivities=w).with_uns(connectivity_mode=mode)
+
+
+@register("graph.connectivities", backend="cpu")
+def connectivities_cpu(data: CellData, mode: str = "umap") -> CellData:
+    idx = np.asarray(data.obsp["knn_indices"])[: data.n_cells]
+    dist = np.asarray(data.obsp["knn_distances"], np.float64)[: data.n_cells]
+    is_self = idx == np.arange(len(idx))[:, None]
+    d = np.where((idx < 0) | is_self, np.inf, dist)
+    if mode == "gaussian":
+        finite = np.isfinite(d)
+        sigma = np.where(finite, d, 0.0).sum(1) / np.maximum(finite.sum(1), 1)
+        w = np.exp(-(d**2) / np.maximum(2 * sigma[:, None] ** 2, 1e-12))
+        w = np.where(finite, w, 0.0)
+    elif mode == "umap":
+        k = idx.shape[1]
+        target = np.log2(max(k, 2))
+        rho = np.min(np.where(np.isfinite(d), d, np.inf), axis=1)
+        shifted = np.maximum(d - rho[:, None], 0.0)
+        lo = np.full(len(d), 1e-6)
+        hi = np.full(len(d), 1e3)
+        for _ in range(20):
+            mid = 0.5 * (lo + hi)
+            w = np.exp(-shifted / np.maximum(mid[:, None], 1e-12))
+            s = np.where(np.isfinite(d), w, 0.0).sum(1)
+            small = s < target
+            lo = np.where(small, mid, lo)
+            hi = np.where(small, hi, mid)
+        sigma = 0.5 * (lo + hi)
+        w = np.exp(-shifted / np.maximum(sigma[:, None], 1e-12))
+        w = np.where(np.isfinite(d), w, 0.0)
+    else:
+        raise ValueError(f"unknown connectivity mode {mode!r}")
+    return data.with_obsp(connectivities=w.astype(np.float32)).with_uns(
+        connectivity_mode=mode)
+
+
+# ----------------------------------------------------------------------
+# Diffusion operator + sparse matvec on the kNN edge list
+# ----------------------------------------------------------------------
+
+
+@jax.jit
+def knn_matvec(knn_idx, weights, x):
+    """``P @ x`` where P is the (n, k)-edge-list sparse matrix.
+
+    x: (n, d).  Gather-weight-sum along k; O(n·k·d).
+    """
+    safe = jnp.where(knn_idx < 0, 0, knn_idx)
+    w = jnp.where(knn_idx < 0, 0.0, weights)
+    gathered = jnp.take(x, safe, axis=0)  # (n, k, d)
+    return jnp.einsum("nk,nkd->nd", w, gathered)
+
+
+@partial(jax.jit, static_argnames=("n",))
+def knn_rmatvec(knn_idx, weights, x, n: int | None = None):
+    """``Pᵀ @ x`` via segment-sum over edges (adjoint of knn_matvec;
+    used for reverse-mode flows and left-eigenvector iterations)."""
+    n = n if n is not None else x.shape[0]
+    safe = jnp.where(knn_idx < 0, n, knn_idx)  # dropped bin
+    w = jnp.where(knn_idx < 0, 0.0, weights)
+    contrib = w[:, :, None] * x[:, None, :]  # (n, k, d)
+    flat = contrib.reshape(-1, x.shape[-1])
+    out = jax.ops.segment_sum(flat, safe.reshape(-1), num_segments=n + 1)
+    return out[:n]
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def _symmetrized_weights(idx, w, block: int = 8192, mode: str = "average"):
+    """Symmetrise edge weights on the kNN edge list.
+
+    "average": w_sym(i→j) = (w_ij + w_ji)/2 when the reverse edge
+    exists, else w_ij (keeps all edges; operator only approximately
+    symmetric — fine for diffusion smoothing).
+    "mutual": same average but one-sided edges are dropped — the
+    resulting kernel is *exactly* symmetric, which the spectral path
+    requires.  The reverse-edge lookup is an (block, k, k) equality
+    mask, chunked over rows so the full (n, k, k) never materialises."""
+    n, k = idx.shape
+    # Lookup tables padded with a sentinel row of -2s: a -1 neighbour
+    # slot maps to row n, whose "neighbours" (-2) can never equal a
+    # real row id — otherwise -1 slots would alias row 0 and fabricate
+    # reverse edges for it, breaking the mutual mode's exact symmetry.
+    safe_tab = jnp.concatenate(
+        [jnp.where(idx < 0, -2, idx), jnp.full((1, k), -2, idx.dtype)])
+    w_tab = jnp.concatenate([w, jnp.zeros((1, k), w.dtype)])
+    nb = -(-n // block)
+    pad = nb * block - n
+    idx_p = jnp.concatenate([idx, jnp.full((pad, k), -1, idx.dtype)]) if pad else idx
+    w_p = jnp.concatenate([w, jnp.zeros((pad, k), w.dtype)]) if pad else w
+    rows = jnp.arange(nb * block, dtype=idx.dtype)
+
+    def per_block(args):
+        iblk, wblk, rblk = args
+        sblk = jnp.where(iblk < 0, n, iblk)
+        non = jnp.take(safe_tab, sblk, axis=0)   # (block, k, k)
+        nw = jnp.take(w_tab, sblk, axis=0)       # (block, k, k)
+        hit = non == rblk[:, None, None]
+        w_rev = jnp.sum(jnp.where(hit, nw, 0.0), axis=2)
+        has_rev = jnp.any(hit, axis=2)
+        if mode == "mutual":
+            return jnp.where(has_rev, 0.5 * (wblk + w_rev), 0.0)
+        return jnp.where(has_rev, 0.5 * (wblk + w_rev), wblk)
+
+    out = jax.lax.map(per_block, (idx_p.reshape(nb, block, k),
+                                  w_p.reshape(nb, block, k),
+                                  rows.reshape(nb, block)))
+    return out.reshape(-1, k)[:n]
+
+
+@register("graph.diffusion_operator", backend="tpu")
+def diffusion_operator_tpu(data: CellData, symmetrize: bool = True) -> CellData:
+    """Row-normalised diffusion weights from connectivities.
+
+    With ``symmetrize`` the kernel is (W + Wᵀ)/2 restricted to the
+    existing edge pattern (the reverse-edge weight is looked up via a
+    segment-mean approximation: w_sym(i→j) = (w_ij + w_ji)/2 where
+    w_ji is taken as w_ij when the reverse edge is absent).
+    Adds obsp["diffusion_weights"] (row-stochastic, aligned with
+    knn_indices).
+    """
+    if "connectivities" not in data.obsp:
+        data = connectivities_tpu(data)
+    idx, _ = _require_knn(data)
+    w = jnp.asarray(data.obsp["connectivities"])[: data.n_cells]
+    if symmetrize:
+        w = _symmetrized_weights(idx, w)
+    row = jnp.sum(jnp.where(idx < 0, 0.0, w), axis=1, keepdims=True)
+    p = jnp.where(idx < 0, 0.0, w) / jnp.maximum(row, 1e-12)
+    return data.with_obsp(diffusion_weights=p)
+
+
+@register("graph.diffusion_operator", backend="cpu")
+def diffusion_operator_cpu(data: CellData, symmetrize: bool = True) -> CellData:
+    import scipy.sparse as sp
+
+    if "connectivities" not in data.obsp:
+        data = connectivities_cpu(data)
+    n = data.n_cells
+    idx = np.asarray(data.obsp["knn_indices"])[:n]
+    w = np.asarray(data.obsp["connectivities"], np.float64)[:n]
+    k = idx.shape[1]
+    rows = np.repeat(np.arange(n), k)
+    cols = idx.reshape(-1)
+    vals = w.reshape(-1)
+    keep = cols >= 0
+    W = sp.csr_matrix((vals[keep], (rows[keep], cols[keep])), shape=(n, n))
+    if symmetrize:
+        Wt = W.T.tocsr()
+        # restrict to existing edge pattern: (w_ij + w_ji)/2 where both
+        # exist, else w_ij  (matches the TPU edge-list semantics)
+        both = W.multiply(Wt.astype(bool).astype(np.float64))
+        W = W - 0.5 * both + 0.5 * Wt.multiply(W.astype(bool).astype(np.float64))
+    # read back into edge-list aligned with knn_indices
+    p = np.zeros_like(w)
+    Wc = W.tocsr()
+    for i in range(n):
+        row = {c: v for c, v in zip(Wc.indices[Wc.indptr[i]:Wc.indptr[i+1]],
+                                    Wc.data[Wc.indptr[i]:Wc.indptr[i+1]])}
+        for j in range(k):
+            if idx[i, j] >= 0:
+                p[i, j] = row.get(idx[i, j], 0.0)
+    rs = p.sum(1, keepdims=True)
+    p = p / np.maximum(rs, 1e-12)
+    return data.with_obsp(diffusion_weights=p.astype(np.float32))
+
+
+# ----------------------------------------------------------------------
+# impute.magic — diffusion imputation (X_imputed = Pᵗ X)
+# ----------------------------------------------------------------------
+
+
+@register("impute.magic", backend="tpu")
+def magic_tpu(data: CellData, t: int = 3, use_rep: str = "X",
+              n_genes_out: int | None = None) -> CellData:
+    """MAGIC-style imputation: t diffusion steps of the expression
+    matrix along the cell graph.  Adds obsm["X_magic"] (dense
+    (n, n_genes_out or n_genes)).  Densifies gene space — subset genes
+    first (hvg.select(subset=True)) for large panels."""
+    if "diffusion_weights" not in data.obsp:
+        data = diffusion_operator_tpu(data)
+    idx, _ = _require_knn(data)
+    p = jnp.asarray(data.obsp["diffusion_weights"])[: data.n_cells]
+    if use_rep == "X":
+        X = data.X
+        Xd = X.to_dense() if isinstance(X, SparseCells) else (
+            jnp.asarray(X)[: data.n_cells])
+    else:
+        Xd = jnp.asarray(data.obsm[use_rep])[: data.n_cells]
+    if n_genes_out is not None:
+        Xd = Xd[:, :n_genes_out]
+
+    def step(x, _):
+        return knn_matvec(idx, p, x), None
+
+    out, _ = jax.lax.scan(step, Xd.astype(jnp.float32), None, length=t)
+    return data.with_obsm(X_magic=out).with_uns(magic_t=t)
+
+
+@register("impute.magic", backend="cpu")
+def magic_cpu(data: CellData, t: int = 3, use_rep: str = "X",
+              n_genes_out: int | None = None) -> CellData:
+    import scipy.sparse as sp
+
+    if "diffusion_weights" not in data.obsp:
+        data = diffusion_operator_cpu(data)
+    n = data.n_cells
+    idx = np.asarray(data.obsp["knn_indices"])[:n]
+    p = np.asarray(data.obsp["diffusion_weights"], np.float64)[:n]
+    if use_rep == "X":
+        X = data.X
+        Xd = np.asarray(X.todense()) if sp.issparse(X) else np.asarray(X)[:n]
+    else:
+        Xd = np.asarray(data.obsm[use_rep])[:n]
+    if n_genes_out is not None:
+        Xd = Xd[:, :n_genes_out]
+    out = Xd.astype(np.float64)
+    safe = np.where(idx < 0, 0, idx)
+    w = np.where(idx < 0, 0.0, p)
+    for _ in range(t):
+        out = np.einsum("nk,nkd->nd", w, out[safe])
+    return data.with_obsm(X_magic=out.astype(np.float32)).with_uns(magic_t=t)
+
+
+# ----------------------------------------------------------------------
+# embed.spectral — diffusion-map embedding (top eigenvectors of P)
+# ----------------------------------------------------------------------
+
+
+def _sym_normalized_edges(idx, w):
+    """Edge weights of S = D^-1/2 W_mutual D^-1/2 plus the degree
+    vector.  W_mutual is exactly symmetric (one-sided edges dropped),
+    so S is symmetric and its spectrum is real in [-1, 1]."""
+    wm = _symmetrized_weights(idx, w, mode="mutual")
+    wm = jnp.where(idx < 0, 0.0, wm)
+    deg = jnp.sum(wm, axis=1)
+    inv_sqrt = jnp.where(deg > 0, 1.0 / jnp.sqrt(jnp.maximum(deg, 1e-12)), 0.0)
+    safe = jnp.where(idx < 0, 0, idx)
+    s = wm * inv_sqrt[:, None] * jnp.take(inv_sqrt, safe, axis=0)
+    return s, deg, inv_sqrt
+
+
+@partial(jax.jit, static_argnames=("n_comps", "n_iter"))
+def diffusion_eigs(knn_idx, s_edges, key, n_comps: int = 15,
+                   n_iter: int = 60):
+    """Leading eigenpairs of the symmetric normalised operator S via
+    subspace iteration with CholeskyQR2 + Rayleigh–Ritz (matrix-free:
+    only knn_matvec).  Ordered by descending eigenvalue."""
+    from .pca import cholesky_qr
+
+    n = knn_idx.shape[0]
+    V = jax.random.normal(key, (n, n_comps + 5), jnp.float32)
+    V = cholesky_qr(V)
+
+    def step(V, _):
+        # shift: (S + I)/2 maps spectrum to [0, 1] so the largest
+        # *algebraic* eigenvalues dominate the iteration, not the
+        # largest-magnitude (possibly negative) ones
+        V = 0.5 * (knn_matvec(knn_idx, s_edges, V) + V)
+        return cholesky_qr(V), None
+
+    V, _ = jax.lax.scan(step, V, None, length=n_iter)
+    SV = knn_matvec(knn_idx, s_edges, V)
+    H = jnp.dot(V.T, SV, preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)
+    evals, W = jnp.linalg.eigh(0.5 * (H + H.T))
+    order = jnp.argsort(-evals)[: n_comps]
+    return evals[order], (V @ W)[:, order]
+
+
+@register("embed.spectral", backend="tpu")
+def spectral_tpu(data: CellData, n_comps: int = 15, seed: int = 0,
+                 drop_first: bool = True) -> CellData:
+    """Diffusion-map embedding from the symmetric normalised kernel;
+    eigenvectors are mapped back to the random-walk convention
+    (ψ = D^-1/2 φ, unit-normalised).  Adds obsm["X_diffmap"],
+    uns["diffmap_evals"].  The trivial top eigenvector is dropped by
+    default."""
+    if "connectivities" not in data.obsp:
+        data = connectivities_tpu(data)
+    idx, _ = _require_knn(data)
+    w = jnp.asarray(data.obsp["connectivities"])[: data.n_cells]
+    s, deg, inv_sqrt = _sym_normalized_edges(idx, w)
+    extra = 1 if drop_first else 0
+    evals, phi = diffusion_eigs(idx, s, jax.random.PRNGKey(seed),
+                                n_comps=n_comps + extra)
+    psi = phi * inv_sqrt[:, None]
+    psi = psi / jnp.maximum(jnp.linalg.norm(psi, axis=0, keepdims=True), 1e-12)
+    if drop_first:
+        evals, psi = evals[1:], psi[:, 1:]
+    return data.with_obsm(X_diffmap=psi).with_uns(diffmap_evals=evals)
+
+
+@register("embed.spectral", backend="cpu")
+def spectral_cpu(data: CellData, n_comps: int = 15, seed: int = 0,
+                 drop_first: bool = True) -> CellData:
+    import scipy.sparse as sp
+
+    if "connectivities" not in data.obsp:
+        data = connectivities_cpu(data)
+    n = data.n_cells
+    idx = np.asarray(data.obsp["knn_indices"])[:n]
+    w = np.asarray(data.obsp["connectivities"], np.float64)[:n]
+    k = idx.shape[1]
+    rows = np.repeat(np.arange(n), k)
+    cols = idx.reshape(-1)
+    keep = cols >= 0
+    W = sp.csr_matrix((w.reshape(-1)[keep], (rows[keep], cols[keep])),
+                      shape=(n, n))
+    # mutual symmetrisation: average where both directions exist
+    maskT = W.T.astype(bool)
+    Wm = 0.5 * (W.multiply(maskT) + W.T.multiply(W.astype(bool)))
+    deg = np.asarray(Wm.sum(axis=1)).ravel()
+    inv_sqrt = np.where(deg > 0, 1.0 / np.sqrt(np.maximum(deg, 1e-12)), 0.0)
+    Di = sp.diags(inv_sqrt)
+    S = Di @ Wm @ Di
+    extra = 1 if drop_first else 0
+    # Multi-vector subspace iteration (same scheme as the TPU path):
+    # single-vector Lanczos (eigsh) under-resolves the degenerate
+    # unit eigenspace of graphs with weakly/mutually-disconnected
+    # components — verified against dense eigvalsh.
+    rng = np.random.default_rng(seed)
+    m = n_comps + extra + 5
+    V = np.linalg.qr(rng.standard_normal((n, m)))[0]
+    for _ in range(60):
+        V = np.linalg.qr(0.5 * (S @ V + V))[0]
+    H = V.T @ (S @ V)
+    evals, W_ = np.linalg.eigh(0.5 * (H + H.T))
+    order = np.argsort(-evals)[: n_comps + extra]
+    evals = evals[order]
+    phi = V @ W_[:, order]
+    psi = phi * inv_sqrt[:, None]
+    psi = psi / np.maximum(np.linalg.norm(psi, axis=0, keepdims=True), 1e-12)
+    if drop_first:
+        evals, psi = evals[1:], psi[:, 1:]
+    return data.with_obsm(X_diffmap=psi.astype(np.float32)).with_uns(
+        diffmap_evals=evals.astype(np.float32))
+
+
+# ----------------------------------------------------------------------
+# dpt.pseudotime — diffusion pseudotime from a root cell
+# ----------------------------------------------------------------------
+
+
+@register("dpt.pseudotime", backend="tpu")
+def dpt_tpu(data: CellData, root: int = 0) -> CellData:
+    """Diffusion-distance pseudotime: Euclidean distance to the root
+    in eigenvalue-rescaled diffusion-map space (DPT's closed form).
+    Requires embed.spectral.  Adds obs["dpt_pseudotime"]."""
+    if "X_diffmap" not in data.obsm:
+        data = spectral_tpu(data)
+    V = jnp.asarray(data.obsm["X_diffmap"])
+    ev = jnp.asarray(data.uns["diffmap_evals"])
+    scale = ev / jnp.maximum(1.0 - ev, 1e-6)
+    Z = V * scale[None, :]
+    d = jnp.linalg.norm(Z - Z[root], axis=1)
+    d = d / jnp.maximum(jnp.max(d), 1e-12)
+    return data.with_obs(dpt_pseudotime=d).with_uns(dpt_root=root)
+
+
+@register("dpt.pseudotime", backend="cpu")
+def dpt_cpu(data: CellData, root: int = 0) -> CellData:
+    if "X_diffmap" not in data.obsm:
+        data = spectral_cpu(data)
+    V = np.asarray(data.obsm["X_diffmap"], np.float64)
+    ev = np.asarray(data.uns["diffmap_evals"], np.float64)
+    scale = ev / np.maximum(1.0 - ev, 1e-6)
+    Z = V * scale[None, :]
+    d = np.linalg.norm(Z - Z[root], axis=1)
+    d = d / max(d.max(), 1e-12)
+    return data.with_obs(dpt_pseudotime=d.astype(np.float32)).with_uns(
+        dpt_root=root)

@@ -1,0 +1,153 @@
+"""Graph ops (connectivities, diffusion, MAGIC, spectral, DPT) and
+clustering (kmeans, label propagation) — TPU vs CPU oracle."""
+
+import numpy as np
+import pytest
+
+import sctools_tpu as sct
+from sctools_tpu.data.synthetic import gaussian_blobs, synthetic_counts
+from sctools_tpu.ops.cluster import adjusted_rand_index
+
+
+@pytest.fixture(scope="module")
+def with_knn():
+    # 2 clusters at density 0.3 gives a kNN graph whose *mutual* edge
+    # set is connected — spectral/DPT comparisons are ill-posed on
+    # disconnected diffusion geometries (λ=1 multiplicities).
+    ds = synthetic_counts(300, 200, density=0.3, n_clusters=2, seed=21)
+    pipe = sct.Pipeline([
+        ("normalize.library_size", {"target_sum": 1e4}),
+        ("normalize.log1p", {}),
+        ("pca.exact", {"n_components": 10}),
+        ("neighbors.knn", {"k": 15, "metric": "euclidean",
+                           "query_block": 128, "cand_block": 128}),
+    ])
+    cpu = pipe.run(ds, backend="cpu")
+    # run TPU side on the identical embedding+graph for strict parity
+    dev = cpu.device_put()
+    return cpu, dev
+
+
+@pytest.mark.parametrize("mode", ["umap", "gaussian"])
+def test_connectivities_parity(with_knn, mode):
+    cpu, dev = with_knn
+    c = sct.apply("graph.connectivities", cpu, backend="cpu", mode=mode)
+    t = sct.apply("graph.connectivities", dev, backend="tpu",
+                  mode=mode).to_host()
+    np.testing.assert_allclose(t.obsp["connectivities"],
+                               c.obsp["connectivities"], rtol=1e-3, atol=1e-4)
+    w = np.asarray(c.obsp["connectivities"])
+    assert w.max() <= 1.0 + 1e-6 and w.min() >= 0.0
+
+
+def test_diffusion_operator_parity(with_knn):
+    cpu, dev = with_knn
+    c = sct.apply("graph.diffusion_operator", cpu, backend="cpu")
+    t = sct.apply("graph.diffusion_operator", dev, backend="tpu").to_host()
+    np.testing.assert_allclose(t.obsp["diffusion_weights"],
+                               c.obsp["diffusion_weights"],
+                               rtol=1e-3, atol=1e-4)
+    rs = np.asarray(t.obsp["diffusion_weights"]).sum(axis=1)
+    np.testing.assert_allclose(rs, 1.0, atol=1e-4)
+
+
+def test_magic_parity(with_knn):
+    cpu, dev = with_knn
+    c = sct.apply("impute.magic", cpu, backend="cpu", t=3, n_genes_out=50)
+    t = sct.apply("impute.magic", dev, backend="tpu", t=3,
+                  n_genes_out=50).to_host()
+    np.testing.assert_allclose(t.obsm["X_magic"], c.obsm["X_magic"],
+                               rtol=2e-3, atol=2e-3)
+    # diffusion smooths: neighbour rows get closer
+    X0 = np.asarray(cpu.X.todense())[:, :50]
+    Xs = np.asarray(c.obsm["X_magic"])
+    idx = np.asarray(cpu.obsp["knn_indices"])
+    i, j = 0, idx[0, 1]
+    assert np.linalg.norm(Xs[i] - Xs[j]) < np.linalg.norm(X0[i] - X0[j])
+
+
+def test_spectral_embedding(with_knn):
+    cpu, dev = with_knn
+    c = sct.apply("embed.spectral", cpu, backend="cpu", n_comps=5)
+    t = sct.apply("embed.spectral", dev, backend="tpu", n_comps=5).to_host()
+    ev_c = np.sort(np.abs(np.asarray(c.uns["diffmap_evals"])))[::-1]
+    ev_t = np.sort(np.abs(np.asarray(t.uns["diffmap_evals"])))[::-1]
+    np.testing.assert_allclose(ev_t, ev_c, rtol=5e-2, atol=5e-3)
+    # eigenvalues of a stochastic matrix lie in [-1, 1]
+    assert np.all(np.abs(ev_t) <= 1.0 + 1e-4)
+
+
+def test_dpt_pseudotime(with_knn):
+    cpu, dev = with_knn
+    c = sct.apply("dpt.pseudotime", cpu, backend="cpu", root=0)
+    t = sct.apply("dpt.pseudotime", dev, backend="tpu", root=0).to_host()
+    pc = np.asarray(c.obs["dpt_pseudotime"])
+    pt = np.asarray(t.obs["dpt_pseudotime"])
+    assert pc[0] == 0.0 and pt[0] == 0.0
+    assert pc.max() == 1.0 and pt.max() == 1.0
+    # rank correlation between backends (eigsolvers differ in basis)
+    from scipy.stats import spearmanr
+
+    rho = spearmanr(pc, pt).statistic
+    assert rho > 0.9, f"pseudotime rank correlation {rho}"
+
+
+def test_knn_matvec_adjoint(with_knn):
+    """knn_rmatvec is the exact adjoint of knn_matvec."""
+    import jax.numpy as jnp
+    from sctools_tpu.ops.graph import knn_matvec, knn_rmatvec
+
+    cpu, dev = with_knn
+    rng = np.random.default_rng(31)
+    n = cpu.n_cells
+    idx = jnp.asarray(cpu.obsp["knn_indices"])
+    w = jnp.asarray(np.abs(rng.normal(size=idx.shape)).astype(np.float32))
+    x = jnp.asarray(rng.normal(size=(n, 4)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=(n, 4)).astype(np.float32))
+    lhs = float(np.sum(np.asarray(knn_matvec(idx, w, x)) * np.asarray(y)))
+    rhs = float(np.sum(np.asarray(x) * np.asarray(knn_rmatvec(idx, w, y, n=n))))
+    assert abs(lhs - rhs) < 1e-2 * max(abs(lhs), 1.0)
+
+
+def test_connectivities_exclude_self(with_knn):
+    """Self-edges get weight 0 and the nearest real neighbour gets
+    weight 1.0 under the UMAP calibration (rho = its distance)."""
+    cpu, dev = with_knn
+    t = sct.apply("graph.connectivities", dev, backend="tpu",
+                  mode="umap").to_host()
+    idx = np.asarray(cpu.obsp["knn_indices"])
+    w = np.asarray(t.obsp["connectivities"])
+    n = cpu.n_cells
+    self_pos = idx == np.arange(n)[:, None]
+    assert np.all(w[self_pos] == 0.0)
+    # each row's max non-self weight is exactly exp(0) = 1
+    np.testing.assert_allclose(w.max(axis=1), 1.0, atol=1e-5)
+
+
+def test_kmeans_recovers_blobs():
+    pts, labels = gaussian_blobs(600, 16, n_clusters=5, spread=0.1, seed=22)
+    ds = sct.from_dense(pts).with_obsm(X_pca=pts)
+    t = sct.apply("cluster.kmeans", ds, backend="tpu", n_clusters=5,
+                  seed=1).to_host()
+    c = sct.apply("cluster.kmeans", ds, backend="cpu", n_clusters=5, seed=1)
+    ari_t = adjusted_rand_index(t.obs["kmeans"], labels)
+    ari_c = adjusted_rand_index(c.obs["kmeans"], labels)
+    assert ari_t > 0.95, f"TPU kmeans ARI {ari_t}"
+    assert ari_c > 0.95, f"CPU kmeans ARI {ari_c}"
+
+
+def test_label_propagation_recovers_blobs():
+    pts, labels = gaussian_blobs(400, 12, n_clusters=4, spread=0.08, seed=23)
+    ds = sct.from_dense(pts).with_obsm(X_pca=pts)
+    dev = sct.apply("neighbors.knn", ds.device_put(), backend="tpu", k=10,
+                    metric="euclidean", query_block=128, cand_block=128)
+    dev = sct.apply("graph.connectivities", dev, backend="tpu")
+    t = sct.apply("cluster.leiden_like", dev, backend="tpu").to_host()
+    ari = adjusted_rand_index(t.obs["leiden_like"], labels)
+    assert ari > 0.9, f"label propagation ARI {ari}"
+    cpu_side = sct.apply("neighbors.knn", ds, backend="cpu", k=10,
+                         metric="euclidean")
+    cpu_side = sct.apply("graph.connectivities", cpu_side, backend="cpu")
+    c = sct.apply("cluster.leiden_like", cpu_side, backend="cpu")
+    ari_c = adjusted_rand_index(c.obs["leiden_like"], labels)
+    assert ari_c > 0.9, f"CPU label propagation ARI {ari_c}"
