@@ -148,3 +148,211 @@ def scale_cpu(data: CellData, max_value: float | None = 10.0,
     if max_value is not None:
         Xs = np.clip(Xs, -max_value, max_value)
     return data.with_X(Xs).with_var(scale_mean=mean, scale_std=std)
+
+
+# ----------------------------------------------------------------------
+# normalize.pearson_residuals  (analytic Pearson residuals)
+# ----------------------------------------------------------------------
+
+
+def _pearson_residuals_math(X_dense, totals, gene_sums, grand, theta,
+                            clip, n_cells, xp):
+    """Shared residual math for both backends.
+
+    ``Z_ij = (x_ij - mu_ij) / sqrt(mu_ij + mu_ij^2 / theta)`` with
+    ``mu_ij = t_i * g_j / T`` (the NB offset model of Lause et al.
+    2021), clipped to ``±clip`` (default ``sqrt(n_cells)``).
+    """
+    mu = (totals[:, None] * gene_sums[None, :]) / xp.maximum(grand, 1e-12)
+    denom = xp.sqrt(mu + mu * mu / theta)
+    Z = (X_dense - mu) / xp.maximum(denom, 1e-12)
+    c = float(np.sqrt(n_cells)) if clip is None else float(clip)
+    return xp.clip(Z, -c, c)
+
+
+@register("normalize.pearson_residuals", backend="tpu")
+def pearson_residuals_tpu(data: CellData, theta: float = 100.0,
+                          clip: float | None = None) -> CellData:
+    """Analytic Pearson residuals of an NB offset model (Lause et al.
+    2021; scanpy's ``experimental.pp.normalize_pearson_residuals``).
+
+    Densifies the output — run after ``hvg.select(subset=True)`` (or
+    accept an (n_cells × n_genes) dense result).  Margins
+    (``totals``/``gene_sums``) are computed sparsely; only the residual
+    matrix itself is dense, which it must be (residuals of zeros are
+    nonzero).  Pure VPU work: one rank-1 outer product + elementwise.
+    """
+    X = data.X
+    Xd = X.to_dense() if isinstance(X, SparseCells) else jnp.asarray(X)
+    totals = jnp.sum(Xd, axis=1)
+    gene_sums = jnp.sum(Xd, axis=0)
+    Z = _pearson_residuals_math(Xd, totals, gene_sums, jnp.sum(totals),
+                                theta, clip, Xd.shape[0], jnp)
+    return data.with_X(Z).with_uns(pearson_theta=theta)
+
+
+@register("normalize.pearson_residuals", backend="cpu")
+def pearson_residuals_cpu(data: CellData, theta: float = 100.0,
+                          clip: float | None = None) -> CellData:
+    import scipy.sparse as sp
+
+    X = data.X
+    if sp.issparse(X):
+        Xd = np.asarray(X.todense(), dtype=np.float64)
+    else:
+        Xd = np.asarray(X, dtype=np.float64)
+    totals = Xd.sum(axis=1)
+    gene_sums = Xd.sum(axis=0)
+    Z = _pearson_residuals_math(Xd, totals, gene_sums, totals.sum(),
+                                theta, clip, Xd.shape[0], np)
+    return data.with_X(Z.astype(np.float32)).with_uns(pearson_theta=theta)
+
+
+# ----------------------------------------------------------------------
+# normalize.regress_out  (residualise X against obs covariates)
+# ----------------------------------------------------------------------
+
+
+def _design_matrix(data: CellData, keys, n_rows, xp):
+    """Intercept + one column per numeric covariate; categorical
+    (string/object) covariates are one-hot encoded host-side with the
+    first level dropped (absorbed by the intercept).
+
+    TPU per-cell ops (``qc.per_cell_metrics`` &c.) emit obs arrays at
+    the ELL padded row count, which may exceed ``n_rows`` after X has
+    been trimmed/densified — covariates *longer* than ``n_rows`` are
+    therefore trimmed (trailing entries are row padding by contract,
+    see ``CellData.to_host``); *shorter* ones raise.
+    """
+
+    def fit(kname, v):
+        if v.shape[0] >= n_rows:
+            return v[:n_rows]
+        raise ValueError(
+            f"regress_out: obs[{kname!r}] has {v.shape[0]} entries, "
+            f"X has {n_rows} rows")
+
+    cols = [xp.ones((n_rows,), dtype=xp.float32)]
+    for kname in keys:
+        if kname not in data.obs:
+            raise KeyError(f"regress_out: obs has no key {kname!r}; "
+                           f"available: {sorted(data.obs)}")
+        v = data.obs[kname]
+        kind = getattr(np.asarray(v) if not hasattr(v, "dtype") else v,
+                       "dtype", np.dtype(object)).kind
+        if kind in "OUS":  # categorical: one-hot, drop first level
+            host = fit(kname, np.asarray(v).reshape(-1))
+            levels, codes = np.unique(host, return_inverse=True)
+            onehot = np.eye(len(levels), dtype=np.float32)[codes][:, 1:]
+            cols.extend(xp.asarray(onehot[:, j])
+                        for j in range(onehot.shape[1]))
+            continue
+        cols.append(fit(kname, xp.asarray(v, dtype=xp.float32).reshape(-1)))
+    return xp.stack(cols, axis=1)  # (n_rows, p)
+
+
+@register("normalize.regress_out", backend="tpu")
+def regress_out_tpu(data: CellData, keys: list | tuple = (),
+                    ridge: float = 1e-6) -> CellData:
+    """Remove linear effects of ``obs[keys]`` covariates per gene
+    (scanpy ``pp.regress_out``), via one normal-equations solve.
+
+    ``beta = (CᵀC + λI)⁻¹ CᵀX``; ``X ← X − C·beta``.  CᵀC is (p×p)
+    (tiny), CᵀX is a single (p × n_genes) MXU matmul — no per-gene
+    loop.  Densifies (run post-HVG; ``to_dense`` already trims padding
+    rows, so C and X are both exactly n_cells tall).  Categorical
+    covariates are one-hot encoded.
+    """
+    if not keys:
+        raise ValueError("regress_out needs at least one obs key")
+    X = data.X
+    X = X.to_dense() if isinstance(X, SparseCells) else jnp.asarray(X)
+    C = _design_matrix(data, keys, X.shape[0], jnp)
+    ctc = C.T @ C + ridge * jnp.eye(C.shape[1], dtype=X.dtype)
+    ctx = C.T @ X
+    beta = jax.scipy.linalg.solve(ctc, ctx, assume_a="pos")
+    return data.with_X(X - C @ beta)
+
+
+@register("normalize.regress_out", backend="cpu")
+def regress_out_cpu(data: CellData, keys: list | tuple = (),
+                    ridge: float = 1e-6) -> CellData:
+    import scipy.sparse as sp
+
+    if not keys:
+        raise ValueError("regress_out needs at least one obs key")
+    X = data.X
+    if sp.issparse(X):
+        X = np.asarray(X.todense())
+    X = np.asarray(X, dtype=np.float64)
+    C = _design_matrix(data, keys, X.shape[0], np).astype(np.float64)
+    ctc = C.T @ C + ridge * np.eye(C.shape[1])
+    beta = np.linalg.solve(ctc, C.T @ X)
+    return data.with_X((X - C @ beta).astype(np.float32))
+
+
+# ----------------------------------------------------------------------
+# normalize.downsample_counts  (binomial thinning to a target total)
+# ----------------------------------------------------------------------
+
+
+@register("normalize.downsample_counts", backend="tpu")
+def downsample_counts_tpu(data: CellData, target_total: float = 1e3,
+                          seed: int = 0) -> CellData:
+    """Binomially thin each cell's counts to ~``target_total``
+    (scanpy ``pp.downsample_counts`` semantics, per-cell).
+
+    On the ELL layout this is elementwise ``Binomial(n=x_ij, p_i)``
+    over the value plane — sparsity pattern only shrinks, the layout
+    is reused as-is.  Cells already at/below target are untouched.
+
+    Thinning is only defined for integer counts: non-integer values
+    (e.g. after ``normalize.library_size``) are floored first, on both
+    backends, so the CPU oracle and TPU path agree.
+    """
+    X = data.X
+    key = jax.random.PRNGKey(seed)
+    if isinstance(X, SparseCells):
+        counts = jnp.floor(X.data.astype(jnp.float32))
+        totals = jnp.sum(counts, axis=1)
+        p = jnp.minimum(1.0, target_total / jnp.maximum(totals, 1e-12))
+        newdata = jax.random.binomial(
+            key, counts, p[:, None]).astype(X.data.dtype)
+        # Entries thinned to zero leave the sparsity pattern: mark
+        # their slots as padding (sentinel index) so nnz-based stats
+        # (qc n_genes, hvg dropout) match the CPU oracle's
+        # eliminate_zeros().  The pattern only ever shrinks, so
+        # rewriting indices in place is legal in the ELL layout.
+        newidx = jnp.where(newdata == 0, X.sentinel, X.indices)
+        return data.with_X(SparseCells(newidx.astype(X.indices.dtype),
+                                       newdata, X.n_cells, X.n_genes))
+    Xd = jnp.floor(jnp.asarray(X).astype(jnp.float32))
+    totals = jnp.sum(Xd, axis=1)
+    p = jnp.minimum(1.0, target_total / jnp.maximum(totals, 1e-12))
+    out = jax.random.binomial(key, Xd, p[:, None])
+    return data.with_X(out.astype(jnp.asarray(X).dtype))
+
+
+@register("normalize.downsample_counts", backend="cpu")
+def downsample_counts_cpu(data: CellData, target_total: float = 1e3,
+                          seed: int = 0) -> CellData:
+    import scipy.sparse as sp
+
+    rng = np.random.default_rng(seed)
+    X = data.X
+    if sp.issparse(X):
+        X = X.tocsr().copy()
+        counts = np.floor(X.data).astype(np.int64)  # match TPU floor
+        totals = np.bincount(
+            np.repeat(np.arange(X.shape[0]), np.diff(X.indptr)),
+            weights=counts, minlength=X.shape[0])
+        p = np.minimum(1.0, target_total / np.maximum(totals, 1e-12))
+        per_nz = np.repeat(p, np.diff(X.indptr))
+        X.data = rng.binomial(counts, per_nz).astype(X.data.dtype)
+        X.eliminate_zeros()
+        return data.with_X(X)
+    X = np.asarray(X)
+    counts = np.floor(X).astype(np.int64)
+    totals = counts.sum(axis=1)
+    p = np.minimum(1.0, target_total / np.maximum(totals, 1e-12))
+    return data.with_X(rng.binomial(counts, p[:, None]).astype(X.dtype))

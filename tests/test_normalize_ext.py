@@ -1,0 +1,106 @@
+"""Extended normalisation ops vs CPU oracle: pearson_residuals,
+regress_out, downsample_counts."""
+
+import numpy as np
+import pytest
+
+import sctools_tpu as sct
+from sctools_tpu.data.synthetic import synthetic_counts
+
+
+@pytest.fixture(scope="module")
+def ds():
+    return synthetic_counts(150, 220, density=0.12, n_clusters=3,
+                            mito_frac=0.02, seed=11)
+
+
+def test_pearson_residuals_matches_cpu(ds):
+    cpu = sct.apply("normalize.pearson_residuals", ds, backend="cpu",
+                    theta=100.0)
+    tpu = sct.apply("normalize.pearson_residuals", ds.device_put(),
+                    backend="tpu", theta=100.0).to_host()
+    Zt = np.asarray(tpu.X)[: ds.n_cells]
+    np.testing.assert_allclose(Zt, cpu.X, rtol=2e-3, atol=2e-3)
+
+
+def test_pearson_residuals_properties(ds):
+    cpu = sct.apply("normalize.pearson_residuals", ds, backend="cpu")
+    Z = np.asarray(cpu.X)
+    # clipped at ±sqrt(n)
+    assert np.abs(Z).max() <= np.sqrt(ds.n_cells) + 1e-6
+    # residuals approximately centred per gene
+    assert abs(Z.mean()) < 0.5
+
+
+def test_regress_out_removes_covariate(ds):
+    rng = np.random.default_rng(0)
+    # plant a covariate effect on dense log data
+    base = sct.apply("normalize.log1p", ds, backend="cpu")
+    X = np.asarray(base.X.todense(), dtype=np.float32)
+    cov = rng.normal(size=ds.n_cells).astype(np.float32)
+    X_planted = X + np.outer(cov, rng.uniform(0.5, 2.0, size=ds.n_genes)
+                             ).astype(np.float32)
+    d = base.with_X(X_planted).with_obs(cov=cov)
+
+    cpu = sct.apply("normalize.regress_out", d, backend="cpu", keys=["cov"])
+    tpu = sct.apply("normalize.regress_out", d.device_put(), backend="tpu",
+                    keys=["cov"]).to_host()
+    Xr_cpu, Xr_tpu = np.asarray(cpu.X), np.asarray(tpu.X)[: ds.n_cells]
+    np.testing.assert_allclose(Xr_tpu, Xr_cpu, rtol=5e-3, atol=5e-3)
+    # planted effect is gone: per-gene correlation with cov ~ 0
+    Xc = Xr_cpu - Xr_cpu.mean(axis=0)
+    cc = cov - cov.mean()
+    norms = np.linalg.norm(Xc, axis=0)
+    corr = (Xc * cc[:, None]).sum(0) / (norms * np.linalg.norm(cc) + 1e-12)
+    # all-zero genes leave float-noise residuals whose "correlation" is
+    # meaningless — only genes with real residual variance must decorrelate
+    real = norms > 1e-3
+    assert real.sum() > 100
+    assert np.abs(corr[real]).max() < 1e-3
+
+
+def test_regress_out_categorical(ds):
+    rng = np.random.default_rng(1)
+    base = sct.apply("normalize.log1p", ds, backend="cpu")
+    X = np.asarray(base.X.todense(), dtype=np.float32)
+    batch = np.array(["a", "b", "c"])[rng.integers(0, 3, ds.n_cells)]
+    offs = {"a": 0.0, "b": 1.5, "c": -0.8}
+    Xp = X + np.array([offs[b] for b in batch], np.float32)[:, None]
+    d = base.with_X(Xp).with_obs(batch=batch)
+    for backend in ("cpu", "tpu"):
+        out = sct.apply("normalize.regress_out",
+                        d.device_put() if backend == "tpu" else d,
+                        backend=backend, keys=["batch"])
+        Xr = np.asarray(out.to_host().X if backend == "tpu" else out.X)
+        # per-batch gene means now agree across batches
+        means = np.stack([Xr[batch == b].mean(axis=0) for b in "abc"])
+        assert np.abs(means - means.mean(axis=0)).max() < 1e-3
+
+
+def test_regress_out_shape_mismatch_raises(ds):
+    # longer-than-X covariates are padded per-cell arrays and trim;
+    # SHORTER ones are real mismatches and must raise
+    d = sct.apply("normalize.log1p", ds, backend="cpu").with_obs(
+        cov=np.zeros(ds.n_cells - 3, np.float32))
+    with pytest.raises(ValueError, match="cov"):
+        sct.apply("normalize.regress_out", d, backend="cpu", keys=["cov"])
+
+
+def test_downsample_counts(ds):
+    for backend, prep in (("cpu", ds), ("tpu", ds.device_put())):
+        out = sct.apply("normalize.downsample_counts", prep,
+                        backend=backend, target_total=50.0, seed=3)
+        out = out.to_host() if backend == "tpu" else out
+        import scipy.sparse as sp
+
+        X = out.X.toarray() if sp.issparse(out.X) else np.asarray(out.X)
+        X = X[: ds.n_cells]
+        totals = X.sum(axis=1)
+        orig = np.asarray(ds.X.sum(axis=1)).ravel()
+        # thinned cells land near the target; small cells untouched
+        big = orig > 80
+        assert np.all(X >= 0) and np.all(X == np.round(X))
+        assert abs(totals[big].mean() - 50.0) < 10.0
+        small = orig <= 50
+        if small.any():
+            np.testing.assert_allclose(totals[small], orig[small])
