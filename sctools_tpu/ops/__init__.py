@@ -2,5 +2,5 @@
 transform with the registry (both cpu and tpu backends)."""
 
 from . import (  # noqa: F401
-    cluster, distance, graph, hvg, knn, normalize, pca, qc,
+    cluster, de, distance, graph, hvg, knn, normalize, pca, qc, score,
 )

@@ -1,0 +1,373 @@
+"""Differential expression: ``de.rank_genes_groups``.
+
+Scanpy-parity surface (``tl.rank_genes_groups``) for the two standard
+methods, built TPU-first:
+
+* ``t-test`` (Welch): per-group gene means/variances come from ONE
+  ``Xᵀ @ onehot`` pass — on the padded-ELL layout that is
+  ``spmm_t(X, G)`` + ``spmm_t(X², G)`` (chunked segment-sums), on
+  dense X two MXU matmuls.  No per-group loop over the data.
+* ``wilcoxon`` (Mann-Whitney U, normal approximation with tie
+  correction): per-gene average ranks are computed by a vmapped
+  sort + double ``searchsorted`` (O(n log n) per gene, static
+  shapes) over gene blocks of static width (memory-bounded — the
+  full dense matrix never materialises), then per-group rank sums
+  are exact ``segment_sum`` reductions (NOT one-hot MXU matmuls,
+  whose bf16 passes corrupt rank-magnitude sums).
+
+P-values (t / normal survival functions) and BH adjustment are tiny
+(n_groups × n_genes) and computed host-side with scipy — keeping
+special functions off the accelerator where they don't pay.
+
+Reference note: dpeerlab/sctools' own DE surface could not be read
+(reference missing, SURVEY.md §0); this follows the scanpy semantics
+its domain implies, with the CPU backend as the scipy oracle.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.dataset import CellData
+from ..data.sparse import SparseCells, spmm_t
+from ..registry import register
+
+
+# ----------------------------------------------------------------------
+# shared label handling
+# ----------------------------------------------------------------------
+
+
+def _group_codes(data: CellData, groupby: str):
+    """(codes int32 (n_cells,), level names list[str])."""
+    if groupby not in data.obs:
+        raise KeyError(f"rank_genes_groups: obs has no key {groupby!r}; "
+                       f"available: {sorted(data.obs)}")
+    # per-cell obs arrays from TPU ops may carry padded rows — trim
+    # before computing levels, or padding values become a bogus group
+    v = np.asarray(data.obs[groupby])[: data.n_cells]
+    n = v.shape[0]
+    levels, codes = np.unique(v, return_inverse=True)
+    return codes.astype(np.int32), [str(l) for l in levels], n
+
+
+def _bh_adjust(p: np.ndarray) -> np.ndarray:
+    """Benjamini-Hochberg along the last axis."""
+    n = p.shape[-1]
+    order = np.argsort(p, axis=-1)
+    ranked = np.take_along_axis(p, order, axis=-1)
+    q = ranked * n / np.arange(1, n + 1)
+    q = np.minimum.accumulate(q[..., ::-1], axis=-1)[..., ::-1]
+    out = np.empty_like(q)
+    np.put_along_axis(out, order, np.clip(q, 0, 1), axis=-1)
+    return out
+
+
+def _logfoldchange(mean_g, mean_rest, base: float = 2.0):
+    """scanpy's logFC convention: data is log1p-normalised, so undo the
+    log, ratio the (pseudo-counted) expm1 means, re-log in base 2."""
+    return (np.log(np.expm1(mean_g) + 1e-9)
+            - np.log(np.expm1(mean_rest) + 1e-9)) / np.log(base)
+
+
+# ----------------------------------------------------------------------
+# group moments (sum / sumsq / count per group per gene)
+# ----------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("n_groups",))
+def _group_moments_dense(X, codes, n_groups):
+    # segment_sum, NOT a one-hot MXU matmul: on TPU the matmul would
+    # run in bf16 and ranks/counts magnitudes (~n_cells) lose integer
+    # precision catastrophically.
+    X = X.astype(jnp.float32)
+    s = jax.ops.segment_sum(X, codes, num_segments=n_groups)
+    ss = jax.ops.segment_sum(X * X, codes, num_segments=n_groups)
+    cnt = jax.ops.segment_sum(jnp.ones_like(codes, jnp.float32), codes,
+                              num_segments=n_groups)
+    return s, ss, cnt
+
+
+@partial(jax.jit, static_argnames=("n_groups", "need_ss"))
+def _group_moments_sparse(x: SparseCells, codes, n_groups, need_ss=True):
+    # codes padded with -1 for padding rows -> one_hot gives zero row.
+    onehot = jax.nn.one_hot(codes, n_groups, dtype=x.data.dtype)
+    s = spmm_t(x, onehot).T               # (g, genes)
+    # the squared-data pass is skipped when only means are needed
+    ss = (spmm_t(x.with_data(x.data * x.data), onehot).T
+          if need_ss else jnp.zeros_like(s))
+    cnt = jnp.sum(onehot, axis=0)
+    return s, ss, cnt
+
+
+def _group_means(s, cnt):
+    """Per-group and rest means from group sums/counts alone."""
+    s, cnt = np.asarray(s, np.float64), np.asarray(cnt, np.float64)
+    tot_s, tot_n = s.sum(0), cnt.sum()
+    n1 = np.maximum(cnt, 1.0)[:, None]
+    n2 = np.maximum(tot_n - cnt, 1.0)[:, None]
+    return s / n1, (tot_s[None, :] - s) / n2
+
+
+def _welch_stats(s, ss, cnt, overestim_var=False):
+    """Per-group vs rest Welch t statistics + dfs, numpy in float64.
+
+    ``overestim_var`` reproduces scanpy's ``t-test_overestim_var``:
+    the rest-group variance is divided by the *group's* size instead
+    of the rest's, deliberately overestimating the standard error.
+    """
+    s, ss, cnt = (np.asarray(a, np.float64) for a in (s, ss, cnt))
+    tot_s, tot_ss, tot_n = s.sum(0), ss.sum(0), cnt.sum()
+    t_stats, dfs, m_g, m_r = [], [], [], []
+    for g in range(s.shape[0]):
+        n1 = max(cnt[g], 1.0)
+        n2 = max(tot_n - cnt[g], 1.0)
+        m1 = s[g] / n1
+        m2 = (tot_s - s[g]) / n2
+        v1 = np.maximum((ss[g] - n1 * m1**2) / max(n1 - 1, 1.0), 0.0)
+        v2 = np.maximum(((tot_ss - ss[g]) - n2 * m2**2)
+                        / max(n2 - 1, 1.0), 0.0)
+        n2_eff = n1 if overestim_var else n2
+        se2_1, se2_2 = v1 / n1, v2 / n2_eff
+        denom = np.sqrt(se2_1 + se2_2)
+        t = (m1 - m2) / np.maximum(denom, 1e-30)
+        df = (se2_1 + se2_2) ** 2 / np.maximum(
+            se2_1**2 / max(n1 - 1, 1.0)
+            + se2_2**2 / max(n2_eff - 1, 1.0), 1e-300)
+        t_stats.append(t)
+        dfs.append(df)
+        m_g.append(m1)
+        m_r.append(m2)
+    return (np.stack(t_stats), np.stack(dfs), np.stack(m_g), np.stack(m_r))
+
+
+# ----------------------------------------------------------------------
+# wilcoxon ranks (TPU): vmapped sort + double searchsorted
+# ----------------------------------------------------------------------
+
+
+@jax.jit
+def _average_ranks(X):
+    """Column-wise average ranks (1-based, ties averaged) and the
+    per-column tie term ``sum(t^3 - t)``; X is (n_cells, n_genes)."""
+
+    def per_gene(col):
+        xs = jnp.sort(col)
+        left = jnp.searchsorted(xs, col, side="left")
+        right = jnp.searchsorted(xs, col, side="right")
+        ranks = 0.5 * (left + right + 1)
+        # tie term: count each run of equal values once, at its first
+        # sorted occurrence
+        lo = jnp.searchsorted(xs, xs, side="left")
+        hi = jnp.searchsorted(xs, xs, side="right")
+        t = (hi - lo).astype(jnp.float32)
+        first = lo == jnp.arange(col.shape[0])
+        tie = jnp.sum(jnp.where(first, t**3 - t, 0.0))
+        return ranks, tie
+
+    ranks, ties = jax.vmap(per_gene, in_axes=1, out_axes=(1, 0))(X)
+    return ranks, ties
+
+
+@partial(jax.jit, static_argnames=("n_groups",))
+def _group_rank_sums(ranks, codes, n_groups):
+    # Sum CENTERED ranks (rank - (n+1)/2) with segment_sum: the group
+    # deviation from its null mean is computed directly instead of as
+    # a difference of two huge numbers, so f32 stays well-conditioned
+    # even at atlas scale (raw rank sums ~ n1*n/2 would swamp f32).
+    n = ranks.shape[0]
+    centered = ranks - 0.5 * (n + 1)
+    rs = jax.ops.segment_sum(centered, codes, num_segments=n_groups)
+    cnt = jax.ops.segment_sum(jnp.ones_like(codes, jnp.float32), codes,
+                              num_segments=n_groups)
+    return rs, cnt  # (g, genes) centered rank sums, (g,)
+
+
+@partial(jax.jit, static_argnames=("width",))
+def _dense_gene_block(x: SparseCells, lo, width):
+    """Densify gene columns [lo, lo+width) of a SparseCells —
+    (n_cells, width).  Same scatter as ``to_dense`` but over a
+    narrow table, so the full matrix never materialises (the whole
+    point for atlas-scale wilcoxon)."""
+    shifted = x.indices - lo
+    inb = (shifted >= 0) & (shifted < width) & (x.indices != x.sentinel)
+    tgt = jnp.where(inb, shifted, width)  # width = drop bin
+    table = jnp.zeros((x.indices.shape[0], width + 1), x.data.dtype)
+    table = jax.vmap(lambda t, i, d: t.at[i].add(d))(table, tgt, x.data)
+    return table[: x.n_cells, :width]
+
+
+_GENE_BLOCK = 2048
+
+
+def _blocked_rank_sums(get_block, n_genes, codes, n_groups):
+    """Accumulate per-gene tie terms and per-group rank sums over gene
+    blocks of static width; trailing all-zero pad columns are trimmed
+    host-side."""
+    rs_chunks, tie_chunks, cnt = [], [], None
+    for lo in range(0, n_genes, _GENE_BLOCK):
+        blk = get_block(lo)  # (n_cells, _GENE_BLOCK) — maybe padded
+        ranks, ties = _average_ranks(blk)
+        rs, cnt = _group_rank_sums(ranks, codes, n_groups)
+        rs_chunks.append(np.asarray(rs))
+        tie_chunks.append(np.asarray(ties))
+    rank_sums = np.concatenate(rs_chunks, axis=1)[:, :n_genes]
+    ties = np.concatenate(tie_chunks)[:n_genes]
+    return ties, cnt, rank_sums
+
+
+def _wilcoxon_z(centered_rank_sums, cnt, ties, n, tie_correct):
+    """z from CENTERED per-group rank sums (null mean already zero)."""
+    rs = np.asarray(centered_rank_sums, np.float64)
+    cnt = np.asarray(cnt, np.float64)
+    ties = np.asarray(ties, np.float64)
+    zs = []
+    for g in range(rs.shape[0]):
+        n1 = cnt[g]
+        n2 = n - n1
+        var = n1 * n2 * (n + 1) / 12.0
+        if tie_correct:
+            var = var * (1.0 - ties / max(n**3 - n, 1.0))
+        zs.append(rs[g] / np.sqrt(np.maximum(var, 1e-30)))
+    return np.stack(zs)
+
+
+# ----------------------------------------------------------------------
+# the registered op
+# ----------------------------------------------------------------------
+
+
+def _finalise(data, scores, pvals, lfc, levels, method, n_top):
+    """Sort per group, BH-adjust, stash scanpy-shaped uns entry."""
+    padj = _bh_adjust(pvals)
+    order = np.argsort(-scores, axis=1)
+    if n_top is not None:
+        order = order[:, :n_top]
+    gene_names = None
+    if "gene_name" in data.var:
+        gene_names = np.asarray(data.var["gene_name"]).astype(str)
+    take = lambda a: np.take_along_axis(a, order, axis=1)
+    result = {
+        "method": method,
+        "groups": levels,
+        "indices": order,
+        "names": (gene_names[order] if gene_names is not None else order),
+        "scores": take(scores),
+        "pvals": take(pvals),
+        "pvals_adj": take(padj),
+        "logfoldchanges": take(lfc),
+    }
+    return data.with_uns(rank_genes_groups=result)
+
+
+def _rank_genes_groups(data: CellData, groupby: str, method: str,
+                       n_top, tie_correct: bool, dense_ranks_via,
+                       group_moments):
+    from scipy import stats as sps
+
+    codes_host, levels, n_obs = _group_codes(data, groupby)
+    n_groups = len(levels)
+
+    if method in ("t-test", "t-test_overestim_var"):
+        s, ss, cnt = group_moments(codes_host, n_groups, need_ss=True)
+        t, df, m_g, m_r = _welch_stats(
+            s, ss, cnt, overestim_var=(method == "t-test_overestim_var"))
+        pvals = 2.0 * sps.t.sf(np.abs(t), np.maximum(df, 1.0))
+        scores = t
+    elif method == "wilcoxon":
+        ties, cnt, rank_sums = dense_ranks_via(codes_host, n_groups)
+        z = _wilcoxon_z(rank_sums, cnt, ties, n_obs, tie_correct)
+        pvals = 2.0 * sps.norm.sf(np.abs(z))
+        scores = z
+        s, _, cnt2 = group_moments(codes_host, n_groups, need_ss=False)
+        m_g, m_r = _group_means(s, cnt2)
+    else:
+        raise ValueError(f"unknown method {method!r}; use 't-test', "
+                         f"'t-test_overestim_var' or 'wilcoxon'")
+    lfc = _logfoldchange(m_g, m_r)
+    return _finalise(data, scores, pvals, lfc, levels, method, n_top)
+
+
+@register("de.rank_genes_groups", backend="tpu")
+def rank_genes_groups_tpu(data: CellData, groupby: str = "label",
+                          method: str = "t-test", n_top: int | None = None,
+                          tie_correct: bool = True) -> CellData:
+    """Rank genes characterising each group vs the rest (scanpy
+    ``tl.rank_genes_groups``), group-vs-rest for every level of
+    ``obs[groupby]``.
+
+    Results land in ``uns["rank_genes_groups"]`` (host numpy): names /
+    indices, scores (t or z), pvals, BH-adjusted pvals, and
+    log2-fold-changes, each (n_groups × n_top_or_all_genes), sorted by
+    descending score per group.
+    """
+    X = data.X
+    n = data.n_cells
+    n_genes = data.n_genes
+
+    if isinstance(X, SparseCells):
+        def group_moments(codes_host, n_groups, need_ss=True):
+            # codes padded with -1 -> one_hot zero rows for padding
+            c = np.full(X.rows_padded, -1, np.int32)
+            c[:n] = codes_host[:n]
+            return _group_moments_sparse(X, jnp.asarray(c), n_groups,
+                                         need_ss=need_ss)
+
+        def dense_ranks_via(codes_host, n_groups):
+            width = min(_GENE_BLOCK, n_genes)
+            return _blocked_rank_sums(
+                lambda lo: _dense_gene_block(X, lo, width),
+                n_genes, jnp.asarray(codes_host), n_groups)
+    else:
+        Xd = jnp.asarray(X)
+
+        def group_moments(codes_host, n_groups, need_ss=True):
+            del need_ss  # dense moments cost one fused pass either way
+            return _group_moments_dense(
+                Xd[:n], jnp.asarray(codes_host), n_groups)
+
+        def dense_ranks_via(codes_host, n_groups):
+            return _blocked_rank_sums(
+                lambda lo: Xd[:n, lo:lo + _GENE_BLOCK],
+                n_genes, jnp.asarray(codes_host), n_groups)
+
+    return _rank_genes_groups(data, groupby, method, n_top, tie_correct,
+                              dense_ranks_via, group_moments)
+
+
+@register("de.rank_genes_groups", backend="cpu")
+def rank_genes_groups_cpu(data: CellData, groupby: str = "label",
+                          method: str = "t-test", n_top: int | None = None,
+                          tie_correct: bool = True) -> CellData:
+    """scipy oracle: same statistics via dense numpy/scipy."""
+    import scipy.sparse as sp
+    from scipy import stats as sps
+
+    X = data.X
+    X = np.asarray(X.todense()) if sp.issparse(X) else np.asarray(X)
+    X = X.astype(np.float64)
+    codes_host, levels, n_obs = _group_codes(data, groupby)
+    n_groups = len(levels)
+
+    def group_moments(codes, ng, need_ss=True):
+        del need_ss
+        onehot = np.eye(ng)[codes]
+        return onehot.T @ X, onehot.T @ (X * X), onehot.sum(0)
+
+    def dense_ranks_via(codes, ng):
+        ranks = sps.rankdata(X, axis=0)
+        # per-gene tie term
+        ties = np.zeros(X.shape[1])
+        for j in range(X.shape[1]):
+            _, t = np.unique(X[:, j], return_counts=True)
+            ties[j] = np.sum(t.astype(np.float64) ** 3 - t)
+        onehot = np.eye(ng)[codes]
+        n = X.shape[0]
+        return ties, onehot.sum(0), onehot.T @ (ranks - 0.5 * (n + 1))
+
+    return _rank_genes_groups(data, groupby, method, n_top, tie_correct,
+                              dense_ranks_via, group_moments)

@@ -1,0 +1,171 @@
+"""Gene-set scoring: ``score.genes`` and ``score.cell_cycle``.
+
+Scanpy-parity (``tl.score_genes`` / ``tl.score_genes_cell_cycle``):
+a cell's score is its mean expression over the gene set minus its mean
+over a control set sampled from expression-matched bins (Satija et al.
+2015).  TPU-first shape: both means are one ``X @ w`` sparse matvec
+(``spmm`` with a (n_genes, 2) weight table), so the whole op is a
+single fused pass over the ELL data regardless of set size.
+
+Control sampling (binning genes by mean expression, drawing
+``ctrl_size`` per occupied bin) is host-side numpy on (n_genes,)
+vectors — data-dependent sizes don't belong under jit.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.dataset import CellData
+from ..data.sparse import SparseCells, spmm
+from ..registry import register
+
+
+def _resolve_gene_indices(data: CellData, genes) -> np.ndarray:
+    """Gene list -> integer indices; names resolved via var['gene_name']."""
+    genes = np.asarray(genes)
+    if genes.dtype.kind in "iu":
+        return genes.astype(np.int64)
+    if "gene_name" not in data.var:
+        raise KeyError("score.genes: gene names given but var has no "
+                       "'gene_name' column")
+    names = np.asarray(data.var["gene_name"]).astype(str)
+    lut = {n: i for i, n in enumerate(names)}
+    wanted = genes.astype(str)
+    idx = [lut[g] for g in wanted if g in lut]
+    missing = [g for g in wanted if g not in lut]
+    if not idx:
+        raise ValueError("score.genes: none of the given genes found in "
+                         "var['gene_name']")
+    if missing:
+        import warnings
+
+        warnings.warn(
+            f"score.genes: {len(missing)}/{len(wanted)} genes not in "
+            f"var['gene_name'] and ignored (e.g. {missing[:5]})",
+            stacklevel=3)
+    return np.asarray(idx, np.int64)
+
+
+def _gene_means_host(data: CellData) -> np.ndarray:
+    """Per-gene mean expression on the host (for control binning)."""
+    X = data.X
+    if isinstance(X, SparseCells):
+        from ..data.sparse import gene_stats
+
+        s, _, _ = gene_stats(X)
+        return np.asarray(s) / X.n_cells
+    import scipy.sparse as sp
+
+    if sp.issparse(X):
+        return np.asarray(X.mean(axis=0)).ravel()
+    return np.asarray(X).mean(axis=0)
+
+
+def _control_indices(gene_means, target_idx, ctrl_size, n_bins, seed):
+    """Expression-matched control genes: bin all genes by mean
+    expression rank, then for each bin containing a target gene draw
+    ``ctrl_size`` genes from it (excluding targets)."""
+    rng = np.random.default_rng(seed)
+    n_genes = gene_means.shape[0]
+    order = np.argsort(gene_means)
+    bin_of = np.empty(n_genes, np.int64)
+    bin_of[order] = np.arange(n_genes) * n_bins // n_genes
+    target_set = np.zeros(n_genes, bool)
+    target_set[target_idx] = True
+    ctrl = []
+    for b in np.unique(bin_of[target_idx]):
+        pool = np.where((bin_of == b) & ~target_set)[0]
+        if len(pool) == 0:
+            continue
+        take = min(ctrl_size, len(pool))
+        ctrl.append(rng.choice(pool, size=take, replace=False))
+    if not ctrl:
+        raise ValueError("score.genes: control pool is empty")
+    return np.unique(np.concatenate(ctrl))
+
+
+def _score_weights(n_genes, target_idx, ctrl_idx):
+    """(n_genes, 2) weight table: col0 averages the target set, col1
+    the control set — score = X@w[:,0] - X@w[:,1]."""
+    w = np.zeros((n_genes, 2), np.float32)
+    w[target_idx, 0] = 1.0 / len(target_idx)
+    w[ctrl_idx, 1] = 1.0 / len(ctrl_idx)
+    return w
+
+
+@register("score.genes", backend="tpu")
+def score_genes_tpu(data: CellData, genes=None, score_name: str = "score",
+                    ctrl_size: int = 50, n_bins: int = 25,
+                    seed: int = 0) -> CellData:
+    """Per-cell gene-set score: mean(set) - mean(expression-matched
+    control), stored in ``obs[score_name]``."""
+    if genes is None:
+        raise ValueError("score.genes needs a gene list")
+    target_idx = _resolve_gene_indices(data, genes)
+    gm = _gene_means_host(data)
+    ctrl_idx = _control_indices(gm, target_idx, ctrl_size, n_bins, seed)
+    w = jnp.asarray(_score_weights(data.n_genes, target_idx, ctrl_idx))
+    X = data.X
+    if isinstance(X, SparseCells):
+        both = spmm(X, w)  # (rows_padded, 2)
+    else:
+        both = jnp.asarray(X) @ w
+    score = both[:, 0] - both[:, 1]
+    return data.with_obs(**{score_name: score})
+
+
+@register("score.genes", backend="cpu")
+def score_genes_cpu(data: CellData, genes=None, score_name: str = "score",
+                    ctrl_size: int = 50, n_bins: int = 25,
+                    seed: int = 0) -> CellData:
+    import scipy.sparse as sp
+
+    if genes is None:
+        raise ValueError("score.genes needs a gene list")
+    target_idx = _resolve_gene_indices(data, genes)
+    gm = _gene_means_host(data)
+    ctrl_idx = _control_indices(gm, target_idx, ctrl_size, n_bins, seed)
+    w = _score_weights(data.n_genes, target_idx, ctrl_idx)
+    X = data.X
+    both = (X @ w if sp.issparse(X) else np.asarray(X) @ w)
+    both = np.asarray(both)
+    return data.with_obs(**{score_name: both[:, 0] - both[:, 1]})
+
+
+def _cell_cycle(data: CellData, s_genes, g2m_genes, backend, seed):
+    from ..registry import apply
+
+    data = apply("score.genes", data, backend=backend, genes=s_genes,
+                 score_name="S_score", seed=seed)
+    data = apply("score.genes", data, backend=backend, genes=g2m_genes,
+                 score_name="G2M_score", seed=seed + 1)
+    # keep obs columns uniform length: phase matches the (possibly
+    # padded) score arrays; padding rows get "" and are trimmed by
+    # to_host like any other per-cell array
+    s = np.asarray(data.obs["S_score"])
+    g2m = np.asarray(data.obs["G2M_score"])
+    phase = np.where((s <= 0) & (g2m <= 0), "G1",
+                     np.where(s > g2m, "S", "G2M"))
+    phase[data.n_cells:] = ""
+    return data.with_obs(phase=phase)
+
+
+@register("score.cell_cycle", backend="tpu")
+def cell_cycle_tpu(data: CellData, s_genes=None, g2m_genes=None,
+                   seed: int = 0) -> CellData:
+    """S/G2M phase scores + phase call (scanpy
+    ``score_genes_cell_cycle``): ``obs["S_score"]``,
+    ``obs["G2M_score"]``, ``obs["phase"]`` in {G1, S, G2M}."""
+    if s_genes is None or g2m_genes is None:
+        raise ValueError("score.cell_cycle needs s_genes and g2m_genes")
+    return _cell_cycle(data, s_genes, g2m_genes, "tpu", seed)
+
+
+@register("score.cell_cycle", backend="cpu")
+def cell_cycle_cpu(data: CellData, s_genes=None, g2m_genes=None,
+                   seed: int = 0) -> CellData:
+    if s_genes is None or g2m_genes is None:
+        raise ValueError("score.cell_cycle needs s_genes and g2m_genes")
+    return _cell_cycle(data, s_genes, g2m_genes, "cpu", seed)

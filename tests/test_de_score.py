@@ -1,0 +1,150 @@
+"""DE (rank_genes_groups) and gene scoring vs scipy oracles."""
+
+import numpy as np
+import pytest
+import scipy.stats as sps
+
+import sctools_tpu as sct
+from sctools_tpu.data.synthetic import synthetic_counts
+
+
+@pytest.fixture(scope="module")
+def ds():
+    d = synthetic_counts(240, 180, density=0.15, n_clusters=3, seed=21)
+    d = sct.apply("normalize.library_size", d, backend="cpu")
+    d = sct.apply("normalize.log1p", d, backend="cpu")
+    rng = np.random.default_rng(5)
+    labels = np.array(["a", "b", "c"])[rng.integers(0, 3, d.n_cells)]
+    # plant group-"b" markers so rankings are meaningful
+    X = np.asarray(d.X.todense(), dtype=np.float32)
+    X[labels == "b", :5] += 2.0
+    import scipy.sparse as sp
+
+    return d.with_X(sp.csr_matrix(X)).with_obs(label=labels)
+
+
+def _scipy_ttest(X, labels, group):
+    m = labels == group
+    return sps.ttest_ind(X[m], X[~m], equal_var=False)
+
+
+def test_ttest_matches_scipy(ds):
+    X = np.asarray(ds.X.todense(), np.float64)
+    labels = ds.obs["label"]
+    for backend, d in (("cpu", ds), ("tpu", ds.device_put())):
+        out = sct.apply("de.rank_genes_groups", d, backend=backend,
+                        groupby="label", method="t-test")
+        r = out.uns["rank_genes_groups"]
+        gi = r["groups"].index("b")
+        t_ref, p_ref = _scipy_ttest(X, labels, "b")
+        inv = np.argsort(r["indices"][gi])
+        scores = r["scores"][gi][inv]
+        pvals = r["pvals"][gi][inv]
+        ok = np.isfinite(t_ref)
+        np.testing.assert_allclose(scores[ok], t_ref[ok], rtol=5e-3,
+                                   atol=5e-3)
+        np.testing.assert_allclose(pvals[ok], p_ref[ok], rtol=2e-2,
+                                   atol=1e-5)
+
+
+def test_ttest_ranks_planted_markers_first(ds):
+    out = sct.apply("de.rank_genes_groups", ds.device_put(), backend="tpu",
+                    groupby="label", method="t-test", n_top=10)
+    r = out.uns["rank_genes_groups"]
+    gi = r["groups"].index("b")
+    assert set(range(5)) <= set(r["indices"][gi][:8].tolist())
+
+
+def test_wilcoxon_matches_scipy(ds):
+    X = np.asarray(ds.X.todense(), np.float64)
+    labels = ds.obs["label"]
+    m = labels == "a"
+    # scipy z via mannwhitneyu (asymptotic, no continuity, tie-corrected)
+    res = sps.mannwhitneyu(X[m], X[~m], axis=0, method="asymptotic",
+                           use_continuity=False)
+    n1, n2 = m.sum(), (~m).sum()
+    for backend, d in (("cpu", ds), ("tpu", ds.device_put())):
+        out = sct.apply("de.rank_genes_groups", d, backend=backend,
+                        groupby="label", method="wilcoxon")
+        r = out.uns["rank_genes_groups"]
+        gi = r["groups"].index("a")
+        inv = np.argsort(r["indices"][gi])
+        pvals = r["pvals"][gi][inv]
+        # scipy returns NaN on all-tied (constant) genes; we clamp to z=0
+        ok = np.isfinite(res.pvalue)
+        np.testing.assert_allclose(pvals[ok], res.pvalue[ok], rtol=2e-2,
+                                   atol=1e-4)
+
+
+def test_wilcoxon_cpu_tpu_agree(ds):
+    outs = {}
+    for backend, d in (("cpu", ds), ("tpu", ds.device_put())):
+        out = sct.apply("de.rank_genes_groups", d, backend=backend,
+                        groupby="label", method="wilcoxon")
+        r = out.uns["rank_genes_groups"]
+        inv = np.argsort(r["indices"], axis=1)
+        outs[backend] = np.take_along_axis(r["scores"], inv, axis=1)
+    np.testing.assert_allclose(outs["tpu"], outs["cpu"], rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_wilcoxon_multiblock_matches_single(ds, monkeypatch):
+    # force several gene blocks so the blocked rank path is exercised
+    import sctools_tpu.ops.de as de
+
+    out1 = sct.apply("de.rank_genes_groups", ds.device_put(), backend="tpu",
+                     groupby="label", method="wilcoxon")
+    monkeypatch.setattr(de, "_GENE_BLOCK", 64)
+    out2 = sct.apply("de.rank_genes_groups", ds.device_put(), backend="tpu",
+                     groupby="label", method="wilcoxon")
+    r1, r2 = out1.uns["rank_genes_groups"], out2.uns["rank_genes_groups"]
+    i1 = np.take_along_axis(r1["scores"], np.argsort(r1["indices"], 1), 1)
+    i2 = np.take_along_axis(r2["scores"], np.argsort(r2["indices"], 1), 1)
+    np.testing.assert_allclose(i1, i2, rtol=1e-4, atol=1e-4)
+
+
+def test_ttest_overestim_var(ds):
+    out = sct.apply("de.rank_genes_groups", ds, backend="cpu",
+                    groupby="label", method="t-test_overestim_var")
+    plain = sct.apply("de.rank_genes_groups", ds, backend="cpu",
+                      groupby="label", method="t-test")
+    r, rp = out.uns["rank_genes_groups"], plain.uns["rank_genes_groups"]
+    a = np.take_along_axis(np.abs(r["scores"]),
+                           np.argsort(r["indices"], 1), 1)
+    b = np.take_along_axis(np.abs(rp["scores"]),
+                           np.argsort(rp["indices"], 1), 1)
+    # overestimated variance can only shrink |t|
+    assert np.all(a <= b + 1e-9)
+    assert not np.allclose(a, b)
+
+
+def test_bh_adjustment_monotone(ds):
+    out = sct.apply("de.rank_genes_groups", ds, backend="cpu",
+                    groupby="label", method="t-test")
+    r = out.uns["rank_genes_groups"]
+    assert np.all(r["pvals_adj"] >= r["pvals"] - 1e-12)
+    assert np.all(r["pvals_adj"] <= 1.0 + 1e-12)
+
+
+def test_score_genes_planted_set(ds):
+    # gene set = planted markers; cells in group b should score higher
+    labels = ds.obs["label"]
+    for backend, d in (("cpu", ds), ("tpu", ds.device_put())):
+        out = sct.apply("score.genes", d, backend=backend,
+                        genes=np.arange(5), score_name="marker_score")
+        s = np.asarray(out.obs["marker_score"])[: ds.n_cells]
+        assert s[labels == "b"].mean() > s[labels != "b"].mean() + 0.5
+
+
+def test_score_genes_by_name(ds):
+    names = np.asarray(ds.var["gene_name"]).astype(str)[:4]
+    out = sct.apply("score.genes", ds, backend="cpu", genes=names)
+    assert "score" in out.obs
+
+
+def test_cell_cycle_phases(ds):
+    out = sct.apply("score.cell_cycle", ds.device_put(), backend="tpu",
+                    s_genes=np.arange(5), g2m_genes=np.arange(10, 15))
+    ph = np.asarray(out.obs["phase"])
+    assert set(np.unique(ph)) <= {"G1", "S", "G2M"}
+    assert "S_score" in out.obs and "G2M_score" in out.obs
