@@ -1,0 +1,257 @@
+"""``qc.doublet_score`` — scrublet-style doublet detection.
+
+Reference parity: dpeerlab/sctools ships doublet QC in its
+preprocessing suite (source unavailable — SURVEY.md §0; algorithm is
+the published Scrublet method: simulate doublets by summing random
+pairs of observed cells, embed them with the observed cells, and score
+each observed cell by how enriched its neighbourhood is in simulated
+doublets).
+
+TPU design: the expensive stage — normalising and projecting the
+simulated doublets into PCA space — is a **fused blocked kernel**
+(``lax.map`` over pair blocks) that never materialises the simulated
+count matrix:
+
+* per block, gather the two parent rows' padded-ELL slots and
+  concatenate → ``(block, 2·capacity)``;
+* merge duplicate gene ids (a gene present in both parents) exactly
+  with a sort + cumsum-difference trick — counts are non-negative, so
+  the cumulative sum at run boundaries recovers every run total
+  regardless of run length, with no scatter;
+* library-normalise + log1p the merged counts and contract against
+  the PCA loadings gathered per slot (zero-padded table row kills
+  sentinel/merged slots) — one VPU-friendly einsum per block.
+
+The kNN over the combined (observed + simulated) embedding reuses the
+blocked MXU top-k from ``neighbors.knn``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.dataset import CellData
+from ..data.sparse import SparseCells
+from ..registry import register
+
+
+def _default_k(n_cells: int) -> int:
+    return max(10, int(round(0.5 * np.sqrt(n_cells))))
+
+
+def _resolve_params(n: int, sim_ratio: float, k: int | None):
+    """(n_sim, k, k_adj) shared by both backends — n_sim depends only
+    on the statistics, never on tiling, so cpu and tpu simulate the
+    same doublets for a seed."""
+    n_sim = max(1, int(round(sim_ratio * n)))
+    k = k or _default_k(n)
+    k_adj = int(round(k * (1.0 + n_sim / n)))
+    return n_sim, k, k_adj
+
+
+def _attach_outputs(data: CellData, obs_s, sim_s, expected_rate,
+                    threshold) -> CellData:
+    out = data.with_obs(doublet_score=obs_s).with_uns(
+        doublet_sim_scores=sim_s, doublet_expected_rate=expected_rate)
+    if threshold is not None:
+        out = out.with_obs(predicted_doublet=obs_s > threshold).with_uns(
+            doublet_threshold=threshold)
+    return out
+
+
+def _doublet_likelihood(q, r, rho):
+    """Scrublet's posterior doublet likelihood from the simulated-
+    neighbour fraction ``q``, simulation ratio ``r = n_sim/n_obs`` and
+    expected doublet rate ``rho``.  q == r/(1+r) (no enrichment) maps
+    to rho; q -> 1 maps to 1."""
+    return q * rho / r / (1.0 - rho - q * (1.0 - rho - rho / r))
+
+
+def _sample_pairs(n_cells: int, n_sim: int, seed: int) -> np.ndarray:
+    """(n_sim, 2) parent indices, i != j.  Host-side numpy rng so the
+    cpu and tpu backends simulate the *same* doublets for a seed."""
+    rng = np.random.default_rng(seed)
+    i = rng.integers(0, n_cells, size=n_sim)
+    j = (i + 1 + rng.integers(0, n_cells - 1, size=n_sim)) % n_cells
+    return np.stack([i, j], axis=1).astype(np.int32)
+
+
+@partial(jax.jit, static_argnames=("target_sum", "block"))
+def _project_doublets(ind, dat, pairs, comps, mu, target_sum: float,
+                      block: int = 1024):
+    """Project simulated doublets into PCA space without materialising
+    their count matrix.
+
+    ind/dat: padded-ELL arrays of the *raw counts*; pairs: (n_sim, 2);
+    comps: (G, d) loadings; mu: (G,) gene means of the normalised
+    observed matrix.  Returns (n_sim, d) centred scores.  Pairs are
+    padded internally to a ``block`` multiple (pair (0, 0) — harmless)
+    and the padding sliced off the result.
+    """
+    d = comps.shape[1]
+    comps_pad = jnp.concatenate(
+        [comps, jnp.zeros((1, d), comps.dtype)], axis=0)
+    mu_proj = mu @ comps  # (d,)
+    n_sim = pairs.shape[0]
+    pad = (-n_sim) % block
+    if pad:
+        pairs = jnp.concatenate(
+            [pairs, jnp.zeros((pad, 2), pairs.dtype)], axis=0)
+
+    def per_block(pblk):  # (block, 2)
+        ind2 = jnp.concatenate(
+            [jnp.take(ind, pblk[:, 0], axis=0),
+             jnp.take(ind, pblk[:, 1], axis=0)], axis=1)
+        dat2 = jnp.concatenate(
+            [jnp.take(dat, pblk[:, 0], axis=0),
+             jnp.take(dat, pblk[:, 1], axis=0)], axis=1)
+        order = jnp.argsort(ind2, axis=1)
+        ind_s = jnp.take_along_axis(ind2, order, axis=1)
+        dat_s = jnp.take_along_axis(dat2, order, axis=1)
+        # Exact duplicate merge: counts are >= 0, so the cumsum is
+        # non-decreasing and the cumulative max of run-boundary cumsums
+        # is the cumsum at the *previous* boundary — run total =
+        # cs[last] - cs[previous last], any run length, no scatter.
+        cs = jnp.cumsum(dat_s.astype(jnp.float32), axis=1)
+        is_last = jnp.concatenate(
+            [ind_s[:, :-1] != ind_s[:, 1:],
+             jnp.ones((ind_s.shape[0], 1), bool)], axis=1)
+        boundary_cs = jnp.where(is_last, cs, 0.0)
+        prev_cs = jnp.concatenate(
+            [jnp.zeros((ind_s.shape[0], 1), jnp.float32),
+             jax.lax.cummax(boundary_cs, axis=1)[:, :-1]], axis=1)
+        val = jnp.where(is_last, cs - prev_cs, 0.0)
+        # library-size normalise + log1p the merged doublet counts
+        totals = cs[:, -1]
+        scale = jnp.where(totals > 0, target_sum / jnp.maximum(totals, 1e-12),
+                          0.0)
+        v = jnp.log1p(val * scale[:, None])
+        # project: zero rows of comps_pad kill sentinel slots; merged
+        # (zero-valued) slots contribute 0 regardless of their index
+        g = jnp.take(comps_pad, jnp.minimum(ind_s, comps.shape[0]), axis=0)
+        return jnp.einsum("bc,bcd->bd", v, g) - mu_proj[None, :]
+
+    out = jax.lax.map(
+        per_block, pairs.reshape((n_sim + pad) // block, block, 2))
+    return out.reshape(n_sim + pad, d)[:n_sim]
+
+
+def _neighbor_scores(emb_obs, emb_sim, n_obs, n_sim, k_adj, metric,
+                     expected_rate, backend):
+    """kNN over the combined embedding; per-row simulated-neighbour
+    fraction → doublet likelihood.  Returns (obs_scores, sim_scores)."""
+    r = n_sim / n_obs
+    if backend == "tpu":
+        from .knn import knn_arrays
+
+        combined = jnp.concatenate(
+            [jnp.asarray(emb_obs), jnp.asarray(emb_sim)], axis=0)
+        idx, _ = knn_arrays(combined, combined, k=k_adj, metric=metric,
+                            n_query=n_obs + n_sim, n_cand=n_obs + n_sim,
+                            exclude_self=True)
+        idx = idx[: n_obs + n_sim]
+        n_sim_nb = jnp.sum(idx >= n_obs, axis=1)
+        n_valid = jnp.sum(idx >= 0, axis=1)
+        q = (n_sim_nb + 1.0) / (n_valid + 2.0)
+        scores = np.asarray(_doublet_likelihood(q, r, expected_rate))
+    else:
+        from .knn import knn_numpy
+
+        combined = np.concatenate(
+            [np.asarray(emb_obs, np.float64), np.asarray(emb_sim, np.float64)])
+        idx, _ = knn_numpy(combined, combined, k=k_adj, metric=metric,
+                           exclude_self=True)
+        n_sim_nb = (idx >= n_obs).sum(axis=1)
+        n_valid = (idx >= 0).sum(axis=1)
+        q = (n_sim_nb + 1.0) / (n_valid + 2.0)
+        scores = _doublet_likelihood(q, r, expected_rate)
+    return (scores[:n_obs].astype(np.float32),
+            scores[n_obs:].astype(np.float32))
+
+
+@register("qc.doublet_score", backend="tpu")
+def doublet_score_tpu(data: CellData, expected_rate: float = 0.06,
+                      sim_ratio: float = 2.0, n_components: int = 30,
+                      k: int | None = None, metric: str = "euclidean",
+                      target_sum: float = 1e4, seed: int = 0,
+                      threshold: float | None = None,
+                      block: int = 1024) -> CellData:
+    """Scrublet-style doublet scoring.  ``data.X`` must hold **raw
+    counts** (run before normalisation).  Adds obs["doublet_score"],
+    uns["doublet_sim_scores"]; with ``threshold`` also
+    obs["predicted_doublet"]."""
+    from .pca import randomized_pca_arrays
+
+    X = data.X
+    if not isinstance(X, SparseCells):
+        raise TypeError("qc.doublet_score(tpu) expects SparseCells raw "
+                        "counts; device_put the data first")
+    n = data.n_cells
+    n_sim, k, k_adj = _resolve_params(n, sim_ratio, k)
+
+    # normalised log1p view of the observed counts (functional copy)
+    from .normalize import _library_size_sparse
+
+    x_scaled, _ = _library_size_sparse(X, target_sum)
+    x_norm = x_scaled.with_data(jnp.log1p(x_scaled.data))
+    obs_scores, comps, _, mu = randomized_pca_arrays(
+        x_norm, jax.random.PRNGKey(seed), n_components=n_components)
+    obs_scores = obs_scores[:n]
+
+    pairs = jnp.asarray(_sample_pairs(n, n_sim, seed))
+    sim_scores_emb = _project_doublets(
+        X.indices, X.data, pairs, comps, mu, target_sum, block=block)
+
+    obs_s, sim_s = _neighbor_scores(
+        obs_scores, sim_scores_emb, n, n_sim, k_adj, metric,
+        expected_rate, backend="tpu")
+    return _attach_outputs(data, obs_s, sim_s, expected_rate, threshold)
+
+
+@register("qc.doublet_score", backend="cpu")
+def doublet_score_cpu(data: CellData, expected_rate: float = 0.06,
+                      sim_ratio: float = 2.0, n_components: int = 30,
+                      k: int | None = None, metric: str = "euclidean",
+                      target_sum: float = 1e4, seed: int = 0,
+                      threshold: float | None = None,
+                      **_ignored) -> CellData:
+    """Numpy/scipy oracle: same simulation (same host rng), exact CSR
+    doublet sums, dense PCA projection."""
+    import scipy.sparse as sp
+
+    X = data.X
+    if not sp.issparse(X):
+        X = sp.csr_matrix(np.asarray(X))
+    X = X.tocsr()
+    n = data.n_cells
+    n_sim, k, k_adj = _resolve_params(n, sim_ratio, k)
+
+    totals = np.asarray(X.sum(axis=1)).ravel()
+    scale = np.where(totals > 0, target_sum / np.maximum(totals, 1e-12), 0.0)
+    x_norm = sp.diags(scale) @ X
+    x_norm.data = np.log1p(x_norm.data)
+
+    from .pca import pca_randomized_cpu
+
+    pcad = pca_randomized_cpu(CellData(x_norm), n_components=n_components,
+                              seed=seed)
+    obs_scores = np.asarray(pcad.obsm["X_pca"], np.float64)
+    comps = np.asarray(pcad.varm["PCs"], np.float64)
+    mu = np.asarray(pcad.uns["pca_mean"], np.float64)
+
+    pairs = _sample_pairs(n, n_sim, seed)
+    dbl = X[pairs[:, 0]] + X[pairs[:, 1]]  # exact CSR duplicate handling
+    dtot = np.asarray(dbl.sum(axis=1)).ravel()
+    dbl = sp.diags(np.where(dtot > 0, target_sum / np.maximum(dtot, 1e-12),
+                            0.0)) @ dbl
+    dbl.data = np.log1p(dbl.data)
+    sim_scores_emb = dbl @ comps - mu @ comps
+
+    obs_s, sim_s = _neighbor_scores(
+        obs_scores, sim_scores_emb, n, n_sim, k_adj, metric,
+        expected_rate, backend="cpu")
+    return _attach_outputs(data, obs_s, sim_s, expected_rate, threshold)
