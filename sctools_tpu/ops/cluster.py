@@ -331,6 +331,47 @@ def leiden_like_cpu(data: CellData, n_iter: int = 30,
     return data.with_obs(leiden_like=labels)
 
 
+# ----------------------------------------------------------------------
+# cluster.phenograph — Jaccard graph + community detection
+# ----------------------------------------------------------------------
+
+
+@register("cluster.phenograph", backend="tpu")
+def phenograph_tpu(data: CellData, n_iter: int = 30) -> CellData:
+    """PhenoGraph: reweight the kNN graph by neighbour-set Jaccard
+    similarity, then detect communities (label propagation +
+    modularity merge — see cluster.leiden_like for the divergence
+    note vs true Louvain).  Requires neighbors.knn.  Adds
+    obs["phenograph"], obsp["jaccard"]."""
+    from .graph import jaccard_tpu
+
+    if "jaccard" not in data.obsp:
+        data = jaccard_tpu(data)
+    out = leiden_like_tpu(data, n_iter=n_iter, weight_key="jaccard")
+    return _as_phenograph(data, out)
+
+
+@register("cluster.phenograph", backend="cpu")
+def phenograph_cpu(data: CellData, n_iter: int = 30) -> CellData:
+    from .graph import jaccard_cpu
+
+    if "jaccard" not in data.obsp:
+        data = jaccard_cpu(data)
+    out = leiden_like_cpu(data, n_iter=n_iter, weight_key="jaccard")
+    return _as_phenograph(data, out)
+
+
+def _as_phenograph(before: CellData, after: CellData) -> CellData:
+    """Move the delegated leiden_like labels to obs["phenograph"],
+    restoring (or dropping) the caller's own obs["leiden_like"]."""
+    obs = dict(after.obs)
+    labels = obs.pop("leiden_like")
+    if "leiden_like" in before.obs:
+        obs["leiden_like"] = before.obs["leiden_like"]
+    obs["phenograph"] = labels
+    return after.replace(obs=obs)
+
+
 def adjusted_rand_index(a, b) -> float:
     """ARI between two labelings (test/bench metric)."""
     a = np.asarray(a)

@@ -151,3 +151,47 @@ def test_label_propagation_recovers_blobs():
     c = sct.apply("cluster.leiden_like", cpu_side, backend="cpu")
     ari_c = adjusted_rand_index(c.obs["leiden_like"], labels)
     assert ari_c > 0.9, f"CPU label propagation ARI {ari_c}"
+
+
+def test_jaccard_parity(with_knn):
+    cpu, dev = with_knn
+    c = sct.apply("graph.jaccard", cpu, backend="cpu")
+    t = sct.apply("graph.jaccard", dev, backend="tpu",
+                  block=64).to_host()
+    np.testing.assert_allclose(t.obsp["jaccard"], c.obsp["jaccard"],
+                               rtol=1e-5, atol=1e-6)
+    j = np.asarray(c.obsp["jaccard"])
+    assert j.max() <= 1.0 + 1e-6 and j.min() >= 0.0
+    # self-edge (distance 0 neighbour) has jaccard 1 with itself
+    idx = np.asarray(cpu.obsp["knn_indices"])
+    self_col = idx == np.arange(len(idx))[:, None]
+    assert np.allclose(j[self_col], 1.0)
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_phenograph_recovers_blobs(backend):
+    pts, labels = gaussian_blobs(400, 12, n_clusters=4, spread=0.08, seed=23)
+    ds = sct.CellData(pts, obsm={"X_pca": pts})
+    ds = sct.apply("neighbors.knn", ds, backend=backend, k=15,
+                   metric="euclidean")
+    out = sct.apply("cluster.phenograph", ds, backend=backend)
+    out = out.to_host() if backend == "tpu" else out
+    got = np.asarray(out.obs["phenograph"])[: len(labels)]
+    ari = adjusted_rand_index(got, labels)
+    assert ari > 0.9, f"phenograph ARI too low ({backend}): {ari:.3f}"
+    assert "jaccard" in out.obsp
+
+
+def test_phenograph_beats_unweighted_on_counts(with_knn):
+    """On the harder counts fixture the Jaccard reweighting must help:
+    phenograph's ARI ≥ the unweighted-connectivities leiden_like ARI."""
+    cpu, _ = with_knn
+    true = np.asarray(cpu.obs["cluster_true"])
+    pheno = sct.apply("cluster.phenograph", cpu, backend="cpu")
+    base = sct.apply("cluster.leiden_like",
+                     sct.apply("graph.connectivities", cpu, backend="cpu"),
+                     backend="cpu")
+    ari_p = adjusted_rand_index(np.asarray(pheno.obs["phenograph"]), true)
+    ari_b = adjusted_rand_index(np.asarray(base.obs["leiden_like"]), true)
+    assert ari_p >= ari_b, (ari_p, ari_b)
+    assert ari_p > 0.4, f"phenograph ARI on counts fixture: {ari_p:.3f}"
