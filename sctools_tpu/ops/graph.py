@@ -244,7 +244,10 @@ def _symmetrized_weights(idx, w, block: int = 8192, mode: str = "average"):
     symmetric — fine for diffusion smoothing).
     "mutual": same average but one-sided edges are dropped — the
     resulting kernel is *exactly* symmetric, which the spectral path
-    requires.  The reverse-edge lookup is an (block, k, k) equality
+    requires.
+    "union": the probabilistic t-conorm ``w + w' - w·w'`` (UMAP's
+    fuzzy-set union; one-sided edges keep their weight).
+    The reverse-edge lookup is an (block, k, k) equality
     mask, chunked over rows so the full (n, k, k) never materialises."""
     n, k = idx.shape
     # Lookup tables padded with a sentinel row of -2s: a -1 neighbour
@@ -270,6 +273,8 @@ def _symmetrized_weights(idx, w, block: int = 8192, mode: str = "average"):
         has_rev = jnp.any(hit, axis=2)
         if mode == "mutual":
             return jnp.where(has_rev, 0.5 * (wblk + w_rev), 0.0)
+        if mode == "union":
+            return wblk + w_rev - wblk * w_rev
         return jnp.where(has_rev, 0.5 * (wblk + w_rev), wblk)
 
     out = jax.lax.map(per_block, (idx_p.reshape(nb, block, k),

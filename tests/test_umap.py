@@ -1,0 +1,72 @@
+"""embed.umap: the layout must separate clusters and preserve
+neighbourhood structure far better than the (noisy spectral) init."""
+
+import numpy as np
+import pytest
+
+import sctools_tpu as sct
+from sctools_tpu.data.synthetic import gaussian_blobs
+
+
+def _sep_ratio(y, labels):
+    """between-cluster / within-cluster mean centroid distance."""
+    y = np.asarray(y, np.float64)
+    cents = np.stack([y[labels == c].mean(0) for c in np.unique(labels)])
+    within = np.mean([np.linalg.norm(y[labels == c] - cents[i], axis=1).mean()
+                      for i, c in enumerate(np.unique(labels))])
+    d = np.linalg.norm(cents[:, None] - cents[None, :], axis=2)
+    between = d[np.triu_indices(len(cents), 1)].mean()
+    return between / max(within, 1e-12)
+
+
+@pytest.fixture(scope="module")
+def blob_knn():
+    pts, labels = gaussian_blobs(400, 10, n_clusters=4, spread=0.15, seed=11)
+    ds = sct.CellData(pts, obsm={"X_pca": pts},
+                      obs={"cluster_true": labels})
+    ds = sct.apply("neighbors.knn", ds, backend="tpu", k=15,
+                   metric="euclidean")
+    return ds, labels
+
+
+@pytest.mark.parametrize("backend", ["tpu", "cpu"])
+def test_umap_separates_blobs(blob_knn, backend):
+    ds, labels = blob_knn
+    out = sct.apply("embed.umap", ds, backend=backend, n_epochs=150,
+                    seed=0)
+    out = out.to_host()
+    y = np.asarray(out.obsm["X_umap"])[: len(labels)]
+    assert y.shape == (len(labels), 2)
+    assert np.isfinite(y).all()
+    ratio = _sep_ratio(y, labels)
+    assert ratio > 3.0, f"cluster separation too weak ({backend}): {ratio:.2f}"
+
+
+def test_umap_deterministic(blob_knn):
+    ds, labels = blob_knn
+    a = sct.apply("embed.umap", ds, backend="tpu", n_epochs=30,
+                  seed=3).to_host()
+    b = sct.apply("embed.umap", ds, backend="tpu", n_epochs=30,
+                  seed=3).to_host()
+    np.testing.assert_array_equal(a.obsm["X_umap"], b.obsm["X_umap"])
+
+
+def test_umap_3d_and_custom_init(blob_knn):
+    ds, labels = blob_knn
+    rng = np.random.default_rng(0)
+    init = rng.normal(size=(ds.n_cells, 3)).astype(np.float32)
+    out = sct.apply("embed.umap", ds, backend="tpu", n_dims=3,
+                    n_epochs=50, init=init, seed=0).to_host()
+    assert np.asarray(out.obsm["X_umap"]).shape[1] == 3
+    with pytest.raises(ValueError, match="init must have shape"):
+        sct.apply("embed.umap", ds, backend="tpu", n_dims=2, init=init)
+
+
+def test_fit_ab_matches_defaults():
+    from sctools_tpu.ops.umap import fit_ab
+
+    a, b = fit_ab(0.1, 1.0)
+    assert abs(a - 1.577) < 0.01 and abs(b - 0.895) < 0.01
+    a2, b2 = fit_ab(0.5, 1.0)
+    # larger min_dist → flatter curve near 0 → smaller a
+    assert a2 < a
