@@ -1,0 +1,270 @@
+"""``integrate.harmony`` — batch-effect correction in PCA space.
+
+Reference parity: dpeerlab/sctools ships batch integration (source
+unavailable — SURVEY.md §0; the algorithm is the published Harmony
+method: alternate (a) diversity-penalised soft k-means clustering of
+the cosine-normalised embedding with (b) a per-cluster ridge
+mixture-of-experts regression that subtracts the batch component).
+
+TPU design: harmonypy's reference loop updates soft assignments R in
+sequential random row blocks (data-dependent, host-driven).  Here both
+phases are fully synchronous linear algebra, jitted end to end:
+
+* assignment: the reference's incremental block updates of R are kept
+  (a fully synchronous R update turns out to equilibrate poorly — the
+  diversity penalty must see its own block's effect), but as a
+  ``lax.scan`` over ~20 *large* blocks: each block step is one MXU
+  matmul ``Zn_blk @ Cᵀ`` plus K×B co-occurrence bookkeeping
+  (O/E updated by two small matmuls), so the device stays busy while
+  the penalty stays self-consistent;
+* correction: the per-cluster design normal equations are accumulated
+  with chunked einsums (no (n, K, P) tensor ever materialises), the
+  K ridge systems solved batched with ``vmap(jnp.linalg.solve)`` on
+  (B+1)×(B+1) matrices, intercept row zeroed, and the correction
+  applied with one more chunked einsum.
+
+Both phases run a fixed number of rounds under ``lax.scan`` (XLA needs
+static trip counts; harmonypy's convergence test is an early-exit
+optimisation, not a semantic difference).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.dataset import CellData
+from ..registry import register
+
+_ROW_CHUNK = 8192
+
+
+def _l2norm(z, axis=1):
+    return z / jnp.maximum(jnp.linalg.norm(z, axis=axis, keepdims=True),
+                           1e-12)
+
+
+def _batch_onehot(batch: np.ndarray):
+    levels, codes = np.unique(np.asarray(batch), return_inverse=True)
+    onehot = np.zeros((len(codes), len(levels)), np.float32)
+    onehot[np.arange(len(codes)), codes] = 1.0
+    return onehot, levels
+
+
+@partial(jax.jit, static_argnames=("n_clusters", "n_rounds",
+                                   "n_cluster_iter"))
+def harmony_arrays(Z, phi, key, n_clusters: int, theta: float = 2.0,
+                   sigma: float = 0.1, lamb: float = 1.0,
+                   n_rounds: int = 10, n_cluster_iter: int = 10):
+    """Run Harmony.  Z: (n, d) embedding; phi: (n, B) one-hot batch.
+    Returns the corrected (n, d) embedding."""
+    n, d = Z.shape
+    B = phi.shape[1]
+    Z = jnp.asarray(Z, jnp.float32)
+    phi = jnp.asarray(phi, jnp.float32)
+    G = jnp.concatenate([jnp.ones((n, 1), jnp.float32), phi], axis=1)
+    P = B + 1
+    # ridge penalises the batch coefficients, never the intercept
+    ridge = lamb * jnp.diag(jnp.concatenate(
+        [jnp.zeros((1,)), jnp.ones((B,))]))
+
+    # block layout for the incremental R updates (~5% of cells per
+    # block, the reference's granularity; static shapes via padding —
+    # padded rows have phi == 0, so they never touch O/E)
+    n_blocks = max(1, min(20, -(-n // 128)))
+    bs = -(-n // n_blocks)
+    pad_r = n_blocks * bs - n
+    phi_p = (jnp.concatenate([phi, jnp.zeros((pad_r, B))]) if pad_r
+             else phi)
+
+    def cluster(Z_corr, R):
+        Zn = _l2norm(Z_corr)
+        Zn_p = (jnp.concatenate([Zn, jnp.zeros((pad_r, d))]) if pad_r
+                else Zn)
+        R_p = (jnp.concatenate([R, jnp.full((pad_r, R.shape[1]),
+                                            1.0 / R.shape[1])])
+               if pad_r else R)
+
+        def it(R_p, _):
+            C = _l2norm(R_p.T @ Zn_p)  # padded Zn rows are 0
+            O0 = R_p.T @ phi_p  # (K, B)
+
+            def block(O, inp):
+                Rb, phib, Znb = inp
+                O = O - Rb.T @ phib  # exclude this block
+                m_k = jnp.sum(O, axis=1)  # included cluster mass
+                n_b = jnp.sum(O, axis=0)  # included batch counts
+                n_inc = jnp.maximum(jnp.sum(n_b), 1.0)
+                E = m_k[:, None] * n_b[None, :] / n_inc
+                pen = theta * jnp.log((E + 1.0) / (O + 1.0))
+                dist = 2.0 * (1.0 - Znb @ C.T)
+                logits = -dist / sigma + phib @ pen.T
+                Rb = jax.nn.softmax(logits, axis=1)
+                return O + Rb.T @ phib, Rb
+
+            _, R_new = jax.lax.scan(
+                block, O0,
+                (R_p.reshape(n_blocks, bs, -1),
+                 phi_p.reshape(n_blocks, bs, B),
+                 Zn_p.reshape(n_blocks, bs, d)))
+            return R_new.reshape(n_blocks * bs, -1), None
+
+        R_p, _ = jax.lax.scan(it, R_p, None, length=n_cluster_iter)
+        return R_p[:n]
+
+    def correct(R):
+        """Mixture-of-experts ridge correction from the ORIGINAL Z."""
+        # normal equations per cluster, accumulated in row chunks
+        nb = -(-n // _ROW_CHUNK)
+        pad = nb * _ROW_CHUNK - n
+        Rp = jnp.concatenate([R, jnp.zeros((pad, R.shape[1]))]) if pad else R
+        Gp = jnp.concatenate([G, jnp.zeros((pad, P))]) if pad else G
+        Zp = jnp.concatenate([Z, jnp.zeros((pad, d))]) if pad else Z
+
+        def acc(carry, inp):
+            A, rhs = carry
+            r, g, z = inp
+            rg = r[:, :, None] * g[:, None, :]  # (chunk, K, P)
+            A = A + jnp.einsum("ckp,cq->kpq", rg, g)
+            rhs = rhs + jnp.einsum("ckp,cd->kpd", rg, z)
+            return (A, rhs), None
+
+        K = R.shape[1]
+        A0 = jnp.zeros((K, P, P))
+        r0 = jnp.zeros((K, P, d))
+        (A, rhs), _ = jax.lax.scan(
+            acc, (A0, r0),
+            (Rp.reshape(nb, _ROW_CHUNK, K), Gp.reshape(nb, _ROW_CHUNK, P),
+             Zp.reshape(nb, _ROW_CHUNK, d)))
+        W = jax.vmap(lambda a, r: jnp.linalg.solve(a + ridge, r))(A, rhs)
+        W = W.at[:, 0, :].set(0.0)  # keep the intercept (cluster mean)
+
+        def app(carry, inp):
+            r, g = inp
+            corr = jnp.einsum("ck,cp,kpd->cd", r, g, W)
+            return carry, corr
+
+        _, corr = jax.lax.scan(
+            app, None, (Rp.reshape(nb, _ROW_CHUNK, K),
+                        Gp.reshape(nb, _ROW_CHUNK, P)))
+        return Z - corr.reshape(-1, d)[:n]
+
+    # init: soft assignment against k-means++-lite centroids
+    from .cluster import kmeans_arrays
+
+    Zn0 = _l2norm(Z)
+    _, C0, _ = kmeans_arrays(Zn0, key, n_clusters=n_clusters, n_iter=10)
+    R = jax.nn.softmax(-2.0 * (1.0 - Zn0 @ _l2norm(C0).T) / sigma, axis=1)
+
+    def round_(carry, _):
+        Z_corr, R = carry
+        R = cluster(Z_corr, R)
+        Z_new = correct(R)
+        return (Z_new, R), None
+
+    (Z_corr, _), _ = jax.lax.scan(round_, (Z, R), None, length=n_rounds)
+    return Z_corr
+
+
+def harmony_numpy(Z, phi, n_clusters: int, theta: float = 2.0,
+                  sigma: float = 0.1, lamb: float = 1.0,
+                  n_rounds: int = 10, n_cluster_iter: int = 10,
+                  seed: int = 0):
+    """Independent numpy oracle of the same synchronous scheme."""
+    rng = np.random.default_rng(seed)
+    Z = np.asarray(Z, np.float64)
+    phi = np.asarray(phi, np.float64)
+    n, d = Z.shape
+    B = phi.shape[1]
+    G = np.concatenate([np.ones((n, 1)), phi], axis=1)
+    ridge = lamb * np.diag(np.concatenate([[0.0], np.ones(B)]))
+
+    def norm(z):
+        return z / np.maximum(np.linalg.norm(z, axis=1, keepdims=True),
+                              1e-12)
+
+    Zn0 = norm(Z)
+    C = Zn0[rng.choice(n, n_clusters, replace=False)]
+    logits = -2.0 * (1.0 - Zn0 @ norm(C).T) / sigma
+    R = np.exp(logits - logits.max(1, keepdims=True))
+    R /= R.sum(1, keepdims=True)
+    n_blocks = max(1, min(20, -(-n // 128)))
+    bounds = np.linspace(0, n, n_blocks + 1).astype(int)
+    Z_corr = Z.copy()
+    for _ in range(n_rounds):
+        Zn = norm(Z_corr)
+        for _ in range(n_cluster_iter):
+            C = norm(R.T @ Zn)
+            O = R.T @ phi
+            for lo, hi in zip(bounds[:-1], bounds[1:]):
+                O -= R[lo:hi].T @ phi[lo:hi]
+                m_k = O.sum(1)
+                n_b = O.sum(0)
+                E = np.outer(m_k, n_b) / max(n_b.sum(), 1.0)
+                pen = theta * np.log((E + 1.0) / (O + 1.0))
+                dist = 2.0 * (1.0 - Zn[lo:hi] @ C.T)
+                logits = -dist / sigma + phi[lo:hi] @ pen.T
+                Rb = np.exp(logits - logits.max(1, keepdims=True))
+                Rb /= Rb.sum(1, keepdims=True)
+                R[lo:hi] = Rb
+                O += Rb.T @ phi[lo:hi]
+        corr = np.zeros_like(Z)
+        for k in range(n_clusters):
+            rg = G * R[:, k: k + 1]
+            A = rg.T @ G + ridge
+            W = np.linalg.solve(A, rg.T @ Z)
+            W[0, :] = 0.0
+            corr += rg @ W
+        Z_corr = Z - corr
+    return Z_corr.astype(np.float32)
+
+
+def _resolve_harmony_inputs(data: CellData, batch_key: str, use_rep: str,
+                            n_clusters):
+    if batch_key not in data.obs:
+        raise ValueError(f"batch_key={batch_key!r} not in obs "
+                         f"({sorted(data.obs)})")
+    if use_rep not in data.obsm:
+        raise ValueError(f"use_rep={use_rep!r} not in obsm; run "
+                         "pca.randomized first")
+    n = data.n_cells
+    Z = np.asarray(data.obsm[use_rep])[:n]
+    onehot, levels = _batch_onehot(np.asarray(data.obs[batch_key])[:n])
+    if n_clusters is None:
+        n_clusters = int(min(100, max(2, round(n / 30))))
+    return Z, onehot, levels, n_clusters
+
+
+@register("integrate.harmony", backend="tpu")
+def harmony_tpu(data: CellData, batch_key: str = "batch",
+                use_rep: str = "X_pca", theta: float = 2.0,
+                sigma: float = 0.1, lamb: float = 1.0,
+                n_clusters: int | None = None, n_rounds: int = 10,
+                seed: int = 0) -> CellData:
+    """Adds obsm["X_harmony"] — the batch-corrected embedding."""
+    Z, onehot, levels, n_clusters = _resolve_harmony_inputs(
+        data, batch_key, use_rep, n_clusters)
+    out = harmony_arrays(
+        jnp.asarray(Z), jnp.asarray(onehot), jax.random.PRNGKey(seed),
+        n_clusters=n_clusters, theta=theta, sigma=sigma, lamb=lamb,
+        n_rounds=n_rounds)
+    return data.with_obsm(X_harmony=out).with_uns(
+        harmony_batches=levels, harmony_n_clusters=n_clusters)
+
+
+@register("integrate.harmony", backend="cpu")
+def harmony_cpu(data: CellData, batch_key: str = "batch",
+                use_rep: str = "X_pca", theta: float = 2.0,
+                sigma: float = 0.1, lamb: float = 1.0,
+                n_clusters: int | None = None, n_rounds: int = 10,
+                seed: int = 0) -> CellData:
+    Z, onehot, levels, n_clusters = _resolve_harmony_inputs(
+        data, batch_key, use_rep, n_clusters)
+    out = harmony_numpy(Z, onehot, n_clusters=n_clusters, theta=theta,
+                        sigma=sigma, lamb=lamb, n_rounds=n_rounds,
+                        seed=seed)
+    return data.with_obsm(X_harmony=out).with_uns(
+        harmony_batches=levels, harmony_n_clusters=n_clusters)

@@ -149,10 +149,37 @@ def umap_layout_numpy(idx, w, init, seed, n_epochs: int = 200,
     return y.astype(np.float32)
 
 
+def _sym_union_numpy(idx, w):
+    """Independent scipy implementation of the fuzzy-set union +
+    edge-multiplicity normalisation (the cpu oracle's counterpart of
+    ``_symmetrized_weights(mode="union")``)."""
+    import scipy.sparse as sp
+
+    n, k = idx.shape
+    rows = np.repeat(np.arange(n), k)
+    cols = idx.reshape(-1)
+    vals = np.asarray(w, np.float64).reshape(-1)
+    keep = cols >= 0
+    W = sp.csr_matrix((vals[keep], (rows[keep], cols[keep])), shape=(n, n))
+    U = (W + W.T - W.multiply(W.T)).tocsr()
+    WT = W.T.tocsr()
+    out = np.zeros(n * k)
+    mult = np.ones(n * k)
+    out[keep] = np.asarray(U[rows[keep], cols[keep]]).ravel()
+    mult[keep] += np.asarray(WT[rows[keep], cols[keep]]).ravel() > 0
+    return (out / mult).reshape(n, k).astype(np.float32)
+
+
 def _umap_prepare(data: CellData, backend: str, n_dims, min_dist, spread,
                   seed, init):
     """Shared graph/init/calibration prologue → (data, idx, w, init,
-    a, b); idx/w as numpy, symmetrised with the fuzzy union."""
+    a, b).  The fuzzy-union weight ``w_sym`` of every undirected edge
+    is divided by the number of directed entries carrying it: with the
+    Newton's-third-law reaction in the layout step, each endpoint then
+    receives exactly ``w_sym`` of attraction per epoch whether the
+    edge appears in one kNN list or both (matching the reference's
+    symmetric-CSR semantics).  TPU backend keeps everything on device;
+    cpu uses the independent scipy implementation."""
     from .graph import (_require_knn, _symmetrized_weights,
                         connectivities_cpu, connectivities_tpu)
 
@@ -163,7 +190,13 @@ def _umap_prepare(data: CellData, backend: str, n_dims, min_dist, spread,
     idx, _ = _require_knn(data)
     w = jnp.asarray(np.asarray(data.obsp["connectivities"],
                                np.float32)[:n])
-    w = _symmetrized_weights(idx, w, mode="union")
+    if backend == "tpu":
+        w_union = _symmetrized_weights(idx, w, mode="union")
+        w_mutual = _symmetrized_weights(idx, w, mode="mutual")
+        w = w_union / (1.0 + (w_mutual > 0))
+    else:
+        idx = np.asarray(idx)
+        w = _sym_union_numpy(idx, np.asarray(w))
     if init is None:
         init = _spectral_init(data, n_dims, seed, backend)
     else:
@@ -172,7 +205,7 @@ def _umap_prepare(data: CellData, backend: str, n_dims, min_dist, spread,
             raise ValueError(
                 f"init must have shape ({n}, {n_dims}), got {init.shape}")
     a, b = fit_ab(min_dist, spread)
-    return data, np.asarray(idx), np.asarray(w), init, a, b
+    return data, idx, w, init, a, b
 
 
 @register("embed.umap", backend="tpu")

@@ -1,0 +1,68 @@
+"""integrate.harmony: must mix batches (local batch diversity rises)
+while preserving biological cluster structure."""
+
+import numpy as np
+import pytest
+
+import sctools_tpu as sct
+from sctools_tpu.data.synthetic import gaussian_blobs
+from sctools_tpu.ops.knn import knn_numpy
+
+
+def _local_batch_mix(Z, batch, k=20):
+    """Mean fraction of each cell's kNN drawn from OTHER batches
+    (max = 1 - batch share; higher = better mixed)."""
+    idx, _ = knn_numpy(Z, Z, k=k + 1, metric="euclidean",
+                       exclude_self=True)
+    other = batch[idx[:, :k]] != batch[:, None]
+    return float(other.mean())
+
+
+@pytest.fixture(scope="module")
+def batched_blobs():
+    """Two batches of the same 4 clusters; batch 1 shifted by a
+    constant vector in embedding space (classic linear batch effect)."""
+    rng = np.random.default_rng(4)
+    pts, labels = gaussian_blobs(600, 20, n_clusters=4, spread=0.25,
+                                 seed=17)
+    batch = (rng.random(len(pts)) < 0.5).astype(np.int32)
+    shift = rng.normal(size=20).astype(np.float32)
+    shift = shift / np.linalg.norm(shift) * 2.0
+    pts = pts + batch[:, None] * shift[None, :]
+    ds = sct.CellData(
+        pts, obs={"batch": batch, "cluster_true": labels},
+        obsm={"X_pca": pts})
+    return ds, batch, labels
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_harmony_mixes_batches(batched_blobs, backend):
+    ds, batch, labels = batched_blobs
+    data = ds.device_put() if backend == "tpu" else ds
+    out = sct.apply("integrate.harmony", data, backend=backend,
+                    n_clusters=8, n_rounds=5, seed=0)
+    out = out.to_host() if backend == "tpu" else out
+    Z = np.asarray(out.obsm["X_harmony"])[: ds.n_cells]
+    assert Z.shape == ds.obsm["X_pca"].shape
+    assert np.isfinite(Z).all()
+    before = _local_batch_mix(np.asarray(ds.obsm["X_pca"]), batch)
+    after = _local_batch_mix(Z, batch)
+    assert after > max(before + 0.1, 0.35), (
+        f"harmony did not mix batches ({backend}): {before:.3f} -> "
+        f"{after:.3f} (balanced-batch ideal ≈ 0.5)")
+    # biology preserved: cluster centroids still separable
+    from sctools_tpu.ops.cluster import adjusted_rand_index, kmeans_cpu
+
+    km = kmeans_cpu(sct.CellData(Z, obsm={"X_pca": Z}), n_clusters=4,
+                    seed=1)
+    ari = adjusted_rand_index(np.asarray(km.obs["kmeans"]), labels)
+    assert ari > 0.8, f"harmony destroyed cluster structure: ARI {ari:.3f}"
+
+
+def test_harmony_validates_inputs(batched_blobs):
+    ds, _, _ = batched_blobs
+    with pytest.raises(ValueError, match="batch_key"):
+        sct.apply("integrate.harmony", ds, backend="cpu",
+                  batch_key="nope")
+    with pytest.raises(ValueError, match="use_rep"):
+        sct.apply("integrate.harmony", ds.replace(obsm={}), backend="cpu")
