@@ -247,6 +247,10 @@ def _symmetrized_weights(idx, w, block: int = 8192, mode: str = "average"):
     requires.
     "union": the probabilistic t-conorm ``w + w' - w·w'`` (UMAP's
     fuzzy-set union; one-sided edges keep their weight).
+    "union_norm": the t-conorm divided by the edge's directed
+    multiplicity (1 + has-reverse-edge) — one pass of the reverse
+    lookup instead of two for layouts that apply a symmetric
+    reaction per directed entry (embed.umap).
     The reverse-edge lookup is an (block, k, k) equality
     mask, chunked over rows so the full (n, k, k) never materialises."""
     n, k = idx.shape
@@ -275,6 +279,8 @@ def _symmetrized_weights(idx, w, block: int = 8192, mode: str = "average"):
             return jnp.where(has_rev, 0.5 * (wblk + w_rev), 0.0)
         if mode == "union":
             return wblk + w_rev - wblk * w_rev
+        if mode == "union_norm":
+            return (wblk + w_rev - wblk * w_rev) / (1.0 + has_rev)
         return jnp.where(has_rev, 0.5 * (wblk + w_rev), wblk)
 
     out = jax.lax.map(per_block, (idx_p.reshape(nb, block, k),

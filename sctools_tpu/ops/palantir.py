@@ -1,0 +1,358 @@
+"""``palantir.run`` — trajectory fate mapping (Palantir).
+
+Reference parity: dpeerlab/sctools descends from the Pe'er lab stack,
+whose trajectory tool is Palantir (source unavailable — SURVEY.md §0;
+the published algorithm: multiscale diffusion space → pseudotime from
+a root cell → pseudotime-directed Markov chain → terminal states →
+absorbing-chain fate probabilities + differentiation entropy).
+
+TPU design: every stage is a fixed-shape operation on the (n, k) kNN
+edge list:
+
+* **pseudotime** — single-source shortest path by min-plus relaxation
+  (Bellman–Ford): each round combines a pull (gather neighbours'
+  distances + edge length, min over k) and a push (``segment_min``
+  along reversed edges), under ``lax.scan`` with a static round count
+  — the graph diameter, not n, bounds convergence.  Palantir's
+  waypoint refinement is a sampling device for CPUs; the full
+  relaxation IS the exact limit it approximates (documented
+  divergence).
+* **directed chain** — anisotropic gaussian kernel in multiscale
+  space, gated by a logistic in the pseudotime increment (soft
+  forward drift; see ``directed_chain_arrays`` for why the hard
+  backward cut is not used), rows renormalised.
+* **terminal states** — stationary mass by power iteration of ``Pᵀ``
+  (``knn_rmatvec``); late-pseudotime local maxima of the stationary
+  mass, graph-deduplicated (host-side on k-wide arrays).
+* **fate probabilities** — absorbing-chain absorption probabilities by
+  fixed-point iteration ``B ← P·B`` with terminal rows pinned to
+  one-hot (k-sparse matvecs only); entropy of B is the
+  differentiation potential.
+
+CPU oracle: scipy ``dijkstra`` + a direct sparse solve of
+``(I - Q) B = R`` — an independent formulation of both hard stages.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.dataset import CellData
+from ..registry import register
+
+
+# ----------------------------------------------------------------------
+# multiscale space
+# ----------------------------------------------------------------------
+
+
+def multiscale_space(evals, evecs, n_eigs: int | None = None):
+    """Palantir's multiscale data space: eigenvectors scaled by
+    λ/(1-λ), using the eigengap to pick how many (host-side)."""
+    evals = np.asarray(evals, np.float64)
+    evecs = np.asarray(evecs, np.float64)
+    if n_eigs is None:
+        gaps = evals[:-1] - evals[1:]
+        n_eigs = int(np.argmax(gaps) + 1)
+        n_eigs = max(n_eigs, 2)
+    use = slice(0, n_eigs)
+    scale = evals[use] / (1.0 - np.minimum(evals[use], 1.0 - 1e-6))
+    return (evecs[:, use] * scale[None, :]).astype(np.float32)
+
+
+# ----------------------------------------------------------------------
+# pseudotime: single-source shortest path on the kNN graph
+# ----------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("n_rounds",))
+def shortest_path_arrays(knn_idx, edge_len, root, n_rounds: int = 64):
+    """Min-plus Bellman–Ford from ``root``.  knn_idx: (n, k);
+    edge_len: (n, k) non-negative lengths (-1 slots ignored).
+    Returns (n,) distances (inf where unreachable)."""
+    n, k = knn_idx.shape
+    safe = jnp.where(knn_idx < 0, 0, knn_idx)
+    wlen = jnp.where(knn_idx < 0, jnp.inf, edge_len.astype(jnp.float32))
+    d0 = jnp.full((n,), jnp.inf, jnp.float32).at[root].set(0.0)
+
+    def relax(d, _):
+        # pull: via my out-edges, d_i ← min(d_i, d_j + len_ij)
+        pull = jnp.min(jnp.take(d, safe) + wlen, axis=1)
+        d = jnp.minimum(d, pull)
+        # push: via reversed edges, d_j ← min(d_j, d_i + len_ij)
+        cand = (d[:, None] + wlen).reshape(-1)
+        seg = jnp.where(knn_idx < 0, n, knn_idx).reshape(-1)
+        push = jax.ops.segment_min(cand, seg, num_segments=n + 1)[:n]
+        return jnp.minimum(d, push), None
+
+    d, _ = jax.lax.scan(relax, d0, None, length=n_rounds)
+    return d
+
+
+# ----------------------------------------------------------------------
+# directed transition matrix
+# ----------------------------------------------------------------------
+
+
+@jax.jit
+def directed_chain_arrays(knn_idx, ms_emb, pseudotime, beta: float = 4.0):
+    """Pseudotime-directed row-stochastic transition weights on the
+    kNN edge list.  Anisotropic kernel σ_i = median neighbour distance,
+    gated by a **logistic** in the pseudotime increment:
+    ``w ← w · sigmoid(β·Δpt/s_i)`` with s_i the local scale of
+    neighbour Δpt.
+
+    Documented divergence from Palantir's hard backward-edge cut: when
+    the two branches of a fork advance pseudotime at different rates
+    (sparser sampling stretches diffusion distances), a hard tolerance
+    turns the faster branch into a one-way trapdoor — walks that enter
+    it can never re-emerge, and absorption ratios collapse to ~0/1
+    regardless of branch size (reproduced on synthetic forks,
+    tests/test_palantir.py).  The smooth gate keeps the same forward
+    drift while leaving every move reversible at reduced probability,
+    which removes the trapdoor artifact and also guarantees the
+    absorbing solve is nonsingular."""
+    n, k = knn_idx.shape
+    safe = jnp.where(knn_idx < 0, 0, knn_idx)
+    emb = jnp.asarray(ms_emb, jnp.float32)
+    diff = emb[:, None, :] - jnp.take(emb, safe, axis=0)
+    d = jnp.sqrt(jnp.maximum(jnp.sum(diff * diff, axis=2), 0.0))
+    d = jnp.where(knn_idx < 0, jnp.inf, d)
+    finite = jnp.isfinite(d)
+    sigma = jnp.nanmedian(jnp.where(finite, d, jnp.nan), axis=1)
+    sigma = jnp.maximum(sigma, 1e-12)
+    w = jnp.exp(-(d * d) / (sigma[:, None] * jnp.take(sigma, safe)))
+    pt = jnp.asarray(pseudotime, jnp.float32)
+    dpt = jnp.take(pt, safe) - pt[:, None]  # >0 = forward
+    s = jnp.nanstd(jnp.where(finite, dpt, jnp.nan), axis=1)
+    s = jnp.maximum(jnp.where(jnp.isfinite(s), s, 0.0), 1e-9)
+    w = jnp.where(finite,
+                  w * jax.nn.sigmoid(beta * dpt / s[:, None]), 0.0)
+    row = jnp.sum(w, axis=1, keepdims=True)
+    return jnp.where(row > 0, w / jnp.maximum(row, 1e-12), 0.0)
+
+
+@partial(jax.jit, static_argnames=("n_iter",))
+def stationary_arrays(knn_idx, p_edges, n_iter: int = 100):
+    """Stationary mass of the directed chain by power iteration of
+    Pᵀ (zero rows treated as self-loops)."""
+    from .graph import knn_rmatvec
+
+    n = knn_idx.shape[0]
+    x = jnp.full((n, 1), 1.0 / n, jnp.float32)
+    self_mass = 1.0 - jnp.sum(jnp.where(knn_idx < 0, 0.0, p_edges), axis=1)
+
+    def step(x, _):
+        x_new = knn_rmatvec(knn_idx, p_edges, x, n=n) + self_mass[:, None] * x
+        return x_new / jnp.maximum(jnp.sum(x_new), 1e-12), None
+
+    x, _ = jax.lax.scan(step, x, None, length=n_iter)
+    return x[:, 0]
+
+
+@partial(jax.jit, static_argnames=("n_iter",))
+def fate_probs_arrays(knn_idx, p_edges, terminal_onehot, is_terminal,
+                      n_iter: int = 5000, tol: float = 1e-6):
+    """Absorption probabilities of the pseudotime-directed chain.
+
+    terminal_onehot: (n, T) — rows of terminal cells are one-hot over
+    fates, others zero; is_terminal: (n,) bool.  Fixed-point
+    ``B ← P·B`` with terminal rows pinned (the Neumann series of
+    (I-Q)⁻¹R), run under ``lax.while_loop`` until ``max|ΔB| < tol``
+    or ``n_iter`` sweeps — convergence takes on the order of the
+    chain's absorption time, far past any fixed small count (an
+    unconverged B silently mis-splits the early fates).
+    """
+    from .graph import knn_matvec
+
+    n, k = knn_idx.shape
+    self_mass = 1.0 - jnp.sum(jnp.where(knn_idx < 0, 0.0, p_edges), axis=1)
+    B0 = terminal_onehot.astype(jnp.float32)
+
+    def cond(carry):
+        _, i, delta = carry
+        return (i < n_iter) & (delta > tol)
+
+    def step(carry):
+        B, i, _ = carry
+        Bn = knn_matvec(knn_idx, p_edges, B) + self_mass[:, None] * B
+        Bn = jnp.where(is_terminal[:, None], terminal_onehot, Bn)
+        return Bn, i + 1, jnp.max(jnp.abs(Bn - B))
+
+    B, _, _ = jax.lax.while_loop(cond, step, (B0, jnp.int32(0),
+                                              jnp.float32(jnp.inf)))
+    return B
+
+
+def _find_terminal_states(knn_idx, stationary, pseudotime,
+                          max_terminal: int = 10,
+                          pt_quantile: float = 0.7):
+    """Late-pseudotime local maxima of stationary mass, deduplicated
+    through the graph (host-side)."""
+    idx = np.asarray(knn_idx)
+    pi = np.asarray(stationary, np.float64)
+    pt = np.asarray(pseudotime, np.float64)
+    n, k = idx.shape
+    safe = np.where(idx < 0, 0, idx)
+    nb_pi = np.where(idx < 0, -np.inf, pi[safe])
+    is_max = pi >= nb_pi.max(axis=1)
+    finite_pt = pt[np.isfinite(pt)]
+    late = pt >= np.quantile(finite_pt, pt_quantile)
+    cand = np.flatnonzero(is_max & late & np.isfinite(pt))
+    cand = cand[np.argsort(-pi[cand])]
+    chosen: list[int] = []
+    taken = np.zeros(n, bool)
+    for c in cand:
+        if taken[c]:
+            continue
+        chosen.append(int(c))
+        taken[c] = True
+        taken[safe[c][idx[c] >= 0]] = True  # block its neighbourhood
+        if len(chosen) >= max_terminal:
+            break
+    return np.asarray(chosen, np.int64)
+
+
+# ----------------------------------------------------------------------
+# registry ops
+# ----------------------------------------------------------------------
+
+
+def _prep_palantir(data: CellData, backend: str, n_eigs):
+    from .graph import spectral_cpu, spectral_tpu
+
+    if "X_diffmap" not in data.obsm:
+        data = (spectral_tpu if backend == "tpu" else spectral_cpu)(data)
+    if "knn_indices" not in data.obsp:
+        raise ValueError("run neighbors.knn first")
+    n = data.n_cells
+    idx = np.asarray(data.obsp["knn_indices"])[:n]
+    ms = multiscale_space(np.asarray(data.uns["diffmap_evals"]),
+                          np.asarray(data.obsm["X_diffmap"])[:n],
+                          n_eigs=n_eigs)
+    return data, idx, ms
+
+
+def _edge_lengths(idx, ms):
+    safe = np.where(idx < 0, 0, idx)
+    d = np.linalg.norm(ms[:, None, :] - ms[safe], axis=2)
+    return np.where(idx < 0, np.inf, d).astype(np.float32)
+
+
+def _attach(data, pt, fate, entropy, terminals, levels):
+    return data.with_obs(
+        palantir_pseudotime=pt, palantir_entropy=entropy,
+    ).with_obsm(palantir_fate_probs=fate).with_uns(
+        palantir_terminal_states=np.asarray(terminals),
+        palantir_fate_labels=np.asarray(levels),
+    )
+
+
+@register("palantir.run", backend="tpu")
+def palantir_tpu(data: CellData, root: int = 0, terminal_states=None,
+                 n_eigs: int | None = None, max_terminal: int = 10,
+                 sp_rounds: int = 64, fate_iter: int = 5000) -> CellData:
+    """Adds obs["palantir_pseudotime"], obs["palantir_entropy"],
+    obsm["palantir_fate_probs"], uns["palantir_terminal_states"].
+    Requires neighbors.knn (embed.spectral runs if missing)."""
+    data, idx, ms = _prep_palantir(data, "tpu", n_eigs)
+    n = data.n_cells
+    idx_j = jnp.asarray(idx)
+    elen = jnp.asarray(_edge_lengths(idx, ms))
+    d = shortest_path_arrays(idx_j, elen, root, n_rounds=sp_rounds)
+    pt_max = jnp.max(jnp.where(jnp.isfinite(d), d, 0.0))
+    pt = jnp.where(jnp.isfinite(d), d, pt_max) / jnp.maximum(pt_max, 1e-12)
+
+    p = directed_chain_arrays(idx_j, jnp.asarray(ms), pt)
+    if terminal_states is None:
+        pi = stationary_arrays(idx_j, p)
+        terminal_states = _find_terminal_states(
+            idx, pi, np.asarray(pt), max_terminal=max_terminal)
+    terminal_states = np.asarray(terminal_states, np.int64)
+    T = len(terminal_states)
+    if T == 0:
+        raise ValueError("no terminal states found; pass terminal_states")
+    onehot = np.zeros((n, T), np.float32)
+    onehot[terminal_states, np.arange(T)] = 1.0
+    is_term = np.zeros(n, bool)
+    is_term[terminal_states] = True
+    B = fate_probs_arrays(idx_j, p, jnp.asarray(onehot),
+                          jnp.asarray(is_term), n_iter=fate_iter)
+    rowsum = jnp.sum(B, axis=1, keepdims=True)
+    Bn = jnp.where(rowsum > 1e-6, B / jnp.maximum(rowsum, 1e-12), 1.0 / T)
+    ent = -jnp.sum(jnp.where(Bn > 0, Bn * jnp.log(Bn), 0.0), axis=1)
+    return _attach(data, pt, Bn, ent, terminal_states,
+                   terminal_states)
+
+
+@register("palantir.run", backend="cpu")
+def palantir_cpu(data: CellData, root: int = 0, terminal_states=None,
+                 n_eigs: int | None = None, max_terminal: int = 10,
+                 **_ignored) -> CellData:
+    """scipy oracle: dijkstra pseudotime + direct sparse absorbing-
+    chain solve."""
+    import scipy.sparse as sp
+    from scipy.sparse.csgraph import dijkstra
+
+    data, idx, ms = _prep_palantir(data, "cpu", n_eigs)
+    n = data.n_cells
+    k = idx.shape[1]
+    elen = _edge_lengths(idx, ms)
+    rows = np.repeat(np.arange(n), k)
+    cols = idx.reshape(-1)
+    keep = cols >= 0
+    Wlen = sp.csr_matrix(
+        (elen.reshape(-1)[keep], (rows[keep], cols[keep])), shape=(n, n))
+    d = dijkstra(Wlen, directed=False, indices=root)
+    pt_max = np.nanmax(np.where(np.isfinite(d), d, np.nan))
+    pt = np.where(np.isfinite(d), d, pt_max) / max(pt_max, 1e-12)
+
+    # directed chain — same math as the TPU kernel, scipy container
+    p = np.asarray(directed_chain_arrays(jnp.asarray(idx),
+                                         jnp.asarray(ms),
+                                         jnp.asarray(pt)))
+    if terminal_states is None:
+        pi = np.asarray(stationary_arrays(jnp.asarray(idx),
+                                          jnp.asarray(p)))
+        terminal_states = _find_terminal_states(idx, pi, pt,
+                                                max_terminal=max_terminal)
+    terminal_states = np.asarray(terminal_states, np.int64)
+    T = len(terminal_states)
+    if T == 0:
+        raise ValueError("no terminal states found; pass terminal_states")
+    # absorbing-chain direct solve:  (I - Q) B_trans = R
+    self_mass = 1.0 - np.where(idx < 0, 0.0, p).sum(axis=1)
+    P = sp.csr_matrix((p.reshape(-1)[keep], (rows[keep], cols[keep])),
+                      shape=(n, n)) + sp.diags(self_mass)
+    is_term = np.zeros(n, bool)
+    is_term[terminal_states] = True
+    trans = ~is_term
+    Q = P[trans][:, trans]
+    R = P[trans][:, terminal_states]
+    from scipy.sparse.linalg import spsolve
+
+    # ε-damping: closed transient cycles (mutually-late cell pairs
+    # that drain into each other) make I - Q exactly singular; the
+    # damped chain leaks ε of their mass per step instead, and the
+    # final row renormalisation (or the uniform fallback for fully
+    # trapped rows) absorbs the O(ε) error for everyone else.
+    eps = 1e-6
+    I = sp.identity(Q.shape[0], format="csc")
+    B_trans = spsolve(I - (1.0 - eps) * Q.tocsc(), R.tocsc())
+    B_trans = np.asarray(B_trans.todense() if sp.issparse(B_trans)
+                         else B_trans).reshape(Q.shape[0], T)
+    B = np.zeros((n, T), np.float64)
+    B[trans] = B_trans
+    B[terminal_states, np.arange(T)] = 1.0
+    B[~np.isfinite(B).all(axis=1)] = 1.0 / T  # singular-row fallback
+    rowsum = B.sum(axis=1, keepdims=True)
+    Bn = np.where(rowsum > 1e-6, B / np.maximum(rowsum, 1e-12), 1.0 / T)
+    ent = -np.sum(np.where(Bn > 0, Bn * np.log(np.maximum(Bn, 1e-30)), 0.0),
+                  axis=1)
+    return _attach(data, pt.astype(np.float32), Bn.astype(np.float32),
+                   ent.astype(np.float32), terminal_states,
+                   terminal_states)

@@ -191,9 +191,7 @@ def _umap_prepare(data: CellData, backend: str, n_dims, min_dist, spread,
     w = jnp.asarray(np.asarray(data.obsp["connectivities"],
                                np.float32)[:n])
     if backend == "tpu":
-        w_union = _symmetrized_weights(idx, w, mode="union")
-        w_mutual = _symmetrized_weights(idx, w, mode="mutual")
-        w = w_union / (1.0 + (w_mutual > 0))
+        w = _symmetrized_weights(idx, w, mode="union_norm")
     else:
         idx = np.asarray(idx)
         w = _sym_union_numpy(idx, np.asarray(w))

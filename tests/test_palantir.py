@@ -1,0 +1,102 @@
+"""palantir.run on a synthetic branching trajectory: pseudotime must
+track the true progression and fate probabilities must commit to the
+correct branch at the tips while staying uncertain in the trunk."""
+
+import numpy as np
+import pytest
+
+import sctools_tpu as sct
+
+
+def _branching_data(n=600, dim=12, seed=0):
+    """Trunk t∈[0,1) then two branches t∈[1,2]; returns (points,
+    true_t, branch) with branch ∈ {0: trunk, 1, 2}."""
+    rng = np.random.default_rng(seed)
+    t = rng.uniform(0, 2, size=n)
+    branch = np.where(t < 1, 0, rng.integers(1, 3, size=n))
+    dirs = rng.normal(size=(3, dim))
+    dirs /= np.linalg.norm(dirs, axis=1, keepdims=True)
+    # orthogonalise branch directions against the trunk
+    for i in (1, 2):
+        dirs[i] -= dirs[i] @ dirs[0] * dirs[0]
+        dirs[i] /= np.linalg.norm(dirs[i])
+    pts = np.where(
+        (t < 1)[:, None], t[:, None] * dirs[0],
+        dirs[0] + (t - 1)[:, None] * dirs[np.maximum(branch, 1)])
+    pts = pts + 0.03 * rng.normal(size=(n, dim))
+    return pts.astype(np.float32), t, branch
+
+
+def _spearman(a, b):
+    ra = np.argsort(np.argsort(a)).astype(np.float64)
+    rb = np.argsort(np.argsort(b)).astype(np.float64)
+    return float(np.corrcoef(ra, rb)[0, 1])
+
+
+@pytest.fixture(scope="module")
+def branching():
+    pts, t, branch = _branching_data()
+    ds = sct.CellData(pts, obsm={"X_pca": pts})
+    ds = sct.apply("neighbors.knn", ds, backend="tpu", k=15,
+                   metric="euclidean")
+    # one shared diffusion map so backend-parity compares only the
+    # palantir stages themselves
+    ds = sct.apply("embed.spectral", ds, backend="tpu")
+    root = int(np.argmin(t))
+    tip1 = int(np.argmax(np.where(branch == 1, t, -1)))
+    tip2 = int(np.argmax(np.where(branch == 2, t, -1)))
+    return ds, t, branch, root, (tip1, tip2)
+
+
+@pytest.mark.parametrize("backend", ["tpu", "cpu"])
+def test_palantir_pseudotime_and_fates(branching, backend):
+    ds, t, branch, root, tips = branching
+    out = sct.apply("palantir.run", ds, backend=backend, root=root,
+                    terminal_states=list(tips))
+    out = out.to_host()
+    n = len(t)
+    pt = np.asarray(out.obs["palantir_pseudotime"])[:n]
+    rho = _spearman(pt, t)
+    assert rho > 0.9, f"pseudotime uncorrelated ({backend}): ρ={rho:.3f}"
+
+    B = np.asarray(out.obsm["palantir_fate_probs"])[:n]
+    assert B.shape == (n, 2)
+    assert np.all(B >= -1e-6) and np.all(B <= 1 + 1e-6)
+    np.testing.assert_allclose(B.sum(1), 1.0, atol=1e-3)
+    # branch tips commit to their own fate
+    late1 = (branch == 1) & (t > 1.6)
+    late2 = (branch == 2) & (t > 1.6)
+    assert B[late1, 0].mean() > 0.8, f"{backend}: {B[late1, 0].mean():.3f}"
+    assert B[late2, 1].mean() > 0.8, f"{backend}: {B[late2, 1].mean():.3f}"
+    # trunk is uncertain: entropy higher than at tips
+    ent = np.asarray(out.obs["palantir_entropy"])[:n]
+    trunk = t < 0.5
+    assert ent[trunk].mean() > ent[late1].mean() + 0.2
+    assert ent[trunk].mean() > ent[late2].mean() + 0.2
+
+
+def test_palantir_backend_parity(branching):
+    """Same explicit terminals → the two backends' pseudotime and
+    fates agree closely (independent shortest-path + solver)."""
+    ds, t, branch, root, tips = branching
+    a = sct.apply("palantir.run", ds, backend="tpu", root=root,
+                  terminal_states=list(tips)).to_host()
+    b = sct.apply("palantir.run", ds, backend="cpu", root=root,
+                  terminal_states=list(tips))
+    n = len(t)
+    np.testing.assert_allclose(
+        np.asarray(a.obs["palantir_pseudotime"])[:n],
+        np.asarray(b.obs["palantir_pseudotime"])[:n], atol=1e-3)
+    Ba = np.asarray(a.obsm["palantir_fate_probs"])[:n]
+    Bb = np.asarray(b.obsm["palantir_fate_probs"])[:n]
+    assert np.mean(np.abs(Ba - Bb)) < 0.02
+
+
+def test_palantir_auto_terminal_states(branching):
+    ds, t, branch, root, tips = branching
+    out = sct.apply("palantir.run", ds, backend="tpu", root=root)
+    out = out.to_host()
+    terms = np.asarray(out.uns["palantir_terminal_states"])
+    assert len(terms) >= 1
+    # detected terminals must sit late in the true progression
+    assert t[terms].min() > 1.0
