@@ -40,6 +40,19 @@ class Config:
     # "auto" => interpret unless the default backend is a real TPU.
     pallas_interpret: str = "auto"
 
+    # kNN search implementation: "xla" (blocked lax.top_k merge),
+    # "pallas" (fused distance+top-k kernel, ops/pallas_knn.py), or
+    # "auto" (pallas on real TPU — ~3x faster at atlas scale — and
+    # xla elsewhere, since interpret-mode pallas is debug-speed).
+    knn_impl: str = "auto"
+
+    def resolved_knn_impl(self) -> str:
+        if self.knn_impl == "auto":
+            # pallas only when it will actually compile — interpret
+            # mode (off-TPU or forced) is debug-speed
+            return "xla" if self.interpret_mode() else "pallas"
+        return self.knn_impl
+
     # Capacity rounding for the padded-ELL sparse format.
     capacity_multiple: int = 128
 

@@ -74,14 +74,23 @@ def knn_arrays(
     n_query = n_query or query.shape[0]
     n_cand = n_cand or cand.shape[0]
     k_search = max(k, refine) if refine else k
-    idx, dist = _knn_jit(
-        query, cand, k=k_search, metric=metric,
-        n_query=n_query, n_cand=n_cand,
-        qb=query_block or config.row_block,
-        cb=cand_block or config.col_block,
-        mm_dtype=str(jnp.dtype(config.matmul_dtype)),
-        exclude_self=exclude_self,
-    )
+    if config.resolved_knn_impl() == "pallas":
+        from .pallas_knn import pallas_knn_arrays
+
+        idx, dist = pallas_knn_arrays(
+            query, cand, k=k_search, metric=metric,
+            n_query=n_query, n_cand=n_cand, query_block=query_block,
+            cand_block=cand_block, exclude_self=exclude_self,
+        )
+    else:
+        idx, dist = _knn_jit(
+            query, cand, k=k_search, metric=metric,
+            n_query=n_query, n_cand=n_cand,
+            qb=query_block or config.row_block,
+            cb=cand_block or config.col_block,
+            mm_dtype=str(jnp.dtype(config.matmul_dtype)),
+            exclude_self=exclude_self,
+        )
     if refine:
         # Any refine > 0 runs the exact pass — even refine <= k still
         # re-scores the k candidates in f32 (caller asked for exact
@@ -180,7 +189,15 @@ def _refine_jit(query, cand, cand_idx, *, k, metric, qb):
     coarse search (may contain -1 padding).  Returns (idx, dist) of
     the top ``k`` by exact score.  Chunked over query blocks.
     """
-    nq_pad = cand_idx.shape[0]
+    # the coarse search may have padded queries to a different block
+    # multiple than qb (the pallas impl uses its own tile size) — pad
+    # to the lcm-ish multiple here so the reshape below is exact
+    nq_pad = round_up(cand_idx.shape[0], qb)
+    if nq_pad > cand_idx.shape[0]:
+        cand_idx = jnp.concatenate(
+            [cand_idx,
+             jnp.full((nq_pad - cand_idx.shape[0], cand_idx.shape[1]), -1,
+                      cand_idx.dtype)])
     d = query.shape[1]
     kp = cand_idx.shape[1]
     q = jnp.zeros((nq_pad, d), jnp.float32).at[: query.shape[0]].set(

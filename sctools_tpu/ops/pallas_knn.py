@@ -1,0 +1,174 @@
+"""Fused distance + top-k Pallas kernel for ``neighbors.knn``.
+
+Reference parity: the reference framework's kNN hot loop is a custom
+CUDA kernel (source unavailable — SURVEY.md §0); this is its TPU
+counterpart, written against the Mosaic/Pallas TPU programming model
+(/opt/skills/guides/pallas_guide.md).
+
+Design: one grid cell per (query-block i, candidate-block j), with j
+the fastest-varying grid dimension.  Each cell
+
+1. computes the (QB, CB) similarity tile ``Q_i @ C_jᵀ`` on the MXU
+   (bfloat16 inputs, float32 accumulation);
+2. merges the tile into a per-query running top-k held in **VMEM
+   scratch** that persists across the j sweep — a k-step selection
+   loop (max + first-argmax + mask), all VPU work on 2-D tiles;
+3. on the last j writes the merged (QB, K_PAD) values/indices out.
+
+Versus the XLA path (ops/knn.py) the score tile never round-trips to
+HBM and no (QB, k+CB) sort runs per tile — the merge touches each
+score exactly k times in registers/VMEM.  Off-TPU the kernel runs in
+interpreter mode (config.pallas_interpret), which is how the CPU test
+suite exercises it; numerics are identical to the XLA path up to
+matmul precision.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..config import config, round_up
+
+_NEG = float("-inf")  # plain float: jax-array constants cannot be captured by kernels
+
+
+def _knn_kernel(q_ref, c_ref, out_v_ref, out_i_ref, acc_v, acc_i, *,
+                k: int, qb: int, cb: int, k_pad: int, n_cand: int,
+                metric: str, exclude_self: bool, precision):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        acc_v[:] = jnp.full((qb, k_pad), _NEG, jnp.float32)
+        acc_i[:] = jnp.full((qb, k_pad), -1, jnp.int32)
+
+    q = q_ref[:]  # (qb, d)
+    c = c_ref[:]  # (cb, d)
+    s = jnp.dot(q, c.T, preferred_element_type=jnp.float32,
+                precision=precision)  # MXU
+    if metric == "euclidean":
+        qn2 = jnp.sum(q.astype(jnp.float32) ** 2, axis=1, keepdims=True)
+        cn2 = jnp.sum(c.astype(jnp.float32) ** 2, axis=1, keepdims=True)
+        s = -(qn2 - 2.0 * s + cn2.T)
+    col = jax.lax.broadcasted_iota(jnp.int32, (qb, cb), 1)
+    gcol = j * cb + col  # (qb, cb) global candidate ids
+    s = jnp.where(gcol >= n_cand, _NEG, s)
+    if exclude_self:
+        i = pl.program_id(0)
+        grow = i * qb + jax.lax.broadcasted_iota(jnp.int32, (qb, cb), 0)
+        s = jnp.where(gcol == grow, _NEG, s)
+
+    # merge: k-step selection over the union of the running top-k and
+    # the fresh tile.  Values/ids are captured before the in-place
+    # scratch writes below, so the loop reads a consistent snapshot.
+    A = jnp.concatenate([acc_v[:], s], axis=1)  # (qb, k_pad + cb)
+    I = jnp.concatenate([acc_i[:], gcol], axis=1)
+    width = k_pad + cb
+    allcol = jax.lax.broadcasted_iota(jnp.int32, (qb, width), 1)
+    big = jnp.int32(width)
+    for t in range(k):
+        vmax = jnp.max(A, axis=1)  # (qb,)
+        sel = jnp.min(jnp.where(A >= vmax[:, None], allcol, big), axis=1)
+        hit = allcol == sel[:, None]
+        ival = jnp.sum(jnp.where(hit, I, 0), axis=1)
+        acc_v[:, t] = vmax
+        acc_i[:, t] = jnp.where(jnp.isfinite(vmax), ival, -1)
+        A = jnp.where(hit, _NEG, A)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        out_v_ref[:] = acc_v[:]
+        out_i_ref[:] = acc_i[:]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "metric", "n_query", "n_cand", "qb", "cb",
+                     "mm_dtype", "exclude_self", "interpret", "lane"),
+)
+def _pallas_knn_jit(query, cand, *, k, metric, n_query, n_cand, qb, cb,
+                    mm_dtype, exclude_self, interpret, lane):
+    from .knn import _prep
+
+    mm_dtype = jnp.dtype(mm_dtype)
+    d_pad = round_up(query.shape[1], lane)
+    nq_pad = round_up(n_query, qb)
+    nc_pad = round_up(n_cand, cb)
+    k_pad = round_up(k, lane)
+
+    q = jnp.zeros((nq_pad, d_pad), jnp.float32)
+    q = q.at[: query.shape[0], : query.shape[1]].set(
+        query.astype(jnp.float32))
+    c = jnp.zeros((nc_pad, d_pad), jnp.float32)
+    c = c.at[: cand.shape[0], : cand.shape[1]].set(cand.astype(jnp.float32))
+    q = _prep(q, metric, mm_dtype)
+    c = _prep(c, metric, mm_dtype)
+
+    grid = (nq_pad // qb, nc_pad // cb)
+    # float32 inputs need HIGHEST or the MXU drops to bf16 passes
+    # (same convention as ops/knn.py)
+    precision = (jax.lax.Precision.HIGHEST if mm_dtype == jnp.float32
+                 else jax.lax.Precision.DEFAULT)
+    kernel = functools.partial(
+        _knn_kernel, k=k, qb=qb, cb=cb, k_pad=k_pad, n_cand=n_cand,
+        metric=metric, exclude_self=exclude_self, precision=precision)
+    vals, idxs = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((qb, d_pad), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((cb, d_pad), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((qb, k_pad), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((qb, k_pad), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((nq_pad, k_pad), jnp.float32),
+            jax.ShapeDtypeStruct((nq_pad, k_pad), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((qb, k_pad), jnp.float32),
+            pltpu.VMEM((qb, k_pad), jnp.int32),
+        ],
+        interpret=interpret,
+    )(q, c)
+    vals = vals[:, :k]
+    idxs = idxs[:, :k]
+    dists = (1.0 - vals) if metric == "cosine" else jnp.sqrt(
+        jnp.maximum(-vals, 0.0))
+    qvalid = jnp.arange(nq_pad) < n_query
+    idxs = jnp.where(qvalid[:, None], idxs, -1)
+    return idxs, dists
+
+
+def pallas_knn_arrays(query, cand, *, k: int = 15, metric: str = "cosine",
+                      n_query: int | None = None, n_cand: int | None = None,
+                      query_block: int | None = None,
+                      cand_block: int | None = None,
+                      exclude_self: bool = False):
+    """Drop-in counterpart of ``knn.knn_arrays`` (coarse search only —
+    compose with ``knn._refine_jit`` for the exact re-rank)."""
+    if metric not in ("cosine", "euclidean"):
+        raise ValueError(f"unknown metric {metric!r}")
+    n_query = n_query or query.shape[0]
+    n_cand = n_cand or cand.shape[0]
+    return _pallas_knn_jit(
+        query, cand, k=k, metric=metric, n_query=n_query, n_cand=n_cand,
+        qb=query_block or min(config.row_block, 256),
+        cb=cand_block or min(config.col_block, 1024),
+        mm_dtype=str(jnp.dtype(config.matmul_dtype)),
+        exclude_self=exclude_self,
+        interpret=config.interpret_mode(),
+        lane=config.lane,
+    )
