@@ -1,0 +1,339 @@
+"""Out-of-core streaming pipeline: atlas-scale matrices that do not
+fit in HBM.
+
+Reference parity: the reference framework streams AnnData CSR shards
+through its preprocessing + kNN build (BASELINE.json north star: 10M
+cells × 30k genes); its loader is native C++ (source unavailable —
+SURVEY.md §0).
+
+TPU design: the *sparse counts* are the only thing that doesn't fit —
+at 10M cells the skinny dense iterates of randomized PCA ((n, ~60)
+float32 ≈ 2.4 GB) and the final (n, 50) scores sit comfortably in HBM.
+So the streaming decomposition is:
+
+* **one stats pass** over h5ad CSR shards: each shard is packed to
+  padded-ELL (native C++ packer), device_put, library-normalised +
+  log1p'd, and reduced — per-cell QC metrics and per-gene
+  (Σ, Σ², nnz) accumulate on device while the next shard loads (jax
+  async dispatch overlaps the host IO with device compute);
+* **HVG selection** from the accumulated per-gene moments
+  (dispersion flavor — the normalised-variance ranking computable
+  from one streaming pass);
+* **streaming randomized PCA**: the power iteration's tall-skinny
+  iterates Y/Q stay device-resident; each (re-)materialisation of
+  ``Y = X_c @ Q`` / ``Z = X_cᵀ @ Q`` streams the HVG-subset shards
+  through the fused subset→normalise→centered-matvec kernel.
+  CholeskyQR2 orthonormalisation works on the device-resident Y —
+  the same math as ops/pca.py, so single-chip and streaming paths
+  agree to float tolerance;
+* **kNN** on the device-resident scores via the standard blocked /
+  Pallas search (ops/knn.py) — no extra streaming needed.
+
+The full count matrix never exists in memory; peak host usage is one
+shard, peak device usage is the skinny iterates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Callable, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import config, round_up
+from .sparse import SparseCells, gene_stats, spmm, spmm_t
+
+
+# ----------------------------------------------------------------------
+# Shard sources
+# ----------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ShardSource:
+    """A re-iterable source of (row_offset, device SparseCells) shards
+    with uniform shapes (one compiled program serves every shard)."""
+
+    factory: Callable[[], Iterator[SparseCells]]
+    n_cells: int
+    n_genes: int
+    shard_rows: int
+
+    def __iter__(self):
+        offset = 0
+        for shard in self.factory():
+            yield offset, shard.device_put()
+            offset += shard.n_cells
+
+    @property
+    def n_shards(self) -> int:
+        return -(-self.n_cells // self.shard_rows)
+
+    @classmethod
+    def from_h5ad(cls, path: str, shard_rows: int = 65536,
+                  capacity: int | None = None) -> "ShardSource":
+        import h5py
+
+        from .io import shard_iter
+
+        # intermediate shards must have rows_padded == n_cells so row
+        # offsets stay aligned across shards
+        shard_rows = round_up(shard_rows, config.sublane)
+
+        with h5py.File(path, "r") as h5:
+            node = h5["X"]
+            if hasattr(node, "attrs") and "shape" in node.attrs:
+                n, g = tuple(node.attrs["shape"])
+                if capacity is None and "indptr" in node:
+                    # exact global max nnz/row from the indptr alone —
+                    # no data read, and no risk of a later shard
+                    # exceeding a first-shard estimate mid-stream
+                    nnz_max = int(np.diff(node["indptr"][...]).max())
+                    capacity = round_up(max(nnz_max, 1),
+                                        config.capacity_multiple)
+            else:
+                n, g = node.shape
+                if capacity is None:
+                    # dense h5ad: any row may be fully dense
+                    capacity = round_up(int(g), config.capacity_multiple)
+        return cls(lambda: shard_iter(path, shard_rows, capacity=capacity),
+                   int(n), int(g), shard_rows)
+
+    @classmethod
+    def from_scipy(cls, X, shard_rows: int = 65536,
+                   capacity: int | None = None) -> "ShardSource":
+        """In-memory CSR source (tests / moderate sizes)."""
+        X = X.tocsr()
+        n, g = X.shape
+        shard_rows = round_up(shard_rows, config.sublane)
+        if capacity is None:
+            nnz_max = int(np.diff(X.indptr).max()) if X.nnz else 1
+            capacity = round_up(max(nnz_max, 1), config.capacity_multiple)
+
+        def factory():
+            for s in range(0, n, shard_rows):
+                yield SparseCells.from_scipy_csr(
+                    X[s: s + shard_rows], capacity=capacity)
+
+        return cls(factory, n, g, shard_rows)
+
+
+# ----------------------------------------------------------------------
+# Pass 1: QC + per-gene stats of the normalised log matrix
+# ----------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("target_sum",))
+def _shard_stats(x: SparseCells, mito_mask, target_sum: float):
+    """Per-shard: (per-cell totals, n_genes, pct_mito;
+    per-gene Σ/Σ²/nnz of log1p-normalised values)."""
+    from ..ops.normalize import _library_size_sparse
+
+    totals = jnp.sum(x.data, axis=1)
+    n_genes_cell = x.nnz_per_row()
+    mito_pad = jnp.concatenate([mito_mask.astype(x.data.dtype),
+                                jnp.zeros((1,), x.data.dtype)])
+    mito_counts = jnp.sum(
+        x.data * jnp.take(mito_pad, x.indices), axis=1)
+    pct_mito = jnp.where(totals > 0, 100.0 * mito_counts /
+                         jnp.maximum(totals, 1e-12), 0.0)
+    xs, _ = _library_size_sparse(x, target_sum)
+    xn = xs.with_data(jnp.log1p(xs.data))
+    s, ss, nnz = gene_stats(xn)
+    return totals, n_genes_cell, pct_mito, jnp.stack([s, ss, nnz], axis=1)
+
+
+def stream_stats(src: ShardSource, target_sum: float = 1e4,
+                 mito_mask: np.ndarray | None = None) -> dict:
+    """One pass: per-cell QC metrics (host) + per-gene moments of the
+    normalised log matrix (device accumulator)."""
+    if mito_mask is None:
+        mito_mask = np.zeros(src.n_genes, bool)
+    mito = jnp.asarray(mito_mask)
+    acc = jnp.zeros((src.n_genes, 3), jnp.float32)
+    totals, ngenes, pct = [], [], []
+    for offset, shard in src:
+        t, g, m, stats = _shard_stats(shard, mito, target_sum)
+        acc = acc + stats
+        n = shard.n_cells
+        # keep DEVICE arrays here — np.asarray would sync and
+        # serialise host IO with device compute; one fetch after the
+        # loop preserves the async-dispatch overlap
+        totals.append(t[:n])
+        ngenes.append(g[:n])
+        pct.append(m[:n])
+    totals = [np.asarray(t) for t in totals]
+    ngenes = [np.asarray(g) for g in ngenes]
+    pct = [np.asarray(m) for m in pct]
+    s, ss, nnz = np.asarray(acc).T
+    n = src.n_cells
+    mean = s / n
+    var = np.maximum((ss - n * mean**2) / max(n - 1, 1), 0.0)
+    return {
+        "total_counts": np.concatenate(totals),
+        "n_genes": np.concatenate(ngenes),
+        "pct_counts_mt": np.concatenate(pct),
+        "gene_mean": mean,
+        "gene_var": var,
+        "gene_nnz": nnz,
+        "n_cells": n,
+    }
+
+
+def stream_hvg(stats: dict, n_top: int = 2000) -> np.ndarray:
+    """Dispersion-flavor HVG ranking from streamed moments (the
+    seurat_v3 flavor needs a second clipped pass; dispersion is the
+    one-pass ranking — documented divergence for the streaming path).
+    Returns sorted gene indices."""
+    from ..ops.hvg import _dispersion_scores
+
+    scores = _dispersion_scores(stats["gene_mean"].astype(np.float64),
+                                stats["gene_var"].astype(np.float64), np)
+    order = np.argsort(-scores)[:n_top]
+    return np.sort(order)
+
+
+# ----------------------------------------------------------------------
+# Streaming randomized PCA
+# ----------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("target_sum", "g_sub"))
+def _shard_matvec(x: SparseCells, mapping, mu, V, target_sum: float,
+                  g_sub: int):
+    """Fused subset→normalise→log1p→centered ``X_c @ V`` for one shard.
+    mapping: (n_genes+1,) old→new gene id (dropped → g_sub sentinel).
+    Returns (rows_padded, L) with padding rows zeroed."""
+    from ..ops.normalize import _library_size_sparse
+
+    xs, _ = _library_size_sparse(x, target_sum)  # totals over ALL genes
+    xn = xs.with_data(jnp.log1p(xs.data))
+    sub = SparseCells(jnp.take(mapping, xn.indices), xn.data,
+                      xn.n_cells, g_sub)
+    sub = sub.with_data(jnp.where(sub.indices == g_sub, 0.0, sub.data))
+    out = spmm(sub, V) - (mu @ V)[None, :]
+    return jnp.where(sub.row_mask()[:, None], out, 0.0)
+
+
+@partial(jax.jit, static_argnames=("target_sum", "g_sub"))
+def _shard_rmatvec(x: SparseCells, mapping, mu, Q, target_sum: float,
+                   g_sub: int):
+    """Fused centered ``X_cᵀ @ Q`` for one shard (padded rows of Q
+    must be zero)."""
+    from ..ops.normalize import _library_size_sparse
+
+    xs, _ = _library_size_sparse(x, target_sum)
+    xn = xs.with_data(jnp.log1p(xs.data))
+    sub = SparseCells(jnp.take(mapping, xn.indices), xn.data,
+                      xn.n_cells, g_sub)
+    sub = sub.with_data(jnp.where(sub.indices == g_sub, 0.0, sub.data))
+    Qm = jnp.where(sub.row_mask()[:, None], Q, 0.0)
+    colsum = jnp.sum(Qm, axis=0)
+    return spmm_t(sub, Qm) - jnp.outer(mu, colsum)
+
+
+def _assemble_rows(blocks, n_rows):
+    """Stack per-shard (rows_padded, L) device blocks into one
+    device-resident (n_rows, L) array."""
+    trimmed = []
+    got = 0
+    for b in blocks:
+        take = min(b.shape[0], n_rows - got)
+        trimmed.append(b[:take])
+        got += take
+    return jnp.concatenate(trimmed, axis=0)
+
+
+def stream_pca(src: ShardSource, gene_idx: np.ndarray,
+               gene_mean: np.ndarray, key, n_components: int = 50,
+               oversample: int = 10, n_iter: int = 2,
+               target_sum: float = 1e4):
+    """Streaming randomized PCA on the HVG-subset normalised matrix.
+
+    gene_mean: per-gene means of the FULL normalised matrix (from
+    stream_stats) — the subset's centering vector is gene_mean[gene_idx].
+    Returns (scores (n, k) device, components (g_sub, k), explained (k,)).
+    """
+    from ..ops.pca import cholesky_qr
+
+    gene_idx = np.asarray(gene_idx)
+    g_sub = len(gene_idx)
+    mapping = np.full(src.n_genes + 1, g_sub, np.int32)
+    mapping[gene_idx] = np.arange(g_sub, dtype=np.int32)
+    mapping = jnp.asarray(mapping)
+    mu = jnp.asarray(gene_mean[gene_idx].astype(np.float32))
+    L = n_components + oversample
+
+    def matvec_all(V):
+        return _assemble_rows(
+            [_shard_matvec(sh, mapping, mu, V, target_sum, g_sub)
+             for _, sh in src], src.n_cells)
+
+    def rmatvec_all(Q):
+        acc = jnp.zeros((g_sub, Q.shape[1]), jnp.float32)
+        for offset, sh in src:
+            # rows of Q beyond this shard's n_cells (its row padding)
+            # belong to the next shard, but _shard_rmatvec masks by
+            # row_mask so they contribute nothing here
+            q_blk = Q[offset: offset + sh.rows_padded]
+            if q_blk.shape[0] < sh.rows_padded:  # dataset-end padding
+                q_blk = jnp.concatenate(
+                    [q_blk, jnp.zeros((sh.rows_padded - q_blk.shape[0],
+                                       Q.shape[1]))])
+            acc = acc + _shard_rmatvec(sh, mapping, mu, q_blk,
+                                       target_sum, g_sub)
+        return acc
+
+    omega = jax.random.normal(key, (g_sub, L), jnp.float32)
+    Q = cholesky_qr(matvec_all(omega))
+    for _ in range(n_iter):
+        Qz = cholesky_qr(rmatvec_all(Q))
+        Q = cholesky_qr(matvec_all(Qz))
+    B = rmatvec_all(Q).T  # (L, g_sub)
+    U_b, S, Vt = jnp.linalg.svd(B, full_matrices=False)
+    k = n_components
+    scores = (Q @ U_b[:, :k]) * S[:k]
+    components = Vt[:k].T
+    explained = (S[:k] ** 2) / max(src.n_cells - 1, 1)
+    return scores, components, explained
+
+
+# ----------------------------------------------------------------------
+# End-to-end streaming pipeline
+# ----------------------------------------------------------------------
+
+
+def stream_pipeline(src: ShardSource, *, n_top: int = 2000,
+                    n_components: int = 50, k: int = 15,
+                    metric: str = "cosine", target_sum: float = 1e4,
+                    mito_mask: np.ndarray | None = None, seed: int = 0,
+                    refine: int = 64) -> dict:
+    """h5ad shards → QC → HVG → 50-PC randomized PCA → kNN, out of
+    core (BASELINE.json configs[4] shape).  Returns a dict:
+    obs metrics (host), hvg_genes, X_pca (device), knn indices and
+    distances (device, padded rows -1)."""
+    from ..ops.knn import knn_arrays
+
+    stats = stream_stats(src, target_sum=target_sum, mito_mask=mito_mask)
+    hvg_genes = stream_hvg(stats, n_top=n_top)
+    scores, comps, expl = stream_pca(
+        src, hvg_genes, stats["gene_mean"], jax.random.PRNGKey(seed),
+        n_components=n_components, target_sum=target_sum)
+    idx, dist = knn_arrays(scores, scores, k=k, metric=metric,
+                           n_query=src.n_cells, n_cand=src.n_cells,
+                           refine=refine)
+    return {
+        "obs": {"total_counts": stats["total_counts"],
+                "n_genes": stats["n_genes"],
+                "pct_counts_mt": stats["pct_counts_mt"]},
+        "hvg_genes": hvg_genes,
+        "X_pca": scores,
+        "pca_components": comps,
+        "pca_explained_variance": expl,
+        "knn_indices": idx,
+        "knn_distances": dist,
+        "n_cells": src.n_cells,
+    }

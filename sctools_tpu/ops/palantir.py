@@ -264,6 +264,21 @@ def palantir_tpu(data: CellData, root: int = 0, terminal_states=None,
     idx_j = jnp.asarray(idx)
     elen = jnp.asarray(_edge_lengths(idx, ms))
     d = shortest_path_arrays(idx_j, elen, root, n_rounds=sp_rounds)
+    # silent non-convergence check: cells beyond the relaxation horizon
+    # keep d=inf, clamp to pt=1.0 and would masquerade as terminal
+    # states — retry with a deeper sweep, then warn about genuinely
+    # unreachable (disconnected) cells
+    if not bool(jnp.all(jnp.isfinite(d))):
+        d = shortest_path_arrays(idx_j, elen, root, n_rounds=4 * sp_rounds)
+        n_inf = int(jnp.sum(~jnp.isfinite(d)))
+        if n_inf:
+            import warnings
+
+            warnings.warn(
+                f"palantir: {n_inf} cells unreachable from root {root} "
+                f"after {4 * sp_rounds} relaxation rounds (disconnected "
+                "graph or raise sp_rounds); their pseudotime is clamped "
+                "to the max", stacklevel=2)
     pt_max = jnp.max(jnp.where(jnp.isfinite(d), d, 0.0))
     pt = jnp.where(jnp.isfinite(d), d, pt_max) / jnp.maximum(pt_max, 1e-12)
 

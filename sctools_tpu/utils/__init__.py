@@ -1,0 +1,6 @@
+"""Auxiliary subsystems: tracing and checkpoint/resume."""
+
+from .trace import profile, report, reset, span, spans  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    PipelineCheckpointer, load_celldata, save_celldata,
+)

@@ -1,0 +1,152 @@
+"""Checkpoint/resume for CellData and pipelines.
+
+Reference parity: the reference framework checkpoints pipeline state
+so long multi-stage runs survive preemption (source unavailable —
+SURVEY.md §0).
+
+Format: one ``.npz`` per checkpoint — X stored as CSR triples (sparse)
+or dense, every obs/var/obsm/varm/obsp/uns array under a prefixed key.
+Device arrays are fetched to host first (``CellData.to_host`` trims
+row padding), so checkpoints are portable across chip counts and
+backends.  ``PipelineCheckpointer`` wraps a ``Pipeline`` and skips
+completed steps on resume.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..data.dataset import CellData
+from ..data.sparse import SparseCells
+
+_SECTIONS = ("obs", "var", "obsm", "varm", "obsp", "uns")
+
+
+def save_celldata(data: CellData, path: str) -> None:
+    """Write a CellData to ``path`` (.npz, atomic via rename)."""
+    import jax
+    import scipy.sparse as sp
+
+    if isinstance(data.X, (SparseCells, jax.Array)) or any(
+        isinstance(v, jax.Array)
+        for d in (data.obs, data.var, data.obsm, data.varm, data.obsp,
+                  data.uns)
+        for v in d.values()
+    ):
+        data = data.to_host()
+    arrays: dict[str, np.ndarray] = {}
+    X = data.X
+    if sp.issparse(X):
+        X = X.tocsr()
+        arrays["X/format"] = np.array("csr")
+        arrays["X/data"] = X.data
+        arrays["X/indices"] = X.indices
+        arrays["X/indptr"] = X.indptr
+        arrays["X/shape"] = np.asarray(X.shape, np.int64)
+    else:
+        arrays["X/format"] = np.array("dense")
+        arrays["X/data"] = np.asarray(X)
+    skipped = []
+
+    def put(key, v):
+        if isinstance(v, dict):
+            # nested dicts (e.g. de.rank_genes_groups results) flatten
+            # into "//"-joined keys — np.savez would otherwise pickle
+            # them as object arrays that allow_pickle=False can't load
+            for sk, sv in v.items():
+                put(f"{key}//{sk}", sv)
+            return
+        arr = np.asarray(v)
+        if arr.dtype == object:
+            skipped.append(key)
+            return
+        arrays[key] = arr
+
+    for section in _SECTIONS:
+        for k, v in getattr(data, section).items():
+            put(f"{section}/{k}", v)
+    if skipped:
+        import warnings
+
+        warnings.warn(
+            f"save_celldata: skipped non-array entries {skipped}",
+            stacklevel=2)
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, **arrays)
+    os.replace(tmp, path)
+
+
+def load_celldata(path: str) -> CellData:
+    import scipy.sparse as sp
+
+    with np.load(path, allow_pickle=False) as z:
+        fmt = str(z["X/format"])
+        if fmt == "csr":
+            shape = tuple(z["X/shape"])
+            X = sp.csr_matrix(
+                (z["X/data"], z["X/indices"], z["X/indptr"]), shape=shape)
+        else:
+            X = z["X/data"]
+        sections: dict[str, dict] = {s: {} for s in _SECTIONS}
+        for key in z.files:
+            section, _, name = key.partition("/")
+            if section not in sections or key.startswith("X/"):
+                continue
+            target = sections[section]
+            parts = name.split("//")
+            for p in parts[:-1]:  # rebuild nested dicts
+                target = target.setdefault(p, {})
+            target[parts[-1]] = z[key]
+    return CellData(X, **sections)
+
+
+class PipelineCheckpointer:
+    """Run a ``Pipeline`` with a checkpoint after every step; resume
+    skips steps whose checkpoint already exists.
+
+    >>> ckpt = PipelineCheckpointer(pipe, "/path/to/ckpts")
+    >>> out = ckpt.run(data, backend="tpu")       # writes step files
+    >>> out = ckpt.run(data, backend="tpu")       # resumes: loads last
+
+    Step files are named ``step{i:03d}_{transform}.npz``; a change to
+    the step list invalidates mismatched names automatically.
+    """
+
+    def __init__(self, pipeline, directory: str, save_every: int = 1):
+        self.pipeline = pipeline
+        self.directory = directory
+        self.save_every = max(1, save_every)
+        os.makedirs(directory, exist_ok=True)
+
+    def _step_path(self, i: int, name: str) -> str:
+        safe = name.replace(".", "_").replace("/", "_")
+        return os.path.join(self.directory, f"step{i:03d}_{safe}.npz")
+
+    def run(self, data: CellData, backend: str | None = None,
+            resume: bool = True) -> CellData:
+        steps = list(self.pipeline.steps)
+        start = 0
+        if resume:
+            for i in range(len(steps) - 1, -1, -1):
+                p = self._step_path(i, steps[i].name)
+                if os.path.exists(p):
+                    data = load_celldata(p)
+                    if backend in (None, "tpu"):
+                        data = data.device_put()
+                    start = i + 1
+                    break
+        for i in range(start, len(steps)):
+            t = steps[i]
+            if backend is not None and backend != t.backend:
+                t = t.with_backend(backend)
+            data = t(data)
+            if (i + 1) % self.save_every == 0 or i == len(steps) - 1:
+                save_celldata(data, self._step_path(i, steps[i].name))
+        return data
+
+    def clear(self) -> None:
+        for f in os.listdir(self.directory):
+            if f.startswith("step") and f.endswith(".npz"):
+                os.remove(os.path.join(self.directory, f))

@@ -1,0 +1,117 @@
+"""Lightweight tracing for pipeline stages.
+
+Reference parity: the reference framework ships a tracing subsystem
+for its pipeline runtime (source unavailable — SURVEY.md §0).  Two
+layers here:
+
+* ``span(name)`` — nested wall-clock spans with an in-process tree,
+  cheap enough to leave on.  ``sync=True`` inserts a device barrier
+  before closing so the span charges queued TPU work to the stage
+  that launched it (jax dispatch is async — without the barrier a
+  span only measures Python time).
+* ``profile(logdir)`` — wraps ``jax.profiler.trace`` for full XLA
+  traces viewable in TensorBoard/Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Span:
+    name: str
+    start: float
+    duration: float = 0.0
+    children: list = field(default_factory=list)
+
+    def flat(self, depth=0):
+        yield depth, self
+        for c in self.children:
+            yield from c.flat(depth + 1)
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.roots: list[Span] = []
+        self.stack: list[Span] = []
+
+
+_state = _State()
+
+
+def _sync_device():
+    """Barrier: enqueue a trivial computation and block on it — device
+    streams execute in order, so this drains everything queued."""
+    import jax
+    import jax.numpy as jnp
+
+    jnp.zeros(()).block_until_ready()
+    del jax
+
+
+@contextlib.contextmanager
+def span(name: str, sync: bool = False):
+    """Context manager recording a (nested) timing span."""
+    s = Span(name, time.perf_counter())
+    if _state.stack:
+        _state.stack[-1].children.append(s)
+    else:
+        _state.roots.append(s)
+    _state.stack.append(s)
+    try:
+        yield s
+    finally:
+        try:
+            if sync:
+                _sync_device()
+        finally:
+            # always record + pop, even if the device barrier raises —
+            # otherwise the dead span corrupts the stack for the whole
+            # thread
+            s.duration = time.perf_counter() - s.start
+            _state.stack.pop()
+
+
+def spans() -> list[Span]:
+    """Completed root spans of this thread."""
+    return list(_state.roots)
+
+
+def reset() -> None:
+    _state.roots.clear()
+    _state.stack.clear()
+
+
+def report() -> str:
+    """Indented text table of recorded spans."""
+    lines = []
+    for root in _state.roots:
+        for depth, s in root.flat():
+            lines.append(f"{'  ' * depth}{s.name:<40s} {s.duration * 1e3:10.2f} ms")
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def profile(logdir: str):
+    """Full XLA profiler trace (TensorBoard/Perfetto), when the
+    backend supports it; degrades to a plain span otherwise."""
+    import jax
+
+    try:
+        jax.profiler.start_trace(logdir)
+        started = True
+    except Exception:
+        started = False
+    with span(f"profile:{logdir}"):
+        try:
+            yield
+        finally:
+            if started:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
