@@ -1,0 +1,224 @@
+"""``metacells.seacells`` — SEACells-style metacell identification.
+
+Reference parity: dpeerlab/sctools descends from the Pe'er lab stack,
+whose metacell tool is SEACells (source unavailable — SURVEY.md §0;
+the published algorithm: kernel archetypal analysis — find archetypes
+B (convex combinations of cells) and assignments A (convex
+combinations of archetypes) minimising ‖K − K·B·A‖²_F, both updated by
+Frank–Wolfe steps on the probability simplex).
+
+TPU design: the n×n kernel K never materialises — it lives as the
+symmetrised kNN edge list, and every kernel product is a k-sparse
+``knn_matvec``/``knn_rmatvec``.  Per Frank–Wolfe round the gradients
+reduce to
+
+    ∇_A = 2·(CᵀC)·A − 2·CᵀK          with C = K·B   (n × m)
+    ∇_B = 2·KᵀK·B·(AAᵀ) − 2·KᵀK·Aᵀ
+
+where CᵀC and AAᵀ are tiny (m × m).  The simplex linear-minimisation
+step is one argmin per column + a convex update — pure vectorised
+VPU work, iterated under ``lax.fori_loop``.  Initialisation is
+max–min (farthest-point) sampling in the embedding, the same seeding
+SEACells uses.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.dataset import CellData
+from ..data.sparse import SparseCells
+from ..registry import register
+
+
+def maxmin_sample(points: np.ndarray, m: int, seed: int = 0) -> np.ndarray:
+    """Farthest-point sampling of ``m`` indices (host-side)."""
+    rng = np.random.default_rng(seed)
+    pts = np.asarray(points, np.float64)
+    first = int(rng.integers(len(pts)))
+    chosen = [first]
+    dmin = np.linalg.norm(pts - pts[first], axis=1)
+    for _ in range(m - 1):
+        nxt = int(np.argmax(dmin))
+        chosen.append(nxt)
+        dmin = np.minimum(dmin, np.linalg.norm(pts - pts[nxt], axis=1))
+    return np.asarray(chosen)
+
+
+@partial(jax.jit, static_argnames=("n_iter",))
+def seacells_arrays(knn_idx, kernel_w, init_idx, n_iter: int = 50):
+    """Kernel archetypal analysis on the kNN kernel.
+
+    knn_idx/kernel_w: (n, k) symmetric kernel edge list; init_idx:
+    (m,) seed cells.  Returns (A (m, n) column-stochastic assignments,
+    B (n, m) column-stochastic archetypes).
+    """
+    n, k = knn_idx.shape
+    m = init_idx.shape[0]
+
+    from .graph import knn_matvec, knn_rmatvec
+
+    def Kmat(V):  # K @ V — kernel is symmetric, edge list may not be;
+        return knn_matvec(knn_idx, kernel_w, V)
+
+    def KTmat(V):
+        return knn_rmatvec(knn_idx, kernel_w, V, n=n)
+
+    B0 = jnp.zeros((n, m)).at[init_idx, jnp.arange(m)].set(1.0)
+    # A0: assign each cell to its most similar archetype (one kernel hop)
+    C0 = Kmat(B0)  # (n, m)
+    A0 = jax.nn.one_hot(jnp.argmax(C0, axis=1), m).T  # (m, n)
+
+    def body(t, carry):
+        A, B = carry
+        gamma = 2.0 / (t + 2.0)
+        # --- update A (columns = cells, rows simplex over archetypes)
+        C = Kmat(B)  # (n, m)
+        CtC = C.T @ C  # (m, m)
+        CtK = KTmat(C).T  # (m, n)  == Cᵀ K (K symmetric)
+        gA = 2.0 * (CtC @ A) - 2.0 * CtK  # (m, n)
+        eA = jax.nn.one_hot(jnp.argmin(gA, axis=0), m).T  # (m, n)
+        A = (1.0 - gamma) * A + gamma * eA
+        # --- update B (columns = archetypes, rows simplex over cells)
+        KtKB = KTmat(Kmat(B))  # (n, m)
+        KtKAt = KTmat(Kmat(A.T))  # (n, m)
+        gB = 2.0 * (KtKB @ (A @ A.T)) - 2.0 * KtKAt  # (n, m)
+        eB = jax.nn.one_hot(jnp.argmin(gB, axis=0), n).T  # (n, m)
+        B = (1.0 - gamma) * B + gamma * eB
+        return A, B
+
+    A, B = jax.lax.fori_loop(0, n_iter, body, (A0, B0))
+    return A, B
+
+
+def _sym_kernel(data: CellData, backend: str):
+    """Symmetrised connectivities as the kernel edge list."""
+    from .graph import (_require_knn, _symmetrized_weights,
+                        connectivities_cpu, connectivities_tpu)
+
+    if "connectivities" not in data.obsp:
+        data = (connectivities_tpu if backend == "tpu"
+                else connectivities_cpu)(data)
+    n = data.n_cells
+    idx, _ = _require_knn(data)
+    w = jnp.asarray(np.asarray(data.obsp["connectivities"],
+                               np.float32)[:n])
+    w = _symmetrized_weights(idx, w)  # averaged — near-symmetric
+    return data, idx, w
+
+
+def _attach_metacells(data: CellData, A, B, init_idx) -> CellData:
+    labels = jnp.argmax(jnp.asarray(A), axis=0).astype(jnp.int32)
+    return data.with_obs(metacell=labels).with_uns(
+        seacells_A=A, seacells_B=B,
+        seacells_seed_cells=np.asarray(init_idx))
+
+
+@register("metacells.seacells", backend="tpu")
+def seacells_tpu(data: CellData, n_metacells: int | None = None,
+                 n_iter: int = 50, use_rep: str = "X_pca",
+                 seed: int = 0) -> CellData:
+    """Adds obs["metacell"] (hard assignment), uns["seacells_A"/"_B"].
+    Requires neighbors.knn; default n_metacells ≈ n/75 (the SEACells
+    rule of thumb)."""
+    n = data.n_cells
+    if n_metacells is None:
+        n_metacells = max(2, int(round(n / 75)))
+    data, idx, w = _sym_kernel(data, "tpu")
+    emb = np.asarray(data.obsm[use_rep])[:n]
+    init_idx = maxmin_sample(emb, n_metacells, seed=seed)
+    A, B = seacells_arrays(idx, w, jnp.asarray(init_idx), n_iter=n_iter)
+    return _attach_metacells(data, A, B, init_idx)
+
+
+@register("metacells.seacells", backend="cpu")
+def seacells_cpu(data: CellData, n_metacells: int | None = None,
+                 n_iter: int = 50, use_rep: str = "X_pca",
+                 seed: int = 0) -> CellData:
+    """Numpy oracle of the same Frank–Wolfe scheme (dense kernel —
+    small inputs only)."""
+    import scipy.sparse as sp
+
+    n = data.n_cells
+    if n_metacells is None:
+        n_metacells = max(2, int(round(n / 75)))
+    data, idx, w = _sym_kernel(data, "cpu")
+    idx = np.asarray(idx)
+    w = np.asarray(w, np.float64)
+    k = idx.shape[1]
+    rows = np.repeat(np.arange(n), k)
+    cols = idx.reshape(-1)
+    keep = cols >= 0
+    K = sp.csr_matrix((w.reshape(-1)[keep], (rows[keep], cols[keep])),
+                      shape=(n, n)).toarray()
+    emb = np.asarray(data.obsm[use_rep])[:n]
+    init_idx = maxmin_sample(emb, n_metacells, seed=seed)
+    m = n_metacells
+    B = np.zeros((n, m))
+    B[init_idx, np.arange(m)] = 1.0
+    C0 = K @ B
+    A = np.eye(m)[np.argmax(C0, axis=1)].T
+    for t in range(n_iter):
+        gamma = 2.0 / (t + 2.0)
+        C = K @ B
+        gA = 2.0 * (C.T @ C) @ A - 2.0 * (C.T @ K)
+        eA = np.eye(m)[np.argmin(gA, axis=0)].T
+        A = (1 - gamma) * A + gamma * eA
+        KtKB = K.T @ (K @ B)
+        gB = 2.0 * KtKB @ (A @ A.T) - 2.0 * (K.T @ (K @ A.T))
+        eB = np.eye(n)[np.argmin(gB, axis=0)].T
+        B = (1 - gamma) * B + gamma * eB
+    return _attach_metacells(data, A.astype(np.float32),
+                             B.astype(np.float32), init_idx)
+
+
+@register("metacells.aggregate", backend="tpu")
+def aggregate_tpu(data: CellData, key: str = "metacell") -> CellData:
+    """Sum raw counts per metacell → a new small CellData
+    (n_metacells × n_genes, dense) carried in uns["metacell_counts"],
+    plus obs sizes.  Works on SparseCells or dense X."""
+    if key not in data.obs:
+        raise ValueError(f"run metacells.seacells first ({key!r} missing)")
+    n = data.n_cells
+    labels = jnp.asarray(data.obs[key])[:n].astype(jnp.int32)
+    m = int(jnp.max(labels)) + 1
+    X = data.X
+    if isinstance(X, SparseCells):
+        from ..data.sparse import spmm_t
+
+        onehot = jax.nn.one_hot(labels, m, dtype=jnp.float32)
+        pad = X.rows_padded - n
+        if pad:
+            onehot = jnp.concatenate([onehot, jnp.zeros((pad, m))])
+        counts = spmm_t(X, onehot).T  # (m, G)
+    else:
+        Xd = jnp.asarray(X)[:n]
+        counts = jax.ops.segment_sum(Xd, labels, num_segments=m)
+    sizes = jax.ops.segment_sum(jnp.ones((n,), jnp.float32), labels,
+                                num_segments=m)
+    return data.with_uns(metacell_counts=counts, metacell_sizes=sizes)
+
+
+@register("metacells.aggregate", backend="cpu")
+def aggregate_cpu(data: CellData, key: str = "metacell") -> CellData:
+    import scipy.sparse as sp
+
+    if key not in data.obs:
+        raise ValueError(f"run metacells.seacells first ({key!r} missing)")
+    n = data.n_cells
+    labels = np.asarray(data.obs[key])[:n].astype(np.int64)
+    m = int(labels.max()) + 1
+    X = data.X
+    onehot = sp.csr_matrix(
+        (np.ones(n), (labels, np.arange(n))), shape=(m, n))
+    if sp.issparse(X):
+        counts = np.asarray((onehot @ X).todense())
+    else:
+        counts = onehot @ np.asarray(X)
+    sizes = np.bincount(labels, minlength=m).astype(np.float32)
+    return data.with_uns(metacell_counts=counts.astype(np.float32),
+                         metacell_sizes=sizes)

@@ -222,6 +222,131 @@ def umap_tpu(data: CellData, n_dims: int = 2, min_dist: float = 0.1,
     return data.with_obsm(X_umap=y).with_uns(umap_min_dist=min_dist)
 
 
+@partial(jax.jit, static_argnames=("n_epochs", "n_neg"))
+def fa2_layout_arrays(knn_idx, weights, init, key, n_epochs: int = 300,
+                      n_neg: int = 10, repulsion: float = 1.0,
+                      gravity: float = 1.0, lr: float = 0.1):
+    """ForceAtlas2-style layout on the kNN graph, full-batch.
+
+    Linear attraction ``-w·diff`` along edges, degree-scaled
+    ``(deg_i+1)(deg_j+1)/d²`` repulsion estimated by negative sampling
+    (rescaled by n/n_neg to approximate the all-pairs sum), and a
+    gravity term pulling to the origin.  Same vectorised scheme as the
+    UMAP optimiser: one ``lax.scan`` over epochs, no host round-trips.
+    """
+    n, k = knn_idx.shape
+    row_ids = jnp.arange(n, dtype=knn_idx.dtype)[:, None]
+    dead = (knn_idx < 0) | (knn_idx == row_ids)
+    w = jnp.where(dead, 0.0, weights.astype(jnp.float32))
+    safe = jnp.where(knn_idx < 0, 0, knn_idx)
+    deg = jnp.sum(w, axis=1) + 1.0  # (n,)
+    y0 = jnp.asarray(init, jnp.float32)
+    eps = 1e-3
+    scale_rep = repulsion * n / max(n_neg, 1)
+
+    def epoch(y, inp):
+        step, ekey = inp
+        alpha = lr * (1.0 - step / n_epochs)
+        yj = jnp.take(y, safe, axis=0)
+        diff = y[:, None, :] - yj
+        att = -(w[:, :, None] * diff)
+        g = jnp.sum(att, axis=1)
+        g = g + jax.ops.segment_sum(
+            (-att).reshape(-1, y.shape[1]), safe.reshape(-1),
+            num_segments=n)
+        negs = jax.random.randint(ekey, (n, n_neg), 0, n)
+        diff_n = y[:, None, :] - jnp.take(y, negs, axis=0)
+        d2n = jnp.sum(diff_n * diff_n, axis=2)
+        rep_c = (deg[:, None] * jnp.take(deg, negs)) / (eps + d2n)
+        rep = jnp.clip(rep_c[:, :, None] * diff_n, -10.0, 10.0)
+        g = g + scale_rep / n * jnp.sum(rep, axis=1)
+        g = g - gravity * deg[:, None] * y / jnp.maximum(
+            jnp.linalg.norm(y, axis=1, keepdims=True), eps)
+        return y + alpha * jnp.clip(g, -10.0, 10.0), None
+
+    steps = jnp.arange(n_epochs, dtype=jnp.float32)
+    keys = jax.random.split(key, n_epochs)
+    y, _ = jax.lax.scan(epoch, y0, (steps, keys))
+    return y
+
+
+@register("embed.force_directed", backend="tpu")
+def force_directed_tpu(data: CellData, n_dims: int = 2,
+                       n_epochs: int = 300, n_neg: int = 10,
+                       repulsion: float = 1.0, gravity: float = 1.0,
+                       lr: float = 0.1, seed: int = 0,
+                       init=None) -> CellData:
+    """ForceAtlas2-style graph layout (scanpy's draw_graph parity).
+    Adds obsm["X_draw_graph"].  Requires neighbors.knn."""
+    from .graph import _require_knn, connectivities_tpu
+
+    if "connectivities" not in data.obsp:
+        data = connectivities_tpu(data)
+    n = data.n_cells
+    idx, _ = _require_knn(data)
+    w = jnp.asarray(np.asarray(data.obsp["connectivities"],
+                               np.float32)[:n])
+    if init is None:
+        init = _spectral_init(data, n_dims, seed, "tpu", scale=1.0)
+    else:
+        init = np.asarray(init, np.float32)
+        if init.shape != (n, n_dims):
+            raise ValueError(
+                f"init must have shape ({n}, {n_dims}), got {init.shape}")
+    y = fa2_layout_arrays(idx, w, jnp.asarray(init),
+                          jax.random.PRNGKey(seed), n_epochs=n_epochs,
+                          n_neg=n_neg, repulsion=repulsion,
+                          gravity=gravity, lr=lr)
+    return data.with_obsm(X_draw_graph=y)
+
+
+@register("embed.force_directed", backend="cpu")
+def force_directed_cpu(data: CellData, n_dims: int = 2,
+                       n_epochs: int = 300, n_neg: int = 10,
+                       repulsion: float = 1.0, gravity: float = 1.0,
+                       lr: float = 0.1, seed: int = 0,
+                       init=None) -> CellData:
+    """Numpy oracle of the same scheme."""
+    from .graph import _require_knn, connectivities_cpu
+
+    if "connectivities" not in data.obsp:
+        data = connectivities_cpu(data)
+    n = data.n_cells
+    idx = np.asarray(data.obsp["knn_indices"])[:n]
+    w = np.asarray(data.obsp["connectivities"], np.float64)[:n]
+    dead = (idx < 0) | (idx == np.arange(n)[:, None])
+    w = np.where(dead, 0.0, w)
+    safe = np.where(idx < 0, 0, idx)
+    deg = w.sum(1) + 1.0
+    if init is None:
+        init = _spectral_init(data, n_dims, seed, "cpu", scale=1.0)
+    else:
+        init = np.asarray(init, np.float32)
+        if init.shape != (n, n_dims):
+            raise ValueError(
+                f"init must have shape ({n}, {n_dims}), got {init.shape}")
+    rng = np.random.default_rng(seed)
+    y = np.asarray(init, np.float64).copy()
+    eps = 1e-3
+    scale_rep = repulsion * n / max(n_neg, 1)
+    for step in range(n_epochs):
+        alpha = lr * (1.0 - step / n_epochs)
+        diff = y[:, None, :] - y[safe]
+        att = -(w[:, :, None] * diff)
+        g = att.sum(1)
+        np.add.at(g, safe.reshape(-1), -att.reshape(-1, y.shape[1]))
+        negs = rng.integers(0, n, (n, n_neg))
+        diff_n = y[:, None, :] - y[negs]
+        d2n = (diff_n * diff_n).sum(2)
+        rep_c = (deg[:, None] * deg[negs]) / (eps + d2n)
+        g = g + scale_rep / n * np.clip(
+            rep_c[:, :, None] * diff_n, -10.0, 10.0).sum(1)
+        g = g - gravity * deg[:, None] * y / np.maximum(
+            np.linalg.norm(y, axis=1, keepdims=True), eps)
+        y = y + alpha * np.clip(g, -10.0, 10.0)
+    return data.with_obsm(X_draw_graph=y.astype(np.float32))
+
+
 @register("embed.umap", backend="cpu")
 def umap_cpu(data: CellData, n_dims: int = 2, min_dist: float = 0.1,
              spread: float = 1.0, n_epochs: int = 200, n_neg: int = 5,

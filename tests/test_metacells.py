@@ -1,0 +1,72 @@
+"""metacells.seacells: metacells must be compact (cluster-pure) and
+cover the data; aggregation must sum counts exactly."""
+
+import numpy as np
+import pytest
+
+import sctools_tpu as sct
+from sctools_tpu.data.synthetic import gaussian_blobs, synthetic_counts
+
+
+@pytest.fixture(scope="module")
+def blobs_knn():
+    pts, labels = gaussian_blobs(500, 12, n_clusters=5, spread=0.12,
+                                 seed=13)
+    ds = sct.CellData(pts, obsm={"X_pca": pts})
+    ds = sct.apply("neighbors.knn", ds, backend="tpu", k=15,
+                   metric="euclidean")
+    return ds, labels
+
+
+def _purity(metacell, true):
+    """Mean over metacells of the majority-cluster fraction."""
+    ps = []
+    for mc in np.unique(metacell):
+        members = true[metacell == mc]
+        if len(members):
+            ps.append(np.bincount(members).max() / len(members))
+    return float(np.mean(ps))
+
+
+@pytest.mark.parametrize("backend", ["tpu", "cpu"])
+def test_seacells_purity(blobs_knn, backend):
+    ds, labels = blobs_knn
+    out = sct.apply("metacells.seacells", ds, backend=backend,
+                    n_metacells=15, n_iter=30, seed=0)
+    out = out.to_host() if backend == "tpu" else out
+    mc = np.asarray(out.obs["metacell"])[: len(labels)]
+    assert mc.min() >= 0 and mc.max() < 15
+    # metacells never straddle well-separated clusters
+    pur = _purity(mc, labels)
+    assert pur > 0.95, f"metacell purity too low ({backend}): {pur:.3f}"
+    # every cluster is covered by at least one metacell
+    assert len(np.unique(labels[np.unique(mc, return_index=True)[1]])) >= 1
+    A = np.asarray(out.uns["seacells_A"])
+    assert A.shape == (15, len(labels))
+    np.testing.assert_allclose(A.sum(0), 1.0, atol=1e-4)
+
+
+def test_aggregate_sums_counts():
+    ds = synthetic_counts(300, 120, density=0.1, n_clusters=3, seed=5)
+    dev = ds.device_put()
+    pipe = sct.Pipeline([
+        ("normalize.library_size", {"target_sum": 1e4}),
+        ("normalize.log1p", {}),
+        ("pca.randomized", {"n_components": 10}),
+        ("neighbors.knn", {"k": 10, "metric": "euclidean"}),
+    ])
+    dev = pipe.run(dev, backend="tpu")
+    # aggregate the RAW counts: attach labels to the raw data
+    out = sct.apply("metacells.seacells", dev, backend="tpu",
+                    n_metacells=6, n_iter=20)
+    raw = ds.with_obs(metacell=np.asarray(out.to_host().obs["metacell"])[:300])
+    agg_cpu = sct.apply("metacells.aggregate", raw, backend="cpu")
+    agg_tpu = sct.apply("metacells.aggregate", raw.device_put(),
+                        backend="tpu")
+    c_cpu = np.asarray(agg_cpu.uns["metacell_counts"])
+    c_tpu = np.asarray(agg_tpu.uns["metacell_counts"])
+    np.testing.assert_allclose(c_cpu, c_tpu, rtol=1e-5, atol=1e-4)
+    # exact conservation: total counts preserved
+    np.testing.assert_allclose(c_cpu.sum(), ds.X.sum(), rtol=1e-6)
+    sizes = np.asarray(agg_cpu.uns["metacell_sizes"])
+    assert sizes.sum() == 300

@@ -100,3 +100,43 @@ def test_palantir_auto_terminal_states(branching):
     assert len(terms) >= 1
     # detected terminals must sit late in the true progression
     assert t[terms].min() > 1.0
+
+
+@pytest.mark.parametrize("backend", ["tpu", "cpu"])
+def test_gene_trends(branching, backend):
+    """A gene equal to true progression must produce a monotone trend;
+    tpu and cpu trends agree."""
+    ds, t, branch, root, tips = branching
+    out = sct.apply("palantir.run", ds, backend=backend, root=root,
+                    terminal_states=list(tips))
+    # synthesize expression: col0 tracks progression, col1 is flat
+    expr = np.stack([t, np.ones_like(t)], axis=1).astype(np.float32)
+    out = out.with_obsm(expr=expr)
+    tr = sct.apply("palantir.gene_trends", out, backend=backend,
+                   use_rep="expr", n_grid=50)
+    gt = tr.uns["gene_trends"]
+    trends = np.asarray(gt["trends"])
+    assert trends.shape == (50, 2)
+    # trend of the progression gene increases along the grid
+    assert trends[-5:, 0].mean() > trends[:5, 0].mean() + 0.5
+    # flat gene stays flat
+    assert np.ptp(trends[:, 1]) < 0.1
+    # lineage weighting restricts to one branch
+    tr1 = sct.apply("palantir.gene_trends", out, backend=backend,
+                    use_rep="expr", n_grid=50, lineage=0)
+    assert np.isfinite(np.asarray(tr1.uns["gene_trends"]["trends"])).all()
+
+
+def test_gene_trends_backend_parity(branching):
+    ds, t, branch, root, tips = branching
+    out = sct.apply("palantir.run", ds, backend="tpu", root=root,
+                    terminal_states=list(tips))
+    expr = np.stack([t, t * t], axis=1).astype(np.float32)
+    out = out.with_obsm(expr=expr).to_host()
+    a = sct.apply("palantir.gene_trends", out, backend="tpu",
+                  use_rep="expr", n_grid=40)
+    b = sct.apply("palantir.gene_trends", out, backend="cpu",
+                  use_rep="expr", n_grid=40)
+    np.testing.assert_allclose(np.asarray(a.uns["gene_trends"]["trends"]),
+                               np.asarray(b.uns["gene_trends"]["trends"]),
+                               rtol=1e-3, atol=1e-4)

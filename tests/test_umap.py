@@ -70,3 +70,16 @@ def test_fit_ab_matches_defaults():
     a2, b2 = fit_ab(0.5, 1.0)
     # larger min_dist → flatter curve near 0 → smaller a
     assert a2 < a
+
+
+@pytest.mark.parametrize("backend", ["tpu", "cpu"])
+def test_force_directed_separates_blobs(blob_knn, backend):
+    ds, labels = blob_knn
+    out = sct.apply("embed.force_directed", ds, backend=backend,
+                    n_epochs=200, seed=0)
+    out = out.to_host()
+    y = np.asarray(out.obsm["X_draw_graph"])[: len(labels)]
+    assert y.shape == (len(labels), 2)
+    assert np.isfinite(y).all()
+    ratio = _sep_ratio(y, labels)
+    assert ratio > 2.0, f"fa2 separation too weak ({backend}): {ratio:.2f}"
