@@ -1,141 +1,690 @@
-"""Benchmark: end-to-end single-cell preprocessing + kNN throughput.
+"""Benchmark harness: the five BASELINE.json configs + kernel microbench.
 
-Reproduces the BASELINE.json pipeline shape (configs[3]-style:
-normalize → log1p → HVG → 50-PC randomized PCA → cosine kNN k=15) on
-synthetic counts and reports ONE JSON line:
+Contract with the driver (BENCH_r{N}.json):
 
-    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+* **stdout carries exactly ONE JSON line** — the headline metric
+  ``{"metric", "value", "unit", "vs_baseline", "detail"}`` — printed
+  last, whatever happens (including "TPU never became available").
+* **stderr carries one flushed JSON line per stage** as it completes,
+  so a timeout still leaves partial data in the driver's ``tail``
+  capture; the same lines are appended to ``bench_stages.jsonl``.
 
-``vs_baseline``: the only baseline available (reference source/numbers
-missing, see BASELINE.md) is the north-star target — 10M cells on a
-v5e-8 in <300 s, i.e. **4167 cells/s/chip**.  vs_baseline is our
-cells/s/chip divided by that target rate (>1 = faster than target).
+Robustness lessons from round 1 (VERDICT.md "What's weak" #1 — the
+rc=124 with zero output):
 
-Recall@10 vs the float64 numpy oracle is measured on a query sample
-against the full candidate set (same embedding — the well-posed
-decomposition; see tests/test_pca_knn.py for why cross-PCA recall at
-flat-spectrum ranks is ill-defined) and reported in "detail".
+* device acquisition is bounded (``SCTOOLS_BENCH_DEVICE_TIMEOUT_S``,
+  default 600 s) and heartbeats to stderr while it waits — the axon
+  TPU tunnel can block ``jax.devices()`` for many minutes;
+* a total time budget (``SCTOOLS_BENCH_BUDGET_S``, default 1500 s) is
+  tracked between stages; remaining stages shrink or skip rather than
+  blow the budget, and kNN runs in query chunks so it can stop
+  mid-way and report honest partial throughput;
+* a CPU fallback is **never** reported as the TPU number: without a
+  real TPU the headline carries ``"error": "no TPU"`` unless
+  ``SCTOOLS_BENCH_ALLOW_CPU=1`` explicitly opts into a (clearly
+  labelled) CPU run;
+* synthetic data is generated ON DEVICE (data/synthetic.py
+  ``DeviceSyntheticSource``) — the bench host may have a single CPU
+  core and a tunneled TPU, so host-side generation + transfer would
+  dominate every measurement;
+* the persistent XLA compilation cache (``/tmp/sctools_jax_cache``)
+  is enabled so repeat runs skip the single-core-host compile cost.
 
-Env knobs: SCTOOLS_BENCH_CELLS, SCTOOLS_BENCH_GENES,
-SCTOOLS_BENCH_NNZ, SCTOOLS_BENCH_DTYPE (matmul dtype, default
-bfloat16 on TPU).
+Headline: configs[3]-shaped throughput — QC/stats → HVG → 50-PC
+randomized PCA → cosine kNN(k=15, refine=64) — in cells/s on one
+chip.  ``vs_baseline`` divides by the north-star target rate (10M
+cells / 300 s / 8 chips = 4166.7 cells/s/chip; BASELINE.json
+``published`` is empty — the reference shipped no numbers).
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import math
 import os
 import sys
+import threading
 import time
 
 import numpy as np
 
+T_START = time.time()
+BUDGET_S = float(os.environ.get("SCTOOLS_BENCH_BUDGET_S", 1500))
+DEVICE_TIMEOUT_S = float(os.environ.get("SCTOOLS_BENCH_DEVICE_TIMEOUT_S", 600))
+ALLOW_CPU = os.environ.get("SCTOOLS_BENCH_ALLOW_CPU", "") == "1"
+TARGET_RATE = 10_000_000 / 300.0 / 8.0  # north-star cells/s/chip
 
-def _get_jax(retries=4):
-    """The TPU grant can be transiently unavailable right after another
-    process released it — retry before falling back to CPU."""
-    for i in range(retries):
-        try:
-            import jax
+_STAGE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_stages.jsonl")
 
-            jax.devices()
-            return jax
-        except RuntimeError as e:
-            if i == retries - 1:
-                os.environ["JAX_PLATFORMS"] = "cpu"
-                import jax
-
-                jax.config.update("jax_platforms", "cpu")
-                jax.devices()
-                return jax
-            time.sleep(15 * (i + 1))
+# Peak bf16 matmul throughput per chip, flops/s (public spec sheets);
+# used only for the MFU diagnostic in the kernel microbench.
+_PEAK_BF16 = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
 
 
-def main():
-    jax = _get_jax()
+def remaining() -> float:
+    return BUDGET_S - (time.time() - T_START)
+
+
+def stage(name: str, **fields):
+    """Emit one flushed JSON stage line to stderr + bench_stages.jsonl."""
+    rec = {"stage": name, "t": round(time.time() - T_START, 1), **fields}
+    line = json.dumps(rec, default=float)
+    print(line, file=sys.stderr, flush=True)
+    try:
+        with open(_STAGE_FILE, "a") as f:
+            f.write(line + "\n")
+    except OSError:
+        pass
+    return rec
+
+
+def acquire_jax(timeout_s: float) -> dict:
+    """Import jax + enumerate devices in a daemon thread so a hung TPU
+    tunnel cannot wedge the bench past its budget.  Fast failures
+    (transient grant-unavailable RuntimeErrors) retry with backoff
+    inside the thread until the deadline.  Returns a dict:
+    ``{"jax", "backend", "hung", "error", "waited"}`` — ``hung=True``
+    means the init thread is still blocked inside jax backend init
+    (in-process CPU fallback is then IMPOSSIBLE: the backend-init lock
+    is held, any later jax.devices() would block on it too)."""
+    box: dict = {}
+    t0 = time.time()
+    deadline = t0 + timeout_s
+
+    def target():
+        import jax
+
+        forced = os.environ.get("SCTOOLS_BENCH_FORCE_PLATFORM")
+        if forced:
+            # test/CI hook: skip the TPU tunnel entirely (the session
+            # sitecustomize force-sets jax_platforms="axon,cpu", so an
+            # env var alone can't)
+            jax.config.update("jax_platforms", forced)
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                         "/tmp/sctools_jax_cache"))
+        attempt = 0
+        while True:
+            try:
+                box["devices"] = jax.devices()
+                box["jax"] = jax
+                box.pop("error", None)
+                return
+            except Exception as e:  # pragma: no cover - env-dependent
+                box["error"] = repr(e)
+                attempt += 1
+                wait = min(15.0 * attempt, 60.0)
+                if time.time() + wait > deadline - 10:
+                    return
+                time.sleep(wait)
+
+    th = threading.Thread(target=target, daemon=True)
+    th.start()
+    while th.is_alive() and time.time() < deadline:
+        th.join(timeout=15.0)
+        if th.is_alive():
+            stage("acquire.wait", waited_s=round(time.time() - t0, 1))
+    waited = time.time() - t0
+    if "jax" in box:
+        return {"jax": box["jax"], "backend": box["jax"].default_backend(),
+                "hung": False, "error": None, "waited": waited}
+    return {"jax": None, "backend": None, "hung": th.is_alive(),
+            "error": box.get("error"), "waited": waited}
+
+
+# ----------------------------------------------------------------------
+# configs[0] / configs[1]: small in-memory pipelines + CPU parity
+# ----------------------------------------------------------------------
+
+
+def run_config0(jax):
+    """pbmc3k-shape (2.7k x 32k): library-size normalize + log1p,
+    elementwise-checked against the CPU oracle backend."""
     import jax.numpy as jnp
 
     import sctools_tpu as sct
-    from sctools_tpu.config import config
-    from sctools_tpu.data.sparse import SparseCells
-    from sctools_tpu.data.synthetic import synthetic_ell
-    from sctools_tpu.ops.knn import knn_arrays, knn_numpy, recall_at_k
-    from sctools_tpu.ops.pca import randomized_pca_arrays
+    from sctools_tpu.data.synthetic import synthetic_counts
 
-    backend = jax.default_backend()
+    d = synthetic_counts(2700, 32738, density=0.02, n_clusters=3, seed=0)
+    dev = d.device_put()
+    t0 = time.time()
+    out = sct.apply("normalize.library_size", dev, backend="tpu",
+                    target_sum=1e4)
+    out = sct.apply("normalize.log1p", out, backend="tpu")
+    out.X.data.block_until_ready()
+    first = time.time() - t0
+    t0 = time.time()
+    out = sct.apply("normalize.library_size", dev, backend="tpu",
+                    target_sum=1e4)
+    out = sct.apply("normalize.log1p", out, backend="tpu")
+    out.X.data.block_until_ready()
+    steady = time.time() - t0
+    ref = sct.apply("normalize.log1p",
+                    sct.apply("normalize.library_size", d, backend="cpu",
+                              target_sum=1e4), backend="cpu")
+    got = out.to_host().X.tocsr()
+    want = ref.X.tocsr()
+    err = float(abs(got - want).max()) if got.nnz else 0.0
+    return {"n_cells": 2700, "n_genes": 32738,
+            "wall_s": round(steady, 4), "wall_s_first": round(first, 2),
+            "cells_per_s": round(2700 / steady, 1),
+            "max_abs_err_vs_cpu": err, "ok": err < 1e-4}
+
+
+def run_config1(jax):
+    """68k PBMC-shape QC metrics (n_genes, pct_mito, total_counts)."""
+    import sctools_tpu as sct
+    from sctools_tpu.data.synthetic import synthetic_counts
+
+    d = synthetic_counts(68579, 32738, density=0.015, n_clusters=8,
+                         mito_frac=0.01, seed=1)
+    dev = d.device_put()
+    t0 = time.time()
+    out = sct.apply("qc.per_cell_metrics", dev, backend="tpu")
+    out.obs["total_counts"].block_until_ready()
+    first = time.time() - t0
+    t0 = time.time()
+    out = sct.apply("qc.per_cell_metrics", dev, backend="tpu")
+    out.obs["total_counts"].block_until_ready()
+    steady = time.time() - t0
+    ref = sct.apply("qc.per_cell_metrics", d, backend="cpu")
+    err = float(np.max(np.abs(
+        np.asarray(out.obs["total_counts"])[:68579]
+        - np.asarray(ref.obs["total_counts"]))))
+    return {"n_cells": 68579, "n_genes": 32738,
+            "wall_s": round(steady, 4), "wall_s_first": round(first, 2),
+            "cells_per_s": round(68579 / steady, 1),
+            "max_abs_err_total_counts": err, "ok": err < 0.5}
+
+
+# ----------------------------------------------------------------------
+# configs[2] / configs[3]: atlas scale, device-generated shards
+# ----------------------------------------------------------------------
+
+
+def _make_source(jax, n_cells, n_genes, capacity, materialize):
+    from sctools_tpu.data.synthetic import DeviceSyntheticSource
+
+    t0 = time.time()
+    src = DeviceSyntheticSource(
+        n_cells, n_genes, capacity=capacity,
+        shard_rows=int(os.environ.get("SCTOOLS_BENCH_SHARD_ROWS", 131072)),
+        n_clusters=8, seed=0, materialize=materialize)
+    if materialize and src._shards:
+        src._shards[-1].data.block_until_ready()
+    return src, time.time() - t0
+
+
+def run_config2(jax, src):
+    """1.3M x 28k HVG selection from one streaming stats pass."""
+    from sctools_tpu.data.stream import stream_hvg, stream_stats
+
+    n = src.n_cells
+    t0 = time.time()
+    stats = stream_stats(src)
+    hvg = stream_hvg(stats, n_top=2000)
+    first = time.time() - t0
+    t0 = time.time()
+    stats = stream_stats(src)
+    hvg = stream_hvg(stats, n_top=2000)
+    steady = time.time() - t0
+    return {"n_cells": n, "n_genes": src.n_genes,
+            "nnz_per_cell": src.capacity,
+            "wall_s": round(steady, 3), "wall_s_first": round(first, 2),
+            "cells_per_s": round(n / steady, 1), "n_hvg": int(len(hvg)),
+            "flavor": "dispersion (one-pass streaming; seurat_v3 needs "
+                      "a second clipped pass — see hvg.select)"}, stats, hvg
+
+
+def run_config3(jax, src, deadline_frac=0.75):
+    """Headline: stats -> HVG -> 50-PC streaming randomized PCA ->
+    cosine kNN(k=15, refine=64), chunked so it can stop on budget.
+    Recomputes stats/HVG even when config2 just did (this stage times
+    the FULL pipeline; config2's run leaves the compiles warm)."""
+    import jax.numpy as jnp
+
+    from sctools_tpu.config import config
+    from sctools_tpu.data.stream import stream_hvg, stream_pca, stream_stats
+    from sctools_tpu.ops.knn import knn_arrays
+    from sctools_tpu.utils import trace
+
+    n = src.n_cells
+    timings = {}
+    trace.reset()
+    t_all = time.time()
+    with trace.span("stats", sync=True):
+        stats = stream_stats(src)
+        hvg = stream_hvg(stats, n_top=2000)
+    with trace.span("pca", sync=True):
+        scores, comps, expl = stream_pca(
+            src, hvg, stats["gene_mean"], jax.random.PRNGKey(0),
+            n_components=50, n_iter=2)
+        scores.block_until_ready()
+    for s in trace.spans():
+        timings[s.name] = round(s.duration, 2)
+
+    # kNN in query chunks: one compiled shape, budget check between
+    # chunks, honest partial throughput if we must stop early.  Scores
+    # are zero-padded to a chunk multiple so every slice has the same
+    # static shape (the zero queries' outputs are discarded via nq).
+    from sctools_tpu.config import round_up as _round_up
+
+    chunk = 131072 if n >= 131072 else _round_up(n, 1024)
+    n_pad = _round_up(n, chunk)
+    scores_pad = jnp.zeros((n_pad, scores.shape[1]), scores.dtype)
+    scores_pad = scores_pad.at[:n].set(scores[:n])
+    k, refine = 15, 64
+    idx_parts = []
+    t_knn = time.time()
+    done = 0
+    chunk_times = []
+    while done < n:
+        q = jax.lax.dynamic_slice_in_dim(scores_pad, done, chunk, axis=0)
+        nq = min(chunk, n - done)
+        t_c = time.time()
+        idx_c, dist_c = knn_arrays(q, scores, k=k, metric="cosine",
+                                   n_query=chunk, n_cand=n, refine=refine)
+        idx_c.block_until_ready()
+        chunk_times.append(time.time() - t_c)
+        idx_parts.append((done, nq, idx_c))
+        done += nq
+        if done < n and remaining() < BUDGET_S * (1 - deadline_frac):
+            break
+    knn_s = time.time() - t_knn
+    timings["knn"] = round(knn_s, 2)
+    knn_complete = done >= n
+    total_s = time.time() - t_all
+
+    # throughput: completed-work basis.  If kNN stopped early, project
+    # the remaining chunks at the measured steady per-chunk rate and
+    # say so — never report partial work as full-pipeline speed.
+    if knn_complete:
+        pipeline_s = total_s
+        extrapolated = False
+    else:
+        steady_chunk = (np.median(chunk_times[1:])
+                        if len(chunk_times) > 1 else chunk_times[0])
+        pipeline_s = (total_s - knn_s) + steady_chunk * math.ceil(n / chunk)
+        extrapolated = True
+    cells_per_s = n / pipeline_s
+
+    detail = {"n_cells": n, "n_genes": src.n_genes,
+              "nnz_per_cell": src.capacity,
+              "matmul_dtype": config.matmul_dtype,
+              "knn_impl": config.resolved_knn_impl(),
+              "wall_s": round(pipeline_s, 2),
+              "cells_per_s": round(cells_per_s, 1),
+              "stage_s": timings,
+              "knn_chunks_done": len(chunk_times),
+              "knn_chunks_total": math.ceil(n / chunk),
+              "extrapolated": extrapolated,
+              "pca_explained_var_top1": float(np.asarray(expl)[0])}
+    return detail, scores, idx_parts
+
+
+def run_recall(jax, scores, idx_parts, n, n_queries=4096):
+    """Recall@10 vs a chunked numpy float32 oracle with float64
+    re-rank of the top candidates (the f32 gemm is the only affordable
+    full-candidate scan on a 1-core host; the f64 re-rank removes any
+    borderline-tie effect at the top of the list)."""
+    from sctools_tpu.ops.knn import recall_at_k
+
+    rng = np.random.default_rng(1)
+    # only sample queries whose kNN rows were actually computed
+    covered = np.concatenate([np.arange(off, off + nq)
+                              for off, nq, _ in idx_parts])
+    sample = rng.choice(covered, size=min(n_queries, len(covered)),
+                        replace=False)
+    t0 = time.time()
+    emb = np.asarray(scores)[:n].astype(np.float32)
+    fetch_s = time.time() - t0
+    embn = emb / np.maximum(
+        np.linalg.norm(emb, axis=1, keepdims=True), 1e-12)
+    q = embn[sample]
+    t0 = time.time()
+    top = 32
+    blk = 65536  # (n_queries, blk) f32 score tile ~1 GB at 4096 queries
+    best_i = np.zeros((len(q), top), np.int32)
+    best_s = np.full((len(q), top), -np.inf, np.float32)
+    for s in range(0, n, blk):
+        e = min(n, s + blk)
+        sc = q @ embn[s:e].T
+        cat_s = np.concatenate([best_s, sc], axis=1)
+        cat_i = np.concatenate(
+            [best_i, np.broadcast_to(
+                np.arange(s, e, dtype=np.int32), sc.shape)], axis=1)
+        part = np.argpartition(-cat_s, top - 1, axis=1)[:, :top]
+        best_s = np.take_along_axis(cat_s, part, axis=1)
+        best_i = np.take_along_axis(cat_i, part, axis=1)
+    # float64 re-rank of the surviving 32
+    emb64 = emb.astype(np.float64)
+    emb64 /= np.maximum(np.linalg.norm(emb64, axis=1, keepdims=True), 1e-12)
+    g = emb64[best_i]
+    sc64 = np.einsum("qd,qkd->qk", emb64[sample], g)
+    order = np.argsort(-sc64, axis=1)[:, :10]
+    ref_idx = np.take_along_axis(best_i, order, axis=1)
+    oracle_s = time.time() - t0
+
+    got = np.full((len(sample), 10), -1, np.int64)
+    for off, nq, idx_c in idx_parts:
+        in_part = (sample >= off) & (sample < off + nq)
+        if in_part.any():
+            idx_np = np.asarray(idx_c)
+            got[in_part] = idx_np[sample[in_part] - off, :10]
+    rec = recall_at_k(got, ref_idx)
+    return {"recall_at_10_vs_cpu_float64": round(rec, 5),
+            "n_queries": int(len(sample)),
+            "oracle_s": round(oracle_s, 1),
+            "scores_fetch_s": round(fetch_s, 2)}
+
+
+# ----------------------------------------------------------------------
+# kernel microbench: pallas vs xla kNN + MFU
+# ----------------------------------------------------------------------
+
+
+def run_kernel_bench(jax, on_tpu):
+    import jax.numpy as jnp
+
+    from sctools_tpu.config import config, configure
+    from sctools_tpu.data.synthetic import gaussian_blobs
+    from sctools_tpu.ops.knn import knn_arrays
+
+    n, d, k = (131072, 50, 15) if on_tpu else (8192, 50, 15)
+    pts, _ = gaussian_blobs(n, d, 10, seed=2)
+    pts = jax.device_put(pts)
+    out = {"n": n, "d": d, "k": k}
+    flops = 2.0 * n * n * d
+    impls = ["xla", "pallas"] if on_tpu else ["xla"]
+    results = {}
+    for impl in impls:
+        try:
+            with configure(knn_impl=impl, matmul_dtype="bfloat16"):
+                t0 = time.time()
+                i1, _ = knn_arrays(pts, pts, k=k, metric="cosine",
+                                   n_query=n, n_cand=n)
+                i1.block_until_ready()
+                first = time.time() - t0
+                t0 = time.time()
+                i2, _ = knn_arrays(pts, pts, k=k, metric="cosine",
+                                   n_query=n, n_cand=n)
+                i2.block_until_ready()
+                steady = time.time() - t0
+            results[impl] = np.asarray(i2)
+            kind = jax.devices()[0].device_kind
+            peak = _PEAK_BF16.get(kind)
+            out[impl] = {"wall_s": round(steady, 3),
+                         "compile_s": round(first - steady, 1),
+                         "gflops": round(flops / steady / 1e9, 1),
+                         "mfu": (round(flops / steady / peak, 3)
+                                 if peak else None)}
+        except Exception as e:
+            out[impl] = {"error": repr(e)[:200]}
+    if "wall_s" in out.get("pallas", {}) and "wall_s" in out.get("xla", {}):
+        out["pallas_speedup_vs_xla"] = round(
+            out["xla"]["wall_s"] / out["pallas"]["wall_s"], 2)
+        # bf16 coarse search can tie-break differently between impls;
+        # require near-total agreement, not bit equality
+        out["pallas_xla_idx_agreement"] = round(float(
+            (results["pallas"] == results["xla"]).mean()), 4)
+    return out
+
+
+def run_packer_bench():
+    """Native C++ ELL packer throughput (csrc/scio.cpp), host-only —
+    no device transfer in the timed region."""
+    from sctools_tpu.native import have_native, pack_ell
+
+    rng = np.random.default_rng(3)
+    n, nnz = 131072, 256
+    g = 4096
+    indptr = np.arange(0, n * nnz + 1, nnz, dtype=np.int64)
+    indices = rng.integers(0, g, size=n * nnz).astype(np.int32)
+    data = rng.random(n * nnz, dtype=np.float32)
+    t0 = time.time()
+    pack_ell(indptr, indices, data, n, 384, sentinel=g)
+    dt = time.time() - t0
+    mb = (indices.nbytes + data.nbytes) / 1e6
+    return {"native": bool(have_native()), "rows": n,
+            "nnz_per_row": nnz, "wall_s": round(dt, 3),
+            "mb_per_s": round(mb / dt, 1)}
+
+
+# ----------------------------------------------------------------------
+# configs[4]: multi-chip dryrun (separate CPU process, virtual mesh)
+# ----------------------------------------------------------------------
+
+
+def run_config4(budget_s: float):
+    """Times the sharded multi-chip pipeline on an 8-device virtual CPU
+    mesh in a subprocess (the TPU process can't host it), and states
+    the projection model for a real v5e-8.  Timings on the virtual
+    mesh measure algorithmic overhead only — all 8 'devices' share
+    this host's core(s); ICI is what the projection models."""
+    import subprocess
+
+    code = (
+        "import json,time,os\n"
+        "import numpy as np\n"
+        "import jax\n"
+        # env JAX_PLATFORMS is not enough where a sitecustomize
+        # force-sets jax_platforms (the axon tunnel session) — the
+        # config update after import is authoritative
+        "jax.config.update('jax_platforms','cpu')\n"
+        "from sctools_tpu.parallel.knn_multichip import"
+        " knn_multichip_arrays\n"
+        "from sctools_tpu.parallel.mesh import make_mesh\n"
+        "from sctools_tpu.data.synthetic import gaussian_blobs\n"
+        "pts,_ = gaussian_blobs(32768, 50, 8, seed=4)\n"
+        "mesh = make_mesh(8)\n"
+        "out={}\n"
+        "for strat in ('ring','all_gather'):\n"
+        "    t0=time.time()\n"
+        "    i,d = knn_multichip_arrays(pts, k=15, metric='cosine',"
+        " mesh=mesh, strategy=strat)\n"
+        "    i.block_until_ready(); first=time.time()-t0\n"
+        "    t0=time.time()\n"
+        "    i,d = knn_multichip_arrays(pts, k=15, metric='cosine',"
+        " mesh=mesh, strategy=strat)\n"
+        "    i.block_until_ready(); out[strat]={'wall_s':"
+        "round(time.time()-t0,3),'compile_s':round(first,1)}\n"
+        "print(json.dumps(out))\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    try:
+        p = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=max(60, budget_s),
+                           cwd=os.path.dirname(os.path.abspath(__file__)),
+                           env=env)
+        for line in reversed(p.stdout.strip().splitlines()):
+            try:
+                res = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+        else:
+            return {"error": (p.stderr or "no output")[-300:]}
+    except subprocess.TimeoutExpired:
+        return {"error": f"config4 subprocess exceeded {budget_s:.0f}s"}
+    res["note"] = ("8 virtual devices on one host CPU — relative "
+                   "algorithmic cost only, not ICI scaling")
+    # Projection model (stated, not measured): brute kNN flops/chip at
+    # 10M cells, 50 dims = (10M/8)*10M*50*2 bf16 flops; ring transfers
+    # move each 50-dim f32 block 7 times over ICI.
+    n10, d = 10_000_000, 50
+    flops_chip = (n10 / 8) * n10 * d * 2
+    ici_bytes = (n10 / 8) * d * 4 * 7
+    proj = {"assumed_chip": "v5e (197 Tflop/s bf16, ~4.5e10 B/s ICI "
+                            "per link per direction)",
+            "knn_compute_s_per_chip_at_40pct_mfu":
+                round(flops_chip / (197e12 * 0.4), 1),
+            "ring_ici_s": round(ici_bytes / 4.5e10, 2),
+            "model": "max(compute, ici) + preprocess+pca (measured "
+                     "single-chip stats/pca scale linearly in cells)"}
+    res["v5e8_projection_10M"] = proj
+    return res
+
+
+# ----------------------------------------------------------------------
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, default=None,
+                    help="run one BASELINE config (0-4); default all")
+    args = ap.parse_args()
+
+    stage("start", budget_s=BUDGET_S, device_timeout_s=DEVICE_TIMEOUT_S)
+    acq = acquire_jax(DEVICE_TIMEOUT_S)
+    jax, backend, waited = acq["jax"], acq["backend"], acq["waited"]
+    headline = {
+        "metric": "preprocess+hvg+pca50+knn15 throughput (single chip)",
+        "value": None, "unit": "cells/s", "vs_baseline": None,
+        "detail": {"backend": backend, "acquire_s": round(waited, 1)},
+    }
+    if jax is None:
+        stage("acquire.failed", waited_s=round(waited, 1),
+              hung=acq["hung"], error=acq["error"])
+        if not ALLOW_CPU or acq["hung"]:
+            # A hung init holds jax's backend-init lock — in-process
+            # CPU fallback would block on the same lock, so even
+            # ALLOW_CPU can't save a hung tunnel.
+            headline["error"] = (
+                f"no TPU: jax.devices() did not return within "
+                f"{DEVICE_TIMEOUT_S:.0f}s "
+                f"({'init hung' if acq['hung'] else acq['error']}); "
+                f"refusing to benchmark a CPU fallback as the TPU number"
+                + ("" if acq["hung"] else
+                   " (set SCTOOLS_BENCH_ALLOW_CPU=1 to override)"))
+            print(json.dumps(headline), flush=True)
+            return 0
+        import jax  # noqa: F811 - already imported by the thread
+
+        jax.config.update("jax_platforms", "cpu")
+        backend = jax.default_backend()
     on_tpu = backend in ("tpu", "axon")
-    n_cells = int(os.environ.get("SCTOOLS_BENCH_CELLS",
-                                 200_000 if on_tpu else 20_000))
-    n_genes = int(os.environ.get("SCTOOLS_BENCH_GENES",
-                                 20_000 if on_tpu else 2_000))
-    nnz = int(os.environ.get("SCTOOLS_BENCH_NNZ", 600 if on_tpu else 100))
+    if not on_tpu and not ALLOW_CPU:
+        headline["error"] = (f"backend is {backend!r}, not a TPU; refusing "
+                             "to report CPU as the TPU number")
+        stage("acquire.wrong_backend", backend=backend)
+        print(json.dumps(headline), flush=True)
+        return 0
+    stage("acquire.ok", backend=backend, waited_s=round(waited, 1),
+          device_kind=jax.devices()[0].device_kind,
+          n_devices=len(jax.devices()))
+
+    from sctools_tpu.config import config
+
     config.matmul_dtype = os.environ.get(
         "SCTOOLS_BENCH_DTYPE", "bfloat16" if on_tpu else "float32")
 
-    t0 = time.time()
-    d = synthetic_ell(n_cells, n_genes, nnz_per_cell=nnz, n_clusters=10,
-                      seed=0)
-    gen_s = time.time() - t0
+    detail = headline["detail"]
+    detail["backend"] = backend
+    want = (lambda i: args.config is None or args.config == i)
 
-    x_host_idx, x_host_dat = d["indices"], d["data"]
+    if want(0) and remaining() > 60:
+        try:
+            detail["config0_normalize_pbmc3k"] = stage(
+                "config0", **run_config0(jax))
+        except Exception as e:
+            detail["config0_normalize_pbmc3k"] = {"error": repr(e)[:300]}
+            stage("config0.error", error=repr(e)[:300])
+    if want(1) and remaining() > 60:
+        try:
+            detail["config1_qc_68k"] = stage("config1", **run_config1(jax))
+        except Exception as e:
+            detail["config1_qc_68k"] = {"error": repr(e)[:300]}
+            stage("config1.error", error=repr(e)[:300])
 
-    def run_pipeline():
-        x = SparseCells(jnp.asarray(x_host_idx), jnp.asarray(x_host_dat),
-                        n_cells, n_genes)
-        data = sct.CellData(x)
-        data = sct.apply("qc.per_cell_metrics", data, backend="tpu")
-        data = sct.apply("normalize.library_size", data, backend="tpu",
-                         target_sum=1e4)
-        data = sct.apply("normalize.log1p", data, backend="tpu")
-        data = sct.apply("hvg.select", data, backend="tpu", n_top=2000)
-        scores, comps, expl, mu = randomized_pca_arrays(
-            data.X, jax.random.PRNGKey(0), n_components=50, n_iter=2)
-        # coarse bf16 search for 64 candidates, exact f32 re-rank to 15
-        idx, dist = knn_arrays(scores, scores, k=15, metric="cosine",
-                               n_query=n_cells, n_cand=n_cells, refine=64)
-        return scores, idx, dist
+    # atlas-scale source shared by configs[2] and [3]
+    n_cells = int(os.environ.get("SCTOOLS_BENCH_CELLS",
+                                 1_300_000 if on_tpu else 65_536))
+    n_genes = int(os.environ.get("SCTOOLS_BENCH_GENES",
+                                 28_672 if on_tpu else 2_048))
+    capacity = int(os.environ.get("SCTOOLS_BENCH_NNZ",
+                                  512 if on_tpu else 128))
+    src = None
+    if (want(2) or want(3)) and remaining() > 120:
+        # shrink if the budget is already mostly gone (slow acquire)
+        while n_cells > 131072 and remaining() < 180 + n_cells / 4000:
+            n_cells //= 2
+        try:
+            src, gen_s = _make_source(jax, n_cells, n_genes, capacity,
+                                      materialize=True)
+            stage("datagen", n_cells=n_cells, n_genes=n_genes,
+                  capacity=capacity, wall_s=round(gen_s, 1),
+                  hbm_gb=round(n_cells * src.capacity * 8 / 1e9, 2))
+        except Exception as e:
+            stage("datagen.error", error=repr(e)[:300])
+            src = None
+    if want(2) and src is not None and remaining() > 90:
+        try:
+            c2, _stats, _hvg = run_config2(jax, src)
+            detail["config2_hvg_1.3M"] = stage("config2", **c2)
+        except Exception as e:
+            detail["config2_hvg_1.3M"] = {"error": repr(e)[:300]}
+            stage("config2.error", error=repr(e)[:300])
+    if want(3) and src is not None and remaining() > 120:
+        try:
+            c3, scores, idx_parts = run_config3(jax, src)
+            detail["config3_pca_knn"] = stage("config3", **c3)
+            headline["value"] = c3["cells_per_s"]
+            headline["vs_baseline"] = round(
+                c3["cells_per_s"] / TARGET_RATE, 3)
+        except Exception as e:
+            scores = None
+            detail["config3_pca_knn"] = {"error": repr(e)[:300]}
+            stage("config3.error", error=repr(e)[:300])
+        if scores is not None and remaining() > 45:
+            try:
+                rec = run_recall(jax, scores, idx_parts, src.n_cells)
+                detail["config3_pca_knn"].update(rec)
+                stage("recall", **rec)
+            except Exception as e:
+                detail["config3_pca_knn"]["recall_error"] = repr(e)[:300]
+                stage("recall.error", error=repr(e)[:300])
 
-    # Warm-up/compile pass on a slice? Shapes differ -> just time two
-    # full passes and report the second (steady-state, driver-friendly).
-    t1 = time.time()
-    scores, idx, dist = run_pipeline()
-    idx.block_until_ready()
-    first_s = time.time() - t1
+    if args.config is None and remaining() > 90:
+        try:
+            detail["kernel_knn"] = stage(
+                "kernel_knn", **run_kernel_bench(jax, on_tpu))
+        except Exception as e:
+            detail["kernel_knn"] = {"error": repr(e)[:300]}
+            stage("kernel.error", error=repr(e)[:300])
+    if args.config is None and remaining() > 30:
+        try:
+            detail["native_packer"] = stage("packer", **run_packer_bench())
+        except Exception as e:
+            detail["native_packer"] = {"error": repr(e)[:300]}
+    if want(4) and remaining() > 90:
+        try:
+            detail["config4_multichip"] = stage(
+                "config4", **run_config4(min(remaining() - 30, 420)))
+        except Exception as e:
+            detail["config4_multichip"] = {"error": repr(e)[:300]}
+            stage("config4.error", error=repr(e)[:300])
 
-    t2 = time.time()
-    scores, idx, dist = run_pipeline()
-    idx.block_until_ready()
-    steady_s = time.time() - t2
-
-    # Recall@10 on a sample of queries vs the full candidate set.
-    rng = np.random.default_rng(1)
-    n_sample = min(512, n_cells)
-    sample = rng.choice(n_cells, size=n_sample, replace=False)
-    emb = np.asarray(scores)[:n_cells].astype(np.float64)
-    ref_idx, _ = knn_numpy(emb[sample], emb, k=10, metric="cosine")
-    got = np.asarray(idx)[sample, :10]
-    recall = recall_at_k(got, ref_idx)
-
-    cells_per_s = n_cells / steady_s
-    target_rate = 10_000_000 / 300.0 / 8.0  # north-star: 4166.7 cells/s/chip
-    out = {
-        "metric": "preprocess+hvg+pca50+knn15 throughput (single chip)",
-        "value": round(cells_per_s, 1),
-        "unit": "cells/s",
-        "vs_baseline": round(cells_per_s / target_rate, 3),
-        "detail": {
-            "backend": backend,
-            "n_cells": n_cells,
-            "n_genes": n_genes,
-            "nnz_per_cell": nnz,
-            "matmul_dtype": config.matmul_dtype,
-            "wall_s_steady": round(steady_s, 2),
-            "wall_s_first(incl_compile)": round(first_s, 2),
-            "datagen_s": round(gen_s, 2),
-            "recall_at_10_vs_cpu_float64": round(recall, 4),
-        },
-    }
-    print(json.dumps(out))
+    if not on_tpu:
+        headline["metric"] += " (CPU-FALLBACK, not a TPU number)"
+        headline["vs_baseline"] = None
+    headline["detail"] = detail
+    stage("done", total_s=round(time.time() - T_START, 1))
+    print(json.dumps(headline, default=float), flush=True)
+    return 0
 
 
 if __name__ == "__main__":

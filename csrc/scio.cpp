@@ -8,24 +8,22 @@
 //
 // Build: make -C csrc   (produces libscio.so)
 
+#include <algorithm>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <thread>
 #include <vector>
 
-extern "C" {
+namespace {
 
-// CSR -> padded-ELL.  out_idx must be pre-filled with `sentinel`,
-// out_val with zeros (caller allocates; we only touch occupied slots).
-void scio_pack_ell_f32(const int64_t* indptr, const int32_t* indices,
-                       const float* data, int64_t n_rows,
-                       int64_t rows_padded, int64_t capacity,
-                       int32_t sentinel, int32_t* out_idx,
-                       float* out_val) {
-  (void)rows_padded;
-  (void)sentinel;
-  for (int64_t r = 0; r < n_rows; ++r) {
+// Row-range worker: rows are disjoint, so threads never touch the
+// same output bytes (each row owns its capacity-strided slice).
+void pack_rows(const int64_t* indptr, const int32_t* indices,
+               const float* data, int64_t capacity, int32_t* out_idx,
+               float* out_val, int64_t r0, int64_t r1) {
+  for (int64_t r = r0; r < r1; ++r) {
     const int64_t lo = indptr[r], hi = indptr[r + 1];
     // Clamp to capacity: an oversized row must not overwrite its
     // neighbours (the Python layer validates capacity up front; this
@@ -37,6 +35,46 @@ void scio_pack_ell_f32(const int64_t* indptr, const int32_t* indices,
     std::memcpy(oi, indices + lo, sizeof(int32_t) * n);
     std::memcpy(ov, data + lo, sizeof(float) * n);
   }
+}
+
+}  // namespace
+
+extern "C" {
+
+// CSR -> padded-ELL.  out_idx must be pre-filled with `sentinel`,
+// out_val with zeros (caller allocates; we only touch occupied slots).
+// Threaded over disjoint row ranges (ctypes releases the GIL around
+// the call); SCTOOLS_PACK_THREADS overrides hardware_concurrency.
+void scio_pack_ell_f32(const int64_t* indptr, const int32_t* indices,
+                       const float* data, int64_t n_rows,
+                       int64_t rows_padded, int64_t capacity,
+                       int32_t sentinel, int32_t* out_idx,
+                       float* out_val) {
+  (void)rows_padded;
+  (void)sentinel;
+  int64_t nt = (int64_t)std::thread::hardware_concurrency();
+  if (const char* env = std::getenv("SCTOOLS_PACK_THREADS")) {
+    nt = std::atoll(env);
+  }
+  nt = std::max<int64_t>(1, std::min<int64_t>(nt, 64));
+  // Below ~32k rows the memcpy loop finishes in well under a
+  // millisecond — thread spawn would dominate.
+  if (nt <= 1 || n_rows < 32768) {
+    pack_rows(indptr, indices, data, capacity, out_idx, out_val, 0, n_rows);
+    return;
+  }
+  std::vector<std::thread> workers;
+  const int64_t step = (n_rows + nt - 1) / nt;
+  for (int64_t t = 1; t < nt; ++t) {
+    const int64_t r0 = t * step;
+    const int64_t r1 = std::min(n_rows, r0 + step);
+    if (r0 >= r1) break;
+    workers.emplace_back(pack_rows, indptr, indices, data, capacity,
+                         out_idx, out_val, r0, r1);
+  }
+  pack_rows(indptr, indices, data, capacity, out_idx, out_val, 0,
+            std::min(n_rows, step));
+  for (auto& w : workers) w.join();
 }
 
 // ---------------------------------------------------------------------

@@ -153,11 +153,10 @@ def stream_stats(src: ShardSource, target_sum: float = 1e4,
     if mito_mask is None:
         mito_mask = np.zeros(src.n_genes, bool)
     mito = jnp.asarray(mito_mask)
-    acc = jnp.zeros((src.n_genes, 3), jnp.float32)
-    totals, ngenes, pct = [], [], []
+    totals, ngenes, pct, shard_stats = [], [], [], []
+    shard_sizes = []
     for offset, shard in src:
         t, g, m, stats = _shard_stats(shard, mito, target_sum)
-        acc = acc + stats
         n = shard.n_cells
         # keep DEVICE arrays here — np.asarray would sync and
         # serialise host IO with device compute; one fetch after the
@@ -165,13 +164,31 @@ def stream_stats(src: ShardSource, target_sum: float = 1e4,
         totals.append(t[:n])
         ngenes.append(g[:n])
         pct.append(m[:n])
+        shard_stats.append(stats)
+        shard_sizes.append(n)
     totals = [np.asarray(t) for t in totals]
     ngenes = [np.asarray(g) for g in ngenes]
     pct = [np.asarray(m) for m in pct]
-    s, ss, nnz = np.asarray(acc).T
+    # Variance via per-shard centered moments combined in float64
+    # (Chan's pairwise update).  Per-shard sums are float32 over <=64k
+    # rows (benign); the naive global ss - n*mean^2 in float32 would
+    # catastrophically cancel for low-dispersion genes at 10M cells.
+    n_acc = 0
+    mean = np.zeros(src.n_genes, np.float64)
+    m2 = np.zeros(src.n_genes, np.float64)
+    nnz = np.zeros(src.n_genes, np.float64)
+    for stats, n_i in zip(shard_stats, shard_sizes):
+        s_i, ss_i, nnz_i = np.asarray(stats).T.astype(np.float64)
+        mean_i = s_i / n_i
+        m2_i = np.maximum(ss_i - n_i * mean_i**2, 0.0)
+        delta = mean_i - mean
+        tot = n_acc + n_i
+        m2 += m2_i + delta**2 * (n_acc * n_i / tot)
+        mean += delta * (n_i / tot)
+        nnz += nnz_i
+        n_acc = tot
     n = src.n_cells
-    mean = s / n
-    var = np.maximum((ss - n * mean**2) / max(n - 1, 1), 0.0)
+    var = np.maximum(m2 / max(n - 1, 1), 0.0)
     return {
         "total_counts": np.concatenate(totals),
         "n_genes": np.concatenate(ngenes),

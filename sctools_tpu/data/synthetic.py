@@ -11,6 +11,10 @@ rate vectors.
 
 from __future__ import annotations
 
+from functools import partial
+
+import jax
+import jax.numpy as jnp
 import numpy as np
 import scipy.sparse as sp
 
@@ -147,6 +151,127 @@ def synthetic_ell(
     data[row_of, slot_of] = rng.geometric(0.4, size=total).astype(dtype)
     return dict(indices=indices, data=data, n_cells=n_cells,
                 n_genes=n_genes, labels=labels)
+
+
+def _cluster_cdfs(n_genes: int, n_clusters: int, seed: int) -> np.ndarray:
+    """Per-cluster gene-program CDFs (host, tiny): lognormal base rates
+    with cluster-specific boosts — same structure as synthetic_ell."""
+    rng = np.random.default_rng(seed)
+    base = rng.lognormal(mean=0.0, sigma=1.5, size=n_genes)
+    programs = np.tile(base, (n_clusters, 1))
+    for c in range(1, n_clusters):
+        boost = rng.choice(n_genes, size=max(1, n_genes // 20),
+                           replace=False)
+        programs[c, boost] *= rng.uniform(3.0, 10.0, size=len(boost))
+    programs /= programs.sum(axis=1, keepdims=True)
+    return np.cumsum(programs, axis=1).astype(np.float32)
+
+
+def ell_shard_device(key, cdfs, n_valid, *, rows: int, capacity: int,
+                     n_genes: int):
+    """Generate one padded-ELL shard ON DEVICE (no host RAM, no
+    host→device transfer — essential on bench hosts with one CPU core
+    and a tunneled TPU).
+
+    Every valid row has exactly ``capacity`` stored draws (duplicate
+    gene ids act as summed counts — harmless for the linear ops, see
+    synthetic_ell); rows >= ``n_valid`` are zeroed/sentineled padding.
+    Counts are geometric(p=0.4); gene ids are inverse-CDF draws from
+    the row's cluster program.  Deterministic in ``key`` — re-iterating
+    a source regenerates bit-identical shards.
+
+    Returns (indices (rows, capacity) int32, data (rows, capacity) f32,
+    labels (rows,) int32).
+    """
+    return _ell_shard_device_jit(key, cdfs, jnp.asarray(n_valid),
+                                 rows=rows, capacity=capacity,
+                                 n_genes=n_genes)
+
+
+@partial(jax.jit, static_argnames=("rows", "capacity", "n_genes"))
+def _ell_shard_device_jit(key, cdfs, n_valid, *, rows, capacity, n_genes):
+    n_clusters = cdfs.shape[0]
+    ku, kv, kl = jax.random.split(key, 3)
+    labels = jax.random.randint(kl, (rows,), 0, n_clusters)
+    u = jax.random.uniform(ku, (rows, capacity), jnp.float32)
+    idx = jnp.zeros((rows, capacity), jnp.int32)
+    for c in range(n_clusters):  # static unroll; n_clusters is small
+        g = jnp.searchsorted(cdfs[c], u).astype(jnp.int32)
+        idx = jnp.where((labels == c)[:, None], g, idx)
+    idx = jnp.clip(idx, 0, n_genes - 1)
+    uv = jax.random.uniform(kv, (rows, capacity), jnp.float32,
+                            minval=1e-7, maxval=1.0)
+    vals = jnp.ceil(jnp.log1p(-uv * (1 - 1e-7)) /
+                    float(np.log(1.0 - 0.4))).astype(jnp.float32)
+    vals = jnp.maximum(vals, 1.0)
+    row_ok = jnp.arange(rows) < n_valid
+    idx = jnp.where(row_ok[:, None], idx, n_genes)
+    vals = jnp.where(row_ok[:, None], vals, 0.0)
+    return idx, vals, labels
+
+
+class DeviceSyntheticSource:
+    """ShardSource-compatible source of device-generated synthetic
+    shards (see data/stream.py for the consumer protocol: iterating
+    yields ``(row_offset, SparseCells)`` with uniform shard shapes).
+
+    ``materialize=True`` generates every shard once and keeps it in
+    HBM (fastest for multi-pass algorithms like streaming PCA when the
+    matrix fits); ``False`` regenerates each shard deterministically
+    from the per-shard key on every pass — zero steady-state HBM
+    beyond the shard being processed, mimicking an IO-backed stream.
+    """
+
+    def __init__(self, n_cells: int, n_genes: int, *, capacity: int = 512,
+                 shard_rows: int = 131072, n_clusters: int = 8,
+                 seed: int = 0, materialize: bool = True):
+        from ..config import config, round_up
+
+        self.n_cells = int(n_cells)
+        self.n_genes = int(n_genes)
+        self.capacity = round_up(capacity, config.capacity_multiple)
+        self.shard_rows = min(round_up(shard_rows, config.sublane),
+                              round_up(self.n_cells, config.sublane))
+        self.seed = seed
+        self._cdfs = None  # device cdfs, built lazily
+        self._n_clusters = n_clusters
+        self._shards = None
+        if materialize:
+            self._shards = list(self._generate())
+
+    def _gen_cdfs(self):
+        if self._cdfs is None:
+            import jax as _jax
+
+            self._cdfs = _jax.device_put(
+                _cluster_cdfs(self.n_genes, self._n_clusters, self.seed))
+        return self._cdfs
+
+    def _generate(self):
+        import jax as _jax
+
+        from .sparse import SparseCells
+
+        cdfs = self._gen_cdfs()
+        base = _jax.random.PRNGKey(self.seed)
+        for si, start in enumerate(range(0, self.n_cells, self.shard_rows)):
+            n_valid = min(self.shard_rows, self.n_cells - start)
+            idx, dat, _ = ell_shard_device(
+                _jax.random.fold_in(base, si), cdfs, n_valid,
+                rows=self.shard_rows, capacity=self.capacity,
+                n_genes=self.n_genes)
+            yield SparseCells(idx, dat, n_valid, self.n_genes)
+
+    def __iter__(self):
+        shards = self._shards if self._shards is not None else self._generate()
+        offset = 0
+        for shard in shards:
+            yield offset, shard
+            offset += shard.n_cells
+
+    @property
+    def n_shards(self) -> int:
+        return -(-self.n_cells // self.shard_rows)
 
 
 def gaussian_blobs(

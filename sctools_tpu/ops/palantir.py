@@ -190,19 +190,31 @@ def fate_probs_arrays(knn_idx, p_edges, terminal_onehot, is_terminal,
 
 def _find_terminal_states(knn_idx, stationary, pseudotime,
                           max_terminal: int = 10,
-                          pt_quantile: float = 0.7):
+                          pt_quantile: float = 0.7,
+                          reachable=None):
     """Late-pseudotime local maxima of stationary mass, deduplicated
-    through the graph (host-side)."""
+    through the graph (host-side).
+
+    ``reachable``: bool mask of cells reachable from the root.  The
+    callers clamp unreachable cells' pseudotime to the max *before*
+    this runs, which would otherwise park every disconnected component
+    in the late-pseudotime quantile where its stationary-mass maximum
+    can be picked as a spurious terminal state — so unreachable cells
+    are excluded from candidacy here.
+    """
     idx = np.asarray(knn_idx)
     pi = np.asarray(stationary, np.float64)
     pt = np.asarray(pseudotime, np.float64)
     n, k = idx.shape
+    if reachable is None:
+        reachable = np.isfinite(pt)
+    reachable = np.asarray(reachable, bool)
     safe = np.where(idx < 0, 0, idx)
     nb_pi = np.where(idx < 0, -np.inf, pi[safe])
     is_max = pi >= nb_pi.max(axis=1)
-    finite_pt = pt[np.isfinite(pt)]
+    finite_pt = pt[np.isfinite(pt) & reachable]
     late = pt >= np.quantile(finite_pt, pt_quantile)
-    cand = np.flatnonzero(is_max & late & np.isfinite(pt))
+    cand = np.flatnonzero(is_max & late & np.isfinite(pt) & reachable)
     cand = cand[np.argsort(-pi[cand])]
     chosen: list[int] = []
     taken = np.zeros(n, bool)
@@ -279,6 +291,7 @@ def palantir_tpu(data: CellData, root: int = 0, terminal_states=None,
                 f"after {4 * sp_rounds} relaxation rounds (disconnected "
                 "graph or raise sp_rounds); their pseudotime is clamped "
                 "to the max", stacklevel=2)
+    reach = np.isfinite(np.asarray(d))
     pt_max = jnp.max(jnp.where(jnp.isfinite(d), d, 0.0))
     pt = jnp.where(jnp.isfinite(d), d, pt_max) / jnp.maximum(pt_max, 1e-12)
 
@@ -286,7 +299,8 @@ def palantir_tpu(data: CellData, root: int = 0, terminal_states=None,
     if terminal_states is None:
         pi = stationary_arrays(idx_j, p)
         terminal_states = _find_terminal_states(
-            idx, pi, np.asarray(pt), max_terminal=max_terminal)
+            idx, pi, np.asarray(pt), max_terminal=max_terminal,
+            reachable=reach)
     terminal_states = np.asarray(terminal_states, np.int64)
     T = len(terminal_states)
     if T == 0:
@@ -434,6 +448,7 @@ def palantir_cpu(data: CellData, root: int = 0, terminal_states=None,
     Wlen = sp.csr_matrix(
         (elen.reshape(-1)[keep], (rows[keep], cols[keep])), shape=(n, n))
     d = dijkstra(Wlen, directed=False, indices=root)
+    reach = np.isfinite(d)
     pt_max = np.nanmax(np.where(np.isfinite(d), d, np.nan))
     pt = np.where(np.isfinite(d), d, pt_max) / max(pt_max, 1e-12)
 
@@ -445,7 +460,8 @@ def palantir_cpu(data: CellData, root: int = 0, terminal_states=None,
         pi = np.asarray(stationary_arrays(jnp.asarray(idx),
                                           jnp.asarray(p)))
         terminal_states = _find_terminal_states(idx, pi, pt,
-                                                max_terminal=max_terminal)
+                                                max_terminal=max_terminal,
+                                                reachable=reach)
     terminal_states = np.asarray(terminal_states, np.int64)
     T = len(terminal_states)
     if T == 0:

@@ -163,10 +163,16 @@ def pallas_knn_arrays(query, cand, *, k: int = 15, metric: str = "cosine",
         raise ValueError(f"unknown metric {metric!r}")
     n_query = n_query or query.shape[0]
     n_cand = n_cand or cand.shape[0]
+    # Mosaic requires VMEM tiles aligned to the (sublane, lane) grid:
+    # round user-supplied block sizes up to the f32 tile multiples
+    # instead of handing an unaligned BlockSpec to the compiler.
+    qb = query_block or min(config.row_block, 256)
+    cb = cand_block or min(config.col_block, 1024)
+    qb = round_up(max(qb, config.sublane), config.sublane)
+    cb = round_up(max(cb, config.lane), config.lane)
     return _pallas_knn_jit(
         query, cand, k=k, metric=metric, n_query=n_query, n_cand=n_cand,
-        qb=query_block or min(config.row_block, 256),
-        cb=cand_block or min(config.col_block, 1024),
+        qb=qb, cb=cb,
         mm_dtype=str(jnp.dtype(config.matmul_dtype)),
         exclude_self=exclude_self,
         interpret=config.interpret_mode(),

@@ -230,8 +230,10 @@ def fa2_layout_arrays(knn_idx, weights, init, key, n_epochs: int = 300,
 
     Linear attraction ``-w·diff`` along edges, degree-scaled
     ``(deg_i+1)(deg_j+1)/d²`` repulsion estimated by negative sampling
-    (rescaled by n/n_neg to approximate the all-pairs sum), and a
-    gravity term pulling to the origin.  Same vectorised scheme as the
+    (``repulsion`` times the *sample mean* over ``n_neg`` draws — the
+    mean-repulsion parameterisation, so the repulsion magnitude is
+    independent of graph size; the CPU oracle uses the same scheme),
+    and a gravity term pulling to the origin.  Same vectorised scheme as the
     UMAP optimiser: one ``lax.scan`` over epochs, no host round-trips.
     """
     n, k = knn_idx.shape
@@ -242,7 +244,7 @@ def fa2_layout_arrays(knn_idx, weights, init, key, n_epochs: int = 300,
     deg = jnp.sum(w, axis=1) + 1.0  # (n,)
     y0 = jnp.asarray(init, jnp.float32)
     eps = 1e-3
-    scale_rep = repulsion * n / max(n_neg, 1)
+    rep_scale = repulsion / max(n_neg, 1)  # mean over the n_neg draws
 
     def epoch(y, inp):
         step, ekey = inp
@@ -259,7 +261,7 @@ def fa2_layout_arrays(knn_idx, weights, init, key, n_epochs: int = 300,
         d2n = jnp.sum(diff_n * diff_n, axis=2)
         rep_c = (deg[:, None] * jnp.take(deg, negs)) / (eps + d2n)
         rep = jnp.clip(rep_c[:, :, None] * diff_n, -10.0, 10.0)
-        g = g + scale_rep / n * jnp.sum(rep, axis=1)
+        g = g + rep_scale * jnp.sum(rep, axis=1)
         g = g - gravity * deg[:, None] * y / jnp.maximum(
             jnp.linalg.norm(y, axis=1, keepdims=True), eps)
         return y + alpha * jnp.clip(g, -10.0, 10.0), None
@@ -328,7 +330,7 @@ def force_directed_cpu(data: CellData, n_dims: int = 2,
     rng = np.random.default_rng(seed)
     y = np.asarray(init, np.float64).copy()
     eps = 1e-3
-    scale_rep = repulsion * n / max(n_neg, 1)
+    rep_scale = repulsion / max(n_neg, 1)  # mirrors the TPU kernel
     for step in range(n_epochs):
         alpha = lr * (1.0 - step / n_epochs)
         diff = y[:, None, :] - y[safe]
@@ -339,7 +341,7 @@ def force_directed_cpu(data: CellData, n_dims: int = 2,
         diff_n = y[:, None, :] - y[negs]
         d2n = (diff_n * diff_n).sum(2)
         rep_c = (deg[:, None] * deg[negs]) / (eps + d2n)
-        g = g + scale_rep / n * np.clip(
+        g = g + rep_scale * np.clip(
             rep_c[:, :, None] * diff_n, -10.0, 10.0).sum(1)
         g = g - gravity * deg[:, None] * y / np.maximum(
             np.linalg.norm(y, axis=1, keepdims=True), eps)

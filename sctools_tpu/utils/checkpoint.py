@@ -110,8 +110,11 @@ class PipelineCheckpointer:
     >>> out = ckpt.run(data, backend="tpu")       # writes step files
     >>> out = ckpt.run(data, backend="tpu")       # resumes: loads last
 
-    Step files are named ``step{i:03d}_{transform}.npz``; a change to
-    the step list invalidates mismatched names automatically.
+    Step files are named ``step{i:03d}_{transform}_{paramhash}.npz``;
+    a change to the step list OR to any step's parameters invalidates
+    mismatched names automatically (the hash covers every step up to
+    and including step ``i``, so editing an earlier step also
+    invalidates everything downstream of it).
     """
 
     def __init__(self, pipeline, directory: str, save_every: int = 1):
@@ -120,9 +123,44 @@ class PipelineCheckpointer:
         self.save_every = max(1, save_every)
         os.makedirs(directory, exist_ok=True)
 
-    def _step_path(self, i: int, name: str) -> str:
+    def _step_path(self, i: int, steps) -> str:
+        import hashlib
+
+        name = steps[i].name
         safe = name.replace(".", "_").replace("/", "_")
-        return os.path.join(self.directory, f"step{i:03d}_{safe}.npz")
+
+        def sig(v, h):
+            # repr() alone is unsafe: numpy elides large arrays
+            # ("[0, 1, ..., 9]"), so two configs differing mid-array
+            # would collide — hash raw bytes for array-likes instead
+            if isinstance(v, (list, tuple)):
+                h.update(f"<{type(v).__name__}{len(v)}".encode())
+                for x in v:
+                    sig(x, h)
+                h.update(b">")
+            elif isinstance(v, dict):
+                h.update(f"<dict{len(v)}".encode())
+                for kk in sorted(v, key=repr):
+                    h.update(repr(kk).encode())
+                    sig(v[kk], h)
+                h.update(b">")
+            elif isinstance(v, np.ndarray) or type(v).__module__.startswith(
+                    ("jax", "jaxlib")):
+                a = np.asarray(v)
+                h.update(f"nd{a.dtype}{a.shape}".encode())
+                h.update(np.ascontiguousarray(a).tobytes())
+            else:
+                h.update(repr(v).encode())
+
+        # hash of the (name, sorted params) prefix chain — stale
+        # checkpoints from a different configuration (or an edited
+        # earlier step) are never resumed
+        h = hashlib.sha256()
+        for t in steps[: i + 1]:
+            h.update(t.name.encode())
+            sig(dict(t.params), h)
+        hx = h.hexdigest()[:10]
+        return os.path.join(self.directory, f"step{i:03d}_{safe}_{hx}.npz")
 
     def run(self, data: CellData, backend: str | None = None,
             resume: bool = True) -> CellData:
@@ -130,7 +168,7 @@ class PipelineCheckpointer:
         start = 0
         if resume:
             for i in range(len(steps) - 1, -1, -1):
-                p = self._step_path(i, steps[i].name)
+                p = self._step_path(i, steps)
                 if os.path.exists(p):
                     data = load_celldata(p)
                     if backend in (None, "tpu"):
@@ -143,7 +181,7 @@ class PipelineCheckpointer:
                 t = t.with_backend(backend)
             data = t(data)
             if (i + 1) % self.save_every == 0 or i == len(steps) - 1:
-                save_celldata(data, self._step_path(i, steps[i].name))
+                save_celldata(data, self._step_path(i, steps))
         return data
 
     def clear(self) -> None:

@@ -113,5 +113,9 @@ def profile(logdir: str):
             if started:
                 try:
                     jax.profiler.stop_trace()
-                except Exception:
-                    pass
+                except Exception as e:
+                    import warnings
+
+                    warnings.warn(
+                        f"profiler stop_trace failed: {e!r}; trace in "
+                        f"{logdir} may be incomplete", stacklevel=2)

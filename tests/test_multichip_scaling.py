@@ -1,0 +1,75 @@
+"""Multi-chip kNN scaling properties, asserted from the COMPILED
+program rather than wall-clock (8 virtual devices share one host core,
+so timings measure nothing about ICI — the collective structure and
+per-device memory footprint are what distinguish the strategies).
+
+Reference parity: BASELINE.json configs[4] — "multi-chip kNN …
+ICI all-gather"; the ring strategy is the memory-scalable variant
+(constant per-device working set vs all_gather's O(N·d))."""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sctools_tpu.config import config, round_up
+from sctools_tpu.data.synthetic import gaussian_blobs
+from sctools_tpu.parallel import make_mesh
+from sctools_tpu.parallel.knn_multichip import _knn_multichip_jit
+from sctools_tpu.parallel.mesh import CELL_AXIS
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def _lower(strategy, n=16384, d=32, k=15):
+    mesh = make_mesh(8)
+    block = round_up(n // 8, 8)
+    pts, _ = gaussian_blobs(n, d, 4, seed=0)
+    sharding = NamedSharding(mesh, P(CELL_AXIS, None))
+    pts = jax.device_put(jnp.asarray(pts), sharding)
+    return _knn_multichip_jit.lower(
+        pts, k=k, metric="cosine", n_valid=n, block=block,
+        exclude_self=False, strategy=strategy, mesh=mesh,
+        mm_dtype="float32").compile()
+
+
+@pytest.fixture(scope="module")
+def compiled():
+    return {s: _lower(s) for s in ("ring", "all_gather")}
+
+
+def test_ring_uses_ppermute_not_allgather(compiled):
+    hlo = compiled["ring"].as_text()
+    assert "collective-permute" in hlo
+    # the ring must never materialise the full gathered candidate set
+    assert "all-gather" not in hlo
+
+
+def test_allgather_uses_allgather(compiled):
+    hlo = compiled["all_gather"].as_text()
+    assert "all-gather" in hlo
+
+
+def _largest_f32_rows(hlo: str) -> int:
+    # largest leading dim of any f32 tensor in the compiled program —
+    # a shape-level proxy for the working-set scaling claim
+    return max((int(m.group(1)) for m in
+                re.finditer(r"f32\[(\d+),\d+\]", hlo)), default=0)
+
+
+def test_ring_working_set_stays_sharded(compiled):
+    n = 16384
+    ring_rows = _largest_f32_rows(compiled["ring"].as_text())
+    ag_rows = _largest_f32_rows(compiled["all_gather"].as_text())
+    # all_gather materialises every row on every device; the ring keeps
+    # at most a few blocks (shard + in-flight neighbour) resident
+    assert ag_rows >= n
+    assert ring_rows <= n // 8 * 3, (ring_rows, ag_rows)
+
+
+# Note: compiled.memory_analysis() is NOT asserted here — on the
+# virtual CPU mesh it reports whole-process totals (all 8 "devices"
+# share one host executable), where the ring's unrolled scan state
+# looks bigger than the all_gather buffer.  The per-device working-set
+# claim is what the f32-shape scan above checks.
