@@ -56,6 +56,19 @@ class Config:
     # Capacity rounding for the padded-ELL sparse format.
     capacity_multiple: int = 128
 
+    # Streaming loops: block on each shard's outputs before dispatching
+    # the next shard.  "auto" => sync only on the tunneled single-chip
+    # backend ("axon"), where deep async pipelines of large mixed
+    # programs have been observed to crash or wedge the remote worker
+    # (see bench.py's round-4 notes); on real local TPUs the async
+    # overlap is the whole point and stays on.
+    stream_sync: str = "auto"
+
+    def stream_sync_enabled(self) -> bool:
+        if self.stream_sync == "auto":
+            return jax.default_backend() == "axon"
+        return self.stream_sync in ("1", "true", "True", True)
+
     def interpret_mode(self) -> bool:
         if self.pallas_interpret == "auto":
             return jax.default_backend() not in ("tpu", "axon")

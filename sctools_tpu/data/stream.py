@@ -16,9 +16,10 @@ So the streaming decomposition is:
   log1p'd, and reduced — per-cell QC metrics and per-gene
   (Σ, Σ², nnz) accumulate on device while the next shard loads (jax
   async dispatch overlaps the host IO with device compute);
-* **HVG selection** from the accumulated per-gene moments
-  (dispersion flavor — the normalised-variance ranking computable
-  from one streaming pass);
+* **HVG selection**: seurat_v3 (the BASELINE configs[2] flavor) fits
+  the mean-variance trend on the pass-1 raw moments, then streams ONE
+  more clipped-second-moment pass; the one-pass dispersion flavor
+  needs no second pass;
 * **streaming randomized PCA**: the power iteration's tall-skinny
   iterates Y/Q stay device-resident; each (re-)materialisation of
   ``Y = X_c @ Q`` / ``Z = X_cᵀ @ Q`` streams the HVG-subset shards
@@ -44,7 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import config, round_up
-from .sparse import SparseCells, gene_stats, spmm, spmm_t
+from .sparse import SparseCells, segment_reduce, spmm, spmm_t
 
 
 # ----------------------------------------------------------------------
@@ -55,18 +56,52 @@ from .sparse import SparseCells, gene_stats, spmm, spmm_t
 @dataclasses.dataclass
 class ShardSource:
     """A re-iterable source of (row_offset, device SparseCells) shards
-    with uniform shapes (one compiled program serves every shard)."""
+    with uniform shapes (one compiled program serves every shard).
+
+    ``sharding`` (optional, e.g. ``cell_sharding(mesh)``) places every
+    shard cells-axis-sharded across a device mesh at ``device_put``
+    time — the jitted per-shard programs then run SPMD with GSPMD
+    collectives, composing out-of-core streaming with multi-chip
+    execution (the 10M×30k north star needs both at once).  Use
+    :meth:`with_mesh` to get a mesh-placed view of a source."""
 
     factory: Callable[[], Iterator[SparseCells]]
     n_cells: int
     n_genes: int
     shard_rows: int
+    sharding: object | None = None
 
     def __iter__(self):
         offset = 0
         for shard in self.factory():
-            yield offset, shard.device_put()
+            yield offset, shard.device_put(self.sharding)
             offset += shard.n_cells
+
+    def with_mesh(self, mesh) -> "ShardSource":
+        """Copy of this source whose shards are placed cells-axis-
+        sharded over ``mesh``.  Intermediate shards must divide evenly
+        across the mesh (their ``rows_padded`` must equal
+        ``shard_rows``, which therefore must be a mesh-size multiple —
+        offsets would misalign otherwise, see from_h5ad)."""
+        from ..parallel.mesh import cell_sharding
+
+        n_dev = int(mesh.devices.size)
+        mult = n_dev * config.sublane
+        if self.shard_rows % mult:
+            raise ValueError(
+                f"shard_rows={self.shard_rows} must be a multiple of "
+                f"mesh size × sublane = {mult} to shard evenly")
+        base = self.factory
+
+        def factory():
+            # the LAST shard may be short — pad its rows to a mesh
+            # multiple so device_put can split it evenly (padding rows
+            # are sentinel/zero, annihilated by every op)
+            for shard in base():
+                yield shard.pad_rows_to(round_up(shard.rows_padded, mult))
+
+        return dataclasses.replace(self, factory=factory,
+                                   sharding=cell_sharding(mesh))
 
     @property
     def n_shards(self) -> int:
@@ -129,7 +164,22 @@ class ShardSource:
 @partial(jax.jit, static_argnames=("target_sum",))
 def _shard_stats(x: SparseCells, mito_mask, target_sum: float):
     """Per-shard: (per-cell totals, n_genes, pct_mito;
-    per-gene Σ/Σ²/nnz of log1p-normalised values)."""
+    per-gene moments of BOTH the raw counts and the log1p-normalised
+    values, stacked as columns [s_raw, m2_raw, s_norm, m2_norm, nnz]).
+
+    The second moments are SHARD-MEAN-CENTERED sums of squares, not
+    raw Σx²: ``m2 = Σ_valid (x − μ_g)² + (n_valid − nnz_g)·μ_g²``
+    with μ_g the shard's own per-gene mean.  Every term is
+    non-negative, so the float32 segment sum carries ~√N·ε relative
+    error of m2 ITSELF — computing Σx² in f32 and subtracting n·μ²
+    later cancels catastrophically for low-dispersion genes where
+    μ² ≫ var, regardless of shard size.  Shards combine in float64
+    via Chan's pairwise update (stream_stats).
+
+    Two fused segment passes over one index stream: pass A gets
+    (Σ_raw, Σ_norm, nnz); pass B, seeded with pass A's on-device
+    means, gets the two centered squares.  No host sync between them.
+    """
     from ..ops.normalize import _library_size_sparse
 
     totals = jnp.sum(x.data, axis=1)
@@ -141,9 +191,49 @@ def _shard_stats(x: SparseCells, mito_mask, target_sum: float):
     pct_mito = jnp.where(totals > 0, 100.0 * mito_counts /
                          jnp.maximum(totals, 1e-12), 0.0)
     xs, _ = _library_size_sparse(x, target_sum)
-    xn = xs.with_data(jnp.log1p(xs.data))
-    s, ss, nnz = gene_stats(xn)
-    return totals, n_genes_cell, pct_mito, jnp.stack([s, ss, nnz], axis=1)
+    xn_data = jnp.log1p(xs.data)
+    # segment_reduce blocks rows to _ROW_CHUNK multiples; pad the
+    # parallel value plane likewise so dynamic_slice stays in range
+    # (same trick as spmm_t)
+    from .sparse import _ROW_CHUNK
+
+    pad = (-x.rows_padded) % _ROW_CHUNK
+    if pad:
+        xn_data = jnp.concatenate(
+            [xn_data, jnp.zeros((pad, x.capacity), xn_data.dtype)])
+    n_cells = x.n_cells
+    sentinel = x.sentinel
+
+    def slot_sums(ind, dat, row_offset):
+        rows = row_offset + jnp.arange(ind.shape[0])
+        valid = (ind != sentinel) & (rows < n_cells)[:, None]
+        blk = jax.lax.dynamic_slice_in_dim(
+            xn_data, row_offset, ind.shape[0])
+        return jnp.stack([dat, blk, valid.astype(dat.dtype)], axis=2)
+
+    sums = segment_reduce(x, slot_sums, 3)  # (G, [s_raw, s_norm, nnz])
+    s_raw, s_norm, nnz = sums[:, 0], sums[:, 1], sums[:, 2]
+    inv_n = 1.0 / max(n_cells, 1)
+    mu_raw = s_raw * inv_n
+    mu_norm = s_norm * inv_n
+    mu_raw_pad = jnp.concatenate([mu_raw, jnp.zeros((1,))])
+    mu_norm_pad = jnp.concatenate([mu_norm, jnp.zeros((1,))])
+
+    def slot_sq(ind, dat, row_offset):
+        rows = row_offset + jnp.arange(ind.shape[0])
+        valid = (ind != sentinel) & (rows < n_cells)[:, None]
+        blk = jax.lax.dynamic_slice_in_dim(
+            xn_data, row_offset, ind.shape[0])
+        dr = jnp.where(valid, dat - jnp.take(mu_raw_pad, ind), 0.0)
+        dn = jnp.where(valid, blk - jnp.take(mu_norm_pad, ind), 0.0)
+        return jnp.stack([dr * dr, dn * dn], axis=2)
+
+    sq = segment_reduce(x, slot_sq, 2)
+    zeros = jnp.maximum(n_cells - nnz, 0.0)
+    m2_raw = sq[:, 0] + zeros * mu_raw * mu_raw
+    m2_norm = sq[:, 1] + zeros * mu_norm * mu_norm
+    stats = jnp.stack([s_raw, m2_raw, s_norm, m2_norm, nnz], axis=1)
+    return totals, n_genes_cell, pct_mito, stats
 
 
 def stream_stats(src: ShardSource, target_sum: float = 1e4,
@@ -153,6 +243,7 @@ def stream_stats(src: ShardSource, target_sum: float = 1e4,
     if mito_mask is None:
         mito_mask = np.zeros(src.n_genes, bool)
     mito = jnp.asarray(mito_mask)
+    sync = config.stream_sync_enabled()
     totals, ngenes, pct, shard_stats = [], [], [], []
     shard_sizes = []
     for offset, shard in src:
@@ -160,7 +251,11 @@ def stream_stats(src: ShardSource, target_sum: float = 1e4,
         n = shard.n_cells
         # keep DEVICE arrays here — np.asarray would sync and
         # serialise host IO with device compute; one fetch after the
-        # loop preserves the async-dispatch overlap
+        # loop preserves the async-dispatch overlap.  Under
+        # config.stream_sync (the axon tunnel) each shard is drained
+        # before the next dispatch instead — see config.py.
+        if sync:
+            stats.block_until_ready()
         totals.append(t[:n])
         ngenes.append(g[:n])
         pct.append(m[:n])
@@ -169,46 +264,111 @@ def stream_stats(src: ShardSource, target_sum: float = 1e4,
     totals = [np.asarray(t) for t in totals]
     ngenes = [np.asarray(g) for g in ngenes]
     pct = [np.asarray(m) for m in pct]
-    # Variance via per-shard centered moments combined in float64
-    # (Chan's pairwise update).  Per-shard sums are float32 over <=64k
-    # rows (benign); the naive global ss - n*mean^2 in float32 would
-    # catastrophically cancel for low-dispersion genes at 10M cells.
+    # Cross-shard combine in float64 via Chan's pairwise update.  The
+    # per-shard m2 arrive already centered on the SHARD mean as sums
+    # of non-negative f32 terms (see _shard_stats), so no f32
+    # cancellation survives to this point; the combine itself is
+    # float64 throughout.
     n_acc = 0
-    mean = np.zeros(src.n_genes, np.float64)
-    m2 = np.zeros(src.n_genes, np.float64)
+    mean_r = np.zeros(src.n_genes, np.float64)
+    m2_r = np.zeros(src.n_genes, np.float64)
+    mean_n = np.zeros(src.n_genes, np.float64)
+    m2_n = np.zeros(src.n_genes, np.float64)
     nnz = np.zeros(src.n_genes, np.float64)
     for stats, n_i in zip(shard_stats, shard_sizes):
-        s_i, ss_i, nnz_i = np.asarray(stats).T.astype(np.float64)
-        mean_i = s_i / n_i
-        m2_i = np.maximum(ss_i - n_i * mean_i**2, 0.0)
-        delta = mean_i - mean
-        tot = n_acc + n_i
-        m2 += m2_i + delta**2 * (n_acc * n_i / tot)
-        mean += delta * (n_i / tot)
+        s_r, m2r_i, s_n, m2n_i, nnz_i = \
+            np.asarray(stats).T.astype(np.float64)
+        for mean, m2, s_i, m2_i in ((mean_r, m2_r, s_r, m2r_i),
+                                    (mean_n, m2_n, s_n, m2n_i)):
+            mean_i = s_i / n_i
+            delta = mean_i - mean
+            tot = n_acc + n_i
+            m2 += np.maximum(m2_i, 0.0) + delta**2 * (n_acc * n_i / tot)
+            mean += delta * (n_i / tot)
         nnz += nnz_i
-        n_acc = tot
+        n_acc += n_i
     n = src.n_cells
-    var = np.maximum(m2 / max(n - 1, 1), 0.0)
     return {
         "total_counts": np.concatenate(totals),
         "n_genes": np.concatenate(ngenes),
         "pct_counts_mt": np.concatenate(pct),
-        "gene_mean": mean,
-        "gene_var": var,
+        "gene_mean": mean_n,
+        "gene_var": np.maximum(m2_n / max(n - 1, 1), 0.0),
+        "raw_gene_mean": mean_r,
+        "raw_gene_var": np.maximum(m2_r / max(n - 1, 1), 0.0),
         "gene_nnz": nnz,
         "n_cells": n,
     }
 
 
-def stream_hvg(stats: dict, n_top: int = 2000) -> np.ndarray:
-    """Dispersion-flavor HVG ranking from streamed moments (the
-    seurat_v3 flavor needs a second clipped pass; dispersion is the
-    one-pass ranking — documented divergence for the streaming path).
-    Returns sorted gene indices."""
-    from ..ops.hvg import _dispersion_scores
+@partial(jax.jit, static_argnames=())
+def _shard_clipped_ssq(x: SparseCells, mu_over_std, inv_std, clip):
+    """Per-shard Σ min(clip, (x − μ)/σ)² over stored slots (per gene).
+    The zeros' contribution ((0 − μ)/σ clipped, squared, × count) is
+    added by the caller from the pass-1 nnz counts."""
+    n_cells = x.n_cells
+    sentinel = x.sentinel
+    mu_pad = jnp.concatenate([mu_over_std, jnp.zeros((1,))])
+    inv_pad = jnp.concatenate([inv_std, jnp.zeros((1,))])
 
-    scores = _dispersion_scores(stats["gene_mean"].astype(np.float64),
-                                stats["gene_var"].astype(np.float64), np)
+    def slot_vals(ind, dat, row_offset):
+        z = jnp.take(inv_pad, ind) * dat - jnp.take(mu_pad, ind)
+        z = jnp.clip(z, -clip, clip)
+        rows = row_offset + jnp.arange(ind.shape[0])
+        ok = (ind != sentinel) & (rows < n_cells)[:, None]
+        return jnp.where(ok, z * z, 0.0)[:, :, None]
+
+    return segment_reduce(x, slot_vals, 1)[:, 0]
+
+
+def stream_hvg(stats: dict, n_top: int = 2000,
+               flavor: str = "seurat_v3",
+               src: ShardSource | None = None) -> np.ndarray:
+    """HVG ranking from streamed moments.  Returns sorted gene indices.
+
+    ``"seurat_v3"`` (the BASELINE configs[2] flavor) ranks genes by
+    clipped standardised variance of the RAW counts — same math as the
+    in-memory ``hvg.select``: quadratic mean-variance trend fit on the
+    pass-1 raw moments (host, float64), then ONE more streaming pass
+    over ``src`` accumulating the clipped second moment per gene.
+    Requires ``src`` (the clip threshold depends on the global trend,
+    which only exists after pass 1 — the second pass is inherent to
+    the flavor, not a streaming limitation).
+
+    ``"dispersion"`` is the one-pass ranking from the normalised-matrix
+    moments (no second pass, no ``src`` needed).
+    """
+    if flavor == "dispersion":
+        from ..ops.hvg import _dispersion_scores
+
+        scores = _dispersion_scores(stats["gene_mean"].astype(np.float64),
+                                    stats["gene_var"].astype(np.float64),
+                                    np)
+    elif flavor == "seurat_v3":
+        from ..ops.hvg import (_fit_mean_var_trend,
+                               _seurat_v3_scores_from_stats)
+
+        if src is None:
+            raise ValueError(
+                "stream_hvg(flavor='seurat_v3') needs src= for the "
+                "clipped second pass")
+        mean = stats["raw_gene_mean"]
+        var = stats["raw_gene_var"]
+        n = stats["n_cells"]
+        std = np.maximum(np.sqrt(_fit_mean_var_trend(mean, var, np)),
+                         1e-12)
+        clip = float(np.sqrt(n))
+        mu_over_std = jnp.asarray((mean / std).astype(np.float32))
+        inv_std = jnp.asarray((1.0 / std).astype(np.float32))
+        ssq = np.zeros(src.n_genes, np.float64)
+        for _, shard in src:
+            part = _shard_clipped_ssq(shard, mu_over_std, inv_std, clip)
+            ssq += np.asarray(part, np.float64)  # fetch drains per shard
+        zero_term = np.clip(-mean / std, -clip, clip) ** 2
+        ssq += (n - stats["gene_nnz"]) * zero_term
+        scores = _seurat_v3_scores_from_stats(mean, var, ssq, n, np)
+    else:
+        raise ValueError(f"unknown hvg flavor {flavor!r}")
     order = np.argsort(-scores)[:n_top]
     return np.sort(order)
 
@@ -284,10 +444,16 @@ def stream_pca(src: ShardSource, gene_idx: np.ndarray,
     mu = jnp.asarray(gene_mean[gene_idx].astype(np.float32))
     L = n_components + oversample
 
+    sync = config.stream_sync_enabled()
+
     def matvec_all(V):
-        return _assemble_rows(
-            [_shard_matvec(sh, mapping, mu, V, target_sum, g_sub)
-             for _, sh in src], src.n_cells)
+        blocks = []
+        for _, sh in src:
+            b = _shard_matvec(sh, mapping, mu, V, target_sum, g_sub)
+            if sync:
+                b.block_until_ready()
+            blocks.append(b)
+        return _assemble_rows(blocks, src.n_cells)
 
     def rmatvec_all(Q):
         acc = jnp.zeros((g_sub, Q.shape[1]), jnp.float32)
@@ -302,6 +468,8 @@ def stream_pca(src: ShardSource, gene_idx: np.ndarray,
                                        Q.shape[1]))])
             acc = acc + _shard_rmatvec(sh, mapping, mu, q_blk,
                                        target_sum, g_sub)
+            if sync:
+                acc.block_until_ready()
         return acc
 
     omega = jax.random.normal(key, (g_sub, L), jnp.float32)
@@ -327,21 +495,38 @@ def stream_pipeline(src: ShardSource, *, n_top: int = 2000,
                     n_components: int = 50, k: int = 15,
                     metric: str = "cosine", target_sum: float = 1e4,
                     mito_mask: np.ndarray | None = None, seed: int = 0,
-                    refine: int = 64) -> dict:
+                    refine: int = 64,
+                    hvg_flavor: str = "seurat_v3",
+                    mesh=None) -> dict:
     """h5ad shards → QC → HVG → 50-PC randomized PCA → kNN, out of
     core (BASELINE.json configs[4] shape).  Returns a dict:
     obs metrics (host), hvg_genes, X_pca (device), knn indices and
-    distances (device, padded rows -1)."""
+    distances (device, padded rows -1).
+
+    With ``mesh=`` every streamed shard is placed cells-axis-sharded
+    across the mesh (GSPMD collectives in the per-shard programs) and
+    the kNN runs as the ring-ppermute multi-chip search — the
+    composition the 10M-cell north star requires (stream from disk,
+    compute across chips)."""
     from ..ops.knn import knn_arrays
 
+    if mesh is not None:
+        src = src.with_mesh(mesh)
     stats = stream_stats(src, target_sum=target_sum, mito_mask=mito_mask)
-    hvg_genes = stream_hvg(stats, n_top=n_top)
+    hvg_genes = stream_hvg(stats, n_top=n_top, flavor=hvg_flavor, src=src)
     scores, comps, expl = stream_pca(
         src, hvg_genes, stats["gene_mean"], jax.random.PRNGKey(seed),
         n_components=n_components, target_sum=target_sum)
-    idx, dist = knn_arrays(scores, scores, k=k, metric=metric,
-                           n_query=src.n_cells, n_cand=src.n_cells,
-                           refine=refine)
+    if mesh is not None:
+        from ..parallel.knn_multichip import knn_multichip_arrays
+
+        idx, dist = knn_multichip_arrays(
+            scores, k=k, metric=metric, mesh=mesh, n_valid=src.n_cells,
+            strategy="ring")
+    else:
+        idx, dist = knn_arrays(scores, scores, k=k, metric=metric,
+                               n_query=src.n_cells, n_cand=src.n_cells,
+                               refine=refine)
     return {
         "obs": {"total_counts": stats["total_counts"],
                 "n_genes": stats["n_genes"],

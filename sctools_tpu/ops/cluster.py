@@ -229,11 +229,64 @@ def _compact_labels(labels: np.ndarray) -> np.ndarray:
     return inv.astype(np.int32)
 
 
+def _coarse_ell(labels: np.ndarray, idx: np.ndarray, w: np.ndarray,
+                max_capacity: int = 1024):
+    """Aggregate a (possibly directed) ELL graph by community labels
+    into a symmetric coarse ELL graph over ``m`` supernodes (host,
+    scipy).  Intra-community weight becomes a SELF-LOOP on the
+    supernode (stored once per row; ``louvain_moves_arrays`` counts it
+    in the degree but never lets it vote).  Hub rows beyond
+    ``max_capacity`` keep their heaviest off-diagonal edges, with
+    symmetry restored by dropping the reverse copies too; the diagonal
+    is never dropped (it carries the internal weight the next level's
+    modularity needs).
+
+    Returns (idx2 (m, cap) int32 with -1 padding, w2 (m, cap) f32).
+    """
+    import scipy.sparse as sp
+
+    n, k = idx.shape
+    m = int(labels.max()) + 1
+    rows = np.repeat(labels.astype(np.int64), k)
+    cols = idx.reshape(-1)
+    keep = cols >= 0
+    cj = labels[np.clip(cols, 0, n - 1)].astype(np.int64)
+    vals = np.asarray(w, np.float64).reshape(-1)
+    A = sp.coo_matrix((vals[keep], (rows[keep], cj[keep])),
+                      shape=(m, m)).tocsr()
+    A.sum_duplicates()
+    S = (0.5 * (A + A.T)).tocsr()  # no-op for symmetric input
+    S.eliminate_zeros()
+    nnz = np.diff(S.indptr)
+    if len(nnz) and int(nnz.max()) > max_capacity:
+        for r in np.flatnonzero(nnz > max_capacity):
+            lo, hi = S.indptr[r], S.indptr[r + 1]
+            d = S.data[lo:hi]
+            offd = np.flatnonzero(S.indices[lo:hi] != r)
+            n_drop = (hi - lo) - max_capacity
+            drop = offd[np.argpartition(d[offd], n_drop - 1)[:n_drop]]
+            d[drop] = 0.0
+        S.eliminate_zeros()
+        # edge kept iff kept in BOTH rows → symmetric again; diagonal
+        # of minimum(S, Sᵀ) is S's own diagonal, so self-loops survive
+        S = S.minimum(S.T).tocsr()
+        S.eliminate_zeros()
+        nnz = np.diff(S.indptr)
+    cap = max(int(nnz.max()) if len(nnz) and S.nnz else 1, 1)
+    idx2 = np.full((m, cap), -1, np.int32)
+    w2 = np.zeros((m, cap), np.float32)
+    slot = np.arange(S.nnz) - np.repeat(S.indptr[:-1], nnz)
+    rr = np.repeat(np.arange(m), nnz)
+    idx2[rr, slot] = S.indices
+    w2[rr, slot] = S.data
+    return idx2, w2
+
+
 def _modularity_merge(labels: np.ndarray, knn_idx: np.ndarray,
                       weights: np.ndarray, resolution: float = 1.0,
                       max_communities: int = 4096) -> np.ndarray:
-    """Leiden-style aggregation phase: greedily merge communities of
-    the coarse label graph while γ-aware modularity increases.
+    """Leiden-style aggregation phase: merge communities of the coarse
+    label graph while γ-aware modularity increases.
 
     Pure parallel local moves / LPA leave stable same-cluster
     fragments (a fragment's internal support beats boundary votes);
@@ -248,15 +301,38 @@ def _modularity_merge(labels: np.ndarray, knn_idx: np.ndarray,
     stored-vs-recomputed assertion in tests/test_leiden.py.
 
     The dense (m, m) coarse matrix + one-merge-per-argmax loop is
-    O(m²) memory / O(m³) time — fine for the ≤ a-few-thousand
-    communities the move rounds leave, not for an atlas-scale first
-    level that hasn't coarsened yet; above ``max_communities`` the
-    merge is skipped (the caller's next device round coarsens first).
+    O(m²) memory / O(m³) time — fine for a few thousand communities,
+    not for an atlas-scale first level.  Above ``max_communities`` the
+    graph is first AGGREGATED (``_coarse_ell``) and coarsened by
+    device-parallel local-move rounds on the supernode graph
+    (``louvain_moves_arrays`` — standard Louvain aggregation: ΔQ on
+    the coarse graph equals ΔQ on the original), recursing until the
+    community count fits the dense merge.  If a level makes no
+    progress the current labels are returned honestly rather than
+    looping.
     """
     labels = _compact_labels(labels)
     m = int(labels.max()) + 1 if len(labels) else 0
-    if m <= 1 or m > max_communities:
+    if m <= 1:
         return labels
+    if m > max_communities:
+        cidx, cw = _coarse_ell(labels, knn_idx, weights)
+        # the move kernel's per-block (block, cap, cap) community mask
+        # is O(block·cap²): scale the block down for wide coarse rows
+        # (cap can reach _coarse_ell's 1024 on hub-heavy graphs) so
+        # the tile stays ~64 MB instead of OOMing at the default 8192
+        cap = max(cidx.shape[1], 1)
+        block = int(min(8192, max(8, (1 << 24) // (cap * cap))))
+        sub = np.asarray(louvain_moves_arrays(
+            jnp.asarray(cidx), jnp.asarray(cw),
+            jnp.arange(m, dtype=jnp.int32), resolution=resolution,
+            n_rounds=20, block=block))
+        sub = _compact_labels(sub)
+        if int(sub.max()) + 1 >= m:  # no coarsening — avoid recursing
+            return labels
+        sub = _modularity_merge(sub, cidx, cw, resolution=resolution,
+                                max_communities=max_communities)
+        return _compact_labels(sub[labels])
     n, k = knn_idx.shape
     li = np.repeat(labels, k)
     cols = knn_idx.reshape(-1)
@@ -429,15 +505,27 @@ def louvain_moves_arrays(idx, w, labels0, resolution: float = 1.0,
     per-row same-community mask (no scatter into an (n, n_comms)
     table).  Moves apply to alternating node-id parity halves:
     synchronous all-node moves oscillate (two adjacent nodes swap
-    communities forever); the parity split is the deterministic
-    equivalent of the random half-sweeps used by parallel Louvain.
-    Ties break toward the lower community id.  Returns int32 labels.
+    communities forever).  The parity split is a deterministic
+    ANALOGUE of parallel Louvain's random half-sweeps, not an
+    equivalent: fixed halves can still leave move patterns random
+    sweeps would break, so it reaches somewhat lower modularity than
+    serial greedy Louvain on adversarial graphs — the gap is bounded
+    empirically in tests/test_leiden.py (within 5% of the serial
+    oracle), not guaranteed.  Ties break toward the lower community
+    id.  Returns int32 labels.
     """
     n, k = idx.shape
     dead = idx < 0
-    wv = jnp.where(dead, 0.0, w.astype(jnp.float32))
     safe = jnp.where(dead, 0, idx)
-    deg = jnp.sum(wv, axis=1)  # (n,)
+    # Self-loops appear when the "graph" is an aggregated coarse graph
+    # (internal community weight).  They count toward the node's
+    # degree but must never vote: a supernode's internal weight moves
+    # with it, so it cancels out of every ΔQ.
+    row_ids = jnp.arange(n, dtype=idx.dtype)[:, None]
+    novote = dead | (idx == row_ids)
+    w_deg = jnp.where(dead, 0.0, w.astype(jnp.float32))
+    wv = jnp.where(novote, 0.0, w_deg)
+    deg = jnp.sum(w_deg, axis=1)  # (n,) — includes self-loops
     m2 = jnp.maximum(jnp.sum(deg), 1e-12)  # 2m
 
     nb = -(-n // block)
@@ -452,7 +540,7 @@ def louvain_moves_arrays(idx, w, labels0, resolution: float = 1.0,
 
     def round_step(labels, r):
         sig = jax.ops.segment_sum(deg, labels, num_segments=n)  # Σ_tot
-        nl = jnp.where(dead, -1, jnp.take(labels, safe))
+        nl = jnp.where(novote, -1, jnp.take(labels, safe))
         sig_nl = jnp.take(sig, jnp.where(nl < 0, 0, nl))
         sig_cur = jnp.take(sig, labels)
 

@@ -150,7 +150,23 @@ class PipelineCheckpointer:
                 h.update(f"nd{a.dtype}{a.shape}".encode())
                 h.update(np.ascontiguousarray(a).tobytes())
             else:
-                h.update(repr(v).encode())
+                r = repr(v)
+                # A default object repr embeds the memory address
+                # ("<Foo object at 0x7f..>"), which changes every
+                # process — hashing it would silently invalidate every
+                # checkpoint on resume.  Strip addresses (stable across
+                # runs) and warn that the param carries no real state.
+                if " at 0x" in r:
+                    import re
+                    import warnings
+
+                    r = re.sub(r" at 0x[0-9a-fA-F]+", "", r)
+                    warnings.warn(
+                        f"PipelineCheckpointer: parameter {r!r} has no "
+                        "stable repr; its internal state is NOT part of "
+                        "the checkpoint hash — changing it will not "
+                        "invalidate old checkpoints", stacklevel=2)
+                h.update(r.encode())
 
         # hash of the (name, sorted params) prefix chain — stale
         # checkpoints from a different configuration (or an edited

@@ -73,3 +73,77 @@ def test_leiden_requires_knn():
     d = CellData(np.zeros((10, 4), np.float32))
     with pytest.raises(ValueError, match="neighbors.knn"):
         sct.apply("cluster.leiden", d, backend="tpu")
+
+
+# ----------------------------------------------------------------------
+# Merge phase beyond the dense cap (ring of cliques, first level > 4096
+# communities) — before round 4 the merge silently skipped above 4096.
+# ----------------------------------------------------------------------
+
+
+def _ring_of_cliques(n_cliques=5000, clique=4):
+    """Symmetric ELL graph: n_cliques cliques of `clique` nodes, each
+    clique internally complete (weight 1), consecutive cliques joined
+    by one weak ring edge (weight 0.1)."""
+    n = n_cliques * clique
+    cap = clique  # clique-1 internal + at most 1 ring edge
+    idx = np.full((n, cap), -1, np.int32)
+    w = np.zeros((n, cap), np.float32)
+    node = np.arange(n).reshape(n_cliques, clique)
+    for j in range(clique):
+        # internal edges: every clique-mate except self
+        others = np.delete(node, j, axis=1)  # (n_cliques, clique-1)
+        idx[node[:, j], : clique - 1] = others
+        w[node[:, j], : clique - 1] = 1.0
+    # ring: last node of clique c <-> first node of clique c+1
+    a = node[:, -1]
+    b = np.roll(node[:, 0], -1)
+    idx[a, clique - 1] = b
+    w[a, clique - 1] = 0.1
+    idx[b, clique - 1] = a
+    w[b, clique - 1] = 0.1
+    return idx, w
+
+
+def test_merge_active_beyond_dense_cap():
+    from sctools_tpu.ops.cluster import (_modularity_merge,
+                                         louvain_moves_arrays)
+    import jax.numpy as jnp
+
+    idx, w = _ring_of_cliques(5000, 4)
+    n = idx.shape[0]
+    first = np.asarray(louvain_moves_arrays(
+        jnp.asarray(idx), jnp.asarray(w),
+        jnp.arange(n, dtype=jnp.int32), n_rounds=8))
+    m_first = len(np.unique(first))
+    # local moves settle each clique into its own community — well
+    # beyond the 4096 dense-merge cap that used to silently skip
+    assert m_first > 4096, m_first
+    merged = _modularity_merge(first, idx, w)
+    m_merged = len(np.unique(merged))
+    q_first = modularity(idx, w, first)
+    q_merged = modularity(idx, w, merged)
+    # the resolution limit makes merging adjacent cliques strictly
+    # better than one-community-per-clique at 5000 cliques — an
+    # active merge must find that improvement; a skipped merge can't
+    assert m_merged < m_first, (m_merged, m_first)
+    assert q_merged > q_first + 1e-4, (q_merged, q_first)
+    # merged communities must be unions of cliques (never split one)
+    cl = np.repeat(np.arange(5000), 4)
+    for c in np.unique(cl[:64]):  # spot-check the first cliques
+        assert len(np.unique(merged[cl == c])) == 1
+
+
+def test_coarse_ell_preserves_self_loops():
+    from sctools_tpu.ops.cluster import _coarse_ell
+
+    idx, w = _ring_of_cliques(8, 3)
+    labels = np.repeat(np.arange(8), 3).astype(np.int64)
+    cidx, cw = _coarse_ell(labels, idx, w)
+    # each clique of 3 has 6 directed internal entries of weight 1 ->
+    # self-loop weight 6 on its supernode
+    for c in range(8):
+        row = cidx[c]
+        self_slot = np.flatnonzero(row == c)
+        assert len(self_slot) == 1
+        assert np.isclose(cw[c, self_slot[0]], 6.0)

@@ -66,7 +66,7 @@ def test_stream_pca_matches_memory(counts, src):
     import jax
 
     stats = stream_stats(src)
-    hvg = stream_hvg(stats, n_top=200)
+    hvg = stream_hvg(stats, n_top=200, flavor="dispersion")
     scores, comps, expl = stream_pca(
         src, hvg, stats["gene_mean"], jax.random.PRNGKey(0),
         n_components=20)
@@ -90,6 +90,42 @@ def test_stream_pca_matches_memory(counts, src):
     ia, _ = knn_numpy(a, a, k=10, metric="euclidean")
     ib, _ = knn_numpy(b, b, k=10, metric="euclidean")
     assert recall_at_k(ia, ib) > 0.95
+
+
+def test_stream_hvg_seurat_v3_matches_memory(counts, src):
+    """The streamed two-pass seurat_v3 ranking must match the
+    in-memory ``hvg.select(flavor='seurat_v3')`` on raw counts."""
+    stats = stream_stats(src)
+    hvg = stream_hvg(stats, n_top=200, flavor="seurat_v3", src=src)
+    mem = sct.apply("hvg.select", counts, backend="cpu",
+                    flavor="seurat_v3", n_top=200)
+    mem_idx = np.sort(np.flatnonzero(np.asarray(
+        mem.var["highly_variable"])))
+    # identical math in different precisions/orders: allow a small
+    # boundary disagreement, require ≥97% overlap of the gene sets
+    overlap = len(np.intersect1d(hvg, mem_idx)) / 200.0
+    assert overlap >= 0.97, overlap
+
+
+def test_stream_hvg_seurat_v3_needs_src(src):
+    stats = stream_stats(src)
+    with pytest.raises(ValueError, match="needs src"):
+        stream_hvg(stats, n_top=200, flavor="seurat_v3")
+
+
+def test_stream_raw_moments_match_scipy(counts, src):
+    """Pass-1 raw-count moments (the seurat_v3 trend inputs) must
+    match float64 scipy exactly enough that no cancellation survives."""
+    stats = stream_stats(src)
+    X = counts.X.tocsr().astype(np.float64)
+    n = X.shape[0]
+    mean = np.asarray(X.mean(axis=0)).ravel()
+    ss = np.asarray(X.multiply(X).sum(axis=0)).ravel()
+    var = (ss - n * mean**2) / (n - 1)
+    np.testing.assert_allclose(stats["raw_gene_mean"], mean, rtol=1e-5,
+                               atol=1e-8)
+    np.testing.assert_allclose(stats["raw_gene_var"], var, rtol=1e-4,
+                               atol=1e-8)
 
 
 def test_stream_pipeline_end_to_end(counts, src):
