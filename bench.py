@@ -869,10 +869,12 @@ def run_phase(name: str, budget_s: float, env_overrides=None) -> dict:
         stderr=subprocess.PIPE, stdout=subprocess.DEVNULL,
         text=True, cwd=_HERE, env=env)
     last_activity = [time.time()]
+    lines_seen = [0]
 
     def pump():
         for line in proc.stderr:
             last_activity[0] = time.time()
+            lines_seen[0] += 1
             sys.stderr.write(line)
             sys.stderr.flush()
 
@@ -907,6 +909,7 @@ def run_phase(name: str, budget_s: float, env_overrides=None) -> dict:
     except (OSError, json.JSONDecodeError):
         pass
     res["_phase"] = {"status": status, "rc": rc,
+                     "lines": lines_seen[0],
                      "wall_s": round(time.time() - t0, 1)}
     stage(f"phase.{name}", status=status, rc=rc,
           wall_s=round(time.time() - t0, 1))
@@ -947,6 +950,16 @@ def main():
         if rc in (3, 4) or err.startswith(("acquire failed", "backend")):
             tpu_dead = True
             detail["acquire_error"] = err or f"child exited rc={rc}"
+        elif (res.get("_phase", {}).get("status") == "stalled"
+              and res["_phase"].get("lines", 1) == 0):
+            # not one stage line before the stall: the child never got
+            # past interpreter startup — the axon plugin registration
+            # itself hangs when the tunnel is wedged (observed round
+            # 4).  Later phases would burn STALL_S each for nothing.
+            tpu_dead = True
+            detail["acquire_error"] = (
+                "child emitted no output before stall — axon plugin "
+                "registration hang at interpreter startup")
 
     if (want(0) or want(1)) and remaining() > 120:
         res = run_phase("small", min(420.0, remaining() - 60))

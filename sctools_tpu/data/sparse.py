@@ -331,6 +331,10 @@ def gene_stats(x: SparseCells) -> tuple[jax.Array, jax.Array, jax.Array]:
     One fused chunked pass: three segment-sums over the same index
     stream.  Padding rows contribute zeros (their data is zero) except
     for the nnz count, which masks explicitly.
+
+    NOTE: deriving a variance as ``ss − n·mean²`` from these f32 sums
+    cancels catastrophically when ``mean² ≫ var`` — use
+    :func:`gene_moments` for variances.
     """
     n_cells = x.n_cells
 
@@ -341,3 +345,40 @@ def gene_stats(x: SparseCells) -> tuple[jax.Array, jax.Array, jax.Array]:
 
     out = segment_reduce(x, slot_vals, 3)
     return out[:, 0], out[:, 1], out[:, 2]
+
+
+@jax.jit
+def gene_moments(x: SparseCells) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-gene (mean, CENTERED second moment Σ(x−μ)², nnz) across
+    valid cells, cancellation-free.
+
+    Two fused passes over one index stream: pass 1 gets sums/nnz;
+    pass 2, seeded with the on-device means, accumulates the
+    non-negative ``Σ_valid (x−μ)²`` and adds the zeros' closed-form
+    contribution ``(n−nnz)·μ²``.  Every f32 sum is of non-negative
+    terms, so the relative error is ~√N·ε of the moment ITSELF —
+    unlike ``ss − n·μ²``, which loses all precision for genes with
+    ``μ² ≫ var`` (housekeeping genes on raw counts).  Same scheme as
+    the streaming stats pass (data/stream.py _shard_stats).
+    """
+    n_cells = x.n_cells
+
+    def slot_sums(ind, dat, row_offset):
+        rows = row_offset + jnp.arange(ind.shape[0])
+        valid = (ind != x.sentinel) & (rows < n_cells)[:, None]
+        return jnp.stack([dat, valid.astype(dat.dtype)], axis=2)
+
+    out1 = segment_reduce(x, slot_sums, 2)  # (no dead Σx² slot here)
+    s, nnz = out1[:, 0], out1[:, 1]
+    mu = s / max(n_cells, 1)
+    mu_pad = jnp.concatenate([mu, jnp.zeros((1,), mu.dtype)])
+
+    def slot_sq(ind, dat, row_offset):
+        rows = row_offset + jnp.arange(ind.shape[0])
+        valid = (ind != x.sentinel) & (rows < n_cells)[:, None]
+        d = jnp.where(valid, dat - jnp.take(mu_pad, ind), 0.0)
+        return (d * d)[:, :, None]
+
+    m2 = segment_reduce(x, slot_sq, 1)[:, 0]
+    m2 = m2 + jnp.maximum(n_cells - nnz, 0.0) * mu * mu
+    return mu, m2, nnz

@@ -102,14 +102,15 @@ def select_genes_device(data: CellData, gene_idx: np.ndarray,
 
 def _gene_moments_tpu(X):
     """Per-gene mean, (ddof=1) variance, and nnz over cells;
-    sparse-aware.  One segment-sum pass covers all three."""
+    sparse-aware.  The sparse path uses the cancellation-free centered
+    two-pass (``gene_moments``) — ``ss − n·μ²`` in f32 loses all
+    precision for genes with μ² ≫ var, which on raw counts is most
+    housekeeping genes (round-4 fix, mirrors the streaming stats)."""
     if isinstance(X, SparseCells):
-        from ..data.sparse import gene_stats
+        from ..data.sparse import gene_moments
 
-        s, ss, nnz = gene_stats(X)
-        n = X.n_cells
-        mean = s / n
-        var = (ss - n * mean**2) / max(n - 1, 1)
+        mean, m2, nnz = gene_moments(X)
+        var = m2 / max(X.n_cells - 1, 1)
     else:
         X = jnp.asarray(X)
         n = X.shape[0]
@@ -122,18 +123,21 @@ def _gene_moments_tpu(X):
 def _gene_moments_cpu(X) -> tuple[np.ndarray, np.ndarray]:
     import scipy.sparse as sp
 
+    # all sums in float64: ss − n·μ² in the input's float32 cancels
+    # catastrophically for genes with μ² ≫ var (the same defect the
+    # TPU path fixes with the centered two-pass gene_moments)
     if sp.issparse(X):
-        X = X.tocsr()
+        X = X.tocsr().astype(np.float64)
         n = X.shape[0]
         s = np.asarray(X.sum(axis=0)).ravel()
         ss = np.asarray(X.multiply(X).sum(axis=0)).ravel()
         mean = s / n
         var = (ss - n * mean**2) / max(n - 1, 1)
     else:
-        X = np.asarray(X)
+        X = np.asarray(X, dtype=np.float64)
         mean = X.mean(axis=0)
         var = X.var(axis=0, ddof=1)
-    return mean.astype(np.float64), np.maximum(var, 0.0).astype(np.float64)
+    return mean, np.maximum(var, 0.0)
 
 
 # ----------------------------------------------------------------------

@@ -117,3 +117,29 @@ def test_pytree():
 
     y = double(x)
     assert (y.to_scipy_csr() != csr * 2).nnz == 0
+
+
+def test_gene_moments_no_cancellation():
+    """gene_moments must survive mean² >> var in float32 — the naive
+    ss − n·μ² loses every significant digit there (round-4 fix)."""
+    import scipy.sparse as sp
+
+    from sctools_tpu.data.sparse import SparseCells, gene_moments
+
+    rng = np.random.default_rng(0)
+    n = 4096
+    # dense gene: large mean 1000, tiny std 0.1 → var/mean² = 1e-8,
+    # far beyond f32's 24 bits of cancellation headroom
+    vals = (1000.0 + 0.1 * rng.standard_normal(n)).astype(np.float32)
+    X = sp.csr_matrix(vals.reshape(-1, 1))
+    x = SparseCells.from_scipy_csr(X)
+    mean, m2, nnz = (np.asarray(a) for a in gene_moments(x))
+    v64 = vals.astype(np.float64)
+    want_m2 = ((v64 - v64.mean()) ** 2).sum()
+    # mean: plain f32 accumulation, ~√N·ε relative
+    np.testing.assert_allclose(mean[0], v64.mean(), rtol=1e-5)
+    # m2: the naive f32 ss−n·μ² would be off by ORDERS OF MAGNITUDE
+    # here (cancellation amplifies √N·ε by mean²/var = 1e8); the
+    # centered pass must stay within ordinary f32 error of the truth
+    np.testing.assert_allclose(m2[0], want_m2, rtol=1e-2)
+    assert nnz[0] == n

@@ -1,0 +1,71 @@
+"""embed.tsne — structure preservation and backend parity."""
+
+import numpy as np
+import pytest
+
+import sctools_tpu as sct
+from sctools_tpu.data.dataset import CellData
+from sctools_tpu.data.synthetic import gaussian_blobs
+from sctools_tpu.ops.cluster import adjusted_rand_index
+from sctools_tpu.ops.knn import knn_numpy
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    n, blobs_n = 600, 5
+    pts, truth = gaussian_blobs(n, 10, blobs_n, spread=0.2, seed=3)
+    idx, dist = knn_numpy(pts, pts, k=15, metric="euclidean",
+                          exclude_self=True)
+    d = CellData(np.zeros((n, 4), np.float32),
+                 obs={"truth": truth}).with_obsp(
+        knn_indices=idx, knn_distances=dist).with_uns(
+        knn_k=15, knn_metric="euclidean")
+    return d, truth
+
+
+def _purity(emb, truth, k=15):
+    """Fraction of embedding-kNN sharing the query's true label —
+    deterministic, unlike k-means whose one-shot init can split a
+    blob and fail an otherwise perfect layout."""
+    emb = np.asarray(emb, np.float64)
+    idx, _ = knn_numpy(emb, emb, k=k, metric="euclidean",
+                       exclude_self=True)
+    return float((truth[idx] == truth[:, None]).mean())
+
+
+def test_tsne_separates_blobs(blobs):
+    d, truth = blobs
+    out = sct.apply("embed.tsne", d, backend="tpu", n_iter=350)
+    emb = np.asarray(out.obsm["X_tsne"])
+    assert emb.shape == (600, 2)
+    assert np.isfinite(emb).all()
+    purity = _purity(emb, truth)
+    assert purity > 0.95, purity
+
+
+def test_tsne_backend_parity(blobs):
+    """Same init, same math → both backends must separate the blobs
+    and agree on the neighbourhood structure (not bit-identical:
+    f32 scan vs f64 loop)."""
+    d, truth = blobs
+    t = sct.apply("embed.tsne", d, backend="tpu", n_iter=300)
+    c = sct.apply("embed.tsne", d, backend="cpu", n_iter=300)
+    pur_t = _purity(np.asarray(t.obsm["X_tsne"]), truth)
+    pur_c = _purity(np.asarray(c.obsm["X_tsne"]), truth)
+    assert pur_t > 0.95 and pur_c > 0.95, (pur_t, pur_c)
+    # structural agreement: the embeddings' kNN graphs overlap
+    it, _ = knn_numpy(np.asarray(t.obsm["X_tsne"], np.float64),
+                      np.asarray(t.obsm["X_tsne"], np.float64), k=15,
+                      metric="euclidean", exclude_self=True)
+    ic, _ = knn_numpy(np.asarray(c.obsm["X_tsne"], np.float64),
+                      np.asarray(c.obsm["X_tsne"], np.float64), k=15,
+                      metric="euclidean", exclude_self=True)
+    overlap = np.mean([
+        len(np.intersect1d(it[i], ic[i])) / 15 for i in range(600)])
+    assert overlap > 0.5, overlap
+
+
+def test_tsne_requires_knn():
+    d = CellData(np.zeros((10, 4), np.float32))
+    with pytest.raises(ValueError, match="neighbors.knn"):
+        sct.apply("embed.tsne", d, backend="tpu")
