@@ -520,13 +520,18 @@ def run_config3(jax, src, deadline_frac=0.75):
     return detail, scores, idx_parts
 
 
-def run_recall(jax, scores, idx_parts, n, n_queries=4096):
+def run_recall(jax, scores, idx_parts, n, n_queries=None):
     """Recall@10 vs a chunked numpy float32 oracle with float64
     re-rank of the top candidates (the f32 gemm is the only affordable
     full-candidate scan on a 1-core host; the f64 re-rank removes any
     borderline-tie effect at the top of the list)."""
     from sctools_tpu.ops.knn import recall_at_k
 
+    if n_queries is None:
+        # the f32 oracle gemm costs queries × n on ONE host core
+        # (~2 min at 4096×1.3M) — halve the sample at atlas scale;
+        # 2048×10 neighbour checks still bound recall to ±~0.2%
+        n_queries = 2048 if n >= 1_000_000 else 4096
     rng = np.random.default_rng(1)
     # only sample queries whose kNN rows were actually computed
     covered = np.concatenate([np.arange(off, off + nq)
@@ -863,56 +868,28 @@ def run_phase(name: str, budget_s: float, env_overrides=None) -> dict:
     # the orchestrator's hard kill, not 1500s later
     env["SCTOOLS_BENCH_BUDGET_S"] = str(budget_s)
     env.update(env_overrides or {})
-    t0 = time.time()
-    proc = subprocess.Popen(
+
+    def passthrough(line):
+        sys.stderr.write(line)
+        sys.stderr.flush()
+
+    from sctools_tpu.utils.failsafe import watch_process
+
+    watched = watch_process(
         [sys.executable, os.path.abspath(__file__), "--phase", name],
-        stderr=subprocess.PIPE, stdout=subprocess.DEVNULL,
-        text=True, cwd=_HERE, env=env)
-    last_activity = [time.time()]
-    lines_seen = [0]
-
-    def pump():
-        for line in proc.stderr:
-            last_activity[0] = time.time()
-            lines_seen[0] += 1
-            sys.stderr.write(line)
-            sys.stderr.flush()
-
-    th = threading.Thread(target=pump, daemon=True)
-    th.start()
-    status = "completed"
-    while proc.poll() is None:
-        time.sleep(2.0)
-        now = time.time()
-        if now - t0 > budget_s:
-            status = "timeout"
-        elif now - last_activity[0] > STALL_S:
-            status = "stalled"
-        elif remaining() < 15:
-            status = "out_of_budget"
-        else:
-            continue
-        proc.kill()
-        try:
-            proc.wait(timeout=10)
-        except subprocess.TimeoutExpired:
-            pass
-        break
-    th.join(timeout=5)
-    rc = proc.returncode
-    if status == "completed" and rc not in (0, None):
-        status = "crashed"
+        timeout_s=budget_s, stall_timeout_s=STALL_S, env=env, cwd=_HERE,
+        on_line=passthrough, poll_s=2.0,
+        extra_stop=lambda: "out_of_budget" if remaining() < 15 else None)
     res = {}
     try:
         with open(result_path) as f:
             res = json.load(f)
     except (OSError, json.JSONDecodeError):
         pass
-    res["_phase"] = {"status": status, "rc": rc,
-                     "lines": lines_seen[0],
-                     "wall_s": round(time.time() - t0, 1)}
-    stage(f"phase.{name}", status=status, rc=rc,
-          wall_s=round(time.time() - t0, 1))
+    res["_phase"] = {k: watched[k]
+                     for k in ("status", "rc", "lines", "wall_s")}
+    stage(f"phase.{name}", status=watched["status"], rc=watched["rc"],
+          wall_s=watched["wall_s"])
     return res
 
 

@@ -170,8 +170,26 @@ def _prep_p(idx, dist, perplexity, xp=np):
     """kNN distances → symmetrised sparse affinities aligned to the
     DIRECTED edge list (each undirected p_ij split across the one or
     two directed slots that carry it, so the segment-sum reaction in
-    the attractive term reconstitutes the full symmetric force)."""
+    the attractive term reconstitutes the full symmetric force).
+
+    Returns (P, effective_perplexity).  Perplexity is capped at k/3
+    (the k ≈ 3·perplexity rule every kNN t-SNE uses): with only k
+    stored neighbours, an entropy target at or above log(k) pins the
+    bandwidth bisection at its lower bound and the affinities
+    degenerate to exactly uniform — the parameter would silently do
+    nothing."""
     n, k = idx.shape
+    eff = min(float(perplexity), max(2.0, k / 3.0))
+    if eff < perplexity:
+        import warnings
+
+        warnings.warn(
+            f"embed.tsne: perplexity={perplexity} needs ≥3x as many "
+            f"kNN neighbours, but the graph has k={k}; using "
+            f"perplexity={eff:.1f} (rebuild neighbors.knn with "
+            f"k≈{int(3 * perplexity)} for the requested value)",
+            stacklevel=3)
+    perplexity = eff
     is_self = idx == np.arange(n)[:, None]
     d2 = np.where((idx < 0) | is_self, np.inf,
                   np.asarray(dist, np.float64) ** 2)
@@ -206,7 +224,15 @@ def _prep_p(idx, dist, perplexity, xp=np):
     both_d = np.asarray(both[rows, cols.clip(0)]).reshape(n, k)
     P = np.where(both_d > 0, Sd / 2.0, Sd).astype(np.float32)
     P[(idx < 0) | is_self] = 0.0
-    return P
+    return P, perplexity
+
+
+def _exag_iters(n_iter: int, nominal: int = 100) -> int:
+    """Early-exaggeration phase length: the standard ~100 iterations,
+    but never more than a quarter of the run — an unclamped 100 would
+    make a short n_iter<=100 call return the compressed exaggeration-
+    phase layout instead of a t-SNE embedding."""
+    return min(nominal, max(1, n_iter // 4))
 
 
 @register("embed.tsne", backend="tpu")
@@ -221,14 +247,15 @@ def tsne_tpu(data: CellData, n_components: int = 2,
     n = data.n_cells
     idx = np.asarray(data.obsp["knn_indices"])[:n]
     dist = np.asarray(data.obsp["knn_distances"])[:n]
-    P = _prep_p(idx, dist, perplexity)
+    P, eff = _prep_p(idx, dist, perplexity)
     rng = np.random.default_rng(seed)
     init = (rng.standard_normal((n, n_components)) * 1e-4).astype(
         np.float32)
     y = tsne_layout_arrays(jnp.asarray(idx), jnp.asarray(P),
                            jnp.asarray(init), n_iter=n_iter,
+                           exaggeration_iter=_exag_iters(n_iter),
                            learning_rate=learning_rate)
-    return data.with_obsm(X_tsne=y).with_uns(tsne_perplexity=perplexity)
+    return data.with_obsm(X_tsne=y).with_uns(tsne_perplexity=eff)
 
 
 @register("embed.tsne", backend="cpu")
@@ -241,15 +268,17 @@ def tsne_cpu(data: CellData, n_components: int = 2,
     n = data.n_cells
     idx = np.asarray(data.obsp["knn_indices"])[:n]
     dist = np.asarray(data.obsp["knn_distances"])[:n]
-    P = np.asarray(_prep_p(idx, dist, perplexity), np.float64)
+    P, eff = _prep_p(idx, dist, perplexity)
+    P = np.asarray(P, np.float64)
     rng = np.random.default_rng(seed)
     y = rng.standard_normal((n, n_components)) * 1e-4
     vel = np.zeros_like(y)
     gains = np.ones_like(y)
     safe = np.where(idx < 0, 0, idx)
+    ex_it = _exag_iters(n_iter)
     for it in range(n_iter):
-        exag = 12.0 if it < 100 else 1.0
-        momentum = 0.5 if it < 100 else 0.8
+        exag = 12.0 if it < ex_it else 1.0
+        momentum = 0.5 if it < ex_it else 0.8
         d2 = ((y[:, None, :] - y[None, :, :]) ** 2).sum(-1)
         w = 1.0 / (1.0 + d2)
         np.fill_diagonal(w, 0.0)
@@ -272,4 +301,4 @@ def tsne_cpu(data: CellData, n_components: int = 2,
         y = y + vel
         y -= y.mean(0, keepdims=True)
     return data.with_obsm(X_tsne=y.astype(np.float32)).with_uns(
-        tsne_perplexity=perplexity)
+        tsne_perplexity=eff)

@@ -1,0 +1,218 @@
+"""Failure detection + containment for flaky accelerator backends.
+
+Reference parity: the reference framework ships failure-detection
+machinery in its runtime (source unavailable — SURVEY.md §0).  What
+this module owns is the TPU-shaped version of that problem, learned
+the hard way in rounds 1-4 of the bench (bench.py's module docstring
+has the full history): a tunneled device can CRASH (worker dies, every
+later call in the process raises UNAVAILABLE) or WEDGE (calls block
+forever — even ``import``-time plugin registration can hang).  Neither
+is recoverable in-process; containment means subprocesses + watchdogs.
+
+* :func:`probe_device` — is the accelerator usable RIGHT NOW?  Runs a
+  tiny matmul in a subprocess under a timeout, so a wedged tunnel
+  costs ``timeout_s``, not forever, and a crashed worker cannot
+  poison the caller's jax runtime.
+* :func:`run_isolated` — run ``fn(*args)`` in a watched subprocess:
+  killed on deadline or when it stops emitting heartbeats.  The child
+  reports its result via a JSON file; the parent never imports jax.
+* :class:`Heartbeat` — the child-side pulse emitter (any stderr line
+  resets the parent's stall timer; ``beat()`` is a cheap explicit
+  pulse for long device waits).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+
+def probe_device(timeout_s: float = 90.0, platform: str | None = None) -> dict:
+    """Check accelerator health from a throwaway subprocess.
+
+    Returns ``{"ok": True, "device_kind", "wall_s"}`` on success or
+    ``{"ok": False, "reason": "timeout"|"error", ...}``.  Safe to call
+    even while the tunnel is wedged — the caller's process never
+    touches jax.
+    """
+    code = (
+        "import json,sys,time\n"
+        "t0=time.time()\n"
+        "import jax, jax.numpy as jnp\n"
+        + (f"jax.config.update('jax_platforms', {platform!r})\n"
+           if platform else "")
+        + "x = jnp.ones((1024, 1024), jnp.bfloat16)\n"
+        "(x @ x).block_until_ready()\n"
+        "print(json.dumps({'kind': jax.devices()[0].device_kind,"
+        " 'wall_s': round(time.time()-t0, 2)}))\n"
+    )
+    t0 = time.time()
+    try:
+        p = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "reason": "timeout",
+                "wall_s": round(time.time() - t0, 1)}
+    for line in reversed(p.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+            return {"ok": True, "device_kind": rec["kind"],
+                    "wall_s": rec["wall_s"]}
+        except (json.JSONDecodeError, KeyError):
+            continue
+    return {"ok": False, "reason": "error", "rc": p.returncode,
+            "stderr": (p.stderr or "")[-300:]}
+
+
+class Heartbeat:
+    """Child-side pulse for :func:`run_isolated`: any line on stderr
+    resets the parent's stall timer."""
+
+    def __init__(self, every_s: float = 15.0):
+        self.every_s = every_s
+        self._last = 0.0
+
+    def beat(self, note: str = "") -> None:
+        now = time.time()
+        if now - self._last >= self.every_s:
+            print(f"[heartbeat]{(' ' + note) if note else ''}",
+                  file=sys.stderr, flush=True)
+            self._last = now
+
+
+def watch_process(cmd, *, timeout_s: float, stall_timeout_s: float,
+                  env: dict | None = None, cwd: str | None = None,
+                  on_line=None, extra_stop=None,
+                  poll_s: float = 1.0) -> dict:
+    """Run ``cmd`` under the crash/wedge watchdog — THE containment
+    primitive (bench.py's phase runner and :func:`run_isolated` both
+    build on it, so the kill/stall logic exists exactly once).
+
+    The child's stderr is pumped line-by-line; every line resets the
+    stall timer and is passed to ``on_line`` (when given).  The child
+    is killed on deadline, on stall, or when ``extra_stop()`` returns
+    a truthy status string (e.g. an outer budget check).  Returns
+    ``{"status": completed|crashed|stalled|timeout|<extra>, "rc",
+    "wall_s", "lines", "stderr_tail"}``.
+    """
+    t0 = time.time()
+    proc = subprocess.Popen(cmd, stderr=subprocess.PIPE,
+                            stdout=subprocess.DEVNULL, text=True,
+                            env=env, cwd=cwd)
+    last = [time.time()]
+    lines = [0]
+    tail: list = []
+
+    def pump():
+        for line in proc.stderr:
+            last[0] = time.time()
+            lines[0] += 1
+            tail.append(line)
+            if len(tail) > 50:
+                del tail[:-50]
+            if on_line is not None:
+                on_line(line)
+
+    th = threading.Thread(target=pump, daemon=True)
+    th.start()
+    status = "completed"
+    while proc.poll() is None:
+        time.sleep(poll_s)
+        now = time.time()
+        extra = extra_stop() if extra_stop is not None else None
+        if now - t0 > timeout_s:
+            status = "timeout"
+        elif now - last[0] > stall_timeout_s:
+            status = "stalled"
+        elif extra:
+            status = extra
+        else:
+            continue
+        proc.kill()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        break
+    th.join(timeout=5)
+    rc = proc.returncode
+    if status == "completed" and rc not in (0, None):
+        status = "crashed"
+    return {"status": status, "rc": rc, "lines": lines[0],
+            "wall_s": round(time.time() - t0, 1),
+            "stderr_tail": "".join(tail)[-2000:]}
+
+
+def _child_main(payload_path: str, result_path: str) -> int:
+    with open(payload_path, "rb") as f:
+        fn, args, kwargs = pickle.load(f)
+    out = fn(*args, **kwargs)
+    tmp = result_path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(out, f)
+    os.replace(tmp, result_path)
+    return 0
+
+
+def run_isolated(fn, *args, timeout_s: float = 600.0,
+                 stall_timeout_s: float = 240.0, env: dict | None = None,
+                 **kwargs) -> dict:
+    """Run ``fn(*args, **kwargs)`` in a watched subprocess.
+
+    ``fn`` must be an importable module-level callable; arguments and
+    the return value are pickled.  The child is killed when it exceeds
+    ``timeout_s`` OR goes ``stall_timeout_s`` without writing a line
+    to stderr (jax's own logging plus any :class:`Heartbeat` both
+    count).  Returns::
+
+        {"status": "completed"|"crashed"|"stalled"|"timeout",
+         "result": <fn's return value, when completed>,
+         "rc": int | None, "wall_s": float, "stderr_tail": str}
+
+    A crashed or wedged TPU worker takes the CHILD down; the caller's
+    process — and its jax runtime, if any — is untouched.
+    """
+    workdir = tempfile.mkdtemp(prefix="sctools_failsafe_")
+    payload_path = os.path.join(workdir, "payload.pkl")
+    result_path = os.path.join(workdir, "result.pkl")
+    with open(payload_path, "wb") as f:
+        pickle.dump((fn, args, kwargs), f)
+    code = ("import sys\n"
+            "from sctools_tpu.utils.failsafe import _child_main\n"
+            "sys.exit(_child_main(sys.argv[1], sys.argv[2]))\n")
+    child_env = dict(os.environ)
+    # the payload pickles fn BY REFERENCE — the child must be able to
+    # import the caller's module, so the caller's import path rides
+    # along (covers pytest's rootdir insertions etc.)
+    paths = [p for p in sys.path if p] + \
+        [p for p in child_env.get("PYTHONPATH", "").split(os.pathsep) if p]
+    child_env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(paths))
+    child_env.update(env or {})
+    out = watch_process(
+        [sys.executable, "-c", code, payload_path, result_path],
+        timeout_s=timeout_s, stall_timeout_s=stall_timeout_s,
+        env=child_env)
+    if out["status"] == "completed":
+        try:
+            with open(result_path, "rb") as f:
+                out["result"] = pickle.load(f)
+        except (OSError, pickle.UnpicklingError) as e:
+            out["status"] = "crashed"
+            out["stderr_tail"] += f"\n[result unreadable: {e!r}]"
+    for p in (payload_path, result_path):
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+    try:
+        os.rmdir(workdir)
+    except OSError:
+        pass
+    return out

@@ -1,0 +1,61 @@
+"""utils.failsafe — failure detection/containment (CPU-only checks;
+the TPU behaviors it guards against are documented in bench.py)."""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+from sctools_tpu.utils.failsafe import probe_device, run_isolated
+
+# module-level targets (run_isolated pickles them by reference)
+
+
+def _ok_fn(a, b):
+    return {"sum": a + b, "pid": os.getpid()}
+
+
+def _crash_fn():
+    sys.exit(7)
+
+
+def _hang_fn():
+    time.sleep(3600)
+
+
+def _numpy_fn(n):
+    return float(np.arange(n, dtype=np.float64).sum())
+
+
+def test_probe_device_cpu():
+    rec = probe_device(timeout_s=120, platform="cpu")
+    assert rec["ok"], rec
+    assert "Cpu" in rec["device_kind"] or "cpu" in rec["device_kind"].lower()
+
+
+def test_run_isolated_completes():
+    out = run_isolated(_ok_fn, 2, 3, timeout_s=120, stall_timeout_s=60)
+    assert out["status"] == "completed", out
+    assert out["result"]["sum"] == 5
+    assert out["result"]["pid"] != os.getpid()  # truly another process
+
+
+def test_run_isolated_pickles_numpy():
+    out = run_isolated(_numpy_fn, 100, timeout_s=120, stall_timeout_s=60)
+    assert out["status"] == "completed"
+    assert out["result"] == 4950.0
+
+
+def test_run_isolated_crash_contained():
+    out = run_isolated(_crash_fn, timeout_s=120, stall_timeout_s=60)
+    assert out["status"] == "crashed"
+    assert out["rc"] == 7
+    assert "result" not in out
+
+
+def test_run_isolated_stall_killed():
+    t0 = time.time()
+    out = run_isolated(_hang_fn, timeout_s=120, stall_timeout_s=4)
+    assert out["status"] == "stalled", out
+    assert time.time() - t0 < 60
