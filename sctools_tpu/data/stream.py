@@ -53,6 +53,60 @@ from .sparse import SparseCells, segment_reduce, spmm, spmm_t
 # ----------------------------------------------------------------------
 
 
+def _prefetch_iter(make_gen, depth: int = 1):
+    """Run a generator in a daemon worker thread, handing items over a
+    bounded queue — the NEXT shard's host work (h5 read + native pack)
+    overlaps the CURRENT shard's device compute even when
+    ``config.stream_sync`` drains the device between shards (the axon
+    tunnel mode, where jax's own async dispatch is off the table).
+    Exceptions propagate to the consumer at the point of the failed
+    item."""
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+    stop = threading.Event()
+    _END = object()
+
+    def put(item) -> bool:
+        # stop-aware put: a consumer that abandons iteration (device
+        # error mid-stream, GC) must not leave this thread blocked
+        # forever holding the h5 handle + shard buffers
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.5)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for item in make_gen():
+                if not put(item):
+                    return  # consumer gone; generator finalised here
+        except BaseException as e:  # noqa: BLE001 - reraised below
+            put(("__prefetch_error__", e))
+        put(_END)
+
+    threading.Thread(target=worker, daemon=True).start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if (isinstance(item, tuple) and len(item) == 2
+                    and item[0] == "__prefetch_error__"):
+                raise item[1]
+            yield item
+    finally:
+        stop.set()
+        try:  # wake a producer blocked on a full queue
+            q.get_nowait()
+        except queue.Empty:
+            pass
+
+
 @dataclasses.dataclass
 class ShardSource:
     """A re-iterable source of (row_offset, device SparseCells) shards
@@ -70,10 +124,16 @@ class ShardSource:
     n_genes: int
     shard_rows: int
     sharding: object | None = None
+    # read/pack the next shard in a worker thread while the device
+    # chews the current one (on for IO-backed sources; pointless for
+    # in-memory ones)
+    prefetch: bool = False
 
     def __iter__(self):
+        it = (_prefetch_iter(self.factory) if self.prefetch
+              else self.factory())
         offset = 0
-        for shard in self.factory():
+        for shard in it:
             yield offset, shard.device_put(self.sharding)
             offset += shard.n_cells
 
@@ -135,7 +195,7 @@ class ShardSource:
                     # dense h5ad: any row may be fully dense
                     capacity = round_up(int(g), config.capacity_multiple)
         return cls(lambda: shard_iter(path, shard_rows, capacity=capacity),
-                   int(n), int(g), shard_rows)
+                   int(n), int(g), shard_rows, prefetch=True)
 
     @classmethod
     def from_scipy(cls, X, shard_rows: int = 65536,

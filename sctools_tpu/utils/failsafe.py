@@ -65,7 +65,10 @@ def probe_device(timeout_s: float = 90.0, platform: str | None = None) -> dict:
             rec = json.loads(line)
             return {"ok": True, "device_kind": rec["kind"],
                     "wall_s": rec["wall_s"]}
-        except (json.JSONDecodeError, KeyError):
+        except (json.JSONDecodeError, KeyError, TypeError):
+            # noise lines may parse as non-dict JSON ("123", "null");
+            # this function's contract is to never raise for child
+            # weirdness, only report ok=False
             continue
     return {"ok": False, "reason": "error", "rc": p.returncode,
             "stderr": (p.stderr or "")[-300:]}

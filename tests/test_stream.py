@@ -158,3 +158,64 @@ def test_stream_h5ad_roundtrip(counts, tmp_path):
                                stats2["total_counts"], rtol=1e-6)
     np.testing.assert_allclose(stats["gene_mean"], stats2["gene_mean"],
                                rtol=1e-6)
+
+
+def test_prefetch_iter_propagates_and_orders():
+    from sctools_tpu.data.stream import _prefetch_iter
+
+    def gen():
+        yield from range(5)
+
+    assert list(_prefetch_iter(gen)) == [0, 1, 2, 3, 4]
+
+    def bad():
+        yield 1
+        raise RuntimeError("boom")
+
+    it = _prefetch_iter(bad)
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="boom"):
+        next(it)
+
+
+def test_prefetch_overlaps_producer_and_consumer():
+    import time as _time
+
+    from sctools_tpu.data.stream import _prefetch_iter
+
+    def slow_gen():
+        for i in range(4):
+            _time.sleep(0.1)  # "IO"
+            yield i
+
+    t0 = _time.time()
+    for _ in _prefetch_iter(slow_gen):
+        _time.sleep(0.1)  # "compute"
+    overlapped = _time.time() - t0
+    # serial would be ~0.8s; overlapped pipeline ~0.5s
+    assert overlapped < 0.75, overlapped
+
+
+def test_prefetch_abandoned_consumer_unblocks_producer():
+    import threading
+    import time as _time
+
+    from sctools_tpu.data.stream import _prefetch_iter
+
+    finished = threading.Event()
+
+    def gen():
+        try:
+            for i in range(100):
+                yield i
+        finally:
+            finished.set()
+
+    it = _prefetch_iter(gen)
+    assert next(it) == 0
+    it.close()  # abandon mid-stream — producer must terminate
+    for _ in range(40):
+        if finished.is_set():
+            break
+        _time.sleep(0.1)
+    assert finished.is_set(), "producer thread leaked after abandon"
