@@ -46,6 +46,15 @@ class Config:
     # xla elsewhere, since interpret-mode pallas is debug-speed).
     knn_impl: str = "auto"
 
+    # Coarse top-k operator for the blocked XLA path: "topk" (exact
+    # lax.top_k over each merged tile) or "approx"
+    # (lax.approx_max_k on the fresh tile — the TPU-native binned
+    # PartialReduce — followed by a tiny EXACT merge with the running
+    # carry, so per-block recall never compounds across blocks).  Use
+    # "approx" with a refine>=k re-rank; the recall gate stays with
+    # the caller/bench.
+    knn_coarse: str = "topk"
+
     def resolved_knn_impl(self) -> str:
         if self.knn_impl == "auto":
             # pallas only when it will actually compile — interpret

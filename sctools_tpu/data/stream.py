@@ -30,8 +30,10 @@ So the streaming decomposition is:
 * **kNN** on the device-resident scores via the standard blocked /
   Pallas search (ops/knn.py) — no extra streaming needed.
 
-The full count matrix never exists in memory; peak host usage is one
-shard, peak device usage is the skinny iterates.
+The full count matrix never exists in memory; peak host usage is a
+small constant number of shards (the consumer's plus the prefetch
+queue's — see ``_prefetch_iter``), peak device usage is the skinny
+iterates.
 """
 
 from __future__ import annotations

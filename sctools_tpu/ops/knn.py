@@ -71,6 +71,10 @@ def knn_arrays(
     """
     if metric not in ("cosine", "euclidean"):
         raise ValueError(f"unknown metric {metric!r}")
+    if config.knn_coarse not in ("topk", "approx"):
+        raise ValueError(
+            f"unknown knn_coarse {config.knn_coarse!r} "
+            "(expected 'topk' or 'approx')")
     n_query = n_query or query.shape[0]
     n_cand = n_cand or cand.shape[0]
     k_search = max(k, refine) if refine else k
@@ -90,6 +94,7 @@ def knn_arrays(
             cb=cand_block or config.col_block,
             mm_dtype=str(jnp.dtype(config.matmul_dtype)),
             exclude_self=exclude_self,
+            coarse=config.knn_coarse,
         )
     if refine:
         # Any refine > 0 runs the exact pass — even refine <= k still
@@ -105,10 +110,10 @@ def knn_arrays(
 @partial(
     jax.jit,
     static_argnames=("k", "metric", "qb", "cb", "n_query", "n_cand",
-                     "mm_dtype", "exclude_self"),
+                     "mm_dtype", "exclude_self", "coarse"),
 )
 def _knn_jit(query, cand, *, k, metric, n_query, n_cand, qb, cb,
-             mm_dtype, exclude_self):
+             mm_dtype, exclude_self, coarse="topk"):
     mm_dtype = jnp.dtype(mm_dtype)
     # float32 inputs need HIGHEST or the MXU silently drops to bf16.
     precision = (jax.lax.Precision.HIGHEST if mm_dtype == jnp.float32
@@ -150,10 +155,23 @@ def _knn_jit(query, cand, *, k, metric, n_query, n_cand, qb, cb,
             s = jnp.where(invalid[None, :], -jnp.inf, s)
             if exclude_self:
                 s = jnp.where(gcol[None, :] == q_ids[:, None], -jnp.inf, s)
-            allv = jnp.concatenate([bvals, s], axis=1)
-            alli = jnp.concatenate(
-                [bidx, jnp.broadcast_to(gcol[None, :], s.shape)], axis=1
-            )
+            # approx_max_k reduces over the fresh tile's cb columns and
+            # requires k <= cb; a narrower block silently gets the
+            # exact branch (identical results, no crash)
+            if coarse == "approx" and k <= cb:
+                # TPU-native binned PartialReduce on the FRESH tile
+                # only; the carry merge below stays exact, so a global
+                # top-k item risks its one bin collision exactly once
+                # (in its own block), never per subsequent block.
+                fv, fsel = jax.lax.approx_max_k(s, k, recall_target=0.99)
+                fi = off + fsel.astype(jnp.int32)
+                allv = jnp.concatenate([bvals, fv], axis=1)  # (qb, 2k)
+                alli = jnp.concatenate([bidx, fi], axis=1)
+            else:
+                allv = jnp.concatenate([bvals, s], axis=1)
+                alli = jnp.concatenate(
+                    [bidx, jnp.broadcast_to(gcol[None, :], s.shape)],
+                    axis=1)
             v, sel = jax.lax.top_k(allv, k)
             i = jnp.take_along_axis(alli, sel, axis=1)
             return (v, i), None

@@ -143,3 +143,18 @@ def test_pairwise_matches_cpu(prepped):
     np.testing.assert_allclose(tpu.obsp["pairwise_distances"],
                                cpu.obsp["pairwise_distances"],
                                rtol=1e-3, atol=2e-2)
+
+
+def test_knn_approx_coarse_recall():
+    """knn_coarse='approx' (lax.approx_max_k on the fresh tile + exact
+    carry merge) + refine must keep recall vs the exact path."""
+    from sctools_tpu.config import configure
+    from sctools_tpu.data.synthetic import gaussian_blobs
+    from sctools_tpu.ops.knn import knn_arrays, knn_numpy, recall_at_k
+
+    pts, _ = gaussian_blobs(4096, 24, 6, seed=9)
+    ref, _d = knn_numpy(pts, pts, k=10, metric="cosine")
+    with configure(knn_coarse="approx", knn_impl="xla"):
+        idx, _ = knn_arrays(pts, pts, k=10, metric="cosine",
+                            n_query=4096, n_cand=4096, refine=32)
+    assert recall_at_k(np.asarray(idx)[:4096], ref) > 0.99
