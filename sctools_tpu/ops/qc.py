@@ -166,11 +166,21 @@ def filter_cells_tpu(
         keep = keep & X.row_mask()
     keep_host = np.asarray(keep)
     idx = np.nonzero(keep_host)[0]
+    return select_cells_device(data, idx)
+
+
+def select_cells_device(data: CellData, idx: np.ndarray) -> CellData:
+    """Subset a CellData to the cells in ``idx`` (device row gather;
+    shared by qc.filter_cells and qc.subsample).  Drops obsp — pairwise
+    graphs refer to dropped rows and must be rebuilt."""
+    X = data.X
+    idx = np.asarray(idx)
     n_new = len(idx)
     if isinstance(X, SparseCells):
         rows_padded = round_up(max(n_new, 1), config.sublane)
         gidx = jnp.asarray(
-            np.pad(idx, (0, rows_padded - n_new), constant_values=X.rows_padded - 1)
+            np.pad(idx, (0, rows_padded - n_new),
+                   constant_values=X.rows_padded - 1)
         )
         ind = jnp.take(X.indices, gidx, axis=0)
         dat = jnp.take(X.data, gidx, axis=0)
@@ -191,6 +201,47 @@ def filter_cells_tpu(
     obs = {k: take(v) for k, v in data.obs.items()}
     obsm = {k: take(v) for k, v in data.obsm.items()}
     return data.replace(X=newX, obs=obs, obsm=obsm, obsp={})
+
+
+def _subsample_idx(n_cells: int, fraction: float | None, n_obs: int | None,
+                   seed: int) -> np.ndarray:
+    if (fraction is None) == (n_obs is None):
+        raise ValueError("qc.subsample needs exactly one of "
+                         "fraction= or n_obs=")
+    if fraction is not None:
+        if not 0.0 < fraction <= 1.0:
+            raise ValueError(f"fraction must be in (0, 1], got {fraction}")
+        n_obs = int(fraction * n_cells)  # floor — scanpy's convention
+    if not 0 < n_obs <= n_cells:
+        raise ValueError(
+            f"n_obs={n_obs} out of range (need 1..{n_cells}); a "
+            "fraction too small to keep one cell also lands here")
+    rng = np.random.default_rng(seed)
+    return np.sort(rng.choice(n_cells, size=n_obs, replace=False))
+
+
+@register("qc.subsample", backend="tpu")
+def subsample_tpu(data: CellData, fraction: float | None = None,
+                  n_obs: int | None = None, seed: int = 0) -> CellData:
+    """Random cell subset (scanpy ``pp.subsample`` semantics: exactly
+    one of ``fraction`` / ``n_obs``; fraction FLOORS to a count),
+    sampled without replacement with a seeded host RNG (identical
+    cells on both backends), order preserved.  Divergence: a selection
+    of zero cells raises instead of returning an empty dataset (every
+    downstream per-cell op would divide by n_cells).  Device row
+    gather; obsp dropped (rebuild the graph)."""
+    idx = _subsample_idx(data.n_cells, fraction, n_obs, seed)
+    return select_cells_device(data, idx)
+
+
+@register("qc.subsample", backend="cpu")
+def subsample_cpu(data: CellData, fraction: float | None = None,
+                  n_obs: int | None = None, seed: int = 0) -> CellData:
+    idx = _subsample_idx(data.n_cells, fraction, n_obs, seed)
+    X = data.X[idx]
+    obs = {k: np.asarray(v)[idx] for k, v in data.obs.items()}
+    obsm = {k: np.asarray(v)[idx] for k, v in data.obsm.items()}
+    return data.replace(X=X, obs=obs, obsm=obsm, obsp={})
 
 
 @register("qc.filter_cells", backend="cpu")

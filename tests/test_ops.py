@@ -110,3 +110,36 @@ def test_hvg_subset(ds):
     assert tpu.n_genes == cpu.n_genes
     np.testing.assert_allclose(tpu.X.toarray(), cpu.X.toarray(),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_subsample_parity_and_contracts():
+    from sctools_tpu.data.synthetic import synthetic_counts
+
+    d = synthetic_counts(500, 300, density=0.1, n_clusters=3, seed=4)
+    dev = d.device_put()
+    t = sct.apply("qc.subsample", dev, backend="tpu", n_obs=123, seed=7)
+    c = sct.apply("qc.subsample", d, backend="cpu", n_obs=123, seed=7)
+    assert t.n_cells == c.n_cells == 123
+    # identical cells chosen (host RNG shared), matrices equal
+    np.testing.assert_allclose(
+        t.to_host().X.toarray(), c.X.toarray(), rtol=1e-6)
+    np.testing.assert_array_equal(
+        np.asarray(t.to_host().obs["cluster_true"]),
+        np.asarray(c.obs["cluster_true"]))
+    f = sct.apply("qc.subsample", d, backend="cpu", fraction=0.25, seed=1)
+    assert f.n_cells == 125
+    with pytest.raises(ValueError, match="exactly one"):
+        sct.apply("qc.subsample", d, backend="cpu")
+    with pytest.raises(ValueError, match="exactly one"):
+        sct.apply("qc.subsample", d, backend="cpu", fraction=0.5, n_obs=10)
+
+
+def test_subsample_fraction_floors_and_rejects_empty():
+    from sctools_tpu.data.synthetic import synthetic_counts
+
+    d = synthetic_counts(499, 100, density=0.1, seed=4)
+    out = sct.apply("qc.subsample", d, backend="cpu", fraction=0.25)
+    assert out.n_cells == 124  # floor(124.75), scanpy's convention
+    for bad in (dict(n_obs=0), dict(n_obs=-5), dict(fraction=0.0001)):
+        with pytest.raises(ValueError, match="out of range"):
+            sct.apply("qc.subsample", d, backend="cpu", **bad)
