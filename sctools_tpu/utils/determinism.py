@@ -1,0 +1,110 @@
+"""Determinism checking — the functional-framework analogue of race
+detection.
+
+Reference parity: the reference framework ships race detection for its
+threaded runtime (source unavailable — SURVEY.md §0).  In this
+framework the device compute path is functional JAX (no shared mutable
+state to race on), so the corresponding hazard class is
+NON-DETERMINISM: accidental dependence on host thread timing (the
+shard prefetcher, the native packer's worker threads), unseeded or
+reused PRNG keys, unstable reductions across shard orderings, or
+nondeterministic collectives.  ``check_deterministic`` catches all of
+those the same way a race detector catches races: run twice, demand
+bit-identical results.
+
+Structure comparison rides on ``jax.tree_util`` — dict/list/tuple
+layouts, registered pytrees (``SparseCells`` flattens to
+indices/data with n_cells/n_genes in the treedef), and key ORDER all
+live in the treedef, so a run-to-run structural change is a mismatch
+even when the leaf values happen to agree.  scipy sparse matrices are
+tree leaves and get an exact sparse comparison.
+
+>>> from sctools_tpu.utils.determinism import check_deterministic
+>>> rep = check_deterministic(lambda: stream_stats(src))
+>>> assert rep.ok, rep.mismatches
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class DeterminismReport:
+    ok: bool
+    mismatches: list  # [(path, max_abs_diff | reason), ...]
+    n_leaves: int
+
+    def __bool__(self):
+        return self.ok
+
+
+def _leaf_mismatch(a, b, exact: bool, atol: float):
+    """None when the leaves agree; otherwise a reason/diff value."""
+    import scipy.sparse as sp
+
+    if sp.issparse(a) or sp.issparse(b):
+        if not (sp.issparse(a) and sp.issparse(b)):
+            return "sparse vs non-sparse"
+        if a.shape != b.shape:
+            return f"shape {a.shape} vs {b.shape}"
+        d = (a - b)
+        if d.nnz == 0:
+            return None
+        diff = float(np.max(np.abs(d.data)))
+        return diff if (exact or diff > atol) else None
+    try:
+        a_np = np.asarray(a)
+        b_np = np.asarray(b)
+    except Exception:
+        return None if (a is b or a == b) else "non-array mismatch"
+    if a_np.shape != b_np.shape or a_np.dtype != b_np.dtype:
+        return (f"shape/dtype {a_np.shape}/{a_np.dtype} vs "
+                f"{b_np.shape}/{b_np.dtype}")
+    if a_np.dtype.kind in "OUS":
+        return (None if np.array_equal(a_np, b_np)
+                else "string/object mismatch")
+    if exact:
+        if np.array_equal(a_np, b_np, equal_nan=True):
+            return None
+        return float(np.max(np.abs(a_np.astype(np.float64)
+                                   - b_np.astype(np.float64))))
+    diff = float(np.max(np.abs(a_np.astype(np.float64)
+                               - b_np.astype(np.float64))))
+    return diff if diff > atol else None
+
+
+def check_deterministic(fn, *args, runs: int = 2, exact: bool = True,
+                        atol: float = 0.0, **kwargs) -> DeterminismReport:
+    """Run ``fn(*args, **kwargs)`` ``runs`` times and compare outputs.
+
+    ``exact=True`` (default) demands bit-identical arrays — the right
+    bar for a single device, where XLA programs are deterministic and
+    any drift means hidden host-side state or key reuse.  Set
+    ``exact=False`` with ``atol`` when comparing across runs that
+    legitimately reorder float reductions (e.g. different shard
+    orderings by design).
+    """
+    if runs < 2:
+        raise ValueError(f"runs={runs} asserts nothing; need >= 2")
+    import jax
+
+    outs = [fn(*args, **kwargs) for _ in range(runs)]
+    leaves0, tree0 = jax.tree_util.tree_flatten_with_path(outs[0])
+    mismatches = []
+    for other in outs[1:]:
+        leaves, tree = jax.tree_util.tree_flatten_with_path(other)
+        if tree != tree0:
+            # covers renamed dict keys, changed container types, and
+            # registered-pytree aux data (SparseCells n_cells/n_genes)
+            mismatches.append(("$", f"tree structure differs: "
+                                    f"{tree0} vs {tree}"))
+            continue
+        for (p0, a), (_, b) in zip(leaves0, leaves):
+            bad = _leaf_mismatch(a, b, exact, atol)
+            if bad is not None:
+                mismatches.append((jax.tree_util.keystr(p0), bad))
+    return DeterminismReport(ok=not mismatches, mismatches=mismatches,
+                             n_leaves=len(leaves0))

@@ -1,0 +1,111 @@
+"""utils.determinism — the race-detection analogue: key pipeline
+stages must be bit-identical across repeat runs (thread timing in the
+prefetcher/packer, PRNG handling, and shard-order reductions are the
+hazards this guards)."""
+
+import numpy as np
+import pytest
+
+from sctools_tpu.data.stream import ShardSource, stream_pipeline
+from sctools_tpu.data.synthetic import synthetic_counts
+from sctools_tpu.utils.determinism import check_deterministic
+
+
+@pytest.fixture(scope="module")
+def counts():
+    return synthetic_counts(800, 300, density=0.1, n_clusters=3, seed=2)
+
+
+def test_detects_nondeterminism():
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        return {"x": np.full(4, state["n"])}
+
+    rep = check_deterministic(flaky)
+    assert not rep.ok
+    assert rep.mismatches
+
+
+def test_detects_shape_drift():
+    state = {"n": 0}
+
+    def grows():
+        state["n"] += 1
+        return [np.zeros(state["n"])]
+
+    rep = check_deterministic(grows)
+    assert not rep.ok
+
+
+def test_stream_pipeline_deterministic(counts):
+    """The full streaming pipeline — including the PREFETCH THREAD
+    (h5ad source) — must be bit-stable run to run."""
+    import tempfile, os
+
+    from sctools_tpu.data.io import write_h5ad
+
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "c.h5ad")
+        write_h5ad(counts, p)
+        src = ShardSource.from_h5ad(p, shard_rows=256)
+        assert src.prefetch  # the threaded path is what's under test
+
+        def run():
+            out = stream_pipeline(src, n_top=100, n_components=10, k=8,
+                                  refine=16)
+            return {"pca": np.asarray(out["X_pca"]),
+                    "knn": np.asarray(out["knn_indices"]),
+                    "hvg": np.asarray(out["hvg_genes"])}
+
+        rep = check_deterministic(run)
+        assert rep.ok, rep.mismatches[:5]
+
+
+def test_tolerance_mode():
+    state = {"n": 0}
+
+    def jitter():
+        state["n"] += 1
+        return np.array([1.0 + 1e-9 * state["n"]])
+
+    assert not check_deterministic(jitter).ok
+    assert check_deterministic(jitter, exact=False, atol=1e-6).ok
+
+
+def test_detects_structure_change():
+    """Same leaf count, different keys — must NOT pass (run-to-run
+    structural drift is exactly what a nondeterministic id produces)."""
+    state = {"n": 0}
+
+    def renames():
+        state["n"] += 1
+        return {f"k{state['n']}": np.zeros(3)}
+
+    rep = check_deterministic(renames)
+    assert not rep.ok
+    assert "structure" in rep.mismatches[0][1]
+
+
+def test_scipy_sparse_leaves_compared_fully():
+    import scipy.sparse as sp
+
+    state = {"n": 0}
+
+    def shifting_pattern():
+        state["n"] += 1
+        # same data/indices arrays, different indptr -> different matrix
+        if state["n"] == 1:
+            return sp.csr_matrix(([1.0, 1.0], [0, 0], [0, 1, 2]),
+                                 shape=(2, 2))
+        return sp.csr_matrix(([1.0, 1.0], [0, 0], [0, 2, 2]),
+                             shape=(2, 2))
+
+    rep = check_deterministic(shifting_pattern)
+    assert not rep.ok
+
+
+def test_runs_validation():
+    with pytest.raises(ValueError, match="asserts nothing"):
+        check_deterministic(lambda: 1, runs=1)
