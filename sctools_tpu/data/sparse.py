@@ -278,19 +278,38 @@ def spmm(x: SparseCells, v: jax.Array, precision=None,
     TPU mapping: per row-block, gather V rows (V padded with a zero
     row so sentinel indices vanish) and contract slots — VPU-bound
     with V resident in VMEM for typical d ≤ 512.
+
+    Dtype policy: with ``precision=None`` the contraction follows
+    ``config.matmul_dtype`` — bfloat16 inputs with float32
+    accumulation when the policy says bf16, true float32
+    (Precision.HIGHEST — on TPU, f32 inputs at DEFAULT silently run
+    bf16 MXU passes) otherwise.  The policy is captured at TRACE time:
+    flip ``config.matmul_dtype`` before the first call of a given
+    shape, not between calls (same caveat as every jitted
+    config-resolved knob; the bench sets it right after acquire).
+    Output is always float32.
     """
+    if precision is None:
+        use_bf16 = jnp.dtype(config.matmul_dtype) == jnp.bfloat16
+        precision = (jax.lax.Precision.DEFAULT if use_bf16
+                     else jax.lax.Precision.HIGHEST)
+        in_dtype = jnp.bfloat16 if use_bf16 else jnp.float32
+    else:
+        in_dtype = v.dtype
     vp = jnp.concatenate([v, jnp.zeros((1, v.shape[1]), v.dtype)], axis=0)
+    vp = vp.astype(in_dtype)
     ind_b, dat_b, nb, pad = _blocked_pair(x, block)
 
     def per_block(args):
         ind, dat = args
         gathered = jnp.take(vp, ind, axis=0)  # (block, C, d)
-        return jnp.einsum("rc,rcd->rd", dat.astype(v.dtype), gathered,
-                          precision=precision)
+        return jnp.einsum("rc,rcd->rd", dat.astype(in_dtype), gathered,
+                          precision=precision,
+                          preferred_element_type=jnp.float32)
 
     out = jax.lax.map(per_block, (ind_b, dat_b))  # (nb, block, d)
     out = out.reshape(nb * block, v.shape[1])
-    return out[: x.rows_padded]
+    return out[: x.rows_padded].astype(jnp.float32)
 
 
 @partial(jax.jit, static_argnames=("block",))

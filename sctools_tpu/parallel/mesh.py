@@ -1,11 +1,17 @@
-"""Device-mesh utilities.
+"""Device-mesh utilities, single-host and multi-host.
 
 Multi-chip execution follows the standard JAX recipe (pick a mesh,
 annotate shardings, let XLA insert collectives): cells are the batch
 axis and shard across devices; genes stay replicated-contiguous so
 per-gene reductions become single ``psum``-backed ``segment_sum``s.
 The reference's NCCL/MPI communication backend maps onto XLA
-collectives over ICI/DCN — nothing here opens sockets.
+collectives — over ICI within a slice, DCN across hosts — and
+``init_distributed`` below is the SPMD bring-up that replaces its
+``MPI_Init``: after it, ``jax.devices()`` spans every host's chips
+and ``make_mesh()`` (no argument) lays the cell axis across the whole
+pod, so the SAME pipeline code runs 1-chip, 8-chip, or multi-host.
+Nothing in this package opens sockets; the collectives are entirely
+XLA's.
 """
 
 from __future__ import annotations
@@ -18,8 +24,64 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 CELL_AXIS = "cells"
 
 
+def init_distributed(coordinator_address: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None) -> dict:
+    """Multi-host SPMD bring-up (the reference's MPI_Init analogue).
+
+    Wraps ``jax.distributed.initialize``: on managed TPU pods every
+    argument is auto-detected from the environment; pass them
+    explicitly elsewhere.  Exactly two failure modes are benign and
+    degrade to a no-op — a repeat call (jax 0.9 raises
+    "should only be called once"), and a bare single-process call
+    with NO arguments where cluster detection finds nothing (jax
+    raises "coordinator_address should be defined").  Everything else
+    re-raises: a failed bring-up on a real pod must never silently
+    fall back to num_processes=1 per host (each host would run the
+    whole job independently and produce duplicated results).
+    Returns {"process_id", "num_processes", "local_devices",
+    "global_devices"}.
+    """
+    import os
+
+    bare_call = (coordinator_address is None and num_processes is None
+                 and process_id is None)
+    # pod-environment hints: when any of these exist, a failed bring-up
+    # is NEVER benign (swallowing it would run every host standalone).
+    # TPU_WORKER_HOSTNAMES counts only with MULTIPLE entries — single-
+    # chip tunnels set it with one hostname on plain one-host sessions.
+    pod_env = any(os.environ.get(v) for v in (
+        "MEGASCALE_COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+        "CLOUD_TPU_TASK_ID")) or (
+        len(os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",")) > 1)
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+    except RuntimeError as e:
+        benign = ("only be called once" in str(e)  # repeat call
+                  # bare late call on a plain single-process host
+                  # (backend already up, no pod to join)
+                  or (bare_call and not pod_env
+                      and "before any JAX" in str(e)))
+        if not benign:
+            raise
+    except ValueError as e:
+        # bare call, cluster auto-detection found nothing to join
+        if not (bare_call and not pod_env
+                and "coordinator_address" in str(e)):
+            raise
+    return {
+        "process_id": jax.process_index(),
+        "num_processes": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }
+
+
 def make_mesh(n_devices: int | None = None, axis_name: str = CELL_AXIS) -> Mesh:
-    """1-D mesh over the first ``n_devices`` devices (all by default)."""
+    """1-D mesh over the first ``n_devices`` GLOBAL devices (all by
+    default — after :func:`init_distributed` that spans every host)."""
     devs = jax.devices()
     if n_devices is not None:
         if n_devices > len(devs):

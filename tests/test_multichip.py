@@ -112,3 +112,20 @@ def test_sharded_pca_cholesky_qr(mesh8):
     Vr = np.asarray(out.varm["PCs"])[:, :5]
     s = np.linalg.svd(Ve.T @ Vr, compute_uv=False)
     assert s.min() > 0.95, f"subspace misaligned: {s}"
+
+
+def test_init_distributed_single_process_noop():
+    """Single-process bring-up degrades to a no-op with honest counts
+    (the same entry point serves multi-host pods)."""
+    from sctools_tpu.parallel.mesh import init_distributed
+
+    info = init_distributed()
+    assert info["process_id"] == 0
+    assert info["num_processes"] == 1
+    # conftest guarantees >= 8 virtual devices, not exactly 8
+    assert info["global_devices"] == info["local_devices"] >= 8
+    # a repeat call must also no-op (idempotency contract)
+    assert init_distributed() == info
+    # explicit args that cannot be joined must NOT be swallowed
+    with pytest.raises((RuntimeError, ValueError)):
+        init_distributed(num_processes=2, process_id=0)
