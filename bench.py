@@ -340,25 +340,37 @@ def run_kernel_bench(jax, on_tpu):
     pts = jax.device_put(pts)
     out = {"n": n, "d": d, "k": k}
     flops = 2.0 * n * n * d
-    impls = (["xla", "xla_approx", "pallas"] if on_tpu
+    impls = (["xla", "xla_approx", "pallas", "pallas_binned"] if on_tpu
              else ["xla", "xla_approx"])
     results = {}
     for impl in impls:
         knobs = (dict(knn_impl="xla", knn_coarse="approx")
-                 if impl == "xla_approx" else dict(knn_impl=impl))
+                 if impl == "xla_approx"
+                 else dict(knn_impl="pallas") if impl.startswith("pallas")
+                 else dict(knn_impl=impl))
+
+        def call():
+            if impl == "pallas_binned":
+                from sctools_tpu.ops.pallas_knn import pallas_knn_arrays
+
+                return pallas_knn_arrays(pts, pts, k=k, metric="cosine",
+                                         n_query=n, n_cand=n,
+                                         merge="binned", n_bins=1024)
+            return knn_arrays(pts, pts, k=k, metric="cosine",
+                              n_query=n, n_cand=n)
+
         try:
             with configure(matmul_dtype="bfloat16", **knobs):
                 t0 = time.time()
-                i1, _ = knn_arrays(pts, pts, k=k, metric="cosine",
-                                   n_query=n, n_cand=n)
+                i1, _ = call()
                 i1.block_until_ready()
                 first = time.time() - t0
                 t0 = time.time()
-                i2, _ = knn_arrays(pts, pts, k=k, metric="cosine",
-                                   n_query=n, n_cand=n)
+                i2, _ = call()
                 i2.block_until_ready()
                 steady = time.time() - t0
-            results[impl] = np.asarray(i2)
+            # trim each impl's own row padding so comparisons align
+            results[impl] = np.asarray(i2)[:n]
             kind = jax.devices()[0].device_kind
             peak = _PEAK_BF16.get(kind)
             out[impl] = {"wall_s": round(steady, 3),
@@ -378,15 +390,20 @@ def run_kernel_bench(jax, on_tpu):
         # require near-total agreement, not bit equality
         out["pallas_xla_idx_agreement"] = round(float(
             (results["pallas"] == results["xla"]).mean()), 4)
-    if ("wall_s" in out.get("xla_approx", {})
-            and "wall_s" in out.get("xla", {})):
-        out["approx_speedup_vs_xla"] = round(
-            out["xla"]["wall_s"] / out["xla_approx"]["wall_s"], 2)
-        # approx drops a bin-collided candidate per block at most; the
-        # production path re-ranks a refine-wide superset, so what
-        # matters here is high (not bit-exact) agreement
-        out["approx_xla_idx_agreement"] = round(float(
-            (results["xla_approx"] == results["xla"]).mean()), 4)
+    from sctools_tpu.ops.knn import recall_at_k
+
+    for variant in ("xla_approx", "pallas_binned"):
+        if ("wall_s" in out.get(variant, {})
+                and "wall_s" in out.get("xla", {})):
+            out[f"{variant}_speedup_vs_xla"] = round(
+                out["xla"]["wall_s"] / out[variant]["wall_s"], 2)
+            # order-INSENSITIVE recall vs the exact path: a dropped
+            # bin-collided neighbour shifts every later column, so
+            # positional equality would deflate a ~0.999-recall result
+            # to ~0.95 — recall_at_k is the metric the auto-flip
+            # decision should read
+            out[f"{variant}_recall_vs_xla"] = round(recall_at_k(
+                results[variant][:, :k], results["xla"][:, :k]), 4)
     return out
 
 

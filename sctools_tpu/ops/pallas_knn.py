@@ -38,16 +38,13 @@ from ..config import config, round_up
 _NEG = float("-inf")  # plain float: jax-array constants cannot be captured by kernels
 
 
-def _knn_kernel(q_ref, c_ref, out_v_ref, out_i_ref, acc_v, acc_i, *,
-                k: int, qb: int, cb: int, k_pad: int, n_cand: int,
-                metric: str, exclude_self: bool, precision):
+def _score_tile(q_ref, c_ref, *, qb, cb, n_cand, metric, exclude_self,
+                precision):
+    """The (qb, cb) similarity tile of grid cell (i, j): MXU matmul,
+    metric rewrite, candidate-range and self masks.  Shared by both
+    merge kernels so mask/tie-break fixes cannot diverge.
+    Returns (s, gcol)."""
     j = pl.program_id(1)
-
-    @pl.when(j == 0)
-    def _():
-        acc_v[:] = jnp.full((qb, k_pad), _NEG, jnp.float32)
-        acc_i[:] = jnp.full((qb, k_pad), -1, jnp.int32)
-
     q = q_ref[:]  # (qb, d)
     c = c_ref[:]  # (cb, d)
     s = jnp.dot(q, c.T, preferred_element_type=jnp.float32,
@@ -63,13 +60,15 @@ def _knn_kernel(q_ref, c_ref, out_v_ref, out_i_ref, acc_v, acc_i, *,
         i = pl.program_id(0)
         grow = i * qb + jax.lax.broadcasted_iota(jnp.int32, (qb, cb), 0)
         s = jnp.where(gcol == grow, _NEG, s)
+    return s, gcol
 
-    # merge: k-step selection over the union of the running top-k and
-    # the fresh tile.  Values/ids are captured before the in-place
-    # scratch writes below, so the loop reads a consistent snapshot.
-    A = jnp.concatenate([acc_v[:], s], axis=1)  # (qb, k_pad + cb)
-    I = jnp.concatenate([acc_i[:], gcol], axis=1)
-    width = k_pad + cb
+
+def _select_topk(A, I, k, write_v, write_i):
+    """k-step selection (max + first-index + suppress) over value
+    matrix ``A`` with aligned ids ``I``; emits each extracted
+    (value, id) pair through the write callbacks (ties break to the
+    lowest column — keep in lockstep across both kernels)."""
+    qb, width = A.shape
     allcol = jax.lax.broadcasted_iota(jnp.int32, (qb, width), 1)
     big = jnp.int32(width)
     for t in range(k):
@@ -77,9 +76,33 @@ def _knn_kernel(q_ref, c_ref, out_v_ref, out_i_ref, acc_v, acc_i, *,
         sel = jnp.min(jnp.where(A >= vmax[:, None], allcol, big), axis=1)
         hit = allcol == sel[:, None]
         ival = jnp.sum(jnp.where(hit, I, 0), axis=1)
-        acc_v[:, t] = vmax
-        acc_i[:, t] = jnp.where(jnp.isfinite(vmax), ival, -1)
+        write_v(t, vmax)
+        write_i(t, jnp.where(jnp.isfinite(vmax), ival, -1))
         A = jnp.where(hit, _NEG, A)
+
+
+def _knn_kernel(q_ref, c_ref, out_v_ref, out_i_ref, acc_v, acc_i, *,
+                k: int, qb: int, cb: int, k_pad: int, n_cand: int,
+                metric: str, exclude_self: bool, precision):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        acc_v[:] = jnp.full((qb, k_pad), _NEG, jnp.float32)
+        acc_i[:] = jnp.full((qb, k_pad), -1, jnp.int32)
+
+    s, gcol = _score_tile(q_ref, c_ref, qb=qb, cb=cb, n_cand=n_cand,
+                          metric=metric, exclude_self=exclude_self,
+                          precision=precision)
+
+    # merge: k-step selection over the union of the running top-k and
+    # the fresh tile.  Values/ids are captured before the in-place
+    # scratch writes below, so the loop reads a consistent snapshot.
+    A = jnp.concatenate([acc_v[:], s], axis=1)  # (qb, k_pad + cb)
+    I = jnp.concatenate([acc_i[:], gcol], axis=1)
+    _select_topk(A, I, k,
+                 lambda t, v: acc_v.__setitem__((slice(None), t), v),
+                 lambda t, i_: acc_i.__setitem__((slice(None), t), i_))
 
     @pl.when(j == pl.num_programs(1) - 1)
     def _():
@@ -87,13 +110,66 @@ def _knn_kernel(q_ref, c_ref, out_v_ref, out_i_ref, acc_v, acc_i, *,
         out_i_ref[:] = acc_i[:]
 
 
+def _knn_kernel_binned(q_ref, c_ref, out_v_ref, out_i_ref, acc_v, acc_i,
+                       *, k: int, qb: int, cb: int, k_pad: int,
+                       n_bins: int, n_cand: int, metric: str,
+                       exclude_self: bool, precision):
+    """Binned-approximate merge (the TPU-KNN shape): the accumulator
+    holds ONE candidate per bin (bin = column position mod n_bins), so
+    the per-tile merge is a reshape-max plus an elementwise running
+    max — no k-step selection until the very last tile.  Two global
+    top-k candidates land in one bin with probability ~k²/(2·n_bins),
+    losing the weaker one: that is the approximation, the same
+    trade `lax.approx_max_k` makes, tunable via n_bins and recovered
+    downstream by the refine re-rank's wider search."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        acc_v[:] = jnp.full((qb, n_bins), _NEG, jnp.float32)
+        acc_i[:] = jnp.full((qb, n_bins), -1, jnp.int32)
+
+    s, _gcol = _score_tile(q_ref, c_ref, qb=qb, cb=cb, n_cand=n_cand,
+                           metric=metric, exclude_self=exclude_self,
+                           precision=precision)
+
+    # per-bin max of this tile: (qb, cb) -> (qb, cb//n_bins, n_bins)
+    folds = cb // n_bins
+    s3 = s.reshape(qb, folds, n_bins)
+    tile_max = jnp.max(s3, axis=1)  # (qb, n_bins)
+    # index of that max: first fold achieving it, bin-local -> global
+    fold_iota = jax.lax.broadcasted_iota(jnp.int32, (qb, folds, n_bins), 1)
+    hit = s3 >= tile_max[:, None, :]
+    fold_sel = jnp.min(jnp.where(hit, fold_iota, jnp.int32(folds)), axis=1)
+    bin_iota = jax.lax.broadcasted_iota(jnp.int32, (qb, n_bins), 1)
+    tile_idx = j * cb + fold_sel * n_bins + bin_iota
+
+    better = tile_max > acc_v[:]
+    acc_v[:] = jnp.where(better, tile_max, acc_v[:])
+    acc_i[:] = jnp.where(better & jnp.isfinite(tile_max), tile_idx,
+                         acc_i[:])
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        # exact top-k extraction over the n_bins survivors, once
+        _select_topk(
+            acc_v[:], acc_i[:], k,
+            lambda t, v: out_v_ref.__setitem__((slice(None), t), v),
+            lambda t, i_: out_i_ref.__setitem__((slice(None), t), i_))
+        for t in range(k, k_pad):
+            out_v_ref[:, t] = jnp.full((qb,), _NEG, jnp.float32)
+            out_i_ref[:, t] = jnp.full((qb,), -1, jnp.int32)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("k", "metric", "n_query", "n_cand", "qb", "cb",
-                     "mm_dtype", "exclude_self", "interpret", "lane"),
+                     "mm_dtype", "exclude_self", "interpret", "lane",
+                     "merge", "n_bins"),
 )
 def _pallas_knn_jit(query, cand, *, k, metric, n_query, n_cand, qb, cb,
-                    mm_dtype, exclude_self, interpret, lane):
+                    mm_dtype, exclude_self, interpret, lane,
+                    merge="select", n_bins=512):
     from .knn import _prep
 
     mm_dtype = jnp.dtype(mm_dtype)
@@ -115,9 +191,19 @@ def _pallas_knn_jit(query, cand, *, k, metric, n_query, n_cand, qb, cb,
     # (same convention as ops/knn.py)
     precision = (jax.lax.Precision.HIGHEST if mm_dtype == jnp.float32
                  else jax.lax.Precision.DEFAULT)
-    kernel = functools.partial(
-        _knn_kernel, k=k, qb=qb, cb=cb, k_pad=k_pad, n_cand=n_cand,
-        metric=metric, exclude_self=exclude_self, precision=precision)
+    if merge == "binned":
+        kernel = functools.partial(
+            _knn_kernel_binned, k=k, qb=qb, cb=cb, k_pad=k_pad,
+            n_bins=n_bins, n_cand=n_cand, metric=metric,
+            exclude_self=exclude_self, precision=precision)
+        scratch = [pltpu.VMEM((qb, n_bins), jnp.float32),
+                   pltpu.VMEM((qb, n_bins), jnp.int32)]
+    else:
+        kernel = functools.partial(
+            _knn_kernel, k=k, qb=qb, cb=cb, k_pad=k_pad, n_cand=n_cand,
+            metric=metric, exclude_self=exclude_self, precision=precision)
+        scratch = [pltpu.VMEM((qb, k_pad), jnp.float32),
+                   pltpu.VMEM((qb, k_pad), jnp.int32)]
     vals, idxs = pl.pallas_call(
         kernel,
         grid=grid,
@@ -137,10 +223,7 @@ def _pallas_knn_jit(query, cand, *, k, metric, n_query, n_cand, qb, cb,
             jax.ShapeDtypeStruct((nq_pad, k_pad), jnp.float32),
             jax.ShapeDtypeStruct((nq_pad, k_pad), jnp.int32),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((qb, k_pad), jnp.float32),
-            pltpu.VMEM((qb, k_pad), jnp.int32),
-        ],
+        scratch_shapes=scratch,
         interpret=interpret,
     )(q, c)
     vals = vals[:, :k]
@@ -156,11 +239,21 @@ def pallas_knn_arrays(query, cand, *, k: int = 15, metric: str = "cosine",
                       n_query: int | None = None, n_cand: int | None = None,
                       query_block: int | None = None,
                       cand_block: int | None = None,
-                      exclude_self: bool = False):
+                      exclude_self: bool = False,
+                      merge: str = "select", n_bins: int = 512):
     """Drop-in counterpart of ``knn.knn_arrays`` (coarse search only —
-    compose with ``knn._refine_jit`` for the exact re-rank)."""
+    compose with ``knn._refine_jit`` for the exact re-rank).
+
+    ``merge``: "select" (exact k-step selection per tile, the
+    default) or "binned" (one-candidate-per-bin running max — ~k× less
+    VPU work per tile, approximate: two true top-k in one of the
+    ``n_bins`` bins lose the weaker, P ≈ k²/2·n_bins per query; exact
+    whenever ``n_cand <= n_bins`` since every candidate then owns its
+    bin)."""
     if metric not in ("cosine", "euclidean"):
         raise ValueError(f"unknown metric {metric!r}")
+    if merge not in ("select", "binned"):
+        raise ValueError(f"unknown merge {merge!r}")
     n_query = n_query or query.shape[0]
     n_cand = n_cand or cand.shape[0]
     # Mosaic requires VMEM tiles aligned to the (sublane, lane) grid:
@@ -170,6 +263,11 @@ def pallas_knn_arrays(query, cand, *, k: int = 15, metric: str = "cosine",
     cb = cand_block or min(config.col_block, 1024)
     qb = round_up(max(qb, config.sublane), config.sublane)
     cb = round_up(max(cb, config.lane), config.lane)
+    if merge == "binned":
+        if k > n_bins:
+            raise ValueError(f"k={k} > n_bins={n_bins}")
+        n_bins = round_up(n_bins, config.lane)
+        cb = round_up(cb, n_bins)  # the fold reshape needs cb % n_bins == 0
     return _pallas_knn_jit(
         query, cand, k=k, metric=metric, n_query=n_query, n_cand=n_cand,
         qb=qb, cb=cb,
@@ -177,4 +275,5 @@ def pallas_knn_arrays(query, cand, *, k: int = 15, metric: str = "cosine",
         exclude_self=exclude_self,
         interpret=config.interpret_mode(),
         lane=config.lane,
+        merge=merge, n_bins=n_bins,
     )
