@@ -30,17 +30,25 @@ def pairwise_arrays(query, cand, metric: str = "cosine"):
 
 @partial(jax.jit, static_argnames=("metric", "mm_dtype"))
 def _pairwise_jit(query, cand, *, metric, mm_dtype):
-    q = jnp.asarray(query, jnp.dtype(mm_dtype))
-    c = jnp.asarray(cand, jnp.dtype(mm_dtype))
+    mm_dtype = jnp.dtype(mm_dtype)
+    # the numerics contract (config.py): f32 policy means TRUE f32 —
+    # on TPU, f32 inputs at DEFAULT precision silently run bf16 MXU
+    # passes, so request HIGHEST explicitly (same as knn/spmm)
+    precision = (jax.lax.Precision.HIGHEST if mm_dtype == jnp.float32
+                 else jax.lax.Precision.DEFAULT)
+    q = jnp.asarray(query, mm_dtype)
+    c = jnp.asarray(cand, mm_dtype)
     if metric == "cosine":
         q = q / jnp.maximum(jnp.linalg.norm(q, axis=1, keepdims=True), 1e-12)
         c = c / jnp.maximum(jnp.linalg.norm(c, axis=1, keepdims=True), 1e-12)
-        return 1.0 - jnp.dot(q, c.T, preferred_element_type=jnp.float32)
+        return 1.0 - jnp.dot(q, c.T, preferred_element_type=jnp.float32,
+                             precision=precision)
     if metric == "euclidean":
         qn2 = jnp.sum(q.astype(jnp.float32) ** 2, axis=1)
         cn2 = jnp.sum(c.astype(jnp.float32) ** 2, axis=1)
         d2 = qn2[:, None] - 2.0 * jnp.dot(
-            q, c.T, preferred_element_type=jnp.float32
+            q, c.T, preferred_element_type=jnp.float32,
+            precision=precision
         ) + cn2[None, :]
         return jnp.sqrt(jnp.maximum(d2, 0.0))
     raise ValueError(f"unknown metric {metric!r}")

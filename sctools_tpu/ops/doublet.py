@@ -133,7 +133,9 @@ def _project_doublets(ind, dat, pairs, comps, mu, target_sum: float,
         # project: zero rows of comps_pad kill sentinel slots; merged
         # (zero-valued) slots contribute 0 regardless of their index
         g = jnp.take(comps_pad, jnp.minimum(ind_s, comps.shape[0]), axis=0)
-        return jnp.einsum("bc,bcd->bd", v, g) - mu_proj[None, :]
+        return jnp.einsum("bc,bcd->bd", v, g,
+                          precision=jax.lax.Precision.HIGHEST
+                          ) - mu_proj[None, :]
 
     out = jax.lax.map(
         per_block, pairs.reshape((n_sim + pad) // block, block, 2))

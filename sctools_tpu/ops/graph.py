@@ -219,7 +219,8 @@ def knn_matvec(knn_idx, weights, x):
     safe = jnp.where(knn_idx < 0, 0, knn_idx)
     w = jnp.where(knn_idx < 0, 0.0, weights)
     gathered = jnp.take(x, safe, axis=0)  # (n, k, d)
-    return jnp.einsum("nk,nkd->nd", w, gathered)
+    return jnp.einsum("nk,nkd->nd", w, gathered,
+                      precision=jax.lax.Precision.HIGHEST)
 
 
 @partial(jax.jit, static_argnames=("n",))

@@ -128,8 +128,12 @@ def harmony_arrays(Z, phi, key, n_clusters: int, theta: float = 2.0,
             A, rhs = carry
             r, g, z = inp
             rg = r[:, :, None] * g[:, None, :]  # (chunk, K, P)
-            A = A + jnp.einsum("ckp,cq->kpq", rg, g)
-            rhs = rhs + jnp.einsum("ckp,cd->kpd", rg, z)
+            # cell-axis contractions feeding a linear SOLVE: the
+            # numerics contract keeps solve inputs true f32 (TPU
+            # DEFAULT would run bf16 MXU passes)
+            hi = jax.lax.Precision.HIGHEST
+            A = A + jnp.einsum("ckp,cq->kpq", rg, g, precision=hi)
+            rhs = rhs + jnp.einsum("ckp,cd->kpd", rg, z, precision=hi)
             return (A, rhs), None
 
         K = R.shape[1]
@@ -144,7 +148,8 @@ def harmony_arrays(Z, phi, key, n_clusters: int, theta: float = 2.0,
 
         def app(carry, inp):
             r, g = inp
-            corr = jnp.einsum("ck,cp,kpd->cd", r, g, W)
+            corr = jnp.einsum("ck,cp,kpd->cd", r, g, W,
+                              precision=jax.lax.Precision.HIGHEST)
             return carry, corr
 
         _, corr = jax.lax.scan(
