@@ -447,7 +447,9 @@ def diffusion_eigs(knn_idx, s_edges, key, n_comps: int = 15,
                 precision=jax.lax.Precision.HIGHEST)
     evals, W = jnp.linalg.eigh(0.5 * (H + H.T))
     order = jnp.argsort(-evals)[: n_comps]
-    return evals[order], (V @ W)[:, order]
+    rot = jnp.dot(V, W, preferred_element_type=jnp.float32,
+                  precision=jax.lax.Precision.HIGHEST)  # Ritz rotation
+    return evals[order], rot[:, order]
 
 
 @register("embed.spectral", backend="tpu")
