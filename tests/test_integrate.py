@@ -66,3 +66,55 @@ def test_harmony_validates_inputs(batched_blobs):
                   batch_key="nope")
     with pytest.raises(ValueError, match="use_rep"):
         sct.apply("integrate.harmony", ds.replace(obsm={}), backend="cpu")
+
+
+# ----------------------------------------------------------------------
+# integrate.combat
+# ----------------------------------------------------------------------
+
+
+def _batched_data(n=600, g=80, shift=3.0, seed=11):
+    rng = np.random.default_rng(seed)
+    base = rng.normal(size=(n, g))
+    batch = (np.arange(n) % 3).astype(np.int32)
+    # location AND scale effects per batch
+    X = base + shift * batch[:, None] * rng.random(g)[None, :]
+    X *= (1.0 + 0.5 * batch[:, None] * rng.random(g)[None, :])
+    from sctools_tpu.data.dataset import CellData
+
+    return CellData(X.astype(np.float32),
+                    obs={"batch": np.array([f"b{i}" for i in batch])})
+
+
+def test_combat_removes_batch_effect():
+    d = _batched_data()
+    out = sct.apply("integrate.combat", d, backend="tpu")
+    X = np.asarray(out.X)
+    batch = (np.arange(600) % 3)
+    means = np.stack([X[batch == b].mean(0) for b in range(3)])
+    # per-batch gene means nearly equal after correction...
+    assert np.max(np.abs(means - means.mean(0))) < 0.15
+    # ...while before correction they differ grossly
+    X0 = np.asarray(d.X)
+    means0 = np.stack([X0[batch == b].mean(0) for b in range(3)])
+    assert np.max(np.abs(means0 - means0.mean(0))) > 0.5
+
+
+def test_combat_backend_parity():
+    d = _batched_data(seed=12)
+    t = sct.apply("integrate.combat", d, backend="tpu")
+    c = sct.apply("integrate.combat", d, backend="cpu")
+    np.testing.assert_allclose(np.asarray(t.X), np.asarray(c.X),
+                               rtol=2e-3, atol=2e-3)
+    assert list(t.uns["combat_batches"]) == list(c.uns["combat_batches"])
+
+
+def test_combat_validation():
+    from sctools_tpu.data.dataset import CellData
+
+    d = CellData(np.zeros((10, 4), np.float32),
+                 obs={"batch": np.array(["a"] * 10)})
+    with pytest.raises(ValueError, match="2 batches"):
+        sct.apply("integrate.combat", d, backend="cpu")
+    with pytest.raises(KeyError, match="nope"):
+        sct.apply("integrate.combat", d, backend="cpu", batch_key="nope")
