@@ -367,3 +367,118 @@ def recall_at_k(pred_idx, true_idx, k: int | None = None) -> float:
     # anywhere in the predicted row.
     hits = (true_idx[:, :, None] == pred_idx[:, None, :]).any(axis=2)
     return float(hits.sum()) / (n * true_idx.shape[1])
+
+
+# ----------------------------------------------------------------------
+# neighbors.bbknn — batch-balanced kNN (BBKNN)
+# ----------------------------------------------------------------------
+
+
+def _bbknn_combine(parts):
+    """Stack per-batch (idx, dist) results and sort each row by
+    distance (missing slots ranked last)."""
+    gi = np.concatenate([p[0] for p in parts], axis=1)   # (n, B*k)
+    gd = np.concatenate([p[1] for p in parts], axis=1)
+    gd = np.where(gi < 0, np.inf, gd)
+    order = np.argsort(gd, axis=1, kind="stable")
+    gi = np.take_along_axis(gi, order, axis=1)
+    gd = np.take_along_axis(gd, order, axis=1)
+    gd = np.where(gi < 0, np.inf, gd).astype(np.float32)
+    return gi.astype(np.int32), np.where(np.isfinite(gd), gd, 0.0)
+
+
+def _bbknn_driver(batch, n, k_within, search):
+    """One BBKNN pass, parameterised by ``search(sel, k) -> (idx,
+    dist)`` (per-batch local-index results) — the backends share every
+    line of the mapping/self-drop/sort logic, so they cannot diverge.
+    Every batch contributes EXACTLY ``k_within`` columns; batches with
+    fewer cells pad with -1 (consistent shapes and uns["knn_k"] on
+    both backends regardless of batch sizes)."""
+    levels = np.unique(batch)
+    if len(levels) < 2:
+        raise ValueError("bbknn needs >= 2 batches")
+    parts = []
+    for lv in levels:
+        sel = np.flatnonzero(batch == lv)
+        # a query's own row is a candidate only within its own batch,
+        # and global/local id mismatch makes exclude_self= unusable —
+        # search one extra then drop selfs
+        k_eff = min(k_within + 1, len(sel))
+        idx, dist = search(sel, k_eff)
+        idx = np.asarray(idx)[:n]
+        dist = np.asarray(dist)[:n]
+        gidx = np.where(idx >= 0, sel[np.clip(idx, 0, len(sel) - 1)], -1)
+        self_hit = gidx == np.arange(n)[:, None]
+        gidx = np.where(self_hit, -1, gidx)
+        dist = np.where(self_hit, np.inf, dist)
+        order = np.argsort(np.where(gidx < 0, np.inf, dist), axis=1,
+                           kind="stable")[:, :k_within]
+        gi = np.take_along_axis(gidx, order, axis=1)
+        gd = np.take_along_axis(dist, order, axis=1)
+        if gi.shape[1] < k_within:  # batch smaller than k_within
+            pad = k_within - gi.shape[1]
+            gi = np.pad(gi, ((0, 0), (0, pad)), constant_values=-1)
+            gd = np.pad(gd, ((0, 0), (0, pad)), constant_values=np.inf)
+        parts.append((gi, gd))
+    return _bbknn_combine(parts), levels
+
+
+_BBKNN_DOC = """Batch-balanced kNN (the BBKNN method): every cell takes
+its ``k_within`` nearest neighbours FROM EACH BATCH, so no batch can
+monopolise a neighbourhood — the lightweight graph-level integration.
+Adds obsp["knn_indices"/"knn_distances"] with k = n_batches x
+k_within (rows sorted by distance; self matches dropped; batches
+smaller than k_within pad with -1) — feed graph.connectivities next,
+as with neighbors.knn."""
+
+
+@register("neighbors.bbknn", backend="tpu")
+def bbknn_tpu(data: CellData, batch_key: str = "batch",
+              k_within: int = 3, metric: str = "cosine",
+              use_rep: str = "X_pca", refine: int = 0) -> CellData:
+    if batch_key not in data.obs:
+        raise KeyError(f"obs has no {batch_key!r}")
+    rep = jnp.asarray(_get_rep(data, use_rep))
+    n = data.n_cells
+    rep = rep[:n]
+    batch = np.asarray(data.obs[batch_key])[:n]
+
+    def search(sel, k):
+        cand = jnp.take(rep, jnp.asarray(sel), axis=0)
+        return knn_arrays(rep, cand, k=k, metric=metric,
+                          n_query=n, n_cand=len(sel), refine=refine)
+
+    (gi, gd), levels = _bbknn_driver(batch, n, k_within, search)
+    return data.with_obsp(knn_indices=gi, knn_distances=gd).with_uns(
+        knn_k=gi.shape[1], knn_metric=metric,
+        bbknn_batches=levels, bbknn_k_within=k_within)
+
+
+bbknn_tpu.__doc__ = _BBKNN_DOC + """
+
+TPU path: one blocked MXU search per batch over that batch's
+candidate block."""
+
+
+@register("neighbors.bbknn", backend="cpu")
+def bbknn_cpu(data: CellData, batch_key: str = "batch",
+              k_within: int = 3, metric: str = "cosine",
+              use_rep: str = "X_pca", **_ignored) -> CellData:
+    if batch_key not in data.obs:
+        raise KeyError(f"obs has no {batch_key!r}")
+    rep = np.asarray(_get_rep_cpu(data, use_rep), np.float64)[: data.n_cells]
+    n = len(rep)
+    batch = np.asarray(data.obs[batch_key])[:n]
+
+    def search(sel, k):
+        return knn_numpy(rep, rep[sel], k=k, metric=metric)
+
+    (gi, gd), levels = _bbknn_driver(batch, n, k_within, search)
+    return data.with_obsp(knn_indices=gi, knn_distances=gd).with_uns(
+        knn_k=gi.shape[1], knn_metric=metric,
+        bbknn_batches=levels, bbknn_k_within=k_within)
+
+
+bbknn_cpu.__doc__ = _BBKNN_DOC + """
+
+numpy oracle: identical per-batch brute-force searches."""

@@ -158,3 +158,72 @@ def test_knn_approx_coarse_recall():
         idx, _ = knn_arrays(pts, pts, k=10, metric="cosine",
                             n_query=4096, n_cand=4096, refine=32)
     assert recall_at_k(np.asarray(idx)[:4096], ref) > 0.99
+
+
+def test_bbknn_balances_batches():
+    """Every cell must get exactly k_within neighbours from EACH batch
+    even when one batch dominates, and both backends must agree."""
+    from sctools_tpu.data.dataset import CellData
+    from sctools_tpu.data.synthetic import gaussian_blobs
+
+    rng = np.random.default_rng(17)
+    n = 480
+    pts, _ = gaussian_blobs(n, 12, 4, spread=0.3, seed=17)
+    # unbalanced batches with a systematic shift
+    batch = np.where(np.arange(n) < 400, "big", "small")
+    pts = pts + 0.5 * (batch == "small")[:, None].astype(np.float32)
+    d = CellData(np.zeros((n, 4), np.float32),
+                 obs={"batch": batch}).with_obsm(X_pca=pts)
+    t = sct.apply("neighbors.bbknn", d, backend="tpu", k_within=3)
+    c = sct.apply("neighbors.bbknn", d, backend="cpu", k_within=3)
+    it = np.asarray(t.obsp["knn_indices"])
+    ic = np.asarray(c.obsp["knn_indices"])
+    assert it.shape == (n, 6)
+    # per-row neighbour sets identical across backends
+    match = np.mean([set(it[i]) == set(ic[i]) for i in range(n)])
+    assert match > 0.99, match
+    # balance: exactly 3 from each batch for every cell, no selfs
+    from_small = (batch[np.clip(it, 0, n - 1)] == "small") & (it >= 0)
+    assert (from_small.sum(axis=1) == 3).all()
+    assert not (it == np.arange(n)[:, None]).any()
+    # plain kNN by contrast lets the big batch dominate
+    plain = sct.apply("neighbors.knn", d, backend="cpu", k=6,
+                      exclude_self=True)
+    ip = np.asarray(plain.obsp["knn_indices"])
+    small_frac_plain = ((batch[np.clip(ip, 0, n - 1)] == "small")
+                        & (ip >= 0)).mean()
+    assert small_frac_plain < 0.4  # unbalanced without bbknn
+
+
+def test_bbknn_validation():
+    from sctools_tpu.data.dataset import CellData
+
+    d = CellData(np.zeros((10, 4), np.float32),
+                 obs={"batch": np.array(["a"] * 10)}).with_obsm(
+        X_pca=np.zeros((10, 3), np.float32))
+    with pytest.raises(ValueError, match="2 batches"):
+        sct.apply("neighbors.bbknn", d, backend="cpu")
+
+
+def test_bbknn_small_batch_pads_consistently():
+    """A batch smaller than k_within must pad with -1 and keep the
+    SAME shapes/knn_k on both backends (the pre-driver code diverged
+    here: cpu clamped k, tpu did not)."""
+    from sctools_tpu.data.dataset import CellData
+
+    rng = np.random.default_rng(3)
+    n = 12
+    pts = rng.normal(size=(n, 5)).astype(np.float32)
+    batch = np.array(["a"] * 10 + ["b"] * 2)
+    d = CellData(np.zeros((n, 2), np.float32),
+                 obs={"batch": batch}).with_obsm(X_pca=pts)
+    t = sct.apply("neighbors.bbknn", d, backend="tpu", k_within=3)
+    c = sct.apply("neighbors.bbknn", d, backend="cpu", k_within=3)
+    it, ic = np.asarray(t.obsp["knn_indices"]), np.asarray(c.obsp["knn_indices"])
+    assert it.shape == ic.shape == (n, 6)
+    assert int(t.uns["knn_k"]) == int(c.uns["knn_k"]) == 6
+    # the 2-cell batch can supply at most 2 non-self neighbours; for
+    # its own members only 1 — so -1 padding must appear
+    assert (it == -1).any() and (ic == -1).any()
+    match = np.mean([set(it[i]) == set(ic[i]) for i in range(n)])
+    assert match == 1.0, match
