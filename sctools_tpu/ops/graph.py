@@ -554,3 +554,96 @@ def dpt_cpu(data: CellData, root: int = 0) -> CellData:
     d = d / max(d.max(), 1e-12)
     return data.with_obs(dpt_pseudotime=d.astype(np.float32)).with_uns(
         dpt_root=root)
+
+
+# ----------------------------------------------------------------------
+# graph.paga — partition-based graph abstraction
+# ----------------------------------------------------------------------
+
+
+def _paga_stats(idx, w, labels, n_groups):
+    """Inter-group connectivity statistics on the weighted kNN edge
+    list (host numpy — the group graph is tiny; the per-cell work
+    upstream was the device's job).
+
+    theta follows the scanpy ``tl.paga`` v1.2 convention: the
+    symmetrised inter-group edge WEIGHT divided by its random-wiring
+    expectation ``(es_i·n_j + es_j·n_i)/(n−1)`` — where ``es_g`` is
+    the total edge weight incident to group g and ``n_g`` its size —
+    clipped to [0, 1].  No global re-normalisation: absolute
+    thresholds carried over from scanpy keep their meaning.
+    """
+    n, k = idx.shape
+    rows = np.repeat(labels, k)
+    cols = idx.reshape(-1)
+    wf = np.asarray(w, np.float64).reshape(-1)
+    # self-edges carry no inter-group information and would inflate es
+    keep = (cols >= 0) & (wf > 0) & (cols != np.repeat(np.arange(n), k))
+    lj = labels[np.clip(cols, 0, n - 1)]
+    import scipy.sparse as sp
+
+    W = sp.coo_matrix((wf[keep], (rows[keep], lj[keep])),
+                      shape=(n_groups, n_groups)).toarray()
+    C = W + W.T  # symmetrised inter-group weight (each edge ≤ twice)
+    np.fill_diagonal(C, 0.0)
+    sizes = np.bincount(labels, minlength=n_groups).astype(np.float64)
+    es = W.sum(axis=1) + W.sum(axis=0)  # total incident weight per group
+    expected = (np.outer(es, sizes) + np.outer(sizes, es)) / max(n - 1, 1)
+    np.fill_diagonal(expected, 1.0)
+    theta = np.clip(C / np.maximum(expected, 1e-12), 0.0, 1.0)
+    np.fill_diagonal(theta, 0.0)
+    return C, expected, theta.astype(np.float32)
+
+
+def _paga_impl(data: CellData, groups: str) -> CellData:
+    if groups not in data.obs:
+        raise KeyError(
+            f"obs has no {groups!r} — run cluster.leiden (or another "
+            "clustering) first")
+    idx, _ = _require_knn(data)
+    n = data.n_cells
+    idx = np.asarray(idx)[:n]
+    w = None
+    if "connectivities" in data.obsp:
+        cand = np.asarray(data.obsp["connectivities"], np.float64)[:n]
+        if cand.shape == idx.shape:
+            w = cand
+        else:
+            import warnings
+
+            warnings.warn(
+                "graph.paga: obsp['connectivities'] shape "
+                f"{cand.shape} does not match the current kNN graph "
+                f"{idx.shape} (stale after a kNN rebuild?) — using "
+                "unit edge weights", stacklevel=3)
+    if w is None:
+        w = np.ones_like(idx, np.float64)
+    labels = np.asarray(data.obs[groups])[:n]
+    uniq, codes = np.unique(labels, return_inverse=True)
+    C, exp, theta = _paga_stats(idx, w, codes.astype(np.int64), len(uniq))
+    return data.with_uns(
+        paga_connectivities=theta,
+        paga_edge_weights=C.astype(np.float32),
+        paga_groups=uniq)
+
+
+@register("graph.paga", backend="tpu")
+def paga_tpu(data: CellData, groups: str = "leiden") -> CellData:
+    """PAGA (partition-based graph abstraction): the cluster-level
+    connectivity map — symmetrised inter-group edge weight over the
+    degree-based random-wiring expectation, clipped to [0, 1] (the
+    scanpy ``tl.paga`` v1.2 formula — see _paga_stats).  Requires
+    neighbors.knn + a clustering in ``obs[groups]``; uses
+    obsp["connectivities"] weights when they match the current graph.
+    Adds uns["paga_connectivities"] (G × G),
+    uns["paga_edge_weights"], uns["paga_groups"].
+
+    The group graph is a few thousand entries at most — this is host
+    bookkeeping over the device-built kNN graph, identical on both
+    backends by construction."""
+    return _paga_impl(data, groups)
+
+
+@register("graph.paga", backend="cpu")
+def paga_cpu(data: CellData, groups: str = "leiden") -> CellData:
+    return _paga_impl(data, groups)

@@ -45,8 +45,17 @@ def knn_arrays(
     cand_block: int | None = None,
     exclude_self: bool = False,
     refine: int = 0,
+    n_valid_cand=None,
 ):
     """Exact kNN of ``query`` rows against ``cand`` rows.
+
+    ``n_valid_cand`` (optional, TRACED): only the first so-many
+    candidate rows are real; the rest of ``cand``/``n_cand`` is shape
+    padding.  Because it is dynamic, many calls with different valid
+    counts but one bucketed ``n_cand`` share a single compiled program
+    — what ``neighbors.bbknn`` relies on with dozens of distinct batch
+    sizes (static ``n_cand`` alone would retrace per size).  XLA-path
+    only; the pallas path ignores it (callers bucket only on xla).
 
     Returns (indices (n_query_padded, k) int32, distances (…, k)).
     Distances: cosine -> 1 - cos_sim, euclidean -> L2 distance; sorted
@@ -87,8 +96,9 @@ def knn_arrays(
             cand_block=cand_block, exclude_self=exclude_self,
         )
     else:
+        nv = jnp.int32(n_cand if n_valid_cand is None else n_valid_cand)
         idx, dist = _knn_jit(
-            query, cand, k=k_search, metric=metric,
+            query, cand, nv, k=k_search, metric=metric,
             n_query=n_query, n_cand=n_cand,
             qb=query_block or config.row_block,
             cb=cand_block or config.col_block,
@@ -112,7 +122,7 @@ def knn_arrays(
     static_argnames=("k", "metric", "qb", "cb", "n_query", "n_cand",
                      "mm_dtype", "exclude_self", "coarse"),
 )
-def _knn_jit(query, cand, *, k, metric, n_query, n_cand, qb, cb,
+def _knn_jit(query, cand, n_valid, *, k, metric, n_query, n_cand, qb, cb,
              mm_dtype, exclude_self, coarse="topk"):
     mm_dtype = jnp.dtype(mm_dtype)
     # float32 inputs need HIGHEST or the MXU silently drops to bf16.
@@ -151,7 +161,7 @@ def _knn_jit(query, cand, *, k, metric, n_query, n_cand, qb, cb,
             if metric == "euclidean":
                 s = -(qn2[:, None] - 2.0 * s + cn2[None, :])
             gcol = off + col_iota  # (cb,)
-            invalid = gcol >= n_cand
+            invalid = gcol >= n_valid  # traced: bucketed shapes share
             s = jnp.where(invalid[None, :], -jnp.inf, s)
             if exclude_self:
                 s = jnp.where(gcol[None, :] == q_ids[:, None], -jnp.inf, s)
@@ -443,10 +453,23 @@ def bbknn_tpu(data: CellData, batch_key: str = "batch",
     rep = rep[:n]
     batch = np.asarray(data.obs[batch_key])[:n]
 
+    use_bucket = config.resolved_knn_impl() == "xla"
+
     def search(sel, k):
         cand = jnp.take(rep, jnp.asarray(sel), axis=0)
+        if not use_bucket:  # pallas path: exact shapes
+            return knn_arrays(rep, cand, k=k, metric=metric,
+                              n_query=n, n_cand=len(sel), refine=refine)
+        # bucket the candidate count so dozens of batch sizes share a
+        # handful of compiled programs (n_valid_cand masks the pad)
+        bucket = round_up(max(len(sel), 1), 1024)
+        if bucket > len(sel):
+            cand = jnp.concatenate(
+                [cand, jnp.zeros((bucket - len(sel), cand.shape[1]),
+                                 cand.dtype)])
         return knn_arrays(rep, cand, k=k, metric=metric,
-                          n_query=n, n_cand=len(sel), refine=refine)
+                          n_query=n, n_cand=bucket,
+                          n_valid_cand=len(sel), refine=refine)
 
     (gi, gd), levels = _bbknn_driver(batch, n, k_within, search)
     return data.with_obsp(knn_indices=gi, knn_distances=gd).with_uns(

@@ -195,3 +195,41 @@ def test_phenograph_beats_unweighted_on_counts(with_knn):
     ari_b = adjusted_rand_index(np.asarray(base.obs["leiden_like"]), true)
     assert ari_p >= ari_b, (ari_p, ari_b)
     assert ari_p > 0.4, f"phenograph ARI on counts fixture: {ari_p:.3f}"
+
+
+def test_paga_separates_connected_groups():
+    """Blobs arranged so 0-1 are adjacent and 2 is far: PAGA must give
+    the 0-1 link far higher scaled connectivity than 0-2/1-2."""
+    from sctools_tpu.data.dataset import CellData
+    from sctools_tpu.ops.knn import knn_numpy
+
+    rng = np.random.default_rng(5)
+    n_per = 150
+    centers = np.array([[0.0, 0.0], [2.2, 0.0], [30.0, 30.0]])
+    pts = np.concatenate([
+        c + rng.normal(scale=0.6, size=(n_per, 2)) for c in centers
+    ]).astype(np.float32)
+    truth = np.repeat(np.arange(3), n_per)
+    idx, dist = knn_numpy(pts, pts, k=10, metric="euclidean",
+                          exclude_self=True)
+    d = CellData(np.zeros((450, 2), np.float32),
+                 obs={"grp": truth.astype(np.int32)}).with_obsp(
+        knn_indices=idx, knn_distances=dist).with_uns(
+        knn_k=10, knn_metric="euclidean")
+    out = sct.apply("graph.paga", d, backend="tpu", groups="grp")
+    theta = np.asarray(out.uns["paga_connectivities"])
+    assert theta.shape == (3, 3)
+    assert theta[0, 1] > 10 * max(theta[0, 2], theta[1, 2]), theta
+    np.testing.assert_allclose(theta, theta.T)
+    # parity: both backends share the implementation by construction
+    out_c = sct.apply("graph.paga", d, backend="cpu", groups="grp")
+    np.testing.assert_array_equal(
+        theta, np.asarray(out_c.uns["paga_connectivities"]))
+
+
+def test_paga_requires_clustering():
+    from sctools_tpu.data.dataset import CellData
+
+    d = CellData(np.zeros((10, 4), np.float32))
+    with pytest.raises(KeyError, match="leiden"):
+        sct.apply("graph.paga", d, backend="cpu")
