@@ -11,6 +11,9 @@ find what they expect:
     varm  — per-gene matrices (e.g. "PCs": (n_genes, 50))
     obsp  — pairwise/graph data (e.g. "knn_indices", "knn_distances",
             "connectivities")
+    layers — alternative X-shaped matrices (e.g. "counts" preserved
+            before normalisation, "spliced"/"unspliced") — SparseCells
+            / scipy CSR / dense, like X
     uns   — unstructured results (scalars/small arrays)
 
 Unlike AnnData it is **functional**: transforms return a new CellData
@@ -44,10 +47,12 @@ class CellData:
     varm: dict = dataclasses.field(default_factory=dict)
     obsp: dict = dataclasses.field(default_factory=dict)
     uns: dict = dataclasses.field(default_factory=dict)
+    layers: dict = dataclasses.field(default_factory=dict)
 
     # ------------------------------------------------------------------
     def tree_flatten(self):
-        dicts = (self.obs, self.var, self.obsm, self.varm, self.obsp, self.uns)
+        dicts = (self.obs, self.var, self.obsm, self.varm, self.obsp,
+                 self.uns, self.layers)
         keys = tuple(tuple(sorted(d)) for d in dicts)
         children = [self.X] + [
             d[k] for d, ks in zip(dicts, keys) for k in ks
@@ -106,18 +111,22 @@ class CellData:
     def with_uns(self, **entries) -> "CellData":
         return self.replace(uns={**self.uns, **entries})
 
+    def with_layers(self, **entries) -> "CellData":
+        return self.replace(layers={**self.layers, **entries})
+
     # ------------------------------------------------------------------
     def device_put(self, sharding=None) -> "CellData":
         """Move to device: scipy CSR X is packed to SparseCells first."""
         import scipy.sparse as sp
 
-        X = self.X
-        if sp.issparse(X):
-            X = SparseCells.from_scipy_csr(X)
-        if isinstance(X, SparseCells):
-            X = X.device_put(sharding)
-        else:
-            X = jax.device_put(np.asarray(X), sharding)
+        def put_matrix(v):  # X and layers share one packing path
+            if sp.issparse(v):
+                v = SparseCells.from_scipy_csr(v)
+            if isinstance(v, SparseCells):
+                return v.device_put(sharding)
+            return jax.device_put(np.asarray(v), sharding)
+
+        X = put_matrix(self.X)
 
         def put(d):
             out = {}
@@ -132,6 +141,7 @@ class CellData:
         return CellData(
             X, put(self.obs), put(self.var), put(self.obsm),
             put(self.varm), put(self.obsp), dict(self.uns),
+            {k: put_matrix(v) for k, v in self.layers.items()},
         )
 
     def to_host(self) -> "CellData":
@@ -160,6 +170,7 @@ class CellData:
             {k: fetch(v) for k, v in self.varm.items()},
             {k: fetch(v, trim=True) for k, v in self.obsp.items()},
             {k: fetch(v) for k, v in self.uns.items()},
+            {k: fetch(v, trim=True) for k, v in self.layers.items()},
         )
 
     def __repr__(self):
@@ -171,7 +182,8 @@ class CellData:
             f"  X={type(self.X).__name__},\n"
             f"  obs: {ks(self.obs)}\n  var: {ks(self.var)}\n"
             f"  obsm: {ks(self.obsm)}\n  varm: {ks(self.varm)}\n"
-            f"  obsp: {ks(self.obsp)}\n  uns: {ks(self.uns)})"
+            f"  obsp: {ks(self.obsp)}\n  layers: {ks(self.layers)}\n"
+            f"  uns: {ks(self.uns)})"
         )
 
 

@@ -88,7 +88,8 @@ def _decode(arr):
     return arr
 
 
-def read_h5ad(path: str, load_obsm: bool = True) -> CellData:
+def read_h5ad(path: str, load_obsm: bool = True,
+              load_layers: bool = True) -> CellData:
     import h5py
 
     with h5py.File(path, "r") as h5:
@@ -99,12 +100,18 @@ def read_h5ad(path: str, load_obsm: bool = True) -> CellData:
         if load_obsm and "obsm" in h5:
             for key in h5["obsm"]:
                 obsm[key] = h5["obsm"][key][...]
+        layers = {}
+        # opt-out: velocity-style files carry X-sized spliced/unspliced
+        # layers — pipelines that never touch them shouldn't pay 3x IO
+        if load_layers and "layers" in h5:
+            for key in h5["layers"]:
+                layers[key] = _read_h5_matrix(h5["layers"], key)
     if "gene_name" not in var:
         for cand in ("_index", "index", "gene_symbols", "gene_ids"):
             if cand in var:
                 var["gene_name"] = var.pop(cand)
                 break
-    return CellData(X, obs=obs, var=var, obsm=obsm)
+    return CellData(X, obs=obs, var=var, obsm=obsm, layers=layers)
 
 
 def write_h5ad(data: CellData, path: str) -> None:
@@ -113,19 +120,26 @@ def write_h5ad(data: CellData, path: str) -> None:
     import scipy.sparse as sp
 
     host = data.to_host() if _on_device(data) else data
-    X = host.X
-    with h5py.File(path, "w") as h5:
-        if sp.issparse(X):
-            X = X.tocsr()
-            g = h5.create_group("X")
+
+    def write_matrix(parent, name, M):
+        if sp.issparse(M):
+            M = M.tocsr()
+            g = parent.create_group(name)
             g.attrs["encoding-type"] = "csr_matrix"
             g.attrs["encoding-version"] = "0.1.0"
-            g.attrs["shape"] = np.array(X.shape, dtype=np.int64)
-            g.create_dataset("data", data=X.data)
-            g.create_dataset("indices", data=X.indices)
-            g.create_dataset("indptr", data=X.indptr)
+            g.attrs["shape"] = np.array(M.shape, dtype=np.int64)
+            g.create_dataset("data", data=M.data)
+            g.create_dataset("indices", data=M.indices)
+            g.create_dataset("indptr", data=M.indptr)
         else:
-            h5.create_dataset("X", data=np.asarray(X))
+            parent.create_dataset(name, data=np.asarray(M))
+
+    with h5py.File(path, "w") as h5:
+        write_matrix(h5, "X", host.X)
+        if host.layers:
+            lg = h5.create_group("layers")
+            for k, v in host.layers.items():
+                write_matrix(lg, k, v)
         for name, d in (("obs", host.obs), ("var", host.var),
                         ("obsm", host.obsm), ("varm", host.varm),
                         ("obsp", host.obsp), ("uns", host.uns)):
@@ -146,7 +160,9 @@ def h5py_str():
 def _on_device(data: CellData) -> bool:
     import jax
 
-    return isinstance(data.X, (SparseCells, jax.Array))
+    return isinstance(data.X, (SparseCells, jax.Array)) or any(
+        isinstance(v, (SparseCells, jax.Array))
+        for v in data.layers.values())
 
 
 # ----------------------------------------------------------------------

@@ -72,27 +72,39 @@ def _compact_capacity(x: SparseCells, capacity: int) -> SparseCells:
     return SparseCells(ind, dat, x.n_cells, x.n_genes)
 
 
-def select_genes_device(data: CellData, gene_idx: np.ndarray,
-                        compact: bool = False) -> CellData:
-    """Subset a CellData to ``gene_idx`` (device path)."""
-    X = data.X
-    gene_idx = np.asarray(gene_idx)
-    if isinstance(X, SparseCells):
+def _subset_genes_matrix(M, gene_idx: np.ndarray, compact: bool):
+    """Gene-subset an X-shaped matrix (SparseCells / scipy / dense) —
+    shared by X and every layer so they cannot drift."""
+    import scipy.sparse as sp
+
+    if sp.issparse(M):
+        return M.tocsc()[:, gene_idx].tocsr()
+    if isinstance(M, SparseCells):
         cap = None
         if compact:
             # safe upper bound on new nnz/row: min(old capacity, g_new)
-            cap = min(X.capacity, round_up(max(len(gene_idx), 1),
+            cap = min(M.capacity, round_up(max(len(gene_idx), 1),
                                            config.capacity_multiple))
-        newX = subset_genes_sparse(X, gene_idx, capacity=cap)
-    else:
-        newX = jnp.take(jnp.asarray(X), jnp.asarray(gene_idx), axis=1)
+        return subset_genes_sparse(M, gene_idx, capacity=cap)
+    return jnp.take(jnp.asarray(M), jnp.asarray(gene_idx), axis=1)
+
+
+def select_genes_device(data: CellData, gene_idx: np.ndarray,
+                        compact: bool = False) -> CellData:
+    """Subset a CellData to ``gene_idx`` (device path).  X, var, varm,
+    and every layer are sliced consistently."""
+    gene_idx = np.asarray(gene_idx)
+    newX = _subset_genes_matrix(data.X, gene_idx, compact)
+
     def take(v):
         if isinstance(v, jax.Array) or np.asarray(v).dtype.kind in "biufc":
             return jnp.take(jnp.asarray(v), jnp.asarray(gene_idx), axis=0)
         return np.asarray(v)[gene_idx]  # strings/objects stay host-side
     var = {k: take(v) for k, v in data.var.items()}
     varm = {k: take(v) for k, v in data.varm.items()}
-    return data.replace(X=newX, var=var, varm=varm)
+    layers = {k: _subset_genes_matrix(v, gene_idx, compact)
+              for k, v in data.layers.items()}
+    return data.replace(X=newX, var=var, varm=varm, layers=layers)
 
 
 # ----------------------------------------------------------------------
@@ -287,7 +299,10 @@ def hvg_select_cpu(data: CellData, n_top: int = 2000,
         Xs = X[:, idx] if not sp.issparse(X) else X.tocsc()[:, idx].tocsr()
         var_d = {k: np.asarray(v)[idx] for k, v in out.var.items()}
         varm = {k: np.asarray(v)[idx] for k, v in out.varm.items()}
-        out = out.replace(X=Xs, var=var_d, varm=varm)
+        layers = {k: (v.tocsc()[:, idx].tocsr() if sp.issparse(v)
+                      else np.asarray(v)[:, idx])
+                  for k, v in out.layers.items()}
+        out = out.replace(X=Xs, var=var_d, varm=varm, layers=layers)
     return out
 
 

@@ -169,30 +169,39 @@ def filter_cells_tpu(
     return select_cells_device(data, idx)
 
 
-def select_cells_device(data: CellData, idx: np.ndarray) -> CellData:
-    """Subset a CellData to the cells in ``idx`` (device row gather;
-    shared by qc.filter_cells and qc.subsample).  Drops obsp — pairwise
-    graphs refer to dropped rows and must be rebuilt."""
-    X = data.X
-    idx = np.asarray(idx)
+def _gather_rows_matrix(M, idx: np.ndarray):
+    """Row-subset an X-shaped matrix (SparseCells / scipy / dense),
+    device path — shared by X and every layer so they cannot drift."""
+    import scipy.sparse as sp
+
     n_new = len(idx)
-    if isinstance(X, SparseCells):
+    if sp.issparse(M):
+        return M.tocsr()[idx]
+    if isinstance(M, SparseCells):
         rows_padded = round_up(max(n_new, 1), config.sublane)
         gidx = jnp.asarray(
             np.pad(idx, (0, rows_padded - n_new),
-                   constant_values=X.rows_padded - 1)
+                   constant_values=M.rows_padded - 1)
         )
-        ind = jnp.take(X.indices, gidx, axis=0)
-        dat = jnp.take(X.data, gidx, axis=0)
+        ind = jnp.take(M.indices, gidx, axis=0)
+        dat = jnp.take(M.data, gidx, axis=0)
         if rows_padded > n_new:  # ensure padding rows are empty
             pad_row = jnp.arange(rows_padded) >= n_new
-            ind = jnp.where(pad_row[:, None], X.sentinel, ind)
+            ind = jnp.where(pad_row[:, None], M.sentinel, ind)
             dat = jnp.where(pad_row[:, None], 0.0, dat)
-        newX = SparseCells(ind, dat, n_new, X.n_genes)
-        num_idx = gidx
-    else:
-        newX = jnp.take(jnp.asarray(X), jnp.asarray(idx), axis=0)
-        num_idx = jnp.asarray(idx)
+        return SparseCells(ind, dat, n_new, M.n_genes)
+    return jnp.take(jnp.asarray(M), jnp.asarray(idx), axis=0)
+
+
+def select_cells_device(data: CellData, idx: np.ndarray) -> CellData:
+    """Subset a CellData to the cells in ``idx`` (device row gather;
+    shared by qc.filter_cells and qc.subsample).  X, obs, obsm, and
+    every layer are sliced consistently; drops obsp — pairwise graphs
+    refer to dropped rows and must be rebuilt."""
+    X = data.X
+    idx = np.asarray(idx)
+    newX = _gather_rows_matrix(X, idx)
+    num_idx = jnp.asarray(idx)
 
     def take(v):
         if isinstance(v, jax.Array) or np.asarray(v).dtype.kind in "biufc":
@@ -200,7 +209,10 @@ def select_cells_device(data: CellData, idx: np.ndarray) -> CellData:
         return np.asarray(v)[idx]  # strings/objects stay host-side
     obs = {k: take(v) for k, v in data.obs.items()}
     obsm = {k: take(v) for k, v in data.obsm.items()}
-    return data.replace(X=newX, obs=obs, obsm=obsm, obsp={})
+    layers = {k: _gather_rows_matrix(v, idx)
+              for k, v in data.layers.items()}
+    return data.replace(X=newX, obs=obs, obsm=obsm, obsp={},
+                        layers=layers)
 
 
 def _subsample_idx(n_cells: int, fraction: float | None, n_obs: int | None,
@@ -241,7 +253,8 @@ def subsample_cpu(data: CellData, fraction: float | None = None,
     X = data.X[idx]
     obs = {k: np.asarray(v)[idx] for k, v in data.obs.items()}
     obsm = {k: np.asarray(v)[idx] for k, v in data.obsm.items()}
-    return data.replace(X=X, obs=obs, obsm=obsm, obsp={})
+    layers = {k: v[idx] for k, v in data.layers.items()}
+    return data.replace(X=X, obs=obs, obsm=obsm, obsp={}, layers=layers)
 
 
 @register("qc.filter_cells", backend="cpu")
@@ -255,7 +268,8 @@ def filter_cells_cpu(
     X = data.X[keep]
     obs = {k: np.asarray(v)[keep] for k, v in data.obs.items()}
     obsm = {k: np.asarray(v)[keep] for k, v in data.obsm.items()}
-    return data.replace(X=X, obs=obs, obsm=obsm, obsp={})
+    layers = {k: v[keep] for k, v in data.layers.items()}
+    return data.replace(X=X, obs=obs, obsm=obsm, obsp={}, layers=layers)
 
 
 @register("qc.filter_genes", backend="tpu")

@@ -134,6 +134,7 @@ def shard_celldata(data, mesh: Mesh):
     out = CellData(
         X, dict(data.obs), dict(data.var), dict(data.obsm),
         dict(data.varm), dict(data.obsp), dict(data.uns),
+        dict(data.layers),  # carried host-side; shard on use
     )
     return out
 

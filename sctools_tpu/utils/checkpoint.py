@@ -30,25 +30,37 @@ def save_celldata(data: CellData, path: str) -> None:
     import scipy.sparse as sp
 
     if isinstance(data.X, (SparseCells, jax.Array)) or any(
-        isinstance(v, jax.Array)
+        isinstance(v, (jax.Array, SparseCells))
         for d in (data.obs, data.var, data.obsm, data.varm, data.obsp,
-                  data.uns)
+                  data.uns, data.layers)
         for v in d.values()
     ):
         data = data.to_host()
     arrays: dict[str, np.ndarray] = {}
-    X = data.X
-    if sp.issparse(X):
-        X = X.tocsr()
-        arrays["X/format"] = np.array("csr")
-        arrays["X/data"] = X.data
-        arrays["X/indices"] = X.indices
-        arrays["X/indptr"] = X.indptr
-        arrays["X/shape"] = np.asarray(X.shape, np.int64)
-    else:
-        arrays["X/format"] = np.array("dense")
-        arrays["X/data"] = np.asarray(X)
+
+    def put_matrix(prefix, M):
+        if sp.issparse(M):
+            M = M.tocsr()
+            arrays[f"{prefix}/format"] = np.array("csr")
+            arrays[f"{prefix}/data"] = M.data
+            arrays[f"{prefix}/indices"] = M.indices
+            arrays[f"{prefix}/indptr"] = M.indptr
+            arrays[f"{prefix}/shape"] = np.asarray(M.shape, np.int64)
+        else:
+            arrays[f"{prefix}/format"] = np.array("dense")
+            arrays[f"{prefix}/data"] = np.asarray(M)
+
     skipped = []
+    put_matrix("X", data.X)
+    # layers are X-shaped (possibly sparse): same triple encoding,
+    # namespaced so load can rebuild them as matrices
+    for k, v in data.layers.items():
+        arr_like = v if sp.issparse(v) else np.asarray(v)
+        if getattr(arr_like, "dtype", None) is not None and \
+                arr_like.dtype == object:
+            skipped.append(f"layers/{k}")  # pickled npz breaks resume
+            continue
+        put_matrix(f"LAYER::{k}", v)
 
     def put(key, v):
         if isinstance(v, dict):
@@ -82,24 +94,33 @@ def load_celldata(path: str) -> CellData:
     import scipy.sparse as sp
 
     with np.load(path, allow_pickle=False) as z:
-        fmt = str(z["X/format"])
-        if fmt == "csr":
-            shape = tuple(z["X/shape"])
-            X = sp.csr_matrix(
-                (z["X/data"], z["X/indices"], z["X/indptr"]), shape=shape)
-        else:
-            X = z["X/data"]
+        def get_matrix(prefix):
+            fmt = str(z[f"{prefix}/format"])
+            if fmt == "csr":
+                shape = tuple(z[f"{prefix}/shape"])
+                return sp.csr_matrix(
+                    (z[f"{prefix}/data"], z[f"{prefix}/indices"],
+                     z[f"{prefix}/indptr"]), shape=shape)
+            return z[f"{prefix}/data"]
+
+        X = get_matrix("X")
+        layers = {}
+        for key in z.files:
+            if key.startswith("LAYER::") and key.endswith("/format"):
+                name = key[len("LAYER::"):-len("/format")]
+                layers[name] = get_matrix(f"LAYER::{name}")
         sections: dict[str, dict] = {s: {} for s in _SECTIONS}
         for key in z.files:
             section, _, name = key.partition("/")
-            if section not in sections or key.startswith("X/"):
+            if (section not in sections or key.startswith("X/")
+                    or key.startswith("LAYER::")):
                 continue
             target = sections[section]
             parts = name.split("//")
             for p in parts[:-1]:  # rebuild nested dicts
                 target = target.setdefault(p, {})
             target[parts[-1]] = z[key]
-    return CellData(X, **sections)
+    return CellData(X, layers=layers, **sections)
 
 
 class PipelineCheckpointer:

@@ -143,3 +143,33 @@ def test_subsample_fraction_floors_and_rejects_empty():
     for bad in (dict(n_obs=0), dict(n_obs=-5), dict(fraction=0.0001)):
         with pytest.raises(ValueError, match="out of range"):
             sct.apply("qc.subsample", d, backend="cpu", **bad)
+
+
+def test_subset_ops_slice_layers_consistently():
+    """filter_cells / subsample / hvg subset must slice layers with X
+    (pre-fix they silently kept stale full-size layers)."""
+    from sctools_tpu.data.synthetic import synthetic_counts
+
+    d = synthetic_counts(200, 80, density=0.15, seed=9)
+    counts = d.X.copy()
+    d = d.with_layers(counts=counts)
+
+    # cpu cell subset
+    sub = sct.apply("qc.subsample", d, backend="cpu", n_obs=50, seed=1)
+    assert sub.layers["counts"].shape == (50, 80)
+    np.testing.assert_allclose(sub.layers["counts"].toarray(),
+                               sub.X.toarray())
+    # device cell subset
+    dev = d.device_put()
+    sub_t = sct.apply("qc.subsample", dev, backend="tpu", n_obs=50, seed=1)
+    host = sub_t.to_host()
+    np.testing.assert_allclose(host.layers["counts"].toarray(),
+                               sub.layers["counts"].toarray(), rtol=1e-6)
+    # gene subset keeps layers column-aligned (tpu + cpu)
+    hv = sct.apply("hvg.select", dev, backend="tpu", n_top=30,
+                   flavor="dispersion", subset=True)
+    hh = hv.to_host()
+    assert hh.layers["counts"].shape[1] == 30
+    hvc = sct.apply("hvg.select", d, backend="cpu", n_top=30,
+                    flavor="dispersion", subset=True)
+    assert hvc.layers["counts"].shape == (200, 30)

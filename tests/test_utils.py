@@ -104,3 +104,51 @@ def test_checkpointer_partial_resume(tmp_path):
         np.asarray(out2.to_host().X.toarray()
                    if hasattr(out2.X, "to_scipy_csr") or hasattr(
                        out2.X, "data") else out2.X), rtol=1e-6)
+
+
+def test_layers_roundtrip_everywhere(tmp_path):
+    """layers (AnnData parity): device round-trip, h5ad round-trip,
+    and checkpoint round-trip, sparse and dense alike."""
+    import scipy.sparse as sp
+
+    from sctools_tpu.data.io import read_h5ad, write_h5ad
+    from sctools_tpu.data.synthetic import synthetic_counts
+    from sctools_tpu.utils.checkpoint import load_celldata, save_celldata
+
+    d = synthetic_counts(120, 60, density=0.2, seed=6)
+    counts = d.X.copy()
+    dense_layer = np.arange(120 * 60, dtype=np.float32).reshape(120, 60)
+    d = d.with_layers(counts=counts, dense=dense_layer)
+
+    # device -> host round-trip (sparse layer packs to SparseCells)
+    dev = d.device_put()
+    from sctools_tpu.data.sparse import SparseCells
+
+    assert isinstance(dev.layers["counts"], SparseCells)
+    host = dev.to_host()
+    np.testing.assert_allclose(host.layers["counts"].toarray(),
+                               counts.toarray(), rtol=1e-6)
+    np.testing.assert_allclose(host.layers["dense"], dense_layer)
+
+    # h5ad round-trip
+    p = str(tmp_path / "layers.h5ad")
+    write_h5ad(d, p)
+    back = read_h5ad(p)
+    assert sp.issparse(back.layers["counts"])
+    np.testing.assert_allclose(back.layers["counts"].toarray(),
+                               counts.toarray(), rtol=1e-6)
+    np.testing.assert_allclose(back.layers["dense"], dense_layer)
+
+    # checkpoint round-trip
+    cp = str(tmp_path / "ck.npz")
+    save_celldata(d, cp)
+    lk = load_celldata(cp)
+    assert sp.issparse(lk.layers["counts"])
+    np.testing.assert_allclose(lk.layers["counts"].toarray(),
+                               counts.toarray(), rtol=1e-6)
+    np.testing.assert_allclose(lk.layers["dense"], dense_layer)
+
+    # functional update + repr
+    d2 = d.with_layers(extra=dense_layer * 2)
+    assert set(d2.layers) == {"counts", "dense", "extra"}
+    assert "layers: counts, dense" in repr(d)
