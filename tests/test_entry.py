@@ -1,0 +1,20 @@
+"""The driver's compile-check surface (__graft_entry__) must always
+be jittable — round 4 nearly shipped a signature break here that no
+other test exercised."""
+
+import sys
+
+import numpy as np
+
+
+def test_entry_compiles_and_runs():
+    sys.path.insert(0, "/root/repo")
+    import jax
+
+    from __graft_entry__ import entry
+
+    fn, args = entry()
+    idx, dist, expl = jax.jit(fn)(*args)
+    assert idx.shape == (512, 15)
+    assert np.asarray(idx).min() >= 0
+    assert np.isfinite(np.asarray(expl)).all()
