@@ -302,3 +302,15 @@ def filter_genes_cpu(data: CellData, min_cells: int | None = 3,
     var = {k: np.asarray(v)[keep] for k, v in data.var.items()}
     varm = {k: np.asarray(v)[keep] for k, v in data.varm.items()}
     return data.replace(X=X, var=var, varm=varm)
+
+
+@register("util.snapshot_layer", backend="tpu")
+@register("util.snapshot_layer", backend="cpu")
+def snapshot_layer(data: CellData, layer: str = "counts") -> CellData:
+    """Copy the CURRENT X into ``layers[layer]`` — the Pipeline-friendly
+    form of the AnnData idiom ``adata.layers["counts"] = adata.X``
+    (placed before normalisation to preserve raw counts).  X is
+    functional/immutable here, so no copy is made — the layer shares
+    the buffers.  (The kwarg is ``layer``, not ``name`` — ``name`` is
+    the Transform's own first argument.)"""
+    return data.with_layers(**{layer: data.X})

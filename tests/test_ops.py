@@ -173,3 +173,18 @@ def test_subset_ops_slice_layers_consistently():
     hvc = sct.apply("hvg.select", d, backend="cpu", n_top=30,
                     flavor="dispersion", subset=True)
     assert hvc.layers["counts"].shape == (200, 30)
+
+
+def test_snapshot_layer_in_pipeline():
+    from sctools_tpu.data.synthetic import synthetic_counts
+
+    d = synthetic_counts(100, 50, density=0.2, seed=2)
+    raw = d.X.toarray()
+    out = sct.Pipeline([
+        ("util.snapshot_layer", {"layer": "counts"}),
+        ("normalize.library_size", {"target_sum": 100.0}),
+        ("normalize.log1p", {}),
+    ]).run(d.device_put(), backend="tpu").to_host()
+    np.testing.assert_allclose(out.layers["counts"].toarray(), raw,
+                               rtol=1e-6)
+    assert not np.allclose(out.X.toarray(), raw)  # X did change
