@@ -301,7 +301,8 @@ def filter_genes_cpu(data: CellData, min_cells: int | None = 3,
     X = data.X[:, keep]
     var = {k: np.asarray(v)[keep] for k, v in data.var.items()}
     varm = {k: np.asarray(v)[keep] for k, v in data.varm.items()}
-    return data.replace(X=X, var=var, varm=varm)
+    layers = {k: v[:, keep] for k, v in data.layers.items()}
+    return data.replace(X=X, var=var, varm=varm, layers=layers)
 
 
 @register("util.snapshot_layer", backend="tpu")

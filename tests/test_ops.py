@@ -188,3 +188,16 @@ def test_snapshot_layer_in_pipeline():
     np.testing.assert_allclose(out.layers["counts"].toarray(), raw,
                                rtol=1e-6)
     assert not np.allclose(out.X.toarray(), raw)  # X did change
+
+
+def test_filter_genes_slices_layers_both_backends():
+    from sctools_tpu.data.synthetic import synthetic_counts
+
+    d = synthetic_counts(120, 60, density=0.1, seed=5)
+    d = d.with_layers(counts=d.X.copy())
+    c = sct.apply("qc.filter_genes", d, backend="cpu", min_cells=3)
+    assert c.layers["counts"].shape == c.X.shape
+    t = sct.apply("qc.filter_genes", d.device_put(), backend="tpu",
+                  min_cells=3).to_host()
+    assert t.layers["counts"].shape[1] == t.X.shape[1]
+    assert c.X.shape[1] == t.X.shape[1]
