@@ -19,6 +19,32 @@ from contextlib import contextmanager
 import jax
 
 
+def _on_tunnel() -> bool:
+    """True when the default backend is the tunneled single-chip
+    "axon" platform.  Detection must not key on any single string:
+    round 4 measured ``jax.default_backend() == "tpu"`` on a live axon
+    session (device_kind "TPU v5 lite") even though the platform was
+    registered as ``axon`` — which silently disabled the stream_sync
+    drain and let the deep async pipeline crash the remote worker.  So
+    check the backend name, the device platforms, AND the configured
+    platform list."""
+    try:
+        backend = jax.default_backend()
+        if backend == "axon":
+            return True
+        if backend != "tpu":
+            # cpu/gpu fallback after a tunnel death is NOT the tunnel —
+            # don't pay per-shard drains there
+            return False
+        plats = str(getattr(jax.config, "jax_platforms", "") or "")
+        if "axon" in plats.split(","):
+            return True
+        return any(getattr(d, "platform", "") == "axon"
+                   for d in jax.devices())
+    except Exception:
+        return False
+
+
 @dataclasses.dataclass
 class Config:
     # Row/lane alignment.  TPU vector lanes are 128 wide; float32 tiles
@@ -97,7 +123,7 @@ class Config:
 
     def stream_sync_enabled(self) -> bool:
         if self.stream_sync == "auto":
-            return jax.default_backend() == "axon"
+            return _on_tunnel()
         return self.stream_sync in ("1", "true", "True", True)
 
     def interpret_mode(self) -> bool:

@@ -47,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import config, round_up
+from ..utils.sync import hard_sync
 from .sparse import SparseCells, segment_reduce, spmm, spmm_t
 
 
@@ -315,9 +316,11 @@ def stream_stats(src: ShardSource, target_sum: float = 1e4,
         # serialise host IO with device compute; one fetch after the
         # loop preserves the async-dispatch overlap.  Under
         # config.stream_sync (the axon tunnel) each shard is drained
-        # before the next dispatch instead — see config.py.
+        # before the next dispatch instead — see config.py.  The drain
+        # is hard_sync, not block_until_ready: the tunnel returns from
+        # block_until_ready before the program has run (utils/sync.py).
         if sync:
-            stats.block_until_ready()
+            hard_sync(stats)
         totals.append(t[:n])
         ngenes.append(g[:n])
         pct.append(m[:n])
@@ -513,7 +516,7 @@ def stream_pca(src: ShardSource, gene_idx: np.ndarray,
         for _, sh in src:
             b = _shard_matvec(sh, mapping, mu, V, target_sum, g_sub)
             if sync:
-                b.block_until_ready()
+                hard_sync(b)
             blocks.append(b)
         return _assemble_rows(blocks, src.n_cells)
 
@@ -531,7 +534,7 @@ def stream_pca(src: ShardSource, gene_idx: np.ndarray,
             acc = acc + _shard_rmatvec(sh, mapping, mu, q_blk,
                                        target_sum, g_sub)
             if sync:
-                acc.block_until_ready()
+                hard_sync(acc)
         return acc
 
     omega = jax.random.normal(key, (g_sub, L), jnp.float32)

@@ -248,10 +248,14 @@ class DeviceSyntheticSource:
         ``progress(i, seconds)`` is called per shard."""
         import time as _time
 
+        from ..utils.sync import hard_sync
+
         shards = []
         for i, shard in enumerate(self._generate()):
             t0 = _time.time()
-            shard.data.block_until_ready()
+            # hard_sync, not block_until_ready: the tunnel returns from
+            # block_until_ready before the program has run (utils/sync.py)
+            hard_sync(shard.data)
             if progress is not None:
                 progress(i, _time.time() - t0)
             shards.append(shard)

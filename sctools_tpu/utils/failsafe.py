@@ -48,7 +48,10 @@ def probe_device(timeout_s: float = 90.0, platform: str | None = None) -> dict:
         + (f"jax.config.update('jax_platforms', {platform!r})\n"
            if platform else "")
         + "x = jnp.ones((1024, 1024), jnp.bfloat16)\n"
-        "(x @ x).block_until_ready()\n"
+        # fetch, not block_until_ready: the tunneled backend returns
+        # from block_until_ready before execution (utils/sync.py) and
+        # the probe's whole job is proving the device EXECUTES
+        "assert float((x @ x)[0, 0]) == 1024.0\n"
         "print(json.dumps({'kind': jax.devices()[0].device_kind,"
         " 'wall_s': round(time.time()-t0, 2)}))\n"
     )

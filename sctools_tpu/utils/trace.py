@@ -44,13 +44,15 @@ _state = _State()
 
 
 def _sync_device():
-    """Barrier: enqueue a trivial computation and block on it — device
-    streams execute in order, so this drains everything queued."""
-    import jax
+    """Barrier: enqueue a trivial computation and FETCH its value —
+    device streams execute in order, so the fetch drains everything
+    queued.  Fetch, not block_until_ready: the tunneled backend returns
+    from block_until_ready before execution (see utils/sync.py)."""
     import jax.numpy as jnp
 
-    jnp.zeros(()).block_until_ready()
-    del jax
+    from .sync import hard_sync
+
+    hard_sync(jnp.zeros(()) + 0.0)
 
 
 @contextlib.contextmanager

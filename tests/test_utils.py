@@ -152,3 +152,33 @@ def test_layers_roundtrip_everywhere(tmp_path):
     d2 = d.with_layers(extra=dense_layer * 2)
     assert set(d2.layers) == {"counts", "dense", "extra"}
     assert "layers: counts, dense" in repr(d)
+
+
+def test_hard_sync_accepts_every_array_kind():
+    """hard_sync is the stream-drain primitive (utils/sync.py): it must
+    accept jax arrays, numpy arrays, scalars, SparseCells, and None
+    without error, and return the last fetched element."""
+    import jax.numpy as jnp
+
+    from sctools_tpu.data.sparse import SparseCells
+    from sctools_tpu.utils.sync import hard_sync
+
+    x = jnp.arange(6.0).reshape(2, 3) + 1.0
+    assert float(hard_sync(x)) == 1.0
+    assert float(hard_sync(np.ones((4,)) * 7)) == 7.0
+    assert hard_sync(None) is None
+    assert hard_sync(3.5) is None  # python scalar: nothing to fetch
+    sc = SparseCells(jnp.zeros((8, 4), jnp.int32),
+                     jnp.full((8, 4), 2.0), 8, 16)
+    assert float(hard_sync(sc)) == 2.0
+    # scalar jax array
+    assert float(hard_sync(jnp.float32(9.0))) == 9.0
+
+
+def test_stream_sync_auto_is_off_on_cpu():
+    """auto stream_sync must not pay per-shard drains on local
+    backends (tests force the cpu platform in conftest)."""
+    from sctools_tpu.config import config
+
+    assert config.stream_sync == "auto"
+    assert config.stream_sync_enabled() is False
