@@ -759,15 +759,26 @@ def phase_stream_io():
     # disk pass below measures IO/compute overlap, not XLA compile
     # (cold-cache wall_s swamped both and zeroed the overlap metric)
     shards = [s for s in src.factory()]
-    dev_shards = [s.device_put() for s in shards]
-    for s in dev_shards:
+    stage("stream_io.loaded", n_shards=len(shards))
+    dev_shards = []
+    for i, s in enumerate(shards):
+        s = s.device_put()
+        # drain EACH transfer before the next: queued host->device
+        # transfers of many shards are one of the tunnel's documented
+        # wedge triggers — and the stage line names the last shard
+        # that made it, so a stall identifies the one that didn't
         _hard_sync(s.data)
+        stage("stream_io.put", i=i)
+        dev_shards.append(s)
+    stage("stream_io.device", n_shards=len(dev_shards))
     mem_src = dataclasses.replace(
         src, factory=lambda: iter(dev_shards))
     stream_stats(mem_src)  # warm compiles
+    stage("stream_io.warm")
     t1 = time.time()
     stats2 = stream_stats(mem_src)
     compute_s = time.time() - t1
+    stage("stream_io.compute_baseline", wall_s=round(compute_s, 2))
     mean_baseline = np.asarray(stats2["gene_mean"])
     # free the baseline's host+device shard copies so the timed disk
     # pass runs under the same memory conditions the old ordering had
@@ -776,6 +787,7 @@ def phase_stream_io():
 
     gc.collect()
 
+    stage("stream_io.disk_pass_start")
     t1 = time.time()
     stats = stream_stats(timed_src)
     wall_disk = time.time() - t1

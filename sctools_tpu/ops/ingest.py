@@ -1,0 +1,153 @@
+"""``integrate.ingest`` — map query cells onto a reference atlas.
+
+Capability parity: scanpy's ``tl.ingest`` (the reference source at
+/root/reference was empty — SURVEY.md §0; the behavioral contract here
+is the public scanpy operation): fit nothing on the query, instead
+project it into the reference's fitted PCA space, find each query
+cell's k nearest reference cells there, then
+
+* transfer categorical ``obs`` columns by distance-weighted majority
+  vote,
+* transfer numeric ``obs`` columns and reference ``obsm`` embeddings
+  (e.g. ``X_umap``) by distance-weighted averaging.
+
+TPU design: the two heavy stages — the centered projection
+``(Xq − μ) @ PCs`` (one spmm on the MXU) and the blocked kNN search —
+run on device via the existing ``spmm``/``knn_arrays`` machinery; the
+O(n_query × k) vote/average bookkeeping is host numpy (it is three
+orders of magnitude smaller than the search and data-dependent on
+category alphabets, which jit cannot trace).
+
+The query must be preprocessed identically to the reference (same
+normalize/log1p chain, same gene space) — same contract as scanpy's
+ingest, which refuses mismatched ``var_names``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.dataset import CellData
+from ..data.sparse import SparseCells, spmm
+from ..registry import register
+
+
+def _weights(dist: np.ndarray, eps: float = 1e-12) -> np.ndarray:
+    """Inverse-distance weights, rows normalised to 1.  An exact hit
+    (dist 0) gets all the mass of its row via the eps floor."""
+    w = 1.0 / np.maximum(dist.astype(np.float64), eps)
+    return w / w.sum(axis=1, keepdims=True)
+
+
+def _transfer(ref_obs, ref_obsm, obs, embeddings, idx, dist, n_query):
+    """Host-side vote/average given fetched neighbor (idx, dist)."""
+    idx = np.asarray(idx)[:n_query]
+    dist = np.asarray(dist)[:n_query]
+    w = _weights(dist)
+    new_obs: dict = {}
+    for col in obs:
+        if col not in ref_obs:
+            raise KeyError(f"ingest: obs column {col!r} not in reference")
+        vals = np.asarray(ref_obs[col])
+        if vals.dtype.kind in "ifu":
+            new_obs[col] = (w * vals[idx].astype(np.float64)).sum(axis=1)
+        else:
+            levels, codes = np.unique(vals, return_inverse=True)
+            votes = np.zeros((len(idx), len(levels)), np.float64)
+            rows = np.repeat(np.arange(len(idx)), idx.shape[1])
+            np.add.at(votes, (rows, codes[idx].ravel()), w.ravel())
+            win = votes.argmax(axis=1)
+            new_obs[col] = levels[win]
+            new_obs[f"{col}_confidence"] = votes[
+                np.arange(len(idx)), win]
+    new_obsm: dict = {}
+    for emb in embeddings:
+        if emb not in ref_obsm or emb == "X_pca":
+            # scanpy parity: only transfer what the ref has; X_pca is
+            # always produced by the projection itself, never by
+            # neighbor interpolation
+            continue
+        E = np.asarray(ref_obsm[emb])[:, :]
+        new_obsm[emb] = np.einsum("qk,qkd->qd", w, E[idx])
+    return new_obs, new_obsm
+
+
+def _check(query: CellData, ref: CellData):
+    if query.n_genes != ref.n_genes:
+        raise ValueError(
+            f"ingest: query has {query.n_genes} genes but reference has "
+            f"{ref.n_genes} — align var spaces first (same contract as "
+            "scanpy tl.ingest)")
+    qn, rn = query.var.get("gene_name"), ref.var.get("gene_name")
+    if qn is not None and rn is not None:
+        qn, rn = np.asarray(qn), np.asarray(rn)
+        if qn.shape == rn.shape and not (qn == rn).all():
+            bad = int(np.argmin(qn == rn))
+            raise ValueError(
+                "ingest: query/reference gene names differ (first "
+                f"mismatch at {bad}: {qn[bad]!r} vs {rn[bad]!r}) — a "
+                "same-width projection onto mismatched loadings would "
+                "transfer confidently-wrong labels")
+    if "PCs" not in ref.varm or "X_pca" not in ref.obsm:
+        raise ValueError(
+            "ingest: reference needs varm['PCs'] + obsm['X_pca'] — run "
+            "pca.randomized on it first")
+
+
+@register("integrate.ingest", backend="tpu")
+def ingest_tpu(query: CellData, *, ref: CellData,
+               obs: tuple | list = (), embeddings=("X_umap",),
+               k: int = 15, metric: str = "cosine",
+               refine: int = 64) -> CellData:
+    """Returns ``query`` with transferred obs columns (categoricals add
+    a ``<col>_confidence`` sibling), obsm["X_pca"] in the reference's
+    space, and any requested reference embeddings interpolated."""
+    from .knn import knn_arrays
+
+    _check(query, ref)
+    PCs = jnp.asarray(ref.varm["PCs"], jnp.float32)
+    mu = jnp.asarray(ref.uns.get("pca_mean", np.zeros(ref.n_genes)),
+                     jnp.float32)
+    Xq = query.X
+    if isinstance(Xq, SparseCells):
+        scores = spmm(Xq, PCs) - (mu @ PCs)[None, :]
+        scores = jnp.where(Xq.row_mask()[:, None], scores, 0.0)
+    else:
+        scores = (jnp.asarray(Xq, jnp.float32) - mu[None, :]) @ PCs
+    ref_scores = jnp.asarray(ref.obsm["X_pca"], jnp.float32)
+    n_q = query.n_cells
+    idx, dist = knn_arrays(scores, ref_scores, k=k, metric=metric,
+                           n_query=n_q, n_cand=ref.n_cells, refine=refine)
+    new_obs, new_obsm = _transfer(ref.obs, ref.obsm, obs, embeddings,
+                                  idx, dist, n_q)
+    out = query.with_obsm(X_pca=scores[:n_q], **new_obsm)
+    return out.with_obs(**new_obs)
+
+
+@register("integrate.ingest", backend="cpu")
+def ingest_cpu(query: CellData, *, ref: CellData,
+               obs: tuple | list = (), embeddings=("X_umap",),
+               k: int = 15, metric: str = "cosine",
+               refine: int = 64) -> CellData:
+    import scipy.sparse as sp
+
+    from .knn import knn_numpy
+
+    _check(query, ref)
+    PCs = np.asarray(ref.varm["PCs"], np.float64)
+    mu = np.asarray(ref.uns.get("pca_mean", np.zeros(ref.n_genes)),
+                    np.float64)
+    Xq = query.X
+    if sp.issparse(Xq):
+        scores = Xq @ PCs - (mu @ PCs)[None, :]
+    else:
+        scores = (np.asarray(Xq, np.float64) - mu) @ PCs
+    idx, dist = knn_numpy(scores, np.asarray(ref.obsm["X_pca"],
+                                             np.float64),
+                          k=k, metric=metric)
+    new_obs, new_obsm = _transfer(ref.obs, ref.obsm, obs, embeddings,
+                                  idx, dist, query.n_cells)
+    out = query.with_obsm(X_pca=np.asarray(scores), **new_obsm)
+    return out.with_obs(**new_obs)

@@ -1,0 +1,120 @@
+"""Staged TPU-tunnel probe: bisect where atlas-scale work kills the
+remote worker.
+
+Round-4 context: the driver bench's config2 (streamed HVG) crashed the
+tunneled TPU worker even at one 131k x 28k x 512 shard, while datagen,
+normalize, QC and the kNN microbench all ran.  Root-cause candidates
+were (a) the scatter-based ``segment_reduce`` faulting on TPU, vs
+(b) the async dispatch queue: ``block_until_ready`` returns before
+execution on this tunnel, so neither datagen's "blocking"
+materialisation nor the stream_sync drain actually serialized anything
+(see utils/sync.py).  This probe runs each suspect program alone, with
+a hard host-fetch barrier between steps and a flushed progress line
+before and after every device call — whichever step the process dies
+in is the answer.
+
+Usage:  python tools/tpu_probe.py [--upto N] [--cells 131072]
+Each step builds on the previous one's device state; after a worker
+crash rerun in a fresh process (the backend does not heal in-process).
+"""
+
+import argparse
+import sys
+import time
+
+T0 = time.time()
+
+
+def log(*a):
+    print(f"[{time.time() - T0:7.1f}s]", *a, flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--upto", type=int, default=99)
+    ap.add_argument("--cells", type=int, default=131072)
+    args = ap.parse_args()
+
+    log("step0: import jax + first trivial program")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    sys.path.insert(0, "/root/repo")
+    from sctools_tpu.utils.sync import hard_sync
+
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    assert float((x @ x)[0, 0]) == 256.0
+    log("step0 OK:", jax.devices()[0].device_kind,
+        "backend=", jax.default_backend())
+    if args.upto < 1:
+        return
+
+    log("step1: datagen one shard", args.cells, "x 28672 x 512")
+    from sctools_tpu.data.synthetic import DeviceSyntheticSource
+
+    src = DeviceSyntheticSource(args.cells, 28672, capacity=512,
+                                shard_rows=131072, seed=0,
+                                materialize=False)
+    t = time.time()
+    src.materialize(progress=lambda i, s: log("  shard", i, round(s, 1), "s"))
+    log("step1 OK: materialized in", round(time.time() - t, 1), "s")
+    if args.upto < 2:
+        return
+
+    log("step2: _shard_stats (the segment_reduce scatter pass) on shard 0")
+    from sctools_tpu.data.stream import _shard_stats
+
+    shard = src._shards[0]
+    mito = jnp.zeros(src.n_genes, bool)
+    t = time.time()
+    totals, ng, pct, stats = _shard_stats(shard, mito, 1e4)
+    hard_sync(stats)
+    log("step2 OK: first call", round(time.time() - t, 1), "s")
+    t = time.time()
+    totals, ng, pct, stats = _shard_stats(shard, mito, 1e4)
+    hard_sync(stats)
+    log("step2 OK: steady", round(time.time() - t, 2), "s; gene0 sum",
+        float(np.asarray(stats[0, 0])))
+    if args.upto < 3:
+        return
+
+    log("step3: full stream_stats + seurat_v3 stream_hvg (config2 path)")
+    from sctools_tpu.data.stream import stream_hvg, stream_stats
+
+    t = time.time()
+    st = stream_stats(src)
+    hvg = stream_hvg(st, n_top=2000, flavor="seurat_v3", src=src)
+    log("step3 OK:", round(time.time() - t, 1), "s; hvg[0:3]",
+        hvg[:3].tolist())
+    if args.upto < 4:
+        return
+
+    log("step4: stream_pca 50 comps")
+    from sctools_tpu.data.stream import stream_pca
+
+    t = time.time()
+    scores, comps, expl = stream_pca(src, hvg, st["gene_mean"],
+                                     jax.random.PRNGKey(0),
+                                     n_components=50, n_iter=2)
+    hard_sync(scores)
+    log("step4 OK:", round(time.time() - t, 1), "s; expl[0]",
+        float(np.asarray(expl)[0]))
+    if args.upto < 5:
+        return
+
+    log("step5: one 131k-query kNN chunk over", args.cells, "candidates")
+    from sctools_tpu.config import configure
+    from sctools_tpu.ops.knn import knn_arrays
+
+    with configure(matmul_dtype="bfloat16"):
+        t = time.time()
+        idx, _ = knn_arrays(scores[:131072], scores, k=15, metric="cosine",
+                            n_query=131072, n_cand=args.cells, refine=64)
+        hard_sync(idx)
+        log("step5 OK:", round(time.time() - t, 1), "s")
+    log("ALL STEPS PASSED")
+
+
+if __name__ == "__main__":
+    main()
