@@ -28,6 +28,7 @@ Quick start::
 from . import data, ops, parallel  # noqa: F401  (imports register transforms)
 from .config import config, configure
 from .data import CellData, SparseCells
+from .data.concat import concat
 from .data.io import from_dense, from_scipy, read_10x_mtx, read_h5ad, write_h5ad
 from .registry import Pipeline, Transform, apply, backends, get, names, register
 

@@ -3,5 +3,5 @@ transform with the registry (both cpu and tpu backends)."""
 
 from . import (  # noqa: F401
     cluster, de, distance, doublet, graph, hvg, ingest, integrate, knn,
-    metacells, normalize, palantir, pca, qc, score, tsne, umap,
+    metacells, mnn, normalize, palantir, pca, qc, score, tsne, umap,
 )

@@ -804,3 +804,62 @@ def adjusted_rand_index(a, b) -> float:
     if max_idx == expected:
         return 1.0
     return float((s_ij - expected) / (max_idx - expected))
+
+
+# ----------------------------------------------------------------------
+# cluster.dendrogram — hierarchy of group centroids (scanpy
+# tl.dendrogram): ward linkage over per-group mean embeddings
+# ----------------------------------------------------------------------
+
+
+def _dendrogram(data: CellData, groupby: str, use_rep: str,
+                method: str, rep):
+    from scipy.cluster import hierarchy
+    from scipy.spatial.distance import pdist
+
+    labels = np.asarray(data.obs[groupby])[: data.n_cells]
+    levels, codes = np.unique(labels, return_inverse=True)
+    rep = np.asarray(rep, np.float64)[: data.n_cells]
+    means = np.stack([rep[codes == g].mean(axis=0)
+                      for g in range(len(levels))])
+    if len(levels) < 2:
+        raise ValueError(
+            f"cluster.dendrogram: obs[{groupby!r}] has "
+            f"{len(levels)} level(s); need at least 2")
+    corr = np.corrcoef(means)
+    Z = hierarchy.linkage(pdist(means), method=method)
+    order = hierarchy.leaves_list(Z)
+    return data.with_uns(**{f"dendrogram_{groupby}": {
+        "linkage": Z,
+        "groupby": groupby,
+        "use_rep": use_rep,
+        "categories_ordered": [str(levels[i]) for i in order],
+        "categories_idx_ordered": order.astype(np.int64),
+        "correlation_matrix": corr,
+    }})
+
+
+@register("cluster.dendrogram", backend="tpu")
+def dendrogram_tpu(data: CellData, groupby: str = "leiden",
+                   use_rep: str = "X_pca",
+                   method: str = "ward") -> CellData:
+    """Hierarchical clustering of GROUP CENTROIDS (scanpy
+    ``tl.dendrogram``): per-group means of ``obsm[use_rep]``, scipy
+    ward linkage, leaf order.  Adds ``uns['dendrogram_<groupby>']``.
+    The heavy per-cell embedding already lives on device; the
+    (n_groups x d) linkage is microscopic host work on both backends.
+    """
+    from .knn import _get_rep
+
+    return _dendrogram(data, groupby, use_rep, method,
+                       np.asarray(_get_rep(data, use_rep)))
+
+
+@register("cluster.dendrogram", backend="cpu")
+def dendrogram_cpu(data: CellData, groupby: str = "leiden",
+                   use_rep: str = "X_pca",
+                   method: str = "ward") -> CellData:
+    from .knn import _get_rep_cpu
+
+    return _dendrogram(data, groupby, use_rep, method,
+                       _get_rep_cpu(data, use_rep))

@@ -371,3 +371,109 @@ def rank_genes_groups_cpu(data: CellData, groupby: str = "label",
 
     return _rank_genes_groups(data, groupby, method, n_top, tie_correct,
                               dense_ranks_via, group_moments)
+
+
+# ----------------------------------------------------------------------
+# de.filter_rank_genes_groups — expression-fraction / fold-change
+# filter over an existing ranking (scanpy pp namesake)
+# ----------------------------------------------------------------------
+
+
+def _expression_fractions(data: CellData, codes, n_groups, device: bool):
+    """(n_groups, n_genes) fraction of cells expressing each gene,
+    in-group and out-group."""
+    n = data.n_cells
+    n_per = np.bincount(codes, minlength=n_groups).astype(np.float64)
+    if device and isinstance(data.X, SparseCells):
+        # binarise the data plane and reuse the grouped-sum machinery
+        # (same padded-codes convention as rank_genes_groups_tpu)
+        x = data.X
+        c = np.full(x.rows_padded, -1, np.int32)
+        c[:n] = codes[:n]
+        s, _, _ = _group_moments_sparse(
+            x.with_data((x.data > 0).astype(x.data.dtype)),
+            jnp.asarray(c), n_groups, need_ss=False)
+        nnz_gj = np.asarray(s)
+    elif device:
+        Xd = jnp.asarray(data.X)[:n]
+        oh = jax.nn.one_hot(jnp.asarray(codes[:n]), n_groups,
+                            dtype=jnp.float32)
+        nnz_gj = np.asarray(oh.T @ (Xd > 0).astype(jnp.float32))
+    else:
+        import scipy.sparse as sp
+
+        onehot = np.zeros((n, n_groups), np.float32)
+        onehot[np.arange(n), codes] = 1.0
+        X = data.X
+        B = (X > 0) if sp.issparse(X) else sp.csr_matrix(
+            np.asarray(X) > 0)
+        nnz_gj = (B.astype(np.float32).T @ onehot).T
+    total = nnz_gj.sum(axis=0, keepdims=True)
+    frac_in = nnz_gj / np.maximum(n_per[:, None], 1.0)
+    frac_out = (total - nnz_gj) / np.maximum(
+        (n - n_per)[:, None], 1.0)
+    return frac_in, frac_out
+
+
+def _filter_rank_genes_groups(data: CellData, groupby, key,
+                              min_in_group_fraction,
+                              max_out_group_fraction,
+                              min_fold_change, device: bool):
+    if key not in data.uns:
+        raise KeyError(
+            f"filter_rank_genes_groups: uns has no {key!r} — run "
+            "de.rank_genes_groups first")
+    res = data.uns[key]
+    codes, levels, _ = _group_codes(data, groupby)
+    if list(res["groups"]) != list(levels):
+        raise ValueError(
+            f"filter_rank_genes_groups: obs[{groupby!r}] levels "
+            f"{levels} do not match the ranking's groups "
+            f"{list(res['groups'])}")
+    frac_in, frac_out = _expression_fractions(
+        data, codes, len(levels), device)
+    idx = np.asarray(res["indices"])  # (groups, m) gene ids, ranked
+    rows = np.arange(len(levels))[:, None]
+    ok = ((frac_in[rows, idx] >= min_in_group_fraction)
+          & (frac_out[rows, idx] <= max_out_group_fraction)
+          & (np.asarray(res["logfoldchanges"])
+             >= np.log2(min_fold_change)))
+    names = np.asarray(res["names"]).astype(object)
+    names[~ok] = None  # scanpy parity: filtered entries become NaN/None
+    out = dict(res)
+    out["names_filtered"] = names
+    out["kept"] = ok
+    out["frac_in_group"] = frac_in[rows, idx]
+    out["frac_out_group"] = frac_out[rows, idx]
+    return data.with_uns(**{f"{key}_filtered": out})
+
+
+@register("de.filter_rank_genes_groups", backend="tpu")
+def filter_rank_genes_groups_tpu(
+        data: CellData, groupby: str = "label",
+        key: str = "rank_genes_groups",
+        min_in_group_fraction: float = 0.25,
+        max_out_group_fraction: float = 0.5,
+        min_fold_change: float = 1.0) -> CellData:
+    """Filter an existing ``de.rank_genes_groups`` result by in-group
+    expression fraction, out-group expression fraction, and minimum
+    fold change (scanpy ``pp.filter_rank_genes_groups``).  Adds
+    ``uns[key + '_filtered']`` with ``names_filtered`` (non-passing
+    entries None), the boolean ``kept`` mask, and both fraction
+    matrices.  The per-group expression fractions are one binarised
+    ``spmm_t`` on device."""
+    return _filter_rank_genes_groups(
+        data, groupby, key, min_in_group_fraction,
+        max_out_group_fraction, min_fold_change, device=True)
+
+
+@register("de.filter_rank_genes_groups", backend="cpu")
+def filter_rank_genes_groups_cpu(
+        data: CellData, groupby: str = "label",
+        key: str = "rank_genes_groups",
+        min_in_group_fraction: float = 0.25,
+        max_out_group_fraction: float = 0.5,
+        min_fold_change: float = 1.0) -> CellData:
+    return _filter_rank_genes_groups(
+        data, groupby, key, min_in_group_fraction,
+        max_out_group_fraction, min_fold_change, device=False)

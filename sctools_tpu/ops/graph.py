@@ -647,3 +647,26 @@ def paga_tpu(data: CellData, groups: str = "leiden") -> CellData:
 @register("graph.paga", backend="cpu")
 def paga_cpu(data: CellData, groups: str = "leiden") -> CellData:
     return _paga_impl(data, groups)
+
+
+# ----------------------------------------------------------------------
+# embed.diffmap — scanpy's name for the diffusion-map embedding
+# ----------------------------------------------------------------------
+
+
+@register("embed.diffmap", backend="tpu")
+def diffmap_tpu(data: CellData, n_comps: int = 15, seed: int = 0,
+                drop_first: bool = True) -> CellData:
+    """scanpy ``tl.diffmap`` naming for ``embed.spectral`` — identical
+    computation (the two public APIs describe the same diffusion-map
+    eigendecomposition); registered separately so reference users find
+    it under the name they know."""
+    return spectral_tpu(data, n_comps=n_comps, seed=seed,
+                        drop_first=drop_first)
+
+
+@register("embed.diffmap", backend="cpu")
+def diffmap_cpu(data: CellData, n_comps: int = 15, seed: int = 0,
+                drop_first: bool = True) -> CellData:
+    return spectral_cpu(data, n_comps=n_comps, seed=seed,
+                        drop_first=drop_first)

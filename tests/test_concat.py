@@ -1,0 +1,114 @@
+"""concat: AnnData-style cell-axis concatenation."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import sctools_tpu as sct
+from sctools_tpu.data.dataset import CellData
+
+
+def _cd(dense, genes, **obs):
+    return CellData(sp.csr_matrix(np.asarray(dense, np.float32)),
+                    obs={k: np.asarray(v) for k, v in obs.items()},
+                    var={"gene_name": np.asarray(genes)})
+
+
+def test_concat_inner_aligns_by_gene_name():
+    a = _cd([[1, 2, 3], [4, 5, 6]], ["g1", "g2", "g3"],
+            depth=[1.0, 2.0])
+    b = _cd([[7, 8], [9, 10]], ["g3", "g1"], depth=[3.0, 4.0])
+    out = sct.concat([a, b], join="inner", label="batch",
+                     keys=["s1", "s2"])
+    assert list(out.var["gene_name"]) == ["g1", "g3"]  # first's order
+    want = np.array([[1, 3], [4, 6], [8, 7], [10, 9]], np.float32)
+    np.testing.assert_array_equal(out.X.toarray(), want)
+    np.testing.assert_array_equal(out.obs["depth"], [1, 2, 3, 4])
+    assert list(out.obs["batch"]) == ["s1", "s1", "s2", "s2"]
+
+
+def test_concat_outer_fills_zero():
+    a = _cd([[1, 2]], ["g1", "g2"])
+    b = _cd([[5]], ["g3"])
+    out = sct.concat([a, b], join="outer")
+    assert list(out.var["gene_name"]) == ["g1", "g2", "g3"]
+    want = np.array([[1, 2, 0], [0, 0, 5]], np.float32)
+    np.testing.assert_array_equal(out.X.toarray(), want)
+
+
+def test_concat_obs_union_and_obsm_intersection():
+    a = _cd([[1, 2]], ["g1", "g2"], score=[0.5])
+    a = a.with_obsm(X_pca=np.ones((1, 4)), only_a=np.ones((1, 2)))
+    b = _cd([[3, 4]], ["g1", "g2"], other=["x"])
+    b = b.with_obsm(X_pca=np.zeros((1, 4)))
+    out = sct.concat([a, b])
+    # union obs: numeric filled with NaN, string with ""
+    assert np.isnan(out.obs["score"][1])
+    assert out.obs["other"][0] == ""
+    assert out.obs["other"][1] == "x"
+    # intersection obsm
+    assert set(out.obsm) == {"X_pca"}
+    assert out.obsm["X_pca"].shape == (2, 4)
+
+
+def test_concat_layers_reindexed_like_X():
+    a = _cd([[1, 2]], ["g1", "g2"]).with_layers(
+        counts=sp.csr_matrix(np.array([[10, 20]], np.float32)))
+    b = _cd([[3, 4]], ["g2", "g1"]).with_layers(
+        counts=sp.csr_matrix(np.array([[30, 40]], np.float32)))
+    out = sct.concat([a, b], join="inner")
+    np.testing.assert_array_equal(
+        out.layers["counts"].toarray(), [[10, 20], [40, 30]])
+
+
+def test_concat_positional_when_no_gene_names():
+    a = CellData(sp.csr_matrix(np.eye(2, 3, dtype=np.float32)))
+    b = CellData(sp.csr_matrix(np.ones((1, 3), np.float32)))
+    out = sct.concat([a, b])
+    assert out.shape == (3, 3)
+    c = CellData(sp.csr_matrix(np.ones((1, 4), np.float32)))
+    with pytest.raises(ValueError, match="differing gene counts"):
+        sct.concat([a, c])
+
+
+def test_concat_feeds_integration():
+    """The label column drives integrate.harmony end-to-end."""
+    from sctools_tpu.data.synthetic import synthetic_counts
+
+    full = synthetic_counts(400, 300, density=0.1, n_clusters=3, seed=0)
+    X = full.X.tocsr()
+    a, b = full.with_X(X[:200]), full.with_X(X[200:])
+    merged = sct.concat([a, b], label="sample", keys=["runA", "runB"])
+    assert merged.n_cells == 400
+    merged = sct.apply("normalize.library_size", merged, backend="cpu")
+    merged = sct.apply("normalize.log1p", merged, backend="cpu")
+    merged = sct.apply("pca.randomized", merged, backend="cpu",
+                       n_components=10)
+    out = sct.apply("integrate.harmony", merged, backend="cpu",
+                    batch_key="sample", n_clusters=5)
+    assert out.obsm["X_harmony"].shape == (400, 10)
+
+
+def test_concat_rejects_duplicate_gene_names():
+    a = _cd([[1, 2]], ["g1", "g1"])
+    b = _cd([[3, 4]], ["g1", "g2"])
+    with pytest.raises(ValueError, match="duplicate gene names"):
+        sct.concat([a, b])
+
+
+def test_concat_keys_require_label():
+    a = _cd([[1, 2]], ["g1", "g2"])
+    with pytest.raises(ValueError, match="label="):
+        sct.concat([a, a], keys=["s1", "s2"])
+
+
+def test_concat_preserves_first_var_columns():
+    a = _cd([[1, 2]], ["g1", "g2"])
+    a = a.with_var(highly_variable=np.array([True, False]),
+                   feature_type=np.array(["gex", "gex"]))
+    b = _cd([[3, 4, 5]], ["g2", "g3", "g1"])
+    out = sct.concat([a, b], join="outer")
+    assert list(out.var["gene_name"]) == ["g1", "g2", "g3"]
+    hv = out.var["highly_variable"]
+    assert hv[0] == 1.0 and hv[1] == 0.0 and np.isnan(hv[2])
+    assert list(out.var["feature_type"]) == ["gex", "gex", ""]

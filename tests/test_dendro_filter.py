@@ -1,0 +1,100 @@
+"""cluster.dendrogram, de.filter_rank_genes_groups, embed.diffmap."""
+
+import numpy as np
+import pytest
+
+import sctools_tpu as sct
+from sctools_tpu.data.synthetic import synthetic_counts
+
+
+@pytest.fixture(scope="module")
+def clustered():
+    d = synthetic_counts(600, 400, density=0.12, n_clusters=4, seed=0)
+    d = sct.apply("normalize.library_size", d, backend="cpu")
+    d = sct.apply("normalize.log1p", d, backend="cpu")
+    d = sct.apply("pca.randomized", d, backend="cpu", n_components=15)
+    return d.with_obs(label=np.asarray(d.obs["cluster_true"]).astype(str))
+
+
+def test_dendrogram_groups_centroids(clustered):
+    out = sct.apply("cluster.dendrogram", clustered, backend="cpu",
+                    groupby="label")
+    dd = out.uns["dendrogram_label"]
+    assert dd["linkage"].shape == (3, 4)  # 4 groups -> 3 merges
+    assert sorted(dd["categories_ordered"]) == ["0", "1", "2", "3"]
+    assert dd["correlation_matrix"].shape == (4, 4)
+    # tpu backend produces the same leaf order (host linkage on the
+    # same centroids)
+    out_t = sct.apply("cluster.dendrogram", clustered.device_put(),
+                      backend="tpu", groupby="label")
+    assert (out_t.uns["dendrogram_label"]["categories_ordered"]
+            == dd["categories_ordered"])
+
+
+def test_dendrogram_needs_two_groups(clustered):
+    one = clustered.with_obs(label=np.full(600, "all"))
+    with pytest.raises(ValueError, match="at least 2"):
+        sct.apply("cluster.dendrogram", one, backend="cpu",
+                  groupby="label")
+
+
+def test_filter_rank_genes_groups_cpu_tpu_agree(clustered):
+    d = sct.apply("de.rank_genes_groups", clustered, backend="cpu",
+                  groupby="label", method="t-test")
+    f_cpu = sct.apply("de.filter_rank_genes_groups", d, backend="cpu",
+                      groupby="label", min_in_group_fraction=0.3,
+                      max_out_group_fraction=0.6, min_fold_change=1.2)
+    f_tpu = sct.apply("de.filter_rank_genes_groups", d.device_put(),
+                      backend="tpu", groupby="label",
+                      min_in_group_fraction=0.3,
+                      max_out_group_fraction=0.6, min_fold_change=1.2)
+    res_c = f_cpu.uns["rank_genes_groups_filtered"]
+    res_t = f_tpu.uns["rank_genes_groups_filtered"]
+    np.testing.assert_array_equal(res_c["kept"], res_t["kept"])
+    np.testing.assert_allclose(res_c["frac_in_group"],
+                               res_t["frac_in_group"], atol=1e-6)
+    # the filter does something: some genes pass, some don't
+    kept = res_c["kept"]
+    assert 0 < kept.sum() < kept.size
+    # cluster-marker genes (the generator upweights per-cluster gene
+    # blocks) dominate the survivors: every kept entry passes all
+    # three gates by construction
+    assert (res_c["frac_in_group"][kept] >= 0.3).all()
+    assert (res_c["frac_out_group"][~np.isnan(
+        res_c["frac_out_group"])].max() <= 1.0)
+    # filtered names are None where not kept
+    nf = res_c["names_filtered"]
+    assert all(nf[~kept].ravel()[i] is None
+               for i in range(min(5, (~kept).sum())))
+
+
+def test_filter_requires_prior_ranking(clustered):
+    with pytest.raises(KeyError, match="rank_genes_groups"):
+        sct.apply("de.filter_rank_genes_groups", clustered,
+                  backend="cpu", groupby="label")
+
+
+def test_diffmap_alias_matches_spectral(clustered):
+    d = sct.apply("neighbors.knn", clustered, backend="cpu", k=12)
+    a = sct.apply("embed.spectral", d, backend="cpu", n_comps=5, seed=0)
+    b = sct.apply("embed.diffmap", d, backend="cpu", n_comps=5, seed=0)
+    np.testing.assert_allclose(np.asarray(a.obsm["X_diffmap"]),
+                               np.asarray(b.obsm["X_diffmap"]))
+
+
+def test_filter_rank_genes_groups_dense_device_x(clustered):
+    """The TPU fraction pass must handle dense device X, not only
+    SparseCells (rank_genes_groups supports both)."""
+    import scipy.sparse as sp
+
+    dense = clustered.with_X(np.asarray(
+        clustered.X.todense(), np.float32))
+    d = sct.apply("de.rank_genes_groups", dense, backend="cpu",
+                  groupby="label", method="t-test")
+    f_cpu = sct.apply("de.filter_rank_genes_groups", d, backend="cpu",
+                      groupby="label")
+    f_tpu = sct.apply("de.filter_rank_genes_groups", d, backend="tpu",
+                      groupby="label")
+    np.testing.assert_array_equal(
+        f_cpu.uns["rank_genes_groups_filtered"]["kept"],
+        f_tpu.uns["rank_genes_groups_filtered"]["kept"])
