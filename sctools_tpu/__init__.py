@@ -25,7 +25,7 @@ Quick start::
     ]).run(dev, backend="tpu")
 """
 
-from . import data, ops, parallel  # noqa: F401  (imports register transforms)
+from . import data, ops, parallel, recipes  # noqa: F401  (imports register transforms)
 from .config import config, configure
 from .data import CellData, SparseCells
 from .data.concat import concat

@@ -2,6 +2,6 @@
 transform with the registry (both cpu and tpu backends)."""
 
 from . import (  # noqa: F401
-    cluster, de, distance, doublet, graph, hvg, ingest, integrate, knn,
-    metacells, mnn, normalize, palantir, pca, qc, score, tsne, umap,
+    cluster, de, density, distance, doublet, graph, hvg, ingest, integrate,
+    knn, metacells, mnn, normalize, palantir, pca, qc, score, tsne, umap,
 )

@@ -1,0 +1,88 @@
+"""Preprocessing recipes — scanpy's ``pp.recipe_*`` one-call
+pipelines, expressed as this framework's ``Pipeline`` objects.
+
+Capability parity: scanpy ships canned preprocessing recipes
+(``recipe_zheng17`` from the 10x 1.3M-cell paper, ``recipe_seurat``
+from the original Seurat workflow); the reference source was
+unavailable (/root/reference empty — SURVEY.md §0), so the public
+scanpy step lists are the contract.  Each recipe here is BOTH a
+registered one-call op (``sct.apply("recipe.zheng17", data,
+backend="tpu")``) and a ``Pipeline`` factory (``zheng17_pipeline()``)
+so users can inspect, edit, or checkpoint the steps.
+
+The registered form snapshots raw counts into ``layers['counts']``
+first (``util.snapshot_layer``) — the recipes normalise in place and
+downstream DE usually wants the raw counts back.
+"""
+
+from __future__ import annotations
+
+from .data.dataset import CellData
+from .registry import Pipeline, register
+
+
+def zheng17_pipeline(n_top_genes: int = 1000) -> Pipeline:
+    """Zheng et al. 2017 (10x 1.3M-cell paper) steps: gene filter →
+    count normalise → dispersion HVG subset → renormalise → log1p →
+    scale (no clip)."""
+    return Pipeline([
+        ("util.snapshot_layer", {"layer": "counts"}),
+        ("qc.filter_genes", {"min_cells": 1}),
+        ("normalize.library_size", {"target_sum": None}),  # per-cell median
+        ("hvg.select", {"n_top": n_top_genes, "flavor": "dispersion",
+                        "subset": True}),
+        ("normalize.library_size", {"target_sum": None}),
+        ("normalize.log1p", {}),
+        ("normalize.scale", {"max_value": None}),
+    ])
+
+
+def seurat_pipeline(n_top_genes: int = 2000,
+                    min_genes: int = 200, min_cells: int = 3,
+                    target_sum: float = 1e4) -> Pipeline:
+    """Classic Seurat workflow steps: cell filter → gene filter →
+    library-size normalise → log1p → dispersion HVG subset → scale
+    clipped at 10."""
+    return Pipeline([
+        ("util.snapshot_layer", {"layer": "counts"}),
+        ("qc.per_cell_metrics", {}),  # filter_cells reads its columns
+        ("qc.filter_cells", {"min_genes": min_genes}),
+        ("qc.filter_genes", {"min_cells": min_cells}),
+        ("normalize.library_size", {"target_sum": target_sum}),
+        ("normalize.log1p", {}),
+        ("hvg.select", {"n_top": n_top_genes, "flavor": "dispersion",
+                        "subset": True}),
+        ("normalize.scale", {"max_value": 10.0}),
+    ])
+
+
+@register("recipe.zheng17", backend="tpu")
+def recipe_zheng17_tpu(data: CellData,
+                       n_top_genes: int = 1000) -> CellData:
+    """One-call Zheng et al. 2017 preprocessing (see
+    ``zheng17_pipeline`` for the step list)."""
+    return zheng17_pipeline(n_top_genes).run(data, backend="tpu")
+
+
+@register("recipe.zheng17", backend="cpu")
+def recipe_zheng17_cpu(data: CellData,
+                       n_top_genes: int = 1000) -> CellData:
+    return zheng17_pipeline(n_top_genes).run(data, backend="cpu")
+
+
+@register("recipe.seurat", backend="tpu")
+def recipe_seurat_tpu(data: CellData, n_top_genes: int = 2000,
+                      min_genes: int = 200, min_cells: int = 3,
+                      target_sum: float = 1e4) -> CellData:
+    """One-call classic-Seurat preprocessing (see ``seurat_pipeline``
+    for the step list)."""
+    return seurat_pipeline(n_top_genes, min_genes, min_cells,
+                           target_sum).run(data, backend="tpu")
+
+
+@register("recipe.seurat", backend="cpu")
+def recipe_seurat_cpu(data: CellData, n_top_genes: int = 2000,
+                      min_genes: int = 200, min_cells: int = 3,
+                      target_sum: float = 1e4) -> CellData:
+    return seurat_pipeline(n_top_genes, min_genes, min_cells,
+                           target_sum).run(data, backend="cpu")

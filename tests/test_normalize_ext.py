@@ -104,3 +104,41 @@ def test_downsample_counts(ds):
         small = orig <= 50
         if small.any():
             np.testing.assert_allclose(totals[small], orig[small])
+
+
+def test_clr_cell_axis_matches_dense_formula():
+    """normalize.clr vs the definition computed densely in f64."""
+    import scipy.sparse as sp
+
+    from sctools_tpu.data.dataset import CellData
+
+    rng = np.random.default_rng(0)
+    dense = rng.poisson(3.0, (64, 40)).astype(np.float32)
+    dense[rng.random((64, 40)) < 0.5] = 0
+    d = CellData(sp.csr_matrix(dense))
+
+    for axis, ax in (("cell", 1), ("gene", 0)):
+        lg = np.log1p(dense.astype(np.float64))
+        m = lg.mean(axis=ax, keepdims=True)
+        want = np.log1p(dense * np.exp(-m))
+
+        got_cpu = sct.apply("normalize.clr", d, backend="cpu", axis=axis)
+        np.testing.assert_allclose(got_cpu.X.toarray(), want,
+                                   rtol=1e-5, atol=1e-6)
+        got_tpu = sct.apply("normalize.clr", d.device_put(),
+                            backend="tpu", axis=axis).to_host()
+        np.testing.assert_allclose(got_tpu.X.toarray(), want,
+                                   rtol=1e-4, atol=1e-5)
+        # dense inputs agree with sparse inputs
+        got_dense = sct.apply("normalize.clr", CellData(dense),
+                              backend="cpu", axis=axis)
+        np.testing.assert_allclose(np.asarray(got_dense.X), want,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_clr_rejects_bad_axis():
+    from sctools_tpu.data.dataset import CellData
+
+    d = CellData(np.ones((4, 3), np.float32))
+    with pytest.raises(ValueError, match="axis"):
+        sct.apply("normalize.clr", d, backend="cpu", axis="rows")

@@ -1,0 +1,102 @@
+"""recipes, embed.density, de.marker_gene_overlap."""
+
+import numpy as np
+import pytest
+
+import sctools_tpu as sct
+from sctools_tpu.data.synthetic import synthetic_counts
+
+
+@pytest.fixture(scope="module")
+def raw():
+    return synthetic_counts(800, 500, density=0.12, n_clusters=3, seed=0)
+
+
+def test_recipe_zheng17_cpu_tpu_parity(raw):
+    out_c = sct.apply("recipe.zheng17", raw, backend="cpu",
+                      n_top_genes=300)
+    out_t = sct.apply("recipe.zheng17", raw.device_put(), backend="tpu",
+                      n_top_genes=300).to_host()
+    assert out_c.n_genes == 300 and out_t.n_genes == 300
+    # raw counts preserved for downstream DE
+    assert "counts" in out_c.layers and "counts" in out_t.layers
+    # same HVG selection and near-identical scaled values
+    np.testing.assert_array_equal(
+        np.asarray(out_c.var["gene_name"]),
+        np.asarray(out_t.var["gene_name"]))
+    Xc = np.asarray(out_c.X if not hasattr(out_c.X, "toarray")
+                    else out_c.X.toarray())
+    Xt = np.asarray(out_t.X if not hasattr(out_t.X, "toarray")
+                    else out_t.X.toarray())
+    np.testing.assert_allclose(Xc, Xt, atol=2e-3)
+
+
+def test_recipe_seurat_runs_and_filters(raw):
+    out = sct.apply("recipe.seurat", raw, backend="cpu",
+                    n_top_genes=200, min_genes=10, min_cells=3)
+    assert out.n_genes == 200
+    assert out.n_cells <= 800
+    X = np.asarray(out.X if not hasattr(out.X, "toarray")
+                   else out.X.toarray())
+    assert X.max() <= 10.0 + 1e-6  # Seurat clip
+
+
+def test_recipe_pipeline_factory_is_editable():
+    from sctools_tpu.recipes import seurat_pipeline
+
+    p = seurat_pipeline(n_top_genes=150)
+    names = [t.name for t in p.steps]
+    assert names[0] == "util.snapshot_layer"
+    assert "hvg.select" in names
+
+
+def test_embedding_density_cpu_tpu_agree():
+    rng = np.random.default_rng(0)
+    # two blobs: dense core + sparse halo -> density must rank core
+    # cells above halo cells
+    core = rng.normal(0, 0.3, (300, 2))
+    halo = rng.normal(0, 3.0, (100, 2))
+    E = np.vstack([core, halo]).astype(np.float32)
+    from sctools_tpu.data.dataset import CellData
+
+    d = CellData(np.zeros((400, 1), np.float32),
+                 obsm={"X_umap": E},
+                 obs={"grp": np.array(["a"] * 200 + ["b"] * 200)})
+    out_c = sct.apply("embed.density", d, backend="cpu")
+    out_t = sct.apply("embed.density", d, backend="tpu")
+    dc = np.asarray(out_c.obs["umap_density"])
+    dt = np.asarray(out_t.obs["umap_density"])
+    np.testing.assert_allclose(dc, dt, atol=1e-4)
+    assert dc.min() >= 0 and dc.max() <= 1
+    assert dc[:300].mean() > 2 * dc[300:].mean()
+    # grouped variant scales within each group and names the column
+    out_g = sct.apply("embed.density", d, backend="cpu", groupby="grp")
+    dg = np.asarray(out_g.obs["umap_density_grp"])
+    for g in ("a", "b"):
+        m = np.asarray(d.obs["grp"]) == g
+        assert dg[m].max() == pytest.approx(1.0)
+
+
+def test_marker_gene_overlap(raw):
+    d = sct.apply("normalize.library_size", raw, backend="cpu")
+    d = sct.apply("normalize.log1p", d, backend="cpu")
+    d = d.with_obs(label=np.asarray(d.obs["cluster_true"]).astype(str))
+    d = sct.apply("de.rank_genes_groups", d, backend="cpu",
+                  groupby="label", method="t-test")
+    names = np.asarray(d.uns["rank_genes_groups"]["names"])
+    ref = {"setA": list(map(str, names[0][:20])),
+           "setB": ["not_a_gene_1", "not_a_gene_2"]}
+    out = sct.apply("de.marker_gene_overlap", d, backend="cpu",
+                    reference_markers=ref, top_n_markers=50)
+    ov = out.uns["rank_genes_groups_overlap"]
+    m = ov["matrix"]
+    assert m.shape == (2, 3)
+    a = ov["reference"].index("setA")
+    b = ov["reference"].index("setB")
+    g0 = ov["groups"].index("0")
+    assert m[a, g0] == 20.0  # its own top-20 fully recovered
+    assert (m[b] == 0).all()
+    # jaccard stays in [0,1]
+    out2 = sct.apply("de.marker_gene_overlap", d, backend="cpu",
+                     reference_markers=ref, method="jaccard")
+    assert (out2.uns["rank_genes_groups_overlap"]["matrix"] <= 1).all()
