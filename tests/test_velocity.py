@@ -1,0 +1,121 @@
+"""velocity.*: steady-state RNA velocity vs known dynamics."""
+
+import numpy as np
+import pytest
+
+import sctools_tpu as sct
+from sctools_tpu.data.dataset import CellData
+
+
+def _velocity_fixture(n=500, g=40, seed=0):
+    """Cells along a 1-D differentiation time axis.  Per gene g with
+    known γ_g: most cells sit at steady state (u = γ s), while an
+    'induction' band of mid-trajectory cells carries positive extra u
+    — their velocity must come out positive."""
+    rng = np.random.default_rng(seed)
+    t = np.sort(rng.random(n))
+    gamma = rng.uniform(0.2, 1.5, g)
+    s = np.outer(t, rng.uniform(5, 15, g)) + rng.normal(0, 0.05, (n, g))
+    s = np.maximum(s, 0)
+    u = gamma[None, :] * s
+    induced = (t > 0.4) & (t < 0.6)
+    u[induced] += 2.0  # burst of transcription mid-trajectory
+    u = np.maximum(u + rng.normal(0, 0.05, (n, g)), 0)
+    emb = np.stack([t, rng.normal(0, 0.05, n)], axis=1)
+    d = CellData(s.astype(np.float32),
+                 obs={"t": t},
+                 obsm={"X_pca": np.asarray(
+                     np.hstack([emb, rng.normal(0, 0.01, (n, 8))]),
+                     np.float32),
+                       "X_umap": emb.astype(np.float32)})
+    d = d.with_layers(spliced=s.astype(np.float32),
+                      unspliced=u.astype(np.float32))
+    d = sct.apply("neighbors.knn", d, backend="cpu", k=15,
+                  metric="euclidean")
+    return d, gamma, induced
+
+
+@pytest.fixture(scope="module")
+def vdata():
+    return _velocity_fixture()
+
+
+def test_moments_smooth_both_layers(vdata):
+    d, _, _ = vdata
+    out = sct.apply("velocity.moments", d, backend="cpu")
+    assert out.layers["Ms"].shape == (500, 40)
+    # smoothing shrinks local variance but preserves the global trend
+    s = np.asarray(d.layers["spliced"], np.float64)
+    ms = np.asarray(out.layers["Ms"], np.float64)
+    assert np.var(np.diff(ms, axis=0)) < np.var(np.diff(s, axis=0))
+    assert abs(ms.mean() - s.mean()) / s.mean() < 0.05
+    out_t = sct.apply("velocity.moments", d, backend="tpu")
+    np.testing.assert_allclose(np.asarray(out_t.layers["Ms"]), ms,
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_estimate_recovers_gamma_and_flags_induction(vdata):
+    d, gamma, induced = vdata
+    out = sct.apply("velocity.estimate", d, backend="cpu")
+    got = np.asarray(out.var["velocity_gamma"], np.float64)
+    # γ recovered within 15% median relative error
+    rel = np.abs(got - gamma) / gamma
+    assert np.median(rel) < 0.15
+    # induced cells have positive velocity, steady-state cells ~0
+    v = np.asarray(out.layers["velocity"], np.float64)
+    assert v[induced].mean() > 5 * abs(v[~induced].mean())
+    # tpu path agrees
+    out_t = sct.apply("velocity.estimate", d, backend="tpu")
+    np.testing.assert_allclose(
+        np.asarray(out_t.var["velocity_gamma"], np.float64), got,
+        rtol=0.05, atol=0.02)
+
+
+def test_velocity_graph_points_forward(vdata):
+    d, _, induced = vdata
+    out = sct.apply("velocity.estimate", d, backend="cpu")
+    out = sct.apply("velocity.graph", out, backend="cpu")
+    cos = np.asarray(out.obsp["velocity_graph"], np.float64)
+    idx = np.asarray(out.obsp["knn_indices"])
+    t = np.asarray(d.obs["t"])
+    # for INDUCED cells (the ones actually moving), neighbours ahead
+    # in time should score higher cosine than neighbours behind
+    fwd, bwd = [], []
+    for i in np.where(induced)[0]:
+        for jj, j in enumerate(idx[i]):
+            if j < 0:
+                continue
+            (fwd if t[j] > t[i] else bwd).append(cos[i, jj])
+    assert np.mean(fwd) > np.mean(bwd) + 0.2
+    # tpu agreement on the same edges
+    out_t = sct.apply("velocity.graph", out, backend="tpu")
+    np.testing.assert_allclose(
+        np.asarray(out_t.obsp["velocity_graph"], np.float64), cos,
+        atol=5e-3)
+
+
+def test_velocity_embedding_arrows_forward(vdata):
+    d, _, induced = vdata
+    out = sct.apply("velocity.estimate", d, backend="cpu")
+    out = sct.apply("velocity.graph", out, backend="cpu")
+    out = sct.apply("velocity.embedding", out, backend="cpu",
+                    basis="umap")
+    arr = np.asarray(out.obsm["velocity_umap"], np.float64)
+    assert arr.shape == (500, 2)
+    # induced cells' arrows point toward larger t (positive x in this
+    # embedding)
+    assert arr[induced, 0].mean() > 0
+    assert arr[induced, 0].mean() > 3 * abs(arr[~induced, 0].mean())
+
+
+def test_velocity_validates_inputs(vdata):
+    d, _, _ = vdata
+    bare = CellData(np.zeros((10, 4), np.float32))
+    with pytest.raises(KeyError, match="spliced"):
+        sct.apply("velocity.moments",
+                  bare.with_obsp(knn_indices=np.zeros((10, 3), np.int32),
+                                 knn_distances=np.ones((10, 3),
+                                                       np.float32)),
+                  backend="cpu")
+    with pytest.raises(KeyError, match="velocity.estimate"):
+        sct.apply("velocity.graph", d, backend="cpu")
