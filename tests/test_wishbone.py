@@ -1,0 +1,122 @@
+"""wishbone.run: bifurcating trajectory + branch assignment."""
+
+import numpy as np
+import pytest
+
+import sctools_tpu as sct
+from sctools_tpu.data.dataset import CellData
+
+
+def _y_shape(n_trunk=150, n_arm=150, d=10, seed=0):
+    """A Y: trunk from origin, then two arms diverging."""
+    rng = np.random.default_rng(seed)
+    t_trunk = np.linspace(0, 1, n_trunk)
+    t_arm = np.linspace(0, 1, n_arm)
+    dir_trunk = np.zeros(d)
+    dir_trunk[0] = 1.0
+    dir_a = np.zeros(d)
+    dir_a[0], dir_a[1] = 0.7, 0.7
+    dir_b = np.zeros(d)
+    dir_b[0], dir_b[1] = 0.7, -0.7
+    trunk = np.outer(t_trunk, dir_trunk)
+    tip = dir_trunk  # branch point at (1, 0, ...)
+    arm_a = tip + np.outer(t_arm, dir_a)
+    arm_b = tip + np.outer(t_arm, dir_b)
+    E = np.vstack([trunk, arm_a, arm_b])
+    E = E + rng.normal(0, 0.02, E.shape)
+    truth_t = np.concatenate([t_trunk, 1 + t_arm, 1 + t_arm])
+    truth_b = np.concatenate([np.zeros(n_trunk), np.ones(n_arm),
+                              np.full(n_arm, 2)]).astype(int)
+    d_ = CellData(np.zeros((len(E), 1), np.float32),
+                  obsm={"X_pca": E.astype(np.float32)})
+    d_ = sct.apply("neighbors.knn", d_, backend="cpu", k=10,
+                   metric="euclidean")
+    return d_, truth_t, truth_b
+
+
+@pytest.fixture(scope="module")
+def ydata():
+    return _y_shape()
+
+
+def _spearman(a, b):
+    ra = np.argsort(np.argsort(a)).astype(np.float64)
+    rb = np.argsort(np.argsort(b)).astype(np.float64)
+    ra -= ra.mean()
+    rb -= rb.mean()
+    return float((ra * rb).sum()
+                 / np.sqrt((ra * ra).sum() * (rb * rb).sum()))
+
+
+def test_wishbone_orders_cells(ydata):
+    d, truth_t, _ = ydata
+    out = sct.apply("wishbone.run", d, backend="cpu", start_cell=0,
+                    n_waypoints=80)
+    tau = np.asarray(out.obs["wishbone_trajectory"], np.float64)
+    assert _spearman(tau, truth_t) > 0.95
+
+
+def test_wishbone_finds_the_two_arms(ydata):
+    d, truth_t, truth_b = ydata
+    out = sct.apply("wishbone.run", d, backend="cpu", start_cell=0,
+                    n_waypoints=80)
+    br = np.asarray(out.obs["wishbone_branch"])
+    # post-branch cells split into two arms that match the generative
+    # arms (up to label swap)
+    post = truth_b > 0
+    a = br[post & (truth_b == 1)]
+    b = br[post & (truth_b == 2)]
+    # each true arm is dominated by one predicted label, and they differ
+    la = np.bincount(a[a > 0], minlength=3).argmax()
+    lb = np.bincount(b[b > 0], minlength=3).argmax()
+    assert la != lb and la > 0 and lb > 0
+    # cross-arm confusion only in the immediate branch vicinity
+    # (measured 4/300 on this fixture)
+    cross = ((a == lb).sum() + (b == la).sum()) / (len(a) + len(b))
+    assert cross < 0.03
+    acc = ((a == la).mean() + (b == lb).mean()) / 2
+    assert acc > 0.9  # measured 0.973
+    # trunk cells are labelled 0 (measured 0.987)
+    assert (br[truth_b == 0] == 0).mean() > 0.9
+
+
+def test_wishbone_tpu_distances_match_dijkstra(ydata):
+    d, _, _ = ydata
+    out_c = sct.apply("wishbone.run", d, backend="cpu", start_cell=0,
+                      n_waypoints=40)
+    out_t = sct.apply("wishbone.run", d, backend="tpu", start_cell=0,
+                      n_waypoints=40)
+    tc = np.asarray(out_c.obs["wishbone_trajectory"], np.float64)
+    tt = np.asarray(out_t.obs["wishbone_trajectory"], np.float64)
+    # min-plus relaxation (f32) vs dijkstra (f64): same shortest paths
+    np.testing.assert_allclose(tt, tc, rtol=2e-3, atol=2e-3)
+    assert _spearman(tt, tc) > 0.999
+
+
+def test_wishbone_validates(ydata):
+    d, _, _ = ydata
+    with pytest.raises(ValueError, match="start_cell"):
+        sct.apply("wishbone.run", d, backend="cpu", start_cell=10**6)
+    bare = CellData(np.zeros((5, 2), np.float32))
+    with pytest.raises(KeyError, match="neighbors.knn"):
+        sct.apply("wishbone.run", bare, backend="cpu", start_cell=0)
+
+
+def test_minplus_converges_past_the_round_cap():
+    """A path graph's hop-diameter (n-1) far exceeds one relaxation
+    round (128 sweeps); the host loop must keep relaxing until true
+    convergence — regression for silently-unconverged distances."""
+    from sctools_tpu.ops.wishbone import (_distances_cpu, _distances_tpu,
+                                          _sym_edges)
+
+    n = 500
+    idx = np.full((n, 2), -1, np.int32)
+    dist = np.zeros((n, 2), np.float32)
+    idx[:-1, 0] = np.arange(1, n)     # i -> i+1
+    dist[:-1, 0] = 1.0
+    idx2, w2 = _sym_edges(idx, dist)
+    sources = np.array([0, n - 1])
+    D_dev = _distances_tpu(idx2, w2, sources)
+    D_ora = _distances_cpu(idx2, w2, sources)
+    np.testing.assert_allclose(D_dev, D_ora, rtol=1e-5)
+    assert D_dev[n - 1, 0] == pytest.approx(n - 1)  # full chain length
