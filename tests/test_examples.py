@@ -1,0 +1,28 @@
+"""The examples are executable documentation — keep them executing."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("script", ["pbmc_workflow.py",
+                                    "integration_workflow.py"])
+def test_example_runs(script):
+    # PYTHONPATH is REPLACED, not appended: the session's PYTHONPATH
+    # carries the axon sitecustomize that registers the TPU-tunnel
+    # plugin at interpreter startup — with the tunnel down the child
+    # hangs in backend init before main() ever runs.  XLA_FLAGS is
+    # dropped for the same isolation reason (conftest's 8-virtual-
+    # device flag octuples every compile in what should be a
+    # single-device doc run).
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_ROOT)
+    env.pop("XLA_FLAGS", None)
+    p = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "examples", script)],
+        capture_output=True, text=True, timeout=900, env=env)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "OK" in p.stdout or "done" in p.stdout.lower()
