@@ -78,6 +78,14 @@ def knn_arrays(
     ``matmul_dtype="float32"`` alone does NOT buy exact scores —
     we map it to HIGHEST explicitly.
     """
+    if metric == "correlation":
+        # Pearson-correlation distance == cosine distance of the
+        # row-centered vectors (scanpy's metric="correlation"); fold
+        # it into the cosine path so every backend/kernel shares one
+        # implementation
+        query = query - jnp.mean(query, axis=1, keepdims=True)
+        cand = cand - jnp.mean(cand, axis=1, keepdims=True)
+        metric = "cosine"
     if metric not in ("cosine", "euclidean"):
         raise ValueError(f"unknown metric {metric!r}")
     if config.knn_coarse not in ("topk", "approx"):
@@ -326,6 +334,10 @@ def knn_numpy(query, cand, k=15, metric="cosine", exclude_self=False,
     """Exact brute-force kNN in numpy — the recall oracle."""
     query = np.asarray(query, np.float64)
     cand = np.asarray(cand, np.float64)
+    if metric == "correlation":
+        query = query - query.mean(axis=1, keepdims=True)
+        cand = cand - cand.mean(axis=1, keepdims=True)
+        metric = "cosine"
     if metric == "cosine":
         qn = query / np.maximum(np.linalg.norm(query, axis=1, keepdims=True), 1e-12)
         cn = cand / np.maximum(np.linalg.norm(cand, axis=1, keepdims=True), 1e-12)
