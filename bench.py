@@ -258,18 +258,30 @@ def run_config0(jax):
     out = sct.apply("normalize.log1p", out, backend="tpu")
     _hard_sync(out.X.data)
     first = time.time() - t0
-    # steady state over R repetitions, dispatch-all-then-fetch-each: the
-    # per-fetch tunnel RTT amortises, pipelined throughput is measured
+    # steady state: R DATA-DEPENDENT repetitions (each consumes the
+    # previous output, so in-order execution is enforced by the
+    # dataflow, not trusted to the runtime) and ONE final fetch —
+    # fetching each rep would charge R tunnel RTTs to compute time.
+    # The residual single-RTT is measured afterwards and subtracted.
     R = 5
     t0 = time.time()
-    reps = []
+    y = dev
     for _ in range(R):
-        norm = sct.apply("normalize.library_size", dev, backend="tpu",
+        norm = sct.apply("normalize.library_size", y, backend="tpu",
                          target_sum=1e4)
-        reps.append(sct.apply("normalize.log1p", norm, backend="tpu"))
-    _hard_sync(*[o.X.data for o in reps])
-    steady = (time.time() - t0) / R
-    out = reps[-1]
+        y = sct.apply("normalize.log1p", norm, backend="tpu")
+    _hard_sync(y.X.data)
+    chain = time.time() - t0
+    t0 = time.time()
+    _hard_sync(y.X.data)  # already computed: pure fetch RTT
+    rtt = time.time() - t0
+    steady = max(chain - rtt, 1e-9) / R
+    # correctness pass uses a FRESH single application (the chain
+    # renormalises its own output, fine for timing only)
+    norm = sct.apply("normalize.library_size", dev, backend="tpu",
+                     target_sum=1e4)
+    out = sct.apply("normalize.log1p", norm, backend="tpu")
+    _hard_sync(out.X.data)
 
     ref_norm = sct.apply("normalize.library_size", d, backend="cpu",
                          target_sum=1e4)
@@ -291,6 +303,7 @@ def run_config0(jax):
     ok = err_lin < 1e-5 and err_log < 3e-4
     return {"n_cells": 2700, "n_genes": 32738,
             "wall_s": round(steady, 4), "wall_s_first": round(first, 2),
+            "fetch_rtt_s": round(rtt, 4),
             "cells_per_s": round(2700 / steady, 1),
             "max_rel_err_linear": err_lin,
             "max_abs_err_log1p": err_log,
@@ -311,19 +324,33 @@ def run_config1(jax):
     out = sct.apply("qc.per_cell_metrics", dev, backend="tpu")
     _hard_sync(out.obs["total_counts"])
     first = time.time() - t0
+    # chained reps (config0 comment explains why): QC passes X through
+    # untouched, so the dependence is injected explicitly — each rep's
+    # X adds 0 x the previous rep's totals
     R = 5
     t0 = time.time()
-    reps = [sct.apply("qc.per_cell_metrics", dev, backend="tpu")
-            for _ in range(R)]
-    _hard_sync(*[o.obs["total_counts"] for o in reps])
-    steady = (time.time() - t0) / R
-    out = reps[-1]
+    y = dev
+    for _ in range(R):
+        o = sct.apply("qc.per_cell_metrics", y, backend="tpu")
+        import jax.numpy as _jnp
+
+        dep = o.X.with_data(
+            o.X.data + 0.0 * _jnp.asarray(o.obs["total_counts"])[:, None])
+        y = dev.with_X(dep)
+    _hard_sync(o.obs["total_counts"])
+    chain = time.time() - t0
+    t0 = time.time()
+    _hard_sync(o.obs["total_counts"])
+    rtt = time.time() - t0
+    steady = max(chain - rtt, 1e-9) / R
+    out = o
     ref = sct.apply("qc.per_cell_metrics", d, backend="cpu")
     err = float(np.max(np.abs(
         np.asarray(out.obs["total_counts"])[:68579]
         - np.asarray(ref.obs["total_counts"]))))
     return {"n_cells": 68579, "n_genes": 32738,
             "wall_s": round(steady, 4), "wall_s_first": round(first, 2),
+            "fetch_rtt_s": round(rtt, 4),
             "cells_per_s": round(68579 / steady, 1),
             "max_abs_err_total_counts": err, "ok": err < 0.5}
 
