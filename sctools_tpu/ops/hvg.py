@@ -233,9 +233,14 @@ def hvg_select_tpu(data: CellData, n_top: int = 2000,
             z = jnp.clip(z, -clip, clip)
             ssq = jnp.sum(z * z, axis=0)
         score = _seurat_v3_scores_from_stats(mean, var, ssq, n, jnp)
-    elif flavor == "dispersion":
+    elif flavor in ("dispersion", "seurat"):
+        # "seurat" is scanpy's name for exactly this ranking
         mean, var, _ = _gene_moments_tpu(X)
         score = _dispersion_scores(mean, var, jnp)
+    elif flavor == "cell_ranger":
+        mean, var, _ = _gene_moments_tpu(X)
+        score = jnp.asarray(_cell_ranger_scores(
+            np.asarray(mean), np.asarray(var)), jnp.float32)
     else:
         raise ValueError(f"unknown hvg flavor {flavor!r}")
 
@@ -280,8 +285,10 @@ def hvg_select_cpu(data: CellData, n_top: int = 2000,
             z = np.clip((Xd - mean) / std, -clip, clip)
             ssq = (z * z).sum(axis=0)
         score = _seurat_v3_scores_from_stats(mean, var, ssq, n, np)
-    elif flavor == "dispersion":
+    elif flavor in ("dispersion", "seurat"):
         score = _dispersion_scores(mean, var, np)
+    elif flavor == "cell_ranger":
+        score = _cell_ranger_scores(mean, var)
     else:
         raise ValueError(f"unknown hvg flavor {flavor!r}")
 
@@ -330,3 +337,29 @@ def _dispersion_scores(mean, var, xp, n_bins: int = 20):
     bvar = xp.maximum(s / cnt - bmean**2, 1e-12)
     bstd = xp.sqrt(bvar)
     return (disp - bmean[bins]) / bstd[bins]
+
+
+def _cell_ranger_scores(mean, var, min_bins: int = 3):
+    """scanpy flavor="cell_ranger": dispersion normalised by the
+    MEDIAN and median-absolute-deviation within mean-PERCENTILE bins
+    (vs the seurat flavor's equal-width log-mean bins and mean/std).
+    Host numpy on fetched (G,) moments — medians need per-bin sorts,
+    O(G log G) host work vs the O(n·G) device pass that produced the
+    moments."""
+    mean = np.asarray(mean, np.float64)
+    var = np.asarray(var, np.float64)
+    disp = np.where(mean > 0, var / np.maximum(mean, 1e-12), 0.0)
+    edges = np.percentile(mean[mean > 0], np.arange(10, 105, 5))
+    bins = np.digitize(mean, np.unique(edges))
+    score = np.zeros_like(disp)
+    for b in np.unique(bins):
+        m = bins == b
+        if m.sum() < min_bins:
+            # scanpy parity: genes in tiny bins keep raw dispersion
+            # (their MAD is meaningless)
+            score[m] = disp[m]
+            continue
+        med = np.median(disp[m])
+        mad = np.median(np.abs(disp[m] - med)) + 1e-12
+        score[m] = np.abs(disp[m] - med) / mad
+    return score

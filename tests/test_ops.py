@@ -201,3 +201,31 @@ def test_filter_genes_slices_layers_both_backends():
                   min_cells=3).to_host()
     assert t.layers["counts"].shape[1] == t.X.shape[1]
     assert c.X.shape[1] == t.X.shape[1]
+
+
+def test_hvg_seurat_alias_and_cell_ranger():
+    from sctools_tpu.data.synthetic import synthetic_counts
+
+    d = synthetic_counts(400, 800, density=0.1, n_clusters=3, seed=7)
+    d = sct.apply("normalize.library_size", d, backend="cpu")
+    d = sct.apply("normalize.log1p", d, backend="cpu")
+    # "seurat" is an alias of "dispersion"
+    a = sct.apply("hvg.select", d, backend="cpu", n_top=100,
+                  flavor="seurat")
+    b = sct.apply("hvg.select", d, backend="cpu", n_top=100,
+                  flavor="dispersion")
+    np.testing.assert_array_equal(np.asarray(a.var["hvg_rank"]),
+                                  np.asarray(b.var["hvg_rank"]))
+    # cell_ranger runs on both backends and agrees (host scorer on
+    # device-computed moments)
+    c_cpu = sct.apply("hvg.select", d, backend="cpu", n_top=100,
+                      flavor="cell_ranger")
+    c_tpu = sct.apply("hvg.select", d.device_put(), backend="tpu",
+                      n_top=100, flavor="cell_ranger")
+    hc = np.asarray(c_cpu.var["highly_variable"])
+    ht = np.asarray(c_tpu.var["highly_variable"])
+    assert hc.sum() == 100
+    assert (hc == ht).mean() > 0.98  # f32 moment ties at the margin
+    # a different ranking than the seurat flavor (median/MAD vs
+    # mean/std in different bins)
+    assert (hc != np.asarray(a.var["highly_variable"])).any()
