@@ -269,3 +269,55 @@ def shard_iter(path: str, shard_rows: int, capacity: int | None = None
                 nnz_max = int(np.diff(sub.indptr).max()) if e > s else 1
                 capacity = round_up(max(nnz_max * 2, 1), config.capacity_multiple)
             yield SparseCells.from_scipy_csr(sub, capacity=capacity)
+
+
+def read_10x_h5(path: str, genome: str | None = None) -> CellData:
+    """Read a 10x Genomics CellRanger ``.h5`` file (scanpy
+    ``read_10x_h5``).  Handles both layouts the format has shipped:
+
+    * CellRanger >=3: one ``/matrix`` group with ``features/...``
+      (``id``, ``name``, ``feature_type``);
+    * CellRanger 2: one group per genome with ``genes``/``gene_names``
+      (``genome=`` selects it; defaults to the only/first group).
+
+    The stored matrix is features x barcodes in CSC-of-the-transpose
+    form — i.e. exactly CSR of cells x genes once reinterpreted, so no
+    transpose pass is needed: indptr walks barcodes, indices are
+    feature ids.
+    """
+    import h5py
+    import scipy.sparse as sp
+
+    with h5py.File(path, "r") as f:
+        if "matrix" in f:
+            g = f["matrix"]
+            feat = g["features"]
+            var = {
+                "gene_ids": np.asarray(feat["id"]).astype(str),
+                "gene_name": np.asarray(feat["name"]).astype(str),
+                "feature_type": np.asarray(
+                    feat["feature_types"]).astype(str),
+            }
+        else:
+            groups = [k for k in f.keys()
+                      if isinstance(f[k], h5py.Group)]
+            if not groups:
+                raise ValueError(
+                    f"read_10x_h5: no matrix group in {path!r}")
+            name = genome or groups[0]
+            if name not in f:
+                raise ValueError(
+                    f"read_10x_h5: genome {name!r} not in {groups}")
+            g = f[name]
+            var = {
+                "gene_ids": np.asarray(g["genes"]).astype(str),
+                "gene_name": np.asarray(g["gene_names"]).astype(str),
+            }
+        n_genes, n_cells = (int(x) for x in g["shape"][:])
+        X = sp.csr_matrix(
+            (np.asarray(g["data"], np.float32),
+             np.asarray(g["indices"]),
+             np.asarray(g["indptr"])),
+            shape=(n_cells, n_genes))
+        obs = {"barcode": np.asarray(g["barcodes"]).astype(str)}
+    return CellData(X, obs=obs, var=var)

@@ -143,3 +143,57 @@ def test_to_host_trims_knn_padding():
     assert host.obsp["knn_indices"].shape == (100, 5)
     assert host.obsp["knn_distances"].shape == (100, 5)
     assert (host.obsp["knn_indices"] >= 0).all()
+
+
+def test_read_10x_h5_both_layouts(tmp_path):
+    """CellRanger v3 ('matrix' group) and v2 (per-genome group)."""
+    import h5py
+
+    from sctools_tpu.data.io import read_10x_h5
+
+    rng = np.random.default_rng(0)
+    n_cells, n_genes = 30, 50
+    dense = (rng.random((n_cells, n_genes)) < 0.2) * rng.integers(
+        1, 9, (n_cells, n_genes))
+    X = sp.csr_matrix(dense.astype(np.float32))
+
+    def write_common(g):
+        # 10x stores features x barcodes CSC == cells x genes CSR
+        g.create_dataset("data", data=X.data)
+        g.create_dataset("indices", data=X.indices.astype(np.int64))
+        g.create_dataset("indptr", data=X.indptr.astype(np.int64))
+        g.create_dataset("shape", data=np.array([n_genes, n_cells]))
+        g.create_dataset("barcodes", data=np.array(
+            [f"AAAC-{i}".encode() for i in range(n_cells)]))
+
+    p3 = str(tmp_path / "v3.h5")
+    with h5py.File(p3, "w") as f:
+        g = f.create_group("matrix")
+        write_common(g)
+        feat = g.create_group("features")
+        feat.create_dataset("id", data=np.array(
+            [f"ENSG{i:04d}".encode() for i in range(n_genes)]))
+        feat.create_dataset("name", data=np.array(
+            [f"G{i}".encode() for i in range(n_genes)]))
+        feat.create_dataset("feature_types", data=np.array(
+            [b"Gene Expression"] * n_genes))
+    d3 = read_10x_h5(p3)
+    assert d3.shape == (n_cells, n_genes)
+    np.testing.assert_array_equal(d3.X.toarray(), dense)
+    assert d3.var["gene_name"][1] == "G1"
+    assert d3.obs["barcode"][0] == "AAAC-0"
+
+    p2 = str(tmp_path / "v2.h5")
+    with h5py.File(p2, "w") as f:
+        g = f.create_group("GRCh38")
+        write_common(g)
+        g.create_dataset("genes", data=np.array(
+            [f"ENSG{i:04d}".encode() for i in range(n_genes)]))
+        g.create_dataset("gene_names", data=np.array(
+            [f"G{i}".encode() for i in range(n_genes)]))
+    d2 = read_10x_h5(p2)
+    np.testing.assert_array_equal(d2.X.toarray(), dense)
+    d2b = read_10x_h5(p2, genome="GRCh38")
+    np.testing.assert_array_equal(d2b.X.toarray(), dense)
+    with pytest.raises(ValueError, match="genome"):
+        read_10x_h5(p2, genome="mm10")
