@@ -321,3 +321,51 @@ def read_10x_h5(path: str, genome: str | None = None) -> CellData:
             shape=(n_cells, n_genes))
         obs = {"barcode": np.asarray(g["barcodes"]).astype(str)}
     return CellData(X, obs=obs, var=var)
+
+
+def read_loom(path: str, sparse: bool = True,
+              obs_names: str = "CellID",
+              var_names: str = "Gene") -> CellData:
+    """Read a ``.loom`` file (scanpy ``read_loom``) — the velocyto
+    output format whose ``/layers`` (``spliced``/``unspliced``/
+    ``ambiguous``) feed ``velocity.*`` directly.
+
+    Loom stores genes x cells; everything is transposed to
+    cells x genes here.  ``sparse=True`` converts the (chunked-dense)
+    matrix and layers to CSR on the fly, row-block by row-block, so
+    the full dense matrix never materialises in memory.
+    """
+    import h5py
+    import scipy.sparse as sp
+
+    def to_cells_by_genes(dset):
+        # loom matrices are (genes, cells); read in gene-row blocks
+        # and build the transposed CSR incrementally
+        g, c = dset.shape
+        if not sparse:
+            return np.asarray(dset[:], np.float32).T
+        blocks = []
+        step = max(1, min(g, 4096))
+        for lo in range(0, g, step):
+            blk = np.asarray(dset[lo: lo + step], np.float32)
+            blocks.append(sp.csr_matrix(blk.T))  # (cells, block_genes)
+        return sp.hstack(blocks, format="csr")
+
+    with h5py.File(path, "r") as f:
+        X = to_cells_by_genes(f["matrix"])
+        layers = {}
+        if "layers" in f:
+            for name in f["layers"]:
+                layers[name] = to_cells_by_genes(f["layers"][name])
+        obs, var = {}, {}
+        for attrs, out, names_key, rename in (
+                (f.get("col_attrs"), obs, obs_names, "cell_id"),
+                (f.get("row_attrs"), var, var_names, "gene_name")):
+            if attrs is None:
+                continue
+            for k in attrs:
+                v = np.asarray(attrs[k])
+                if v.dtype.kind in "SO":
+                    v = v.astype(str)
+                out[rename if k == names_key else k] = v
+    return CellData(X, obs=obs, var=var, layers=layers)

@@ -197,3 +197,47 @@ def test_read_10x_h5_both_layouts(tmp_path):
     np.testing.assert_array_equal(d2b.X.toarray(), dense)
     with pytest.raises(ValueError, match="genome"):
         read_10x_h5(p2, genome="mm10")
+
+
+def test_read_loom_with_velocity_layers(tmp_path):
+    """Loom (genes x cells + layers) -> CellData feeding velocity.*"""
+    import h5py
+
+    from sctools_tpu.data.io import read_loom
+
+    rng = np.random.default_rng(1)
+    g, c = 40, 25
+    spliced = (rng.random((g, c)) < 0.3) * rng.integers(1, 6, (g, c))
+    unspliced = (rng.random((g, c)) < 0.2) * rng.integers(1, 4, (g, c))
+    p = str(tmp_path / "v.loom")
+    with h5py.File(p, "w") as f:
+        f.create_dataset("matrix", data=spliced.astype(np.float32))
+        lay = f.create_group("layers")
+        lay.create_dataset("spliced", data=spliced.astype(np.float32))
+        lay.create_dataset("unspliced",
+                           data=unspliced.astype(np.float32))
+        ca = f.create_group("col_attrs")
+        ca.create_dataset("CellID", data=np.array(
+            [f"cell{i}".encode() for i in range(c)]))
+        ra = f.create_group("row_attrs")
+        ra.create_dataset("Gene", data=np.array(
+            [f"g{i}".encode() for i in range(g)]))
+
+    d = read_loom(p)
+    assert d.shape == (c, g)  # transposed to cells x genes
+    np.testing.assert_array_equal(d.X.toarray(), spliced.T)
+    np.testing.assert_array_equal(d.layers["unspliced"].toarray(),
+                                  unspliced.T)
+    assert d.obs["cell_id"][0] == "cell0"
+    assert d.var["gene_name"][2] == "g2"
+    # dense mode agrees
+    dd = read_loom(p, sparse=False)
+    np.testing.assert_array_equal(np.asarray(dd.X), spliced.T)
+    # and the layers drive the velocity family end-to-end
+    d = sct.apply("neighbors.knn",
+                  d.with_obsm(X_pca=np.asarray(
+                      d.X.toarray(), np.float32)),
+                  backend="cpu", k=5, use_rep="X_pca")
+    d = sct.apply("velocity.moments", d, backend="cpu")
+    d = sct.apply("velocity.estimate", d, backend="cpu")
+    assert d.layers["velocity"].shape == (c, g)
