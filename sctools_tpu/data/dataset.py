@@ -173,6 +173,46 @@ class CellData:
             {k: fetch(v, trim=True) for k, v in self.layers.items()},
         )
 
+    # ------------------------------------------------------------------
+    def __getitem__(self, key) -> "CellData":
+        """AnnData-style subsetting: ``d[cells]`` / ``d[:, genes]`` /
+        ``d[cells, genes]``.  Selectors: slices, boolean masks, int
+        index arrays, and (for the gene axis) gene-name arrays matched
+        against ``var["gene_name"]``.  Returns a new CellData with X,
+        obs/var, obsm/varm, and every layer sliced consistently; obsp
+        is dropped on cell subsets (pairwise graphs refer to dropped
+        rows — rebuild ``neighbors.knn``).  Works on host (scipy) and
+        device (SparseCells gather) data alike."""
+        if isinstance(key, tuple):
+            if len(key) > 2:
+                raise IndexError("CellData supports at most 2 axes")
+            ckey = key[0]
+            gkey = key[1] if len(key) > 1 else slice(None)
+        else:
+            ckey, gkey = key, slice(None)
+        out = self
+        gidx = _normalize_axis_key(gkey, self.n_genes,
+                                   names=self.var.get("gene_name"),
+                                   axis="gene")
+        cidx = _normalize_axis_key(ckey, self.n_cells, names=None,
+                                   axis="cell")
+        on_host = not isinstance(self.X, SparseCells)
+        if gidx is not None:
+            if on_host:
+                out = _host_subset_genes(out, gidx)
+            else:
+                from ..ops.hvg import select_genes_device
+
+                out = select_genes_device(out, gidx)
+        if cidx is not None:
+            if on_host:
+                out = _host_subset_cells(out, cidx)
+            else:
+                from ..ops.qc import select_cells_device
+
+                out = select_cells_device(out, cidx)
+        return out
+
     def __repr__(self):
         def ks(d):
             return ", ".join(sorted(d)) or "-"
@@ -189,3 +229,88 @@ class CellData:
 
 def _is_arraylike(v) -> bool:
     return isinstance(v, (np.ndarray, jax.Array)) or np.isscalar(v)
+
+
+def _normalize_axis_key(key, n: int, names, axis: str):
+    """Selector → int index array, or None for the full-axis no-op."""
+    if isinstance(key, slice):
+        if key == slice(None):
+            return None
+        return np.arange(*key.indices(n))
+    if isinstance(key, (int, np.integer)):
+        if not -n <= key < n:
+            raise IndexError(f"{axis} index {key} out of range for {n}")
+        return np.array([key % n])
+    arr = np.asarray(key)
+    if arr.size == 0:
+        # AnnData parity: an empty selection yields a 0-row/0-col view
+        return np.empty(0, np.int64)
+    if arr.ndim != 1:
+        raise IndexError(
+            f"{axis} selector must be 1-D, got shape {arr.shape}")
+    if arr.dtype.kind == "b":
+        if len(arr) < n:
+            raise IndexError(
+                f"boolean {axis} mask has length {len(arr)}, "
+                f"expected >= {n}")
+        # per-cell arrays from TPU ops carry padded rows — a mask
+        # built from them is longer than n_cells; extra entries refer
+        # to padding rows and are dropped
+        return np.where(arr[:n])[0]
+    if arr.dtype.kind in "iu":
+        if arr.max() >= n or arr.min() < -n:
+            raise IndexError(f"{axis} indices out of range for {n}")
+        return arr % n
+    if arr.dtype.kind in "US":
+        if names is None:
+            raise KeyError(
+                "name-based selection is only supported on the gene "
+                "axis (via var['gene_name']); select cells by mask or "
+                "index instead" if axis == "cell" else
+                "gene-name selection needs var['gene_name']")
+        pos = {g: i for i, g in enumerate(np.asarray(names).astype(str))}
+        missing = [g for g in arr.astype(str) if g not in pos]
+        if missing:
+            raise KeyError(f"unknown {axis} names: {missing[:5]}")
+        return np.array([pos[g] for g in arr.astype(str)])
+    raise TypeError(f"unsupported {axis} selector {type(key).__name__}")
+
+
+def _slice_aligned(d: dict, idx: np.ndarray) -> dict:
+    return {k: (np.asarray(v)[idx] if getattr(np.asarray(v), "ndim", 0)
+                else v) for k, v in d.items()}
+
+
+def _host_subset_cells(data: "CellData", idx: np.ndarray) -> "CellData":
+    """Pure-host row subset (numpy/scipy stay numpy/scipy; no JAX)."""
+    import scipy.sparse as sp
+
+    def rows(M):
+        return M.tocsr()[idx] if sp.issparse(M) else np.asarray(M)[idx]
+
+    return CellData(rows(data.X),
+                    obs=_slice_aligned(data.obs, idx),
+                    var=dict(data.var),
+                    obsm=_slice_aligned(data.obsm, idx),
+                    varm=dict(data.varm),
+                    obsp={},  # pairwise graphs refer to dropped rows
+                    uns=dict(data.uns),
+                    layers={k: rows(v) for k, v in data.layers.items()})
+
+
+def _host_subset_genes(data: "CellData", idx: np.ndarray) -> "CellData":
+    """Pure-host column subset."""
+    import scipy.sparse as sp
+
+    def cols(M):
+        return (M.tocsc()[:, idx].tocsr() if sp.issparse(M)
+                else np.asarray(M)[:, idx])
+
+    return CellData(cols(data.X),
+                    obs=dict(data.obs),
+                    var=_slice_aligned(data.var, idx),
+                    obsm=dict(data.obsm),
+                    varm=_slice_aligned(data.varm, idx),
+                    obsp=dict(data.obsp),
+                    uns=dict(data.uns),
+                    layers={k: cols(v) for k, v in data.layers.items()})

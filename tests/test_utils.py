@@ -182,3 +182,100 @@ def test_stream_sync_auto_is_off_on_cpu():
 
     assert config.stream_sync == "auto"
     assert config.stream_sync_enabled() is False
+
+
+def test_celldata_getitem_slicing():
+    """AnnData-style d[cells], d[:, genes], d[cells, genes]."""
+    import scipy.sparse as sp
+
+    from sctools_tpu.data.dataset import CellData
+
+    rng = np.random.default_rng(0)
+    dense = (rng.random((20, 10)) < 0.4) * rng.integers(1, 5, (20, 10))
+    d = CellData(sp.csr_matrix(dense.astype(np.float32)),
+                 obs={"depth": np.arange(20.0),
+                      "name": np.array([f"c{i}" for i in range(20)])},
+                 var={"gene_name": np.array([f"g{i}" for i in range(10)])},
+                 obsm={"X_pca": rng.random((20, 3))},
+                 layers={"counts": sp.csr_matrix(
+                     dense.astype(np.float32))})
+
+    # boolean cell mask
+    mask = np.asarray(d.obs["depth"]) > 14.0
+    sub = d[mask]
+    assert sub.shape == (5, 10)
+    np.testing.assert_array_equal(sub.X.toarray(), dense[mask])
+    np.testing.assert_array_equal(sub.obs["depth"], np.arange(15., 20.))
+    assert list(sub.obs["name"]) == [f"c{i}" for i in range(15, 20)]
+    np.testing.assert_array_equal(sub.layers["counts"].toarray(),
+                                  dense[mask])
+    assert sub.obsm["X_pca"].shape == (5, 3)
+
+    # gene names + int list cells
+    sub2 = d[[0, 3], ["g2", "g5"]]
+    np.testing.assert_array_equal(sub2.X.toarray(),
+                                  dense[[0, 3]][:, [2, 5]])
+    assert list(sub2.var["gene_name"]) == ["g2", "g5"]
+
+    # slices, single int, negative
+    assert d[2:5].shape == (3, 10)
+    assert d[-1].shape == (1, 10)
+    assert d[:, 1:4].shape == (20, 3)
+
+    # device round-trip gives identical values
+    dev = d.device_put()
+    sub_d = dev[mask, ["g2", "g5"]].to_host()
+    np.testing.assert_array_equal(sub_d.X.toarray(),
+                                  dense[mask][:, [2, 5]])
+
+    # errors
+    import pytest as _pt
+
+    with _pt.raises(IndexError):
+        d[np.ones(7, bool)]
+    with _pt.raises(KeyError):
+        d[:, ["nope"]]
+    with _pt.raises(IndexError):
+        d[99]
+
+
+def test_celldata_getitem_review_regressions():
+    """Review findings: padded masks, host purity, empty and 2-D
+    selectors, cell-name error message."""
+    import scipy.sparse as sp
+
+    from sctools_tpu.data.dataset import CellData
+
+    rng = np.random.default_rng(1)
+    dense = (rng.random((12, 6)) < 0.5) * rng.integers(1, 4, (12, 6))
+    d = CellData(sp.csr_matrix(dense.astype(np.float32)),
+                 obs={"t": np.arange(12.0)})
+
+    # host subsetting stays host (no jax types)
+    sub = d[np.arange(12) < 4]
+    assert sp.issparse(sub.X)
+    assert isinstance(np.asarray(sub.obs["t"]), np.ndarray)
+    import jax as _jax
+
+    assert not isinstance(sub.obs["t"], _jax.Array)
+
+    # padded mask (device idiom): longer than n_cells is accepted
+    dev = d.device_put()
+    padded_mask = np.zeros(dev.X.rows_padded, bool)
+    padded_mask[:3] = True
+    sub_d = dev[padded_mask]
+    assert sub_d.n_cells == 3
+
+    # empty selections give empty views, not TypeError
+    assert d[[]].shape == (0, 6)
+    assert d[:, np.array([], dtype=np.int64)].shape == (12, 0)
+
+    # 2-D selector is rejected
+    import pytest as _pt
+
+    with _pt.raises(IndexError, match="1-D"):
+        d[np.array([[0, 1], [2, 3]])]
+
+    # cell-name selection gets a sensible message
+    with _pt.raises(KeyError, match="gene axis"):
+        d[["AAACCTG-1"]]
