@@ -29,8 +29,14 @@ def _mito_mask(data: CellData):
 
 
 @register("qc.per_cell_metrics", backend="tpu")
-def per_cell_metrics_tpu(data: CellData, mito_mask=None) -> CellData:
-    """Adds obs: ``n_genes``, ``total_counts``, ``pct_counts_mt``."""
+def per_cell_metrics_tpu(data: CellData, mito_mask=None,
+                         percent_top: tuple = ()) -> CellData:
+    """Adds obs: ``n_genes``, ``total_counts``, ``pct_counts_mt``;
+    each N in ``percent_top`` adds ``pct_counts_in_top_N_genes``
+    (scanpy ``calculate_qc_metrics`` semantics: share of a cell's
+    counts captured by its N highest-count genes — opt-in, e.g.
+    ``percent_top=(50, 100)``).  On the ELL layout the per-cell top-N
+    is one ``lax.top_k`` over the capacity axis."""
     X = data.X
     if mito_mask is None:
         mito_mask = _mito_mask(data)
@@ -55,13 +61,22 @@ def per_cell_metrics_tpu(data: CellData, mito_mask=None) -> CellData:
         else:
             mito_counts = jnp.zeros_like(total)
     pct_mt = 100.0 * mito_counts / jnp.maximum(total, 1e-12)
+    extra = {}
+    for N in percent_top:
+        vals = X.data if isinstance(data.X, SparseCells) else X
+        k_eff = min(int(N), vals.shape[1])
+        top, _ = jax.lax.top_k(vals, k_eff)
+        extra[f"pct_counts_in_top_{int(N)}_genes"] = (
+            100.0 * jnp.sum(top, axis=1) / jnp.maximum(total, 1e-12))
     return data.with_obs(
-        n_genes=n_genes, total_counts=total, pct_counts_mt=pct_mt
+        n_genes=n_genes, total_counts=total, pct_counts_mt=pct_mt,
+        **extra,
     )
 
 
 @register("qc.per_cell_metrics", backend="cpu")
-def per_cell_metrics_cpu(data: CellData, mito_mask=None) -> CellData:
+def per_cell_metrics_cpu(data: CellData, mito_mask=None,
+                         percent_top: tuple = ()) -> CellData:
     import scipy.sparse as sp
 
     X = data.X
@@ -86,9 +101,26 @@ def per_cell_metrics_cpu(data: CellData, mito_mask=None) -> CellData:
             if mito_mask is not None else np.zeros_like(total)
         )
     pct_mt = 100.0 * mito_counts / np.maximum(total, 1e-12)
+    extra = {}
+    if percent_top:
+        Xc = data.X.tocsr() if sp.issparse(data.X) else None
+        for N in percent_top:
+            N = int(N)
+            tops = np.zeros(len(total))
+            for i in range(len(total)):
+                row = (Xc.data[Xc.indptr[i]:Xc.indptr[i + 1]]
+                       if Xc is not None else X[i][X[i] > 0])
+                if len(row) <= N:
+                    tops[i] = row.sum()
+                else:
+                    tops[i] = np.partition(row, len(row) - N)[-N:].sum()
+            extra[f"pct_counts_in_top_{N}_genes"] = (
+                100.0 * tops / np.maximum(total, 1e-12)
+            ).astype(np.float32)
     return data.with_obs(
         n_genes=n_genes, total_counts=total,
         pct_counts_mt=pct_mt.astype(np.float32),
+        **extra,
     )
 
 

@@ -229,3 +229,27 @@ def test_hvg_seurat_alias_and_cell_ranger():
     # a different ranking than the seurat flavor (median/MAD vs
     # mean/std in different bins)
     assert (hc != np.asarray(a.var["highly_variable"])).any()
+
+
+def test_qc_percent_top_genes():
+    from sctools_tpu.data.synthetic import synthetic_counts
+
+    d = synthetic_counts(200, 500, density=0.1, n_clusters=2, seed=3)
+    cpu = sct.apply("qc.per_cell_metrics", d, backend="cpu",
+                    percent_top=(10, 50))
+    tpu = sct.apply("qc.per_cell_metrics", d.device_put(),
+                    backend="tpu", percent_top=(10, 50))
+    for N in (10, 50):
+        col = f"pct_counts_in_top_{N}_genes"
+        c = np.asarray(cpu.obs[col], np.float64)
+        t = np.asarray(tpu.obs[col], np.float64)[:200]
+        np.testing.assert_allclose(t, c, rtol=1e-4, atol=1e-3)
+        assert (c > 0).all() and (c <= 100.0 + 1e-9).all()
+    # top-10 captures less than top-50, never more
+    c10 = np.asarray(cpu.obs["pct_counts_in_top_10_genes"])
+    c50 = np.asarray(cpu.obs["pct_counts_in_top_50_genes"])
+    assert (c10 <= c50 + 1e-6).all()
+    # a cell with fewer than N genes reaches exactly 100%
+    few = np.asarray(cpu.obs["n_genes"]) <= 10
+    if few.any():
+        np.testing.assert_allclose(c10[few], 100.0, rtol=1e-6)
