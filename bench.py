@@ -611,10 +611,15 @@ def run_recall(jax, scores, idx_parts, n, n_queries=None):
     from sctools_tpu.ops.knn import recall_at_k
 
     if n_queries is None:
-        # the f32 oracle gemm costs queries × n on ONE host core
-        # (~2 min at 4096×1.3M) — halve the sample at atlas scale;
-        # 2048×10 neighbour checks still bound recall to ±~0.2%
-        n_queries = 2048 if n >= 1_000_000 else 4096
+        # size the sample by the ORACLE's measured wall rate, not a
+        # guess: r4 measured 59 s for 4096 queries x 131k x 50 on this
+        # 1-core host (~4.6e8 madds/s including the top-k merges).
+        # Target ~150 s of oracle => 7e10 madds; at 1.3M x 50 that is
+        # ~1k queries, whose 10k neighbour checks still bound
+        # recall@10 to +-0.1% — statistics, not coverage, set the
+        # floor of 512
+        d = int(np.asarray(scores).shape[1])
+        n_queries = int(np.clip(7e10 // max(n * d, 1), 512, 4096))
     rng = np.random.default_rng(1)
     # only sample queries whose kNN rows were actually computed
     covered = np.concatenate([np.arange(off, off + nq)
