@@ -394,12 +394,18 @@ def run_kernel_bench(jax, on_tpu):
     pts = jax.device_put(pts)
     out = {"n": n, "d": d, "k": k}
     flops = 2.0 * n * n * d
-    impls = (["xla", "xla_approx", "pallas", "pallas_binned"] if on_tpu
+    impls = (["xla", "xla_cb8192", "xla_approx", "pallas",
+              "pallas_binned"] if on_tpu
              else ["xla", "xla_approx"])
     results = {}
     for impl in impls:
         knobs = (dict(knn_impl="xla", knn_coarse="approx")
                  if impl == "xla_approx"
+                 # candidate-block sweep: 2048 (default) vs 8192 — at
+                 # 1.3M candidates the scan runs 640 vs 160 steps and
+                 # nobody has measured which wins on hardware yet
+                 else dict(knn_impl="xla", col_block=8192)
+                 if impl == "xla_cb8192"
                  else dict(knn_impl="pallas") if impl.startswith("pallas")
                  else dict(knn_impl=impl))
 
