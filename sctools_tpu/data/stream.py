@@ -403,12 +403,18 @@ def stream_hvg(stats: dict, n_top: int = 2000,
     ``"dispersion"`` is the one-pass ranking from the normalised-matrix
     moments (no second pass, no ``src`` needed).
     """
-    if flavor == "dispersion":
+    if flavor in ("dispersion", "seurat"):
         from ..ops.hvg import _dispersion_scores
 
         scores = _dispersion_scores(stats["gene_mean"].astype(np.float64),
                                     stats["gene_var"].astype(np.float64),
                                     np)
+    elif flavor == "cell_ranger":
+        # needs only the pass-1 moments — free at streaming scale
+        from ..ops.hvg import _cell_ranger_scores
+
+        scores = _cell_ranger_scores(stats["gene_mean"],
+                                     stats["gene_var"])
     elif flavor == "seurat_v3":
         from ..ops.hvg import (_fit_mean_var_trend,
                                _seurat_v3_scores_from_stats)

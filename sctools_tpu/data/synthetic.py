@@ -173,9 +173,15 @@ def ell_shard_device(key, cdfs, n_valid, *, rows: int, capacity: int,
     host→device transfer — essential on bench hosts with one CPU core
     and a tunneled TPU).
 
-    Every valid row has exactly ``capacity`` stored draws (duplicate
-    gene ids act as summed counts — harmless for the linear ops, see
-    synthetic_ell); rows >= ``n_valid`` are zeroed/sentineled padding.
+    Gene ids are drawn with replacement, then duplicate slots within a
+    row are MERGED on device (sort + run-total + sentinel the rest):
+    duplicates are harmless for linear ops (X@V sums slot
+    contributions either way) but the streaming pipeline applies
+    log1p PER SLOT, and log1p(a)+log1p(b) != log1p(a+b) — unmerged
+    duplicates made the device-generated "matrix" disagree with its
+    own CSR export wherever a nonlinear op ran (r4 session-2 finding:
+    streamed HVG moments off by 2x on hot genes).
+    Rows >= ``n_valid`` are zeroed/sentineled padding.
     Counts are geometric(p=0.4); gene ids are inverse-CDF draws from
     the row's cluster program.  Deterministic in ``key`` — re-iterating
     a source regenerates bit-identical shards.
@@ -207,6 +213,21 @@ def _ell_shard_device_jit(key, cdfs, n_valid, *, rows, capacity, n_genes):
     row_ok = jnp.arange(rows) < n_valid
     idx = jnp.where(row_ok[:, None], idx, n_genes)
     vals = jnp.where(row_ok[:, None], vals, 0.0)
+    # merge duplicate gene ids within each row (see docstring): sort
+    # slots by gene, sum each run into its first slot, sentinel the
+    # rest.  Counts are small integers, so the f32 run sums are exact.
+    order = jnp.argsort(idx, axis=1)
+    si = jnp.take_along_axis(idx, order, axis=1)
+    sv = jnp.take_along_axis(vals, order, axis=1)
+    first = jnp.concatenate(
+        [jnp.ones((rows, 1), bool), si[:, 1:] != si[:, :-1]], axis=1)
+    run_id = jnp.cumsum(first, axis=1) - 1
+    totals = jax.vmap(
+        lambda v, r: jax.ops.segment_sum(v, r, num_segments=capacity)
+    )(sv, run_id)
+    idx = jnp.where(first, si, n_genes)
+    vals = jnp.where(first & (idx < n_genes),
+                     jnp.take_along_axis(totals, run_id, axis=1), 0.0)
     return idx, vals, labels
 
 

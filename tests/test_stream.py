@@ -224,3 +224,34 @@ def test_prefetch_abandoned_consumer_unblocks_producer():
             break
         _time.sleep(0.1)
     assert finished.is_set(), "producer thread leaked after abandon"
+
+
+def test_stream_hvg_moment_only_flavors_match_in_memory():
+    """seurat/cell_ranger flavors need only pass-1 moments: the
+    streamed ranking must match the in-memory hvg.select ranking."""
+    from sctools_tpu.data.stream import stream_hvg, stream_stats
+    from sctools_tpu.data.synthetic import DeviceSyntheticSource
+
+    src = DeviceSyntheticSource(6000, 1200, capacity=128,
+                                shard_rows=2048, seed=4,
+                                materialize=True)
+    stats = stream_stats(src)
+    # in-memory oracle on the SAME matrix
+    import scipy.sparse as sp
+
+    from sctools_tpu.data.dataset import CellData
+
+    mats = [sh.to_scipy_csr() for _, sh in src]
+    X = sp.vstack(mats, format="csr")[:6000]
+    d = CellData(X)
+    d = sct.apply("normalize.library_size", d, backend="cpu",
+                  target_sum=1e4)
+    d = sct.apply("normalize.log1p", d, backend="cpu")
+    for flavor in ("seurat", "cell_ranger"):
+        got = stream_hvg(stats, n_top=200, flavor=flavor)
+        want = sct.apply("hvg.select", d, backend="cpu", n_top=200,
+                         flavor=flavor)
+        want_idx = np.sort(np.where(
+            np.asarray(want.var["highly_variable"]))[0])
+        overlap = len(set(got.tolist()) & set(want_idx.tolist())) / 200
+        assert overlap > 0.97, (flavor, overlap)
