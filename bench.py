@@ -624,7 +624,7 @@ def run_recall(jax, scores, idx_parts, n, n_queries=None):
         # ~1k queries, whose 10k neighbour checks still bound
         # recall@10 to +-0.1% — statistics, not coverage, set the
         # floor of 512
-        d = int(np.asarray(scores).shape[1])
+        d = int(scores.shape[1])  # shape only — no full-matrix fetch
         n_queries = int(np.clip(7e10 // max(n * d, 1), 512, 4096))
     rng = np.random.default_rng(1)
     # only sample queries whose kNN rows were actually computed

@@ -5,7 +5,8 @@ network in this environment):
 
 1. two "sequencing runs" merged with ``sct.concat`` (outer gene join,
    per-cell ``sample`` label),
-2. the classic Seurat recipe as a one-call preprocessing op,
+2. normalize -> log1p -> HVG-subset preprocessing (a chain the
+   query can replay exactly — ingest's contract),
 3. batch correction three ways — Harmony, fastMNN, BBKNN — all fed by
    the same label column concat wrote,
 4. annotation transfer from the integrated "atlas" onto a held-out
@@ -38,9 +39,17 @@ def main():
                         keys=["runA", "runB"])
     print(f"merged: {merged.n_cells} cells x {merged.n_genes} genes")
 
-    # --- 2. one-call Seurat preprocessing --------------------------
-    ds = sct.apply("recipe.seurat", merged.device_put(), backend="tpu",
-                   n_top_genes=1000, min_genes=10)
+    # --- 2. preprocessing ------------------------------------------
+    # NOT recipe.seurat here: its scale() step would bake per-gene
+    # mean/std into the PCA loadings, and ingest's contract (step 4)
+    # requires the query to be preprocessed IDENTICALLY — normalize +
+    # log1p + HVG subset is a chain the query can replay exactly
+    ds = sct.Pipeline([
+        ("util.snapshot_layer", {"layer": "counts"}),
+        ("normalize.library_size", {"target_sum": 1e4}),
+        ("normalize.log1p", {}),
+        ("hvg.select", {"n_top": 1000, "subset": True}),
+    ]).run(merged.device_put(), backend="tpu")
     ds = sct.apply("pca.randomized", ds, backend="tpu", n_components=30)
 
     # --- 3. integrate three ways -----------------------------------
@@ -79,10 +88,9 @@ def main():
           f"median confidence {np.median(conf):.2f}")
 
     # --- 5. RNA velocity from spliced/unspliced layers -------------
-    Xa = host_atlas.X  # dense after recipe.seurat's scale step
+    Xa = host_atlas.X
     spliced = np.asarray(Xa.todense() if hasattr(Xa, "todense") else Xa,
                          np.float32)
-    spliced = np.maximum(spliced, 0.0)  # scale() centres; counts-like
     gamma_true = rng.uniform(0.3, 1.2, spliced.shape[1]).astype(np.float32)
     unspliced = gamma_true * spliced + rng.normal(
         0, 0.05, spliced.shape).astype(np.float32)

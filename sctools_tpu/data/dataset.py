@@ -249,13 +249,14 @@ def _normalize_axis_key(key, n: int, names, axis: str):
         raise IndexError(
             f"{axis} selector must be 1-D, got shape {arr.shape}")
     if arr.dtype.kind == "b":
-        if len(arr) < n:
+        if len(arr) != n and not (axis == "cell" and len(arr) > n):
+            # only the CELL axis accepts longer masks (per-cell arrays
+            # from TPU ops carry padded rows; the extra entries refer
+            # to padding and are dropped) — a long mask on the gene
+            # axis is a wrong-axis bug, not an idiom
             raise IndexError(
                 f"boolean {axis} mask has length {len(arr)}, "
-                f"expected >= {n}")
-        # per-cell arrays from TPU ops carry padded rows — a mask
-        # built from them is longer than n_cells; extra entries refer
-        # to padding rows and are dropped
+                f"expected {n}")
         return np.where(arr[:n])[0]
     if arr.dtype.kind in "iu":
         if arr.max() >= n or arr.min() < -n:

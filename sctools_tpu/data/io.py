@@ -295,9 +295,13 @@ def read_10x_h5(path: str, genome: str | None = None) -> CellData:
             var = {
                 "gene_ids": np.asarray(feat["id"]).astype(str),
                 "gene_name": np.asarray(feat["name"]).astype(str),
-                "feature_type": np.asarray(
-                    feat["feature_types"]).astype(str),
             }
+            # the CellRanger v3 spec names it 'feature_type'
+            # (singular); some writers emit the plural
+            for ft in ("feature_type", "feature_types"):
+                if ft in feat:
+                    var["feature_type"] = np.asarray(feat[ft]).astype(str)
+                    break
         else:
             groups = [k for k in f.keys()
                       if isinstance(f[k], h5py.Group)]

@@ -43,18 +43,23 @@ def _mutual_pairs(idx_b2r, idx_r2b):
     nR = idx_r2b.shape[0]
     # vectorised edge-set intersection on packed int64 keys b*nR + r
     # (a python tuple-set would cost O(n*k) interpreter time and
-    # hundreds of MB at atlas scale)
+    # hundreds of MB at atlas scale).  -1 padding slots (k larger than
+    # the candidate set) must be dropped BEFORE packing: b*nR + (-1)
+    # would alias (b-1)*nR + (nR-1) and fabricate pairs
     fwd = (np.repeat(np.arange(nB, dtype=np.int64), k) * nR
            + idx_b2r.ravel().astype(np.int64))
+    fwd = fwd[idx_b2r.ravel() >= 0]
     rev = (idx_r2b.ravel().astype(np.int64) * nR
            + np.repeat(np.arange(nR, dtype=np.int64),
                        idx_r2b.shape[1]))
+    rev = rev[idx_r2b.ravel() >= 0]
     mutual = np.intersect1d(fwd, rev, assume_unique=False)
     return mutual // nR, mutual % nR
 
 
 def _correct_one(ref, bat, k, sigma, knn):
     """Correction matrix (nB, d) moving ``bat`` toward ``ref``."""
+    k = min(k, len(ref), len(bat))  # tiny batches: no padded -1 ids
     idx_b2r, _ = knn(bat, ref, k)
     idx_r2b, _ = knn(ref, bat, k)
     bm, rm = _mutual_pairs(np.asarray(idx_b2r)[: len(bat)],

@@ -78,3 +78,23 @@ def test_mnn_validates():
     one = d.with_obs(batch=np.full(400, "A"))
     with pytest.raises(ValueError, match="at least 2"):
         sct.apply("integrate.mnn", one, backend="cpu")
+
+
+def test_mnn_tiny_batch_no_padding_alias():
+    """k larger than a batch: -1 padded neighbour slots must not
+    fabricate mutual pairs (the packed-key aliasing regression)."""
+    rng = np.random.default_rng(3)
+    Z = rng.normal(0, 2, (30, 6)).astype(np.float32)
+    batch = np.array(["A"] * 22 + ["B"] * 8)
+    Z[batch == "B"] += 1.0
+    d = CellData(np.zeros((30, 1), np.float32), obs={"batch": batch},
+                 obsm={"X_pca": Z})
+    out = sct.apply("integrate.mnn", d, backend="cpu", k=20)
+    Z1 = np.asarray(out.obsm["X_mnn"], np.float64)
+    # reference batch untouched; corrected batch moved toward it
+    np.testing.assert_allclose(Z1[batch == "A"],
+                               Z[batch == "A"].astype(np.float64),
+                               atol=1e-5)
+    g0 = np.linalg.norm(Z[batch == "A"].mean(0) - Z[batch == "B"].mean(0))
+    g1 = np.linalg.norm(Z1[batch == "A"].mean(0) - Z1[batch == "B"].mean(0))
+    assert g1 < g0

@@ -279,3 +279,15 @@ def test_celldata_getitem_review_regressions():
     # cell-name selection gets a sensible message
     with _pt.raises(KeyError, match="gene axis"):
         d[["AAACCTG-1"]]
+
+
+def test_getitem_gene_axis_rejects_long_mask():
+    import scipy.sparse as sp
+
+    from sctools_tpu.data.dataset import CellData
+
+    d = CellData(sp.csr_matrix(np.ones((10, 4), np.float32)))
+    import pytest as _pt
+
+    with _pt.raises(IndexError, match="gene mask"):
+        d[:, np.ones(10, bool)]
