@@ -30,7 +30,7 @@ from .config import config, configure
 from .data import CellData, SparseCells
 from .data.concat import concat
 from .data.io import (from_dense, from_scipy, read_10x_h5, read_10x_mtx,
-                      read_h5ad, read_loom, write_h5ad)
+                      read_h5ad, read_loom, write_h5ad, write_loom)
 from .registry import Pipeline, Transform, apply, backends, get, names, register
 
 __version__ = "0.1.0"
@@ -39,5 +39,6 @@ __all__ = [
     "CellData", "SparseCells", "Pipeline", "Transform", "apply", "register",
     "get", "names", "backends", "config", "configure",
     "read_h5ad", "write_h5ad", "read_10x_mtx", "read_10x_h5", "read_loom",
+    "write_loom",
     "from_scipy", "from_dense",
 ]

@@ -373,3 +373,38 @@ def read_loom(path: str, sparse: bool = True,
                     v = v.astype(str)
                 out[rename if k == names_key else k] = v
     return CellData(X, obs=obs, var=var, layers=layers)
+
+
+def write_loom(data: CellData, path: str) -> None:
+    """Write a ``.loom`` file (genes x cells, layers included) —
+    round-trips with :func:`read_loom`.  Dense on disk (the loom
+    format); row/col attrs carry var/obs columns."""
+    import h5py
+    import scipy.sparse as sp
+
+    def dense_T(M):
+        if isinstance(M, SparseCells):
+            M = M.to_scipy_csr()
+        if sp.issparse(M):
+            M = M.toarray()
+        return np.asarray(M, np.float32).T  # genes x cells
+
+    n = data.n_cells
+    with h5py.File(path, "w") as f:
+        f.create_dataset("matrix", data=dense_T(data.X))
+        if data.layers:
+            lay = f.create_group("layers")
+            for k, v in data.layers.items():
+                lay.create_dataset(k, data=dense_T(v))
+        ca = f.create_group("col_attrs")
+        for k, v in data.obs.items():
+            v = np.asarray(v)[:n]
+            ca.create_dataset("CellID" if k == "cell_id" else k,
+                              data=(v.astype("S") if v.dtype.kind
+                                    in "US" else v))
+        ra = f.create_group("row_attrs")
+        for k, v in data.var.items():
+            v = np.asarray(v)
+            ra.create_dataset("Gene" if k == "gene_name" else k,
+                              data=(v.astype("S") if v.dtype.kind
+                                    in "US" else v))

@@ -241,3 +241,25 @@ def test_read_loom_with_velocity_layers(tmp_path):
     d = sct.apply("velocity.moments", d, backend="cpu")
     d = sct.apply("velocity.estimate", d, backend="cpu")
     assert d.layers["velocity"].shape == (c, g)
+
+
+def test_loom_round_trip(tmp_path):
+    from sctools_tpu.data.io import read_loom, write_loom
+
+    rng = np.random.default_rng(2)
+    dense = ((rng.random((15, 8)) < 0.4)
+             * rng.integers(1, 5, (15, 8))).astype(np.float32)
+    d = sct.CellData(sp.csr_matrix(dense),
+                     obs={"cell_id": np.array(
+                         [f"c{i}" for i in range(15)])},
+                     var={"gene_name": np.array(
+                         [f"g{i}" for i in range(8)])},
+                     layers={"spliced": sp.csr_matrix(dense * 2)})
+    p = str(tmp_path / "rt.loom")
+    write_loom(d, p)
+    back = read_loom(p)
+    np.testing.assert_array_equal(back.X.toarray(), dense)
+    np.testing.assert_array_equal(back.layers["spliced"].toarray(),
+                                  dense * 2)
+    assert list(back.obs["cell_id"]) == [f"c{i}" for i in range(15)]
+    assert list(back.var["gene_name"]) == [f"g{i}" for i in range(8)]
