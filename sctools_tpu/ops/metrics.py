@@ -1,0 +1,169 @@
+"""``metrics.morans_i`` / ``metrics.gearys_c`` — spatial/graph
+autocorrelation per gene.
+
+Capability parity: scanpy ``sc.metrics.morans_i`` and
+``sc.metrics.gearys_c`` (reference source unavailable — SURVEY.md §0;
+the public formulas are the contract), computed over the kNN
+connectivities graph this framework already builds:
+
+* Moran's I_g  = (n / S0) · Σ_i z_i (Wz)_i / Σ_i z_i²
+* Geary's C_g = ((n−1) / 2S0) · Σ_ij w_ij (x_i − x_j)² / Σ_i z_i²
+
+with z the per-gene centered values and S0 = Σ w_ij.  The pair term
+expands to matvecs — Σ_ij w_ij (x_i−x_j)² = Σ_i r_i x_i² + Σ_j c_j x_j²
+− 2 Σ_i x_i (Wx)_i with r/c the row/col weight sums — so both metrics
+are three k-sparse gather-matvecs over a (n, G_chunk) value block,
+chunked across genes.  No (n, n) object, no scatter.
+
+Accepts dense X, a layer, or an obsm basis via ``use_rep``; sparse X
+is densified per gene-chunk only.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.dataset import CellData
+from ..data.sparse import SparseCells
+from ..registry import register
+
+_GCHUNK = 256  # (n, k, chunk) gather tile stays modest at atlas n
+
+
+def _edge_arrays(data: CellData, xp):
+    if "knn_indices" not in data.obsp:
+        raise KeyError("metrics: run neighbors.knn (+ "
+                       "graph.connectivities) first")
+    n = data.n_cells
+    idx = np.asarray(data.obsp["knn_indices"])[:n]
+    if "connectivities" in data.obsp:
+        w = np.asarray(data.obsp["connectivities"], np.float64)[:n]
+    else:
+        w = np.ones_like(idx, np.float64)
+    w = np.where(idx >= 0, w, 0.0)
+    return idx, w
+
+
+def _values_chunk(data: CellData, use_rep, lo, hi, xp):
+    n = data.n_cells
+    if use_rep == "X":
+        X = data.X
+        if isinstance(X, SparseCells):
+            from .hvg import subset_genes_sparse
+
+            sub = subset_genes_sparse(X, np.arange(lo, hi))
+            return sub.to_dense()[:n]
+        if hasattr(X, "tocsc"):
+            return np.asarray(X.tocsc()[:, lo:hi].todense(), np.float64)
+        return xp.asarray(X)[:n, lo:hi]
+    M = data.layers.get(use_rep, data.obsm.get(use_rep))
+    if M is None:
+        raise KeyError(f"metrics: no layer/obsm named {use_rep!r}")
+    if isinstance(M, SparseCells):
+        from .hvg import subset_genes_sparse
+
+        return subset_genes_sparse(M, np.arange(lo, hi)).to_dense()[:n]
+    if hasattr(M, "tocsc"):
+        return np.asarray(M.tocsc()[:, lo:hi].todense(), np.float64)
+    return xp.asarray(M)[:n, lo:hi]
+
+
+@partial(jax.jit, static_argnames=())
+def _auto_terms(idx, w, Xc, colsum_w):
+    """Per gene: (num_moran, num_geary, denom) for one value block.
+    The edge sums ride graph.knn_matvec (gather-weight-sum; weights
+    already zeroed on -1 slots by the caller)."""
+    from .graph import knn_matvec
+
+    z = Xc - jnp.mean(Xc, axis=0, keepdims=True)
+    Wz = knn_matvec(idx, w, z)
+    num_i = jnp.sum(z * Wz, axis=0)
+    r = jnp.sum(w, axis=1)
+    Wx = knn_matvec(idx, w, Xc)
+    num_c = (jnp.sum(r[:, None] * Xc * Xc, axis=0)
+             + jnp.sum(colsum_w[:, None] * Xc * Xc, axis=0)
+             - 2.0 * jnp.sum(Xc * Wx, axis=0))
+    denom = jnp.sum(z * z, axis=0)
+    return num_i, num_c, denom
+
+
+def _metrics(data: CellData, use_rep, device):
+    idx, w = _edge_arrays(data, np)
+    n = len(idx)
+    S0 = float(w.sum())
+    colsum = np.zeros(n)
+    np.add.at(colsum, np.where(idx >= 0, idx, 0).ravel(),
+              w.ravel())
+    G = (data.n_genes if use_rep == "X" or use_rep in data.layers
+         else np.asarray(data.obsm[use_rep]).shape[1])
+    mor = np.zeros(G)
+    gea = np.zeros(G)
+    if device:
+        idx_d = jnp.asarray(idx)
+        w_d = jnp.asarray(w, jnp.float32)
+        cs_d = jnp.asarray(colsum, jnp.float32)
+    for lo in range(0, G, _GCHUNK):
+        hi = min(G, lo + _GCHUNK)
+        Xc = _values_chunk(data, use_rep, lo, hi,
+                           jnp if device else np)
+        if device:
+            ni, nc, dn = _auto_terms(idx_d, w_d,
+                                     jnp.asarray(Xc, jnp.float32), cs_d)
+            ni, nc, dn = (np.asarray(a, np.float64) for a in (ni, nc, dn))
+        else:
+            Xc = np.asarray(Xc, np.float64)
+            z = Xc - Xc.mean(axis=0, keepdims=True)
+            safe = np.where(idx >= 0, idx, 0)
+            Wz = np.einsum("nk,nkg->ng", w, z[safe])
+            ni = (z * Wz).sum(axis=0)
+            r = w.sum(axis=1)
+            Wx = np.einsum("nk,nkg->ng", w, Xc[safe])
+            nc = ((r[:, None] * Xc * Xc).sum(axis=0)
+                  + (colsum[:, None] * Xc * Xc).sum(axis=0)
+                  - 2.0 * (Xc * Wx).sum(axis=0))
+            dn = (z * z).sum(axis=0)
+        dn = np.maximum(dn, 1e-12)
+        mor[lo:hi] = (n / S0) * ni / dn
+        gea[lo:hi] = ((n - 1) / (2.0 * S0)) * nc / dn
+    return mor, gea
+
+
+@register("metrics.morans_i", backend="tpu")
+def morans_i_tpu(data: CellData, use_rep: str = "X") -> CellData:
+    """Adds var["morans_i"] (or uns["morans_i_<rep>"] for obsm reps):
+    +1 = neighbours share the gene's value, 0 = noise, <0 =
+    anti-correlated over the graph."""
+    mor, _ = _metrics(data, use_rep, device=True)
+    if use_rep == "X" or use_rep in data.layers:
+        return data.with_var(morans_i=mor.astype(np.float32))
+    return data.with_uns(**{f"morans_i_{use_rep}": mor})
+
+
+@register("metrics.morans_i", backend="cpu")
+def morans_i_cpu(data: CellData, use_rep: str = "X") -> CellData:
+    mor, _ = _metrics(data, use_rep, device=False)
+    if use_rep == "X" or use_rep in data.layers:
+        return data.with_var(morans_i=mor.astype(np.float32))
+    return data.with_uns(**{f"morans_i_{use_rep}": mor})
+
+
+@register("metrics.gearys_c", backend="tpu")
+def gearys_c_tpu(data: CellData, use_rep: str = "X") -> CellData:
+    """Adds var["gearys_c"]: 0 = perfect positive autocorrelation over
+    the graph, 1 = none, >1 = anti-correlated (complements Moran's I)."""
+    _, gea = _metrics(data, use_rep, device=True)
+    if use_rep == "X" or use_rep in data.layers:
+        return data.with_var(gearys_c=gea.astype(np.float32))
+    return data.with_uns(**{f"gearys_c_{use_rep}": gea})
+
+
+@register("metrics.gearys_c", backend="cpu")
+def gearys_c_cpu(data: CellData, use_rep: str = "X") -> CellData:
+    _, gea = _metrics(data, use_rep, device=False)
+    if use_rep == "X" or use_rep in data.layers:
+        return data.with_var(gearys_c=gea.astype(np.float32))
+    return data.with_uns(**{f"gearys_c_{use_rep}": gea})

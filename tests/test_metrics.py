@@ -1,0 +1,98 @@
+"""metrics.morans_i / metrics.gearys_c vs a dense-formula oracle."""
+
+import numpy as np
+import pytest
+
+import sctools_tpu as sct
+from sctools_tpu.data.dataset import CellData
+
+
+@pytest.fixture(scope="module")
+def graphed():
+    """Two spatial blobs; gene 0 separates them (high autocorrelation),
+    gene 1 is pure noise (none)."""
+    rng = np.random.default_rng(0)
+    n = 300
+    pos = np.vstack([rng.normal(0, 1, (150, 5)),
+                     rng.normal(6, 1, (150, 5))]).astype(np.float32)
+    X = np.zeros((n, 3), np.float32)
+    X[:, 0] = np.concatenate([np.zeros(150), np.ones(150)]) \
+        + rng.normal(0, 0.1, n)
+    X[:, 1] = rng.normal(0, 1, n)
+    X[:, 2] = pos[:, 0] * 0.5 + rng.normal(0, 0.2, n)
+    d = CellData(X, obsm={"X_pca": pos})
+    d = sct.apply("neighbors.knn", d, backend="cpu", k=10,
+                  metric="euclidean")
+    return sct.apply("graph.connectivities", d, backend="cpu")
+
+
+def _dense_oracle(d):
+    """Direct formulas on the densified weight matrix."""
+    n = d.n_cells
+    idx = np.asarray(d.obsp["knn_indices"])
+    w = np.asarray(d.obsp["connectivities"], np.float64)
+    W = np.zeros((n, n))
+    for i in range(n):
+        for j, wij in zip(idx[i], w[i]):
+            if j >= 0:
+                W[i, j] = wij
+    X = np.asarray(d.X, np.float64)
+    S0 = W.sum()
+    I, C = [], []
+    for g in range(X.shape[1]):
+        x = X[:, g]
+        z = x - x.mean()
+        I.append((n / S0) * (z @ W @ z) / (z @ z))
+        diff2 = (x[:, None] - x[None, :]) ** 2
+        C.append(((n - 1) / (2 * S0)) * (W * diff2).sum() / (z @ z))
+    return np.array(I), np.array(C)
+
+
+def test_metrics_match_dense_oracle(graphed):
+    want_i, want_c = _dense_oracle(graphed)
+    out = sct.apply("metrics.morans_i", graphed, backend="cpu")
+    out = sct.apply("metrics.gearys_c", out, backend="cpu")
+    np.testing.assert_allclose(np.asarray(out.var["morans_i"],
+                                          np.float64),
+                               want_i, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out.var["gearys_c"],
+                                          np.float64),
+                               want_c, rtol=1e-5, atol=1e-6)
+
+
+def test_metrics_separate_signal_from_noise(graphed):
+    out = sct.apply("metrics.morans_i", graphed, backend="cpu")
+    out = sct.apply("metrics.gearys_c", out, backend="cpu")
+    I = np.asarray(out.var["morans_i"])
+    C = np.asarray(out.var["gearys_c"])
+    assert I[0] > 0.8       # blob-separating gene: strong structure
+    assert abs(I[1]) < 0.15  # noise gene
+    assert C[0] < 0.3 and 0.7 < C[1] < 1.3
+
+
+def test_metrics_tpu_matches_cpu(graphed):
+    a = sct.apply("metrics.morans_i", graphed, backend="tpu")
+    b = sct.apply("metrics.morans_i", graphed, backend="cpu")
+    np.testing.assert_allclose(np.asarray(a.var["morans_i"]),
+                               np.asarray(b.var["morans_i"]),
+                               rtol=1e-4, atol=1e-5)
+    a = sct.apply("metrics.gearys_c", graphed, backend="tpu")
+    b = sct.apply("metrics.gearys_c", graphed, backend="cpu")
+    np.testing.assert_allclose(np.asarray(a.var["gearys_c"]),
+                               np.asarray(b.var["gearys_c"]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_metrics_on_obsm_rep(graphed):
+    out = sct.apply("metrics.morans_i", graphed, backend="cpu",
+                    use_rep="X_pca")
+    assert out.uns["morans_i_X_pca"].shape == (5,)
+    # spatial coordinates are maximally autocorrelated over their own
+    # kNN graph
+    assert out.uns["morans_i_X_pca"][0] > 0.9
+
+
+def test_metrics_require_graph():
+    d = CellData(np.ones((5, 2), np.float32))
+    with pytest.raises(KeyError, match="neighbors.knn"):
+        sct.apply("metrics.morans_i", d, backend="cpu")
