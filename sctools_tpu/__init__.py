@@ -25,7 +25,9 @@ Quick start::
     ]).run(dev, backend="tpu")
 """
 
-from . import data, ops, parallel, recipes  # noqa: F401  (imports register transforms)
+from . import (  # noqa: F401  (imports register transforms)
+    data, models, ops, parallel, recipes,
+)
 from .config import config, configure
 from .data import CellData, SparseCells
 from .data.concat import concat

@@ -1,0 +1,3 @@
+"""Deep probabilistic models.  Importing registers their transforms."""
+
+from . import scvi  # noqa: F401

@@ -1,0 +1,222 @@
+"""``model.scvi`` — a negative-binomial VAE for count matrices (the
+scVI model family).
+
+Capability parity: scVI (Lopez et al. 2018) is the de-facto deep
+model for scRNA-seq — a VAE whose decoder parameterises a negative
+binomial over raw counts with a per-gene dispersion and the cell's
+library size as an offset, optionally conditioned on a batch
+covariate.  The reference source was unavailable (/root/reference
+empty — SURVEY.md §0); the published generative model is the
+contract:
+
+    z ~ N(0, I)                       (n_latent)
+    rho = softmax(decoder(z, batch))  (gene expression fractions)
+    x_g ~ NB(mean = l * rho_g, inverse-dispersion theta_g)
+
+with l the cell's observed library size (scVI's fixed-l variant —
+no latent library; it trains stably and keeps the ELBO exact).
+
+TPU design: training IS the workload TPUs are built for — everything
+is dense bf16-friendly matmuls.  One jitted update step consumes a
+(B, G) count slab; an epoch is a ``lax.scan`` over the permuted
+minibatch index array, so the whole epoch executes as ONE device
+program (no per-step dispatch over the tunnel — the round-4 lesson).
+Parameters are a plain pytree (no framework dependency); optax Adam;
+reparameterised KL in closed form; NB log-likelihood via lgamma.
+
+The same code is the CPU oracle (same program, cpu backend) — tests
+assert the ELBO improves, the latent separates generative clusters,
+and the decoded expression correlates with the truth.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..data.dataset import CellData
+from ..data.sparse import SparseCells
+from ..registry import register
+
+
+def _init_mlp(key, sizes):
+    params = []
+    for kin, kout in zip(sizes[:-1], sizes[1:]):
+        key, k1 = jax.random.split(key)
+        w = jax.random.normal(k1, (kin, kout)) * jnp.sqrt(2.0 / kin)
+        params.append({"w": w, "b": jnp.zeros((kout,))})
+    return params
+
+
+def _mlp(params, x, final_linear=True):
+    for i, lyr in enumerate(params):
+        x = x @ lyr["w"] + lyr["b"]
+        if i < len(params) - 1 or not final_linear:
+            x = jax.nn.relu(x)
+    return x
+
+
+def init_params(key, n_genes, n_batches, n_latent=10, n_hidden=128):
+    ke, kd = jax.random.split(key)
+    return {
+        "enc": _init_mlp(ke, (n_genes + n_batches, n_hidden,
+                              2 * n_latent)),
+        "dec": _init_mlp(kd, (n_latent + n_batches, n_hidden, n_genes)),
+        # per-gene inverse dispersion, initialised CONCENTRATED
+        # (theta ~ 7): starting at theta=1 (very overdispersed) is a
+        # training trap — with fuzzy reconstruction the ELBO prefers
+        # lowering theta further over sharpening the means, and the
+        # latent never learns structure (measured: theta collapsed to
+        # ~0.4 and cluster ARI halved)
+        "log_theta": jnp.full((n_genes,), 2.0),
+    }
+
+
+def _nb_logpmf(x, mean, theta):
+    """Negative binomial log-pmf, mean/inverse-dispersion form."""
+    eps = 1e-8
+    log_theta_mu = jnp.log(theta + mean + eps)
+    return (jax.lax.lgamma(x + theta)
+            - jax.lax.lgamma(theta)
+            - jax.lax.lgamma(x + 1.0)
+            + theta * (jnp.log(theta + eps) - log_theta_mu)
+            + x * (jnp.log(mean + eps) - log_theta_mu))
+
+
+def _enc_input(x, batch_oh):
+    """Encoder sees LIBRARY-NORMALISED log counts: with the fixed-l NB
+    decoder the library is an observed offset, so feeding raw counts
+    would make the encoder burn capacity re-deriving depth before it
+    can represent cell state."""
+    lib = jnp.sum(x, axis=1, keepdims=True)
+    xn = jnp.log1p(x * (1e4 / jnp.maximum(lib, 1.0)))
+    return jnp.concatenate([xn, batch_oh], axis=1)
+
+
+def elbo_fn(params, x, batch_oh, key, kl_weight=1.0):
+    """Mean per-cell negative ELBO for a (B, G) count slab."""
+    lib = jnp.sum(x, axis=1, keepdims=True)
+    xin = _enc_input(x, batch_oh)
+    h = _mlp(params["enc"], xin)
+    mu, logvar = jnp.split(h, 2, axis=1)
+    logvar = jnp.clip(logvar, -10.0, 10.0)
+    z = mu + jnp.exp(0.5 * logvar) * jax.random.normal(key, mu.shape)
+    rho = jax.nn.softmax(
+        _mlp(params["dec"], jnp.concatenate([z, batch_oh], axis=1)),
+        axis=1)
+    theta = jnp.exp(jnp.clip(params["log_theta"], -10.0, 10.0))
+    ll = jnp.sum(_nb_logpmf(x, lib * rho, theta[None, :]), axis=1)
+    kl = 0.5 * jnp.sum(jnp.exp(logvar) + mu**2 - 1.0 - logvar, axis=1)
+    return -jnp.mean(ll - kl_weight * kl)
+
+
+@partial(jax.jit, static_argnames=("n_steps", "batch_size"))
+def _train_epoch(params, opt_state, Xd, batch_oh, perm, key, kl_weight,
+                 *, n_steps: int, batch_size: int):
+    """One epoch as a single compiled scan over minibatches."""
+    tx = _make_tx()
+
+    def step(carry, i):
+        params, opt_state, key = carry
+        key, ks = jax.random.split(key)
+        rows = jax.lax.dynamic_slice_in_dim(perm, i * batch_size,
+                                            batch_size)
+        xb = jnp.take(Xd, rows, axis=0)
+        bb = jnp.take(batch_oh, rows, axis=0)
+        loss, grads = jax.value_and_grad(elbo_fn)(params, xb, bb, ks,
+                                                  kl_weight)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state, key), loss
+
+    (params, opt_state, key), losses = jax.lax.scan(
+        step, (params, opt_state, key), jnp.arange(n_steps))
+    return params, opt_state, jnp.mean(losses)
+
+
+_LR = 1e-3
+
+
+def _make_tx():
+    return optax.adam(_LR)
+
+
+@partial(jax.jit, static_argnames=())
+def _encode(params, x, batch_oh):
+    mu, _ = jnp.split(_mlp(params["enc"], _enc_input(x, batch_oh)),
+                      2, axis=1)
+    return mu
+
+
+def _counts_dense(data: CellData):
+    """Raw counts as dense (n, G) — layers['counts'] if the pipeline
+    snapshotted them, else X."""
+    M = data.layers.get("counts", data.X)
+    n = data.n_cells
+    if isinstance(M, SparseCells):
+        return M.to_dense()[:n]
+    if hasattr(M, "toarray"):
+        return jnp.asarray(M.toarray(), jnp.float32)
+    return jnp.asarray(M, jnp.float32)[:n]
+
+
+def _fit(data: CellData, n_latent, n_hidden, epochs, batch_size,
+         batch_key, seed, kl_warmup):
+    n = data.n_cells
+    X = _counts_dense(data)
+    if batch_key is not None:
+        if batch_key not in data.obs:
+            raise KeyError(f"model.scvi: obs has no {batch_key!r}")
+        levels, codes = np.unique(
+            np.asarray(data.obs[batch_key])[:n], return_inverse=True)
+        n_batches = len(levels)
+        batch_oh = jax.nn.one_hot(jnp.asarray(codes), n_batches)
+    else:
+        n_batches = 0
+        batch_oh = jnp.zeros((n, 0), jnp.float32)
+    key = jax.random.PRNGKey(seed)
+    key, ki = jax.random.split(key)
+    params = init_params(ki, data.n_genes, n_batches, n_latent, n_hidden)
+    tx = _make_tx()
+    opt_state = tx.init(params)
+    batch_size = min(batch_size, n)
+    n_steps = max(n // batch_size, 1)
+    rng = np.random.default_rng(seed)
+    history = []
+    for ep in range(epochs):
+        perm = jnp.asarray(
+            rng.permutation(n)[: n_steps * batch_size].astype(np.int32))
+        key, ke = jax.random.split(key)
+        klw = jnp.float32(min(1.0, (ep + 1) / max(kl_warmup, 1)))
+        params, opt_state, loss = _train_epoch(
+            params, opt_state, X, batch_oh, perm, ke, klw,
+            n_steps=n_steps, batch_size=batch_size)
+        history.append(float(loss))
+    latent = np.asarray(_encode(params, X, batch_oh))
+    theta = np.exp(np.clip(np.asarray(params["log_theta"]), -10, 10))
+    return latent, theta, history, params
+
+
+@register("model.scvi", backend="tpu")
+@register("model.scvi", backend="cpu")
+def scvi(data: CellData, n_latent: int = 10, n_hidden: int = 128,
+         epochs: int = 40, batch_size: int = 512,
+         batch_key: str | None = None, seed: int = 0,
+         kl_warmup: int = 10) -> CellData:
+    """Train the NB-VAE and embed every cell.  Adds obsm["X_scvi"]
+    (the posterior mean latent), var["scvi_dispersion"], and
+    uns["scvi_elbo_history"] (negative ELBO per epoch — should
+    decrease).  One registration serves both backends: the program is
+    identical, only the device differs.  Run AFTER hvg subsetting
+    (training densifies gene space) and BEFORE normalisation, or
+    snapshot counts first (``util.snapshot_layer``)."""
+    latent, theta, history, _ = _fit(
+        data, n_latent, n_hidden, epochs, batch_size, batch_key, seed,
+        kl_warmup)
+    return (data.with_obsm(X_scvi=latent)
+            .with_var(scvi_dispersion=theta.astype(np.float32))
+            .with_uns(scvi_elbo_history=np.asarray(history)))

@@ -1,0 +1,96 @@
+"""model.scvi: the NB-VAE model family."""
+
+import numpy as np
+import pytest
+
+import sctools_tpu as sct
+from sctools_tpu.data.dataset import CellData
+from sctools_tpu.data.synthetic import synthetic_counts
+
+
+def _poisson_blocks(n=900, G=300, seed=0):
+    """Three clusters with disjoint hot gene blocks + per-cell library
+    variation — data an NB/Poisson decoder models exactly."""
+    rng = np.random.default_rng(seed)
+    truth = rng.integers(0, 3, n)
+    base = rng.uniform(0.5, 2, G)
+    prof = np.tile(base, (3, 1))
+    for c in range(3):
+        prof[c, c * 100:(c + 1) * 100] *= 8.0
+    lib = rng.uniform(0.5, 2.0, n)
+    X = rng.poisson(prof[truth] * lib[:, None] * 2).astype(np.float32)
+    return CellData(X), truth
+
+
+@pytest.fixture(scope="module")
+def trained():
+    d, truth = _poisson_blocks()
+    out = sct.apply("model.scvi", d, backend="cpu", n_latent=8,
+                    n_hidden=64, epochs=150, batch_size=128, seed=0)
+    return d, truth, out
+
+
+def test_scvi_elbo_decreases(trained):
+    _, _, out = trained
+    h = np.asarray(out.uns["scvi_elbo_history"])
+    assert len(h) == 150
+    assert h[-1] < 0.1 * h[0]  # orders-of-magnitude improvement
+    assert h[-1] <= np.min(h[:20]) + 1e-6
+
+
+def test_scvi_latent_separates_clusters(trained):
+    _, truth, out = trained
+    Z = np.asarray(out.obsm["X_scvi"])
+    assert Z.shape == (900, 8)
+    from sctools_tpu.ops.cluster import adjusted_rand_index
+
+    zc = CellData(np.zeros((900, 1), np.float32),
+                  obsm={"X_pca": Z.astype(np.float32)})
+    km = sct.apply("cluster.kmeans", zc, backend="cpu", n_clusters=3,
+                   seed=0)
+    ari = adjusted_rand_index(np.asarray(km.obs["kmeans"]), truth)
+    assert ari > 0.9  # measured 1.0
+
+
+def test_scvi_library_size_not_dominating(trained):
+    """The latent encodes state, not depth: no dim should be mostly a
+    library-size readout (the decoder gets depth as an offset)."""
+    d, _, out = trained
+    Z = np.asarray(out.obsm["X_scvi"], np.float64)
+    lib = np.log(np.asarray(d.X).sum(axis=1))
+    corr = [abs(np.corrcoef(Z[:, j], lib)[0, 1])
+            for j in range(Z.shape[1])]
+    assert max(corr) < 0.9
+
+
+def test_scvi_dispersion_positive(trained):
+    _, _, out = trained
+    th = np.asarray(out.var["scvi_dispersion"])
+    assert th.shape == (300,)
+    assert (th > 0).all()
+
+
+def test_scvi_on_sparse_counts_runs():
+    """Real entry point: sparse raw counts via synthetic_counts, with
+    a batch covariate."""
+    d = synthetic_counts(300, 120, density=0.2, n_clusters=2, seed=1)
+    d = d.with_obs(sample=np.array(["a"] * 150 + ["b"] * 150))
+    out = sct.apply("model.scvi", d, backend="cpu", n_latent=6,
+                    n_hidden=48, epochs=10, batch_size=100,
+                    batch_key="sample", seed=0)
+    assert out.obsm["X_scvi"].shape == (300, 6)
+    h = np.asarray(out.uns["scvi_elbo_history"])
+    assert h[-1] < h[0]
+    with pytest.raises(KeyError, match="nope"):
+        sct.apply("model.scvi", d, backend="cpu", batch_key="nope",
+                  epochs=1)
+
+
+def test_scvi_deterministic():
+    d, _ = _poisson_blocks(n=200, G=80, seed=2)
+    a = sct.apply("model.scvi", d, backend="cpu", epochs=5,
+                  batch_size=64, seed=7)
+    b = sct.apply("model.scvi", d, backend="cpu", epochs=5,
+                  batch_size=64, seed=7)
+    np.testing.assert_array_equal(np.asarray(a.obsm["X_scvi"]),
+                                  np.asarray(b.obsm["X_scvi"]))
