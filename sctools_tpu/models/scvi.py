@@ -145,6 +145,46 @@ def _make_tx():
     return optax.adam(_LR)
 
 
+def _make_epoch_sharded(mesh, Xd, batch_oh):
+    """Build the COMPILED data-parallel epoch once (re-jitting per
+    epoch cost minutes on the virtual mesh): every device owns a
+    shard of the permuted minibatch ROWS, computes local gradients,
+    and a ``pmean`` keeps the replicated params in lockstep — the
+    standard DP recipe, expressed as ``shard_map`` so the same step
+    compiles for any device count.  ``perm`` has shape (n_steps,
+    batch_size) with batch_size divisible by the mesh size; each
+    device takes its slice of every minibatch."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    axis = mesh.axis_names[0]
+    tx = _make_tx()
+
+    def epoch(params, opt_state, perm_local, key, kl_weight):
+        def step(carry, rows):
+            params, opt_state = carry
+            ks = jax.random.fold_in(key, rows[0])
+            xb = jnp.take(Xd, rows, axis=0)
+            bb = jnp.take(batch_oh, rows, axis=0)
+            loss, grads = jax.value_and_grad(elbo_fn)(
+                params, xb, bb, ks, kl_weight)
+            grads = jax.lax.pmean(grads, axis)
+            loss = jax.lax.pmean(loss, axis)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            step, (params, opt_state), perm_local)
+        return params, opt_state, jnp.mean(losses)
+
+    return jax.jit(shard_map(
+        epoch, mesh=mesh,
+        in_specs=(P(), P(), P(None, axis), P(), P()),
+        out_specs=(P(), P(), P()),
+        check_rep=False))
+
+
 @partial(jax.jit, static_argnames=())
 def _encode(params, x, batch_oh):
     mu, _ = jnp.split(_mlp(params["enc"], _enc_input(x, batch_oh)),
@@ -165,7 +205,7 @@ def _counts_dense(data: CellData):
 
 
 def _fit(data: CellData, n_latent, n_hidden, epochs, batch_size,
-         batch_key, seed, kl_warmup):
+         batch_key, seed, kl_warmup, mesh=None):
     n = data.n_cells
     X = _counts_dense(data)
     if batch_key is not None:
@@ -184,17 +224,30 @@ def _fit(data: CellData, n_latent, n_hidden, epochs, batch_size,
     tx = _make_tx()
     opt_state = tx.init(params)
     batch_size = min(batch_size, n)
+    if mesh is not None:
+        nd = mesh.devices.size
+        batch_size = max(batch_size // nd, 1) * nd  # divisible shards
     n_steps = max(n // batch_size, 1)
     rng = np.random.default_rng(seed)
     history = []
+    epoch_sharded = (_make_epoch_sharded(mesh, X, batch_oh)
+                     if mesh is not None else None)
     for ep in range(epochs):
-        perm = jnp.asarray(
-            rng.permutation(n)[: n_steps * batch_size].astype(np.int32))
         key, ke = jax.random.split(key)
         klw = jnp.float32(min(1.0, (ep + 1) / max(kl_warmup, 1)))
-        params, opt_state, loss = _train_epoch(
-            params, opt_state, X, batch_oh, perm, ke, klw,
-            n_steps=n_steps, batch_size=batch_size)
+        if mesh is not None:
+            perm2 = jnp.asarray(
+                rng.permutation(n)[: n_steps * batch_size]
+                .astype(np.int32).reshape(n_steps, batch_size))
+            params, opt_state, loss = epoch_sharded(
+                params, opt_state, perm2, ke, klw)
+        else:
+            perm = jnp.asarray(
+                rng.permutation(n)[: n_steps * batch_size]
+                .astype(np.int32))
+            params, opt_state, loss = _train_epoch(
+                params, opt_state, X, batch_oh, perm, ke, klw,
+                n_steps=n_steps, batch_size=batch_size)
         history.append(float(loss))
     latent = np.asarray(_encode(params, X, batch_oh))
     theta = np.exp(np.clip(np.asarray(params["log_theta"]), -10, 10))
@@ -206,17 +259,25 @@ def _fit(data: CellData, n_latent, n_hidden, epochs, batch_size,
 def scvi(data: CellData, n_latent: int = 10, n_hidden: int = 128,
          epochs: int = 40, batch_size: int = 512,
          batch_key: str | None = None, seed: int = 0,
-         kl_warmup: int = 10) -> CellData:
+         kl_warmup: int = 10, n_devices: int | None = None) -> CellData:
     """Train the NB-VAE and embed every cell.  Adds obsm["X_scvi"]
     (the posterior mean latent), var["scvi_dispersion"], and
     uns["scvi_elbo_history"] (negative ELBO per epoch — should
     decrease).  One registration serves both backends: the program is
-    identical, only the device differs.  Run AFTER hvg subsetting
-    (training densifies gene space) and BEFORE normalisation, or
-    snapshot counts first (``util.snapshot_layer``)."""
+    identical, only the device differs.  ``n_devices`` > 1 trains
+    data-parallel over a 1-D mesh (shard_map + pmean'd gradients; X
+    replicated — shard the LOADING too for matrices beyond one chip's
+    HBM).  Run AFTER hvg subsetting (training densifies gene space)
+    and BEFORE normalisation, or snapshot counts first
+    (``util.snapshot_layer``)."""
+    mesh = None
+    if n_devices is not None and n_devices > 1:
+        from ..parallel.mesh import make_mesh
+
+        mesh = make_mesh(n_devices)
     latent, theta, history, _ = _fit(
         data, n_latent, n_hidden, epochs, batch_size, batch_key, seed,
-        kl_warmup)
+        kl_warmup, mesh=mesh)
     return (data.with_obsm(X_scvi=latent)
             .with_var(scvi_dispersion=theta.astype(np.float32))
             .with_uns(scvi_elbo_history=np.asarray(history)))

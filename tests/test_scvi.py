@@ -94,3 +94,23 @@ def test_scvi_deterministic():
                   batch_size=64, seed=7)
     np.testing.assert_array_equal(np.asarray(a.obsm["X_scvi"]),
                                   np.asarray(b.obsm["X_scvi"]))
+
+
+def test_scvi_data_parallel_over_mesh():
+    """8-virtual-device DP training: pmean'd grads keep replicated
+    params in lockstep; the model still learns and separates."""
+    d, truth = _poisson_blocks(n=600, G=200, seed=3)
+    out = sct.apply("model.scvi", d, backend="tpu", n_latent=8,
+                    n_hidden=64, epochs=250, batch_size=128, seed=0,
+                    n_devices=8)
+    h = np.asarray(out.uns["scvi_elbo_history"])
+    assert h[-1] < 0.2 * h[0]
+    from sctools_tpu.ops.cluster import adjusted_rand_index
+
+    Z = np.asarray(out.obsm["X_scvi"])
+    zc = CellData(np.zeros((600, 1), np.float32),
+                  obsm={"X_pca": Z.astype(np.float32)})
+    km = sct.apply("cluster.kmeans", zc, backend="cpu", n_clusters=3,
+                   seed=0)
+    assert adjusted_rand_index(np.asarray(km.obs["kmeans"]),
+                               truth) > 0.9
