@@ -192,6 +192,15 @@ def _encode(params, x, batch_oh):
     return mu
 
 
+@partial(jax.jit, static_argnames=())
+def _decode_rho(params, z, batch_oh):
+    """Posterior-mean denoised expression fractions (scVI's
+    get_normalized_expression)."""
+    return jax.nn.softmax(
+        _mlp(params["dec"], jnp.concatenate([z, batch_oh], axis=1)),
+        axis=1)
+
+
 def _counts_dense(data: CellData):
     """Raw counts as dense (n, G) — layers['counts'] if the pipeline
     snapshotted them, else X."""
@@ -249,9 +258,10 @@ def _fit(data: CellData, n_latent, n_hidden, epochs, batch_size,
                 params, opt_state, X, batch_oh, perm, ke, klw,
                 n_steps=n_steps, batch_size=batch_size)
         history.append(float(loss))
-    latent = np.asarray(_encode(params, X, batch_oh))
+    latent_d = _encode(params, X, batch_oh)
+    latent = np.asarray(latent_d)
     theta = np.exp(np.clip(np.asarray(params["log_theta"]), -10, 10))
-    return latent, theta, history, params
+    return latent, theta, history, params, (latent_d, batch_oh)
 
 
 @register("model.scvi", backend="tpu")
@@ -259,7 +269,8 @@ def _fit(data: CellData, n_latent, n_hidden, epochs, batch_size,
 def scvi(data: CellData, n_latent: int = 10, n_hidden: int = 128,
          epochs: int = 40, batch_size: int = 512,
          batch_key: str | None = None, seed: int = 0,
-         kl_warmup: int = 10, n_devices: int | None = None) -> CellData:
+         kl_warmup: int = 10, n_devices: int | None = None,
+         store_normalized: bool = False) -> CellData:
     """Train the NB-VAE and embed every cell.  Adds obsm["X_scvi"]
     (the posterior mean latent), var["scvi_dispersion"], and
     uns["scvi_elbo_history"] (negative ELBO per epoch — should
@@ -275,9 +286,14 @@ def scvi(data: CellData, n_latent: int = 10, n_hidden: int = 128,
         from ..parallel.mesh import make_mesh
 
         mesh = make_mesh(n_devices)
-    latent, theta, history, _ = _fit(
+    latent, theta, history, params, (latent_d, batch_oh) = _fit(
         data, n_latent, n_hidden, epochs, batch_size, batch_key, seed,
         kl_warmup, mesh=mesh)
-    return (data.with_obsm(X_scvi=latent)
-            .with_var(scvi_dispersion=theta.astype(np.float32))
-            .with_uns(scvi_elbo_history=np.asarray(history)))
+    out = (data.with_obsm(X_scvi=latent)
+           .with_var(scvi_dispersion=theta.astype(np.float32))
+           .with_uns(scvi_elbo_history=np.asarray(history)))
+    if store_normalized:
+        # (n, G) dense — opt-in; scVI get_normalized_expression parity
+        out = out.with_layers(scvi_normalized=np.asarray(
+            _decode_rho(params, latent_d, batch_oh), np.float32))
+    return out

@@ -114,3 +114,19 @@ def test_scvi_data_parallel_over_mesh():
                    seed=0)
     assert adjusted_rand_index(np.asarray(km.obs["kmeans"]),
                                truth) > 0.9
+
+
+def test_scvi_normalized_expression():
+    """store_normalized: decoded rho recovers the generative profile
+    ordering — hot-block genes dominate within their own cluster."""
+    d, truth = _poisson_blocks(n=300, G=150, seed=4)
+    out = sct.apply("model.scvi", d, backend="cpu", n_latent=6,
+                    n_hidden=48, epochs=120, batch_size=100, seed=0,
+                    store_normalized=True)
+    rho = np.asarray(out.layers["scvi_normalized"])
+    assert rho.shape == (300, 150)
+    np.testing.assert_allclose(rho.sum(axis=1), 1.0, rtol=1e-4)
+    # cluster-0 cells put more mass on genes 0:50 than cluster-1 cells
+    m0 = rho[truth == 0][:, 0:50].sum(axis=1).mean()
+    m1 = rho[truth == 1][:, 0:50].sum(axis=1).mean()
+    assert m0 > 2 * m1
