@@ -147,25 +147,31 @@ def _make_tx():
 
 def _make_epoch_sharded(mesh, Xd, batch_oh):
     """Build the COMPILED data-parallel epoch once (re-jitting per
-    epoch cost minutes on the virtual mesh): every device owns a
-    shard of the permuted minibatch ROWS, computes local gradients,
-    and a ``pmean`` keeps the replicated params in lockstep — the
-    standard DP recipe, expressed as ``shard_map`` so the same step
-    compiles for any device count.  ``perm`` has shape (n_steps,
-    batch_size) with batch_size divisible by the mesh size; each
-    device takes its slice of every minibatch."""
+    epoch cost minutes on the virtual mesh).
+
+    **X is cells-axis SHARDED across the mesh** — the atlas-scale
+    shape where no chip holds the full matrix.  Each device samples
+    minibatch rows from ITS OWN shard (``perm`` carries local
+    indices, batch-axis sharded), computes local gradients, and a
+    ``pmean`` keeps the replicated params in lockstep — the standard
+    DP recipe, expressed as ``shard_map`` so the same step compiles
+    for any device count."""
     from jax.experimental.shard_map import shard_map
-    from jax.sharding import PartitionSpec as P
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     axis = mesh.axis_names[0]
     tx = _make_tx()
+    Xd = jax.device_put(Xd, NamedSharding(mesh, P(axis, None)))
+    batch_oh = jax.device_put(batch_oh, NamedSharding(mesh, P(axis, None)))
 
-    def epoch(params, opt_state, perm_local, key, kl_weight):
+    def epoch(params, opt_state, X_local, oh_local, perm_local, key,
+              kl_weight):
         def step(carry, rows):
             params, opt_state = carry
-            ks = jax.random.fold_in(key, rows[0])
-            xb = jnp.take(Xd, rows, axis=0)
-            bb = jnp.take(batch_oh, rows, axis=0)
+            ks = jax.random.fold_in(
+                key, rows[0] + jax.lax.axis_index(axis) * 100003)
+            xb = jnp.take(X_local, rows, axis=0)
+            bb = jnp.take(oh_local, rows, axis=0)
             loss, grads = jax.value_and_grad(elbo_fn)(
                 params, xb, bb, ks, kl_weight)
             grads = jax.lax.pmean(grads, axis)
@@ -178,11 +184,18 @@ def _make_epoch_sharded(mesh, Xd, batch_oh):
             step, (params, opt_state), perm_local)
         return params, opt_state, jnp.mean(losses)
 
-    return jax.jit(shard_map(
+    fn = jax.jit(shard_map(
         epoch, mesh=mesh,
-        in_specs=(P(), P(), P(None, axis), P(), P()),
+        in_specs=(P(), P(), P(axis, None), P(axis, None),
+                  P(None, axis), P(), P()),
         out_specs=(P(), P(), P()),
         check_rep=False))
+
+    def run(params, opt_state, perm, key, klw):
+        return fn(params, opt_state, Xd, batch_oh, perm, key, klw)
+
+    run.x_sharded = Xd  # introspection hook for tests
+    return run
 
 
 @partial(jax.jit, static_argnames=())
@@ -239,15 +252,25 @@ def _fit(data: CellData, n_latent, n_hidden, epochs, batch_size,
     n_steps = max(n // batch_size, 1)
     rng = np.random.default_rng(seed)
     history = []
-    epoch_sharded = (_make_epoch_sharded(mesh, X, batch_oh)
-                     if mesh is not None else None)
+    if mesh is not None:
+        nd = mesh.devices.size
+        n_local = -(-n // nd)
+        # wrap-pad so every device's shard holds REAL cells (zero-pad
+        # rows would be sampled as fake empty cells)
+        pad_rows = np.arange(n_local * nd - n) % n
+        Xp = jnp.concatenate([X, X[pad_rows]]) if len(pad_rows) else X
+        ohp = (jnp.concatenate([batch_oh, batch_oh[pad_rows]])
+               if len(pad_rows) else batch_oh)
+        epoch_sharded = _make_epoch_sharded(mesh, Xp, ohp)
+        b_local = batch_size // nd
     for ep in range(epochs):
         key, ke = jax.random.split(key)
         klw = jnp.float32(min(1.0, (ep + 1) / max(kl_warmup, 1)))
         if mesh is not None:
-            perm2 = jnp.asarray(
-                rng.permutation(n)[: n_steps * batch_size]
-                .astype(np.int32).reshape(n_steps, batch_size))
+            # per-device LOCAL row indices, device blocks side by side
+            perm2 = jnp.asarray(rng.integers(
+                0, n_local, size=(n_steps, nd * b_local),
+                dtype=np.int32))
             params, opt_state, loss = epoch_sharded(
                 params, opt_state, perm2, ke, klw)
         else:
