@@ -147,3 +147,34 @@ def test_scvi_sharded_x_lives_on_the_mesh():
     fn = S._make_epoch_sharded(mesh, X, oh)
     shard_rows = {s.data.shape[0] for s in fn.x_sharded.addressable_shards}
     assert shard_rows == {160 // 8}  # each device holds 1/8 of cells
+
+
+def test_scanvi_semi_supervised_label_recovery():
+    """30% of cells labelled; scanvi must predict the held-out 70%
+    accurately on separable data."""
+    d, truth = _poisson_blocks(n=600, G=200, seed=6)
+    rng = np.random.default_rng(0)
+    labels = np.array([f"type_{c}" for c in truth], dtype=object)
+    mask = rng.random(600) > 0.3
+    labels[mask] = "Unknown"
+    d = d.with_obs(cell_type=labels.astype(str))
+    out = sct.apply("model.scanvi", d, backend="cpu", n_latent=8,
+                    n_hidden=64, epochs=150, batch_size=128, seed=0)
+    pred = np.asarray(out.obs["scanvi_prediction"])
+    want = np.array([f"type_{c}" for c in truth])
+    acc_unlabeled = (pred[mask] == want[mask]).mean()
+    assert acc_unlabeled > 0.9
+    conf = np.asarray(out.obs["scanvi_confidence"])
+    assert conf.min() > 1.0 / 3.0 - 1e-6 and conf.max() <= 1.0 + 1e-6
+    h = np.asarray(out.uns["scanvi_elbo_history"])
+    assert h[-1] < h[0]
+    assert out.obsm["X_scanvi"].shape == (600, 8)
+
+
+def test_scanvi_validates():
+    d, _ = _poisson_blocks(n=100, G=50, seed=7)
+    with pytest.raises(KeyError, match="cell_type"):
+        sct.apply("model.scanvi", d, backend="cpu", epochs=1)
+    one = d.with_obs(cell_type=np.array(["a"] * 100))
+    with pytest.raises(ValueError, match=">=2"):
+        sct.apply("model.scanvi", one, backend="cpu", epochs=1)
