@@ -97,8 +97,8 @@ def _enc_input(x, batch_oh):
     return jnp.concatenate([xn, batch_oh], axis=1)
 
 
-def elbo_fn(params, x, batch_oh, key, kl_weight=1.0):
-    """Mean per-cell negative ELBO for a (B, G) count slab."""
+def _vae_terms(params, x, batch_oh, key):
+    """Shared VAE body: per-cell (log-likelihood, KL, sampled z)."""
     lib = jnp.sum(x, axis=1, keepdims=True)
     xin = _enc_input(x, batch_oh)
     h = _mlp(params["enc"], xin)
@@ -111,6 +111,12 @@ def elbo_fn(params, x, batch_oh, key, kl_weight=1.0):
     theta = jnp.exp(jnp.clip(params["log_theta"], -10.0, 10.0))
     ll = jnp.sum(_nb_logpmf(x, lib * rho, theta[None, :]), axis=1)
     kl = 0.5 * jnp.sum(jnp.exp(logvar) + mu**2 - 1.0 - logvar, axis=1)
+    return ll, kl, z
+
+
+def elbo_fn(params, x, batch_oh, key, kl_weight=1.0):
+    """Mean per-cell negative ELBO for a (B, G) count slab."""
+    ll, kl, _ = _vae_terms(params, x, batch_oh, key)
     return -jnp.mean(ll - kl_weight * kl)
 
 
@@ -166,10 +172,16 @@ def _make_epoch_sharded(mesh, Xd, batch_oh):
 
     def epoch(params, opt_state, X_local, oh_local, perm_local, key,
               kl_weight):
-        def step(carry, rows):
+        def step(carry, inp):
             params, opt_state = carry
+            step_i, rows = inp
+            # key = f(epoch key, step index, device index): unique per
+            # step AND device by construction — deriving it from
+            # rows[0] collided whenever two steps sampled the same
+            # first row, and across devices at n_local > 100003
             ks = jax.random.fold_in(
-                key, rows[0] + jax.lax.axis_index(axis) * 100003)
+                jax.random.fold_in(key, step_i),
+                jax.lax.axis_index(axis))
             xb = jnp.take(X_local, rows, axis=0)
             bb = jnp.take(oh_local, rows, axis=0)
             loss, grads = jax.value_and_grad(elbo_fn)(
@@ -181,7 +193,8 @@ def _make_epoch_sharded(mesh, Xd, batch_oh):
             return (params, opt_state), loss
 
         (params, opt_state), losses = jax.lax.scan(
-            step, (params, opt_state), perm_local)
+            step, (params, opt_state),
+            (jnp.arange(perm_local.shape[0]), perm_local))
         return params, opt_state, jnp.mean(losses)
 
     fn = jax.jit(shard_map(
@@ -214,6 +227,17 @@ def _decode_rho(params, z, batch_oh):
         axis=1)
 
 
+def _batch_onehot(data: CellData, batch_key, n, opname):
+    """(n, n_batches) one-hot of obs[batch_key]; (n, 0) when None."""
+    if batch_key is None:
+        return jnp.zeros((n, 0), jnp.float32)
+    if batch_key not in data.obs:
+        raise KeyError(f"{opname}: obs has no {batch_key!r}")
+    levels, codes = np.unique(
+        np.asarray(data.obs[batch_key])[:n], return_inverse=True)
+    return jax.nn.one_hot(jnp.asarray(codes), len(levels))
+
+
 def _counts_dense(data: CellData):
     """Raw counts as dense (n, G) — layers['counts'] if the pipeline
     snapshotted them, else X."""
@@ -230,19 +254,11 @@ def _fit(data: CellData, n_latent, n_hidden, epochs, batch_size,
          batch_key, seed, kl_warmup, mesh=None):
     n = data.n_cells
     X = _counts_dense(data)
-    if batch_key is not None:
-        if batch_key not in data.obs:
-            raise KeyError(f"model.scvi: obs has no {batch_key!r}")
-        levels, codes = np.unique(
-            np.asarray(data.obs[batch_key])[:n], return_inverse=True)
-        n_batches = len(levels)
-        batch_oh = jax.nn.one_hot(jnp.asarray(codes), n_batches)
-    else:
-        n_batches = 0
-        batch_oh = jnp.zeros((n, 0), jnp.float32)
+    batch_oh = _batch_onehot(data, batch_key, n, "model.scvi")
     key = jax.random.PRNGKey(seed)
     key, ki = jax.random.split(key)
-    params = init_params(ki, data.n_genes, n_batches, n_latent, n_hidden)
+    params = init_params(ki, data.n_genes, batch_oh.shape[1],
+                         n_latent, n_hidden)
     tx = _make_tx()
     opt_state = tx.init(params)
     batch_size = min(batch_size, n)
@@ -299,10 +315,12 @@ def scvi(data: CellData, n_latent: int = 10, n_hidden: int = 128,
     uns["scvi_elbo_history"] (negative ELBO per epoch — should
     decrease).  One registration serves both backends: the program is
     identical, only the device differs.  ``n_devices`` > 1 trains
-    data-parallel over a 1-D mesh (shard_map + pmean'd gradients; X
-    replicated — shard the LOADING too for matrices beyond one chip's
-    HBM).  Run AFTER hvg subsetting (training densifies gene space)
-    and BEFORE normalisation, or snapshot counts first
+    data-parallel over a 1-D mesh: X lives cells-axis SHARDED
+    (``NamedSharding``), each device samples minibatches from its own
+    shard, gradients pmean — no chip ever holds the full matrix
+    during training (the final encode pass is currently unsharded).
+    Run AFTER hvg subsetting (training densifies gene space) and
+    BEFORE normalisation, or snapshot counts first
     (``util.snapshot_layer``)."""
     mesh = None
     if n_devices is not None and n_devices > 1:
@@ -342,18 +360,7 @@ def semi_elbo_fn(params, x, batch_oh, y, has_label, key,
     conditions the decoder on y; that refinement mostly matters for
     counterfactual decoding, which this op does not expose — the
     simplification is documented, not hidden.)"""
-    lib = jnp.sum(x, axis=1, keepdims=True)
-    xin = _enc_input(x, batch_oh)
-    h = _mlp(params["enc"], xin)
-    mu, logvar = jnp.split(h, 2, axis=1)
-    logvar = jnp.clip(logvar, -10.0, 10.0)
-    z = mu + jnp.exp(0.5 * logvar) * jax.random.normal(key, mu.shape)
-    rho = jax.nn.softmax(
-        _mlp(params["dec"], jnp.concatenate([z, batch_oh], axis=1)),
-        axis=1)
-    theta = jnp.exp(jnp.clip(params["log_theta"], -10.0, 10.0))
-    ll = jnp.sum(_nb_logpmf(x, lib * rho, theta[None, :]), axis=1)
-    kl = 0.5 * jnp.sum(jnp.exp(logvar) + mu**2 - 1.0 - logvar, axis=1)
+    ll, kl, z = _vae_terms(params, x, batch_oh, key)
     logits = _clf_logits(params, z)
     logp = jax.nn.log_softmax(logits, axis=1)
     ce = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
@@ -389,14 +396,7 @@ def scanvi(data: CellData, labels_key: str = "cell_type",
     has_label = (~unl).astype(np.float32)
 
     X = _counts_dense(data)
-    if batch_key is not None:
-        if batch_key not in data.obs:
-            raise KeyError(f"model.scanvi: obs has no {batch_key!r}")
-        blevels, bcodes = np.unique(
-            np.asarray(data.obs[batch_key])[:n], return_inverse=True)
-        batch_oh = jax.nn.one_hot(jnp.asarray(bcodes), len(blevels))
-    else:
-        batch_oh = jnp.zeros((n, 0), jnp.float32)
+    batch_oh = _batch_onehot(data, batch_key, n, "model.scanvi")
     key = jax.random.PRNGKey(seed)
     key, ki, kc = jax.random.split(key, 3)
     params = init_params(ki, data.n_genes, batch_oh.shape[1],
@@ -410,18 +410,21 @@ def scanvi(data: CellData, labels_key: str = "cell_type",
     y_d = jnp.asarray(y)
     hl_d = jnp.asarray(has_label)
 
+    # arrays enter as jit ARGUMENTS (closing over the dense X would
+    # bake it into the jaxpr as a constant — the large-constant
+    # pathology _train_epoch avoids the same way)
     @partial(jax.jit, static_argnames=("n_steps", "batch_size"))
-    def train_epoch(params, opt_state, perm, key, klw, *,
-                    n_steps: int, batch_size: int):
+    def train_epoch(params, opt_state, Xd, oh, yv, hlv, perm, key, klw,
+                    *, n_steps: int, batch_size: int):
         def step(carry, i):
             params, opt_state, key = carry
             key, ks = jax.random.split(key)
             rows = jax.lax.dynamic_slice_in_dim(perm, i * batch_size,
                                                 batch_size)
             loss, grads = jax.value_and_grad(semi_elbo_fn)(
-                params, jnp.take(X, rows, axis=0),
-                jnp.take(batch_oh, rows, axis=0),
-                jnp.take(y_d, rows), jnp.take(hl_d, rows), ks, klw,
+                params, jnp.take(Xd, rows, axis=0),
+                jnp.take(oh, rows, axis=0),
+                jnp.take(yv, rows), jnp.take(hlv, rows), ks, klw,
                 alpha)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
@@ -439,7 +442,7 @@ def scanvi(data: CellData, labels_key: str = "cell_type",
         key, ke = jax.random.split(key)
         klw = jnp.float32(min(1.0, (ep + 1) / max(kl_warmup, 1)))
         params, opt_state, loss = train_epoch(
-            params, opt_state, perm, ke, klw,
+            params, opt_state, X, batch_oh, y_d, hl_d, perm, ke, klw,
             n_steps=n_steps, batch_size=batch_size)
         history.append(float(loss))
     Z = _encode(params, X, batch_oh)
