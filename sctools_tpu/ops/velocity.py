@@ -325,3 +325,187 @@ def vembed(data: CellData, basis: str = "umap",
     cosines.  O(n·k·2) host math on fetched edge data — identical on
     both backends."""
     return _vembed(data, basis, scale)
+
+
+# ----------------------------------------------------------------------
+# velocity.terminal_states / velocity.fate_probabilities — CellRank-
+# style fate mapping on the velocity-directed kNN chain
+# ----------------------------------------------------------------------
+
+
+def _velocity_transition(data: CellData, scale: float,
+                         lambda_conn: float = 0.2, device=False):
+    """Row-stochastic T over the UNDIRECTED-UNION kNN edge list: a
+    (1−λ)/λ blend of the velocity kernel exp(cosine/scale) with a
+    uniform diffusive walk — CellRank's kernel-combination recipe.
+
+    Two details are load-bearing, both measured on the Y-fixture:
+
+    * the support is the union of each directed edge and its reverse
+      (wishbone's ``_sym_edges``).  Out-edge-only support broke
+      reachability: the overlap zone at a branch had no OUT-edge onto
+      one arm's continuation even though the reverse edge existed, so
+      that arm's absorption probability was exactly 0 for every
+      upstream cell;
+    * the diffusive component: a pure velocity kernel is near-
+      deterministic and funnels all mass through whichever branch
+      wins the first tie-break in the noise.
+
+    Cosines for added reverse edges are recomputed with the same
+    kernel ``velocity.graph`` uses (the jitted device path on the tpu
+    backend; chunked numpy on cpu)."""
+    n = data.n_cells
+    if "velocity" not in data.layers or "Ms" not in data.layers:
+        raise KeyError("velocity fate mapping: run velocity.estimate "
+                       "(and velocity.graph) first")
+    if "knn_indices" not in data.obsp:
+        raise KeyError("velocity fate mapping: run neighbors.knn first")
+    from .wishbone import _sym_edges
+
+    idx = np.asarray(data.obsp["knn_indices"])[:n]
+    dist = np.asarray(data.obsp.get(
+        "knn_distances", np.ones_like(idx, np.float32)), np.float64)[:n]
+    idx2, _ = _sym_edges(idx, dist)
+    genes = np.asarray(data.var.get(
+        "velocity_genes", np.ones(data.n_genes, bool)))
+    Ms = np.asarray(data.layers["Ms"], np.float32)[:n][:, genes]
+    V = np.asarray(data.layers["velocity"], np.float32)[:n][:, genes]
+    if device:
+        from ..config import round_up
+
+        K2 = idx2.shape[1]
+        chunk = min(_CHUNK, round_up(n, 8))
+        n_pad = round_up(n, chunk)
+        pad = lambda M: jnp.zeros((n_pad, M.shape[1]), jnp.float32
+                                  ).at[:n].set(jnp.asarray(M))
+        idx_pad = jnp.full((n_pad, K2), -1, jnp.int32
+                           ).at[:n].set(jnp.asarray(idx2))
+        cos = np.asarray(_velocity_cosines(
+            pad(Ms), pad(V), idx_pad, chunk=chunk), np.float64)[:n]
+    else:
+        vn = np.linalg.norm(V, axis=1)
+        cos = np.zeros_like(idx2, np.float64)
+        for lo in range(0, n, _CHUNK):
+            sl = slice(lo, min(lo + _CHUNK, n))
+            safe = np.where(idx2[sl] < 0, 0, idx2[sl])
+            delta = Ms[safe] - Ms[sl][:, None, :]
+            num = np.einsum("ckg,cg->ck", delta, V[sl])
+            dn = (np.linalg.norm(delta, axis=2)
+                  * np.maximum(vn[sl][:, None], 1e-12))
+            cos[sl] = np.where(idx2[sl] < 0, 0.0,
+                               num / np.maximum(dn, 1e-12))
+    ok = (idx2 >= 0).astype(np.float64)
+    Tv = ok * np.exp(cos / scale)
+    Tv /= np.maximum(Tv.sum(axis=1, keepdims=True), 1e-12)
+    Tc = ok / np.maximum(ok.sum(axis=1, keepdims=True), 1e-12)
+    T = (1.0 - lambda_conn) * Tv + lambda_conn * Tc
+    T /= np.maximum(T.sum(axis=1, keepdims=True), 1e-12)
+    return idx2, T
+
+
+@register("velocity.terminal_states", backend="tpu")
+@register("velocity.terminal_states", backend="cpu")
+def terminal_states(data: CellData, scale: float = 0.25,
+                    quantile: float = 0.95, min_cells: int = 5,
+                    n_iter: int = 300) -> CellData:
+    """Find absorbing regions of the velocity-directed chain: the
+    stationary distribution (power iteration of Tᵀ over the edge
+    list) concentrates on cells flow converges INTO; the top-quantile
+    cells are grouped into connected components and small groups are
+    dropped.  Adds obs["terminal_states"] (-1 = not terminal, else
+    group id) and uns["terminal_stationary"].  Host numpy — the chain
+    bookkeeping is O(n·k) and shared verbatim by both backends (the
+    heavy inputs, velocity graph and connectivities, were computed on
+    device upstream)."""
+    n = data.n_cells
+    idx, T = _velocity_transition(data, scale)
+    k = idx.shape[1]
+    # stationary distribution: pi <- pi T via edge scatter
+    pi = np.full(n, 1.0 / n)
+    rows = np.repeat(np.arange(n), k)
+    cols = np.where(idx >= 0, idx, 0).ravel()
+    vals = T.ravel()
+    for _ in range(n_iter):
+        nxt = np.zeros(n)
+        np.add.at(nxt, cols, vals * pi[rows])
+        s = nxt.sum()
+        if s <= 0:
+            break
+        nxt /= s
+        if np.abs(nxt - pi).max() < 1e-12:
+            pi = nxt
+            break
+        pi = nxt
+    thresh = np.quantile(pi, quantile)
+    top = np.where(pi >= thresh)[0]
+    # connected components among top cells (undirected kNN edges)
+    top_set = set(top.tolist())
+    label = {c: -1 for c in top.tolist()}
+    gid = 0
+    for c in top.tolist():
+        if label[c] != -1:
+            continue
+        stack = [c]
+        label[c] = gid
+        while stack:
+            u = stack.pop()
+            for v in idx[u]:
+                v = int(v)
+                if v in top_set and label[v] == -1:
+                    label[v] = gid
+                    stack.append(v)
+        gid += 1
+    counts = np.bincount([label[c] for c in top.tolist()],
+                         minlength=gid)
+    keep = {g for g in range(gid) if counts[g] >= min_cells}
+    remap = {g: i for i, g in enumerate(sorted(keep))}
+    out = np.full(n, -1, np.int32)
+    for c in top.tolist():
+        if label[c] in keep:
+            out[c] = remap[label[c]]
+    return (data.with_obs(terminal_states=out)
+            .with_uns(terminal_stationary=pi.astype(np.float32)))
+
+
+@register("velocity.fate_probabilities", backend="tpu")
+@register("velocity.fate_probabilities", backend="cpu")
+def fate_probabilities(data: CellData,
+                       terminal_key: str = "terminal_states",
+                       scale: float = 0.25,
+                       n_iter: int = 2000) -> CellData:
+    """Absorption probabilities of the velocity-directed chain into
+    each terminal group: iterate F <- Q F + R (Jacobi on the linear
+    system (I − Q) F = R — Q is substochastic on transient cells, so
+    the iteration contracts).  Adds obsm["fate_probs"]
+    (n x n_terminal; terminal rows are one-hot on their own group)."""
+    n = data.n_cells
+    if terminal_key not in data.obs:
+        raise KeyError("velocity.fate_probabilities: run "
+                       "velocity.terminal_states first")
+    term = np.asarray(data.obs[terminal_key])[:n].astype(int)
+    n_groups = int(term.max()) + 1
+    if n_groups < 1:
+        raise ValueError("velocity.fate_probabilities: no terminal "
+                         "states found")
+    idx, T = _velocity_transition(data, scale)
+    k = idx.shape[1]
+    absorbed = term >= 0
+    F = np.zeros((n, n_groups))
+    F[absorbed, term[absorbed]] = 1.0
+    safe = np.where(idx >= 0, idx, 0)
+    Tm = np.where(idx >= 0, T, 0.0)
+    transient = ~absorbed
+    for _ in range(n_iter):
+        nxt = np.einsum("ck,ckg->cg", Tm, F[safe])
+        nxt[absorbed] = F[absorbed]
+        if np.abs(nxt - F).max() < 1e-10:
+            F = nxt
+            break
+        F = nxt
+    # rows that never reach any terminal state stay ~0 — normalise
+    # only where mass arrived, leave true orphans at zero
+    s = F.sum(axis=1, keepdims=True)
+    F = np.where(s > 1e-8, F / np.maximum(s, 1e-12), 0.0)
+    F[absorbed] = 0.0
+    F[absorbed, term[absorbed]] = 1.0
+    return data.with_obsm(fate_probs=F.astype(np.float32))

@@ -119,3 +119,52 @@ def test_velocity_validates_inputs(vdata):
                   backend="cpu")
     with pytest.raises(KeyError, match="velocity.estimate"):
         sct.apply("velocity.graph", d, backend="cpu")
+
+
+def test_terminal_states_and_fate_probs():
+    """Y-shaped flow: velocities point from trunk into two arms; the
+    arm tips must be found as terminal states and trunk cells must
+    split fate mass between them."""
+    rng = np.random.default_rng(0)
+    n_t, n_a = 100, 100
+    t_tr = np.linspace(0, 1, n_t)
+    t_ar = np.linspace(0, 1, n_a)
+    trunk = np.stack([t_tr, np.zeros(n_t)], axis=1)
+    arm_a = np.stack([1 + t_ar, t_ar], axis=1)
+    arm_b = np.stack([1 + t_ar, -t_ar], axis=1)
+    E = np.vstack([trunk, arm_a, arm_b]) + rng.normal(0, 0.02, (300, 2))
+    # "gene space" = embedding; velocity = local flow direction
+    V = np.vstack([np.tile([1.0, 0.0], (n_t, 1)),
+                   np.tile([1.0, 1.0], (n_a, 1)) / np.sqrt(2),
+                   np.tile([1.0, -1.0], (n_a, 1)) / np.sqrt(2)])
+    d = CellData(E.astype(np.float32),
+                 obsm={"X_pca": np.asarray(
+                     np.hstack([E, rng.normal(0, 0.01, (300, 4))]),
+                     np.float32)})
+    d = d.with_layers(Ms=E.astype(np.float32),
+                      velocity=V.astype(np.float32))
+    d = d.with_var(velocity_genes=np.ones(2, bool))
+    d = sct.apply("neighbors.knn", d, backend="cpu", k=10,
+                  metric="euclidean")
+    d = sct.apply("velocity.graph", d, backend="cpu")
+    d = sct.apply("velocity.terminal_states", d, backend="cpu",
+                  quantile=0.93)
+    term = np.asarray(d.obs["terminal_states"])
+    groups = sorted(set(term[term >= 0].tolist()))
+    assert len(groups) == 2  # the two arm tips
+    # terminal cells sit late on the arms (x > 1.5)
+    assert E[term >= 0, 0].min() > 1.4
+    d = sct.apply("velocity.fate_probabilities", d, backend="cpu")
+    F = np.asarray(d.obsm["fate_probs"])
+    assert F.shape == (300, 2)
+    # early trunk: both fates reachable, neither dominating
+    early = np.where(E[:, 0] < 0.3)[0]
+    assert (F[early].sum(axis=1) > 0.99).all()
+    assert 0.2 < F[early, 0].mean() < 0.8
+    # mid-arm cells (excluding the terminal tips themselves) commit to
+    # their own arm's terminal group
+    arm_a_idx = np.arange(n_t, n_t + n_a)[
+        (E[n_t:n_t + n_a, 0] > 1.3) & (term[n_t:n_t + n_a] < 0)]
+    ga = np.bincount(term[term >= 0][
+        E[term >= 0, 1] > 0], minlength=2).argmax()
+    assert F[arm_a_idx, ga].mean() > 0.9
