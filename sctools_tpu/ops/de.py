@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..data.dataset import CellData
-from ..data.sparse import SparseCells, spmm_t
+from ..data.sparse import SparseCells, spmm, spmm_t
 from ..registry import register
 
 
@@ -264,6 +264,54 @@ def _finalise(data, scores, pvals, lfc, levels, method, n_top):
     return data.with_uns(rank_genes_groups=result)
 
 
+def _logreg_scores(data: CellData, codes, n_groups, l2: float = 1e-4,
+                   n_steps: int = 300, lr: float = 0.1, seed: int = 0):
+    """Multinomial logistic-regression coefficients (scanpy's
+    method="logreg" scores): softmax CE + L2, optax Adam, full-batch,
+    logits via ``spmm`` so sparse X never densifies.  The SAME jax
+    program serves both backends (logreg has no scipy oracle in this
+    environment; the tests gate it on marker recovery instead)."""
+    import optax
+
+    n = data.n_cells
+    X = data.X
+    y = jnp.asarray(codes[:n])
+    dense = not isinstance(X, SparseCells)
+    if dense:
+        Xd = jnp.asarray(
+            X.toarray() if hasattr(X, "toarray") else X
+        )[:n].astype(jnp.float32)
+
+    def logits_of(W, b):
+        if dense:
+            return Xd @ W + b
+        out = spmm(X, W)[:n] + b  # (rows_padded, k) -> valid rows
+        return out
+
+    key = jax.random.PRNGKey(seed)
+    params = {"W": 1e-3 * jax.random.normal(
+        key, (data.n_genes, n_groups), jnp.float32),
+        "b": jnp.zeros((n_groups,), jnp.float32)}
+
+    def loss_fn(p):
+        lg = jax.nn.log_softmax(logits_of(p["W"], p["b"]), axis=1)
+        ce = -jnp.mean(jnp.take_along_axis(lg, y[:, None], axis=1))
+        return ce + l2 * jnp.sum(p["W"] ** 2)
+
+    tx = optax.adam(lr)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt):
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        up, opt = tx.update(g, opt, params)
+        return optax.apply_updates(params, up), opt, loss
+
+    for _ in range(n_steps):
+        params, opt, _ = step(params, opt)
+    return np.asarray(params["W"]).T  # (n_groups, n_genes)
+
+
 def _rank_genes_groups(data: CellData, groupby: str, method: str,
                        n_top, tie_correct: bool, dense_ranks_via,
                        group_moments):
@@ -272,7 +320,12 @@ def _rank_genes_groups(data: CellData, groupby: str, method: str,
     codes_host, levels, n_obs = _group_codes(data, groupby)
     n_groups = len(levels)
 
-    if method in ("t-test", "t-test_overestim_var"):
+    if method == "logreg":
+        scores = _logreg_scores(data, codes_host, n_groups)
+        pvals = np.full_like(scores, np.nan)  # scanpy parity: no pvals
+        s, _, cnt2 = group_moments(codes_host, n_groups, need_ss=False)
+        m_g, m_r = _group_means(s, cnt2)
+    elif method in ("t-test", "t-test_overestim_var"):
         s, ss, cnt = group_moments(codes_host, n_groups, need_ss=True)
         t, df, m_g, m_r = _welch_stats(
             s, ss, cnt, overestim_var=(method == "t-test_overestim_var"))
@@ -287,7 +340,8 @@ def _rank_genes_groups(data: CellData, groupby: str, method: str,
         m_g, m_r = _group_means(s, cnt2)
     else:
         raise ValueError(f"unknown method {method!r}; use 't-test', "
-                         f"'t-test_overestim_var' or 'wilcoxon'")
+                         f"'t-test_overestim_var', 'wilcoxon' or "
+                         f"'logreg'")
     lfc = _logfoldchange(m_g, m_r)
     return _finalise(data, scores, pvals, lfc, levels, method, n_top)
 
