@@ -188,14 +188,55 @@ def _seurat_v3_scores_from_stats(mean, var, clipped_ssq, n, xp):
     return xp.where((mean > 0) & (var > 0), std_var, 0.0)
 
 
+
+
+def _hvg_batched(data: CellData, n_top, flavor, subset, compact,
+                 batch_key, single, subset_fn):
+    """scanpy batch_key semantics: score each batch separately
+    (per-batch cell subsets via CellData.__getitem__), then combine —
+    genes flagged in MORE batches win, median per-batch rank breaks
+    ties.  Adds var["highly_variable_nbatches"]."""
+    n = data.n_cells
+    if batch_key not in data.obs:
+        raise KeyError(f"hvg.select: obs has no {batch_key!r}")
+    labels = np.asarray(data.obs[batch_key])[:n]
+    ranks, flags = [], []
+    for b in np.unique(labels):
+        scored = single(data[labels == b])
+        ranks.append(np.asarray(scored.var["hvg_rank"]))
+        flags.append(np.asarray(scored.var["highly_variable"]))
+    nb = np.sum(np.stack(flags), axis=0).astype(np.int32)
+    med = np.median(np.stack(ranks), axis=0)
+    order = np.lexsort((med, -nb))
+    G = data.n_genes
+    rank = np.empty(G, np.int64)
+    rank[order] = np.arange(G)
+    highly = rank < n_top
+    out = data.with_var(
+        highly_variable=highly, hvg_rank=rank.astype(np.int32),
+        highly_variable_nbatches=nb,
+        hvg_score=(-med).astype(np.float32))
+    if subset:
+        out = subset_fn(out, np.sort(order[:n_top]), compact=compact)
+    return out
+
 @register("hvg.select", backend="tpu")
 def hvg_select_tpu(data: CellData, n_top: int = 2000,
                    flavor: str = "seurat_v3", subset: bool = False,
-                   compact: bool = True) -> CellData:
+                   compact: bool = True,
+                   batch_key: str | None = None) -> CellData:
     """Rank genes by variability; adds var: ``highly_variable``,
     ``hvg_rank``, ``hvg_score`` (+ ``means``/``variances``).  With
     ``subset=True`` returns the gene-subset CellData (materialisation
-    point, like the reference's shard repack)."""
+    point, like the reference's shard repack).  ``batch_key`` scores
+    each batch separately and rank-combines (scanpy semantics: genes
+    variable in MORE batches win, median per-batch rank breaks ties;
+    adds ``highly_variable_nbatches``)."""
+    if batch_key is not None:
+        return _hvg_batched(
+            data, n_top, flavor, subset, compact, batch_key,
+            lambda d: hvg_select_tpu(d, n_top=n_top, flavor=flavor),
+            select_genes_device)
     X = data.X
     if flavor == "seurat_v3":
         mean, var, nnz = _gene_moments_tpu(X)
@@ -260,9 +301,15 @@ def hvg_select_tpu(data: CellData, n_top: int = 2000,
 @register("hvg.select", backend="cpu")
 def hvg_select_cpu(data: CellData, n_top: int = 2000,
                    flavor: str = "seurat_v3", subset: bool = False,
-                   compact: bool = True) -> CellData:
+                   compact: bool = True,
+                   batch_key: str | None = None) -> CellData:
     import scipy.sparse as sp
 
+    if batch_key is not None:
+        return _hvg_batched(
+            data, n_top, flavor, subset, compact, batch_key,
+            lambda d: hvg_select_cpu(d, n_top=n_top, flavor=flavor),
+            select_genes_device)
     X = data.X
     mean, var = _gene_moments_cpu(X)
     n = data.n_cells

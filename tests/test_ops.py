@@ -253,3 +253,44 @@ def test_qc_percent_top_genes():
     few = np.asarray(cpu.obs["n_genes"]) <= 10
     if few.any():
         np.testing.assert_allclose(c10[few], 100.0, rtol=1e-6)
+
+
+def test_hvg_batch_key_combines_ranks():
+    """batch_key: a gene variable only through a batch-specific shift
+    must LOSE to genes variable within every batch."""
+    import scipy.sparse as sp
+
+    from sctools_tpu.data.synthetic import synthetic_counts
+
+    rng = np.random.default_rng(0)
+    d = synthetic_counts(600, 400, density=0.15, n_clusters=3, seed=9)
+    X = np.asarray(d.X.todense())
+    # gene 0: constant within each batch, big shift BETWEEN batches
+    X[:, 0] = 1.0
+    X[300:, 0] = 50.0
+    batch = np.array(["a"] * 300 + ["b"] * 300)
+    d = d.with_X(sp.csr_matrix(X.astype(np.float32))).with_obs(
+        sample=batch)
+
+    plain = sct.apply("hvg.select", d, backend="cpu", n_top=50,
+                      flavor="seurat_v3")
+    batched = sct.apply("hvg.select", d, backend="cpu", n_top=50,
+                        flavor="seurat_v3", batch_key="sample")
+    # without batch awareness the shifted gene looks hyper-variable
+    assert bool(np.asarray(plain.var["highly_variable"])[0])
+    # batch-aware ranking sends it down the list
+    assert not bool(np.asarray(batched.var["highly_variable"])[0])
+    nb = np.asarray(batched.var["highly_variable_nbatches"])
+    assert nb.max() == 2 and nb.min() >= 0
+    # tpu path agrees on the selection
+    batched_t = sct.apply("hvg.select", d.device_put(), backend="tpu",
+                          n_top=50, flavor="seurat_v3",
+                          batch_key="sample")
+    a = np.asarray(batched.var["highly_variable"])
+    b = np.asarray(batched_t.var["highly_variable"])
+    assert (a == b).mean() > 0.98
+    # subset=True materialises the combined selection
+    subd = sct.apply("hvg.select", d, backend="cpu", n_top=50,
+                     flavor="seurat_v3", batch_key="sample",
+                     subset=True)
+    assert subd.n_genes == 50
