@@ -278,37 +278,35 @@ def _logreg_scores(data: CellData, codes, n_groups, l2: float = 1e-4,
     y = jnp.asarray(codes[:n])
     dense = not isinstance(X, SparseCells)
     if dense:
-        Xd = jnp.asarray(
+        X = jnp.asarray(
             X.toarray() if hasattr(X, "toarray") else X
         )[:n].astype(jnp.float32)
-
-    def logits_of(W, b):
-        if dense:
-            return Xd @ W + b
-        out = spmm(X, W)[:n] + b  # (rows_padded, k) -> valid rows
-        return out
 
     key = jax.random.PRNGKey(seed)
     params = {"W": 1e-3 * jax.random.normal(
         key, (data.n_genes, n_groups), jnp.float32),
         "b": jnp.zeros((n_groups,), jnp.float32)}
-
-    def loss_fn(p):
-        lg = jax.nn.log_softmax(logits_of(p["W"], p["b"]), axis=1)
-        ce = -jnp.mean(jnp.take_along_axis(lg, y[:, None], axis=1))
-        return ce + l2 * jnp.sum(p["W"] ** 2)
-
     tx = optax.adam(lr)
     opt = tx.init(params)
 
+    # X and y enter as jit ARGUMENTS (X is a pytree either way) —
+    # closing over them would bake the matrix into the jaxpr as a
+    # constant, the large-constant pathology models/scvi.py documents
     @jax.jit
-    def step(params, opt):
+    def step(params, opt, Xop, yv):
+        def loss_fn(p):
+            logits = ((Xop @ p["W"] if dense
+                       else spmm(Xop, p["W"])[:n]) + p["b"])
+            lg = jax.nn.log_softmax(logits, axis=1)
+            ce = -jnp.mean(jnp.take_along_axis(lg, yv[:, None], axis=1))
+            return ce + l2 * jnp.sum(p["W"] ** 2)
+
         loss, g = jax.value_and_grad(loss_fn)(params)
         up, opt = tx.update(g, opt, params)
         return optax.apply_updates(params, up), opt, loss
 
     for _ in range(n_steps):
-        params, opt, _ = step(params, opt)
+        params, opt, _ = step(params, opt, X, y)
     return np.asarray(params["W"]).T  # (n_groups, n_genes)
 
 

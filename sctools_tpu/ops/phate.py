@@ -57,8 +57,12 @@ def _kernel(idx, dist, ka, xp, alpha: float = 2.0):
         W[rows[keep], cols[keep]] = w.reshape(-1)[keep]
     else:
         safe = jnp.where(idx < 0, 0, idx)
+        # .add, not .set: padded -1 slots alias column 0, and duplicate
+        # .set indices keep an arbitrary winner — a real edge to cell 0
+        # could be clobbered by the padding's 0.0.  Adding a masked 0
+        # is harmless.
         W = jnp.zeros((n, n)).at[
-            jnp.asarray(rows), safe.reshape(-1)].set(
+            jnp.asarray(rows), safe.reshape(-1)].add(
             jnp.where(idx < 0, 0.0, w).reshape(-1))
     W = 0.5 * (W + W.T)
     return W / xp.maximum(W.sum(axis=1, keepdims=True), 1e-12)
@@ -132,8 +136,9 @@ def _phate_device(idx, dist, key, *, t: int, n_components: int,
     for _ in range(n_iter):
         Q = cholesky_qr(Uc @ (Uc.T @ Q))
     B = Q.T @ Uc
-    _, S, _ = jnp.linalg.svd(B, full_matrices=False)
-    V = Q @ jnp.linalg.svd(B @ B.T, full_matrices=False)[0]
+    # one SVD: B's left singular vectors ARE the eigenvectors of B Bᵀ
+    U_b, S, _ = jnp.linalg.svd(B, full_matrices=False)
+    V = Q @ U_b
     emb = V[:, :n_components] * S[:n_components]
     return emb
 
@@ -154,8 +159,13 @@ def phate_tpu(data: CellData, n_components: int = 2,
     diffusion time by the von Neumann entropy knee (host, on the
     kernel spectrum).  Exact PHATE is O(n²) — see module docstring."""
     idx, dist = _require_graph(data)
-    P = _kernel(idx, dist.astype(np.float64), ka, np, alpha)
-    t_used = _von_neumann_t(P, np) if t is None else t
+    if t is None:
+        # the auto-t spectrum needs the dense host kernel — only pay
+        # the O(n²) f64 build when t was not given
+        P = _kernel(idx, dist.astype(np.float64), ka, np, alpha)
+        t_used = _von_neumann_t(P, np)
+    else:
+        t_used = t
     emb = np.asarray(_phate_device(
         jnp.asarray(idx), jnp.asarray(dist), jax.random.PRNGKey(seed),
         t=int(t_used), n_components=n_components, ka=ka,

@@ -403,11 +403,10 @@ def _velocity_transition(data: CellData, scale: float,
     return idx2, T
 
 
-@register("velocity.terminal_states", backend="tpu")
 @register("velocity.terminal_states", backend="cpu")
 def terminal_states(data: CellData, scale: float = 0.25,
                     quantile: float = 0.95, min_cells: int = 5,
-                    n_iter: int = 300) -> CellData:
+                    n_iter: int = 300, device: bool = False) -> CellData:
     """Find absorbing regions of the velocity-directed chain: the
     stationary distribution (power iteration of Tᵀ over the edge
     list) concentrates on cells flow converges INTO; the top-quantile
@@ -418,7 +417,7 @@ def terminal_states(data: CellData, scale: float = 0.25,
     heavy inputs, velocity graph and connectivities, were computed on
     device upstream)."""
     n = data.n_cells
-    idx, T = _velocity_transition(data, scale)
+    idx, T = _velocity_transition(data, scale, device=device)
     k = idx.shape[1]
     # stationary distribution: pi <- pi T via edge scatter
     pi = np.full(n, 1.0 / n)
@@ -467,12 +466,11 @@ def terminal_states(data: CellData, scale: float = 0.25,
             .with_uns(terminal_stationary=pi.astype(np.float32)))
 
 
-@register("velocity.fate_probabilities", backend="tpu")
 @register("velocity.fate_probabilities", backend="cpu")
 def fate_probabilities(data: CellData,
                        terminal_key: str = "terminal_states",
-                       scale: float = 0.25,
-                       n_iter: int = 2000) -> CellData:
+                       scale: float = 0.25, n_iter: int = 2000,
+                       device: bool = False) -> CellData:
     """Absorption probabilities of the velocity-directed chain into
     each terminal group: iterate F <- Q F + R (Jacobi on the linear
     system (I − Q) F = R — Q is substochastic on transient cells, so
@@ -487,7 +485,7 @@ def fate_probabilities(data: CellData,
     if n_groups < 1:
         raise ValueError("velocity.fate_probabilities: no terminal "
                          "states found")
-    idx, T = _velocity_transition(data, scale)
+    idx, T = _velocity_transition(data, scale, device=device)
     k = idx.shape[1]
     absorbed = term >= 0
     F = np.zeros((n, n_groups))
@@ -509,3 +507,24 @@ def fate_probabilities(data: CellData,
     F[absorbed] = 0.0
     F[absorbed, term[absorbed]] = 1.0
     return data.with_obsm(fate_probs=F.astype(np.float32))
+
+
+@register("velocity.terminal_states", backend="tpu")
+def terminal_states_tpu(data: CellData, scale: float = 0.25,
+                        quantile: float = 0.95, min_cells: int = 5,
+                        n_iter: int = 300) -> CellData:
+    """tpu backend: union-edge cosine recomputation runs through the
+    jitted _velocity_cosines kernel; the O(n·k) chain bookkeeping
+    stays host numpy (see terminal_states)."""
+    return terminal_states(data, scale, quantile, min_cells, n_iter,
+                           device=True)
+
+
+@register("velocity.fate_probabilities", backend="tpu")
+def fate_probabilities_tpu(data: CellData,
+                           terminal_key: str = "terminal_states",
+                           scale: float = 0.25,
+                           n_iter: int = 2000) -> CellData:
+    """tpu backend of :func:`fate_probabilities` (device cosines)."""
+    return fate_probabilities(data, terminal_key, scale, n_iter,
+                              device=True)

@@ -168,3 +168,32 @@ def test_terminal_states_and_fate_probs():
     ga = np.bincount(term[term >= 0][
         E[term >= 0, 1] > 0], minlength=2).argmax()
     assert F[arm_a_idx, ga].mean() > 0.9
+
+
+def test_fate_tpu_backend_matches_cpu():
+    """The tpu backend recomputes union-edge cosines on device — same
+    terminal states and closely matching fate probabilities."""
+    rng = np.random.default_rng(1)
+    n = 150
+    t = np.linspace(0, 1, n)
+    E = np.stack([t, np.zeros(n)], axis=1) + rng.normal(0, 0.01, (n, 2))
+    V = np.tile([1.0, 0.0], (n, 1))
+    d = CellData(E.astype(np.float32),
+                 obsm={"X_pca": np.asarray(
+                     np.hstack([E, rng.normal(0, 0.01, (n, 3))]),
+                     np.float32)})
+    d = d.with_layers(Ms=E.astype(np.float32),
+                      velocity=V.astype(np.float32))
+    d = d.with_var(velocity_genes=np.ones(2, bool))
+    d = sct.apply("neighbors.knn", d, backend="cpu", k=8,
+                  metric="euclidean")
+    d = sct.apply("velocity.graph", d, backend="cpu")
+    a = sct.apply("velocity.terminal_states", d, backend="cpu")
+    b = sct.apply("velocity.terminal_states", d, backend="tpu")
+    np.testing.assert_array_equal(np.asarray(a.obs["terminal_states"]),
+                                  np.asarray(b.obs["terminal_states"]))
+    fa = sct.apply("velocity.fate_probabilities", a, backend="cpu")
+    fb = sct.apply("velocity.fate_probabilities", a, backend="tpu")
+    np.testing.assert_allclose(np.asarray(fa.obsm["fate_probs"]),
+                               np.asarray(fb.obsm["fate_probs"]),
+                               atol=2e-3)
