@@ -174,6 +174,32 @@ class CellData:
         )
 
     # ------------------------------------------------------------------
+    def obs_vector(self, key: str) -> np.ndarray:
+        """AnnData ``obs_vector``: an obs column, or a GENE's expression
+        across cells (matched via var["gene_name"]) — the accessor
+        plotting/inspection code reaches for."""
+        if key in self.obs:
+            return np.asarray(self.obs[key])[: self.n_cells]
+        names = self.var.get("gene_name")
+        if names is not None:
+            pos = np.nonzero(np.asarray(names).astype(str) == key)[0]
+            if len(pos):
+                return _column_1d(self[:, int(pos[0])].X, self.n_cells)
+        raise KeyError(
+            f"obs_vector: {key!r} is neither an obs column nor a gene "
+            "name")
+
+    def var_vector(self, key: str) -> np.ndarray:
+        """AnnData ``var_vector``: a var column, or a CELL's expression
+        across genes (by integer position — CellData has no obs
+        index)."""
+        if key in self.var:
+            return np.asarray(self.var[key])[: self.n_genes]
+        if isinstance(key, (int, np.integer)):
+            return _column_1d(self[int(key)].X, self.n_genes)
+        raise KeyError(f"var_vector: {key!r} is not a var column")
+
+    # ------------------------------------------------------------------
     def __getitem__(self, key) -> "CellData":
         """AnnData-style subsetting: ``d[cells]`` / ``d[:, genes]`` /
         ``d[cells, genes]``.  Selectors: slices, boolean masks, int
@@ -229,6 +255,16 @@ class CellData:
 
 def _is_arraylike(v) -> bool:
     return isinstance(v, (np.ndarray, jax.Array)) or np.isscalar(v)
+
+
+def _column_1d(M, n: int) -> np.ndarray:
+    """A 1-row/1-column X slice as a flat numpy vector, whatever the
+    residency (scipy / dense / SparseCells)."""
+    if isinstance(M, SparseCells):
+        return np.asarray(M.to_dense()).ravel()[:n]
+    if hasattr(M, "toarray"):
+        return M.toarray().ravel()[:n]
+    return np.asarray(M).ravel()[:n]
 
 
 def _normalize_axis_key(key, n: int, names, axis: str):

@@ -291,3 +291,26 @@ def test_getitem_gene_axis_rejects_long_mask():
 
     with _pt.raises(IndexError, match="gene mask"):
         d[:, np.ones(10, bool)]
+
+
+def test_obs_vector_var_vector():
+    import scipy.sparse as sp
+
+    from sctools_tpu.data.dataset import CellData
+
+    dense = np.arange(12, dtype=np.float32).reshape(4, 3)
+    d = CellData(sp.csr_matrix(dense),
+                 obs={"depth": np.array([1.0, 2, 3, 4])},
+                 var={"gene_name": np.array(["a", "b", "c"]),
+                      "hv": np.array([True, False, True])})
+    np.testing.assert_array_equal(d.obs_vector("depth"), [1, 2, 3, 4])
+    np.testing.assert_array_equal(d.obs_vector("b"), dense[:, 1])
+    np.testing.assert_array_equal(d.var_vector("hv"),
+                                  [True, False, True])
+    import pytest as _pt
+
+    with _pt.raises(KeyError):
+        d.obs_vector("nope")
+    # device data works too (getitem handles both residencies)
+    dev = d.device_put()
+    np.testing.assert_allclose(dev.obs_vector("b"), dense[:, 1])
