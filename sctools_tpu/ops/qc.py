@@ -168,7 +168,8 @@ def per_gene_metrics_cpu(data: CellData) -> CellData:
 # ----------------------------------------------------------------------
 
 
-def _cell_keep_mask(data: CellData, min_genes, min_counts, max_pct_mt, xp):
+def _cell_keep_mask(data: CellData, min_genes, min_counts, max_pct_mt,
+                    xp, max_genes=None, max_counts=None):
     obs = data.obs
     need = [k for k in ("n_genes", "total_counts") if k not in obs]
     if need:
@@ -178,8 +179,12 @@ def _cell_keep_mask(data: CellData, min_genes, min_counts, max_pct_mt, xp):
     keep = xp.ones(obs["n_genes"].shape, bool)
     if min_genes is not None:
         keep &= obs["n_genes"] >= min_genes
+    if max_genes is not None:
+        keep &= obs["n_genes"] <= max_genes
     if min_counts is not None:
         keep &= obs["total_counts"] >= min_counts
+    if max_counts is not None:
+        keep &= obs["total_counts"] <= max_counts
     if max_pct_mt is not None and "pct_counts_mt" in obs:
         keep &= obs["pct_counts_mt"] <= max_pct_mt
     return keep
@@ -191,10 +196,19 @@ def filter_cells_tpu(
     min_genes: int | None = None,
     min_counts: float | None = None,
     max_pct_mt: float | None = None,
+    max_genes: int | None = None,
+    max_counts: float | None = None,
 ) -> CellData:
     X = data.X
-    keep = _cell_keep_mask(data, min_genes, min_counts, max_pct_mt, jnp)
+    keep = _cell_keep_mask(data, min_genes, min_counts, max_pct_mt, jnp,
+                           max_genes, max_counts)
     if isinstance(X, SparseCells):
+        if keep.shape[0] < X.rows_padded:
+            # obs metrics computed on the cpu backend are n_cells long;
+            # device-computed ones carry padded rows — align before
+            # masking
+            keep = jnp.concatenate([
+                keep, jnp.zeros(X.rows_padded - keep.shape[0], bool)])
         keep = keep & X.row_mask()
     keep_host = np.asarray(keep)
     idx = np.nonzero(keep_host)[0]
@@ -295,8 +309,12 @@ def filter_cells_cpu(
     min_genes: int | None = None,
     min_counts: float | None = None,
     max_pct_mt: float | None = None,
+    max_genes: int | None = None,
+    max_counts: float | None = None,
 ) -> CellData:
-    keep = np.asarray(_cell_keep_mask(data, min_genes, min_counts, max_pct_mt, np))
+    keep = np.asarray(_cell_keep_mask(data, min_genes, min_counts,
+                                      max_pct_mt, np, max_genes,
+                                      max_counts))
     X = data.X[keep]
     obs = {k: np.asarray(v)[keep] for k, v in data.obs.items()}
     obsm = {k: np.asarray(v)[keep] for k, v in data.obsm.items()}
@@ -306,7 +324,9 @@ def filter_cells_cpu(
 
 @register("qc.filter_genes", backend="tpu")
 def filter_genes_tpu(data: CellData, min_cells: int | None = 3,
-                     min_counts: float | None = None) -> CellData:
+                     min_counts: float | None = None,
+                     max_cells: int | None = None,
+                     max_counts: float | None = None) -> CellData:
     from .hvg import select_genes_device  # shared gene-subset machinery
 
     if "n_cells" not in data.var:
@@ -314,22 +334,32 @@ def filter_genes_tpu(data: CellData, min_cells: int | None = 3,
     keep = jnp.ones(data.n_genes, bool)
     if min_cells is not None:
         keep &= data.var["n_cells"] >= min_cells
+    if max_cells is not None:
+        keep &= data.var["n_cells"] <= max_cells
     if min_counts is not None:
         keep &= data.var["total_counts"] >= min_counts
+    if max_counts is not None:
+        keep &= data.var["total_counts"] <= max_counts
     idx = np.nonzero(np.asarray(keep))[0]
     return select_genes_device(data, idx)
 
 
 @register("qc.filter_genes", backend="cpu")
 def filter_genes_cpu(data: CellData, min_cells: int | None = 3,
-                     min_counts: float | None = None) -> CellData:
+                     min_counts: float | None = None,
+                     max_cells: int | None = None,
+                     max_counts: float | None = None) -> CellData:
     if "n_cells" not in data.var:
         data = per_gene_metrics_cpu(data)
     keep = np.ones(data.n_genes, bool)
     if min_cells is not None:
         keep &= np.asarray(data.var["n_cells"]) >= min_cells
+    if max_cells is not None:
+        keep &= np.asarray(data.var["n_cells"]) <= max_cells
     if min_counts is not None:
         keep &= np.asarray(data.var["total_counts"]) >= min_counts
+    if max_counts is not None:
+        keep &= np.asarray(data.var["total_counts"]) <= max_counts
     X = data.X[:, keep]
     var = {k: np.asarray(v)[keep] for k, v in data.var.items()}
     varm = {k: np.asarray(v)[keep] for k, v in data.varm.items()}

@@ -294,3 +294,28 @@ def test_hvg_batch_key_combines_ranks():
                      flavor="seurat_v3", batch_key="sample",
                      subset=True)
     assert subd.n_genes == 50
+
+
+def test_filter_max_bounds():
+    """scanpy parity: max_genes/max_counts (cells) and
+    max_cells/max_counts (genes) upper bounds."""
+    from sctools_tpu.data.synthetic import synthetic_counts
+
+    d = synthetic_counts(300, 200, density=0.2, n_clusters=2, seed=5)
+    d = sct.apply("qc.per_cell_metrics", d, backend="cpu")
+    ng = np.asarray(d.obs["n_genes"])
+    hi = int(np.percentile(ng, 90))
+    f = sct.apply("qc.filter_cells", d, backend="cpu", max_genes=hi)
+    assert f.n_cells == int((ng <= hi).sum())
+    f2 = sct.apply("qc.filter_cells", d.device_put(), backend="tpu",
+                   max_genes=hi)
+    assert f2.n_cells == f.n_cells
+    nc = np.asarray(sct.apply("qc.per_gene_metrics", d,
+                              backend="cpu").var["n_cells"])
+    hic = int(np.percentile(nc, 80))
+    g = sct.apply("qc.filter_genes", d, backend="cpu", min_cells=None,
+                  max_cells=hic)
+    assert g.n_genes == int((nc <= hic).sum())
+    g2 = sct.apply("qc.filter_genes", d.device_put(), backend="tpu",
+                   min_cells=None, max_cells=hic)
+    assert g2.n_genes == g.n_genes
