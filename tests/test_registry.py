@@ -82,3 +82,20 @@ def test_every_registered_op_is_documented():
     assert len(ops) > 50
     missing = [o for o in ops if o not in docs]
     assert not missing, f"ops missing from docs: {missing}"
+
+
+def test_api_docs_are_fresh():
+    """docs/API.md is generated from the registry; regenerate and
+    compare so a new op cannot ship with a stale reference page."""
+    import os
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(root, "tools"))
+    import gen_api_docs
+
+    want = gen_api_docs.generate()
+    with open(os.path.join(root, "docs", "API.md")) as f:
+        got = f.read()
+    assert got == want, ("docs/API.md is stale — run "
+                         "python tools/gen_api_docs.py")
