@@ -44,25 +44,97 @@ def _library_size_dense(x: jax.Array, target_sum):
     return x * scale[:, None], totals
 
 
+def _he_gene_flag_device(x: SparseCells, totals, max_fraction):
+    """Genes taking > max_fraction of ANY cell's counts (scanpy's
+    exclude_highly_expressed rule).  Indicator slots -> one segment
+    sum; no scatter-max needed."""
+    from ..data.sparse import segment_reduce
+
+    n_cells = x.n_cells
+    sentinel = x.sentinel
+    inv_tot = jnp.where(totals > 0, 1.0 / jnp.maximum(totals, 1e-12),
+                        0.0)
+
+    def slot_vals(ind, dat, row_offset):
+        rows = row_offset + jnp.arange(ind.shape[0])
+        valid = (ind != sentinel) & (rows < n_cells)[:, None]
+        frac = dat * jnp.take(inv_tot, jnp.minimum(
+            rows, len(totals) - 1))[:, None]
+        return (valid & (frac > max_fraction)).astype(dat.dtype)[
+            :, :, None]
+
+    return segment_reduce(x, slot_vals, 1)[:, 0] > 0
+
+
 @register("normalize.library_size", backend="tpu")
-def library_size_tpu(data: CellData, target_sum: float | None = 1e4) -> CellData:
+def library_size_tpu(data: CellData, target_sum: float | None = 1e4,
+                     exclude_highly_expressed: bool = False,
+                     max_fraction: float = 0.05) -> CellData:
     """Scale every cell to ``target_sum`` total counts (median of
-    totals when ``target_sum=None``)."""
-    if isinstance(data.X, SparseCells):
-        X, totals = _library_size_sparse(data.X, target_sum)
+    totals when ``target_sum=None``).  ``exclude_highly_expressed``
+    (scanpy ``normalize_total`` parity): genes taking more than
+    ``max_fraction`` of ANY cell's counts are left out of the size
+    computation — so one hyper-abundant transcript cannot deflate
+    every other gene of its cell — but are still scaled."""
+    X = data.X
+    if isinstance(X, SparseCells):
+        if exclude_highly_expressed:
+            totals_all = row_sum(X)
+            he = _he_gene_flag_device(X, totals_all, max_fraction)
+            table = jnp.concatenate([
+                he.astype(X.data.dtype), jnp.zeros((1,), X.data.dtype)])
+            he_counts = jnp.sum(
+                X.data * jnp.take(table, X.indices), axis=1)
+            totals = totals_all - he_counts
+            if target_sum is None:
+                valid = X.row_mask()
+                target = jnp.nanmedian(
+                    jnp.where(valid, totals, jnp.nan))
+            else:
+                target = jnp.asarray(target_sum, X.data.dtype)
+            scale = jnp.where(totals > 0,
+                              target / jnp.maximum(totals, 1e-12), 0.0)
+            Xs = X.with_data(X.data * scale[:, None])
+            return (data.with_X(Xs).with_obs(library_size=totals)
+                    .with_var(highly_expressed=he))
+        Xs, totals = _library_size_sparse(X, target_sum)
     else:
-        X, totals = _library_size_dense(jnp.asarray(data.X), target_sum)
-    return data.with_X(X).with_obs(library_size=totals)
+        Xd = jnp.asarray(X)
+        if exclude_highly_expressed:
+            totals_all = jnp.sum(Xd, axis=1)
+            frac = Xd / jnp.maximum(totals_all[:, None], 1e-12)
+            he = jnp.any(frac > max_fraction, axis=0)
+            totals = jnp.sum(jnp.where(he[None, :], 0.0, Xd), axis=1)
+            target = (jnp.median(totals) if target_sum is None
+                      else jnp.asarray(target_sum, Xd.dtype))
+            scale = jnp.where(totals > 0,
+                              target / jnp.maximum(totals, 1e-12), 0.0)
+            return (data.with_X(Xd * scale[:, None])
+                    .with_obs(library_size=totals)
+                    .with_var(highly_expressed=he))
+        Xs, totals = _library_size_dense(Xd, target_sum)
+    return data.with_X(Xs).with_obs(library_size=totals)
 
 
 @register("normalize.library_size", backend="cpu")
-def library_size_cpu(data: CellData, target_sum: float | None = 1e4) -> CellData:
+def library_size_cpu(data: CellData, target_sum: float | None = 1e4,
+                     exclude_highly_expressed: bool = False,
+                     max_fraction: float = 0.05) -> CellData:
     import scipy.sparse as sp
 
     X = data.X
+    he = None
     if sp.issparse(X):
         X = X.tocsr().astype(np.float64).astype(np.float32)
         totals = np.asarray(X.sum(axis=1)).ravel()
+        if exclude_highly_expressed:
+            inv = np.divide(1.0, totals, out=np.zeros_like(totals),
+                            where=totals > 0)
+            frac = sp.diags(inv) @ X
+            he = np.asarray(
+                (frac > max_fraction).max(axis=0).todense()).ravel()
+            totals = totals - np.asarray(
+                X[:, he].sum(axis=1)).ravel()
         target = np.median(totals) if target_sum is None else target_sum
         scale = np.divide(target, totals, out=np.zeros_like(totals),
                           where=totals > 0)
@@ -70,11 +142,18 @@ def library_size_cpu(data: CellData, target_sum: float | None = 1e4) -> CellData
     else:
         X = np.asarray(X, dtype=np.float32)
         totals = X.sum(axis=1)
+        if exclude_highly_expressed:
+            frac = X / np.maximum(totals[:, None], 1e-12)
+            he = (frac > max_fraction).any(axis=0)
+            totals = X[:, ~he].sum(axis=1)
         target = np.median(totals) if target_sum is None else target_sum
         scale = np.divide(target, totals, out=np.zeros_like(totals),
                           where=totals > 0)
         X = X * scale[:, None]
-    return data.with_X(X).with_obs(library_size=totals.astype(np.float32))
+    out = data.with_X(X).with_obs(library_size=totals.astype(np.float32))
+    if he is not None:
+        out = out.with_var(highly_expressed=np.asarray(he, bool))
+    return out
 
 
 # ----------------------------------------------------------------------

@@ -142,3 +142,38 @@ def test_clr_rejects_bad_axis():
     d = CellData(np.ones((4, 3), np.float32))
     with pytest.raises(ValueError, match="axis"):
         sct.apply("normalize.clr", d, backend="cpu", axis="rows")
+
+
+def test_library_size_exclude_highly_expressed():
+    import scipy.sparse as sp
+
+    from sctools_tpu.data.dataset import CellData
+
+    rng = np.random.default_rng(0)
+    dense = rng.poisson(2.0, (50, 30)).astype(np.float32) + 1.0
+    dense[:, 3] = 500.0  # one hyper-abundant transcript everywhere
+    d = CellData(sp.csr_matrix(dense))
+    out = sct.apply("normalize.library_size", d, backend="cpu",
+                    target_sum=1e3, exclude_highly_expressed=True,
+                    max_fraction=0.2)
+    he = np.asarray(out.var["highly_expressed"])
+    assert he[3] and he.sum() == 1
+    # sizes exclude gene 3
+    np.testing.assert_allclose(np.asarray(out.obs["library_size"]),
+                               dense[:, [c for c in range(30)
+                                         if c != 3]].sum(axis=1),
+                               rtol=1e-5)
+    # every cell's NON-excluded genes now sum to target
+    Xn = out.X.toarray()
+    np.testing.assert_allclose(
+        Xn[:, [c for c in range(30) if c != 3]].sum(axis=1), 1e3,
+        rtol=1e-4)
+    # tpu path agrees
+    out_t = sct.apply("normalize.library_size", d.device_put(),
+                      backend="tpu", target_sum=1e3,
+                      exclude_highly_expressed=True,
+                      max_fraction=0.2).to_host()
+    np.testing.assert_allclose(out_t.X.toarray(), Xn, rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_array_equal(
+        np.asarray(out_t.var["highly_expressed"]), he)
