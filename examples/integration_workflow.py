@@ -103,6 +103,23 @@ def main():
     rel = np.abs(got_gamma - gamma_true) / gamma_true
     print(f"velocity: median gamma error {np.median(rel):.1%}, "
           f"{int(np.asarray(vds.var['velocity_genes']).sum())} velocity genes")
+    vds = sct.apply("velocity.terminal_states", vds, backend="cpu")
+    term = np.asarray(vds.obs["terminal_states"])
+    if (term >= 0).any():
+        vds = sct.apply("velocity.fate_probabilities", vds,
+                        backend="cpu")
+        print(f"fate mapping: {int(term.max()) + 1} terminal group(s), "
+              f"probs {np.asarray(vds.obsm['fate_probs']).shape}")
+
+    # --- 5b. the scVI model family on the raw counts ---------------
+    counts = host_atlas.layers["counts"]
+    mds = sct.apply("model.scvi",
+                    host_atlas.with_X(counts), backend="tpu",
+                    n_latent=8, n_hidden=64, epochs=30,
+                    batch_size=256, batch_key="sample", seed=0)
+    h = np.asarray(mds.uns["scvi_elbo_history"])
+    print(f"scvi: latent {mds.obsm['X_scvi'].shape}, "
+          f"ELBO {h[0]:.0f} -> {h[-1]:.0f}")
 
     # --- 6. Wishbone bifurcation on the atlas ----------------------
     wb = sct.apply("wishbone.run", ds, backend="tpu", start_cell=0,
