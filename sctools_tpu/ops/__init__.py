@@ -2,7 +2,7 @@
 transform with the registry (both cpu and tpu backends)."""
 
 from . import (  # noqa: F401
-    cluster, de, density, distance, doublet, graph, hvg, ingest, integrate,
+    abundance, cluster, de, density, distance, doublet, graph, hvg, ingest, integrate,
     knn, metacells, metrics, mnn, normalize, palantir, pca, phate, qc,
     score, tsne, umap, velocity, wishbone,
 )

@@ -1,0 +1,72 @@
+"""da.neighborhoods: Milo-style differential abundance."""
+
+import numpy as np
+import pytest
+
+import sctools_tpu as sct
+from sctools_tpu.data.dataset import CellData
+
+
+@pytest.fixture(scope="module")
+def conditioned():
+    """Two spatial blobs; condition A dominates blob 1, balanced in
+    blob 2 — enrichment must localise to blob 1."""
+    rng = np.random.default_rng(0)
+    n = 400
+    pos = np.vstack([rng.normal(0, 1, (200, 6)),
+                     rng.normal(8, 1, (200, 6))]).astype(np.float32)
+    cond = np.empty(n, dtype=object)
+    cond[:200] = rng.choice(["A", "B"], 200, p=[0.9, 0.1])
+    cond[200:] = rng.choice(["A", "B"], 200, p=[0.5, 0.5])
+    d = CellData(np.zeros((n, 1), np.float32),
+                 obsm={"X_pca": pos},
+                 obs={"condition": cond.astype(str)})
+    return sct.apply("neighbors.knn", d, backend="cpu", k=15,
+                     metric="euclidean"), np.arange(n) < 200
+
+
+def test_da_localises_enrichment(conditioned):
+    d, in_blob1 = conditioned
+    out = sct.apply("da.neighborhoods", d, backend="cpu")
+    z = np.asarray(out.obs["da_score"])
+    fdr = np.asarray(out.obs["da_fdr"])
+    assert out.uns["da_conditions"] == ["A", "B"]
+    # the null is the GLOBAL composition (~0.7 A here), so the 90/10
+    # blob reads A-enriched and the 50/50 blob reads RELATIVELY
+    # B-enriched — signs oppose and the contrast is large
+    assert z[in_blob1].mean() > 1.0
+    assert z[~in_blob1].mean() < -1.0
+    assert z[in_blob1].mean() - z[~in_blob1].mean() > 3.0
+    # per-region sign consistency
+    assert (z[in_blob1] > 0).mean() > 0.9
+    assert (z[~in_blob1] < 0).mean() > 0.9
+    # significance exists and is not universal
+    sig = fdr < 0.1
+    assert 0.05 < sig.mean() < 0.95
+    # logfc sign agrees with z
+    lfc = np.asarray(out.obs["da_logfc"])
+    assert np.sign(lfc[in_blob1]).mean() > 0.8
+
+
+def test_da_tpu_matches_cpu(conditioned):
+    d, _ = conditioned
+    a = sct.apply("da.neighborhoods", d, backend="cpu")
+    b = sct.apply("da.neighborhoods", d, backend="tpu")
+    np.testing.assert_allclose(np.asarray(a.obs["da_score"]),
+                               np.asarray(b.obs["da_score"]),
+                               atol=1e-4)
+
+
+def test_da_validates(conditioned):
+    d, _ = conditioned
+    with pytest.raises(KeyError, match="nope"):
+        sct.apply("da.neighborhoods", d, backend="cpu",
+                  condition_key="nope")
+    three = d.with_obs(condition=np.array(
+        (["A", "B", "C"] * 134)[:400]))
+    with pytest.raises(ValueError, match="exactly 2"):
+        sct.apply("da.neighborhoods", three, backend="cpu")
+    bare = CellData(np.zeros((5, 1), np.float32),
+                    obs={"condition": np.array(["A"] * 5)})
+    with pytest.raises(KeyError, match="neighbors.knn"):
+        sct.apply("da.neighborhoods", bare, backend="cpu")
