@@ -8,9 +8,10 @@ recipe's core is the contract, with ONE documented simplification:
 Milo fits an edgeR negative-binomial GLM per neighbourhood; this
 implementation uses the binomial normal approximation against the
 global condition proportion (with BH correction), which matches the
-GLM's calls on balanced designs and keeps the op closed-form.  The
-``sample_key`` option aggregates to per-sample counts first so
-replicate structure still enters the variance.
+GLM's calls on balanced designs and keeps the op closed-form.
+(Replicate-aware variance — Milo's per-sample aggregation — is NOT
+implemented; treat the FDRs as composition-shift calls, not
+replicate-backed inference.)
 
 TPU design: a neighbourhood is each index cell's kNN set (plus
 itself) — per-neighbourhood condition counts are ONE gather+sum over
