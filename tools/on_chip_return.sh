@@ -1,0 +1,68 @@
+#!/bin/bash
+# On-heal auto-runner: poll the axon TPU tunnel, and the moment it
+# answers, run the staged probe (tools/tpu_probe.py — validates that
+# the round-4 hard_sync/stream_sync fix actually keeps the worker
+# alive at 131k-cell shards) followed by the full bench.  Artifacts
+# land in artifacts/ and are committed immediately, so a chip window
+# is never wasted even if the interactive session is gone.
+#
+# Context (see README.md "TPU status" + utils/sync.py): the tunnel's
+# block_until_ready returns before execution, the backend can wedge
+# for hours, and rounds 1-4 all ended with a dead tunnel at driver
+# bench time.  This runner exists so the next live window is consumed
+# automatically: probe first (cheap bisect, ~2-10 min), then the
+# headline bench (budgeted), then git commit of everything.
+#
+# Usage: nohup bash tools/on_chip_return.sh >/tmp/on_chip_return.out 2>&1 &
+set -u
+REPO=/root/repo
+ART=$REPO/artifacts
+LOG=$ART/on_chip_return.log
+mkdir -p "$ART"
+cd "$REPO"
+
+say() { echo "$(date -u +%H:%M:%S) $*" >> "$LOG"; }
+
+say "runner started (pid $$)"
+ATTEMPT=0
+while true; do
+  out=$(timeout 75 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((128,128)); print('ALIVE', float((x@x)[0,0]), jax.devices()[0].platform)
+" 2>&1 | tail -1)
+  # Require a TPU-ish platform in the probe line: if the axon plugin
+  # fails init cleanly, JAX falls back to CPU and still prints ALIVE —
+  # that must take the cheap "down" path, not a 70-minute bench loop.
+  if [[ "$out" == *ALIVE* && ( "$out" == *tpu* || "$out" == *axon* ) ]]; then
+    ATTEMPT=$((ATTEMPT+1))
+    TS=$(date -u +%m%dT%H%M)
+    say "chip ALIVE ($out) — attempt $ATTEMPT: probe"
+    timeout 1200 python tools/tpu_probe.py --cells 131072 \
+      > "$ART/probe_${TS}.log" 2>&1
+    prc=$?
+    say "probe exit=$prc ($(tail -1 "$ART/probe_${TS}.log" 2>/dev/null | head -c 120))"
+    git add -A artifacts/ && git commit -q -m "artifacts: tpu probe ${TS} (exit=$prc)" || true
+
+    say "bench (budget 2400s)"
+    SCTOOLS_BENCH_BUDGET_S=2400 timeout 2700 python bench.py \
+      > "$ART/bench_${TS}.json" 2> "$ART/bench_${TS}.err"
+    brc=$?
+    headline=$(cat "$ART/bench_${TS}.json" 2>/dev/null | head -c 300)
+    say "bench exit=$brc headline: $headline"
+    cp -f bench_stages.jsonl "$ART/bench_stages_${TS}.jsonl" 2>/dev/null
+    git add -A artifacts/ bench_stages.jsonl && \
+      git commit -q -m "artifacts: on-heal bench ${TS} (exit=$brc)" || true
+
+    if [[ "$headline" == *'"value":'* && "$headline" != *'"value": null'* && "$headline" != *'"value":null'* ]]; then
+      say "non-null headline captured — runner done"
+      exit 0
+    fi
+    # Crash/null: the worker may be wedged for a while; cool down
+    # before re-polling so we don't hammer a dying backend.
+    say "headline still null — cooling down 600s then re-polling"
+    sleep 600
+  else
+    say "down: ${out:0:100}"
+    sleep 90
+  fi
+done
