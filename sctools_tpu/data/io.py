@@ -308,6 +308,12 @@ def read_10x_h5(path: str, genome: str | None = None) -> CellData:
             if not groups:
                 raise ValueError(
                     f"read_10x_h5: no matrix group in {path!r}")
+            if genome is None and len(groups) > 1:
+                # a mixed-species file read half-empty without warning
+                # is worse than an error
+                raise ValueError(
+                    f"read_10x_h5: multiple genome groups {groups} in "
+                    f"{path!r}; pass genome= to pick one")
             name = genome or groups[0]
             if name not in f:
                 raise ValueError(

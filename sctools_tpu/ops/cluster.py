@@ -815,7 +815,7 @@ def adjusted_rand_index(a, b) -> float:
 def _dendrogram(data: CellData, groupby: str, use_rep: str,
                 method: str, rep):
     from scipy.cluster import hierarchy
-    from scipy.spatial.distance import pdist
+    from scipy.spatial.distance import squareform
 
     labels = np.asarray(data.obs[groupby])[: data.n_cells]
     levels, codes = np.unique(labels, return_inverse=True)
@@ -827,7 +827,12 @@ def _dendrogram(data: CellData, groupby: str, use_rep: str,
             f"cluster.dendrogram: obs[{groupby!r}] has "
             f"{len(levels)} level(s); need at least 2")
     corr = np.corrcoef(means)
-    Z = hierarchy.linkage(pdist(means), method=method)
+    # scanpy links on the condensed 1 - Pearson distance of the
+    # centroid matrix, not euclidean pdist; keep the stored linkage
+    # consistent with the stored correlation_matrix.
+    dist = np.maximum(1.0 - corr, 0.0)
+    np.fill_diagonal(dist, 0.0)
+    Z = hierarchy.linkage(squareform(dist, checks=False), method=method)
     order = hierarchy.leaves_list(Z)
     return data.with_uns(**{f"dendrogram_{groupby}": {
         "linkage": Z,
@@ -842,7 +847,7 @@ def _dendrogram(data: CellData, groupby: str, use_rep: str,
 @register("cluster.dendrogram", backend="tpu")
 def dendrogram_tpu(data: CellData, groupby: str = "leiden",
                    use_rep: str = "X_pca",
-                   method: str = "ward") -> CellData:
+                   method: str = "complete") -> CellData:
     """Hierarchical clustering of GROUP CENTROIDS (scanpy
     ``tl.dendrogram``): per-group means of ``obsm[use_rep]``, scipy
     ward linkage, leaf order.  Adds ``uns['dendrogram_<groupby>']``.
@@ -858,7 +863,7 @@ def dendrogram_tpu(data: CellData, groupby: str = "leiden",
 @register("cluster.dendrogram", backend="cpu")
 def dendrogram_cpu(data: CellData, groupby: str = "leiden",
                    use_rep: str = "X_pca",
-                   method: str = "ward") -> CellData:
+                   method: str = "complete") -> CellData:
     from .knn import _get_rep_cpu
 
     return _dendrogram(data, groupby, use_rep, method,

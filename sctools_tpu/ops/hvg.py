@@ -408,5 +408,7 @@ def _cell_ranger_scores(mean, var, min_bins: int = 3):
             continue
         med = np.median(disp[m])
         mad = np.median(np.abs(disp[m] - med)) + 1e-12
-        score[m] = np.abs(disp[m] - med) / mad
+        # Signed, as in scanpy: low-dispersion genes must rank LAST,
+        # not alias with high-dispersion ones via an abs().
+        score[m] = (disp[m] - med) / mad
     return score

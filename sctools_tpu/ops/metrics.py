@@ -48,27 +48,28 @@ def _edge_arrays(data: CellData, xp):
     return idx, w
 
 
-def _values_chunk(data: CellData, use_rep, lo, hi, xp):
-    n = data.n_cells
+def _resolve_values(data: CellData, use_rep):
+    """Pick the value matrix once per call; host scipy matrices are
+    converted to CSC a single time here so the per-chunk column slices
+    below don't redo an O(nnz) conversion per 256-gene chunk."""
     if use_rep == "X":
-        X = data.X
-        if isinstance(X, SparseCells):
-            from .hvg import subset_genes_sparse
+        M = data.X
+    else:
+        M = data.layers.get(use_rep, data.obsm.get(use_rep))
+        if M is None:
+            raise KeyError(f"metrics: no layer/obsm named {use_rep!r}")
+    if not isinstance(M, SparseCells) and hasattr(M, "tocsc"):
+        M = M.tocsc()
+    return M
 
-            sub = subset_genes_sparse(X, np.arange(lo, hi))
-            return sub.to_dense()[:n]
-        if hasattr(X, "tocsc"):
-            return np.asarray(X.tocsc()[:, lo:hi].todense(), np.float64)
-        return xp.asarray(X)[:n, lo:hi]
-    M = data.layers.get(use_rep, data.obsm.get(use_rep))
-    if M is None:
-        raise KeyError(f"metrics: no layer/obsm named {use_rep!r}")
+
+def _values_chunk(M, n, lo, hi, xp):
     if isinstance(M, SparseCells):
         from .hvg import subset_genes_sparse
 
         return subset_genes_sparse(M, np.arange(lo, hi)).to_dense()[:n]
-    if hasattr(M, "tocsc"):
-        return np.asarray(M.tocsc()[:, lo:hi].todense(), np.float64)
+    if hasattr(M, "tocsc"):  # scipy sparse, already CSC
+        return np.asarray(M[:, lo:hi].todense(), np.float64)
     return xp.asarray(M)[:n, lo:hi]
 
 
@@ -106,9 +107,10 @@ def _metrics(data: CellData, use_rep, device):
         idx_d = jnp.asarray(idx)
         w_d = jnp.asarray(w, jnp.float32)
         cs_d = jnp.asarray(colsum, jnp.float32)
+    M = _resolve_values(data, use_rep)
     for lo in range(0, G, _GCHUNK):
         hi = min(G, lo + _GCHUNK)
-        Xc = _values_chunk(data, use_rep, lo, hi,
+        Xc = _values_chunk(M, data.n_cells, lo, hi,
                            jnp if device else np)
         if device:
             ni, nc, dn = _auto_terms(idx_d, w_d,

@@ -29,7 +29,9 @@ def zheng17_pipeline(n_top_genes: int = 1000) -> Pipeline:
         ("util.snapshot_layer", {"layer": "counts"}),
         ("qc.filter_genes", {"min_cells": 1}),
         ("normalize.library_size", {"target_sum": None}),  # per-cell median
-        ("hvg.select", {"n_top": n_top_genes, "flavor": "dispersion",
+        # published recipe_zheng17 ranks genes with the cell_ranger
+        # flavor (percentile-binned signed normalized dispersion)
+        ("hvg.select", {"n_top": n_top_genes, "flavor": "cell_ranger",
                         "subset": True}),
         ("normalize.library_size", {"target_sum": None}),
         ("normalize.log1p", {}),

@@ -198,6 +198,20 @@ def test_read_10x_h5_both_layouts(tmp_path):
     with pytest.raises(ValueError, match="genome"):
         read_10x_h5(p2, genome="mm10")
 
+    # multi-genome v2 file with genome=None must raise, not silently
+    # load the first (possibly half-empty) group
+    with h5py.File(p2, "a") as f:
+        g = f.create_group("mm10")
+        write_common(g)
+        g.create_dataset("genes", data=np.array(
+            [f"ENSMUSG{i:04d}".encode() for i in range(n_genes)]))
+        g.create_dataset("gene_names", data=np.array(
+            [f"g{i}".encode() for i in range(n_genes)]))
+    with pytest.raises(ValueError, match="multiple genome groups"):
+        read_10x_h5(p2)
+    np.testing.assert_array_equal(
+        read_10x_h5(p2, genome="mm10").X.toarray(), dense)
+
 
 def test_read_loom_with_velocity_layers(tmp_path):
     """Loom (genes x cells + layers) -> CellData feeding velocity.*"""

@@ -231,6 +231,23 @@ def test_hvg_seurat_alias_and_cell_ranger():
     assert (hc != np.asarray(a.var["highly_variable"])).any()
 
 
+def test_hvg_cell_ranger_score_is_signed():
+    """scanpy's cell_ranger normalized dispersion is SIGNED: a gene
+    with unusually LOW dispersion within its mean-bin must score below
+    the bin median, never alias with a high-dispersion gene."""
+    from sctools_tpu.ops.hvg import _cell_ranger_scores
+
+    rng = np.random.default_rng(0)
+    mean = np.full(60, 5.0) * rng.uniform(0.9, 1.1, 60)
+    var = mean * 1.0  # dispersion ~1 baseline
+    var[3] = mean[3] * 50.0   # unusually HIGH dispersion
+    var[7] = mean[7] * 0.02   # unusually LOW dispersion
+    s = _cell_ranger_scores(mean, var)
+    assert s[3] > 0
+    assert s[7] < 0
+    assert s[7] < np.median(s)  # low-dispersion gene ranks last, not first
+
+
 def test_qc_percent_top_genes():
     from sctools_tpu.data.synthetic import synthetic_counts
 
