@@ -4,19 +4,30 @@ Capability parity: the Milo recipe (Dann et al. 2022) for "where in
 the manifold is condition A enriched over condition B", the standard
 condition-comparison companion to integration.  The reference source
 was unavailable (/root/reference empty — SURVEY.md §0); the published
-recipe's core is the contract, with ONE documented simplification:
-Milo fits an edgeR negative-binomial GLM per neighbourhood; this
-implementation uses the binomial normal approximation against the
-global condition proportion (with BH correction), which matches the
-GLM's calls on balanced designs and keeps the op closed-form.
-(Replicate-aware variance — Milo's per-sample aggregation — is NOT
-implemented; treat the FDRs as composition-shift calls, not
-replicate-backed inference.)
+recipe's core is the contract.
+
+Two inference modes:
+
+* ``sample_key=None`` (no replicates): binomial normal approximation
+  of each neighbourhood's condition fraction against the global
+  proportion, BH-corrected.  This matches the Milo GLM's calls on
+  balanced designs but its FDRs are composition-shift calls, not
+  replicate-backed inference — sample-level batch shifts inflate its
+  call rate (pinned by a test).
+* ``sample_key=`` (replicates): Milo's per-sample aggregation.
+  Neighbourhood counts are aggregated per replicate, depth-normalised
+  to per-sample neighbourhood frequencies, and tested with a Welch
+  t-test ACROSS replicates within each condition — the
+  quasi-likelihood analogue of Milo's edgeR NB GLM (between-replicate
+  variance is estimated from the data, so an overdispersed replicated
+  design widens the null instead of over-calling).  Requires >=2
+  samples per condition; each sample must belong to exactly one
+  condition.
 
 TPU design: a neighbourhood is each index cell's kNN set (plus
-itself) — per-neighbourhood condition counts are ONE gather+sum over
-the edge list per condition, the same k-sparse primitive every graph
-op here uses.  The z/p/FDR bookkeeping is O(n) host math.
+itself) — per-neighbourhood per-sample counts are ONE one-hot
+gather+sum over the edge list, the same k-sparse primitive every
+graph op here uses.  The t/p/FDR bookkeeping is O(n·S) host math.
 """
 
 from __future__ import annotations
@@ -41,8 +52,86 @@ def _nbhd_counts(idx, flags, device):
     return gathered.sum(axis=1) + f[: idx.shape[0]]
 
 
+def _nbhd_sample_counts(idx, codes, S, device):
+    """(n, S) count of each index cell's neighbours (self included)
+    per sample code — one one-hot gather+sum over the edge list."""
+    n, k = idx.shape
+    if device:
+        codes_d = jnp.asarray(codes)
+        oh = jnp.zeros((len(codes), S), jnp.float32)
+        oh = oh.at[jnp.arange(len(codes)), codes_d].set(1.0)
+        safe = jnp.where(idx < 0, 0, idx)
+        g = jnp.take(oh, jnp.asarray(safe), axis=0)  # (n, k, S)
+        g = jnp.where(jnp.asarray(idx >= 0)[:, :, None], g, 0.0)
+        return np.asarray(g.sum(axis=1) + oh[:n], np.float64)
+    codes = np.asarray(codes)
+    valid = (idx >= 0).ravel()
+    rows = np.repeat(np.arange(n), k)[valid]
+    c = codes[idx.ravel()[valid]]
+    counts = np.bincount(rows * S + c, minlength=n * S).reshape(n, S)
+    counts = counts.astype(np.float64)
+    counts[np.arange(n), codes[:n]] += 1.0  # self
+    return counts
+
+
+def _bh_fdr(pvals):
+    order = np.argsort(pvals)
+    q = pvals[order] * len(pvals) / np.arange(1, len(pvals) + 1)
+    q = np.minimum.accumulate(q[::-1])[::-1]
+    fdr = np.empty_like(q)
+    fdr[order] = np.clip(q, 0, 1)
+    return fdr
+
+
+def _replicate_test(idx, cond, samples, a, b, device):
+    """Welch t-test across per-sample neighbourhood frequencies —
+    the replicate-aware path (see module docstring)."""
+    from scipy import stats as sps
+
+    slevels, scodes = np.unique(samples, return_inverse=True)
+    S = len(slevels)
+    samp_cond = np.empty(S, dtype=object)
+    for si, s in enumerate(slevels):
+        cs = set(cond[samples == s].tolist())
+        if len(cs) != 1:
+            raise ValueError(
+                f"da.neighborhoods: sample {s!r} spans conditions "
+                f"{sorted(cs)}; each sample must belong to exactly one")
+        samp_cond[si] = cs.pop()
+    in_a = samp_cond == a
+    in_b = samp_cond == b
+    if in_a.sum() < 2 or in_b.sum() < 2:
+        raise ValueError(
+            f"da.neighborhoods: replicate-aware test needs >=2 samples "
+            f"per condition (got {int(in_a.sum())} {a!r} / "
+            f"{int(in_b.sum())} {b!r}); omit sample_key= for the "
+            f"closed-form composition test")
+    C = _nbhd_sample_counts(idx, scodes, S, device)  # (n, S)
+    # depth normalisation: per-sample neighbourhood frequency, so a
+    # deeply-sampled replicate doesn't masquerade as enrichment
+    Ns = np.bincount(scodes, minlength=S).astype(np.float64)
+    R = C / np.maximum(Ns[None, :], 1.0)
+    ra, rb = R[:, in_a], R[:, in_b]
+    na_s, nb_s = int(in_a.sum()), int(in_b.sum())
+    ma, mb = ra.mean(axis=1), rb.mean(axis=1)
+    va = ra.var(axis=1, ddof=1) / na_s
+    vb = rb.var(axis=1, ddof=1) / nb_s
+    se = np.sqrt(np.maximum(va + vb, 1e-24))
+    t = (ma - mb) / se
+    # Welch–Satterthwaite df; zero-variance neighbourhoods fall back
+    # to the pooled df
+    denom = (va**2 / max(na_s - 1, 1) + vb**2 / max(nb_s - 1, 1))
+    df = np.where(denom > 0, (va + vb) ** 2 / np.maximum(denom, 1e-300),
+                  na_s + nb_s - 2)
+    df = np.clip(df, 1.0, None)
+    pvals = 2.0 * sps.t.sf(np.abs(t), df)
+    eps = 0.5 / max(Ns.mean(), 1.0)  # half-cell pseudo-frequency
+    lfc = np.log2((ma + eps) / (mb + eps))
+    return t, pvals, lfc, slevels
+
+
 def _differential_abundance(data: CellData, condition_key, groups,
-                            device):
+                            sample_key, device):
     n = data.n_cells
     if "knn_indices" not in data.obsp:
         raise KeyError("da.neighborhoods: run neighbors.knn first")
@@ -56,43 +145,58 @@ def _differential_abundance(data: CellData, condition_key, groups,
             f"got {levels}")
     a, b = levels
     idx = np.asarray(data.obsp["knn_indices"])[:n]
+
+    if sample_key is not None:
+        if sample_key not in data.obs:
+            raise KeyError(
+                f"da.neighborhoods: obs has no {sample_key!r}")
+        samples = np.asarray(data.obs[sample_key]).astype(str)[:n]
+        score, pvals, lfc, slevels = _replicate_test(
+            idx, cond, samples, a, b, device)
+        return (data.with_obs(
+            da_score=score.astype(np.float32),
+            da_fdr=_bh_fdr(pvals).astype(np.float32),
+            da_logfc=lfc.astype(np.float32))
+            .with_uns(da_conditions=[a, b],
+                      da_method="replicate-welch",
+                      da_samples=[str(s) for s in slevels]))
+
     na = _nbhd_counts(idx, cond == a, device)
     nb = _nbhd_counts(idx, cond == b, device)
     tot = na + nb
     p0 = float((cond == a).sum()) / max(len(cond), 1)
     # binomial z of the neighbourhood's A-fraction vs the global
-    # proportion (the documented Milo-GLM simplification)
+    # proportion (the no-replicates closed form)
     se = np.sqrt(np.maximum(tot * p0 * (1 - p0), 1e-12))
     z = (na - tot * p0) / se
     from scipy import stats as sps
 
     pvals = 2.0 * sps.norm.sf(np.abs(z))
-    order = np.argsort(pvals)
-    q = pvals[order] * len(pvals) / np.arange(1, len(pvals) + 1)
-    q = np.minimum.accumulate(q[::-1])[::-1]
-    fdr = np.empty_like(q)
-    fdr[order] = np.clip(q, 0, 1)
+    fdr = _bh_fdr(pvals)
     lfc = np.log2((na + 0.5) / (nb + 0.5)
                   / (p0 / max(1 - p0, 1e-12)))
     return (data.with_obs(
         da_score=z.astype(np.float32),
         da_fdr=fdr.astype(np.float32),
         da_logfc=lfc.astype(np.float32))
-        .with_uns(da_conditions=[a, b]))
+        .with_uns(da_conditions=[a, b],
+                  da_method="binomial-global"))
 
 
 @register("da.neighborhoods", backend="tpu")
 def da_tpu(data: CellData, condition_key: str = "condition",
-           groups=None) -> CellData:
-    """Adds obs["da_score"] (signed z, + = enriched for the first
-    level), obs["da_fdr"], obs["da_logfc"]; uns["da_conditions"].
-    Each cell's kNN neighbourhood is its Milo-style index set."""
+           groups=None, sample_key: str | None = None) -> CellData:
+    """Adds obs["da_score"] (signed z or Welch t, + = enriched for the
+    first level), obs["da_fdr"], obs["da_logfc"]; uns["da_conditions"],
+    uns["da_method"].  Each cell's kNN neighbourhood is its Milo-style
+    index set.  Pass ``sample_key=`` for replicate-aware inference
+    (see module docstring)."""
     return _differential_abundance(data, condition_key, groups,
-                                   device=True)
+                                   sample_key, device=True)
 
 
 @register("da.neighborhoods", backend="cpu")
 def da_cpu(data: CellData, condition_key: str = "condition",
-           groups=None) -> CellData:
+           groups=None, sample_key: str | None = None) -> CellData:
     return _differential_abundance(data, condition_key, groups,
-                                   device=False)
+                                   sample_key, device=False)
