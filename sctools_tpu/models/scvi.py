@@ -97,20 +97,37 @@ def _enc_input(x, batch_oh):
     return jnp.concatenate([xn, batch_oh], axis=1)
 
 
-def _vae_terms(params, x, batch_oh, key):
-    """Shared VAE body: per-cell (log-likelihood, KL, sampled z)."""
-    lib = jnp.sum(x, axis=1, keepdims=True)
+def _enc_z(params, x, batch_oh, key):
+    """Encoder half: sampled z + the posterior moments (the caller
+    picks the prior — N(0,I) for scVI, class-conditional for scANVI)."""
     xin = _enc_input(x, batch_oh)
     h = _mlp(params["enc"], xin)
     mu, logvar = jnp.split(h, 2, axis=1)
     logvar = jnp.clip(logvar, -10.0, 10.0)
     z = mu + jnp.exp(0.5 * logvar) * jax.random.normal(key, mu.shape)
-    rho = jax.nn.softmax(
-        _mlp(params["dec"], jnp.concatenate([z, batch_oh], axis=1)),
-        axis=1)
+    return z, mu, logvar
+
+
+def _kl_gauss(mu, logvar, prior_mu=0.0):
+    """KL( N(mu, e^logvar) || N(prior_mu, I) ), per cell."""
+    return 0.5 * jnp.sum(jnp.exp(logvar) + (mu - prior_mu) ** 2
+                         - 1.0 - logvar, axis=1)
+
+
+def _nb_ll(params, x, lib, dec_in):
+    """NB log-likelihood of counts x given a decoder input row."""
+    rho = jax.nn.softmax(_mlp(params["dec"], dec_in), axis=1)
     theta = jnp.exp(jnp.clip(params["log_theta"], -10.0, 10.0))
-    ll = jnp.sum(_nb_logpmf(x, lib * rho, theta[None, :]), axis=1)
-    kl = 0.5 * jnp.sum(jnp.exp(logvar) + mu**2 - 1.0 - logvar, axis=1)
+    return jnp.sum(_nb_logpmf(x, lib * rho, theta[None, :]), axis=1)
+
+
+def _vae_terms(params, x, batch_oh, key):
+    """Shared VAE body: per-cell (log-likelihood, KL, sampled z)."""
+    lib = jnp.sum(x, axis=1, keepdims=True)
+    z, mu, logvar = _enc_z(params, x, batch_oh, key)
+    kl = _kl_gauss(mu, logvar)
+    ll = _nb_ll(params, x, lib,
+                jnp.concatenate([z, batch_oh], axis=1))
     return ll, kl, z
 
 
@@ -351,15 +368,11 @@ def _clf_logits(params, z):
 
 def semi_elbo_fn(params, x, batch_oh, y, has_label, key,
                  kl_weight=1.0, alpha=50.0):
-    """Negative ELBO + alpha-weighted cross-entropy on labelled cells.
-
-    This is the practical core of scANVI (Xu et al. 2021): a
-    classifier q(y|z) co-trained with the VAE so the latent organises
-    around the annotated states and unlabelled cells receive
-    calibrated predictions.  (The full scANVI generative model also
-    conditions the decoder on y; that refinement mostly matters for
-    counterfactual decoding, which this op does not expose — the
-    simplification is documented, not hidden.)"""
+    """Classifier-head-only objective (``classifier_only=True``):
+    negative ELBO + alpha-weighted cross-entropy on labelled cells.
+    The decoder does NOT see y — kept as the cheap variant; the
+    published y-conditioned generative model is
+    :func:`semi_elbo_y_fn` (the default)."""
     ll, kl, z = _vae_terms(params, x, batch_oh, key)
     logits = _clf_logits(params, z)
     logp = jax.nn.log_softmax(logits, axis=1)
@@ -370,6 +383,50 @@ def semi_elbo_fn(params, x, batch_oh, y, has_label, key,
             + alpha * jnp.sum(ce) / n_lab)
 
 
+def semi_elbo_y_fn(params, x, batch_oh, y, has_label, key,
+                   kl_weight=1.0, alpha=50.0):
+    """Published scANVI objective (Xu et al. 2021 / Kingma M2): the
+    GENERATIVE model is conditioned on y — the decoder input carries
+    the class one-hot AND the latent prior is class-conditional,
+    p(z|y) = N(prior_mu[y], I) with learned anchors (the collapsed
+    one-level form of scANVI's z1/z2 hierarchy).
+
+    Labelled cells use their observed y; unlabelled cells MARGINALISE
+    both the reconstruction and the z-KL over y under q(y|z) and add
+    the entropy bonus H(q) (the M2 ``U(x)`` term), so the classifier
+    is trained by the generative likelihood itself, not only by the
+    alpha-weighted cross-entropy.  Cost: one decoder pass per class
+    (vmapped over a C-row one-hot eye — C is small and static, so XLA
+    sees one batched matmul, MXU-friendly)."""
+    lib = jnp.sum(x, axis=1, keepdims=True)
+    z, mu, logvar = _enc_z(params, x, batch_oh, key)
+    logits = _clf_logits(params, z)
+    logq = jax.nn.log_softmax(logits, axis=1)
+    n_classes = logits.shape[1]
+
+    def terms_for_class(c, c_oh):
+        dec_in = jnp.concatenate(
+            [z, jnp.broadcast_to(c_oh, (z.shape[0], n_classes)),
+             batch_oh], axis=1)
+        ll_c = _nb_ll(params, x, lib, dec_in)
+        kl_c = _kl_gauss(mu, logvar, params["prior_mu"][c][None, :])
+        return ll_c, kl_c
+
+    ll_all, kl_all = jax.vmap(terms_for_class)(
+        jnp.arange(n_classes), jnp.eye(n_classes))  # (C, B) each
+    elbo_all = ll_all - kl_weight * kl_all
+    elbo_obs = jnp.take_along_axis(elbo_all, y[None, :], axis=0)[0]
+    q = jnp.exp(logq)
+    elbo_marg = jnp.sum(q * elbo_all.T, axis=1)
+    ent = -jnp.sum(q * logq, axis=1)
+    per_cell = jnp.where(has_label > 0, -elbo_obs,
+                         -(elbo_marg + ent))
+    ce = -jnp.take_along_axis(logq, y[:, None], axis=1)[:, 0]
+    ce = jnp.where(has_label > 0, ce, 0.0)
+    n_lab = jnp.maximum(jnp.sum(has_label), 1.0)
+    return jnp.mean(per_cell) + alpha * jnp.sum(ce) / n_lab
+
+
 @register("model.scanvi", backend="tpu")
 @register("model.scanvi", backend="cpu")
 def scanvi(data: CellData, labels_key: str = "cell_type",
@@ -377,12 +434,21 @@ def scanvi(data: CellData, labels_key: str = "cell_type",
            n_hidden: int = 128, epochs: int = 40,
            batch_size: int = 512, batch_key: str | None = None,
            seed: int = 0, kl_warmup: int = 10,
-           alpha: float = 50.0) -> CellData:
+           alpha: float = 50.0, classifier_only: bool = False) -> CellData:
     """Semi-supervised scVI: cells whose ``obs[labels_key]`` equals
     ``unlabeled_category`` (or "" / "nan") are unlabelled; everyone
     else supervises the classifier head.  Adds obsm["X_scanvi"],
-    obs["scanvi_prediction"] (+ "_confidence"), and
-    uns["scanvi_elbo_history"]."""
+    obs["scanvi_prediction"] (+ "_confidence"),
+    uns["scanvi_elbo_history"], and (default model)
+    uns["scanvi_class_profiles"] — the per-class decoded mean
+    expression profile, the counterfactual readout the y-conditioned
+    decoder exists for.
+
+    By default this is the published scANVI generative model
+    (:func:`semi_elbo_y_fn`: decoder conditioned on y, unlabelled
+    cells marginalised over q(y|z)).  ``classifier_only=True`` keeps
+    the round-4 cheap variant (classifier head only, decoder blind
+    to y)."""
     n = data.n_cells
     if labels_key not in data.obs:
         raise KeyError(f"model.scanvi: obs has no {labels_key!r}")
@@ -398,11 +464,21 @@ def scanvi(data: CellData, labels_key: str = "cell_type",
     X = _counts_dense(data)
     batch_oh = _batch_onehot(data, batch_key, n, "model.scanvi")
     key = jax.random.PRNGKey(seed)
-    key, ki, kc = jax.random.split(key, 3)
+    key, ki, kc, kd = jax.random.split(key, 4)
     params = init_params(ki, data.n_genes, batch_oh.shape[1],
                          n_latent, n_hidden)
     params["clf"] = _init_mlp(kc, (n_latent, n_hidden // 2,
                                    len(levels)))
+    if not classifier_only:
+        # published model: the decoder sees y — widen its input by the
+        # class one-hot (fresh init; the y-less weights have no slot)
+        # — and the latent prior is class-conditional with learned
+        # anchors
+        params["dec"] = _init_mlp(
+            kd, (n_latent + len(levels) + batch_oh.shape[1],
+                 n_hidden, data.n_genes))
+        params["prior_mu"] = jnp.zeros((len(levels), n_latent))
+    loss_fn = semi_elbo_fn if classifier_only else semi_elbo_y_fn
     tx = _make_tx()
     opt_state = tx.init(params)
     batch_size = min(batch_size, n)
@@ -421,7 +497,7 @@ def scanvi(data: CellData, labels_key: str = "cell_type",
             key, ks = jax.random.split(key)
             rows = jax.lax.dynamic_slice_in_dim(perm, i * batch_size,
                                                 batch_size)
-            loss, grads = jax.value_and_grad(semi_elbo_fn)(
+            loss, grads = jax.value_and_grad(loss_fn)(
                 params, jnp.take(Xd, rows, axis=0),
                 jnp.take(oh, rows, axis=0),
                 jnp.take(yv, rows), jnp.take(hlv, rows), ks, klw,
@@ -448,8 +524,22 @@ def scanvi(data: CellData, labels_key: str = "cell_type",
     Z = _encode(params, X, batch_oh)
     probs = np.asarray(jax.nn.softmax(_clf_logits(params, Z), axis=1))
     pred_idx = probs.argmax(axis=1)
+    uns = {"scanvi_elbo_history": np.asarray(history)}
+    if not classifier_only:
+        # class-archetype readout: decode each class's learned latent
+        # anchor under its own label (conditioning enters through BOTH
+        # prior_mu[y] and the decoder's y one-hot), at the dataset's
+        # mean batch composition — the counterfactual profile the
+        # y-conditioned generative model exists for (pinned by a test)
+        C = len(levels)
+        bmean = jnp.asarray(batch_oh).mean(axis=0, keepdims=True)
+        dec_in = jnp.concatenate(
+            [params["prior_mu"], jnp.eye(C),
+             jnp.broadcast_to(bmean, (C, bmean.shape[1]))], axis=1)
+        rho = jax.nn.softmax(_mlp(params["dec"], dec_in), axis=1)
+        uns["scanvi_class_profiles"] = np.asarray(rho)
     return (data.with_obsm(X_scanvi=np.asarray(Z))
             .with_obs(scanvi_prediction=levels[pred_idx],
                       scanvi_confidence=probs[
                           np.arange(n), pred_idx].astype(np.float32))
-            .with_uns(scanvi_elbo_history=np.asarray(history)))
+            .with_uns(**uns))

@@ -171,6 +171,51 @@ def test_scanvi_semi_supervised_label_recovery():
     assert out.obsm["X_scanvi"].shape == (600, 8)
 
 
+def test_scanvi_decoder_conditions_on_label():
+    """The published y-conditioned generative model (r4 documented
+    simplification, now the default): uns['scanvi_class_profiles']
+    decodes each class's learned latent anchor under its own label —
+    class 0's archetype must be hot on class 0's gene block relative
+    to class 1's archetype, and vice versa (measured ratios ~1.7/1.6).
+    Class 2's hot block lies beyond G=200 in this fixture, so its
+    archetype stays flat on both blocks — a built-in negative
+    control."""
+    d, truth = _poisson_blocks(n=600, G=200, seed=6)
+    rng = np.random.default_rng(0)
+    labels = np.array([f"type_{c}" for c in truth], dtype=object)
+    mask = rng.random(600) > 0.3
+    labels[mask] = "Unknown"
+    d = d.with_obs(cell_type=labels.astype(str))
+    out = sct.apply("model.scanvi", d, backend="cpu", n_latent=8,
+                    n_hidden=64, epochs=150, batch_size=128, seed=0)
+    prof = np.asarray(out.uns["scanvi_class_profiles"])
+    assert prof.shape == (3, 200)
+    np.testing.assert_allclose(prof.sum(axis=1), 1.0, rtol=1e-4)
+    b0 = prof[:, :100].mean(axis=1)
+    b1 = prof[:, 100:200].mean(axis=1)
+    assert b0[0] / b0[1] > 1.25   # class-0 archetype hot on block 0
+    assert b1[1] / b1[0] > 1.25   # class-1 archetype hot on block 1
+    # negative control: class 2 has no block in range — near-flat
+    assert abs(b0[2] / b1[2] - 1.0) < 0.15
+    # accuracy must not regress vs the classifier-only variant's gate
+    pred = np.asarray(out.obs["scanvi_prediction"])
+    want = np.array([f"type_{c}" for c in truth])
+    assert (pred[mask] == want[mask]).mean() > 0.9
+
+
+def test_scanvi_classifier_only_variant():
+    """The r4 cheap variant stays available and emits no profiles."""
+    d, truth = _poisson_blocks(n=400, G=200, seed=8)
+    labels = np.array([f"type_{c}" for c in truth])
+    d = d.with_obs(cell_type=labels)
+    out = sct.apply("model.scanvi", d, backend="cpu", n_latent=8,
+                    n_hidden=64, epochs=120, batch_size=128, seed=0,
+                    classifier_only=True)
+    assert "scanvi_class_profiles" not in out.uns
+    assert (np.asarray(out.obs["scanvi_prediction"])
+            == labels).mean() > 0.85  # measured 0.93
+
+
 def test_scanvi_validates():
     d, _ = _poisson_blocks(n=100, G=50, seed=7)
     with pytest.raises(KeyError, match="cell_type"):
