@@ -1,0 +1,92 @@
+"""REAL multi-process exercise of ``init_distributed`` (r4 partial
+#50: "the actual multi-host path has never run").
+
+JAX's distributed runtime works on CPU with a localhost coordinator,
+so the MPI_Init-analogue bring-up CAN run here: two fresh processes
+(4 virtual CPU devices each) join one cluster, every process sees all
+8 global devices, ``make_mesh()`` spans both hosts, and a
+``psum``-backed reduction over a cells-sharded global array returns
+the cross-process total on both sides.  This is the same code path a
+real pod takes over DCN — only the transport differs.
+
+Children are spawned with PYTHONPATH REPLACED (the axon sitecustomize
+would hang interpreter startup when the tunnel is down — see
+tests/test_examples.py) and must not inherit the forced-cpu config of
+this test process, hence fresh env.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = textwrap.dedent("""
+    import os, sys
+    pid = int(sys.argv[1]); port = sys.argv[2]
+    import numpy as np
+    import jax
+    from sctools_tpu.parallel.mesh import (
+        CELL_AXIS, init_distributed, make_mesh, cell_sharding)
+
+    info = init_distributed(f"127.0.0.1:{port}", num_processes=2,
+                            process_id=pid)
+    assert info["num_processes"] == 2, info
+    assert info["process_id"] == pid, info
+    assert info["local_devices"] == 4, info
+    assert info["global_devices"] == 8, info
+
+    mesh = make_mesh()  # no argument: spans BOTH processes' devices
+    assert mesh.devices.size == 8
+
+    # cross-host collective: rows 0..7 sharded one per device; the
+    # replicated global sum must come back identical on both hosts
+    sharding = cell_sharding(mesh, ndim=2)
+    rows = np.arange(8, dtype=np.float32)[:, None] * np.ones(
+        (1, 4), np.float32)
+    garr = jax.make_array_from_callback(
+        (8, 4), sharding, lambda idx: rows[idx])
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    total = jax.jit(lambda x: x.sum(),
+                    out_shardings=NamedSharding(mesh, P()))(garr)
+    # replicated output: every host holds the full value locally
+    got = float(total.addressable_shards[0].data)
+    assert got == 112.0, got  # sum(0..7) * 4
+    print(f"OK pid={pid} global={info['global_devices']} sum={got}",
+          flush=True)
+""")
+
+
+def test_init_distributed_two_processes(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    script = tmp_path / "child.py"
+    script.write_text(CHILD)
+    env = {
+        "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+        "HOME": os.environ.get("HOME", "/root"),
+        "PYTHONPATH": REPO,  # REPLACED: no axon sitecustomize
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+    }
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(i), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env, cwd=REPO) for i in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=150)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process bring-up hung")
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"child {i} failed:\n{out[-2000:]}"
+        assert f"OK pid={i} global=8 sum=112.0" in out, out[-2000:]
