@@ -615,7 +615,9 @@ def run_config3(jax, src, deadline_frac=0.75):
     # static shape (the zero queries' outputs are discarded via nq).
     from sctools_tpu.config import round_up as _round_up
 
-    chunk = 131072 if n >= 131072 else _round_up(n, 1024)
+    chunk = int(os.environ.get("SCTOOLS_BENCH_KNN_CHUNK",
+                               131072 if n >= 131072
+                               else _round_up(n, 1024)))
     n_pad = _round_up(n, chunk)
     scores_pad = jnp.zeros((n_pad, scores.shape[1]), scores.dtype)
     scores_pad = scores_pad.at[:n].set(scores[:n])
@@ -634,6 +636,11 @@ def run_config3(jax, src, deadline_frac=0.75):
         chunk_times.append(time.time() - t_c)
         idx_parts.append((done, nq, idx_c))
         done += nq
+        # progress line per chunk: feeds the stall watchdog and names
+        # the last chunk that survived if the worker dies mid-kNN
+        stage("config3.knn_chunk", i=len(chunk_times),
+              total=math.ceil(n / chunk),
+              wall_s=round(chunk_times[-1], 2))
         flush_result(config3_partial={
             "knn_chunks_done": len(chunk_times),
             "knn_chunks_total": math.ceil(n / chunk),
@@ -1219,8 +1226,15 @@ def main():
     # up; the LARGEST completed attempt provides the headline.  Every
     # attempt is a fresh subprocess with a fresh TPU grant.
     full = int(os.environ.get("SCTOOLS_BENCH_CELLS", 1_300_000))
-    sizes = [s for s in (131_072, 524_288, full)
-             if s <= full] or [full]
+    # SCTOOLS_BENCH_RAMP overrides the default ramp ladder — the CPU
+    # exercise mode (tools/cpu_ramp_exercise.sh) uses it to force >=3
+    # steps through the largest-completed-wins + partial-kNN-flush
+    # machinery without TPU-scale shapes (r4 Weak #3)
+    ramp_env = os.environ.get("SCTOOLS_BENCH_RAMP")
+    if ramp_env:
+        sizes = [int(s) for s in ramp_env.split(",") if s.strip()]
+    else:
+        sizes = [s for s in (131_072, 524_288, full) if s <= full] or [full]
     sizes = sorted(set(sizes))
     best = None
     attempts = []
@@ -1230,8 +1244,10 @@ def main():
                 stage("atlas.skip", n_cells=n_cells,
                       reason="budget", remaining_s=round(remaining(), 1))
                 break
+            attempt_cap = float(os.environ.get(
+                "SCTOOLS_BENCH_ATTEMPT_S", 600))
             res = run_phase(
-                "atlas", min(600.0, remaining() - 120),
+                "atlas", min(attempt_cap, remaining() - 120),
                 env_overrides={"SCTOOLS_BENCH_CELLS": str(n_cells)})
             note_tpu(res)
             if tpu_dead:

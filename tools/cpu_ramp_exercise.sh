@@ -1,0 +1,27 @@
+#!/bin/bash
+# CPU exercise of the bench atlas ramp (r4 Weak #3 / Next #7): forces
+# three ramp steps (131k -> 262k -> 524k) and multi-shard streaming
+# (shard_rows 32768 -> 4/8/16 shards per step) through config2/config3
+# in fresh subprocesses, so largest-completed-wins, the partial-kNN
+# flush, and the per-shard progress lines are all tested somewhere
+# that is not a dying tunnel.  Gene/nnz shapes are CPU-scale; the
+# headline stays null (the orchestrator refuses a CPU number) — the
+# deliverable is bench_stages.jsonl showing the steps completing.
+set -u
+cd /root/repo
+OUT=${1:-artifacts/cpu_ramp_exercise.json}
+mkdir -p "$(dirname "$OUT")"
+SCTOOLS_BENCH_FORCE_PLATFORM=cpu \
+SCTOOLS_BENCH_ALLOW_CPU=1 \
+SCTOOLS_BENCH_CELLS=524288 \
+SCTOOLS_BENCH_RAMP=131072,262144,524288 \
+SCTOOLS_BENCH_GENES=2048 \
+SCTOOLS_BENCH_NNZ=128 \
+SCTOOLS_BENCH_SHARD_ROWS=32768 \
+SCTOOLS_BENCH_KNN_CHUNK=65536 \
+SCTOOLS_BENCH_ATTEMPT_S=900 \
+SCTOOLS_BENCH_STALL_S=900 \
+SCTOOLS_BENCH_BUDGET_S=${SCTOOLS_BENCH_BUDGET_S:-3000} \
+python bench.py --config 3 > "$OUT" 2> "${OUT%.json}.err"
+echo "exit=$? -> $OUT"
+tail -c 400 "$OUT"
