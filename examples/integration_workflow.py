@@ -128,6 +128,33 @@ def main():
     br = np.asarray(wb.obs["wishbone_branch"])
     print(f"wishbone: trajectory range [0, {tau.max():.2f}], "
           f"branch sizes {np.bincount(br, minlength=3).tolist()}")
+
+    # --- 7. replicate-aware differential abundance (Milo) ----------
+    # 4 treated + 4 control samples; the treated replicates
+    # consistently place more cells in region 1 — the Welch test
+    # across replicates localises the shift
+    from sctools_tpu.data.dataset import CellData
+
+    frac = [0.72, 0.75, 0.70, 0.78, 0.32, 0.28, 0.35, 0.30]
+    pos, cond, samp = [], [], []
+    for s, f in enumerate(frac):
+        n1 = int(round(f * 100))
+        pos.append(np.vstack([rng.normal(0, 1, (n1, 5)),
+                              rng.normal(7, 1, (100 - n1, 5))]))
+        cond += ["treated" if s < 4 else "control"] * 100
+        samp += [f"donor{s}"] * 100
+    da = CellData(np.zeros((800, 1), np.float32),
+                  obsm={"X_pca": np.vstack(pos).astype(np.float32)},
+                  obs={"condition": np.array(cond),
+                       "sample": np.array(samp)})
+    da = sct.apply("neighbors.knn", da, backend="tpu", k=30,
+                   metric="euclidean")
+    da = sct.apply("da.neighborhoods", da, backend="tpu",
+                   condition_key="condition", sample_key="sample")
+    called = (np.asarray(da.obs["da_fdr"]) < 0.1)
+    print(f"differential abundance ({da.uns['da_method']}): "
+          f"{called.mean():.0%} of neighbourhoods shifted across "
+          f"{len(da.uns['da_samples'])} donors")
     print("OK")
 
 
