@@ -57,13 +57,12 @@ def _nbhd_sample_counts(idx, codes, S, device):
     per sample code — one one-hot gather+sum over the edge list."""
     n, k = idx.shape
     if device:
-        codes_d = jnp.asarray(codes)
-        oh = jnp.zeros((len(codes), S), jnp.float32)
-        oh = oh.at[jnp.arange(len(codes)), codes_d].set(1.0)
-        safe = jnp.where(idx < 0, 0, idx)
-        g = jnp.take(oh, jnp.asarray(safe), axis=0)  # (n, k, S)
-        g = jnp.where(jnp.asarray(idx >= 0)[:, :, None], g, 0.0)
-        return np.asarray(g.sum(axis=1) + oh[:n], np.float64)
+        # one flag-gather pass per sample (S is small): keeps peak
+        # device memory at the edge list's own O(n*k) instead of a
+        # dense (n, k, S) one-hot gather — ~1.6 GB at 1.3M x 15 x 20
+        cols = [_nbhd_counts(idx, np.asarray(codes) == s, device=True)
+                for s in range(S)]
+        return np.stack(cols, axis=1).astype(np.float64)
     codes = np.asarray(codes)
     valid = (idx >= 0).ravel()
     rows = np.repeat(np.arange(n), k)[valid]

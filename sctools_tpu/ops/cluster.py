@@ -808,7 +808,8 @@ def adjusted_rand_index(a, b) -> float:
 
 # ----------------------------------------------------------------------
 # cluster.dendrogram — hierarchy of group centroids (scanpy
-# tl.dendrogram): ward linkage over per-group mean embeddings
+# tl.dendrogram): complete linkage on 1 - Pearson correlation of the
+# per-group mean embeddings
 # ----------------------------------------------------------------------
 
 
@@ -827,6 +828,11 @@ def _dendrogram(data: CellData, groupby: str, use_rep: str,
             f"cluster.dendrogram: obs[{groupby!r}] has "
             f"{len(levels)} level(s); need at least 2")
     corr = np.corrcoef(means)
+    # degenerate-but-legal centroids (zero variance across features,
+    # or a 1-column rep) give NaN correlation rows; treat them as
+    # uncorrelated (distance 1) rather than crashing linkage
+    corr = np.nan_to_num(corr, nan=0.0)
+    np.fill_diagonal(corr, 1.0)
     # scanpy links on the condensed 1 - Pearson distance of the
     # centroid matrix, not euclidean pdist; keep the stored linkage
     # consistent with the stored correlation_matrix.
@@ -850,9 +856,11 @@ def dendrogram_tpu(data: CellData, groupby: str = "leiden",
                    method: str = "complete") -> CellData:
     """Hierarchical clustering of GROUP CENTROIDS (scanpy
     ``tl.dendrogram``): per-group means of ``obsm[use_rep]``, scipy
-    ward linkage, leaf order.  Adds ``uns['dendrogram_<groupby>']``.
-    The heavy per-cell embedding already lives on device; the
-    (n_groups x d) linkage is microscopic host work on both backends.
+    linkage (default ``complete``) on the condensed 1 - Pearson
+    correlation distance, leaf order.  Adds
+    ``uns['dendrogram_<groupby>']``.  The heavy per-cell embedding
+    already lives on device; the (n_groups x d) linkage is microscopic
+    host work on both backends.
     """
     from .knn import _get_rep
 

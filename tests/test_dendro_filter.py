@@ -31,6 +31,24 @@ def test_dendrogram_groups_centroids(clustered):
             == dd["categories_ordered"])
 
 
+def test_dendrogram_degenerate_centroid_survives():
+    """A 1-column rep (and any constant-across-features centroid)
+    makes np.corrcoef emit NaN rows; the correlation-distance linkage
+    must treat those as uncorrelated, not crash."""
+    from sctools_tpu.data.dataset import CellData
+
+    rng = np.random.default_rng(0)
+    rep = rng.normal(0, 1, (60, 1)).astype(np.float32)  # 1-D rep
+    d = CellData(np.zeros((60, 1), np.float32),
+                 obsm={"X_pca": rep},
+                 obs={"g": np.array((["a", "b", "c"] * 20))})
+    out = sct.apply("cluster.dendrogram", d, backend="cpu",
+                    groupby="g")
+    dd = out.uns["dendrogram_g"]
+    assert np.isfinite(dd["linkage"]).all()
+    assert sorted(dd["categories_ordered"]) == ["a", "b", "c"]
+
+
 def test_dendrogram_needs_two_groups(clustered):
     one = clustered.with_obs(label=np.full(600, "all"))
     with pytest.raises(ValueError, match="at least 2"):
