@@ -168,7 +168,7 @@ def _make_tx():
     return optax.adam(_LR)
 
 
-def _make_epoch_sharded(mesh, Xd, batch_oh):
+def _make_epoch_sharded(mesh, Xd, batch_oh, extras=(), loss_call=None):
     """Build the COMPILED data-parallel epoch once (re-jitting per
     epoch cost minutes on the virtual mesh).
 
@@ -178,17 +178,29 @@ def _make_epoch_sharded(mesh, Xd, batch_oh):
     indices, batch-axis sharded), computes local gradients, and a
     ``pmean`` keeps the replicated params in lockstep — the standard
     DP recipe, expressed as ``shard_map`` so the same step compiles
-    for any device count."""
+    for any device count.
+
+    ``extras`` are additional per-cell ``(n,)`` arrays sharded along
+    cells (scANVI's labels and label mask); their minibatch gathers
+    are passed to ``loss_call(params, xb, bb, *ebs, key, kl_weight)``,
+    which defaults to the plain scVI ELBO."""
     from jax.experimental.shard_map import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     axis = mesh.axis_names[0]
     tx = _make_tx()
+    if loss_call is None:
+        loss_call = elbo_fn
     Xd = jax.device_put(Xd, NamedSharding(mesh, P(axis, None)))
     batch_oh = jax.device_put(batch_oh, NamedSharding(mesh, P(axis, None)))
+    extras_d = tuple(
+        jax.device_put(e, NamedSharding(mesh, P(axis))) for e in extras)
+    n_extra = len(extras_d)
 
-    def epoch(params, opt_state, X_local, oh_local, perm_local, key,
-              kl_weight):
+    def epoch(params, opt_state, X_local, oh_local, *rest):
+        extra_locals = rest[:n_extra]
+        perm_local, key, kl_weight = rest[n_extra:]
+
         def step(carry, inp):
             params, opt_state = carry
             step_i, rows = inp
@@ -201,8 +213,9 @@ def _make_epoch_sharded(mesh, Xd, batch_oh):
                 jax.lax.axis_index(axis))
             xb = jnp.take(X_local, rows, axis=0)
             bb = jnp.take(oh_local, rows, axis=0)
-            loss, grads = jax.value_and_grad(elbo_fn)(
-                params, xb, bb, ks, kl_weight)
+            ebs = tuple(jnp.take(el, rows) for el in extra_locals)
+            loss, grads = jax.value_and_grad(loss_call)(
+                params, xb, bb, *ebs, ks, kl_weight)
             grads = jax.lax.pmean(grads, axis)
             loss = jax.lax.pmean(loss, axis)
             updates, opt_state = tx.update(grads, opt_state, params)
@@ -217,12 +230,13 @@ def _make_epoch_sharded(mesh, Xd, batch_oh):
     fn = jax.jit(shard_map(
         epoch, mesh=mesh,
         in_specs=(P(), P(), P(axis, None), P(axis, None),
-                  P(None, axis), P(), P()),
+                  *([P(axis)] * n_extra), P(None, axis), P(), P()),
         out_specs=(P(), P(), P()),
         check_rep=False))
 
     def run(params, opt_state, perm, key, klw):
-        return fn(params, opt_state, Xd, batch_oh, perm, key, klw)
+        return fn(params, opt_state, Xd, batch_oh, *extras_d,
+                  perm, key, klw)
 
     run.x_sharded = Xd  # introspection hook for tests
     return run
@@ -434,7 +448,8 @@ def scanvi(data: CellData, labels_key: str = "cell_type",
            n_hidden: int = 128, epochs: int = 40,
            batch_size: int = 512, batch_key: str | None = None,
            seed: int = 0, kl_warmup: int = 10,
-           alpha: float = 50.0, classifier_only: bool = False) -> CellData:
+           alpha: float = 50.0, classifier_only: bool = False,
+           n_devices: int | None = None) -> CellData:
     """Semi-supervised scVI: cells whose ``obs[labels_key]`` equals
     ``unlabeled_category`` (or "" / "nan") are unlabelled; everyone
     else supervises the classifier head.  Adds obsm["X_scanvi"],
@@ -448,7 +463,9 @@ def scanvi(data: CellData, labels_key: str = "cell_type",
     (:func:`semi_elbo_y_fn`: decoder conditioned on y, unlabelled
     cells marginalised over q(y|z)).  ``classifier_only=True`` keeps
     the round-4 cheap variant (classifier head only, decoder blind
-    to y)."""
+    to y).  ``n_devices`` > 1 trains data-parallel over a 1-D mesh
+    exactly like :func:`scvi` — X, y, and the label mask live
+    cells-axis sharded, gradients pmean."""
     n = data.n_cells
     if labels_key not in data.obs:
         raise KeyError(f"model.scanvi: obs has no {labels_key!r}")
@@ -482,9 +499,34 @@ def scanvi(data: CellData, labels_key: str = "cell_type",
     tx = _make_tx()
     opt_state = tx.init(params)
     batch_size = min(batch_size, n)
-    n_steps = max(n // batch_size, 1)
     y_d = jnp.asarray(y)
     hl_d = jnp.asarray(has_label)
+
+    mesh = None
+    if n_devices is not None and n_devices > 1:
+        from ..parallel.mesh import make_mesh
+
+        mesh = make_mesh(n_devices)
+        nd = mesh.devices.size
+        batch_size = max(batch_size // nd, 1) * nd
+    n_steps = max(n // batch_size, 1)
+    if mesh is not None:
+        # mirror _fit's DP layout: wrap-pad so every device's shard
+        # holds REAL cells, then shard X/y/mask along the cell axis
+        n_local = -(-n // nd)
+        pad_rows = np.arange(n_local * nd - n) % n
+        Xp = jnp.concatenate([X, X[pad_rows]]) if len(pad_rows) else X
+        ohp = (jnp.concatenate([batch_oh, batch_oh[pad_rows]])
+               if len(pad_rows) else batch_oh)
+        yp = (jnp.concatenate([y_d, y_d[pad_rows]])
+              if len(pad_rows) else y_d)
+        hlp = (jnp.concatenate([hl_d, hl_d[pad_rows]])
+               if len(pad_rows) else hl_d)
+        epoch_sharded = _make_epoch_sharded(
+            mesh, Xp, ohp, extras=(yp, hlp),
+            loss_call=lambda p, xb, bb, yb, hlb, ks, klw:
+                loss_fn(p, xb, bb, yb, hlb, ks, klw, alpha))
+        b_local = batch_size // nd
 
     # arrays enter as jit ARGUMENTS (closing over the dense X would
     # bake it into the jaxpr as a constant — the large-constant
@@ -513,13 +555,22 @@ def scanvi(data: CellData, labels_key: str = "cell_type",
     rng = np.random.default_rng(seed)
     history = []
     for ep in range(epochs):
-        perm = jnp.asarray(
-            rng.permutation(n)[: n_steps * batch_size].astype(np.int32))
         key, ke = jax.random.split(key)
         klw = jnp.float32(min(1.0, (ep + 1) / max(kl_warmup, 1)))
-        params, opt_state, loss = train_epoch(
-            params, opt_state, X, batch_oh, y_d, hl_d, perm, ke, klw,
-            n_steps=n_steps, batch_size=batch_size)
+        if mesh is not None:
+            # per-device LOCAL row indices, device blocks side by side
+            perm2 = jnp.asarray(rng.integers(
+                0, n_local, size=(n_steps, nd * b_local),
+                dtype=np.int32))
+            params, opt_state, loss = epoch_sharded(
+                params, opt_state, perm2, ke, klw)
+        else:
+            perm = jnp.asarray(
+                rng.permutation(n)[: n_steps * batch_size]
+                .astype(np.int32))
+            params, opt_state, loss = train_epoch(
+                params, opt_state, X, batch_oh, y_d, hl_d, perm, ke,
+                klw, n_steps=n_steps, batch_size=batch_size)
         history.append(float(loss))
     Z = _encode(params, X, batch_oh)
     probs = np.asarray(jax.nn.softmax(_clf_logits(params, Z), axis=1))
