@@ -449,7 +449,8 @@ def scanvi(data: CellData, labels_key: str = "cell_type",
            batch_size: int = 512, batch_key: str | None = None,
            seed: int = 0, kl_warmup: int = 10,
            alpha: float = 50.0, classifier_only: bool = False,
-           n_devices: int | None = None) -> CellData:
+           n_devices: int | None = None,
+           store_normalized: bool = False) -> CellData:
     """Semi-supervised scVI: cells whose ``obs[labels_key]`` equals
     ``unlabeled_category`` (or "" / "nan") are unlabelled; everyone
     else supervises the classifier head.  Adds obsm["X_scanvi"],
@@ -589,8 +590,25 @@ def scanvi(data: CellData, labels_key: str = "cell_type",
              jnp.broadcast_to(bmean, (C, bmean.shape[1]))], axis=1)
         rho = jax.nn.softmax(_mlp(params["dec"], dec_in), axis=1)
         uns["scanvi_class_profiles"] = np.asarray(rho)
-    return (data.with_obsm(X_scanvi=np.asarray(Z))
-            .with_obs(scanvi_prediction=levels[pred_idx],
-                      scanvi_confidence=probs[
-                          np.arange(n), pred_idx].astype(np.float32))
-            .with_uns(**uns))
+    layers = {}
+    if store_normalized:
+        # scvi-tools get_normalized_expression parity: decode each
+        # cell's z under its OBSERVED label (predicted where
+        # unlabelled); the classifier-only decoder has no y input
+        y_use = jnp.asarray(np.where(has_label > 0, y, pred_idx))
+        if classifier_only:
+            dec_in = jnp.concatenate([Z, jnp.asarray(batch_oh)], axis=1)
+        else:
+            dec_in = jnp.concatenate(
+                [Z, jax.nn.one_hot(y_use, len(levels)),
+                 jnp.asarray(batch_oh)], axis=1)
+        layers["scanvi_normalized"] = np.asarray(
+            jax.nn.softmax(_mlp(params["dec"], dec_in), axis=1))
+    out = (data.with_obsm(X_scanvi=np.asarray(Z))
+           .with_obs(scanvi_prediction=levels[pred_idx],
+                     scanvi_confidence=probs[
+                         np.arange(n), pred_idx].astype(np.float32))
+           .with_uns(**uns))
+    if layers:
+        out = out.with_layers(**layers)
+    return out

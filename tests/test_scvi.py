@@ -240,6 +240,26 @@ def test_scanvi_classifier_only_variant():
             == labels).mean() > 0.85  # measured 0.93
 
 
+def test_scanvi_store_normalized():
+    """Decoded expression under each cell's own (predicted where
+    unlabelled) label — class-c cells put more mass on their hot
+    block."""
+    d, truth = _poisson_blocks(n=400, G=200, seed=9)
+    rng = np.random.default_rng(1)
+    labels = np.array([f"type_{c}" for c in truth], dtype=object)
+    labels[rng.random(400) > 0.5] = "Unknown"
+    d = d.with_obs(cell_type=labels.astype(str))
+    out = sct.apply("model.scanvi", d, backend="cpu", n_latent=8,
+                    n_hidden=64, epochs=120, batch_size=128, seed=0,
+                    store_normalized=True)
+    rho = np.asarray(out.layers["scanvi_normalized"])
+    assert rho.shape == (400, 200)
+    np.testing.assert_allclose(rho.sum(axis=1), 1.0, rtol=1e-4)
+    m0 = rho[truth == 0][:, :100].sum(axis=1).mean()
+    m1 = rho[truth == 1][:, :100].sum(axis=1).mean()
+    assert m0 > 1.5 * m1
+
+
 def test_scanvi_validates():
     d, _ = _poisson_blocks(n=100, G=50, seed=7)
     with pytest.raises(KeyError, match="cell_type"):
