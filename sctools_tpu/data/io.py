@@ -222,23 +222,31 @@ def read_10x_mtx(path: str) -> CellData:
 # ----------------------------------------------------------------------
 
 
-def shard_iter(path: str, shard_rows: int, capacity: int | None = None
-               ) -> Iterator[SparseCells]:
+def shard_iter(path: str, shard_rows: int, capacity: int | None = None,
+               start_row: int = 0) -> Iterator[SparseCells]:
     """Stream an h5ad CSR matrix as padded-ELL shards of ``shard_rows``
     cells without loading the whole matrix.
 
     Every shard shares one global ``capacity`` so a single compiled
     program processes all shards; pass ``capacity=`` to override the
     first-shard estimate (an undersized estimate raises).
+    ``start_row`` (a ``shard_rows`` multiple) seeks straight to that
+    shard without reading the skipped ones — checkpoint/resume of
+    streaming passes depends on this being a true seek, not a
+    read-and-discard.
     """
     import h5py
     import scipy.sparse as sp
 
+    if start_row % shard_rows:
+        raise ValueError(
+            f"start_row={start_row} must be a multiple of "
+            f"shard_rows={shard_rows}")
     with h5py.File(path, "r") as h5:
         node = h5["X"]
         if isinstance(node, h5py.Dataset):
             n = node.shape[0]
-            for s in range(0, n, shard_rows):
+            for s in range(start_row, n, shard_rows):
                 e = min(n, s + shard_rows)
                 sub = sp.csr_matrix(node[s:e])
                 if capacity is None:
@@ -257,7 +265,7 @@ def shard_iter(path: str, shard_rows: int, capacity: int | None = None
         indptr = node["indptr"][...]
         shape = tuple(node.attrs["shape"])
         n = shape[0]
-        for s in range(0, n, shard_rows):
+        for s in range(start_row, n, shard_rows):
             e = min(n, s + shard_rows)
             lo, hi = indptr[s], indptr[e]
             sub = sp.csr_matrix(

@@ -39,6 +39,7 @@ iterates.
 from __future__ import annotations
 
 import dataclasses
+import os
 from functools import partial
 from typing import Callable, Iterator
 
@@ -131,12 +132,30 @@ class ShardSource:
     # chews the current one (on for IO-backed sources; pointless for
     # in-memory ones)
     prefetch: bool = False
+    # optional range-aware factory(start_shard) that SEEKS to the
+    # given shard index (h5 indptr slicing / CSR row slicing) — the
+    # checkpoint/resume path of the streaming passes uses it to skip
+    # already-accumulated shards without re-reading them
+    factory_from: Callable[[int], Iterator[SparseCells]] | None = None
 
     def __iter__(self):
-        it = (_prefetch_iter(self.factory) if self.prefetch
-              else self.factory())
-        offset = 0
-        for shard in it:
+        yield from self.iter_from(0)
+
+    def iter_from(self, start_shard: int):
+        """Iterate ``(row_offset, device shard)`` starting at shard
+        index ``start_shard``.  Range-aware sources seek; others read
+        and discard the skipped shards (correct, just not free)."""
+        if start_shard and self.factory_from is not None:
+            base = lambda: self.factory_from(start_shard)  # noqa: E731
+            skip = 0
+        else:
+            base = self.factory
+            skip = start_shard
+        it = _prefetch_iter(base) if self.prefetch else base()
+        offset = start_shard * self.shard_rows
+        for i, shard in enumerate(it):
+            if i < skip:
+                continue  # not range-aware: discarded without device_put
             yield offset, shard.device_put(self.sharding)
             offset += shard.n_cells
 
@@ -155,16 +174,20 @@ class ShardSource:
                 f"shard_rows={self.shard_rows} must be a multiple of "
                 f"mesh size × sublane = {mult} to shard evenly")
         base = self.factory
+        base_from = self.factory_from
 
-        def factory():
+        def _pad(it):
             # the LAST shard may be short — pad its rows to a mesh
             # multiple so device_put can split it evenly (padding rows
             # are sentinel/zero, annihilated by every op)
-            for shard in base():
+            for shard in it:
                 yield shard.pad_rows_to(round_up(shard.rows_padded, mult))
 
-        return dataclasses.replace(self, factory=factory,
-                                   sharding=cell_sharding(mesh))
+        return dataclasses.replace(
+            self, factory=lambda: _pad(base()),
+            factory_from=(None if base_from is None
+                          else lambda k: _pad(base_from(k))),
+            sharding=cell_sharding(mesh))
 
     @property
     def n_shards(self) -> int:
@@ -198,7 +221,10 @@ class ShardSource:
                     # dense h5ad: any row may be fully dense
                     capacity = round_up(int(g), config.capacity_multiple)
         return cls(lambda: shard_iter(path, shard_rows, capacity=capacity),
-                   int(n), int(g), shard_rows, prefetch=True)
+                   int(n), int(g), shard_rows, prefetch=True,
+                   factory_from=lambda k: shard_iter(
+                       path, shard_rows, capacity=capacity,
+                       start_row=k * shard_rows))
 
     @classmethod
     def from_scipy(cls, X, shard_rows: int = 65536,
@@ -211,12 +237,13 @@ class ShardSource:
             nnz_max = int(np.diff(X.indptr).max()) if X.nnz else 1
             capacity = round_up(max(nnz_max, 1), config.capacity_multiple)
 
-        def factory():
-            for s in range(0, n, shard_rows):
+        def factory_from(start_shard):
+            for s in range(start_shard * shard_rows, n, shard_rows):
                 yield SparseCells.from_scipy_csr(
                     X[s: s + shard_rows], capacity=capacity)
 
-        return cls(factory, n, g, shard_rows)
+        return cls(lambda: factory_from(0), n, g, shard_rows,
+                   factory_from=factory_from)
 
 
 # ----------------------------------------------------------------------
@@ -300,16 +327,70 @@ def _shard_stats(x: SparseCells, mito_mask, target_sum: float):
 
 
 def stream_stats(src: ShardSource, target_sum: float = 1e4,
-                 mito_mask: np.ndarray | None = None) -> dict:
+                 mito_mask: np.ndarray | None = None,
+                 checkpoint: str | None = None) -> dict:
     """One pass: per-cell QC metrics (host) + per-gene moments of the
-    normalised log matrix (device accumulator)."""
+    normalised log matrix (device accumulator).
+
+    ``checkpoint=`` makes the pass RESUMABLE: after every shard the
+    fetched per-shard results are written atomically to the given
+    ``.npz`` path, and a rerun with the same arguments loads it, seeks
+    the source to the first unprocessed shard (range-aware sources
+    skip the read entirely — see ``ShardSource.iter_from``), and
+    finishes the pass.  This is the recovery story for the pass that
+    historically killed tunneled TPU workers mid-atlas: a crashed
+    process loses at most one shard of work.  The file is deleted on
+    successful completion.  Checkpointing forces a per-shard fetch
+    (the same drain ``config.stream_sync`` imposes on the tunnel), so
+    leave it off when failure recovery isn't worth a sync per shard.
+    """
     if mito_mask is None:
         mito_mask = np.zeros(src.n_genes, bool)
     mito = jnp.asarray(mito_mask)
     sync = config.stream_sync_enabled()
     totals, ngenes, pct, shard_stats = [], [], [], []
     shard_sizes = []
-    for offset, shard in src:
+    start_shard = 0
+    if checkpoint is not None and os.path.exists(checkpoint):
+        z = np.load(checkpoint)
+        meta_ok = (int(z["n_cells"]) == src.n_cells
+                   and int(z["n_genes"]) == src.n_genes
+                   and int(z["shard_rows"]) == src.shard_rows
+                   and float(z["target_sum"]) == float(target_sum))
+        if not meta_ok:
+            raise ValueError(
+                f"stream_stats: checkpoint {checkpoint!r} was written "
+                f"for a different source/arguments; delete it or pass "
+                f"a fresh path")
+        start_shard = int(z["next_shard"])
+        sizes = z["shard_sizes"]
+        bounds = np.concatenate([[0], np.cumsum(sizes)]).astype(int)
+        for i, n_i in enumerate(sizes):
+            totals.append(z["totals"][bounds[i]:bounds[i + 1]])
+            ngenes.append(z["ngenes"][bounds[i]:bounds[i + 1]])
+            pct.append(z["pct"][bounds[i]:bounds[i + 1]])
+            shard_stats.append(z["stats"][i])
+            shard_sizes.append(int(n_i))
+
+    def _save_checkpoint(next_shard):
+        tmp = checkpoint + ".tmp.npz"  # savez won't re-suffix this
+        np.savez(tmp,
+                 n_cells=src.n_cells, n_genes=src.n_genes,
+                 shard_rows=src.shard_rows, target_sum=target_sum,
+                 next_shard=next_shard,
+                 shard_sizes=np.asarray(shard_sizes, np.int64),
+                 totals=np.concatenate([np.asarray(t, np.float32)
+                                        for t in totals]),
+                 ngenes=np.concatenate([np.asarray(g, np.float32)
+                                        for g in ngenes]),
+                 pct=np.concatenate([np.asarray(m, np.float32)
+                                     for m in pct]),
+                 stats=np.stack([np.asarray(s, np.float32)
+                                 for s in shard_stats]))
+        os.replace(tmp, checkpoint)
+
+    for k, (offset, shard) in enumerate(src.iter_from(start_shard),
+                                        start=start_shard):
         t, g, m, stats = _shard_stats(shard, mito, target_sum)
         n = shard.n_cells
         # keep DEVICE arrays here — np.asarray would sync and
@@ -326,6 +407,13 @@ def stream_stats(src: ShardSource, target_sum: float = 1e4,
         pct.append(m[:n])
         shard_stats.append(stats)
         shard_sizes.append(n)
+        if checkpoint is not None:
+            # fetch (the checkpoint needs host values anyway) + persist
+            totals[-1] = np.asarray(totals[-1])
+            ngenes[-1] = np.asarray(ngenes[-1])
+            pct[-1] = np.asarray(pct[-1])
+            shard_stats[-1] = np.asarray(shard_stats[-1])
+            _save_checkpoint(k + 1)
     totals = [np.asarray(t) for t in totals]
     ngenes = [np.asarray(g) for g in ngenes]
     pct = [np.asarray(m) for m in pct]
@@ -353,6 +441,8 @@ def stream_stats(src: ShardSource, target_sum: float = 1e4,
         nnz += nnz_i
         n_acc += n_i
     n = src.n_cells
+    if checkpoint is not None and os.path.exists(checkpoint):
+        os.remove(checkpoint)  # pass completed; resume state is stale
     return {
         "total_counts": np.concatenate(totals),
         "n_genes": np.concatenate(ngenes),

@@ -290,14 +290,16 @@ class DeviceSyntheticSource:
                 _cluster_cdfs(self.n_genes, self._n_clusters, self.seed))
         return self._cdfs
 
-    def _generate(self):
+    def _generate(self, start_shard: int = 0):
         import jax as _jax
 
         from .sparse import SparseCells
 
         cdfs = self._gen_cdfs()
         base = _jax.random.PRNGKey(self.seed)
-        for si, start in enumerate(range(0, self.n_cells, self.shard_rows)):
+        starts = range(start_shard * self.shard_rows, self.n_cells,
+                       self.shard_rows)
+        for si, start in enumerate(starts, start=start_shard):
             n_valid = min(self.shard_rows, self.n_cells - start)
             idx, dat, _ = ell_shard_device(
                 _jax.random.fold_in(base, si), cdfs, n_valid,
@@ -306,8 +308,17 @@ class DeviceSyntheticSource:
             yield SparseCells(idx, dat, n_valid, self.n_genes)
 
     def __iter__(self):
-        shards = self._shards if self._shards is not None else self._generate()
-        offset = 0
+        yield from self.iter_from(0)
+
+    def iter_from(self, start_shard: int):
+        """Source-protocol resume hook (see ShardSource.iter_from):
+        materialized shards are sliced; regenerating sources skip the
+        per-shard keys below ``start_shard`` without generating."""
+        offset = start_shard * self.shard_rows
+        if self._shards is not None:
+            shards = self._shards[start_shard:]
+        else:
+            shards = self._generate(start_shard=start_shard)
         for shard in shards:
             yield offset, shard
             offset += shard.n_cells

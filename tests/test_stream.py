@@ -1,6 +1,8 @@
 """Out-of-core streaming pipeline: shard-by-shard results must match
 the in-memory pipeline on the same data."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -255,3 +257,95 @@ def test_stream_hvg_moment_only_flavors_match_in_memory():
             np.asarray(want.var["highly_variable"]))[0])
         overlap = len(set(got.tolist()) & set(want_idx.tolist())) / 200
         assert overlap > 0.97, (flavor, overlap)
+
+
+def test_stream_stats_checkpoint_resume(counts, src, tmp_path):
+    """Crash after two shards; the rerun must seek to shard 2 (no
+    re-read of completed shards for a range-aware source) and produce
+    bit-identical stats vs an uncheckpointed pass."""
+    import dataclasses
+
+    ck = str(tmp_path / "stats_ck.npz")
+    want = stream_stats(src)
+
+    reads = []
+    base_from = src.factory_from
+
+    def counting_from(k):
+        def gen():
+            for i, s in enumerate(base_from(k), start=k):
+                reads.append(i)
+                yield s
+        return gen()
+
+    counted = dataclasses.replace(
+        src, factory=lambda: counting_from(0), factory_from=counting_from)
+
+    class Boom(RuntimeError):
+        pass
+
+    def exploding_from(k):
+        def gen():
+            for i, s in enumerate(base_from(k), start=k):
+                if i == 2:
+                    raise Boom("simulated worker crash at shard 2")
+                reads.append(i)
+                yield s
+        return gen()
+
+    crashing = dataclasses.replace(
+        src, factory=lambda: exploding_from(0),
+        factory_from=exploding_from)
+    with pytest.raises(Boom):
+        stream_stats(crashing, checkpoint=ck)
+    assert os.path.exists(ck)
+    assert reads == [0, 1]  # two shards accumulated before the crash
+
+    reads.clear()
+    got = stream_stats(counted, checkpoint=ck)
+    assert reads == [2, 3, 4]  # resumed AT shard 2 — nothing re-read
+    for key in want:
+        np.testing.assert_allclose(got[key], want[key], rtol=1e-6,
+                                   err_msg=key)
+    assert not os.path.exists(ck)  # consumed on success
+
+
+def test_stream_stats_checkpoint_rejects_mismatched_source(counts, src,
+                                                           tmp_path):
+    ck = str(tmp_path / "stats_ck.npz")
+
+    import dataclasses
+
+    base_from = src.factory_from
+
+    def exploding_from(k):
+        def gen():
+            for i, s in enumerate(base_from(k), start=k):
+                if i == 1:
+                    raise RuntimeError("crash")
+                yield s
+        return gen()
+
+    crashing = dataclasses.replace(
+        src, factory=lambda: exploding_from(0),
+        factory_from=exploding_from)
+    with pytest.raises(RuntimeError):
+        stream_stats(crashing, checkpoint=ck)
+    with pytest.raises(ValueError, match="different source"):
+        stream_stats(src, target_sum=2e4, checkpoint=ck)
+
+
+def test_shard_iter_start_row(counts, tmp_path):
+    """h5-backed sources SEEK: start_row jumps straight to the shard."""
+    from sctools_tpu.data.dataset import CellData
+    from sctools_tpu.data.io import shard_iter, write_h5ad
+
+    path = str(tmp_path / "seek.h5ad")
+    write_h5ad(CellData(counts.X), path)
+    full = [s for s in shard_iter(path, 256)]
+    tail = [s for s in shard_iter(path, 256, start_row=512)]
+    assert len(tail) == len(full) - 2
+    np.testing.assert_array_equal(
+        np.asarray(tail[0].data), np.asarray(full[2].data))
+    with pytest.raises(ValueError, match="multiple"):
+        next(shard_iter(path, 256, start_row=100))
