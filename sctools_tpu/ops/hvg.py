@@ -20,6 +20,8 @@ second segment-sum.  Everything else is O(G) work.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -132,6 +134,79 @@ def _gene_moments_tpu(X):
     return mean, jnp.maximum(var, 0.0), nnz
 
 
+def _pearson_residual_var_sparse_tpu(X: SparseCells, theta: float,
+                                     gchunk: int = 256):
+    """Per-gene variance of clipped Pearson residuals of RAW counts
+    (scanpy experimental flavor='pearson_residuals', Lause 2021):
+    ``r = clip((x - mu) / sqrt(mu + mu^2/theta), ±sqrt(n))`` with
+    ``mu = total_i * gene_sum_j / grand_total``.
+
+    The zeros' residual depends on the CELL total, so there is no
+    per-gene closed form — the zero baseline is computed densely per
+    gene chunk (an outer product, MXU-shaped), then the stored entries
+    are corrected in one k-sparse segment pass (r - r0, r² - r0²)."""
+    from ..data.sparse import segment_reduce
+
+    n = X.n_cells
+    totals = jnp.sum(X.data, axis=1)[:n]
+    gsum = n * _gene_moments_tpu(X)[0]
+    p = gsum / jnp.maximum(jnp.sum(totals), 1e-12)
+    clip = float(np.sqrt(n))
+
+    @partial(jax.jit, static_argnames=())
+    def chunk_baseline(p_chunk):
+        mu = totals[:, None] * p_chunk[None, :]
+        denom = jnp.sqrt(mu + mu * mu / theta)
+        r0 = jnp.clip(-mu / jnp.maximum(denom, 1e-12), -clip, clip)
+        return jnp.sum(r0, axis=0), jnp.sum(r0 * r0, axis=0)
+
+    G = int(p.shape[0])
+    S = np.zeros(G, np.float64)
+    Q = np.zeros(G, np.float64)
+    p_chunkpad = jnp.pad(p, (0, (-G) % gchunk))
+    for lo in range(0, G, gchunk):
+        s0, q0 = chunk_baseline(jax.lax.dynamic_slice_in_dim(
+            p_chunkpad, lo, gchunk))
+        hi = min(G, lo + gchunk)
+        S[lo:hi] = np.asarray(s0)[: hi - lo]
+        Q[lo:hi] = np.asarray(q0)[: hi - lo]
+
+    totals_pad = jnp.concatenate([totals, jnp.zeros(
+        (X.rows_padded - n,), totals.dtype)])
+    p_pad = jnp.concatenate([p, jnp.zeros((1,))])
+    sentinel = X.sentinel
+
+    def slot_vals(ind, dat, row_offset):
+        rows = row_offset + jnp.arange(ind.shape[0])
+        t = jnp.take(totals_pad, jnp.minimum(rows, X.rows_padded - 1))
+        mu = t[:, None] * jnp.take(p_pad, ind)
+        denom = jnp.maximum(jnp.sqrt(mu + mu * mu / theta), 1e-12)
+        r = jnp.clip((dat - mu) / denom, -clip, clip)
+        r0 = jnp.clip(-mu / denom, -clip, clip)
+        ok = (ind != sentinel) & (rows < n)[:, None]
+        dS = jnp.where(ok, r - r0, 0.0)
+        dQ = jnp.where(ok, r * r - r0 * r0, 0.0)
+        return jnp.stack([dS, dQ], axis=2)
+
+    corr = np.asarray(segment_reduce(X, slot_vals, 2), np.float64)
+    S += corr[:, 0]
+    Q += corr[:, 1]
+    return ((Q - S * S / n) / max(n - 1, 1)).astype(np.float32)
+
+
+def _pearson_residual_var_dense(Xd, theta: float, xp):
+    """Dense counterpart (numpy oracle and small device-dense X)."""
+    n = Xd.shape[0]
+    Xd = xp.asarray(Xd, jnp.float32 if xp is jnp else np.float64)
+    totals = Xd.sum(axis=1, keepdims=True)
+    p = Xd.sum(axis=0) / xp.maximum(totals.sum(), 1e-12)
+    mu = totals * p[None, :]
+    denom = xp.maximum(xp.sqrt(mu + mu * mu / theta), 1e-12)
+    clip = float(np.sqrt(n))
+    r = xp.clip((Xd - mu) / denom, -clip, clip)
+    return r.var(axis=0, ddof=1)
+
+
 def _gene_moments_cpu(X) -> tuple[np.ndarray, np.ndarray]:
     import scipy.sparse as sp
 
@@ -224,7 +299,8 @@ def _hvg_batched(data: CellData, n_top, flavor, subset, compact,
 def hvg_select_tpu(data: CellData, n_top: int = 2000,
                    flavor: str = "seurat_v3", subset: bool = False,
                    compact: bool = True,
-                   batch_key: str | None = None) -> CellData:
+                   batch_key: str | None = None,
+                   theta: float = 100.0) -> CellData:
     """Rank genes by variability; adds var: ``highly_variable``,
     ``hvg_rank``, ``hvg_score`` (+ ``means``/``variances``).  With
     ``subset=True`` returns the gene-subset CellData (materialisation
@@ -282,6 +358,16 @@ def hvg_select_tpu(data: CellData, n_top: int = 2000,
         mean, var, _ = _gene_moments_tpu(X)
         score = jnp.asarray(_cell_ranger_scores(
             np.asarray(mean), np.asarray(var)), jnp.float32)
+    elif flavor == "pearson_residuals":
+        # expects RAW counts (like seurat_v3); scanpy experimental
+        # flavor (Lause 2021) — rank by clipped-residual variance
+        mean, var, _ = _gene_moments_tpu(X)
+        if isinstance(X, SparseCells):
+            score = jnp.asarray(
+                _pearson_residual_var_sparse_tpu(X, theta))
+        else:
+            score = _pearson_residual_var_dense(jnp.asarray(X), theta,
+                                                jnp)
     else:
         raise ValueError(f"unknown hvg flavor {flavor!r}")
 
@@ -302,7 +388,8 @@ def hvg_select_tpu(data: CellData, n_top: int = 2000,
 def hvg_select_cpu(data: CellData, n_top: int = 2000,
                    flavor: str = "seurat_v3", subset: bool = False,
                    compact: bool = True,
-                   batch_key: str | None = None) -> CellData:
+                   batch_key: str | None = None,
+                   theta: float = 100.0) -> CellData:
     import scipy.sparse as sp
 
     if batch_key is not None:
@@ -336,6 +423,9 @@ def hvg_select_cpu(data: CellData, n_top: int = 2000,
         score = _dispersion_scores(mean, var, np)
     elif flavor == "cell_ranger":
         score = _cell_ranger_scores(mean, var)
+    elif flavor == "pearson_residuals":
+        Xd = X.toarray() if sp.issparse(X) else np.asarray(X)
+        score = _pearson_residual_var_dense(Xd, theta, np)
     else:
         raise ValueError(f"unknown hvg flavor {flavor!r}")
 
