@@ -476,9 +476,50 @@ def _shard_clipped_ssq(x: SparseCells, mu_over_std, inv_std, clip):
     return segment_reduce(x, slot_vals, 1)[:, 0]
 
 
+@partial(jax.jit, static_argnames=())
+def _pearson_zero_chunk(totals_block, p_chunk, theta, clip):
+    """Zero-entry residual sums for a (cells-block × gene-chunk) tile:
+    the x=0 residual depends only on the CELL total, so the baseline
+    needs no matrix pass — just the pass-1 totals."""
+    mu = totals_block[:, None] * p_chunk[None, :]
+    denom = jnp.maximum(jnp.sqrt(mu + mu * mu / theta), 1e-12)
+    r0 = jnp.clip(-mu / denom, -clip, clip)
+    return jnp.sum(r0, axis=0), jnp.sum(r0 * r0, axis=0)
+
+
+@partial(jax.jit, static_argnames=())
+def _shard_pearson_corr(x: SparseCells, p_pad, theta, clip):
+    """Stored-entry correction (r - r0, r² - r0²) per gene for one
+    shard; row totals recomputed on device from the shard itself."""
+    from .sparse import _ROW_CHUNK
+
+    totals = jnp.sum(x.data, axis=1)
+    pad = (-x.rows_padded) % _ROW_CHUNK
+    if pad:
+        totals = jnp.concatenate([totals, jnp.zeros((pad,),
+                                                    totals.dtype)])
+    n_cells = x.n_cells
+    sentinel = x.sentinel
+
+    def slot_vals(ind, dat, row_offset):
+        rows = row_offset + jnp.arange(ind.shape[0])
+        t = jax.lax.dynamic_slice_in_dim(totals, row_offset,
+                                         ind.shape[0])
+        mu = t[:, None] * jnp.take(p_pad, ind)
+        denom = jnp.maximum(jnp.sqrt(mu + mu * mu / theta), 1e-12)
+        r = jnp.clip((dat - mu) / denom, -clip, clip)
+        r0 = jnp.clip(-mu / denom, -clip, clip)
+        ok = (ind != sentinel) & (rows < n_cells)[:, None]
+        return jnp.stack([jnp.where(ok, r - r0, 0.0),
+                          jnp.where(ok, r * r - r0 * r0, 0.0)], axis=2)
+
+    return segment_reduce(x, slot_vals, 2)
+
+
 def stream_hvg(stats: dict, n_top: int = 2000,
                flavor: str = "seurat_v3",
-               src: ShardSource | None = None) -> np.ndarray:
+               src: ShardSource | None = None,
+               theta: float = 100.0) -> np.ndarray:
     """HVG ranking from streamed moments.  Returns sorted gene indices.
 
     ``"seurat_v3"`` (the BASELINE configs[2] flavor) ranks genes by
@@ -528,6 +569,41 @@ def stream_hvg(stats: dict, n_top: int = 2000,
         zero_term = np.clip(-mean / std, -clip, clip) ** 2
         ssq += (n - stats["gene_nnz"]) * zero_term
         scores = _seurat_v3_scores_from_stats(mean, var, ssq, n, np)
+    elif flavor == "pearson_residuals":
+        # scanpy experimental flavor at streaming scale: the zero
+        # baseline comes from the pass-1 cell totals alone (no matrix
+        # pass), stored entries from ONE k-sparse pass over src
+        if src is None:
+            raise ValueError(
+                "stream_hvg(flavor='pearson_residuals') needs src= "
+                "for the stored-entry correction pass")
+        n = stats["n_cells"]
+        totals_all = np.asarray(stats["total_counts"], np.float64)
+        gsum = np.asarray(stats["raw_gene_mean"], np.float64) * n
+        p = gsum / max(totals_all.sum(), 1e-12)
+        clip = jnp.float32(np.sqrt(n))
+        th = jnp.float32(theta)
+        G = src.n_genes
+        S = np.zeros(G, np.float64)
+        Q = np.zeros(G, np.float64)
+        gchunk, cblock = 512, 65536
+        p_dev = jnp.asarray(np.pad(p, (0, (-G) % gchunk)), jnp.float32)
+        for c0 in range(0, n, cblock):
+            tb = jnp.asarray(totals_all[c0:c0 + cblock], jnp.float32)
+            for lo in range(0, G, gchunk):
+                s0, q0 = _pearson_zero_chunk(
+                    tb, jax.lax.dynamic_slice_in_dim(p_dev, lo, gchunk),
+                    th, clip)
+                hi = min(G, lo + gchunk)
+                S[lo:hi] += np.asarray(s0, np.float64)[: hi - lo]
+                Q[lo:hi] += np.asarray(q0, np.float64)[: hi - lo]
+        p_pad = jnp.asarray(np.concatenate([p, [0.0]]), jnp.float32)
+        for _, shard in src:
+            corr = np.asarray(
+                _shard_pearson_corr(shard, p_pad, th, clip), np.float64)
+            S += corr[:, 0]
+            Q += corr[:, 1]  # fetch drains per shard
+        scores = (Q - S * S / n) / max(n - 1, 1)
     else:
         raise ValueError(f"unknown hvg flavor {flavor!r}")
     order = np.argsort(-scores)[:n_top]

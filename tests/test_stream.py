@@ -349,3 +349,18 @@ def test_shard_iter_start_row(counts, tmp_path):
         np.asarray(tail[0].data), np.asarray(full[2].data))
     with pytest.raises(ValueError, match="multiple"):
         next(shard_iter(path, 256, start_row=100))
+
+
+def test_stream_hvg_pearson_residuals_matches_memory(counts, src):
+    """Streamed pearson_residuals (totals-only zero baseline + one
+    k-sparse correction pass) == the in-memory flavor."""
+    mem = sct.apply("hvg.select", counts, backend="cpu", n_top=120,
+                    flavor="pearson_residuals")
+    stats = stream_stats(src)
+    idx = stream_hvg(stats, n_top=120, flavor="pearson_residuals",
+                     src=src)
+    want = np.sort(np.nonzero(np.asarray(mem.var["highly_variable"]))[0])
+    agree = len(set(idx.tolist()) & set(want.tolist()))
+    assert agree >= 118  # ties at the cutoff may swap a gene or two
+    with pytest.raises(ValueError, match="needs src"):
+        stream_hvg(stats, flavor="pearson_residuals")
