@@ -88,3 +88,42 @@ def recipe_seurat_cpu(data: CellData, n_top_genes: int = 2000,
                       target_sum: float = 1e4) -> CellData:
     return seurat_pipeline(n_top_genes, min_genes, min_cells,
                            target_sum).run(data, backend="cpu")
+
+
+def pearson_residuals_pipeline(n_top_genes: int = 2000,
+                               theta: float = 100.0,
+                               n_components: int = 50,
+                               min_cells: int = 5) -> Pipeline:
+    """scanpy ``experimental.pp.recipe_pearson_residuals`` steps:
+    gene filter → pearson-residual HVG subset (raw counts) →
+    analytic Pearson-residual normalisation → randomized PCA."""
+    return Pipeline([
+        ("util.snapshot_layer", {"layer": "counts"}),
+        ("qc.filter_genes", {"min_cells": min_cells}),
+        ("hvg.select", {"n_top": n_top_genes,
+                        "flavor": "pearson_residuals",
+                        "theta": theta, "subset": True}),
+        ("normalize.pearson_residuals", {"theta": theta}),
+        # residuals are per-gene standardised, so the spectrum's tail
+        # is flat and the default 2 power iterations under-converge —
+        # 4 is cheap insurance on whitened data
+        ("pca.randomized", {"n_components": n_components, "n_iter": 4}),
+    ])
+
+
+@register("recipe.pearson_residuals", backend="tpu")
+def recipe_pearson_tpu(data: CellData, n_top_genes: int = 2000,
+                       theta: float = 100.0,
+                       n_components: int = 50) -> CellData:
+    """One-call Pearson-residuals workflow (Lause 2021 / scanpy
+    experimental recipe; see ``pearson_residuals_pipeline``)."""
+    return pearson_residuals_pipeline(
+        n_top_genes, theta, n_components).run(data, backend="tpu")
+
+
+@register("recipe.pearson_residuals", backend="cpu")
+def recipe_pearson_cpu(data: CellData, n_top_genes: int = 2000,
+                       theta: float = 100.0,
+                       n_components: int = 50) -> CellData:
+    return pearson_residuals_pipeline(
+        n_top_genes, theta, n_components).run(data, backend="cpu")
