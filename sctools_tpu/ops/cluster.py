@@ -876,3 +876,30 @@ def dendrogram_cpu(data: CellData, groupby: str = "leiden",
 
     return _dendrogram(data, groupby, use_rep, method,
                        _get_rep_cpu(data, use_rep))
+
+
+# ----------------------------------------------------------------------
+# cluster.louvain — scanpy's name for the same modularity optimiser
+# ----------------------------------------------------------------------
+
+
+@register("cluster.louvain", backend="tpu")
+def louvain_tpu(data: CellData, resolution: float = 1.0,
+                n_rounds: int = 20, n_levels: int = 3,
+                weight_key: str = "connectivities") -> CellData:
+    """scanpy ``tl.louvain`` naming: identical computation to
+    ``cluster.leiden`` (this package's optimiser IS the Louvain
+    local-moves + aggregation scheme — see the module docstring), with
+    the result stored under obs["louvain"]."""
+    out = leiden_tpu(data, resolution=resolution, n_rounds=n_rounds,
+                     n_levels=n_levels, weight_key=weight_key)
+    return out.with_obs(louvain=np.asarray(out.obs["leiden"]))
+
+
+@register("cluster.louvain", backend="cpu")
+def louvain_cpu(data: CellData, resolution: float = 1.0,
+                n_rounds: int = 20, n_levels: int = 3,
+                weight_key: str = "connectivities") -> CellData:
+    out = leiden_cpu(data, resolution=resolution, n_rounds=n_rounds,
+                     n_levels=n_levels, weight_key=weight_key)
+    return out.with_obs(louvain=np.asarray(out.obs["leiden"]))

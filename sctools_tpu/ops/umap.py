@@ -360,3 +360,32 @@ def umap_cpu(data: CellData, n_dims: int = 2, min_dist: float = 0.1,
     y = umap_layout_numpy(idx, w, init, seed, n_epochs=n_epochs,
                           n_neg=n_neg, a=a, b=b, lr=lr)
     return data.with_obsm(X_umap=y).with_uns(umap_min_dist=min_dist)
+
+
+# ----------------------------------------------------------------------
+# embed.draw_graph — scanpy's name for the force-directed layout
+# ----------------------------------------------------------------------
+
+
+@register("embed.draw_graph", backend="tpu")
+def draw_graph_tpu(data: CellData, n_dims: int = 2, n_epochs: int = 300,
+                   n_neg: int = 10, repulsion: float = 1.0,
+                   gravity: float = 1.0, lr: float = 0.1,
+                   seed: int = 0, init=None) -> CellData:
+    """scanpy ``tl.draw_graph`` naming for ``embed.force_directed`` —
+    identical computation, identical ``obsm["X_draw_graph"]`` output."""
+    return force_directed_tpu(data, n_dims=n_dims, n_epochs=n_epochs,
+                              n_neg=n_neg, repulsion=repulsion,
+                              gravity=gravity, lr=lr, seed=seed,
+                              init=init)
+
+
+@register("embed.draw_graph", backend="cpu")
+def draw_graph_cpu(data: CellData, n_dims: int = 2, n_epochs: int = 300,
+                   n_neg: int = 10, repulsion: float = 1.0,
+                   gravity: float = 1.0, lr: float = 0.1,
+                   seed: int = 0, init=None) -> CellData:
+    return force_directed_cpu(data, n_dims=n_dims, n_epochs=n_epochs,
+                              n_neg=n_neg, repulsion=repulsion,
+                              gravity=gravity, lr=lr, seed=seed,
+                              init=init)

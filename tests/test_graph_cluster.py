@@ -233,3 +233,20 @@ def test_paga_requires_clustering():
     d = CellData(np.zeros((10, 4), np.float32))
     with pytest.raises(KeyError, match="leiden"):
         sct.apply("graph.paga", d, backend="cpu")
+
+
+def test_scanpy_name_aliases(with_knn):
+    """cluster.louvain / embed.draw_graph are registered scanpy-name
+    views of cluster.leiden / embed.force_directed — same computation,
+    scanpy-shaped output columns."""
+    cpu, dev = with_knn
+    lv = sct.apply("cluster.louvain", cpu, backend="cpu")
+    ld = sct.apply("cluster.leiden", cpu, backend="cpu")
+    np.testing.assert_array_equal(np.asarray(lv.obs["louvain"]),
+                                  np.asarray(ld.obs["leiden"]))
+    dg = sct.apply("embed.draw_graph", dev, backend="tpu", n_epochs=20)
+    fd = sct.apply("embed.force_directed", dev, backend="tpu",
+                   n_epochs=20)
+    np.testing.assert_allclose(
+        np.asarray(dg.obsm["X_draw_graph"]),
+        np.asarray(fd.obsm["X_draw_graph"]), atol=1e-5)
