@@ -88,6 +88,20 @@ def _decode(arr):
     return arr
 
 
+def _read_h5_tree(node):
+    """Recursive reader for uns/obsp-style groups: CSR subgroups come
+    back as scipy matrices, plain groups as dicts, datasets decoded."""
+    import h5py
+
+    if isinstance(node, h5py.Dataset):
+        return _decode(node[...])
+    enc = node.attrs.get("encoding-type", b"")
+    enc = enc.decode() if isinstance(enc, bytes) else enc
+    if str(enc).startswith("csr"):
+        return _read_h5_matrix(node.parent, node.name.rsplit("/", 1)[-1])
+    return {k: _read_h5_tree(node[k]) for k in node}
+
+
 def read_h5ad(path: str, load_obsm: bool = True,
               load_layers: bool = True) -> CellData:
     import h5py
@@ -106,12 +120,21 @@ def read_h5ad(path: str, load_obsm: bool = True,
         if load_layers and "layers" in h5:
             for key in h5["layers"]:
                 layers[key] = _read_h5_matrix(h5["layers"], key)
+        obsp = {}
+        if "obsp" in h5:
+            for key in h5["obsp"]:
+                obsp[key] = _read_h5_tree(h5["obsp"][key])
+        uns = {}
+        if "uns" in h5:
+            for key in h5["uns"]:
+                uns[key] = _read_h5_tree(h5["uns"][key])
     if "gene_name" not in var:
         for cand in ("_index", "index", "gene_symbols", "gene_ids"):
             if cand in var:
                 var["gene_name"] = var.pop(cand)
                 break
-    return CellData(X, obs=obs, var=var, obsm=obsm, layers=layers)
+    return CellData(X, obs=obs, var=var, obsm=obsm, layers=layers,
+                    obsp=obsp, uns=uns)
 
 
 def write_h5ad(data: CellData, path: str) -> None:
@@ -134,6 +157,21 @@ def write_h5ad(data: CellData, path: str) -> None:
         else:
             parent.create_dataset(name, data=np.asarray(M))
 
+    def write_value(g, k, v):
+        if isinstance(v, dict):
+            # nested uns (dendrogram, paga, …): a subgroup, AnnData-style
+            sub = g.create_group(str(k))
+            for kk, vv in v.items():
+                write_value(sub, kk, vv)
+            return
+        if sp.issparse(v):
+            write_matrix(g, str(k), v)
+            return
+        v = np.asarray(v)
+        if v.dtype.kind in ("U", "O"):
+            v = v.astype(h5py_str())
+        g.create_dataset(str(k), data=v)
+
     with h5py.File(path, "w") as h5:
         write_matrix(h5, "X", host.X)
         if host.layers:
@@ -145,10 +183,7 @@ def write_h5ad(data: CellData, path: str) -> None:
                         ("obsp", host.obsp), ("uns", host.uns)):
             g = h5.create_group(name)
             for k, v in d.items():
-                v = np.asarray(v)
-                if v.dtype.kind in ("U", "O"):
-                    v = v.astype(h5py_str())
-                g.create_dataset(k, data=v)
+                write_value(g, k, v)
 
 
 def h5py_str():

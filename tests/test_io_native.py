@@ -277,3 +277,35 @@ def test_loom_round_trip(tmp_path):
                                   dense * 2)
     assert list(back.obs["cell_id"]) == [f"c{i}" for i in range(15)]
     assert list(back.var["gene_name"]) == [f"g{i}" for i in range(8)]
+
+
+def test_h5ad_roundtrip_nested_uns_and_obsp(tmp_path):
+    """uns dicts (dendrogram-style) become subgroups and come back as
+    dicts; obsp (the kNN graph) round-trips — losing the graph on save
+    was a real pre-fix failure (write crashed on dict uns)."""
+    from sctools_tpu.data.io import read_h5ad, write_h5ad
+    from sctools_tpu.data.synthetic import synthetic_counts
+
+    d = synthetic_counts(120, 80, density=0.2, n_clusters=3, seed=0)
+    d = sct.Pipeline([
+        ("normalize.library_size", {}), ("normalize.log1p", {}),
+        ("pca.randomized", {"n_components": 8}),
+        ("neighbors.knn", {"k": 8}),
+    ]).run(d, backend="cpu")
+    d = sct.apply("cluster.kmeans", d, backend="cpu", n_clusters=3)
+    d = d.with_obs(label=np.asarray(d.obs["kmeans"]).astype(str))
+    d = sct.apply("cluster.dendrogram", d, backend="cpu",
+                  groupby="label")
+    p = str(tmp_path / "nested.h5ad")
+    write_h5ad(d, p)
+    r = read_h5ad(p)
+    dd = r.uns["dendrogram_label"]
+    np.testing.assert_allclose(
+        dd["linkage"], d.uns["dendrogram_label"]["linkage"])
+    assert (list(dd["categories_ordered"])
+            == list(d.uns["dendrogram_label"]["categories_ordered"]))
+    np.testing.assert_array_equal(
+        r.obsp["knn_indices"], np.asarray(d.obsp["knn_indices"]))
+    np.testing.assert_allclose(
+        r.obsp["knn_distances"], np.asarray(d.obsp["knn_distances"]),
+        rtol=1e-6)
