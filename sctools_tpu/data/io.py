@@ -97,13 +97,14 @@ def _read_h5_tree(node):
         return _decode(node[...])
     enc = node.attrs.get("encoding-type", b"")
     enc = enc.decode() if isinstance(enc, bytes) else enc
-    if str(enc).startswith("csr"):
+    if str(enc).startswith(("csr", "csc")):  # _read_h5_matrix converts
         return _read_h5_matrix(node.parent, node.name.rsplit("/", 1)[-1])
     return {k: _read_h5_tree(node[k]) for k in node}
 
 
 def read_h5ad(path: str, load_obsm: bool = True,
-              load_layers: bool = True) -> CellData:
+              load_layers: bool = True,
+              load_obsp: bool = True) -> CellData:
     import h5py
 
     with h5py.File(path, "r") as h5:
@@ -111,9 +112,14 @@ def read_h5ad(path: str, load_obsm: bool = True,
         obs = _read_h5_frame(h5, "obs")
         var = _read_h5_frame(h5, "var")
         obsm = {}
-        if load_obsm and "obsm" in h5:
-            for key in h5["obsm"]:
-                obsm[key] = h5["obsm"][key][...]
+        varm = {}
+        if load_obsm:
+            if "obsm" in h5:
+                for key in h5["obsm"]:
+                    obsm[key] = h5["obsm"][key][...]
+            if "varm" in h5:
+                for key in h5["varm"]:
+                    varm[key] = h5["varm"][key][...]
         layers = {}
         # opt-out: velocity-style files carry X-sized spliced/unspliced
         # layers — pipelines that never touch them shouldn't pay 3x IO
@@ -121,7 +127,9 @@ def read_h5ad(path: str, load_obsm: bool = True,
             for key in h5["layers"]:
                 layers[key] = _read_h5_matrix(h5["layers"], key)
         obsp = {}
-        if "obsp" in h5:
+        # opt-out for the same reason: external files can carry
+        # n_obs x n_obs distance/connectivity matrices here
+        if load_obsp and "obsp" in h5:
             for key in h5["obsp"]:
                 obsp[key] = _read_h5_tree(h5["obsp"][key])
         uns = {}
@@ -133,8 +141,8 @@ def read_h5ad(path: str, load_obsm: bool = True,
             if cand in var:
                 var["gene_name"] = var.pop(cand)
                 break
-    return CellData(X, obs=obs, var=var, obsm=obsm, layers=layers,
-                    obsp=obsp, uns=uns)
+    return CellData(X, obs=obs, var=var, obsm=obsm, varm=varm,
+                    layers=layers, obsp=obsp, uns=uns)
 
 
 def write_h5ad(data: CellData, path: str) -> None:
@@ -167,6 +175,10 @@ def write_h5ad(data: CellData, path: str) -> None:
         if sp.issparse(v):
             write_matrix(g, str(k), v)
             return
+        if v is None:
+            # scanpy idiom uns['log1p'] = {'base': None}; h5 has no
+            # null — store the AnnData-ish empty-string sentinel
+            v = np.asarray("", dtype=object)
         v = np.asarray(v)
         if v.dtype.kind in ("U", "O"):
             v = v.astype(h5py_str())

@@ -309,3 +309,14 @@ def test_h5ad_roundtrip_nested_uns_and_obsp(tmp_path):
     np.testing.assert_allclose(
         r.obsp["knn_distances"], np.asarray(d.obsp["knn_distances"]),
         rtol=1e-6)
+
+    # review findings: None inside uns (scanpy log1p idiom) must not
+    # crash; varm round-trips; obsp is opt-out like layers
+    d2 = d.with_uns(log1p={"base": None}).with_varm(
+        PCs=np.arange(80 * 3, dtype=np.float32).reshape(80, 3))
+    write_h5ad(d2, p)
+    r2 = read_h5ad(p)
+    assert r2.uns["log1p"]["base"] == ""
+    np.testing.assert_allclose(r2.varm["PCs"], d2.varm["PCs"])
+    lean = read_h5ad(p, load_obsp=False)
+    assert lean.obsp == {}
