@@ -664,12 +664,22 @@ def _assemble_rows(blocks, n_rows):
 def stream_pca(src: ShardSource, gene_idx: np.ndarray,
                gene_mean: np.ndarray, key, n_components: int = 50,
                oversample: int = 10, n_iter: int = 2,
-               target_sum: float = 1e4):
+               target_sum: float = 1e4, checkpoint: str | None = None):
     """Streaming randomized PCA on the HVG-subset normalised matrix.
 
     gene_mean: per-gene means of the FULL normalised matrix (from
     stream_stats) — the subset's centering vector is gene_mean[gene_idx].
     Returns (scores (n, k) device, components (g_sub, k), explained (k,)).
+
+    ``checkpoint=`` makes the pass resumable at per-shard granularity
+    for the (g_sub, L)-sized state: the power iteration is organised
+    in rounds carrier → Q = qr(X @ carrier) → z = qr(Xᵀ Q), and only
+    the SMALL carrier + the rmatvec accumulator are persisted (~L·g_sub
+    floats, not the (n, L) Q — at 10M cells that array is GBs).  On
+    resume, Q is recomputed from the carrier (one deterministic matvec
+    sweep), then the rmatvec pass continues from the first unprocessed
+    shard: a crash loses at most one matvec sweep.  The file is
+    deleted on success.
     """
     from ..ops.pca import cholesky_qr
 
@@ -692,9 +702,27 @@ def stream_pca(src: ShardSource, gene_idx: np.ndarray,
             blocks.append(b)
         return _assemble_rows(blocks, src.n_cells)
 
-    def rmatvec_all(Q):
-        acc = jnp.zeros((g_sub, Q.shape[1]), jnp.float32)
-        for offset, sh in src:
+    start_round, start_shard, acc0 = 0, 0, None
+    if checkpoint is not None and os.path.exists(checkpoint):
+        z = np.load(checkpoint)
+        if not (int(z["n_cells"]) == src.n_cells
+                and int(z["g_sub"]) == g_sub and int(z["L"]) == L
+                and int(z["n_iter"]) == n_iter
+                and float(z["target_sum"]) == float(target_sum)):
+            raise ValueError(
+                f"stream_pca: checkpoint {checkpoint!r} was written for "
+                f"different arguments; delete it or pass a fresh path")
+        start_round = int(z["round"])
+        start_shard = int(z["next_shard"])
+        carrier = jnp.asarray(z["carrier"])
+        acc0 = jnp.asarray(z["acc"])
+    else:
+        carrier = jax.random.normal(key, (g_sub, L), jnp.float32)
+
+    def rmatvec_all(Q, rnd, acc=None, first_shard=0):
+        acc = (jnp.zeros((g_sub, Q.shape[1]), jnp.float32)
+               if acc is None else acc)
+        for offset, sh in src.iter_from(first_shard):
             # rows of Q beyond this shard's n_cells (its row padding)
             # belong to the next shard, but _shard_rmatvec masks by
             # row_mask so they contribute nothing here
@@ -707,19 +735,36 @@ def stream_pca(src: ShardSource, gene_idx: np.ndarray,
                                        target_sum, g_sub)
             if sync:
                 hard_sync(acc)
+            if checkpoint is not None:
+                shard_i = offset // src.shard_rows
+                tmp = checkpoint + ".tmp.npz"
+                np.savez(tmp, n_cells=src.n_cells, g_sub=g_sub, L=L,
+                         n_iter=n_iter, target_sum=target_sum,
+                         round=rnd, next_shard=shard_i + 1,
+                         carrier=np.asarray(carrier),
+                         acc=np.asarray(acc))
+                os.replace(tmp, checkpoint)
         return acc
 
-    omega = jax.random.normal(key, (g_sub, L), jnp.float32)
-    Q = cholesky_qr(matvec_all(omega))
-    for _ in range(n_iter):
-        Qz = cholesky_qr(rmatvec_all(Q))
-        Q = cholesky_qr(matvec_all(Qz))
-    B = rmatvec_all(Q).T  # (L, g_sub)
+    # rounds: carrier_r -> Q = qr(X c) -> z = rmatvec(Q);
+    # r < n_iter: carrier_{r+1} = qr(z); r == n_iter: B = z.T -> SVD
+    for rnd in range(start_round, n_iter + 1):
+        Q = cholesky_qr(matvec_all(carrier))
+        z = rmatvec_all(Q, rnd,
+                        acc=acc0 if rnd == start_round else None,
+                        first_shard=(start_shard
+                                     if rnd == start_round else 0))
+        acc0 = None
+        if rnd < n_iter:
+            carrier = cholesky_qr(z)
+    B = z.T  # (L, g_sub)
     U_b, S, Vt = jnp.linalg.svd(B, full_matrices=False)
     k = n_components
     scores = (Q @ U_b[:, :k]) * S[:k]
     components = Vt[:k].T
     explained = (S[:k] ** 2) / max(src.n_cells - 1, 1)
+    if checkpoint is not None and os.path.exists(checkpoint):
+        os.remove(checkpoint)
     return scores, components, explained
 
 
@@ -734,7 +779,8 @@ def stream_pipeline(src: ShardSource, *, n_top: int = 2000,
                     mito_mask: np.ndarray | None = None, seed: int = 0,
                     refine: int = 64,
                     hvg_flavor: str = "seurat_v3",
-                    mesh=None) -> dict:
+                    mesh=None,
+                    checkpoint_dir: str | None = None) -> dict:
     """h5ad shards → QC → HVG → 50-PC randomized PCA → kNN, out of
     core (BASELINE.json configs[4] shape).  Returns a dict:
     obs metrics (host), hvg_genes, X_pca (device), knn indices and
@@ -749,11 +795,21 @@ def stream_pipeline(src: ShardSource, *, n_top: int = 2000,
 
     if mesh is not None:
         src = src.with_mesh(mesh)
-    stats = stream_stats(src, target_sum=target_sum, mito_mask=mito_mask)
+    ck_stats = ck_pca = None
+    if checkpoint_dir is not None:
+        # crash recovery for the two heavy streamed passes (see
+        # stream_stats/stream_pca checkpoint=); each file self-deletes
+        # when its pass completes
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        ck_stats = os.path.join(checkpoint_dir, "stream_stats.npz")
+        ck_pca = os.path.join(checkpoint_dir, "stream_pca.npz")
+    stats = stream_stats(src, target_sum=target_sum, mito_mask=mito_mask,
+                         checkpoint=ck_stats)
     hvg_genes = stream_hvg(stats, n_top=n_top, flavor=hvg_flavor, src=src)
     scores, comps, expl = stream_pca(
         src, hvg_genes, stats["gene_mean"], jax.random.PRNGKey(seed),
-        n_components=n_components, target_sum=target_sum)
+        n_components=n_components, target_sum=target_sum,
+        checkpoint=ck_pca)
     if mesh is not None:
         from ..parallel.knn_multichip import knn_multichip_arrays
 

@@ -364,3 +364,56 @@ def test_stream_hvg_pearson_residuals_matches_memory(counts, src):
     assert agree >= 118  # ties at the cutoff may swap a gene or two
     with pytest.raises(ValueError, match="needs src"):
         stream_hvg(stats, flavor="pearson_residuals")
+
+
+def test_stream_pca_checkpoint_resume(counts, src, tmp_path):
+    """Kill the PCA mid-rmatvec in round 1; the rerun recomputes Q
+    from the small carrier and finishes — scores match the
+    uncheckpointed run to float tolerance."""
+    import dataclasses
+
+    import jax
+
+    stats = stream_stats(src)
+    hvg = stream_hvg(stats, n_top=150, flavor="dispersion")
+    args = dict(gene_idx=hvg, gene_mean=stats["gene_mean"],
+                key=jax.random.PRNGKey(0), n_components=15)
+    want_s, want_c, want_e = stream_pca(src, **args)
+
+    ck = str(tmp_path / "pca_ck.npz")
+    calls = [0]
+    base_from = src.factory_from
+
+    def exploding_from(k):
+        def gen():
+            for i, s in enumerate(base_from(k), start=k):
+                calls[0] += 1
+                # the 8th shard visit overall lands inside round 1's
+                # rmatvec (5 shards/pass: matvec 1-5, rmatvec 6-10)
+                if calls[0] == 8:
+                    raise RuntimeError("simulated crash mid-rmatvec")
+                yield s
+        return gen()
+
+    crashing = dataclasses.replace(
+        src, factory=lambda: exploding_from(0),
+        factory_from=exploding_from)
+    with pytest.raises(RuntimeError, match="mid-rmatvec"):
+        stream_pca(crashing, checkpoint=ck, **args)
+    assert os.path.exists(ck)
+    state = np.load(ck)
+    assert int(state["round"]) == 0 and int(state["next_shard"]) >= 1
+
+    got_s, got_c, got_e = stream_pca(src, checkpoint=ck, **args)
+    np.testing.assert_allclose(np.asarray(got_e), np.asarray(want_e),
+                               rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(got_s), np.asarray(want_s),
+                               rtol=1e-3, atol=1e-3)
+    assert not os.path.exists(ck)
+
+    # a stale checkpoint from different arguments must be rejected
+    np.savez(ck, n_cells=1, g_sub=1, L=1, n_iter=1, target_sum=1.0,
+             round=0, next_shard=0, carrier=np.zeros((1, 1)),
+             acc=np.zeros((1, 1)))
+    with pytest.raises(ValueError, match="different arguments"):
+        stream_pca(src, checkpoint=ck, **args)
