@@ -241,8 +241,12 @@ def _wilcoxon_z(centered_rank_sums, cnt, ties, n, tie_correct):
 # ----------------------------------------------------------------------
 
 
-def _finalise(data, scores, pvals, lfc, levels, method, n_top):
-    """Sort per group, BH-adjust, stash scanpy-shaped uns entry."""
+def _finalise(data, scores, pvals, lfc, levels, method, n_top,
+              pts_pair=None):
+    """Sort per group, BH-adjust, stash scanpy-shaped uns entry.
+    ``pts_pair`` (scanpy ``pts=True``): per-group expressing-cell
+    fractions, stored UNSORTED as (n_groups, n_genes) ``pts`` /
+    ``pts_rest`` — indexed by gene id, not by the ranked order."""
     padj = _bh_adjust(pvals)
     order = np.argsort(-scores, axis=1)
     if n_top is not None:
@@ -261,6 +265,9 @@ def _finalise(data, scores, pvals, lfc, levels, method, n_top):
         "pvals_adj": take(padj),
         "logfoldchanges": take(lfc),
     }
+    if pts_pair is not None:
+        result["pts"], result["pts_rest"] = (
+            np.asarray(p) for p in pts_pair)
     return data.with_uns(rank_genes_groups=result)
 
 
@@ -312,7 +319,8 @@ def _logreg_scores(data: CellData, codes, n_groups, l2: float = 1e-4,
 
 def _rank_genes_groups(data: CellData, groupby: str, method: str,
                        n_top, tie_correct: bool, dense_ranks_via,
-                       group_moments):
+                       group_moments, pts: bool = False,
+                       device: bool = True):
     from scipy import stats as sps
 
     codes_host, levels, n_obs = _group_codes(data, groupby)
@@ -341,13 +349,17 @@ def _rank_genes_groups(data: CellData, groupby: str, method: str,
                          f"'t-test_overestim_var', 'wilcoxon' or "
                          f"'logreg'")
     lfc = _logfoldchange(m_g, m_r)
-    return _finalise(data, scores, pvals, lfc, levels, method, n_top)
+    pts_pair = (_expression_fractions(data, codes_host, n_groups,
+                                      device) if pts else None)
+    return _finalise(data, scores, pvals, lfc, levels, method, n_top,
+                     pts_pair=pts_pair)
 
 
 @register("de.rank_genes_groups", backend="tpu")
 def rank_genes_groups_tpu(data: CellData, groupby: str = "label",
                           method: str = "t-test", n_top: int | None = None,
-                          tie_correct: bool = True) -> CellData:
+                          tie_correct: bool = True,
+                          pts: bool = False) -> CellData:
     """Rank genes characterising each group vs the rest (scanpy
     ``tl.rank_genes_groups``), group-vs-rest for every level of
     ``obs[groupby]``.
@@ -388,13 +400,15 @@ def rank_genes_groups_tpu(data: CellData, groupby: str = "label",
                 n_genes, jnp.asarray(codes_host), n_groups)
 
     return _rank_genes_groups(data, groupby, method, n_top, tie_correct,
-                              dense_ranks_via, group_moments)
+                              dense_ranks_via, group_moments, pts=pts,
+                              device=True)
 
 
 @register("de.rank_genes_groups", backend="cpu")
 def rank_genes_groups_cpu(data: CellData, groupby: str = "label",
                           method: str = "t-test", n_top: int | None = None,
-                          tie_correct: bool = True) -> CellData:
+                          tie_correct: bool = True,
+                          pts: bool = False) -> CellData:
     """scipy oracle: same statistics via dense numpy/scipy."""
     import scipy.sparse as sp
     from scipy import stats as sps
@@ -422,7 +436,8 @@ def rank_genes_groups_cpu(data: CellData, groupby: str = "label",
         return ties, onehot.sum(0), onehot.T @ (ranks - 0.5 * (n + 1))
 
     return _rank_genes_groups(data, groupby, method, n_top, tie_correct,
-                              dense_ranks_via, group_moments)
+                              dense_ranks_via, group_moments, pts=pts,
+                              device=False)
 
 
 # ----------------------------------------------------------------------

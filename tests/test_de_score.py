@@ -187,3 +187,26 @@ def test_rank_genes_groups_logreg_recovers_markers():
         b = set(np.asarray(out_t.uns["rank_genes_groups"]["indices"])[g]
                 .tolist())
         assert len(a & b) / 30 > 0.8
+
+
+def test_rank_genes_groups_pts(ds):
+    """pts=True (scanpy): per-group expressing-cell fractions, stored
+    unsorted by gene id; in-group fraction of a marker gene beats its
+    out-group fraction, and both backends agree."""
+    d = ds
+    c = sct.apply("de.rank_genes_groups", d, backend="cpu",
+                  groupby="label", method="t-test", pts=True)
+    t = sct.apply("de.rank_genes_groups", d.device_put(), backend="tpu",
+                  groupby="label", method="t-test", pts=True)
+    rc, rt = c.uns["rank_genes_groups"], t.uns["rank_genes_groups"]
+    assert rc["pts"].shape == (len(rc["groups"]), d.n_genes)
+    np.testing.assert_allclose(rt["pts"], rc["pts"], atol=1e-6)
+    np.testing.assert_allclose(rt["pts_rest"], rc["pts_rest"],
+                               atol=1e-6)
+    # top-ranked marker of group 0: expressed more inside than outside
+    g0_top = int(rc["indices"][0, 0])
+    assert rc["pts"][0, g0_top] > rc["pts_rest"][0, g0_top]
+    # default stays lean
+    assert "pts" not in sct.apply(
+        "de.rank_genes_groups", d, backend="cpu",
+        groupby="label").uns["rank_genes_groups"]
