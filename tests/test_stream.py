@@ -417,3 +417,16 @@ def test_stream_pca_checkpoint_resume(counts, src, tmp_path):
              acc=np.zeros((1, 1)))
     with pytest.raises(ValueError, match="different arguments"):
         stream_pca(src, checkpoint=ck, **args)
+
+
+def test_stream_pipeline_checkpoint_dir(counts, src, tmp_path):
+    """checkpoint_dir wires both passes; files self-delete on success
+    and the result matches the checkpoint-free run."""
+    want = stream_pipeline(src, n_top=150, n_components=10, k=8)
+    ckd = str(tmp_path / "cks")
+    got = stream_pipeline(src, n_top=150, n_components=10, k=8,
+                          checkpoint_dir=ckd)
+    np.testing.assert_allclose(np.asarray(got["X_pca"]),
+                               np.asarray(want["X_pca"]),
+                               rtol=1e-3, atol=1e-3)
+    assert os.listdir(ckd) == []  # both checkpoints consumed
