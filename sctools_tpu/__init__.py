@@ -34,6 +34,7 @@ from .data.concat import concat
 from .data.io import (from_dense, from_scipy, read_10x_h5, read_10x_mtx,
                       read_h5ad, read_loom, write_h5ad, write_loom)
 from .registry import Pipeline, Transform, apply, backends, get, names, register
+from .compat import experimental, pp, tl  # scanpy-style namespaces
 
 __version__ = "0.1.0"
 
@@ -43,4 +44,5 @@ __all__ = [
     "read_h5ad", "write_h5ad", "read_10x_mtx", "read_10x_h5", "read_loom",
     "write_loom",
     "from_scipy", "from_dense",
+    "pp", "tl", "experimental",
 ]

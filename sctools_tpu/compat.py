@@ -1,0 +1,131 @@
+"""scanpy-compatible function namespaces: ``sct.pp`` / ``sct.tl`` /
+``sct.experimental``.
+
+The registry's dotted operator names are the canonical API
+(``sct.apply("cluster.leiden", ...)``); these wrappers exist so a
+scanpy/reference user's muscle memory keeps working unchanged:
+
+>>> import sctools_tpu as sct
+>>> d = sct.pp.normalize_total(d, target_sum=1e4)
+>>> d = sct.pp.log1p(d)
+>>> d = sct.pp.highly_variable_genes(d, n_top=2000, subset=True)
+>>> d = sct.pp.pca(d); d = sct.pp.neighbors(d)
+>>> d = sct.tl.leiden(d); d = sct.tl.umap(d)
+
+Differences from scanpy, stated once: every wrapper is PURE (returns a
+new CellData; nothing mutates in place), takes ``backend=`` ("tpu"
+default, "cpu" for the oracle), and keyword names follow this
+package's operators (the GUIDE's operator map documents every
+rename).  Wrappers are thin — one ``apply`` call — except the three
+scanpy entry points that bundle several steps (``calculate_qc_metrics``,
+``neighbors``, ``recipe_*``), which compose the same registered ops a
+user would chain by hand.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+from .registry import apply
+
+# one-to-one renames: scanpy name -> registered operator
+_PP = {
+    "filter_cells": "qc.filter_cells",
+    "filter_genes": "qc.filter_genes",
+    "subsample": "qc.subsample",
+    "normalize_total": "normalize.library_size",
+    "log1p": "normalize.log1p",
+    "scale": "normalize.scale",
+    "regress_out": "normalize.regress_out",
+    "downsample_counts": "normalize.downsample_counts",
+    "highly_variable_genes": "hvg.select",
+    "pca": "pca.randomized",
+    "combat": "integrate.combat",
+    "bbknn": "neighbors.bbknn",
+    "magic": "impute.magic",
+    "scrublet": "qc.doublet_score",
+    "recipe_zheng17": "recipe.zheng17",
+    "recipe_seurat": "recipe.seurat",
+}
+
+_TL = {
+    "leiden": "cluster.leiden",
+    "louvain": "cluster.louvain",
+    "kmeans": "cluster.kmeans",
+    "dendrogram": "cluster.dendrogram",
+    "umap": "embed.umap",
+    "tsne": "embed.tsne",
+    "diffmap": "embed.diffmap",
+    "draw_graph": "embed.draw_graph",
+    "embedding_density": "embed.density",
+    "phate": "embed.phate",
+    "dpt": "dpt.pseudotime",
+    "paga": "graph.paga",
+    "rank_genes_groups": "de.rank_genes_groups",
+    "filter_rank_genes_groups": "de.filter_rank_genes_groups",
+    "marker_gene_overlap": "de.marker_gene_overlap",
+    "score_genes": "score.genes",
+    "score_genes_cell_cycle": "score.cell_cycle",
+    "ingest": "integrate.ingest",
+    "palantir": "palantir.run",
+    "wishbone": "wishbone.run",
+    "phenograph": "cluster.phenograph",
+}
+
+_EXPERIMENTAL_PP = {
+    "normalize_pearson_residuals": "normalize.pearson_residuals",
+    "recipe_pearson_residuals": "recipe.pearson_residuals",
+}
+
+
+def _wrap(scanpy_name: str, op: str):
+    def f(data, backend: str = "tpu", **kw):
+        return apply(op, data, backend=backend, **kw)
+
+    f.__name__ = scanpy_name
+    f.__qualname__ = scanpy_name
+    f.__doc__ = (f"scanpy-compat wrapper: ``{op}`` (see its registered "
+                 f"docstring / docs/GUIDE.md for parameter names).")
+    return f
+
+
+def _calculate_qc_metrics(data, backend: str = "tpu", **kw):
+    """scanpy ``pp.calculate_qc_metrics``: per-cell AND per-gene
+    metrics (``qc.per_cell_metrics`` + ``qc.per_gene_metrics``)."""
+    data = apply("qc.per_cell_metrics", data, backend=backend, **kw)
+    return apply("qc.per_gene_metrics", data, backend=backend)
+
+
+def _neighbors(data, backend: str = "tpu", k: int = 15,
+               metric: str = "cosine", connectivities: bool = True,
+               **kw):
+    """scanpy ``pp.neighbors``: kNN search plus the UMAP fuzzy
+    connectivity weights (``neighbors.knn`` + ``graph.connectivities``)."""
+    data = apply("neighbors.knn", data, backend=backend, k=k,
+                 metric=metric, **kw)
+    if connectivities:
+        data = apply("graph.connectivities", data, backend=backend)
+    return data
+
+
+def _experimental_hvg(data, backend: str = "tpu", **kw):
+    """scanpy ``experimental.pp.highly_variable_genes`` (pearson
+    residuals flavor by default)."""
+    kw.setdefault("flavor", "pearson_residuals")
+    return apply("hvg.select", data, backend=backend, **kw)
+
+
+pp = SimpleNamespace(
+    calculate_qc_metrics=_calculate_qc_metrics,
+    neighbors=_neighbors,
+    **{name: _wrap(name, op) for name, op in _PP.items()},
+)
+
+tl = SimpleNamespace(
+    **{name: _wrap(name, op) for name, op in _TL.items()},
+)
+
+experimental = SimpleNamespace(pp=SimpleNamespace(
+    highly_variable_genes=_experimental_hvg,
+    **{name: _wrap(name, op) for name, op in _EXPERIMENTAL_PP.items()},
+))

@@ -1,0 +1,56 @@
+"""scanpy-compat namespaces (sct.pp / sct.tl / sct.experimental)."""
+
+import numpy as np
+import pytest
+
+import sctools_tpu as sct
+from sctools_tpu.compat import _EXPERIMENTAL_PP, _PP, _TL
+from sctools_tpu.data.synthetic import synthetic_counts
+
+
+def test_every_wrapper_maps_to_a_registered_op():
+    registered = set(sct.names())
+    for table in (_PP, _TL, _EXPERIMENTAL_PP):
+        for scanpy_name, op in table.items():
+            assert op in registered, (scanpy_name, op)
+            assert callable(getattr(
+                sct.tl if table is _TL else
+                (sct.experimental.pp if table is _EXPERIMENTAL_PP
+                 else sct.pp), scanpy_name))
+
+
+def test_scanpy_style_workflow_runs():
+    """The scanpy call shapes drive the whole core workflow."""
+    d = synthetic_counts(300, 250, density=0.12, n_clusters=3, seed=6)
+    d = sct.pp.calculate_qc_metrics(d, backend="cpu")
+    assert "total_counts" in d.obs and "n_cells" in d.var
+    d = sct.pp.normalize_total(d, backend="cpu", target_sum=1e4)
+    d = sct.pp.log1p(d, backend="cpu")
+    d = sct.pp.highly_variable_genes(d, backend="cpu", n_top=120,
+                                     flavor="dispersion", subset=True)
+    d = sct.pp.pca(d, backend="cpu", n_components=12)
+    d = sct.pp.neighbors(d, backend="cpu", k=10)
+    assert "knn_indices" in d.obsp and "connectivities" in d.obsp
+    d = sct.tl.leiden(d, backend="cpu")
+    d = sct.tl.rank_genes_groups(d, backend="cpu", groupby="leiden")
+    assert "rank_genes_groups" in d.uns
+    assert len(np.unique(np.asarray(d.obs["leiden"]))) >= 2
+
+
+def test_compat_is_pure():
+    d = synthetic_counts(100, 60, density=0.2, seed=1)
+    out = sct.pp.log1p(d, backend="cpu")
+    assert out is not d
+    assert float(d.X.max()) > float(out.X.max())  # original untouched
+
+
+def test_experimental_namespace():
+    d = synthetic_counts(200, 150, density=0.15, n_clusters=3, seed=2)
+    h = sct.experimental.pp.highly_variable_genes(d, backend="cpu",
+                                                  n_top=50)
+    assert int(np.asarray(h.var["highly_variable"]).sum()) == 50
+    r = sct.experimental.pp.normalize_pearson_residuals(
+        sct.pp.highly_variable_genes(d, backend="cpu", n_top=80,
+                                     flavor="dispersion", subset=True),
+        backend="cpu")
+    assert np.asarray(r.X).shape == (200, 80)
