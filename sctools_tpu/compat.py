@@ -98,13 +98,18 @@ def _calculate_qc_metrics(data, backend: str = "tpu", **kw):
 
 def _neighbors(data, backend: str = "tpu", k: int = 15,
                metric: str = "cosine", connectivities: bool = True,
-               **kw):
-    """scanpy ``pp.neighbors``: kNN search plus the UMAP fuzzy
-    connectivity weights (``neighbors.knn`` + ``graph.connectivities``)."""
+               method: str = "umap", **kw):
+    """scanpy ``pp.neighbors``: kNN search plus the connectivity
+    weights (``neighbors.knn`` + ``graph.connectivities``).
+    ``method`` is scanpy's kernel choice ("umap" or "gauss"/"gaussian"),
+    routed to ``graph.connectivities(mode=)``; everything else forwards
+    to the kNN search."""
     data = apply("neighbors.knn", data, backend=backend, k=k,
                  metric=metric, **kw)
     if connectivities:
-        data = apply("graph.connectivities", data, backend=backend)
+        mode = {"gauss": "gaussian"}.get(method, method)
+        data = apply("graph.connectivities", data, backend=backend,
+                     mode=mode)
     return data
 
 

@@ -54,3 +54,14 @@ def test_experimental_namespace():
                                      flavor="dispersion", subset=True),
         backend="cpu")
     assert np.asarray(r.X).shape == (200, 80)
+
+
+def test_pp_neighbors_method_routes_to_connectivities():
+    d = synthetic_counts(150, 100, density=0.15, n_clusters=2, seed=3)
+    d = sct.pp.normalize_total(d, backend="cpu")
+    d = sct.pp.log1p(d, backend="cpu")
+    d = sct.pp.pca(d, backend="cpu", n_components=8)
+    g = sct.pp.neighbors(d, backend="cpu", k=8, method="gauss")
+    assert g.uns["connectivity_mode"] == "gaussian"
+    u = sct.pp.neighbors(d, backend="cpu", k=8)
+    assert u.uns["connectivity_mode"] == "umap"
