@@ -639,7 +639,8 @@ def _leiden_graph(data: CellData, weight_key: str):
 @register("cluster.leiden", backend="tpu")
 def leiden_tpu(data: CellData, resolution: float = 1.0,
                n_rounds: int = 20, n_levels: int = 3,
-               weight_key: str = "connectivities") -> CellData:
+               weight_key: str = "connectivities",
+               key_added: str = "leiden") -> CellData:
     """Modularity clustering of the kNN graph: device-parallel local
     moves (``louvain_moves_arrays``) interleaved with host coarse-graph
     merges, Louvain-style, until modularity stops improving.  The
@@ -660,15 +661,17 @@ def leiden_tpu(data: CellData, resolution: float = 1.0,
         if q <= best_q + 1e-9:
             break
         best_q, best_labels = q, labels
-    return data.with_obs(leiden=best_labels.astype(np.int32)).with_uns(
-        leiden_modularity=np.float32(best_q),
-        leiden_resolution=np.float32(resolution))
+    return data.with_obs(
+        **{key_added: best_labels.astype(np.int32)}).with_uns(
+        **{f"{key_added}_modularity": np.float32(best_q),
+           f"{key_added}_resolution": np.float32(resolution)})
 
 
 @register("cluster.leiden", backend="cpu")
 def leiden_cpu(data: CellData, resolution: float = 1.0,
                n_rounds: int = 20, n_levels: int = 3,
-               weight_key: str = "connectivities") -> CellData:
+               weight_key: str = "connectivities",
+               key_added: str = "leiden") -> CellData:
     """Sequential greedy Louvain oracle (same gain formula, node-by-
     node sweeps in id order — the classic serial algorithm the
     device's parallel half-sweeps approximate).
@@ -691,9 +694,10 @@ def leiden_cpu(data: CellData, resolution: float = 1.0,
             break
         best_q, best_labels = q, labels
         labels = labels.astype(np.int64)
-    return data.with_obs(leiden=best_labels.astype(np.int32)).with_uns(
-        leiden_modularity=np.float32(best_q),
-        leiden_resolution=np.float32(resolution))
+    return data.with_obs(
+        **{key_added: best_labels.astype(np.int32)}).with_uns(
+        **{f"{key_added}_modularity": np.float32(best_q),
+           f"{key_added}_resolution": np.float32(resolution)})
 
 
 def _serial_sweeps(idx2, w2, labels, resolution, n_rounds,
@@ -891,15 +895,15 @@ def louvain_tpu(data: CellData, resolution: float = 1.0,
     ``cluster.leiden`` (this package's optimiser IS the Louvain
     local-moves + aggregation scheme — see the module docstring), with
     the result stored under obs["louvain"]."""
-    out = leiden_tpu(data, resolution=resolution, n_rounds=n_rounds,
-                     n_levels=n_levels, weight_key=weight_key)
-    return out.with_obs(louvain=np.asarray(out.obs["leiden"]))
+    return leiden_tpu(data, resolution=resolution, n_rounds=n_rounds,
+                      n_levels=n_levels, weight_key=weight_key,
+                      key_added="louvain")
 
 
 @register("cluster.louvain", backend="cpu")
 def louvain_cpu(data: CellData, resolution: float = 1.0,
                 n_rounds: int = 20, n_levels: int = 3,
                 weight_key: str = "connectivities") -> CellData:
-    out = leiden_cpu(data, resolution=resolution, n_rounds=n_rounds,
-                     n_levels=n_levels, weight_key=weight_key)
-    return out.with_obs(louvain=np.asarray(out.obs["leiden"]))
+    return leiden_cpu(data, resolution=resolution, n_rounds=n_rounds,
+                      n_levels=n_levels, weight_key=weight_key,
+                      key_added="louvain")

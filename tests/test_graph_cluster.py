@@ -250,3 +250,13 @@ def test_scanpy_name_aliases(with_knn):
     np.testing.assert_allclose(
         np.asarray(dg.obsm["X_draw_graph"]),
         np.asarray(fd.obsm["X_draw_graph"]), atol=1e-5)
+
+
+def test_leiden_key_added(with_knn):
+    cpu, _ = with_knn
+    out = sct.apply("cluster.leiden", cpu, backend="cpu",
+                    resolution=0.5, key_added="leiden_r05")
+    assert "leiden_r05" in out.obs and "leiden" not in out.obs
+    assert "leiden_r05_modularity" in out.uns
+    lv = sct.apply("cluster.louvain", cpu, backend="cpu")
+    assert "louvain" in lv.obs and "leiden" not in lv.obs
