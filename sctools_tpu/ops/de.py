@@ -113,8 +113,9 @@ def _group_means(s, cnt):
     return s / n1, (tot_s[None, :] - s) / n2
 
 
-def _welch_stats(s, ss, cnt, overestim_var=False):
-    """Per-group vs rest Welch t statistics + dfs, numpy in float64.
+def _welch_stats(s, ss, cnt, overestim_var=False, ref=None):
+    """Per-group vs rest (or vs a REFERENCE group, scanpy
+    ``reference=``) Welch t statistics + dfs, numpy in float64.
 
     ``overestim_var`` reproduces scanpy's ``t-test_overestim_var``:
     the rest-group variance is divided by the *group's* size instead
@@ -125,11 +126,16 @@ def _welch_stats(s, ss, cnt, overestim_var=False):
     t_stats, dfs, m_g, m_r = [], [], [], []
     for g in range(s.shape[0]):
         n1 = max(cnt[g], 1.0)
-        n2 = max(tot_n - cnt[g], 1.0)
+        if ref is None:
+            n2 = max(tot_n - cnt[g], 1.0)
+            s2, ss2 = tot_s - s[g], tot_ss - ss[g]
+        else:
+            n2 = max(cnt[ref], 1.0)
+            s2, ss2 = s[ref], ss[ref]
         m1 = s[g] / n1
-        m2 = (tot_s - s[g]) / n2
+        m2 = s2 / n2
         v1 = np.maximum((ss[g] - n1 * m1**2) / max(n1 - 1, 1.0), 0.0)
-        v2 = np.maximum(((tot_ss - ss[g]) - n2 * m2**2)
+        v2 = np.maximum((ss2 - n2 * m2**2)
                         / max(n2 - 1, 1.0), 0.0)
         n2_eff = n1 if overestim_var else n2
         se2_1, se2_2 = v1 / n1, v2 / n2_eff
@@ -242,7 +248,7 @@ def _wilcoxon_z(centered_rank_sums, cnt, ties, n, tie_correct):
 
 
 def _finalise(data, scores, pvals, lfc, levels, method, n_top,
-              pts_pair=None):
+              pts_pair=None, reference="rest"):
     """Sort per group, BH-adjust, stash scanpy-shaped uns entry.
     ``pts_pair`` (scanpy ``pts=True``): per-group expressing-cell
     fractions, stored UNSORTED as (n_groups, n_genes) ``pts`` /
@@ -257,6 +263,7 @@ def _finalise(data, scores, pvals, lfc, levels, method, n_top,
     take = lambda a: np.take_along_axis(a, order, axis=1)
     result = {
         "method": method,
+        "reference": reference,
         "groups": levels,
         "indices": order,
         "names": (gene_names[order] if gene_names is not None else order),
@@ -320,11 +327,25 @@ def _logreg_scores(data: CellData, codes, n_groups, l2: float = 1e-4,
 def _rank_genes_groups(data: CellData, groupby: str, method: str,
                        n_top, tie_correct: bool, dense_ranks_via,
                        group_moments, pts: bool = False,
-                       device: bool = True):
+                       device: bool = True, groups=None,
+                       reference: str = "rest"):
     from scipy import stats as sps
 
     codes_host, levels, n_obs = _group_codes(data, groupby)
     n_groups = len(levels)
+    ref_idx = None
+    if reference != "rest":
+        if str(reference) not in levels:
+            raise ValueError(
+                f"rank_genes_groups: reference {reference!r} is not a "
+                f"level of obs[{groupby!r}] ({levels})")
+        if method not in ("t-test", "t-test_overestim_var"):
+            raise ValueError(
+                "rank_genes_groups: reference= other than 'rest' is "
+                "supported for the t-test methods (scanpy's wilcoxon-"
+                "vs-reference ranks only the pair subset; use "
+                "method='t-test')")
+        ref_idx = levels.index(str(reference))
 
     if method == "logreg":
         scores = _logreg_scores(data, codes_host, n_groups)
@@ -334,7 +355,8 @@ def _rank_genes_groups(data: CellData, groupby: str, method: str,
     elif method in ("t-test", "t-test_overestim_var"):
         s, ss, cnt = group_moments(codes_host, n_groups, need_ss=True)
         t, df, m_g, m_r = _welch_stats(
-            s, ss, cnt, overestim_var=(method == "t-test_overestim_var"))
+            s, ss, cnt, overestim_var=(method == "t-test_overestim_var"),
+            ref=ref_idx)
         pvals = 2.0 * sps.t.sf(np.abs(t), np.maximum(df, 1.0))
         scores = t
     elif method == "wilcoxon":
@@ -351,15 +373,28 @@ def _rank_genes_groups(data: CellData, groupby: str, method: str,
     lfc = _logfoldchange(m_g, m_r)
     pts_pair = (_expression_fractions(data, codes_host, n_groups,
                                       device) if pts else None)
+    if groups is not None or ref_idx is not None:
+        want = (None if groups is None else {str(g) for g in groups})
+        keep = [i for i, l in enumerate(levels)
+                if (want is None or l in want) and i != ref_idx]
+        if not keep:
+            raise ValueError(
+                f"rank_genes_groups: groups={groups!r} selects no "
+                f"level of {levels}")
+        scores, pvals, lfc = scores[keep], pvals[keep], lfc[keep]
+        levels = [levels[i] for i in keep]
+        if pts_pair is not None:
+            pts_pair = tuple(np.asarray(p)[keep] for p in pts_pair)
     return _finalise(data, scores, pvals, lfc, levels, method, n_top,
-                     pts_pair=pts_pair)
+                     pts_pair=pts_pair, reference=reference)
 
 
 @register("de.rank_genes_groups", backend="tpu")
 def rank_genes_groups_tpu(data: CellData, groupby: str = "label",
                           method: str = "t-test", n_top: int | None = None,
                           tie_correct: bool = True,
-                          pts: bool = False) -> CellData:
+                          pts: bool = False, groups=None,
+                          reference: str = "rest") -> CellData:
     """Rank genes characterising each group vs the rest (scanpy
     ``tl.rank_genes_groups``), group-vs-rest for every level of
     ``obs[groupby]``.
@@ -401,14 +436,16 @@ def rank_genes_groups_tpu(data: CellData, groupby: str = "label",
 
     return _rank_genes_groups(data, groupby, method, n_top, tie_correct,
                               dense_ranks_via, group_moments, pts=pts,
-                              device=True)
+                              device=True, groups=groups,
+                              reference=reference)
 
 
 @register("de.rank_genes_groups", backend="cpu")
 def rank_genes_groups_cpu(data: CellData, groupby: str = "label",
                           method: str = "t-test", n_top: int | None = None,
                           tie_correct: bool = True,
-                          pts: bool = False) -> CellData:
+                          pts: bool = False, groups=None,
+                          reference: str = "rest") -> CellData:
     """scipy oracle: same statistics via dense numpy/scipy."""
     import scipy.sparse as sp
     from scipy import stats as sps
@@ -437,7 +474,8 @@ def rank_genes_groups_cpu(data: CellData, groupby: str = "label",
 
     return _rank_genes_groups(data, groupby, method, n_top, tie_correct,
                               dense_ranks_via, group_moments, pts=pts,
-                              device=False)
+                              device=False, groups=groups,
+                              reference=reference)
 
 
 # ----------------------------------------------------------------------

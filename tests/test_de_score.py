@@ -210,3 +210,40 @@ def test_rank_genes_groups_pts(ds):
     assert "pts" not in sct.apply(
         "de.rank_genes_groups", d, backend="cpu",
         groupby="label").uns["rank_genes_groups"]
+
+
+def test_rank_genes_groups_reference_and_groups(ds):
+    """scanpy groups=/reference=: compare selected groups against one
+    reference group with pairwise Welch statistics."""
+    d = ds
+    out = sct.apply("de.rank_genes_groups", d, backend="cpu",
+                    groupby="label", method="t-test",
+                    groups=["b"], reference="a")
+    r = out.uns["rank_genes_groups"]
+    assert r["groups"] == ["b"] and r["reference"] == "a"
+    assert r["scores"].shape[0] == 1
+    # oracle: scipy Welch t of b vs a directly
+    X = np.asarray(d.X.todense(), np.float64)
+    labels = np.asarray(d.obs["label"])
+    t_ref, _ = sps.ttest_ind(X[labels == "b"], X[labels == "a"],
+                             equal_var=False)
+    g0 = int(r["indices"][0, 0])
+    np.testing.assert_allclose(r["scores"][0, 0], t_ref[g0], rtol=1e-3)
+    # the planted b-markers (genes 0:5) dominate b-vs-a
+    assert set(r["indices"][0, :5].tolist()) & set(range(5))
+    # tpu parity
+    t = sct.apply("de.rank_genes_groups", d.device_put(), backend="tpu",
+                  groupby="label", method="t-test", groups=["b"],
+                  reference="a")
+    np.testing.assert_allclose(t.uns["rank_genes_groups"]["scores"],
+                               r["scores"], rtol=1e-3, atol=1e-4)
+    # validation
+    with pytest.raises(ValueError, match="not a level"):
+        sct.apply("de.rank_genes_groups", d, backend="cpu",
+                  groupby="label", reference="zzz")
+    with pytest.raises(ValueError, match="t-test"):
+        sct.apply("de.rank_genes_groups", d, backend="cpu",
+                  groupby="label", method="wilcoxon", reference="a")
+    with pytest.raises(ValueError, match="selects no"):
+        sct.apply("de.rank_genes_groups", d, backend="cpu",
+                  groupby="label", groups=["zzz"])
