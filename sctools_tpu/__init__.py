@@ -35,6 +35,25 @@ from .data.io import (from_dense, from_scipy, read_10x_h5, read_10x_mtx,
                       read_h5ad, read_loom, write_h5ad, write_loom)
 from .registry import Pipeline, Transform, apply, backends, get, names, register
 from .compat import experimental, pp, tl  # scanpy-style namespaces
+from . import accessors as _accessors
+from .registry import get as _registry_get
+
+
+class _GetNamespace:
+    """``sct.get`` serves two scanpy-shaped roles: CALLED, it is the
+    registry lookup (``sct.get("normalize.log1p", backend="tpu")``);
+    as a namespace it carries the ``sc.get``-style tabular accessors
+    (``sct.get.rank_genes_groups_df`` / ``obs_df`` / ``var_df``)."""
+
+    def __call__(self, name, backend=None):
+        return _registry_get(name, backend)
+
+    rank_genes_groups_df = staticmethod(_accessors.rank_genes_groups_df)
+    obs_df = staticmethod(_accessors.obs_df)
+    var_df = staticmethod(_accessors.var_df)
+
+
+get = _GetNamespace()
 
 __version__ = "0.1.0"
 

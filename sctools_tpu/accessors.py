@@ -1,0 +1,69 @@
+"""Tabular accessors (scanpy's ``sc.get`` namespace) — exposed as
+``sct.get.obs_df`` etc. via the callable namespace in ``__init__``
+(``sct.get("op", backend=...)`` remains the registry lookup).
+
+No pandas dependency is assumed by the core package, so "DataFrame"
+here means a plain ``dict[str, np.ndarray]`` of aligned columns —
+``pandas.DataFrame(result)`` turns any of these into the real thing
+when pandas is around.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .data.dataset import CellData
+
+
+def rank_genes_groups_df(data: CellData, group: str,
+                         key: str = "rank_genes_groups") -> dict:
+    """scanpy ``get.rank_genes_groups_df``: one group's ranking as
+    aligned columns (names, scores, pvals, pvals_adj, logfoldchanges,
+    and pct_nz_group/pct_nz_reference when ``pts=True`` was used)."""
+    if key not in data.uns:
+        raise KeyError(f"get.rank_genes_groups_df: uns has no {key!r} "
+                       f"— run de.rank_genes_groups first")
+    res = data.uns[key]
+    groups = [str(g) for g in res["groups"]]
+    if str(group) not in groups:
+        raise ValueError(f"group {group!r} not in {groups}")
+    gi = groups.index(str(group))
+    out = {
+        "names": np.asarray(res["names"][gi]),
+        "scores": np.asarray(res["scores"][gi]),
+        "pvals": np.asarray(res["pvals"][gi]),
+        "pvals_adj": np.asarray(res["pvals_adj"][gi]),
+        "logfoldchanges": np.asarray(res["logfoldchanges"][gi]),
+    }
+    if "pts" in res:
+        # pts is stored unsorted by gene id; align to the ranked order
+        idx = np.asarray(res["indices"][gi])
+        out["pct_nz_group"] = np.asarray(res["pts"][gi])[idx]
+        out["pct_nz_reference"] = np.asarray(res["pts_rest"][gi])[idx]
+    return out
+
+
+def obs_df(data: CellData, keys) -> dict:
+    """scanpy ``get.obs_df``: per-cell columns by name — obs columns,
+    gene names (expression pulled from X), or ``obsm`` columns given
+    as ``(obsm_key, column_index)`` tuples."""
+    out = {}
+    for k in keys:
+        if isinstance(k, tuple):
+            m, j = k
+            out[f"{m}-{j}"] = np.asarray(data.obsm[m])[: data.n_cells, j]
+        else:
+            out[str(k)] = data.obs_vector(k)
+    return out
+
+
+def var_df(data: CellData, keys) -> dict:
+    """scanpy ``get.var_df``: per-gene columns by name — var columns
+    or cell ids (int index: that cell's expression across genes)."""
+    out = {}
+    for k in keys:
+        if isinstance(k, (int, np.integer)):
+            out[f"cell{int(k)}"] = data.var_vector(int(k))
+        else:
+            out[str(k)] = data.var_vector(k)
+    return out

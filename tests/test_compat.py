@@ -65,3 +65,36 @@ def test_pp_neighbors_method_routes_to_connectivities():
     assert g.uns["connectivity_mode"] == "gaussian"
     u = sct.pp.neighbors(d, backend="cpu", k=8)
     assert u.uns["connectivity_mode"] == "umap"
+
+
+def test_get_accessors():
+    """sc.get-style tabular accessors (dicts of aligned columns)."""
+    d = synthetic_counts(200, 120, density=0.15, n_clusters=2, seed=4)
+    d = sct.pp.normalize_total(d, backend="cpu")
+    d = sct.pp.log1p(d, backend="cpu")
+    labels = np.array(["a", "b"])[np.arange(200) % 2]
+    d = d.with_obs(label=labels)
+    d = sct.tl.rank_genes_groups(d, backend="cpu", groupby="label",
+                                 pts=True)
+    df = sct.get.rank_genes_groups_df(d, "a")
+    n_genes = 120
+    for col in ("names", "scores", "pvals", "pvals_adj",
+                "logfoldchanges", "pct_nz_group", "pct_nz_reference"):
+        assert len(df[col]) == n_genes, col
+    # pct columns align with the ranked names, not gene-id order
+    top = df["names"][0]
+    gid = int(np.nonzero(np.asarray(
+        d.var["gene_name"]).astype(str) == str(top))[0][0])
+    assert df["pct_nz_group"][0] == d.uns["rank_genes_groups"]["pts"][0, gid]
+
+    od = sct.get.obs_df(d, ["label", str(np.asarray(
+        d.var["gene_name"])[3])])
+    assert len(od) == 2 and all(len(v) == 200 for v in od.values())
+    vd = sct.get.var_df(d, ["gene_name", 0])
+    assert len(vd["cell0"]) == 120
+
+    with pytest.raises(ValueError, match="not in"):
+        sct.get.rank_genes_groups_df(d, "zzz")
+    with pytest.raises(KeyError, match="rank_genes_groups"):
+        sct.get.rank_genes_groups_df(
+            synthetic_counts(10, 10, seed=0), "a")
