@@ -46,6 +46,8 @@ class _GetNamespace:
     (``sct.get.rank_genes_groups_df`` / ``obs_df`` / ``var_df``)."""
 
     def __call__(self, name, backend=None):
+        if backend is None:  # registry default, not a literal None
+            return _registry_get(name)
         return _registry_get(name, backend)
 
     rank_genes_groups_df = staticmethod(_accessors.rank_genes_groups_df)
