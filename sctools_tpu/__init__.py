@@ -33,7 +33,7 @@ from .data import CellData, SparseCells
 from .data.concat import concat
 from .data.io import (from_dense, from_scipy, read_10x_h5, read_10x_mtx,
                       read_h5ad, read_loom, write_h5ad, write_loom)
-from .registry import Pipeline, Transform, apply, backends, get, names, register
+from .registry import Pipeline, Transform, apply, backends, names, register
 from .compat import experimental, pp, tl  # scanpy-style namespaces
 from . import accessors as _accessors
 from .registry import get as _registry_get

@@ -375,16 +375,29 @@ def _rank_genes_groups(data: CellData, groupby: str, method: str,
                                       device) if pts else None)
     if groups is not None or ref_idx is not None:
         want = (None if groups is None else {str(g) for g in groups})
+        if want is not None:
+            unknown = want - set(levels)
+            if unknown:
+                raise ValueError(
+                    f"rank_genes_groups: groups {sorted(unknown)} are "
+                    f"not levels of obs[{groupby!r}] ({levels})")
         keep = [i for i, l in enumerate(levels)
                 if (want is None or l in want) and i != ref_idx]
         if not keep:
             raise ValueError(
                 f"rank_genes_groups: groups={groups!r} selects no "
                 f"level of {levels}")
+        if pts_pair is not None:
+            frac_in, frac_out = (np.asarray(p) for p in pts_pair)
+            if ref_idx is not None:
+                # vs a named reference: the "rest" column is the
+                # REFERENCE group's own expressing fraction (scanpy's
+                # pct_nz_reference), not the vs-rest complement
+                frac_out = np.broadcast_to(
+                    frac_in[ref_idx], frac_in.shape).copy()
+            pts_pair = (frac_in[keep], frac_out[keep])
         scores, pvals, lfc = scores[keep], pvals[keep], lfc[keep]
         levels = [levels[i] for i in keep]
-        if pts_pair is not None:
-            pts_pair = tuple(np.asarray(p)[keep] for p in pts_pair)
     return _finalise(data, scores, pvals, lfc, levels, method, n_top,
                      pts_pair=pts_pair, reference=reference)
 

@@ -244,6 +244,26 @@ def test_rank_genes_groups_reference_and_groups(ds):
     with pytest.raises(ValueError, match="t-test"):
         sct.apply("de.rank_genes_groups", d, backend="cpu",
                   groupby="label", method="wilcoxon", reference="a")
-    with pytest.raises(ValueError, match="selects no"):
+    with pytest.raises(ValueError, match="not levels"):
         sct.apply("de.rank_genes_groups", d, backend="cpu",
                   groupby="label", groups=["zzz"])
+
+
+def test_rank_genes_groups_reference_pts_semantics(ds):
+    """With reference=, pts_rest must be the REFERENCE group's own
+    expressing fraction (scanpy pct_nz_reference), and unknown
+    groups= names raise instead of silently dropping."""
+    d = ds
+    out = sct.apply("de.rank_genes_groups", d, backend="cpu",
+                    groupby="label", method="t-test",
+                    groups=["b"], reference="a", pts=True)
+    r = out.uns["rank_genes_groups"]
+    # reference fractions == group-a fractions from a plain pts run
+    full = sct.apply("de.rank_genes_groups", d, backend="cpu",
+                     groupby="label", pts=True)
+    a_row = list(full.uns["rank_genes_groups"]["groups"]).index("a")
+    np.testing.assert_allclose(
+        r["pts_rest"][0], full.uns["rank_genes_groups"]["pts"][a_row])
+    with pytest.raises(ValueError, match="not levels"):
+        sct.apply("de.rank_genes_groups", d, backend="cpu",
+                  groupby="label", groups=["b", "Bcell-typo"])
