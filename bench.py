@@ -1263,11 +1263,23 @@ def main():
                 detail[key] = res[key]
         detail["phase_small"] = res.get("_phase")
 
+    atlas_route_env = {}
     if args.config is None and not tpu_dead and remaining() > 150:
         res = run_phase("kernel", min(300.0, remaining() - 60))
         note_tpu(res)
         if "kernel_knn" in res:
             detail["kernel_knn"] = res["kernel_knn"]
+            # route the atlas onto the sweep's measured winner IN THIS
+            # RUN: the recommendation only fires on a hard-sync'd,
+            # roofline-plausible >=1.2x win at >=0.99 quality
+            rec = res["kernel_knn"].get("routing_recommendation")
+            if rec in ("pallas", "pallas_binned"):
+                atlas_route_env["SCTOOLS_TPU_KNN_IMPL"] = rec
+                stage("atlas.route", knn_impl=rec,
+                      reason="kernel sweep winner")
+            if res["kernel_knn"].get("col_block_recommendation"):
+                atlas_route_env["SCTOOLS_TPU_COL_BLOCK"] = str(
+                    res["kernel_knn"]["col_block_recommendation"])
         detail["phase_kernel"] = res.get("_phase")
 
     # atlas ramp: smallest (known-survivable) size first, then scale
@@ -1298,7 +1310,8 @@ def main():
                 os.environ.get("TMPDIR", "/tmp"),
                 f"sctools_stats_ck_{n_cells}.npz")
             overrides = {"SCTOOLS_BENCH_CELLS": str(n_cells),
-                         "SCTOOLS_BENCH_STATS_CHECKPOINT": ck_path}
+                         "SCTOOLS_BENCH_STATS_CHECKPOINT": ck_path,
+                         **atlas_route_env}
             res = run_phase("atlas",
                             min(attempt_cap, remaining() - 120),
                             env_overrides=overrides)
@@ -1340,7 +1353,8 @@ def main():
             res = run_phase(
                 "atlas", min(600.0, remaining() - 120),
                 env_overrides={"SCTOOLS_BENCH_CELLS": str(full),
-                               "SCTOOLS_BENCH_MATERIALIZE": "0"})
+                               "SCTOOLS_BENCH_MATERIALIZE": "0",
+                               **atlas_route_env})
             note_tpu(res)
             attempts.append({"n_cells": full, "materialized": False,
                              "status": res["_phase"]["status"],

@@ -136,6 +136,12 @@ config = Config()
 
 if os.environ.get("SCTOOLS_TPU_MATMUL_DTYPE"):
     config.matmul_dtype = os.environ["SCTOOLS_TPU_MATMUL_DTYPE"]
+if os.environ.get("SCTOOLS_TPU_KNN_IMPL"):
+    # lets the bench orchestrator route atlas children onto the kernel
+    # sweep's measured winner within the same run
+    config.knn_impl = os.environ["SCTOOLS_TPU_KNN_IMPL"]
+if os.environ.get("SCTOOLS_TPU_COL_BLOCK"):
+    config.col_block = int(os.environ["SCTOOLS_TPU_COL_BLOCK"])
 if os.environ.get("SCTOOLS_TPU_PALLAS_INTERPRET"):
     config.pallas_interpret = os.environ["SCTOOLS_TPU_PALLAS_INTERPRET"]
 

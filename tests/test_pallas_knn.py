@@ -148,3 +148,20 @@ def test_binned_merge_validation():
         pallas_knn_arrays(pts, pts, k=600, merge="binned", n_bins=512)
     with pytest.raises(ValueError, match="merge"):
         pallas_knn_arrays(pts, pts, k=5, merge="bogus")
+
+
+def test_knn_impl_pallas_binned_routes(monkeypatch):
+    """config.knn_impl='pallas_binned' (the bench routing target) runs
+    the binned-merge Pallas variant through the public knn_arrays."""
+    import jax.numpy as jnp
+
+    from sctools_tpu.config import configure
+    from sctools_tpu.data.synthetic import gaussian_blobs
+    from sctools_tpu.ops.knn import knn_arrays, knn_numpy, recall_at_k
+
+    pts, _ = gaussian_blobs(512, 16, 4, seed=0)
+    with configure(knn_impl="pallas_binned", pallas_interpret=True):
+        idx, _ = knn_arrays(jnp.asarray(pts), jnp.asarray(pts), k=5,
+                            metric="euclidean")
+    ref, _ = knn_numpy(pts, pts, k=5, metric="euclidean")
+    assert recall_at_k(np.asarray(idx)[:512, :5], ref) > 0.97
