@@ -467,9 +467,15 @@ def run_kernel_bench(jax, on_tpu):
             if impl == "pallas_binned":
                 from sctools_tpu.ops.pallas_knn import pallas_knn_arrays
 
+                from sctools_tpu.config import config as _cfg
+
+                # the SAME n_bins a routed atlas will run with
+                # (config.knn_bins) — the recall gate must approve the
+                # exact kernel configuration that gets routed
                 return pallas_knn_arrays(pts, pts, k=k, metric="cosine",
                                          n_query=n, n_cand=n,
-                                         merge="binned", n_bins=1024)
+                                         merge="binned",
+                                         n_bins=_cfg.knn_bins)
             return knn_arrays(pts, pts, k=k, metric="cosine",
                               n_query=n, n_cand=n)
 
@@ -1275,11 +1281,15 @@ def main():
             rec = res["kernel_knn"].get("routing_recommendation")
             if rec in ("pallas", "pallas_binned"):
                 atlas_route_env["SCTOOLS_TPU_KNN_IMPL"] = rec
-                stage("atlas.route", knn_impl=rec,
-                      reason="kernel sweep winner")
             if res["kernel_knn"].get("col_block_recommendation"):
                 atlas_route_env["SCTOOLS_TPU_COL_BLOCK"] = str(
                     res["kernel_knn"]["col_block_recommendation"])
+            if atlas_route_env:
+                # one stage record per route decision, so the artifact
+                # always states the non-default config atlas ran with
+                stage("atlas.route", reason="kernel sweep winner",
+                      **{k.lower(): v
+                         for k, v in atlas_route_env.items()})
         detail["phase_kernel"] = res.get("_phase")
 
     # atlas ramp: smallest (known-survivable) size first, then scale

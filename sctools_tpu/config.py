@@ -56,6 +56,11 @@ class Config:
     row_block: int = 1024
     # Candidate-block size for blocked kNN (columns of the score tile).
     col_block: int = 2048
+    # Bin count for the binned Pallas top-k merge (collision odds
+    # ~k²/(2·knn_bins), see pallas_knn.py).  The kernel microbench
+    # measures this exact value, so a routed atlas runs the same
+    # kernel configuration the recall gate approved.
+    knn_bins: int = 1024
 
     # Compute dtypes — THE NUMERICS CONTRACT (per-op):
     #
@@ -139,9 +144,24 @@ if os.environ.get("SCTOOLS_TPU_MATMUL_DTYPE"):
 if os.environ.get("SCTOOLS_TPU_KNN_IMPL"):
     # lets the bench orchestrator route atlas children onto the kernel
     # sweep's measured winner within the same run
-    config.knn_impl = os.environ["SCTOOLS_TPU_KNN_IMPL"]
+    _impl = os.environ["SCTOOLS_TPU_KNN_IMPL"]
+    if _impl not in ("auto", "xla", "pallas", "pallas_binned"):
+        raise ValueError(
+            f"SCTOOLS_TPU_KNN_IMPL={_impl!r}: use auto, xla, pallas "
+            f"or pallas_binned (an unknown value would silently run "
+            f"xla while the artifact records the bogus name)")
+    config.knn_impl = _impl
 if os.environ.get("SCTOOLS_TPU_COL_BLOCK"):
-    config.col_block = int(os.environ["SCTOOLS_TPU_COL_BLOCK"])
+    try:
+        _cb = int(os.environ["SCTOOLS_TPU_COL_BLOCK"])
+    except ValueError as e:
+        raise ValueError(
+            f"SCTOOLS_TPU_COL_BLOCK="
+            f"{os.environ['SCTOOLS_TPU_COL_BLOCK']!r} is not an "
+            f"integer") from e
+    if _cb <= 0:
+        raise ValueError(f"SCTOOLS_TPU_COL_BLOCK={_cb} must be > 0")
+    config.col_block = _cb
 if os.environ.get("SCTOOLS_TPU_PALLAS_INTERPRET"):
     config.pallas_interpret = os.environ["SCTOOLS_TPU_PALLAS_INTERPRET"]
 

@@ -95,15 +95,16 @@ def knn_arrays(
     n_query = n_query or query.shape[0]
     n_cand = n_cand or cand.shape[0]
     k_search = max(k, refine) if refine else k
-    if config.resolved_knn_impl() in ("pallas", "pallas_binned"):
+    impl = config.resolved_knn_impl()
+    if impl in ("pallas", "pallas_binned"):
         from .pallas_knn import pallas_knn_arrays
 
         idx, dist = pallas_knn_arrays(
             query, cand, k=k_search, metric=metric,
             n_query=n_query, n_cand=n_cand, query_block=query_block,
             cand_block=cand_block, exclude_self=exclude_self,
-            **({"merge": "binned"}
-               if config.resolved_knn_impl() == "pallas_binned" else {}),
+            merge="binned" if impl == "pallas_binned" else "select",
+            n_bins=config.knn_bins,
         )
     else:
         nv = jnp.int32(n_cand if n_valid_cand is None else n_valid_cand)
