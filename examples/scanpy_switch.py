@@ -1,0 +1,67 @@
+"""The switching story in one file: a standard scanpy PBMC-style
+script where the ONLY changes are the import line and reading results
+from the returned object (ops are pure — nothing mutates in place).
+
+scanpy version this mirrors, line for line:
+
+    import scanpy as sc
+    adata = sc.read_h5ad("pbmc.h5ad")
+    sc.pp.calculate_qc_metrics(adata)
+    sc.pp.filter_cells(adata, min_genes=200)
+    sc.pp.filter_genes(adata, min_cells=3)
+    sc.pp.normalize_total(adata, target_sum=1e4)
+    sc.pp.log1p(adata)
+    sc.pp.highly_variable_genes(adata, n_top_genes=2000, subset=True)
+    sc.pp.pca(adata, n_comps=50)
+    sc.pp.neighbors(adata, n_neighbors=15)
+    sc.tl.leiden(adata)
+    sc.tl.umap(adata)
+    sc.tl.rank_genes_groups(adata, "leiden", pts=True)
+    df = sc.get.rank_genes_groups_df(adata, "0")
+"""
+
+import numpy as np
+
+import sctools_tpu as sct
+
+
+def main(backend: str = "tpu"):
+    from sctools_tpu.data.synthetic import synthetic_counts
+
+    d = synthetic_counts(2000, 3000, density=0.06, n_clusters=5,
+                        mito_frac=0.02, seed=0)
+    if backend == "tpu":
+        d = d.device_put()
+
+    d = sct.pp.calculate_qc_metrics(d, backend=backend)
+    d = sct.pp.filter_cells(d, backend=backend, min_genes=20)
+    d = sct.pp.filter_genes(d, backend=backend, min_cells=3)
+    d = sct.pp.normalize_total(d, backend=backend, target_sum=1e4)
+    d = sct.pp.log1p(d, backend=backend)
+    d = sct.pp.highly_variable_genes(d, backend=backend, n_top=1500,
+                                     subset=True)
+    d = sct.pp.pca(d, backend=backend, n_components=50)
+    d = sct.pp.neighbors(d, backend=backend, k=15)
+    d = sct.tl.leiden(d, backend=backend)
+    d = sct.tl.umap(d, backend=backend, n_epochs=100)
+    d = sct.tl.rank_genes_groups(d, backend=backend, groupby="leiden",
+                                 pts=True)
+
+    host = d.to_host() if backend == "tpu" else d
+    groups = [str(g) for g in host.uns["rank_genes_groups"]["groups"]]
+    df = sct.get.rank_genes_groups_df(host, groups[0])
+    n_clusters = len(np.unique(np.asarray(host.obs["leiden"])))
+    print(f"cells={host.n_cells} genes={host.n_genes} "
+          f"clusters={n_clusters} umap={host.obsm['X_umap'].shape} "
+          f"top marker of cluster {groups[0]}: {df['names'][0]} "
+          f"(pct in/ref {df['pct_nz_group'][0]:.2f}/"
+          f"{df['pct_nz_reference'][0]:.2f})")
+    assert n_clusters >= 3
+    assert host.obsm["X_umap"].shape[1] == 2
+    print("OK")
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1] if len(sys.argv) > 1 else "tpu")

@@ -10,7 +10,8 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.mark.parametrize("script", ["pbmc_workflow.py",
-                                    "integration_workflow.py"])
+                                    "integration_workflow.py",
+                                    "scanpy_switch.py"])
 def test_example_runs(script):
     # PYTHONPATH is REPLACED, not appended: the session's PYTHONPATH
     # carries the axon sitecustomize that registers the TPU-tunnel
