@@ -83,7 +83,18 @@ def main():
     from sctools_tpu.data.stream import stream_hvg, stream_stats
 
     t = time.time()
-    st = stream_stats(src)
+    # checkpointed: a worker crash mid-stats leaves resume state, so
+    # the NEXT probe run (fresh process — the backend doesn't heal
+    # in-process) continues from the first unprocessed shard instead
+    # of replaying the crash from shard 0
+    ck = "/tmp/tpu_probe_stats_ck.npz"
+    try:
+        st = stream_stats(src, checkpoint=ck)
+    except ValueError:  # stale state from a different --cells run
+        import os as _os
+
+        _os.remove(ck)
+        st = stream_stats(src, checkpoint=ck)
     hvg = stream_hvg(st, n_top=2000, flavor="seurat_v3", src=src)
     log("step3 OK:", round(time.time() - t, 1), "s; hvg[0:3]",
         hvg[:3].tolist())
