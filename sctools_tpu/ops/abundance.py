@@ -40,16 +40,20 @@ from ..registry import register
 
 
 def _nbhd_counts(idx, flags, device):
-    """Per-index-cell count of flagged neighbours (self included)."""
+    """Per-index-cell count of flagged neighbours.  ``idx`` rows must
+    already carry the index cell itself as their first column (the
+    caller appends it — under ``prop=`` sampling the row position no
+    longer equals the cell id, so an implicit self-add would index the
+    wrong cells)."""
     if device:
         safe = jnp.where(idx < 0, 0, idx)
         f = jnp.asarray(flags, jnp.float32)
         gathered = jnp.where(idx >= 0, jnp.take(f, safe), 0.0)
-        return np.asarray(jnp.sum(gathered, axis=1) + f[: idx.shape[0]])
+        return np.asarray(jnp.sum(gathered, axis=1))
     f = np.asarray(flags, np.float64)
     safe = np.where(idx >= 0, idx, 0)
     gathered = np.where(idx >= 0, f[safe], 0.0)
-    return gathered.sum(axis=1) + f[: idx.shape[0]]
+    return gathered.sum(axis=1)
 
 
 def _nbhd_sample_counts(idx, codes, S, device):
@@ -68,9 +72,17 @@ def _nbhd_sample_counts(idx, codes, S, device):
     rows = np.repeat(np.arange(n), k)[valid]
     c = codes[idx.ravel()[valid]]
     counts = np.bincount(rows * S + c, minlength=n * S).reshape(n, S)
-    counts = counts.astype(np.float64)
-    counts[np.arange(n), codes[:n]] += 1.0  # self
-    return counts
+    return counts.astype(np.float64)  # self is idx's first column
+
+
+def _expand(vals, index_cells, n):
+    """Scatter per-index-cell results to (n,) float32, NaN elsewhere
+    (Milo convention: non-index cells have no neighbourhood)."""
+    if len(index_cells) == n:
+        return np.asarray(vals, np.float32)
+    out = np.full(n, np.nan, np.float32)
+    out[index_cells] = vals
+    return out
 
 
 def _bh_fdr(pvals):
@@ -130,7 +142,7 @@ def _replicate_test(idx, cond, samples, a, b, device):
 
 
 def _differential_abundance(data: CellData, condition_key, groups,
-                            sample_key, device):
+                            sample_key, device, prop=1.0, seed=0):
     n = data.n_cells
     if "knn_indices" not in data.obsp:
         raise KeyError("da.neighborhoods: run neighbors.knn first")
@@ -145,6 +157,22 @@ def _differential_abundance(data: CellData, condition_key, groups,
     a, b = levels
     idx = np.asarray(data.obsp["knn_indices"])[:n]
 
+    # Milo's make_nhoods(prop=): sample a fraction of cells as
+    # neighbourhood index cells — FDR correction then runs over the
+    # sampled neighbourhoods only, and non-index cells carry NaN
+    if not (0.0 < prop <= 1.0):
+        raise ValueError(f"da.neighborhoods: prop={prop} not in (0, 1]")
+    index_cells = np.arange(n)
+    if prop < 1.0:
+        rng = np.random.default_rng(seed)
+        n_idx = max(int(round(prop * n)), 2)
+        index_cells = np.sort(rng.choice(n, size=n_idx, replace=False))
+        idx = idx[index_cells]
+    # neighbourhood = index cell + its kNN set: make the self
+    # membership an explicit first column (see _nbhd_counts)
+    idx = np.concatenate([index_cells[:, None].astype(idx.dtype), idx],
+                         axis=1)
+
     if sample_key is not None:
         if sample_key not in data.obs:
             raise KeyError(
@@ -153,11 +181,12 @@ def _differential_abundance(data: CellData, condition_key, groups,
         score, pvals, lfc, slevels = _replicate_test(
             idx, cond, samples, a, b, device)
         return (data.with_obs(
-            da_score=score.astype(np.float32),
-            da_fdr=_bh_fdr(pvals).astype(np.float32),
-            da_logfc=lfc.astype(np.float32))
+            da_score=_expand(score, index_cells, n),
+            da_fdr=_expand(_bh_fdr(pvals), index_cells, n),
+            da_logfc=_expand(lfc, index_cells, n))
             .with_uns(da_conditions=[a, b],
                       da_method="replicate-welch",
+                      da_index_cells=index_cells.astype(np.int64),
                       da_samples=[str(s) for s in slevels]))
 
     na = _nbhd_counts(idx, cond == a, device)
@@ -175,27 +204,32 @@ def _differential_abundance(data: CellData, condition_key, groups,
     lfc = np.log2((na + 0.5) / (nb + 0.5)
                   / (p0 / max(1 - p0, 1e-12)))
     return (data.with_obs(
-        da_score=z.astype(np.float32),
-        da_fdr=fdr.astype(np.float32),
-        da_logfc=lfc.astype(np.float32))
+        da_score=_expand(z, index_cells, n),
+        da_fdr=_expand(fdr, index_cells, n),
+        da_logfc=_expand(lfc, index_cells, n))
         .with_uns(da_conditions=[a, b],
-                  da_method="binomial-global"))
+                  da_method="binomial-global",
+                  da_index_cells=index_cells.astype(np.int64)))
 
 
 @register("da.neighborhoods", backend="tpu")
 def da_tpu(data: CellData, condition_key: str = "condition",
-           groups=None, sample_key: str | None = None) -> CellData:
+           groups=None, sample_key: str | None = None,
+           prop: float = 1.0, seed: int = 0) -> CellData:
     """Adds obs["da_score"] (signed z or Welch t, + = enriched for the
     first level), obs["da_fdr"], obs["da_logfc"]; uns["da_conditions"],
     uns["da_method"].  Each cell's kNN neighbourhood is its Milo-style
     index set.  Pass ``sample_key=`` for replicate-aware inference
     (see module docstring)."""
     return _differential_abundance(data, condition_key, groups,
-                                   sample_key, device=True)
+                                   sample_key, device=True, prop=prop,
+                                   seed=seed)
 
 
 @register("da.neighborhoods", backend="cpu")
 def da_cpu(data: CellData, condition_key: str = "condition",
-           groups=None, sample_key: str | None = None) -> CellData:
+           groups=None, sample_key: str | None = None,
+           prop: float = 1.0, seed: int = 0) -> CellData:
     return _differential_abundance(data, condition_key, groups,
-                                   sample_key, device=False)
+                                   sample_key, device=False, prop=prop,
+                                   seed=seed)

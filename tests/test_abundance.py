@@ -160,3 +160,26 @@ def test_da_validates(conditioned):
                     obs={"condition": np.array(["A"] * 5)})
     with pytest.raises(KeyError, match="neighbors.knn"):
         sct.apply("da.neighborhoods", bare, backend="cpu")
+
+
+def test_da_prop_samples_index_cells(conditioned):
+    """Milo make_nhoods(prop=): only sampled index cells get scores
+    (others NaN), FDR corrects over the sampled neighbourhoods, and
+    the sampled scores equal the full run's at the same cells."""
+    d, in_blob1 = conditioned
+    full = sct.apply("da.neighborhoods", d, backend="cpu")
+    out = sct.apply("da.neighborhoods", d, backend="cpu", prop=0.25,
+                    seed=3)
+    z = np.asarray(out.obs["da_score"])
+    idxc = np.asarray(out.uns["da_index_cells"])
+    assert len(idxc) == 100
+    assert np.isnan(z[np.setdiff1d(np.arange(400), idxc)]).all()
+    np.testing.assert_allclose(z[idxc],
+                               np.asarray(full.obs["da_score"])[idxc],
+                               atol=1e-5)
+    # enrichment still localises on the sampled neighbourhoods
+    m1 = np.nanmean(z[in_blob1])
+    m2 = np.nanmean(z[~in_blob1])
+    assert m1 > 1.0 and m2 < -1.0
+    with pytest.raises(ValueError, match="prop"):
+        sct.apply("da.neighborhoods", d, backend="cpu", prop=0.0)
