@@ -780,7 +780,8 @@ def stream_pipeline(src: ShardSource, *, n_top: int = 2000,
                     refine: int = 64,
                     hvg_flavor: str = "seurat_v3",
                     mesh=None,
-                    checkpoint_dir: str | None = None) -> dict:
+                    checkpoint_dir: str | None = None,
+                    knn_chunk: int | None = None) -> dict:
     """h5ad shards → QC → HVG → 50-PC randomized PCA → kNN, out of
     core (BASELINE.json configs[4] shape).  Returns a dict:
     obs metrics (host), hvg_genes, X_pca (device), knn indices and
@@ -816,6 +817,28 @@ def stream_pipeline(src: ShardSource, *, n_top: int = 2000,
         idx, dist = knn_multichip_arrays(
             scores, k=k, metric=metric, mesh=mesh, n_valid=src.n_cells,
             strategy="ring")
+    elif knn_chunk is not None:
+        # query-chunked search: ONE compiled (chunk x n) program reused
+        # across chunks, each drained before the next — the same
+        # small-program discipline the bench's atlas path uses on the
+        # crash-prone tunnel, now available to library callers
+        n = src.n_cells
+        chunk = round_up(min(knn_chunk, n), 1024)
+        n_pad = round_up(n, chunk)
+        scores_pad = jnp.zeros((n_pad, scores.shape[1]), scores.dtype)
+        scores_pad = scores_pad.at[:n].set(scores[:n])
+        parts_i, parts_d = [], []
+        for off in range(0, n, chunk):
+            q = jax.lax.dynamic_slice_in_dim(scores_pad, off, chunk,
+                                             axis=0)
+            idx_c, dist_c = knn_arrays(q, scores, k=k, metric=metric,
+                                       n_query=chunk, n_cand=n,
+                                       refine=refine)
+            hard_sync(idx_c)
+            parts_i.append(idx_c)
+            parts_d.append(dist_c)
+        idx = jnp.concatenate(parts_i)[:n]
+        dist = jnp.concatenate(parts_d)[:n]
     else:
         idx, dist = knn_arrays(scores, scores, k=k, metric=metric,
                                n_query=src.n_cells, n_cand=src.n_cells,

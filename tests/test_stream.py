@@ -430,3 +430,14 @@ def test_stream_pipeline_checkpoint_dir(counts, src, tmp_path):
                                np.asarray(want["X_pca"]),
                                rtol=1e-3, atol=1e-3)
     assert os.listdir(ckd) == []  # both checkpoints consumed
+
+
+def test_stream_pipeline_knn_chunked(counts, src):
+    """Query-chunked kNN matches the single-program search."""
+    full = stream_pipeline(src, n_top=150, n_components=10, k=8)
+    chunked = stream_pipeline(src, n_top=150, n_components=10, k=8,
+                              knn_chunk=300)  # rounds to 1024: 2 chunks
+    n = 1200
+    np.testing.assert_array_equal(
+        np.asarray(chunked["knn_indices"])[:n],
+        np.asarray(full["knn_indices"])[:n])
