@@ -661,27 +661,26 @@ def run_config3(jax, src, deadline_frac=0.75):
     # static shape (the zero queries' outputs are discarded via nq).
     from sctools_tpu.config import round_up as _round_up
 
-    chunk = int(os.environ.get("SCTOOLS_BENCH_KNN_CHUNK",
-                               131072 if n >= 131072
-                               else _round_up(n, 1024)))
-    n_pad = _round_up(n, chunk)
-    scores_pad = jnp.zeros((n_pad, scores.shape[1]), scores.dtype)
-    scores_pad = scores_pad.at[:n].set(scores[:n])
+    from sctools_tpu.ops.knn import iter_knn_chunks, resolve_knn_chunk
+
+    chunk = resolve_knn_chunk(
+        int(os.environ.get("SCTOOLS_BENCH_KNN_CHUNK",
+                           131072 if n >= 131072
+                           else _round_up(n, 1024))), n)
     k, refine = 15, 64
     idx_parts = []
     t_knn = time.time()
     done = 0
     chunk_times = []
-    while done < n:
-        q = jax.lax.dynamic_slice_in_dim(scores_pad, done, chunk, axis=0)
-        nq = min(chunk, n - done)
-        t_c = time.time()
-        idx_c, dist_c = knn_arrays(q, scores, k=k, metric="cosine",
-                                   n_query=chunk, n_cand=n, refine=refine)
-        _hard_sync(idx_c)
-        chunk_times.append(time.time() - t_c)
-        idx_parts.append((done, nq, idx_c))
-        done += nq
+    # the shared chunked-search generator (ops/knn.py) does the
+    # pad/slice/hard-sync; this loop owns budget stops, progress
+    # lines, and partial flushes
+    for off, nq, idx_c, dist_c, wall in iter_knn_chunks(
+            scores, k=k, chunk=chunk, metric="cosine", refine=refine,
+            n=n):
+        chunk_times.append(wall)
+        idx_parts.append((off, nq, idx_c))
+        done = off + nq
         # progress line per chunk: feeds the stall watchdog and names
         # the last chunk that survived if the worker dies mid-kNN
         stage("config3.knn_chunk", i=len(chunk_times),

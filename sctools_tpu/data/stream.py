@@ -794,6 +794,10 @@ def stream_pipeline(src: ShardSource, *, n_top: int = 2000,
     compute across chips)."""
     from ..ops.knn import knn_arrays
 
+    if mesh is not None and knn_chunk is not None:
+        raise ValueError(
+            "stream_pipeline: knn_chunk= applies to the single-device "
+            "search only; the mesh path runs the ring kNN (drop one)")
     if mesh is not None:
         src = src.with_mesh(mesh)
     ck_stats = ck_pca = None
@@ -818,27 +822,19 @@ def stream_pipeline(src: ShardSource, *, n_top: int = 2000,
             scores, k=k, metric=metric, mesh=mesh, n_valid=src.n_cells,
             strategy="ring")
     elif knn_chunk is not None:
-        # query-chunked search: ONE compiled (chunk x n) program reused
-        # across chunks, each drained before the next — the same
-        # small-program discipline the bench's atlas path uses on the
-        # crash-prone tunnel, now available to library callers
-        n = src.n_cells
-        chunk = round_up(min(knn_chunk, n), 1024)
-        n_pad = round_up(n, chunk)
-        scores_pad = jnp.zeros((n_pad, scores.shape[1]), scores.dtype)
-        scores_pad = scores_pad.at[:n].set(scores[:n])
+        # query-chunked search via the shared generator (ops/knn.py
+        # iter_knn_chunks — also the bench atlas path's engine): ONE
+        # compiled (chunk x n) program reused, each chunk drained
+        from ..ops.knn import iter_knn_chunks
+
         parts_i, parts_d = [], []
-        for off in range(0, n, chunk):
-            q = jax.lax.dynamic_slice_in_dim(scores_pad, off, chunk,
-                                             axis=0)
-            idx_c, dist_c = knn_arrays(q, scores, k=k, metric=metric,
-                                       n_query=chunk, n_cand=n,
-                                       refine=refine)
-            hard_sync(idx_c)
+        for _off, _nq, idx_c, dist_c, _s in iter_knn_chunks(
+                scores, k=k, chunk=knn_chunk, metric=metric,
+                refine=refine, n=src.n_cells):
             parts_i.append(idx_c)
             parts_d.append(dist_c)
-        idx = jnp.concatenate(parts_i)[:n]
-        dist = jnp.concatenate(parts_d)[:n]
+        idx = jnp.concatenate(parts_i)
+        dist = jnp.concatenate(parts_d)
     else:
         idx, dist = knn_arrays(scores, scores, k=k, metric=metric,
                                n_query=src.n_cells, n_cand=src.n_cells,

@@ -128,6 +128,49 @@ def knn_arrays(
     return idx, dist
 
 
+def resolve_knn_chunk(chunk: int, n: int) -> int:
+    """The actual query-chunk size ``iter_knn_chunks`` will use: a
+    ``row_block`` multiple, so each compiled call returns exactly
+    ``chunk`` rows (a non-multiple would leave -1 padding rows inside
+    the concatenated result — silent corruption)."""
+    from ..config import config, round_up
+
+    return round_up(min(max(chunk, 1), n), config.row_block)
+
+
+def iter_knn_chunks(scores, *, k: int, chunk: int, metric: str = "cosine",
+                    refine: int = 0, n: int | None = None):
+    """Query-chunked self-kNN: yields ``(offset, nq, idx, dist,
+    wall_s)`` per chunk, with ``idx``/``dist`` TRIMMED to the ``nq``
+    valid rows and each chunk hard-synced before the next dispatch.
+
+    One compiled (chunk × n) program is reused for every chunk — the
+    small-program discipline crash-prone backends need.  Both the
+    bench's atlas path and ``stream_pipeline(knn_chunk=)`` drive this
+    generator; the consumer decides about budgets, progress lines, and
+    early stops (just stop iterating)."""
+    import time as _time
+
+    from ..utils.sync import hard_sync
+
+    n = n or int(scores.shape[0])
+    chunk = resolve_knn_chunk(chunk, n)
+    from ..config import round_up
+
+    n_pad = round_up(n, chunk)
+    scores_pad = jnp.zeros((n_pad, scores.shape[1]), scores.dtype)
+    scores_pad = scores_pad.at[:n].set(scores[:n])
+    for off in range(0, n, chunk):
+        q = jax.lax.dynamic_slice_in_dim(scores_pad, off, chunk, axis=0)
+        nq = min(chunk, n - off)
+        t0 = _time.time()
+        idx_c, dist_c = knn_arrays(q, scores, k=k, metric=metric,
+                                   n_query=chunk, n_cand=n,
+                                   refine=refine)
+        hard_sync(idx_c)
+        yield off, nq, idx_c[:nq], dist_c[:nq], _time.time() - t0
+
+
 @partial(
     jax.jit,
     static_argnames=("k", "metric", "qb", "cb", "n_query", "n_cand",

@@ -433,11 +433,29 @@ def test_stream_pipeline_checkpoint_dir(counts, src, tmp_path):
 
 
 def test_stream_pipeline_knn_chunked(counts, src):
-    """Query-chunked kNN matches the single-program search."""
+    """Query-chunked kNN matches the single-program search — including
+    under a NON-DEFAULT row_block, where naive concatenation would
+    interleave -1 padding rows into the global result (review
+    finding: chunk must resolve to a row_block multiple)."""
+    from sctools_tpu.config import configure
+
     full = stream_pipeline(src, n_top=150, n_components=10, k=8)
+    n = full["n_cells"]
     chunked = stream_pipeline(src, n_top=150, n_components=10, k=8,
-                              knn_chunk=300)  # rounds to 1024: 2 chunks
-    n = 1200
+                              knn_chunk=300)
     np.testing.assert_array_equal(
         np.asarray(chunked["knn_indices"])[:n],
         np.asarray(full["knn_indices"])[:n])
+    np.testing.assert_allclose(
+        np.asarray(chunked["knn_distances"])[:n],
+        np.asarray(full["knn_distances"])[:n], rtol=1e-6)
+    with configure(row_block=512):
+        c2 = stream_pipeline(src, n_top=150, n_components=10, k=8,
+                             knn_chunk=300)
+    np.testing.assert_array_equal(
+        np.asarray(c2["knn_indices"])[:n],
+        np.asarray(full["knn_indices"])[:n])
+    with pytest.raises(ValueError, match="knn_chunk"):
+        from sctools_tpu.parallel.mesh import make_mesh
+
+        stream_pipeline(src, knn_chunk=300, mesh=make_mesh(8))
