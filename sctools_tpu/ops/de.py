@@ -339,13 +339,53 @@ def _rank_genes_groups(data: CellData, groupby: str, method: str,
             raise ValueError(
                 f"rank_genes_groups: reference {reference!r} is not a "
                 f"level of obs[{groupby!r}] ({levels})")
-        if method not in ("t-test", "t-test_overestim_var"):
+        if method == "logreg":
             raise ValueError(
                 "rank_genes_groups: reference= other than 'rest' is "
-                "supported for the t-test methods (scanpy's wilcoxon-"
-                "vs-reference ranks only the pair subset; use "
-                "method='t-test')")
+                "not defined for method='logreg' (multinomial over "
+                "all groups); use a t-test or wilcoxon")
         ref_idx = levels.index(str(reference))
+
+    if ref_idx is not None and method == "wilcoxon":
+        # scanpy's wilcoxon-vs-reference ranks only the PAIR subset —
+        # run each selected group as a 2-level sub-comparison, where
+        # group-vs-rest IS group-vs-reference, and stitch the rows.
+        # Each pairwise run reuses the full blocked-rank machinery on
+        # the subset (CellData.__getitem__ works on both residencies).
+        from ..registry import apply as _apply
+
+        v = np.asarray(data.obs[groupby])[:n_obs].astype(str)
+        want = (None if groups is None else {str(g) for g in groups})
+        if want is not None:
+            unknown = want - set(levels)
+            if unknown:
+                raise ValueError(
+                    f"rank_genes_groups: groups {sorted(unknown)} are "
+                    f"not levels of obs[{groupby!r}] ({levels})")
+        sel = [l for l in levels
+               if (want is None or l in want) and l != str(reference)]
+        if not sel:
+            raise ValueError(
+                f"rank_genes_groups: groups={groups!r} selects no "
+                f"level of {levels}")
+        backend = "tpu" if device else "cpu"
+        parts = []
+        for g_level in sel:
+            sub = data[(v == g_level) | (v == str(reference))]
+            r = _apply("de.rank_genes_groups", sub, backend=backend,
+                       groupby=groupby, method="wilcoxon",
+                       n_top=n_top, tie_correct=tie_correct,
+                       groups=[g_level], pts=pts)
+            parts.append(r.uns["rank_genes_groups"])
+        result = {"method": "wilcoxon", "reference": reference,
+                  "groups": sel}
+        for key in ("indices", "names", "scores", "pvals",
+                    "pvals_adj", "logfoldchanges"):
+            result[key] = np.concatenate([p[key] for p in parts])
+        if pts:
+            for key in ("pts", "pts_rest"):
+                result[key] = np.concatenate([p[key] for p in parts])
+        return data.with_uns(rank_genes_groups=result)
 
     if method == "logreg":
         scores = _logreg_scores(data, codes_host, n_groups)

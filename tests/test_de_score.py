@@ -241,9 +241,23 @@ def test_rank_genes_groups_reference_and_groups(ds):
     with pytest.raises(ValueError, match="not a level"):
         sct.apply("de.rank_genes_groups", d, backend="cpu",
                   groupby="label", reference="zzz")
-    with pytest.raises(ValueError, match="t-test"):
+    # wilcoxon vs reference: exact pairwise sub-runs; oracle is
+    # scipy mannwhitneyu on the b/a pair
+    w = sct.apply("de.rank_genes_groups", d, backend="cpu",
+                  groupby="label", method="wilcoxon", reference="a",
+                  groups=["b"])
+    rw = w.uns["rank_genes_groups"]
+    assert rw["groups"] == ["b"] and rw["reference"] == "a"
+    gw = int(rw["indices"][0, 0])
+    from scipy.stats import mannwhitneyu
+
+    u = mannwhitneyu(X[labels == "b"][:, gw], X[labels == "a"][:, gw],
+                     alternative="two-sided")
+    assert abs(rw["pvals"][0, 0] - u.pvalue) < 0.05
+    assert set(rw["indices"][0, :5].tolist()) & set(range(5))
+    with pytest.raises(ValueError, match="logreg"):
         sct.apply("de.rank_genes_groups", d, backend="cpu",
-                  groupby="label", method="wilcoxon", reference="a")
+                  groupby="label", method="logreg", reference="a")
     with pytest.raises(ValueError, match="not levels"):
         sct.apply("de.rank_genes_groups", d, backend="cpu",
                   groupby="label", groups=["zzz"])
