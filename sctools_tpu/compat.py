@@ -78,15 +78,39 @@ _EXPERIMENTAL_PP = {
 }
 
 
-def _wrap(scanpy_name: str, op: str):
+def _wrap(scanpy_name: str, op: str, aliases: dict | None = None):
+    """``aliases`` maps scanpy keyword names onto this package's
+    operator keywords, so muscle-memory call sites
+    (``n_top_genes=``, ``n_comps=``, ...) work unchanged."""
+
     def f(data, backend: str = "tpu", **kw):
+        if aliases:
+            for scanpy_kw, our_kw in aliases.items():
+                if scanpy_kw in kw:
+                    if our_kw in kw:
+                        raise TypeError(
+                            f"{scanpy_name}: got both {scanpy_kw!r} "
+                            f"and its alias {our_kw!r}")
+                    kw[our_kw] = kw.pop(scanpy_kw)
         return apply(op, data, backend=backend, **kw)
 
     f.__name__ = scanpy_name
     f.__qualname__ = scanpy_name
     f.__doc__ = (f"scanpy-compat wrapper: ``{op}`` (see its registered "
-                 f"docstring / docs/GUIDE.md for parameter names).")
+                 f"docstring / docs/GUIDE.md for parameter names"
+                 + (f"; accepts scanpy aliases {sorted(aliases)}"
+                    if aliases else "") + ").")
     return f
+
+
+# scanpy keyword spellings -> this package's operator keywords
+_ALIASES = {
+    "highly_variable_genes": {"n_top_genes": "n_top"},
+    "pca": {"n_comps": "n_components"},
+    "rank_genes_groups": {"n_genes": "n_top"},
+    "score_genes": {"gene_list": "genes"},
+    "umap": {"maxiter": "n_epochs"},
+}
 
 
 def _calculate_qc_metrics(data, backend: str = "tpu", **kw):
@@ -98,12 +122,15 @@ def _calculate_qc_metrics(data, backend: str = "tpu", **kw):
 
 def _neighbors(data, backend: str = "tpu", k: int = 15,
                metric: str = "cosine", connectivities: bool = True,
-               method: str = "umap", **kw):
+               method: str = "umap", n_neighbors: int | None = None,
+               **kw):
     """scanpy ``pp.neighbors``: kNN search plus the connectivity
     weights (``neighbors.knn`` + ``graph.connectivities``).
     ``method`` is scanpy's kernel choice ("umap" or "gauss"/"gaussian"),
     routed to ``graph.connectivities(mode=)``; everything else forwards
     to the kNN search."""
+    if n_neighbors is not None:
+        k = n_neighbors  # scanpy spelling
     data = apply("neighbors.knn", data, backend=backend, k=k,
                  metric=metric, **kw)
     if connectivities:
@@ -123,11 +150,13 @@ def _experimental_hvg(data, backend: str = "tpu", **kw):
 pp = SimpleNamespace(
     calculate_qc_metrics=_calculate_qc_metrics,
     neighbors=_neighbors,
-    **{name: _wrap(name, op) for name, op in _PP.items()},
+    **{name: _wrap(name, op, _ALIASES.get(name))
+       for name, op in _PP.items()},
 )
 
 tl = SimpleNamespace(
-    **{name: _wrap(name, op) for name, op in _TL.items()},
+    **{name: _wrap(name, op, _ALIASES.get(name))
+       for name, op in _TL.items()},
 )
 
 experimental = SimpleNamespace(pp=SimpleNamespace(

@@ -98,3 +98,25 @@ def test_get_accessors():
     with pytest.raises(KeyError, match="rank_genes_groups"):
         sct.get.rank_genes_groups_df(
             synthetic_counts(10, 10, seed=0), "a")
+
+
+def test_scanpy_kwarg_aliases():
+    """scanpy keyword spellings (n_top_genes, n_comps, n_neighbors,
+    gene_list) work through the compat wrappers."""
+    d = synthetic_counts(200, 150, density=0.15, n_clusters=2, seed=7)
+    d = sct.pp.normalize_total(d, backend="cpu")
+    d = sct.pp.log1p(d, backend="cpu")
+    h = sct.pp.highly_variable_genes(d, backend="cpu",
+                                     n_top_genes=40,
+                                     flavor="dispersion")
+    assert int(np.asarray(h.var["highly_variable"]).sum()) == 40
+    p = sct.pp.pca(d, backend="cpu", n_comps=7)
+    assert p.obsm["X_pca"].shape[1] == 7
+    g = sct.pp.neighbors(p, backend="cpu", n_neighbors=9)
+    assert np.asarray(g.obsp["knn_indices"]).shape[1] == 9
+    genes = [str(n) for n in np.asarray(d.var["gene_name"])[:10]]
+    sc = sct.tl.score_genes(d, backend="cpu", gene_list=genes)
+    assert "score" in sc.obs
+    with pytest.raises(TypeError, match="alias"):
+        sct.pp.highly_variable_genes(d, backend="cpu",
+                                     n_top_genes=40, n_top=40)
