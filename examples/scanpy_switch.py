@@ -38,10 +38,10 @@ def main(backend: str = "tpu"):
     d = sct.pp.filter_genes(d, backend=backend, min_cells=3)
     d = sct.pp.normalize_total(d, backend=backend, target_sum=1e4)
     d = sct.pp.log1p(d, backend=backend)
-    d = sct.pp.highly_variable_genes(d, backend=backend, n_top=1500,
-                                     subset=True)
-    d = sct.pp.pca(d, backend=backend, n_components=50)
-    d = sct.pp.neighbors(d, backend=backend, k=15)
+    d = sct.pp.highly_variable_genes(d, backend=backend,
+                                     n_top_genes=1500, subset=True)
+    d = sct.pp.pca(d, backend=backend, n_comps=50)
+    d = sct.pp.neighbors(d, backend=backend, n_neighbors=15)
     d = sct.tl.leiden(d, backend=backend)
     d = sct.tl.umap(d, backend=backend, n_epochs=100)
     d = sct.tl.rank_genes_groups(d, backend=backend, groupby="leiden",
