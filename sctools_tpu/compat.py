@@ -8,15 +8,17 @@ scanpy/reference user's muscle memory keeps working unchanged:
 >>> import sctools_tpu as sct
 >>> d = sct.pp.normalize_total(d, target_sum=1e4)
 >>> d = sct.pp.log1p(d)
->>> d = sct.pp.highly_variable_genes(d, n_top=2000, subset=True)
+>>> d = sct.pp.highly_variable_genes(d, n_top_genes=2000, subset=True)
 >>> d = sct.pp.pca(d); d = sct.pp.neighbors(d)
 >>> d = sct.tl.leiden(d); d = sct.tl.umap(d)
 
 Differences from scanpy, stated once: every wrapper is PURE (returns a
-new CellData; nothing mutates in place), takes ``backend=`` ("tpu"
-default, "cpu" for the oracle), and keyword names follow this
-package's operators (the GUIDE's operator map documents every
-rename).  Wrappers are thin — one ``apply`` call — except the three
+new CellData; nothing mutates in place) and takes ``backend=`` ("tpu"
+default, "cpu" for the oracle).  Keyword names follow this package's
+operators, with the common scanpy spellings accepted as aliases
+(``n_top_genes``, ``n_comps``, ``n_neighbors``, ``n_genes``,
+``gene_list``, ``maxiter`` — see ``_ALIASES``); the GUIDE's operator
+map documents every rename.  Wrappers are thin — one ``apply`` call — except the three
 scanpy entry points that bundle several steps (``calculate_qc_metrics``,
 ``neighbors``, ``recipe_*``), which compose the same registered ops a
 user would chain by hand.
