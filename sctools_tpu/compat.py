@@ -35,6 +35,7 @@ _PP = {
     "filter_cells": "qc.filter_cells",
     "filter_genes": "qc.filter_genes",
     "subsample": "qc.subsample",
+    "sample": "qc.subsample",  # scanpy >=1.10 name
     "normalize_total": "normalize.library_size",
     "log1p": "normalize.log1p",
     "scale": "normalize.scale",
