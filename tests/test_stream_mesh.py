@@ -57,3 +57,44 @@ def test_stream_pipeline_mesh_matches_single(counts, src):
     idx_s = np.asarray(single["knn_indices"])[:1200]
     idx_m = np.asarray(multi["knn_indices"])[:1200]
     assert recall_at_k(idx_m, idx_s) > 0.99
+
+
+def test_mesh_checkpoint_resume_composition(counts, src, tmp_path):
+    """checkpoint/resume composes with mesh placement: the mesh-
+    wrapped range-aware factory (with_mesh wraps factory_from too)
+    seeks, pads, and produces stats identical to an uncheckpointed
+    meshed pass."""
+    import dataclasses
+    import os
+
+    from sctools_tpu.data.stream import stream_stats
+
+    mesh = make_mesh(8)
+    msrc = src.with_mesh(mesh)
+    want = stream_stats(msrc)
+
+    ck = str(tmp_path / "mesh_ck.npz")
+    base_from = msrc.factory_from
+    # crash the FIRST pass at shard 1; the rerun resumes cleanly
+    attempt = [0]
+
+    def crashing_from(k):
+        def gen():
+            for i, s in enumerate(base_from(k), start=k):
+                if attempt[0] == 0 and i == 1:
+                    attempt[0] = 1
+                    raise RuntimeError("boom")
+                yield s
+        return gen()
+
+    crashing = dataclasses.replace(
+        msrc, factory=lambda: crashing_from(0),
+        factory_from=crashing_from)
+    with pytest.raises(RuntimeError, match="boom"):
+        stream_stats(crashing, checkpoint=ck)
+    assert os.path.exists(ck)
+    got = stream_stats(crashing, checkpoint=ck)  # resumes past shard 1
+    for key in ("gene_mean", "gene_var", "total_counts"):
+        np.testing.assert_allclose(got[key], want[key], rtol=1e-6,
+                                   err_msg=key)
+    assert not os.path.exists(ck)
