@@ -140,7 +140,14 @@ def _neighbors(data, backend: str = "tpu", k: int = 15,
         mode = {"gauss": "gaussian"}.get(method, method)
         data = apply("graph.connectivities", data, backend=backend,
                      mode=mode)
-    return data
+    # scanpy-shaped provenance record (tooling reads
+    # uns['neighbors']['params']['n_neighbors'])
+    return data.with_uns(neighbors={
+        "connectivities_key": "connectivities",
+        "distances_key": "knn_distances",
+        "params": {"n_neighbors": int(k), "metric": metric,
+                   "method": method},
+    })
 
 
 def _experimental_hvg(data, backend: str = "tpu", **kw):

@@ -120,3 +120,13 @@ def test_scanpy_kwarg_aliases():
     with pytest.raises(TypeError, match="alias"):
         sct.pp.highly_variable_genes(d, backend="cpu",
                                      n_top_genes=40, n_top=40)
+
+
+def test_pp_neighbors_uns_record():
+    d = synthetic_counts(120, 80, density=0.2, n_clusters=2, seed=9)
+    d = sct.pp.pca(sct.pp.log1p(sct.pp.normalize_total(
+        d, backend="cpu"), backend="cpu"), backend="cpu", n_comps=6)
+    g = sct.pp.neighbors(d, backend="cpu", n_neighbors=7)
+    rec = g.uns["neighbors"]
+    assert rec["params"]["n_neighbors"] == 7
+    assert rec["connectivities_key"] == "connectivities"
