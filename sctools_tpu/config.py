@@ -118,6 +118,15 @@ class Config:
     # Capacity rounding for the padded-ELL sparse format.
     capacity_multiple: int = 128
 
+    # Device synthetic generation: rows per jitted generator program.
+    # The full-shard (131072-row) generator program deterministically
+    # crashed the tunneled TPU worker ("kernel fault") in the round-5
+    # live window — three times, probe + both bench ramp attempts —
+    # while every smaller program ran; generating a shard as a few
+    # fixed-quantum chunks keeps each program small and the output
+    # deterministic in (key, quantum) alone.
+    gen_chunk_rows: int = 16384
+
     # Streaming loops: block on each shard's outputs before dispatching
     # the next shard.  "auto" => sync only on the tunneled single-chip
     # backend ("axon"), where deep async pipelines of large mixed
@@ -141,6 +150,8 @@ config = Config()
 
 if os.environ.get("SCTOOLS_TPU_MATMUL_DTYPE"):
     config.matmul_dtype = os.environ["SCTOOLS_TPU_MATMUL_DTYPE"]
+if os.environ.get("SCTOOLS_GEN_CHUNK_ROWS"):
+    config.gen_chunk_rows = int(os.environ["SCTOOLS_GEN_CHUNK_ROWS"])
 if os.environ.get("SCTOOLS_TPU_KNN_IMPL"):
     # lets the bench orchestrator route atlas children onto the kernel
     # sweep's measured winner within the same run

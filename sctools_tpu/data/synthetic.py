@@ -183,15 +183,38 @@ def ell_shard_device(key, cdfs, n_valid, *, rows: int, capacity: int,
     streamed HVG moments off by 2x on hot genes).
     Rows >= ``n_valid`` are zeroed/sentineled padding.
     Counts are geometric(p=0.4); gene ids are inverse-CDF draws from
-    the row's cluster program.  Deterministic in ``key`` — re-iterating
-    a source regenerates bit-identical shards.
+    the row's cluster program.
 
     Returns (indices (rows, capacity) int32, data (rows, capacity) f32,
     labels (rows,) int32).
+
+    Generation runs as fixed-quantum row chunks (``config.gen_chunk_rows``
+    per jitted program, key folded per chunk): the single full-shard
+    program at 131072x28672x512 deterministically crashed the tunneled
+    TPU worker ("kernel fault", round-5 live window) while smaller
+    programs ran.  Output is deterministic in (key, quantum); the
+    quantum is a config constant precisely so re-iteration regenerates
+    identical shards.
     """
-    return _ell_shard_device_jit(key, cdfs, jnp.asarray(n_valid),
-                                 rows=rows, capacity=capacity,
-                                 n_genes=n_genes)
+    from ..config import config
+
+    chunk = max(8, min(int(config.gen_chunk_rows), rows))
+    n_valid = int(n_valid)
+    if chunk >= rows:
+        return _ell_shard_device_jit(key, cdfs, jnp.asarray(n_valid),
+                                     rows=rows, capacity=capacity,
+                                     n_genes=n_genes)
+    outs = []
+    for ci, start in enumerate(range(0, rows, chunk)):
+        crows = min(chunk, rows - start)
+        cvalid = max(0, min(n_valid - start, crows))
+        outs.append(_ell_shard_device_jit(
+            jax.random.fold_in(key, ci), cdfs, jnp.asarray(cvalid),
+            rows=crows, capacity=capacity, n_genes=n_genes))
+    idx = jnp.concatenate([o[0] for o in outs], axis=0)
+    vals = jnp.concatenate([o[1] for o in outs], axis=0)
+    labels = jnp.concatenate([o[2] for o in outs], axis=0)
+    return idx, vals, labels
 
 
 @partial(jax.jit, static_argnames=("rows", "capacity", "n_genes"))
@@ -215,19 +238,29 @@ def _ell_shard_device_jit(key, cdfs, n_valid, *, rows, capacity, n_genes):
     vals = jnp.where(row_ok[:, None], vals, 0.0)
     # merge duplicate gene ids within each row (see docstring): sort
     # slots by gene, sum each run into its first slot, sentinel the
-    # rest.  Counts are small integers, so the f32 run sums are exact.
+    # rest.  Scatter-free: run totals come from the row cumsum gathered
+    # at each run's last slot (a scatter-based vmapped segment_sum was
+    # a prime suspect in the tunnel worker "kernel fault" crashes).
+    # Counts are small integers and the row cumsum stays < 2^24, so
+    # the f32 differences are exact.
     order = jnp.argsort(idx, axis=1)
     si = jnp.take_along_axis(idx, order, axis=1)
     sv = jnp.take_along_axis(vals, order, axis=1)
     first = jnp.concatenate(
         [jnp.ones((rows, 1), bool), si[:, 1:] != si[:, :-1]], axis=1)
-    run_id = jnp.cumsum(first, axis=1) - 1
-    totals = jax.vmap(
-        lambda v, r: jax.ops.segment_sum(v, r, num_segments=capacity)
-    )(sv, run_id)
+    csum = jnp.cumsum(sv, axis=1)
+    pos = jnp.broadcast_to(jnp.arange(capacity, dtype=jnp.int32),
+                           (rows, capacity))
+    # index of the next run's first slot (capacity when none), then the
+    # last slot of THIS run = next_first - 1
+    nf = jax.lax.cummin(jnp.where(first, pos, capacity), axis=1,
+                        reverse=True)
+    nf_after = jnp.concatenate(
+        [nf[:, 1:], jnp.full((rows, 1), capacity, jnp.int32)], axis=1)
+    last = nf_after - 1
+    totals = jnp.take_along_axis(csum, last, axis=1) - csum + sv
     idx = jnp.where(first, si, n_genes)
-    vals = jnp.where(first & (idx < n_genes),
-                     jnp.take_along_axis(totals, run_id, axis=1), 0.0)
+    vals = jnp.where(first & (idx < n_genes), totals, 0.0)
     return idx, vals, labels
 
 

@@ -1,0 +1,99 @@
+"""Bisect which stream_pca device program wedges the tunneled TPU
+worker (round-5 live window: tpu_probe step4 hung >12 min at 131k
+while steps 0-3 — chunked datagen, stats scatter, streamed HVG — all
+ran; see artifacts/probe_0731T0121_chunkedgen.log).
+
+Runs each candidate program alone at a configurable row count with a
+hard host-fetch barrier and a flushed line before/after, smallest
+first: whichever line is last tells which program (and at what size)
+kills or wedges the worker.
+
+Usage: python tools/tpu_bisect_pca.py [--rows 131072] [--upto N]
+"""
+
+import argparse
+import sys
+import time
+
+T0 = time.time()
+
+
+def log(*a):
+    print(f"[{time.time() - T0:7.1f}s]", *a, flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=131072)
+    ap.add_argument("--genes", type=int, default=28672)
+    ap.add_argument("--gsub", type=int, default=2000)
+    ap.add_argument("--upto", type=int, default=99)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    sys.path.insert(0, "/root/repo")
+    from sctools_tpu.data.stream import _shard_matvec, _shard_rmatvec
+    from sctools_tpu.data.synthetic import DeviceSyntheticSource
+    from sctools_tpu.utils.sync import hard_sync
+
+    log("gen one shard", args.rows, "x", args.genes, "x 512 (chunked)")
+    src = DeviceSyntheticSource(args.rows, args.genes, capacity=512,
+                                shard_rows=args.rows, seed=0,
+                                materialize=False)
+    src.materialize(progress=lambda i, s: log("  shard", i, round(s, 1)))
+    sh = src._shards[0]
+    log("gen OK")
+
+    rng = np.random.default_rng(0)
+    gene_idx = np.sort(rng.choice(args.genes, args.gsub, replace=False))
+    mapping = np.full(args.genes + 1, args.gsub, np.int32)
+    mapping[gene_idx] = np.arange(args.gsub, dtype=np.int32)
+    mapping = jnp.asarray(mapping)
+    mu = jnp.asarray(rng.random(args.gsub, dtype=np.float32))
+    L = 60
+    V = jnp.asarray(rng.standard_normal((args.gsub, L), dtype=np.float32))
+    Q = jnp.asarray(rng.standard_normal((sh.rows_padded, L),
+                                        dtype=np.float32))
+
+    if args.upto < 1:
+        return
+    log("step1: _shard_matvec (gather-side spmm) FULL", args.rows)
+    t = time.time()
+    b = _shard_matvec(sh, mapping, mu, V, 1e4, args.gsub)
+    hard_sync(b)
+    log("step1 OK:", round(time.time() - t, 1), "s")
+    t = time.time()
+    b = _shard_matvec(sh, mapping, mu, V, 1e4, args.gsub)
+    hard_sync(b)
+    log("step1 steady:", round(time.time() - t, 2), "s")
+
+    if args.upto < 2:
+        return
+    log("step2: _shard_rmatvec (scatter-side spmm_t) FULL", args.rows)
+    t = time.time()
+    z = _shard_rmatvec(sh, mapping, mu, Q, 1e4, args.gsub)
+    hard_sync(z)
+    log("step2 OK:", round(time.time() - t, 1), "s")
+    t = time.time()
+    z = _shard_rmatvec(sh, mapping, mu, Q, 1e4, args.gsub)
+    hard_sync(z)
+    log("step2 steady:", round(time.time() - t, 2), "s")
+
+    if args.upto < 3:
+        return
+    log("step3: cholesky_qr on (rows, L) matvec output")
+    from sctools_tpu.ops.pca import cholesky_qr
+
+    t = time.time()
+    q = cholesky_qr(Q)
+    hard_sync(q)
+    log("step3 OK:", round(time.time() - t, 1), "s")
+
+    log("ALL OK — stream_pca's parts each run alone at", args.rows)
+
+
+if __name__ == "__main__":
+    main()
