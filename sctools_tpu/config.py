@@ -127,6 +127,27 @@ class Config:
     # deterministic in (key, quantum) alone.
     gen_chunk_rows: int = 16384
 
+    # Streaming PCA matvec/rmatvec: rows per jitted program.  -1 =
+    # auto (16384 on the tunneled backend, whole-shard elsewhere);
+    # 0 = whole shard; >0 explicit.  Execution-only — results are
+    # identical, the chunk just bounds program size: the full-shard
+    # stream_pca programs at 131072 rows WEDGED the tunneled worker
+    # (round-5 probe step4, >19 min no progress) after the same-sized
+    # datagen program crashed it outright.
+    stream_row_chunk: int = -1
+
+    def stream_row_chunk_rows(self) -> int:
+        v = int(self.stream_row_chunk)
+        if v < -1:
+            # a negative typo must not silently select whole-shard
+            # programs — the exact mode that wedges the tunneled worker
+            raise ValueError(
+                f"stream_row_chunk={v}: use -1 (auto), 0 (whole "
+                f"shard) or a positive row count")
+        if v == -1:
+            return 16384 if _on_tunnel() else 0
+        return v
+
     # Streaming loops: block on each shard's outputs before dispatching
     # the next shard.  "auto" => sync only on the tunneled single-chip
     # backend ("axon"), where deep async pipelines of large mixed
@@ -152,6 +173,8 @@ if os.environ.get("SCTOOLS_TPU_MATMUL_DTYPE"):
     config.matmul_dtype = os.environ["SCTOOLS_TPU_MATMUL_DTYPE"]
 if os.environ.get("SCTOOLS_GEN_CHUNK_ROWS"):
     config.gen_chunk_rows = int(os.environ["SCTOOLS_GEN_CHUNK_ROWS"])
+if os.environ.get("SCTOOLS_STREAM_ROW_CHUNK"):
+    config.stream_row_chunk = int(os.environ["SCTOOLS_STREAM_ROW_CHUNK"])
 if os.environ.get("SCTOOLS_TPU_KNN_IMPL"):
     # lets the bench orchestrator route atlas children onto the kernel
     # sweep's measured winner within the same run

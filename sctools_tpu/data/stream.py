@@ -649,6 +649,22 @@ def _shard_rmatvec(x: SparseCells, mapping, mu, Q, target_sum: float,
     return spmm_t(sub, Qm) - jnp.outer(mu, colsum)
 
 
+def _iter_row_chunks(sh: SparseCells, step: int):
+    """Yield ``(row_offset, sub_shard)`` row slices of one padded-ELL
+    shard.  Execution-only (identical results): bounds the size of each
+    jitted PCA program — the tunneled TPU worker wedged on full-shard
+    (131072-row) matvec/rmatvec programs while 16384-row programs run
+    (round-5 probe).  ``step <= 0`` yields the shard whole."""
+    if step <= 0 or step >= sh.rows_padded:
+        yield 0, sh
+        return
+    for a in range(0, sh.rows_padded, step):
+        b = min(a + step, sh.rows_padded)
+        yield a, SparseCells(sh.indices[a:b], sh.data[a:b],
+                             max(0, min(sh.n_cells - a, b - a)),
+                             sh.n_genes)
+
+
 def _assemble_rows(blocks, n_rows):
     """Stack per-shard (rows_padded, L) device blocks into one
     device-resident (n_rows, L) array."""
@@ -693,13 +709,17 @@ def stream_pca(src: ShardSource, gene_idx: np.ndarray,
 
     sync = config.stream_sync_enabled()
 
+    row_chunk = config.stream_row_chunk_rows()
+
     def matvec_all(V):
         blocks = []
         for _, sh in src:
-            b = _shard_matvec(sh, mapping, mu, V, target_sum, g_sub)
-            if sync:
-                hard_sync(b)
-            blocks.append(b)
+            for _, sub in _iter_row_chunks(sh, row_chunk):
+                b = _shard_matvec(sub, mapping, mu, V, target_sum,
+                                  g_sub)
+                if sync:
+                    hard_sync(b)
+                blocks.append(b)
         return _assemble_rows(blocks, src.n_cells)
 
     start_round, start_shard, acc0 = 0, 0, None
@@ -731,10 +751,12 @@ def stream_pca(src: ShardSource, gene_idx: np.ndarray,
                 q_blk = jnp.concatenate(
                     [q_blk, jnp.zeros((sh.rows_padded - q_blk.shape[0],
                                        Q.shape[1]))])
-            acc = acc + _shard_rmatvec(sh, mapping, mu, q_blk,
-                                       target_sum, g_sub)
-            if sync:
-                hard_sync(acc)
+            for a, sub in _iter_row_chunks(sh, row_chunk):
+                acc = acc + _shard_rmatvec(
+                    sub, mapping, mu, q_blk[a: a + sub.rows_padded],
+                    target_sum, g_sub)
+                if sync:
+                    hard_sync(acc)
             if checkpoint is not None:
                 shard_i = offset // src.shard_rows
                 tmp = checkpoint + ".tmp.npz"

@@ -459,3 +459,31 @@ def test_stream_pipeline_knn_chunked(counts, src):
         from sctools_tpu.parallel.mesh import make_mesh
 
         stream_pipeline(src, knn_chunk=300, mesh=make_mesh(8))
+
+
+def test_stream_pca_row_chunked_matches_whole_shard(counts, src):
+    # config.stream_row_chunk bounds the size of each jitted PCA
+    # program (the tunneled TPU worker wedges on full-131k-row
+    # matvec/rmatvec programs); results must be identical up to f32
+    # reduction order.
+    import jax
+
+    from sctools_tpu.config import configure
+
+    stats = stream_stats(src)
+    hvg = stream_hvg(stats, n_top=200, flavor="dispersion")
+    with configure(stream_row_chunk=0):
+        whole, _, _ = stream_pca(src, hvg, stats["gene_mean"],
+                                 jax.random.PRNGKey(0), n_components=20)
+    with configure(stream_row_chunk=96):  # 3 chunks per 256-row shard
+        chunked, _, _ = stream_pca(src, hvg, stats["gene_mean"],
+                                   jax.random.PRNGKey(0),
+                                   n_components=20)
+    a, b = np.asarray(whole), np.asarray(chunked)
+    scale = np.abs(a).max()
+    assert np.abs(a - b).max() / scale < 1e-3
+    ia, _ = knn_numpy(a.astype(np.float64), a.astype(np.float64), k=10,
+                      metric="euclidean")
+    ib, _ = knn_numpy(b.astype(np.float64), b.astype(np.float64), k=10,
+                      metric="euclidean")
+    assert recall_at_k(ia, ib) > 0.99
