@@ -543,7 +543,12 @@ def run_kernel_bench(jax, on_tpu):
     rec = "xla"
     if (_valid("pallas_binned") and _valid("xla")
             and out.get("pallas_binned_speedup_vs_xla", 0) >= 1.2
-            and out.get("pallas_binned_recall_vs_xla", 0) >= 0.99):
+            and out.get("pallas_binned_recall_vs_xla", 0) >= 0.995):
+        # 0.995, not the headline's 0.99: the binned loss STACKS with
+        # the TPU-vs-CPU-oracle loss in the final recall gate, so the
+        # kernel-level number must keep margin (r5 live window: binned
+        # measured 0.9933 vs xla — a 64x win the headline gate cannot
+        # safely spend; exact pallas at 15x takes the route instead)
         rec = "pallas_binned"
     elif (_valid("pallas") and _valid("xla")
           and out.get("pallas_speedup_vs_xla", 0) >= 1.2
@@ -555,8 +560,9 @@ def run_kernel_bench(jax, on_tpu):
             < 0.9 * out["xla"]["wall_s"]):
         out["col_block_recommendation"] = 8192
     out["routing_rule"] = (
-        ">=1.2x hard-sync'd speedup, no implausible flag, recall>=0.99 "
-        "(binned) / idx-agreement>=0.999 (exact); else xla")
+        ">=1.2x hard-sync'd speedup, no implausible flag, "
+        "recall>=0.995 (binned; stacks with the CPU-oracle gate) / "
+        "idx-agreement>=0.999 (exact); else xla")
     return out
 
 
@@ -1275,10 +1281,11 @@ def main():
         if "kernel_knn" in res:
             detail["kernel_knn"] = res["kernel_knn"]
             # route the atlas onto the sweep's measured winner IN THIS
-            # RUN: the recommendation only fires on a hard-sync'd,
-            # roofline-plausible >=1.2x win at >=0.99 quality
+            # RUN — including rec == "xla": since knn_impl='auto' now
+            # resolves to pallas on TPU, leaving the env unset would
+            # ride pallas even when THIS run's gate just rejected it
             rec = res["kernel_knn"].get("routing_recommendation")
-            if rec in ("pallas", "pallas_binned"):
+            if rec in ("xla", "pallas", "pallas_binned"):
                 atlas_route_env["SCTOOLS_TPU_KNN_IMPL"] = rec
             if res["kernel_knn"].get("col_block_recommendation"):
                 atlas_route_env["SCTOOLS_TPU_COL_BLOCK"] = str(

@@ -91,14 +91,15 @@ class Config:
     pallas_interpret: str = "auto"
 
     # kNN search implementation: "xla" (blocked lax.top_k merge),
-    # "pallas" (fused distance+top-k kernel, ops/pallas_knn.py), or
-    # "auto".  Auto resolves to the XLA path everywhere for now: the
-    # Pallas kernel has not yet executed COMPILED on hardware (rounds
-    # 1-3 lost every chip session before the microbench ran —
-    # VERDICT.md), and routing production to an unmeasured path is
-    # how round 3 earned a "partial" on this component.  The bench's
-    # kernel phase measures xla vs xla_approx vs pallas on every chip
-    # contact; flip auto to pallas when the artifact shows it winning.
+    # "pallas" (fused distance+top-k kernel, ops/pallas_knn.py),
+    # "pallas_binned", or "auto".  Auto resolves to the EXACT Pallas
+    # kernel on a real TPU backend, XLA elsewhere: the round-5 live
+    # window finally measured the sweep hard-sync'd and roofline-
+    # gated (artifacts/bench_stages_0731T0103.jsonl kernel_knn,
+    # 131072x50 k=15: pallas 15.3x over blocked-XLA at idx agreement
+    # 1.0; pallas_binned 63.9x but recall 0.9933 — that loss stacks
+    # with the TPU-vs-CPU-oracle loss, so the binned variant stays
+    # opt-in where a ~0.993 kernel-level recall is acceptable).
     knn_impl: str = "auto"
 
     # Coarse top-k operator for the blocked XLA path: "topk" (exact
@@ -112,7 +113,12 @@ class Config:
 
     def resolved_knn_impl(self) -> str:
         if self.knn_impl == "auto":
-            return "xla"  # see knn_impl comment: measured paths only
+            # measured paths only (see knn_impl comment): exact pallas
+            # won the r5 hard-sync'd sweep on hardware; interpret-mode
+            # pallas off-TPU would be pure overhead
+            if not self.interpret_mode():
+                return "pallas"
+            return "xla"
         return self.knn_impl
 
     # Capacity rounding for the padded-ELL sparse format.

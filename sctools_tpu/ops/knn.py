@@ -96,6 +96,12 @@ def knn_arrays(
     n_cand = n_cand or cand.shape[0]
     k_search = max(k, refine) if refine else k
     impl = config.resolved_knn_impl()
+    if impl in ("pallas", "pallas_binned") and n_valid_cand is not None:
+        # the pallas kernels take exact candidate shapes and have no
+        # valid-count mask; honouring the mask matters more than the
+        # kernel win (only the bucketed bbknn path passes it today,
+        # and that path already routes itself to xla)
+        impl = "xla"
     if impl in ("pallas", "pallas_binned"):
         from .pallas_knn import pallas_knn_arrays
 
@@ -511,15 +517,14 @@ def bbknn_tpu(data: CellData, batch_key: str = "batch",
     rep = rep[:n]
     batch = np.asarray(data.obs[batch_key])[:n]
 
-    use_bucket = config.resolved_knn_impl() == "xla"
-
     def search(sel, k):
         cand = jnp.take(rep, jnp.asarray(sel), axis=0)
-        if not use_bucket:  # pallas path: exact shapes
-            return knn_arrays(rep, cand, k=k, metric=metric,
-                              n_query=n, n_cand=len(sel), refine=refine)
-        # bucket the candidate count so dozens of batch sizes share a
-        # handful of compiled programs (n_valid_cand masks the pad)
+        # ALWAYS bucket the candidate count so dozens of batch sizes
+        # share a handful of compiled programs; passing n_valid_cand
+        # routes knn_arrays to the XLA path, which is deliberate —
+        # exact-shape pallas here would retrace one kernel per batch
+        # size (static n_cand), the program churn the tunneled worker
+        # tolerates worst (n_valid_cand masks the pad)
         bucket = round_up(max(len(sel), 1), 1024)
         if bucket > len(sel):
             cand = jnp.concatenate(

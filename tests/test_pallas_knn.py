@@ -92,17 +92,21 @@ def test_pallas_refine_default_blocks():
 
 
 def test_auto_impl_routes_to_measured_path():
-    """'auto' resolves to the XLA path until a kernel-bench artifact
-    shows compiled pallas winning (round-4 policy: production never
-    rides an unmeasured code path); explicit 'pallas' still opts in."""
+    """'auto' rides the measured winner: the r5 hard-sync'd kernel
+    sweep on hardware (artifacts/bench_stages_0731T0103.jsonl) showed
+    exact pallas 15.3x over blocked-XLA at idx agreement 1.0 — so auto
+    resolves to pallas wherever the kernel runs compiled, and to XLA
+    in interpret mode (off-TPU), where pallas is pure overhead."""
     from sctools_tpu.config import config
 
-    with configure(knn_impl="auto", pallas_interpret="auto"):
+    with configure(knn_impl="auto", pallas_interpret="true"):
+        # interpret mode (off-TPU) => xla, any host backend
         assert config.resolved_knn_impl() == "xla"
     with configure(knn_impl="auto", pallas_interpret="false"):
-        assert config.resolved_knn_impl() == "xla"
-    with configure(knn_impl="pallas"):
+        # compiled-pallas environment => the measured winner
         assert config.resolved_knn_impl() == "pallas"
+    with configure(knn_impl="pallas_binned"):
+        assert config.resolved_knn_impl() == "pallas_binned"
 
 
 def test_binned_merge_exact_when_bins_cover_candidates():
