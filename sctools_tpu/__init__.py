@@ -35,6 +35,7 @@ from .data.io import (from_dense, from_scipy, read_10x_h5, read_10x_mtx,
                       read_h5ad, read_loom, write_h5ad, write_loom)
 from .registry import Pipeline, Transform, apply, backends, names, register
 from .compat import experimental, pp, tl  # scanpy-style namespaces
+from . import pl  # scanpy-style plotting namespace (host-side)
 from . import accessors as _accessors
 from .registry import get as _registry_get
 
@@ -65,5 +66,5 @@ __all__ = [
     "read_h5ad", "write_h5ad", "read_10x_mtx", "read_10x_h5", "read_loom",
     "write_loom",
     "from_scipy", "from_dense",
-    "pp", "tl", "experimental",
+    "pp", "tl", "experimental", "pl",
 ]

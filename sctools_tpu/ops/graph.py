@@ -624,7 +624,11 @@ def _paga_impl(data: CellData, groups: str) -> CellData:
     return data.with_uns(
         paga_connectivities=theta,
         paga_edge_weights=C.astype(np.float32),
-        paga_groups=uniq)
+        paga_groups=uniq,
+        # the obs column the abstraction was computed over (scanpy
+        # stores uns['paga']['groups']); pl.paga must not have to
+        # guess it by level-matching across obs columns
+        paga_groups_key=groups)
 
 
 @register("graph.paga", backend="tpu")

@@ -1,0 +1,524 @@
+"""``sct.pl`` — the scanpy-style plotting namespace.
+
+Plotting is host-side by nature: every function fetches the (small)
+arrays it needs from the ``CellData`` container (device or host
+residency both work — ``obs_vector``/``np.asarray`` handle the fetch)
+and draws with matplotlib.  Nothing here dispatches device programs;
+the TPU work happened upstream in the ops that produced the
+embeddings/scores being drawn.
+
+API shape follows scanpy's ``sc.pl`` (a reference user should find the
+canonical names): ``pl.umap(adata, color="leiden")``,
+``pl.violin(adata, ["n_genes"], groupby="leiden")``,
+``pl.dotplot(adata, markers, groupby="leiden")``,
+``pl.rank_genes_groups(adata)``, ``pl.paga(adata)``, …  Every function
+returns the matplotlib ``Axes`` and accepts ``ax=``, ``save=`` (write
+the figure to a path, closing self-created figures so batch loops
+don't accumulate) and ``show=`` (kept for scanpy call-site
+compatibility).  The one exception is ``rank_genes_groups``, which
+draws a multi-panel figure and returns the 2-D axes array (no ``ax=``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+
+def _plt():
+    import os
+
+    import matplotlib
+
+    # Backend init is lazy in modern matplotlib, so "import pyplot"
+    # succeeds even where figure creation would later TclError: switch
+    # to Agg up front when an interactive backend is configured but no
+    # display exists (Linux: DISPLAY/WAYLAND_DISPLAY).
+    headless = not (os.environ.get("DISPLAY")
+                    or os.environ.get("WAYLAND_DISPLAY"))
+    if headless and matplotlib.get_backend().lower() not in (
+            "agg", "pdf", "svg", "ps", "cairo", "template"):
+        matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    return plt
+
+
+# Integer columns are treated as categorical only when they look like
+# cluster labels (a handful of levels — tab20-sized); count-like
+# metrics (n_genes, ...) must render as a colormap, not a legend.
+_CAT_MAX_INT = 20
+
+
+def _is_categorical(v: np.ndarray) -> bool:
+    if v.dtype.kind in ("U", "S", "O", "b"):
+        return True
+    if v.dtype.kind in ("i", "u"):
+        return len(np.unique(v)) <= _CAT_MAX_INT
+    return False
+
+
+def _resolve_color(data, key):
+    """obs column or gene name -> (values, is_categorical)."""
+    v = np.asarray(data.obs_vector(key))
+    return v, _is_categorical(v)
+
+
+def _basis_key(data, basis: str) -> str:
+    key = basis if basis.startswith("X_") else f"X_{basis}"
+    if key not in data.obsm:
+        raise KeyError(
+            f"pl: obsm has no {key!r} — run the matching embedding op "
+            f"first (available: {sorted(data.obsm)})")
+    return key
+
+
+def _cat_palette(plt, n):
+    base = plt.get_cmap("tab20").colors
+    if n <= 20:
+        return [base[i] for i in range(n)]
+    return [plt.get_cmap("hsv")(i / n) for i in range(n)]
+
+
+def _finish(fig, ax, save, show, created=False):
+    if save:
+        fig.savefig(save, bbox_inches="tight", dpi=150)
+        if created:  # saved batch plots must not accumulate in pyplot's
+            import matplotlib.pyplot as plt  # global figure registry
+
+            plt.close(fig)
+    return ax
+
+
+def _std_scale(means: np.ndarray, standard_scale):
+    """scanpy's standard_scale: None, 'var' (per column) or 'group'
+    (per row), each min-max scaled over the other axis."""
+    if standard_scale is None:
+        return means
+    if standard_scale == "var":
+        rng = means.max(axis=0) - means.min(axis=0)
+        return (means - means.min(axis=0)) / np.where(rng > 0, rng, 1)
+    if standard_scale == "group":
+        rng = (means.max(axis=1) - means.min(axis=1))[:, None]
+        return ((means - means.min(axis=1)[:, None])
+                / np.where(rng > 0, rng, 1))
+    raise ValueError(
+        f"standard_scale={standard_scale!r}: use None, 'var' or "
+        f"'group'")
+
+
+def embedding(data, basis: str = "X_umap", *, color=None, ax=None,
+              size=None, cmap: str = "viridis", title=None,
+              legend_loc: str = "right margin", alpha: float = 0.9,
+              components=(0, 1), save=None, show=None):
+    """Scatter an obsm embedding, optionally colored by an obs column
+    or a gene (scanpy ``pl.embedding``).  Categorical colors get a
+    legend; continuous a colorbar."""
+    plt = _plt()
+    E = np.asarray(data.obsm[_basis_key(data, basis)])[: data.n_cells]
+    x, y = E[:, components[0]], E[:, components[1]]
+    created = ax is None
+    if created:
+        fig, ax = plt.subplots(figsize=(4.2, 4.0))
+    else:
+        fig = ax.figure
+    if size is None:
+        size = max(120000 / max(len(x), 1), 0.5)
+    if color is None:
+        ax.scatter(x, y, s=size, c="tab:blue", alpha=alpha,
+                   linewidths=0)
+    else:
+        v, cat = _resolve_color(data, color)
+        if cat:
+            levels = np.unique(v)
+            pal = _cat_palette(plt, len(levels))
+            for li, lev in enumerate(levels):
+                m = v == lev
+                ax.scatter(x[m], y[m], s=size, color=pal[li],
+                           alpha=alpha, linewidths=0, label=str(lev))
+            if legend_loc == "on data":
+                for li, lev in enumerate(levels):
+                    m = v == lev
+                    ax.text(x[m].mean(), y[m].mean(), str(lev),
+                            ha="center", va="center", fontsize=8,
+                            weight="bold")
+            elif legend_loc:
+                ax.legend(loc="center left", bbox_to_anchor=(1.0, 0.5),
+                          frameon=False, markerscale=3, fontsize=8)
+        else:
+            sc = ax.scatter(x, y, s=size, c=v, cmap=cmap, alpha=alpha,
+                            linewidths=0)
+            fig.colorbar(sc, ax=ax, shrink=0.7)
+    name = basis.removeprefix("X_")
+    ax.set_xlabel(f"{name}{components[0] + 1}")
+    ax.set_ylabel(f"{name}{components[1] + 1}")
+    ax.set_title(title if title is not None else (color or name))
+    ax.set_xticks([])
+    ax.set_yticks([])
+    return _finish(fig, ax, save, show, created)
+
+
+umap = partial(embedding, basis="X_umap")
+tsne = partial(embedding, basis="X_tsne")
+pca = partial(embedding, basis="X_pca")
+diffmap = partial(embedding, basis="X_diffmap")
+draw_graph = partial(embedding, basis="X_draw_graph")
+phate = partial(embedding, basis="X_phate")
+
+
+def scatter(data, x: str, y: str, *, color=None, ax=None, save=None,
+            show=None):
+    """Scatter two obs columns / genes against each other
+    (scanpy ``pl.scatter``)."""
+    plt = _plt()
+    xv = np.asarray(data.obs_vector(x), float)
+    yv = np.asarray(data.obs_vector(y), float)
+    created = ax is None
+    if created:
+        fig, ax = plt.subplots(figsize=(4.0, 3.6))
+    else:
+        fig = ax.figure
+    if color is None:
+        ax.scatter(xv, yv, s=8, alpha=0.7, linewidths=0)
+    else:
+        v, cat = _resolve_color(data, color)
+        if cat:
+            levels = np.unique(v)
+            pal = _cat_palette(plt, len(levels))
+            for li, lev in enumerate(levels):
+                m = v == lev
+                ax.scatter(xv[m], yv[m], s=8, color=pal[li], alpha=0.7,
+                           linewidths=0, label=str(lev))
+            ax.legend(frameon=False, fontsize=8)
+        else:
+            sc = ax.scatter(xv, yv, s=8, c=v, alpha=0.7, linewidths=0)
+            fig.colorbar(sc, ax=ax, shrink=0.7)
+    ax.set_xlabel(x)
+    ax.set_ylabel(y)
+    return _finish(fig, ax, save, show, created)
+
+
+def violin(data, keys, *, groupby: str | None = None, log: bool = False,
+           ax=None, save=None, show=None, rotation: float = 0.0):
+    """Violin plot of obs columns / genes, optionally split by a
+    categorical obs column (scanpy ``pl.violin``)."""
+    plt = _plt()
+    created = ax is None
+    if isinstance(keys, str):
+        keys = [keys]
+    if groupby is None:
+        if created:
+            fig, ax = plt.subplots(figsize=(0.9 * len(keys) + 1.6, 3.2))
+        else:
+            fig = ax.figure
+        vals = [np.asarray(data.obs_vector(k), float) for k in keys]
+        ax.violinplot(vals, showmedians=True, widths=0.8)
+        ax.set_xticks(np.arange(1, len(keys) + 1), keys,
+                      rotation=rotation)
+    else:
+        if len(keys) != 1:
+            raise ValueError(
+                "pl.violin: pass exactly one key with groupby= "
+                "(scanpy semantics)")
+        g = np.asarray(data.obs_vector(groupby))
+        levels = np.unique(g)
+        v = np.asarray(data.obs_vector(keys[0]), float)
+        if created:
+            fig, ax = plt.subplots(
+                figsize=(0.6 * len(levels) + 1.6, 3.2))
+        else:
+            fig = ax.figure
+        ax.violinplot([v[g == lev] for lev in levels], showmedians=True,
+                      widths=0.8)
+        ax.set_xticks(np.arange(1, len(levels) + 1),
+                      [str(lev) for lev in levels], rotation=rotation)
+        ax.set_xlabel(groupby)
+        ax.set_ylabel(keys[0])
+    if log:
+        ax.set_yscale("log")
+    return _finish(fig, ax, save, show, created)
+
+
+def highest_expr_genes(data, n_top: int = 30, *, ax=None, save=None,
+                       show=None):
+    """Boxplot of the genes with the highest mean fraction of total
+    counts per cell (scanpy ``pl.highest_expr_genes``)."""
+    plt = _plt()
+    host = data.to_host()
+    X = host.X
+    import scipy.sparse as sp
+
+    M = X.tocsr() if sp.issparse(X) else sp.csr_matrix(np.asarray(X))
+    M = M[: host.n_cells]
+    totals = np.maximum(np.asarray(M.sum(axis=1)).ravel(), 1e-12)
+    frac = sp.diags(1.0 / totals) @ M
+    mean_frac = np.asarray(frac.mean(axis=0)).ravel()
+    top = np.argsort(-mean_frac)[:n_top]
+    names = (np.asarray(host.var["gene_name"]).astype(str)
+             if "gene_name" in host.var
+             else np.array([str(i) for i in range(host.n_genes)]))
+    created = ax is None
+    if created:
+        fig, ax = plt.subplots(figsize=(4.0, 0.22 * n_top + 1.2))
+    else:
+        fig = ax.figure
+    cols = [np.asarray(frac[:, j].todense()).ravel() * 100 for j in top]
+    ax.boxplot(cols[::-1], orientation="horizontal", showfliers=False,
+               tick_labels=list(names[top])[::-1])
+    ax.set_xlabel("% of total counts")
+    return _finish(fig, ax, save, show, created)
+
+
+def _grouped_stats(data, var_names, groupby):
+    """(group levels, mean expression (G, V), fraction expressing)."""
+    g = np.asarray(data.obs_vector(groupby))
+    levels = np.unique(g)
+    vals = np.stack([np.asarray(data.obs_vector(v), float)
+                     for v in var_names], axis=1)  # (n, V)
+    means = np.stack([vals[g == lev].mean(axis=0) for lev in levels])
+    fracs = np.stack([(vals[g == lev] > 0).mean(axis=0)
+                      for lev in levels])
+    return levels, means, fracs
+
+
+def dotplot(data, var_names, groupby: str, *, standard_scale=None,
+            cmap: str = "Reds", ax=None, save=None, show=None):
+    """Mean expression (color) x fraction-expressing (dot size) per
+    group (scanpy ``pl.dotplot``)."""
+    plt = _plt()
+    if isinstance(var_names, str):
+        var_names = [var_names]
+    levels, means, fracs = _grouped_stats(data, var_names, groupby)
+    means = _std_scale(means, standard_scale)
+    G, V = means.shape
+    created = ax is None
+    if created:
+        fig, ax = plt.subplots(
+            figsize=(0.45 * V + 2.0, 0.45 * G + 1.2))
+    else:
+        fig = ax.figure
+    xx, yy = np.meshgrid(np.arange(V), np.arange(G))
+    sc = ax.scatter(xx.ravel(), yy.ravel(), s=12 + 260 * fracs.ravel(),
+                    c=means.ravel(), cmap=cmap, edgecolors="0.6",
+                    linewidths=0.4)
+    ax.set_xticks(np.arange(V), list(var_names), rotation=90)
+    ax.set_yticks(np.arange(G), [str(lev) for lev in levels])
+    ax.set_xlim(-0.7, V - 0.3)
+    ax.set_ylim(G - 0.3, -0.7)
+    ax.set_ylabel(groupby)
+    fig.colorbar(sc, ax=ax, shrink=0.6, label="mean expression")
+    return _finish(fig, ax, save, show, created)
+
+
+def matrixplot(data, var_names, groupby: str, *, cmap: str = "viridis",
+               standard_scale=None, ax=None, save=None, show=None):
+    """Heatmap of per-group mean expression (scanpy ``pl.matrixplot``)."""
+    plt = _plt()
+    if isinstance(var_names, str):
+        var_names = [var_names]
+    levels, means, _ = _grouped_stats(data, var_names, groupby)
+    means = _std_scale(means, standard_scale)
+    G, V = means.shape
+    created = ax is None
+    if created:
+        fig, ax = plt.subplots(
+            figsize=(0.45 * V + 2.0, 0.45 * G + 1.2))
+    else:
+        fig = ax.figure
+    im = ax.imshow(means, cmap=cmap, aspect="auto")
+    ax.set_xticks(np.arange(V), list(var_names), rotation=90)
+    ax.set_yticks(np.arange(G), [str(lev) for lev in levels])
+    ax.set_ylabel(groupby)
+    ax.figure.colorbar(im, ax=ax, shrink=0.6, label="mean expression")
+    return _finish(fig, ax, save, show, created)
+
+
+def heatmap(data, var_names, groupby: str, *, cmap: str = "viridis",
+            ax=None, save=None, show=None):
+    """Per-cell expression heatmap with cells ordered by group
+    (scanpy ``pl.heatmap``)."""
+    plt = _plt()
+    if isinstance(var_names, str):
+        var_names = [var_names]
+    g = np.asarray(data.obs_vector(groupby))
+    order = np.argsort(g, kind="stable")
+    vals = np.stack([np.asarray(data.obs_vector(v), float)
+                     for v in var_names], axis=1)[order]
+    created = ax is None
+    if created:
+        fig, ax = plt.subplots(
+            figsize=(0.45 * len(var_names) + 2.0, 4.0))
+    else:
+        fig = ax.figure
+    im = ax.imshow(vals, cmap=cmap, aspect="auto",
+                   interpolation="nearest")
+    ax.set_xticks(np.arange(len(var_names)), list(var_names),
+                  rotation=90)
+    for b in np.flatnonzero(g[order][1:] != g[order][:-1]):
+        ax.axhline(b + 0.5, color="w", lw=0.8)
+    ax.set_ylabel(f"cells (grouped by {groupby})")
+    ax.set_yticks([])
+    fig.colorbar(im, ax=ax, shrink=0.6)
+    return _finish(fig, ax, save, show, created)
+
+
+def rank_genes_groups(data, *, n_genes: int = 20,
+                      key: str = "rank_genes_groups", ncols: int = 4,
+                      save=None, show=None):
+    """Per-group top-gene score panels (scanpy
+    ``pl.rank_genes_groups``)."""
+    plt = _plt()
+    if key not in data.uns:
+        raise KeyError(f"pl.rank_genes_groups: uns has no {key!r} — "
+                       "run de.rank_genes_groups first")
+    res = data.uns[key]
+    groups = list(res["groups"])
+    names = np.asarray(res["names"])
+    scores = np.asarray(res["scores"], float)
+    ncols = min(ncols, len(groups))
+    nrows = -(-len(groups) // ncols)
+    fig, axes = plt.subplots(nrows, ncols, squeeze=False,
+                             figsize=(2.6 * ncols, 2.4 * nrows),
+                             sharey=False)
+    ymin = scores[:, :n_genes].min()
+    ymax = scores[:, :n_genes].max()
+    for gi, grp in enumerate(groups):
+        ax = axes[gi // ncols][gi % ncols]
+        s = scores[gi, :n_genes]
+        ax.set_title(str(grp), fontsize=9)
+        for r in range(len(s)):
+            ax.text(r, s[r], str(names[gi, r]), rotation=90,
+                    va="bottom", ha="center", fontsize=7)
+        ax.set_xlim(-1, n_genes)
+        ax.set_ylim(ymin, ymax + 0.25 * (ymax - ymin + 1e-12))
+        if gi % ncols == 0:
+            ax.set_ylabel("score")
+    for gi in range(len(groups), nrows * ncols):
+        axes[gi // ncols][gi % ncols].axis("off")
+    fig.tight_layout()
+    if save:
+        fig.savefig(save, bbox_inches="tight", dpi=150)
+    return axes
+
+
+def paga(data, *, threshold: float = 0.01, basis: str | None = None,
+         groups: str | None = None, node_scale: float = 900.0,
+         ax=None, save=None, show=None):
+    """Cluster-abstraction graph: nodes at group centroids (of
+    ``basis``, default the first available embedding), edge width
+    proportional to PAGA connectivity (scanpy ``pl.paga``)."""
+    plt = _plt()
+    if "paga_connectivities" not in data.uns:
+        raise KeyError("pl.paga: run graph.paga first")
+    theta = np.asarray(data.uns["paga_connectivities"], float)
+    levels = np.asarray(data.uns["paga_groups"])
+    if groups is None:
+        # graph.paga stores the column it ran over; the level-matching
+        # scan is only a fallback for pre-r5 results and can pick the
+        # wrong column when two clusterings share level names
+        groups = data.uns.get("paga_groups_key")
+    if groups is None:
+        groups = next((k for k in data.obs
+                       if np.array_equal(
+                           np.unique(np.asarray(data.obs[k])[
+                               : data.n_cells]), levels)), None)
+    if basis is None:
+        for cand in ("X_umap", "X_draw_graph", "X_tsne", "X_phate",
+                     "X_pca"):
+            if cand in data.obsm:
+                basis = cand
+                break
+    if groups is not None and basis is not None:
+        E = np.asarray(data.obsm[_basis_key(data, basis)])[
+            : data.n_cells, :2]
+        g = np.asarray(data.obs[groups])[: data.n_cells]
+        pos = np.stack([E[g == lev].mean(axis=0) for lev in levels])
+    else:  # circular layout fallback
+        ang = 2 * np.pi * np.arange(len(levels)) / len(levels)
+        pos = np.stack([np.cos(ang), np.sin(ang)], axis=1)
+    created = ax is None
+    if created:
+        fig, ax = plt.subplots(figsize=(4.0, 4.0))
+    else:
+        fig = ax.figure
+    wmax = theta.max() or 1.0
+    for i in range(len(levels)):
+        for j in range(i + 1, len(levels)):
+            if theta[i, j] >= threshold:
+                ax.plot(*zip(pos[i], pos[j]), color="0.5",
+                        lw=0.5 + 4.0 * theta[i, j] / wmax, zorder=1)
+    sizes = np.array([(np.asarray(data.obs[groups])[: data.n_cells]
+                       == lev).mean() if groups else 1 / len(levels)
+                      for lev in levels])
+    ax.scatter(pos[:, 0], pos[:, 1], s=100 + node_scale * sizes,
+               c=_cat_palette(plt, len(levels)), zorder=2,
+               edgecolors="k", linewidths=0.5)
+    for i, lev in enumerate(levels):
+        ax.text(pos[i, 0], pos[i, 1], str(lev), ha="center",
+                va="center", fontsize=8, zorder=3)
+    ax.set_xticks([])
+    ax.set_yticks([])
+    ax.set_title("PAGA")
+    return _finish(fig, ax, save, show, created)
+
+
+def embedding_density(data, basis: str = "X_umap", *, key: str | None =
+                      None, ax=None, save=None, show=None):
+    """Embedding colored by the ``embed.density`` KDE (scanpy
+    ``pl.embedding_density``)."""
+    name = basis.removeprefix("X_")
+    key = key or f"{name}_density"
+    if key not in data.obs:
+        raise KeyError(f"pl.embedding_density: obs has no {key!r} — "
+                       "run embed.density first")
+    return embedding(data, basis, color=key, cmap="YlOrRd", ax=ax,
+                     save=save, show=show, title=key)
+
+
+def dendrogram(data, groupby: str, *, ax=None, save=None, show=None):
+    """The stored ``cluster.dendrogram`` linkage as a tree (scanpy
+    ``pl.dendrogram``)."""
+    plt = _plt()
+    key = f"dendrogram_{groupby}"
+    if key not in data.uns:
+        raise KeyError(f"pl.dendrogram: uns has no {key!r} — run "
+                       "cluster.dendrogram first")
+    from scipy.cluster import hierarchy
+
+    d = data.uns[key]
+    created = ax is None
+    if created:
+        fig, ax = plt.subplots(figsize=(4.0, 3.0))
+    else:
+        fig = ax.figure
+    cats = d.get("categories")
+    if cats is None:
+        # levels in original order: invert categories_ordered by idx
+        order = np.asarray(d["categories_idx_ordered"])
+        cats = np.empty(len(order), object)
+        cats[order] = d["categories_ordered"]
+    hierarchy.dendrogram(np.asarray(d["linkage"], float),
+                         labels=list(map(str, cats)), ax=ax,
+                         color_threshold=0)
+    ax.set_ylabel("distance")
+    return _finish(fig, ax, save, show, created)
+
+
+def velocity_embedding(data, basis: str = "umap", *, scale: float = 1.0,
+                       color=None, ax=None, save=None, show=None):
+    """Per-cell velocity arrows over an embedding (scVelo
+    ``pl.velocity_embedding``); requires ``velocity.embedding``."""
+    plt = _plt()
+    name = basis.removeprefix("X_")
+    vcol = f"velocity_{name}"
+    if vcol not in data.obsm:
+        raise KeyError(f"pl.velocity_embedding: obsm has no {vcol!r} — "
+                       "run velocity.embedding first")
+    ax = embedding(data, f"X_{name}", color=color, ax=ax, alpha=0.35)
+    E = np.asarray(data.obsm[f"X_{name}"])[: data.n_cells, :2]
+    V = np.asarray(data.obsm[vcol])[: data.n_cells, :2]
+    ax.quiver(E[:, 0], E[:, 1], V[:, 0], V[:, 1], angles="xy",
+              scale_units="xy", scale=1.0 / max(scale, 1e-12),
+              width=0.002, color="k", alpha=0.7)
+    return _finish(ax.figure, ax, save, show)

@@ -1,0 +1,150 @@
+"""``sct.pl`` plotting namespace: every staple draws on a realistic
+workflow result, returns live Axes with the expected marks, and
+round-trips through savefig (Agg backend — no display needed)."""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+
+import sctools_tpu as sct
+from sctools_tpu.data.synthetic import synthetic_counts
+
+
+@pytest.fixture(scope="module")
+def workflow():
+    ds = synthetic_counts(600, 300, density=0.12, n_clusters=3, seed=3)
+    ds = ds.with_var(gene_name=np.array([f"G{i}" for i in range(300)]))
+    out = sct.Pipeline([
+        ("qc.per_cell_metrics", {}),
+        ("normalize.library_size", {"target_sum": 1e4}),
+        ("normalize.log1p", {}),
+        ("pca.randomized", {"n_components": 20}),
+        ("neighbors.knn", {"k": 10, "metric": "cosine"}),
+        ("graph.connectivities", {}),
+        ("cluster.leiden", {"resolution": 1.0}),
+        ("embed.umap", {"n_epochs": 30}),
+        ("graph.paga", {"groups": "leiden"}),
+        ("de.rank_genes_groups", {"groupby": "leiden"}),
+        ("cluster.dendrogram", {"groupby": "leiden"}),
+        ("embed.density", {"basis": "X_umap"}),
+    ]).run(ds.device_put(), backend="tpu").to_host()
+    return out
+
+
+def _n_points(ax):
+    return sum(len(c.get_offsets()) for c in ax.collections)
+
+
+def test_embedding_categorical_and_gene(workflow, tmp_path):
+    ax = sct.pl.umap(workflow, color="leiden",
+                     save=tmp_path / "umap.png")
+    assert _n_points(ax) == workflow.n_cells
+    assert ax.get_legend() is not None
+    assert (tmp_path / "umap.png").stat().st_size > 1000
+    # gene-colored: continuous -> one collection + colorbar
+    ax2 = sct.pl.umap(workflow, color="G5")
+    assert _n_points(ax2) == workflow.n_cells
+    assert ax2.get_legend() is None
+
+
+def test_embedding_missing_basis_raises(workflow):
+    with pytest.raises(KeyError, match="X_tsne"):
+        sct.pl.tsne(workflow)
+
+
+def test_scatter_and_violin(workflow, tmp_path):
+    ax = sct.pl.scatter(workflow, "total_counts", "n_genes",
+                        color="leiden")
+    assert _n_points(ax) == workflow.n_cells
+    ax2 = sct.pl.violin(workflow, ["total_counts", "n_genes"])
+    assert len(ax2.collections) > 0
+    ax3 = sct.pl.violin(workflow, ["total_counts"], groupby="leiden",
+                        save=tmp_path / "violin.png")
+    n_groups = len(np.unique(workflow.obs_vector("leiden")))
+    assert len(ax3.get_xticklabels()) == n_groups
+    with pytest.raises(ValueError, match="exactly one key"):
+        sct.pl.violin(workflow, ["a", "b"], groupby="leiden")
+
+
+def test_highest_expr_genes(workflow):
+    ax = sct.pl.highest_expr_genes(workflow, n_top=10)
+    assert len(ax.get_yticklabels()) == 10
+
+
+def test_dotplot_matrixplot_heatmap(workflow, tmp_path):
+    markers = [f"G{i}" for i in (1, 5, 9, 20)]
+    ax = sct.pl.dotplot(workflow, markers, groupby="leiden",
+                        save=tmp_path / "dot.png")
+    n_groups = len(np.unique(workflow.obs_vector("leiden")))
+    assert _n_points(ax) == n_groups * len(markers)
+    ax2 = sct.pl.matrixplot(workflow, markers, groupby="leiden",
+                            standard_scale="var")
+    assert ax2.images[0].get_array().shape == (n_groups, len(markers))
+    ax3 = sct.pl.heatmap(workflow, markers, groupby="leiden")
+    assert ax3.images[0].get_array().shape == (workflow.n_cells,
+                                               len(markers))
+
+
+def test_rank_genes_groups_panels(workflow, tmp_path):
+    axes = sct.pl.rank_genes_groups(workflow, n_genes=8,
+                                    save=tmp_path / "rgg.png")
+    groups = list(workflow.uns["rank_genes_groups"]["groups"])
+    live = [a for row in axes for a in row if a.get_title()]
+    assert len(live) == len(groups)
+    # gene names rendered as text
+    assert len(live[0].texts) == 8
+
+
+def test_paga_and_dendrogram_and_density(workflow, tmp_path):
+    ax = sct.pl.paga(workflow, save=tmp_path / "paga.png")
+    n_groups = len(np.asarray(workflow.uns["paga_groups"]))
+    assert _n_points(ax) == n_groups
+    ax2 = sct.pl.dendrogram(workflow, "leiden")
+    assert len(ax2.collections) > 0 or len(ax2.lines) > 0
+    ax3 = sct.pl.embedding_density(workflow, "X_umap")
+    assert _n_points(ax3) == workflow.n_cells
+
+
+def test_velocity_embedding_requires_arrows(workflow):
+    with pytest.raises(KeyError, match="velocity_umap"):
+        sct.pl.velocity_embedding(workflow)
+
+
+def test_standard_scale_group_and_validation(workflow):
+    markers = ["G1", "G5", "G9", "G20"]
+    ax = sct.pl.matrixplot(workflow, markers, groupby="leiden",
+                           standard_scale="group")
+    arr = np.asarray(ax.images[0].get_array())
+    # per-row min-max: every non-degenerate row peaks at exactly 1
+    rowmax = arr.max(axis=1)
+    assert ((np.isclose(rowmax, 1.0)) | (np.isclose(rowmax, 0.0))).all()
+    assert np.isclose(rowmax, 1.0).any()
+    assert np.isclose(arr.min(axis=1), 0.0).all()
+    with pytest.raises(ValueError, match="standard_scale"):
+        sct.pl.dotplot(workflow, markers, groupby="leiden",
+                       standard_scale="cells")
+
+
+def test_paga_uses_stored_groups_key(workflow):
+    # a second obs column with IDENTICAL levels must not hijack the
+    # layout: graph.paga stores paga_groups_key and pl.paga reads it
+    decoy = np.asarray(workflow.obs_vector("leiden")).copy()
+    rng = np.random.default_rng(0)
+    rng.shuffle(decoy)
+    d2 = workflow.with_obs(aaa_decoy=decoy)  # sorts before "leiden"
+    assert d2.uns["paga_groups_key"] == "leiden"
+    ax = sct.pl.paga(d2)
+    assert _n_points(ax) == len(np.asarray(d2.uns["paga_groups"]))
+
+
+def test_save_closes_created_figures(workflow, tmp_path):
+    import matplotlib.pyplot as plt
+
+    before = plt.get_fignums()
+    for i in range(3):
+        sct.pl.umap(workflow, color="leiden",
+                    save=tmp_path / f"u{i}.png")
+    assert plt.get_fignums() == before  # no figure leak
