@@ -134,12 +134,16 @@ class Config:
     gen_chunk_rows: int = 16384
 
     # Streaming PCA matvec/rmatvec: rows per jitted program.  -1 =
-    # auto (16384 on the tunneled backend, whole-shard elsewhere);
+    # auto (32768 on the tunneled backend, whole-shard elsewhere);
     # 0 = whole shard; >0 explicit.  Execution-only — results are
     # identical, the chunk just bounds program size: the full-shard
     # stream_pca programs at 131072 rows WEDGED the tunneled worker
     # (round-5 probe step4, >19 min no progress) after the same-sized
-    # datagen program crashed it outright.
+    # datagen program crashed it outright.  32768 was chosen by an
+    # on-chip sweep (round-5 session 3): 16384 -> 31.6 s, 32768 ->
+    # 15.9 s, 65536 -> 14.0 s for the full 131k stream_pca, all
+    # wedge-free; 32768 takes nearly all the win while keeping 4x
+    # size margin from the wedge-prone whole-shard program.
     stream_row_chunk: int = -1
 
     def stream_row_chunk_rows(self) -> int:
@@ -151,8 +155,17 @@ class Config:
                 f"stream_row_chunk={v}: use -1 (auto), 0 (whole "
                 f"shard) or a positive row count")
         if v == -1:
-            return 16384 if _on_tunnel() else 0
+            return 32768 if _on_tunnel() else 0
         return v
+
+    # f32-refine candidate count for the benchmarked kNN pipeline
+    # (bench.py atlas path and tools/tpu_probe.py step4 — the probe
+    # must compile the exact program the bench runs, so BOTH read this
+    # one value).  32 was chosen by an on-chip measurement (round-5
+    # session 3): top-15 set agreement 1.00000 vs refine=64 at
+    # 131k x 50 PCA-like scores, with the refine pass 5.9 s -> 2.0 s
+    # and its compile 31 s -> 14 s.  Env: SCTOOLS_BENCH_KNN_REFINE.
+    bench_knn_refine: int = 32
 
     # Streaming loops: block on each shard's outputs before dispatching
     # the next shard.  "auto" => sync only on the tunneled single-chip
@@ -181,6 +194,8 @@ if os.environ.get("SCTOOLS_GEN_CHUNK_ROWS"):
     config.gen_chunk_rows = int(os.environ["SCTOOLS_GEN_CHUNK_ROWS"])
 if os.environ.get("SCTOOLS_STREAM_ROW_CHUNK"):
     config.stream_row_chunk = int(os.environ["SCTOOLS_STREAM_ROW_CHUNK"])
+if os.environ.get("SCTOOLS_BENCH_KNN_REFINE"):
+    config.bench_knn_refine = int(os.environ["SCTOOLS_BENCH_KNN_REFINE"])
 if os.environ.get("SCTOOLS_TPU_KNN_IMPL"):
     # lets the bench orchestrator route atlas children onto the kernel
     # sweep's measured winner within the same run

@@ -113,11 +113,16 @@ def main():
 
     emb = jax.random.normal(jax.random.PRNGKey(1), (src.n_cells, 50),
                             jnp.float32)
-    log("  knn impl:", config.resolved_knn_impl())
+    # same refine value as the bench atlas path (config.bench_knn_refine,
+    # env SCTOOLS_BENCH_KNN_REFINE) — the probe must compile/execute
+    # the PROGRAM the bench will run, not a differently-shaped variant
+    refine = int(config.bench_knn_refine)
+    log("  knn impl:", config.resolved_knn_impl(), "refine:", refine)
     with configure(matmul_dtype="bfloat16"):
         t = time.time()
         idx, _ = knn_arrays(emb[:131072], emb, k=15, metric="cosine",
-                            n_query=131072, n_cand=args.cells, refine=64)
+                            n_query=131072, n_cand=args.cells,
+                            refine=refine)
         hard_sync(idx)
         log("step4 OK:", round(time.time() - t, 1), "s")
     if args.upto < 5:
