@@ -1369,12 +1369,15 @@ def main():
                              "status": res["_phase"]["status"],
                              "wall_s": res["_phase"]["wall_s"]})
             ok3 = _attempt_ok(res)
-            if (not ok3 and os.path.exists(ck_path)
+            if (not ok3 and (os.path.exists(ck_path)
+                             or os.path.exists(ck_path + ".pca.npz"))
                     and remaining() > 300):
-                # the crash left a stats checkpoint: one same-size
-                # retry resumes from the first unprocessed shard
-                # instead of abandoning the size (stream.py
-                # stream_stats checkpoint=)
+                # the crash left a stats OR pca checkpoint: one
+                # same-size retry resumes from the first unprocessed
+                # shard / power-iteration round instead of abandoning
+                # the size (stream.py stream_stats/stream_pca
+                # checkpoint=; datagen is deterministic in the seed,
+                # so resumed state is valid on regenerated shards)
                 res = run_phase("atlas",
                                 min(attempt_cap, remaining() - 120),
                                 env_overrides=overrides)

@@ -31,8 +31,9 @@ from . import (  # noqa: F401  (imports register transforms)
 from .config import config, configure
 from .data import CellData, SparseCells
 from .data.concat import concat
-from .data.io import (from_dense, from_scipy, read_10x_h5, read_10x_mtx,
-                      read_h5ad, read_loom, write_h5ad, write_loom)
+from .data.io import (from_dense, from_scipy, read, read_10x_h5,
+                      read_10x_mtx, read_csv, read_h5ad, read_loom,
+                      read_mtx, read_text, write_h5ad, write_loom)
 from .registry import Pipeline, Transform, apply, backends, names, register
 from .compat import experimental, pp, tl  # scanpy-style namespaces
 from . import pl  # scanpy-style plotting namespace (host-side)
@@ -63,6 +64,7 @@ __version__ = "0.1.0"
 __all__ = [
     "CellData", "SparseCells", "Pipeline", "Transform", "apply", "register",
     "get", "names", "backends", "config", "configure",
+    "read", "read_csv", "read_text", "read_mtx",
     "read_h5ad", "write_h5ad", "read_10x_mtx", "read_10x_h5", "read_loom",
     "write_loom",
     "from_scipy", "from_dense",

@@ -265,6 +265,117 @@ def read_10x_mtx(path: str) -> CellData:
 
 
 # ----------------------------------------------------------------------
+# Generic text / matrix-market readers + extension dispatch
+# (scanpy sc.read_csv / sc.read_text / sc.read_mtx / sc.read parity;
+# reference source unavailable — SURVEY.md §0 — the public scanpy
+# signatures are the contract)
+# ----------------------------------------------------------------------
+
+
+def read_csv(path: str, delimiter: str | None = ",",
+             first_column_names: bool | None = None,
+             dtype=np.float32) -> CellData:
+    """Read a dense delimited cells×genes table.
+
+    Row 1 is taken as gene names when non-numeric; the first column
+    is taken as cell names when ``first_column_names=True`` or (None)
+    when its first data entry is non-numeric — scanpy's read_csv
+    detection rules."""
+    import csv as _csv
+
+    def _is_num(s: str) -> bool:
+        try:
+            float(s)
+            return True
+        except ValueError:
+            return False
+
+    opener = __import__("gzip").open if path.endswith(".gz") else open
+    with opener(path, "rt") as fh:
+        if delimiter is None:
+            rows = [l.split() for l in fh if l.strip()]
+        else:
+            rows = [r for r in _csv.reader(fh, delimiter=delimiter) if r]
+    if not rows:
+        raise ValueError(f"read_csv: {path} is empty")
+    header = rows[0]
+    has_header = not all(_is_num(c) for c in header[1:] or header)
+    body = rows[1:] if has_header else rows
+    if not body:
+        raise ValueError(f"read_csv: {path} has a header but no data")
+    if first_column_names is None:
+        first_column_names = not _is_num(body[0][0])
+    obs: dict = {}
+    if first_column_names:
+        obs["cell_name"] = np.array([r[0] for r in body])
+        body = [r[1:] for r in body]
+        if has_header and len(header) == len(body[0]) + 1:
+            header = header[1:]
+    X = np.array(body, dtype=dtype)  # C-level str->float, not a
+    # per-cell Python conversion (ragged rows still raise ValueError)
+    var: dict = {}
+    if has_header:
+        if len(header) != X.shape[1]:
+            raise ValueError(
+                f"read_csv: header has {len(header)} names for "
+                f"{X.shape[1]} data columns")
+        var["gene_name"] = np.array(header)
+    return CellData(X, obs=obs, var=var)
+
+
+def read_text(path: str, delimiter: str | None = None,
+              first_column_names: bool | None = None,
+              dtype=np.float32) -> CellData:
+    """``read_csv`` with whitespace splitting by default (scanpy
+    sc.read_text)."""
+    return read_csv(path, delimiter=delimiter,
+                    first_column_names=first_column_names, dtype=dtype)
+
+
+def read_mtx(path: str, transpose: bool = False) -> CellData:
+    """Read a single matrix-market file AS STORED (scanpy sc.read_mtx:
+    no 10x directory convention, no implicit transpose — pass
+    ``transpose=True`` for genes×cells files)."""
+    import scipy.io
+    import scipy.sparse as sp
+
+    if path.endswith(".gz"):
+        import gzip
+
+        with gzip.open(path, "rb") as fh:
+            m = scipy.io.mmread(fh)
+    else:
+        m = scipy.io.mmread(path)
+    m = m.T if transpose else m
+    X = sp.csr_matrix(m)
+    return CellData(X)
+
+
+def read(path: str, **kw) -> CellData:
+    """Extension-dispatching reader (scanpy ``sc.read``): .h5ad,
+    .loom, .mtx[.gz], .csv[.gz], .txt/.tsv/.tab[.gz], .h5 (10x)."""
+    base = path[:-3] if path.endswith(".gz") else path
+    ext = os.path.splitext(base)[1].lower()
+    if ext == ".h5ad":
+        return read_h5ad(path, **kw)
+    if ext == ".loom":
+        return read_loom(path, **kw)
+    if ext == ".mtx":
+        return read_mtx(path, **kw)
+    if ext == ".csv":
+        return read_csv(path, **kw)
+    if ext in (".txt", ".tsv", ".tab", ".data"):
+        kw.setdefault("delimiter",
+                      "\t" if ext in (".tsv", ".tab") else None)
+        return read_text(path, **kw)
+    if ext == ".h5":
+        return read_10x_h5(path, **kw)
+    raise ValueError(
+        f"read: unknown extension {ext!r} for {path!r} (use read_h5ad/"
+        f"read_loom/read_mtx/read_csv/read_text/read_10x_h5 directly)")
+
+
+# ----------------------------------------------------------------------
 # Shard streaming (out-of-core)
 # ----------------------------------------------------------------------
 

@@ -90,6 +90,91 @@ def recipe_seurat_cpu(data: CellData, n_top_genes: int = 2000,
                            target_sum).run(data, backend="cpu")
 
 
+def _weinreb17(data: CellData, backend: str, log: bool,
+               mean_threshold: float, cv_threshold: float,
+               n_comps: int) -> CellData:
+    """Shared Weinreb et al. 2017 (SPRING) preprocessing body.
+
+    Step list (the public scanpy ``pp.recipe_weinreb17`` contract —
+    reference source unavailable, SURVEY.md §0): per-cell count
+    normalisation → gene filter by mean AND coefficient of variation
+    thresholds → per-gene z-score → randomized PCA.  The CV filter is
+    computed on the NORMALISED PRE-LOG counts (CV on log-counts would
+    compress the threshold's meaning); ``log=True`` applies log1p
+    between the filter and the z-score.
+    """
+    import numpy as np
+
+    from .registry import apply
+
+    d = apply("util.snapshot_layer", data, layer="counts",
+              backend=backend)
+    d = apply("normalize.library_size", d, target_sum=None,
+              backend=backend)
+    if backend == "tpu":
+        from .ops.hvg import _gene_moments_tpu
+
+        mu_d, var_d, _ = _gene_moments_tpu(d.X)  # sparse AND dense X
+        mu = np.asarray(mu_d)
+        var = np.asarray(var_d)
+    else:
+        from .ops.hvg import _gene_moments_cpu
+
+        mu, var = _gene_moments_cpu(d.X)
+    cv = np.sqrt(np.maximum(var, 0.0)) / np.maximum(mu, 1e-12)
+    keep = (mu >= mean_threshold) & (cv >= cv_threshold)
+    if not keep.any():
+        raise ValueError(
+            f"recipe.weinreb17: no gene passes mean>={mean_threshold} "
+            f"and cv>={cv_threshold}; loosen the thresholds")
+    idx = np.flatnonzero(keep)
+    if backend == "tpu":
+        from .ops.hvg import select_genes_device
+
+        d = select_genes_device(d, idx, compact=True)
+    else:
+        import scipy.sparse as sp
+
+        X = d.X
+        Xs = (X.tocsc()[:, idx].tocsr() if sp.issparse(X)
+              else np.asarray(X)[:, idx])
+        var_d = {k: np.asarray(v)[idx] for k, v in d.var.items()}
+        varm = {k: np.asarray(v)[idx] for k, v in d.varm.items()}
+        layers = {k: (v.tocsc()[:, idx].tocsr() if sp.issparse(v)
+                      else np.asarray(v)[:, idx])
+                  for k, v in d.layers.items()}
+        d = d.replace(X=Xs, var=var_d, varm=varm, layers=layers)
+    if log:
+        d = apply("normalize.log1p", d, backend=backend)
+    d = apply("normalize.scale", d, max_value=None, backend=backend)
+    # z-scored genes flatten the spectrum's tail; as in the
+    # pearson_residuals recipe, the default 2 power iterations
+    # under-converge on whitened data — 4 is cheap insurance
+    return apply("pca.randomized", d, n_components=n_comps, n_iter=4,
+                 backend=backend)
+
+
+@register("recipe.weinreb17", backend="tpu")
+def recipe_weinreb17_tpu(data: CellData, log: bool = True,
+                         mean_threshold: float = 0.01,
+                         cv_threshold: float = 2.0,
+                         n_comps: int = 50) -> CellData:
+    """One-call Weinreb et al. 2017 (SPRING) preprocessing: count
+    normalise → mean/CV gene filter → log1p → z-score → 50-PC
+    randomized PCA (see ``_weinreb17`` for the exact order)."""
+    return _weinreb17(data, "tpu", log, mean_threshold, cv_threshold,
+                      n_comps)
+
+
+@register("recipe.weinreb17", backend="cpu")
+def recipe_weinreb17_cpu(data: CellData, log: bool = True,
+                         mean_threshold: float = 0.01,
+                         cv_threshold: float = 2.0,
+                         n_comps: int = 50) -> CellData:
+    return _weinreb17(data, "cpu", log, mean_threshold, cv_threshold,
+                      n_comps)
+
+
 def pearson_residuals_pipeline(n_top_genes: int = 2000,
                                theta: float = 100.0,
                                n_components: int = 50,

@@ -87,6 +87,48 @@ def native_lib():
     return native
 
 
+def test_read_csv_text_mtx_and_dispatch(tmp_path):
+    import scipy.io
+    import scipy.sparse as sp
+
+    import sctools_tpu as sct
+
+    # csv with gene header + cell-name first column (auto-detected)
+    csv = tmp_path / "t.csv"
+    csv.write_text("g1,g2,g3\nc1,1,2,3\nc2,4,5,6\n")
+    d = sct.read_csv(str(csv))
+    assert d.n_cells == 2 and d.n_genes == 3
+    assert list(d.var["gene_name"]) == ["g1", "g2", "g3"]
+    assert list(d.obs["cell_name"]) == ["c1", "c2"]
+    np.testing.assert_array_equal(np.asarray(d.X),
+                                  [[1, 2, 3], [4, 5, 6]])
+
+    # headerless numeric csv: no names, all rows are data
+    raw = tmp_path / "r.csv"
+    raw.write_text("1,2\n3,4\n")
+    d2 = sct.read_csv(str(raw))
+    assert d2.n_cells == 2 and "gene_name" not in d2.var
+
+    # whitespace text via the dispatcher
+    txt = tmp_path / "t.txt"
+    txt.write_text("g1 g2\n1 2\n3 4\n")
+    d3 = sct.read(str(txt))
+    assert d3.n_genes == 2 and list(d3.var["gene_name"]) == ["g1", "g2"]
+
+    # generic mtx: stored as-is, transpose= flips
+    M = sp.random(5, 3, density=0.5, format="coo", random_state=0)
+    mtx = tmp_path / "m.mtx"
+    scipy.io.mmwrite(str(mtx), M)
+    d4 = sct.read_mtx(str(mtx))
+    assert (d4.n_cells, d4.n_genes) == (5, 3)
+    d5 = sct.read(str(mtx), transpose=True)
+    assert (d5.n_cells, d5.n_genes) == (3, 5)
+    np.testing.assert_allclose(d4.X.toarray(), d5.X.toarray().T)
+
+    with pytest.raises(ValueError, match="unknown extension"):
+        sct.read("file.xyz")
+
+
 def test_native_pack_matches_numpy(native_lib):
     rng = np.random.default_rng(4)
     csr = sp.random(50, 40, density=0.3, format="csr",
