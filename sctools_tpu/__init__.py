@@ -37,6 +37,8 @@ from .data.io import (from_dense, from_scipy, read, read_10x_h5,
 from .registry import Pipeline, Transform, apply, backends, names, register
 from .compat import experimental, pp, tl  # scanpy-style namespaces
 from . import pl  # scanpy-style plotting namespace (host-side)
+from . import settings as logging  # print_header/print_versions/info/hint
+from .settings import settings  # scanpy sc.settings analogue
 from . import accessors as _accessors
 from .registry import get as _registry_get
 
@@ -64,7 +66,7 @@ __version__ = "0.1.0"
 __all__ = [
     "CellData", "SparseCells", "Pipeline", "Transform", "apply", "register",
     "get", "names", "backends", "config", "configure",
-    "read", "read_csv", "read_text", "read_mtx",
+    "read", "read_csv", "read_text", "read_mtx", "settings", "logging",
     "read_h5ad", "write_h5ad", "read_10x_mtx", "read_10x_h5", "read_loom",
     "write_loom",
     "from_scipy", "from_dense",

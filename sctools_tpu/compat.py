@@ -49,6 +49,7 @@ _PP = {
     "scrublet": "qc.doublet_score",
     "recipe_zheng17": "recipe.zheng17",
     "recipe_seurat": "recipe.seurat",
+    "recipe_weinreb17": "recipe.weinreb17",
 }
 
 _TL = {

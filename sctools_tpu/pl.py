@@ -83,7 +83,21 @@ def _cat_palette(plt, n):
 
 def _finish(fig, ax, save, show, created=False):
     if save:
-        fig.savefig(save, bbox_inches="tight", dpi=150)
+        import os
+        import sys
+
+        from .settings import settings
+
+        if save is True:
+            # scanpy's bool form derives the filename from the plot
+            # kind; our caller IS the pl.<kind> function one frame up
+            kind = sys._getframe(1).f_code.co_name.lstrip("_") or "plot"
+            save = f"{kind}.{settings.file_format_figs}"
+        path = str(save)
+        if not os.path.dirname(path):  # bare name -> settings.figdir
+            os.makedirs(settings.figdir, exist_ok=True)
+            path = os.path.join(settings.figdir, path)
+        fig.savefig(path, bbox_inches="tight", dpi=settings.dpi_save)
         if created:  # saved batch plots must not accumulate in pyplot's
             import matplotlib.pyplot as plt  # global figure registry
 
@@ -156,6 +170,13 @@ def embedding(data, basis: str = "X_umap", *, color=None, ax=None,
     ax.set_title(title if title is not None else (color or name))
     ax.set_xticks([])
     ax.set_yticks([])
+    if save is True:
+        # scanpy's bool form names the file after the basis (pl.umap
+        # -> umap.pdf); the generic frame-name fallback in _finish
+        # would say "embedding" for every aliased basis
+        from .settings import settings
+
+        save = f"{name}.{settings.file_format_figs}"
     return _finish(fig, ax, save, show, created)
 
 

@@ -1,0 +1,98 @@
+"""``sct.settings`` / ``sct.logging`` — the scanpy session-config
+surface, so a switched script's first lines keep working
+(``sc.settings.verbosity = 3``, ``sc.settings.set_figure_params(...)``,
+``sc.logging.print_header()``).
+
+Capability parity: scanpy ships a module-level settings object
+consulted by its plotting and logging; the reference source was
+unavailable (/root/reference empty — SURVEY.md §0), so the public
+scanpy attribute names are the contract.  Only the attributes that
+change observable behavior HERE are live: ``figdir`` + ``dpi_save``
+feed ``sct.pl``'s save path/resolution, ``set_figure_params`` applies
+matplotlib rcParams, ``verbosity`` gates the ``info``/``hint``
+helpers.  The rest (``n_jobs``, ``autoshow``, ...) are accepted and
+stored — harness knobs other libraries read from scanpy don't apply
+to a jit-compiled TPU pipeline, and silently dropping an assignment
+would be worse than holding the value.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+class _Settings:
+    def __init__(self):
+        self.verbosity: int = 1
+        self.figdir: str = "./figures/"
+        self.file_format_figs: str = "pdf"
+        self.autoshow: bool = True
+        self.autosave: bool = False
+        self.n_jobs: int = 1
+        self.dpi: int = 80
+        self.dpi_save: int = 150
+
+    def set_figure_params(self, dpi: int = 80, dpi_save: int = 150,
+                          figsize=None, facecolor=None,
+                          frameon: bool = True, fontsize: int = 14,
+                          color_map: str | None = None,
+                          format: str = "pdf",
+                          transparent: bool = False, **_ignored):
+        """Apply scanpy's figure defaults to matplotlib rcParams (the
+        subset that exists in matplotlib; unknown scanpy-only kwargs
+        are accepted and ignored, stated here rather than hidden)."""
+        self.dpi, self.dpi_save = int(dpi), int(dpi_save)
+        self.file_format_figs = format
+        try:
+            import matplotlib as mpl
+        except ImportError:  # plotting remains optional
+            return
+        rc = {"figure.dpi": dpi, "savefig.dpi": dpi_save,
+              "savefig.transparent": transparent,
+              "font.size": fontsize, "axes.spines.top": frameon,
+              "axes.spines.right": frameon}
+        if figsize is not None:
+            rc["figure.figsize"] = figsize
+        if facecolor is not None:
+            rc["figure.facecolor"] = facecolor
+            rc["axes.facecolor"] = facecolor
+        if color_map is not None:
+            rc["image.cmap"] = color_map
+        mpl.rcParams.update(rc)
+
+
+settings = _Settings()
+
+
+def _versions() -> dict:
+    import importlib.metadata as md
+
+    out = {"python": sys.version.split()[0]}
+    for pkg in ("jax", "jaxlib", "numpy", "scipy", "h5py"):
+        try:
+            out[pkg] = md.version(pkg)
+        except md.PackageNotFoundError:
+            pass
+    return out
+
+
+def print_header(*, file=None) -> None:
+    """scanpy ``sc.logging.print_header`` analogue: one line of
+    dependency versions."""
+    vs = _versions()
+    print(" ".join(f"{k}=={v}" for k, v in vs.items()),
+          file=file or sys.stdout)
+
+
+def print_versions(*, file=None) -> None:
+    print_header(file=file)
+
+
+def info(*msg) -> None:
+    if settings.verbosity >= 2:
+        print(*msg, file=sys.stderr)
+
+
+def hint(*msg) -> None:
+    if settings.verbosity >= 3:
+        print(*msg, file=sys.stderr)

@@ -130,3 +130,49 @@ def test_pp_neighbors_uns_record():
     rec = g.uns["neighbors"]
     assert rec["params"]["n_neighbors"] == 7
     assert rec["connectivities_key"] == "connectivities"
+
+
+def test_settings_and_logging_surface(tmp_path, capsys, monkeypatch):
+    import matplotlib as mpl
+
+    import sctools_tpu as sct
+
+    monkeypatch.setattr(sct.settings, "verbosity", 3)
+    monkeypatch.setattr(sct.settings, "dpi_save", 150)
+    with mpl.rc_context():  # scope the global rcParams mutation
+        # the first lines of a switched scanpy script must work
+        sct.settings.set_figure_params(dpi=90, dpi_save=72)
+        assert sct.settings.dpi_save == 72
+        sct.logging.print_header()
+        assert "jax==" in capsys.readouterr().out
+
+        # bare-filename saves land in settings.figdir at dpi_save
+        import numpy as np
+
+        from sctools_tpu.data.dataset import CellData
+
+        d = CellData(np.ones((10, 3), np.float32),
+                     obsm={"X_umap": np.random.default_rng(0)
+                           .normal(size=(10, 2)).astype(np.float32)})
+        monkeypatch.setattr(sct.settings, "figdir",
+                            str(tmp_path / "figs"))
+        sct.pl.umap(d, show=False, save="u.png")
+        assert (tmp_path / "figs" / "u.png").exists()
+        # explicit paths are used as-is
+        sct.pl.umap(d, show=False, save=str(tmp_path / "direct.png"))
+        assert (tmp_path / "direct.png").exists()
+        # scanpy's bool form derives the name from the plot kind
+        sct.pl.umap(d, show=False, save=True)
+        assert (tmp_path / "figs" / "umap.pdf").exists()
+
+
+def test_compat_recipe_weinreb17_name():
+    import numpy as np
+
+    import sctools_tpu as sct
+    from sctools_tpu.data.synthetic import synthetic_counts
+
+    raw = synthetic_counts(150, 90, density=0.2, n_clusters=2, seed=0)
+    out = sct.pp.recipe_weinreb17(raw, backend="cpu", cv_threshold=0.5,
+                                  n_comps=5)
+    assert np.asarray(out.obsm["X_pca"]).shape == (150, 5)
