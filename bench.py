@@ -1351,8 +1351,15 @@ def main():
                 stage("atlas.skip", n_cells=n_cells,
                       reason="budget", remaining_s=round(remaining(), 1))
                 break
+            # size-aware cap: the full 1.3M materialized attempt
+            # measured ~1640 s before the flat-searchsorted datagen
+            # (~1050 s after); 600 s only ever covered the smaller
+            # ramp steps and killed 1.3M mid-pipeline (r5 session-3
+            # runs).  Wedges are the watchdog's job (240 s silence),
+            # not the cap's — the cap bounds slow-but-alive attempts.
+            default_cap = 600 if n_cells <= 524_288 else 1500
             attempt_cap = float(os.environ.get(
-                "SCTOOLS_BENCH_ATTEMPT_S", 600))
+                "SCTOOLS_BENCH_ATTEMPT_S", default_cap))
             ck_path = os.path.join(
                 os.environ.get("TMPDIR", "/tmp"),
                 f"sctools_stats_ck_{n_cells}.npz")
@@ -1405,8 +1412,13 @@ def main():
             # the materialized full-size run died: one streaming
             # attempt (regenerate per pass, ~zero steady-state HBM —
             # the round-4 probes showed generation itself is cheap)
+            # same size-aware cap as the materialized attempt: a 600 s
+            # cap can never complete the full shape it exists to rescue
+            fallback_cap = float(os.environ.get(
+                "SCTOOLS_BENCH_ATTEMPT_S",
+                600 if full <= 524_288 else 1500))
             res = run_phase(
-                "atlas", min(600.0, remaining() - 120),
+                "atlas", min(fallback_cap, remaining() - 120),
                 env_overrides={"SCTOOLS_BENCH_CELLS": str(full),
                                "SCTOOLS_BENCH_MATERIALIZE": "0",
                                **atlas_route_env})

@@ -294,6 +294,14 @@ def _refine_jit(query, cand, cand_idx, *, k, metric, qb):
     if metric == "cosine":
         q = q / jnp.maximum(jnp.linalg.norm(q, axis=1, keepdims=True), 1e-12)
         c = c / jnp.maximum(jnp.linalg.norm(c, axis=1, keepdims=True), 1e-12)
+    # NOTE (r5 session-3 measurement): at 1.3M candidates the refine
+    # pass costs ~13.9 s/chunk vs ~1.4 s at 131k.  The gather table
+    # (260 MB f32) exceeds on-chip residency, so the random row
+    # gather runs at HBM random-access rates; an explicit
+    # optimization_barrier pinning the normalised table was tried and
+    # measured to change NOTHING (19.45 s before and after), so the
+    # cost is the gather itself, not re-fused normalisation.  Kept
+    # barrier-free; a locality-aware gather is the known follow-up.
 
     def per_block(args):
         qblk, iblk = args  # (qb, d), (qb, kp); iblk may contain -1
