@@ -678,6 +678,47 @@ def run_config3(jax, src, deadline_frac=0.75):
     # this stage runs; env SCTOOLS_BENCH_KNN_REFINE).  The headline
     # selection enforces the recall@10 >= 0.99 gate downstream.
     k, refine = 15, int(config.bench_knn_refine)
+
+    # refine-gather A/B at large candidate tables (>=786k): the
+    # blocked gather was measured at ~10x its 131k wall at 1.3M (the
+    # 260 MB table leaves on-chip residency); the sorted gather is
+    # built for exactly that regime but its win is unmeasured — so
+    # measure HERE, on the first chunk, and run the loop on the
+    # winner.  Cost: ~2-3 extra chunk-walls (the blocked warmup doubles
+    # as the loop's first-call compile); at 1.3M that is ~50 s against
+    # a potential ~110 s saving — the measured-not-asserted rule this
+    # repo benches under.
+    if (refine and n >= 786_432
+            and config.knn_refine_mode == "auto"
+            and os.environ.get("SCTOOLS_TPU_REFINE_MODE") is None):
+        from sctools_tpu.ops.knn import knn_arrays
+
+        q0 = scores[:chunk]
+        ab = {}
+        try:
+            for mode in ("blocked", "sorted"):
+                config.knn_refine_mode = mode
+                i_m, _ = knn_arrays(q0, scores, k=k, metric="cosine",
+                                    n_query=chunk, n_cand=n,
+                                    refine=refine)
+                _hard_sync(i_m)  # compile + first run
+                t0 = time.time()
+                i_m, _ = knn_arrays(q0, scores, k=k, metric="cosine",
+                                    n_query=chunk, n_cand=n,
+                                    refine=refine)
+                _hard_sync(i_m)
+                ab[mode] = time.time() - t0
+        finally:
+            # a crash mid-measurement must not pin a half-validated
+            # mode on this process (the same-size retry is a fresh
+            # child, but in-process code after a caught failure would
+            # otherwise silently run the unmeasured path)
+            config.knn_refine_mode = "auto"
+        winner = min(ab, key=ab.get)
+        config.knn_refine_mode = winner
+        stage("config3.refine_ab", n_cand=n,
+              blocked_s=round(ab["blocked"], 2),
+              sorted_s=round(ab["sorted"], 2), winner=winner)
     idx_parts = []
     t_knn = time.time()
     done = 0

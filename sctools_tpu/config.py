@@ -158,6 +158,26 @@ class Config:
             return 32768 if _on_tunnel() else 0
         return v
 
+    # f32-refine GATHER strategy: "blocked" (per-query-block row
+    # gathers — fine while the candidate table fits on-chip) or
+    # "sorted" (argsort the flattened candidate ids, gather in
+    # ascending order, inverse-permute only the scores — built for
+    # tables beyond on-chip residency, where the r5 session-3
+    # measurement showed blocked refine at 1.3M costs ~10x its 131k
+    # wall).  "auto" currently means "blocked": the sorted path
+    # selects the same neighbours (scores differ only by f32
+    # reduction-order ulps; tests pin set-equality + tolerance) but
+    # its on-chip win is unmeasured — the bench A/Bs both modes at
+    # large atlas shapes and routes its chunk loop onto the measured
+    # winner, recording the decision as a stage line.
+    # Env: SCTOOLS_TPU_REFINE_MODE.
+    knn_refine_mode: str = "auto"
+
+    def resolved_refine_mode(self, n_cand: int) -> str:
+        if self.knn_refine_mode == "auto":
+            return "blocked"
+        return self.knn_refine_mode
+
     # f32-refine candidate count for the benchmarked kNN pipeline
     # (bench.py atlas path and tools/tpu_probe.py step4 — the probe
     # must compile the exact program the bench runs, so BOTH read this
@@ -196,6 +216,14 @@ if os.environ.get("SCTOOLS_STREAM_ROW_CHUNK"):
     config.stream_row_chunk = int(os.environ["SCTOOLS_STREAM_ROW_CHUNK"])
 if os.environ.get("SCTOOLS_BENCH_KNN_REFINE"):
     config.bench_knn_refine = int(os.environ["SCTOOLS_BENCH_KNN_REFINE"])
+if os.environ.get("SCTOOLS_TPU_REFINE_MODE"):
+    _rm = os.environ["SCTOOLS_TPU_REFINE_MODE"]
+    if _rm not in ("auto", "blocked", "sorted"):
+        raise ValueError(
+            f"SCTOOLS_TPU_REFINE_MODE={_rm!r}: use auto, blocked or "
+            f"sorted (an unknown value would silently run blocked "
+            f"while the artifact records the bogus name)")
+    config.knn_refine_mode = _rm
 if os.environ.get("SCTOOLS_TPU_KNN_IMPL"):
     # lets the bench orchestrator route atlas children onto the kernel
     # sweep's measured winner within the same run

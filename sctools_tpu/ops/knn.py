@@ -127,8 +127,14 @@ def knn_arrays(
         # Any refine > 0 runs the exact pass — even refine <= k still
         # re-scores the k candidates in f32 (caller asked for exact
         # distances, not just a wider search).
-        idx, dist = _refine_jit(query, cand, idx, k=k, metric=metric,
-                                qb=query_block or config.row_block)
+        mode = config.resolved_refine_mode(n_cand)
+        if mode == "sorted":
+            idx, dist = _refine_sorted_jit(query, cand, idx, k=k,
+                                           metric=metric)
+        else:
+            idx, dist = _refine_jit(query, cand, idx, k=k,
+                                    metric=metric,
+                                    qb=query_block or config.row_block)
         qvalid = jnp.arange(idx.shape[0]) < n_query
         idx = jnp.where(qvalid[:, None], idx, -1)
     return idx, dist
@@ -327,6 +333,83 @@ def _refine_jit(query, cand, cand_idx, *, k, metric, qb):
     idxs = idxs.reshape(nq_pad, k)  # -1 padding propagates via iblk
     dists = (1.0 - vals) if metric == "cosine" else jnp.sqrt(
         jnp.maximum(-vals, 0.0))
+    return idxs, dists
+
+
+@partial(jax.jit, static_argnames=("k", "metric"))
+def _refine_sorted_jit(query, cand, cand_idx, *, k, metric):
+    """Exact float32 re-rank with a LOCALITY-AWARE gather.
+
+    Semantically identical to ``_refine_jit`` — the same candidate
+    lists re-scored in f32 and the same top_k rule — with scores
+    equal up to f32 reduction-order (ulp) differences: the blocked
+    path reduces over d inside a batched einsum, this one in an
+    elementwise dot, and the two may round differently (so a top-k
+    selection can flip only between ulp-level ties).  Only the HBM
+    access pattern is the point.  Motivation (r5 session-3 measurement on a
+    v5e): at 1.3M candidates the blocked refine costs ~13.9 s/chunk —
+    the (nc, d) f32 table is 260 MB, far beyond on-chip residency, so
+    per-query-block random row gathers run at HBM random-access
+    rates.  Here the flattened candidate ids are argsorted once
+    (~4.2M int32), candidate rows are gathered in ASCENDING id order
+    (streaming-friendly, duplicate-id reads coalesce), each element's
+    score is computed against its owner query immediately (the query
+    table is small enough to gather from freely), and only the f32
+    SCORES (17 MB, not the 840 MB of gathered vectors) are scattered
+    back through the inverse permutation.
+    """
+    nq, kp = cand_idx.shape
+    d = query.shape[1]
+    q = query.astype(jnp.float32)
+    c = cand.astype(jnp.float32)
+    if metric == "cosine":
+        q = q / jnp.maximum(jnp.linalg.norm(q, axis=1, keepdims=True),
+                            1e-12)
+        c = c / jnp.maximum(jnp.linalg.norm(c, axis=1, keepdims=True),
+                            1e-12)
+    flat = cand_idx.reshape(-1)
+    # -1 padding sorts to the FRONT as-is; remap to nc so the padding
+    # gathers the (clipped) last row and sorts to the end instead —
+    # the score is masked by the original -1 below either way
+    flat_sane = jnp.where(flat < 0, c.shape[0], flat).astype(jnp.int32)
+    order = jnp.argsort(flat_sane)
+    owner = (order // kp).astype(jnp.int32)
+    sorted_ids = jnp.take(flat_sane, order)
+
+    def score_slice(args):
+        ids, own = args  # (m,), (m,)
+        g = jnp.take(c, ids, axis=0)          # ascending-id gather
+        qg = jnp.take(q, own, axis=0)         # small-table gather
+        s = jnp.einsum("md,md->m", qg, g,
+                       precision=jax.lax.Precision.HIGHEST)
+        if metric == "euclidean":
+            qn2 = jnp.sum(qg * qg, axis=1)
+            cn2 = jnp.sum(g * g, axis=1)
+            s = -(qn2 - 2.0 * s + cn2)
+        return s
+
+    n_flat = nq * kp
+    # bound the gathered-vector temp: slices of <=2^19 rows (~100 MB
+    # of (m, d) f32 at d=50) pipelined by lax.map
+    m = min(n_flat, 1 << 19)
+    n_slices = -(-n_flat // m)
+    pad = n_slices * m - n_flat
+    ids_p = jnp.concatenate(
+        [sorted_ids, jnp.zeros((pad,), jnp.int32)]) if pad else sorted_ids
+    own_p = jnp.concatenate(
+        [owner, jnp.zeros((pad,), jnp.int32)]) if pad else owner
+    s_sorted = jax.lax.map(
+        score_slice,
+        (ids_p.reshape(n_slices, m), own_p.reshape(n_slices, m)),
+    ).reshape(-1)[:n_flat]
+    # inverse-permute ONLY the scores
+    s = jnp.zeros((n_flat,), jnp.float32).at[order].set(s_sorted)
+    s = s.reshape(nq, kp)
+    s = jnp.where(cand_idx < 0, -jnp.inf, s)
+    v, sel = jax.lax.top_k(s, k)
+    idxs = jnp.take_along_axis(cand_idx, sel, axis=1)
+    dists = (1.0 - v) if metric == "cosine" else jnp.sqrt(
+        jnp.maximum(-v, 0.0))
     return idxs, dists
 
 

@@ -260,3 +260,49 @@ def test_knn_correlation_metric_matches_centered_cosine():
                       metric="cosine")
     assert recall_at_k(np.asarray(plain.obsp["knn_indices"]),
                        want) < 0.9
+
+
+def test_refine_sorted_matches_blocked_exactly():
+    """The locality-aware sorted refine is an ACCESS-PATTERN change:
+    same candidate lists, same top_k rule, scores equal up to f32
+    reduction-order noise (batched-einsum vs elementwise dot round
+    differently).  Assert per-row SET equality of the selected
+    neighbours and distance agreement to f32 tolerance — including
+    the -1 coarse-padding handling."""
+    import jax.numpy as jnp
+
+    from sctools_tpu.config import config, configure
+    from sctools_tpu.ops.knn import _refine_jit, _refine_sorted_jit
+
+    rng = np.random.default_rng(3)
+    nq, nc, d, kp, k = 256, 1024, 20, 32, 10
+    q = jnp.asarray(rng.normal(size=(nq, d)).astype(np.float32))
+    c = jnp.asarray(rng.normal(size=(nc, d)).astype(np.float32))
+    idx = rng.integers(0, nc, (nq, kp)).astype(np.int32)
+    idx[5, 20:] = -1  # coarse padding must mask identically
+    idx[17, :] = -1
+    idx = jnp.asarray(idx)
+    def assert_same(ib, db, is_, ds):
+        ib, is_ = np.asarray(ib), np.asarray(is_)
+        db, ds = np.asarray(db), np.asarray(ds)
+        for r in range(ib.shape[0]):
+            assert set(ib[r].tolist()) == set(is_[r].tolist()), r
+        np.testing.assert_allclose(np.sort(db, axis=1),
+                                   np.sort(ds, axis=1), atol=1e-5)
+
+    for metric in ("cosine", "euclidean"):
+        ib, db = _refine_jit(q, c, idx, k=k, metric=metric, qb=64)
+        is_, ds = _refine_sorted_jit(q, c, idx, k=k, metric=metric)
+        assert_same(ib, db, is_, ds)
+
+    # and through the public path via the config knob
+    with configure(knn_refine_mode="sorted"):
+        assert config.resolved_refine_mode(nc) == "sorted"
+        from sctools_tpu.ops.knn import knn_arrays
+
+        i1, d1 = knn_arrays(q, c, k=k, metric="cosine", n_query=nq,
+                            n_cand=nc, refine=kp)
+    with configure(knn_refine_mode="blocked"):
+        i0, d0 = knn_arrays(q, c, k=k, metric="cosine", n_query=nq,
+                            n_cand=nc, refine=kp)
+    assert_same(i0, d0, i1, d1)
