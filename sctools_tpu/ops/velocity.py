@@ -528,3 +528,66 @@ def fate_probabilities_tpu(data: CellData,
     """tpu backend of :func:`fate_probabilities` (device cosines)."""
     return fate_probabilities(data, terminal_key, scale, n_iter,
                               device=True)
+
+
+# ----------------------------------------------------------------------
+# velocity.lineage_drivers
+# ----------------------------------------------------------------------
+
+
+def _lineage_drivers(data: CellData, layer: str, device: bool):
+    """Per-gene Pearson correlation with each lineage's fate
+    probability across TRANSIENT cells (CellRank ``lineage_drivers``:
+    a gene whose expression tracks commitment toward a fate is a
+    candidate driver of it).  Terminal cells are excluded — their
+    one-hot fate rows would let any marker of the terminal cluster
+    masquerade as a driver of the journey there.
+
+    One centered cross-product matmul per call: corr = (Xc^T Fc)
+    / (||Xc_g|| * ||Fc_l||) — (n_genes x n_lineages) on the MXU for
+    the device path, numpy otherwise.  Adds varm["lineage_drivers"].
+    """
+    if "fate_probs" not in data.obsm:
+        raise KeyError("velocity.lineage_drivers: run "
+                       "velocity.fate_probabilities first")
+    n = data.n_cells
+    F = np.asarray(data.obsm["fate_probs"])[:n].astype(np.float32)
+    term = np.asarray(data.obs["terminal_states"])[:n].astype(int)
+    mask = term < 0  # transient cells only
+    if mask.sum() < 3:
+        raise ValueError("velocity.lineage_drivers: fewer than 3 "
+                         "transient cells")
+    if device:
+        X = _dense_layer(data, layer, jnp)
+        Xm = jnp.asarray(X)[jnp.asarray(mask)]
+        Fm = jnp.asarray(F)[jnp.asarray(mask)]
+        Xc = Xm - Xm.mean(axis=0)
+        Fc = Fm - Fm.mean(axis=0)
+        num = Xc.T @ Fc  # (g, l) — the MXU cross-product
+        den = (jnp.linalg.norm(Xc, axis=0)[:, None]
+               * jnp.linalg.norm(Fc, axis=0)[None, :])
+        corr = np.asarray(num / jnp.maximum(den, 1e-12))
+    else:
+        X = _dense_layer(data, layer, np)
+        Xm, Fm = X[mask], F[mask]
+        Xc = Xm - Xm.mean(axis=0)
+        Fc = Fm - Fm.mean(axis=0)
+        den = (np.linalg.norm(Xc, axis=0)[:, None]
+               * np.linalg.norm(Fc, axis=0)[None, :])
+        corr = (Xc.T @ Fc) / np.maximum(den, 1e-12)
+    # zero-variance genes (or a zero-variance lineage) carry no signal
+    corr = np.where(np.isfinite(corr), corr, 0.0).astype(np.float32)
+    return data.with_varm(lineage_drivers=corr)
+
+
+@register("velocity.lineage_drivers", backend="tpu")
+def lineage_drivers_tpu(data: CellData,
+                        layer: str = "Ms") -> CellData:
+    """CellRank-style driver-gene correlations (device matmul)."""
+    return _lineage_drivers(data, layer, device=True)
+
+
+@register("velocity.lineage_drivers", backend="cpu")
+def lineage_drivers_cpu(data: CellData,
+                        layer: str = "Ms") -> CellData:
+    return _lineage_drivers(data, layer, device=False)

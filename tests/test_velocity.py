@@ -197,3 +197,61 @@ def test_fate_tpu_backend_matches_cpu():
     np.testing.assert_allclose(np.asarray(fa.obsm["fate_probs"]),
                                np.asarray(fb.obsm["fate_probs"]),
                                atol=2e-3)
+
+
+def test_lineage_drivers_recovers_fate_tracking_gene():
+    """Y-flow as above, plus genes engineered so gene 0 tracks arm-A
+    commitment, gene 1 tracks arm-B, gene 2 is noise: lineage_drivers
+    must rank each tracker first for its own lineage, on both
+    backends, and exclude terminal cells from the correlation."""
+    rng = np.random.default_rng(0)
+    n_t, n_a = 100, 100
+    t_tr = np.linspace(0, 1, n_t)
+    t_ar = np.linspace(0, 1, n_a)
+    trunk = np.stack([t_tr, np.zeros(n_t)], axis=1)
+    arm_a = np.stack([1 + t_ar, t_ar], axis=1)
+    arm_b = np.stack([1 + t_ar, -t_ar], axis=1)
+    E = np.vstack([trunk, arm_a, arm_b]) + rng.normal(0, 0.02, (300, 2))
+    V = np.vstack([np.tile([1.0, 0.0], (n_t, 1)),
+                   np.tile([1.0, 1.0], (n_a, 1)) / np.sqrt(2),
+                   np.tile([1.0, -1.0], (n_a, 1)) / np.sqrt(2)])
+    d = CellData(E.astype(np.float32),
+                 obsm={"X_pca": np.asarray(
+                     np.hstack([E, rng.normal(0, 0.01, (300, 4))]),
+                     np.float32)})
+    d = d.with_layers(Ms=E.astype(np.float32),
+                      velocity=V.astype(np.float32))
+    d = d.with_var(velocity_genes=np.ones(2, bool))
+    d = sct.apply("neighbors.knn", d, backend="cpu", k=10,
+                  metric="euclidean")
+    d = sct.apply("velocity.graph", d, backend="cpu")
+    d = sct.apply("velocity.terminal_states", d, backend="cpu",
+                  quantile=0.93)
+    d = sct.apply("velocity.fate_probabilities", d, backend="cpu")
+    F = np.asarray(d.obsm["fate_probs"])
+    # which fate column is arm A (positive y among terminal cells)?
+    term = np.asarray(d.obs["terminal_states"])
+    ga = np.bincount(term[term >= 0][E[term >= 0, 1] > 0],
+                     minlength=2).argmax()
+    gene_a = F[:, ga] + rng.normal(0, 0.05, 300)
+    gene_b = F[:, 1 - ga] + rng.normal(0, 0.05, 300)
+    noise = rng.normal(0, 1.0, 300)
+    Ms = np.stack([gene_a, gene_b, noise], axis=1).astype(np.float32)
+    d = d.with_layers(Ms=Ms)
+    out_c = sct.apply("velocity.lineage_drivers", d, backend="cpu")
+    out_t = sct.apply("velocity.lineage_drivers", d, backend="tpu")
+    for out in (out_c, out_t):
+        C = np.asarray(out.varm["lineage_drivers"])
+        assert C.shape == (3, 2)
+        assert C[:, ga].argmax() == 0 and C[0, ga] > 0.6
+        assert C[:, 1 - ga].argmax() == 1 and C[1, 1 - ga] > 0.6
+        assert abs(C[2]).max() < 0.3  # noise gene is no driver
+    np.testing.assert_allclose(
+        np.asarray(out_c.varm["lineage_drivers"]),
+        np.asarray(out_t.varm["lineage_drivers"]), atol=1e-4)
+
+
+def test_lineage_drivers_requires_fate_probs():
+    d = CellData(np.ones((10, 3), np.float32))
+    with pytest.raises(KeyError, match="fate_probabilities first"):
+        sct.apply("velocity.lineage_drivers", d, backend="cpu")
