@@ -74,6 +74,16 @@ _TL = {
     "palantir": "palantir.run",
     "wishbone": "wishbone.run",
     "phenograph": "cluster.phenograph",
+    # scVelo tl.* muscle memory (scv.tl.*); tl.velocity and pp.moments
+    # need signature-aware wrappers (mode=/n_neighbors=) and are
+    # defined below, not here
+    "velocity_graph": "velocity.graph",
+    "velocity_embedding": "velocity.embedding",
+    "recover_dynamics": "velocity.recover_dynamics",
+    "latent_time": "velocity.latent_time",
+    "terminal_states": "velocity.terminal_states",
+    "fate_probabilities": "velocity.fate_probabilities",
+    "lineage_drivers": "velocity.lineage_drivers",
 }
 
 _EXPERIMENTAL_PP = {
@@ -151,6 +161,37 @@ def _neighbors(data, backend: str = "tpu", k: int = 15,
     })
 
 
+def _moments(data, backend: str = "tpu", n_neighbors: int | None = None,
+             n_pcs: int | None = None, metric: str = "cosine"):
+    """scVelo ``pp.moments``: the canonical tutorial call passes
+    ``n_pcs=``/``n_neighbors=`` and expects the neighbor graph to be
+    (re)built first — compose pca/kNN accordingly, then smooth.
+    Without those kwargs, the existing graph is used as-is."""
+    if n_pcs is not None:
+        data = apply("pca.randomized", data, backend=backend,
+                     n_components=n_pcs)
+    if n_neighbors is not None or "knn_indices" not in data.obsp:
+        data = apply("neighbors.knn", data, backend=backend,
+                     k=n_neighbors or 30, metric=metric)
+    return apply("velocity.moments", data, backend=backend)
+
+
+def _velocity(data, backend: str = "tpu", mode: str = "steady_state",
+              **kw):
+    """scVelo ``tl.velocity``: ``mode=`` routes between the
+    steady-state fit and the dynamical model (scVelo's
+    'deterministic'/'stochastic' both map to the steady-state op — the
+    second-moment refinement is a documented omission)."""
+    if mode == "dynamical":
+        return apply("velocity.recover_dynamics", data,
+                     backend=backend, **kw)
+    if mode in ("steady_state", "deterministic", "stochastic"):
+        return apply("velocity.estimate", data, backend=backend, **kw)
+    raise ValueError(
+        f"tl.velocity: unknown mode {mode!r} (use 'steady_state', "
+        f"'deterministic', 'stochastic' or 'dynamical')")
+
+
 def _experimental_hvg(data, backend: str = "tpu", **kw):
     """scanpy ``experimental.pp.highly_variable_genes`` (pearson
     residuals flavor by default)."""
@@ -161,11 +202,13 @@ def _experimental_hvg(data, backend: str = "tpu", **kw):
 pp = SimpleNamespace(
     calculate_qc_metrics=_calculate_qc_metrics,
     neighbors=_neighbors,
+    moments=_moments,
     **{name: _wrap(name, op, _ALIASES.get(name))
        for name, op in _PP.items()},
 )
 
 tl = SimpleNamespace(
+    velocity=_velocity,
     **{name: _wrap(name, op, _ALIASES.get(name))
        for name, op in _TL.items()},
 )

@@ -643,8 +643,9 @@ def _dyn_fit_gene(u, s, slope, n_outer=40, n_inner=5, n_grid=64,
     distance at the assigned times.  Everything is fixed-iteration
     ``lax.scan`` — no data-dependent control flow.
 
-    Returns (params, t_cells, r2): params = (α, β, γ, t_switch,
-    fit_scaling) — FIVE entries — in NORMALISED units (u, s scaled to
+    Returns (params, t_cells, r2): params = (α, β, γ, t_switch_ecdf,
+    fit_scaling, t_switch_geometric) — SIX entries — in NORMALISED
+    units (u, s scaled to
     ~unit 99th percentile, t in [0, 1] — absolute time is not
     identifiable from one snapshot, so the latent-time scale is fixed
     instead of the rates; fit_scaling is the u measurement scale,
@@ -732,8 +733,12 @@ def _dyn_fit_gene(u, s, slope, n_outer=40, n_inner=5, n_grid=64,
               .astype(jnp.float32)) / n_c
     ts_ecdf = (jnp.searchsorted(t_sorted, ts, side="right")
                .astype(jnp.float32)) / n_c
+    # keep BOTH switch times: the ECDF-warped one lives on the same
+    # scale as the reported cell times; the GEOMETRIC one is what
+    # _dyn_traj needs to reconstruct the fitted curve (pl.velocity) —
+    # review caught the warped value being fed back into the ODE
     return (jnp.stack([jnp.exp(la), jnp.exp(lb), jnp.exp(lg), ts_ecdf,
-                       jnp.exp(lc)]),
+                       jnp.exp(lc), ts]),
             t_ecdf, r2)
 
 
@@ -766,7 +771,9 @@ def recover_dynamics(data: CellData, min_r2: float = 0.3,
     (c) no per-cell likelihood variances (scVelo's fit_std_u/s).
 
     Needs layers["Ms"]/["Mu"] (run velocity.moments first).  Adds
-    var["fit_alpha"/"fit_beta"/"fit_gamma"/"fit_t_switch"/"fit_r2"],
+    var["fit_alpha"/"fit_beta"/"fit_gamma"/"fit_t_switch" (ECDF
+    scale) / "fit_t_switch_geo" (ODE scale, for curve
+    reconstruction) / "fit_scaling"/"fit_r2"],
     layers["fit_t"] (per-cell per-gene latent time),
     layers["velocity"] = β·u − γ·s in NORMALISED units (feeds
     velocity.graph unchanged), var["velocity_genes"] = fit_r2 gate,
@@ -788,7 +795,7 @@ def recover_dynamics(data: CellData, min_r2: float = 0.3,
     params = np.asarray(params)
     t_cells = np.asarray(t_cells).T  # (n, g)
     r2 = np.asarray(r2)
-    alpha, beta, gamma, t_sw, scal = params.T
+    alpha, beta, gamma, t_sw, scal, t_sw_geo = params.T
     # ds/dt in RAW Ms units (velocity.graph cosines mix this with raw
     # Ms displacements — per-gene-normalised units would silently
     # reweight every gene by 1/ss in the graph): the normalised-space
@@ -805,6 +812,7 @@ def recover_dynamics(data: CellData, min_r2: float = 0.3,
         fit_beta=beta.astype(np.float32),
         fit_gamma=gamma.astype(np.float32),
         fit_t_switch=t_sw.astype(np.float32),
+        fit_t_switch_geo=t_sw_geo.astype(np.float32),
         fit_scaling=scal.astype(np.float32),
         fit_r2=r2.astype(np.float32),
         velocity_gamma=gamma_slope,

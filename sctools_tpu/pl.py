@@ -543,3 +543,92 @@ def velocity_embedding(data, basis: str = "umap", *, scale: float = 1.0,
               scale_units="xy", scale=1.0 / max(scale, 1e-12),
               width=0.002, color="k", alpha=0.7)
     return _finish(ax.figure, ax, save, show)
+
+
+def velocity(data, var_names, *, ncols: int = 4, color: str | None = None,
+             save=None, show=None):
+    """Per-gene (spliced, unspliced) phase portraits (scVelo
+    ``pl.velocity``): Ms-vs-Mu scatter, the steady-state line from
+    ``var['velocity_gamma']``, and — when ``velocity.recover_dynamics``
+    has run — the fitted dynamical trajectory (drawn from the stored
+    fit_* parameters through the same closed form the fit used,
+    un-normalised back to raw layer units)."""
+    plt = _plt()
+    if "Ms" not in data.layers or "Mu" not in data.layers:
+        raise KeyError("pl.velocity: layers need Ms/Mu — run "
+                       "velocity.moments first")
+    if isinstance(var_names, (str, int)):
+        var_names = [var_names]
+    gene_names = (np.asarray(data.var["gene_name"])
+                  if "gene_name" in data.var else None)
+
+    def gene_index(v):
+        if isinstance(v, (int, np.integer)):
+            return int(v)
+        if gene_names is None:
+            raise KeyError(f"pl.velocity: no var['gene_name'] to "
+                           f"resolve {v!r}; pass integer indices")
+        hit = np.flatnonzero(gene_names == v)
+        if not len(hit):
+            raise KeyError(f"pl.velocity: unknown gene {v!r}")
+        return int(hit[0])
+
+    idx = [gene_index(v) for v in var_names]
+    n = data.n_cells
+    Ms = np.asarray(data.layers["Ms"], np.float32)[:n]
+    Mu = np.asarray(data.layers["Mu"], np.float32)[:n]
+    cvals = None
+    if color is not None:
+        cvals, cat = _resolve_color(data, color)
+        if cat:  # categorical -> integer codes for a cmap
+            cvals = np.unique(cvals, return_inverse=True)[1]
+    has_fit = "fit_alpha" in data.var
+    ncols = min(ncols, len(idx))
+    nrows = -(-len(idx) // ncols)
+    fig, axes = plt.subplots(nrows, ncols, squeeze=False,
+                             figsize=(2.8 * ncols, 2.6 * nrows))
+    for pi, j in enumerate(idx):
+        ax = axes[pi // ncols][pi % ncols]
+        s, u = Ms[:, j], Mu[:, j]
+        ax.scatter(s, u, s=4, c=(cvals if cvals is not None
+                                 else "tab:blue"),
+                   cmap="viridis", alpha=0.6, linewidths=0)
+        if "velocity_gamma" in data.var:
+            g = float(np.asarray(data.var["velocity_gamma"])[j])
+            xs = np.linspace(0.0, max(s.max(), 1e-9), 32)
+            ax.plot(xs, g * xs, "k--", lw=1, alpha=0.8)
+        if has_fit:
+            import jax.numpy as jnp
+
+            from .ops.velocity import _dyn_traj
+
+            var = data.var
+            la = np.log(max(float(np.asarray(var["fit_alpha"])[j]),
+                            1e-12))
+            lb = np.log(max(float(np.asarray(var["fit_beta"])[j]),
+                            1e-12))
+            lg = np.log(max(float(np.asarray(var["fit_gamma"])[j]),
+                            1e-12))
+            # the GEOMETRIC switch time — fit_t_switch is ECDF-warped
+            # onto the uniform cell-time scale and does not
+            # parameterise the ODE
+            ts = float(np.asarray(var["fit_t_switch_geo"])[j])
+            c = float(np.asarray(var["fit_scaling"])[j])
+            tg = jnp.linspace(0.0, 1.0, 200)
+            ut, st = _dyn_traj(la, lb, lg, ts, tg)
+            # back to raw units: the fit saw u/su99 = c·u_ode,
+            # s/ss99 = s_ode
+            su = max(float(np.percentile(u, 99)), 1e-6)
+            ss = max(float(np.percentile(s, 99)), 1e-6)
+            ax.plot(np.asarray(st) * ss, np.asarray(ut) * c * su,
+                    color="purple", lw=1.5, alpha=0.9)
+        title = (str(gene_names[j]) if gene_names is not None
+                 else f"gene {j}")
+        ax.set_title(title, fontsize=9)
+        ax.set_xlabel("Ms (spliced)", fontsize=8)
+        if pi % ncols == 0:
+            ax.set_ylabel("Mu (unspliced)", fontsize=8)
+    for pi in range(len(idx), nrows * ncols):
+        axes[pi // ncols][pi % ncols].axis("off")
+    fig.tight_layout()
+    return _finish(fig, axes, save, show, created=True)

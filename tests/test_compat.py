@@ -176,3 +176,30 @@ def test_compat_recipe_weinreb17_name():
     out = sct.pp.recipe_weinreb17(raw, backend="cpu", cv_threshold=0.5,
                                   n_comps=5)
     assert np.asarray(out.obsm["X_pca"]).shape == (150, 5)
+
+
+def test_scvelo_signature_wrappers():
+    """The literal tutorial calls must work: pp.moments(d, n_pcs=,
+    n_neighbors=) and tl.velocity(d, mode='dynamical')."""
+    import numpy as np
+
+    import sctools_tpu as sct
+    from sctools_tpu.data.dataset import CellData
+
+    rng = np.random.default_rng(0)
+    n, g = 150, 6
+    t = rng.uniform(0, 1, n).astype(np.float32)
+    S = (np.abs(rng.normal(1, 0.2, (n, g))) * t[:, None]).astype(
+        np.float32)
+    U = (np.abs(rng.normal(1, 0.2, (n, g))) * (1 - t)[:, None]).astype(
+        np.float32)
+    d = CellData(S).with_layers(spliced=S, unspliced=U)
+    d = sct.pp.moments(d, backend="cpu", n_pcs=4, n_neighbors=10)
+    assert "Ms" in d.layers and "X_pca" in d.obsm
+    d2 = sct.tl.velocity(d, backend="cpu", min_r2=-10)
+    assert "velocity" in d2.layers
+    d3 = sct.tl.velocity(d, backend="cpu", mode="dynamical",
+                         n_outer=5, min_r2=-10)
+    assert "fit_alpha" in d3.var
+    with pytest.raises(ValueError, match="unknown mode"):
+        sct.tl.velocity(d, backend="cpu", mode="nope")

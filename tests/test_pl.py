@@ -10,6 +10,7 @@ import numpy as np
 import pytest
 
 import sctools_tpu as sct
+from sctools_tpu.data.dataset import CellData
 from sctools_tpu.data.synthetic import synthetic_counts
 
 
@@ -148,3 +149,31 @@ def test_save_closes_created_figures(workflow, tmp_path):
         sct.pl.umap(workflow, color="leiden",
                     save=tmp_path / f"u{i}.png")
     assert plt.get_fignums() == before  # no figure leak
+
+
+def test_velocity_phase_portraits(tmp_path):
+    rng = np.random.default_rng(0)
+    n, g = 120, 4
+    t = rng.uniform(0, 1, n).astype(np.float32)
+    S = (np.abs(rng.normal(1, 0.2, (n, g))) * t[:, None]).astype(
+        np.float32)
+    U = (np.abs(rng.normal(1, 0.2, (n, g))) * (1 - t)[:, None]).astype(
+        np.float32)
+    d = CellData(S, var={"gene_name": np.array(
+        [f"G{i}" for i in range(g)])})
+    d = d.with_layers(Ms=S, Mu=U)
+    d = d.with_obs(pt=t)
+    d = sct.apply("velocity.estimate", d, backend="cpu", min_r2=-10)
+    axes = sct.pl.velocity(d, ["G0", "G2"], color="pt",
+                           save=tmp_path / "vel.png", show=False)
+    assert axes.shape == (1, 2)
+    assert (tmp_path / "vel.png").exists()
+    # with the dynamical fit present, the trajectory overlay draws too
+    d = sct.apply("velocity.recover_dynamics", d, backend="cpu",
+                  n_outer=5, min_r2=-10)
+    sct.pl.velocity(d, [0, 1, 2, 3], ncols=2,
+                    save=tmp_path / "vel_fit.png", show=False)
+    assert (tmp_path / "vel_fit.png").exists()
+
+    with pytest.raises(KeyError, match="unknown gene"):
+        sct.pl.velocity(d, ["NOPE"])
