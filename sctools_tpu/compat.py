@@ -170,7 +170,10 @@ def _moments(data, backend: str = "tpu", n_neighbors: int | None = None,
     if n_pcs is not None:
         data = apply("pca.randomized", data, backend=backend,
                      n_components=n_pcs)
-    if n_neighbors is not None or "knn_indices" not in data.obsp:
+    if (n_neighbors is not None or n_pcs is not None
+            or "knn_indices" not in data.obsp):
+        # n_pcs alone must ALSO rebuild the graph: smoothing over a
+        # kNN built on the old embedding would be silently stale
         data = apply("neighbors.knn", data, backend=backend,
                      k=n_neighbors or 30, metric=metric)
     return apply("velocity.moments", data, backend=backend)
@@ -179,13 +182,16 @@ def _moments(data, backend: str = "tpu", n_neighbors: int | None = None,
 def _velocity(data, backend: str = "tpu", mode: str = "steady_state",
               **kw):
     """scVelo ``tl.velocity``: ``mode=`` routes between the
-    steady-state fit and the dynamical model (scVelo's
-    'deterministic'/'stochastic' both map to the steady-state op — the
-    second-moment refinement is a documented omission)."""
+    steady-state γ fit ('steady_state'/'deterministic'), the
+    second-moment stacked fit ('stochastic' — scVelo's default), and
+    the dynamical ODE model ('dynamical')."""
     if mode == "dynamical":
         return apply("velocity.recover_dynamics", data,
                      backend=backend, **kw)
-    if mode in ("steady_state", "deterministic", "stochastic"):
+    if mode == "stochastic":
+        return apply("velocity.estimate", data, backend=backend,
+                     mode="stochastic", **kw)
+    if mode in ("steady_state", "deterministic"):
         return apply("velocity.estimate", data, backend=backend, **kw)
     raise ValueError(
         f"tl.velocity: unknown mode {mode!r} (use 'steady_state', "
@@ -217,3 +223,26 @@ experimental = SimpleNamespace(pp=SimpleNamespace(
     highly_variable_genes=_experimental_hvg,
     **{name: _wrap(name, op) for name, op in _EXPERIMENTAL_PP.items()},
 ))
+
+# scanpy.external (``import scanpy.external as sce``) entry points —
+# the third-party tools scanpy wraps that this framework implements
+# natively.  Same thin-_wrap contract as pp/tl.
+_EXTERNAL_PP = {
+    "harmony_integrate": "integrate.harmony",
+    "mnn_correct": "integrate.mnn",
+    "bbknn": "neighbors.bbknn",
+    "magic": "impute.magic",
+    "scrublet": "qc.doublet_score",
+}
+_EXTERNAL_TL = {
+    "phenograph": "cluster.phenograph",
+    "palantir": "palantir.run",
+    "wishbone": "wishbone.run",
+    "phate": "embed.phate",
+}
+external = SimpleNamespace(
+    pp=SimpleNamespace(**{name: _wrap(name, op)
+                          for name, op in _EXTERNAL_PP.items()}),
+    tl=SimpleNamespace(**{name: _wrap(name, op)
+                          for name, op in _EXTERNAL_TL.items()}),
+)

@@ -577,12 +577,24 @@ def velocity(data, var_names, *, ncols: int = 4, color: str | None = None,
     n = data.n_cells
     Ms = np.asarray(data.layers["Ms"], np.float32)[:n]
     Mu = np.asarray(data.layers["Mu"], np.float32)[:n]
+    plt_colors = None
+    legend_handles = None
     cvals = None
     if color is not None:
         cvals, cat = _resolve_color(data, color)
-        if cat:  # categorical -> integer codes for a cmap
-            cvals = np.unique(cvals, return_inverse=True)[1]
-    has_fit = "fit_alpha" in data.var
+        if cat:  # per-level palette + legend, same as pl.embedding
+            levels, codes = np.unique(cvals, return_inverse=True)
+            pal = _cat_palette(plt, len(levels))
+            plt_colors = np.asarray(pal)[codes]
+            legend_handles = [
+                plt.Line2D([], [], marker="o", ls="", color=pal[i],
+                           label=str(lev))
+                for i, lev in enumerate(levels)]
+            cvals = None
+    # the ODE-scale switch time is required to redraw the curve; fits
+    # saved before it existed fall back to the steady-state line only
+    has_fit = ("fit_alpha" in data.var
+               and "fit_t_switch_geo" in data.var)
     ncols = min(ncols, len(idx))
     nrows = -(-len(idx) // ncols)
     fig, axes = plt.subplots(nrows, ncols, squeeze=False,
@@ -590,9 +602,16 @@ def velocity(data, var_names, *, ncols: int = 4, color: str | None = None,
     for pi, j in enumerate(idx):
         ax = axes[pi // ncols][pi % ncols]
         s, u = Ms[:, j], Mu[:, j]
-        ax.scatter(s, u, s=4, c=(cvals if cvals is not None
-                                 else "tab:blue"),
-                   cmap="viridis", alpha=0.6, linewidths=0)
+        if plt_colors is not None:
+            ax.scatter(s, u, s=4, c=plt_colors, alpha=0.6,
+                       linewidths=0)
+            if pi == 0 and legend_handles:
+                ax.legend(handles=legend_handles, fontsize=6,
+                          frameon=False, loc="best")
+        else:
+            ax.scatter(s, u, s=4, c=(cvals if cvals is not None
+                                     else "tab:blue"),
+                       cmap="viridis", alpha=0.6, linewidths=0)
         if "velocity_gamma" in data.var:
             g = float(np.asarray(data.var["velocity_gamma"])[j])
             xs = np.linspace(0.0, max(s.max(), 1e-9), 32)

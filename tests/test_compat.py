@@ -203,3 +203,25 @@ def test_scvelo_signature_wrappers():
     assert "fit_alpha" in d3.var
     with pytest.raises(ValueError, match="unknown mode"):
         sct.tl.velocity(d, backend="cpu", mode="nope")
+
+
+def test_external_namespace():
+    """scanpy.external entry points (sce.pp.* / sce.tl.*) resolve to
+    the native implementations."""
+    import sctools_tpu as sct
+    from sctools_tpu.compat import _EXTERNAL_PP, _EXTERNAL_TL
+
+    registered = set(sct.names())
+    for table, ns in ((_EXTERNAL_PP, sct.external.pp),
+                      (_EXTERNAL_TL, sct.external.tl)):
+        for name, op in table.items():
+            assert op in registered, (name, op)
+            assert callable(getattr(ns, name))
+
+    d = synthetic_counts(200, 120, density=0.15, n_clusters=2, seed=5)
+    d = sct.pp.normalize_total(d, backend="cpu")
+    d = sct.pp.log1p(d, backend="cpu")
+    d = sct.pp.pca(d, backend="cpu", n_components=8)
+    d = sct.pp.neighbors(d, backend="cpu", k=8)
+    out = sct.external.tl.phenograph(d, backend="cpu")
+    assert "phenograph" in out.obs

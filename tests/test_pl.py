@@ -177,3 +177,22 @@ def test_velocity_phase_portraits(tmp_path):
 
     with pytest.raises(KeyError, match="unknown gene"):
         sct.pl.velocity(d, ["NOPE"])
+
+
+def test_velocity_portrait_categorical_color_and_legacy_fit(tmp_path):
+    rng = np.random.default_rng(2)
+    n, g = 80, 3
+    S = np.abs(rng.normal(1, 0.3, (n, g))).astype(np.float32)
+    U = np.abs(rng.normal(0.5, 0.2, (n, g))).astype(np.float32)
+    d = CellData(S).with_layers(Ms=S, Mu=U)
+    d = d.with_obs(grp=np.array(["a", "b"])[np.arange(n) % 2])
+    # categorical color draws per-level palette without error
+    sct.pl.velocity(d, [0, 1], color="grp",
+                    save=tmp_path / "cat.png", show=False)
+    assert (tmp_path / "cat.png").exists()
+    # a legacy fit WITHOUT fit_t_switch_geo must fall back to the
+    # steady-state-line-only portrait, not KeyError
+    d2 = d.with_var(fit_alpha=np.ones(g, np.float32),
+                    velocity_gamma=np.full(g, 0.5, np.float32))
+    sct.pl.velocity(d2, [0], save=tmp_path / "legacy.png", show=False)
+    assert (tmp_path / "legacy.png").exists()

@@ -361,3 +361,54 @@ def test_latent_time_requires_dynamics():
     d = CellData(np.ones((10, 3), np.float32))
     with pytest.raises(KeyError, match="recover_dynamics first"):
         sct.apply("velocity.latent_time", d, backend="cpu")
+
+
+def test_stochastic_mode_on_pooled_steady_state():
+    """Stationary Poisson cells whose moment layers are k=30-pooled
+    estimates (what velocity.moments' kNN smoothing produces): the
+    stacked GLS stochastic fit must recover gamma/beta and stay
+    within ~1.5x of the deterministic error — measured behaviour,
+    stated as such in the op: on iid-pooled data the deterministic
+    estimator is already efficient, the stochastic mode exists for
+    scVelo-default parity."""
+    rng = np.random.default_rng(0)
+    n, g, k = 2000, 5, 30
+    ub = 0.5
+    ratios = np.linspace(0.4, 1.2, g).astype(np.float32)
+    U = rng.poisson(ub, (n, k, g)).astype(np.float32)
+    S = rng.poisson(ub / ratios[None, None, :],
+                    (n, k, g)).astype(np.float32)
+    d = CellData(S.mean(1))
+    d = d.with_layers(Ms=S.mean(1), Mu=U.mean(1),
+                      Mss=(S * S).mean(1), Mus=(U * S).mean(1))
+    det = sct.apply("velocity.estimate", d, backend="cpu",
+                    quantile=1.0, min_r2=-10)
+    sto = sct.apply("velocity.estimate", d, backend="cpu",
+                    quantile=1.0, min_r2=-10, mode="stochastic")
+    g_det = np.asarray(det.var["velocity_gamma"])
+    g_sto = np.asarray(sto.var["velocity_gamma"])
+    err_det = np.abs(g_det / ratios - 1).mean()
+    err_sto = np.abs(g_sto / ratios - 1).mean()
+    assert err_sto < 0.2, (g_sto, ratios)
+    assert err_sto < 1.8 * err_det + 0.02, (err_sto, err_det)
+    # tpu backend agrees
+    sto_t = sct.apply("velocity.estimate", d, backend="tpu",
+                      quantile=1.0, min_r2=-10, mode="stochastic")
+    np.testing.assert_allclose(
+        np.asarray(sto_t.var["velocity_gamma"]), g_sto, rtol=1e-3)
+
+
+def test_stochastic_mode_computes_second_moments_if_missing():
+    rng = np.random.default_rng(1)
+    n, g = 200, 4
+    S = rng.poisson(2.0, (n, g)).astype(np.float32)
+    U = rng.poisson(1.0, (n, g)).astype(np.float32)
+    d = CellData(S, obsm={"X_pca": rng.normal(
+        0, 1, (n, 4)).astype(np.float32)})
+    d = d.with_layers(spliced=S, unspliced=U)
+    d = sct.apply("neighbors.knn", d, backend="cpu", k=8,
+                  metric="euclidean")
+    out = sct.tl.velocity(d, backend="cpu", mode="stochastic",
+                          min_r2=-10)
+    assert "Mss" in out.layers and "Mus" in out.layers
+    assert "velocity" in out.layers
