@@ -688,7 +688,8 @@ def run_config3(jax, src, deadline_frac=0.75):
     # as the loop's first-call compile); at 1.3M that is ~50 s against
     # a potential ~110 s saving — the measured-not-asserted rule this
     # repo benches under.
-    if (refine and n >= 786_432
+    ab_min = int(os.environ.get("SCTOOLS_BENCH_REFINE_AB_MIN", 786_432))
+    if (refine and n >= ab_min
             and config.knn_refine_mode == "auto"
             and os.environ.get("SCTOOLS_TPU_REFINE_MODE") is None):
         from sctools_tpu.ops.knn import knn_arrays
