@@ -26,6 +26,17 @@ from ..data.sparse import SparseCells, gene_sum, row_sum, spmm, spmm_t
 from ..registry import register
 
 
+def _warn_if_narrowed(n_components: int, data) -> None:
+    lim = min(data.n_cells, data.n_genes)
+    if n_components > lim:
+        import warnings
+
+        warnings.warn(
+            f"pca.randomized: n_components={n_components} exceeds "
+            f"min(n_cells, n_genes)={lim}; returning {lim} components",
+            stacklevel=3)
+
+
 def _center_matvec(X, mu, V):
     """(X - 1 μᵀ) @ V with padded rows forced to zero."""
     if isinstance(X, SparseCells):
@@ -92,7 +103,12 @@ def randomized_pca_arrays(X, key, n_components: int = 50, oversample: int = 10,
     """
     G = X.n_genes if isinstance(X, SparseCells) else X.shape[1]
     n = X.n_cells if isinstance(X, SparseCells) else X.shape[0]
-    L = n_components + oversample
+    # the sketch cannot be wider than the matrix: L > min(n, G) makes
+    # the Gram matrix singular and CholeskyQR2 returns NaN scores
+    # (found via a 14-gene velocity fixture whose NaNs silently
+    # flipped a downstream terminal-state call)
+    L = min(n_components + oversample, G, n)
+    k = min(n_components, L)
     dtype = X.data.dtype if isinstance(X, SparseCells) else X.dtype
     mu = _gene_mean(X) if center else jnp.zeros((G,), dtype)
 
@@ -106,7 +122,6 @@ def randomized_pca_arrays(X, key, n_components: int = 50, oversample: int = 10,
         Q = _orthonormalize(Y, qr_method)
     B = _center_rmatvec(X, mu, Q).T  # (L, G)
     U_b, S, Vt = jnp.linalg.svd(B, full_matrices=False)
-    k = n_components
     scores = (Q @ U_b[:, :k]) * S[:k]
     components = Vt[:k].T  # (G, k)
     explained = (S[:k] ** 2) / max(n - 1, 1)
@@ -118,7 +133,10 @@ def pca_randomized_tpu(data: CellData, n_components: int = 50,
                        oversample: int = 10, n_iter: int = 2,
                        center: bool = True, seed: int = 0,
                        qr_method: str = "cholesky") -> CellData:
-    """Adds obsm["X_pca"], varm["PCs"], uns["pca_explained_variance"]."""
+    """Adds obsm["X_pca"], varm["PCs"], uns["pca_explained_variance"].
+    Requesting more components than min(n_cells, n_genes) returns the
+    achievable width with a warning (the sketch clamp below)."""
+    _warn_if_narrowed(n_components, data)
     key = jax.random.PRNGKey(seed)
     scores, comps, expl, mu = randomized_pca_arrays(
         data.X, key, n_components=n_components, oversample=oversample,
@@ -135,10 +153,16 @@ def pca_randomized_cpu(data: CellData, n_components: int = 50,
                        center: bool = True, seed: int = 0) -> CellData:
     import scipy.sparse as sp
 
+    _warn_if_narrowed(n_components, data)
+
     X = data.X
     rng = np.random.default_rng(seed)
     n, G = X.shape
-    L = n_components + oversample
+    # same sketch-width clamp as the tpu path (L > min(n, G) is
+    # rank-deficient; np.linalg.qr tolerates it but the trailing
+    # components are garbage directions)
+    L = min(n_components + oversample, G, n)
+    k = min(n_components, L)
     if sp.issparse(X):
         mu = np.asarray(X.mean(axis=0)).ravel() if center else np.zeros(G)
         mv = lambda V: X @ V - np.outer(np.ones(n), mu @ V)
@@ -155,7 +179,6 @@ def pca_randomized_cpu(data: CellData, n_components: int = 50,
         Q, _ = np.linalg.qr(mv(Qz))
     B = rmv(Q).T
     U_b, S, Vt = np.linalg.svd(B, full_matrices=False)
-    k = n_components
     scores = (Q @ U_b[:, :k]) * S[:k]
     comps = Vt[:k].T
     expl = (S[:k] ** 2) / max(n - 1, 1)

@@ -11,8 +11,9 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 @pytest.mark.parametrize("script", ["pbmc_workflow.py",
                                     "integration_workflow.py",
-                                    "scanpy_switch.py"])
-def test_example_runs(script):
+                                    "scanpy_switch.py",
+                                    "velocity_workflow.py"])
+def test_example_runs(script, tmp_path):
     # PYTHONPATH is REPLACED, not appended: the session's PYTHONPATH
     # carries the axon sitecustomize that registers the TPU-tunnel
     # plugin at interpreter startup — with the tunnel down the child
@@ -22,8 +23,11 @@ def test_example_runs(script):
     # single-device doc run).
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_ROOT)
     env.pop("XLA_FLAGS", None)
+    # cwd=tmp_path: scripts that save figures (settings.figdir is
+    # CWD-relative) must not dirty the repo checkout on every run
     p = subprocess.run(
         [sys.executable, os.path.join(_ROOT, "examples", script)],
-        capture_output=True, text=True, timeout=900, env=env)
+        capture_output=True, text=True, timeout=900, env=env,
+        cwd=str(tmp_path))
     assert p.returncode == 0, p.stderr[-2000:]
     assert "OK" in p.stdout or "done" in p.stdout.lower()

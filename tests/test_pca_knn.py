@@ -306,3 +306,24 @@ def test_refine_sorted_matches_blocked_exactly():
         i0, d0 = knn_arrays(q, c, k=k, metric="cosine", n_query=nq,
                             n_cand=nc, refine=kp)
     assert_same(i0, d0, i1, d1)
+
+
+def test_randomized_pca_sketch_wider_than_features():
+    """n_components + oversample > n_genes must clamp the sketch, not
+    Cholesky a singular Gram matrix into NaN scores (found via a
+    14-gene velocity fixture whose NaNs silently flipped a
+    terminal-state call downstream)."""
+    from sctools_tpu.data.dataset import CellData
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(0, 1, (200, 14)).astype(np.float32)
+    for backend in ("cpu", "tpu"):
+        d = CellData(X)
+        out = sct.apply("pca.randomized", d if backend == "cpu"
+                        else d.device_put(), backend=backend,
+                        n_components=8, oversample=10)
+        P = np.asarray(out.obsm["X_pca"])
+        assert P.shape == (200, 8)
+        assert np.isfinite(P).all()
+        ev = np.asarray(out.uns["pca_explained_variance"])
+        assert np.isfinite(ev).all() and (ev >= -1e-6).all()
