@@ -37,6 +37,7 @@ _PP = {
     "subsample": "qc.subsample",
     "sample": "qc.subsample",  # scanpy >=1.10 name
     "normalize_total": "normalize.library_size",
+    "normalize_per_cell": "normalize.library_size",  # pre-1.0 scanpy name
     "log1p": "normalize.log1p",
     "scale": "normalize.scale",
     "regress_out": "normalize.regress_out",
@@ -56,6 +57,7 @@ _TL = {
     "leiden": "cluster.leiden",
     "louvain": "cluster.louvain",
     "kmeans": "cluster.kmeans",
+    "pca": "pca.randomized",  # scanpy exposes tl.pca AND pp.pca
     "dendrogram": "cluster.dendrogram",
     "umap": "embed.umap",
     "tsne": "embed.tsne",
@@ -120,6 +122,7 @@ def _wrap(scanpy_name: str, op: str, aliases: dict | None = None):
 # scanpy keyword spellings -> this package's operator keywords
 _ALIASES = {
     "highly_variable_genes": {"n_top_genes": "n_top"},
+    "normalize_per_cell": {"counts_per_cell_after": "target_sum"},
     "pca": {"n_comps": "n_components"},
     "rank_genes_groups": {"n_genes": "n_top"},
     "score_genes": {"gene_list": "genes"},
@@ -198,6 +201,117 @@ def _velocity(data, backend: str = "tpu", mode: str = "steady_state",
         f"'deterministic', 'stochastic' or 'dynamical')")
 
 
+def _filter_genes_dispersion(data, backend: str = "tpu",
+                             n_top_genes: int | None = None,
+                             min_mean: float | None = None,
+                             max_mean: float | None = None,
+                             min_disp: float | None = None,
+                             **kw):
+    """Pre-1.0 scanpy ``pp.filter_genes_dispersion``, both call forms:
+    ``n_top_genes=`` ranks by dispersion and subsets; the cutoff form
+    (``min_mean``/``max_mean``/``min_disp``) masks on the per-gene
+    mean of the input and the bin-normalised dispersion score that
+    ``hvg.select`` stores in ``var['means']``/``var['hvg_score']``
+    (the legacy analogue — this framework does not reproduce the
+    pre-1.0 log-binning byte-for-byte)."""
+    if n_top_genes is not None:
+        return apply("hvg.select", data, backend=backend,
+                     n_top=n_top_genes, flavor="dispersion",
+                     subset=True, **kw)
+    import numpy as np
+
+    scored = apply("hvg.select", data, backend=backend,
+                   flavor="dispersion", subset=False, **kw)
+    mean = np.asarray(scored.var["means"])
+    disp = np.asarray(scored.var["hvg_score"])
+    keep = np.ones(scored.n_genes, bool)
+    if min_mean is not None:
+        keep &= mean >= min_mean
+    if max_mean is not None:
+        keep &= mean <= max_mean
+    if min_disp is not None:
+        keep &= disp >= min_disp
+    if not keep.any():
+        raise ValueError("filter_genes_dispersion: no gene passes the "
+                         "cutoffs; loosen min_mean/max_mean/min_disp")
+    idx = np.flatnonzero(keep)
+    if backend == "tpu":
+        from .ops.hvg import select_genes_device
+
+        return select_genes_device(scored, idx, compact=True)
+    return scored[:, idx]
+
+
+def _scale_layers_like_x(before, after, layer_names, backend):
+    """Apply the per-cell factors that took ``before.X`` to
+    ``after.X`` onto the named layers (scVelo's filter_and_normalize
+    normalises spliced/unspliced alongside X)."""
+    import numpy as np
+
+    def row_sums(d):
+        X = d.X
+        from .data.sparse import SparseCells, row_sum
+
+        if isinstance(X, SparseCells):
+            return np.asarray(row_sum(X))[: d.n_cells]
+        if hasattr(X, "sum") and not isinstance(X, np.ndarray):
+            return np.asarray(X.sum(axis=1)).ravel()
+        return np.asarray(X).sum(axis=1)
+
+    tb = row_sums(before)
+    ta = row_sums(after)
+    fac = np.where(tb > 0, ta / np.maximum(tb, 1e-12), 1.0)
+    new = {}
+    for name in layer_names:
+        L = after.layers[name]
+        try:
+            import scipy.sparse as sp
+
+            if sp.issparse(L):
+                new[name] = (sp.diags(fac) @ L).astype(np.float32)
+                continue
+        except ImportError:  # pragma: no cover
+            pass
+        arr = np.asarray(L, np.float32) if backend == "cpu" else L
+        n = min(len(fac), arr.shape[0])
+        scaled = np.asarray(arr[:n], np.float32) * fac[:n, None]
+        if arr.shape[0] > n:  # padded device rows stay as-is
+            scaled = np.concatenate(
+                [scaled, np.asarray(arr[n:], np.float32)])
+        new[name] = scaled.astype(np.float32)
+    return after.with_layers(**new)
+
+
+def _filter_and_normalize(data, backend: str = "tpu",
+                          min_shared_counts: int = 20,
+                          n_top_genes: int | None = 2000,
+                          log: bool = True):
+    """scVelo ``pp.filter_and_normalize``: gene filter on total counts
+    (the spliced X), library-size normalisation of X AND the
+    spliced/unspliced layers (the same per-cell factors), optional HVG
+    subset, log1p on X.  Stated deviations from the published helper:
+    the gene filter uses X total counts, not spliced∩unspliced
+    'shared counts' (the layers still ride through every subset
+    aligned), and ONLY min_cells-free count filtering is applied —
+    scVelo adds no detected-cells floor here."""
+    data = apply("qc.per_gene_metrics", data, backend=backend)
+    data = apply("qc.filter_genes", data, backend=backend,
+                 min_cells=None, min_counts=min_shared_counts)
+    before = data
+    data = apply("normalize.library_size", data, backend=backend)
+    vel_layers = [n for n in ("spliced", "unspliced")
+                  if n in data.layers]
+    if vel_layers:
+        data = _scale_layers_like_x(before, data, vel_layers, backend)
+    if n_top_genes is not None:
+        data = apply("hvg.select", data, backend=backend,
+                     n_top=n_top_genes, flavor="dispersion",
+                     subset=True)
+    if log:
+        data = apply("normalize.log1p", data, backend=backend)
+    return data
+
+
 def _experimental_hvg(data, backend: str = "tpu", **kw):
     """scanpy ``experimental.pp.highly_variable_genes`` (pearson
     residuals flavor by default)."""
@@ -209,6 +323,8 @@ pp = SimpleNamespace(
     calculate_qc_metrics=_calculate_qc_metrics,
     neighbors=_neighbors,
     moments=_moments,
+    filter_genes_dispersion=_filter_genes_dispersion,
+    filter_and_normalize=_filter_and_normalize,
     **{name: _wrap(name, op, _ALIASES.get(name))
        for name, op in _PP.items()},
 )

@@ -225,3 +225,50 @@ def test_external_namespace():
     d = sct.pp.neighbors(d, backend="cpu", k=8)
     out = sct.external.tl.phenograph(d, backend="cpu")
     assert "phenograph" in out.obs
+
+
+def test_legacy_and_scvelo_preprocessing_names():
+    import numpy as np
+
+    import sctools_tpu as sct
+    from sctools_tpu.data.dataset import CellData
+
+    d = synthetic_counts(250, 200, density=0.15, n_clusters=2, seed=8)
+    # pre-1.0 scanpy spellings — including the canonical kwarg
+    n = sct.pp.normalize_per_cell(d, backend="cpu",
+                                  counts_per_cell_after=1e4)
+    assert float(np.asarray(n.X.sum(axis=1)).std()) < 1.0
+    f = sct.pp.filter_genes_dispersion(n, backend="cpu",
+                                       n_top_genes=80)
+    assert f.n_genes == 80
+    # the classic cutoff form selects a non-trivial subset
+    f2 = sct.pp.filter_genes_dispersion(n, backend="cpu",
+                                        min_mean=0.01, max_mean=50,
+                                        min_disp=0.0)
+    assert 0 < f2.n_genes < 200
+    p = sct.tl.pca(n, backend="cpu", n_comps=6)
+    assert p.obsm["X_pca"].shape[1] == 6
+
+    # scVelo's canned preprocessing keeps layers aligned through the
+    # gene subsets
+    rng = np.random.default_rng(0)
+    depth = rng.uniform(0.3, 3.0, 200)  # real per-cell depth spread
+    S = rng.poisson(depth[:, None] * 1.0,
+                    (200, 150)).astype(np.float32)
+    U = rng.poisson(depth[:, None] * 0.5,
+                    (200, 150)).astype(np.float32)
+    v = CellData(S).with_layers(spliced=S, unspliced=U)
+    out = sct.pp.filter_and_normalize(v, backend="cpu",
+                                      min_shared_counts=5,
+                                      n_top_genes=60)
+    assert out.n_genes == 60
+    assert out.layers["spliced"].shape[1] == 60
+    assert out.layers["unspliced"].shape[1] == 60
+    # the layers were library-size normalised WITH X (scVelo parity):
+    # spliced totals become near-constant across cells
+    sp_tot = np.asarray(out.layers["spliced"]).sum(axis=1)
+    # HVG subsetting reintroduces some spread; it must still be far
+    # tighter than the raw depth spread
+    raw_tot = S.sum(axis=1)
+    assert (sp_tot.std() / max(sp_tot.mean(), 1e-9)
+            < 0.5 * raw_tot.std() / raw_tot.mean())
