@@ -54,8 +54,14 @@ x = jnp.ones((128,128)); print('ALIVE', float((x@x)[0,0]), jax.devices()[0].plat
       git commit -q -m "artifacts: on-heal bench ${TS} (exit=$brc)" || true
 
     if [[ "$headline" == *'"value":'* && "$headline" != *'"value": null'* && "$headline" != *'"value":null'* ]]; then
-      say "non-null headline captured — runner done"
-      exit 0
+      # keep polling: code keeps improving between windows (r5: the
+      # flat-searchsorted datagen and the refine A/B landed AFTER the
+      # first success), so a later window should re-validate with the
+      # improved tree rather than idle.  A long cooldown keeps a
+      # healthy chip from being re-benched in a tight loop.
+      say "non-null headline captured — cooling down 3600s, then re-polling for a re-validation window"
+      sleep 3600
+      continue
     fi
     # Crash/null: the worker may be wedged for a while; cool down
     # before re-polling so we don't hammer a dying backend.
