@@ -272,3 +272,17 @@ def test_legacy_and_scvelo_preprocessing_names():
     raw_tot = S.sum(axis=1)
     assert (sp_tot.std() / max(sp_tot.mean(), 1e-9)
             < 0.5 * raw_tot.std() / raw_tot.mean())
+
+
+def test_datasets_namespace():
+    import numpy as np
+
+    import sctools_tpu as sct
+
+    b = sct.datasets.blobs(n_observations=100, n_centers=3)
+    assert b.n_cells == 100 and "blobs" in b.obs
+    assert len(np.unique(np.asarray(b.obs["blobs"]))) == 3
+    s = sct.datasets.synthetic_counts(120, 80, seed=1)
+    assert (s.n_cells, s.n_genes) == (120, 80)
+    with pytest.raises(RuntimeError, match="network"):
+        sct.datasets.pbmc3k()
