@@ -22,23 +22,26 @@ def blobs(n_variables: int = 11, n_centers: int = 5,
     ground-truth ``obs['blobs']`` cluster label."""
     rng = np.random.default_rng(random_state)
     centers = rng.normal(0.0, 5.0, (n_centers, n_variables))
-    labels = rng.integers(0, n_centers, n_observations)
+    # guaranteed coverage: every center gets ~n/k members (sampling
+    # labels independently can leave centers empty at small n), and
+    # labels are STRINGS like scanpy's blobs — ported code compares
+    # against '0'/'1'/... and int labels would silently match nothing
+    labels = rng.permutation(np.arange(n_observations) % n_centers)
     X = (centers[labels]
          + rng.normal(0.0, cluster_std,
                       (n_observations, n_variables)))
     return CellData(X.astype(np.float32),
-                    obs={"blobs": labels.astype(np.int32)})
+                    obs={"blobs": labels.astype(str)})
 
 
 def synthetic_counts(n_cells: int = 2700, n_genes: int = 3000,
-                     density: float = 0.08, n_clusters: int = 5,
-                     seed: int = 0) -> CellData:
-    """Clustered sparse count matrix (this framework's test/bench
-    generator re-exported at the datasets surface)."""
+                     **kwargs) -> CellData:
+    """Clustered sparse count matrix — a pure re-export of
+    ``data.synthetic.synthetic_counts`` (same defaults; re-stating
+    them here once silently diverged from the source of truth)."""
     from .data.synthetic import synthetic_counts as _sc
 
-    return _sc(n_cells, n_genes, density=density,
-               n_clusters=n_clusters, seed=seed)
+    return _sc(n_cells, n_genes, **kwargs)
 
 
 def pbmc3k_like(seed: int = 0) -> CellData:

@@ -275,13 +275,16 @@ def test_legacy_and_scvelo_preprocessing_names():
 
 
 def test_datasets_namespace():
-    import numpy as np
-
     import sctools_tpu as sct
 
     b = sct.datasets.blobs(n_observations=100, n_centers=3)
     assert b.n_cells == 100 and "blobs" in b.obs
-    assert len(np.unique(np.asarray(b.obs["blobs"]))) == 3
+    labels = np.asarray(b.obs["blobs"])
+    assert labels.dtype.kind == "U"  # scanpy-style string labels
+    assert set(labels) == {"0", "1", "2"}
+    # coverage guaranteed even at tiny n
+    tiny = sct.datasets.blobs(n_observations=8, n_centers=6)
+    assert len(set(np.asarray(tiny.obs["blobs"]))) == 6
     s = sct.datasets.synthetic_counts(120, 80, seed=1)
     assert (s.n_cells, s.n_genes) == (120, 80)
     with pytest.raises(RuntimeError, match="network"):
