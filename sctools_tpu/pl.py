@@ -11,12 +11,15 @@ API shape follows scanpy's ``sc.pl`` (a reference user should find the
 canonical names): ``pl.umap(adata, color="leiden")``,
 ``pl.violin(adata, ["n_genes"], groupby="leiden")``,
 ``pl.dotplot(adata, markers, groupby="leiden")``,
-``pl.rank_genes_groups(adata)``, ``pl.paga(adata)``, …  Every function
+``pl.rank_genes_groups(adata)``, ``pl.paga(adata)``,
+``pl.velocity(adata, genes)`` (phase portraits), …  Every function
 returns the matplotlib ``Axes`` and accepts ``ax=``, ``save=`` (write
-the figure to a path, closing self-created figures so batch loops
-don't accumulate) and ``show=`` (kept for scanpy call-site
-compatibility).  The one exception is ``rank_genes_groups``, which
-draws a multi-panel figure and returns the 2-D axes array (no ``ax=``).
+the figure to a path — bare names land in ``settings.figdir`` at
+``settings.dpi_save``; ``save=True`` derives the scanpy-style name —
+closing self-created figures so batch loops don't accumulate) and
+``show=`` (kept for scanpy call-site compatibility).  The exceptions
+are ``rank_genes_groups`` and ``velocity``, which draw multi-panel
+figures and return the 2-D axes array (no ``ax=``).
 """
 
 from __future__ import annotations
