@@ -38,6 +38,7 @@ from .registry import Pipeline, Transform, apply, backends, names, register
 from .compat import experimental, external, pp, tl  # scanpy-style namespaces
 from . import pl  # scanpy-style plotting namespace (host-side)
 from . import datasets  # offline sc.datasets subset
+from . import queries  # offline sc.queries subset
 from . import settings as logging  # print_header/print_versions/info/hint
 from .settings import settings  # scanpy sc.settings analogue
 from . import accessors as _accessors
@@ -71,5 +72,5 @@ __all__ = [
     "read_h5ad", "write_h5ad", "read_10x_mtx", "read_10x_h5", "read_loom",
     "write_loom",
     "from_scipy", "from_dense",
-    "pp", "tl", "experimental", "external", "pl", "datasets",
+    "pp", "tl", "experimental", "external", "pl", "datasets", "queries",
 ]

@@ -96,6 +96,40 @@ class CellData:
     def with_obs(self, **entries) -> "CellData":
         return self.replace(obs={**self.obs, **entries})
 
+    def var_names_make_unique(self, join: str = "-") -> "CellData":
+        """Deduplicate ``var['gene_name']`` by appending ``-1``,
+        ``-2``, … to repeats, keeping the first occurrence unchanged
+        (anndata ``.var_names_make_unique()`` — the call every 10x
+        read is followed by, since CellRanger references repeat gene
+        symbols).  No-op when names are absent or already unique."""
+        names = self.var.get("gene_name")
+        if names is None:
+            return self
+        names = np.asarray(names).astype(str)
+        if len(np.unique(names)) == len(names):
+            return self
+        # build as a python LIST — assigning 'A-1' into the input's
+        # fixed-width '<U1' array truncates it straight back to 'A'
+        existing = set(names.tolist())
+        seen: dict = {}
+        out: list = []
+        for nm in names:
+            k = seen.get(nm, 0)
+            if k:  # repeat: suffix with its occurrence count
+                new = f"{nm}{join}{k}"
+                # the candidate may collide with a name ANYWHERE in
+                # the array (earlier or later) or one already issued;
+                # keep bumping (anndata warns here — we resolve)
+                while new in existing or new in seen:
+                    k += 1
+                    new = f"{nm}{join}{k}"
+                out.append(new)
+                seen[new] = 1
+            else:
+                out.append(nm)
+            seen[nm] = k + 1
+        return self.with_var(gene_name=np.asarray(out))
+
     def with_var(self, **entries) -> "CellData":
         return self.replace(var={**self.var, **entries})
 

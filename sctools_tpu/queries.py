@@ -1,0 +1,74 @@
+"""``sct.queries`` — the offline-answerable subset of scanpy's
+``sc.queries``.
+
+scanpy's queries hit Ensembl BioMart over the network; this
+environment has none.  What CAN be answered offline is the question
+people actually ask these helpers: "which genes are mitochondrial" —
+the 13 protein-coding mtDNA genes are a fixed, organism-stable list,
+and the standard nomenclature prefix ("MT-" human / "mt-" mouse)
+covers the full mitochondrial transcript set in CellRanger
+references.  Anything genuinely requiring BioMart raises with the
+honest reason.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# The 13 protein-coding genes of the human mitochondrial genome
+# (HGNC symbols).  Mouse uses the same set, lowercase-prefixed.
+_MT_PROTEIN_CODING = (
+    "ND1", "ND2", "ND3", "ND4", "ND4L", "ND5", "ND6",
+    "CO1", "CO2", "CO3", "ATP6", "ATP8", "CYB",
+)
+
+
+def mitochondrial_genes(org: str = "hsapiens") -> list[str]:
+    """The 13 protein-coding mitochondrial gene symbols for human
+    (``MT-*``) or mouse (``mt-*``).  For masking rRNA/tRNA transcripts
+    too, prefer :func:`mitochondrial_mask` — the name PREFIX covers
+    the whole mt chromosome in CellRanger references."""
+    if org in ("hsapiens", "human", "hg38", "hg19"):
+        return [f"MT-{g}" for g in _MT_PROTEIN_CODING]
+    if org in ("mmusculus", "mouse", "mm10", "mm39"):
+        return [f"mt-{g.capitalize()}" for g in _MT_PROTEIN_CODING]
+    raise ValueError(
+        f"mitochondrial_genes: unknown organism {org!r} (offline "
+        f"support: hsapiens/human, mmusculus/mouse; other organisms "
+        f"need scanpy's BioMart query, which requires network)")
+
+
+def mitochondrial_mask(data, org: str = "hsapiens") -> np.ndarray:
+    """Boolean per-gene mask of mitochondrial genes — the SAME
+    implementation ``qc.per_cell_metrics`` uses (case-insensitive
+    ``MT-`` prefix, honouring a curated ``var['mito']`` column), so
+    the two can never disagree on one dataset.  ``org`` is validated
+    for API parity but doesn't change the mask: the prefix rule is
+    case-insensitive, covering human ``MT-`` and mouse ``mt-``."""
+    if org not in ("hsapiens", "human", "hg38", "hg19",
+                   "mmusculus", "mouse", "mm10", "mm39"):
+        raise ValueError(
+            f"mitochondrial_mask: unknown organism {org!r} (offline "
+            f"support: hsapiens/human, mmusculus/mouse)")
+    from .ops.qc import _mito_mask
+
+    mask = _mito_mask(data)
+    if mask is None:
+        raise KeyError("mitochondrial_mask: data has neither "
+                       "var['gene_name'] nor var['mito']")
+    return np.asarray(mask, bool)
+
+
+def _network_required(name: str):
+    def f(*a, **kw):
+        raise RuntimeError(
+            f"sct.queries.{name}: scanpy answers this via an Ensembl "
+            f"BioMart query, which needs network access this "
+            f"environment does not have")
+    f.__name__ = name
+    return f
+
+
+biomart_annotations = _network_required("biomart_annotations")
+gene_coordinates = _network_required("gene_coordinates")
+enrich = _network_required("enrich")

@@ -289,3 +289,41 @@ def test_datasets_namespace():
     assert (s.n_cells, s.n_genes) == (120, 80)
     with pytest.raises(RuntimeError, match="network"):
         sct.datasets.pbmc3k()
+
+
+def test_queries_and_var_names_make_unique():
+    import sctools_tpu as sct
+    from sctools_tpu.data.dataset import CellData
+
+    mt = sct.queries.mitochondrial_genes("human")
+    assert "MT-ND1" in mt and len(mt) == 13
+    assert sct.queries.mitochondrial_genes("mouse")[0] == "mt-Nd1"
+    with pytest.raises(RuntimeError, match="network"):
+        sct.queries.biomart_annotations("hsapiens", ["ensembl_gene_id"])
+
+    d = CellData(np.ones((4, 5), np.float32),
+                 var={"gene_name": np.array(
+                     ["A", "MT-ND1", "A", "B", "A"])})
+    u = d.var_names_make_unique()
+    names = list(np.asarray(u.var["gene_name"]))
+    assert names == ["A", "MT-ND1", "A-1", "B", "A-2"]
+    assert len(set(names)) == 5
+    # review regressions: fixed-width '<U1' input must not truncate
+    # the suffix, and a generated suffix must not steal a REAL
+    # later-occurring gene's name
+    t1 = CellData(np.ones((2, 2), np.float32),
+                  var={"gene_name": np.array(["A", "A"])})
+    assert list(np.asarray(
+        t1.var_names_make_unique().var["gene_name"])) == ["A", "A-1"]
+    t2 = CellData(np.ones((2, 3), np.float32),
+                  var={"gene_name": np.array(["A", "A", "A-1"])})
+    n2 = list(np.asarray(t2.var_names_make_unique().var["gene_name"]))
+    assert n2[0] == "A" and n2[2] == "A-1" and len(set(n2)) == 3
+    # mask helper finds the mt gene, case-insensitively (the shared
+    # qc implementation), and validates the organism
+    m = sct.queries.mitochondrial_mask(u, "human")
+    assert m.tolist() == [False, True, False, False, False]
+    with pytest.raises(ValueError, match="unknown organism"):
+        sct.queries.mitochondrial_mask(u, "Human ")
+    # unique names: no-op returns self
+    assert u.var_names_make_unique() is u
