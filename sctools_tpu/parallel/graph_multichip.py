@@ -1,0 +1,120 @@
+"""Multi-chip edge-list graph primitives: ``knn_matvec_sharded``.
+
+Every downstream graph op in this framework (velocity moments, MAGIC
+imputation, diffusion operators, DPT flows) reduces to ``P @ X`` with
+P in the padded (n, k) edge-list form (``ops/graph.py knn_matvec``).
+This module gives that primitive a cells-sharded multi-chip execution
+so the graph FAMILY scales the same way the kNN build does
+(``parallel/knn_multichip.py``), not just the search.
+
+TPU design — two strategies over the 1-D cell mesh:
+
+* ``"all_gather"``: one ``jax.lax.all_gather`` of the source matrix,
+  then a purely local edge gather.  Right when the gathered operand is
+  narrow (PCA scores, velocity layers after HVG subset: n × ≤2k
+  floats) — one ICI collective, maximal MXU/VPU locality.
+* ``"ring"``: the source shard circulates with ``jax.lax.ppermute``;
+  at step ``t`` device ``i`` holds the chunk that STARTED on device
+  ``(i − t) mod P``, so membership of each edge's global target id in
+  the circulating chunk is computed, not communicated — the same
+  provenance arithmetic as the ring kNN.  Peak per-device memory is
+  one chunk, for wide operands that must never materialise gathered.
+
+Edge ids are GLOBAL row indices; ``idx``/``weights``/``x`` are sharded
+along cells.  Rows must divide evenly over the mesh (pad with -1
+edges / zero rows — the same contract every sharded op here uses).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .mesh import CELL_AXIS
+
+
+def knn_matvec_sharded(knn_idx, weights, x, mesh,
+                       axis: str = CELL_AXIS,
+                       strategy: str = "all_gather"):
+    """``P @ x`` with everything cells-sharded over ``mesh``.
+
+    Matches ``ops.graph.knn_matvec`` exactly (same masking of -1
+    edges, same einsum precision); only the execution is distributed.
+    """
+    n_dev = mesh.shape[axis]
+    if not (knn_idx.shape[0] == weights.shape[0] == x.shape[0]):
+        raise ValueError(
+            f"knn_matvec_sharded: idx/weights/x row counts differ "
+            f"({knn_idx.shape[0]}/{weights.shape[0]}/{x.shape[0]}) — "
+            f"independently-divisible mismatches would shard-misalign "
+            f"SILENTLY, pairing wrong rows per device")
+    if x.shape[0] % n_dev:
+        raise ValueError(
+            f"knn_matvec_sharded: {x.shape[0]} rows do not divide "
+            f"over {n_dev} devices; pad rows (zero x, -1 edges) to a "
+            f"device multiple first")
+
+    def body_all_gather(idx_b, w_b, x_b):
+        x_full = jax.lax.all_gather(x_b, axis, axis=0, tiled=True)
+        safe = jnp.where(idx_b < 0, 0, idx_b)
+        w = jnp.where(idx_b < 0, 0.0, w_b)
+        g = jnp.take(x_full, safe, axis=0)
+        return jnp.einsum("nk,nkd->nd", w, g,
+                          precision=jax.lax.Precision.HIGHEST)
+
+    def body_ring(idx_b, w_b, x_b):
+        rows = x_b.shape[0]
+        me = jax.lax.axis_index(axis)
+        perm = [(d, (d + 1) % n_dev) for d in range(n_dev)]
+
+        def step(t, carry):
+            acc, chunk = carry
+            src = (me - t) % n_dev
+            off = src * rows
+            in_chunk = (idx_b >= off) & (idx_b < off + rows)
+            loc = jnp.clip(idx_b - off, 0, rows - 1)
+            w = jnp.where(in_chunk & (idx_b >= 0), w_b, 0.0)
+            g = jnp.take(chunk, loc, axis=0)
+            acc = acc + jnp.einsum(
+                "nk,nkd->nd", w, g,
+                precision=jax.lax.Precision.HIGHEST)
+            chunk = jax.lax.ppermute(chunk, axis, perm)
+            return acc, chunk
+
+        # x_b * 0, not jnp.zeros: the carry must enter the loop with
+        # the same varying-over-the-mesh-axis type it exits with
+        # (shard_map tracks per-value manual axes; a plain constant
+        # is unvarying and the fori_loop carry types then mismatch)
+        acc = x_b * 0.0
+        acc, _ = jax.lax.fori_loop(0, n_dev, step, (acc, x_b))
+        return acc
+
+    if strategy == "all_gather":
+        body = body_all_gather
+    elif strategy == "ring":
+        body = body_ring
+    else:
+        raise ValueError(
+            f"knn_matvec_sharded: unknown strategy {strategy!r} "
+            f"(use 'all_gather' or 'ring')")
+    spec = P(axis)
+    return jax.shard_map(body, mesh=mesh,
+                     in_specs=(spec, spec, spec),
+                     out_specs=spec)(knn_idx, weights, x)
+
+
+def smooth_layers_sharded(knn_idx, weights, layers, mesh,
+                          axis: str = CELL_AXIS,
+                          strategy: str = "all_gather"):
+    """The velocity-moments smoothing kernel, sharded:
+    ``(X + P @ X) / (1 + rowsum(P))`` for each layer (what
+    ``velocity.moments`` computes per layer after weight
+    symmetrisation) — one mesh program per layer."""
+    w = jnp.where(knn_idx < 0, 0.0, weights)
+    denom = 1.0 + jnp.sum(w, axis=1, keepdims=True)
+    return [
+        (X + knn_matvec_sharded(knn_idx, weights, X, mesh, axis=axis,
+                                strategy=strategy)) / denom
+        for X in layers
+    ]
