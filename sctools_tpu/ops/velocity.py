@@ -77,7 +77,8 @@ def _dense_layer(data: CellData, name: str, xp):
 # ----------------------------------------------------------------------
 
 
-def _moments(data: CellData, device: bool, second: bool = False):
+def _moments(data: CellData, device: bool, second: bool = False,
+             mesh=None, strategy: str = "all_gather"):
     n = data.n_cells
     if device:
         from .graph import (_require_knn, _symmetrized_weights,
@@ -97,6 +98,45 @@ def _moments(data: CellData, device: bool, second: bool = False):
         # kNN weights — one-sided edges at cluster boundaries matter
         w = _symmetrized_weights(idx, w, mode="union")
         w = jnp.where(idx < 0, 0.0, w)
+        if mesh is not None:
+            # heavy (n, g) smoothing cells-sharded over the mesh —
+            # the symmetrised (n, k) weight prep above stays
+            # single-program (it is k-sparse and tiny next to X)
+            from ..config import round_up
+            from ..parallel.graph_multichip import smooth_layers_sharded
+            from ..parallel.mesh import CELL_AXIS
+
+            if CELL_AXIS not in mesh.shape:
+                raise ValueError(
+                    f"velocity.moments: mesh has axes "
+                    f"{tuple(mesh.shape)}; expected a "
+                    f"{CELL_AXIS!r} axis (parallel.make_mesh)")
+            n_dev = mesh.shape[CELL_AXIS]
+            rows = round_up(n, n_dev)
+
+            def pad(a, fill):
+                if a.shape[0] == rows:
+                    return a
+                width = ((0, rows - a.shape[0]),) + tuple(
+                    (0, 0) for _ in a.shape[1:])
+                return jnp.pad(a, width, constant_values=fill)
+
+            idx_p = pad(idx[:n], -1)
+            w_p = pad(w[:n], 0.0)
+            mats = [S, U] + ([S * S, U * S] if second else [])
+            # ONE mesh program over the gene-concatenated matrix —
+            # the smoothing is per-gene independent, so four separate
+            # shard_map dispatches (one per layer) would run four
+            # collective chains for identical idx/weights
+            big = pad(jnp.concatenate(mats, axis=1), 0.0)
+            sm = smooth_layers_sharded(idx_p, w_p, [big], mesh,
+                                       strategy=strategy)[0][:n]
+            g = S.shape[1]
+            out = {"Ms": sm[:, :g], "Mu": sm[:, g:2 * g]}
+            if second:
+                out["Mss"] = sm[:, 2 * g:3 * g]
+                out["Mus"] = sm[:, 3 * g:]
+            return data.with_layers(**out)
         denom = 1.0 + jnp.sum(w, axis=1, keepdims=True)
 
         def smooth(X):
@@ -150,11 +190,16 @@ def _moments(data: CellData, device: bool, second: bool = False):
 
 
 @register("velocity.moments", backend="tpu")
-def moments_tpu(data: CellData, second: bool = False) -> CellData:
+def moments_tpu(data: CellData, second: bool = False,
+                mesh=None, strategy: str = "all_gather") -> CellData:
     """Adds layers["Ms"]/["Mu"] (kNN-smoothed spliced/unspliced);
     ``second=True`` also adds ["Mss"]/["Mus"] for the stochastic
-    model."""
-    return _moments(data, device=True, second=second)
+    model.  ``mesh=`` (a ``parallel.make_mesh`` cell mesh) runs the
+    heavy (n, g) smoothing cells-sharded over the devices;
+    ``strategy="ring"`` keeps per-device memory at one chunk for
+    operands too wide to all_gather (parallel/graph_multichip.py)."""
+    return _moments(data, device=True, second=second, mesh=mesh,
+                    strategy=strategy)
 
 
 @register("velocity.moments", backend="cpu")

@@ -169,3 +169,32 @@ def test_knn_matvec_sharded_matches_single_device():
     with pytest.raises(ValueError, match="divide"):
         knn_matvec_sharded(jnp.asarray(idx[:100]), jnp.asarray(w[:100]),
                            jnp.asarray(x[:100]), mesh)
+
+
+def test_velocity_moments_over_mesh_matches_single_device():
+    """velocity.moments(mesh=) shards the (n, g) smoothing; the
+    result must match the single-device op to float tolerance,
+    including the second moments and non-divisible row padding."""
+    import sctools_tpu as sct
+    from sctools_tpu.data.dataset import CellData
+    from sctools_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(3)
+    n, g = 250, 18  # NOT a multiple of 8: exercises the pad path
+    S = rng.poisson(2.0, (n, g)).astype(np.float32)
+    U = rng.poisson(1.0, (n, g)).astype(np.float32)
+    d = CellData(S, obsm={"X_pca": rng.normal(
+        0, 1, (n, 6)).astype(np.float32)})
+    d = d.with_layers(spliced=S, unspliced=U)
+    d = sct.apply("neighbors.knn", d, backend="tpu", k=8,
+                  metric="euclidean")
+    one = sct.apply("velocity.moments", d, backend="tpu", second=True)
+    mesh = make_mesh(8)
+    for strategy in ("all_gather", "ring"):
+        shd = sct.apply("velocity.moments", d, backend="tpu",
+                        second=True, mesh=mesh, strategy=strategy)
+        for layer in ("Ms", "Mu", "Mss", "Mus"):
+            np.testing.assert_allclose(
+                np.asarray(shd.layers[layer]),
+                np.asarray(one.layers[layer]),
+                atol=1e-4, err_msg=f"{strategy}:{layer}")
