@@ -354,28 +354,44 @@ def diffusion_operator_cpu(data: CellData, symmetrize: bool = True) -> CellData:
 
 @register("impute.magic", backend="tpu")
 def magic_tpu(data: CellData, t: int = 3, use_rep: str = "X",
-              n_genes_out: int | None = None) -> CellData:
+              n_genes_out: int | None = None, mesh=None,
+              strategy: str = "all_gather") -> CellData:
     """MAGIC-style imputation: t diffusion steps of the expression
     matrix along the cell graph.  Adds obsm["X_magic"] (dense
     (n, n_genes_out or n_genes)).  Densifies gene space — subset genes
-    first (hvg.select(subset=True)) for large panels."""
+    first (hvg.select(subset=True)) for large panels.  ``mesh=`` runs
+    the diffusion cells-sharded as one mesh program (t steps inside
+    the program — ``parallel.diffuse_sharded``); ``strategy="ring"``
+    bounds per-device memory at one chunk for wide gene panels."""
     if "diffusion_weights" not in data.obsp:
         data = diffusion_operator_tpu(data)
     idx, _ = _require_knn(data)
-    p = jnp.asarray(data.obsp["diffusion_weights"])[: data.n_cells]
+    n = data.n_cells
+    p = jnp.asarray(data.obsp["diffusion_weights"])[:n]
     if use_rep == "X":
         X = data.X
         Xd = X.to_dense() if isinstance(X, SparseCells) else (
-            jnp.asarray(X)[: data.n_cells])
+            jnp.asarray(X)[:n])
     else:
-        Xd = jnp.asarray(data.obsm[use_rep])[: data.n_cells]
+        Xd = jnp.asarray(data.obsm[use_rep])[:n]
     if n_genes_out is not None:
         Xd = Xd[:, :n_genes_out]
+    Xd = Xd.astype(jnp.float32)
+
+    if mesh is not None:
+        from ..parallel.graph_multichip import (diffuse_sharded,
+                                                pad_rows_for_mesh)
+
+        idx_p, p_p, X_p, _ = pad_rows_for_mesh(
+            mesh, idx=idx[:n], weights=p, x=Xd, who="impute.magic")
+        out = diffuse_sharded(idx_p, p_p, X_p, mesh, t,
+                              strategy=strategy)[:n]
+        return data.with_obsm(X_magic=out).with_uns(magic_t=t)
 
     def step(x, _):
         return knn_matvec(idx, p, x), None
 
-    out, _ = jax.lax.scan(step, Xd.astype(jnp.float32), None, length=t)
+    out, _ = jax.lax.scan(step, Xd, None, length=t)
     return data.with_obsm(X_magic=out).with_uns(magic_t=t)
 
 

@@ -102,33 +102,18 @@ def _moments(data: CellData, device: bool, second: bool = False,
             # heavy (n, g) smoothing cells-sharded over the mesh —
             # the symmetrised (n, k) weight prep above stays
             # single-program (it is k-sparse and tiny next to X)
-            from ..config import round_up
-            from ..parallel.graph_multichip import smooth_layers_sharded
-            from ..parallel.mesh import CELL_AXIS
+            from ..parallel.graph_multichip import (pad_rows_for_mesh,
+                                                    smooth_layers_sharded)
 
-            if CELL_AXIS not in mesh.shape:
-                raise ValueError(
-                    f"velocity.moments: mesh has axes "
-                    f"{tuple(mesh.shape)}; expected a "
-                    f"{CELL_AXIS!r} axis (parallel.make_mesh)")
-            n_dev = mesh.shape[CELL_AXIS]
-            rows = round_up(n, n_dev)
-
-            def pad(a, fill):
-                if a.shape[0] == rows:
-                    return a
-                width = ((0, rows - a.shape[0]),) + tuple(
-                    (0, 0) for _ in a.shape[1:])
-                return jnp.pad(a, width, constant_values=fill)
-
-            idx_p = pad(idx[:n], -1)
-            w_p = pad(w[:n], 0.0)
             mats = [S, U] + ([S * S, U * S] if second else [])
             # ONE mesh program over the gene-concatenated matrix —
             # the smoothing is per-gene independent, so four separate
             # shard_map dispatches (one per layer) would run four
             # collective chains for identical idx/weights
-            big = pad(jnp.concatenate(mats, axis=1), 0.0)
+            idx_p, w_p, big, _ = pad_rows_for_mesh(
+                mesh, idx=idx[:n], weights=w[:n],
+                x=jnp.concatenate(mats, axis=1),
+                who="velocity.moments")
             sm = smooth_layers_sharded(idx_p, w_p, [big], mesh,
                                        strategy=strategy)[0][:n]
             g = S.shape[1]

@@ -3,12 +3,13 @@
 Importing registers the multi-chip transforms."""
 
 from . import knn_multichip  # noqa: F401  (registers transforms)
-from .graph_multichip import knn_matvec_sharded, smooth_layers_sharded
+from .graph_multichip import (diffuse_sharded, knn_matvec_sharded,
+                              smooth_layers_sharded)
 from .knn_multichip import knn_multichip_arrays
 from .mesh import CELL_AXIS, cell_sharding, make_mesh, replicated, shard_celldata
 
 __all__ = [
     "CELL_AXIS", "make_mesh", "cell_sharding", "replicated",
     "shard_celldata", "knn_multichip_arrays",
-    "knn_matvec_sharded", "smooth_layers_sharded",
+    "knn_matvec_sharded", "smooth_layers_sharded", "diffuse_sharded",
 ]

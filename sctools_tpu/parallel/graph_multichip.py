@@ -1,4 +1,5 @@
-"""Multi-chip edge-list graph primitives: ``knn_matvec_sharded``.
+"""Multi-chip edge-list graph primitives: ``knn_matvec_sharded`` /
+``diffuse_sharded``.
 
 Every downstream graph op in this framework (velocity moments, MAGIC
 imputation, diffusion operators, DPT flows) reduces to ``P @ X`` with
@@ -7,22 +8,26 @@ This module gives that primitive a cells-sharded multi-chip execution
 so the graph FAMILY scales the same way the kNN build does
 (``parallel/knn_multichip.py``), not just the search.
 
-TPU design — two strategies over the 1-D cell mesh:
+TPU design — two strategies over the 1-D cell mesh, shared by the
+one-shot matvec and the t-step diffusion through the same per-step
+helpers (a fix to the ring arithmetic lands in exactly one place):
 
 * ``"all_gather"``: one ``jax.lax.all_gather`` of the source matrix,
   then a purely local edge gather.  Right when the gathered operand is
   narrow (PCA scores, velocity layers after HVG subset: n × ≤2k
   floats) — one ICI collective, maximal MXU/VPU locality.
 * ``"ring"``: the source shard circulates with ``jax.lax.ppermute``;
-  at step ``t`` device ``i`` holds the chunk that STARTED on device
-  ``(i − t) mod P``, so membership of each edge's global target id in
-  the circulating chunk is computed, not communicated — the same
+  at inner step ``s`` device ``i`` holds the chunk that STARTED on
+  device ``(i − s) mod P``, so membership of each edge's global target
+  id in the circulating chunk is computed, not communicated — the same
   provenance arithmetic as the ring kNN.  Peak per-device memory is
   one chunk, for wide operands that must never materialise gathered.
 
 Edge ids are GLOBAL row indices; ``idx``/``weights``/``x`` are sharded
-along cells.  Rows must divide evenly over the mesh (pad with -1
-edges / zero rows — the same contract every sharded op here uses).
+along cells.  Rows must divide evenly over the mesh —
+``pad_rows_for_mesh`` implements the contract (-1 edges, zero
+weights, zero rows; padded rows contribute nothing and callers slice
+them back off).
 """
 
 from __future__ import annotations
@@ -33,6 +38,93 @@ from jax.sharding import PartitionSpec as P
 
 from .mesh import CELL_AXIS
 
+_STRATEGIES = ("all_gather", "ring")
+
+
+def require_cell_axis(mesh, who: str, axis: str = CELL_AXIS) -> int:
+    """The mesh-axis guard every sharded graph op needs: returns the
+    device count, raising the explicit error (not a bare KeyError)
+    when the mesh was built with a different axis name."""
+    if axis not in mesh.shape:
+        raise ValueError(
+            f"{who}: mesh has axes {tuple(mesh.shape)}; expected a "
+            f"{axis!r} axis (parallel.make_mesh)")
+    return mesh.shape[axis]
+
+
+def pad_rows_for_mesh(mesh, *, idx, weights, x, axis: str = CELL_AXIS,
+                      who: str = "graph_multichip"):
+    """Pad (idx, weights, x) rows to a device multiple under the
+    module's contract (-1 edges, zero weights, zero rows).  Returns
+    the padded triple plus the original row count to slice with."""
+    n_dev = require_cell_axis(mesh, who, axis)
+    n = x.shape[0]
+    rows = -(-n // n_dev) * n_dev
+    if rows == n:
+        return idx, weights, x, n
+
+    def pad(a, fill):
+        width = ((0, rows - n),) + tuple((0, 0) for _ in a.shape[1:])
+        return jnp.pad(a, width, constant_values=fill)
+
+    return pad(idx, -1), pad(weights, 0.0), pad(x, 0.0), n
+
+
+def _check(who, knn_idx, weights, x, n_dev, strategy):
+    if strategy not in _STRATEGIES:
+        raise ValueError(f"{who}: unknown strategy {strategy!r} "
+                         f"(use 'all_gather' or 'ring')")
+    if not (knn_idx.shape[0] == weights.shape[0] == x.shape[0]):
+        raise ValueError(
+            f"{who}: idx/weights/x row counts differ "
+            f"({knn_idx.shape[0]}/{weights.shape[0]}/{x.shape[0]}) — "
+            f"independently-divisible mismatches would shard-misalign "
+            f"SILENTLY, pairing wrong rows per device")
+    if x.shape[0] % n_dev:
+        raise ValueError(
+            f"{who}: {x.shape[0]} rows do not divide over {n_dev} "
+            f"devices; pad rows first (pad_rows_for_mesh)")
+
+
+def _step_all_gather(idx_b, w_b, x_b, axis):
+    """One ``P @ x`` application, all-gather strategy (shard-local
+    view).  -1 edges masked exactly like ops.graph.knn_matvec."""
+    x_full = jax.lax.all_gather(x_b, axis, axis=0, tiled=True)
+    safe = jnp.where(idx_b < 0, 0, idx_b)
+    w = jnp.where(idx_b < 0, 0.0, w_b)
+    g = jnp.take(x_full, safe, axis=0)
+    return jnp.einsum("nk,nkd->nd", w, g,
+                      precision=jax.lax.Precision.HIGHEST)
+
+
+def _step_ring(idx_b, w_b, x_b, axis, n_dev):
+    """One ``P @ x`` application, ring strategy: the source shard
+    circulates; chunk provenance at inner step ``s`` is device
+    ``(me − s) mod P`` (computed, not communicated)."""
+    rows = x_b.shape[0]
+    me = jax.lax.axis_index(axis)
+    perm = [(d, (d + 1) % n_dev) for d in range(n_dev)]
+
+    def inner(s, carry):
+        acc, chunk = carry
+        src = (me - s) % n_dev
+        off = src * rows
+        in_chunk = (idx_b >= off) & (idx_b < off + rows)
+        loc = jnp.clip(idx_b - off, 0, rows - 1)
+        w = jnp.where(in_chunk & (idx_b >= 0), w_b, 0.0)
+        g = jnp.take(chunk, loc, axis=0)
+        acc = acc + jnp.einsum("nk,nkd->nd", w, g,
+                               precision=jax.lax.Precision.HIGHEST)
+        chunk = jax.lax.ppermute(chunk, axis, perm)
+        return acc, chunk
+
+    # x_b * 0, not jnp.zeros: the carry must enter the loop with the
+    # same varying-over-the-mesh-axis type it exits with (shard_map
+    # tracks per-value manual axes; a plain constant is unvarying and
+    # the fori_loop carry types then mismatch)
+    acc, _ = jax.lax.fori_loop(0, n_dev, inner, (x_b * 0.0, x_b))
+    return acc
+
 
 def knn_matvec_sharded(knn_idx, weights, x, mesh,
                        axis: str = CELL_AXIS,
@@ -42,66 +134,18 @@ def knn_matvec_sharded(knn_idx, weights, x, mesh,
     Matches ``ops.graph.knn_matvec`` exactly (same masking of -1
     edges, same einsum precision); only the execution is distributed.
     """
-    n_dev = mesh.shape[axis]
-    if not (knn_idx.shape[0] == weights.shape[0] == x.shape[0]):
-        raise ValueError(
-            f"knn_matvec_sharded: idx/weights/x row counts differ "
-            f"({knn_idx.shape[0]}/{weights.shape[0]}/{x.shape[0]}) — "
-            f"independently-divisible mismatches would shard-misalign "
-            f"SILENTLY, pairing wrong rows per device")
-    if x.shape[0] % n_dev:
-        raise ValueError(
-            f"knn_matvec_sharded: {x.shape[0]} rows do not divide "
-            f"over {n_dev} devices; pad rows (zero x, -1 edges) to a "
-            f"device multiple first")
+    n_dev = require_cell_axis(mesh, "knn_matvec_sharded", axis)
+    _check("knn_matvec_sharded", knn_idx, weights, x, n_dev, strategy)
 
-    def body_all_gather(idx_b, w_b, x_b):
-        x_full = jax.lax.all_gather(x_b, axis, axis=0, tiled=True)
-        safe = jnp.where(idx_b < 0, 0, idx_b)
-        w = jnp.where(idx_b < 0, 0.0, w_b)
-        g = jnp.take(x_full, safe, axis=0)
-        return jnp.einsum("nk,nkd->nd", w, g,
-                          precision=jax.lax.Precision.HIGHEST)
+    def body(idx_b, w_b, x_b):
+        if strategy == "all_gather":
+            return _step_all_gather(idx_b, w_b, x_b, axis)
+        return _step_ring(idx_b, w_b, x_b, axis, n_dev)
 
-    def body_ring(idx_b, w_b, x_b):
-        rows = x_b.shape[0]
-        me = jax.lax.axis_index(axis)
-        perm = [(d, (d + 1) % n_dev) for d in range(n_dev)]
-
-        def step(t, carry):
-            acc, chunk = carry
-            src = (me - t) % n_dev
-            off = src * rows
-            in_chunk = (idx_b >= off) & (idx_b < off + rows)
-            loc = jnp.clip(idx_b - off, 0, rows - 1)
-            w = jnp.where(in_chunk & (idx_b >= 0), w_b, 0.0)
-            g = jnp.take(chunk, loc, axis=0)
-            acc = acc + jnp.einsum(
-                "nk,nkd->nd", w, g,
-                precision=jax.lax.Precision.HIGHEST)
-            chunk = jax.lax.ppermute(chunk, axis, perm)
-            return acc, chunk
-
-        # x_b * 0, not jnp.zeros: the carry must enter the loop with
-        # the same varying-over-the-mesh-axis type it exits with
-        # (shard_map tracks per-value manual axes; a plain constant
-        # is unvarying and the fori_loop carry types then mismatch)
-        acc = x_b * 0.0
-        acc, _ = jax.lax.fori_loop(0, n_dev, step, (acc, x_b))
-        return acc
-
-    if strategy == "all_gather":
-        body = body_all_gather
-    elif strategy == "ring":
-        body = body_ring
-    else:
-        raise ValueError(
-            f"knn_matvec_sharded: unknown strategy {strategy!r} "
-            f"(use 'all_gather' or 'ring')")
     spec = P(axis)
     return jax.shard_map(body, mesh=mesh,
-                     in_specs=(spec, spec, spec),
-                     out_specs=spec)(knn_idx, weights, x)
+                         in_specs=(spec, spec, spec),
+                         out_specs=spec)(knn_idx, weights, x)
 
 
 def smooth_layers_sharded(knn_idx, weights, layers, mesh,
@@ -110,7 +154,9 @@ def smooth_layers_sharded(knn_idx, weights, layers, mesh,
     """The velocity-moments smoothing kernel, sharded:
     ``(X + P @ X) / (1 + rowsum(P))`` for each layer (what
     ``velocity.moments`` computes per layer after weight
-    symmetrisation) — one mesh program per layer."""
+    symmetrisation) — one mesh program per list entry, so callers
+    that can concatenate layers along genes should pass one matrix
+    (velocity.moments does)."""
     w = jnp.where(knn_idx < 0, 0.0, weights)
     denom = 1.0 + jnp.sum(w, axis=1, keepdims=True)
     return [
@@ -118,3 +164,30 @@ def smooth_layers_sharded(knn_idx, weights, layers, mesh,
                                 strategy=strategy)) / denom
         for X in layers
     ]
+
+
+def diffuse_sharded(knn_idx, weights, x, mesh, t: int,
+                    axis: str = CELL_AXIS,
+                    strategy: str = "all_gather"):
+    """``P^t @ x`` cells-sharded — MAGIC's diffusion — as ONE mesh
+    program: the t-step ``lax.scan`` lives INSIDE the shard_map body
+    (t steps cost t collectives, not t program dispatches; each step
+    must re-communicate since the operand changes, so the per-step
+    collective is inherent — the dispatch overhead is not).  Uses the
+    same per-step helpers as ``knn_matvec_sharded``."""
+    n_dev = require_cell_axis(mesh, "diffuse_sharded", axis)
+    _check("diffuse_sharded", knn_idx, weights, x, n_dev, strategy)
+
+    def body(idx_b, w_b, x_b):
+        def step(xc, _):
+            if strategy == "all_gather":
+                return _step_all_gather(idx_b, w_b, xc, axis), None
+            return _step_ring(idx_b, w_b, xc, axis, n_dev), None
+
+        out, _ = jax.lax.scan(step, x_b, None, length=t)
+        return out
+
+    spec = P(axis)
+    return jax.shard_map(body, mesh=mesh,
+                         in_specs=(spec, spec, spec),
+                         out_specs=spec)(knn_idx, weights, x)

@@ -198,3 +198,28 @@ def test_velocity_moments_over_mesh_matches_single_device():
                 np.asarray(shd.layers[layer]),
                 np.asarray(one.layers[layer]),
                 atol=1e-4, err_msg=f"{strategy}:{layer}")
+
+
+def test_magic_over_mesh_matches_single_device():
+    """impute.magic(mesh=) — t diffusion steps inside ONE mesh
+    program — must match the single-device op for both strategies,
+    including non-divisible padding."""
+    import sctools_tpu as sct
+    from sctools_tpu.data.synthetic import synthetic_counts
+    from sctools_tpu.parallel.mesh import make_mesh
+
+    d = synthetic_counts(210, 60, density=0.2, n_clusters=3,
+                         seed=5).device_put()
+    d = sct.apply("normalize.library_size", d, backend="tpu")
+    d = sct.apply("normalize.log1p", d, backend="tpu")
+    d = sct.apply("pca.randomized", d, backend="tpu", n_components=8)
+    d = sct.apply("neighbors.knn", d, backend="tpu", k=8)
+    one = sct.apply("impute.magic", d, backend="tpu", t=3)
+    mesh = make_mesh(8)
+    for strategy in ("all_gather", "ring"):
+        shd = sct.apply("impute.magic", d, backend="tpu", t=3,
+                        mesh=mesh, strategy=strategy)
+        np.testing.assert_allclose(
+            np.asarray(shd.obsm["X_magic"]),
+            np.asarray(one.obsm["X_magic"]), atol=1e-4,
+            err_msg=strategy)
