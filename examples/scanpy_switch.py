@@ -18,6 +18,11 @@ scanpy version this mirrors, line for line:
     sc.tl.umap(adata)
     sc.tl.rank_genes_groups(adata, "leiden", pts=True)
     df = sc.get.rank_genes_groups_df(adata, "0")
+    sc.pl.umap(adata, color="leiden", save="_clusters.png")
+
+plus the session-config lines every script opens with
+(sc.settings.verbosity, sc.settings.set_figure_params) — all of which
+work here spelled identically.
 """
 
 import numpy as np
@@ -26,10 +31,16 @@ import sctools_tpu as sct
 
 
 def main(backend: str = "tpu"):
+    # the first lines of a real scanpy script
+    sct.settings.verbosity = 1
+    sct.settings.set_figure_params(dpi=80, dpi_save=100)
+    sct.settings.figdir = "./figures"
+
     from sctools_tpu.data.synthetic import synthetic_counts
 
     d = synthetic_counts(2000, 3000, density=0.06, n_clusters=5,
                         mito_frac=0.02, seed=0)
+    d = d.var_names_make_unique()  # the post-read anndata staple
     if backend == "tpu":
         d = d.device_put()
 
@@ -58,6 +69,12 @@ def main(backend: str = "tpu"):
           f"{df['pct_nz_reference'][0]:.2f})")
     assert n_clusters >= 3
     assert host.obsm["X_umap"].shape[1] == 2
+    # the plotting line, scanpy-spelled (bare name -> settings.figdir)
+    sct.pl.umap(host, color="leiden", save="switch_clusters.png",
+                show=False)
+    import os
+
+    assert os.path.exists("./figures/switch_clusters.png")
     print("OK")
 
 
