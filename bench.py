@@ -1540,7 +1540,11 @@ def main():
         # quality before a later phase found the tunnel dead
         headline["error"] = (
             "no TPU: " + detail.get("acquire_error", "acquire failed")
-            + "; refusing to benchmark a CPU fallback as the TPU number")
+            + "; refusing to benchmark a CPU fallback as the TPU "
+            + "number.  Committed on-chip results live in artifacts/ "
+            + "(bench_*.json) and are summarised in README.md / "
+            + "docs/PERF.md — a dead tunnel at run time does not "
+            + "retract them")
     detail["backend"] = backend
     stage("done", total_s=round(time.time() - T_START, 1))
     print(json.dumps(headline, default=float), flush=True)
