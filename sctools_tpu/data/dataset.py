@@ -87,6 +87,31 @@ class CellData:
     def shape(self):
         return (self.n_cells, self.n_genes)
 
+    # anndata-spelled aliases — the names every ported script reaches
+    # for first (adata.n_obs, adata.var_names, ...).  Name arrays fall
+    # back to positional string ids when no annotation exists, like a
+    # fresh AnnData's default RangeIndex-as-strings.
+    @property
+    def n_obs(self) -> int:
+        return self.n_cells
+
+    @property
+    def n_vars(self) -> int:
+        return self.n_genes
+
+    @property
+    def obs_names(self) -> np.ndarray:
+        for key in ("cell_name", "barcode"):
+            if key in self.obs:
+                return np.asarray(self.obs[key]).astype(str)
+        return np.arange(self.n_cells).astype(str)
+
+    @property
+    def var_names(self) -> np.ndarray:
+        if "gene_name" in self.var:
+            return np.asarray(self.var["gene_name"]).astype(str)
+        return np.arange(self.n_genes).astype(str)
+
     def replace(self, **kw) -> "CellData":
         return dataclasses.replace(self, **kw)
 

@@ -327,3 +327,19 @@ def test_queries_and_var_names_make_unique():
         sct.queries.mitochondrial_mask(u, "Human ")
     # unique names: no-op returns self
     assert u.var_names_make_unique() is u
+
+
+def test_anndata_spelled_properties():
+    import sctools_tpu as sct
+    from sctools_tpu.data.dataset import CellData
+
+    d = CellData(np.ones((5, 3), np.float32),
+                 var={"gene_name": np.array(["a", "b", "c"])},
+                 obs={"barcode": np.array([f"bc{i}" for i in range(5)])})
+    assert (d.n_obs, d.n_vars) == (5, 3) == d.shape
+    assert list(d.var_names) == ["a", "b", "c"]
+    assert list(d.obs_names) == [f"bc{i}" for i in range(5)]
+    # defaults: positional string ids, like a fresh AnnData
+    bare = CellData(np.ones((2, 2), np.float32))
+    assert list(bare.var_names) == ["0", "1"]
+    assert list(bare.obs_names) == ["0", "1"]
