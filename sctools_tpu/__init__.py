@@ -34,7 +34,9 @@ from .data.concat import concat
 from .data.io import (from_dense, from_scipy, read, read_10x_h5,
                       read_10x_mtx, read_csv, read_h5ad, read_loom,
                       read_mtx, read_text, write_h5ad, write_loom)
+from .recipes import recipe_pipeline, run_recipe
 from .registry import Pipeline, Transform, apply, backends, names, register
+from .runner import ResilientRunner, RetryPolicy
 from .compat import experimental, external, pp, tl  # scanpy-style namespaces
 from . import pl  # scanpy-style plotting namespace (host-side)
 from . import datasets  # offline sc.datasets subset
@@ -73,4 +75,5 @@ __all__ = [
     "write_loom",
     "from_scipy", "from_dense",
     "pp", "tl", "experimental", "external", "pl", "datasets", "queries",
+    "ResilientRunner", "RetryPolicy", "recipe_pipeline", "run_recipe",
 ]

@@ -289,11 +289,17 @@ def _filter_and_normalize(data, backend: str = "tpu",
     """scVelo ``pp.filter_and_normalize``: gene filter on total counts
     (the spliced X), library-size normalisation of X AND the
     spliced/unspliced layers (the same per-cell factors), optional HVG
-    subset, log1p on X.  Stated deviations from the published helper:
+    subset, log1p on X.  Stated deviations from the published helper
+    (also listed under "Known API deviations" in docs/GUIDE.md):
     the gene filter uses X total counts, not spliced∩unspliced
     'shared counts' (the layers still ride through every subset
-    aligned), and ONLY min_cells-free count filtering is applied —
-    scVelo adds no detected-cells floor here."""
+    aligned); ONLY min_cells-free count filtering is applied —
+    scVelo adds no detected-cells floor here; and the spliced/
+    unspliced layers are scaled by X's per-cell normalisation
+    factors, where scVelo's ``pp.normalize_per_cell`` normalises
+    each layer by its OWN initial per-layer counts — ported
+    pipelines therefore get slightly different Ms/Mu than upstream
+    when layer depth profiles differ from X's."""
     data = apply("qc.per_gene_metrics", data, backend=backend)
     data = apply("qc.filter_genes", data, backend=backend,
                  min_cells=None, min_counts=min_shared_counts)

@@ -164,18 +164,24 @@ class Config:
     # ascending order, inverse-permute only the scores — built for
     # tables beyond on-chip residency, where the r5 session-3
     # measurement showed blocked refine at 1.3M costs ~10x its 131k
-    # wall).  "auto" currently means "blocked": the sorted path
+    # wall).  "auto" thresholds on the candidate-table size: blocked
+    # below refine_sorted_min_cand, sorted at or above it, so library
+    # callers at the >=786k regime the sorted gather was built for get
+    # it without going through bench.py's A/B.  The sorted path
     # selects the same neighbours (scores differ only by f32
-    # reduction-order ulps; tests pin set-equality + tolerance) but
-    # its on-chip win is unmeasured — the bench A/Bs both modes at
-    # large atlas shapes and routes its chunk loop onto the measured
-    # winner, recording the decision as a stage line.
+    # reduction-order ulps; tests pin set-equality + tolerance).
     # Env: SCTOOLS_TPU_REFINE_MODE.
     knn_refine_mode: str = "auto"
+    # The 'auto' cutoff: 6 x 131072 — the r5 session-3 measurement
+    # showed blocked refine at 1.3M candidates costing ~10x its 131k
+    # wall, and 786432 is the same breakpoint bench.py's atlas A/B
+    # brackets.  Callers below it keep the on-chip blocked gather.
+    refine_sorted_min_cand: int = 786432
 
     def resolved_refine_mode(self, n_cand: int) -> str:
         if self.knn_refine_mode == "auto":
-            return "blocked"
+            return ("sorted" if n_cand >= self.refine_sorted_min_cand
+                    else "blocked")
         return self.knn_refine_mode
 
     # f32-refine candidate count for the benchmarked kNN pipeline

@@ -126,7 +126,16 @@ class CellData:
         ``-2``, … to repeats, keeping the first occurrence unchanged
         (anndata ``.var_names_make_unique()`` — the call every 10x
         read is followed by, since CellRanger references repeat gene
-        symbols).  No-op when names are absent or already unique."""
+        symbols).  No-op when names are absent or already unique.
+
+        RETURNS A NEW ``CellData`` — you MUST reassign::
+
+            data = data.var_names_make_unique()
+
+        This deviates from anndata, whose method mutates in place;
+        a ported script calling it without reassignment is a silent
+        no-op (``CellData`` is immutable, so an in-place form cannot
+        exist — see "Known API deviations" in docs/GUIDE.md)."""
         names = self.var.get("gene_name")
         if names is None:
             return self

@@ -84,17 +84,16 @@ def _cat_palette(plt, n):
     return [plt.get_cmap("hsv")(i / n) for i in range(n)]
 
 
-def _finish(fig, ax, save, show, created=False):
+def _finish(fig, ax, save, show, created=False, kind="plot"):
     if save:
         import os
-        import sys
 
         from .settings import settings
 
         if save is True:
             # scanpy's bool form derives the filename from the plot
-            # kind; our caller IS the pl.<kind> function one frame up
-            kind = sys._getframe(1).f_code.co_name.lstrip("_") or "plot"
+            # kind; callers pass their own name explicitly (a frame
+            # inspection here breaks under any wrapper/decorator)
             save = f"{kind}.{settings.file_format_figs}"
         path = str(save)
         if not os.path.dirname(path):  # bare name -> settings.figdir
@@ -180,7 +179,7 @@ def embedding(data, basis: str = "X_umap", *, color=None, ax=None,
         from .settings import settings
 
         save = f"{name}.{settings.file_format_figs}"
-    return _finish(fig, ax, save, show, created)
+    return _finish(fig, ax, save, show, created, kind="embedding")
 
 
 umap = partial(embedding, basis="X_umap")
@@ -220,7 +219,7 @@ def scatter(data, x: str, y: str, *, color=None, ax=None, save=None,
             fig.colorbar(sc, ax=ax, shrink=0.7)
     ax.set_xlabel(x)
     ax.set_ylabel(y)
-    return _finish(fig, ax, save, show, created)
+    return _finish(fig, ax, save, show, created, kind="scatter")
 
 
 def violin(data, keys, *, groupby: str | None = None, log: bool = False,
@@ -261,7 +260,7 @@ def violin(data, keys, *, groupby: str | None = None, log: bool = False,
         ax.set_ylabel(keys[0])
     if log:
         ax.set_yscale("log")
-    return _finish(fig, ax, save, show, created)
+    return _finish(fig, ax, save, show, created, kind="violin")
 
 
 def highest_expr_genes(data, n_top: int = 30, *, ax=None, save=None,
@@ -291,7 +290,7 @@ def highest_expr_genes(data, n_top: int = 30, *, ax=None, save=None,
     ax.boxplot(cols[::-1], orientation="horizontal", showfliers=False,
                tick_labels=list(names[top])[::-1])
     ax.set_xlabel("% of total counts")
-    return _finish(fig, ax, save, show, created)
+    return _finish(fig, ax, save, show, created, kind="highest_expr_genes")
 
 
 def _grouped_stats(data, var_names, groupby):
@@ -332,7 +331,7 @@ def dotplot(data, var_names, groupby: str, *, standard_scale=None,
     ax.set_ylim(G - 0.3, -0.7)
     ax.set_ylabel(groupby)
     fig.colorbar(sc, ax=ax, shrink=0.6, label="mean expression")
-    return _finish(fig, ax, save, show, created)
+    return _finish(fig, ax, save, show, created, kind="dotplot")
 
 
 def matrixplot(data, var_names, groupby: str, *, cmap: str = "viridis",
@@ -355,7 +354,7 @@ def matrixplot(data, var_names, groupby: str, *, cmap: str = "viridis",
     ax.set_yticks(np.arange(G), [str(lev) for lev in levels])
     ax.set_ylabel(groupby)
     ax.figure.colorbar(im, ax=ax, shrink=0.6, label="mean expression")
-    return _finish(fig, ax, save, show, created)
+    return _finish(fig, ax, save, show, created, kind="matrixplot")
 
 
 def heatmap(data, var_names, groupby: str, *, cmap: str = "viridis",
@@ -384,7 +383,7 @@ def heatmap(data, var_names, groupby: str, *, cmap: str = "viridis",
     ax.set_ylabel(f"cells (grouped by {groupby})")
     ax.set_yticks([])
     fig.colorbar(im, ax=ax, shrink=0.6)
-    return _finish(fig, ax, save, show, created)
+    return _finish(fig, ax, save, show, created, kind="heatmap")
 
 
 def rank_genes_groups(data, *, n_genes: int = 20,
@@ -484,7 +483,7 @@ def paga(data, *, threshold: float = 0.01, basis: str | None = None,
     ax.set_xticks([])
     ax.set_yticks([])
     ax.set_title("PAGA")
-    return _finish(fig, ax, save, show, created)
+    return _finish(fig, ax, save, show, created, kind="paga")
 
 
 def embedding_density(data, basis: str = "X_umap", *, key: str | None =
@@ -526,7 +525,7 @@ def dendrogram(data, groupby: str, *, ax=None, save=None, show=None):
                          labels=list(map(str, cats)), ax=ax,
                          color_threshold=0)
     ax.set_ylabel("distance")
-    return _finish(fig, ax, save, show, created)
+    return _finish(fig, ax, save, show, created, kind="dendrogram")
 
 
 def velocity_embedding(data, basis: str = "umap", *, scale: float = 1.0,
@@ -545,7 +544,7 @@ def velocity_embedding(data, basis: str = "umap", *, scale: float = 1.0,
     ax.quiver(E[:, 0], E[:, 1], V[:, 0], V[:, 1], angles="xy",
               scale_units="xy", scale=1.0 / max(scale, 1e-12),
               width=0.002, color="k", alpha=0.7)
-    return _finish(ax.figure, ax, save, show)
+    return _finish(ax.figure, ax, save, show, kind="velocity_embedding")
 
 
 def velocity(data, var_names, *, ncols: int = 4, color: str | None = None,
@@ -611,10 +610,14 @@ def velocity(data, var_names, *, ncols: int = 4, color: str | None = None,
             if pi == 0 and legend_handles:
                 ax.legend(handles=legend_handles, fontsize=6,
                           frameon=False, loc="best")
+        elif cvals is not None:
+            ax.scatter(s, u, s=4, c=cvals, cmap="viridis", alpha=0.6,
+                       linewidths=0)
         else:
-            ax.scatter(s, u, s=4, c=(cvals if cvals is not None
-                                     else "tab:blue"),
-                       cmap="viridis", alpha=0.6, linewidths=0)
+            # scalar color: passing cmap= alongside it makes matplotlib
+            # emit a UserWarning per panel — only map when values resolve
+            ax.scatter(s, u, s=4, c="tab:blue", alpha=0.6,
+                       linewidths=0)
         if "velocity_gamma" in data.var:
             g = float(np.asarray(data.var["velocity_gamma"])[j])
             xs = np.linspace(0.0, max(s.max(), 1e-9), 32)
@@ -653,4 +656,4 @@ def velocity(data, var_names, *, ncols: int = 4, color: str | None = None,
     for pi in range(len(idx), nrows * ncols):
         axes[pi // ncols][pi % ncols].axis("off")
     fig.tight_layout()
-    return _finish(fig, axes, save, show, created=True)
+    return _finish(fig, axes, save, show, created=True, kind="velocity")

@@ -20,7 +20,65 @@ from __future__ import annotations
 from .data.dataset import CellData
 from .registry import Pipeline, register
 
+# Pipeline-shaped recipes by short name — the index both
+# ``recipe_pipeline()`` and ``run_recipe()`` dispatch through.
+# ``weinreb17`` is deliberately absent: its gene filter needs host-side
+# moment thresholding between device steps, so it exists only as the
+# registered one-call op and cannot be checkpointed step-wise.
+PIPELINES: dict = {}
 
+
+def _pipeline_recipe(name: str):
+    def deco(factory):
+        PIPELINES[name] = factory
+        return factory
+
+    return deco
+
+
+def recipe_pipeline(name: str, **kw) -> Pipeline:
+    """Build the named recipe's :class:`Pipeline` (``"zheng17"``,
+    ``"seurat"``, ``"pearson_residuals"``) with the factory's keyword
+    arguments — the inspectable/editable/checkpointable form of the
+    one-call ``recipe.*`` ops."""
+    try:
+        factory = PIPELINES[name]
+    except KeyError:
+        raise KeyError(
+            f"no pipeline-shaped recipe named {name!r}; known: "
+            f"{sorted(PIPELINES)} (weinreb17 is one-call only — its "
+            f"gene filter thresholds host-side moments mid-recipe)"
+        ) from None
+    return factory(**kw)
+
+
+def run_recipe(name: str, data: CellData, *, backend: str | None = None,
+               checkpoint_dir: str | None = None, resume: bool = True,
+               runner_kw: dict | None = None, **recipe_kw) -> CellData:
+    """Run a named recipe under the resilient execution layer.
+
+    The one-call ``apply("recipe.seurat", ...)`` form dies on the
+    first transient device error and restarts from scratch; this form
+    builds the recipe's :class:`Pipeline` and hands it to
+    ``runner.ResilientRunner`` — per-step retry with backoff, health-
+    checked CPU fallback, and (with ``checkpoint_dir=``) per-step
+    checkpoints so a killed run resumes at the failed step.
+    ``runner_kw`` forwards to the runner constructor (``policy=``,
+    ``isolate=``, ``preflight=`` …); ``recipe_kw`` to the recipe
+    factory (``n_top_genes=`` …).
+
+    >>> out = run_recipe("seurat", data, backend="tpu",
+    ...                  checkpoint_dir="ck/", n_top_genes=2000)
+    """
+    from .runner import ResilientRunner
+
+    pipe = recipe_pipeline(name, **recipe_kw)
+    runner = ResilientRunner(pipe, checkpoint_dir=checkpoint_dir,
+                             **(runner_kw or {}))
+    return runner.run(data, backend=backend, resume=resume)
+
+
+@_pipeline_recipe("zheng17")
 def zheng17_pipeline(n_top_genes: int = 1000) -> Pipeline:
     """Zheng et al. 2017 (10x 1.3M-cell paper) steps: gene filter →
     count normalise → dispersion HVG subset → renormalise → log1p →
@@ -39,6 +97,7 @@ def zheng17_pipeline(n_top_genes: int = 1000) -> Pipeline:
     ])
 
 
+@_pipeline_recipe("seurat")
 def seurat_pipeline(n_top_genes: int = 2000,
                     min_genes: int = 200, min_cells: int = 3,
                     target_sum: float = 1e4) -> Pipeline:
@@ -175,6 +234,7 @@ def recipe_weinreb17_cpu(data: CellData, log: bool = True,
                       n_comps)
 
 
+@_pipeline_recipe("pearson_residuals")
 def pearson_residuals_pipeline(n_top_genes: int = 2000,
                                theta: float = 100.0,
                                n_components: int = 50,

@@ -23,6 +23,7 @@ design target.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Any, Callable
 
@@ -30,6 +31,45 @@ _REGISTRY: dict[str, dict[str, Callable]] = {}
 _DOCS: dict[str, str] = {}
 
 DEFAULT_BACKEND = "tpu"
+
+# ---------------------------------------------------------------------------
+# Call wrappers — the registry's run hooks.
+#
+# A wrapper is ``wrapper(name, backend, fn) -> fn`` applied around every
+# transform invocation (``apply()``, ``Transform.__call__``, and
+# therefore every ``Pipeline``/recipe step) while it is installed.
+# This is the interception point the chaos fault-injection harness
+# (utils/chaos.py) and any instrumentation hook use: installation is
+# dynamic, so already-constructed Transforms/Pipelines are covered —
+# the wrap happens at call time, not at bind time.  Wrappers stack;
+# the most recently pushed runs outermost.
+# ---------------------------------------------------------------------------
+
+_CALL_WRAPPERS: list[Callable[[str, str, Callable], Callable]] = []
+
+
+def push_call_wrapper(wrapper: Callable[[str, str, Callable], Callable]) -> None:
+    _CALL_WRAPPERS.append(wrapper)
+
+
+def pop_call_wrapper(wrapper: Callable[[str, str, Callable], Callable]) -> None:
+    _CALL_WRAPPERS.remove(wrapper)
+
+
+@contextlib.contextmanager
+def call_wrapper(wrapper: Callable[[str, str, Callable], Callable]):
+    """Scoped installation: ``with call_wrapper(w): pipeline.run(...)``."""
+    push_call_wrapper(wrapper)
+    try:
+        yield
+    finally:
+        pop_call_wrapper(wrapper)
+
+
+def _wrap_call(name: str, backend: str, fn: Callable) -> Callable:
+    for w in _CALL_WRAPPERS:
+        fn = w(name, backend, fn)
+    return fn
 
 
 class UnknownTransformError(KeyError):
@@ -89,7 +129,10 @@ def describe(name: str) -> str:
 
 def apply(name: str, data, *args, backend: str = DEFAULT_BACKEND, **kw):
     """Apply a registered transform to ``data`` and return the result."""
-    return get(name, backend)(data, *args, **kw)
+    fn = get(name, backend)
+    if _CALL_WRAPPERS:
+        fn = _wrap_call(name, backend, fn)
+    return fn(data, *args, **kw)
 
 
 class Transform:
@@ -110,7 +153,10 @@ class Transform:
 
     def __call__(self, data, **overrides):
         kw = {**self.params, **overrides}
-        return self._fn(data, **kw)
+        fn = self._fn
+        if _CALL_WRAPPERS:
+            fn = _wrap_call(self.name, self.backend, fn)
+        return fn(data, **kw)
 
     def with_backend(self, backend: str) -> "Transform":
         return Transform(self.name, backend=backend, **self.params)
@@ -143,6 +189,9 @@ class Pipeline:
                 )
 
     def run(self, data, backend: str | None = None):
+        """Run all steps.  For retry, fault containment and resume,
+        run the pipeline under ``sctools_tpu.runner.ResilientRunner``
+        instead — this loop dies on the first error by design."""
         for t in self.steps:
             if backend is not None and backend != t.backend:
                 t = t.with_backend(backend)

@@ -1,0 +1,223 @@
+"""Deterministic fault injection for pipeline transforms.
+
+The failure modes this harness injects are the ones the live TPU
+tunnel actually produced in bench rounds 1–5 (bench.py's history):
+``UNAVAILABLE`` raises from a dead worker, wedges that hang a call
+past every deadline, corrupted results, and hard process death.  The
+resilient runner (``sctools_tpu/runner.py``) exists to survive those;
+this module exists so its recovery paths are exercised in tier-1 CPU
+tests instead of only on a live flaky tunnel.
+
+Everything is deterministic and seedable: a :class:`ChaosMonkey` with
+the same faults and seed injects the same failures at the same calls,
+so a recovery test is exactly reproducible.
+
+>>> from sctools_tpu.utils.chaos import ChaosMonkey, Fault
+>>> monkey = ChaosMonkey([Fault("hvg.select", "unavailable", times=2)])
+>>> with monkey.activate():                 # registry-level wrap
+...     out = runner.run(data)              # first 2 hvg calls raise
+>>> monkey.injected                         # what actually fired
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import fnmatch
+import random
+import time
+
+import numpy as np
+
+from .. import registry
+from .failsafe import TransientDeviceError
+
+MODES = ("unavailable", "hang", "corrupt", "crash", "kill")
+
+
+class ChaosCrash(BaseException):
+    """Simulated hard process death (preemption, SIGKILL, worker
+    segfault).  Deliberately a ``BaseException``: no ``except
+    Exception`` handler — including the resilient runner's retry loop
+    — survives it in-process, exactly like the real thing.  Recovery
+    from it is a NEW run resuming from checkpoints."""
+
+
+@dataclasses.dataclass
+class Fault:
+    """One injected failure rule.
+
+    ``op`` is an fnmatch pattern over dotted transform names
+    (``"hvg.select"``, ``"normalize.*"``); ``backend`` optionally
+    restricts the fault to one backend (so a TPU-only outage leaves
+    the CPU fallback healthy).  The fault fires on calls
+    ``on_call .. on_call+times-1`` of a matching op (1-based count
+    per op name; ``times=-1`` means forever), each firing gated by
+    probability ``p`` drawn from the monkey's seeded stream.
+    """
+
+    op: str
+    mode: str  # one of MODES
+    on_call: int = 1
+    times: int = 1
+    backend: str | None = None
+    p: float = 1.0
+
+    def __post_init__(self):
+        if self.mode not in MODES:
+            raise ValueError(
+                f"Fault mode {self.mode!r}: use one of {MODES}")
+
+
+def _corrupt_value(out, rng: random.Random):
+    """Deterministically damage a transform result: one element of X
+    (CellData) or of the array itself becomes NaN — the silent-wrong-
+    answer failure a health probe cannot see."""
+    import scipy.sparse as sp
+
+    def damage_dense(a):
+        a = np.array(a, np.float32, copy=True)
+        if a.size:
+            a.flat[rng.randrange(a.size)] = np.nan
+        return a
+
+    if hasattr(out, "X") and hasattr(out, "to_host"):  # CellData
+        host = out.to_host()
+        X = host.X
+        if sp.issparse(X):
+            # raw 10x counts are commonly integer — cast like the
+            # dense branch, or the NaN assignment itself raises
+            X = (X.astype(np.float32) if X.data.dtype.kind != "f"
+                 else X.copy())
+            if X.data.size:
+                X.data[rng.randrange(X.data.size)] = np.nan
+        else:
+            X = damage_dense(X)
+        return host.with_X(X)
+    if isinstance(out, np.ndarray):
+        return damage_dense(out)
+    return out  # non-array result: nothing meaningful to corrupt
+
+
+class ChaosMonkey:
+    """Wraps registered transforms (via the registry's call-wrapper
+    hook) to inject :class:`Fault` rules.
+
+    * ``unavailable`` — raise :class:`TransientDeviceError` with an
+      ``UNAVAILABLE`` message (classified transient → retried).
+    * ``hang`` — sleep ``hang_s`` before proceeding (a wedge; under
+      subprocess containment the watchdog kills the child).  The
+      sleeper is injectable so tier-1 tests hang no real clock.
+    * ``corrupt`` — run the op, then deterministically NaN one element
+      of the result.
+    * ``crash`` — raise :class:`ChaosCrash` (in-process stand-in for
+      process death; aborts the whole run, testing resume).
+    * ``kill`` — ``os._exit(9)``: REAL process death.  Only meaningful
+      inside a contained child (``failsafe.run_isolated``); in the
+      parent process it takes the test runner down with it.
+
+    ``calls`` counts invocations per op name; ``injected`` logs every
+    firing as ``{"op", "call", "mode", "backend"}`` — two monkeys with
+    equal faults/seed driving the same workload produce identical
+    logs (the determinism contract tier-1 pins).
+    """
+
+    def __init__(self, faults, seed: int = 0, hang_s: float = 3600.0,
+                 sleep=time.sleep):
+        self.faults = list(faults)
+        self.seed = seed
+        self.hang_s = hang_s
+        self.sleep = sleep
+        self.calls: dict[str, int] = {}
+        self.injected: list[dict] = []
+        self._rng = random.Random(seed)
+
+    # -- picklable spec: forwards the monkey (with its call counts)
+    # into failsafe.run_isolated children so Nth-call semantics span
+    # the containment boundary
+    def spec(self) -> dict:
+        return {"faults": [dataclasses.asdict(f) for f in self.faults],
+                "seed": self.seed, "hang_s": self.hang_s,
+                "calls": dict(self.calls)}
+
+    @classmethod
+    def from_spec(cls, spec: dict) -> "ChaosMonkey":
+        m = cls([Fault(**f) for f in spec["faults"]], seed=spec["seed"],
+                hang_s=spec["hang_s"])
+        m.calls = dict(spec.get("calls", {}))
+        return m
+
+    def note_external_call(self, name: str) -> None:
+        """Record that a contained child invoked ``name`` once (the
+        parent's counter must advance even though the wrap ran in the
+        child's process)."""
+        self.calls[name] = self.calls.get(name, 0) + 1
+
+    def _firing(self, name: str, backend: str, call_no: int):
+        for f in self.faults:
+            if not fnmatch.fnmatchcase(name, f.op):
+                continue
+            if f.backend is not None and backend != f.backend:
+                continue
+            if call_no < f.on_call:
+                continue
+            if f.times >= 0 and call_no >= f.on_call + f.times:
+                continue
+            if f.p < 1.0 and self._rng.random() >= f.p:
+                continue
+            return f
+        return None
+
+    def _wrap(self, name: str, backend: str, fn):
+        def chaotic(data, *args, **kw):
+            call_no = self.calls.get(name, 0) + 1
+            self.calls[name] = call_no
+            f = self._firing(name, backend, call_no)
+            if f is None:
+                return fn(data, *args, **kw)
+            self.injected.append({"op": name, "call": call_no,
+                                  "mode": f.mode, "backend": backend})
+            if f.mode == "unavailable":
+                raise TransientDeviceError(
+                    f"chaos: UNAVAILABLE injected in {name!r} "
+                    f"(call {call_no})")
+            if f.mode == "crash":
+                raise ChaosCrash(
+                    f"chaos: process death injected in {name!r} "
+                    f"(call {call_no})")
+            if f.mode == "kill":
+                import os
+                import sys
+
+                print(f"[chaos] killing process in {name!r}",
+                      file=sys.stderr, flush=True)
+                os._exit(9)
+            if f.mode == "hang":
+                self.sleep(self.hang_s)
+                return fn(data, *args, **kw)
+            # corrupt: per-firing rng derived from (seed, op, call) so
+            # the damage is reproducible regardless of what else drew
+            # from the monkey's main stream
+            out = fn(data, *args, **kw)
+            sub = random.Random((self.seed, name, call_no).__repr__())
+            return _corrupt_value(out, sub)
+
+        return chaotic
+
+    @contextlib.contextmanager
+    def activate(self):
+        """Install into the transform registry for the enclosed block;
+        every ``apply``/``Transform``/``Pipeline`` call is wrapped.
+
+        Reentrant: nested activation of the SAME monkey (e.g. a test's
+        ``with monkey.activate():`` around a runner that was also given
+        ``chaos=monkey``) installs the wrapper once — a double wrap
+        would double-count every call and shift Nth-call faults."""
+        if self._wrap in registry._CALL_WRAPPERS:
+            yield self
+            return
+        registry.push_call_wrapper(self._wrap)
+        try:
+            yield self
+        finally:
+            registry.pop_call_wrapper(self._wrap)

@@ -123,6 +123,89 @@ def load_celldata(path: str) -> CellData:
     return CellData(X, layers=layers, **sections)
 
 
+def step_fingerprint(steps, i: int) -> str:
+    """Content hash (10 hex chars) of the step-``i`` prefix of
+    ``steps`` — name plus parameters of every step up to and including
+    ``i``, so a change to ANY earlier step invalidates everything
+    downstream of it.  This is the step identity the checkpoint
+    filenames embed; the ResilientRunner journals it so a run record
+    can be matched to the exact pipeline configuration that produced
+    it."""
+    import hashlib
+
+    def sig(v, h):
+        # repr() alone is unsafe: numpy elides large arrays
+        # ("[0, 1, ..., 9]"), so two configs differing mid-array
+        # would collide — hash raw bytes for array-likes instead
+        if isinstance(v, (list, tuple)):
+            h.update(f"<{type(v).__name__}{len(v)}".encode())
+            for x in v:
+                sig(x, h)
+            h.update(b">")
+        elif isinstance(v, dict):
+            h.update(f"<dict{len(v)}".encode())
+            for kk in sorted(v, key=repr):
+                h.update(repr(kk).encode())
+                sig(v[kk], h)
+            h.update(b">")
+        elif isinstance(v, np.ndarray) or type(v).__module__.startswith(
+                ("jax", "jaxlib")):
+            a = np.asarray(v)
+            h.update(f"nd{a.dtype}{a.shape}".encode())
+            h.update(np.ascontiguousarray(a).tobytes())
+        else:
+            r = repr(v)
+            # A default object repr embeds the memory address
+            # ("<Foo object at 0x7f..>"), which changes every
+            # process — hashing it would silently invalidate every
+            # checkpoint on resume.  Strip addresses (stable across
+            # runs) and warn that the param carries no real state.
+            if " at 0x" in r:
+                import re
+                import warnings
+
+                r = re.sub(r" at 0x[0-9a-fA-F]+", "", r)
+                warnings.warn(
+                    f"step_fingerprint: parameter {r!r} has no "
+                    "stable repr; its internal state is NOT part of "
+                    "the checkpoint hash — changing it will not "
+                    "invalidate old checkpoints", stacklevel=2)
+            h.update(r.encode())
+
+    # hash of the (name, sorted params) prefix chain — stale
+    # checkpoints from a different configuration (or an edited
+    # earlier step) are never resumed
+    h = hashlib.sha256()
+    for t in steps[: i + 1]:
+        h.update(t.name.encode())
+        sig(dict(t.params), h)
+    return h.hexdigest()[:10]
+
+
+def step_filename(steps, i: int) -> str:
+    """Checkpoint basename for step ``i``:
+    ``step{i:03d}_{transform}_{fingerprint}.npz``.  Pure function of
+    the step list — PipelineCheckpointer and the ResilientRunner both
+    name through here, so their checkpoints interoperate (a run
+    started under one resumes under the other)."""
+    safe = steps[i].name.replace(".", "_").replace("/", "_")
+    return f"step{i:03d}_{safe}_{step_fingerprint(steps, i)}.npz"
+
+
+def latest_step(directory: str, steps, upto: int | None = None) -> int | None:
+    """Index of the newest step whose checkpoint exists in
+    ``directory`` under the CURRENT fingerprints, or ``None``.  Stale
+    files from an edited configuration never match (their fingerprint
+    differs), so they are simply ignored.  ``upto`` bounds the search
+    to indices ``<= upto`` — how a resumer skips past a checkpoint it
+    found unreadable and falls back to the next-newest one."""
+    hi = len(steps) - 1 if upto is None else min(upto, len(steps) - 1)
+    for i in range(hi, -1, -1):
+        if os.path.exists(os.path.join(directory, step_filename(steps, i))):
+            return i
+    return None
+
+
 class PipelineCheckpointer:
     """Run a ``Pipeline`` with a checkpoint after every step; resume
     skips steps whose checkpoint already exists.
@@ -131,11 +214,11 @@ class PipelineCheckpointer:
     >>> out = ckpt.run(data, backend="tpu")       # writes step files
     >>> out = ckpt.run(data, backend="tpu")       # resumes: loads last
 
-    Step files are named ``step{i:03d}_{transform}_{paramhash}.npz``;
-    a change to the step list OR to any step's parameters invalidates
-    mismatched names automatically (the hash covers every step up to
-    and including step ``i``, so editing an earlier step also
-    invalidates everything downstream of it).
+    Step files are named ``step{i:03d}_{transform}_{paramhash}.npz``
+    (see :func:`step_filename`); a change to the step list OR to any
+    step's parameters invalidates mismatched names automatically (the
+    hash covers every step up to and including step ``i``, so editing
+    an earlier step also invalidates everything downstream of it).
     """
 
     def __init__(self, pipeline, directory: str, save_every: int = 1):
@@ -145,73 +228,19 @@ class PipelineCheckpointer:
         os.makedirs(directory, exist_ok=True)
 
     def _step_path(self, i: int, steps) -> str:
-        import hashlib
-
-        name = steps[i].name
-        safe = name.replace(".", "_").replace("/", "_")
-
-        def sig(v, h):
-            # repr() alone is unsafe: numpy elides large arrays
-            # ("[0, 1, ..., 9]"), so two configs differing mid-array
-            # would collide — hash raw bytes for array-likes instead
-            if isinstance(v, (list, tuple)):
-                h.update(f"<{type(v).__name__}{len(v)}".encode())
-                for x in v:
-                    sig(x, h)
-                h.update(b">")
-            elif isinstance(v, dict):
-                h.update(f"<dict{len(v)}".encode())
-                for kk in sorted(v, key=repr):
-                    h.update(repr(kk).encode())
-                    sig(v[kk], h)
-                h.update(b">")
-            elif isinstance(v, np.ndarray) or type(v).__module__.startswith(
-                    ("jax", "jaxlib")):
-                a = np.asarray(v)
-                h.update(f"nd{a.dtype}{a.shape}".encode())
-                h.update(np.ascontiguousarray(a).tobytes())
-            else:
-                r = repr(v)
-                # A default object repr embeds the memory address
-                # ("<Foo object at 0x7f..>"), which changes every
-                # process — hashing it would silently invalidate every
-                # checkpoint on resume.  Strip addresses (stable across
-                # runs) and warn that the param carries no real state.
-                if " at 0x" in r:
-                    import re
-                    import warnings
-
-                    r = re.sub(r" at 0x[0-9a-fA-F]+", "", r)
-                    warnings.warn(
-                        f"PipelineCheckpointer: parameter {r!r} has no "
-                        "stable repr; its internal state is NOT part of "
-                        "the checkpoint hash — changing it will not "
-                        "invalidate old checkpoints", stacklevel=2)
-                h.update(r.encode())
-
-        # hash of the (name, sorted params) prefix chain — stale
-        # checkpoints from a different configuration (or an edited
-        # earlier step) are never resumed
-        h = hashlib.sha256()
-        for t in steps[: i + 1]:
-            h.update(t.name.encode())
-            sig(dict(t.params), h)
-        hx = h.hexdigest()[:10]
-        return os.path.join(self.directory, f"step{i:03d}_{safe}_{hx}.npz")
+        return os.path.join(self.directory, step_filename(steps, i))
 
     def run(self, data: CellData, backend: str | None = None,
             resume: bool = True) -> CellData:
         steps = list(self.pipeline.steps)
         start = 0
         if resume:
-            for i in range(len(steps) - 1, -1, -1):
-                p = self._step_path(i, steps)
-                if os.path.exists(p):
-                    data = load_celldata(p)
-                    if backend in (None, "tpu"):
-                        data = data.device_put()
-                    start = i + 1
-                    break
+            i = latest_step(self.directory, steps)
+            if i is not None:
+                data = load_celldata(self._step_path(i, steps))
+                if backend in (None, "tpu"):
+                    data = data.device_put()
+                start = i + 1
         for i in range(start, len(steps)):
             t = steps[i]
             if backend is not None and backend != t.backend:

@@ -19,6 +19,11 @@ is recoverable in-process; containment means subprocesses + watchdogs.
 * :class:`Heartbeat` — the child-side pulse emitter (any stderr line
   resets the parent's stall timer; ``beat()`` is a cheap explicit
   pulse for long device waits).
+* :func:`classify_error` — the retryable-error taxonomy: is an
+  exception a TRANSIENT device condition (retry with backoff) or a
+  DETERMINISTIC program error (retrying re-raises the same thing)?
+  The runner (``sctools_tpu/runner.py``) routes every step failure
+  through this one function so the retry policy exists exactly once.
 """
 
 from __future__ import annotations
@@ -31,6 +36,83 @@ import sys
 import tempfile
 import threading
 import time
+
+# ---------------------------------------------------------------------------
+# Retryable-error taxonomy
+# ---------------------------------------------------------------------------
+
+TRANSIENT = "transient"
+DETERMINISTIC = "deterministic"
+FATAL = "fatal"  # BaseException (process-death class): never retried
+
+
+class TransientDeviceError(RuntimeError):
+    """A device condition worth retrying: the tunneled worker died or
+    went unreachable (UNAVAILABLE), a watched child was killed for
+    wedging, a heartbeat deadline passed.  Raise this to *assert*
+    transience when the wrapped error type alone cannot prove it
+    (e.g. a contained subprocess death reported by run_isolated)."""
+
+
+# Substrings (lowercased) that mark an accelerator-runtime error as
+# transient.  jaxlib's XlaRuntimeError is one class for every gRPC
+# status, so the status name in the MESSAGE is the only signal; the
+# exact list is the round-1..5 crash corpus (bench.py history):
+# UNAVAILABLE / DEADLINE_EXCEEDED from a dead or unreachable tunnel
+# worker, ABORTED on worker restart, socket-level noise in between.
+# RESOURCE_EXHAUSTED is deliberately absent — an HBM OOM recurs at the
+# same shapes and must fail fast.
+_TRANSIENT_MARKERS = (
+    "unavailable",
+    "deadline_exceeded",
+    "deadline exceeded",
+    "aborted",
+    "connection reset",
+    "connection refused",
+    "connection closed",
+    "socket closed",
+    "broken pipe",
+    "failed to connect",
+    "heartbeat",
+)
+
+_TRANSIENT_TYPES = (TransientDeviceError, TimeoutError, ConnectionError,
+                    InterruptedError)
+# Program errors: identical inputs give an identical raise — a retry
+# can only burn the attempt budget.  Checked BEFORE the message scan
+# so a ValueError whose text happens to contain "aborted" stays
+# deterministic.
+_DETERMINISTIC_TYPES = (ValueError, TypeError, KeyError, IndexError,
+                        AttributeError, ArithmeticError, AssertionError,
+                        NotImplementedError)
+
+
+def classify_error(exc: BaseException) -> str:
+    """Classify ``exc`` as :data:`TRANSIENT`, :data:`DETERMINISTIC`
+    or :data:`FATAL`.
+
+    Type beats message: known-transient types (timeouts, connection
+    drops, :class:`TransientDeviceError`) and known-deterministic
+    types (ValueError/TypeError/shape errors …) are decided outright;
+    only the remaining grey zone — jaxlib's single XlaRuntimeError
+    class carrying any gRPC status — falls through to the
+    status-marker message scan.  Unknown errors default to
+    DETERMINISTIC: failing fast on a novel error is cheap to diagnose,
+    retrying a permanent one is not."""
+    if not isinstance(exc, Exception):
+        return FATAL
+    if isinstance(exc, _TRANSIENT_TYPES):
+        return TRANSIENT
+    if isinstance(exc, _DETERMINISTIC_TYPES):
+        return DETERMINISTIC
+    msg = f"{type(exc).__name__}: {exc}".lower()
+    if any(m in msg for m in _TRANSIENT_MARKERS):
+        return TRANSIENT
+    return DETERMINISTIC
+
+
+def is_transient(exc: BaseException) -> bool:
+    return classify_error(exc) == TRANSIENT
 
 
 def probe_device(timeout_s: float = 90.0, platform: str | None = None) -> dict:

@@ -16,9 +16,16 @@ layers here:
 from __future__ import annotations
 
 import contextlib
+import itertools
 import threading
 import time
 from dataclasses import dataclass, field
+
+# Process-wide monotonic span ids: external records (e.g. the
+# ResilientRunner's JSONL run journal) reference a span by id instead
+# of copying its timings, so one id joins the journal to the in-tree
+# span and to the profiler trace that wraps it.
+_span_ids = itertools.count(1)
 
 
 @dataclass
@@ -27,6 +34,8 @@ class Span:
     start: float
     duration: float = 0.0
     children: list = field(default_factory=list)
+    id: int = 0
+    meta: dict = field(default_factory=dict)
 
     def flat(self, depth=0):
         yield depth, self
@@ -56,9 +65,14 @@ def _sync_device():
 
 
 @contextlib.contextmanager
-def span(name: str, sync: bool = False):
-    """Context manager recording a (nested) timing span."""
-    s = Span(name, time.perf_counter())
+def span(name: str, sync: bool = False, meta: dict | None = None):
+    """Context manager recording a (nested) timing span.
+
+    ``meta`` attaches arbitrary journal-linkage payload (step index,
+    attempt number, …); the span's process-unique ``id`` is the join
+    key external records use."""
+    s = Span(name, time.perf_counter(), id=next(_span_ids),
+             meta=dict(meta) if meta else {})
     if _state.stack:
         _state.stack[-1].children.append(s)
     else:

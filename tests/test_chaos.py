@@ -1,0 +1,203 @@
+"""utils.chaos — deterministic fault injection.  Same seed + same
+faults must inject the same failures at the same calls (the contract
+that makes every recovery test reproducible); nothing here sleeps a
+real clock."""
+
+import numpy as np
+import pytest
+
+from sctools_tpu import registry
+from sctools_tpu.data.synthetic import synthetic_counts
+from sctools_tpu.registry import apply
+from sctools_tpu.utils.chaos import ChaosCrash, ChaosMonkey, Fault
+from sctools_tpu.utils.failsafe import TransientDeviceError
+
+
+def _data(n=100, g=40):
+    return synthetic_counts(n, g, n_clusters=2)
+
+
+def _drive(monkey, n_calls=8, op="normalize.log1p"):
+    """Apply ``op`` n_calls times under the monkey, recording which
+    calls raised."""
+    data = _data()
+    raised = []
+    with monkey.activate():
+        for i in range(1, n_calls + 1):
+            try:
+                apply(op, data, backend="cpu")
+            except TransientDeviceError:
+                raised.append(i)
+    return raised
+
+
+def test_fault_rejects_unknown_mode():
+    with pytest.raises(ValueError, match="Fault mode"):
+        Fault("x", "explode")
+
+
+def test_nth_call_window():
+    monkey = ChaosMonkey(
+        [Fault("normalize.log1p", "unavailable", on_call=2, times=2)])
+    assert _drive(monkey, 5) == [2, 3]
+    assert [r["call"] for r in monkey.injected] == [2, 3]
+
+
+def test_times_minus_one_means_forever():
+    monkey = ChaosMonkey(
+        [Fault("normalize.log1p", "unavailable", times=-1)])
+    assert _drive(monkey, 4) == [1, 2, 3, 4]
+
+
+def test_fnmatch_pattern_scopes_the_fault():
+    monkey = ChaosMonkey([Fault("normalize.*", "unavailable",
+                                times=-1)])
+    data = _data()
+    with monkey.activate():
+        apply("qc.per_cell_metrics", data, backend="cpu")  # unmatched
+        with pytest.raises(TransientDeviceError):
+            apply("normalize.log1p", data, backend="cpu")
+        with pytest.raises(TransientDeviceError):
+            apply("normalize.library_size", data, backend="cpu")
+    assert {r["op"] for r in monkey.injected} == {
+        "normalize.log1p", "normalize.library_size"}
+
+
+def test_backend_restriction():
+    monkey = ChaosMonkey(
+        [Fault("normalize.log1p", "unavailable", times=-1,
+               backend="tpu")])
+    data = _data()
+    with monkey.activate():
+        apply("normalize.log1p", data, backend="cpu")  # unaffected
+        with pytest.raises(TransientDeviceError):
+            apply("normalize.log1p", data, backend="tpu")
+
+
+def test_probabilistic_faults_are_seed_deterministic():
+    faults = [Fault("normalize.log1p", "unavailable", times=-1, p=0.5)]
+    a = ChaosMonkey(faults, seed=3)
+    b = ChaosMonkey([Fault(**{**f.__dict__}) for f in faults], seed=3)
+    ra, rb = _drive(a, 20), _drive(b, 20)
+    assert ra == rb  # same seed -> identical injection schedule
+    assert a.injected == b.injected
+    assert 0 < len(ra) < 20  # p=0.5 actually gates some calls
+
+
+def test_crash_is_base_exception():
+    monkey = ChaosMonkey([Fault("normalize.log1p", "crash")])
+    data = _data()
+    with monkey.activate():
+        with pytest.raises(ChaosCrash):
+            try:
+                apply("normalize.log1p", data, backend="cpu")
+            except Exception:  # noqa: BLE001 — the point: a plain
+                pytest.fail("except Exception must NOT catch "
+                            "ChaosCrash")  # handler can't swallow it
+
+
+def test_hang_uses_injectable_sleeper_no_real_clock():
+    slept = []
+    monkey = ChaosMonkey([Fault("normalize.log1p", "hang")],
+                         hang_s=3600.0, sleep=slept.append)
+    data = _data()
+    with monkey.activate():
+        out = apply("normalize.log1p", data, backend="cpu")
+    assert slept == [3600.0]  # the wedge went through the fake clock
+    assert out.X.shape == data.X.shape  # then the op ran normally
+
+
+def test_corrupt_is_deterministic_and_detectable():
+    def run_once():
+        monkey = ChaosMonkey([Fault("normalize.log1p", "corrupt")],
+                             seed=11)
+        with monkey.activate():
+            return apply("normalize.log1p", _data(), backend="cpu")
+
+    a, b = run_once(), run_once()
+    Xa = np.asarray(a.to_host().X.todense()
+                    if hasattr(a.to_host().X, "todense")
+                    else a.to_host().X)
+    Xb = np.asarray(b.to_host().X.todense()
+                    if hasattr(b.to_host().X, "todense")
+                    else b.to_host().X)
+    na, nb = np.flatnonzero(np.isnan(Xa.ravel())), \
+        np.flatnonzero(np.isnan(Xb.ravel()))
+    assert len(na) == 1  # exactly one silently-damaged element
+    assert na.tolist() == nb.tolist()  # at the same seed-pinned spot
+
+
+def test_spec_roundtrip_preserves_call_counters():
+    monkey = ChaosMonkey(
+        [Fault("normalize.log1p", "unavailable", on_call=3)], seed=5)
+    _drive(monkey, 2)  # calls 1..2: below on_call, nothing fires
+    clone = ChaosMonkey.from_spec(monkey.spec())
+    assert clone.calls == {"normalize.log1p": 2}
+    # the clone continues the count: its next call is #3 -> fires
+    assert _drive(clone, 1) == [1]
+    assert clone.injected[0]["call"] == 3
+
+
+def test_note_external_call_advances_counter():
+    monkey = ChaosMonkey(
+        [Fault("normalize.log1p", "unavailable", on_call=2)])
+    monkey.note_external_call("normalize.log1p")  # a contained child ran it
+    assert _drive(monkey, 1) == [1]  # in-process call is #2 -> fires
+
+
+def test_activate_restores_clean_registry():
+    monkey = ChaosMonkey(
+        [Fault("normalize.log1p", "unavailable", times=-1)])
+    data = _data()
+    with monkey.activate():
+        with pytest.raises(TransientDeviceError):
+            apply("normalize.log1p", data, backend="cpu")
+    # wrapper uninstalled: the op runs clean again
+    out = apply("normalize.log1p", data, backend="cpu")
+    assert out.X.shape == data.X.shape
+    assert not registry._CALL_WRAPPERS
+
+
+def test_activate_is_reentrant_single_wrap():
+    """Nested activation of the same monkey (external `with` around a
+    runner given chaos=) must install ONE wrapper — a double wrap
+    would double-count calls and shift Nth-call faults."""
+    monkey = ChaosMonkey(
+        [Fault("normalize.log1p", "unavailable", on_call=2)])
+    data = _data()
+    with monkey.activate():
+        with monkey.activate():
+            assert registry._CALL_WRAPPERS.count(monkey._wrap) == 1
+            apply("normalize.log1p", data, backend="cpu")  # call 1
+            with pytest.raises(TransientDeviceError):
+                apply("normalize.log1p", data, backend="cpu")  # call 2
+        # inner exit must NOT uninstall the outer activation
+        assert registry._CALL_WRAPPERS.count(monkey._wrap) == 1
+    assert not registry._CALL_WRAPPERS
+    assert monkey.calls["normalize.log1p"] == 2
+
+
+def test_corrupt_handles_integer_sparse_counts():
+    """Raw 10x counts are integer CSR — the corrupt mode must cast,
+    not raise, so the silent-corruption recovery path is testable on
+    realistic inputs."""
+    import scipy.sparse as sp
+
+    data = _data()
+    assert sp.issparse(data.X)
+    intdata = data.with_X(data.X.astype(np.int32))
+    monkey = ChaosMonkey([Fault("util.snapshot_layer", "corrupt")],
+                         seed=2)
+    with monkey.activate():
+        out = apply("util.snapshot_layer", intdata, layer="c",
+                    backend="cpu")
+    X = out.to_host().X
+    assert np.isnan(X.data).sum() == 1
+
+
+def test_activate_unwinds_on_exception():
+    monkey = ChaosMonkey([])
+    with pytest.raises(RuntimeError, match="boom"):
+        with monkey.activate():
+            raise RuntimeError("boom")
+    assert not registry._CALL_WRAPPERS

@@ -327,3 +327,18 @@ def test_randomized_pca_sketch_wider_than_features():
         assert np.isfinite(P).all()
         ev = np.asarray(out.uns["pca_explained_variance"])
         assert np.isfinite(ev).all() and (ev >= -1e-6).all()
+
+
+def test_refine_mode_auto_thresholds_on_n_cand():
+    """'auto' routes the >=786k-candidate regime onto the sorted
+    gather (measured ~10x cheaper there) and keeps smaller tables on
+    the on-chip blocked path."""
+    from sctools_tpu.config import config, configure
+
+    with configure(knn_refine_mode="auto"):
+        cut = config.refine_sorted_min_cand
+        assert cut == 786432  # 6 x 131072, the r5 measured breakpoint
+        assert config.resolved_refine_mode(cut - 1) == "blocked"
+        assert config.resolved_refine_mode(cut) == "sorted"
+    with configure(knn_refine_mode="blocked"):
+        assert config.resolved_refine_mode(cut) == "blocked"

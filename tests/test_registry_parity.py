@@ -1,0 +1,24 @@
+"""tools/check_registry_parity.py as a tier-1 gate: every registered
+transform has both cpu and tpu backends (or an allowlist entry with a
+reason) — the pairing the oracle tests AND the runner's degrade-to-cpu
+fallback both depend on."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "tools"))
+
+from check_registry_parity import ALLOWLIST, check  # noqa: E402
+
+
+def test_registry_parity():
+    problems = check()
+    assert not problems, "\n".join(problems)
+
+
+def test_allowlist_entries_have_reasons():
+    for name, reason in ALLOWLIST.items():
+        assert reason and reason.strip(), (
+            f"allowlist entry {name!r} has no reason — state why the "
+            f"parity exemption is intentional")

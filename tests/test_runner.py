@@ -1,0 +1,438 @@
+"""ResilientRunner — retry classification, backoff schedule, health-
+checked CPU fallback, checkpointed resume, run journal.  Everything
+runs on the CPU backend with injected probes/sleepers: ZERO real
+sleeps, no accelerator, faults injected deterministically by
+utils.chaos (the whole point — recovery paths exercised in tier-1
+instead of only on a live flaky tunnel)."""
+
+import json
+import os
+import random
+
+import numpy as np
+import pytest
+
+from sctools_tpu.data.synthetic import synthetic_counts
+from sctools_tpu.recipes import run_recipe, seurat_pipeline
+from sctools_tpu.registry import Pipeline, register
+from sctools_tpu.runner import (ResilientRunError, ResilientRunner,
+                                RetryPolicy)
+from sctools_tpu.utils.chaos import ChaosCrash, ChaosMonkey, Fault
+from sctools_tpu.utils.failsafe import (DETERMINISTIC, FATAL, TRANSIENT,
+                                        TransientDeviceError,
+                                        classify_error)
+
+OK_PROBE = {"ok": True, "device_kind": "test", "wall_s": 0.0}
+DOWN_PROBE = {"ok": False, "reason": "test-ruled-down"}
+
+
+@pytest.fixture
+def boom_op():
+    """A transform that always raises ValueError, registered under the
+    reserved ``test.`` fixture prefix and removed on teardown so the
+    registry-wide gates (docs coverage, cpu/tpu parity) never see it."""
+
+    @register("test.boom", backend="tpu")
+    @register("test.boom", backend="cpu")
+    def _boom(data, **kw):
+        raise ValueError("test.boom: deliberate shape mismatch")
+
+    yield "test.boom"
+    registry_mod = __import__("sctools_tpu.registry",
+                              fromlist=["_REGISTRY", "_DOCS"])
+    registry_mod._REGISTRY.pop("test.boom", None)
+    registry_mod._DOCS.pop("test.boom", None)
+
+
+def _data(n=300, g=120):
+    return synthetic_counts(n, g, n_clusters=3)
+
+
+def _pipe(**kw):
+    kw.setdefault("n_top_genes", 50)
+    kw.setdefault("min_genes", 1)
+    kw.setdefault("min_cells", 1)
+    return seurat_pipeline(**kw)
+
+
+def _runner(pipe, **kw):
+    kw.setdefault("probe", lambda: dict(OK_PROBE))
+    kw.setdefault("sleep", lambda s: None)
+    return ResilientRunner(pipe, **kw)
+
+
+def _journal(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+def _dense(X):
+    if hasattr(X, "todense"):
+        return np.asarray(X.todense())
+    return np.asarray(X)
+
+
+# ---------------------------------------------------------------- taxonomy
+
+def test_classify_error_taxonomy():
+    assert classify_error(TransientDeviceError("x")) == TRANSIENT
+    assert classify_error(TimeoutError()) == TRANSIENT
+    assert classify_error(ConnectionResetError()) == TRANSIENT
+    # jaxlib's XlaRuntimeError is one class for every gRPC status —
+    # the status name in the message is the only signal
+    assert classify_error(RuntimeError("UNAVAILABLE: socket closed")) \
+        == TRANSIENT
+    assert classify_error(RuntimeError("DEADLINE_EXCEEDED")) == TRANSIENT
+    assert classify_error(ValueError("shape mismatch")) == DETERMINISTIC
+    assert classify_error(TypeError()) == DETERMINISTIC
+    # type beats message: a ValueError mentioning "aborted" is still
+    # a program error
+    assert classify_error(ValueError("user aborted the run")) \
+        == DETERMINISTIC
+    # unknown errors fail fast, not retry
+    assert classify_error(RuntimeError("novel weirdness")) \
+        == DETERMINISTIC
+    # RESOURCE_EXHAUSTED recurs at the same shapes — never retried
+    assert classify_error(RuntimeError("RESOURCE_EXHAUSTED: HBM OOM")) \
+        == DETERMINISTIC
+    assert classify_error(KeyboardInterrupt()) == FATAL
+    assert classify_error(SystemExit(1)) == FATAL
+    assert classify_error(ChaosCrash("preempted")) == FATAL
+
+
+def test_retry_policy_schedule_no_jitter():
+    p = RetryPolicy(base_delay_s=0.5, multiplier=2.0, max_delay_s=3.0,
+                    jitter=0.0)
+    rng = random.Random(0)
+    assert [p.delay_s(n, rng) for n in (1, 2, 3, 4, 5)] == \
+        [0.5, 1.0, 2.0, 3.0, 3.0]  # capped at max_delay_s
+
+
+def test_retry_policy_jitter_seeded_and_bounded():
+    p = RetryPolicy(base_delay_s=1.0, multiplier=2.0, jitter=0.5, seed=7)
+    a = [p.delay_s(n, random.Random(7)) for n in (1, 1, 1)]
+    assert a[0] == a[1] == a[2]  # same rng state -> same delay
+    rng = random.Random(7)
+    for n in (1, 2, 3):
+        d = p.delay_s(n, rng)
+        base = 1.0 * 2.0 ** (n - 1)
+        assert 0.5 * base <= d <= 1.5 * base
+
+
+# ------------------------------------------------------------ retry paths
+
+def test_transient_retries_then_succeeds(tmp_path):
+    data, pipe = _data(), _pipe()
+    base = pipe.run(data, backend="cpu")
+    monkey = ChaosMonkey([Fault("hvg.select", "unavailable", times=1)])
+    sleeps = []
+    r = _runner(pipe, checkpoint_dir=str(tmp_path), sleep=sleeps.append)
+    with monkey.activate():
+        out = r.run(data, backend="cpu")
+    hvg = next(s for s in r.report.steps if s.name == "hvg.select")
+    assert [a.status for a in hvg.attempts] == ["error", "ok"]
+    assert hvg.attempts[0].classified == TRANSIENT
+    assert len(sleeps) == 1  # one backoff, via the injected sleeper
+    np.testing.assert_allclose(np.asarray(base.X), np.asarray(out.X),
+                               atol=1e-6)
+
+
+def test_backoff_schedule_pinned_against_fake_clock():
+    data, pipe = _data(), _pipe()
+    policy = RetryPolicy(max_attempts=4, base_delay_s=0.5,
+                         multiplier=2.0, jitter=0.5, seed=42)
+    monkey = ChaosMonkey(
+        [Fault("normalize.log1p", "unavailable", times=3)])
+    sleeps = []
+    r = _runner(pipe, policy=policy, sleep=sleeps.append,
+                fallback_backend=None)
+    with monkey.activate():
+        r.run(data, backend="cpu")
+    # deterministic seeded jitter: the exact schedule is reproducible
+    rng = random.Random(42)
+    assert sleeps == [policy.delay_s(n, rng) for n in (1, 2, 3)]
+    for n, d in enumerate(sleeps, 1):
+        base = 0.5 * 2.0 ** (n - 1)
+        assert 0.5 * base <= d <= 1.5 * base
+
+
+def test_deterministic_error_fails_fast_no_retry(boom_op):
+    data = _data()
+    pipe = Pipeline([("qc.per_cell_metrics", {}), (boom_op, {}),
+                     ("normalize.log1p", {})])
+    sleeps = []
+    r = _runner(pipe, sleep=sleeps.append)
+    with pytest.raises(ValueError, match="deliberate shape mismatch"):
+        r.run(data, backend="cpu")
+    boom = r.report.steps[1]
+    assert len(boom.attempts) == 1  # NO retry on a deterministic raise
+    assert boom.attempts[0].classified == DETERMINISTIC
+    assert boom.status == "failed"
+    assert sleeps == []  # and no backoff was scheduled
+    assert r.report.steps[2].status == "pending"
+
+
+def test_validate_hook_failure_is_the_attempts_failure():
+    data, pipe = _data(), _pipe()
+
+    def validate(i, name, out):
+        if name == "normalize.scale":
+            raise ValueError("validator: NaN in result")
+
+    r = _runner(pipe, validate=validate)
+    with pytest.raises(ValueError, match="validator"):
+        r.run(data, backend="cpu")
+    scale = next(s for s in r.report.steps
+                 if s.name == "normalize.scale")
+    assert len(scale.attempts) == 1  # ValueError -> fail fast
+
+
+def test_exhausted_budget_raises_with_report():
+    data, pipe = _data(), _pipe()
+    monkey = ChaosMonkey(
+        [Fault("normalize.log1p", "unavailable", times=-1)])
+    r = _runner(pipe, policy=RetryPolicy(max_attempts=3),
+                fallback_backend=None)
+    with monkey.activate():
+        with pytest.raises(ResilientRunError) as ei:
+            r.run(data, backend="cpu")
+    assert isinstance(ei.value.__cause__, TransientDeviceError)
+    report = ei.value.report
+    step = next(s for s in report.steps if s.name == "normalize.log1p")
+    assert len(step.attempts) == 3
+    assert report.status == "failed"
+
+
+# ------------------------------------------------------------- fallback
+
+def test_unhealthy_device_degrades_to_cpu_with_loud_warning():
+    data, pipe = _data(), _pipe()
+    # a TPU-only outage: the fault never fires on the cpu backend
+    monkey = ChaosMonkey(
+        [Fault("normalize.log1p", "unavailable", times=-1,
+               backend="tpu")])
+    r = _runner(pipe, probe=lambda: dict(DOWN_PROBE),
+                policy=RetryPolicy(max_attempts=2),
+                fallback_backend="cpu")
+    with monkey.activate():
+        with pytest.warns(RuntimeWarning, match="DEGRADING"):
+            out = r.run(data, backend="tpu")
+    assert r.report.degraded
+    assert r.report.backend == "cpu"
+    step = next(s for s in r.report.steps
+                if s.name == "normalize.log1p")
+    # 2 failed tpu attempts, then a fresh budget on cpu
+    assert [a.backend for a in step.attempts] == ["tpu", "tpu", "cpu"]
+    assert step.status == "completed"
+    assert out.X.shape[1] == 50
+
+
+def test_preflight_probe_degrades_before_first_step():
+    data, pipe = _data(), _pipe()
+    r = _runner(pipe, probe=lambda: dict(DOWN_PROBE), preflight=True,
+                fallback_backend="cpu")
+    with pytest.warns(RuntimeWarning, match="preflight"):
+        r.run(data, backend="tpu")
+    assert r.report.degraded
+    assert all(a.backend == "cpu" for s in r.report.steps
+               for a in s.attempts)
+
+
+# -------------------------------------------------------------- resume
+
+def test_crash_then_resume_from_step_checkpoint(tmp_path):
+    data, pipe = _data(), _pipe()
+    base = pipe.run(data, backend="cpu")
+    monkey = ChaosMonkey([Fault("hvg.select", "crash", times=1)])
+    r1 = _runner(pipe, checkpoint_dir=str(tmp_path))
+    with monkey.activate():
+        with pytest.raises(ChaosCrash):
+            r1.run(data, backend="cpu")
+    assert r1.report.status == "aborted"
+
+    # a NEW runner (the killed process restarted) resumes mid-pipeline
+    r2 = _runner(pipe, checkpoint_dir=str(tmp_path))
+    out = r2.run(data, backend="cpu", resume=True)
+    hvg_i = next(i for i, s in enumerate(r2.report.steps)
+                 if s.name == "hvg.select")
+    assert r2.report.resumed_from == hvg_i - 1
+    assert all(s.status == "resumed"
+               for s in r2.report.steps[:hvg_i])
+    np.testing.assert_allclose(np.asarray(base.X), np.asarray(out.X),
+                               atol=1e-6)
+
+
+def test_resume_invalidates_only_downstream_of_an_edit(tmp_path):
+    data = _data()
+    _runner(_pipe(), checkpoint_dir=str(tmp_path)).run(
+        data, backend="cpu")
+    # editing the HVG step invalidates it and everything after it,
+    # but the shared 6-step prefix stays resumable
+    r = _runner(_pipe(n_top_genes=40), checkpoint_dir=str(tmp_path))
+    out = r.run(data, backend="cpu", resume=True)
+    hvg_i = next(i for i, s in enumerate(r.report.steps)
+                 if s.name == "hvg.select")
+    assert r.report.resumed_from == hvg_i - 1
+    assert out.X.shape[1] == 40
+
+    # editing an EARLY step invalidates all downstream checkpoints
+    r2 = _runner(_pipe(target_sum=2e4), checkpoint_dir=str(tmp_path))
+    r2.run(data, backend="cpu", resume=True)
+    lib_i = next(i for i, s in enumerate(r2.report.steps)
+                 if s.name == "normalize.library_size")
+    assert r2.report.resumed_from == lib_i - 1
+
+
+def test_chaos_param_activates_for_the_whole_run():
+    """chaos= alone (no external activate()) must inject on ordinary
+    in-process steps — the runner owns the activation."""
+    data, pipe = _data(), _pipe()
+    monkey = ChaosMonkey([Fault("hvg.select", "unavailable", times=1)])
+    r = _runner(pipe, chaos=monkey)
+    r.run(data, backend="cpu")
+    assert monkey.injected and monkey.injected[0]["op"] == "hvg.select"
+    hvg = next(s for s in r.report.steps if s.name == "hvg.select")
+    assert [a.status for a in hvg.attempts] == ["error", "ok"]
+
+
+def test_corrupt_checkpoint_falls_back_to_previous(tmp_path):
+    data, pipe = _data(), _pipe()
+    base = _runner(pipe, checkpoint_dir=str(tmp_path)).run(
+        data, backend="cpu")
+    # damage the newest checkpoint in place; the intact earlier ones
+    # must still be used (not discarded for a from-scratch rerun)
+    newest = max(tmp_path.glob("step*.npz"), key=lambda p: p.name)
+    newest.write_bytes(b"not an npz")
+    r = _runner(pipe, checkpoint_dir=str(tmp_path))
+    with pytest.warns(RuntimeWarning, match="unreadable"):
+        out = r.run(data, backend="cpu", resume=True)
+    n = len(r.report.steps)
+    assert r.report.resumed_from == n - 2  # next-newest checkpoint
+    assert [s.status for s in r.report.steps] == \
+        ["resumed"] * (n - 1) + ["completed"]
+    np.testing.assert_allclose(_dense(base.X), _dense(out.X), atol=1e-6)
+
+
+def test_all_checkpoints_corrupt_restarts_from_scratch(tmp_path):
+    data, pipe = _data(), _pipe()
+    _runner(pipe, checkpoint_dir=str(tmp_path)).run(data, backend="cpu")
+    for p in tmp_path.glob("step*.npz"):
+        p.write_bytes(b"not an npz")
+    r = _runner(pipe, checkpoint_dir=str(tmp_path))
+    with pytest.warns(RuntimeWarning, match="unreadable"):
+        out = r.run(data, backend="cpu", resume=True)
+    assert r.report.resumed_from is None  # full rerun, not a crash
+    assert all(s.status == "completed" for s in r.report.steps)
+    assert out.X.shape[1] == 50
+
+
+def test_resume_false_reruns_from_scratch(tmp_path):
+    data, pipe = _data(), _pipe()
+    _runner(pipe, checkpoint_dir=str(tmp_path)).run(data, backend="cpu")
+    r = _runner(pipe, checkpoint_dir=str(tmp_path))
+    r.run(data, backend="cpu", resume=False)
+    assert r.report.resumed_from is None
+    assert all(s.status == "completed" for s in r.report.steps)
+
+
+def test_fully_resumed_run_returns_final_result(tmp_path):
+    data, pipe = _data(), _pipe()
+    first = _runner(pipe, checkpoint_dir=str(tmp_path)).run(
+        data, backend="cpu")
+    r = _runner(pipe, checkpoint_dir=str(tmp_path))
+    again = r.run(data, backend="cpu", resume=True)
+    assert r.report.resumed_from == len(r.report.steps) - 1
+    assert all(not s.attempts for s in r.report.steps)  # nothing re-ran
+    np.testing.assert_allclose(_dense(first.X), _dense(again.X),
+                               atol=1e-6)
+
+
+# ----------------------------------------------------- acceptance e2e
+
+def test_chaos_end_to_end_recovery_identical_to_fault_free(tmp_path):
+    """The acceptance scenario: a seurat run with one transient
+    UNAVAILABLE (retried in-run) plus a mid-pipeline process crash
+    (aborts the run), resumed by a fresh runner, completing with
+    results identical to a fault-free run — every attempt journaled
+    with its classified error."""
+    data, pipe = _data(), _pipe()
+    base = pipe.run(data, backend="cpu")
+
+    monkey = ChaosMonkey([
+        Fault("normalize.log1p", "unavailable", times=1),
+        Fault("hvg.select", "crash", times=1),
+    ])
+    ck = str(tmp_path)
+    r1 = _runner(pipe, checkpoint_dir=ck)
+    with monkey.activate():
+        with pytest.raises(ChaosCrash):
+            r1.run(data, backend="cpu")
+
+    r2 = _runner(pipe, checkpoint_dir=ck)
+    out = r2.run(data, backend="cpu", resume=True)
+    assert r2.report.status == "completed"
+    np.testing.assert_allclose(np.asarray(base.X), np.asarray(out.X),
+                               atol=1e-6)
+    assert list(out.var_names) == list(base.var_names)
+
+    events = _journal(os.path.join(ck, "journal.jsonl"))
+    attempts = [e for e in events if e["event"] == "attempt"]
+    # every error attempt carries its classification
+    errors = [e for e in attempts if e["status"] == "error"]
+    assert {e["classified"] for e in errors} == {TRANSIENT, FATAL}
+    log1p = [e for e in errors if e["name"] == "normalize.log1p"]
+    assert log1p and log1p[0]["classified"] == TRANSIENT
+    crash = [e for e in errors if e["name"] == "hvg.select"]
+    assert crash and crash[0]["classified"] == FATAL
+    # the resumed run is journaled as such, in the same file
+    assert [e["event"] for e in events].count("run_start") == 2
+    assert any(e["event"] == "resume" for e in events)
+    assert events[-1]["event"] == "run_completed"
+    # attempts link to trace spans
+    assert all(e.get("span_id", 0) > 0 for e in attempts)
+
+
+def test_run_recipe_resilient_wrapper(tmp_path):
+    data = _data()
+    base = _pipe().run(data, backend="cpu")
+    out = run_recipe(
+        "seurat", data, backend="cpu", checkpoint_dir=str(tmp_path),
+        runner_kw={"probe": lambda: dict(OK_PROBE),
+                   "sleep": lambda s: None},
+        n_top_genes=50, min_genes=1, min_cells=1)
+    np.testing.assert_allclose(np.asarray(base.X), np.asarray(out.X),
+                               atol=1e-6)
+    assert os.path.exists(os.path.join(str(tmp_path), "journal.jsonl"))
+
+
+def test_run_recipe_unknown_name():
+    with pytest.raises(KeyError, match="weinreb17 is one-call only"):
+        run_recipe("weinreb17", _data())
+
+
+# ---------------------------------------------------------- containment
+
+def test_isolated_step_contains_real_process_death(tmp_path):
+    """chaos 'kill' (os._exit(9)) inside a contained child: the child
+    dies for real, the runner's process survives, classifies the death
+    transient, and the retry — with the chaos call-counter advanced
+    across the process boundary — completes the step."""
+    data, pipe = _data(150, 80), Pipeline([
+        ("qc.per_cell_metrics", {}),
+        ("normalize.library_size", {"target_sum": 1e4}),
+        ("normalize.log1p", {}),
+    ])
+    base = pipe.run(data, backend="cpu")
+    monkey = ChaosMonkey(
+        [Fault("normalize.log1p", "kill", times=1)])
+    r = _runner(pipe, checkpoint_dir=str(tmp_path),
+                isolate={"normalize.log1p"}, chaos=monkey,
+                isolate_timeout_s=240.0, isolate_stall_s=120.0)
+    with monkey.activate():
+        out = r.run(data, backend="cpu")
+    step = next(s for s in r.report.steps
+                if s.name == "normalize.log1p")
+    assert step.isolated
+    assert [a.status for a in step.attempts] == ["error", "ok"]
+    assert step.attempts[0].classified == TRANSIENT
+    np.testing.assert_allclose(_dense(base.X), _dense(out.X), atol=1e-6)
