@@ -199,6 +199,14 @@ def filter_cells_tpu(
     max_genes: int | None = None,
     max_counts: float | None = None,
 ) -> CellData:
+    """Drop cells outside the given QC bounds (scanpy
+    ``pp.filter_cells`` semantics, all bounds inclusive; ``max_pct_mt``
+    additionally caps mitochondrial fraction when
+    ``obs["pct_counts_mt"]`` exists).  Requires ``qc.per_cell_metrics``
+    first — raises if ``obs`` lacks n_genes/total_counts.  Subsetting
+    changes shapes, so this is a materialisation point: the keep mask
+    is computed on device, the row gather re-pads host-side; ``obsp``
+    is dropped (pairwise graphs must be rebuilt)."""
     X = data.X
     keep = _cell_keep_mask(data, min_genes, min_counts, max_pct_mt, jnp,
                            max_genes, max_counts)
@@ -327,6 +335,12 @@ def filter_genes_tpu(data: CellData, min_cells: int | None = 3,
                      min_counts: float | None = None,
                      max_cells: int | None = None,
                      max_counts: float | None = None) -> CellData:
+    """Drop genes outside the given QC bounds (scanpy
+    ``pp.filter_genes`` semantics, bounds inclusive, on
+    ``var["n_cells"]``/``var["total_counts"]`` — computed via
+    ``qc.per_gene_metrics`` on demand).  A materialisation point like
+    ``qc.filter_cells``: the column subset re-lays-out the ELL matrix
+    at a new padded width."""
     from .hvg import select_genes_device  # shared gene-subset machinery
 
     if "n_cells" not in data.var:

@@ -27,8 +27,11 @@ tree leaves and get an exact sparse comparison.
 from __future__ import annotations
 
 import dataclasses
+import logging
 
 import numpy as np
+
+_logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
@@ -58,14 +61,32 @@ def _leaf_mismatch(a, b, exact: bool, atol: float):
     try:
         a_np = np.asarray(a)
         b_np = np.asarray(b)
-    except Exception:
-        return None if (a is b or a == b) else "non-array mismatch"
+    except Exception as e:
+        # non-arrayable leaf (custom object in uns, etc.) — fall back
+        # to identity/equality, and log what was swallowed so a
+        # conversion failure is diagnosable rather than silent
+        _logger.debug("leaf not array-convertible (%s: %s); comparing "
+                      "by equality", type(e).__name__, e)
+        try:
+            same = a is b or bool(a == b)
+        except Exception as e2:  # incomparable objects are a mismatch
+            return (f"non-array leaf, equality check failed "
+                    f"({type(e2).__name__}: {e2})")
+        return None if same else (
+            f"non-array mismatch (asarray failed: {type(e).__name__})")
     if a_np.shape != b_np.shape or a_np.dtype != b_np.dtype:
         return (f"shape/dtype {a_np.shape}/{a_np.dtype} vs "
                 f"{b_np.shape}/{b_np.dtype}")
     if a_np.dtype.kind in "OUS":
-        return (None if np.array_equal(a_np, b_np)
-                else "string/object mismatch")
+        # object arrays compare via each element's __eq__, which can
+        # itself raise — a determinism CHECK must report that, not
+        # crash the run it is checking
+        try:
+            same = bool(np.array_equal(a_np, b_np))
+        except Exception as e:
+            return (f"object equality raised "
+                    f"({type(e).__name__}: {e})")
+        return None if same else "string/object mismatch"
     if exact:
         if np.array_equal(a_np, b_np, equal_nan=True):
             return None

@@ -120,7 +120,15 @@ def profile(logdir: str):
     try:
         jax.profiler.start_trace(logdir)
         started = True
-    except Exception:
+    except Exception as e:
+        # degrade to a plain span, but say so — a silently missing
+        # trace looks exactly like a trace that was never requested
+        import warnings
+
+        warnings.warn(
+            f"profiler start_trace failed ({type(e).__name__}: {e}); "
+            f"recording a wall-clock span only — no XLA trace in "
+            f"{logdir}", stacklevel=2)
         started = False
     with span(f"profile:{logdir}"):
         try:

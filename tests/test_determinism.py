@@ -109,3 +109,54 @@ def test_scipy_sparse_leaves_compared_fully():
 def test_runs_validation():
     with pytest.raises(ValueError, match="asserts nothing"):
         check_deterministic(lambda: 1, runs=1)
+
+
+def test_non_arrayable_leaf_compared_by_identity():
+    """Leaves numpy can't convert (raising ``__array__``) fall back to
+    identity/equality instead of crashing — and the swallowed
+    conversion error is logged, not silent (sctlint SCT005).  A plain
+    object WITHOUT ``__array__`` takes the 0-d-object-array path
+    instead; both must come out ok for an identical leaf."""
+    class NotArrayable:
+        def __array__(self, *a, **kw):
+            raise TypeError("refuses conversion")
+
+    class Opaque:
+        pass
+
+    na, o = NotArrayable(), Opaque()
+    rep = check_deterministic(
+        lambda: {"x": np.arange(3), "na": na, "o": o})
+    assert rep.ok, rep.mismatches
+
+
+def test_not_arrayable_and_incomparable_reported():
+    """Worst case — neither arrayable nor comparable: the check must
+    report the failed equality as the mismatch reason, not raise."""
+    class Nasty:
+        def __array__(self, *a, **kw):
+            raise TypeError("no array")
+
+        def __eq__(self, other):
+            raise TypeError("no eq")
+        __hash__ = None
+
+    outs = [Nasty(), Nasty()]
+    rep = check_deterministic(lambda: outs.pop(0))
+    assert not rep.ok
+    assert "equality check failed" in str(rep.mismatches[0][1])
+
+
+def test_raising_eq_reported_not_raised():
+    """An object whose __eq__ raises must surface as a mismatch
+    REASON; the determinism check itself never crashes the run it is
+    checking."""
+    class Hostile:
+        def __eq__(self, other):
+            raise TypeError("nope")
+        __hash__ = None
+
+    outs = [Hostile(), Hostile()]
+    rep = check_deterministic(lambda: outs.pop(0))
+    assert not rep.ok
+    assert "raised" in str(rep.mismatches[0][1])

@@ -1,0 +1,640 @@
+"""sctlint as a tier-1 gate: per-rule unit tests on synthetic
+snippets (violating / clean / suppressed / baselined), the framework
+mechanics (suppression comments, baseline fingerprint drift
+resistance, stale-entry detection, CLI exit codes), and the
+enforcement test — ``sctools_tpu/`` is clean modulo the committed
+baseline, and every baseline entry carries a written reason."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from tools.sctlint import RULES, Baseline, run_lint  # noqa: E402
+from tools.sctlint.baseline import assign_fingerprints  # noqa: E402
+from tools.sctlint.cli import default_baseline_path, main  # noqa: E402
+
+_PRELUDE = """\
+import functools
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sctools_tpu.registry import register
+"""
+
+
+def lint_src(tmp_path, src, only=None, name="snippet.py",
+             baseline=None, prelude=True):
+    p = tmp_path / name
+    p.write_text((_PRELUDE if prelude else "") + textwrap.dedent(src))
+    return run_lint([str(p)], root=str(tmp_path), only=only,
+                    baseline=baseline, project_rules=False)
+
+
+def rule_ids(result):
+    return [v.rule for v in result.violations]
+
+
+# ---------------------------------------------------------------------------
+# SCT001 — host sync in jit
+# ---------------------------------------------------------------------------
+
+def test_sct001_flags_cast_of_traced_local(tmp_path):
+    r = lint_src(tmp_path, """
+        @jax.jit
+        def f(x):
+            t = jnp.sum(x)
+            return float(t)
+        """, only=["SCT001"])
+    assert rule_ids(r) == ["SCT001"]
+    assert "float" in r.violations[0].message
+
+
+def test_sct001_flags_item_and_asarray_on_param(tmp_path):
+    r = lint_src(tmp_path, """
+        @partial(jax.jit, static_argnames=())
+        def f(x):
+            a = np.asarray(x)
+            return jnp.sum(x).item()
+        """, only=["SCT001"])
+    assert sorted(rule_ids(r)) == ["SCT001", "SCT001"]
+
+
+def test_sct001_clean_static_and_shape_math(tmp_path):
+    r = lint_src(tmp_path, """
+        @partial(jax.jit, static_argnames=("k",))
+        def f(x, *, k=4):
+            rows = int(x.shape[0])       # shape math: static
+            kk = float(k)                # static arg: host value
+            c = float(np.sqrt(rows))     # host math on shapes
+            return x[: rows // 2] * kk * c
+        """, only=["SCT001"])
+    assert rule_ids(r) == []
+
+
+def test_sct001_ignores_unjitted_functions(tmp_path):
+    r = lint_src(tmp_path, """
+        def f(x):
+            return float(jnp.sum(x))  # host-side caller: legitimate
+        """, only=["SCT001"])
+    assert rule_ids(r) == []
+
+
+# ---------------------------------------------------------------------------
+# SCT002 — python loop in jit
+# ---------------------------------------------------------------------------
+
+def test_sct002_flags_for_and_while(tmp_path):
+    r = lint_src(tmp_path, """
+        @jax.jit
+        def f(x, n):
+            for i in range(100):
+                x = jnp.dot(x, x)
+            while True:
+                x = x + jnp.ones(3)
+            return x
+        """, only=["SCT002"])
+    assert rule_ids(r) == ["SCT002", "SCT002"]
+
+
+def test_sct002_allows_tiny_unroll_and_host_loops(tmp_path):
+    r = lint_src(tmp_path, """
+        @jax.jit
+        def f(x):
+            for _ in range(2):          # bounded unroll: fine
+                x = jnp.tanh(x)
+            for name in ("a", "b"):     # literal iterable: fine
+                x = x + jnp.ones(1)
+            out = []
+            for i in range(1000):       # no jax ops in body: fine
+                out.append(i * 2)
+            return x
+
+        def host(xs):
+            for x in xs:                # not jitted: fine
+                x = jnp.sum(x)
+            return x
+        """, only=["SCT002"])
+    assert rule_ids(r) == []
+
+
+# ---------------------------------------------------------------------------
+# SCT003 — static_argnames
+# ---------------------------------------------------------------------------
+
+def test_sct003_flags_missing_static_kwargs(tmp_path):
+    r = lint_src(tmp_path, """
+        @partial(jax.jit, static_argnames=("metric",))
+        def f(x, *, k=10, metric="cosine", sorted_out=False):
+            return x
+        """, only=["SCT003"])
+    msgs = [v.message for v in r.violations]
+    assert len(msgs) == 2  # k (name pattern) + sorted_out (bool)
+    assert any("'k'" in m for m in msgs)
+    assert any("'sorted_out'" in m for m in msgs)
+
+
+def test_sct003_clean_when_listed_or_traced_by_design(tmp_path):
+    r = lint_src(tmp_path, """
+        @partial(jax.jit, static_argnames=("k", "mode", "n_iter"))
+        def f(x, *, k=10, mode="x", n_iter=5, alpha=0.5, length=None):
+            return x
+        """, only=["SCT003"])
+    assert rule_ids(r) == []  # alpha: float, length: None default
+
+
+def test_sct003_skips_unreadable_static_argnames(tmp_path):
+    r = lint_src(tmp_path, """
+        NAMES = ("k",)
+
+        @partial(jax.jit, static_argnames=NAMES)
+        def f(x, *, k=10):
+            return x
+        """, only=["SCT003"])
+    assert rule_ids(r) == []
+
+
+# ---------------------------------------------------------------------------
+# SCT004 — numpy RNG discipline in tpu-reachable code
+# ---------------------------------------------------------------------------
+
+def test_sct004_flags_legacy_and_unseeded_transitively(tmp_path):
+    r = lint_src(tmp_path, """
+        def _helper(n):
+            w = np.random.rand(n)          # legacy global RNG
+            rng = np.random.default_rng()  # unseeded
+            return w
+
+        @register("demo.op", backend="tpu")
+        def op_tpu(data, seed=0):
+            '''Doc.'''
+            return _helper(4)
+        """, only=["SCT004"])
+    assert rule_ids(r) == ["SCT004", "SCT004"]
+
+
+def test_sct004_clean_seeded_rng_and_cpu_only_code(tmp_path):
+    r = lint_src(tmp_path, """
+        def _helper(n, seed):
+            return np.random.default_rng(seed).random(n)
+
+        @register("demo.op", backend="tpu")
+        def op_tpu(data, seed=0):
+            '''Doc.'''
+            return _helper(4, seed)
+
+        @register("demo.op", backend="cpu")
+        def op_cpu(data, seed=0):
+            return np.random.rand(4)  # cpu oracle: out of scope
+        """, only=["SCT004"])
+    assert rule_ids(r) == []
+
+
+# ---------------------------------------------------------------------------
+# SCT005 — silent broad except in resilience paths
+# ---------------------------------------------------------------------------
+
+def test_sct005_flags_silent_swallow(tmp_path):
+    r = lint_src(tmp_path, """
+        def load():
+            try:
+                return open("x").read()
+            except Exception:
+                return None
+        """, only=["SCT005"], name="checkpoint.py", prelude=False)
+    assert rule_ids(r) == ["SCT005"]
+
+
+def test_sct005_clean_when_classified_warned_or_captured(tmp_path):
+    r = lint_src(tmp_path, """
+        import warnings
+        from sctools_tpu.utils.failsafe import classify_error
+
+        def a():
+            try:
+                work()
+            except Exception as e:
+                kind = classify_error(e)
+
+        def b():
+            try:
+                work()
+            except Exception as e:
+                warnings.warn(f"failed: {e}")
+
+        def c():
+            try:
+                work()
+            except BaseException as e:
+                err = e   # captured for later classification
+            return err
+
+        def d():
+            try:
+                work()
+            except ValueError:   # narrow type: fine anywhere
+                pass
+        """, only=["SCT005"], name="runner.py", prelude=False)
+    assert rule_ids(r) == []
+
+
+def test_sct005_scoped_to_resilience_modules(tmp_path):
+    r = lint_src(tmp_path, """
+        def load():
+            try:
+                return open("x").read()
+            except Exception:
+                return None
+        """, only=["SCT005"], name="misc_module.py", prelude=False)
+    assert rule_ids(r) == []
+
+
+# ---------------------------------------------------------------------------
+# SCT006 — registry conventions
+# ---------------------------------------------------------------------------
+
+def test_sct006_flags_name_backend_and_docstring(tmp_path):
+    r = lint_src(tmp_path, """
+        @register("BadName", backend="gpu")
+        def bad(data):
+            return data
+        """, only=["SCT006"])
+    msgs = " | ".join(v.message for v in r.violations)
+    assert len(r.violations) == 3
+    assert "dotted lowercase" in msgs
+    assert "unknown backend" in msgs
+    assert "docstring" in msgs
+
+
+def test_sct006_dynamic_name_flagged_singledispatch_exempt(tmp_path):
+    r = lint_src(tmp_path, """
+        from functools import singledispatch
+
+        NAME = "demo.op"
+
+        @register(NAME, backend="tpu")
+        def dynamic(data):
+            '''Doc.'''
+            return data
+
+        @singledispatch
+        def to_host(x):
+            '''Doc.'''
+            return x
+
+        @to_host.register
+        def _(x: list):
+            return x
+        """, only=["SCT006"])
+    msgs = [v.message for v in r.violations]
+    assert len(msgs) == 1  # only the dynamic registry name
+    assert "string literal" in msgs[0]
+
+
+def test_sct006_docstring_satisfied_by_any_impl_or_doc_assign(tmp_path):
+    r = lint_src(tmp_path, """
+        @register("demo.op", backend="tpu")
+        def op_tpu(data):
+            '''The doc.'''
+            return data
+
+        @register("demo.op", backend="cpu")
+        def op_cpu(data):
+            return data
+
+        _DOC = "Shared doc."
+
+        @register("demo.other", backend="tpu")
+        def other_tpu(data):
+            return data
+
+        other_tpu.__doc__ = _DOC
+
+        @register("test.fixture", backend="cpu")
+        def fixture(data):
+            '''Test-prefix ops are exempt from the dotted-name rule.'''
+            return data
+        """, only=["SCT006"])
+    assert rule_ids(r) == []
+
+
+# ---------------------------------------------------------------------------
+# framework: suppressions
+# ---------------------------------------------------------------------------
+
+def test_suppression_comment_silences_one_rule(tmp_path):
+    r = lint_src(tmp_path, """
+        @jax.jit
+        def f(x):
+            for i in range(100):  # sctlint: disable=SCT002
+                x = jnp.dot(x, x)
+            return x
+        """, only=["SCT002"])
+    assert rule_ids(r) == []
+    assert [v.rule for v in r.suppressed] == ["SCT002"]
+    assert r.ok
+
+
+def test_suppression_is_rule_specific_and_line_specific(tmp_path):
+    r = lint_src(tmp_path, """
+        @jax.jit
+        def f(x):
+            for i in range(100):  # sctlint: disable=SCT001
+                x = jnp.dot(x, x)
+            while x.ndim:
+                x = x + jnp.ones(1)
+            return x
+        """, only=["SCT002"])
+    # wrong rule id on the for; nothing on the while -> both still fire
+    assert rule_ids(r) == ["SCT002", "SCT002"]
+
+
+def test_bare_disable_suppresses_all_rules_on_line(tmp_path):
+    r = lint_src(tmp_path, """
+        @partial(jax.jit, static_argnames=())
+        def f(x, *, k=10):  # sctlint: disable
+            return x
+        """, only=["SCT003"])
+    assert rule_ids(r) == []
+    assert len(r.suppressed) == 1
+
+
+def test_disable_inside_string_literal_does_not_suppress(tmp_path):
+    r = lint_src(tmp_path, '''
+        @jax.jit
+        def f(x):
+            for i in range(100):
+                x = jnp.dot(x, x) + len("# sctlint: disable")
+            return x
+        ''', only=["SCT002"])
+    assert rule_ids(r) == ["SCT002"]
+
+
+# ---------------------------------------------------------------------------
+# framework: baseline
+# ---------------------------------------------------------------------------
+
+_BASELINE_SRC = """
+    @jax.jit
+    def f(x):
+        for i in range(100):
+            x = jnp.dot(x, x)
+        return x
+    """
+
+
+def _make_baseline(tmp_path, result, reason="grandfathered"):
+    b = Baseline.from_violations(
+        assign_fingerprints(result.violations), default_reason=reason)
+    path = tmp_path / "baseline.json"
+    b.save(str(path))
+    return Baseline.load(str(path))
+
+
+def test_baselined_violation_passes(tmp_path):
+    first = lint_src(tmp_path, _BASELINE_SRC, only=["SCT002"])
+    assert len(first.violations) == 1
+    b = _make_baseline(tmp_path, first)
+    again = lint_src(tmp_path, _BASELINE_SRC, only=["SCT002"],
+                     baseline=b)
+    assert again.ok
+    assert [v.rule for v in again.baselined] == ["SCT002"]
+
+
+def test_baseline_survives_line_drift(tmp_path):
+    first = lint_src(tmp_path, _BASELINE_SRC, only=["SCT002"])
+    b = _make_baseline(tmp_path, first)
+    shifted = ("# leading comment\n# another\n\n"
+               + textwrap.dedent(_BASELINE_SRC))
+    again = lint_src(tmp_path, shifted, only=["SCT002"], baseline=b)
+    assert again.ok, (again.violations, again.stale_baseline)
+    assert len(again.baselined) == 1
+
+
+def test_baseline_goes_stale_when_code_changes(tmp_path):
+    first = lint_src(tmp_path, _BASELINE_SRC, only=["SCT002"])
+    b = _make_baseline(tmp_path, first)
+    edited = _BASELINE_SRC.replace("range(100)", "range(200)")
+    again = lint_src(tmp_path, edited, only=["SCT002"], baseline=b)
+    assert not again.ok
+    assert len(again.violations) == 1  # the edited loop: new violation
+    assert len(again.stale_baseline) == 1  # the old entry: stale
+
+
+def test_project_rule_fingerprints_distinct_by_message():
+    """Project-rule violations share path/line and have no source
+    line; the message must disambiguate them or one baselined parity
+    finding would mask every future one."""
+    from tools.sctlint.core import Violation
+
+    a = Violation("SCT000", "sctools_tpu/registry.py", 1, 0,
+                  "op_a: missing backend(s) ['tpu']")
+    b = Violation("SCT000", "sctools_tpu/registry.py", 1, 0,
+                  "op_b: missing backend(s) ['cpu']")
+    fps = [fp for _, fp in assign_fingerprints([a, b])]
+    assert fps[0] != fps[1]
+
+
+def test_baseline_entry_for_deleted_file_goes_stale(tmp_path):
+    first = lint_src(tmp_path, _BASELINE_SRC, only=["SCT002"])
+    b = _make_baseline(tmp_path, first)
+    (tmp_path / "snippet.py").unlink()
+    # linting the DIRECTORY that used to contain the file: the entry
+    # is in scope (prefix match) and must be reported stale
+    r = run_lint([str(tmp_path)], root=str(tmp_path), only=["SCT002"],
+                 baseline=b, project_rules=False)
+    assert not r.ok
+    assert len(r.stale_baseline) == 1
+
+
+def test_update_merge_preserves_out_of_scope_entries(tmp_path):
+    from tools.sctlint.baseline import merge_update
+
+    d1, d2 = tmp_path / "d1", tmp_path / "d2"
+    d1.mkdir(), d2.mkdir()
+    (d1 / "hot.py").write_text(_PRELUDE + textwrap.dedent(_BASELINE_SRC))
+    (d2 / "ok.py").write_text("x = 1\n")
+    first = run_lint([str(d1)], root=str(tmp_path), only=["SCT002"],
+                     project_rules=False)
+    old = _make_baseline(tmp_path, first)
+    assert len(old.entries) == 1
+    # "update" from a lint of d2 only: d1's entry is out of scope and
+    # must survive the rewrite
+    clean = run_lint([str(d2)], root=str(tmp_path), only=["SCT002"],
+                     project_rules=False)
+    merged = merge_update(assign_fingerprints(clean.violations), old,
+                          clean.scope.covers)
+    assert len(merged.entries) == 1
+    # whereas a lint that DOES cover d1 (and finds nothing, the file
+    # having been deleted) drops it
+    (d1 / "hot.py").unlink()
+    gone = run_lint([str(d1)], root=str(tmp_path), only=["SCT002"],
+                    project_rules=False)
+    merged2 = merge_update(assign_fingerprints(gone.violations), old,
+                           gone.scope.covers)
+    assert len(merged2.entries) == 0
+
+
+def test_filtered_update_keeps_unselected_rules_entries(tmp_path, capsys):
+    """`--update-baseline --only SCT002` must not delete SCT001
+    entries (and their hand-written reasons) for files it relinted."""
+    src = tmp_path / "hot.py"
+    src.write_text(_PRELUDE + textwrap.dedent("""
+        @jax.jit
+        def f(x):
+            t = jnp.sum(x)         # -> SCT001 via float() below
+            for i in range(100):   # -> SCT002
+                x = jnp.dot(x, x)
+            return float(t)
+        """))
+    bl = str(tmp_path / "bl.json")
+    rc = main([str(tmp_path), "--update-baseline", "--baseline", bl,
+               "--no-project-rules"])
+    capsys.readouterr()
+    assert rc == 0
+    assert sorted(e["rule"] for e in
+                  json.load(open(bl))["entries"]) == ["SCT001", "SCT002"]
+    rc = main([str(tmp_path), "--update-baseline", "--baseline", bl,
+               "--no-project-rules", "--only", "SCT002"])
+    capsys.readouterr()
+    assert rc == 0
+    assert sorted(e["rule"] for e in
+                  json.load(open(bl))["entries"]) == ["SCT001", "SCT002"]
+
+
+def test_stale_only_counted_for_linted_paths(tmp_path):
+    first = lint_src(tmp_path, _BASELINE_SRC, only=["SCT002"])
+    b = _make_baseline(tmp_path, first)
+    other = lint_src(tmp_path, "x = 1\n", only=["SCT002"],
+                     name="other.py", baseline=b, prelude=False)
+    assert other.ok  # snippet.py's entry isn't stale: file not linted
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_json_output_and_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(_PRELUDE + textwrap.dedent("""
+        @jax.jit
+        def f(x):
+            for i in range(100):
+                x = jnp.dot(x, x)
+            return x
+        """))
+    rc = main([str(bad), "--no-project-rules", "--no-baseline",
+               "--format", "json", "--only", "SCT002"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["ok"] is False
+    assert [v["rule"] for v in doc["violations"]] == ["SCT002"]
+
+    ok = tmp_path / "ok.py"
+    ok.write_text("x = 1\n")
+    rc = main([str(ok), "--no-project-rules", "--no-baseline"])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_cli_list_rules_covers_all_ids(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in RULES:
+        assert rid in out
+
+
+def test_cli_rejects_unknown_rule_id(tmp_path):
+    with pytest.raises(SystemExit):
+        main([str(tmp_path), "--only", "SCT999"])
+
+
+# ---------------------------------------------------------------------------
+# project rules
+# ---------------------------------------------------------------------------
+
+def test_sct007_flags_tracked_pycache(tmp_path):
+    repo = tmp_path / "r"
+    pkg = repo / "pkg" / "__pycache__"
+    pkg.mkdir(parents=True)
+    (pkg / "mod.cpython-310.pyc").write_bytes(b"\x00")
+    (repo / "pkg" / "mod.py").write_text("x = 1\n")
+    (repo / ".gitignore").write_text("")  # no ignore patterns either
+    env = {**os.environ,
+           "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+           "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"}
+    for cmd in (["git", "init", "-q"], ["git", "add", "-A", "-f"]):
+        p = subprocess.run(cmd, cwd=repo, env=env, capture_output=True)
+        if p.returncode != 0:
+            pytest.skip(f"git unavailable: {p.stderr.decode()[:200]}")
+    r = run_lint([str(repo / "pkg" / "mod.py")], root=str(repo),
+                 only=["SCT007"], project_rules=True)
+    kinds = sorted(v.path for v in r.violations)
+    assert any("__pycache__" in p for p in kinds)
+    assert ".gitignore" in kinds
+
+
+def test_sct007_clean_on_this_repo():
+    r = run_lint([os.path.join(_ROOT, "tools", "sctlint", "cli.py")],
+                 root=_ROOT, only=["SCT007"], project_rules=True)
+    assert r.ok, [v.format() for v in r.violations]
+
+
+# ---------------------------------------------------------------------------
+# enforcement: the real package is clean modulo the committed baseline
+# ---------------------------------------------------------------------------
+
+def test_sctools_tpu_clean_modulo_baseline():
+    """THE tier-1 gate: `python -m tools.sctlint sctools_tpu` exits 0.
+
+    Runs the same configuration as the CLI default — all rules
+    including SCT000 (parity, import-based) and SCT007 (hygiene),
+    against the committed baseline.  Any new violation, or any stale
+    baseline entry, fails here before it fails in CI."""
+    baseline = Baseline.load(default_baseline_path(_ROOT))
+    r = run_lint([os.path.join(_ROOT, "sctools_tpu")], root=_ROOT,
+                 baseline=baseline, project_rules=True)
+    assert r.ok, (
+        "sctlint violations (fix them, suppress with a "
+        "`# sctlint: disable=...` comment, or baseline with a reason "
+        "via --update-baseline):\n"
+        + "\n".join(v.format() for v in r.violations)
+        + "".join(f"\nstale baseline: {e.path}:{e.line} {e.rule}"
+                  for e in r.stale_baseline)
+        + "".join(f"\nerror: {e}" for e in r.errors))
+    assert r.n_files > 40  # the walk actually saw the package
+
+
+def test_baseline_entries_have_reasons():
+    baseline = Baseline.load(default_baseline_path(_ROOT))
+    for e in baseline.entries.values():
+        assert e.reason and e.reason.strip(), (
+            f"baseline entry {e.path}:{e.line} ({e.rule}) has no "
+            f"reason — state why it is grandfathered instead of fixed")
+
+
+def test_seeded_violation_fails_the_gate(tmp_path):
+    """End-to-end acceptance: introducing a violation into a freshly
+    seeded file is caught with exit 1 (the committed baseline cannot
+    mask new hits — fingerprints include the source line)."""
+    bad = tmp_path / "newly_added.py"
+    bad.write_text(_PRELUDE + textwrap.dedent("""
+        @partial(jax.jit, static_argnames=())
+        def fresh(x, *, n_comps=16):
+            return float(jnp.sum(x)) + n_comps
+        """))
+    baseline = Baseline.load(default_baseline_path(_ROOT))
+    r = run_lint([str(bad)], root=str(tmp_path), baseline=baseline,
+                 project_rules=False)
+    assert not r.ok
+    assert sorted(rule_ids(r)) == ["SCT001", "SCT003"]

@@ -1,0 +1,3 @@
+"""Repo tooling — makes ``tools/`` importable so ``python -m
+tools.sctlint`` works from the repo root.  Scripts in this directory
+remain directly runnable (each inserts the repo root on sys.path)."""

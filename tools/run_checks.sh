@@ -1,0 +1,63 @@
+#!/usr/bin/env bash
+# One-command CI-style gate: static analysis + registry parity +
+# tier-1 tests.  Run from anywhere; everything resolves relative to
+# the repo root.  Exits non-zero on the first failing stage.
+#
+#   tools/run_checks.sh           # full gate (lint + parity + pytest)
+#   tools/run_checks.sh --fast    # skip the pytest stage (seconds, not
+#                                 # minutes — lint + parity + hygiene)
+#
+# Stages:
+#   1. sctlint        python -m tools.sctlint sctools_tpu
+#                     (AST rules SCT001-SCT006 + parity SCT000 +
+#                      repo-hygiene SCT007; suppressions + baseline
+#                      honoured, stale baseline entries fail)
+#   2. tracked-bytecode guard (belt-and-braces duplicate of SCT007,
+#                     kept shell-side so the gate still catches it if
+#                     sctlint itself is broken)
+#   3. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
+
+set -u -o pipefail
+
+ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$ROOT"
+
+FAST=0
+[ "${1:-}" = "--fast" ] && FAST=1
+
+fail=0
+stage() { printf '\n== %s ==\n' "$1"; }
+
+stage "sctlint (static analysis, rules SCT000-SCT007)"
+if ! JAX_PLATFORMS=cpu python -m tools.sctlint sctools_tpu; then
+    fail=1
+fi
+
+stage "tracked bytecode guard"
+tracked=$(git ls-files | grep -E '(^|/)__pycache__/|\.py[co]$' || true)
+if [ -n "$tracked" ]; then
+    echo "bytecode artifacts tracked by git:"
+    echo "$tracked"
+    fail=1
+else
+    echo "OK: no __pycache__/*.pyc tracked"
+fi
+
+if [ "$FAST" = "1" ]; then
+    stage "tier-1 pytest"
+    echo "skipped (--fast)"
+else
+    stage "tier-1 pytest (cpu, not slow)"
+    if ! JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+            --continue-on-collection-errors -p no:cacheprovider; then
+        fail=1
+    fi
+fi
+
+printf '\n'
+if [ "$fail" = "0" ]; then
+    echo "run_checks: ALL STAGES PASSED"
+else
+    echo "run_checks: FAILURES (see above)"
+fi
+exit "$fail"

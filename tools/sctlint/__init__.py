@@ -1,0 +1,44 @@
+"""sctlint — AST-based static analysis for the sctools-tpu codebase.
+
+The registry/runner/jit conventions this package enforces are exactly
+the hazard classes that dominate TPU-port regressions (see PAPERS.md:
+rapids-singlecell on silent host transfers; the TPU benchmarking
+literature on recompilation): a convention that is only prose in
+ARCHITECTURE.md regresses the first time someone edits under pressure.
+sctlint turns them into machine-checked contracts:
+
+* ``SCT000`` registry cpu/tpu parity (the degrade-to-cpu contract)
+* ``SCT001`` host-device sync inside jitted code
+* ``SCT002`` Python loops over jnp ops inside jitted code
+* ``SCT003`` shape-controlling jit kwargs missing from static_argnames
+* ``SCT004`` numpy RNG discipline in tpu-backend-reachable code
+* ``SCT005`` broad ``except Exception`` in runner/failsafe paths
+* ``SCT006`` registry naming/docstring conventions
+* ``SCT007`` repo hygiene (no tracked __pycache__/*.pyc)
+
+Usage::
+
+    python -m tools.sctlint sctools_tpu            # lint, exit 1 on hits
+    python -m tools.sctlint --format json ...      # machine-readable
+    python -m tools.sctlint --update-baseline ...  # regenerate baseline
+
+Per-line suppression: append ``# sctlint: disable=SCT001`` (comma-list
+or bare ``disable`` for all rules) to the flagged line.  Grandfathered
+violations live in ``tools/sctlint/baseline.json`` with a written
+reason each; stale entries fail the lint so the baseline only shrinks.
+"""
+
+from .core import (  # noqa: F401
+    RULES,
+    FileContext,
+    LintResult,
+    ProjectContext,
+    Rule,
+    Violation,
+    rule,
+    run_lint,
+)
+from .baseline import Baseline, BaselineEntry, fingerprint  # noqa: F401
+
+# importing the rules package registers every rule in RULES
+from . import rules  # noqa: F401,E402

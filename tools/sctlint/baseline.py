@@ -1,0 +1,121 @@
+"""Committed baseline of grandfathered violations.
+
+Each entry carries a content fingerprint — rule id, repo-relative
+path, the stripped source line, and an occurrence index — so entries
+survive unrelated edits (line-number drift does not invalidate them)
+but die with the code they describe (editing the flagged line makes
+the entry stale, which fails the lint until the baseline is
+regenerated).  Every entry needs a human-written ``reason``; the tier-1
+test asserts none are blank.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+
+
+@dataclasses.dataclass
+class BaselineEntry:
+    fingerprint: str
+    rule: str
+    path: str
+    line: int  # informational only — matching is by fingerprint
+    code: str
+    message: str
+    reason: str
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def fingerprint(rule: str, path: str, anchor: str, index: int) -> str:
+    """Stable id for the ``index``-th violation of ``rule`` in ``path``
+    anchored to ``anchor`` — the flagged source line for file rules,
+    the message for project rules (which have no source line; without
+    the message, every SCT000 finding would collapse to one
+    fingerprint and a single baselined entry would mask all future
+    ones)."""
+    h = hashlib.sha256(
+        f"{rule}|{path}|{anchor}|{index}".encode()).hexdigest()
+    return h[:16]
+
+
+def _anchor(v) -> str:
+    return v.code or v.message
+
+
+def assign_fingerprints(violations):
+    """Pair each violation (pre-sorted by path/line) with its
+    fingerprint; duplicates of the same (rule, path, anchor) get
+    occurrence indices in line order."""
+    counters: dict[tuple, int] = {}
+    out = []
+    for v in violations:
+        key = (v.rule, v.path, _anchor(v))
+        idx = counters.get(key, 0)
+        counters[key] = idx + 1
+        out.append((v, fingerprint(v.rule, v.path, _anchor(v), idx)))
+    return out
+
+
+def merge_update(pairs, old: "Baseline | None", covers,
+                 default_reason: str = "") -> "Baseline":
+    """Baseline for ``--update-baseline``: current violations (reasons
+    carried over by fingerprint) PLUS old entries outside the lint's
+    scope — a narrow-path update must not silently delete
+    grandfathered entries for files it never looked at.  ``covers`` is
+    a predicate over entries (see ``LintScope.covers``)."""
+    new = Baseline.from_violations(pairs, old=old,
+                                   default_reason=default_reason)
+    if old is not None:
+        for fp, e in old.entries.items():
+            if fp not in new.entries and not covers(e):
+                new.entries[fp] = e
+    return new
+
+
+class Baseline:
+    def __init__(self, entries: dict[str, BaselineEntry] | None = None):
+        self.entries: dict[str, BaselineEntry] = entries or {}
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls()
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        entries = {}
+        for rec in doc.get("entries", ()):
+            e = BaselineEntry(**rec)
+            entries[e.fingerprint] = e
+        return cls(entries)
+
+    def save(self, path: str) -> None:
+        doc = {
+            "note": ("grandfathered sctlint violations — regenerate with "
+                     "`python -m tools.sctlint --update-baseline <paths>`; "
+                     "every entry needs a reason (tier-1 enforced)"),
+            "entries": [e.to_json() for e in sorted(
+                self.entries.values(),
+                key=lambda e: (e.path, e.line, e.rule))],
+        }
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+
+    @classmethod
+    def from_violations(cls, pairs, old: "Baseline | None" = None,
+                        default_reason: str = "") -> "Baseline":
+        """Build a baseline from ``assign_fingerprints`` output,
+        carrying reasons over from ``old`` where fingerprints match."""
+        entries = {}
+        for v, fp in pairs:
+            prev = old.entries.get(fp) if old else None
+            entries[fp] = BaselineEntry(
+                fingerprint=fp, rule=v.rule, path=v.path, line=v.line,
+                code=v.code, message=v.message,
+                reason=prev.reason if prev else default_reason)
+        return cls(entries)

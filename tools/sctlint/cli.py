@@ -1,0 +1,154 @@
+"""Command-line front end: ``python -m tools.sctlint [paths...]``.
+
+Exit codes: 0 clean (every hit suppressed or baselined), 1 violations
+/ stale baseline entries / unreadable files, 2 bad usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .baseline import Baseline, assign_fingerprints, merge_update
+from .core import RULES, LintResult, repo_root, run_lint
+
+
+def default_baseline_path(root: str) -> str:
+    return os.path.join(root, "tools", "sctlint", "baseline.json")
+
+
+def _parse_ids(s: str | None) -> list[str] | None:
+    if s is None:
+        return None
+    ids = [i.strip().upper() for i in s.split(",") if i.strip()]
+    unknown = [i for i in ids if i not in RULES]
+    if unknown:
+        raise SystemExit(
+            f"sctlint: unknown rule id(s) {unknown}; known: "
+            f"{sorted(RULES)}")
+    return ids
+
+
+def _print_text(result: LintResult, show_baselined: bool) -> None:
+    for err in result.errors:
+        print(f"{err}")
+    for v in result.violations:
+        print(v.format())
+    if show_baselined:
+        for v in result.baselined:
+            print(f"{v.format()}  [baselined]")
+    for e in result.stale_baseline:
+        print(f"{e.path}:{e.line}: {e.rule} stale baseline entry "
+              f"(code no longer matches: {e.code!r}) — run "
+              f"--update-baseline")
+    print(f"sctlint: {len(result.violations)} violation(s), "
+          f"{len(result.baselined)} baselined, "
+          f"{len(result.suppressed)} suppressed, "
+          f"{len(result.stale_baseline)} stale baseline entr"
+          f"{'y' if len(result.stale_baseline) == 1 else 'ies'}, "
+          f"{len(result.errors)} error(s) "
+          f"[{result.n_files} files]")
+
+
+def _print_json(result: LintResult) -> None:
+    doc = {
+        "ok": result.ok,
+        "n_files": result.n_files,
+        "violations": [v.to_json() for v in result.violations],
+        "baselined": [v.to_json() for v in result.baselined],
+        "suppressed": [v.to_json() for v in result.suppressed],
+        "stale_baseline": [e.to_json() for e in result.stale_baseline],
+        "errors": result.errors,
+    }
+    json.dump(doc, sys.stdout, indent=1)
+    sys.stdout.write("\n")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.sctlint",
+        description="AST-based JAX correctness linter for sctools-tpu "
+                    "(rules SCT000-SCT007; see docs/ARCHITECTURE.md "
+                    "'Static analysis')")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: sctools_tpu)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", metavar="PATH",
+                    help="baseline file (default "
+                         "tools/sctlint/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report everything)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current hits, "
+                         "keeping reasons for surviving entries")
+    ap.add_argument("--only", metavar="IDS",
+                    help="comma-separated rule ids to run")
+    ap.add_argument("--disable", metavar="IDS",
+                    help="comma-separated rule ids to skip")
+    ap.add_argument("--no-project-rules", action="store_true",
+                    help="skip project-scope rules (SCT000/SCT007)")
+    ap.add_argument("--show-baselined", action="store_true",
+                    help="also print baselined hits (text format)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    root = repo_root()
+    if args.list_rules:
+        for r in sorted(RULES.values(), key=lambda r: r.id):
+            print(f"{r.id}  [{r.scope:7s}]  {r.name}: {r.summary}")
+        return 0
+
+    paths = args.paths or [os.path.join(root, "sctools_tpu")]
+    only = _parse_ids(args.only)
+    disable = _parse_ids(args.disable)
+    baseline_path = args.baseline or default_baseline_path(root)
+
+    try:
+        return _run(args, paths, root, only, disable, baseline_path)
+    except FileNotFoundError as e:
+        print(f"sctlint: {e}", file=sys.stderr)
+        return 2
+
+
+def _run(args, paths, root, only, disable, baseline_path) -> int:
+    if args.update_baseline:
+        result = run_lint(paths, root=root, only=only, disable=disable,
+                          baseline=None,
+                          project_rules=not args.no_project_rules)
+        old = Baseline.load(baseline_path)
+        only_set = set(only) if only is not None else None
+        disable_set = set(disable or ())
+
+        def covered(e):
+            # an entry is only up for replacement when this run could
+            # have re-found it: path in scope AND its rule actually
+            # selected — `--update-baseline --only SCT002` must not
+            # delete SCT001 entries (and their reasons)
+            return (result.scope.covers(e)
+                    and (only_set is None or e.rule in only_set)
+                    and e.rule not in disable_set)
+
+        new = merge_update(assign_fingerprints(result.violations),
+                           old, covered)
+        new.save(baseline_path)
+        blank = sum(1 for e in new.entries.values()
+                    if not e.reason.strip())
+        print(f"sctlint: wrote {len(new.entries)} baseline entr"
+              f"{'y' if len(new.entries) == 1 else 'ies'} to "
+              f"{os.path.relpath(baseline_path, root)}"
+              + (f" — {blank} need a reason (tier-1 enforces "
+                 f"non-blank reasons)" if blank else ""))
+        return 0
+
+    baseline = (None if args.no_baseline
+                else Baseline.load(baseline_path))
+    result = run_lint(paths, root=root, only=only, disable=disable,
+                      baseline=baseline,
+                      project_rules=not args.no_project_rules)
+    if args.format == "json":
+        _print_json(result)
+    else:
+        _print_text(result, args.show_baselined)
+    return result.exit_code

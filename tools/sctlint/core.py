@@ -1,0 +1,337 @@
+"""The lint engine: rule registry, file contexts, suppression
+comments, and the orchestration that runs rules over a path set.
+
+Two rule scopes:
+
+* ``file`` rules get a :class:`FileContext` (one parsed module) and
+  yield violations anchored to AST nodes.  Per-line ``# sctlint:
+  disable=SCT0xx`` comments suppress them.
+* ``project`` rules get a :class:`ProjectContext` (the whole lint run)
+  and check cross-file invariants — registry parity, repo hygiene.
+  They have no source line to suppress on; exemptions go in the
+  baseline (or the rule's own allowlist, e.g. SCT000's).
+
+Violations that are neither suppressed nor matched by the committed
+baseline fail the run.  Baseline entries that no longer match anything
+ALSO fail the run — the baseline is a ratchet, not a dumping ground.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Callable, Iterable
+
+from .baseline import Baseline, assign_fingerprints
+
+#: directory names never descended into when expanding path arguments
+SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", ".hypothesis",
+             "artifacts", "node_modules", ".venv", "venv"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str  # repo-relative posix path (absolute if outside the repo)
+    line: int
+    col: int
+    message: str
+    code: str = ""  # stripped source of the flagged line (baseline key)
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class FileContext:
+    """One parsed module, shared by every file rule."""
+
+    path: str
+    abspath: str
+    source: str
+    tree: ast.Module
+    lines: list[str]
+    #: lineno -> suppressed rule ids on that line (None = all rules)
+    suppressions: dict[int, set[str] | None]
+
+    def violation(self, rule_id: str, node, message: str) -> Violation:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        code = (self.lines[line - 1].strip()
+                if 0 < line <= len(self.lines) else "")
+        return Violation(rule_id, self.path, line, col, message, code)
+
+    def is_suppressed(self, v: Violation) -> bool:
+        sup = self.suppressions.get(v.line, ...)
+        if sup is ...:
+            return False
+        return sup is None or v.rule in sup
+
+
+@dataclasses.dataclass
+class ProjectContext:
+    root: str
+    files: list[FileContext]
+
+    def has_package(self, prefix: str) -> bool:
+        prefix = prefix.rstrip("/") + "/"
+        return any(f.path.startswith(prefix) for f in self.files)
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    summary: str
+    scope: str  # "file" | "project"
+    check: Callable[..., Iterable[Violation]]
+
+
+RULES: dict[str, Rule] = {}
+
+
+def rule(rule_id: str, name: str, summary: str, scope: str = "file"):
+    """Decorator registering a rule's check function under ``rule_id``."""
+
+    if scope not in ("file", "project"):
+        raise ValueError(f"unknown rule scope {scope!r}")
+
+    def deco(fn):
+        if rule_id in RULES:
+            raise ValueError(f"duplicate rule id {rule_id}")
+        RULES[rule_id] = Rule(rule_id, name, summary, scope, fn)
+        return fn
+
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# Suppression comments
+# ---------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"sctlint:\s*disable(?:=([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*))?")
+
+
+def parse_suppressions(source: str) -> dict[int, set[str] | None]:
+    """Map line numbers to the rule ids suppressed there.
+
+    Tokenizes so comments inside string literals don't count.  A bare
+    ``# sctlint: disable`` suppresses every rule on that line.
+    """
+    sup: dict[int, set[str] | None] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            line = tok.start[0]
+            if m.group(1) is None:
+                sup[line] = None
+            elif sup.get(line, set()) is not None:
+                ids = {s.strip().upper() for s in m.group(1).split(",")
+                       if s.strip()}
+                sup[line] = set(sup.get(line) or ()) | ids
+    except tokenize.TokenError:
+        pass  # the ast parse will report the real problem
+    return sup
+
+
+# ---------------------------------------------------------------------------
+# Path collection / parsing
+# ---------------------------------------------------------------------------
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _rel(abspath: str, root: str) -> str:
+    rel = os.path.relpath(abspath, root)
+    if rel.startswith(".."):
+        return abspath.replace(os.sep, "/")
+    return rel.replace(os.sep, "/")
+
+
+def collect_files(paths: Iterable[str], root: str) -> list[str]:
+    """Expand path arguments into a sorted list of .py files."""
+    out: set[str] = set()
+    for p in paths:
+        ap = os.path.abspath(p)
+        if os.path.isdir(ap):
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in SKIP_DIRS
+                                     and not d.startswith("."))
+                for f in filenames:
+                    if f.endswith(".py"):
+                        out.add(os.path.join(dirpath, f))
+        elif ap.endswith(".py"):
+            out.add(ap)
+        else:
+            raise FileNotFoundError(f"not a .py file or directory: {p}")
+    return sorted(out)
+
+
+def load_file(abspath: str, root: str) -> FileContext:
+    with open(abspath, encoding="utf-8") as f:
+        source = f.read()
+    tree = ast.parse(source, filename=abspath)  # SyntaxError -> caller
+    return FileContext(
+        path=_rel(abspath, root),
+        abspath=abspath,
+        source=source,
+        tree=tree,
+        lines=source.splitlines(),
+        # tokenizing every file costs more than the rules do — only
+        # files that mention sctlint can contain suppressions
+        suppressions=(parse_suppressions(source)
+                      if "sctlint" in source else {}),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Lint run
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class LintScope:
+    """What this lint run was responsible for — used to decide whether
+    an unmatched baseline entry is stale (in scope but gone) or merely
+    out of scope (a narrower run than the baseline covers).  Directory
+    targets are prefixes, so an entry for a DELETED file under a
+    linted directory still counts as in scope and goes stale."""
+
+    linted: frozenset  # repo-relative paths actually parsed
+    prefixes: tuple    # dir targets, as "pkg/sub/" rel prefixes
+    exact: frozenset   # file targets, repo-relative
+    project_rule_ids: frozenset  # project rules that ran
+
+    def covers(self, entry) -> bool:
+        r = RULES.get(entry.rule)
+        if r is not None and r.scope == "project":
+            return entry.rule in self.project_rule_ids
+        return (entry.path in self.linted
+                or entry.path in self.exact
+                or any(entry.path.startswith(p) for p in self.prefixes))
+
+
+@dataclasses.dataclass
+class LintResult:
+    violations: list[Violation]
+    suppressed: list[Violation]
+    baselined: list[Violation]
+    stale_baseline: list  # BaselineEntry
+    errors: list[str]
+    n_files: int
+    scope: LintScope | None = None
+
+    @property
+    def ok(self) -> bool:
+        return not (self.violations or self.stale_baseline or self.errors)
+
+    @property
+    def exit_code(self) -> int:
+        return 0 if self.ok else 1
+
+
+def _sort_key(v: Violation):
+    return (v.path, v.line, v.col, v.rule)
+
+
+def run_lint(paths: Iterable[str], *, root: str | None = None,
+             only: Iterable[str] | None = None,
+             disable: Iterable[str] | None = None,
+             baseline: Baseline | None = None,
+             project_rules: bool = True) -> LintResult:
+    """Lint ``paths`` and split hits into active / suppressed /
+    baselined, plus stale baseline entries.
+
+    ``only``/``disable`` select rule ids.  ``project_rules=False``
+    skips project-scope rules regardless of selection (unit tests lint
+    synthetic snippets that have no project around them).
+    """
+    paths = list(paths)  # iterated twice (scope prefixes + collection)
+    root = root or repo_root()
+    active = {
+        r for r in RULES.values()
+        if (only is None or r.id in set(only))
+        and r.id not in set(disable or ())
+    }
+    file_rules = sorted((r for r in active if r.scope == "file"),
+                        key=lambda r: r.id)
+    proj_rules = sorted((r for r in active if r.scope == "project"),
+                        key=lambda r: r.id) if project_rules else []
+
+    errors: list[str] = []
+    contexts: list[FileContext] = []
+    prefixes: list[str] = []
+    exact: set[str] = set()
+    for p in paths:
+        ap = os.path.abspath(p)
+        if os.path.isdir(ap):
+            rel = _rel(ap, root)
+            # the root itself covers every relative path
+            prefixes.append("" if rel == "." else rel.rstrip("/") + "/")
+        else:
+            exact.add(_rel(ap, root))
+    for ap in collect_files(paths, root):
+        try:
+            contexts.append(load_file(ap, root))
+        except SyntaxError as e:
+            errors.append(f"{_rel(ap, root)}:{e.lineno or 0}: "
+                          f"syntax error: {e.msg}")
+        except (OSError, UnicodeDecodeError) as e:
+            errors.append(f"{_rel(ap, root)}: unreadable: {e}")
+
+    raw: list[Violation] = []
+    suppressed: list[Violation] = []
+    for ctx in contexts:
+        for r in file_rules:
+            for v in r.check(ctx):
+                (suppressed if ctx.is_suppressed(v) else raw).append(v)
+    pctx = ProjectContext(root=root, files=contexts)
+    for r in proj_rules:
+        raw.extend(r.check(pctx))
+
+    raw.sort(key=_sort_key)
+    suppressed.sort(key=_sort_key)
+
+    violations: list[Violation] = []
+    baselined: list[Violation] = []
+    matched: set[str] = set()
+    for v, fp in assign_fingerprints(raw):
+        if baseline is not None and fp in baseline.entries:
+            matched.add(fp)
+            baselined.append(v)
+        else:
+            violations.append(v)
+
+    scope = LintScope(
+        linted=frozenset(c.path for c in contexts),
+        prefixes=tuple(prefixes), exact=frozenset(exact),
+        project_rule_ids=frozenset(r.id for r in proj_rules))
+
+    stale = []
+    if baseline is not None:
+        for fp, entry in sorted(baseline.entries.items(),
+                                key=lambda kv: (kv[1].path, kv[1].line)):
+            if fp in matched:
+                continue
+            if scope.covers(entry) \
+                    and (only is None or entry.rule in set(only)) \
+                    and entry.rule not in set(disable or ()):
+                stale.append(entry)
+
+    return LintResult(violations=violations, suppressed=suppressed,
+                      baselined=baselined, stale_baseline=stale,
+                      errors=errors, n_files=len(contexts), scope=scope)

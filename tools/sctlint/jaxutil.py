@@ -1,0 +1,295 @@
+"""Shared AST machinery for the JAX-aware rules: import-alias
+resolution, jit-decorator detection, registry-decorator detection,
+and a module-local call graph for "reachable from a tpu impl" checks.
+
+Everything here is a heuristic over one module's AST — no imports are
+executed, no cross-module resolution is attempted.  That bounds both
+the cost (pure parsing) and the failure mode (a rule misses code it
+cannot see; it never crashes the lint).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterator
+
+
+def import_aliases(tree: ast.Module) -> dict[str, str]:
+    """Local name -> canonical dotted prefix, from import statements.
+
+    ``import numpy as np`` -> ``{"np": "numpy"}``; ``from jax import
+    jit`` -> ``{"jit": "jax.jit"}``; ``from functools import partial``
+    -> ``{"partial": "functools.partial"}``.
+    """
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    aliases[a.asname] = a.name
+                else:
+                    aliases[a.name.split(".")[0]] = a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def dotted(node: ast.AST, aliases: dict[str, str]) -> str | None:
+    """Canonical dotted name of an attribute chain, or None.
+
+    ``np.random.default_rng`` -> ``"numpy.random.default_rng"`` when
+    ``np`` aliases numpy.
+    """
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    parts.reverse()
+    head = aliases.get(parts[0], parts[0])
+    return ".".join([head] + parts[1:])
+
+
+# ---------------------------------------------------------------------------
+# jit detection
+# ---------------------------------------------------------------------------
+
+_JIT_NAMES = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+
+
+@dataclasses.dataclass
+class JitInfo:
+    """A function wrapped by jax.jit via decorator.
+
+    ``static_argnames`` is the literal name set when it could be read
+    from the source, else None (unknown — rules that need it skip).
+    """
+    fn: ast.FunctionDef
+    static_argnames: frozenset[str] | None
+
+
+def _literal_names(node: ast.AST | None) -> frozenset[str] | None:
+    if node is None:
+        return frozenset()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return frozenset({node.value})
+    if isinstance(node, (ast.Tuple, ast.List)):
+        names = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, str)):
+                return None
+            names.append(elt.value)
+        return frozenset(names)
+    return None
+
+
+def _jit_from_decorator(dec: ast.AST,
+                        aliases: dict[str, str]) -> frozenset[str] | None | bool:
+    """False if the decorator is not a jit form; otherwise the static
+    argname set (frozenset, possibly empty) or None when unreadable."""
+    # @jax.jit / @jit (from jax import jit)
+    name = dotted(dec, aliases)
+    if name in _JIT_NAMES:
+        return frozenset()
+    if not isinstance(dec, ast.Call):
+        return False
+    fname = dotted(dec.func, aliases)
+    kwargs = {kw.arg: kw.value for kw in dec.keywords if kw.arg}
+    # @jax.jit(static_argnames=...)
+    if fname in _JIT_NAMES:
+        return _literal_names(kwargs.get("static_argnames"))
+    # @partial(jax.jit, static_argnames=...)
+    if fname == "functools.partial" and dec.args \
+            and dotted(dec.args[0], aliases) in _JIT_NAMES:
+        return _literal_names(kwargs.get("static_argnames"))
+    return False
+
+
+def iter_jitted_functions(tree: ast.Module,
+                          aliases: dict[str, str]) -> Iterator[JitInfo]:
+    """Every function (any nesting level) carrying a jit decorator."""
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            static = _jit_from_decorator(dec, aliases)
+            if static is not False:
+                yield JitInfo(fn=node, static_argnames=static)
+                break
+
+
+# ---------------------------------------------------------------------------
+# registry.register detection
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class RegisteredImpl:
+    fn: ast.FunctionDef
+    decorator: ast.Call
+    name: str | None     # first positional arg when a str literal
+    backend: str | None  # backend kwarg literal; defaults to "tpu"
+                         # (registry.register's default), None if dynamic
+
+
+def iter_registered_impls(tree: ast.Module,
+                          aliases: dict[str, str]) -> Iterator[RegisteredImpl]:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        for dec in node.decorator_list:
+            if not isinstance(dec, ast.Call):
+                continue
+            fname = dotted(dec.func, aliases)
+            if fname is None or fname.split(".")[-1] != "register":
+                continue
+            name = None
+            if dec.args and isinstance(dec.args[0], ast.Constant) \
+                    and isinstance(dec.args[0].value, str):
+                name = dec.args[0].value
+            has_backend_kw = any(kw.arg == "backend"
+                                 for kw in dec.keywords)
+            if name is None and not has_backend_kw:
+                # not provably OUR registry — e.g. singledispatch's
+                # `@fn.register` also ends in .register
+                continue
+            backend: str | None = "tpu"  # registry default
+            for kw in dec.keywords:
+                if kw.arg == "backend":
+                    backend = (kw.value.value
+                               if isinstance(kw.value, ast.Constant)
+                               and isinstance(kw.value.value, str)
+                               else None)
+            yield RegisteredImpl(fn=node, decorator=dec, name=name,
+                                 backend=backend)
+
+
+# ---------------------------------------------------------------------------
+# module-local call graph
+# ---------------------------------------------------------------------------
+
+def module_functions(tree: ast.Module) -> dict[str, ast.FunctionDef]:
+    """Top-level function defs by name (later defs win, like runtime)."""
+    return {n.name: n for n in tree.body
+            if isinstance(n, ast.FunctionDef)}
+
+
+def _called_names(fn: ast.FunctionDef) -> set[str]:
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            out.add(node.func.id)
+        elif isinstance(node, ast.Name):
+            # bare references count too: helpers passed as callbacks
+            # (e.g. segment_reduce(x, slot_vals, ...)) are reachable
+            out.add(node.id)
+    return out
+
+
+def reachable_functions(tree: ast.Module,
+                        roots: list[ast.FunctionDef]
+                        ) -> list[ast.FunctionDef]:
+    """Transitive closure of module-local callees from ``roots``
+    (roots included).  Name-based: a local function referenced
+    anywhere inside a reachable function is reachable."""
+    fns = module_functions(tree)
+    seen: dict[str, ast.FunctionDef] = {}
+    stack = list(roots)
+    seen.update({f.name: f for f in roots})
+    while stack:
+        fn = stack.pop()
+        for name in _called_names(fn):
+            callee = fns.get(name)
+            if callee is not None and name not in seen:
+                seen[name] = callee
+                stack.append(callee)
+    return list(seen.values())
+
+
+# ---------------------------------------------------------------------------
+# shared per-file analysis (computed once per file, used by all rules)
+# ---------------------------------------------------------------------------
+
+class ModuleInfo:
+    """Everything the rules need from one module's AST, from a single
+    pass: import aliases, jitted functions (with their call/loop nodes
+    pre-collected), registered impls, the tpu-reachable closure, and
+    module-level ``fn.__doc__ = ...`` assignments."""
+
+    def __init__(self, tree: ast.Module):
+        self.aliases = import_aliases(tree)
+        self.jitted: list[JitInfo] = list(
+            iter_jitted_functions(tree, self.aliases))
+        self.registered: list[RegisteredImpl] = list(
+            iter_registered_impls(tree, self.aliases))
+        tpu_roots = [r.fn for r in self.registered
+                     if r.backend in ("tpu", None)]
+        self.tpu_reachable: list[ast.FunctionDef] = (
+            reachable_functions(tree, tpu_roots) if tpu_roots else [])
+        self._jit_nodes: set[int] = set()
+        self.jit_calls: list[tuple[JitInfo, ast.Call]] = []
+        self.jit_loops: list[tuple[JitInfo, ast.For | ast.While]] = []
+        for ji in self.jitted:
+            for node in ast.walk(ji.fn):
+                self._jit_nodes.add(id(node))
+                if isinstance(node, ast.Call):
+                    self.jit_calls.append((ji, node))
+                elif isinstance(node, (ast.For, ast.While)):
+                    self.jit_loops.append((ji, node))
+        # names with a module-level `name.__doc__ = ...` assignment —
+        # how long shared docstrings are attached (e.g. ops/knn.py's
+        # _BBKNN_DOC); counts as "has a docstring" for SCT006
+        self.doc_assigned: set[str] = {
+            t.value.id
+            for n in tree.body if isinstance(n, ast.Assign)
+            for t in n.targets
+            if isinstance(t, ast.Attribute) and t.attr == "__doc__"
+            and isinstance(t.value, ast.Name)}
+
+    def in_jit(self, node: ast.AST) -> bool:
+        return id(node) in self._jit_nodes
+
+
+def module_info(ctx) -> ModuleInfo:
+    """Per-:class:`FileContext` analysis, memoised on the context
+    itself (NOT keyed by ``id(ctx)`` in a global dict — a freed
+    context's address gets reused across run_lint calls and would
+    serve another module's analysis)."""
+    info = getattr(ctx, "_module_info", None)
+    if info is None:
+        info = ModuleInfo(ctx.tree)
+        ctx._module_info = info
+    return info
+
+
+# ---------------------------------------------------------------------------
+# misc predicates shared by rules
+# ---------------------------------------------------------------------------
+
+def is_shapeish(node: ast.AST) -> bool:
+    """Does the expression look like static shape/host math —
+    ``x.shape[0]``, ``len(xs)``, ``x.ndim`` — rather than a traced
+    value?  Used to avoid flagging ``int(x.shape[0] / b)`` etc."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in (
+                "shape", "ndim", "size", "dtype", "itemsize"):
+            return True
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) \
+                and sub.func.id == "len":
+            return True
+    return False
+
+
+def const_int(node: ast.AST) -> int | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        inner = const_int(node.operand)
+        return -inner if inner is not None else None
+    return None

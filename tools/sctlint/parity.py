@@ -1,0 +1,59 @@
+"""Registry cpu/tpu parity — the check behind rule SCT000.
+
+Every registered transform must have BOTH a ``cpu`` and a ``tpu``
+backend, or be explicitly allowlisted here.  The cpu/tpu pairing is
+what the whole test strategy hangs on — the numpy/scipy cpu
+implementation is the oracle the TPU path validates against, and it is
+also what the ResilientRunner degrades to when the accelerator is
+ruled unhealthy.  A transform registered for only one backend silently
+breaks both: tests can't cross-check it, and a degraded run dies on it
+with ``UnknownBackendError`` mid-pipeline.
+
+Unlike the AST rules this one imports the live package (registration
+happens at import time), so it runs only when the lint targets include
+``sctools_tpu``.  ``tools/check_registry_parity.py`` remains the thin
+standalone entrypoint.
+"""
+
+from __future__ import annotations
+
+# Transforms intentionally exempt from cpu/tpu parity.  Every entry
+# needs a reason — an empty allowlist is the goal state.
+ALLOWLIST: dict[str, str] = {
+    # (none — all registered transforms currently have both backends)
+}
+
+REQUIRED = ("cpu", "tpu")
+
+
+def check() -> list[str]:
+    """Return one human-readable problem line per violation."""
+    import sctools_tpu  # noqa: F401  (imports register all transforms)
+    from sctools_tpu import registry
+
+    problems = []
+    for name in registry.names():
+        if name.startswith("test."):
+            # reserved for test-fixture ops (tests register throwaway
+            # transforms under this prefix; tools/gen_api_docs.py
+            # applies the same exclusion)
+            continue
+        have = set(registry.backends(name))
+        missing = [b for b in REQUIRED if b not in have]
+        if not missing:
+            continue
+        if name in ALLOWLIST:
+            continue
+        problems.append(
+            f"{name}: missing backend(s) {missing} (has {sorted(have)}) "
+            f"— add the implementation or allowlist it with a reason")
+    for name in sorted(ALLOWLIST):
+        if name not in registry.names():
+            problems.append(
+                f"allowlist entry {name!r} matches no registered "
+                f"transform — stale, remove it")
+        elif all(b in registry.backends(name) for b in REQUIRED):
+            problems.append(
+                f"allowlist entry {name!r} now has full parity — "
+                f"remove it so regressions are caught again")
+    return problems

@@ -1,0 +1,6 @@
+"""Rule modules — importing this package registers every rule."""
+
+from . import jaxrules  # noqa: F401  SCT001-SCT004
+from . import excepts  # noqa: F401   SCT005
+from . import registry_conv  # noqa: F401  SCT006
+from . import project  # noqa: F401   SCT000, SCT007

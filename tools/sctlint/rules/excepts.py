@@ -1,0 +1,84 @@
+"""SCT005 — broad ``except Exception`` in resilience-critical paths.
+
+The runner/failsafe/checkpoint stack routes every failure through
+``failsafe.classify_error`` so retry policy exists exactly once; a
+bare ``except Exception: pass``-style handler in those modules
+swallows exactly the transient-vs-deterministic signal the runner
+needs.  A broad handler is fine when it re-raises, classifies, warns,
+or journals — the rule only fires on silent swallows.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import FileContext, rule
+from ..jaxutil import dotted, module_info
+
+# resilience-path modules (matched on the repo-relative path tail so
+# synthetic test files named e.g. runner.py exercise the rule too)
+_PATH_RE = re.compile(
+    r"(^|/)(runner|failsafe|checkpoint|chaos|trace|determinism|sync)\.py$")
+
+_BROAD = {"Exception", "BaseException"}
+
+# a handler that calls any of these has dealt with the error
+_OK_CALLS = {
+    "classify_error", "is_transient",         # failsafe taxonomy
+    "warn", "warn_explicit",                  # warnings
+    "exception", "log", "debug", "info", "warning", "error", "critical",
+    "write",                                  # run journal
+    "print",                                  # last-resort visibility
+}
+
+
+def _is_broad(handler: ast.ExceptHandler, aliases) -> bool:
+    t = handler.type
+    if t is None:  # bare `except:`
+        return True
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    for node in types:
+        name = dotted(node, aliases)
+        if name and name.split(".")[-1] in _BROAD:
+            return True
+    return False
+
+
+def _handles_it(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            func = node.func
+            last = (func.attr if isinstance(func, ast.Attribute)
+                    else func.id if isinstance(func, ast.Name) else None)
+            if last in _OK_CALLS:
+                return True
+        # referencing the bound exception (`except ... as e: err = e`,
+        # or folding it into a returned reason) is capture, not
+        # swallow — the caller decides what to do with it
+        if handler.name and isinstance(node, ast.Name) \
+                and node.id == handler.name:
+            return True
+    return False
+
+
+@rule("SCT005", "silent-broad-except",
+      "broad `except Exception` in runner/failsafe/checkpoint paths "
+      "that neither classifies, logs, nor re-raises the error")
+def check_broad_except(ctx: FileContext):
+    if not _PATH_RE.search(ctx.path):
+        return
+    aliases = module_info(ctx).aliases
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        for handler in node.handlers:
+            if _is_broad(handler, aliases) and not _handles_it(handler):
+                yield ctx.violation(
+                    "SCT005", handler,
+                    "broad `except Exception` swallows the error "
+                    "silently in a resilience path — classify it "
+                    "(failsafe.classify_error), warn, journal, or "
+                    "narrow the except type")

@@ -1,0 +1,283 @@
+"""JAX correctness rules SCT001-SCT004.
+
+These encode the TPU-port hazard classes from PAPERS.md (silent
+host-device syncs and recompilation dominate ported-pipeline
+regressions) as checks over this repo's jit/registry idioms:
+
+* SCT001 — host-device sync inside a jitted function
+* SCT002 — Python loop over jnp ops inside a jitted function
+* SCT003 — shape/branch-controlling jit kwarg missing from
+  static_argnames
+* SCT004 — numpy RNG discipline in code reachable from a
+  ``@register(..., backend="tpu")`` implementation
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import FileContext, rule
+from ..jaxutil import (
+    const_int,
+    dotted,
+    is_shapeish,
+    module_info,
+)
+
+
+def _param_names(fn: ast.FunctionDef) -> set[str]:
+    a = fn.args
+    return {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)}
+
+
+def _contains_jax_call(node: ast.AST, aliases) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            name = dotted(sub.func, aliases)
+            if name and (name == "jax" or name.startswith("jax.")):
+                return True
+    return False
+
+
+def _traced_locals(fn: ast.FunctionDef, aliases) -> set[str]:
+    """Names assigned (anywhere in ``fn``) from an expression that
+    calls into jax — conservatively traced."""
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) \
+                and _contains_jax_call(node.value, aliases):
+            for t in node.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+        elif isinstance(node, ast.AugAssign) \
+                and isinstance(node.target, ast.Name) \
+                and _contains_jax_call(node.value, aliases):
+            out.add(node.target.id)
+    return out
+
+
+def _traced_expr(node: ast.AST, aliases, params: set[str],
+                 static: frozenset | None, traced: set[str]) -> bool:
+    """Heuristic: does this expression hold a traced value?  True for
+    expressions built from jax/jnp calls, for locals assigned from
+    them, and for bare names that are non-static parameters of the
+    enclosing jit function."""
+    if isinstance(node, ast.Constant):
+        return False
+    if is_shapeish(node):
+        return False
+    if _contains_jax_call(node, aliases):
+        return True
+    if isinstance(node, ast.Name):
+        if node.id in traced:
+            return True
+        if static is not None:
+            return node.id in params and node.id not in static
+    return False
+
+
+# ---------------------------------------------------------------------------
+# SCT001 — host-device sync inside jit
+# ---------------------------------------------------------------------------
+
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_SYNC_CASTS = {"float", "int", "bool", "complex"}
+_SYNC_FUNCS = {"numpy.asarray", "numpy.array", "jax.device_get"}
+
+
+@rule("SCT001", "host-sync-in-jit",
+      "host-device sync (.item()/float()/np.asarray) inside a jitted "
+      "function forces a transfer or fails on a tracer")
+def check_host_sync(ctx: FileContext):
+    info = module_info(ctx)
+    seen: set[int] = set()
+    fn_cache: dict[int, tuple] = {}
+    for ji, node in info.jit_calls:
+        if id(node) in seen:
+            continue  # nested-jit bodies appear under both walks
+        seen.add(id(node))
+        if id(ji.fn) not in fn_cache:
+            fn_cache[id(ji.fn)] = (_param_names(ji.fn),
+                                   _traced_locals(ji.fn, info.aliases))
+        params, traced = fn_cache[id(ji.fn)]
+        static = ji.static_argnames
+        # x.item() / x.tolist() / x.block_until_ready()
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _SYNC_METHODS \
+                and not node.args:
+            yield ctx.violation(
+                "SCT001", node,
+                f"`.{node.func.attr}()` inside jitted "
+                f"'{ji.fn.name}' forces a host-device sync (fails "
+                f"on a tracer; keep results as arrays)")
+            continue
+        name = dotted(node.func, info.aliases)
+        # float(x) / int(x) / bool(x) on a traced value
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in _SYNC_CASTS \
+                and len(node.args) == 1 \
+                and _traced_expr(node.args[0], info.aliases,
+                                 params, static, traced):
+            yield ctx.violation(
+                "SCT001", node,
+                f"`{node.func.id}()` on a traced value inside "
+                f"jitted '{ji.fn.name}' concretises the tracer "
+                f"(host sync / ConcretizationTypeError); keep the "
+                f"computation in jnp or mark the arg static")
+            continue
+        # np.asarray(x) / jax.device_get(x)
+        if name in _SYNC_FUNCS and node.args \
+                and _traced_expr(node.args[0], info.aliases,
+                                 params, static, traced):
+            yield ctx.violation(
+                "SCT001", node,
+                f"`{name.replace('numpy.', 'np.')}()` on a traced "
+                f"value inside jitted '{ji.fn.name}' materialises "
+                f"the array on host mid-trace; use jnp.asarray or "
+                f"hoist it out of jit")
+
+
+# ---------------------------------------------------------------------------
+# SCT002 — Python loop over jnp ops inside jit
+# ---------------------------------------------------------------------------
+
+_MAX_UNROLL = 4  # loops over literal iterables this short are an
+                 # intentional, bounded unroll — not a hazard
+
+
+def _tiny_literal_loop(loop: ast.For) -> bool:
+    it = loop.iter
+    if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
+            and it.func.id == "range" and len(it.args) == 1:
+        n = const_int(it.args[0])
+        return n is not None and n <= _MAX_UNROLL
+    if isinstance(it, (ast.Tuple, ast.List)):
+        return len(it.elts) <= _MAX_UNROLL
+    return False
+
+
+@rule("SCT002", "python-loop-in-jit",
+      "Python for/while over jnp ops inside a jitted function unrolls "
+      "at trace time (compile-time blowup / recompile hazard)")
+def check_python_loop(ctx: FileContext):
+    info = module_info(ctx)
+    seen: set[int] = set()
+    for ji, node in info.jit_loops:
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        if isinstance(node, ast.For) and _tiny_literal_loop(node):
+            continue
+        body_has_jax = any(
+            _contains_jax_call(stmt, info.aliases)
+            for stmt in node.body + node.orelse)
+        if body_has_jax:
+            kind = "for" if isinstance(node, ast.For) else "while"
+            yield ctx.violation(
+                "SCT002", node,
+                f"Python `{kind}` loop over jax ops inside jitted "
+                f"'{ji.fn.name}' unrolls at trace time — use "
+                f"jax.lax.scan/fori_loop, or hoist the loop out "
+                f"of jit")
+
+
+# ---------------------------------------------------------------------------
+# SCT003 — shape-controlling jit kwargs must be static
+# ---------------------------------------------------------------------------
+
+# kw-only parameter names that control output shapes, tile sizes, or
+# trace-time branches in this codebase's jit idiom (traced positional
+# args first, compile-time params keyword-only)
+_STATIC_NAME_RE = re.compile(
+    r"^(k|qb|cb|block|chunk|width|depth|rank|bins|mode|metric|method|"
+    r"precision|interp)$"
+    r"|^(n|num)_"
+    r"|_(size|block|chunk|iter|iters|epochs|steps|rounds|comps|"
+    r"components|neighbors|bins|dim|dims|clusters|grid|sweeps|outer|"
+    r"neg|dtype)$")
+
+
+@rule("SCT003", "jit-missing-static",
+      "jit kw-only arg that controls shapes/branches is not in "
+      "static_argnames (recompile-per-value or concretisation error)")
+def check_static_argnames(ctx: FileContext):
+    info = module_info(ctx)
+    for ji in info.jitted:
+        static = ji.static_argnames
+        if static is None:
+            continue  # static_argnames not a readable literal — skip
+        kwonly = ji.fn.args.kwonlyargs
+        defaults = ji.fn.args.kw_defaults
+        for arg, default in zip(kwonly, defaults):
+            if arg.arg in static:
+                continue
+            why = None
+            if _STATIC_NAME_RE.search(arg.arg):
+                why = "looks shape/branch-controlling"
+            elif isinstance(default, ast.Constant) \
+                    and isinstance(default.value, bool):
+                why = "is bool-valued (trace-time branch)"
+            elif isinstance(default, ast.Constant) \
+                    and isinstance(default.value, str):
+                why = "is string-valued (cannot be traced)"
+            if why:
+                yield ctx.violation(
+                    "SCT003", arg,
+                    f"jitted '{ji.fn.name}': kw-only arg "
+                    f"'{arg.arg}' {why} but is missing from "
+                    f"static_argnames — passing it traced recompiles "
+                    f"per value or fails to concretise")
+
+
+# ---------------------------------------------------------------------------
+# SCT004 — numpy RNG discipline in tpu-reachable code
+# ---------------------------------------------------------------------------
+
+_LEGACY_NP_RANDOM = {
+    "rand", "randn", "randint", "random", "random_sample", "ranf",
+    "sample", "choice", "permutation", "shuffle", "normal", "uniform",
+    "binomial", "poisson", "beta", "gamma", "exponential", "seed",
+    "standard_normal", "get_state", "set_state",
+}
+
+
+@rule("SCT004", "np-random-in-tpu-path",
+      "numpy RNG misuse in code reachable from a tpu-backend impl "
+      "(global state, unseeded, or constant-folded under jit)")
+def check_np_random(ctx: FileContext):
+    info = module_info(ctx)
+    seen: set[int] = set()
+    for fn in info.tpu_reachable:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            name = dotted(node.func, info.aliases)
+            if not name or not name.startswith("numpy.random."):
+                continue
+            seen.add(id(node))
+            tail = name.rsplit(".", 1)[-1]
+            if info.in_jit(node):
+                yield ctx.violation(
+                    "SCT004", node,
+                    f"`np.random.{tail}` inside a jitted function in "
+                    f"the tpu path is constant-folded at trace time "
+                    f"(same 'random' numbers every call) — use "
+                    f"jax.random with an explicit key")
+            elif tail == "default_rng" and not node.args \
+                    and not node.keywords:
+                yield ctx.violation(
+                    "SCT004", node,
+                    f"unseeded `np.random.default_rng()` in "
+                    f"'{fn.name}' (reachable from a tpu-backend impl) "
+                    f"breaks run-to-run determinism — pass the op's "
+                    f"seed parameter")
+            elif tail in _LEGACY_NP_RANDOM:
+                yield ctx.violation(
+                    "SCT004", node,
+                    f"legacy global `np.random.{tail}` in '{fn.name}' "
+                    f"(reachable from a tpu-backend impl) uses hidden "
+                    f"global RNG state — use "
+                    f"np.random.default_rng(seed) host-side or "
+                    f"jax.random on device")

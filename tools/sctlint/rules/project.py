@@ -1,0 +1,78 @@
+"""Project-scope rules: SCT000 registry parity, SCT007 repo hygiene.
+
+These check cross-file invariants, so they run once per lint rather
+than once per file, and their findings anchor to the artifact that
+owns the invariant (registry.py, .gitignore) rather than a source
+line.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+from ..core import ProjectContext, Violation, rule
+
+
+@rule("SCT000", "registry-parity",
+      "every registered transform has both cpu and tpu backends "
+      "(the test-oracle AND degrade-to-cpu contract)",
+      scope="project")
+def check_registry_parity(ctx: ProjectContext):
+    if not ctx.has_package("sctools_tpu"):
+        return  # linting something else — nothing to import
+    import sys
+
+    # registration happens at import time; keep that import off any
+    # accelerator and make the package resolvable from the lint root
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if ctx.root not in sys.path:
+        sys.path.insert(0, ctx.root)
+    from ..parity import check
+
+    try:
+        problems = check()
+    except Exception as e:  # noqa: BLE001 — an import-time crash in the
+        # package IS a finding, not a lint crash
+        yield Violation("SCT000", "sctools_tpu/registry.py", 1, 0,
+                        f"parity check could not run — importing the "
+                        f"package failed: {type(e).__name__}: {e}")
+        return
+    for p in problems:
+        yield Violation("SCT000", "sctools_tpu/registry.py", 1, 0, p)
+
+
+_HYGIENE_PATTERNS = ("__pycache__/", "*.pyc")
+
+
+@rule("SCT007", "repo-hygiene",
+      "no __pycache__/*.pyc tracked by git, and .gitignore covers them",
+      scope="project")
+def check_repo_hygiene(ctx: ProjectContext):
+    try:
+        p = subprocess.run(["git", "-C", ctx.root, "ls-files", "-z"],
+                           capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return  # no git — nothing to check
+    if p.returncode != 0:
+        return  # not a git repo (e.g. linting an exported tree)
+    for path in p.stdout.split("\0"):
+        if not path:
+            continue
+        if "__pycache__/" in path or path.endswith((".pyc", ".pyo")):
+            yield Violation(
+                "SCT007", path, 1, 0,
+                "bytecode artifact is tracked by git — `git rm "
+                "--cached` it (and keep __pycache__/ in .gitignore)")
+    gi = os.path.join(ctx.root, ".gitignore")
+    try:
+        with open(gi, encoding="utf-8") as f:
+            lines = {ln.strip() for ln in f}
+    except OSError:
+        lines = set()
+    for pat in _HYGIENE_PATTERNS:
+        if pat not in lines and pat.rstrip("/") not in lines:
+            yield Violation(
+                "SCT007", ".gitignore", 1, 0,
+                f"missing ignore pattern {pat!r} — bytecode would be "
+                f"stageable with `git add .`")

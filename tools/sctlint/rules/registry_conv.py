@@ -1,0 +1,69 @@
+"""SCT006 — registry convention checks.
+
+Registered transform names are the public API surface
+(``sct.apply(name, ...)``) and feed docs generation
+(tools/gen_api_docs.py takes the first line of the first registered
+docstring).  Conventions enforced per module:
+
+* the registry name is a string literal, dotted, lowercase
+  (``"normalize.log1p"`` — ``group.op`` is what GUIDE.md's operator
+  map and the parity lint key on);
+* the backend is the literal ``"cpu"`` or ``"tpu"``;
+* at least one implementation of each name in the module carries a
+  docstring (else the op is blank in docs/API.md and
+  ``registry.describe`` returns nothing).
+"""
+
+from __future__ import annotations
+
+import re
+
+from ..core import FileContext, rule
+from ..jaxutil import module_info
+
+_NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
+_BACKENDS = {"cpu", "tpu"}
+
+
+@rule("SCT006", "registry-conventions",
+      "registered transforms need literal dotted lowercase names, a "
+      "cpu/tpu backend literal, and a docstring on some impl")
+def check_registry_conventions(ctx: FileContext):
+    import ast
+
+    info = module_info(ctx)
+    by_name: dict[str, list] = {}
+    for impl in info.registered:
+        if impl.name is None:
+            yield ctx.violation(
+                "SCT006", impl.decorator,
+                f"register() on '{impl.fn.name}': the transform name "
+                f"must be a string literal (docs generation and the "
+                f"parity lint both read it statically)")
+            continue
+        if not impl.name.startswith("test.") \
+                and not _NAME_RE.match(impl.name):
+            yield ctx.violation(
+                "SCT006", impl.decorator,
+                f"registry name {impl.name!r} is not dotted lowercase "
+                f"(expected 'group.op', e.g. 'normalize.log1p')")
+        if impl.backend is None:
+            yield ctx.violation(
+                "SCT006", impl.decorator,
+                f"register({impl.name!r}): backend must be the "
+                f"literal 'cpu' or 'tpu'")
+        elif impl.backend not in _BACKENDS:
+            yield ctx.violation(
+                "SCT006", impl.decorator,
+                f"register({impl.name!r}): unknown backend "
+                f"{impl.backend!r} (expected 'cpu' or 'tpu')")
+        by_name.setdefault(impl.name, []).append(impl)
+    for name, group in by_name.items():
+        if not any(ast.get_docstring(i.fn)
+                   or i.fn.name in info.doc_assigned for i in group):
+            first = min(group, key=lambda i: i.fn.lineno)
+            yield ctx.violation(
+                "SCT006", first.decorator,
+                f"no implementation of {name!r} has a docstring — "
+                f"docs/API.md and registry.describe() would be blank "
+                f"for it")
