@@ -54,27 +54,42 @@ def recipe_pipeline(name: str, **kw) -> Pipeline:
 
 def run_recipe(name: str, data: CellData, *, backend: str | None = None,
                checkpoint_dir: str | None = None, resume: bool = True,
+               step_deadline_s: float | None = None,
                runner_kw: dict | None = None, **recipe_kw) -> CellData:
     """Run a named recipe under the resilient execution layer.
 
     The one-call ``apply("recipe.seurat", ...)`` form dies on the
     first transient device error and restarts from scratch; this form
     builds the recipe's :class:`Pipeline` and hands it to
-    ``runner.ResilientRunner`` — per-step retry with backoff, health-
-    checked CPU fallback, and (with ``checkpoint_dir=``) per-step
-    checkpoints so a killed run resumes at the failed step.
-    ``runner_kw`` forwards to the runner constructor (``policy=``,
-    ``isolate=``, ``preflight=`` …); ``recipe_kw`` to the recipe
-    factory (``n_top_genes=`` …).
+    ``runner.ResilientRunner`` — per-step retry with backoff, a
+    circuit breaker over repeated transient failures, health-checked
+    CPU fallback, optional per-step wall-clock deadlines
+    (``step_deadline_s=``), and (with ``checkpoint_dir=``) digest-
+    verified per-step checkpoints so a killed run resumes at the
+    failed step.  Corrupt checkpoint files are quarantined (moved to
+    ``checkpoint_dir/quarantine/``, never deleted) and resume falls
+    back past them.  The input data's content digest is part of every
+    checkpoint fingerprint: calling again with DIFFERENT data and the
+    same ``checkpoint_dir`` recomputes instead of silently returning
+    the previous run's result.  ``runner_kw`` forwards to the runner
+    constructor (``policy=``, ``isolate=``, ``preflight=``,
+    ``breaker=`` …); ``recipe_kw`` to the recipe factory
+    (``n_top_genes=`` …).
 
     >>> out = run_recipe("seurat", data, backend="tpu",
-    ...                  checkpoint_dir="ck/", n_top_genes=2000)
+    ...                  checkpoint_dir="ck/", step_deadline_s=900,
+    ...                  n_top_genes=2000)
     """
     from .runner import ResilientRunner
 
-    pipe = recipe_pipeline(name, **recipe_kw)
-    runner = ResilientRunner(pipe, checkpoint_dir=checkpoint_dir,
-                             **(runner_kw or {}))
+    kw = dict(runner_kw or {})
+    if step_deadline_s is not None:
+        # the explicit parameter wins over a runner_kw duplicate — a
+        # silently-discarded deadline budget is exactly the kind of
+        # config drift the journal exists to rule out
+        kw["step_deadline_s"] = step_deadline_s
+    runner = ResilientRunner(recipe_pipeline(name, **recipe_kw),
+                             checkpoint_dir=checkpoint_dir, **kw)
     return runner.run(data, backend=backend, resume=resume)
 
 

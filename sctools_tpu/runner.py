@@ -26,20 +26,45 @@ into one execution layer:
   ``failsafe.run_isolated``: a crash or wedge kills the CHILD, the
   runner's process (and its jax runtime) stays clean, and the death
   is classified transient (retried, possibly degraded).
-* **Checkpointed resume** — with ``checkpoint_dir=``, every completed
-  step is checkpointed under its content fingerprint
-  (``checkpoint.step_filename``); a killed run re-invoked with
-  ``resume=True`` restarts at the failed step.  Filenames are shared
-  with ``PipelineCheckpointer``, so the two interoperate.
+* **Checkpointed resume with integrity** — with ``checkpoint_dir=``,
+  every completed step is checkpointed under its content fingerprint
+  (``checkpoint.step_filename``) with an embedded digest; a killed
+  run re-invoked with ``resume=True`` restarts at the failed step.
+  Resume VERIFIES every candidate file (``verify_checkpoint``) before
+  trusting it: corrupt or mismatched files are QUARANTINED (moved to
+  ``quarantine/``, never deleted, reason journaled) and resume falls
+  back past them deterministically.  The input data's content digest
+  is part of every fingerprint, so a resume against different data
+  recomputes instead of returning the previous run's result.
+  Filenames are shared with ``PipelineCheckpointer``, so the two
+  interoperate.
+* **Per-step deadlines** — ``step_deadline_s=`` gives every step
+  ATTEMPT a wall-clock budget (a fresh token per retry — a retried
+  attempt must be allowed the time a wedge stole; worst-case step
+  wall is therefore budget × attempts + backoff): a cooperative
+  ``DeadlineToken`` is threaded through the registry call-wrapper
+  hooks (checked before and after every transform invocation),
+  isolated steps inherit the remaining budget as their watchdog
+  timeout, and an overrun raises ``StepDeadlineExceeded`` —
+  classified transient, so it is journaled and retried/degraded like
+  any other device error.
+* **Circuit breaker** — after K classified-transient accelerator
+  failures in a sliding window (``failsafe.CircuitBreaker``) the
+  breaker OPENS and further accelerator attempts short-circuit
+  straight to the degrade ruling — no retry storm, no 90 s probe
+  storm.  After the cooldown it HALF-OPENS; one successful probe
+  closes it and un-degrades the run.
 * **Structured run journal** — one JSONL record per event (attempt,
-  backoff, fallback, resume, completion) with the classified error,
-  backend, wall time and the ``trace.span`` id it links to; the
-  in-memory :class:`RunReport` mirrors it.
+  backoff, deadline, breaker transition, fallback, quarantine,
+  resume, completion) with the classified error, backend, wall time
+  and the ``trace.span`` id it links to; the in-memory
+  :class:`RunReport` mirrors it.
 
-All time sources are injectable (``sleep=``, ``probe=``), so recovery
-behaviour — including the backoff schedule — is testable in tier-1
-with zero real sleeps (tests/test_runner.py), with faults injected
-deterministically by ``utils/chaos.py``.
+All time sources are injectable (``sleep=``, ``probe=``, ``clock=`` —
+see ``utils/vclock.py``), so recovery behaviour — backoff schedules,
+deadline overruns, breaker cooldowns — is testable in tier-1 with
+zero real sleeps (tests/test_runner.py, tests/test_integrity.py),
+with faults injected deterministically by ``utils/chaos.py``.
 
 >>> from sctools_tpu.runner import ResilientRunner
 >>> runner = ResilientRunner(seurat_pipeline(), checkpoint_dir="ck/")
@@ -57,14 +82,20 @@ import tempfile
 import time
 import warnings
 
+from . import registry as _registry
 from .registry import Pipeline, Transform
 from .utils import trace
-from .utils.checkpoint import (load_celldata, save_celldata,
-                               step_filename, step_fingerprint,
-                               latest_step)
+from .utils.checkpoint import (CheckpointCorruptError, data_digest,
+                               load_celldata, quarantine_checkpoint,
+                               save_celldata, step_filename,
+                               step_fingerprint, latest_step)
 from .utils.failsafe import (DETERMINISTIC, FATAL, TRANSIENT,
-                             TransientDeviceError, classify_error,
+                             CircuitBreaker, DeadlineToken,
+                             StepDeadlineExceeded, check_deadline,
+                             classify_child_result, classify_error,
+                             current_deadline, deadline_scope,
                              probe_device, run_isolated)
+from .utils.vclock import SYSTEM_CLOCK
 
 
 @dataclasses.dataclass
@@ -126,6 +157,8 @@ class RunReport:
     degraded: bool = False
     resumed_from: int | None = None
     journal_path: str | None = None
+    input_digest: str | None = None
+    breaker: dict | None = None   # CircuitBreaker.snapshot(), live
     steps: list = dataclasses.field(default_factory=list)
 
     def to_dict(self) -> dict:
@@ -170,8 +203,25 @@ def _exec_step(in_path: str, name: str, backend: str, params: dict,
             out = t(data)
     else:
         out = t(data)
-    save_celldata(out, out_path)
+    # digest=False: a same-process transfer file, never resumed from —
+    # hashing multi-GB payloads twice per attempt buys nothing here
+    save_celldata(out, out_path, digest=False)
     return True
+
+
+def _deadline_wrap(name, backend, fn):
+    """Registry call-wrapper: check the current cooperative deadline
+    token before AND after every transform invocation.  Installed for
+    the whole run, so composite steps that dispatch nested ``apply``
+    calls hit the check at every boundary; outside a
+    ``deadline_scope`` the check is a no-op."""
+    def checked(data, *args, **kw):
+        check_deadline()
+        out = fn(data, *args, **kw)
+        check_deadline()
+        return out
+
+    return checked
 
 
 class _Journal:
@@ -216,7 +266,10 @@ class ResilientRunner:
         ruled unhealthy (``None`` disables fallback).
     isolate : collection of str
         Transform names to contain in a watched subprocess
-        (known-wedging stages); a killed child is a TRANSIENT failure.
+        (known-wedging stages); the child's death is CLASSIFIED from
+        its stderr tail (``failsafe.classify_child_result``) — a
+        deterministic traceback fails fast, only device/timeout
+        signatures retry.
     validate : callable | None
         ``validate(index, name, data)`` after each successful step;
         a raise is treated as that attempt's failure (a ``ValueError``
@@ -224,8 +277,28 @@ class ResilientRunner:
     chaos : ChaosMonkey | None
         Fault-injection harness active for the whole run and
         forwarded into isolated children.
+    step_deadline_s : float | None
+        Wall-clock budget per step ATTEMPT (each retry gets a fresh
+        token; a step's worst-case wall is budget × max_attempts plus
+        backoff).  In-process steps carry a cooperative
+        ``DeadlineToken`` checked at every registry call boundary;
+        isolated steps inherit the remaining budget as their watchdog
+        timeout.  Overrun → ``StepDeadlineExceeded`` (transient:
+        journaled, retried, degradable).
+    breaker : failsafe.CircuitBreaker | None
+        Accelerator circuit breaker; default
+        ``CircuitBreaker(failure_threshold=3, window_s=300,
+        cooldown_s=60)`` on the runner's clock.  OPEN short-circuits
+        accelerator attempts straight to the degrade ruling;
+        HALF_OPEN allows one probe, whose success closes the breaker
+        and un-degrades the run.
+    clock : vclock.Clock
+        Time source for backoff, deadlines and the breaker window
+        (default: the system clock).  Tests share one
+        ``VirtualClock`` between runner, breaker and ChaosMonkey.
     sleep : callable
-        Backoff sleeper (``time.sleep``); tests inject a fake.
+        Backoff sleeper (default ``clock.sleep``); tests inject a
+        fake.
     """
 
     def __init__(self, pipeline: Pipeline, *,
@@ -237,7 +310,10 @@ class ResilientRunner:
                  fallback_backend: str | None = "cpu",
                  isolate=(), isolate_timeout_s: float = 600.0,
                  isolate_stall_s: float = 240.0,
-                 validate=None, chaos=None, sleep=time.sleep):
+                 validate=None, chaos=None,
+                 step_deadline_s: float | None = None,
+                 breaker: CircuitBreaker | None = None,
+                 clock=None, sleep=None):
         self.pipeline = pipeline
         self.checkpoint_dir = checkpoint_dir
         if checkpoint_dir:
@@ -255,9 +331,15 @@ class ResilientRunner:
         self.isolate_stall_s = isolate_stall_s
         self.validate = validate
         self.chaos = chaos
-        self.sleep = sleep
+        self.step_deadline_s = step_deadline_s
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
+        self.breaker = breaker if breaker is not None else \
+            CircuitBreaker(clock=self.clock)
+        self.sleep = sleep if sleep is not None else self.clock.sleep
         self.journal = _Journal(journal_path)
         self.report = RunReport(journal_path=journal_path)
+        self._input_digest: str | None = None
+        self._breaker_degraded = False
 
     # ------------------------------------------------------------------
     def run(self, data, backend: str | None = None, resume: bool = True):
@@ -265,18 +347,28 @@ class ResilientRunner:
 
         steps = list(self.pipeline.steps)
         rng = random.Random(self.policy.seed)
+        dig = self._input_digest = data_digest(data)
+        self._breaker_degraded = False
         report = self.report = RunReport(
             status="pending", backend=backend,
-            journal_path=self.journal.path,
-            steps=[StepReport(i, t.name, step_fingerprint(steps, i),
+            journal_path=self.journal.path, input_digest=dig,
+            breaker=self.breaker.snapshot(),
+            steps=[StepReport(i, t.name,
+                              step_fingerprint(steps, i,
+                                               input_digest=dig),
                               isolated=t.name in self.isolate)
                    for i, t in enumerate(steps)])
         self.journal.write(
             "run_start", n_steps=len(steps), backend=backend,
             resume=bool(resume and self.checkpoint_dir),
+            input_digest=dig,
             steps=[{"index": s.index, "name": s.name,
                     "fingerprint": s.fingerprint}
                    for s in report.steps])
+        if dig is None:
+            # data_digest already warned; the journal must say so too —
+            # resume cannot prove the checkpoints belong to THIS input
+            self.journal.write("resume_unverified_input")
 
         degraded = False
         if self.preflight:
@@ -286,40 +378,56 @@ class ResilientRunner:
             # host-side load only — device placement happens per-step
             # inside the attempt try-block (_match_residency), where a
             # dead device is classified and degraded like any other
-            # failure.  An unreadable checkpoint (disk error, external
-            # truncation) falls back to the next-newest intact one;
-            # only when none survive does the run restart from scratch.
-            i = latest_step(self.checkpoint_dir, steps)
+            # failure.  Every candidate is digest-verified first; a
+            # corrupt, mismatched or unreadable file is QUARANTINED
+            # (moved aside, never deleted, reason journaled) and the
+            # resume falls back to the next-newest intact one; only
+            # when none survive does the run restart from scratch.
+            i = latest_step(self.checkpoint_dir, steps,
+                            input_digest=dig)
             while i is not None:
+                path = self._ckpt_path(steps, i)
                 try:
-                    data_ck = load_celldata(self._ckpt_path(steps, i))
-                except Exception as e:  # noqa: BLE001 — a corrupt
-                    # checkpoint must not kill the run; an earlier
-                    # one (or scratch) always can
-                    warnings.warn(
-                        f"ResilientRunner: checkpoint for step {i} "
-                        f"unreadable ({type(e).__name__}: {e}) — "
-                        "falling back to the previous checkpoint",
-                        RuntimeWarning, stacklevel=2)
-                    self.journal.write(
-                        "resume_load_failed", from_step=i,
-                        error=f"{type(e).__name__}: {e}")
+                    # verify + load from ONE read of the file
+                    data_ck = load_celldata(
+                        path, verify=True,
+                        expect_fingerprint=report.steps[i].fingerprint)
+                except CheckpointCorruptError as e:
+                    self._quarantine(i, path, e.reason)
                     i = latest_step(self.checkpoint_dir, steps,
-                                    upto=i - 1)
+                                    upto=i - 1, input_digest=dig)
+                    continue
+                except Exception as e:  # noqa: BLE001 — verified yet
+                    # not reconstructable (malformed payload keys):
+                    # same ruling as corrupt — quarantine, fall back
+                    self._quarantine(
+                        i, path,
+                        f"unreadable ({type(e).__name__}: {e})")
+                    i = latest_step(self.checkpoint_dir, steps,
+                                    upto=i - 1, input_digest=dig)
                     continue
                 data = data_ck
                 start = i + 1
                 report.resumed_from = i
                 for s in report.steps[: i + 1]:
                     s.status = "resumed"
+                # the passed `data` argument is superseded by the
+                # checkpoint from here on — safe (the input digest is
+                # part of the fingerprint) but worth a journal line
                 self.journal.write(
                     "resume", from_step=i,
-                    fingerprint=report.steps[i].fingerprint)
+                    fingerprint=report.steps[i].fingerprint,
+                    input_digest=dig,
+                    note="checkpoint supersedes the passed data "
+                         "argument")
                 break
 
         chaos_ctx = (self.chaos.activate() if self.chaos is not None
                      else contextlib.nullcontext())
-        with chaos_ctx:
+        # the deadline wrapper is pushed INSIDE the chaos activation so
+        # it runs outermost — a chaos wedge that burns the clock is
+        # caught by the token check on the way out of the op
+        with chaos_ctx, _registry.call_wrapper(_deadline_wrap):
             for i in range(start, len(steps)):
                 data, degraded = self._run_step(
                     steps, i, data, backend, degraded, rng)
@@ -347,9 +455,11 @@ class ResilientRunner:
                     error=f"{type(e).__name__}: {e}")
         report.status = "completed"
         report.degraded = degraded
+        report.breaker = self.breaker.snapshot()
         if degraded:
             report.backend = self.fallback_backend
-        self.journal.write("run_completed", degraded=degraded)
+        self.journal.write("run_completed", degraded=degraded,
+                           breaker=report.breaker)
         return data
 
     # ------------------------------------------------------------------
@@ -361,7 +471,21 @@ class ResilientRunner:
         return b
 
     def _ckpt_path(self, steps, i: int) -> str:
-        return os.path.join(self.checkpoint_dir, step_filename(steps, i))
+        return os.path.join(
+            self.checkpoint_dir,
+            step_filename(steps, i, input_digest=self._input_digest))
+
+    def _quarantine(self, i: int, path: str, reason: str) -> None:
+        """Move a failed-verification checkpoint aside (never delete),
+        warn loudly, and journal the ruling."""
+        qpath = quarantine_checkpoint(path, reason)
+        warnings.warn(
+            f"ResilientRunner: checkpoint for step {i} failed "
+            f"verification ({reason}) — QUARANTINED to {qpath}; "
+            "falling back to the previous checkpoint",
+            RuntimeWarning, stacklevel=3)
+        self.journal.write("quarantine", step=i, reason=reason,
+                           path=qpath)
 
     def _rule_unhealthy(self, where: str) -> bool:
         """Probe the device; on an unhealthy verdict warn LOUDLY and
@@ -403,16 +527,47 @@ class ResilientRunner:
         attempt = 0        # monotonic across a fallback — the journal
         budget_used = 0    # join key must never repeat within a step
         while True:
+            # breaker half-open (cooldown elapsed): ONE probe decides —
+            # success closes the breaker and un-degrades the run,
+            # failure re-opens it for another cooldown
+            if (degraded and self._breaker_degraded
+                    and self.breaker.state == CircuitBreaker.HALF_OPEN):
+                rec = self.probe()
+                self.journal.write("health_check",
+                                   where=f"step {i} half-open",
+                                   result=rec)
+                if rec.get("ok"):
+                    self.breaker.record_success()
+                    degraded = False
+                    self._breaker_degraded = False
+                    self.report.degraded = False
+                    self.report.backend = backend
+                    self.report.breaker = self.breaker.snapshot()
+                    self.journal.write("breaker_close", step=i)
+                else:
+                    self.breaker.record_failure()  # half-open → open
+                    self.report.breaker = self.breaker.snapshot()
+                    self.journal.write("breaker_reopen", step=i,
+                                       reason=rec.get("reason"))
             attempt += 1
             budget_used += 1
             b = self._target_backend(t, backend, degraded)
             sr.backend = b
+            tok = (DeadlineToken(self.step_deadline_s, clock=self.clock,
+                                 label=f"step {i} ({t.name})")
+                   if self.step_deadline_s is not None else None)
             err = None
             with trace.span(f"runner:{t.name}",
                             meta={"step": i, "attempt": attempt,
                                   "backend": b}) as sp:
                 try:
-                    out = self._execute(t, data, b, i, steps)
+                    scope = (deadline_scope(tok) if tok is not None
+                             else contextlib.nullcontext())
+                    with scope:
+                        out = self._execute(t, data, b, i, steps)
+                        if tok is not None:
+                            tok.check()  # isolated steps bypass the
+                            # registry wrapper in THIS process
                     if self.validate is not None:
                         self.validate(i, t.name, out)
                     if self.checkpoint_dir:
@@ -421,7 +576,14 @@ class ResilientRunner:
                         # device that died between compute and save
                         # must be retried/degraded like any other
                         # step failure — not leak a raw raise
-                        save_celldata(out, self._ckpt_path(steps, i))
+                        save_celldata(out, self._ckpt_path(steps, i),
+                                      fingerprint=sr.fingerprint)
+                        if self.chaos is not None:
+                            # silent on-disk corruption, injected after
+                            # a good save — only the next resume's
+                            # digest verify can catch it
+                            self.chaos.on_checkpoint(
+                                t.name, self._ckpt_path(steps, i), b)
                 except BaseException as e:  # noqa: BLE001 — reported,
                     err = e                 # classified, re-raised below
             if err is None:
@@ -446,6 +608,13 @@ class ResilientRunner:
                 backend=b, status="error", classified=cls,
                 error=f"{type(err).__name__}: {err}",
                 wall_s=round(sp.duration, 4), span_id=sp.id)
+            if isinstance(err, StepDeadlineExceeded):
+                # its own journal event: the acceptance contract is
+                # that a wedged step leaves a "deadline" record before
+                # any breaker/fallback ruling it feeds into
+                self.journal.write(
+                    "deadline", step=i, name=t.name, attempt=attempt,
+                    budget_s=self.step_deadline_s)
             if cls == FATAL:
                 sr.status = "aborted"
                 self.report.status = "aborted"
@@ -460,8 +629,42 @@ class ResilientRunner:
                 self.journal.write("run_failed", step=i,
                                    classified=cls)
                 raise err
-            # transient: retry with backoff until the budget is spent,
-            # then let the health probe rule on a backend fallback
+            # transient: feed the breaker (accelerator attempts only —
+            # there is nothing to trip when already on the fallback)
+            on_accel = (self.fallback_backend is not None
+                        and b != self.fallback_backend)
+            if on_accel:
+                prev = self.breaker.state
+                now_state = self.breaker.record_failure()
+                self.report.breaker = self.breaker.snapshot()
+                if (now_state == CircuitBreaker.OPEN
+                        and prev != CircuitBreaker.OPEN):
+                    self.journal.write("breaker_open", step=i,
+                                       **self.breaker.snapshot())
+            if on_accel and not degraded and not self.breaker.allow():
+                # breaker OPEN: skip the remaining retries AND the
+                # probe — straight to the degrade ruling (this is the
+                # no-more-probe-storms contract)
+                warnings.warn(
+                    "ResilientRunner: circuit breaker OPEN "
+                    f"({self.breaker.failure_threshold} transient "
+                    f"failures within {self.breaker.window_s:g}s) — "
+                    f"DEGRADING remaining steps to backend="
+                    f"{self.fallback_backend!r} without probing.  A "
+                    "successful probe after the cooldown closes the "
+                    "breaker and returns to the accelerator.",
+                    RuntimeWarning, stacklevel=2)
+                self.journal.write("fallback", where=f"step {i}",
+                                   backend=self.fallback_backend,
+                                   reason="breaker_open")
+                self.report.degraded = True
+                self.report.backend = self.fallback_backend
+                degraded = True
+                self._breaker_degraded = True
+                budget_used = 0  # fresh budget on the fallback
+                continue
+            # retry with backoff until the budget is spent, then let
+            # the health probe rule on a backend fallback
             if budget_used < policy.max_attempts:
                 d = policy.delay_s(budget_used, rng)
                 self.journal.write("backoff", step=i, attempt=attempt,
@@ -521,25 +724,33 @@ class ResilientRunner:
         """Run one step under ``failsafe.run_isolated``: the data
         crosses into the watched child as a checkpoint file and comes
         back the same way, so a crashed/wedged child can never poison
-        this process's jax runtime."""
+        this process's jax runtime.  The child's death is CLASSIFIED
+        (``failsafe.classify_child_result``): a deterministic
+        traceback in the stderr tail fails fast instead of burning
+        the retry budget; watchdog kills and tracebackless process
+        death stay transient.  A per-step deadline caps the child's
+        watchdog timeout to the budget that remains."""
         workdir = self.checkpoint_dir or tempfile.mkdtemp(
             prefix="sctools_runner_")
         in_path = os.path.join(workdir, f"isolate_in_{i:03d}.npz")
         out_path = os.path.join(workdir, f"isolate_out_{i:03d}.npz")
-        save_celldata(data, in_path)
+        save_celldata(data, in_path, digest=False)  # transfer file
         kwargs = {"chaos_spec": self.chaos.spec()} if self.chaos else {}
+        timeout_s = self.isolate_timeout_s
+        tok = current_deadline()
+        if tok is not None:
+            # the deadline rules the child too; floor keeps a nearly-
+            # spent budget from passing a zero/negative watchdog
+            timeout_s = max(0.1, min(timeout_s, tok.remaining()))
         try:
             res = run_isolated(
                 _exec_step, in_path, t.name, t.backend, dict(t.params),
-                out_path, timeout_s=self.isolate_timeout_s,
+                out_path, timeout_s=timeout_s,
                 stall_timeout_s=self.isolate_stall_s, **kwargs)
             if self.chaos is not None:
                 self.chaos.note_external_call(t.name)
             if res["status"] != "completed":
-                raise TransientDeviceError(
-                    f"isolated step {t.name!r} {res['status']} "
-                    f"(rc={res.get('rc')}, wall={res.get('wall_s')}s); "
-                    f"stderr tail: {res.get('stderr_tail', '')[-300:]}")
+                raise classify_child_result(res, t.name)
             out = load_celldata(out_path)
             if backend == "tpu":
                 out = out.device_put()

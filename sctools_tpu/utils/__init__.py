@@ -1,6 +1,9 @@
-"""Auxiliary subsystems: tracing and checkpoint/resume."""
+"""Auxiliary subsystems: tracing, checkpoint/resume (+ integrity),
+and the injectable clock the resilience stack schedules through."""
 
 from .trace import profile, report, reset, span, spans  # noqa: F401
 from .checkpoint import (  # noqa: F401
-    PipelineCheckpointer, load_celldata, save_celldata,
+    PipelineCheckpointer, data_digest, load_celldata,
+    quarantine_checkpoint, save_celldata, verify_checkpoint,
 )
+from .vclock import SystemClock, VirtualClock  # noqa: F401

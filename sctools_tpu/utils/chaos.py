@@ -25,14 +25,15 @@ import contextlib
 import dataclasses
 import fnmatch
 import random
-import time
 
 import numpy as np
 
 from .. import registry
-from .failsafe import TransientDeviceError
+from .failsafe import TransientDeviceError, check_deadline
+from .vclock import SYSTEM_CLOCK
 
-MODES = ("unavailable", "hang", "corrupt", "crash", "kill")
+MODES = ("unavailable", "hang", "wedge", "corrupt",
+         "corrupt_checkpoint", "crash", "kill")
 
 
 class ChaosCrash(BaseException):
@@ -108,26 +109,41 @@ class ChaosMonkey:
     * ``hang`` — sleep ``hang_s`` before proceeding (a wedge; under
       subprocess containment the watchdog kills the child).  The
       sleeper is injectable so tier-1 tests hang no real clock.
+    * ``wedge`` — advance the monkey's ``clock`` by ``wedge_s`` and
+      then check the cooperative deadline: with the SAME (virtual)
+      clock shared with a ResilientRunner's ``step_deadline_s``
+      token, the op overruns its budget and raises
+      ``StepDeadlineExceeded`` — the in-process wedge the per-step
+      deadline layer exists to bound, with zero real sleeps.
     * ``corrupt`` — run the op, then deterministically NaN one element
       of the result.
+    * ``corrupt_checkpoint`` — never fires on the op call itself;
+      fires through :meth:`on_checkpoint` (the runner calls it after
+      every step-checkpoint save) and flips bytes of the file on
+      disk — the bit-rot/truncation damage the digest verify +
+      quarantine path exists to catch on the next resume.
     * ``crash`` — raise :class:`ChaosCrash` (in-process stand-in for
       process death; aborts the whole run, testing resume).
     * ``kill`` — ``os._exit(9)``: REAL process death.  Only meaningful
       inside a contained child (``failsafe.run_isolated``); in the
       parent process it takes the test runner down with it.
 
-    ``calls`` counts invocations per op name; ``injected`` logs every
+    ``calls`` counts invocations per op name (checkpoint saves count
+    separately under ``"<op>@checkpoint"``); ``injected`` logs every
     firing as ``{"op", "call", "mode", "backend"}`` — two monkeys with
     equal faults/seed driving the same workload produce identical
     logs (the determinism contract tier-1 pins).
     """
 
     def __init__(self, faults, seed: int = 0, hang_s: float = 3600.0,
-                 sleep=time.sleep):
+                 sleep=None, clock=None, wedge_s: float | None = None):
         self.faults = list(faults)
         self.seed = seed
         self.hang_s = hang_s
-        self.sleep = sleep
+        self.clock = clock
+        self.wedge_s = hang_s if wedge_s is None else wedge_s
+        self.sleep = (sleep if sleep is not None
+                      else (clock or SYSTEM_CLOCK).sleep)
         self.calls: dict[str, int] = {}
         self.injected: list[dict] = []
         self._rng = random.Random(seed)
@@ -138,12 +154,12 @@ class ChaosMonkey:
     def spec(self) -> dict:
         return {"faults": [dataclasses.asdict(f) for f in self.faults],
                 "seed": self.seed, "hang_s": self.hang_s,
-                "calls": dict(self.calls)}
+                "wedge_s": self.wedge_s, "calls": dict(self.calls)}
 
     @classmethod
     def from_spec(cls, spec: dict) -> "ChaosMonkey":
         m = cls([Fault(**f) for f in spec["faults"]], seed=spec["seed"],
-                hang_s=spec["hang_s"])
+                hang_s=spec["hang_s"], wedge_s=spec.get("wedge_s"))
         m.calls = dict(spec.get("calls", {}))
         return m
 
@@ -153,8 +169,40 @@ class ChaosMonkey:
         child's process)."""
         self.calls[name] = self.calls.get(name, 0) + 1
 
-    def _firing(self, name: str, backend: str, call_no: int):
+    def on_checkpoint(self, name: str, path: str,
+                      backend: str | None = None) -> bool:
+        """Runner hook, called after every step-checkpoint save: a
+        matching ``corrupt_checkpoint`` fault XOR-flips bytes of the
+        file in place (deterministically from the seed) and returns
+        True.  The run that wrote the file continues unharmed — the
+        damage is exactly the silent on-disk corruption that only the
+        NEXT resume's digest verification can catch."""
+        key = f"{name}@checkpoint"
+        call_no = self.calls.get(key, 0) + 1
+        self.calls[key] = call_no
+        f = self._firing(name, backend, call_no, channel="checkpoint")
+        if f is None:
+            return False
+        self.injected.append({"op": name, "call": call_no,
+                              "mode": f.mode, "backend": backend})
+        rng = random.Random((self.seed, name, call_no, "ckpt").__repr__())
+        with open(path, "r+b") as fh:
+            blob = bytearray(fh.read())
+            if blob:
+                for _ in range(min(16, len(blob))):
+                    blob[rng.randrange(len(blob))] ^= 0xFF
+                fh.seek(0)
+                fh.write(blob)
+        return True
+
+    def _firing(self, name: str, backend: str, call_no: int,
+                channel: str = "call"):
         for f in self.faults:
+            # corrupt_checkpoint faults live on the checkpoint channel
+            # (fired by on_checkpoint), every other mode on the op-call
+            # channel — a fault never fires on the wrong one
+            if (f.mode == "corrupt_checkpoint") != (channel == "checkpoint"):
+                continue
             if not fnmatch.fnmatchcase(name, f.op):
                 continue
             if f.backend is not None and backend != f.backend:
@@ -194,6 +242,27 @@ class ChaosMonkey:
                 os._exit(9)
             if f.mode == "hang":
                 self.sleep(self.hang_s)
+                return fn(data, *args, **kw)
+            if f.mode == "wedge":
+                # burn the step's wall-clock budget on the SHARED
+                # (virtual) clock, then let the cooperative token rule
+                # the op overrun — the op itself "never returns".
+                # Without an injected clock there is nothing to
+                # advance (and a real hang_s-scale sleep — e.g. a
+                # spec-rebuilt monkey inside an isolated child, which
+                # cannot inherit the parent's clock — would break the
+                # zero-real-sleeps contract): warn and skip the burn.
+                if self.clock is not None:
+                    self.clock.sleep(self.wedge_s)
+                else:
+                    import warnings
+
+                    warnings.warn(
+                        f"chaos: 'wedge' fault on {name!r} has no "
+                        "shared clock= to advance — skipping the "
+                        "time burn (use mode='hang' for real-clock "
+                        "wedges)", RuntimeWarning, stacklevel=2)
+                check_deadline()
                 return fn(data, *args, **kw)
             # corrupt: per-firing rng derived from (seed, op, call) so
             # the damage is reproducible regardless of what else drew

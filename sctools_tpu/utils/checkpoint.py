@@ -10,11 +10,29 @@ Device arrays are fetched to host first (``CellData.to_host`` trims
 row padding), so checkpoints are portable across chip counts and
 backends.  ``PipelineCheckpointer`` wraps a ``Pipeline`` and skips
 completed steps on resume.
+
+Integrity (the run-integrity layer): every file carries a content
+digest, a schema version and (when the writer knows it) the step
+fingerprint under ``_integrity/*`` keys.  :func:`verify_checkpoint`
+re-hashes a file before anyone trusts it; a file that fails — bit
+rot, a truncated write that survived the atomic rename race, chaos-
+injected corruption — is never deleted but moved aside by
+:func:`quarantine_checkpoint` so resume falls back past it
+deterministically while the bytes stay available as evidence.
+:func:`data_digest` hashes a run's INPUT, and
+:func:`step_fingerprint` mixes that digest into every step identity —
+so ``resume=True`` with *different* data and the same checkpoint
+directory recomputes instead of silently returning the previous run's
+result (the PR-1 latent bug).
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
+import time
+import warnings
 
 import numpy as np
 
@@ -23,9 +41,57 @@ from ..data.sparse import SparseCells
 
 _SECTIONS = ("obs", "var", "obsm", "varm", "obsp", "uns")
 
+#: bump when the npz layout changes incompatibly; files stamped with a
+#: NEWER schema than the reader understands fail verification (an old
+#: reader must not half-parse a future layout)
+CHECKPOINT_SCHEMA = 1
 
-def save_celldata(data: CellData, path: str) -> None:
-    """Write a CellData to ``path`` (.npz, atomic via rename)."""
+#: npz key prefix for integrity metadata — never part of the payload
+_INTEGRITY = "_integrity/"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed digest/schema/fingerprint verification.
+    Deterministic by classification: re-reading the same bytes fails
+    the same way — callers quarantine and fall back, never retry.
+    ``.reason`` carries the machine-readable why (the same string
+    :func:`verify_checkpoint` returns), ``.path`` the file."""
+
+    def __init__(self, path: str, reason: str):
+        super().__init__(f"{path}: {reason}")
+        self.path = path
+        self.reason = reason
+
+
+def _content_digest(arrays: dict) -> str:
+    """Order-independent sha256 over every payload array (key, dtype,
+    shape, raw bytes); ``_integrity/*`` keys are excluded so the
+    digest can be stored inside the file it covers."""
+    h = hashlib.sha256()
+    for k in sorted(arrays):
+        if k.startswith(_INTEGRITY):
+            continue
+        a = np.asarray(arrays[k])
+        h.update(k.encode())
+        h.update(f"|{a.dtype}|{a.shape}|".encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()[:16]
+
+
+def save_celldata(data: CellData, path: str, *,
+                  fingerprint: str | None = None,
+                  digest: bool = True) -> None:
+    """Write a CellData to ``path`` (.npz, atomic via rename).
+
+    The file self-describes its integrity: a content digest over every
+    payload array, the writer's :data:`CHECKPOINT_SCHEMA`, and — when
+    the caller passes ``fingerprint=`` (the runner does, with the
+    step's :func:`step_fingerprint`) — the step identity, so
+    :func:`verify_checkpoint` can detect renamed/mismatched files as
+    well as damaged ones.  ``digest=False`` skips the integrity keys
+    entirely (a full hash pass over the payload) — for throwaway
+    same-process transfer files that are never resumed from, e.g. the
+    runner's isolation handoffs."""
     import jax
     import scipy.sparse as sp
 
@@ -80,58 +146,235 @@ def save_celldata(data: CellData, path: str) -> None:
         for k, v in getattr(data, section).items():
             put(f"{section}/{k}", v)
     if skipped:
-        import warnings
-
         warnings.warn(
             f"save_celldata: skipped non-array entries {skipped}",
             stacklevel=2)
+    if digest:
+        arrays[f"{_INTEGRITY}digest"] = np.array(_content_digest(arrays))
+        arrays[f"{_INTEGRITY}schema"] = np.array(CHECKPOINT_SCHEMA,
+                                                 np.int64)
+        arrays[f"{_INTEGRITY}fingerprint"] = np.array(fingerprint or "")
     tmp = path + ".tmp.npz"
     np.savez(tmp, **arrays)
     os.replace(tmp, path)
 
 
-def load_celldata(path: str) -> CellData:
+def _read_arrays(path: str) -> dict:
+    """One-pass read of every npz entry into memory (reading each
+    member also runs the zip CRC checks).  The SAME dict feeds both
+    verification and CellData reconstruction, so a verified load
+    touches the file exactly once."""
+    with np.load(path, allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+def _verify_arrays(arrays: dict,
+                   expect_fingerprint: str | None = None) -> dict:
+    """Integrity ruling over already-read arrays (see
+    :func:`verify_checkpoint` for the reason vocabulary)."""
+    if f"{_INTEGRITY}digest" not in arrays:
+        return {"ok": True, "reason": "legacy", "schema": 0,
+                "fingerprint": None}
+    try:
+        stored = str(arrays[f"{_INTEGRITY}digest"])
+        schema = int(arrays[f"{_INTEGRITY}schema"])
+        fp = str(arrays[f"{_INTEGRITY}fingerprint"]) or None
+    except (KeyError, TypeError, ValueError) as e:
+        # a digest with its sibling keys stripped or mangled is a
+        # tampered/truncated file, not a legacy one — same ruling as
+        # unreadable, and NEVER a raw raise out of a verify call
+        return {"ok": False, "schema": None, "fingerprint": None,
+                "reason": "unreadable (integrity keys incomplete: "
+                          f"{type(e).__name__}: {e})"}
+    if schema > CHECKPOINT_SCHEMA:
+        return {"ok": False, "schema": schema, "fingerprint": fp,
+                "reason": f"schema {schema} newer than supported "
+                          f"{CHECKPOINT_SCHEMA}"}
+    computed = _content_digest(arrays)
+    if computed != stored:
+        return {"ok": False, "schema": schema, "fingerprint": fp,
+                "reason": f"digest mismatch (stored {stored}, "
+                          f"computed {computed})"}
+    if expect_fingerprint and fp and fp != expect_fingerprint:
+        return {"ok": False, "schema": schema, "fingerprint": fp,
+                "reason": f"fingerprint mismatch (file {fp}, "
+                          f"expected {expect_fingerprint})"}
+    return {"ok": True, "reason": None, "schema": schema,
+            "fingerprint": fp}
+
+
+def verify_checkpoint(path: str,
+                      expect_fingerprint: str | None = None) -> dict:
+    """Re-hash a checkpoint before trusting it.
+
+    Returns ``{"ok": bool, "reason": str | None, "schema": int,
+    "fingerprint": str | None}``.  Failure reasons: ``unreadable``
+    (not an npz / zip CRC failure / missing keys), ``digest
+    mismatch`` (bit rot or tampering), ``schema ... newer`` (written
+    by a future layout), ``fingerprint mismatch`` (the file's stored
+    step identity disagrees with ``expect_fingerprint`` — a renamed
+    or cross-wired file).  Files from before the integrity layer
+    carry no digest and verify ``ok`` with ``reason="legacy"`` — an
+    unverifiable file is not the same as a corrupt one.  To verify
+    AND load in one read, use ``load_celldata(path, verify=True)``.
+    """
+    try:
+        arrays = _read_arrays(path)
+        return _verify_arrays(arrays, expect_fingerprint)
+    except Exception as e:  # noqa: BLE001 — any unreadable byte
+        # pattern (BadZipFile, zlib, KeyError on truncated archives)
+        # means the same thing to the caller: do not trust this file
+        return {"ok": False,
+                "reason": f"unreadable ({type(e).__name__}: {e})",
+                "schema": None, "fingerprint": None}
+
+
+def quarantine_checkpoint(path: str, reason: str) -> str:
+    """Move a corrupt/mismatched checkpoint into a ``quarantine/``
+    subdir beside it — NEVER deleted; the bytes are the evidence a
+    post-mortem needs — and drop a ``.reason.json`` sidecar.  Returns
+    the quarantined path.  Resume then falls back past the file
+    deterministically (``latest_step(upto=...)``)."""
+    d = os.path.dirname(os.path.abspath(path))
+    qdir = os.path.join(d, "quarantine")
+    os.makedirs(qdir, exist_ok=True)
+    base = os.path.basename(path)
+    dest = os.path.join(qdir, base)
+    n = 1
+    while os.path.exists(dest):
+        dest = os.path.join(qdir, f"{base}.{n}")
+        n += 1
+    os.replace(path, dest)
+    try:
+        with open(dest + ".reason.json", "w") as f:
+            json.dump({"reason": reason, "ts": round(time.time(), 3),
+                       "original": os.path.abspath(path)}, f)
+    except OSError as e:
+        # the MOVE is the contract; a failed sidecar only loses the
+        # human-readable why
+        warnings.warn(f"quarantine_checkpoint: could not write reason "
+                      f"sidecar ({e})", stacklevel=2)
+    return dest
+
+
+def data_digest(data) -> str | None:
+    """Cheap content digest (12 hex chars) of a run's INPUT: the X
+    matrix plus every obs/var/obsm/varm/obsp/uns/layers entry.
+    Annotations are part of the identity on purpose — transforms
+    consume them too (``abundance.*`` reads obs condition labels, DE
+    reads groupings), so two inputs with the same counts but
+    different labels must invalidate each other's checkpoints.
+    Mixed into every step fingerprint so checkpoints from a run over
+    different data can never be resumed by mistake.  Returns ``None``
+    (with a warning) when the input cannot be hashed; callers must
+    then treat resume as unverified rather than fail the run."""
     import scipy.sparse as sp
 
-    with np.load(path, allow_pickle=False) as z:
-        def get_matrix(prefix):
-            fmt = str(z[f"{prefix}/format"])
-            if fmt == "csr":
-                shape = tuple(z[f"{prefix}/shape"])
-                return sp.csr_matrix(
-                    (z[f"{prefix}/data"], z[f"{prefix}/indices"],
-                     z[f"{prefix}/indptr"]), shape=shape)
-            return z[f"{prefix}/data"]
+    def hash_matrix(h, M):
+        if hasattr(M, "to_scipy_csr"):  # device-packed SparseCells
+            M = M.to_scipy_csr()
+        if sp.issparse(M):
+            M = M.tocsr()
+            h.update(f"csr|{M.shape}|{M.data.dtype}|".encode())
+            for a in (M.data, M.indices, M.indptr):
+                h.update(np.ascontiguousarray(a).tobytes())
+            return
+        a = np.asarray(M)  # fetches device arrays to host
+        if a.dtype == object:
+            # labels/dicts: repr of the nested value is content-
+            # deterministic; order-sensitive for dicts, which only
+            # errs toward recomputing (fails safe)
+            h.update(f"obj|{a.shape}|".encode())
+            h.update(repr(a.tolist()).encode())
+            return
+        h.update(f"dense|{a.shape}|{a.dtype}|".encode())
+        h.update(np.ascontiguousarray(a).tobytes())
 
-        X = get_matrix("X")
-        layers = {}
-        for key in z.files:
-            if key.startswith("LAYER::") and key.endswith("/format"):
-                name = key[len("LAYER::"):-len("/format")]
-                layers[name] = get_matrix(f"LAYER::{name}")
-        sections: dict[str, dict] = {s: {} for s in _SECTIONS}
-        for key in z.files:
-            section, _, name = key.partition("/")
-            if (section not in sections or key.startswith("X/")
-                    or key.startswith("LAYER::")):
-                continue
-            target = sections[section]
-            parts = name.split("//")
-            for p in parts[:-1]:  # rebuild nested dicts
-                target = target.setdefault(p, {})
-            target[parts[-1]] = z[key]
+    try:
+        h = hashlib.sha256()
+        if not hasattr(data, "X"):
+            hash_matrix(h, data)
+            return h.hexdigest()[:12]
+        hash_matrix(h, data.X)
+        for section in _SECTIONS + ("layers",):
+            d = getattr(data, section, None) or {}
+            for k in sorted(d):
+                h.update(f"|{section}/{k}|".encode())
+                hash_matrix(h, d[k])
+        return h.hexdigest()[:12]
+    except Exception as e:  # noqa: BLE001 — an unhashable input must
+        # not kill a run; resume just loses input-identity checking
+        warnings.warn(
+            f"data_digest: could not hash the input "
+            f"({type(e).__name__}: {e}) — resume will NOT detect a "
+            "changed input dataset", stacklevel=2)
+        return None
+
+
+def load_celldata(path: str, *, verify: bool = False,
+                  expect_fingerprint: str | None = None) -> CellData:
+    """Load a CellData checkpoint.  ``verify=True`` rules on the
+    file's integrity (digest/schema/``expect_fingerprint``) from the
+    SAME single read that feeds reconstruction — no second pass over
+    a multi-GB file — raising :class:`CheckpointCorruptError` (with
+    ``.reason``) on any failure, unreadable bytes included."""
+    import scipy.sparse as sp
+
+    if verify:
+        try:
+            arrays = _read_arrays(path)
+        except Exception as e:  # noqa: BLE001 — unreadable is an
+            # integrity ruling here, not a programming error
+            raise CheckpointCorruptError(
+                path, f"unreadable ({type(e).__name__}: {e})") from e
+        chk = _verify_arrays(arrays, expect_fingerprint)
+        if not chk["ok"]:
+            raise CheckpointCorruptError(path, chk["reason"])
+    else:
+        arrays = _read_arrays(path)
+
+    def get_matrix(prefix):
+        fmt = str(arrays[f"{prefix}/format"])
+        if fmt == "csr":
+            shape = tuple(arrays[f"{prefix}/shape"])
+            return sp.csr_matrix(
+                (arrays[f"{prefix}/data"], arrays[f"{prefix}/indices"],
+                 arrays[f"{prefix}/indptr"]), shape=shape)
+        return arrays[f"{prefix}/data"]
+
+    X = get_matrix("X")
+    layers = {}
+    for key in arrays:
+        if key.startswith("LAYER::") and key.endswith("/format"):
+            name = key[len("LAYER::"):-len("/format")]
+            layers[name] = get_matrix(f"LAYER::{name}")
+    sections: dict[str, dict] = {s: {} for s in _SECTIONS}
+    for key in arrays:
+        section, _, name = key.partition("/")
+        if (section not in sections or key.startswith("X/")
+                or key.startswith("LAYER::")):
+            continue
+        target = sections[section]
+        parts = name.split("//")
+        for p in parts[:-1]:  # rebuild nested dicts
+            target = target.setdefault(p, {})
+        target[parts[-1]] = arrays[key]
     return CellData(X, layers=layers, **sections)
 
 
-def step_fingerprint(steps, i: int) -> str:
+def step_fingerprint(steps, i: int,
+                     input_digest: str | None = None) -> str:
     """Content hash (10 hex chars) of the step-``i`` prefix of
     ``steps`` — name plus parameters of every step up to and including
     ``i``, so a change to ANY earlier step invalidates everything
-    downstream of it.  This is the step identity the checkpoint
+    downstream of it.  ``input_digest`` (from :func:`data_digest`)
+    seeds the hash when given, making the INPUT DATA part of the step
+    identity — a resume against the same directory with different
+    data then matches nothing instead of silently returning the
+    previous run's result.  This is the step identity the checkpoint
     filenames embed; the ResilientRunner journals it so a run record
     can be matched to the exact pipeline configuration that produced
     it."""
-    import hashlib
 
     def sig(v, h):
         # repr() alone is unsafe: numpy elides large arrays
@@ -172,37 +415,52 @@ def step_fingerprint(steps, i: int) -> str:
                     "invalidate old checkpoints", stacklevel=2)
             h.update(r.encode())
 
-    # hash of the (name, sorted params) prefix chain — stale
-    # checkpoints from a different configuration (or an edited
-    # earlier step) are never resumed
+    # hash of the (input digest, (name, sorted params) prefix chain) —
+    # stale checkpoints from a different configuration, an edited
+    # earlier step, OR a different input dataset are never resumed
     h = hashlib.sha256()
+    if input_digest:
+        h.update(f"input:{input_digest}|".encode())
     for t in steps[: i + 1]:
         h.update(t.name.encode())
         sig(dict(t.params), h)
     return h.hexdigest()[:10]
 
 
-def step_filename(steps, i: int) -> str:
+def step_filename(steps, i: int,
+                  input_digest: str | None = None) -> str:
     """Checkpoint basename for step ``i``:
     ``step{i:03d}_{transform}_{fingerprint}.npz``.  Pure function of
-    the step list — PipelineCheckpointer and the ResilientRunner both
-    name through here, so their checkpoints interoperate (a run
-    started under one resumes under the other)."""
+    the step list (and the optional input digest) —
+    PipelineCheckpointer and the ResilientRunner both name through
+    here, so their checkpoints interoperate (a run started under one
+    resumes under the other)."""
     safe = steps[i].name.replace(".", "_").replace("/", "_")
-    return f"step{i:03d}_{safe}_{step_fingerprint(steps, i)}.npz"
+    fp = step_fingerprint(steps, i, input_digest=input_digest)
+    return f"step{i:03d}_{safe}_{fp}.npz"
 
 
-def latest_step(directory: str, steps, upto: int | None = None) -> int | None:
+def latest_step(directory: str, steps, upto: int | None = None,
+                input_digest: str | None = None,
+                verify: bool = False) -> int | None:
     """Index of the newest step whose checkpoint exists in
     ``directory`` under the CURRENT fingerprints, or ``None``.  Stale
-    files from an edited configuration never match (their fingerprint
-    differs), so they are simply ignored.  ``upto`` bounds the search
-    to indices ``<= upto`` — how a resumer skips past a checkpoint it
-    found unreadable and falls back to the next-newest one."""
+    files from an edited configuration (or, with ``input_digest``, a
+    different input dataset) never match — their fingerprint differs —
+    so they are simply ignored.  ``verify=True`` additionally re-hashes
+    each candidate (:func:`verify_checkpoint`) and skips files that
+    fail, falling back to the next-newest intact one.  ``upto`` bounds
+    the search to indices ``<= upto`` — how a resumer skips past a
+    checkpoint it has already quarantined."""
     hi = len(steps) - 1 if upto is None else min(upto, len(steps) - 1)
     for i in range(hi, -1, -1):
-        if os.path.exists(os.path.join(directory, step_filename(steps, i))):
-            return i
+        p = os.path.join(
+            directory, step_filename(steps, i, input_digest=input_digest))
+        if not os.path.exists(p):
+            continue
+        if verify and not verify_checkpoint(p)["ok"]:
+            continue
+        return i
     return None
 
 
@@ -219,6 +477,10 @@ class PipelineCheckpointer:
     step's parameters invalidates mismatched names automatically (the
     hash covers every step up to and including step ``i``, so editing
     an earlier step also invalidates everything downstream of it).
+    The input data's :func:`data_digest` is part of the hash too, so
+    a resume against different data recomputes.  Resume only trusts
+    files that pass :func:`verify_checkpoint` (corrupt ones are
+    skipped; the ResilientRunner additionally quarantines them).
     """
 
     def __init__(self, pipeline, directory: str, save_every: int = 1):
@@ -227,27 +489,50 @@ class PipelineCheckpointer:
         self.save_every = max(1, save_every)
         os.makedirs(directory, exist_ok=True)
 
-    def _step_path(self, i: int, steps) -> str:
-        return os.path.join(self.directory, step_filename(steps, i))
+    def _step_path(self, i: int, steps,
+                   input_digest: str | None = None) -> str:
+        return os.path.join(
+            self.directory,
+            step_filename(steps, i, input_digest=input_digest))
 
     def run(self, data: CellData, backend: str | None = None,
             resume: bool = True) -> CellData:
         steps = list(self.pipeline.steps)
+        dig = data_digest(data)
         start = 0
         if resume:
-            i = latest_step(self.directory, steps)
-            if i is not None:
-                data = load_celldata(self._step_path(i, steps))
+            # single-pass verified load per candidate: a corrupt file
+            # is skipped (the ResilientRunner additionally quarantines
+            # in this situation; here we only refuse to trust it)
+            i = latest_step(self.directory, steps, input_digest=dig)
+            while i is not None:
+                try:
+                    loaded = load_celldata(
+                        self._step_path(i, steps, dig), verify=True)
+                except Exception as e:  # noqa: BLE001 — untrusted
+                    # file: fall back, never crash a resumable run
+                    warnings.warn(
+                        f"PipelineCheckpointer: checkpoint for step "
+                        f"{i} rejected ({e}) — falling back",
+                        RuntimeWarning, stacklevel=2)
+                    i = latest_step(self.directory, steps,
+                                    upto=i - 1, input_digest=dig)
+                    continue
+                data = loaded
                 if backend in (None, "tpu"):
                     data = data.device_put()
                 start = i + 1
+                break
         for i in range(start, len(steps)):
             t = steps[i]
             if backend is not None and backend != t.backend:
                 t = t.with_backend(backend)
             data = t(data)
             if (i + 1) % self.save_every == 0 or i == len(steps) - 1:
-                save_celldata(data, self._step_path(i, steps))
+                save_celldata(
+                    data, self._step_path(i, steps, dig),
+                    fingerprint=step_fingerprint(steps, i,
+                                                 input_digest=dig))
         return data
 
     def clear(self) -> None:

@@ -24,18 +24,40 @@ is recoverable in-process; containment means subprocesses + watchdogs.
   DETERMINISTIC program error (retrying re-raises the same thing)?
   The runner (``sctools_tpu/runner.py``) routes every step failure
   through this one function so the retry policy exists exactly once.
+* :func:`classify_child_result` — the same taxonomy for a contained
+  child's death: a deterministic traceback in the stderr tail FAILS
+  FAST; only genuine device/timeout signatures (watchdog kills,
+  tracebackless process death, UNAVAILABLE noise) retry.
+* :class:`DeadlineToken` / :func:`deadline_scope` — a cooperative
+  per-step wall-clock budget, threaded through the registry's
+  call-wrapper hooks by the runner; overrun raises
+  :class:`StepDeadlineExceeded` (a transient — retried/degraded like
+  any other device error).
+* :class:`CircuitBreaker` — after K classified-transient failures in
+  a sliding window the breaker OPENS and the runner short-circuits
+  further accelerator attempts straight to the degrade ruling (no
+  more probe storms); after a cooldown it HALF-OPENS and a single
+  successful probe closes it again.
+
+All scheduling here goes through the injectable clock
+(``utils/vclock.py``), so every recovery path is tier-1 testable with
+zero real sleeps.
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import pickle
+import re
 import subprocess
 import sys
 import tempfile
 import threading
 import time
+
+from .vclock import SYSTEM_CLOCK, Clock
 
 # ---------------------------------------------------------------------------
 # Retryable-error taxonomy
@@ -52,6 +74,23 @@ class TransientDeviceError(RuntimeError):
     wedging, a heartbeat deadline passed.  Raise this to *assert*
     transience when the wrapped error type alone cannot prove it
     (e.g. a contained subprocess death reported by run_isolated)."""
+
+
+class StepDeadlineExceeded(TransientDeviceError):
+    """A step overran its cooperative wall-clock budget
+    (:class:`DeadlineToken`).  Subclass of TransientDeviceError on
+    purpose: an overrun is device-shaped (a wedged tunnel, an op that
+    silently recompiled) — the runner retries/degrades it like any
+    other transient, it never fails the run outright."""
+
+
+class DeterministicChildError(RuntimeError):
+    """An isolated child died raising a deterministic program error
+    (a ``ValueError``-class traceback in its stderr tail).  Registered
+    in the DETERMINISTIC type set so the runner FAILS FAST instead of
+    burning the retry budget and a ~90 s probe on an error that
+    replays identically — the isolated-child misclassification the
+    PR-1 review flagged."""
 
 
 # Substrings (lowercased) that mark an accelerator-runtime error as
@@ -84,7 +123,7 @@ _TRANSIENT_TYPES = (TransientDeviceError, TimeoutError, ConnectionError,
 # deterministic.
 _DETERMINISTIC_TYPES = (ValueError, TypeError, KeyError, IndexError,
                         AttributeError, ArithmeticError, AssertionError,
-                        NotImplementedError)
+                        NotImplementedError, DeterministicChildError)
 
 
 def classify_error(exc: BaseException) -> str:
@@ -113,6 +152,243 @@ def classify_error(exc: BaseException) -> str:
 
 def is_transient(exc: BaseException) -> bool:
     return classify_error(exc) == TRANSIENT
+
+
+# ---------------------------------------------------------------------------
+# Child-death taxonomy (run_isolated results)
+# ---------------------------------------------------------------------------
+
+# Terminal traceback lines in a child's stderr tail: "ValueError: msg",
+# "numpy.linalg.LinAlgError: ...".  The LAST match is the exception the
+# child actually died on (earlier ones are chained causes).
+_CHILD_EXC_RE = re.compile(
+    r"^([A-Za-z_][A-Za-z0-9_.]*(?:Error|Exception|Exceeded))\b(?::.*)?$",
+    re.MULTILINE)
+
+# Exception TYPE NAMES that prove the child's death deterministic:
+# identical inputs replay the identical raise, so a retry only burns
+# budget.  The classify_error type set plus the common concrete
+# subclasses whose base-class identity a name match cannot see.
+_DETERMINISTIC_CHILD_NAMES = frozenset(
+    t.__name__ for t in _DETERMINISTIC_TYPES) | {
+    "ZeroDivisionError", "FloatingPointError", "OverflowError",
+    "RecursionError", "NameError", "UnboundLocalError", "LookupError",
+    "ImportError", "ModuleNotFoundError", "UnicodeError",
+    "PicklingError", "UnpicklingError",
+}
+
+# ...and the transient mirror (_TRANSIENT_TYPES + concrete subclasses):
+# the same TimeoutError that retries in-process must retry when it
+# killed a child instead
+_TRANSIENT_CHILD_NAMES = frozenset(
+    t.__name__ for t in _TRANSIENT_TYPES) | {
+    "StepDeadlineExceeded", "ConnectionResetError",
+    "ConnectionRefusedError", "ConnectionAbortedError",
+    "BrokenPipeError",
+}
+
+
+def classify_child_result(res: dict, step: str) -> BaseException:
+    """Map a non-``completed`` :func:`run_isolated` result to the
+    exception the caller should raise.
+
+    Rules, in order:
+
+    * ``timeout`` / ``stalled`` — the watchdog killed a wedged child:
+      :class:`TransientDeviceError` (retry/degrade).
+    * a deterministic exception type name terminates the stderr
+      traceback — :class:`DeterministicChildError` (FAIL FAST; the
+      child will raise the same thing on every retry).
+    * a transient exception type name (the ``_TRANSIENT_TYPES``
+      mirror: timeouts, connection drops), or any named exception
+      with a transient device marker (``UNAVAILABLE`` …) in the
+      tail — transient, exactly as in-process classification would
+      rule the same raise.
+    * an *unknown* named exception with no device signature —
+      deterministic (same default as :func:`classify_error`: failing
+      fast on a novel error is cheap to diagnose).
+    * no Python traceback at all — hard process death (SIGKILL,
+      preemption, ``os._exit``): device-shaped, transient.
+    """
+    status = res.get("status")
+    tail = res.get("stderr_tail", "") or ""
+    detail = (f"(status={status}, rc={res.get('rc')}, "
+              f"wall={res.get('wall_s')}s); stderr tail: {tail[-300:]}")
+    if status in ("timeout", "stalled"):
+        return TransientDeviceError(
+            f"isolated step {step!r} {status} — watchdog killed a "
+            f"wedged child {detail}")
+    low = tail.lower()
+    names = _CHILD_EXC_RE.findall(tail)
+    if names:
+        last = names[-1].rsplit(".", 1)[-1]
+        if last in _DETERMINISTIC_CHILD_NAMES:
+            return DeterministicChildError(
+                f"isolated step {step!r} died on a deterministic "
+                f"{names[-1]} — failing fast, a retry replays the "
+                f"same raise {detail}")
+        if last in _TRANSIENT_CHILD_NAMES or \
+                any(m in low for m in _TRANSIENT_MARKERS):
+            return TransientDeviceError(
+                f"isolated step {step!r} died on a device-shaped "
+                f"{names[-1]} {detail}")
+        return DeterministicChildError(
+            f"isolated step {step!r} died on {names[-1]} — novel "
+            f"error, failing fast {detail}")
+    if any(m in low for m in _TRANSIENT_MARKERS):
+        return TransientDeviceError(
+            f"isolated step {step!r} died with a device signature "
+            f"{detail}")
+    return TransientDeviceError(
+        f"isolated step {step!r} died with no Python traceback — "
+        f"hard process death (signal/preemption/_exit) {detail}")
+
+
+# ---------------------------------------------------------------------------
+# Cooperative per-step deadlines
+# ---------------------------------------------------------------------------
+
+#: innermost-last stack of active DeadlineTokens (single-threaded
+#: pipeline execution; the runner scopes one token per step attempt)
+_DEADLINES: list["DeadlineToken"] = []
+
+
+class DeadlineToken:
+    """A wall-clock budget for one unit of work, measured on an
+    injectable clock.  COOPERATIVE: nothing interrupts a running op —
+    the token is checked at every registry call-wrapper boundary (the
+    runner installs the check for the whole run) and by any op that
+    calls :func:`check_deadline` inside a long loop.  Overrun raises
+    :class:`StepDeadlineExceeded`, which classifies transient."""
+
+    def __init__(self, budget_s: float, clock: Clock | None = None,
+                 label: str = ""):
+        self.budget_s = float(budget_s)
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
+        self.label = label
+        self._t0 = self.clock.monotonic()
+
+    def elapsed(self) -> float:
+        return self.clock.monotonic() - self._t0
+
+    def remaining(self) -> float:
+        return self.budget_s - self.elapsed()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def check(self) -> None:
+        if self.expired():
+            raise StepDeadlineExceeded(
+                f"deadline: {self.label or 'step'} exceeded its "
+                f"{self.budget_s:g}s budget "
+                f"(elapsed {self.elapsed():g}s)")
+
+
+@contextlib.contextmanager
+def deadline_scope(token: DeadlineToken):
+    """Make ``token`` the current deadline for the enclosed block."""
+    _DEADLINES.append(token)
+    try:
+        yield token
+    finally:
+        _DEADLINES.remove(token)
+
+
+def current_deadline() -> DeadlineToken | None:
+    return _DEADLINES[-1] if _DEADLINES else None
+
+
+def check_deadline() -> None:
+    """Raise :class:`StepDeadlineExceeded` if the innermost active
+    deadline is overrun; no-op outside any :func:`deadline_scope`."""
+    tok = current_deadline()
+    if tok is not None:
+        tok.check()
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+# ---------------------------------------------------------------------------
+
+class CircuitBreaker:
+    """Sliding-window circuit breaker over classified-transient
+    accelerator failures.
+
+    States (``.state``): ``closed`` (normal), ``open`` (accelerator
+    attempts short-circuit straight to the degrade ruling — no retry
+    storm, no probe storm), ``half_open`` (the cooldown elapsed: ONE
+    probe is allowed; success closes the breaker, failure re-opens
+    it).  The open→half-open transition is lazy — evaluated on read
+    from the injectable clock, so tests drive it with a
+    :class:`~sctools_tpu.utils.vclock.VirtualClock` and zero real
+    sleeps.
+    """
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    def __init__(self, failure_threshold: int = 3,
+                 window_s: float = 300.0, cooldown_s: float = 60.0,
+                 clock: Clock | None = None):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = failure_threshold
+        self.window_s = float(window_s)
+        self.cooldown_s = float(cooldown_s)
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
+        self._failures: list[float] = []
+        self._state = self.CLOSED
+        self._opened_at: float | None = None
+        self.opened_count = 0
+
+    @property
+    def state(self) -> str:
+        if self._state == self.OPEN and self._opened_at is not None \
+                and self.clock.monotonic() - self._opened_at \
+                >= self.cooldown_s:
+            self._state = self.HALF_OPEN
+        return self._state
+
+    def allow(self) -> bool:
+        """May the caller attempt the accelerator right now?  False
+        only while OPEN (cooldown not yet elapsed)."""
+        return self.state != self.OPEN
+
+    def record_failure(self) -> str:
+        """Record one classified-transient failure; returns the new
+        state.  K failures inside the window trip CLOSED→OPEN; any
+        failure while HALF_OPEN re-opens (the probe lied)."""
+        now = self.clock.monotonic()
+        self._failures.append(now)
+        self._failures = [t for t in self._failures
+                          if now - t <= self.window_s]
+        st = self.state
+        if st == self.HALF_OPEN or (
+                st == self.CLOSED
+                and len(self._failures) >= self.failure_threshold):
+            self._state = self.OPEN
+            self._opened_at = now
+            self.opened_count += 1
+        return self.state
+
+    def record_success(self) -> str:
+        """A successful probe (or accelerator attempt): close the
+        breaker and clear the failure window."""
+        self._failures.clear()
+        self._state = self.CLOSED
+        self._opened_at = None
+        return self._state
+
+    def snapshot(self) -> dict:
+        """Journal/report-ready view of the breaker."""
+        return {"state": self.state,
+                "failures_in_window": len(self._failures),
+                "opened_count": self.opened_count,
+                "failure_threshold": self.failure_threshold,
+                "window_s": self.window_s,
+                "cooldown_s": self.cooldown_s}
 
 
 def probe_device(timeout_s: float = 90.0, platform: str | None = None) -> dict:
@@ -186,21 +462,26 @@ def watch_process(cmd, *, timeout_s: float, stall_timeout_s: float,
     The child's stderr is pumped line-by-line; every line resets the
     stall timer and is passed to ``on_line`` (when given).  The child
     is killed on deadline, on stall, or when ``extra_stop()`` returns
-    a truthy status string (e.g. an outer budget check).  Returns
+    a truthy status string (e.g. an outer budget check).  Timing is
+    deliberately pinned to the SYSTEM clock (not injectable): this
+    watches a REAL subprocess, and a virtual clock here would hot-spin
+    the poll loop and rule a healthy child timed-out in milliseconds —
+    tests shrink ``poll_s``/``stall_timeout_s`` instead.  Returns
     ``{"status": completed|crashed|stalled|timeout|<extra>, "rc",
     "wall_s", "lines", "stderr_tail"}``.
     """
-    t0 = time.time()
+    clk = SYSTEM_CLOCK
+    t0 = clk.monotonic()
     proc = subprocess.Popen(cmd, stderr=subprocess.PIPE,
                             stdout=subprocess.DEVNULL, text=True,
                             env=env, cwd=cwd)
-    last = [time.time()]
+    last = [clk.monotonic()]
     lines = [0]
     tail: list = []
 
     def pump():
         for line in proc.stderr:
-            last[0] = time.time()
+            last[0] = clk.monotonic()
             lines[0] += 1
             tail.append(line)
             if len(tail) > 50:
@@ -212,8 +493,8 @@ def watch_process(cmd, *, timeout_s: float, stall_timeout_s: float,
     th.start()
     status = "completed"
     while proc.poll() is None:
-        time.sleep(poll_s)
-        now = time.time()
+        clk.sleep(poll_s)
+        now = clk.monotonic()
         extra = extra_stop() if extra_stop is not None else None
         if now - t0 > timeout_s:
             status = "timeout"
@@ -234,7 +515,7 @@ def watch_process(cmd, *, timeout_s: float, stall_timeout_s: float,
     if status == "completed" and rc not in (0, None):
         status = "crashed"
     return {"status": status, "rc": rc, "lines": lines[0],
-            "wall_s": round(time.time() - t0, 1),
+            "wall_s": round(clk.monotonic() - t0, 1),
             "stderr_tail": "".join(tail)[-2000:]}
 
 

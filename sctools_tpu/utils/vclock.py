@@ -1,0 +1,72 @@
+"""Injectable wall-clock for the resilience stack.
+
+Every module in the retry/deadline/breaker stack (``runner.py``,
+``utils/failsafe.py``, ``utils/checkpoint.py``, ``utils/chaos.py``)
+measures elapsed time and sleeps through a :class:`Clock` object
+instead of calling ``time.sleep``/``time.monotonic`` directly — the
+sctlint rule SCT008 (and the shell guard in ``tools/run_checks.sh``)
+enforce that.  The single seam is what lets tier-1 tests drive
+deadline overruns, circuit-breaker cooldowns, wedged-step chaos and
+backoff schedules with ZERO real sleeps: hand every participant the
+same :class:`VirtualClock` and time moves only when someone sleeps or
+calls ``advance``.
+
+``time.time()`` stays legal everywhere — journal/sidecar timestamps
+are wall-clock *facts about when something happened*; only *schedules*
+(how long to wait, whether a budget is spent) must be injectable.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class Clock:
+    """The clock interface the resilience stack depends on:
+    ``monotonic()`` for elapsed-time arithmetic (never wall time — it
+    must survive NTP steps) and ``sleep(seconds)`` for waiting."""
+
+    def monotonic(self) -> float:
+        raise NotImplementedError
+
+    def sleep(self, seconds: float) -> None:
+        raise NotImplementedError
+
+
+class SystemClock(Clock):
+    """The real clock — the only sanctioned call sites of
+    ``time.monotonic``/``time.sleep`` in the resilience stack (SCT008
+    exempts this module)."""
+
+    def monotonic(self) -> float:
+        return time.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        time.sleep(max(0.0, seconds))
+
+
+class VirtualClock(Clock):
+    """Deterministic test clock: starts at ``start``, ``sleep``
+    advances virtual time instantly (and records the request in
+    ``.sleeps``), ``advance`` moves time without a sleeper.  Sharing
+    one instance between a ResilientRunner, its ChaosMonkey and its
+    CircuitBreaker is how a test wedges a step past its deadline or
+    expires a breaker cooldown without waiting a real second."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+        self.sleeps: list[float] = []
+
+    def monotonic(self) -> float:
+        return self._now
+
+    def sleep(self, seconds: float) -> None:
+        self.sleeps.append(float(seconds))
+        self._now += max(0.0, float(seconds))
+
+    def advance(self, seconds: float) -> None:
+        self._now += max(0.0, float(seconds))
+
+
+#: module-level default so every resilience module shares one instance
+SYSTEM_CLOCK = SystemClock()

@@ -201,3 +201,106 @@ def test_activate_unwinds_on_exception():
         with monkey.activate():
             raise RuntimeError("boom")
     assert not registry._CALL_WRAPPERS
+
+
+def test_wedge_advances_shared_clock_and_trips_deadline():
+    """wedge burns the SHARED virtual clock and rules the op overrun
+    via the cooperative deadline token — the in-process wedge the
+    per-step deadline layer bounds, with zero real sleeps."""
+    from sctools_tpu.utils.failsafe import (DeadlineToken,
+                                            StepDeadlineExceeded,
+                                            deadline_scope)
+    from sctools_tpu.utils.vclock import VirtualClock
+
+    clock = VirtualClock()
+    monkey = ChaosMonkey([Fault("normalize.log1p", "wedge", times=1)],
+                         clock=clock, wedge_s=100.0)
+    data = _data()
+    with monkey.activate():
+        tok = DeadlineToken(50.0, clock=clock)
+        with deadline_scope(tok):
+            with pytest.raises(StepDeadlineExceeded):
+                apply("normalize.log1p", data, backend="cpu")
+        assert clock.monotonic() == 100.0  # virtual time only
+        # fault exhausted: the next call runs clean
+        out = apply("normalize.log1p", data, backend="cpu")
+    assert out.X.shape == data.X.shape
+    assert monkey.injected[0]["mode"] == "wedge"
+
+
+def test_wedge_without_deadline_is_benign():
+    from sctools_tpu.utils.vclock import VirtualClock
+
+    clock = VirtualClock()
+    monkey = ChaosMonkey([Fault("normalize.log1p", "wedge", times=1)],
+                         clock=clock, wedge_s=100.0)
+    data = _data()
+    with monkey.activate():
+        out = apply("normalize.log1p", data, backend="cpu")
+    assert clock.monotonic() == 100.0
+    assert out.X.shape == data.X.shape  # no token -> op proceeds
+
+
+def test_wedge_without_shared_clock_never_really_sleeps():
+    """A spec-rebuilt monkey (e.g. inside an isolated child) has no
+    shared clock — wedge must warn and skip the burn, NOT sleep
+    wedge_s of real time."""
+    import time as _time
+
+    monkey = ChaosMonkey.from_spec(
+        ChaosMonkey([Fault("normalize.log1p", "wedge", times=1)],
+                    clock=None, wedge_s=3600.0).spec())
+    assert monkey.clock is None
+    data = _data()
+    t0 = _time.time()
+    with monkey.activate():
+        with pytest.warns(RuntimeWarning, match="no shared clock"):
+            out = apply("normalize.log1p", data, backend="cpu")
+    assert _time.time() - t0 < 30.0  # no hour-long real hang
+    assert out.X.shape == data.X.shape
+
+
+def test_corrupt_checkpoint_fires_only_on_checkpoint_channel(tmp_path):
+    """A corrupt_checkpoint fault must NEVER fire on the op call
+    itself; it fires through on_checkpoint and damages the file on
+    disk so only a digest verify can catch it."""
+    from sctools_tpu.utils.checkpoint import (save_celldata,
+                                              verify_checkpoint)
+
+    monkey = ChaosMonkey(
+        [Fault("normalize.log1p", "corrupt_checkpoint", times=1)])
+    data = _data()
+    with monkey.activate():
+        out = apply("normalize.log1p", data, backend="cpu")
+    assert monkey.injected == []  # op channel untouched
+    p = str(tmp_path / "ck.npz")
+    save_celldata(out, p)
+    assert verify_checkpoint(p)["ok"]
+    assert monkey.on_checkpoint("normalize.log1p", p)
+    assert not verify_checkpoint(p)["ok"]
+    assert monkey.injected[0]["mode"] == "corrupt_checkpoint"
+    # times=1 spent: the next save is left alone
+    save_celldata(out, p)
+    assert not monkey.on_checkpoint("normalize.log1p", p)
+    assert verify_checkpoint(p)["ok"]
+
+
+def test_corrupt_checkpoint_is_seed_deterministic(tmp_path):
+    from sctools_tpu.utils.checkpoint import save_celldata
+
+    out = apply("normalize.log1p", _data(), backend="cpu")
+    blobs = []
+    for run in ("a", "b"):
+        p = str(tmp_path / f"ck_{run}.npz")
+        save_celldata(out, p)
+        monkey = ChaosMonkey(
+            [Fault("normalize.log1p", "corrupt_checkpoint")], seed=4)
+        monkey.on_checkpoint("normalize.log1p", p)
+        blobs.append(open(p, "rb").read())
+    assert blobs[0] == blobs[1]  # same seed -> identical damage
+
+
+def test_spec_roundtrip_carries_wedge_s():
+    monkey = ChaosMonkey([Fault("x", "wedge")], wedge_s=42.0)
+    clone = ChaosMonkey.from_spec(monkey.spec())
+    assert clone.wedge_s == 42.0

@@ -7,7 +7,12 @@ import time
 
 import numpy as np
 
-from sctools_tpu.utils.failsafe import probe_device, run_isolated
+from sctools_tpu.utils.failsafe import (DETERMINISTIC, TRANSIENT,
+                                        DeterministicChildError,
+                                        TransientDeviceError,
+                                        classify_child_result,
+                                        classify_error, probe_device,
+                                        run_isolated)
 
 # module-level targets (run_isolated pickles them by reference)
 
@@ -22,6 +27,10 @@ def _crash_fn():
 
 def _hang_fn():
     time.sleep(3600)
+
+
+def _value_error_fn():
+    raise ValueError("deliberate bad shape in the child")
 
 
 def _numpy_fn(n):
@@ -59,3 +68,24 @@ def test_run_isolated_stall_killed():
     out = run_isolated(_hang_fn, timeout_s=120, stall_timeout_s=4)
     assert out["status"] == "stalled", out
     assert time.time() - t0 < 60
+
+
+def test_child_value_error_classified_deterministic_end_to_end():
+    """A real child raising ValueError: run_isolated reports crashed,
+    and classify_child_result reads the REAL stderr tail into a
+    fail-fast DeterministicChildError — the full satellite path, not
+    a synthetic dict."""
+    out = run_isolated(_value_error_fn, timeout_s=120,
+                       stall_timeout_s=60)
+    assert out["status"] == "crashed"
+    err = classify_child_result(out, "test.step")
+    assert isinstance(err, DeterministicChildError)
+    assert classify_error(err) == DETERMINISTIC
+    assert "ValueError" in str(err)
+
+
+def test_child_stall_classified_transient_end_to_end():
+    out = run_isolated(_hang_fn, timeout_s=120, stall_timeout_s=4)
+    err = classify_child_result(out, "test.step")
+    assert isinstance(err, TransientDeviceError)
+    assert classify_error(err) == TRANSIENT

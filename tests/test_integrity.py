@@ -1,0 +1,357 @@
+"""Run-integrity primitives: the injectable clock, cooperative
+deadline tokens, the circuit breaker's state machine, checksummed
+checkpoints (digest/schema/fingerprint verify + quarantine), the
+input-data digest mixed into step fingerprints, and the child-death
+taxonomy.  All pure CPU, zero real sleeps — every timed behaviour
+runs on a VirtualClock."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from sctools_tpu.data.synthetic import synthetic_counts
+from sctools_tpu.utils.checkpoint import (CheckpointCorruptError,
+                                          data_digest, load_celldata,
+                                          latest_step,
+                                          quarantine_checkpoint,
+                                          save_celldata,
+                                          step_fingerprint,
+                                          verify_checkpoint)
+from sctools_tpu.utils.failsafe import (DETERMINISTIC, TRANSIENT,
+                                        CircuitBreaker, DeadlineToken,
+                                        DeterministicChildError,
+                                        StepDeadlineExceeded,
+                                        TransientDeviceError,
+                                        check_deadline,
+                                        classify_child_result,
+                                        classify_error, current_deadline,
+                                        deadline_scope)
+from sctools_tpu.utils.vclock import SystemClock, VirtualClock
+
+
+def _data(n=60, g=30, seed=0):
+    return synthetic_counts(n, g, n_clusters=2, seed=seed)
+
+
+# ------------------------------------------------------------- vclock
+
+def test_virtual_clock_sleep_advances_and_records():
+    c = VirtualClock()
+    assert c.monotonic() == 0.0
+    c.sleep(2.5)
+    c.advance(1.5)
+    assert c.monotonic() == 4.0
+    assert c.sleeps == [2.5]  # advance() is not a sleep
+
+
+def test_system_clock_is_monotonic_and_nonnegative_sleep():
+    c = SystemClock()
+    a = c.monotonic()
+    c.sleep(-5.0)  # negative request must not raise (clamped to 0)
+    assert c.monotonic() >= a
+
+
+# ----------------------------------------------------------- deadline
+
+def test_deadline_token_expires_on_virtual_clock():
+    clock = VirtualClock()
+    tok = DeadlineToken(10.0, clock=clock, label="step 3 (hvg)")
+    assert not tok.expired() and tok.remaining() == 10.0
+    clock.advance(9.9)
+    tok.check()  # still inside budget
+    clock.advance(0.2)
+    assert tok.expired()
+    with pytest.raises(StepDeadlineExceeded, match="step 3"):
+        tok.check()
+
+
+def test_deadline_overrun_classifies_transient():
+    # the whole design hinges on this: an overrun is retried/degraded
+    # like a device error, never a deterministic failure
+    assert classify_error(StepDeadlineExceeded("x")) == TRANSIENT
+
+
+def test_deadline_scope_stacks_and_check_is_noop_outside():
+    check_deadline()  # no active scope: no-op
+    clock = VirtualClock()
+    outer = DeadlineToken(100.0, clock=clock)
+    inner = DeadlineToken(5.0, clock=clock)
+    with deadline_scope(outer):
+        assert current_deadline() is outer
+        with deadline_scope(inner):
+            assert current_deadline() is inner
+            clock.advance(6.0)
+            with pytest.raises(StepDeadlineExceeded):
+                check_deadline()
+        # inner popped even after its raise; outer still has budget
+        assert current_deadline() is outer
+        check_deadline()
+    assert current_deadline() is None
+
+
+# ------------------------------------------------------------ breaker
+
+def test_breaker_opens_after_threshold_in_window():
+    clock = VirtualClock()
+    br = CircuitBreaker(failure_threshold=3, window_s=60.0,
+                        cooldown_s=30.0, clock=clock)
+    assert br.state == CircuitBreaker.CLOSED and br.allow()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == CircuitBreaker.CLOSED  # 2 < 3
+    assert br.record_failure() == CircuitBreaker.OPEN
+    assert not br.allow()
+    assert br.opened_count == 1
+
+
+def test_breaker_window_slides_old_failures_out():
+    clock = VirtualClock()
+    br = CircuitBreaker(failure_threshold=3, window_s=60.0,
+                        clock=clock)
+    br.record_failure()
+    clock.advance(61.0)  # first failure ages out of the window
+    br.record_failure()
+    assert br.record_failure() == CircuitBreaker.CLOSED  # only 2 live
+    assert br.record_failure() == CircuitBreaker.OPEN
+
+
+def test_breaker_half_open_then_close_or_reopen():
+    clock = VirtualClock()
+    br = CircuitBreaker(failure_threshold=1, window_s=60.0,
+                        cooldown_s=30.0, clock=clock)
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    clock.advance(30.0)  # cooldown elapses -> half-open, probe allowed
+    assert br.state == CircuitBreaker.HALF_OPEN and br.allow()
+    # a failure while half-open re-opens for another cooldown
+    assert br.record_failure() == CircuitBreaker.OPEN
+    assert br.opened_count == 2
+    clock.advance(30.0)
+    assert br.state == CircuitBreaker.HALF_OPEN
+    # a success while half-open closes and clears the window
+    assert br.record_success() == CircuitBreaker.CLOSED
+    assert br.snapshot()["failures_in_window"] == 0
+
+
+def test_breaker_snapshot_is_journal_ready():
+    br = CircuitBreaker(failure_threshold=2, window_s=10.0,
+                        cooldown_s=5.0, clock=VirtualClock())
+    snap = br.snapshot()
+    assert snap == {"state": "closed", "failures_in_window": 0,
+                    "opened_count": 0, "failure_threshold": 2,
+                    "window_s": 10.0, "cooldown_s": 5.0}
+    json.dumps(snap)  # must serialise straight into the journal
+
+
+def test_breaker_rejects_zero_threshold():
+    with pytest.raises(ValueError, match="failure_threshold"):
+        CircuitBreaker(failure_threshold=0)
+
+
+# -------------------------------------------- checkpoint integrity
+
+def test_checkpoint_digest_roundtrip_verifies(tmp_path):
+    p = str(tmp_path / "ck.npz")
+    save_celldata(_data(), p, fingerprint="abc123")
+    chk = verify_checkpoint(p)
+    assert chk["ok"] and chk["reason"] is None
+    assert chk["schema"] == 1
+    assert chk["fingerprint"] == "abc123"
+    # fingerprint agreement is checked when the caller expects one
+    assert verify_checkpoint(p, expect_fingerprint="abc123")["ok"]
+    bad = verify_checkpoint(p, expect_fingerprint="zzz999")
+    assert not bad["ok"] and "fingerprint mismatch" in bad["reason"]
+
+
+def test_checkpoint_bitflip_fails_digest(tmp_path):
+    p = str(tmp_path / "ck.npz")
+    save_celldata(_data(), p)
+    blob = bytearray(open(p, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(p, "wb").write(bytes(blob))
+    chk = verify_checkpoint(p)
+    assert not chk["ok"]
+    assert "digest mismatch" in chk["reason"] or \
+        "unreadable" in chk["reason"]
+    with pytest.raises(CheckpointCorruptError):
+        load_celldata(p, verify=True)
+
+
+def test_checkpoint_not_an_npz_is_unreadable(tmp_path):
+    p = str(tmp_path / "ck.npz")
+    open(p, "wb").write(b"definitely not an npz")
+    chk = verify_checkpoint(p)
+    assert not chk["ok"] and "unreadable" in chk["reason"]
+
+
+def test_stripped_integrity_keys_rule_unreadable_not_raise(tmp_path):
+    # digest present but schema/fingerprint stripped: tampered, not
+    # legacy — both verify entry points must rule, never raise raw
+    p = str(tmp_path / "ck.npz")
+    save_celldata(_data(), p)
+    with np.load(p, allow_pickle=False) as z:
+        arrays = {k: z[k] for k in z.files
+                  if k != "_integrity/schema"}
+    np.savez(p, **arrays)
+    chk = verify_checkpoint(p)
+    assert not chk["ok"] and "integrity keys incomplete" in chk["reason"]
+    with pytest.raises(CheckpointCorruptError,
+                       match="integrity keys incomplete"):
+        load_celldata(p, verify=True)
+
+
+def test_legacy_checkpoint_without_digest_is_accepted(tmp_path):
+    # files written before the integrity layer carry no _integrity/*
+    # keys: unverifiable is NOT corrupt — they must still load
+    d = _data()
+    p = str(tmp_path / "legacy.npz")
+    save_celldata(d, p)
+    with np.load(p, allow_pickle=False) as z:
+        arrays = {k: z[k] for k in z.files
+                  if not k.startswith("_integrity/")}
+    np.savez(p, **arrays)
+    chk = verify_checkpoint(p)
+    assert chk["ok"] and chk["reason"] == "legacy"
+    back = load_celldata(p, verify=True)
+    assert back.X.shape == d.X.shape
+
+
+def test_quarantine_moves_never_deletes(tmp_path):
+    p = str(tmp_path / "ck.npz")
+    save_celldata(_data(), p)
+    blob = open(p, "rb").read()
+    dest = quarantine_checkpoint(p, "digest mismatch (test)")
+    assert not os.path.exists(p)
+    assert os.path.exists(dest)
+    assert os.path.basename(os.path.dirname(dest)) == "quarantine"
+    assert open(dest, "rb").read() == blob  # moved byte-identical
+    with open(dest + ".reason.json") as f:
+        rec = json.load(f)
+    assert rec["reason"] == "digest mismatch (test)"
+    # a second quarantine of the same basename must not clobber
+    save_celldata(_data(), p)
+    dest2 = quarantine_checkpoint(p, "again")
+    assert dest2 != dest and os.path.exists(dest2)
+
+
+def test_latest_step_verify_skips_corrupt_files(tmp_path):
+    from sctools_tpu.registry import Pipeline
+
+    pipe = Pipeline([("normalize.library_size", {"target_sum": 1e4}),
+                     ("normalize.log1p", {})])
+    steps = list(pipe.steps)
+    d = _data()
+    for i in range(2):
+        from sctools_tpu.utils.checkpoint import step_filename
+
+        save_celldata(d, str(tmp_path / step_filename(steps, i)))
+    newest = str(tmp_path / step_filename(steps, 1))
+    open(newest, "wb").write(b"garbage")
+    assert latest_step(str(tmp_path), steps) == 1  # existence only
+    assert latest_step(str(tmp_path), steps, verify=True) == 0
+
+
+# -------------------------------------------------- input digest
+
+def test_data_digest_tracks_content():
+    a, b = _data(seed=0), _data(seed=1)
+    da, db = data_digest(a), data_digest(b)
+    assert da and db and da != db
+    assert data_digest(_data(seed=0)) == da  # content-deterministic
+    # dense vs sparse of the same values differ by construction is
+    # fine; what matters is same-content stability and change detection
+    dense = a.with_X(np.asarray(a.X.todense()))
+    assert data_digest(dense) != da
+
+
+def test_data_digest_covers_annotations_not_just_x():
+    """Same counts, different obs labels must differ: transforms like
+    abundance.* consume annotations, so label-only changes must also
+    invalidate resume."""
+    a = _data(seed=0)
+    relabeled = a.replace(obs={**a.obs,
+                               "condition": np.array(["ko"] * a.X.shape[0])})
+    assert data_digest(relabeled) != data_digest(a)
+    relabeled2 = a.replace(obs={**a.obs,
+                                "condition": np.array(["ko"] * a.X.shape[0])})
+    assert data_digest(relabeled) == data_digest(relabeled2)
+
+
+def test_input_digest_changes_step_fingerprint():
+    from sctools_tpu.registry import Pipeline
+
+    steps = list(Pipeline([("normalize.log1p", {})]).steps)
+    base = step_fingerprint(steps, 0)
+    assert step_fingerprint(steps, 0, input_digest="aaa") != base
+    assert step_fingerprint(steps, 0, input_digest="aaa") == \
+        step_fingerprint(steps, 0, input_digest="aaa")
+    assert step_fingerprint(steps, 0, input_digest="bbb") != \
+        step_fingerprint(steps, 0, input_digest="aaa")
+
+
+# --------------------------------------------- child-death taxonomy
+
+def _res(status, tail="", rc=1):
+    return {"status": status, "rc": rc, "wall_s": 1.0,
+            "stderr_tail": tail}
+
+
+def test_child_timeout_and_stall_are_transient():
+    for status in ("timeout", "stalled"):
+        err = classify_child_result(_res(status), "pca.randomized")
+        assert isinstance(err, TransientDeviceError)
+        assert classify_error(err) == TRANSIENT
+
+
+def test_child_deterministic_traceback_fails_fast():
+    tail = ("Traceback (most recent call last):\n"
+            "  File \"x.py\", line 3, in f\n"
+            "ValueError: operands could not be broadcast together\n")
+    err = classify_child_result(_res("crashed", tail), "hvg.select")
+    assert isinstance(err, DeterministicChildError)
+    assert classify_error(err) == DETERMINISTIC
+    # ... even when the tail ALSO contains transient-looking noise
+    # (heartbeats): the exception TYPE beats the message scan
+    noisy = "[heartbeat] step running\n" + tail
+    err2 = classify_child_result(_res("crashed", noisy), "hvg.select")
+    assert classify_error(err2) == DETERMINISTIC
+
+
+def test_child_dotted_exception_name_is_recognised():
+    tail = "numpy.linalg.LinAlgError: SVD did not converge\n"
+    err = classify_child_result(_res("crashed", tail), "pca.exact")
+    # unknown name, no device signature -> deterministic (fail fast,
+    # same default as classify_error on a novel in-process error)
+    assert classify_error(err) == DETERMINISTIC
+
+
+def test_child_device_signature_retries():
+    tail = ("jaxlib.xla_extension.XlaRuntimeError: UNAVAILABLE: "
+            "socket closed\n")
+    err = classify_child_result(_res("crashed", tail), "pca.exact")
+    assert isinstance(err, TransientDeviceError)
+
+
+def test_child_transient_types_mirror_in_process_taxonomy():
+    # the same TimeoutError/ConnectionResetError that retries
+    # in-process (classify_error's _TRANSIENT_TYPES) must retry when
+    # it killed a child instead — even with no device marker in the
+    # message
+    for tail in ("TimeoutError: the read operation timed out\n",
+                 "ConnectionResetError: peer went away\n",
+                 "sctools_tpu.utils.failsafe.StepDeadlineExceeded: "
+                 "deadline: step 2 exceeded its 60s budget\n"):
+        err = classify_child_result(_res("crashed", tail), "x.y")
+        assert isinstance(err, TransientDeviceError), tail
+        assert classify_error(err) == TRANSIENT
+
+
+def test_child_tracebackless_death_is_transient():
+    # SIGKILL/preemption/_exit leave no Python traceback — that is a
+    # device-shaped death, not a program error
+    err = classify_child_result(
+        _res("crashed", "[chaos] killing process in 'x'\n", rc=9),
+        "normalize.log1p")
+    assert classify_error(err) == TRANSIENT

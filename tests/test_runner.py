@@ -19,8 +19,12 @@ from sctools_tpu.runner import (ResilientRunError, ResilientRunner,
                                 RetryPolicy)
 from sctools_tpu.utils.chaos import ChaosCrash, ChaosMonkey, Fault
 from sctools_tpu.utils.failsafe import (DETERMINISTIC, FATAL, TRANSIENT,
+                                        CircuitBreaker,
+                                        DeterministicChildError,
+                                        StepDeadlineExceeded,
                                         TransientDeviceError,
                                         classify_error)
+from sctools_tpu.utils.vclock import VirtualClock
 
 OK_PROBE = {"ok": True, "device_kind": "test", "wall_s": 0.0}
 DOWN_PROBE = {"ok": False, "reason": "test-ruled-down"}
@@ -410,6 +414,290 @@ def test_run_recipe_unknown_name():
         run_recipe("weinreb17", _data())
 
 
+# ----------------------------------------------------- step deadlines
+
+def test_step_deadline_wedge_retried_like_any_transient(tmp_path):
+    """A wedged step (chaos advances the shared virtual clock past the
+    budget) overruns its deadline, is journaled and classified
+    transient, and the retry completes — zero real sleeps."""
+    data, pipe = _data(), _pipe()
+    base = pipe.run(data, backend="cpu")
+    clock = VirtualClock()
+    monkey = ChaosMonkey([Fault("hvg.select", "wedge", times=1)],
+                         clock=clock, wedge_s=120.0)
+    sleeps = []
+    r = ResilientRunner(pipe, checkpoint_dir=str(tmp_path),
+                        chaos=monkey, clock=clock, sleep=sleeps.append,
+                        probe=lambda: dict(OK_PROBE),
+                        step_deadline_s=60.0)
+    out = r.run(data, backend="cpu")
+    hvg = next(s for s in r.report.steps if s.name == "hvg.select")
+    assert [a.status for a in hvg.attempts] == ["error", "ok"]
+    assert hvg.attempts[0].classified == TRANSIENT
+    assert "StepDeadlineExceeded" in hvg.attempts[0].error
+    assert sleeps and clock.monotonic() >= 120.0  # virtual time only
+    events = _journal(os.path.join(str(tmp_path), "journal.jsonl"))
+    dl = [e for e in events if e["event"] == "deadline"]
+    assert dl and dl[0]["name"] == "hvg.select" \
+        and dl[0]["budget_s"] == 60.0
+    np.testing.assert_allclose(_dense(base.X), _dense(out.X), atol=1e-6)
+
+
+def test_step_deadline_exhaustion_degrades_to_fallback():
+    """A step that wedges on EVERY accelerator attempt burns its
+    budget on deadline overruns, then degrades to the fallback like
+    any other transient failure."""
+    data, pipe = _data(), _pipe()
+    clock = VirtualClock()
+    monkey = ChaosMonkey(
+        [Fault("normalize.log1p", "wedge", times=-1, backend="tpu")],
+        clock=clock, wedge_s=999.0)
+    r = ResilientRunner(pipe, chaos=monkey, clock=clock,
+                        probe=lambda: dict(DOWN_PROBE),
+                        policy=RetryPolicy(max_attempts=2),
+                        breaker=CircuitBreaker(failure_threshold=99,
+                                               clock=clock),
+                        step_deadline_s=60.0, fallback_backend="cpu")
+    with pytest.warns(RuntimeWarning, match="DEGRADING"):
+        out = r.run(data, backend="tpu")
+    step = next(s for s in r.report.steps
+                if s.name == "normalize.log1p")
+    assert [a.backend for a in step.attempts] == ["tpu", "tpu", "cpu"]
+    assert all(a.classified == TRANSIENT
+               for a in step.attempts if a.status == "error")
+    assert r.report.degraded
+    assert out.X.shape[1] == 50
+
+
+def test_isolated_deadline_caps_child_watchdog(tmp_path):
+    """An isolated step inherits the REMAINING deadline budget as its
+    watchdog timeout (floored, never zero/negative)."""
+    data = _data(120, 60)
+    pipe = Pipeline([("qc.per_cell_metrics", {}),
+                     ("normalize.log1p", {})])
+    clock = VirtualClock()
+    seen = {}
+    import sctools_tpu.runner as runner_mod
+
+    real = runner_mod.run_isolated
+
+    def spy(fn, *a, **kw):
+        seen["timeout_s"] = kw.get("timeout_s")
+        return real(fn, *a, **kw)
+
+    r = _runner(pipe, checkpoint_dir=str(tmp_path),
+                isolate={"normalize.log1p"}, clock=clock,
+                step_deadline_s=45.0, isolate_timeout_s=600.0)
+    orig = runner_mod.run_isolated
+    runner_mod.run_isolated = spy
+    try:
+        r.run(data, backend="cpu")
+    finally:
+        runner_mod.run_isolated = orig
+    # deadline (45s) < isolate_timeout_s (600s): the tighter rules
+    assert seen["timeout_s"] == pytest.approx(45.0, abs=1.0)
+
+
+# ------------------------------------------------------ circuit breaker
+
+def test_breaker_open_short_circuits_retries_and_probe():
+    """K transient accelerator failures inside the window trip the
+    breaker; further accelerator attempts skip the remaining retries
+    AND the health probe, going straight to the degrade ruling."""
+    data, pipe = _data(), _pipe()
+    clock = VirtualClock()
+    monkey = ChaosMonkey(
+        [Fault("normalize.log1p", "unavailable", times=-1,
+               backend="tpu")])
+    probes = []
+
+    def probe():
+        probes.append(1)
+        return dict(OK_PROBE)
+
+    r = ResilientRunner(
+        pipe, chaos=monkey, clock=clock, probe=probe,
+        policy=RetryPolicy(max_attempts=5),  # budget NOT exhausted
+        breaker=CircuitBreaker(failure_threshold=2, window_s=300.0,
+                               cooldown_s=1e6, clock=clock),
+        fallback_backend="cpu")
+    with pytest.warns(RuntimeWarning, match="circuit breaker OPEN"):
+        out = r.run(data, backend="tpu")
+    step = next(s for s in r.report.steps
+                if s.name == "normalize.log1p")
+    # 2 tpu failures (not 5 — the breaker cut the retry storm), then cpu
+    assert [a.backend for a in step.attempts] == ["tpu", "tpu", "cpu"]
+    assert probes == []  # and NO probe storm either
+    assert r.report.degraded and r.report.breaker["state"] == "open"
+    assert out.X.shape[1] == 50
+
+
+def test_breaker_open_journaled_with_fallback_reason(tmp_path):
+    data, pipe = _data(), _pipe()
+    clock = VirtualClock()
+    monkey = ChaosMonkey(
+        [Fault("normalize.log1p", "unavailable", times=-1,
+               backend="tpu")])
+    r = ResilientRunner(
+        pipe, checkpoint_dir=str(tmp_path), chaos=monkey, clock=clock,
+        probe=lambda: dict(OK_PROBE),
+        breaker=CircuitBreaker(failure_threshold=2, cooldown_s=1e6,
+                               clock=clock),
+        fallback_backend="cpu")
+    with pytest.warns(RuntimeWarning, match="circuit breaker OPEN"):
+        r.run(data, backend="tpu")
+    events = _journal(os.path.join(str(tmp_path), "journal.jsonl"))
+    opens = [e for e in events if e["event"] == "breaker_open"]
+    assert opens and opens[0]["state"] == "open" \
+        and opens[0]["failure_threshold"] == 2
+    fb = [e for e in events if e["event"] == "fallback"]
+    assert fb and fb[0]["reason"] == "breaker_open"
+    done = [e for e in events if e["event"] == "run_completed"]
+    assert done and done[0]["breaker"]["state"] == "open"
+
+
+def test_breaker_half_open_probe_closes_and_undegrades():
+    """After the cooldown the breaker half-opens; ONE successful probe
+    closes it and the run returns to the accelerator — the full
+    open → half-open → closed cycle on a virtual clock."""
+    data, pipe = _data(), _pipe()
+    clock = VirtualClock()
+    monkey = ChaosMonkey([
+        # tpu-only outage on library_size trips the breaker...
+        Fault("normalize.library_size", "unavailable", times=-1,
+              backend="tpu"),
+        # ...and a hang on the (post-degrade, cpu) log1p advances the
+        # shared clock past the breaker cooldown
+        Fault("normalize.log1p", "hang", times=1),
+    ], clock=clock, hang_s=100.0)
+    probes = []
+
+    def probe():
+        probes.append(1)
+        return dict(OK_PROBE)
+
+    r = ResilientRunner(
+        pipe, chaos=monkey, clock=clock, probe=probe,
+        policy=RetryPolicy(max_attempts=4, jitter=0.0),
+        breaker=CircuitBreaker(failure_threshold=2, window_s=1000.0,
+                               cooldown_s=50.0, clock=clock),
+        fallback_backend="cpu")
+    with pytest.warns(RuntimeWarning, match="circuit breaker OPEN"):
+        out = r.run(data, backend="tpu")
+    by_name = {s.name: s for s in r.report.steps}
+    assert [a.backend for a in by_name["normalize.library_size"].attempts] \
+        == ["tpu", "tpu", "cpu"]
+    assert [a.backend for a in by_name["normalize.log1p"].attempts] \
+        == ["cpu"]
+    # cooldown elapsed during log1p's hang -> half-open -> probe ok ->
+    # breaker closed, run un-degraded, back on the accelerator
+    assert [a.backend for a in by_name["hvg.select"].attempts] == ["tpu"]
+    assert probes == [1]  # exactly one half-open probe
+    assert not r.report.degraded
+    assert r.report.breaker["state"] == "closed"
+    assert out.X.shape[1] == 50
+
+
+def test_breaker_half_open_failed_probe_reopens():
+    data, pipe = _data(), _pipe()
+    clock = VirtualClock()
+    monkey = ChaosMonkey([
+        Fault("normalize.library_size", "unavailable", times=-1,
+              backend="tpu"),
+        Fault("normalize.log1p", "hang", times=1),
+    ], clock=clock, hang_s=100.0)
+    probes = []
+
+    def probe():
+        probes.append(1)
+        return dict(DOWN_PROBE)
+
+    r = ResilientRunner(
+        pipe, chaos=monkey, clock=clock, probe=probe,
+        policy=RetryPolicy(max_attempts=4, jitter=0.0),
+        breaker=CircuitBreaker(failure_threshold=2, window_s=1000.0,
+                               cooldown_s=50.0, clock=clock),
+        fallback_backend="cpu")
+    with pytest.warns(RuntimeWarning, match="circuit breaker OPEN"):
+        r.run(data, backend="tpu")
+    by_name = {s.name: s for s in r.report.steps}
+    # the failed half-open probe re-opened the breaker: still degraded
+    assert [a.backend for a in by_name["hvg.select"].attempts] == ["cpu"]
+    assert r.report.degraded
+    assert r.report.breaker["state"] in ("open", "half_open")
+    assert r.report.breaker["opened_count"] == 2
+
+
+# ------------------------------------------- checkpoint quarantine
+
+def test_corrupt_checkpoint_quarantined_on_resume(tmp_path):
+    """chaos corrupt_checkpoint damages the final step's file ON DISK
+    after a good save; the next resume's digest verify catches it,
+    quarantines the file (never deletes), journals the reason, and
+    falls back to the previous intact checkpoint."""
+    data, pipe = _data(), _pipe()
+    base = pipe.run(data, backend="cpu")
+    ck = str(tmp_path)
+    monkey = ChaosMonkey(
+        [Fault("normalize.scale", "corrupt_checkpoint", times=1)])
+    r1 = _runner(pipe, checkpoint_dir=ck, chaos=monkey)
+    r1.run(data, backend="cpu")
+    assert r1.report.status == "completed"  # the WRITING run is fine
+    assert any(f["mode"] == "corrupt_checkpoint"
+               for f in monkey.injected)
+
+    r2 = _runner(pipe, checkpoint_dir=ck)
+    with pytest.warns(RuntimeWarning, match="QUARANTINED"):
+        out = r2.run(data, backend="cpu", resume=True)
+    n = len(r2.report.steps)
+    assert r2.report.resumed_from == n - 2
+    assert r2.report.steps[-1].status == "completed"  # re-ran
+    np.testing.assert_allclose(_dense(base.X), _dense(out.X), atol=1e-6)
+    qdir = tmp_path / "quarantine"
+    qfiles = sorted(os.listdir(qdir))
+    assert len([f for f in qfiles if f.endswith(".npz")]) == 1
+    assert any(f.endswith(".reason.json") for f in qfiles)
+    events = _journal(os.path.join(ck, "journal.jsonl"))
+    quar = [e for e in events if e["event"] == "quarantine"]
+    assert quar and quar[0]["step"] == n - 1
+    assert "digest mismatch" in quar[0]["reason"] \
+        or "unreadable" in quar[0]["reason"]
+    # quarantine precedes the resume record, in the same journal
+    names = [e["event"] for e in events]
+    assert names.index("quarantine") < names.index("resume")
+
+
+def test_resume_with_different_data_recomputes(tmp_path):
+    """The PR-1 latent bug: resume=True with DIFFERENT data and the
+    same checkpoint_dir silently returned the previous run's result.
+    The input-content digest in the fingerprint makes the stale
+    checkpoints unmatchable."""
+    a = _data()
+    b = synthetic_counts(300, 120, n_clusters=3, seed=7)
+    pipe = _pipe()
+    r1 = _runner(pipe, checkpoint_dir=str(tmp_path))
+    out_a = r1.run(a, backend="cpu")
+    r2 = _runner(pipe, checkpoint_dir=str(tmp_path))
+    out_b = r2.run(b, backend="cpu", resume=True)
+    assert r2.report.resumed_from is None  # nothing matched: recompute
+    base_b = pipe.run(b, backend="cpu")
+    np.testing.assert_allclose(_dense(base_b.X), _dense(out_b.X),
+                               atol=1e-6)
+    events = _journal(os.path.join(str(tmp_path), "journal.jsonl"))
+    starts = [e for e in events if e["event"] == "run_start"]
+    assert starts[0]["input_digest"] != starts[1]["input_digest"]
+    # same data still resumes (and journals that the passed argument
+    # is superseded by the checkpoint)
+    r3 = _runner(pipe, checkpoint_dir=str(tmp_path))
+    out_b2 = r3.run(b, backend="cpu", resume=True)
+    assert r3.report.resumed_from == len(r3.report.steps) - 1
+    np.testing.assert_allclose(_dense(out_b.X), _dense(out_b2.X),
+                               atol=1e-6)
+    events = _journal(os.path.join(str(tmp_path), "journal.jsonl"))
+    res = [e for e in events if e["event"] == "resume"]
+    assert res and "supersedes" in res[-1]["note"]
+
+
 # ---------------------------------------------------------- containment
 
 def test_isolated_step_contains_real_process_death(tmp_path):
@@ -436,3 +724,106 @@ def test_isolated_step_contains_real_process_death(tmp_path):
     assert [a.status for a in step.attempts] == ["error", "ok"]
     assert step.attempts[0].classified == TRANSIENT
     np.testing.assert_allclose(_dense(base.X), _dense(out.X), atol=1e-6)
+
+
+def test_isolated_deterministic_child_error_fails_fast(tmp_path):
+    """The ROADMAP open item: a deterministic error inside an isolated
+    child (here a TypeError from a bogus parameter) must FAIL FAST —
+    classified from the stderr tail, one attempt, no retry burn, no
+    probe, no degrade-to-cpu of a healthy device."""
+    data = _data(150, 80)
+    pipe = Pipeline([
+        ("qc.per_cell_metrics", {}),
+        ("normalize.log1p", {"bogus_param": 1}),
+    ])
+    probes = []
+
+    def probe():
+        probes.append(1)
+        return dict(OK_PROBE)
+
+    r = _runner(pipe, checkpoint_dir=str(tmp_path), probe=probe,
+                isolate={"normalize.log1p"},
+                isolate_timeout_s=240.0, isolate_stall_s=120.0)
+    with pytest.raises(DeterministicChildError, match="TypeError"):
+        r.run(data, backend="cpu")
+    step = r.report.steps[1]
+    assert step.isolated
+    assert len(step.attempts) == 1  # NO retry on a deterministic raise
+    assert step.attempts[0].classified == DETERMINISTIC
+    assert step.status == "failed"
+    assert probes == []  # and no probe storm
+    assert not r.report.degraded
+
+
+# ----------------------------------------- acceptance e2e (ISSUE 3)
+
+def test_run_integrity_acceptance_wedge_breaker_corrupt_resume(tmp_path):
+    """The ISSUE-3 acceptance scenario, all on a virtual clock with
+    zero real sleeps: one step WEDGES past its per-step deadline
+    (retried), repeated accelerator failures trip the circuit BREAKER
+    open (short-circuit degrade, no probe), the latest checkpoint is
+    CORRUPTED on disk, and a fresh resume still completes end-to-end —
+    with the journal recording deadline → breaker-open → quarantine →
+    resume, in order."""
+    data, pipe = _data(), _pipe()
+    ck = str(tmp_path)
+    clock = VirtualClock()
+    monkey = ChaosMonkey([
+        Fault("qc.per_cell_metrics", "wedge", times=1),
+        Fault("normalize.library_size", "unavailable", times=-1,
+              backend="tpu"),
+        Fault("normalize.scale", "corrupt_checkpoint", times=1),
+    ], clock=clock, wedge_s=120.0)
+    probes = []
+
+    def probe():
+        probes.append(1)
+        return dict(OK_PROBE)
+
+    r1 = ResilientRunner(
+        pipe, checkpoint_dir=ck, chaos=monkey, clock=clock,
+        probe=probe, step_deadline_s=60.0,
+        policy=RetryPolicy(max_attempts=4, jitter=0.0),
+        breaker=CircuitBreaker(failure_threshold=2, window_s=300.0,
+                               cooldown_s=1e6, clock=clock),
+        fallback_backend="cpu")
+    with pytest.warns(RuntimeWarning, match="circuit breaker OPEN"):
+        out1 = r1.run(data, backend="tpu")
+    assert r1.report.status == "completed"
+    assert r1.report.degraded  # breaker-driven, cooldown never elapsed
+    assert probes == []        # straight to the ruling, no probe storm
+    assert {f["mode"] for f in monkey.injected} == \
+        {"wedge", "unavailable", "corrupt_checkpoint"}
+
+    # a NEW runner (fresh process after a crash) resumes: the corrupt
+    # final checkpoint is quarantined, the intact previous one is used
+    probes2 = []
+
+    def probe2():
+        probes2.append(1)
+        return dict(OK_PROBE)
+
+    r2 = ResilientRunner(pipe, checkpoint_dir=ck, probe=probe2,
+                         clock=VirtualClock())
+    with pytest.warns(RuntimeWarning, match="QUARANTINED"):
+        out2 = r2.run(data, backend="tpu", resume=True)
+    assert r2.report.status == "completed"
+    n = len(r2.report.steps)
+    assert r2.report.resumed_from == n - 2
+    assert probes2 == []
+    assert out2.X.shape[1] == 50
+    assert not np.isnan(np.asarray(_dense(out2.X))).any()
+    assert os.path.isdir(os.path.join(ck, "quarantine"))
+
+    events = _journal(os.path.join(ck, "journal.jsonl"))
+    names = [e["event"] for e in events]
+    # the acceptance ordering contract
+    assert names.index("deadline") < names.index("breaker_open") \
+        < names.index("quarantine") < names.index("resume")
+    # and the journal ties each ruling to its step
+    dl = next(e for e in events if e["event"] == "deadline")
+    assert dl["name"] == "qc.per_cell_metrics"
+    fb = next(e for e in events if e["event"] == "fallback")
+    assert fb["reason"] == "breaker_open"
+    assert names[-1] == "run_completed"

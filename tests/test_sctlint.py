@@ -259,6 +259,95 @@ def test_sct005_scoped_to_resilience_modules(tmp_path):
     assert rule_ids(r) == []
 
 
+def test_sct005_covers_vclock(tmp_path):
+    r = lint_src(tmp_path, """
+        def now():
+            try:
+                return read_clock()
+            except Exception:
+                return 0.0
+        """, only=["SCT005"], name="vclock.py", prelude=False)
+    assert rule_ids(r) == ["SCT005"]
+
+
+# ---------------------------------------------------------------------------
+# SCT008 — bare wall-clock in resilience modules
+# ---------------------------------------------------------------------------
+
+def test_sct008_flags_bare_sleep_and_monotonic(tmp_path):
+    r = lint_src(tmp_path, """
+        import time
+
+        def backoff(d):
+            t0 = time.monotonic()
+            time.sleep(d)
+            return time.monotonic() - t0
+        """, only=["SCT008"], name="runner.py", prelude=False)
+    assert rule_ids(r) == ["SCT008", "SCT008", "SCT008"]
+    assert "injectable clock" in r.violations[0].message
+
+
+def test_sct008_flags_reference_smuggled_as_default(tmp_path):
+    # `sleep=time.sleep` as a default argument is not a Call but still
+    # hard-wires the real clock
+    r = lint_src(tmp_path, """
+        import time
+
+        def __init__(self, sleep=time.sleep):
+            self.sleep = sleep
+        """, only=["SCT008"], name="chaos.py", prelude=False)
+    assert rule_ids(r) == ["SCT008"]
+
+
+def test_sct008_flags_from_import_alias(tmp_path):
+    r = lint_src(tmp_path, """
+        from time import sleep
+
+        def backoff(d):
+            sleep(d)
+        """, only=["SCT008"], name="failsafe.py", prelude=False)
+    assert rule_ids(r) == ["SCT008"]
+
+
+def test_sct008_allows_time_time_and_injected_clocks(tmp_path):
+    # journal timestamps are wall-clock FACTS, not schedules; and a
+    # clock object's own .sleep/.monotonic are exactly the seam
+    r = lint_src(tmp_path, """
+        import time
+
+        def journal(clock):
+            ts = time.time()
+            clock.sleep(1.0)
+            return ts, clock.monotonic()
+        """, only=["SCT008"], name="checkpoint.py", prelude=False)
+    assert rule_ids(r) == []
+
+
+def test_sct008_exempts_vclock_and_other_modules(tmp_path):
+    src = """
+        import time
+
+        def sleep(d):
+            time.sleep(d)
+        """
+    # vclock.py IS the sanctioned home of the real calls
+    assert rule_ids(lint_src(tmp_path, src, only=["SCT008"],
+                             name="vclock.py", prelude=False)) == []
+    # non-resilience modules are out of scope
+    assert rule_ids(lint_src(tmp_path, src, only=["SCT008"],
+                             name="misc_module.py", prelude=False)) == []
+
+
+def test_sct008_suppressible_per_line(tmp_path):
+    r = lint_src(tmp_path, """
+        import time
+
+        def backoff(d):
+            time.sleep(d)  # sctlint: disable=SCT008
+        """, only=["SCT008"], name="runner.py", prelude=False)
+    assert rule_ids(r) == []
+
+
 # ---------------------------------------------------------------------------
 # SCT006 — registry conventions
 # ---------------------------------------------------------------------------

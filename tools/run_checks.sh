@@ -9,13 +9,18 @@
 #
 # Stages:
 #   1. sctlint        python -m tools.sctlint sctools_tpu
-#                     (AST rules SCT001-SCT006 + parity SCT000 +
-#                      repo-hygiene SCT007; suppressions + baseline
-#                      honoured, stale baseline entries fail)
+#                     (AST rules SCT001-SCT006 + SCT008 bare-clock +
+#                      parity SCT000 + repo-hygiene SCT007;
+#                      suppressions + baseline honoured, stale
+#                      baseline entries fail)
 #   2. tracked-bytecode guard (belt-and-braces duplicate of SCT007,
 #                     kept shell-side so the gate still catches it if
 #                     sctlint itself is broken)
-#   3. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
+#   3. bare-clock guard (belt-and-braces duplicate of SCT008: the
+#                     resilience stack must schedule through the
+#                     injectable clock, utils/vclock.py, so deadline/
+#                     breaker/backoff tests never really sleep)
+#   4. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
 
 set -u -o pipefail
 
@@ -28,7 +33,7 @@ FAST=0
 fail=0
 stage() { printf '\n== %s ==\n' "$1"; }
 
-stage "sctlint (static analysis, rules SCT000-SCT007)"
+stage "sctlint (static analysis, rules SCT000-SCT008)"
 if ! JAX_PLATFORMS=cpu python -m tools.sctlint sctools_tpu; then
     fail=1
 fi
@@ -41,6 +46,22 @@ if [ -n "$tracked" ]; then
     fail=1
 else
     echo "OK: no __pycache__/*.pyc tracked"
+fi
+
+stage "bare-clock guard (resilience modules use the injectable clock)"
+bare=$(grep -nE '\btime\.(sleep|monotonic)\b' \
+        sctools_tpu/runner.py \
+        sctools_tpu/utils/failsafe.py \
+        sctools_tpu/utils/checkpoint.py \
+        sctools_tpu/utils/chaos.py 2>/dev/null \
+        | grep -v 'sctlint: disable=SCT008' || true)
+if [ -n "$bare" ]; then
+    echo "bare time.sleep/time.monotonic in resilience modules" \
+         "(schedule through sctools_tpu/utils/vclock.py):"
+    echo "$bare"
+    fail=1
+else
+    echo "OK: deadlines/backoff/cooldowns go through the injectable clock"
 fi
 
 if [ "$FAST" = "1" ]; then

@@ -1,0 +1,49 @@
+"""SCT008 — bare wall-clock scheduling in the resilience stack.
+
+Deadline overruns, breaker cooldowns, backoff schedules and chaos
+wedges are tier-1 tested with ZERO real sleeps; that only holds if
+every resilience module schedules time through the injectable clock
+(``sctools_tpu/utils/vclock.py``) instead of ``time.sleep`` /
+``time.monotonic``.  ``time.time()`` stays legal everywhere — journal
+and sidecar timestamps are wall-clock *facts*, not *schedules*.
+``vclock.py`` itself is exempt: its ``SystemClock`` is the one
+sanctioned home of the real calls.  ``tools/run_checks.sh`` carries a
+shell-side duplicate of this guard (belt and braces, like SCT007's
+bytecode check).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import FileContext, rule
+from ..jaxutil import dotted, module_info
+
+# resilience modules whose scheduling must be injectable (matched on
+# the repo-relative path tail, like SCT005); vclock.py is deliberately
+# absent — it IS the injection seam
+_PATH_RE = re.compile(r"(^|/)(runner|failsafe|checkpoint|chaos)\.py$")
+
+_BANNED = {"time.sleep", "time.monotonic"}
+
+
+@rule("SCT008", "bare-clock",
+      "bare time.sleep/time.monotonic in a resilience module — "
+      "schedule through the injectable clock (utils/vclock.py)")
+def check_bare_clock(ctx: FileContext):
+    if not _PATH_RE.search(ctx.path):
+        return
+    aliases = module_info(ctx).aliases
+    for node in ast.walk(ctx.tree):
+        # calls AND bare references (`sleep=time.sleep` as a default
+        # argument smuggles the real clock in without a Call node)
+        if isinstance(node, (ast.Attribute, ast.Name)):
+            name = dotted(node, aliases)
+            if name in _BANNED:
+                yield ctx.violation(
+                    "SCT008", node,
+                    f"bare {name} in a resilience module — deadlines/"
+                    "backoff/cooldowns must go through the injectable "
+                    "clock (sctools_tpu.utils.vclock.Clock) so tier-1 "
+                    "tests never really sleep")

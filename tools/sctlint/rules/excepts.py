@@ -17,9 +17,11 @@ from ..core import FileContext, rule
 from ..jaxutil import dotted, module_info
 
 # resilience-path modules (matched on the repo-relative path tail so
-# synthetic test files named e.g. runner.py exercise the rule too)
+# synthetic test files named e.g. runner.py exercise the rule too);
+# vclock carries the breaker/deadline stack's injectable clock
 _PATH_RE = re.compile(
-    r"(^|/)(runner|failsafe|checkpoint|chaos|trace|determinism|sync)\.py$")
+    r"(^|/)(runner|failsafe|checkpoint|chaos|trace|determinism|sync"
+    r"|vclock)\.py$")
 
 _BROAD = {"Exception", "BaseException"}
 
