@@ -150,12 +150,33 @@ def _hard_sync(*xs):
 _WRITE_STAGE_FILE = True  # standalone --phase debug runs switch it off
 
 
+def _metrics_glimpse():
+    """Counter snapshot from the process-wide telemetry registry, IF
+    the library's telemetry module is already loaded.  Never imports
+    it: stage() runs before jax acquisition too, and importing the
+    package at that point could wedge exactly the way acquire_jax
+    exists to contain (plugin registration hangs, r1-r5)."""
+    mod = sys.modules.get("sctools_tpu.utils.telemetry")
+    if mod is None:
+        return None
+    try:
+        return mod.default_registry().snapshot_compact() or None
+    except Exception:  # a stage line must never die on telemetry
+        return None
+
+
 def stage(name: str, **fields):
     """Emit one flushed JSON stage line to stderr; append it to
     bench_stages.jsonl only for real runs (the orchestrator and its
     children) — ad-hoc ``--phase`` debug invocations must not inject
-    orphan records into the journal's start..done framing."""
+    orphan records into the journal's start..done framing.  Stage
+    lines carry the telemetry counter snapshot when one exists, so a
+    post-mortem can diff retries/degrades/op-calls BETWEEN stages of
+    a run that died before writing metrics.json."""
     rec = {"stage": name, "t": round(time.time() - T_START, 1), **fields}
+    glimpse = _metrics_glimpse()
+    if glimpse:
+        rec["metrics"] = glimpse
     line = json.dumps(rec, default=float)
     print(line, file=sys.stderr, flush=True)
     if _WRITE_STAGE_FILE:

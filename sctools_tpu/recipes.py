@@ -73,8 +73,17 @@ def run_recipe(name: str, data: CellData, *, backend: str | None = None,
     same ``checkpoint_dir`` recomputes instead of silently returning
     the previous run's result.  ``runner_kw`` forwards to the runner
     constructor (``policy=``, ``isolate=``, ``preflight=``,
-    ``breaker=`` …); ``recipe_kw`` to the recipe factory
-    (``n_top_genes=`` …).
+    ``breaker=``, ``metrics=`` …); ``recipe_kw`` to the recipe
+    factory (``n_top_genes=`` …).
+
+    Observability rides along for free: every step is traced and
+    auto-instrumented (per-op call/duration metrics, labelled
+    cpu/tpu/degraded), every retry/degrade/breaker/quarantine ruling
+    is journaled AND counted, and with ``checkpoint_dir=`` the run
+    leaves ``journal.jsonl`` + ``metrics.json`` + a
+    Perfetto-loadable ``trace.json`` behind —
+    ``python -m tools.sctreport <checkpoint_dir>`` merges them into
+    one run report (docs/GUIDE.md "Reading a run report").
 
     >>> out = run_recipe("seurat", data, backend="tpu",
     ...                  checkpoint_dir="ck/", step_deadline_s=900,

@@ -38,11 +38,17 @@ DEFAULT_BACKEND = "tpu"
 # A wrapper is ``wrapper(name, backend, fn) -> fn`` applied around every
 # transform invocation (``apply()``, ``Transform.__call__``, and
 # therefore every ``Pipeline``/recipe step) while it is installed.
-# This is the interception point the chaos fault-injection harness
-# (utils/chaos.py) and any instrumentation hook use: installation is
-# dynamic, so already-constructed Transforms/Pipelines are covered —
-# the wrap happens at call time, not at bind time.  Wrappers stack;
-# the most recently pushed runs outermost.
+# This is the ONE interception point every cross-cutting layer shares:
+# the chaos fault-injection harness (utils/chaos.py), the runner's
+# cooperative deadline check (runner._deadline_wrap), and the
+# telemetry auto-instrumentor (utils/telemetry.py CallInstrumentor —
+# per-op call/error/duration metrics).  Installation is dynamic, so
+# already-constructed Transforms/Pipelines are covered — the wrap
+# happens at call time, not at bind time.  Wrappers stack; the most
+# recently pushed runs outermost (the runner pushes chaos, then the
+# deadline check, then telemetry, so an op's recorded duration
+# includes an injected wedge and its deadline raise counts as that
+# op's error).
 # ---------------------------------------------------------------------------
 
 _CALL_WRAPPERS: list[Callable[[str, str, Callable], Callable]] = []

@@ -59,6 +59,18 @@ into one execution layer:
   resume, completion) with the classified error, backend, wall time
   and the ``trace.span`` id it links to; the in-memory
   :class:`RunReport` mirrors it.
+* **Telemetry** — every recovery ruling also increments a metric in
+  the (injectable) ``utils/telemetry.py`` registry — retries,
+  degrades, breaker transitions, quarantines, deadline overruns,
+  checkpoint bytes — and every transform call is auto-instrumented
+  (per-op call count + duration, labelled cpu/tpu/degraded) through
+  the registry call-wrapper hook.  At run end the runner writes
+  ``metrics.json`` and a Perfetto-loadable ``trace.json`` next to
+  the journal; ``python -m tools.sctreport <checkpoint_dir>`` merges
+  the three into one run report.  Isolated steps hand their span
+  TREE back through the handoff file and it is grafted under the
+  parent's step span (their in-child op metrics are not merged — the
+  parent's attempt record and spans carry the containment story).
 
 All time sources are injectable (``sleep=``, ``probe=``, ``clock=`` —
 see ``utils/vclock.py``), so recovery behaviour — backoff schedules,
@@ -84,7 +96,7 @@ import warnings
 
 from . import registry as _registry
 from .registry import Pipeline, Transform
-from .utils import trace
+from .utils import telemetry, trace
 from .utils.checkpoint import (CheckpointCorruptError, data_digest,
                                load_celldata, quarantine_checkpoint,
                                save_celldata, step_filename,
@@ -187,26 +199,36 @@ class ResilientRunError(RuntimeError):
 
 
 def _exec_step(in_path: str, name: str, backend: str, params: dict,
-               out_path: str, chaos_spec: dict | None = None) -> bool:
+               out_path: str, chaos_spec: dict | None = None) -> dict:
     """Containment target for ``failsafe.run_isolated``: load → apply
     one transform → save.  Module-level because the payload pickles it
     by reference; data crosses the process boundary as checkpoint
     files, not pickles.  A forwarded chaos spec re-arms fault
     injection inside the child (how tier-1 exercises the kill/wedge
-    containment paths for real)."""
-    data = load_celldata(in_path)
-    t = Transform(name, backend=backend, **params)
-    if chaos_spec is not None:
-        from .utils.chaos import ChaosMonkey
+    containment paths for real).
 
-        with ChaosMonkey.from_spec(chaos_spec).activate():
-            out = t(data)
-    else:
-        out = t(data)
-    # digest=False: a same-process transfer file, never resumed from —
-    # hashing multi-GB payloads twice per attempt buys nothing here
-    save_celldata(out, out_path, digest=False)
-    return True
+    Returns the child's SPAN TREE (``trace.serialize_spans``) so the
+    parent can graft it under its step span — without this handoff,
+    isolated steps simply vanish from the run's trace."""
+    trace.reset()  # a fresh child, but cheap insurance on reuse
+    with trace.span(f"isolated:{name}", meta={"backend": backend}):
+        with trace.span("load"):
+            data = load_celldata(in_path)
+        t = Transform(name, backend=backend, **params)
+        with trace.span(name):
+            if chaos_spec is not None:
+                from .utils.chaos import ChaosMonkey
+
+                with ChaosMonkey.from_spec(chaos_spec).activate():
+                    out = t(data)
+            else:
+                out = t(data)
+        # digest=False: a same-process transfer file, never resumed
+        # from — hashing multi-GB payloads twice per attempt buys
+        # nothing here
+        with trace.span("save"):
+            save_celldata(out, out_path, digest=False)
+    return {"ok": True, "spans": trace.serialize_spans()}
 
 
 def _deadline_wrap(name, backend, fn):
@@ -299,6 +321,15 @@ class ResilientRunner:
     sleep : callable
         Backoff sleeper (default ``clock.sleep``); tests inject a
         fake.
+    metrics : telemetry.MetricsRegistry | None
+        Where recovery counters (retries, degrades, breaker
+        transitions, quarantines, checkpoint bytes, …) and the
+        auto-instrumented per-op call metrics are recorded; defaults
+        to the process-wide ``telemetry.default_registry()``.  With
+        ``checkpoint_dir=`` the snapshot is written to
+        ``metrics.json`` (and the run's spans to ``trace.json``) at
+        run end — the inputs ``tools/sctreport.py`` merges with the
+        journal.
     """
 
     def __init__(self, pipeline: Pipeline, *,
@@ -313,7 +344,7 @@ class ResilientRunner:
                  validate=None, chaos=None,
                  step_deadline_s: float | None = None,
                  breaker: CircuitBreaker | None = None,
-                 clock=None, sleep=None):
+                 clock=None, sleep=None, metrics=None):
         self.pipeline = pipeline
         self.checkpoint_dir = checkpoint_dir
         if checkpoint_dir:
@@ -336,10 +367,17 @@ class ResilientRunner:
         self.breaker = breaker if breaker is not None else \
             CircuitBreaker(clock=self.clock)
         self.sleep = sleep if sleep is not None else self.clock.sleep
+        self.metrics = metrics if metrics is not None \
+            else telemetry.default_registry()
+        # one instrumentor per runner: its backend_override scopes a
+        # degrade ruling's "degraded" label to THIS run, even when the
+        # metrics registry is the process-shared default
+        self._inst = telemetry.CallInstrumentor(self.metrics)
         self.journal = _Journal(journal_path)
         self.report = RunReport(journal_path=journal_path)
         self._input_digest: str | None = None
         self._breaker_degraded = False
+        self._spans: list = []  # this run's attempt spans, for export
 
     # ------------------------------------------------------------------
     def run(self, data, backend: str | None = None, resume: bool = True):
@@ -349,6 +387,8 @@ class ResilientRunner:
         rng = random.Random(self.policy.seed)
         dig = self._input_digest = data_digest(data)
         self._breaker_degraded = False
+        self._spans = []
+        self._inst.backend_override = None
         report = self.report = RunReport(
             status="pending", backend=backend,
             journal_path=self.journal.path, input_digest=dig,
@@ -420,17 +460,35 @@ class ResilientRunner:
                     input_digest=dig,
                     note="checkpoint supersedes the passed data "
                          "argument")
+                self.metrics.counter("runner.resumes").inc()
                 break
 
         chaos_ctx = (self.chaos.activate() if self.chaos is not None
                      else contextlib.nullcontext())
-        # the deadline wrapper is pushed INSIDE the chaos activation so
-        # it runs outermost — a chaos wedge that burns the clock is
-        # caught by the token check on the way out of the op
-        with chaos_ctx, _registry.call_wrapper(_deadline_wrap):
-            for i in range(start, len(steps)):
-                data, degraded = self._run_step(
-                    steps, i, data, backend, degraded, rng)
+        # wrapper order (innermost → outermost): chaos, then the
+        # deadline check (a chaos wedge that burns the clock is caught
+        # by the token check on the way out of the op), then telemetry
+        # outermost — so an op's recorded duration includes the wedge
+        # and its raise is counted as that op's error
+        try:
+            with chaos_ctx, _registry.call_wrapper(_deadline_wrap), \
+                    _registry.call_wrapper(self._inst.wrap):
+                for i in range(start, len(steps)):
+                    data, degraded = self._run_step(
+                        steps, i, data, backend, degraded, rng)
+        except BaseException:
+            # a FAILED run still gets metrics.json/trace.json — the
+            # post-mortem needs them most — but WITHOUT journal
+            # records: run_failed has already been written and must
+            # stay the file's final line (the journal's last line is
+            # the run verdict, for every outcome).  An ABORTED run
+            # (fatal, process-death class) gets neither: real death
+            # writes nothing either.
+            if self.report.status == "failed":
+                self._write_run_artifacts(journal_events=False)
+            raise
+        finally:
+            self._inst.backend_override = None
 
         if start == len(steps) and steps:
             # fully-resumed: no step ran to re-place the loaded data —
@@ -458,9 +516,49 @@ class ResilientRunner:
         report.breaker = self.breaker.snapshot()
         if degraded:
             report.backend = self.fallback_backend
+        # artifacts BEFORE the run_completed record: the journal's
+        # final line stays the run verdict (tests and tail -1 rely on
+        # it), and the snapshot already holds every counter
+        self._write_run_artifacts()
         self.journal.write("run_completed", degraded=degraded,
                            breaker=report.breaker)
         return data
+
+    def _write_run_artifacts(self, journal_events: bool = True) -> None:
+        """End-of-run telemetry: the metrics snapshot as
+        ``metrics.json`` and this run's span trees as a
+        Perfetto-loadable ``trace.json``, both next to the journal —
+        the three files ``tools/sctreport.py`` merges.  Best effort:
+        a full disk must not turn a completed run into a failure.
+        ``journal_events=False`` (the failed-run path) writes the
+        files but no journal records, so the terminal verdict stays
+        the journal's final line."""
+        if not self.checkpoint_dir:
+            return
+        mpath = os.path.join(self.checkpoint_dir, "metrics.json")
+        try:
+            self.metrics.write(mpath)
+            if journal_events:
+                self.journal.write("metrics_written", path=mpath)
+        except OSError as e:
+            warnings.warn(
+                f"ResilientRunner: could not write {mpath} "
+                f"({type(e).__name__}: {e})", RuntimeWarning,
+                stacklevel=3)
+        tpath = os.path.join(self.checkpoint_dir, "trace.json")
+        try:
+            # append: a crash → resume sequence shares the journal
+            # file, so it must share the trace too — the old spans'
+            # ids keep resolving
+            trace.export_trace(tpath, self._spans, append=True)
+            if journal_events:
+                self.journal.write("trace_exported", path=tpath,
+                                   n_spans=len(self._spans))
+        except OSError as e:
+            warnings.warn(
+                f"ResilientRunner: could not write {tpath} "
+                f"({type(e).__name__}: {e})", RuntimeWarning,
+                stacklevel=3)
 
     # ------------------------------------------------------------------
     def _target_backend(self, t: Transform, backend: str | None,
@@ -486,6 +584,7 @@ class ResilientRunner:
             RuntimeWarning, stacklevel=3)
         self.journal.write("quarantine", step=i, reason=reason,
                            path=qpath)
+        self.metrics.counter("runner.quarantines").inc()
 
     def _rule_unhealthy(self, where: str) -> bool:
         """Probe the device; on an unhealthy verdict warn LOUDLY and
@@ -513,6 +612,8 @@ class ResilientRunner:
             RuntimeWarning, stacklevel=3)
         self.journal.write("fallback", where=where,
                            backend=self.fallback_backend)
+        self.metrics.counter("runner.degrades", reason="probe").inc()
+        self._inst.backend_override = "degraded"
         # recorded immediately, not at run end: the report attached to
         # a later failure must already say what the run degraded to
         self.report.degraded = True
@@ -544,11 +645,16 @@ class ResilientRunner:
                     self.report.backend = backend
                     self.report.breaker = self.breaker.snapshot()
                     self.journal.write("breaker_close", step=i)
+                    self.metrics.counter("runner.breaker_transitions",
+                                         to="close").inc()
+                    self._inst.backend_override = None
                 else:
                     self.breaker.record_failure()  # half-open → open
                     self.report.breaker = self.breaker.snapshot()
                     self.journal.write("breaker_reopen", step=i,
                                        reason=rec.get("reason"))
+                    self.metrics.counter("runner.breaker_transitions",
+                                         to="reopen").inc()
             attempt += 1
             budget_used += 1
             b = self._target_backend(t, backend, degraded)
@@ -586,6 +692,12 @@ class ResilientRunner:
                                 t.name, self._ckpt_path(steps, i), b)
                 except BaseException as e:  # noqa: BLE001 — reported,
                     err = e                 # classified, re-raised below
+            self._spans.append(sp)
+            status = "ok" if err is None else "error"
+            self.metrics.counter("runner.attempts", status=status,
+                                 backend=b).inc()
+            self.metrics.histogram("runner.step_wall_s",
+                                   status=status).observe(sp.duration)
             if err is None:
                 sr.attempts.append(StepAttempt(
                     attempt, b, "ok", round(sp.duration, 4), sp.id))
@@ -597,6 +709,15 @@ class ResilientRunner:
                 if self.checkpoint_dir:
                     self.journal.write("checkpoint", step=i,
                                        fingerprint=sr.fingerprint)
+                    self.metrics.counter("runner.checkpoint_writes") \
+                        .inc()
+                    try:
+                        self.metrics.counter("runner.checkpoint_bytes") \
+                            .inc(os.path.getsize(
+                                self._ckpt_path(steps, i)))
+                    except OSError:
+                        pass  # stat raced a cleanup; the write event
+                        # above already proves the save happened
                 return out, degraded
 
             cls = classify_error(err)
@@ -615,6 +736,7 @@ class ResilientRunner:
                 self.journal.write(
                     "deadline", step=i, name=t.name, attempt=attempt,
                     budget_s=self.step_deadline_s)
+                self.metrics.counter("runner.deadline_overruns").inc()
             if cls == FATAL:
                 sr.status = "aborted"
                 self.report.status = "aborted"
@@ -641,6 +763,8 @@ class ResilientRunner:
                         and prev != CircuitBreaker.OPEN):
                     self.journal.write("breaker_open", step=i,
                                        **self.breaker.snapshot())
+                    self.metrics.counter("runner.breaker_transitions",
+                                         to="open").inc()
             if on_accel and not degraded and not self.breaker.allow():
                 # breaker OPEN: skip the remaining retries AND the
                 # probe — straight to the degrade ruling (this is the
@@ -657,6 +781,9 @@ class ResilientRunner:
                 self.journal.write("fallback", where=f"step {i}",
                                    backend=self.fallback_backend,
                                    reason="breaker_open")
+                self.metrics.counter("runner.degrades",
+                                     reason="breaker_open").inc()
+                self._inst.backend_override = "degraded"
                 self.report.degraded = True
                 self.report.backend = self.fallback_backend
                 degraded = True
@@ -669,6 +796,7 @@ class ResilientRunner:
                 d = policy.delay_s(budget_used, rng)
                 self.journal.write("backoff", step=i, attempt=attempt,
                                    delay_s=round(d, 4))
+                self.metrics.counter("runner.retries").inc()
                 self.sleep(d)
                 continue
             if (not degraded and self.fallback_backend
@@ -751,6 +879,13 @@ class ResilientRunner:
                 self.chaos.note_external_call(t.name)
             if res["status"] != "completed":
                 raise classify_child_result(res, t.name)
+            payload = res.get("result")
+            if isinstance(payload, dict) and payload.get("spans"):
+                # graft the child's span tree under the current step
+                # span (we are inside _run_step's `runner:<name>`
+                # span here) — isolated steps must not vanish from
+                # the trace
+                trace.graft(payload["spans"])
             out = load_celldata(out_path)
             if backend == "tpu":
                 out = out.device_put()

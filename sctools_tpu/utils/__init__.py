@@ -1,7 +1,13 @@
-"""Auxiliary subsystems: tracing, checkpoint/resume (+ integrity),
-and the injectable clock the resilience stack schedules through."""
+"""Auxiliary subsystems: tracing (+ Perfetto export), the telemetry
+metrics registry, checkpoint/resume (+ integrity), and the injectable
+clock the resilience stack schedules through."""
 
-from .trace import profile, report, reset, span, spans  # noqa: F401
+from .trace import (  # noqa: F401
+    all_spans, export_trace, profile, report, reset, span, spans,
+)
+from .telemetry import (  # noqa: F401
+    MetricsRegistry, default_registry, instrument_calls,
+)
 from .checkpoint import (  # noqa: F401
     PipelineCheckpointer, data_digest, load_celldata,
     quarantine_checkpoint, save_celldata, verify_checkpoint,

@@ -1,0 +1,378 @@
+"""Process-wide telemetry: the metrics registry and the call
+instrumentation hook.
+
+The resilience stack (PRs 1–3) answers "did the run survive"; this
+module answers "what did the run DO" — how many retries, degrades,
+cache misses and checkpoint bytes, and where the wall-clock went per
+op — without reading three disjoint artifacts by hand.  Three pieces:
+
+* :class:`MetricsRegistry` — counters, gauges and histograms with
+  FIXED bucket boundaries, keyed by ``(name, labels)``.  Metric names
+  are drawn from the central :data:`METRICS` vocabulary (sctlint
+  SCT009 checks every literal call site against it, so a typo'd
+  counter name fails lint instead of silently forking a series).
+  Anything timed goes through the injectable clock
+  (``utils/vclock.py``), so timing-shaped tests run with zero real
+  sleeps.
+* :func:`instrument_calls` — a ``registry.push_call_wrapper`` hook
+  that auto-instruments EVERY transform invocation (``apply``,
+  ``Transform.__call__``, every ``Pipeline``/recipe step) with
+  per-op call counts, error counts and duration histograms, labelled
+  by op name and backend (``cpu`` / ``tpu`` / ``degraded``).
+* :data:`EVENTS` — the run-journal event vocabulary.  The runner's
+  ``journal.write(event, ...)`` literals must be members (SCT009
+  again): the journal, the metrics snapshot and the exported span
+  trace are one joined observability surface (docs/ARCHITECTURE.md
+  "Observability"), and a typo'd event name would silently fall out
+  of every ``tools/sctreport.py`` report.
+
+NO DEVICE SYNCS ON THE HOT PATH: recording a metric touches Python
+scalars and the injectable clock only — never a device array.  On an
+async backend the instrumented duration is therefore the HOST
+DISPATCH wall, not the device execution wall; for execution walls put
+a ``trace.span(sync=True)`` barrier at the stage boundary instead
+(that is a measurement you opt into, never a side effect of
+telemetry being on).
+
+>>> from sctools_tpu.utils import telemetry
+>>> with telemetry.instrument_calls() as m:
+...     sct.apply("normalize.log1p", data, backend="tpu")
+>>> m.snapshot()["counters"]["op.calls{backend=tpu,op=normalize.log1p}"]
+1
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+from .vclock import SYSTEM_CLOCK, Clock
+
+# ---------------------------------------------------------------------------
+# Central vocabularies (the SCT009 contract)
+# ---------------------------------------------------------------------------
+
+#: Every legal run-journal event name.  ``journal.write(...)`` call
+#: sites must use literal members (sctlint SCT009); sctreport and the
+#: docs/ARCHITECTURE.md event table are generated against this set.
+EVENTS = frozenset({
+    # run lifecycle
+    "run_start", "run_completed", "run_failed", "run_aborted",
+    # per-step execution
+    "attempt", "backoff", "deadline", "checkpoint",
+    # containment ladder rulings
+    "breaker_open", "breaker_close", "breaker_reopen",
+    "health_check", "fallback", "quarantine",
+    # resume
+    "resume", "resume_unverified_input", "resume_place_failed",
+    # end-of-run telemetry artifacts
+    "metrics_written", "trace_exported",
+})
+
+#: Every legal metric name → one-line meaning (the docs table).  Like
+#: EVENTS, literal ``counter()/gauge()/histogram()/timer()`` call
+#: sites must use members (SCT009) — a typo would fork a series that
+#: no report ever reads.
+METRICS = {
+    "op.calls": "counter: transform invocations (labels op=, backend=)",
+    "op.errors": "counter: transform invocations that raised "
+                 "(labels op=, backend=)",
+    "op.duration_s": "histogram: per-transform host dispatch wall "
+                     "seconds (labels op=, backend=)",
+    "runner.attempts": "counter: step attempts (labels status=, "
+                       "backend=)",
+    "runner.retries": "counter: backoff retries scheduled",
+    "runner.deadline_overruns": "counter: StepDeadlineExceeded raises",
+    "runner.degrades": "counter: degrade-to-fallback rulings "
+                       "(labels reason=)",
+    "runner.breaker_transitions": "counter: circuit-breaker "
+                                  "transitions (labels to=)",
+    "runner.quarantines": "counter: checkpoints quarantined on resume",
+    "runner.resumes": "counter: runs resumed from a verified "
+                      "checkpoint",
+    "runner.checkpoint_writes": "counter: step checkpoints written",
+    "runner.checkpoint_bytes": "counter: bytes written to step "
+                               "checkpoints",
+    "runner.step_wall_s": "histogram: per-step-attempt wall seconds "
+                          "(labels status=)",
+}
+
+#: Fixed histogram bucket upper bounds (seconds), chosen to straddle
+#: everything from a cached jit dispatch (~1 ms) to a wedged-step
+#: deadline (minutes).  FIXED on purpose: snapshots from different
+#: runs/processes merge bucket-by-bucket only if the boundaries never
+#: move.  A terminal +inf bucket is implicit.
+DURATION_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5,
+                    1.0, 5.0, 10.0, 30.0, 60.0, 300.0)
+
+#: metrics.json layout version (bump on incompatible change)
+SNAPSHOT_SCHEMA = 1
+
+
+# ---------------------------------------------------------------------------
+# Metric primitives
+# ---------------------------------------------------------------------------
+
+class Counter:
+    """Monotonic sum.  ``inc`` only — a counter that can go down is a
+    gauge wearing the wrong name.  Mutation holds a lock (the owning
+    registry's RLock, so a snapshot mid-``inc`` never tears): ``+=``
+    on an attribute is read-modify-write, and the GIL does not make
+    that atomic."""
+
+    __slots__ = ("value", "_lock")
+
+    def __init__(self, lock=None):
+        self.value = 0.0
+        self._lock = lock if lock is not None else threading.RLock()
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError("Counter.inc(n) requires n >= 0 — use a "
+                             "Gauge for values that go down")
+        with self._lock:
+            self.value += n
+
+
+class Gauge:
+    """Last-written value (queue depth, residency bytes, breaker
+    failures-in-window)."""
+
+    __slots__ = ("value", "_lock")
+
+    def __init__(self, lock=None):
+        self.value = 0.0
+        self._lock = lock if lock is not None else threading.RLock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+
+class Histogram:
+    """Fixed-boundary histogram: per-bucket counts plus count/sum/max.
+
+    ``observe(v)`` increments the first bucket whose upper bound
+    holds ``v`` (terminal +inf bucket implicit).  The snapshot emits
+    CUMULATIVE counts per bound (prometheus ``le`` style), which is
+    what makes cross-run merges a per-bucket add.  ``observe`` and
+    ``to_dict`` hold the lock, so a snapshot never sees ``count``
+    disagree with the bucket totals."""
+
+    __slots__ = ("buckets", "counts", "count", "sum", "max", "_lock")
+
+    def __init__(self, buckets=DURATION_BUCKETS, lock=None):
+        self.buckets = tuple(float(b) for b in buckets)
+        if list(self.buckets) != sorted(set(self.buckets)):
+            raise ValueError("histogram buckets must be strictly "
+                             "increasing")
+        self.counts = [0] * (len(self.buckets) + 1)  # +1: the +inf bucket
+        self.count = 0
+        self.sum = 0.0
+        self.max = 0.0
+        self._lock = lock if lock is not None else threading.RLock()
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            if v > self.max:
+                self.max = v
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self.counts[i] += 1
+                    return
+            self.counts[-1] += 1
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            cum, acc = {}, 0
+            for b, c in zip(self.buckets, self.counts):
+                acc += c
+                cum[f"{b:g}"] = acc
+            cum["+inf"] = acc + self.counts[-1]
+            return {"count": self.count, "sum": round(self.sum, 6),
+                    "max": round(self.max, 6), "buckets": cum}
+
+
+def _series_key(name: str, labels: dict) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+class MetricsRegistry:
+    """Process-wide, thread-safe registry of labelled metric series.
+
+    ``counter/gauge/histogram`` are get-or-create on the
+    ``(name, labels)`` key; :meth:`timer` observes an elapsed-seconds
+    histogram measured on the INJECTABLE clock (``clock=``, default
+    the system clock) — hand every participant one ``VirtualClock``
+    and timing tests never really sleep.  One RLock (reentrant: a
+    snapshot reads histogram cells under it) guards the series maps
+    AND every cell's mutation, so concurrent increments never lose
+    updates and snapshots never tear.
+    """
+
+    def __init__(self, clock: Clock | None = None):
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
+        self._lock = threading.RLock()
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._histograms: dict[str, Histogram] = {}
+
+    # -- series accessors ------------------------------------------------
+    def counter(self, name: str, **labels) -> Counter:
+        key = _series_key(name, labels)
+        with self._lock:
+            c = self._counters.get(key)
+            if c is None:
+                c = self._counters[key] = Counter(lock=self._lock)
+        return c
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        key = _series_key(name, labels)
+        with self._lock:
+            g = self._gauges.get(key)
+            if g is None:
+                g = self._gauges[key] = Gauge(lock=self._lock)
+        return g
+
+    def histogram(self, name: str, buckets=DURATION_BUCKETS,
+                  **labels) -> Histogram:
+        key = _series_key(name, labels)
+        with self._lock:
+            h = self._histograms.get(key)
+            if h is None:
+                h = self._histograms[key] = Histogram(buckets,
+                                                      lock=self._lock)
+        return h
+
+    @contextlib.contextmanager
+    def timer(self, name: str, **labels):
+        """Observe the enclosed block's elapsed seconds (on the
+        injectable clock) into the ``name`` histogram."""
+        h = self.histogram(name, **labels)
+        t0 = self.clock.monotonic()
+        try:
+            yield h
+        finally:
+            h.observe(self.clock.monotonic() - t0)
+
+    # -- snapshots -------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Full JSON-ready view: ``{"counters", "gauges",
+        "histograms"}``, each keyed ``name{label=value,...}``."""
+        with self._lock:
+            return {
+                "counters": {k: c.value
+                             for k, c in sorted(self._counters.items())},
+                "gauges": {k: g.value
+                           for k, g in sorted(self._gauges.items())},
+                "histograms": {k: h.to_dict()
+                               for k, h in sorted(self._histograms.items())},
+            }
+
+    def snapshot_compact(self) -> dict:
+        """Counters only — the cheap glimpse bench stage lines embed."""
+        with self._lock:
+            return {k: c.value for k, c in sorted(self._counters.items())}
+
+    def write(self, path: str) -> str:
+        """Atomically write the snapshot as ``metrics.json`` (tmp +
+        rename — a crash mid-write must not leave a half file where
+        sctreport looks)."""
+        doc = {"schema": SNAPSHOT_SCHEMA,
+               "written_at": round(time.time(), 3),
+               "metrics": self.snapshot()}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+        return path
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+
+#: the process-wide default registry ("process-wide" is the contract:
+#: every layer that doesn't get an explicit ``metrics=`` records here,
+#: so one snapshot sees the whole process)
+_DEFAULT = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    return _DEFAULT
+
+
+# ---------------------------------------------------------------------------
+# Auto-instrumentation of transform calls
+# ---------------------------------------------------------------------------
+
+class CallInstrumentor:
+    """The ``registry.push_call_wrapper``-shaped hook: wraps every
+    transform invocation with call/error counters and a duration
+    histogram.  Safe to install for a whole run (the ResilientRunner
+    does) or a single ``with`` block.
+
+    ``backend_override`` is the degraded-run label seam: while set
+    (the owning ResilientRunner sets it to ``"degraded"`` for the
+    lifetime of a degrade ruling), ops are labelled with it instead
+    of the dispatch backend — so a post-mortem can split "tpu when
+    healthy" from "cpu because we were ruled off the device".  It
+    lives on the instrumentor, NOT the (possibly process-shared)
+    registry: each run's degrade ruling scopes to that run's own
+    hook, so concurrent runs cannot cross-contaminate labels."""
+
+    def __init__(self, metrics: MetricsRegistry | None = None):
+        self.metrics = metrics if metrics is not None else _DEFAULT
+        self.backend_override: str | None = None
+
+    def wrap(self, name: str, backend: str, fn):
+        m = self.metrics
+
+        def instrumented(data, *args, **kw):
+            label = self.backend_override or backend
+            t0 = m.clock.monotonic()
+            try:
+                out = fn(data, *args, **kw)
+            except BaseException:
+                m.counter("op.errors", op=name, backend=label).inc()
+                raise
+            finally:
+                # counts + duration recorded for error attempts too —
+                # a wedge that burned 60 s then raised is exactly the
+                # duration a post-mortem needs.  Python scalars only:
+                # `out` is never touched, so no device sync.
+                m.counter("op.calls", op=name, backend=label).inc()
+                m.histogram("op.duration_s", op=name, backend=label) \
+                    .observe(m.clock.monotonic() - t0)
+            return out
+
+        return instrumented
+
+
+@contextlib.contextmanager
+def instrument_calls(metrics: MetricsRegistry | None = None):
+    """Scoped auto-instrumentation of every transform call:
+
+    >>> with telemetry.instrument_calls() as m:
+    ...     pipeline.run(data, backend="tpu")
+    >>> m.snapshot()["counters"]
+
+    Yields the target :class:`MetricsRegistry` (the process default
+    unless ``metrics=`` is given).  Composes with other call wrappers
+    (chaos, deadlines) — most recently pushed runs outermost."""
+    from .. import registry as _registry
+
+    inst = CallInstrumentor(metrics)
+    with _registry.call_wrapper(inst.wrap):
+        yield inst.metrics

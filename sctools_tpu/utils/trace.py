@@ -1,7 +1,7 @@
 """Lightweight tracing for pipeline stages.
 
 Reference parity: the reference framework ships a tracing subsystem
-for its pipeline runtime (source unavailable — SURVEY.md §0).  Two
+for its pipeline runtime (source unavailable — SURVEY.md §0).  Three
 layers here:
 
 * ``span(name)`` — nested wall-clock spans with an in-process tree,
@@ -9,6 +9,17 @@ layers here:
   before closing so the span charges queued TPU work to the stage
   that launched it (jax dispatch is async — without the barrier a
   span only measures Python time).
+* the **process-wide collector** — span stacks are thread-local (a
+  worker thread's nesting can't corrupt the main thread's), but
+  completed trees from EVERY thread are visible to ``all_spans()`` /
+  ``report()`` and cleared by ``reset()``; opt out with
+  ``set_cross_thread(False)`` when a long-lived service must not
+  accumulate span trees process-wide.
+* **export** — ``export_trace(path)`` writes Chrome/Perfetto
+  ``trace_event`` JSON; ``serialize_spans()``/``graft()`` move a span
+  tree across a process boundary (how an isolated child's spans
+  survive into the parent's trace instead of vanishing — the
+  run-journal's ``span_id`` stays the join key throughout).
 * ``profile(logdir)`` — wraps ``jax.profiler.trace`` for full XLA
   traces viewable in TensorBoard/Perfetto.
 """
@@ -17,14 +28,17 @@ from __future__ import annotations
 
 import contextlib
 import itertools
+import json
+import os
 import threading
 import time
+import weakref
 from dataclasses import dataclass, field
 
 # Process-wide monotonic span ids: external records (e.g. the
 # ResilientRunner's JSONL run journal) reference a span by id instead
 # of copying its timings, so one id joins the journal to the in-tree
-# span and to the profiler trace that wraps it.
+# span and to the exported trace_event record that carries it.
 _span_ids = itertools.count(1)
 
 
@@ -42,11 +56,59 @@ class Span:
         for c in self.children:
             yield from c.flat(depth + 1)
 
+    def to_dict(self) -> dict:
+        """JSON-safe tree form (the isolation-handoff wire format)."""
+        return {"name": self.name, "start": self.start,
+                "duration": self.duration, "id": self.id,
+                "meta": dict(self.meta),
+                "children": [c.to_dict() for c in self.children]}
+
+
+def span_from_dict(d: dict) -> Span:
+    return Span(d["name"], float(d["start"]),
+                duration=float(d.get("duration", 0.0)),
+                id=int(d.get("id", 0)), meta=dict(d.get("meta") or {}),
+                children=[span_from_dict(c)
+                          for c in d.get("children", ())])
+
+
+# ---------------------------------------------------------------------------
+# Per-thread state + the process-wide collector
+# ---------------------------------------------------------------------------
+
+#: (thread weakref, thread name, THE SAME list object as that
+#: thread's local roots) per recording thread.  Sharing the list is
+#: the whole trick: clearing it from any thread resets the owning
+#: thread's state too — the bug ``reset()`` used to have (it only
+#: ever saw the calling thread).  Keyed by the thread OBJECT (weakly),
+#: not its ident: CPython reuses idents after a join, and an
+#: ident-keyed map would let a later thread silently evict a dead
+#: thread's recorded spans.  Dead threads' entries are pruned on
+#: ``reset()``.
+_COLLECTOR_LOCK = threading.Lock()
+_ALL_ROOTS: list[tuple] = []
+_CROSS_THREAD = True
+
+
+def set_cross_thread(enabled: bool) -> None:
+    """Opt out of (or back into) process-wide collection.  While
+    disabled, threads that record their FIRST span are not registered
+    with the collector — their spans stay visible only to themselves
+    (pre-collector behaviour); already-registered threads keep
+    reporting.  Disabling is for long-lived services where
+    accumulating every worker's span trees process-wide is a leak."""
+    global _CROSS_THREAD
+    _CROSS_THREAD = bool(enabled)
+
 
 class _State(threading.local):
     def __init__(self):
         self.roots: list[Span] = []
         self.stack: list[Span] = []
+        if _CROSS_THREAD:
+            t = threading.current_thread()
+            with _COLLECTOR_LOCK:
+                _ALL_ROOTS.append((weakref.ref(t), t.name, self.roots))
 
 
 _state = _State()
@@ -93,22 +155,212 @@ def span(name: str, sync: bool = False, meta: dict | None = None):
 
 
 def spans() -> list[Span]:
-    """Completed root spans of this thread."""
+    """Completed root spans of THIS thread (see ``all_spans`` for the
+    process-wide view)."""
     return list(_state.roots)
 
 
+def all_spans() -> list[Span]:
+    """Root spans recorded by EVERY collected thread (living or
+    dead), in start order."""
+    with _COLLECTOR_LOCK:
+        out = [s for _, _, roots in _ALL_ROOTS for s in roots]
+    return sorted(out, key=lambda s: s.start)
+
+
+def _threads() -> list[tuple[str, list[Span]]]:
+    """(thread name, roots) per collected thread, calling thread
+    first — the export's tid assignment."""
+    me = threading.current_thread()
+    with _COLLECTOR_LOCK:
+        items = [(ref() is me, name, list(roots))
+                 for ref, name, roots in _ALL_ROOTS]
+    items.sort(key=lambda it: (not it[0], it[1]))
+    return [(name, roots) for _, name, roots in items if roots]
+
+
 def reset() -> None:
+    """Clear recorded spans — including trees recorded by OTHER
+    threads (their registered root lists are shared objects, so the
+    owning thread's view empties too).  The calling thread's open-span
+    stack is also cleared; other threads' in-flight stacks are left
+    alone (popping a span out from under a running thread would
+    corrupt its nesting)."""
     _state.roots.clear()
     _state.stack.clear()
+    with _COLLECTOR_LOCK:
+        for _, _, roots in _ALL_ROOTS:
+            roots.clear()
+        # a live thread's registration survives the reset (its next
+        # span appends to the SAME list); dead threads' now-empty
+        # entries are pruned so sequential short-lived workers don't
+        # accumulate slots forever
+        _ALL_ROOTS[:] = [e for e in _ALL_ROOTS if e[0]() is not None]
 
 
-def report() -> str:
-    """Indented text table of recorded spans."""
+def report(all_threads: bool = True) -> str:
+    """Indented text table of recorded spans.  Covers every collected
+    thread by default (thread-name headers appear only when more than
+    one thread recorded); ``all_threads=False`` restores the
+    calling-thread-only view."""
+    groups = (_threads() if all_threads
+              else [(threading.current_thread().name, spans())])
     lines = []
-    for root in _state.roots:
-        for depth, s in root.flat():
-            lines.append(f"{'  ' * depth}{s.name:<40s} {s.duration * 1e3:10.2f} ms")
+    named = len(groups) > 1
+    for tname, roots in groups:
+        if named and roots:
+            lines.append(f"[thread {tname}]")
+        for root in roots:
+            for depth, s in root.flat():
+                lines.append(
+                    f"{'  ' * depth}{s.name:<40s} "
+                    f"{s.duration * 1e3:10.2f} ms")
     return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Chrome/Perfetto trace_event export
+# ---------------------------------------------------------------------------
+
+def trace_events(span_list: list[Span] | None = None) -> list[dict]:
+    """Flatten span trees into Chrome ``trace_event`` complete events
+    (``ph: "X"``; ts/dur in microseconds, rebased so the earliest
+    span starts at 0).  ``None`` exports every collected thread, one
+    ``tid`` per thread with a thread-name metadata record.  Children
+    are clamped inside their parent's [ts, ts+dur] window so float
+    rounding can never make a trace viewer rule a child "outside" the
+    stage that ran it."""
+    groups = ([(threading.current_thread().name, list(span_list))]
+              if span_list is not None else _threads())
+    starts = [s.start for _, roots in groups for s in roots]
+    if not starts:
+        return []
+    t0 = min(starts)
+    events: list[dict] = []
+
+    def emit(s: Span, tid: int, lo: float, hi: float):
+        ts = max((s.start - t0) * 1e6, lo)
+        end = min(ts + s.duration * 1e6, hi) if hi is not None \
+            else ts + s.duration * 1e6
+        end = max(end, ts)  # a zero-length child never goes negative
+        events.append({
+            "name": s.name, "cat": "span", "ph": "X",
+            "ts": round(ts, 3), "dur": round(end - ts, 3),
+            "pid": 1, "tid": tid,
+            "args": {"span_id": s.id, **s.meta},
+        })
+        for c in s.children:
+            emit(c, tid, ts, end)
+
+    for tid, (tname, roots) in enumerate(groups):
+        events.append({"name": "thread_name", "ph": "M", "pid": 1,
+                       "tid": tid, "args": {"name": tname}})
+        for root in roots:
+            emit(root, tid, 0.0, None)
+    return events
+
+
+def export_trace(path: str, span_list: list[Span] | None = None,
+                 append: bool = False) -> str:
+    """Write a Perfetto/chrome://tracing-loadable ``trace.json``
+    (atomic tmp + rename).  Returns ``path``.
+
+    ``append=True`` merges into an existing file instead of
+    clobbering it — the new events are shifted to start after the old
+    ones end, so a crash → resume sequence (which APPENDS to the run
+    journal) accumulates one trace covering every run, and the
+    journal's span ids keep resolving.  An unreadable existing file
+    is overwritten (it would fail every viewer anyway)."""
+    events = trace_events(span_list)
+    if append and os.path.exists(path):
+        try:
+            with open(path) as f:
+                old = json.load(f)["traceEvents"]
+        except (OSError, json.JSONDecodeError, KeyError, TypeError):
+            old = None
+        if old:
+            end = max((e.get("ts", 0.0) + e.get("dur", 0.0)
+                       for e in old if e.get("ph") == "X"),
+                      default=0.0)
+            shift = end + 10_000.0  # 10 ms of daylight between runs
+            for e in events:
+                if e.get("ph") == "X":
+                    e["ts"] = round(e["ts"] + shift, 3)
+            # drop duplicate thread-name metadata records
+            seen = {(e.get("tid"), e["args"].get("name"))
+                    for e in old if e.get("ph") == "M"}
+            events = [e for e in events
+                      if e.get("ph") != "M"
+                      or (e.get("tid"), e["args"].get("name"))
+                      not in seen]
+            events = old + events
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Cross-process span handoff (isolated children)
+# ---------------------------------------------------------------------------
+
+def serialize_spans(span_list: list[Span] | None = None) -> list[dict]:
+    """JSON-safe dump of root spans (default: this thread's) for a
+    handoff file — the form an isolated child returns its tree in."""
+    return [s.to_dict() for s in (span_list if span_list is not None
+                                  else spans())]
+
+
+def graft(span_dicts: list[dict], rebase: bool = True) -> list[Span]:
+    """Attach a serialized span tree (from :func:`serialize_spans`,
+    typically recorded in an isolated child process) under the
+    CURRENT span — or as roots of this thread if none is open.
+
+    Every grafted span gets a FRESH id from this process's counter
+    (the child's counter starts at 1 too, so its ids would collide
+    with the parent's; the original id is kept as
+    ``meta["child_span_id"]`` for cross-referencing the child's own
+    artifacts).  With ``rebase=True`` (default) the tree is shifted
+    onto this process's clock so the children END at the graft point
+    — a child's ``perf_counter`` epoch is meaningless here, and the
+    graft happens right after the child finished."""
+    roots = [span_from_dict(d) for d in span_dicts]
+    if not roots:
+        return []
+
+    def reid(s: Span):
+        if s.id:
+            s.meta.setdefault("child_span_id", s.id)
+        s.id = next(_span_ids)
+        for c in s.children:
+            reid(c)
+
+    for r in roots:
+        reid(r)
+    if rebase:
+        end = max(r.start + r.duration for r in roots)
+        offset = time.perf_counter() - end
+        if _state.stack:
+            # never rebase a child to before its new parent's start:
+            # a child tree whose recorded duration exceeds the
+            # parent's elapsed-so-far would otherwise "begin" before
+            # the span it is grafted under (ending-at-now yields; the
+            # parent is still open, so containment holds either way)
+            first = min(r.start for r in roots)
+            offset = max(offset, _state.stack[-1].start - first)
+        def shift(s: Span):
+            s.start += offset
+            for c in s.children:
+                shift(c)
+        for r in roots:
+            shift(r)
+    if _state.stack:
+        _state.stack[-1].children.extend(roots)
+    else:
+        _state.roots.extend(roots)
+    return roots
 
 
 @contextlib.contextmanager

@@ -827,3 +827,132 @@ def test_run_integrity_acceptance_wedge_breaker_corrupt_resume(tmp_path):
     fb = next(e for e in events if e["event"] == "fallback")
     assert fb["reason"] == "breaker_open"
     assert names[-1] == "run_completed"
+
+
+# --------------------------------------- telemetry artifacts (ISSUE 4)
+
+def test_isolated_child_spans_grafted_into_parent_trace(tmp_path):
+    """Regression for the lost-child-spans bug: isolated steps used to
+    produce NO spans in the parent — the child's tree now rides the
+    run_isolated handoff and is grafted under the parent's step span,
+    with fresh parent-side ids."""
+    from sctools_tpu.utils import trace
+
+    data = _data(120, 60)
+    pipe = Pipeline([("qc.per_cell_metrics", {}),
+                     ("normalize.log1p", {})])
+    r = _runner(pipe, checkpoint_dir=str(tmp_path),
+                isolate={"normalize.log1p"},
+                isolate_timeout_s=240.0, isolate_stall_s=120.0)
+    r.run(data, backend="cpu")
+    step_span = next(s for s in r._spans
+                     if s.name == "runner:normalize.log1p")
+    kids = [c.name for c in step_span.children]
+    assert kids == ["isolated:normalize.log1p"]
+    child_root = step_span.children[0]
+    assert [c.name for c in child_root.children] == \
+        ["load", "normalize.log1p", "save"]
+    # fresh ids from THIS process's counter; the child's own id is
+    # kept for cross-reference
+    ids = [s.id for _, s in child_root.flat()]
+    assert len(set(ids)) == len(ids) and all(i > 0 for i in ids)
+    assert child_root.meta.get("child_span_id")
+    assert child_root.meta.get("backend") == "cpu"
+    # and the graft survives into the exported trace.json
+    doc = json.load(open(os.path.join(str(tmp_path), "trace.json")))
+    names = [e["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "X"]
+    assert "isolated:normalize.log1p" in names
+    assert "load" in names and "save" in names
+    trace.reset()
+
+
+def test_metrics_counters_mirror_journal_and_artifacts_written(tmp_path):
+    """The runner's recovery counters agree with the journal, the
+    snapshot lands in metrics.json, the spans in trace.json, and the
+    journal's attempt span_ids all resolve in the trace — the
+    join-key property PR 1 promised."""
+    from sctools_tpu.utils.telemetry import MetricsRegistry
+    from sctools_tpu.utils.vclock import VirtualClock
+
+    data, pipe = _data(150, 80), _pipe()
+    m = MetricsRegistry(clock=VirtualClock())
+    monkey = ChaosMonkey([Fault("hvg.select", "unavailable", times=1)])
+    r = _runner(pipe, checkpoint_dir=str(tmp_path), chaos=monkey,
+                metrics=m)
+    r.run(data, backend="cpu")
+
+    snap = m.snapshot()
+    c = snap["counters"]
+    assert c["runner.retries"] == 1
+    assert c["runner.attempts{backend=cpu,status=error}"] == 1
+    assert c["runner.attempts{backend=cpu,status=ok}"] == len(pipe.steps)
+    assert c["runner.checkpoint_writes"] == len(pipe.steps)
+    assert c["runner.checkpoint_bytes"] > 0
+    # auto-instrumented op metrics, installed by the runner itself
+    assert c["op.calls{backend=cpu,op=hvg.select}"] == 2
+    assert c["op.errors{backend=cpu,op=hvg.select}"] == 1
+    assert snap["histograms"]["runner.step_wall_s{status=ok}"][
+        "count"] == len(pipe.steps)
+
+    mdoc = json.load(open(os.path.join(str(tmp_path), "metrics.json")))
+    assert mdoc["metrics"]["counters"] == c
+    tdoc = json.load(open(os.path.join(str(tmp_path), "trace.json")))
+    trace_ids = {e["args"]["span_id"]
+                 for e in tdoc["traceEvents"] if e.get("ph") == "X"}
+    events = _journal(os.path.join(str(tmp_path), "journal.jsonl"))
+    attempt_ids = {e["span_id"] for e in events
+                   if e["event"] == "attempt"}
+    assert attempt_ids and attempt_ids <= trace_ids
+    # artifact events are journaled, and run_completed stays LAST
+    names = [e["event"] for e in events]
+    assert "metrics_written" in names and "trace_exported" in names
+    assert names[-1] == "run_completed"
+
+
+def test_degraded_runs_label_ops_degraded(tmp_path):
+    from sctools_tpu.utils.telemetry import MetricsRegistry
+    from sctools_tpu.utils.vclock import VirtualClock
+
+    data, pipe = _data(150, 80), _pipe()
+    m = MetricsRegistry(clock=VirtualClock())
+    monkey = ChaosMonkey(
+        [Fault("normalize.log1p", "unavailable", times=-1,
+               backend="tpu")])
+    r = _runner(pipe, probe=lambda: dict(DOWN_PROBE),
+                policy=RetryPolicy(max_attempts=2),
+                fallback_backend="cpu", metrics=m)
+    with monkey.activate():
+        with pytest.warns(RuntimeWarning, match="DEGRADING"):
+            r.run(data, backend="tpu")
+    c = m.snapshot()["counters"]
+    assert c["runner.degrades{reason=probe}"] == 1
+    # ops before the ruling are labelled tpu, after it degraded
+    assert c["op.calls{backend=tpu,op=normalize.log1p}"] == 2
+    assert c["op.calls{backend=degraded,op=normalize.log1p}"] == 1
+    assert c["op.calls{backend=degraded,op=hvg.select}"] == 1
+    # the override is scoped to this runner's instrumentor and
+    # cleared at run end
+    assert r._inst.backend_override is None
+
+
+def test_failed_run_still_writes_artifacts(tmp_path):
+    from sctools_tpu.utils.telemetry import MetricsRegistry
+    from sctools_tpu.utils.vclock import VirtualClock
+
+    data, pipe = _data(150, 80), _pipe()
+    m = MetricsRegistry(clock=VirtualClock())
+    monkey = ChaosMonkey(
+        [Fault("normalize.log1p", "unavailable", times=-1)])
+    r = _runner(pipe, checkpoint_dir=str(tmp_path), chaos=monkey,
+                policy=RetryPolicy(max_attempts=2),
+                fallback_backend=None, metrics=m)
+    with pytest.raises(ResilientRunError):
+        r.run(data, backend="cpu")
+    assert os.path.exists(os.path.join(str(tmp_path), "metrics.json"))
+    assert os.path.exists(os.path.join(str(tmp_path), "trace.json"))
+    assert m.snapshot()["counters"]["runner.retries"] == 1
+    # the journal's final line stays the run VERDICT — artifacts are
+    # written for failed runs but never journaled after the verdict
+    events = _journal(os.path.join(str(tmp_path), "journal.jsonl"))
+    assert events[-1]["event"] == "run_failed"

@@ -349,6 +349,84 @@ def test_sct008_suppressible_per_line(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# SCT009 — telemetry vocabulary (journal events + metric names)
+# ---------------------------------------------------------------------------
+
+def test_sct009_flags_typoed_event_and_metric(tmp_path):
+    r = lint_src(tmp_path, """
+        def record(self, m):
+            self.journal.write("quarntine", step=1)
+            m.counter("runner.retrys").inc()
+        """, only=["SCT009"], prelude=False)
+    assert rule_ids(r) == ["SCT009", "SCT009"]
+    msgs = " | ".join(v.message for v in r.violations)
+    assert "telemetry.EVENTS" in msgs
+    assert "telemetry.METRICS" in msgs
+
+
+def test_sct009_flags_computed_event_name(tmp_path):
+    # a computed event name can never be vocabulary-checked — the
+    # whole point is that sctreport reads events by literal name
+    r = lint_src(tmp_path, """
+        def record(journal, ev):
+            journal.write(ev, step=1)
+        """, only=["SCT009"], prelude=False)
+    assert rule_ids(r) == ["SCT009"]
+    assert "LITERAL" in r.violations[0].message
+
+
+def test_sct009_clean_vocabulary_members(tmp_path):
+    r = lint_src(tmp_path, """
+        def record(self, m):
+            self.journal.write("attempt", step=1, span_id=3)
+            self.journal.write("quarantine", step=1, reason="x")
+            journal.write("run_completed", degraded=False)
+            m.counter("runner.retries").inc()
+            m.counter("op.calls", op="a", backend="tpu").inc()
+            m.histogram("op.duration_s", op="a").observe(0.1)
+            with m.timer("runner.step_wall_s"):
+                pass
+        """, only=["SCT009"], prelude=False)
+    assert rule_ids(r) == []
+
+
+def test_sct009_ignores_unrelated_write_and_histogram_calls(tmp_path):
+    # f.write(...) is not a journal; np.histogram's first arg is not
+    # a string literal — neither may fire
+    r = lint_src(tmp_path, """
+        import numpy as np
+
+        def other(f, x):
+            f.write("anything at all")
+            return np.histogram(x, bins=10)
+        """, only=["SCT009"], prelude=False)
+    assert rule_ids(r) == []
+
+
+def test_sct009_suppressible_per_line(tmp_path):
+    r = lint_src(tmp_path, """
+        def record(self):
+            self.journal.write("experimental_event")  # sctlint: disable=SCT009
+        """, only=["SCT009"], prelude=False)
+    assert rule_ids(r) == []
+    assert [v.rule for v in r.suppressed] == ["SCT009"]
+
+
+def test_sct009_vocabulary_is_ast_extracted_not_imported():
+    """The rule reads EVENTS/METRICS from telemetry.py by AST — it
+    must agree with the live module without importing it during a
+    lint run (sctlint executes no library code except SCT000)."""
+    from sctools_tpu.utils.telemetry import EVENTS, METRICS
+    from tools.sctlint.rules.vocab import _load_vocab
+
+    vocab = _load_vocab()
+    assert vocab is not None
+    events, metrics = vocab
+    assert events == EVENTS
+    assert metrics == frozenset(METRICS)
+
+
+# ---------------------------------------------------------------------------
 # SCT006 — registry conventions
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,187 @@
+"""tools/sctreport — the run-report CLI.  Fixture tests run against
+the committed synthetic run directory (the same one the
+tools/run_checks.sh CI stage executes against); the acceptance test
+produces a REAL chaos-injected run_recipe run directory and reads it
+back — all on a VirtualClock, zero real sleeps."""
+
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import pytest
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from tools.sctreport import (digest_run, load_journal, main,  # noqa: E402
+                             split_runs)
+
+FIXTURE = os.path.join(_ROOT, "tests", "fixtures", "sctreport_run")
+
+
+# ------------------------------------------------------------- fixture
+
+def test_fixture_report_names_every_ruling(capsys):
+    assert main([FIXTURE]) == 0
+    out = capsys.readouterr().out
+    assert "per-step timeline" in out
+    # the committed fixture holds a wedge (deadline), a breaker-driven
+    # degrade, a retry, a quarantine and a resume — all must be NAMED
+    assert "DEADLINE" in out and "qc.per_cell_metrics" in out
+    assert "BREAKER open" in out
+    assert "DEGRADE" in out and "reason=breaker_open" in out
+    assert "QUARANTINE" in out and "normalize" in out
+    assert "RESUME from step" in out
+    assert "retries (backoff): 1" in out
+    # span join: every journal attempt id resolves in trace.json
+    assert "span-id join: 11/11" in out
+    # metrics snapshot included
+    assert "runner.quarantines" in out
+    assert "op.calls{backend=degraded" in out
+
+
+def test_fixture_trace_is_perfetto_loadable():
+    doc = json.load(open(os.path.join(FIXTURE, "trace.json")))
+    evs = doc["traceEvents"]
+    assert isinstance(evs, list) and evs
+    slices = [e for e in evs if e.get("ph") == "X"]
+    assert slices
+    for e in slices:
+        assert e["ts"] >= 0 and e["dur"] >= 0
+        assert {"name", "pid", "tid", "args"} <= set(e)
+
+
+def test_fixture_json_mode(capsys):
+    assert main([FIXTURE, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert len(doc["runs"]) == 2
+    assert doc["runs"][0]["outcome"] == "completed"
+    assert doc["runs"][0]["degraded"] is True
+    assert doc["runs"][1]["resumed_from"] == 6
+    assert doc["trace"]["n_events"] == 11
+    assert doc["metrics"]["metrics"]["counters"]["runner.retries"] == 1
+
+
+def test_cli_module_invocation_matches_run_checks_stage():
+    """The exact invocation the CI stage runs — jax-free, exit 0,
+    non-empty stdout."""
+    env = dict(os.environ)
+    p = subprocess.run(
+        [sys.executable, "-m", "tools.sctreport", FIXTURE],
+        capture_output=True, text=True, cwd=_ROOT, env=env,
+        timeout=120)
+    assert p.returncode == 0, p.stderr
+    assert len(p.stdout.splitlines()) > 10
+
+
+def test_missing_and_empty_journals_fail(tmp_path, capsys):
+    assert main([str(tmp_path)]) == 1  # no journal.jsonl
+    (tmp_path / "journal.jsonl").write_text("")
+    assert main([str(tmp_path)]) == 1  # empty journal: empty report
+    err = capsys.readouterr().err
+    assert "journal" in err
+
+
+def test_malformed_lines_are_survived(tmp_path, capsys):
+    (tmp_path / "journal.jsonl").write_text(
+        '{"event": "run_start", "n_steps": 1, "backend": "cpu", '
+        '"steps": [{"index": 0, "name": "x.y", "fingerprint": "f"}]}\n'
+        "NOT JSON AT ALL\n"
+        '{"event": "attempt", "step": 0, "name": "x.y", "attempt": 1, '
+        '"backend": "cpu", "status": "ok", "wall_s": 0.1, '
+        '"span_id": 1}\n'
+        '{"event": "run_completed", "degraded": false}\n')
+    assert main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "1 malformed journal line(s) skipped" in out
+    assert "x.y" in out and "completed" in out
+    assert "(no trace.json" in out and "(no metrics.json" in out
+
+
+def test_digest_splits_runs_and_tracks_statuses():
+    events, bad = load_journal(os.path.join(FIXTURE, "journal.jsonl"))
+    assert bad == 0
+    runs = [digest_run(r) for r in split_runs(events)]
+    assert len(runs) == 2
+    assert runs[0]["degraded"] and runs[0]["outcome"] == "completed"
+    assert runs[1]["quarantines"] and runs[1]["resumed_from"] == 6
+    # the resumed run marks prefix steps resumed, the re-ran one done
+    last = runs[1]["steps"]
+    assert last[6]["status"] == "resumed"
+    assert last[7]["status"] == "completed"
+
+
+# ------------------------------------------- acceptance e2e (ISSUE 4)
+
+def test_acceptance_chaos_run_recipe_report(tmp_path, capsys):
+    """The ISSUE-4 acceptance scenario: a chaos-injected run_recipe
+    run (wedge past the step deadline + corrupt_checkpoint + a
+    tpu-only outage that forces a degrade), resumed once, then
+    sctreport over the run dir — the report names every retry,
+    degrade and quarantine event, and trace.json is Perfetto-shaped.
+    Zero real sleeps (VirtualClock), no device syncs (cpu backend,
+    metric paths never touch arrays)."""
+    from sctools_tpu.data.synthetic import synthetic_counts
+    from sctools_tpu.recipes import run_recipe
+    from sctools_tpu.utils.chaos import ChaosMonkey, Fault
+    from sctools_tpu.utils.failsafe import CircuitBreaker
+    from sctools_tpu.utils.telemetry import MetricsRegistry
+    from sctools_tpu.utils.vclock import VirtualClock
+
+    data = synthetic_counts(200, 100, n_clusters=3)
+    ck = str(tmp_path)
+    clock = VirtualClock()
+    monkey = ChaosMonkey([
+        Fault("qc.per_cell_metrics", "wedge", times=1),
+        Fault("normalize.library_size", "unavailable", times=-1,
+              backend="tpu"),
+        Fault("normalize.scale", "corrupt_checkpoint", times=1),
+    ], clock=clock, wedge_s=120.0)
+    m = MetricsRegistry(clock=clock)
+    kw = dict(chaos=monkey, clock=clock, metrics=m,
+              probe=lambda: {"ok": True, "device_kind": "t",
+                             "wall_s": 0.0},
+              sleep=lambda s: None,
+              breaker=CircuitBreaker(failure_threshold=2,
+                                     window_s=300.0, cooldown_s=1e6,
+                                     clock=clock))
+    with pytest.warns(RuntimeWarning, match="circuit breaker OPEN"):
+        run_recipe("seurat", data, backend="tpu", checkpoint_dir=ck,
+                   step_deadline_s=60.0, runner_kw=kw,
+                   n_top_genes=50, min_genes=1, min_cells=1)
+    # fresh "process": resume quarantines the corrupted checkpoint
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        run_recipe("seurat", data, backend="tpu", checkpoint_dir=ck,
+                   runner_kw={"probe": kw["probe"], "metrics": m,
+                              "sleep": lambda s: None,
+                              "clock": VirtualClock()},
+                   n_top_genes=50, min_genes=1, min_cells=1)
+    assert clock.monotonic() >= 120.0  # the wedge burned VIRTUAL time
+
+    assert main([ck]) == 0
+    out = capsys.readouterr().out
+    # every retry/degrade/quarantine ruling is named
+    assert "DEADLINE step" in out and "qc.per_cell_metrics" in out
+    assert "retries (backoff): 1" in out
+    assert "DEGRADE" in out and "reason=breaker_open" in out
+    assert "QUARANTINE step" in out
+    assert "RESUME from step" in out
+    assert "runner.deadline_overruns" in out
+
+    tdoc = json.load(open(os.path.join(ck, "trace.json")))
+    slices = [e for e in tdoc["traceEvents"] if e.get("ph") == "X"]
+    assert slices and all(e["dur"] >= 0 and e["ts"] >= 0
+                          for e in slices)
+    # the join-key property: journal attempt span ids resolve
+    attempt_ids = set()
+    with open(os.path.join(ck, "journal.jsonl")) as f:
+        for line in f:
+            e = json.loads(line)
+            if e["event"] == "attempt":
+                attempt_ids.add(e["span_id"])
+    trace_ids = {e["args"]["span_id"] for e in slices}
+    assert attempt_ids and attempt_ids <= trace_ids

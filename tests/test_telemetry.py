@@ -1,0 +1,208 @@
+"""Metrics registry + call auto-instrumentation.  All timing runs on
+a VirtualClock — zero real sleeps — and no test touches a device
+array from inside a metric path (the no-device-syncs contract)."""
+
+import json
+import threading
+
+import pytest
+
+from sctools_tpu import registry as sct_registry
+from sctools_tpu.data.synthetic import synthetic_counts
+from sctools_tpu.registry import Pipeline, apply
+from sctools_tpu.utils import telemetry
+from sctools_tpu.utils.telemetry import (DURATION_BUCKETS, EVENTS,
+                                         METRICS, CallInstrumentor,
+                                         Counter, Histogram,
+                                         MetricsRegistry,
+                                         default_registry,
+                                         instrument_calls)
+from sctools_tpu.utils.vclock import VirtualClock
+
+
+# ------------------------------------------------------------ primitives
+
+def test_counter_monotonic():
+    c = Counter()
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError, match="Gauge"):
+        c.inc(-1)
+
+
+def test_histogram_fixed_buckets_cumulative():
+    h = Histogram()
+    assert h.buckets == DURATION_BUCKETS  # the FIXED boundaries
+    for v in (0.0005, 0.3, 7.0, 1e6):
+        h.observe(v)
+    d = h.to_dict()
+    assert d["count"] == 4
+    assert d["max"] == 1e6
+    # cumulative (prometheus `le`) semantics, terminal +inf bucket
+    assert d["buckets"]["0.001"] == 1
+    assert d["buckets"]["0.5"] == 2
+    assert d["buckets"]["10"] == 3
+    assert d["buckets"]["300"] == 3
+    assert d["buckets"]["+inf"] == 4
+
+
+def test_histogram_rejects_unsorted_buckets():
+    with pytest.raises(ValueError, match="increasing"):
+        Histogram(buckets=(1.0, 0.5))
+
+
+# -------------------------------------------------------------- registry
+
+def test_labelled_series_are_distinct():
+    m = MetricsRegistry(clock=VirtualClock())
+    m.counter("op.calls", op="a", backend="cpu").inc()
+    m.counter("op.calls", op="a", backend="tpu").inc(2)
+    m.counter("op.calls", op="a", backend="cpu").inc()  # same series
+    snap = m.snapshot()["counters"]
+    assert snap["op.calls{backend=cpu,op=a}"] == 2
+    assert snap["op.calls{backend=tpu,op=a}"] == 2
+
+
+def test_timer_uses_injectable_clock_no_real_sleep():
+    clock = VirtualClock()
+    m = MetricsRegistry(clock=clock)
+    with m.timer("op.duration_s", op="x"):
+        clock.advance(42.0)  # virtual time only
+    h = m.snapshot()["histograms"]["op.duration_s{op=x}"]
+    assert h["count"] == 1 and h["sum"] == 42.0
+    assert h["buckets"]["60"] == 1 and h["buckets"]["30"] == 0
+
+
+def test_snapshot_write_is_valid_json(tmp_path):
+    m = MetricsRegistry(clock=VirtualClock())
+    m.counter("runner.retries").inc(3)
+    m.gauge("runner.checkpoint_bytes").set(17)
+    path = m.write(str(tmp_path / "metrics.json"))
+    doc = json.loads(open(path).read())
+    assert doc["schema"] == telemetry.SNAPSHOT_SCHEMA
+    assert doc["metrics"]["counters"]["runner.retries"] == 3
+    assert doc["metrics"]["gauges"]["runner.checkpoint_bytes"] == 17
+
+
+def test_reset_clears_series():
+    m = MetricsRegistry(clock=VirtualClock())
+    m.counter("runner.retries").inc()
+    m.reset()
+    assert m.snapshot() == {"counters": {}, "gauges": {},
+                            "histograms": {}}
+
+
+def test_default_registry_is_process_wide_singleton():
+    assert default_registry() is default_registry()
+    assert isinstance(default_registry(), MetricsRegistry)
+
+
+def test_threaded_increments_all_land():
+    m = MetricsRegistry(clock=VirtualClock())
+
+    def work():
+        for _ in range(500):
+            m.counter("op.calls", op="t").inc()
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert m.snapshot()["counters"]["op.calls{op=t}"] == 2000
+
+
+# ------------------------------------------------- auto-instrumentation
+
+def _data():
+    return synthetic_counts(120, 60, n_clusters=2)
+
+
+def test_instrument_calls_records_per_op_and_backend():
+    m = MetricsRegistry(clock=VirtualClock())
+    with instrument_calls(m) as got:
+        assert got is m
+        out = apply("normalize.log1p", _data(), backend="cpu")
+    assert out is not None
+    snap = m.snapshot()["counters"]
+    assert snap["op.calls{backend=cpu,op=normalize.log1p}"] == 1
+    assert "op.errors{backend=cpu,op=normalize.log1p}" not in snap
+    h = m.snapshot()["histograms"]
+    assert h["op.duration_s{backend=cpu,op=normalize.log1p}"]["count"] == 1
+
+
+def test_instrument_calls_covers_pipeline_steps_and_uninstalls():
+    m = MetricsRegistry(clock=VirtualClock())
+    pipe = Pipeline([("qc.per_cell_metrics", {}),
+                     ("normalize.log1p", {})])
+    before = len(sct_registry._CALL_WRAPPERS)
+    with instrument_calls(m):
+        pipe.run(_data(), backend="cpu")
+    assert len(sct_registry._CALL_WRAPPERS) == before  # popped cleanly
+    snap = m.snapshot()["counters"]
+    assert snap["op.calls{backend=cpu,op=qc.per_cell_metrics}"] == 1
+    assert snap["op.calls{backend=cpu,op=normalize.log1p}"] == 1
+    # and calls AFTER the scope are no longer recorded
+    apply("normalize.log1p", _data(), backend="cpu")
+    assert m.snapshot()["counters"] == snap
+
+
+def test_instrumented_error_counted_and_reraised():
+    m = MetricsRegistry(clock=VirtualClock())
+    with instrument_calls(m):
+        with pytest.raises(TypeError):
+            apply("normalize.log1p", _data(), backend="cpu",
+                  bogus_param=1)
+    snap = m.snapshot()["counters"]
+    assert snap["op.errors{backend=cpu,op=normalize.log1p}"] == 1
+    assert snap["op.calls{backend=cpu,op=normalize.log1p}"] == 1
+
+
+def test_backend_override_labels_degraded_per_instrumentor():
+    """The override lives on the INSTRUMENTOR, not the registry: two
+    runs sharing the process-wide registry cannot cross-contaminate
+    each other's degrade labels."""
+    m = MetricsRegistry(clock=VirtualClock())
+    inst_a, inst_b = CallInstrumentor(m), CallInstrumentor(m)
+    a = inst_a.wrap("x.y", "cpu", lambda data: data)
+    b = inst_b.wrap("x.y", "cpu", lambda data: data)
+    a(1)
+    inst_a.backend_override = "degraded"
+    a(1)
+    b(1)  # B is NOT degraded — A's ruling must not leak
+    snap = m.snapshot()["counters"]
+    assert snap["op.calls{backend=cpu,op=x.y}"] == 2
+    assert snap["op.calls{backend=degraded,op=x.y}"] == 1
+
+
+# ------------------------------------------------------------ vocabulary
+
+def test_vocabulary_covers_runner_usage():
+    """Every event/metric literal the runner writes is a vocabulary
+    member — the runtime mirror of lint rule SCT009 (which checks the
+    same thing statically, against the same constants)."""
+    import ast
+    import inspect
+
+    import sctools_tpu.runner as runner_mod
+
+    tree = ast.parse(inspect.getsource(runner_mod))
+    used_events, used_metrics = set(), set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            continue
+        if f.attr == "write" and isinstance(f.value, ast.Attribute) \
+                and f.value.attr == "journal":
+            used_events.add(arg.value)
+        elif f.attr in ("counter", "gauge", "histogram", "timer"):
+            used_metrics.add(arg.value)
+    assert used_events and used_events <= EVENTS
+    assert used_metrics and used_metrics <= set(METRICS)

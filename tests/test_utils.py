@@ -314,3 +314,203 @@ def test_obs_vector_var_vector():
     # device data works too (getitem handles both residencies)
     dev = d.device_put()
     np.testing.assert_allclose(dev.obs_vector("b"), dense[:, 1])
+
+
+# ---------------------------------------------------------------------------
+# Cross-thread span collection + Perfetto export (the observability PR)
+# ---------------------------------------------------------------------------
+
+def test_worker_thread_spans_collected_and_reset_process_wide():
+    """Spans recorded on a worker thread are visible to all_spans()
+    and report(), and reset() clears them even though they live in
+    ANOTHER thread's local state (the bug this PR fixes)."""
+    import threading
+
+    from sctools_tpu.utils import trace
+
+    trace.reset()
+    done = threading.Event()
+
+    def work():
+        with trace.span("worker-root"):
+            with trace.span("worker-child"):
+                pass
+        done.set()
+
+    t = threading.Thread(target=work, name="span-worker")
+    t.start()
+    t.join()
+    assert done.is_set()
+    # thread-local view unchanged: the MAIN thread recorded nothing
+    assert trace.spans() == []
+    names = [s.name for s in trace.all_spans()]
+    assert names == ["worker-root"]
+    txt = trace.report()
+    assert "worker-root" in txt and "worker-child" in txt
+    assert "span-worker" not in txt  # one thread: no header noise
+    # calling-thread-only view stays available
+    assert "worker-root" not in trace.report(all_threads=False)
+    trace.reset()
+    assert trace.all_spans() == []
+
+
+def test_report_names_threads_when_more_than_one_recorded():
+    import threading
+
+    from sctools_tpu.utils import trace
+
+    trace.reset()
+    with trace.span("main-root"):
+        pass
+
+    def work():
+        with trace.span("other-root"):
+            pass
+
+    t = threading.Thread(target=work, name="other-thread")
+    t.start()
+    t.join()
+    txt = trace.report()
+    assert "main-root" in txt and "other-root" in txt
+    assert "other-thread" in txt  # >1 thread: headers appear
+    trace.reset()
+
+
+def test_cross_thread_opt_out():
+    import threading
+
+    from sctools_tpu.utils import trace
+
+    trace.reset()
+    trace.set_cross_thread(False)
+    try:
+        def work():
+            with trace.span("hidden-root"):
+                pass
+
+        t = threading.Thread(target=work, name="hidden-worker")
+        t.start()
+        t.join()
+        assert all(s.name != "hidden-root" for s in trace.all_spans())
+    finally:
+        trace.set_cross_thread(True)
+        trace.reset()
+
+
+def test_perfetto_export_valid_and_monotonic(tmp_path):
+    """trace.json is valid JSON whose ts/dur pairs nest consistently:
+    every child slice lies inside its parent's [ts, ts+dur] window,
+    and span ids round-trip into the args."""
+    import json as _json
+
+    from sctools_tpu.utils import trace
+
+    trace.reset()
+    with trace.span("outer", meta={"step": 0}) as outer:
+        with trace.span("mid") as mid:
+            with trace.span("leaf"):
+                pass
+        with trace.span("mid2"):
+            pass
+    path = trace.export_trace(str(tmp_path / "trace.json"))
+    doc = _json.loads(open(path).read())
+    assert isinstance(doc["traceEvents"], list)
+    slices = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert [e["name"] for e in slices] == ["outer", "mid", "leaf",
+                                           "mid2"]
+    by_name = {e["name"]: e for e in slices}
+    for child, parent in (("mid", "outer"), ("leaf", "mid"),
+                          ("mid2", "outer")):
+        c, p = by_name[child], by_name[parent]
+        assert c["ts"] >= p["ts"]
+        assert c["ts"] + c["dur"] <= p["ts"] + p["dur"]
+    assert all(e["dur"] >= 0 for e in slices)
+    assert by_name["outer"]["args"]["span_id"] == outer.id
+    assert by_name["outer"]["args"]["step"] == 0
+    assert by_name["mid"]["args"]["span_id"] == mid.id
+    # one metadata record names the recording thread
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert metas and metas[0]["name"] == "thread_name"
+    trace.reset()
+
+
+def test_export_append_merges_runs(tmp_path):
+    import json as _json
+
+    from sctools_tpu.utils import trace
+
+    trace.reset()
+    path = str(tmp_path / "trace.json")
+    with trace.span("run1"):
+        pass
+    trace.export_trace(path, trace.spans())
+    trace.reset()
+    with trace.span("run2"):
+        pass
+    trace.export_trace(path, trace.spans(), append=True)
+    doc = _json.loads(open(path).read())
+    slices = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert [e["name"] for e in slices] == ["run1", "run2"]
+    r1, r2 = slices
+    assert r2["ts"] >= r1["ts"] + r1["dur"]  # run2 shifted after run1
+    # thread metadata not duplicated
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert len(metas) == 1
+    trace.reset()
+
+
+def test_span_tree_serialization_roundtrip_and_graft():
+    """serialize_spans() → graft() reconstructs the tree under the
+    current span with FRESH ids (a child process's counter collides
+    with the parent's) and rebasies it onto this clock so the export
+    stays monotonically consistent."""
+    from sctools_tpu.utils import trace
+
+    trace.reset()
+    with trace.span("child-root"):
+        with trace.span("child-leaf"):
+            pass
+    payload = trace.serialize_spans()
+    orig_ids = {payload[0]["id"]}
+    trace.reset()
+
+    with trace.span("parent-step") as parent:
+        grafted = trace.graft(payload)
+    assert [c.name for c in parent.children] == ["child-root"]
+    root = parent.children[0]
+    assert [c.name for c in root.children] == ["child-leaf"]
+    new_ids = {s.id for _, s in root.flat()}
+    assert all(i > 0 for i in new_ids)
+    assert root.meta["child_span_id"] in orig_ids
+    assert grafted[0] is root
+    # rebased: the grafted tree ends inside the parent span's window
+    assert parent.start <= root.start
+    assert root.start + root.duration <= parent.start + parent.duration
+    trace.reset()
+
+
+def test_sequential_worker_threads_all_collected():
+    """CPython reuses thread idents after a join; the collector keys
+    by thread OBJECT so a later worker can never evict a dead
+    worker's recorded spans (code-review regression)."""
+    import threading
+
+    from sctools_tpu.utils import trace
+
+    trace.reset()
+    for i in range(3):  # sequential: idents are commonly reused
+        t = threading.Thread(name=f"w{i}", target=_record_one,
+                             args=(f"root-{i}",))
+        t.start()
+        t.join()
+    names = sorted(s.name for s in trace.all_spans())
+    assert names == ["root-0", "root-1", "root-2"]
+    trace.reset()
+    assert trace.all_spans() == []
+
+
+def _record_one(name):
+    from sctools_tpu.utils import trace
+
+    with trace.span(name):
+        pass

@@ -10,9 +10,9 @@
 # Stages:
 #   1. sctlint        python -m tools.sctlint sctools_tpu
 #                     (AST rules SCT001-SCT006 + SCT008 bare-clock +
-#                      parity SCT000 + repo-hygiene SCT007;
-#                      suppressions + baseline honoured, stale
-#                      baseline entries fail)
+#                      SCT009 telemetry vocabulary + parity SCT000 +
+#                      repo-hygiene SCT007; suppressions + baseline
+#                      honoured, stale baseline entries fail)
 #   2. tracked-bytecode guard (belt-and-braces duplicate of SCT007,
 #                     kept shell-side so the gate still catches it if
 #                     sctlint itself is broken)
@@ -20,7 +20,11 @@
 #                     resilience stack must schedule through the
 #                     injectable clock, utils/vclock.py, so deadline/
 #                     breaker/backoff tests never really sleep)
-#   4. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
+#   4. sctreport      python -m tools.sctreport on the committed
+#                     synthetic run fixture (journal + spans +
+#                     metrics); a non-zero exit OR an empty report
+#                     fails — the post-mortem tool must never rot
+#   5. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
 
 set -u -o pipefail
 
@@ -33,7 +37,7 @@ FAST=0
 fail=0
 stage() { printf '\n== %s ==\n' "$1"; }
 
-stage "sctlint (static analysis, rules SCT000-SCT008)"
+stage "sctlint (static analysis, rules SCT000-SCT009)"
 if ! JAX_PLATFORMS=cpu python -m tools.sctlint sctools_tpu; then
     fail=1
 fi
@@ -53,7 +57,8 @@ bare=$(grep -nE '\btime\.(sleep|monotonic)\b' \
         sctools_tpu/runner.py \
         sctools_tpu/utils/failsafe.py \
         sctools_tpu/utils/checkpoint.py \
-        sctools_tpu/utils/chaos.py 2>/dev/null \
+        sctools_tpu/utils/chaos.py \
+        sctools_tpu/utils/telemetry.py 2>/dev/null \
         | grep -v 'sctlint: disable=SCT008' || true)
 if [ -n "$bare" ]; then
     echo "bare time.sleep/time.monotonic in resilience modules" \
@@ -62,6 +67,22 @@ if [ -n "$bare" ]; then
     fail=1
 else
     echo "OK: deadlines/backoff/cooldowns go through the injectable clock"
+fi
+
+stage "sctreport (run-report CLI on the committed run fixture)"
+# jax-free by design, so no JAX_PLATFORMS needed — and importing the
+# library here would itself be a regression worth failing on
+if report=$(python -m tools.sctreport tests/fixtures/sctreport_run); then
+    if [ -z "$report" ]; then
+        echo "sctreport exited 0 but produced an EMPTY report"
+        fail=1
+    else
+        echo "$report" | sed -n '1,4p'
+        echo "OK: sctreport produced a $(printf '%s\n' "$report" | wc -l)-line report"
+    fi
+else
+    echo "sctreport FAILED on the committed fixture (rc=$?)"
+    fail=1
 fi
 
 if [ "$FAST" = "1" ]; then

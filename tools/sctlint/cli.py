@@ -70,7 +70,7 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.sctlint",
         description="AST-based JAX correctness linter for sctools-tpu "
-                    "(rules SCT000-SCT007; see docs/ARCHITECTURE.md "
+                    "(rules SCT000-SCT009; see docs/ARCHITECTURE.md "
                     "'Static analysis')")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to lint (default: sctools_tpu)")

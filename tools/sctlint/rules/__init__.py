@@ -5,3 +5,4 @@ from . import excepts  # noqa: F401   SCT005
 from . import registry_conv  # noqa: F401  SCT006
 from . import project  # noqa: F401   SCT000, SCT007
 from . import clockdiscipline  # noqa: F401  SCT008
+from . import vocab  # noqa: F401     SCT009

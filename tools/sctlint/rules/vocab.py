@@ -1,0 +1,126 @@
+"""SCT009 — journal events and metric names come from the central
+vocabulary.
+
+The run journal, the metrics snapshot and the exported span trace are
+one joined observability surface; ``tools/sctreport.py`` and every
+dashboard that follows read them by NAME.  A typo'd
+``journal.write("quarntine", ...)`` or ``counter("runner.retrys")``
+doesn't crash anything — it silently forks a series that no report
+ever finds, which is exactly the failure mode a vocabulary kills at
+lint time.  The vocabulary lives in
+``sctools_tpu/utils/telemetry.py`` (``EVENTS`` / ``METRICS``) and is
+read here by AST, not import — sctlint stays a linter that executes
+no library code (SCT000's registry import is the one exception).
+
+Flagged:
+
+* ``<anything>.journal.write(<event>, ...)`` / ``journal.write(...)``
+  where the event is not a string literal, or is a literal missing
+  from ``EVENTS``;
+* ``.counter(name)`` / ``.gauge(name)`` / ``.histogram(name)`` /
+  ``.timer(name)`` where a LITERAL first argument is missing from
+  ``METRICS`` (non-literal metric names are left alone — e.g.
+  ``np.histogram(x, bins)`` shares the attribute name).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from ..core import FileContext, repo_root, rule
+
+_METRIC_METHODS = frozenset({"counter", "gauge", "histogram", "timer"})
+
+_VOCAB: dict[str, tuple[frozenset, frozenset] | None] = {}
+
+
+def _load_vocab() -> tuple[frozenset, frozenset] | None:
+    """AST-extract ``EVENTS`` / ``METRICS`` from telemetry.py (cached
+    per process).  Returns None — rule disabled — if the module or
+    either constant cannot be found, rather than flagging every call
+    site over a broken checkout."""
+    path = os.path.join(repo_root(), "sctools_tpu", "utils",
+                        "telemetry.py")
+    if path in _VOCAB:
+        return _VOCAB[path]
+    out = None
+    try:
+        tree = ast.parse(open(path, encoding="utf-8").read())
+    except (OSError, SyntaxError):
+        _VOCAB[path] = None
+        return None
+    events = metrics = None
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        name, val = node.targets[0].id, node.value
+        if name == "EVENTS" and isinstance(val, ast.Call) \
+                and isinstance(val.args[0] if val.args else None,
+                               (ast.Set, ast.List, ast.Tuple)):
+            events = frozenset(
+                e.value for e in val.args[0].elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, str))
+        elif name == "METRICS" and isinstance(val, ast.Dict):
+            metrics = frozenset(
+                k.value for k in val.keys
+                if isinstance(k, ast.Constant)
+                and isinstance(k.value, str))
+    if events and metrics:
+        out = (events, metrics)
+    _VOCAB[path] = out
+    return out
+
+
+def _is_journal_write(call: ast.Call) -> bool:
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "write"):
+        return False
+    recv = f.value
+    return (isinstance(recv, ast.Name) and recv.id == "journal") or \
+        (isinstance(recv, ast.Attribute) and recv.attr == "journal")
+
+
+@rule("SCT009", "telemetry-vocabulary",
+      "journal event / metric names must be literals from the central "
+      "vocabulary (sctools_tpu/utils/telemetry.py EVENTS / METRICS)")
+def check_vocabulary(ctx: FileContext):
+    vocab = _load_vocab()
+    if vocab is None:
+        return
+    events, metrics = vocab
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_journal_write(node):
+            arg = node.args[0] if node.args else None
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                yield ctx.violation(
+                    "SCT009", node,
+                    "journal.write() event must be a string LITERAL "
+                    "from telemetry.EVENTS — a computed name can't be "
+                    "checked against the vocabulary, and sctreport "
+                    "reads events by name")
+            elif arg.value not in events:
+                yield ctx.violation(
+                    "SCT009", node,
+                    f"journal event {arg.value!r} is not in "
+                    f"telemetry.EVENTS — a typo'd event silently "
+                    f"falls out of every sctreport; add it to the "
+                    f"vocabulary (and the docs table) if it is new")
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _METRIC_METHODS:
+            arg = node.args[0] if node.args else None
+            if isinstance(arg, ast.Constant) \
+                    and isinstance(arg.value, str) \
+                    and arg.value not in metrics:
+                yield ctx.violation(
+                    "SCT009", node,
+                    f"metric name {arg.value!r} is not in "
+                    f"telemetry.METRICS — a typo'd name forks a "
+                    f"series no report reads; add it to the "
+                    f"vocabulary (with its one-line meaning) if it "
+                    f"is new")

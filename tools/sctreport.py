@@ -1,0 +1,350 @@
+"""sctreport — one human-readable report for one run directory.
+
+``python -m tools.sctreport <run_dir>`` merges the three artifacts a
+``ResilientRunner`` run leaves behind (``journal.jsonl`` — required;
+``metrics.json`` and the Perfetto-loadable ``trace.json`` — optional,
+written at run end) into a single report: the per-step timeline, the
+attempt/outcome table, every retry/degrade/breaker/quarantine ruling,
+the top-N slowest spans, and the metrics snapshot.  The join key
+throughout is the trace-span id each journal ``attempt`` record
+carries (docs/ARCHITECTURE.md "Observability" has the join model).
+
+Deliberately stdlib-only and jax-free: post-mortems happen on
+machines (and in CI stages — tools/run_checks.sh) where importing the
+library, let alone initialising a backend, is neither possible nor
+wanted.
+
+Exit codes: 0 report written; 1 missing/empty/unreadable journal
+(an empty report is a failure — CI treats silence as breakage);
+2 bad usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+TOP_N_DEFAULT = 10
+
+
+# ---------------------------------------------------------------------------
+# Artifact loading
+# ---------------------------------------------------------------------------
+
+def load_journal(path: str) -> tuple[list[dict], int]:
+    """Parse JSONL events; malformed lines are counted, not fatal —
+    a journal truncated by the very crash being diagnosed must still
+    produce a report."""
+    events, bad = [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                bad += 1
+                continue
+            if isinstance(rec, dict) and "event" in rec:
+                events.append(rec)
+            else:
+                bad += 1
+    return events, bad
+
+
+def load_optional_json(path: str):
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"sctreport: warning: unreadable {path}: {e}",
+              file=sys.stderr)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Journal digestion
+# ---------------------------------------------------------------------------
+
+def split_runs(events: list[dict]) -> list[list[dict]]:
+    """One journal file may hold several runs (crash → resume appends
+    to the same file); split on ``run_start``."""
+    runs: list[list[dict]] = []
+    for e in events:
+        if e["event"] == "run_start" or not runs:
+            runs.append([])
+        runs[-1].append(e)
+    return runs
+
+
+_TERMINAL = {"run_completed": "completed", "run_failed": "FAILED",
+             "run_aborted": "ABORTED"}
+
+
+def digest_run(run: list[dict]) -> dict:
+    """Fold one run's events into the report's working form."""
+    d = {
+        "n_steps": None, "backend": None, "input_digest": None,
+        "steps": {},          # index -> {name, attempts: [...], status}
+        "outcome": "INTERRUPTED (no terminal event)",
+        "degraded": False, "resumed_from": None,
+        "retries": [], "deadlines": [], "fallbacks": [],
+        "breaker": [], "quarantines": [], "health_checks": [],
+        "resume_notes": [],
+    }
+    steps = d["steps"]
+
+    def step(e):
+        return steps.setdefault(
+            e.get("step"), {"name": e.get("name"), "attempts": [],
+                            "status": "pending", "checkpointed": False})
+
+    for e in run:
+        ev = e["event"]
+        if ev == "run_start":
+            d["n_steps"] = e.get("n_steps")
+            d["backend"] = e.get("backend")
+            d["input_digest"] = e.get("input_digest")
+            for s in e.get("steps", ()):
+                steps[s["index"]] = {"name": s["name"], "attempts": [],
+                                     "status": "pending",
+                                     "checkpointed": False}
+        elif ev == "attempt":
+            s = step(e)
+            s["name"] = e.get("name", s["name"])
+            s["attempts"].append(e)
+            s["status"] = ("completed" if e.get("status") == "ok"
+                           else "failing")
+        elif ev == "checkpoint":
+            step(e)["checkpointed"] = True
+        elif ev == "backoff":
+            d["retries"].append(e)
+        elif ev == "deadline":
+            d["deadlines"].append(e)
+        elif ev == "fallback":
+            d["fallbacks"].append(e)
+            d["degraded"] = True
+        elif ev.startswith("breaker_"):
+            d["breaker"].append(e)
+        elif ev == "quarantine":
+            d["quarantines"].append(e)
+        elif ev == "health_check":
+            d["health_checks"].append(e)
+        elif ev == "resume":
+            d["resumed_from"] = e.get("from_step")
+            for i in steps:
+                if i is not None and i <= e.get("from_step", -1):
+                    steps[i]["status"] = "resumed"
+        elif ev in ("resume_unverified_input", "resume_place_failed"):
+            d["resume_notes"].append(e)
+        elif ev in _TERMINAL:
+            d["outcome"] = _TERMINAL[ev]
+            if e.get("degraded"):
+                d["degraded"] = True
+    return d
+
+
+# ---------------------------------------------------------------------------
+# Trace + metrics digestion
+# ---------------------------------------------------------------------------
+
+def digest_trace(doc) -> dict | None:
+    if not isinstance(doc, dict) or \
+            not isinstance(doc.get("traceEvents"), list):
+        return None
+    slices = [e for e in doc["traceEvents"]
+              if isinstance(e, dict) and e.get("ph") == "X"]
+    return {
+        "n_events": len(slices),
+        "span_ids": {e.get("args", {}).get("span_id") for e in slices}
+        - {None},
+        "slowest": sorted(slices, key=lambda e: -e.get("dur", 0.0)),
+    }
+
+
+def fmt_wall(seconds: float) -> str:
+    return f"{seconds:.3f}s" if seconds < 120 else f"{seconds / 60:.1f}m"
+
+
+# ---------------------------------------------------------------------------
+# Report rendering
+# ---------------------------------------------------------------------------
+
+def render(run_dir: str, runs: list[dict], trace_d: dict | None,
+           metrics: dict | None, bad_lines: int,
+           top: int = TOP_N_DEFAULT) -> str:
+    L: list[str] = []
+    add = L.append
+    add(f"== sctreport: {run_dir} ==")
+    if bad_lines:
+        add(f"(!) {bad_lines} malformed journal line(s) skipped")
+
+    add(f"runs in journal: {len(runs)}")
+    for ri, r in enumerate(runs):
+        extra = []
+        if r["degraded"]:
+            extra.append("DEGRADED")
+        if r["resumed_from"] is not None:
+            extra.append(f"resumed from step {r['resumed_from']}")
+        add(f"  run {ri}: {r['outcome']}"
+            f" backend={r['backend'] or '-'}"
+            + (f"  [{', '.join(extra)}]" if extra else ""))
+
+    last = runs[-1]
+    add("")
+    add("-- per-step timeline (last run) --")
+    for i in sorted(k for k in last["steps"] if k is not None):
+        s = last["steps"][i]
+        atts = s["attempts"]
+        wall = sum(a.get("wall_s", 0.0) for a in atts)
+        backends = ",".join(dict.fromkeys(a.get("backend", "?")
+                                          for a in atts)) or "-"
+        add(f"  [{i:02d}] {s['name'] or '?':<28s} {s['status']:<10s}"
+            f" attempts={len(atts)} backend={backends}"
+            f" wall={fmt_wall(wall)}"
+            + ("  ckpt" if s["checkpointed"] else ""))
+
+    add("")
+    add("-- attempts (all runs) --")
+    add(f"  {'run':>3s} {'step':>4s} {'op':<28s} {'att':>3s} "
+        f"{'backend':<8s} {'status':<6s} {'classified':<13s} "
+        f"{'wall':>9s} {'span':>5s}")
+    for ri, r in enumerate(runs):
+        for i in sorted(k for k in r["steps"] if k is not None):
+            for a in r["steps"][i]["attempts"]:
+                add(f"  {ri:3d} {i:4d} {a.get('name', '?'):<28s} "
+                    f"{a.get('attempt', 0):3d} "
+                    f"{a.get('backend', '?'):<8s} "
+                    f"{a.get('status', '?'):<6s} "
+                    f"{a.get('classified') or '-':<13s} "
+                    f"{fmt_wall(a.get('wall_s', 0.0)):>9s} "
+                    f"{a.get('span_id', 0):5d}"
+                    + (f"  {a['error']}" if a.get("error") else ""))
+
+    add("")
+    add("-- recovery rulings --")
+    n_ret = sum(len(r["retries"]) for r in runs)
+    n_dl = sum(len(r["deadlines"]) for r in runs)
+    add(f"  retries (backoff): {n_ret}    deadline overruns: {n_dl}")
+    for ri, r in enumerate(runs):
+        for e in r["deadlines"]:
+            add(f"  run {ri}: DEADLINE step {e.get('step')} "
+                f"({e.get('name')}) overran {e.get('budget_s')}s "
+                f"budget on attempt {e.get('attempt')}")
+        for e in r["breaker"]:
+            add(f"  run {ri}: BREAKER {e['event'].split('_', 1)[1]}"
+                f" at step {e.get('step')}"
+                + (f" (failures_in_window="
+                   f"{e.get('failures_in_window')})"
+                   if "failures_in_window" in e else ""))
+        for e in r["fallbacks"]:
+            add(f"  run {ri}: DEGRADE at {e.get('where')} -> "
+                f"backend={e.get('backend')}"
+                f" reason={e.get('reason', 'probe')}")
+        for e in r["quarantines"]:
+            add(f"  run {ri}: QUARANTINE step {e.get('step')}: "
+                f"{e.get('reason')} -> {e.get('path')}")
+        if r["resumed_from"] is not None:
+            add(f"  run {ri}: RESUME from step {r['resumed_from']}")
+        for e in r["resume_notes"]:
+            add(f"  run {ri}: note: {e['event']}")
+
+    add("")
+    add(f"-- top {top} slowest spans --")
+    if trace_d is None:
+        add("  (no trace.json in this run dir)")
+    else:
+        for e in trace_d["slowest"][:top]:
+            sid = e.get("args", {}).get("span_id", "-")
+            add(f"  {e.get('name', '?'):<40s} "
+                f"{e.get('dur', 0.0) / 1e3:10.2f} ms  span={sid}")
+        journal_ids = {a.get("span_id") for r in runs
+                       for s in r["steps"].values()
+                       for a in s["attempts"]} - {None, 0}
+        joined = journal_ids & trace_d["span_ids"]
+        add(f"  span-id join: {len(joined)}/{len(journal_ids)} journal"
+            f" attempt span(s) present in trace.json"
+            f" ({trace_d['n_events']} trace events)")
+
+    add("")
+    add("-- metrics snapshot --")
+    if metrics is None:
+        add("  (no metrics.json in this run dir)")
+    else:
+        m = metrics.get("metrics", metrics)
+        for k, v in sorted(m.get("counters", {}).items()):
+            add(f"  {k:<56s} {v:g}")
+        for k, h in sorted(m.get("histograms", {}).items()):
+            add(f"  {k:<56s} count={h.get('count')} "
+                f"sum={h.get('sum')} max={h.get('max')}")
+    return "\n".join(L)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.sctreport",
+        description="Merge a run directory's journal.jsonl + "
+                    "trace.json + metrics.json into one run report "
+                    "(docs/GUIDE.md 'Reading a run report')")
+    ap.add_argument("run_dir", help="directory holding journal.jsonl "
+                                    "(a ResilientRunner checkpoint_dir)")
+    ap.add_argument("--top", type=int, default=TOP_N_DEFAULT,
+                    metavar="N", help="slowest spans to list")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the merged machine-readable document "
+                         "instead of text")
+    args = ap.parse_args(argv)
+
+    jpath = os.path.join(args.run_dir, "journal.jsonl")
+    if not os.path.isfile(jpath):
+        print(f"sctreport: no journal.jsonl in {args.run_dir!r} — "
+              "not a run directory?", file=sys.stderr)
+        return 1
+    try:
+        events, bad = load_journal(jpath)
+    except OSError as e:
+        print(f"sctreport: cannot read {jpath}: {e}", file=sys.stderr)
+        return 1
+    if not events:
+        print(f"sctreport: {jpath} holds no journal events — "
+              "an empty report is a failure", file=sys.stderr)
+        return 1
+
+    runs = [digest_run(r) for r in split_runs(events)]
+    trace_d = digest_trace(
+        load_optional_json(os.path.join(args.run_dir, "trace.json")))
+    metrics = load_optional_json(
+        os.path.join(args.run_dir, "metrics.json"))
+
+    if args.json:
+        doc = {"run_dir": args.run_dir, "runs": [
+            {k: (v if k != "steps" else
+                 {str(i): s for i, s in v.items() if i is not None})
+             for k, v in r.items()} for r in runs],
+            "trace": (None if trace_d is None else
+                      {"n_events": trace_d["n_events"],
+                       "span_ids": sorted(trace_d["span_ids"])}),
+            "metrics": metrics, "malformed_lines": bad}
+        json.dump(doc, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+        return 0
+    text = render(args.run_dir, runs, trace_d, metrics, bad,
+                  top=args.top)
+    if not text.strip():
+        print("sctreport: rendered an empty report", file=sys.stderr)
+        return 1
+    print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
