@@ -1249,6 +1249,130 @@ def run_config4(budget_s: float, measured_mfu: float | None = None):
 
 
 # ----------------------------------------------------------------------
+# fusion: fused-vs-unfused dispatch wall + prefetch overlap efficiency
+# ----------------------------------------------------------------------
+
+
+def run_fusion(jax, n_cells=None, n_genes=None, reps=None):
+    """Fused execution (plan.fused_pipeline) vs the step-by-step
+    dispatch loop on a configs[3]-shaped preprocessing chain
+    (normalize → log1p → seurat_v3 HVG scoring → scale — the per-shard
+    work of the streaming atlas pipeline), on synthetic counts sized
+    for the current box (env ``SCTOOLS_BENCH_FUSION_CELLS/GENES``; CPU
+    CI runs the small default, real chips can scale up).  Also runs a
+    double-buffered prefetch stream over the same synthetic matrix and
+    reports OVERLAP EFFICIENCY: the fraction of prefetch-worker wall
+    (decode + pack + device_put) hidden behind consumer compute
+    (``stream.overlap_s`` / (overlap + stall)).
+
+    Returns a detail dict with ``speedup_vs_unfused`` (the acceptance
+    gate: >= 1.5x on the CPU CI box) and second-run plan-cache
+    counters proving zero retraces."""
+    from sctools_tpu.data.stream import ShardSource
+    from sctools_tpu.data.synthetic import synthetic_counts
+    from sctools_tpu.plan import clear_plan_cache, fused_pipeline
+    from sctools_tpu.registry import Pipeline
+    from sctools_tpu.utils.telemetry import MetricsRegistry
+
+    n = int(n_cells or os.environ.get("SCTOOLS_BENCH_FUSION_CELLS",
+                                      2048))
+    g = int(n_genes or os.environ.get("SCTOOLS_BENCH_FUSION_GENES",
+                                      512))
+    reps = int(reps or os.environ.get("SCTOOLS_BENCH_FUSION_REPS", 7))
+    host = synthetic_counts(n, g, density=0.05, n_clusters=8, seed=0)
+    d = host.device_put()
+    chain = [("normalize.library_size", {"target_sum": 1e4}),
+             ("normalize.log1p", {}),
+             ("hvg.select", {"n_top": 2000, "flavor": "seurat_v3"}),
+             ("normalize.scale", {"max_value": 10.0})]
+    pipe = Pipeline(chain, backend="tpu")
+
+    def timed(p):
+        out = p.run(d)          # warm compiles / first-call trace
+        _hard_sync(out.X)
+        walls = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = p.run(d)
+            _hard_sync(out.X)   # steady-state rule: fetch-synced
+            walls.append(time.perf_counter() - t0)
+        return float(np.median(walls)), out
+
+    unfused_s, out_u = timed(pipe)
+    clear_plan_cache()
+    m = MetricsRegistry()
+    fused_s, out_f = timed(fused_pipeline(pipe, metrics=m))
+    counters = m.snapshot_compact()
+    # parity guard: a fused win over wrong results is not a win
+    err = float(np.max(np.abs(np.asarray(out_u.X, np.float64)
+                              - np.asarray(out_f.X, np.float64))))
+
+    # prefetch overlap efficiency: stream the same matrix as shards
+    # (CSR slice + pack + device_put in the worker), one fetched
+    # reduction per shard as the consumer's "compute"
+    src = ShardSource.from_scipy(host.X, shard_rows=256)
+    t0 = time.perf_counter()
+    sc = _fusion_stream_counters(src)
+    stream_s = time.perf_counter() - t0
+    overlap = sc.get("stream.overlap_s", 0.0)
+    stall = sc.get("stream.stall_s", 0.0)
+    eff = overlap / max(overlap + stall, 1e-9)
+
+    return {
+        "n_cells": n, "n_genes": g, "reps": reps,
+        "unfused_s": round(unfused_s, 4), "fused_s": round(fused_s, 4),
+        "speedup_vs_unfused": round(unfused_s / max(fused_s, 1e-9), 3),
+        "fused_max_abs_err": err,
+        "plan_counters": {k: v for k, v in counters.items()
+                          if k.startswith("plan.")},
+        "stream_wall_s": round(stream_s, 4),
+        "stream_overlap_s": round(overlap, 4),
+        "stream_stall_s": round(stall, 4),
+        "overlap_efficiency": round(eff, 4),
+    }
+
+
+def _fusion_stream_counters(src):
+    """One double-buffered pass over ``src`` with worker-side
+    ``device_put``, recording into a PRIVATE registry so the
+    efficiency number is this pass's alone (the process default
+    accumulates across the whole bench).  Returns the counter
+    snapshot (``stream.overlap_s`` / ``stream.stall_s``)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from sctools_tpu.data import stream as _stream_mod
+    from sctools_tpu.utils.telemetry import MetricsRegistry
+
+    m = MetricsRegistry()
+    plain = dataclasses.replace(src, prefetch=False)
+
+    def host_shards():
+        # re-slice the host CSR like iter_from would, WITHOUT the
+        # device move — that is what prepare= does in the worker
+        yield from plain.factory()
+
+    for shard in _stream_mod._prefetch_iter(
+            host_shards, depth=2,
+            prepare=lambda s: s.device_put(plain.sharding), metrics=m):
+        float(jnp.sum(shard.data))  # consumer compute + per-shard drain
+    return m.snapshot_compact()
+
+
+def phase_fusion():
+    jax, backend, on_tpu = _child_acquire("fusion")
+    try:
+        det = run_fusion(jax)
+        stage("fusion", **{k: v for k, v in det.items()
+                           if not isinstance(v, dict)})
+        flush_result(fusion=det, backend=backend)
+    except Exception as e:
+        stage("fusion.error", error=repr(e)[:300])
+        flush_result(fusion={"error": repr(e)[:300]}, backend=backend)
+
+
+# ----------------------------------------------------------------------
 # orchestrator
 # ----------------------------------------------------------------------
 
@@ -1311,7 +1435,8 @@ def main():
             global _WRITE_STAGE_FILE
             _WRITE_STAGE_FILE = False
         {"small": phase_small, "kernel": phase_kernel,
-         "atlas": phase_atlas, "stream_io": phase_stream_io}[args.phase]()
+         "atlas": phase_atlas, "stream_io": phase_stream_io,
+         "fusion": phase_fusion}[args.phase]()
         return 0
 
     stage("start", budget_s=BUDGET_S, stall_s=STALL_S,
@@ -1353,6 +1478,16 @@ def main():
             if key in res:
                 detail[key] = res[key]
         detail["phase_small"] = res.get("_phase")
+
+    if args.config is None and not tpu_dead and remaining() > 120:
+        # cheap, high-information: the dispatch-tax measurement the
+        # plan layer exists to win — runs before the fragile
+        # large-scale phases for the same reason the kernel sweep does
+        res = run_phase("fusion", min(240.0, remaining() - 60))
+        note_tpu(res)
+        if "fusion" in res:
+            detail["fusion"] = res["fusion"]
+        detail["phase_fusion"] = res.get("_phase")
 
     atlas_route_env = {}
     if args.config is None and not tpu_dead and remaining() > 150:

@@ -28,13 +28,13 @@ def main():
     rng = np.random.default_rng(0)
 
     # --- 1. two runs from one biology, different depth -------------
-    full = synthetic_counts(2400, 3000, density=0.08, n_clusters=4,
+    full = synthetic_counts(1500, 1800, density=0.08, n_clusters=4,
                             seed=0)
     X = full.X.tocsr()
     truth = np.asarray(full.obs["cluster_true"])
-    runA = full.with_X(X[:1000])
-    runB = full.with_X((X[1000:2000] * 2.0).astype(np.float32))  # 2x depth
-    query = full.with_X(X[2000:])
+    runA = full.with_X(X[:600])
+    runB = full.with_X((X[600:1200] * 2.0).astype(np.float32))  # 2x depth
+    query = full.with_X(X[1200:])
     merged = sct.concat([runA, runB], label="sample",
                         keys=["runA", "runB"])
     print(f"merged: {merged.n_cells} cells x {merged.n_genes} genes")
@@ -48,9 +48,9 @@ def main():
         ("util.snapshot_layer", {"layer": "counts"}),
         ("normalize.library_size", {"target_sum": 1e4}),
         ("normalize.log1p", {}),
-        ("hvg.select", {"n_top": 1000, "subset": True}),
+        ("hvg.select", {"n_top": 600, "subset": True}),
     ]).run(merged.device_put(), backend="tpu")
-    ds = sct.apply("pca.randomized", ds, backend="tpu", n_components=30)
+    ds = sct.apply("pca.randomized", ds, backend="tpu", n_components=20)
 
     # --- 3. integrate three ways -----------------------------------
     ds = sct.apply("integrate.harmony", ds, backend="tpu",
@@ -115,7 +115,7 @@ def main():
     counts = host_atlas.layers["counts"]
     mds = sct.apply("model.scvi",
                     host_atlas.with_X(counts), backend="tpu",
-                    n_latent=8, n_hidden=64, epochs=30,
+                    n_latent=8, n_hidden=64, epochs=15,
                     batch_size=256, batch_key="sample", seed=0)
     h = np.asarray(mds.uns["scvi_elbo_history"])
     print(f"scvi: latent {mds.obsm['X_scvi'].shape}, "
@@ -123,7 +123,7 @@ def main():
 
     # --- 6. Wishbone bifurcation on the atlas ----------------------
     wb = sct.apply("wishbone.run", ds, backend="tpu", start_cell=0,
-                   n_waypoints=60)
+                   n_waypoints=40)
     tau = np.asarray(wb.obs["wishbone_trajectory"])
     br = np.asarray(wb.obs["wishbone_branch"])
     print(f"wishbone: trajectory range [0, {tau.max():.2f}], "

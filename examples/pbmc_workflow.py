@@ -16,7 +16,10 @@ from sctools_tpu.data.synthetic import synthetic_counts
 
 
 def main():
-    ds = synthetic_counts(3000, 8000, density=0.05, n_clusters=5,
+    # sized to document the workflow, not to benchmark it: every op
+    # below scales past this shape unchanged (bench.py owns the
+    # at-scale numbers)
+    ds = synthetic_counts(1500, 4000, density=0.05, n_clusters=5,
                           mito_frac=0.02, seed=0)
 
     # QC + filtering happen on raw counts
@@ -31,15 +34,15 @@ def main():
     out = sct.Pipeline([
         ("normalize.library_size", {"target_sum": 1e4}),
         ("normalize.log1p", {}),
-        ("hvg.select", {"n_top": 2000, "subset": True}),
-        ("pca.randomized", {"n_components": 50}),
-        ("neighbors.knn", {"k": 15, "metric": "cosine", "refine": 64,
+        ("hvg.select", {"n_top": 1000, "subset": True}),
+        ("pca.randomized", {"n_components": 30}),
+        ("neighbors.knn", {"k": 15, "metric": "cosine", "refine": 32,
                            "exclude_self": True}),
         ("graph.connectivities", {}),
         ("cluster.leiden", {}),
         ("graph.paga", {}),
         ("embed.umap", {}),
-        ("embed.tsne", {"n_iter": 300}),
+        ("embed.tsne", {"n_iter": 150}),
         ("de.rank_genes_groups", {"groupby": "leiden"}),
         ("dpt.pseudotime", {}),
     ]).run(ds, backend="tpu")

@@ -38,7 +38,7 @@ def main(backend: str = "tpu"):
 
     from sctools_tpu.data.synthetic import synthetic_counts
 
-    d = synthetic_counts(2000, 3000, density=0.06, n_clusters=5,
+    d = synthetic_counts(1200, 2000, density=0.06, n_clusters=5,
                         mito_frac=0.02, seed=0)
     d = d.var_names_make_unique()  # the post-read anndata staple
     if backend == "tpu":
@@ -50,11 +50,11 @@ def main(backend: str = "tpu"):
     d = sct.pp.normalize_total(d, backend=backend, target_sum=1e4)
     d = sct.pp.log1p(d, backend=backend)
     d = sct.pp.highly_variable_genes(d, backend=backend,
-                                     n_top_genes=1500, subset=True)
-    d = sct.pp.pca(d, backend=backend, n_comps=50)
+                                     n_top_genes=800, subset=True)
+    d = sct.pp.pca(d, backend=backend, n_comps=30)
     d = sct.pp.neighbors(d, backend=backend, n_neighbors=15)
     d = sct.tl.leiden(d, backend=backend)
-    d = sct.tl.umap(d, backend=backend, n_epochs=100)
+    d = sct.tl.umap(d, backend=backend, n_epochs=60)
     d = sct.tl.rank_genes_groups(d, backend=backend, groupby="leiden",
                                  pts=True)
 

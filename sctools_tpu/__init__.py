@@ -34,6 +34,7 @@ from .data.concat import concat
 from .data.io import (from_dense, from_scipy, read, read_10x_h5,
                       read_10x_mtx, read_csv, read_h5ad, read_loom,
                       read_mtx, read_text, write_h5ad, write_loom)
+from .plan import describe_plan, fused_pipeline
 from .recipes import recipe_pipeline, run_recipe
 from .registry import Pipeline, Transform, apply, backends, names, register
 from .runner import ResilientRunner, RetryPolicy
@@ -76,4 +77,5 @@ __all__ = [
     "from_scipy", "from_dense",
     "pp", "tl", "experimental", "external", "pl", "datasets", "queries",
     "ResilientRunner", "RetryPolicy", "recipe_pipeline", "run_recipe",
+    "fused_pipeline", "describe_plan",
 ]

@@ -48,7 +48,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import config, round_up
+from ..utils import telemetry
 from ..utils.sync import hard_sync
+from ..utils.vclock import SYSTEM_CLOCK
 from .sparse import SparseCells, segment_reduce, spmm, spmm_t
 
 
@@ -57,20 +59,38 @@ from .sparse import SparseCells, segment_reduce, spmm, spmm_t
 # ----------------------------------------------------------------------
 
 
-def _prefetch_iter(make_gen, depth: int = 1):
+def _prefetch_iter(make_gen, depth: int = 2, prepare=None, clock=None,
+                   metrics=None):
     """Run a generator in a daemon worker thread, handing items over a
-    bounded queue — the NEXT shard's host work (h5 read + native pack)
-    overlaps the CURRENT shard's device compute even when
+    bounded queue (``depth=2``: a DOUBLE-BUFFERED shard pipeline — the
+    worker keeps shard N+1 fully prepared while the consumer computes
+    on shard N, with one more slot so the worker never idles on the
+    handoff).  ``prepare`` runs IN THE WORKER on every produced item —
+    ``ShardSource`` passes its ``device_put``, so the native-packer
+    CSR decode AND the host→device transfer of the next shard both
+    overlap the current shard's device compute, even when
     ``config.stream_sync`` drains the device between shards (the axon
     tunnel mode, where jax's own async dispatch is off the table).
-    Exceptions propagate to the consumer at the point of the failed
-    item."""
+    Exceptions (from the generator or from ``prepare``) propagate to
+    the consumer at the point of the failed item.
+
+    Overlap accounting goes to ``metrics`` (default: the process-wide
+    telemetry registry) on the injectable ``clock`` — tier-1 drives it
+    with a ``VirtualClock``-timed fake packer and zero real sleeps:
+
+    * ``stream.stall_s``   — consumer seconds blocked on the queue
+      (the stream is producer-bound: IO/pack/H2D is the bottleneck);
+    * ``stream.overlap_s`` — producer work seconds hidden behind
+      consumer compute (the overlap the double buffer exists to buy).
+    """
     import queue
     import threading
 
+    clock = clock if clock is not None else SYSTEM_CLOCK
     q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
     stop = threading.Event()
     _END = object()
+    _ERR = object()
 
     def put(item) -> bool:
         # stop-aware put: a consumer that abandons iteration (device
@@ -85,30 +105,53 @@ def _prefetch_iter(make_gen, depth: int = 1):
         return False
 
     def worker():
+        gen = make_gen()
         try:
-            for item in make_gen():
-                if not put(item):
+            while True:
+                t0 = clock.monotonic()
+                try:
+                    item = next(gen)
+                except StopIteration:
+                    break
+                if prepare is not None:
+                    item = prepare(item)
+                # production wall: generator work + prepare (decode +
+                # pack + device_put) — NOT time blocked on a full
+                # queue, which is the consumer's compute, not ours
+                work = clock.monotonic() - t0
+                if not put((None, item, work)):
                     return  # consumer gone; generator finalised here
         except BaseException as e:  # noqa: BLE001 - reraised below
-            put(("__prefetch_error__", e))
+            put((_ERR, e, 0.0))
         put(_END)
 
     threading.Thread(target=worker, daemon=True).start()
+    stall_total = 0.0
+    overlap_total = 0.0
     try:
         while True:
+            t0 = clock.monotonic()
             item = q.get()
+            stall = clock.monotonic() - t0
             if item is _END:
                 return
-            if (isinstance(item, tuple) and len(item) == 2
-                    and item[0] == "__prefetch_error__"):
-                raise item[1]
-            yield item
+            tag, payload, work = item
+            if tag is _ERR:
+                raise payload
+            stall_total += stall
+            # the slice of this item's production wall that did NOT
+            # stall the consumer — i.e. was hidden behind compute
+            overlap_total += max(0.0, work - stall)
+            yield payload
     finally:
         stop.set()
         try:  # wake a producer blocked on a full queue
             q.get_nowait()
         except queue.Empty:
             pass
+        m = metrics if metrics is not None else telemetry.default_registry()
+        m.counter("stream.stall_s").inc(stall_total)
+        m.counter("stream.overlap_s").inc(overlap_total)
 
 
 @dataclasses.dataclass
@@ -128,15 +171,19 @@ class ShardSource:
     n_genes: int
     shard_rows: int
     sharding: object | None = None
-    # read/pack the next shard in a worker thread while the device
-    # chews the current one (on for IO-backed sources; pointless for
-    # in-memory ones)
+    # read/pack AND device_put the next shard in a worker thread while
+    # the device chews the current one (on for IO-backed sources;
+    # pointless for in-memory ones)
     prefetch: bool = False
     # optional range-aware factory(start_shard) that SEEKS to the
     # given shard index (h5 indptr slicing / CSR row slicing) — the
     # checkpoint/resume path of the streaming passes uses it to skip
     # already-accumulated shards without re-reading them
     factory_from: Callable[[int], Iterator[SparseCells]] | None = None
+    # prefetch queue depth: 2 = double-buffered (shard N+1 decoded,
+    # packed and device_put while shard N computes — see
+    # ``_prefetch_iter``'s stream.overlap_s / stream.stall_s counters)
+    prefetch_depth: int = 2
 
     def __iter__(self):
         yield from self.iter_from(0)
@@ -144,20 +191,35 @@ class ShardSource:
     def iter_from(self, start_shard: int):
         """Iterate ``(row_offset, device shard)`` starting at shard
         index ``start_shard``.  Range-aware sources seek; others read
-        and discard the skipped shards (correct, just not free)."""
+        and discard the skipped shards (correct, just not free).
+        With ``prefetch`` the ``device_put`` runs in the worker thread
+        too, so the H2D transfer of shard N+1 overlaps compute on
+        shard N."""
         if start_shard and self.factory_from is not None:
             base = lambda: self.factory_from(start_shard)  # noqa: E731
             skip = 0
         else:
             base = self.factory
             skip = start_shard
-        it = _prefetch_iter(base) if self.prefetch else base()
+
+        def host_iter():
+            for i, shard in enumerate(base()):
+                if i < skip:
+                    continue  # not range-aware: discarded before pack
+                yield shard
+
         offset = start_shard * self.shard_rows
-        for i, shard in enumerate(it):
-            if i < skip:
-                continue  # not range-aware: discarded without device_put
-            yield offset, shard.device_put(self.sharding)
-            offset += shard.n_cells
+        if self.prefetch:
+            it = _prefetch_iter(
+                host_iter, depth=self.prefetch_depth,
+                prepare=lambda s: s.device_put(self.sharding))
+            for shard in it:
+                yield offset, shard
+                offset += shard.n_cells
+        else:
+            for shard in host_iter():
+                yield offset, shard.device_put(self.sharding)
+                offset += shard.n_cells
 
     def with_mesh(self, mesh) -> "ShardSource":
         """Copy of this source whose shards are placed cells-axis-
@@ -803,7 +865,8 @@ def stream_pipeline(src: ShardSource, *, n_top: int = 2000,
                     hvg_flavor: str = "seurat_v3",
                     mesh=None,
                     checkpoint_dir: str | None = None,
-                    knn_chunk: int | None = None) -> dict:
+                    knn_chunk: int | None = None,
+                    prefetch_depth: int | None = None) -> dict:
     """h5ad shards → QC → HVG → 50-PC randomized PCA → kNN, out of
     core (BASELINE.json configs[4] shape).  Returns a dict:
     obs metrics (host), hvg_genes, X_pca (device), knn indices and
@@ -813,9 +876,17 @@ def stream_pipeline(src: ShardSource, *, n_top: int = 2000,
     across the mesh (GSPMD collectives in the per-shard programs) and
     the kNN runs as the ring-ppermute multi-chip search — the
     composition the 10M-cell north star requires (stream from disk,
-    compute across chips)."""
+    compute across chips).
+
+    ``prefetch_depth`` overrides the source's prefetch queue depth
+    (default 2: double-buffered — shard N+1's decode + pack +
+    device_put overlap shard N's compute on EVERY streamed pass below;
+    the ``stream.overlap_s`` / ``stream.stall_s`` telemetry counters
+    say how much overlap the stream actually achieved)."""
     from ..ops.knn import knn_arrays
 
+    if prefetch_depth is not None:
+        src = dataclasses.replace(src, prefetch_depth=prefetch_depth)
     if mesh is not None and knn_chunk is not None:
         raise ValueError(
             "stream_pipeline: knn_chunk= applies to the single-device "

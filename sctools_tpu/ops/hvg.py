@@ -295,7 +295,18 @@ def _hvg_batched(data: CellData, n_top, flavor, subset, compact,
         out = subset_fn(out, np.sort(order[:n_top]), compact=compact)
     return out
 
-@register("hvg.select", backend="tpu")
+def _hvg_fusable(params: dict) -> bool:
+    """hvg.select traces end-to-end only without its host-side paths:
+    ``subset=True`` is a data-dependent-shape materialisation point,
+    ``batch_key`` subsets per batch on host, and the cell_ranger /
+    pearson_residuals flavors do host-side per-bin / chunked work."""
+    return (not params.get("subset", False)
+            and params.get("batch_key") is None
+            and params.get("flavor", "seurat_v3")
+            in ("seurat_v3", "dispersion", "seurat"))
+
+
+@register("hvg.select", backend="tpu", fusable=_hvg_fusable)
 def hvg_select_tpu(data: CellData, n_top: int = 2000,
                    flavor: str = "seurat_v3", subset: bool = False,
                    compact: bool = True,

@@ -66,7 +66,7 @@ def _he_gene_flag_device(x: SparseCells, totals, max_fraction):
     return segment_reduce(x, slot_vals, 1)[:, 0] > 0
 
 
-@register("normalize.library_size", backend="tpu")
+@register("normalize.library_size", backend="tpu", fusable=True)
 def library_size_tpu(data: CellData, target_sum: float | None = 1e4,
                      exclude_highly_expressed: bool = False,
                      max_fraction: float = 0.05) -> CellData:
@@ -161,7 +161,7 @@ def library_size_cpu(data: CellData, target_sum: float | None = 1e4,
 # ----------------------------------------------------------------------
 
 
-@register("normalize.log1p", backend="tpu")
+@register("normalize.log1p", backend="tpu", fusable=True)
 def log1p_tpu(data: CellData) -> CellData:
     """``x -> log(1 + x)`` elementwise.  On the sparse layout this maps
     only stored values (log1p(0) == 0, so sparsity is preserved)."""
@@ -191,7 +191,7 @@ def log1p_cpu(data: CellData) -> CellData:
 # ----------------------------------------------------------------------
 
 
-@register("normalize.scale", backend="tpu")
+@register("normalize.scale", backend="tpu", fusable=True)
 def scale_tpu(data: CellData, max_value: float | None = 10.0,
               zero_center: bool = True) -> CellData:
     """Per-gene standardisation (unit variance, optionally zero mean).
@@ -249,7 +249,7 @@ def _pearson_residuals_math(X_dense, totals, gene_sums, grand, theta,
     return xp.clip(Z, -c, c)
 
 
-@register("normalize.pearson_residuals", backend="tpu")
+@register("normalize.pearson_residuals", backend="tpu", fusable=True)
 def pearson_residuals_tpu(data: CellData, theta: float = 100.0,
                           clip: float | None = None) -> CellData:
     """Analytic Pearson residuals of an NB offset model (Lause et al.
@@ -375,7 +375,7 @@ def regress_out_cpu(data: CellData, keys: list | tuple = (),
 # ----------------------------------------------------------------------
 
 
-@register("normalize.downsample_counts", backend="tpu")
+@register("normalize.downsample_counts", backend="tpu", fusable=True)
 def downsample_counts_tpu(data: CellData, target_total: float = 1e3,
                           seed: int = 0) -> CellData:
     """Binomially thin each cell's counts to ~``target_total``
@@ -442,7 +442,7 @@ def downsample_counts_cpu(data: CellData, target_total: float = 1e3,
 # ----------------------------------------------------------------------
 
 
-@register("normalize.clr", backend="tpu")
+@register("normalize.clr", backend="tpu", fusable=True)
 def clr_tpu(data: CellData, axis: str = "cell") -> CellData:
     """Centered log-ratio transform (Seurat ``NormalizeData(method=
     "CLR")`` / muon ``prot.pp.clr``): the standard normalisation for

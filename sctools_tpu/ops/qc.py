@@ -28,7 +28,7 @@ def _mito_mask(data: CellData):
     return None
 
 
-@register("qc.per_cell_metrics", backend="tpu")
+@register("qc.per_cell_metrics", backend="tpu", fusable=True)
 def per_cell_metrics_tpu(data: CellData, mito_mask=None,
                          percent_top: tuple = ()) -> CellData:
     """Adds obs: ``n_genes``, ``total_counts``, ``pct_counts_mt``;
@@ -124,7 +124,7 @@ def per_cell_metrics_cpu(data: CellData, mito_mask=None,
     )
 
 
-@register("qc.per_gene_metrics", backend="tpu")
+@register("qc.per_gene_metrics", backend="tpu", fusable=True)
 def per_gene_metrics_tpu(data: CellData) -> CellData:
     """Adds var: ``n_cells``, ``total_counts``, ``mean_counts``."""
     X = data.X
@@ -381,7 +381,7 @@ def filter_genes_cpu(data: CellData, min_cells: int | None = 3,
     return data.replace(X=X, var=var, varm=varm, layers=layers)
 
 
-@register("util.snapshot_layer", backend="tpu")
+@register("util.snapshot_layer", backend="tpu", fusable=True)
 @register("util.snapshot_layer", backend="cpu")
 def snapshot_layer(data: CellData, layer: str = "counts") -> CellData:
     """Copy the CURRENT X into ``layers[layer]`` — the Pipeline-friendly

@@ -1,0 +1,460 @@
+"""Execution plans: fuse runs of device transforms into single cached
+jitted programs.
+
+Why this layer exists: a registry ``Pipeline`` is a Python dispatch
+loop — every step pays per-op dispatch, every eager jnp call inside a
+step pays its own XLA launch, and every invocation of a recipe
+re-traces nothing but still re-dispatches everything.  On the GPU
+single-cell stacks this framework tracks (rapids-singlecell,
+PAPERS.md) that per-op tax is the dominant cost of the preprocessing
+hot path.  The plan layer removes it structurally:
+
+* :func:`fused_pipeline` compiles a ``Pipeline`` into STAGES — maximal
+  runs of consecutive transforms whose implementations declared
+  themselves jit-traceable (``registry.register(..., fusable=...)``)
+  become one :class:`FusedTransform`; everything else (host-only ops,
+  data-dependent-shape materialisation points like
+  ``hvg.select(subset=True)``, backend breaks) stays an eager step and
+  forms a FUSION BREAK.  ``CellData`` stays device-resident across
+  stage boundaries; transfers happen only at breaks.
+* Each fused stage executes as ONE ``jax.jit`` program: intermediates
+  between member ops never materialise (XLA reuses their buffers —
+  the in-program form of buffer donation).  Donation of the stage's
+  INPUT buffers is opt-in (``donate=True``) and never applied to the
+  pipeline's first stage: CellData stages routinely alias buffers
+  (``util.snapshot_layer`` shares X with ``layers['counts']``), so
+  donating a caller-visible input could invalidate arrays the caller
+  still holds.  The ResilientRunner path never donates — a retried
+  attempt must be able to replay its input.
+* Compiled programs live in a PROCESS-WIDE cache keyed by (op chain +
+  params, input tree structure, traced leaf shapes/dtypes, opaque
+  -leaf content, jax backend, donate flag): a second invocation of the
+  same recipe on same-shaped data performs ZERO retraces
+  (``plan.cache_hits`` / ``plan.cache_misses`` counters prove it).
+* The layer composes with every cross-cutting hook.  A fused stage is
+  called through the registry call-wrapper chain ONCE PER MEMBER OP:
+  chaos faults targeting an op inside a fused stage still fire (and
+  classify) on that op's name with unchanged Nth-call counting, the
+  runner's cooperative deadline token is checked at stage boundaries,
+  and telemetry's per-op call counters keep ticking (durations are
+  attributed at stage granularity — the stage IS the dispatch unit).
+  If tracing a stage fails (an op lied about fusability, or host
+  values leak into control flow), the stage falls back to eager
+  step-by-step execution with a warning and a ``plan.fallbacks``
+  count — never a changed result.
+
+>>> from sctools_tpu.plan import fused_pipeline
+>>> fast = fused_pipeline(seurat_pipeline())
+>>> out = fast.run(data.device_put())      # compiles fused stages
+>>> out = fast.run(data.device_put())      # 100% plan-cache hit
+"""
+
+from __future__ import annotations
+
+import threading
+import warnings
+
+import jax
+import numpy as np
+
+from . import registry as _registry
+from .registry import Pipeline, Transform
+from .utils import telemetry, trace
+
+# ---------------------------------------------------------------------------
+# The process-wide plan cache
+# ---------------------------------------------------------------------------
+
+_CACHE: dict = {}
+_CACHE_LOCK = threading.RLock()
+_FALLBACK = object()  # cache sentinel: this stage signature won't trace
+
+
+def plan_cache_stats() -> dict:
+    """Cheap introspection: entry count and per-kind split of the
+    process-wide plan cache."""
+    with _CACHE_LOCK:
+        vals = list(_CACHE.values())
+    return {"entries": len(vals),
+            "compiled": sum(1 for v in vals if v is not _FALLBACK),
+            "fallback": sum(1 for v in vals if v is _FALLBACK)}
+
+
+def clear_plan_cache() -> None:
+    """Drop every compiled plan (tests; or after a ``config`` change
+    that alters traced behaviour — the cache key covers op chain,
+    params, shapes and backend, not global config knobs)."""
+    with _CACHE_LOCK:
+        _CACHE.clear()
+
+
+# ---------------------------------------------------------------------------
+# CellData <-> (traced leaves, opaque leaves) splitting
+# ---------------------------------------------------------------------------
+
+
+def _is_traced_leaf(v) -> bool:
+    if isinstance(v, jax.Array):
+        return True
+    return isinstance(v, np.ndarray) and v.dtype.kind in "biufc"
+
+
+def _split(data):
+    """Flatten a pytree into (traced numeric leaves, opaque host
+    leaves, treedef, mask).  Opaque leaves — string/object arrays,
+    python scalars, anything jit cannot trace — ride around the
+    compiled program by value."""
+    leaves, treedef = jax.tree_util.tree_flatten(data)
+    mask = tuple(_is_traced_leaf(v) for v in leaves)
+    traced = [v for v, m in zip(leaves, mask) if m]
+    opaque = [v for v, m in zip(leaves, mask) if not m]
+    return traced, opaque, treedef, mask
+
+
+def _merge(traced, opaque, treedef, mask):
+    it_t, it_o = iter(traced), iter(opaque)
+    leaves = [next(it_t) if m else next(it_o) for m in mask]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _digest(payload: bytes) -> bytes:
+    """16-byte content digest for array payloads in cache keys.  Keys
+    must cover CONTENT (ops bake host values into traced constants)
+    but must not RETAIN it: raw bytes in a process-wide cache key
+    would pin megabyte gene-name arrays forever and re-hash them on
+    every dict lookup — the digest costs one pass per call and the
+    key stays 16 bytes."""
+    import hashlib
+
+    return hashlib.blake2b(payload, digest_size=16).digest()
+
+
+def _opaque_token(v):
+    """Hashable content token for an opaque leaf.  Opaque content must
+    be part of the cache key: ops may READ it at trace time and bake
+    the result into the program as a constant (``qc.per_cell_metrics``
+    derives the mito mask from ``var['gene_name']`` strings)."""
+    if v is None or isinstance(v, (bool, int, float, complex, str, bytes)):
+        return ("v", type(v).__name__, v)
+    if isinstance(v, np.ndarray):
+        if v.dtype.kind == "O":
+            return ("nd", "O", v.shape, _digest(repr(v.tolist()).encode()))
+        return ("nd", str(v.dtype), v.shape, _digest(v.tobytes()))
+    return ("r", type(v).__name__, repr(v))
+
+
+def _freeze(v):
+    """Hashable token for a bound parameter value (the op-chain part
+    of the cache key)."""
+    if isinstance(v, dict):
+        return ("d",) + tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    if isinstance(v, (list, tuple, frozenset, set)):
+        items = sorted(v, key=repr) if isinstance(v, (set, frozenset)) else v
+        return (type(v).__name__,) + tuple(_freeze(x) for x in items)
+    if isinstance(v, (np.ndarray, jax.Array)):
+        a = np.asarray(v)
+        return ("nd", str(a.dtype), a.shape,
+                _digest(a.tobytes() if a.dtype.kind != "O"
+                        else repr(a.tolist()).encode()))
+    return v
+
+
+class _StageProgram:
+    """One compiled fused stage: the jitted callable plus the output
+    reassembly spec captured at trace time.  ``out_map`` rebuilds the
+    output's opaque leaves per call: ``("in", j)`` means the j-th
+    input opaque leaf passed through by identity (the common case —
+    gene names, uns scalars), ``("const", v)`` a value created during
+    the trace."""
+
+    __slots__ = ("jitted", "out_treedef", "out_mask", "out_map")
+
+    def __init__(self, jitted, out_treedef, out_mask, out_map):
+        self.jitted = jitted
+        self.out_treedef = out_treedef
+        self.out_mask = out_mask
+        self.out_map = out_map
+
+    def rebuild(self, out_traced, in_opaque):
+        out_opaque = [in_opaque[j] if kind == "in" else v
+                      for kind, j, v in self.out_map]
+        return _merge(out_traced, out_opaque, self.out_treedef,
+                      self.out_mask)
+
+
+# ---------------------------------------------------------------------------
+# FusedTransform — the Transform-alike a Pipeline can hold as one step
+# ---------------------------------------------------------------------------
+
+
+class FusedTransform:
+    """A run of consecutive fusable transforms executed as ONE jitted
+    program behind the process-wide plan cache.
+
+    Quacks like :class:`registry.Transform` — ``name`` / ``backend`` /
+    ``params`` / callable / ``with_backend`` — so everything built on
+    Transforms (Pipeline iteration, ResilientRunner retry/checkpoint
+    fingerprints, journal records) treats a fused stage as one
+    retryable step.  ``params`` carries the member ``(name, params)``
+    chain, so checkpoint fingerprints change when any member does.
+    ``with_backend`` returns an UNFUSED sequential chain on the new
+    backend — the degrade-to-cpu ruling falls back to the oracle path
+    step by step, exactly as an unfused pipeline would.
+    """
+
+    def __init__(self, members, backend: str | None = None,
+                 metrics=None, donate: bool = False):
+        if not members:
+            raise ValueError("FusedTransform needs at least one member")
+        self.members = list(members)
+        self.backend = backend or self.members[0].backend
+        self.name = "fused:" + "+".join(t.name for t in self.members)
+        self.params = {"ops": [(t.name, dict(t.params))
+                               for t in self.members]}
+        self.metrics = metrics
+        self.donate = donate
+
+    # -- Transform protocol -------------------------------------------
+    def with_backend(self, backend: str):
+        if backend == self.backend:
+            return self
+        return _UnfusedChain(
+            [t.with_backend(backend) for t in self.members],
+            backend, self.name, self.params)
+
+    def __repr__(self):
+        return (f"FusedTransform([{', '.join(t.name for t in self.members)}]"
+                f", backend={self.backend!r})")
+
+    def __call__(self, data, **overrides):
+        if overrides:
+            raise TypeError(
+                "FusedTransform takes no per-call overrides — member "
+                "params are baked into the compiled program")
+        fn = self._execute
+        if _registry._CALL_WRAPPERS:
+            # one wrapper application PER MEMBER op (first member
+            # outermost): chaos faults fnmatch member names and keep
+            # their Nth-call counting, the deadline token is checked
+            # at the stage boundary, telemetry counts each member call
+            for t in reversed(self.members):
+                fn = _registry._wrap_call(t.name, self.backend, fn)
+        return fn(data)
+
+    # -- execution -----------------------------------------------------
+    def _metrics(self):
+        return (self.metrics if self.metrics is not None
+                else telemetry.default_registry())
+
+    def _ensure_device(self, data):
+        """Fused stages consume device-resident data; pack a host
+        scipy X at the boundary (same adaptation the runner's
+        ``_match_residency`` performs)."""
+        X = getattr(data, "X", None)
+        if X is None or not hasattr(data, "device_put"):
+            return data
+        import scipy.sparse as sp
+
+        if sp.issparse(X):
+            return data.device_put()
+        return data
+
+    def _ops_key(self):
+        return tuple((t.name, t.backend, _freeze(dict(t.params)))
+                     for t in self.members)
+
+    def _run_eager(self, data):
+        for t in self.members:
+            data = t._fn(data, **t.params)
+        return data
+
+    def _execute(self, data):
+        m = self._metrics()
+        data = self._ensure_device(data)
+        traced, opaque, treedef, mask = _split(data)
+        donate = bool(self.donate) and jax.default_backend() != "cpu"
+        try:
+            key = (self._ops_key(), treedef, mask,
+                   tuple((tuple(v.shape), str(v.dtype)) for v in traced),
+                   tuple(_opaque_token(v) for v in opaque),
+                   jax.default_backend(), donate)
+        except TypeError as e:
+            # unhashable param/opaque content: this chain cannot be
+            # cached — run it eagerly rather than retrace forever
+            warnings.warn(
+                f"plan: {self.name} has an unhashable cache key "
+                f"({e}) — executing unfused", RuntimeWarning,
+                stacklevel=2)
+            m.counter("plan.fallbacks").inc()
+            return self._run_eager(data)
+        with _CACHE_LOCK:
+            prog = _CACHE.get(key)
+        if prog is _FALLBACK:
+            return self._run_eager(data)
+        n_ops = len(self.members)
+        with trace.span(f"plan:{self.name}",
+                        meta={"backend": self.backend, "n_ops": n_ops,
+                              "cached": prog is not None}):
+            if prog is not None:
+                m.counter("plan.cache_hits").inc()
+                out_traced = prog.jitted(traced)
+                m.counter("plan.fused_ops").inc(n_ops)
+                return prog.rebuild(out_traced, opaque)
+            # miss: trace + compile + execute in one first call
+            m.counter("plan.cache_misses").inc()
+            box: dict = {}
+            members = self.members
+
+            def fused(traced_in):
+                d = _merge(traced_in, opaque, treedef, mask)
+                for t in members:
+                    d = t._fn(d, **t.params)
+                out_traced, out_opaque, out_treedef, out_mask = _split(d)
+                box["spec"] = (out_opaque, out_treedef, out_mask)
+                return out_traced
+
+            jitted = jax.jit(fused,
+                             donate_argnums=(0,) if donate else ())
+            try:
+                out_traced = jitted(traced)
+            except (jax.errors.JAXTypeError, TypeError,
+                    NotImplementedError) as e:
+                # the chain does not trace (host sync / concretisation
+                # inside a member): permanent eager fallback for this
+                # signature, identical results
+                warnings.warn(
+                    f"plan: tracing {self.name} failed "
+                    f"({type(e).__name__}: {e}) — falling back to "
+                    f"step-by-step execution for this input signature",
+                    RuntimeWarning, stacklevel=2)
+                m.counter("plan.fallbacks").inc()
+                with _CACHE_LOCK:
+                    _CACHE[key] = _FALLBACK
+                return self._run_eager(data)
+            out_opaque, out_treedef, out_mask = box["spec"]
+            opaque_pos = {id(v): j for j, v in enumerate(opaque)}
+            out_map = tuple(
+                ("in", opaque_pos[id(v)], None) if id(v) in opaque_pos
+                else ("const", -1, v)
+                for v in out_opaque)
+            prog = _StageProgram(jitted, out_treedef, out_mask, out_map)
+            with _CACHE_LOCK:
+                _CACHE[key] = prog
+            m.counter("plan.fused_ops").inc(n_ops)
+            return prog.rebuild(out_traced, opaque)
+
+
+class _UnfusedChain:
+    """``FusedTransform.with_backend`` result: the same member chain
+    executed step by step on another backend (the degrade ruling's
+    fallback form).  Keeps the fused step's ``name``/``params`` so
+    journal records and checkpoint fingerprints stay joined."""
+
+    def __init__(self, members, backend, name, params):
+        self.members = list(members)
+        self.backend = backend
+        self.name = name
+        self.params = params
+
+    def with_backend(self, backend: str):
+        if backend == self.backend:
+            return self
+        return _UnfusedChain(
+            [t.with_backend(backend) for t in self.members],
+            backend, self.name, self.params)
+
+    def __call__(self, data, **overrides):
+        if overrides:
+            raise TypeError("fused steps take no per-call overrides")
+        for t in self.members:
+            data = t(data)  # Transform.__call__: wrappers per member
+        return data
+
+    def __repr__(self):
+        return (f"_UnfusedChain([{', '.join(t.name for t in self.members)}]"
+                f", backend={self.backend!r})")
+
+
+# ---------------------------------------------------------------------------
+# Pipeline compilation
+# ---------------------------------------------------------------------------
+
+
+def fused_pipeline(pipeline: Pipeline, backend: str | None = None,
+                   *, no_fuse=(), min_run: int = 2,
+                   donate: bool = False, metrics=None) -> Pipeline:
+    """Compile a :class:`Pipeline` into fused execution stages.
+
+    Walks the step list and groups maximal runs of consecutive
+    transforms that (a) share a backend, (b) registered as fusable for
+    it (``registry.is_fusable``), and (c) are not named in
+    ``no_fuse`` (the runner passes its ``isolate`` set — an isolated
+    step must stay an individually-containable dispatch).  Runs of at
+    least ``min_run`` become one :class:`FusedTransform` step; shorter
+    runs and everything else stay eager steps (single eager ops
+    already amortise their compiles through jax's own jit cache).
+
+    ``donate=True`` lets stages past the pipeline's FIRST step donate
+    their input buffers to the compiled program (device backends only;
+    a no-op on CPU).  Leave it off — the default — whenever the
+    caller, a checkpointing runner, or an aliasing op
+    (``util.snapshot_layer``) may still hold references into a stage's
+    input.  Returns a new Pipeline; the original is untouched.
+    """
+    steps = []
+    for t in pipeline.steps:
+        if backend is not None and t.backend != backend:
+            t = t.with_backend(backend)
+        steps.append(t)
+    no_fuse = frozenset(no_fuse)
+    out: list = []
+    run: list = []
+    first_member_index = 0
+
+    def flush():
+        nonlocal first_member_index
+        if len(run) >= min_run:
+            out.append(FusedTransform(
+                run, run[0].backend, metrics=metrics,
+                donate=donate and first_member_index > 0))
+        else:
+            out.extend(run)
+        run.clear()
+
+    for i, t in enumerate(steps):
+        fusable = (isinstance(t, Transform)
+                   and t.name not in no_fuse
+                   and _registry.is_fusable(t.name, t.backend, t.params))
+        if fusable and run and run[-1].backend != t.backend:
+            flush()
+        if fusable:
+            if not run:
+                first_member_index = i
+            run.append(t)
+        else:
+            flush()
+            out.append(t)
+    flush()
+    return Pipeline(out)
+
+
+def describe_plan(pipeline: Pipeline, backend: str | None = None,
+                  **kw) -> str:
+    """Human-readable stage map of what :func:`fused_pipeline` would
+    compile — which ops fuse, where the breaks fall and why a break is
+    a break (the first thing to look at when a recipe is slower than
+    expected; docs/GUIDE.md "Making a recipe fast")."""
+    compiled = fused_pipeline(pipeline, backend=backend, **kw)
+    lines = []
+    for i, t in enumerate(compiled.steps):
+        if isinstance(t, FusedTransform):
+            lines.append(f"[{i:02d}] FUSED ({len(t.members)} ops, one "
+                         f"program): " +
+                         " -> ".join(m.name for m in t.members))
+        else:
+            why = ("not registered fusable"
+                   if not _registry.is_fusable(t.name, t.backend,
+                                               t.params)
+                   else "run too short / isolated")
+            lines.append(f"[{i:02d}] eager: {t.name}  ({why})")
+    return "\n".join(lines)

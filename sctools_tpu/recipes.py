@@ -55,6 +55,7 @@ def recipe_pipeline(name: str, **kw) -> Pipeline:
 def run_recipe(name: str, data: CellData, *, backend: str | None = None,
                checkpoint_dir: str | None = None, resume: bool = True,
                step_deadline_s: float | None = None,
+               fuse: bool = False,
                runner_kw: dict | None = None, **recipe_kw) -> CellData:
     """Run a named recipe under the resilient execution layer.
 
@@ -85,6 +86,16 @@ def run_recipe(name: str, data: CellData, *, backend: str | None = None,
     ``python -m tools.sctreport <checkpoint_dir>`` merges them into
     one run report (docs/GUIDE.md "Reading a run report").
 
+    ``fuse=True`` compiles the recipe into fused execution stages
+    first (``plan.fused_pipeline``): runs of consecutive
+    jit-traceable device transforms become ONE cached compiled program
+    and ONE retryable runner step — retries, deadlines, chaos faults
+    and checkpoints all rule at stage granularity (fused and unfused
+    checkpoints have different step fingerprints, so toggling ``fuse``
+    across a resume recomputes rather than mixing layouts).  The
+    one-call ``apply("recipe.*")`` forms fuse by default; here it is
+    opt-in to keep existing checkpoint directories resumable.
+
     >>> out = run_recipe("seurat", data, backend="tpu",
     ...                  checkpoint_dir="ck/", step_deadline_s=900,
     ...                  n_top_genes=2000)
@@ -98,7 +109,8 @@ def run_recipe(name: str, data: CellData, *, backend: str | None = None,
         # config drift the journal exists to rule out
         kw["step_deadline_s"] = step_deadline_s
     runner = ResilientRunner(recipe_pipeline(name, **recipe_kw),
-                             checkpoint_dir=checkpoint_dir, **kw)
+                             checkpoint_dir=checkpoint_dir, fuse=fuse,
+                             **kw)
     return runner.run(data, backend=backend, resume=resume)
 
 
@@ -145,8 +157,12 @@ def seurat_pipeline(n_top_genes: int = 2000,
 def recipe_zheng17_tpu(data: CellData,
                        n_top_genes: int = 1000) -> CellData:
     """One-call Zheng et al. 2017 preprocessing (see
-    ``zheng17_pipeline`` for the step list)."""
-    return zheng17_pipeline(n_top_genes).run(data, backend="tpu")
+    ``zheng17_pipeline`` for the step list).  Runs FUSED: consecutive
+    device steps execute as one cached compiled program, so repeated
+    invocations on same-shaped data skip retrace entirely
+    (docs/ARCHITECTURE.md "Execution plans & fusion")."""
+    return zheng17_pipeline(n_top_genes).run(data, backend="tpu",
+                                             fuse=True)
 
 
 @register("recipe.zheng17", backend="cpu")
@@ -160,9 +176,10 @@ def recipe_seurat_tpu(data: CellData, n_top_genes: int = 2000,
                       min_genes: int = 200, min_cells: int = 3,
                       target_sum: float = 1e4) -> CellData:
     """One-call classic-Seurat preprocessing (see ``seurat_pipeline``
-    for the step list)."""
+    for the step list).  Runs FUSED like ``recipe.zheng17``."""
     return seurat_pipeline(n_top_genes, min_genes, min_cells,
-                           target_sum).run(data, backend="tpu")
+                           target_sum).run(data, backend="tpu",
+                                           fuse=True)
 
 
 @register("recipe.seurat", backend="cpu")
@@ -195,17 +212,28 @@ def _weinreb17(data: CellData, backend: str, log: bool,
     d = apply("normalize.library_size", d, target_sum=None,
               backend=backend)
     if backend == "tpu":
+        # moments AND the mean/CV thresholding stay ON DEVICE — the
+        # consumer (the gene subset below) is the next device stage.
+        # The ONE host materialisation is the boolean keep-mask fetch:
+        # the subset's output shape depends on it, so the sync is
+        # inherent to the filter, not an implementation round-trip
+        # (previously mu and var were both fetched and thresholded on
+        # host — two array transfers plus host math on the hot path).
+        import jax.numpy as jnp
+
         from .ops.hvg import _gene_moments_tpu
 
         mu_d, var_d, _ = _gene_moments_tpu(d.X)  # sparse AND dense X
-        mu = np.asarray(mu_d)
-        var = np.asarray(var_d)
+        cv_d = (jnp.sqrt(jnp.maximum(var_d, 0.0))
+                / jnp.maximum(mu_d, 1e-12))
+        keep = np.asarray((mu_d >= mean_threshold)
+                          & (cv_d >= cv_threshold))
     else:
         from .ops.hvg import _gene_moments_cpu
 
         mu, var = _gene_moments_cpu(d.X)
-    cv = np.sqrt(np.maximum(var, 0.0)) / np.maximum(mu, 1e-12)
-    keep = (mu >= mean_threshold) & (cv >= cv_threshold)
+        cv = np.sqrt(np.maximum(var, 0.0)) / np.maximum(mu, 1e-12)
+        keep = (mu >= mean_threshold) & (cv >= cv_threshold)
     if not keep.any():
         raise ValueError(
             f"recipe.weinreb17: no gene passes mean>={mean_threshold} "
@@ -285,9 +313,11 @@ def recipe_pearson_tpu(data: CellData, n_top_genes: int = 2000,
                        theta: float = 100.0,
                        n_components: int = 50) -> CellData:
     """One-call Pearson-residuals workflow (Lause 2021 / scanpy
-    experimental recipe; see ``pearson_residuals_pipeline``)."""
+    experimental recipe; see ``pearson_residuals_pipeline``).  Runs
+    FUSED like ``recipe.zheng17``."""
     return pearson_residuals_pipeline(
-        n_top_genes, theta, n_components).run(data, backend="tpu")
+        n_top_genes, theta, n_components).run(data, backend="tpu",
+                                              fuse=True)
 
 
 @register("recipe.pearson_residuals", backend="cpu")

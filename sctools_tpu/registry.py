@@ -30,6 +30,16 @@ from typing import Any, Callable
 _REGISTRY: dict[str, dict[str, Callable]] = {}
 _DOCS: dict[str, str] = {}
 
+# fusability metadata: name -> backend -> True | predicate(params)->bool.
+# A FUSABLE implementation is traceable end-to-end by jax.jit on a
+# device-resident CellData — no host syncs (np.asarray on results, data
+# -dependent output shapes, host-side loops).  The plan layer
+# (plan.py) compiles maximal runs of consecutive fusable transforms
+# into ONE program; everything else stays an eager dispatch and forms
+# a fusion break.  Param-dependent cases (hvg.select's subset=True
+# materialisation) register a predicate instead of True.
+_FUSABLE: dict[str, dict[str, object]] = {}
+
 DEFAULT_BACKEND = "tpu"
 
 # ---------------------------------------------------------------------------
@@ -86,21 +96,42 @@ class UnknownBackendError(KeyError):
     pass
 
 
-def register(name: str, backend: str = "tpu") -> Callable[[Callable], Callable]:
+def register(name: str, backend: str = "tpu",
+             fusable=False) -> Callable[[Callable], Callable]:
     """Decorator: register ``fn`` as the implementation of ``name`` for
     ``backend``.
 
-    >>> @register("normalize.log1p", backend="tpu")
+    ``fusable`` declares the implementation traceable end-to-end by
+    ``jax.jit`` on device-resident data (no host syncs, no
+    data-dependent output shapes) — the opt-in that lets ``plan.py``
+    compile it into a fused multi-op program.  Pass ``True``, or a
+    ``predicate(params) -> bool`` when fusability depends on the bound
+    parameters (e.g. ``hvg.select``'s ``subset=True`` materialisation
+    point).
+
+    >>> @register("normalize.log1p", backend="tpu", fusable=True)
     ... def log1p_tpu(data, **kw): ...
     """
 
     def deco(fn: Callable) -> Callable:
         _REGISTRY.setdefault(name, {})[backend] = fn
+        if fusable:
+            _FUSABLE.setdefault(name, {})[backend] = fusable
         if fn.__doc__ and name not in _DOCS:
             _DOCS[name] = fn.__doc__
         return fn
 
     return deco
+
+
+def is_fusable(name: str, backend: str, params: dict | None = None) -> bool:
+    """True when the ``(name, backend)`` implementation declared itself
+    jit-traceable (``register(..., fusable=...)``) for these bound
+    parameters — the plan layer's fusion eligibility test."""
+    f = _FUSABLE.get(name, {}).get(backend, False)
+    if callable(f):
+        return bool(f(dict(params or {})))
+    return bool(f)
 
 
 def get(name: str, backend: str = DEFAULT_BACKEND) -> Callable:
@@ -184,7 +215,11 @@ class Pipeline:
     def __init__(self, steps, backend: str | None = None):
         self.steps: list[Transform] = []
         for step in steps:
-            if isinstance(step, Transform):
+            if isinstance(step, Transform) or (
+                    callable(step) and hasattr(step, "name")
+                    and hasattr(step, "backend")
+                    and hasattr(step, "params")):
+                # Transform, or a Transform-alike (plan.FusedTransform)
                 self.steps.append(step)
             elif isinstance(step, str):
                 self.steps.append(Transform(step, backend=backend or DEFAULT_BACKEND))
@@ -194,10 +229,19 @@ class Pipeline:
                     Transform(name, backend=backend or DEFAULT_BACKEND, **params)
                 )
 
-    def run(self, data, backend: str | None = None):
-        """Run all steps.  For retry, fault containment and resume,
-        run the pipeline under ``sctools_tpu.runner.ResilientRunner``
-        instead — this loop dies on the first error by design."""
+    def run(self, data, backend: str | None = None, fuse: bool = False):
+        """Run all steps.  ``fuse=True`` first compiles the chain into
+        fused execution stages (``plan.fused_pipeline``): maximal runs
+        of consecutive jit-traceable device transforms execute as ONE
+        cached compiled program — repeated invocations with the same
+        op chain, params and shapes skip retrace entirely.  For retry,
+        fault containment and resume, run the pipeline under
+        ``sctools_tpu.runner.ResilientRunner`` instead — this loop
+        dies on the first error by design."""
+        if fuse:
+            from .plan import fused_pipeline
+
+            return fused_pipeline(self, backend=backend).run(data)
         for t in self.steps:
             if backend is not None and backend != t.backend:
                 t = t.with_backend(backend)

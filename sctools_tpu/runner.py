@@ -321,6 +321,17 @@ class ResilientRunner:
     sleep : callable
         Backoff sleeper (default ``clock.sleep``); tests inject a
         fake.
+    fuse : bool
+        Compile the pipeline into fused execution stages first
+        (``plan.fused_pipeline``): maximal runs of consecutive
+        jit-traceable device transforms execute as ONE cached
+        compiled program and ONE retryable step.  Deadline tokens are
+        checked at stage boundaries, chaos faults inside a fused
+        stage still classify on their member op's name, a degrade
+        ruling unfuses the stage onto the fallback backend, and
+        checkpoints land at stage granularity (different step
+        fingerprints than the unfused pipeline — a fuse toggle across
+        a resume recomputes).  Names in ``isolate`` are never fused.
     metrics : telemetry.MetricsRegistry | None
         Where recovery counters (retries, degrades, breaker
         transitions, quarantines, checkpoint bytes, …) and the
@@ -344,7 +355,21 @@ class ResilientRunner:
                  validate=None, chaos=None,
                  step_deadline_s: float | None = None,
                  breaker: CircuitBreaker | None = None,
-                 clock=None, sleep=None, metrics=None):
+                 clock=None, sleep=None, metrics=None,
+                 fuse: bool = False):
+        if fuse:
+            # compile the pipeline into fused execution stages
+            # (plan.fused_pipeline): each fused stage is ONE retryable
+            # step — retried/deadlined/checkpointed as a unit, with
+            # chaos faults inside it still firing (and classifying) on
+            # member-op names.  Isolated steps are fusion breaks: a
+            # contained subprocess must dispatch exactly one named op.
+            # The runner path never donates stage inputs — a retried
+            # attempt must be able to replay them.
+            from .plan import fused_pipeline
+
+            pipeline = fused_pipeline(pipeline, no_fuse=isolate,
+                                      donate=False, metrics=metrics)
         self.pipeline = pipeline
         self.checkpoint_dir = checkpoint_dir
         if checkpoint_dir:

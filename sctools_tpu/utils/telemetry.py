@@ -98,6 +98,19 @@ METRICS = {
                                "checkpoints",
     "runner.step_wall_s": "histogram: per-step-attempt wall seconds "
                           "(labels status=)",
+    "plan.cache_hits": "counter: fused-stage executions served from "
+                       "the process-wide plan cache (zero retrace)",
+    "plan.cache_misses": "counter: fused-stage compilations (trace + "
+                         "compile on first sight of a signature)",
+    "plan.fused_ops": "counter: member transforms executed inside "
+                      "fused stages (the dispatch loop they skipped)",
+    "plan.fallbacks": "counter: fused stages that failed to trace and "
+                      "fell back to eager step-by-step execution",
+    "stream.overlap_s": "counter: prefetch worker seconds (decode + "
+                        "pack + device_put) hidden behind consumer "
+                        "compute",
+    "stream.stall_s": "counter: consumer seconds stalled waiting on "
+                      "the prefetch queue (producer-bound stream)",
 }
 
 #: Fixed histogram bucket upper bounds (seconds), chosen to straddle

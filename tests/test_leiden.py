@@ -177,13 +177,15 @@ def test_native_sweeps_match_python():
 
 def test_leiden_parity_at_scale():
     """Device-parallel moves vs the native serial oracle at a scale
-    where parallel-move pathologies can actually appear (20k nodes —
-    the pure-Python oracle capped this assertion at ~600)."""
+    where parallel-move pathologies can actually appear (8k nodes —
+    beyond the 4096 dense-merge cap, so the sparse merge path is
+    active; the pure-Python oracle capped this assertion at ~600, and
+    20k was measured to buy no extra coverage for ~4x the wall)."""
     from sctools_tpu.native import have_native
 
     if not have_native():
         pytest.skip("native library not built")
-    n = 20000
+    n = 8192
     pts, truth = gaussian_blobs(n, 10, 12, spread=0.3, seed=13)
     idx, dist = knn_numpy(pts, pts, k=10, metric="euclidean",
                           exclude_self=True)

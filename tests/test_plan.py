@@ -1,0 +1,397 @@
+"""plan.py — fused execution stages, the process-wide plan cache, and
+their composition with the resilience stack."""
+
+import numpy as np
+import pytest
+
+import sctools_tpu as sct
+from sctools_tpu.data.synthetic import synthetic_counts
+from sctools_tpu.plan import (FusedTransform, _UnfusedChain,
+                              clear_plan_cache, describe_plan,
+                              fused_pipeline, plan_cache_stats)
+from sctools_tpu.recipes import seurat_pipeline, zheng17_pipeline
+from sctools_tpu.registry import Pipeline, Transform
+from sctools_tpu.runner import ResilientRunner
+from sctools_tpu.utils.chaos import ChaosMonkey, Fault
+from sctools_tpu.utils.failsafe import TRANSIENT
+from sctools_tpu.utils.telemetry import MetricsRegistry
+from sctools_tpu.utils.vclock import VirtualClock
+
+
+def _data(n=256, g=96, seed=0):
+    return synthetic_counts(n, g, density=0.08, n_clusters=3, seed=seed)
+
+
+def _chain():
+    """An all-fusable device chain (one fused stage)."""
+    return Pipeline([
+        ("normalize.library_size", {"target_sum": 1e4}),
+        ("normalize.log1p", {}),
+        ("hvg.select", {"n_top": 32, "flavor": "dispersion"}),
+        ("normalize.scale", {"max_value": 10.0}),
+    ], backend="tpu")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    clear_plan_cache()
+    yield
+    clear_plan_cache()
+
+
+# ------------------------------------------------------------- stage split
+
+def test_fused_pipeline_groups_maximal_runs():
+    pipe = seurat_pipeline(n_top_genes=32, min_genes=1, min_cells=1)
+    fp = fused_pipeline(pipe)
+    kinds = [(type(t).__name__, t.name) for t in fp.steps]
+    # snapshot+per_cell_metrics fuse; both filters and the subsetting
+    # hvg.select are breaks; library_size+log1p fuse; scale is a
+    # trailing singleton (below min_run) and stays eager
+    names = [n for _, n in kinds]
+    assert names == [
+        "fused:util.snapshot_layer+qc.per_cell_metrics",
+        "qc.filter_cells", "qc.filter_genes",
+        "fused:normalize.library_size+normalize.log1p",
+        "hvg.select", "normalize.scale"]
+    assert [k for k, _ in kinds] == [
+        "FusedTransform", "Transform", "Transform",
+        "FusedTransform", "Transform", "Transform"]
+
+
+def test_subset_hvg_is_a_fusion_break():
+    from sctools_tpu.registry import is_fusable
+
+    assert is_fusable("hvg.select", "tpu", {"flavor": "dispersion"})
+    assert not is_fusable("hvg.select", "tpu", {"subset": True})
+    assert not is_fusable("hvg.select", "tpu", {"flavor": "cell_ranger"})
+    assert not is_fusable("hvg.select", "tpu", {"batch_key": "sample"})
+    assert not is_fusable("qc.filter_genes", "tpu", {})
+    assert not is_fusable("normalize.log1p", "cpu", {})
+
+
+def test_no_fuse_names_stay_eager():
+    fp = fused_pipeline(_chain(), no_fuse={"normalize.log1p"})
+    names = [t.name for t in fp.steps]
+    assert names == ["normalize.library_size", "normalize.log1p",
+                     "fused:hvg.select+normalize.scale"]
+
+
+def test_describe_plan_names_breaks():
+    text = describe_plan(seurat_pipeline(n_top_genes=32, min_genes=1,
+                                         min_cells=1))
+    assert "FUSED" in text and "eager: qc.filter_genes" in text
+
+
+# ------------------------------------------------- correctness and cache
+
+def test_fused_matches_step_by_step_bitwise_on_cpu_oracle():
+    """The fused program computes the SAME values the dispatch loop
+    does.  X (elementwise chain) is bitwise; score-like reductions may
+    regroup under XLA fusion, so derived RANKINGS must agree exactly
+    and the sums to float tolerance."""
+    d = _data().device_put()
+    pipe = _chain()
+    ref = pipe.run(d)
+    out = fused_pipeline(pipe).run(d)
+    assert np.array_equal(np.asarray(out.X), np.asarray(ref.X))
+    assert np.array_equal(np.asarray(out.obs["library_size"]),
+                          np.asarray(ref.obs["library_size"]))
+    np.testing.assert_allclose(np.asarray(out.var["hvg_score"]),
+                               np.asarray(ref.var["hvg_score"]),
+                               rtol=1e-3, atol=1e-5)
+    # rank swaps are legal ONLY between near-tied scores (last-ulp
+    # reduction regrouping); any real reordering is a bug
+    rank_out = np.asarray(out.var["hvg_rank"])
+    rank_ref = np.asarray(ref.var["hvg_rank"])
+    s = np.asarray(ref.var["hvg_score"], np.float64)
+    for g in np.flatnonzero(rank_out != rank_ref):
+        partner = int(np.flatnonzero(rank_ref == rank_out[g])[0])
+        assert abs(s[g] - s[partner]) <= 1e-3 * max(1.0, abs(s[g])), \
+            (g, partner, s[g], s[partner])
+
+
+def test_full_recipe_fused_matches_unfused():
+    d = _data(300, 120).device_put()
+    pipe = seurat_pipeline(n_top_genes=48, min_genes=1, min_cells=1)
+    ref = pipe.run(d)
+    out = pipe.run(d, fuse=True)
+    np.testing.assert_allclose(np.asarray(out.X), np.asarray(ref.X),
+                               rtol=1e-4, atol=1e-5)
+    assert np.array_equal(np.asarray(out.var["highly_variable"]),
+                          np.asarray(ref.var["highly_variable"]))
+
+
+def test_plan_cache_hit_miss_counters():
+    d = _data().device_put()
+    m = MetricsRegistry()
+    fp = fused_pipeline(_chain(), metrics=m)
+    fp.run(d)
+    c1 = m.snapshot_compact()
+    assert c1["plan.cache_misses"] == 1.0
+    assert "plan.cache_hits" not in c1
+    assert c1["plan.fused_ops"] == 4.0
+    fp.run(d)
+    c2 = m.snapshot_compact()
+    assert c2["plan.cache_misses"] == 1.0  # unchanged
+    assert c2["plan.cache_hits"] == 1.0
+    assert c2["plan.fused_ops"] == 8.0
+
+
+def test_second_invocation_of_cached_recipe_zero_retraces():
+    """The acceptance gate: a REBUILT pipeline (fresh Transform
+    objects, same ops/params/shapes) hits the process-wide cache —
+    repeated recipe invocations skip retrace entirely."""
+    d = _data().device_put()
+    m = MetricsRegistry()
+    fused_pipeline(_chain(), metrics=m).run(d)  # first: compiles
+    before = m.snapshot_compact()
+    fused_pipeline(_chain(), metrics=m).run(d)  # second: rebuilt
+    after = m.snapshot_compact()
+    assert after["plan.cache_misses"] - before["plan.cache_misses"] == 0
+    assert after["plan.cache_hits"] - before.get("plan.cache_hits", 0) == 1
+
+
+def test_shape_change_retraces():
+    m = MetricsRegistry()
+    fp = fused_pipeline(_chain(), metrics=m)
+    fp.run(_data(256, 96).device_put())
+    fp.run(_data(512, 96, seed=1).device_put())  # new row count
+    c = m.snapshot_compact()
+    assert c["plan.cache_misses"] == 2.0
+    assert plan_cache_stats()["compiled"] == 2
+
+
+def test_param_change_retraces():
+    d = _data().device_put()
+    m = MetricsRegistry()
+    fused_pipeline(Pipeline([("normalize.log1p", {}),
+                             ("normalize.scale", {"max_value": 10.0})],
+                            backend="tpu"), metrics=m).run(d)
+    fused_pipeline(Pipeline([("normalize.log1p", {}),
+                             ("normalize.scale", {"max_value": 5.0})],
+                            backend="tpu"), metrics=m).run(d)
+    assert m.snapshot_compact()["plan.cache_misses"] == 2.0
+
+
+def test_trace_failure_falls_back_to_eager(monkeypatch):
+    """An op that lied about fusability (host sync inside) must fall
+    back to step-by-step execution with identical results — and mark
+    the signature so later calls skip the failed trace."""
+    from sctools_tpu import registry as reg
+
+    def leaky(data, **kw):
+        # host concretisation of a traced value: untraceable
+        return data.with_X(np.log1p(np.asarray(data.X.data))
+                           if hasattr(data.X, "data")
+                           else np.log1p(np.asarray(data.X)))
+
+    reg._REGISTRY.setdefault("test.leaky", {})["tpu"] = leaky
+    reg._FUSABLE.setdefault("test.leaky", {})["tpu"] = True
+    try:
+        d = _data().device_put()
+        m = MetricsRegistry()
+        pipe = Pipeline([("normalize.log1p", {}), ("test.leaky", {})],
+                        backend="tpu")
+        with pytest.warns(RuntimeWarning, match="falling back"):
+            out = fused_pipeline(pipe, metrics=m).run(d)
+        ref = pipe.run(d)
+        np.testing.assert_allclose(np.asarray(out.X),
+                                   np.asarray(ref.X), atol=1e-6)
+        c = m.snapshot_compact()
+        assert c["plan.fallbacks"] == 1.0
+        assert plan_cache_stats()["fallback"] == 1
+        # second call: cached fallback ruling, no second warning/trace
+        out2 = fused_pipeline(pipe, metrics=m).run(d)
+        np.testing.assert_allclose(np.asarray(out2.X),
+                                   np.asarray(ref.X), atol=1e-6)
+        assert m.snapshot_compact()["plan.fallbacks"] == 1.0
+    finally:
+        reg._REGISTRY.pop("test.leaky", None)
+        reg._FUSABLE.pop("test.leaky", None)
+        reg._DOCS.pop("test.leaky", None)
+
+
+# ------------------------------------------------------------- donation
+
+def test_donation_defaults_off_and_input_stays_live():
+    """The caller's input CellData must stay readable after a fused
+    run: donation is opt-in, and even opted in it never applies to the
+    pipeline's first stage (its input is caller-owned and may be
+    aliased — snapshot_layer shares X with layers['counts'])."""
+    d = _data().device_put()
+    before = np.asarray(d.X.data).copy()
+    fp = fused_pipeline(_chain())
+    assert all(not getattr(t, "donate", False) for t in fp.steps)
+    fp.run(d)
+    # input buffers not donated/invalidated: still fetchable, unchanged
+    assert np.array_equal(np.asarray(d.X.data), before)
+
+    fp2 = fused_pipeline(_chain(), donate=True)
+    stage = next(t for t in fp2.steps if isinstance(t, FusedTransform))
+    # the single stage starts at pipeline position 0 -> never donated
+    assert stage.donate is False
+
+
+def test_donation_optin_applies_only_past_first_step():
+    pipe = Pipeline([
+        ("qc.filter_genes", {"min_cells": 1}),       # break at step 0
+        ("normalize.library_size", {"target_sum": 1e4}),
+        ("normalize.log1p", {}),
+    ], backend="tpu")
+    fp = fused_pipeline(pipe, donate=True)
+    stage = next(t for t in fp.steps if isinstance(t, FusedTransform))
+    assert stage.donate is True  # input is a plan-local intermediate
+    # runner path: never donates, whatever the stage placement
+    r = ResilientRunner(pipe, fuse=True, probe=lambda: {"ok": True},
+                        sleep=lambda s: None)
+    assert all(not getattr(t, "donate", False)
+               for t in r.pipeline.steps)
+    # and on the CPU platform donation is a no-op anyway: results of a
+    # donate-enabled plan still match (the flag only reaches jit on
+    # device backends)
+    out = fp.run(_data().device_put())
+    ref = pipe.run(_data().device_put())
+    np.testing.assert_allclose(np.asarray(out.X.to_dense()),
+                               np.asarray(ref.X.to_dense()), atol=1e-6)
+
+
+# ------------------------------------------- composition: runner + chaos
+
+def test_runner_fuse_treats_stage_as_one_retryable_step(tmp_path):
+    d = _data(300, 120)
+    pipe = seurat_pipeline(n_top_genes=48, min_genes=1, min_cells=1)
+    base = pipe.run(d, backend="cpu")
+    r = ResilientRunner(pipe, fuse=True, checkpoint_dir=str(tmp_path),
+                        probe=lambda: {"ok": True},
+                        sleep=lambda s: None)
+    out = r.run(d.device_put(), backend="tpu")
+    names = [s.name for s in r.report.steps]
+    assert "fused:normalize.library_size+normalize.log1p" in names
+    assert all(s.status == "completed" for s in r.report.steps)
+    np.testing.assert_allclose(np.asarray(out.X), np.asarray(base.X),
+                               rtol=1e-4, atol=1e-4)
+    # a second, fresh runner resumes from the fused-stage checkpoints
+    r2 = ResilientRunner(pipe, fuse=True, checkpoint_dir=str(tmp_path),
+                         probe=lambda: {"ok": True},
+                         sleep=lambda s: None)
+    r2.run(d.device_put(), backend="tpu")
+    assert r2.report.resumed_from == len(r2.report.steps) - 1
+
+
+def test_chaos_fault_inside_fused_stage_classifies_and_retries():
+    """A chaos fault targeting an op INSIDE a fused stage fires on the
+    member's name, classifies transient, and the runner retries the
+    whole stage."""
+    d = _data(300, 120)
+    pipe = seurat_pipeline(n_top_genes=48, min_genes=1, min_cells=1)
+    monkey = ChaosMonkey([Fault("normalize.log1p", "unavailable",
+                                times=1)])
+    sleeps = []
+    r = ResilientRunner(pipe, fuse=True, probe=lambda: {"ok": True},
+                        sleep=sleeps.append, chaos=monkey)
+    out = r.run(d.device_put(), backend="tpu")
+    assert out is not None
+    stage = next(s for s in r.report.steps
+                 if s.name == "fused:normalize.library_size+"
+                              "normalize.log1p")
+    assert [a.status for a in stage.attempts] == ["error", "ok"]
+    assert stage.attempts[0].classified == TRANSIENT
+    assert monkey.injected[0]["op"] == "normalize.log1p"
+    # member call counting advanced once per stage execution
+    assert monkey.calls["normalize.log1p"] == 2
+    assert monkey.calls["normalize.library_size"] == 2
+    assert len(sleeps) == 1
+
+
+def test_deadline_wedge_inside_fused_stage_overruns():
+    """A chaos wedge burning the shared virtual clock inside a fused
+    stage trips the cooperative deadline at the stage boundary."""
+    clock = VirtualClock()
+    monkey = ChaosMonkey([Fault("normalize.log1p", "wedge", times=1)],
+                         clock=clock, wedge_s=120.0)
+    d = _data(300, 120)
+    pipe = seurat_pipeline(n_top_genes=48, min_genes=1, min_cells=1)
+    r = ResilientRunner(pipe, fuse=True, chaos=monkey, clock=clock,
+                        sleep=lambda s: None,
+                        probe=lambda: {"ok": True},
+                        step_deadline_s=60.0)
+    out = r.run(d.device_put(), backend="tpu")
+    assert out is not None
+    stage = next(s for s in r.report.steps
+                 if s.name.startswith("fused:normalize.library_size"))
+    assert stage.attempts[0].status == "error"
+    assert "StepDeadlineExceeded" in stage.attempts[0].error
+    assert stage.attempts[-1].status == "ok"
+
+
+def test_degrade_unfuses_onto_fallback_backend():
+    """A fused stage degraded to cpu runs its members step-by-step on
+    the oracle backend (cpu ops are not fusable) and still completes."""
+    d = _data(300, 120)
+    pipe = seurat_pipeline(n_top_genes=48, min_genes=1, min_cells=1)
+    base = pipe.run(d, backend="cpu")
+    monkey = ChaosMonkey([Fault("normalize.library_size", "unavailable",
+                                times=-1, backend="tpu")])
+    r = ResilientRunner(pipe, fuse=True, chaos=monkey,
+                        sleep=lambda s: None,
+                        probe=lambda: {"ok": False, "reason": "down"},
+                        fallback_backend="cpu")
+    with pytest.warns(RuntimeWarning, match="DEGRADING"):
+        out = r.run(d.device_put(), backend="tpu")
+    assert r.report.degraded
+    np.testing.assert_allclose(np.asarray(out.X), np.asarray(base.X),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_with_backend_returns_unfused_chain():
+    ft = fused_pipeline(_chain()).steps[0]
+    assert isinstance(ft, FusedTransform)
+    un = ft.with_backend("cpu")
+    assert isinstance(un, _UnfusedChain)
+    assert un.name == ft.name and un.backend == "cpu"
+    assert [t.backend for t in un.members] == ["cpu"] * 4
+    # same-backend rebind is the identity (runner fast path)
+    assert ft.with_backend("tpu") is ft
+
+
+def test_fused_stage_emits_span_and_op_metrics():
+    from sctools_tpu.utils import telemetry, trace
+
+    d = _data().device_put()
+    trace.reset()
+    m = MetricsRegistry()
+    with telemetry.instrument_calls(m):
+        fused_pipeline(_chain(), metrics=m).run(d)
+    spans = [s for s in trace.spans() if s.name.startswith("plan:fused:")]
+    assert len(spans) == 1
+    assert spans[0].meta["n_ops"] == 4
+    c = m.snapshot_compact()
+    # per-op call counters keep ticking under fusion (stage-granular
+    # durations; the counts stay per member op)
+    assert c["op.calls{backend=tpu,op=normalize.log1p}"] == 1.0
+    assert c["op.calls{backend=tpu,op=hvg.select}"] == 1.0
+
+
+def test_one_call_recipe_is_fused_and_cached():
+    """apply("recipe.zheng17") — the production one-call path — runs
+    fused and its second invocation is a pure cache hit."""
+    from sctools_tpu.utils import telemetry
+
+    d = _data(300, 120).device_put()
+    m = telemetry.default_registry()
+
+    def count(key):
+        return m.snapshot_compact().get(key, 0.0)
+
+    ref = zheng17_pipeline(48).run(d)
+    h0, m0 = count("plan.cache_hits"), count("plan.cache_misses")
+    out1 = sct.apply("recipe.zheng17", d, backend="tpu", n_top_genes=48)
+    assert count("plan.cache_misses") > m0  # first run compiles
+    m1 = count("plan.cache_misses")
+    out2 = sct.apply("recipe.zheng17", d, backend="tpu", n_top_genes=48)
+    assert count("plan.cache_misses") == m1  # zero retraces
+    assert count("plan.cache_hits") > h0
+    np.testing.assert_allclose(np.asarray(out1.X), np.asarray(ref.X),
+                               rtol=1e-4, atol=1e-4)
+    assert np.array_equal(np.asarray(out1.X), np.asarray(out2.X))

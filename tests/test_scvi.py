@@ -26,14 +26,14 @@ def _poisson_blocks(n=900, G=300, seed=0):
 def trained():
     d, truth = _poisson_blocks()
     out = sct.apply("model.scvi", d, backend="cpu", n_latent=8,
-                    n_hidden=64, epochs=150, batch_size=128, seed=0)
+                    n_hidden=64, epochs=80, batch_size=128, seed=0)
     return d, truth, out
 
 
 def test_scvi_elbo_decreases(trained):
     _, _, out = trained
     h = np.asarray(out.uns["scvi_elbo_history"])
-    assert len(h) == 150
+    assert len(h) == 80
     assert h[-1] < 0.1 * h[0]  # orders-of-magnitude improvement
     assert h[-1] <= np.min(h[:20]) + 1e-6
 
@@ -101,7 +101,7 @@ def test_scvi_data_parallel_over_mesh():
     params in lockstep; the model still learns and separates."""
     d, truth = _poisson_blocks(n=600, G=200, seed=3)
     out = sct.apply("model.scvi", d, backend="tpu", n_latent=8,
-                    n_hidden=64, epochs=250, batch_size=128, seed=0,
+                    n_hidden=64, epochs=175, batch_size=128, seed=0,
                     n_devices=8)
     h = np.asarray(out.uns["scvi_elbo_history"])
     assert h[-1] < 0.2 * h[0]
@@ -121,7 +121,7 @@ def test_scvi_normalized_expression():
     ordering — hot-block genes dominate within their own cluster."""
     d, truth = _poisson_blocks(n=300, G=150, seed=4)
     out = sct.apply("model.scvi", d, backend="cpu", n_latent=6,
-                    n_hidden=48, epochs=120, batch_size=100, seed=0,
+                    n_hidden=48, epochs=90, batch_size=100, seed=0,
                     store_normalized=True)
     rho = np.asarray(out.layers["scvi_normalized"])
     assert rho.shape == (300, 150)
@@ -149,9 +149,11 @@ def test_scvi_sharded_x_lives_on_the_mesh():
     assert shard_rows == {160 // 8}  # each device holds 1/8 of cells
 
 
-def test_scanvi_semi_supervised_label_recovery():
-    """30% of cells labelled; scanvi must predict the held-out 70%
-    accurately on separable data."""
+@pytest.fixture(scope="module")
+def scanvi_trained():
+    """ONE semi-supervised scanvi training shared by the label-recovery
+    and decoder-conditioning tests (they trained the identical model
+    twice; the duplicate cost bought no coverage)."""
     d, truth = _poisson_blocks(n=600, G=200, seed=6)
     rng = np.random.default_rng(0)
     labels = np.array([f"type_{c}" for c in truth], dtype=object)
@@ -159,7 +161,14 @@ def test_scanvi_semi_supervised_label_recovery():
     labels[mask] = "Unknown"
     d = d.with_obs(cell_type=labels.astype(str))
     out = sct.apply("model.scanvi", d, backend="cpu", n_latent=8,
-                    n_hidden=64, epochs=150, batch_size=128, seed=0)
+                    n_hidden=64, epochs=80, batch_size=128, seed=0)
+    return truth, mask, out
+
+
+def test_scanvi_semi_supervised_label_recovery(scanvi_trained):
+    """30% of cells labelled; scanvi must predict the held-out 70%
+    accurately on separable data."""
+    truth, mask, out = scanvi_trained
     pred = np.asarray(out.obs["scanvi_prediction"])
     want = np.array([f"type_{c}" for c in truth])
     acc_unlabeled = (pred[mask] == want[mask]).mean()
@@ -171,7 +180,7 @@ def test_scanvi_semi_supervised_label_recovery():
     assert out.obsm["X_scanvi"].shape == (600, 8)
 
 
-def test_scanvi_decoder_conditions_on_label():
+def test_scanvi_decoder_conditions_on_label(scanvi_trained):
     """The published y-conditioned generative model (r4 documented
     simplification, now the default): uns['scanvi_class_profiles']
     decodes each class's learned latent anchor under its own label —
@@ -180,14 +189,7 @@ def test_scanvi_decoder_conditions_on_label():
     Class 2's hot block lies beyond G=200 in this fixture, so its
     archetype stays flat on both blocks — a built-in negative
     control."""
-    d, truth = _poisson_blocks(n=600, G=200, seed=6)
-    rng = np.random.default_rng(0)
-    labels = np.array([f"type_{c}" for c in truth], dtype=object)
-    mask = rng.random(600) > 0.3
-    labels[mask] = "Unknown"
-    d = d.with_obs(cell_type=labels.astype(str))
-    out = sct.apply("model.scanvi", d, backend="cpu", n_latent=8,
-                    n_hidden=64, epochs=150, batch_size=128, seed=0)
+    truth, mask, out = scanvi_trained
     prof = np.asarray(out.uns["scanvi_class_profiles"])
     assert prof.shape == (3, 200)
     np.testing.assert_allclose(prof.sum(axis=1), 1.0, rtol=1e-4)
@@ -215,7 +217,7 @@ def test_scanvi_data_parallel_over_mesh():
     labels[mask] = "Unknown"
     d = d.with_obs(cell_type=labels.astype(str))
     out = sct.apply("model.scanvi", d, backend="tpu", n_latent=8,
-                    n_hidden=64, epochs=150, batch_size=128, seed=0,
+                    n_hidden=64, epochs=100, batch_size=128, seed=0,
                     n_devices=8)
     pred = np.asarray(out.obs["scanvi_prediction"])
     want = np.array([f"type_{c}" for c in truth])
@@ -233,7 +235,7 @@ def test_scanvi_classifier_only_variant():
     labels = np.array([f"type_{c}" for c in truth])
     d = d.with_obs(cell_type=labels)
     out = sct.apply("model.scanvi", d, backend="cpu", n_latent=8,
-                    n_hidden=64, epochs=120, batch_size=128, seed=0,
+                    n_hidden=64, epochs=60, batch_size=128, seed=0,
                     classifier_only=True)
     assert "scanvi_class_profiles" not in out.uns
     assert (np.asarray(out.obs["scanvi_prediction"])
@@ -250,7 +252,7 @@ def test_scanvi_store_normalized():
     labels[rng.random(400) > 0.5] = "Unknown"
     d = d.with_obs(cell_type=labels.astype(str))
     out = sct.apply("model.scanvi", d, backend="cpu", n_latent=8,
-                    n_hidden=64, epochs=120, batch_size=128, seed=0,
+                    n_hidden=64, epochs=60, batch_size=128, seed=0,
                     store_normalized=True)
     rho = np.asarray(out.layers["scanvi_normalized"])
     assert rho.shape == (400, 200)

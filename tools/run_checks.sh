@@ -24,7 +24,12 @@
 #                     synthetic run fixture (journal + spans +
 #                     metrics); a non-zero exit OR an empty report
 #                     fails — the post-mortem tool must never rot
-#   5. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
+#   5. plan-cache     a canned recipe's SECOND run must be a 100%
+#                     plan-cache hit (plan.cache_misses unchanged) —
+#                     the fused-execution layer's zero-retrace
+#                     contract (docs/ARCHITECTURE.md "Execution
+#                     plans & fusion")
+#   6. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
 
 set -u -o pipefail
 
@@ -58,7 +63,8 @@ bare=$(grep -nE '\btime\.(sleep|monotonic)\b' \
         sctools_tpu/utils/failsafe.py \
         sctools_tpu/utils/checkpoint.py \
         sctools_tpu/utils/chaos.py \
-        sctools_tpu/utils/telemetry.py 2>/dev/null \
+        sctools_tpu/utils/telemetry.py \
+        sctools_tpu/data/stream.py 2>/dev/null \
         | grep -v 'sctlint: disable=SCT008' || true)
 if [ -n "$bare" ]; then
     echo "bare time.sleep/time.monotonic in resilience modules" \
@@ -82,6 +88,45 @@ if report=$(python -m tools.sctreport tests/fixtures/sctreport_run); then
     fi
 else
     echo "sctreport FAILED on the committed fixture (rc=$?)"
+    fail=1
+fi
+
+stage "plan-cache (second recipe run is a 100% plan-cache hit)"
+if JAX_PLATFORMS=cpu python - <<'PYEOF'
+import sys
+
+from sctools_tpu import apply
+from sctools_tpu.data.synthetic import synthetic_counts
+from sctools_tpu.utils import telemetry
+
+d = synthetic_counts(512, 128, density=0.08, n_clusters=3,
+                     seed=0).device_put()
+m = telemetry.default_registry()
+
+
+def counters():
+    c = m.snapshot_compact()
+    return (c.get("plan.cache_hits", 0.0),
+            c.get("plan.cache_misses", 0.0))
+
+
+apply("recipe.zheng17", d, backend="tpu", n_top_genes=32)
+hits1, misses1 = counters()
+if misses1 < 1:
+    sys.exit("first recipe run compiled no fused stage")
+apply("recipe.zheng17", d, backend="tpu", n_top_genes=32)
+hits2, misses2 = counters()
+if misses2 != misses1:
+    sys.exit(f"second run RETRACED: cache_misses {misses1} -> {misses2}")
+if hits2 <= hits1:
+    sys.exit("second run recorded no plan-cache hits")
+print(f"OK: second run hit the plan cache ({int(hits2 - hits1)} "
+      f"stage(s), 0 retraces)")
+PYEOF
+then
+    :
+else
+    echo "plan-cache stage FAILED (rc=$?)"
     fail=1
 fi
 

@@ -22,8 +22,11 @@ from ..jaxutil import dotted, module_info
 
 # resilience modules whose scheduling must be injectable (matched on
 # the repo-relative path tail, like SCT005); vclock.py is deliberately
-# absent — it IS the injection seam
-_PATH_RE = re.compile(r"(^|/)(runner|failsafe|checkpoint|chaos)\.py$")
+# absent — it IS the injection seam.  stream.py is listed for its
+# prefetch overlap/stall accounting: the double-buffer tests drive it
+# with a VirtualClock-timed fake packer and zero real sleeps.
+_PATH_RE = re.compile(
+    r"(^|/)(runner|failsafe|checkpoint|chaos|stream)\.py$")
 
 _BANNED = {"time.sleep", "time.monotonic"}
 
