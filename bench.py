@@ -61,7 +61,6 @@ import argparse
 import json
 import math
 import os
-import subprocess
 import sys
 import threading
 import time
@@ -1169,83 +1168,11 @@ def run_packer_bench():
             "host_cpus": os.cpu_count(), "loadavg_1m": load1}
 
 
-def run_config4(budget_s: float, measured_mfu: float | None = None):
-    """Times the sharded multi-chip pipeline on an 8-device virtual CPU
-    mesh in a subprocess (the TPU process can't host it), and states
-    the projection model for a real v5e-8.  Timings on the virtual
-    mesh measure algorithmic overhead only — all 8 'devices' share
-    this host's core(s); ICI is what the projection models."""
-    code = (
-        "import json,time,os\n"
-        "import numpy as np\n"
-        "import jax\n"
-        # env JAX_PLATFORMS is not enough where a sitecustomize
-        # force-sets jax_platforms (the axon tunnel session) — the
-        # config update after import is authoritative
-        "jax.config.update('jax_platforms','cpu')\n"
-        "from sctools_tpu.parallel.knn_multichip import"
-        " knn_multichip_arrays\n"
-        "from sctools_tpu.parallel.mesh import make_mesh\n"
-        "from sctools_tpu.data.synthetic import gaussian_blobs\n"
-        "pts,_ = gaussian_blobs(32768, 50, 8, seed=4)\n"
-        "mesh = make_mesh(8)\n"
-        "out={}\n"
-        "for strat in ('ring','all_gather'):\n"
-        "    t0=time.time()\n"
-        "    i,d = knn_multichip_arrays(pts, k=15, metric='cosine',"
-        " mesh=mesh, strategy=strat)\n"
-        "    i.block_until_ready(); first=time.time()-t0\n"
-        "    t0=time.time()\n"
-        "    i,d = knn_multichip_arrays(pts, k=15, metric='cosine',"
-        " mesh=mesh, strategy=strat)\n"
-        "    i.block_until_ready(); out[strat]={'wall_s':"
-        "round(time.time()-t0,3),'first_call_s':round(first,1)}\n"
-        "print(json.dumps(out))\n"
-    )
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
-                        + " --xla_force_host_platform_device_count=8")
-    try:
-        p = subprocess.run([sys.executable, "-c", code],
-                           capture_output=True, text=True,
-                           timeout=max(60, budget_s),
-                           cwd=_HERE, env=env)
-        for line in reversed(p.stdout.strip().splitlines()):
-            try:
-                res = json.loads(line)
-                break
-            except json.JSONDecodeError:
-                continue
-        else:
-            return {"error": (p.stderr or "no output")[-300:]}
-    except subprocess.TimeoutExpired:
-        return {"error": f"config4 subprocess exceeded {budget_s:.0f}s"}
-    res["note"] = ("8 virtual devices on one host CPU — relative "
-                   "algorithmic cost only, not ICI scaling")
-    # Projection model (stated, not measured): brute kNN flops/chip at
-    # 10M cells, 50 dims = (10M/8)*10M*50*2 bf16 flops; ring transfers
-    # move each 50-dim f32 block 7 times over ICI.
-    n10, d = 10_000_000, 50
-    flops_chip = (n10 / 8) * n10 * d * 2
-    ici_bytes = (n10 / 8) * d * 4 * 7
-    # anchor: a VALID (roofline-plausible, hard-sync'd) MFU from this
-    # run's kernel phase replaces the assumed 40% the moment one
-    # exists (r4 Weak #5 — the 40% was doing all the north-star work)
-    mfu = measured_mfu if measured_mfu and 0 < measured_mfu <= 1 else 0.40
-    proj = {"assumed_chip": "v5e (197 Tflop/s bf16, ~4.5e10 B/s ICI "
-                            "per link per direction)",
-            "mfu_anchor": round(mfu, 3),
-            "mfu_source": ("measured kernel bench (this run)"
-                           if measured_mfu else "assumed — no valid "
-                           "measured MFU exists yet"),
-            "knn_compute_s_per_chip":
-                round(flops_chip / (197e12 * mfu), 1),
-            "ring_ici_s": round(ici_bytes / 4.5e10, 2),
-            "model": "max(compute, ici) + preprocess+pca (measured "
-                     "single-chip stats/pca scale linearly in cells)"}
-    res["v5e8_projection_10M"] = proj
-    return res
+# configs[4] — the multi-chip stage — runs as ``--phase mesh``: a
+# watched child on an 8-device host-platform mesh (tools/bench_mesh.py
+# has the measurement; phase_mesh below is the child entry).  The old
+# string-built ``python -c`` snippet that lived here is gone — the
+# helper is a real importable module with its own tests.
 
 
 # ----------------------------------------------------------------------
@@ -1372,6 +1299,38 @@ def phase_fusion():
         flush_result(fusion={"error": repr(e)[:300]}, backend=backend)
 
 
+def phase_mesh():
+    """configs[4]: sharded fused plan vs per-chip dispatch on the
+    8-device host-platform mesh (the orchestrator launches this child
+    with ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` and
+    ``JAX_PLATFORMS=cpu`` — the TPU process can't host the virtual
+    mesh).  The measurement lives in ``tools/bench_mesh.py``."""
+    acq = acquire_jax(min(DEVICE_TIMEOUT_S, max(remaining() - 20, 30)))
+    if acq["jax"] is None:
+        stage("mesh.acquire_failed", hung=acq["hung"],
+              error=acq["error"], waited_s=round(acq["waited"], 1))
+        flush_result(error=f"acquire failed: "
+                           f"{'hung' if acq['hung'] else acq['error']}")
+        sys.exit(3)
+    jax, backend = acq["jax"], acq["backend"]
+    # no wrong-backend exit here: the virtual host mesh is cpu BY
+    # DESIGN (the orchestrator forces JAX_PLATFORMS=cpu + 8 devices)
+    stage("mesh.acquire", backend=backend,
+          n_devices=jax.device_count())
+    try:
+        from tools.bench_mesh import run_mesh_bench
+
+        mfu = os.environ.get("SCTOOLS_BENCH_MESH_MFU")
+        det = run_mesh_bench(jax,
+                             measured_mfu=float(mfu) if mfu else None)
+        stage("mesh", **{k: v for k, v in det.items()
+                         if not isinstance(v, dict)})
+        flush_result(mesh=det, backend=backend)
+    except Exception as e:
+        stage("mesh.error", error=repr(e)[:300])
+        flush_result(mesh={"error": repr(e)[:300]}, backend=backend)
+
+
 # ----------------------------------------------------------------------
 # orchestrator
 # ----------------------------------------------------------------------
@@ -1436,7 +1395,7 @@ def main():
             _WRITE_STAGE_FILE = False
         {"small": phase_small, "kernel": phase_kernel,
          "atlas": phase_atlas, "stream_io": phase_stream_io,
-         "fusion": phase_fusion}[args.phase]()
+         "fusion": phase_fusion, "mesh": phase_mesh}[args.phase]()
         return 0
 
     stage("start", budget_s=BUDGET_S, stall_s=STALL_S,
@@ -1663,24 +1622,29 @@ def main():
         except Exception as e:
             detail["native_packer"] = {"error": repr(e)[:300]}
     if want(4) and remaining() > 90:
-        try:
-            # best plausible measured MFU from this run's kernel phase
-            # (exact impls only — approx/binned do the same matmul but
-            # their mfu shares the bound, so any of them anchors)
-            kmfu = None
-            kk = detail.get("kernel_knn", {})
-            for impl in ("xla", "xla_cb8192", "pallas", "pallas_binned"):
-                r = kk.get(impl, {})
-                if (isinstance(r, dict) and r.get("mfu")
-                        and not r.get("implausible")
-                        and 0 < r["mfu"] <= 1):
-                    kmfu = max(kmfu or 0.0, r["mfu"])
-            detail["config4_multichip"] = stage(
-                "config4", **run_config4(min(remaining() - 30, 420),
-                                         measured_mfu=kmfu))
-        except Exception as e:
-            detail["config4_multichip"] = {"error": repr(e)[:300]}
-            stage("config4.error", error=repr(e)[:300])
+        # best plausible measured MFU from this run's kernel phase
+        # (exact impls only — approx/binned do the same matmul but
+        # their mfu shares the bound, so any of them anchors)
+        kmfu = None
+        kk = detail.get("kernel_knn", {})
+        for impl in ("xla", "xla_cb8192", "pallas", "pallas_binned"):
+            r = kk.get(impl, {})
+            if (isinstance(r, dict) and r.get("mfu")
+                    and not r.get("implausible")
+                    and 0 < r["mfu"] <= 1):
+                kmfu = max(kmfu or 0.0, r["mfu"])
+        env = {"JAX_PLATFORMS": "cpu",
+               "SCTOOLS_BENCH_FORCE_PLATFORM": "cpu",
+               "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                             + " --xla_force_host_platform_device"
+                               "_count=8").strip()}
+        if kmfu:
+            env["SCTOOLS_BENCH_MESH_MFU"] = str(kmfu)
+        res = run_phase("mesh", min(420.0, remaining() - 60),
+                        env_overrides=env)
+        if "mesh" in res:
+            detail["config4_multichip"] = res["mesh"]
+        detail["phase_mesh"] = res.get("_phase")
 
     # the headline is only a TPU number when a child CONFIRMED a TPU
     # backend; anything else (CPU fallback, no phase ran, dead tunnel)

@@ -909,11 +909,23 @@ def stream_pipeline(src: ShardSource, *, n_top: int = 2000,
         n_components=n_components, target_sum=target_sum,
         checkpoint=ck_pca)
     if mesh is not None:
-        from ..parallel.knn_multichip import knn_multichip_arrays
+        # the kNN tail runs INSIDE the plan layer: the registered
+        # multichip op compiles as a ShardedCollective stage (the
+        # pipeline's mesh threaded into the call, counted under
+        # plan.sharded_stages, one retryable step when a runner owns
+        # it) instead of a hand-called dispatch around the planner
+        from ..data.dataset import CellData
+        from ..plan import fused_pipeline
+        from ..registry import Pipeline as _Pipeline
 
-        idx, dist = knn_multichip_arrays(
-            scores, k=k, metric=metric, mesh=mesh, n_valid=src.n_cells,
-            strategy="ring")
+        tail = fused_pipeline(
+            _Pipeline([("neighbors.knn_multichip",
+                        {"k": k, "metric": metric,
+                         "strategy": "ring"})], backend="tpu"),
+            mesh=mesh)
+        cd = tail.run(CellData(scores, obsm={"X_pca": scores}))
+        idx = cd.obsp["knn_indices"]
+        dist = cd.obsp["knn_distances"]
     elif knn_chunk is not None:
         # query-chunked search via the shared generator (ops/knn.py
         # iter_knn_chunks — also the bench atlas path's engine): ONE

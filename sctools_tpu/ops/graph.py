@@ -352,7 +352,8 @@ def diffusion_operator_cpu(data: CellData, symmetrize: bool = True) -> CellData:
 # ----------------------------------------------------------------------
 
 
-@register("impute.magic", backend="tpu")
+@register("impute.magic", backend="tpu", sharding="cells",
+          collective=True)
 def magic_tpu(data: CellData, t: int = 3, use_rep: str = "X",
               n_genes_out: int | None = None, mesh=None,
               strategy: str = "all_gather") -> CellData:

@@ -174,7 +174,8 @@ def _moments(data: CellData, device: bool, second: bool = False,
     return data.with_layers(**out)
 
 
-@register("velocity.moments", backend="tpu")
+@register("velocity.moments", backend="tpu", sharding="cells",
+          collective=True)
 def moments_tpu(data: CellData, second: bool = False,
                 mesh=None, strategy: str = "all_gather") -> CellData:
     """Adds layers["Ms"]/["Mu"] (kNN-smoothed spliced/unspliced);

@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from .mesh import CELL_AXIS
+from .mesh import CELL_AXIS, shard_map
 
 _STRATEGIES = ("all_gather", "ring")
 
@@ -143,9 +143,9 @@ def knn_matvec_sharded(knn_idx, weights, x, mesh,
         return _step_ring(idx_b, w_b, x_b, axis, n_dev)
 
     spec = P(axis)
-    return jax.shard_map(body, mesh=mesh,
-                         in_specs=(spec, spec, spec),
-                         out_specs=spec)(knn_idx, weights, x)
+    return shard_map(body, mesh=mesh,
+                     in_specs=(spec, spec, spec),
+                     out_specs=spec)(knn_idx, weights, x)
 
 
 def smooth_layers_sharded(knn_idx, weights, layers, mesh,
@@ -188,6 +188,6 @@ def diffuse_sharded(knn_idx, weights, x, mesh, t: int,
         return out
 
     spec = P(axis)
-    return jax.shard_map(body, mesh=mesh,
-                         in_specs=(spec, spec, spec),
-                         out_specs=spec)(knn_idx, weights, x)
+    return shard_map(body, mesh=mesh,
+                     in_specs=(spec, spec, spec),
+                     out_specs=spec)(knn_idx, weights, x)

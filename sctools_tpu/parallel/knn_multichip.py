@@ -31,7 +31,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..config import config, round_up
 from ..data.dataset import CellData
 from ..registry import register
-from .mesh import CELL_AXIS, make_mesh
+from .mesh import CELL_AXIS, make_mesh, pvary, shard_map
 
 
 def _merge_chunk(q, chunk, chunk_offset, running, *, k, metric, block,
@@ -159,8 +159,10 @@ def _knn_multichip_jit(pts, *, k, metric, n_valid, block, exclude_self,
 
     def vary(x):
         # shard_map's vma type system: constants are "invariant" until
-        # cast; scan carries must enter with their final (varying) type.
-        return jax.lax.pcast(x, (CELL_AXIS,), to="varying")
+        # cast; scan carries must enter with their final (varying) type
+        # (identity on jax versions that track replication implicitly
+        # — mesh.pvary is the compat shim).
+        return pvary(x, (CELL_AXIS,))
 
     def ring(q_local):
         shard = jax.lax.axis_index(CELL_AXIS)
@@ -207,7 +209,7 @@ def _knn_multichip_jit(pts, *, k, metric, n_valid, block, exclude_self,
         )
 
     fn = ring if strategy == "ring" else gather
-    vals, idx = jax.shard_map(
+    vals, idx = shard_map(
         fn, mesh=mesh, in_specs=P(CELL_AXIS, None),
         out_specs=(P(CELL_AXIS, None), P(CELL_AXIS, None)),
     )(pts)
@@ -220,17 +222,21 @@ def _knn_multichip_jit(pts, *, k, metric, n_valid, block, exclude_self,
     return idx, dists
 
 
-@register("neighbors.knn_multichip", backend="tpu")
+@register("neighbors.knn_multichip", backend="tpu",
+          sharding="cells", collective=True)
 def knn_multichip_tpu(data: CellData, k: int = 15, metric: str = "cosine",
                       use_rep: str = "X_pca", n_devices: int | None = None,
                       block: int | None = None, exclude_self: bool = False,
-                      strategy: str = "ring") -> CellData:
-    """Multi-device kNN over all available devices (or ``n_devices``).
-    Adds the same obsp/uns fields as ``neighbors.knn``."""
+                      strategy: str = "ring", mesh=None) -> CellData:
+    """Multi-device kNN over all available devices (or ``n_devices``,
+    or an explicit ``mesh=`` — how ``plan.fused_pipeline(mesh=...)``
+    threads its mesh into this collective stage).  Adds the same
+    obsp/uns fields as ``neighbors.knn``."""
     from ..ops.knn import _get_rep
 
     rep = _get_rep(data, use_rep)
-    mesh = make_mesh(n_devices)
+    if mesh is None:
+        mesh = make_mesh(n_devices)
     idx, dist = knn_multichip_arrays(
         rep, k=k, metric=metric, mesh=mesh, n_valid=data.n_cells,
         block=block, exclude_self=exclude_self, strategy=strategy,

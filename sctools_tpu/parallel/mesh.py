@@ -24,6 +24,64 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 CELL_AXIS = "cells"
 
 
+# ---------------------------------------------------------------------------
+# jax API compatibility: shard_map moved from jax.experimental to the
+# top level, and the manual-axes "varying" cast was renamed/introduced
+# across releases.  Every call site in this package goes through these
+# two shims so one jax upgrade lands in exactly one place.
+# ---------------------------------------------------------------------------
+
+
+def shard_map(f, mesh, in_specs, out_specs):
+    """``jax.shard_map`` where it exists, else the
+    ``jax.experimental.shard_map`` form (jax <= 0.4.x)."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+def pvary(x, axis_names=(CELL_AXIS,)):
+    """Cast a shard_map-invariant constant to the mesh-varying type
+    (``jax.lax.pcast(..., to="varying")`` on new jax, ``jax.lax
+    .pvary`` on intermediate releases).  Older jax tracks replication
+    per-value without an explicit cast, so the shim degrades to the
+    identity there — semantics are unchanged, only the vma type
+    system needs the hint."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, tuple(axis_names), to="varying")
+    pv = getattr(jax.lax, "pvary", None)
+    if pv is not None:
+        return pv(x, tuple(axis_names))
+    return x
+
+
+def active_mesh() -> Mesh | None:
+    """The mesh currently entered via ``with mesh:`` (jax's thread-
+    local mesh context), or ``None``.  The plan layer consults this
+    when ``fused_pipeline`` is called without an explicit ``mesh=``."""
+    try:
+        from jax._src import mesh as _mesh_lib
+
+        m = _mesh_lib.thread_resources.env.physical_mesh
+    except Exception:  # pragma: no cover - jax internals moved
+        return None
+    if m is None or getattr(m, "empty", False) or m.devices.size == 0:
+        return None
+    return m
+
+
+def mesh_signature(mesh: Mesh) -> tuple:
+    """Hashable, repr-stable identity of a mesh: axis names, shape and
+    the flat device ids.  A REBUILT mesh over the same devices yields
+    the same signature (plan-cache hit, identical checkpoint
+    fingerprints); a different device count/order does not."""
+    return (tuple(str(a) for a in mesh.axis_names),
+            tuple(int(mesh.shape[a]) for a in mesh.axis_names),
+            tuple(int(d.id) for d in mesh.devices.flat))
+
+
 def init_distributed(coordinator_address: str | None = None,
                      num_processes: int | None = None,
                      process_id: int | None = None) -> dict:
@@ -140,6 +198,15 @@ def shard_celldata(data, mesh: Mesh):
 
 
 def jnp_asarray(x):
+    """``jnp.asarray`` that PRESERVES an existing committed sharding:
+    a jax array already placed (sharded over a mesh, or pinned to a
+    device) passes through untouched — re-wrapping it with
+    ``jnp.asarray`` would re-place it on the default device, silently
+    gathering a sharded operand before the very ``device_put`` that
+    was about to shard it again (one extra full-array transfer per
+    call)."""
     import jax.numpy as jnp
 
+    if isinstance(x, jax.Array):
+        return x
     return jnp.asarray(x)

@@ -42,6 +42,25 @@ hot path.  The plan layer removes it structurally:
   values leak into control flow), the stage falls back to eager
   step-by-step execution with a warning and a ``plan.fallbacks``
   count — never a changed result.
+* MESH-SHARDED STAGES: with a device mesh (``fused_pipeline(mesh=)``,
+  or the mesh entered via ``with mesh:``) a fused stage compiles as
+  ONE program ACROSS THE MESH — per-leaf ``in_shardings`` built from
+  ``parallel.mesh.cell_sharding``/``replicated`` (an arriving
+  committed sharding on the same mesh is honoured, so a stage whose
+  producer already emitted matching shardings pays no reshard —
+  ``plan.reshards_avoided`` counts the boundary crossings that stayed
+  free), output leaves pinned by ``with_sharding_constraint`` under
+  the same rule so CONSECUTIVE stages hand over pre-partitioned
+  arrays (the pjit contract: outputs of one compiled stage match the
+  next's in_shardings).  Member ops that registered a COLLECTIVE body
+  (``register(..., collective=True)`` — the ppermute-ring kNN, the
+  sharded graph matvec family) cannot be traced under GSPMD; they
+  become a :class:`ShardedCollective` stage that threads the plan's
+  mesh into the op call.  The cache key gains the mesh signature
+  (axis names + shape + device ids) and the per-leaf PartitionSpecs:
+  a REBUILT identical mesh is a hit (zero retraces on the second run
+  of a sharded recipe), a different mesh is a miss
+  (``plan.mesh_cache_misses`` splits those from shape misses).
 
 >>> from sctools_tpu.plan import fused_pipeline
 >>> fast = fused_pipeline(seurat_pipeline())
@@ -68,6 +87,15 @@ from .utils import telemetry, trace
 _CACHE: dict = {}
 _CACHE_LOCK = threading.RLock()
 _FALLBACK = object()  # cache sentinel: this stage signature won't trace
+#: per-entry debug metadata (ops, backend, mesh+shape signature),
+#: written at insert under the same lock — cache_info()'s substrate
+_CACHE_META: dict = {}
+#: mesh-part index: base key (everything BUT the mesh) -> mesh parts
+#: seen, so a miss can be attributed to a mesh change vs a new chain
+_BY_BASE: dict = {}
+#: process-lifetime hit/miss tallies (metric counters are per
+#: MetricsRegistry; the debugging helper needs one process-wide view)
+_STATS = {"hits": 0, "misses": 0, "mesh_misses": 0}
 
 
 def plan_cache_stats() -> dict:
@@ -80,12 +108,36 @@ def plan_cache_stats() -> dict:
             "fallback": sum(1 for v in vals if v is _FALLBACK)}
 
 
+def cache_info() -> dict:
+    """Debugging view of the process-wide plan cache: process-lifetime
+    hit/miss tallies (``mesh_misses`` = misses attributable to a mesh
+    change on an already-seen chain) and one record per entry — the op
+    chain, backend, kind (compiled/fallback/sharded), traced leaf
+    shapes and the mesh signature (axis names, shape, device ids) it
+    was compiled against.  ``python -m tools.sctreport`` prints the
+    counter-level view from ``metrics.json``; this helper is the
+    in-process form with per-entry detail."""
+    with _CACHE_LOCK:
+        entries = []
+        for key, val in _CACHE.items():
+            meta = dict(_CACHE_META.get(key, {}))
+            meta["kind"] = ("fallback" if val is _FALLBACK
+                            else ("sharded" if meta.get("mesh")
+                                  else "compiled"))
+            entries.append(meta)
+        stats = dict(_STATS)
+    return {"n_entries": len(entries), "entries": entries, **stats}
+
+
 def clear_plan_cache() -> None:
     """Drop every compiled plan (tests; or after a ``config`` change
     that alters traced behaviour — the cache key covers op chain,
     params, shapes and backend, not global config knobs)."""
     with _CACHE_LOCK:
         _CACHE.clear()
+        _CACHE_META.clear()
+        _BY_BASE.clear()
+        _STATS.update(hits=0, misses=0, mesh_misses=0)
 
 
 # ---------------------------------------------------------------------------
@@ -159,6 +211,49 @@ def _freeze(v):
     return v
 
 
+# ---------------------------------------------------------------------------
+# Mesh sharding decisions
+# ---------------------------------------------------------------------------
+
+
+def _pm():
+    # parallel.mesh imported lazily: plan.py must stay importable
+    # before the parallel package (and its transform registrations)
+    from .parallel import mesh as pm
+
+    return pm
+
+
+def _rule_sharding(shape, mesh, n_dev: int, rule: str):
+    """The sharding one leaf gets under a partitioning rule:
+    ``"cells"`` shards the leading axis over the cell mesh axis when
+    it divides the device count (row-padded CellData leaves — X, obs
+    columns, obsm blocks), everything else replicates; ``"replicated"``
+    replicates outright (per-gene reductions, uns scalars)."""
+    pm = _pm()
+    if (rule != "replicated" and len(shape) >= 1 and shape[0]
+            and shape[0] % n_dev == 0):
+        return pm.cell_sharding(mesh, ndim=max(len(shape), 1))
+    return pm.replicated(mesh)
+
+
+def _pick_in_sharding(v, mesh, sig, n_dev: int):
+    """In-sharding for one traced input leaf: an arriving COMMITTED
+    NamedSharding on the same mesh (by signature) is honoured — that
+    leaf crosses the stage boundary with zero data movement, which is
+    the whole no-reshard contract — anything else gets the "cells"
+    rule."""
+    s = getattr(v, "sharding", None)
+    if (getattr(v, "committed", False) and s is not None
+            and hasattr(s, "mesh") and hasattr(s, "spec")):
+        try:
+            if _pm().mesh_signature(s.mesh) == sig:
+                return s
+        except Exception:  # pragma: no cover - exotic sharding type
+            pass
+    return _rule_sharding(getattr(v, "shape", ()), mesh, n_dev, "cells")
+
+
 class _StageProgram:
     """One compiled fused stage: the jitted callable plus the output
     reassembly spec captured at trace time.  ``out_map`` rebuilds the
@@ -200,17 +295,30 @@ class FusedTransform:
     ``with_backend`` returns an UNFUSED sequential chain on the new
     backend — the degrade-to-cpu ruling falls back to the oracle path
     step by step, exactly as an unfused pipeline would.
+
+    With ``mesh=`` the stage is MESH-SHARDED: it compiles with
+    per-leaf ``in_shardings`` and sharding-constrained outputs and
+    runs as one program across the mesh (module docstring).  The mesh
+    signature joins ``params`` — checkpoint fingerprints for a
+    sharded stage differ from the single-device form AND between
+    meshes, so a resume across a mesh change recomputes.  ``replan``
+    rebuilds the same member chain on fewer devices (``None`` →
+    single-device) — the runner's mesh-shrink degrade rung.
     """
 
     def __init__(self, members, backend: str | None = None,
-                 metrics=None, donate: bool = False):
+                 metrics=None, donate: bool = False, mesh=None):
         if not members:
             raise ValueError("FusedTransform needs at least one member")
         self.members = list(members)
         self.backend = backend or self.members[0].backend
-        self.name = "fused:" + "+".join(t.name for t in self.members)
+        self.mesh = mesh
+        prefix = "fused:" if mesh is None else "sharded:"
+        self.name = prefix + "+".join(t.name for t in self.members)
         self.params = {"ops": [(t.name, dict(t.params))
                                for t in self.members]}
+        if mesh is not None:
+            self.params["mesh"] = _pm().mesh_signature(mesh)
         self.metrics = metrics
         self.donate = donate
 
@@ -221,6 +329,17 @@ class FusedTransform:
         return _UnfusedChain(
             [t.with_backend(backend) for t in self.members],
             backend, self.name, self.params)
+
+    def replan(self, n_devices: int | None):
+        """The same member chain planned for ``n_devices`` (``None``
+        or ``<= 1`` → the plain single-device fused stage).  Never
+        donates: the caller is the runner's degrade ladder, and a
+        re-planned attempt must be able to replay its input."""
+        mesh = (_pm().make_mesh(n_devices)
+                if n_devices is not None and n_devices > 1 else None)
+        return FusedTransform(self.members, self.backend,
+                              metrics=self.metrics, donate=False,
+                              mesh=mesh)
 
     def __repr__(self):
         return (f"FusedTransform([{', '.join(t.name for t in self.members)}]"
@@ -268,16 +387,41 @@ class FusedTransform:
             data = t._fn(data, **t.params)
         return data
 
+    def _out_rule(self) -> str:
+        """Output partitioning rule for the stage: the LAST member's
+        registered ``sharding=`` declaration (its outputs are what
+        cross the boundary), default ``"cells"``."""
+        t = self.members[-1]
+        return (_registry.sharding_of(t.name, t.backend, t.params)
+                or "cells")
+
     def _execute(self, data):
         m = self._metrics()
         data = self._ensure_device(data)
         traced, opaque, treedef, mask = _split(data)
-        donate = bool(self.donate) and jax.default_backend() != "cpu"
+        mesh = self.mesh
+        # sharded stages never donate (a mesh re-plan after a failure
+        # must replay the stage input), and donation is a cpu no-op
+        donate = (bool(self.donate) and mesh is None
+                  and jax.default_backend() != "cpu")
+        in_shards = None
+        mesh_part = None
+        if mesh is not None:
+            pm = _pm()
+            sig = pm.mesh_signature(mesh)
+            n_dev = int(mesh.devices.size)
+            in_shards = [_pick_in_sharding(v, mesh, sig, n_dev)
+                         for v in traced]
+            # mesh shape + axis names + device ids + per-leaf
+            # PartitionSpec: a rebuilt identical mesh hashes the same
+            # (hit), any mesh/spec change is a miss
+            mesh_part = ("mesh", sig,
+                         tuple(str(s.spec) for s in in_shards))
         try:
             key = (self._ops_key(), treedef, mask,
                    tuple((tuple(v.shape), str(v.dtype)) for v in traced),
                    tuple(_opaque_token(v) for v in opaque),
-                   jax.default_backend(), donate)
+                   jax.default_backend(), donate, mesh_part)
         except TypeError as e:
             # unhashable param/opaque content: this chain cannot be
             # cached — run it eagerly rather than retrace forever
@@ -289,9 +433,21 @@ class FusedTransform:
             return self._run_eager(data)
         with _CACHE_LOCK:
             prog = _CACHE.get(key)
+            if prog is not None and prog is not _FALLBACK:
+                _STATS["hits"] += 1
         if prog is _FALLBACK:
             return self._run_eager(data)
         n_ops = len(self.members)
+        if mesh is not None:
+            m.counter("plan.sharded_stages").inc()
+            # boundary crossings that stayed free: device leaves that
+            # arrived already partitioned to the program's in_shardings
+            matched = sum(
+                1 for v, s in zip(traced, in_shards)
+                if getattr(v, "committed", False)
+                and getattr(v, "sharding", None) == s)
+            if matched:
+                m.counter("plan.reshards_avoided").inc(matched)
         with trace.span(f"plan:{self.name}",
                         meta={"backend": self.backend, "n_ops": n_ops,
                               "cached": prog is not None}):
@@ -302,26 +458,49 @@ class FusedTransform:
                 return prog.rebuild(out_traced, opaque)
             # miss: trace + compile + execute in one first call
             m.counter("plan.cache_misses").inc()
+            with _CACHE_LOCK:
+                _STATS["misses"] += 1
+                base = key[:-1]
+                seen = _BY_BASE.setdefault(base, set())
+                if mesh_part is not None and seen \
+                        and mesh_part not in seen:
+                    _STATS["mesh_misses"] += 1
+                    m.counter("plan.mesh_cache_misses").inc()
+                seen.add(mesh_part)
             box: dict = {}
             members = self.members
+            out_rule = self._out_rule() if mesh is not None else None
 
             def fused(traced_in):
                 d = _merge(traced_in, opaque, treedef, mask)
                 for t in members:
                     d = t._fn(d, **t.params)
                 out_traced, out_opaque, out_treedef, out_mask = _split(d)
+                if mesh is not None:
+                    # pin output partitioning so the NEXT sharded
+                    # stage's in_shardings match what leaves here —
+                    # the reshard-free boundary contract
+                    n_dev = int(mesh.devices.size)
+                    out_traced = [
+                        jax.lax.with_sharding_constraint(
+                            v, _rule_sharding(v.shape, mesh, n_dev,
+                                              out_rule))
+                        for v in out_traced]
                 box["spec"] = (out_opaque, out_treedef, out_mask)
                 return out_traced
 
-            jitted = jax.jit(fused,
-                             donate_argnums=(0,) if donate else ())
+            jit_kw: dict = {"donate_argnums": (0,) if donate else ()}
+            if mesh is not None:
+                jit_kw["in_shardings"] = (in_shards,)
+            jitted = jax.jit(fused, **jit_kw)
             try:
                 out_traced = jitted(traced)
-            except (jax.errors.JAXTypeError, TypeError,
+            except (jax.errors.JAXTypeError, TypeError, ValueError,
                     NotImplementedError) as e:
                 # the chain does not trace (host sync / concretisation
-                # inside a member): permanent eager fallback for this
-                # signature, identical results
+                # inside a member, or a sharding the chain cannot
+                # carry): permanent eager fallback for this signature,
+                # identical results
                 warnings.warn(
                     f"plan: tracing {self.name} failed "
                     f"({type(e).__name__}: {e}) — falling back to "
@@ -330,6 +509,7 @@ class FusedTransform:
                 m.counter("plan.fallbacks").inc()
                 with _CACHE_LOCK:
                     _CACHE[key] = _FALLBACK
+                    _CACHE_META[key] = self._cache_meta(traced)
                 return self._run_eager(data)
             out_opaque, out_treedef, out_mask = box["spec"]
             opaque_pos = {id(v): j for j, v in enumerate(opaque)}
@@ -340,8 +520,18 @@ class FusedTransform:
             prog = _StageProgram(jitted, out_treedef, out_mask, out_map)
             with _CACHE_LOCK:
                 _CACHE[key] = prog
+                _CACHE_META[key] = self._cache_meta(traced)
             m.counter("plan.fused_ops").inc(n_ops)
             return prog.rebuild(out_traced, opaque)
+
+    def _cache_meta(self, traced) -> dict:
+        return {
+            "ops": [t.name for t in self.members],
+            "backend": self.backend,
+            "shapes": [f"{tuple(v.shape)}:{v.dtype}" for v in traced],
+            "mesh": (None if self.mesh is None
+                     else self.params["mesh"]),
+        }
 
 
 class _UnfusedChain:
@@ -375,6 +565,74 @@ class _UnfusedChain:
                 f", backend={self.backend!r})")
 
 
+class ShardedCollective:
+    """A single member op with a registered COLLECTIVE body
+    (``register(..., collective=True)`` — the ppermute-ring kNN, the
+    sharded graph matvec family), executed as one sharded plan stage.
+
+    These implementations carry their own ``shard_map`` body and
+    manage their own compile cache (a jit keyed on the static mesh),
+    so the plan layer's job is placement, not tracing: thread the
+    plan's mesh into the call (``mesh=`` kwarg), present the stage as
+    one Transform-alike retryable step whose ``params`` carry the
+    mesh signature (checkpoint fingerprints differ between meshes),
+    and count it as a sharded stage.  ``with_backend`` falls back to
+    the plain registered op on the new backend (the cpu oracle path);
+    ``replan`` rebuilds on a smaller mesh — the degrade rung."""
+
+    def __init__(self, member: Transform, mesh, metrics=None):
+        self.member = member
+        self.mesh = mesh
+        self.backend = member.backend
+        self.name = "sharded:" + member.name
+        self.params = {"ops": [(member.name, dict(member.params))],
+                       "mesh": _pm().mesh_signature(mesh)}
+        self.metrics = metrics
+
+    @property
+    def members(self):  # symmetry with FusedTransform (runner, tests)
+        return [self.member]
+
+    def with_backend(self, backend: str):
+        if backend == self.backend:
+            return self
+        return Transform(self.member.name, backend=backend,
+                         **self.member.params)
+
+    def replan(self, n_devices: int | None):
+        """The same collective op planned for ``n_devices`` devices
+        (``None``/``<=1`` → a 1-device mesh: the op's collective body
+        still runs, with every collective a self-edge)."""
+        n = n_devices if n_devices is not None and n_devices >= 1 else 1
+        return ShardedCollective(self.member, _pm().make_mesh(n),
+                                 self.metrics)
+
+    def __call__(self, data, **overrides):
+        if overrides:
+            raise TypeError(
+                "ShardedCollective takes no per-call overrides — "
+                "member params are part of the plan")
+        fn = self._execute
+        if _registry._CALL_WRAPPERS:
+            fn = _registry._wrap_call(self.member.name, self.backend, fn)
+        return fn(data)
+
+    def _execute(self, data):
+        m = (self.metrics if self.metrics is not None
+             else telemetry.default_registry())
+        m.counter("plan.sharded_stages").inc()
+        with trace.span(f"plan:{self.name}",
+                        meta={"backend": self.backend, "n_ops": 1,
+                              "mesh_devices":
+                                  int(self.mesh.devices.size)}):
+            return self.member._fn(data, mesh=self.mesh,
+                                   **self.member.params)
+
+    def __repr__(self):
+        return (f"ShardedCollective({self.member.name!r}, "
+                f"devices={int(self.mesh.devices.size)})")
+
+
 # ---------------------------------------------------------------------------
 # Pipeline compilation
 # ---------------------------------------------------------------------------
@@ -382,7 +640,8 @@ class _UnfusedChain:
 
 def fused_pipeline(pipeline: Pipeline, backend: str | None = None,
                    *, no_fuse=(), min_run: int = 2,
-                   donate: bool = False, metrics=None) -> Pipeline:
+                   donate: bool = False, metrics=None,
+                   mesh=None) -> Pipeline:
     """Compile a :class:`Pipeline` into fused execution stages.
 
     Walks the step list and groups maximal runs of consecutive
@@ -394,13 +653,26 @@ def fused_pipeline(pipeline: Pipeline, backend: str | None = None,
     runs and everything else stay eager steps (single eager ops
     already amortise their compiles through jax's own jit cache).
 
+    ``mesh=`` (or a mesh entered via ``with mesh:`` —
+    ``parallel.mesh.active_mesh``) makes every fused stage
+    MESH-SHARDED: one program across the mesh with per-leaf
+    in_shardings and sharding-constrained outputs (module docstring).
+    Member ops that registered a collective body
+    (``registry.is_collective``) become their own
+    :class:`ShardedCollective` stage with the mesh threaded into the
+    call — how the multichip kNN and the sharded graph tail land
+    INSIDE plans instead of being hand-dispatched around them.
+
     ``donate=True`` lets stages past the pipeline's FIRST step donate
     their input buffers to the compiled program (device backends only;
-    a no-op on CPU).  Leave it off — the default — whenever the
-    caller, a checkpointing runner, or an aliasing op
-    (``util.snapshot_layer``) may still hold references into a stage's
-    input.  Returns a new Pipeline; the original is untouched.
+    a no-op on CPU, never on sharded stages).  Leave it off — the
+    default — whenever the caller, a checkpointing runner, or an
+    aliasing op (``util.snapshot_layer``) may still hold references
+    into a stage's input.  Returns a new Pipeline; the original is
+    untouched.
     """
+    if mesh is None:
+        mesh = _pm().active_mesh()
     steps = []
     for t in pipeline.steps:
         if backend is not None and t.backend != backend:
@@ -416,12 +688,21 @@ def fused_pipeline(pipeline: Pipeline, backend: str | None = None,
         if len(run) >= min_run:
             out.append(FusedTransform(
                 run, run[0].backend, metrics=metrics,
-                donate=donate and first_member_index > 0))
+                donate=donate and first_member_index > 0,
+                mesh=mesh))
         else:
             out.extend(run)
         run.clear()
 
     for i, t in enumerate(steps):
+        if (mesh is not None and isinstance(t, Transform)
+                and t.name not in no_fuse
+                and _registry.is_collective(t.name, t.backend,
+                                            t.params)):
+            # collective body: its own sharded stage, mesh threaded in
+            flush()
+            out.append(ShardedCollective(t, mesh, metrics=metrics))
+            continue
         fusable = (isinstance(t, Transform)
                    and t.name not in no_fuse
                    and _registry.is_fusable(t.name, t.backend, t.params))
@@ -447,9 +728,15 @@ def describe_plan(pipeline: Pipeline, backend: str | None = None,
     compiled = fused_pipeline(pipeline, backend=backend, **kw)
     lines = []
     for i, t in enumerate(compiled.steps):
-        if isinstance(t, FusedTransform):
+        if isinstance(t, ShardedCollective):
+            lines.append(f"[{i:02d}] SHARDED collective "
+                         f"({int(t.mesh.devices.size)} devices): "
+                         f"{t.member.name}")
+        elif isinstance(t, FusedTransform):
+            over = ("" if t.mesh is None else
+                    f", over {int(t.mesh.devices.size)} devices")
             lines.append(f"[{i:02d}] FUSED ({len(t.members)} ops, one "
-                         f"program): " +
+                         f"program{over}): " +
                          " -> ".join(m.name for m in t.members))
         else:
             why = ("not registered fusable"

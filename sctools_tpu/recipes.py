@@ -55,7 +55,7 @@ def recipe_pipeline(name: str, **kw) -> Pipeline:
 def run_recipe(name: str, data: CellData, *, backend: str | None = None,
                checkpoint_dir: str | None = None, resume: bool = True,
                step_deadline_s: float | None = None,
-               fuse: bool = False,
+               fuse: bool = False, mesh=None,
                runner_kw: dict | None = None, **recipe_kw) -> CellData:
     """Run a named recipe under the resilient execution layer.
 
@@ -96,6 +96,12 @@ def run_recipe(name: str, data: CellData, *, backend: str | None = None,
     one-call ``apply("recipe.*")`` forms fuse by default; here it is
     opt-in to keep existing checkpoint directories resumable.
 
+    ``mesh=`` (with ``fuse=True``; a ``parallel.make_mesh`` cell
+    mesh) compiles MESH-SHARDED stages — one program across the mesh
+    per stage, shard the input first with ``parallel.shard_celldata``
+    — and arms the runner's fewer-devices degrade rung
+    (docs/GUIDE.md "Making a recipe fast", multi-device walkthrough).
+
     >>> out = run_recipe("seurat", data, backend="tpu",
     ...                  checkpoint_dir="ck/", step_deadline_s=900,
     ...                  n_top_genes=2000)
@@ -108,9 +114,11 @@ def run_recipe(name: str, data: CellData, *, backend: str | None = None,
         # silently-discarded deadline budget is exactly the kind of
         # config drift the journal exists to rule out
         kw["step_deadline_s"] = step_deadline_s
+    # mesh without fuse raises in the ResilientRunner constructor —
+    # the guard lives on the mechanism, so direct runner users get it
     runner = ResilientRunner(recipe_pipeline(name, **recipe_kw),
                              checkpoint_dir=checkpoint_dir, fuse=fuse,
-                             **kw)
+                             mesh=mesh, **kw)
     return runner.run(data, backend=backend, resume=resume)
 
 
@@ -284,6 +292,32 @@ def recipe_weinreb17_cpu(data: CellData, log: bool = True,
                          n_comps: int = 50) -> CellData:
     return _weinreb17(data, "cpu", log, mean_threshold, cv_threshold,
                       n_comps)
+
+
+@_pipeline_recipe("atlas_knn")
+def atlas_knn_pipeline(n_top_genes: int = 2000, n_components: int = 50,
+                       k: int = 15, metric: str = "cosine",
+                       target_sum: float = 1e4,
+                       knn_strategy: str = "ring") -> Pipeline:
+    """The north-star atlas tail as ONE pipeline: count normalise →
+    log1p → HVG scoring (moment flavor — no subset materialisation,
+    so the whole preprocessing chain stays fusable) → scale → 50-PC
+    randomized PCA → multi-chip kNN.  Under
+    ``plan.fused_pipeline(mesh=...)`` this compiles to exactly two
+    sharded stages: one GSPMD program for preprocess+PCA and the
+    ppermute-ring kNN collective — the kNN+graph tail fused with
+    preprocessing instead of a per-chip dispatch loop around it.
+    Single-device (no mesh) it runs as one fused stage plus the
+    multichip op on a 1-device mesh."""
+    return Pipeline([
+        ("normalize.library_size", {"target_sum": target_sum}),
+        ("normalize.log1p", {}),
+        ("hvg.select", {"n_top": n_top_genes, "flavor": "seurat_v3"}),
+        ("normalize.scale", {"max_value": 10.0}),
+        ("pca.randomized", {"n_components": n_components}),
+        ("neighbors.knn_multichip", {"k": k, "metric": metric,
+                                     "strategy": knn_strategy}),
+    ])
 
 
 @_pipeline_recipe("pearson_residuals")

@@ -40,6 +40,27 @@ _DOCS: dict[str, str] = {}
 # materialisation) register a predicate instead of True.
 _FUSABLE: dict[str, dict[str, object]] = {}
 
+# mesh-execution metadata (plan.py's sharded stages):
+#
+# _SHARDING: name -> backend -> "cells" | "replicated" |
+#   predicate(params)->str.  Declares how the op's OUTPUT leaves
+#   should be partitioned when the op runs inside a mesh-sharded
+#   fused stage ("cells" = leading axis sharded over the cell mesh
+#   axis where divisible, the default heuristic; "replicated" = every
+#   output leaf replicated).  Consecutive fused stages apply the same
+#   rule to their in_shardings, which is what keeps stage boundaries
+#   reshard-free (SNIPPETS pjit contract: outputs of one compiled
+#   stage arrive pre-partitioned to match the next's in_shardings).
+#
+# _COLLECTIVE: name -> backend -> True | predicate(params)->bool.
+# Declares that the implementation carries its OWN collective body
+# (shard_map / ppermute ring — e.g. neighbors.knn_multichip) instead
+# of relying on GSPMD sharding propagation: the plan layer must not
+# trace it into a pjit stage but wrap it as a single sharded stage
+# that threads the plan's mesh into the call (plan.ShardedCollective).
+_SHARDING: dict[str, dict[str, object]] = {}
+_COLLECTIVE: dict[str, dict[str, object]] = {}
+
 DEFAULT_BACKEND = "tpu"
 
 # ---------------------------------------------------------------------------
@@ -97,7 +118,8 @@ class UnknownBackendError(KeyError):
 
 
 def register(name: str, backend: str = "tpu",
-             fusable=False) -> Callable[[Callable], Callable]:
+             fusable=False, sharding=None,
+             collective=False) -> Callable[[Callable], Callable]:
     """Decorator: register ``fn`` as the implementation of ``name`` for
     ``backend``.
 
@@ -109,6 +131,16 @@ def register(name: str, backend: str = "tpu",
     parameters (e.g. ``hvg.select``'s ``subset=True`` materialisation
     point).
 
+    ``sharding`` ("cells" | "replicated" | predicate(params)->str)
+    declares how the op's output leaves partition over a cell mesh
+    when it runs inside a mesh-sharded fused stage; unset means the
+    plan layer's default heuristic (leading axis cells-sharded where
+    it divides the mesh).  ``collective`` (True | predicate) declares
+    the implementation carries its own collective body (shard_map /
+    ppermute) — the plan layer then wraps it as a single sharded
+    stage, threading the plan's mesh into the call, instead of
+    tracing it under GSPMD.
+
     >>> @register("normalize.log1p", backend="tpu", fusable=True)
     ... def log1p_tpu(data, **kw): ...
     """
@@ -117,6 +149,10 @@ def register(name: str, backend: str = "tpu",
         _REGISTRY.setdefault(name, {})[backend] = fn
         if fusable:
             _FUSABLE.setdefault(name, {})[backend] = fusable
+        if sharding is not None:
+            _SHARDING.setdefault(name, {})[backend] = sharding
+        if collective:
+            _COLLECTIVE.setdefault(name, {})[backend] = collective
         if fn.__doc__ and name not in _DOCS:
             _DOCS[name] = fn.__doc__
         return fn
@@ -132,6 +168,33 @@ def is_fusable(name: str, backend: str, params: dict | None = None) -> bool:
     if callable(f):
         return bool(f(dict(params or {})))
     return bool(f)
+
+
+def is_collective(name: str, backend: str,
+                  params: dict | None = None) -> bool:
+    """True when the ``(name, backend)`` implementation declared a
+    collective body (``register(..., collective=...)``): the plan
+    layer runs it as its own sharded stage (mesh threaded into the
+    call) rather than tracing it into a pjit program."""
+    c = _COLLECTIVE.get(name, {}).get(backend, False)
+    if callable(c):
+        return bool(c(dict(params or {})))
+    return bool(c)
+
+
+def sharding_of(name: str, backend: str,
+                params: dict | None = None) -> str | None:
+    """The op's declared output-partitioning rule over a cell mesh
+    (``"cells"`` / ``"replicated"``), or ``None`` when the op left it
+    to the plan layer's default heuristic."""
+    s = _SHARDING.get(name, {}).get(backend)
+    if callable(s):
+        s = s(dict(params or {}))
+    if s is not None and s not in ("cells", "replicated"):
+        raise ValueError(
+            f"transform {name!r} declared sharding={s!r}; "
+            f"use 'cells' or 'replicated'")
+    return s
 
 
 def get(name: str, backend: str = DEFAULT_BACKEND) -> Callable:

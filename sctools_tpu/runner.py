@@ -332,6 +332,20 @@ class ResilientRunner:
         checkpoints land at stage granularity (different step
         fingerprints than the unfused pipeline — a fuse toggle across
         a resume recomputes).  Names in ``isolate`` are never fused.
+    mesh : jax.sharding.Mesh | None
+        With ``fuse=True``, compile MESH-SHARDED stages over this
+        device mesh (``plan.fused_pipeline(mesh=)``).  A sharded
+        stage is one retryable step whose degrade ruling is RE-PLAN
+        ON FEWER DEVICES: when a stage exhausts its retry budget the
+        runner shrinks the mesh (halving the device count), then
+        drops to the single-device fused form, and only then rules on
+        the backend fallback — two extra rungs in the retry →
+        breaker → degrade ladder that keep the run on the
+        accelerator.  Each shrink is journaled as a ``degrade`` event
+        with ``reason="mesh_shrink"`` and refreshes the step
+        fingerprints from the re-planned steps (they embed the mesh
+        signature, so checkpoints written before and after the shrink
+        never mix and a resume across the mesh change recomputes).
     metrics : telemetry.MetricsRegistry | None
         Where recovery counters (retries, degrades, breaker
         transitions, quarantines, checkpoint bytes, …) and the
@@ -356,7 +370,13 @@ class ResilientRunner:
                  step_deadline_s: float | None = None,
                  breaker: CircuitBreaker | None = None,
                  clock=None, sleep=None, metrics=None,
-                 fuse: bool = False):
+                 fuse: bool = False, mesh=None):
+        if mesh is not None and not fuse:
+            raise ValueError(
+                "ResilientRunner(mesh=...) shards fused execution "
+                "stages — pass fuse=True as well (an eager "
+                "step-by-step run ignores the mesh, silently "
+                "dropping the parallelism you asked for)")
         if fuse:
             # compile the pipeline into fused execution stages
             # (plan.fused_pipeline): each fused stage is ONE retryable
@@ -369,7 +389,8 @@ class ResilientRunner:
             from .plan import fused_pipeline
 
             pipeline = fused_pipeline(pipeline, no_fuse=isolate,
-                                      donate=False, metrics=metrics)
+                                      donate=False, metrics=metrics,
+                                      mesh=mesh)
         self.pipeline = pipeline
         self.checkpoint_dir = checkpoint_dir
         if checkpoint_dir:
@@ -792,8 +813,16 @@ class ResilientRunner:
                                          to="open").inc()
             if on_accel and not degraded and not self.breaker.allow():
                 # breaker OPEN: skip the remaining retries AND the
-                # probe — straight to the degrade ruling (this is the
-                # no-more-probe-storms contract)
+                # probe — straight to the degrade ruling.  For a
+                # mesh-sharded stage the ruling is RE-PLAN ON FEWER
+                # DEVICES first (shrink, then single-device); only
+                # when those rungs are spent does the run leave the
+                # accelerator for the fallback backend.
+                shrunk = self._replan_fewer_devices(steps, i, t)
+                if shrunk is not None:
+                    t = shrunk
+                    budget_used = 0
+                    continue
                 warnings.warn(
                     "ResilientRunner: circuit breaker OPEN "
                     f"({self.breaker.failure_threshold} transient "
@@ -824,6 +853,17 @@ class ResilientRunner:
                 self.metrics.counter("runner.retries").inc()
                 self.sleep(d)
                 continue
+            if not degraded:
+                # mesh-sharded stage out of budget: before ruling the
+                # whole backend unhealthy, RE-PLAN ON FEWER DEVICES —
+                # shrink the mesh (half the devices), then the
+                # single-device fused form; only when those rungs are
+                # spent does the run fall through to the cpu fallback
+                shrunk = self._replan_fewer_devices(steps, i, t)
+                if shrunk is not None:
+                    t = shrunk
+                    budget_used = 0  # fresh budget on the smaller mesh
+                    continue
             if (not degraded and self.fallback_backend
                     and b != self.fallback_backend):
                 if self._rule_unhealthy(where=f"step {i}"):
@@ -837,6 +877,44 @@ class ResilientRunner:
                 f"step {i} ({t.name!r}) failed {attempt} times on "
                 f"backend {b!r}; last error: "
                 f"{type(err).__name__}: {err}", self.report) from err
+
+    def _replan_fewer_devices(self, steps, i: int, t):
+        """The sharded-stage degrade rung: re-plan step ``i`` on half
+        the devices (→ single-device fused when the mesh bottoms out).
+        Returns the re-planned step (already swapped into ``steps``,
+        fingerprints for ``i..`` refreshed — they embed the mesh
+        signature, so checkpoints from the larger mesh never match
+        again) or ``None`` when the step is not sharded / already
+        single-device."""
+        mesh = getattr(t, "mesh", None)
+        replan = getattr(t, "replan", None)
+        if mesh is None or replan is None:
+            return None
+        n_dev = int(mesh.devices.size)
+        if n_dev <= 1:
+            return None
+        target = n_dev // 2 if n_dev // 2 > 1 else None
+        new_t = replan(target)
+        steps[i] = new_t
+        for j in range(i, len(steps)):
+            # the prefix chain embeds step i's mesh signature — every
+            # downstream fingerprint moves with it
+            self.report.steps[j].fingerprint = step_fingerprint(
+                steps, j, input_digest=self._input_digest)
+        new_mesh = getattr(new_t, "mesh", None)
+        to_dev = 1 if new_mesh is None else int(new_mesh.devices.size)
+        warnings.warn(
+            f"ResilientRunner: sharded step {i} ({t.name!r}) exhausted "
+            f"its retry budget on {n_dev} devices — RE-PLANNING on "
+            f"{to_dev} device(s) before ruling on a backend fallback.",
+            RuntimeWarning, stacklevel=3)
+        self.journal.write(
+            "degrade", step=i, reason="mesh_shrink",
+            from_devices=n_dev, to_devices=to_dev,
+            fingerprint=self.report.steps[i].fingerprint)
+        self.metrics.counter("runner.degrades",
+                             reason="mesh_shrink").inc()
+        return new_t
 
     # ------------------------------------------------------------------
     @staticmethod

@@ -65,7 +65,7 @@ EVENTS = frozenset({
     "attempt", "backoff", "deadline", "checkpoint",
     # containment ladder rulings
     "breaker_open", "breaker_close", "breaker_reopen",
-    "health_check", "fallback", "quarantine",
+    "health_check", "fallback", "degrade", "quarantine",
     # resume
     "resume", "resume_unverified_input", "resume_place_failed",
     # end-of-run telemetry artifacts
@@ -106,6 +106,16 @@ METRICS = {
                       "fused stages (the dispatch loop they skipped)",
     "plan.fallbacks": "counter: fused stages that failed to trace and "
                       "fell back to eager step-by-step execution",
+    "plan.sharded_stages": "counter: mesh-sharded stage executions "
+                           "(GSPMD-fused or collective-bodied)",
+    "plan.reshards_avoided": "counter: sharded-stage input leaves that "
+                             "arrived already partitioned to the "
+                             "stage's in_shardings (no boundary "
+                             "reshard)",
+    "plan.mesh_cache_misses": "counter: plan-cache misses attributable "
+                              "to a mesh change on an already-seen "
+                              "stage signature (a rebuilt identical "
+                              "mesh never counts)",
     "stream.overlap_s": "counter: prefetch worker seconds (decode + "
                         "pack + device_put) hidden behind consumer "
                         "compute",

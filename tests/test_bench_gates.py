@@ -79,6 +79,38 @@ def test_fusion_stage_speedup_and_cache_gate():
     assert 0.0 <= det["overlap_efficiency"] <= 1.0
 
 
+def test_mesh_stage_speedup_recall_and_cache_gate():
+    """The sharded-plan acceptance gate: bench's ``mesh`` stage must
+    show the mesh-sharded fused plan beating the per-chip dispatch
+    loop on the same host mesh, with kNN recall vs a single-device
+    exact search >= 0.999 (the MULTICHIP gate) and zero retraces
+    after the first compile.  One re-measure before failing: this box
+    has 2 cores and CI neighbours."""
+    import jax
+
+    from tools.bench_mesh import run_mesh_bench, v5e8_projection
+
+    det = run_mesh_bench(jax, n_cells=1024, n_genes=256, reps=3)
+    if det["speedup_vs_dispatch"] < 1.1:  # pragma: no cover - noisy box
+        det = run_mesh_bench(jax, n_cells=1024, n_genes=256, reps=3)
+    assert det["speedup_vs_dispatch"] > 1.0, det
+    assert det["knn_recall_vs_single"] >= 0.999, det
+    assert det["n_devices"] == 8
+    # steady-state reps after the first compile are all cache hits,
+    # and both sharded stage kinds ran every rep (warm + reps)
+    assert det["plan_counters"]["plan.cache_misses"] == 1.0, det
+    assert det["plan_counters"]["plan.cache_hits"] == float(det["reps"])
+    assert det["plan_counters"]["plan.sharded_stages"] == \
+        2.0 * (det["reps"] + 1)
+    proj = det["v5e8_projection_10M"]
+    assert proj["knn_compute_s_per_chip"] > 0
+    # a measured MFU anchors the projection; garbage values don't
+    assert v5e8_projection(0.55)["mfu_source"].startswith("measured")
+    # an out-of-range "measured" value is neither used NOR claimed
+    assert v5e8_projection(7.0)["mfu_anchor"] == 0.40
+    assert v5e8_projection(7.0)["mfu_source"].startswith("assumed")
+
+
 def test_flops_and_bytes_take_max():
     # compute-bound case: flops bound dominates the byte bound
     g = roofline_gate(1.0, flops=1e15, bytes_moved=1.0,

@@ -1,0 +1,325 @@
+"""Mesh-sharded execution plans: GSPMD-fused stages + collective
+stages, the mesh-aware plan cache (zero retraces on a rebuilt
+identical mesh — the acceptance gate), and the runner's re-plan-on-
+fewer-devices degrade ladder.  Everything runs on the conftest's
+8-device host-platform mesh with zero real sleeps."""
+
+import json
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from sctools_tpu.data.synthetic import synthetic_counts
+from sctools_tpu.ops.knn import recall_at_k
+from sctools_tpu.parallel import make_mesh, shard_celldata
+from sctools_tpu.parallel.mesh import mesh_signature
+from sctools_tpu.plan import (FusedTransform, ShardedCollective,
+                              cache_info, clear_plan_cache,
+                              describe_plan, fused_pipeline)
+from sctools_tpu.recipes import recipe_pipeline, run_recipe
+from sctools_tpu.registry import Pipeline, Transform
+from sctools_tpu.runner import ResilientRunner
+from sctools_tpu.utils.chaos import ChaosMonkey, Fault
+from sctools_tpu.utils.telemetry import MetricsRegistry
+
+
+def _data(n=256, g=96, seed=0):
+    return synthetic_counts(n, g, density=0.08, n_clusters=3, seed=seed)
+
+
+def _chain():
+    """All-fusable device chain → exactly one sharded GSPMD stage."""
+    return Pipeline([
+        ("normalize.library_size", {"target_sum": 1e4}),
+        ("normalize.log1p", {}),
+        ("hvg.select", {"n_top": 32, "flavor": "dispersion"}),
+        ("normalize.scale", {"max_value": 10.0}),
+    ], backend="tpu")
+
+
+def _atlas():
+    """Preprocess + PCA + multichip kNN: one GSPMD stage + one
+    collective stage under a mesh."""
+    return recipe_pipeline("atlas_knn", n_top_genes=32, n_components=8,
+                           k=8, metric="cosine")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    clear_plan_cache()
+    yield
+    clear_plan_cache()
+
+
+# ------------------------------------------------------------ stage split
+
+def test_mesh_plan_splits_gspmd_and_collective_stages():
+    mesh = make_mesh(8)
+    fp = fused_pipeline(_atlas(), mesh=mesh)
+    kinds = [type(t).__name__ for t in fp.steps]
+    assert kinds == ["FusedTransform", "ShardedCollective"]
+    stage, knn = fp.steps
+    assert stage.mesh is mesh
+    assert stage.name.startswith("sharded:normalize.library_size")
+    assert knn.name == "sharded:neighbors.knn_multichip"
+    # mesh signature rides in params → checkpoint fingerprints move
+    # with the mesh
+    assert stage.params["mesh"] == mesh_signature(mesh)
+    assert knn.params["mesh"] == mesh_signature(mesh)
+    text = describe_plan(_atlas(), mesh=mesh)
+    assert "over 8 devices" in text and "SHARDED collective" in text
+
+
+def test_active_mesh_context_shards_the_plan():
+    mesh = make_mesh(8)
+    with mesh:
+        fp = fused_pipeline(_chain())
+    assert isinstance(fp.steps[0], FusedTransform)
+    assert fp.steps[0].mesh is mesh
+    # outside the context nothing changes
+    fp2 = fused_pipeline(_chain())
+    assert fp2.steps[0].mesh is None
+
+
+# ------------------------------------------------- parity and the cache
+
+def test_sharded_plan_matches_single_device():
+    host = _data(300, 120)
+    mesh = make_mesh(8)
+    ref = _atlas().run(host.device_put())
+    out = fused_pipeline(_atlas(), mesh=mesh).run(
+        shard_celldata(host, mesh))
+    np.testing.assert_allclose(np.asarray(out.X)[:300],
+                               np.asarray(ref.X)[:300],
+                               rtol=1e-4, atol=1e-4)
+    r = recall_at_k(np.asarray(out.obsp["knn_indices"])[:300],
+                    np.asarray(ref.obsp["knn_indices"])[:300])
+    assert r >= 0.999, f"recall {r}"
+
+
+def test_zero_retraces_on_rebuilt_identical_mesh():
+    """THE acceptance gate: a second invocation of a sharded recipe —
+    fresh pipeline objects, fresh shard placement, REBUILT mesh over
+    the same devices — performs zero retraces."""
+    host = _data()
+    m = MetricsRegistry()
+
+    def run_once():
+        mesh = make_mesh(8)
+        fused_pipeline(_chain(), metrics=m, mesh=mesh).run(
+            shard_celldata(host, mesh))
+        c = m.snapshot_compact()
+        return (c.get("plan.cache_hits", 0.0),
+                c.get("plan.cache_misses", 0.0))
+
+    h1, m1 = run_once()
+    assert m1 == 1.0 and h1 == 0.0
+    h2, m2 = run_once()
+    assert m2 == m1, "second sharded run RETRACED"
+    assert h2 == h1 + 1
+    c = m.snapshot_compact()
+    assert c["plan.sharded_stages"] == 2.0
+    assert "plan.mesh_cache_misses" not in c
+
+
+def test_mesh_change_is_a_counted_miss():
+    host = _data()
+    m = MetricsRegistry()
+    for n_dev in (8, 4):
+        mesh = make_mesh(n_dev)
+        fused_pipeline(_chain(), metrics=m, mesh=mesh).run(
+            shard_celldata(host, mesh))
+    c = m.snapshot_compact()
+    assert c["plan.cache_misses"] == 2.0
+    assert c["plan.mesh_cache_misses"] == 1.0
+    info = cache_info()
+    assert info["n_entries"] == 2 and info["mesh_misses"] == 1
+    meshes = sorted(e["mesh"][1] for e in info["entries"])
+    assert meshes == [(4,), (8,)]
+
+
+def test_sharded_vs_unsharded_are_distinct_cache_entries():
+    host = _data()
+    m = MetricsRegistry()
+    mesh = make_mesh(8)
+    fused_pipeline(_chain(), metrics=m).run(host.device_put())
+    fused_pipeline(_chain(), metrics=m, mesh=mesh).run(
+        shard_celldata(host, mesh))
+    c = m.snapshot_compact()
+    assert c["plan.cache_misses"] == 2.0
+    kinds = sorted(e["kind"] for e in cache_info()["entries"])
+    assert kinds == ["compiled", "sharded"]
+
+
+def test_reshards_avoided_counts_presharded_inputs():
+    host = _data()
+    mesh = make_mesh(8)
+    m = MetricsRegistry()
+    sharded = shard_celldata(host, mesh)
+    fused_pipeline(_chain(), metrics=m, mesh=mesh).run(sharded)
+    c = m.snapshot_compact()
+    # the packed X (indices + data) arrives committed on the plan's
+    # mesh — those boundary crossings stay reshard-free
+    assert c.get("plan.reshards_avoided", 0.0) >= 2.0
+
+
+def test_cache_info_shape():
+    host = _data()
+    m = MetricsRegistry()
+    fused_pipeline(_chain(), metrics=m).run(host.device_put())
+    info = cache_info()
+    assert info["n_entries"] == 1 and info["misses"] == 1
+    (e,) = info["entries"]
+    assert e["kind"] == "compiled" and e["mesh"] is None
+    assert e["ops"][0] == "normalize.library_size"
+    assert any(":" in s for s in e["shapes"])
+
+
+# ------------------------------------------------ fingerprints + backend
+
+def test_fingerprints_differ_by_mesh_signature():
+    from sctools_tpu.utils.checkpoint import step_fingerprint
+
+    host_steps = fused_pipeline(_chain()).steps
+    m8_steps = fused_pipeline(_chain(), mesh=make_mesh(8)).steps
+    m4_steps = fused_pipeline(_chain(), mesh=make_mesh(4)).steps
+    fps = {step_fingerprint(s, 0) for s in
+           (host_steps, m8_steps, m4_steps)}
+    assert len(fps) == 3
+    # rebuilt identical mesh → identical fingerprint (resume works)
+    m8b = fused_pipeline(_chain(), mesh=make_mesh(8)).steps
+    assert step_fingerprint(m8b, 0) == step_fingerprint(m8_steps, 0)
+
+
+def test_collective_with_backend_falls_back_to_plain_transform():
+    mesh = make_mesh(8)
+    knn = fused_pipeline(_atlas(), mesh=mesh).steps[1]
+    assert isinstance(knn, ShardedCollective)
+    cpu = knn.with_backend("cpu")
+    assert isinstance(cpu, Transform)
+    assert cpu.name == "neighbors.knn_multichip"
+    assert cpu.backend == "cpu"
+    assert knn.with_backend("tpu") is knn
+
+
+def test_replan_ladder_shapes():
+    mesh = make_mesh(8)
+    stage = fused_pipeline(_chain(), mesh=mesh).steps[0]
+    s4 = stage.replan(4)
+    assert isinstance(s4, FusedTransform)
+    assert int(s4.mesh.devices.size) == 4
+    s1 = s4.replan(None)
+    assert s1.mesh is None and s1.name.startswith("fused:")
+    knn = fused_pipeline(_atlas(), mesh=mesh).steps[1]
+    k1 = knn.replan(None)
+    assert isinstance(k1, ShardedCollective)
+    assert int(k1.mesh.devices.size) == 1  # collective keeps a mesh
+
+
+# ----------------------------------------- runner: mesh-shrink degrade
+
+def _quiet_run(runner, data, backend="tpu"):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return runner.run(data, backend=backend)
+
+
+def test_runner_degrades_by_replanning_on_fewer_devices(tmp_path):
+    """A transient failure storm inside a sharded stage re-plans on a
+    shrunk mesh (journal: ``degrade`` reason=mesh_shrink 8 -> 4) and
+    COMPLETES on the accelerator — no cpu fallback, zero real
+    sleeps."""
+    host = _data(300, 120)
+    mesh = make_mesh(8)
+    monkey = ChaosMonkey([Fault("normalize.log1p", "unavailable",
+                                times=3)])
+    sleeps = []
+    r = ResilientRunner(_chain(), fuse=True, mesh=mesh, chaos=monkey,
+                        checkpoint_dir=str(tmp_path),
+                        probe=lambda: {"ok": True},
+                        sleep=sleeps.append)
+    out = _quiet_run(r, shard_celldata(host, mesh))
+    assert r.report.status == "completed"
+    assert not r.report.degraded  # stayed on the accelerator
+    ref = _chain().run(host.device_put())
+    np.testing.assert_allclose(np.asarray(out.X)[:300],
+                               np.asarray(ref.X)[:300],
+                               rtol=1e-4, atol=1e-4)
+    evs = [json.loads(l) for l in
+           open(os.path.join(str(tmp_path), "journal.jsonl"))]
+    deg = [e for e in evs if e["event"] == "degrade"]
+    assert len(deg) == 1
+    assert deg[0]["reason"] == "mesh_shrink"
+    assert (deg[0]["from_devices"], deg[0]["to_devices"]) == (8, 4)
+    # the shrink refreshed the journaled fingerprint to the 4-dev plan
+    steps4 = fused_pipeline(_chain(), mesh=make_mesh(4)).steps
+    from sctools_tpu.utils.checkpoint import step_fingerprint
+    assert deg[0]["fingerprint"] == step_fingerprint(
+        steps4, 0, input_digest=r.report.input_digest)
+    assert sleeps and all(isinstance(s, float) for s in sleeps)
+
+
+def test_runner_mesh_shrink_checkpoint_resume(tmp_path):
+    """Checkpoints written after the shrink carry the SHRUNK mesh's
+    fingerprints: a 4-device runner fully resumes from them, an
+    8-device runner matches nothing and recomputes."""
+    host = _data(300, 120)
+    mesh = make_mesh(8)
+    monkey = ChaosMonkey([Fault("normalize.log1p", "unavailable",
+                                times=3)])
+    r = ResilientRunner(_chain(), fuse=True, mesh=mesh, chaos=monkey,
+                        checkpoint_dir=str(tmp_path),
+                        probe=lambda: {"ok": True},
+                        sleep=lambda s: None)
+    _quiet_run(r, shard_celldata(host, mesh))
+
+    mesh4 = make_mesh(4)
+    r4 = ResilientRunner(_chain(), fuse=True, mesh=mesh4,
+                         checkpoint_dir=str(tmp_path),
+                         probe=lambda: {"ok": True},
+                         sleep=lambda s: None)
+    _quiet_run(r4, shard_celldata(host, mesh4))
+    assert r4.report.resumed_from == len(r4.report.steps) - 1
+
+    mesh8 = make_mesh(8)
+    r8 = ResilientRunner(_chain(), fuse=True, mesh=mesh8,
+                         checkpoint_dir=str(tmp_path),
+                         probe=lambda: {"ok": True},
+                         sleep=lambda s: None)
+    _quiet_run(r8, shard_celldata(host, mesh8))
+    assert r8.report.resumed_from is None  # fingerprints differ
+
+
+def test_runner_shrinks_collective_stage_too(tmp_path):
+    """The ladder also rules collective stages: a failing multichip
+    kNN re-plans onto a 4-device mesh and completes."""
+    host = _data(256, 96)
+    mesh = make_mesh(8)
+    pipe = Pipeline([
+        ("pca.randomized", {"n_components": 8}),
+        ("neighbors.knn_multichip", {"k": 8, "metric": "cosine"}),
+    ], backend="tpu")
+    monkey = ChaosMonkey([Fault("neighbors.knn_multichip",
+                                "unavailable", times=3)])
+    r = ResilientRunner(pipe, fuse=True, mesh=mesh, chaos=monkey,
+                        checkpoint_dir=str(tmp_path),
+                        probe=lambda: {"ok": True},
+                        sleep=lambda s: None)
+    out = _quiet_run(r, shard_celldata(host, mesh))
+    assert r.report.status == "completed"
+    evs = [json.loads(l) for l in
+           open(os.path.join(str(tmp_path), "journal.jsonl"))]
+    deg = [e for e in evs if e["event"] == "degrade"]
+    assert deg and deg[0]["reason"] == "mesh_shrink"
+    assert out.obsp["knn_indices"].shape[1] == 8
+
+
+def test_mesh_requires_fuse():
+    # the guard lives on the mechanism (ResilientRunner), so the
+    # recipe wrapper AND direct runner construction both get it
+    with pytest.raises(ValueError, match="fuse=True"):
+        run_recipe("atlas_knn", _data(), mesh=make_mesh(2))
+    with pytest.raises(ValueError, match="fuse=True"):
+        ResilientRunner(_chain(), mesh=make_mesh(2))

@@ -90,6 +90,63 @@ def test_sct001_ignores_unjitted_functions(tmp_path):
     assert rule_ids(r) == []
 
 
+def test_sct001_flags_host_sync_inside_shard_map_body(tmp_path):
+    """A shard_map body is traced exactly like a jitted function —
+    the collective bodies behind mesh-sharded plan stages must not be
+    a lint blind spot (catches both the jax.experimental form and the
+    parallel.mesh compat shim, matched on the trailing name)."""
+    r = lint_src(tmp_path, """
+        from jax.experimental.shard_map import shard_map
+
+        def outer(x, mesh, spec):
+            def body(xb):
+                t = jnp.sum(xb)
+                return xb * float(t)      # traced host sync
+            return shard_map(body, mesh=mesh, in_specs=spec,
+                             out_specs=spec)(x)
+        """, only=["SCT001"])
+    assert rule_ids(r) == ["SCT001"]
+    assert "body" in r.violations[0].message
+
+
+def test_sct001_clean_shard_map_body(tmp_path):
+    r = lint_src(tmp_path, """
+        from sctools_tpu.parallel.mesh import shard_map
+
+        def outer(x, mesh, spec):
+            def body(xb):
+                return xb * jax.lax.axis_index("cells")
+            return shard_map(body, mesh=mesh, in_specs=spec,
+                             out_specs=spec)(x)
+        """, only=["SCT001"])
+    assert rule_ids(r) == []
+
+
+def test_sct001_same_named_shard_map_bodies_each_resolve(tmp_path):
+    """Scope-aware resolution: two functions each defining a nested
+    ``body`` (the graph_multichip matvec/diffuse idiom) must each
+    lint THEIR OWN def — a flat module-wide name map would let the
+    second body's host sync escape."""
+    r = lint_src(tmp_path, """
+        from jax.experimental.shard_map import shard_map
+
+        def matvec(x, mesh, spec):
+            def body(xb):
+                return xb * 2.0                  # clean
+            return shard_map(body, mesh=mesh, in_specs=spec,
+                             out_specs=spec)(x)
+
+        def diffuse(x, mesh, spec):
+            def body(xb):
+                t = jnp.sum(xb)
+                return xb * float(t)             # traced host sync
+            return shard_map(body, mesh=mesh, in_specs=spec,
+                             out_specs=spec)(x)
+        """, only=["SCT001"])
+    assert rule_ids(r) == ["SCT001"]
+    assert r.violations[0].line > 10  # the SECOND body's sync
+
+
 # ---------------------------------------------------------------------------
 # SCT002 — python loop in jit
 # ---------------------------------------------------------------------------
@@ -151,6 +208,19 @@ def test_sct003_clean_when_listed_or_traced_by_design(tmp_path):
             return x
         """, only=["SCT003"])
     assert rule_ids(r) == []  # alpha: float, length: None default
+
+
+def test_sct003_covers_pjit_call_sites(tmp_path):
+    """jax.pjit is a jit form for the rule — a sharded entry point
+    with a shape-controlling kw-only arg missing from static_argnames
+    flags exactly like its jax.jit twin."""
+    r = lint_src(tmp_path, """
+        @partial(jax.pjit, static_argnames=())
+        def f(x, *, n_comps=8):
+            return x[:, :n_comps]
+        """, only=["SCT003"])
+    assert rule_ids(r) == ["SCT003"]
+    assert "'n_comps'" in r.violations[0].message
 
 
 def test_sct003_skips_unreadable_static_argnames(tmp_path):

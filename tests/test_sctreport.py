@@ -101,6 +101,46 @@ def test_malformed_lines_are_survived(tmp_path, capsys):
     assert "(no trace.json" in out and "(no metrics.json" in out
 
 
+def test_plan_cache_section_renders_when_plan_counters_exist(
+        tmp_path, capsys):
+    """metrics.json with ``plan.*`` counters gets a plan-cache
+    section (hit rate + the sharded-stage story); a metrics file
+    without them gets NO section (absence = nothing planned)."""
+    journal = (
+        '{"event": "run_start", "n_steps": 1, "backend": "tpu", '
+        '"steps": [{"index": 0, "name": "sharded:x", '
+        '"fingerprint": "f"}]}\n'
+        '{"event": "attempt", "step": 0, "name": "sharded:x", '
+        '"attempt": 1, "backend": "tpu", "status": "ok", '
+        '"wall_s": 0.1, "span_id": 1}\n'
+        '{"event": "degrade", "step": 0, "reason": "mesh_shrink", '
+        '"from_devices": 8, "to_devices": 4}\n'
+        '{"event": "run_completed", "degraded": false}\n')
+    (tmp_path / "journal.jsonl").write_text(journal)
+    (tmp_path / "metrics.json").write_text(json.dumps({
+        "schema": 1, "metrics": {"counters": {
+            "plan.cache_hits": 3.0, "plan.cache_misses": 1.0,
+            "plan.sharded_stages": 4.0, "plan.reshards_avoided": 6.0,
+            "plan.mesh_cache_misses": 1.0, "plan.fused_ops": 16.0,
+        }, "gauges": {}, "histograms": {}}}))
+    assert main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "-- plan cache --" in out
+    assert "hit rate 75%" in out
+    assert "sharded stages run: 4" in out
+    assert "reshards avoided: 6" in out
+    assert "mesh-change misses: 1" in out
+    # the mesh_shrink ruling is named with its device transition
+    assert "DEGRADE step 0 reason=mesh_shrink (8 -> 4 devices)" in out
+
+    # no plan counters -> no section
+    (tmp_path / "metrics.json").write_text(json.dumps({
+        "schema": 1, "metrics": {"counters": {"op.calls": 1.0},
+                                 "gauges": {}, "histograms": {}}}))
+    assert main([str(tmp_path)]) == 0
+    assert "-- plan cache --" not in capsys.readouterr().out
+
+
 def test_digest_splits_runs_and_tracks_statuses():
     events, bad = load_journal(os.path.join(FIXTURE, "journal.jsonl"))
     assert bad == 0

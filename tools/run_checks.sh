@@ -29,7 +29,13 @@
 #                     the fused-execution layer's zero-retrace
 #                     contract (docs/ARCHITECTURE.md "Execution
 #                     plans & fusion")
-#   6. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
+#   6. sharded-plan   the SAME contract for mesh-sharded stages, on an
+#                     8-device host-platform mesh (XLA_FLAGS forces
+#                     the virtual devices, so the mesh path is
+#                     exercised on this CPU-only box): a second
+#                     sharded run on a REBUILT identical mesh must be
+#                     a pure cache hit — zero retraces
+#   7. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
 
 set -u -o pipefail
 
@@ -127,6 +133,59 @@ then
     :
 else
     echo "plan-cache stage FAILED (rc=$?)"
+    fail=1
+fi
+
+stage "sharded-plan (second sharded run on a rebuilt mesh: zero retraces)"
+if JAX_PLATFORMS=cpu \
+   XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+   python - <<'PYEOF'
+import sys
+
+from sctools_tpu.data.synthetic import synthetic_counts
+from sctools_tpu.parallel import make_mesh, shard_celldata
+from sctools_tpu.plan import fused_pipeline
+from sctools_tpu.recipes import recipe_pipeline
+from sctools_tpu.utils.telemetry import MetricsRegistry
+
+host = synthetic_counts(512, 128, density=0.08, n_clusters=3, seed=0)
+pipe = recipe_pipeline("atlas_knn", n_top_genes=64, n_components=8,
+                       k=10)
+m = MetricsRegistry()
+
+
+def run_once():
+    # REBUILD mesh + plan + sharded placement every time: the
+    # zero-retrace contract must hold across fresh objects, not one
+    # cached pipeline instance
+    mesh = make_mesh(8)
+    fused_pipeline(pipe, metrics=m, mesh=mesh).run(
+        shard_celldata(host, mesh))
+    c = m.snapshot_compact()
+    return (c.get("plan.cache_hits", 0.0),
+            c.get("plan.cache_misses", 0.0),
+            c.get("plan.sharded_stages", 0.0))
+
+
+h1, m1, s1 = run_once()
+if m1 < 1:
+    sys.exit("first sharded run compiled no fused stage")
+if s1 < 2:
+    sys.exit(f"expected >=2 sharded stages (GSPMD + collective), "
+             f"got {s1}")
+h2, m2, s2 = run_once()
+if m2 != m1:
+    sys.exit(f"second sharded run RETRACED: cache_misses {m1} -> {m2}")
+if h2 <= h1:
+    sys.exit("second sharded run recorded no plan-cache hits")
+print(f"OK: rebuilt-mesh second run hit the plan cache "
+      f"({int(h2 - h1)} stage(s), 0 retraces, "
+      f"{int(s2)} sharded stage executions)")
+PYEOF
+then
+    :
+else
+    echo "sharded-plan stage FAILED (rc=$?)"
     fail=1
 fi
 

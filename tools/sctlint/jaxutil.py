@@ -124,6 +124,69 @@ def iter_jitted_functions(tree: ast.Module,
                 break
 
 
+def shard_map_bodies(tree: ast.Module, aliases: dict[str, str],
+                     seen_fn_ids: set[int]) -> list[JitInfo]:
+    """Functions passed BY NAME as the body of a ``shard_map`` call —
+    ``shard_map(body, mesh=..., in_specs=..., out_specs=...)`` (the
+    jax.shard_map / jax.experimental form, or this repo's
+    ``parallel.mesh.shard_map`` compat shim, matched by the trailing
+    attribute so relative imports resolve too).
+
+    A shard_map body is TRACED exactly like a jitted function, so a
+    host sync inside it is the same SCT001 hazard and a Python loop
+    over jnp ops unrolls the same way (SCT002) — without this, the
+    collective bodies behind the mesh-sharded execution plans would
+    be a lint blind spot.  Resolution is SCOPE-AWARE, not a flat
+    module-wide name map: two functions that each define a nested
+    ``body`` and shard_map it (graph_multichip's matvec + diffuse
+    pair) must each resolve to THEIR OWN def, or the second body
+    silently escapes linting.  Bodies passed through a variable
+    (``fn = ring if ... else gather``) stay invisible — heuristic,
+    like everything here."""
+    parents: dict[int, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+
+    def nearest_scope(node):
+        cur = parents.get(id(node))
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                      ast.Module)):
+            cur = parents.get(id(cur))
+        return cur
+
+    def defs_in_scope(scope, name):
+        # defs named `name` whose NEAREST function scope is `scope`
+        # (a def inside a deeper nested function belongs to that one)
+        return [n for n in ast.walk(scope)
+                if isinstance(n, ast.FunctionDef) and n.name == name
+                and n is not scope and nearest_scope(n) is scope]
+
+    out: list[JitInfo] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func, aliases)
+        if not name or name.split(".")[-1] != "shard_map":
+            continue
+        if not node.args or not isinstance(node.args[0], ast.Name):
+            continue
+        fn = None
+        scope = nearest_scope(node)
+        while scope is not None:
+            cands = defs_in_scope(scope, node.args[0].id)
+            if cands:
+                fn = cands[-1]  # later def wins, like runtime
+                break
+            scope = (None if isinstance(scope, ast.Module)
+                     else nearest_scope(scope))
+        if fn is not None and id(fn) not in seen_fn_ids:
+            seen_fn_ids.add(id(fn))
+            out.append(JitInfo(fn=fn, static_argnames=frozenset()))
+    return out
+
+
 # ---------------------------------------------------------------------------
 # registry.register detection
 # ---------------------------------------------------------------------------
@@ -225,6 +288,11 @@ class ModuleInfo:
         self.aliases = import_aliases(tree)
         self.jitted: list[JitInfo] = list(
             iter_jitted_functions(tree, self.aliases))
+        # shard_map bodies are traced contexts too (SCT001/SCT002
+        # apply inside them) — appended after the decorator scan so a
+        # body that is ALSO jit-decorated keeps its static_argnames
+        self.jitted.extend(shard_map_bodies(
+            tree, self.aliases, {id(j.fn) for j in self.jitted}))
         self.registered: list[RegisteredImpl] = list(
             iter_registered_impls(tree, self.aliases))
         tpu_roots = [r.fn for r in self.registered

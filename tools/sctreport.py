@@ -94,8 +94,8 @@ def digest_run(run: list[dict]) -> dict:
         "outcome": "INTERRUPTED (no terminal event)",
         "degraded": False, "resumed_from": None,
         "retries": [], "deadlines": [], "fallbacks": [],
-        "breaker": [], "quarantines": [], "health_checks": [],
-        "resume_notes": [],
+        "degrades": [], "breaker": [], "quarantines": [],
+        "health_checks": [], "resume_notes": [],
     }
     steps = d["steps"]
 
@@ -129,6 +129,11 @@ def digest_run(run: list[dict]) -> dict:
         elif ev == "fallback":
             d["fallbacks"].append(e)
             d["degraded"] = True
+        elif ev == "degrade":
+            # in-ladder ruling that KEEPS the run on the accelerator
+            # (mesh_shrink re-plan) — reported, but not a backend
+            # degrade
+            d["degrades"].append(e)
         elif ev.startswith("breaker_"):
             d["breaker"].append(e)
         elif ev == "quarantine":
@@ -246,6 +251,12 @@ def render(run_dir: str, runs: list[dict], trace_d: dict | None,
             add(f"  run {ri}: DEGRADE at {e.get('where')} -> "
                 f"backend={e.get('backend')}"
                 f" reason={e.get('reason', 'probe')}")
+        for e in r["degrades"]:
+            add(f"  run {ri}: DEGRADE step {e.get('step')} "
+                f"reason={e.get('reason')}"
+                + (f" ({e.get('from_devices')} -> "
+                   f"{e.get('to_devices')} devices)"
+                   if e.get("from_devices") is not None else ""))
         for e in r["quarantines"]:
             add(f"  run {ri}: QUARANTINE step {e.get('step')}: "
                 f"{e.get('reason')} -> {e.get('path')}")
@@ -271,6 +282,11 @@ def render(run_dir: str, runs: list[dict], trace_d: dict | None,
             f" attempt span(s) present in trace.json"
             f" ({trace_d['n_events']} trace events)")
 
+    plan = plan_cache_section(metrics)
+    if plan:
+        add("")
+        L.extend(plan)
+
     add("")
     add("-- metrics snapshot --")
     if metrics is None:
@@ -283,6 +299,45 @@ def render(run_dir: str, runs: list[dict], trace_d: dict | None,
             add(f"  {k:<56s} count={h.get('count')} "
                 f"sum={h.get('sum')} max={h.get('max')}")
     return "\n".join(L)
+
+
+def plan_cache_section(metrics) -> list[str]:
+    """The fused-execution plan-cache digest, rendered only when the
+    run recorded ``plan.*`` counters (a run that never fused has no
+    section — absence means 'nothing planned', not 'cache empty').
+    Derives the hit rate and the sharded-stage story (stages run,
+    boundary reshards avoided, misses attributable to a mesh
+    change)."""
+    if metrics is None:
+        return []
+    m = metrics.get("metrics", metrics)
+    counters = m.get("counters", {})
+    plan = {k: v for k, v in counters.items() if k.startswith("plan.")}
+    if not plan:
+        return []
+    L = ["-- plan cache --"]
+    hits = plan.get("plan.cache_hits", 0.0)
+    misses = plan.get("plan.cache_misses", 0.0)
+    total = hits + misses
+    L.append(f"  stage executions: {total:g}  (hits {hits:g} / "
+             f"misses {misses:g}"
+             + (f", hit rate {hits / total:.0%}" if total else "")
+             + ")")
+    if plan.get("plan.sharded_stages"):
+        L.append(f"  sharded stages run: "
+                 f"{plan['plan.sharded_stages']:g}  "
+                 f"(boundary reshards avoided: "
+                 f"{plan.get('plan.reshards_avoided', 0.0):g}, "
+                 f"mesh-change misses: "
+                 f"{plan.get('plan.mesh_cache_misses', 0.0):g})")
+    if plan.get("plan.fallbacks"):
+        L.append(f"  (!) eager fallbacks: {plan['plan.fallbacks']:g} "
+                 f"— a stage failed to trace; check the run's "
+                 f"warnings")
+    if plan.get("plan.fused_ops"):
+        L.append(f"  member ops executed inside fused stages: "
+                 f"{plan['plan.fused_ops']:g}")
+    return L
 
 
 # ---------------------------------------------------------------------------
