@@ -1299,6 +1299,29 @@ def phase_fusion():
         flush_result(fusion={"error": repr(e)[:300]}, backend=backend)
 
 
+def phase_graph():
+    """The post-kNN graph tail: tiled graph kernels (matvec / MAGIC
+    diffusion / jaccard) + the RCM locality reorder vs the legacy
+    whole-graph gather path.  The measurement lives in
+    ``tools/bench_graph.py``; the phase-level >=1.3x gate is enforced
+    by tests/test_bench_gates.py."""
+    jax, backend, on_tpu = _child_acquire("graph")
+    try:
+        from tools.bench_graph import run_graph_bench
+
+        det = run_graph_bench(jax)
+        stage("graph", **{k: v for k, v in det.items()
+                          if not isinstance(v, (dict, list))})
+        for s in det["per_size"]:
+            stage(f"graph.size{s['n_cells']}",
+                  **{k: v for k, v in s.items()
+                     if not isinstance(v, (dict, list))})
+        flush_result(graph=det, backend=backend)
+    except Exception as e:
+        stage("graph.error", error=repr(e)[:300])
+        flush_result(graph={"error": repr(e)[:300]}, backend=backend)
+
+
 def phase_mesh():
     """configs[4]: sharded fused plan vs per-chip dispatch on the
     8-device host-platform mesh (the orchestrator launches this child
@@ -1395,7 +1418,8 @@ def main():
             _WRITE_STAGE_FILE = False
         {"small": phase_small, "kernel": phase_kernel,
          "atlas": phase_atlas, "stream_io": phase_stream_io,
-         "fusion": phase_fusion, "mesh": phase_mesh}[args.phase]()
+         "fusion": phase_fusion, "mesh": phase_mesh,
+         "graph": phase_graph}[args.phase]()
         return 0
 
     stage("start", budget_s=BUDGET_S, stall_s=STALL_S,
@@ -1447,6 +1471,15 @@ def main():
         if "fusion" in res:
             detail["fusion"] = res["fusion"]
         detail["phase_fusion"] = res.get("_phase")
+
+    if args.config is None and not tpu_dead and remaining() > 120:
+        # the post-kNN graph tail: tiled kernels + locality reorder vs
+        # the legacy gather path (ISSUE 8's >=1.3x phase gate)
+        res = run_phase("graph", min(240.0, remaining() - 60))
+        note_tpu(res)
+        if "graph" in res:
+            detail["graph"] = res["graph"]
+        detail["phase_graph"] = res.get("_phase")
 
     atlas_route_env = {}
     if args.config is None and not tpu_dead and remaining() > 150:

@@ -102,6 +102,24 @@ class Config:
     # opt-in where a ~0.993 kernel-level recall is acceptable).
     knn_impl: str = "auto"
 
+    # Graph-tail kernel family (ops/pallas_graph.py): implementation
+    # behind graph.knn_matvec / knn_rmatvec / graph.jaccard and the
+    # t-SNE repulsion sweep.  "gather" = the legacy whole-graph
+    # gather/segment-sum path (the correctness fallback the escape
+    # hatch restores), "xla" = the blocked row-tiled twins (bitwise
+    # identical to gather, measured 5.5x on the CPU CI box at 32k
+    # cells), "pallas" = the banded one-hot Mosaic kernels
+    # (interpreter mode off-TPU — parity tests only), "auto" =
+    # pallas on a real TPU backend, xla elsewhere.
+    # Env: SCTOOLS_PALLAS_GRAPH (0 -> gather, 1 -> pallas, or an
+    # explicit impl name).
+    graph_impl: str = "auto"
+
+    def resolved_graph_impl(self) -> str:
+        from .ops.pallas_graph import resolved_impl
+
+        return resolved_impl()
+
     # Coarse top-k operator for the blocked XLA path: "topk" (exact
     # lax.top_k over each merged tile) or "approx"
     # (lax.approx_max_k on the fresh tile — the TPU-native binned
@@ -253,6 +271,29 @@ if os.environ.get("SCTOOLS_TPU_COL_BLOCK"):
     config.col_block = _cb
 if os.environ.get("SCTOOLS_TPU_PALLAS_INTERPRET"):
     config.pallas_interpret = os.environ["SCTOOLS_TPU_PALLAS_INTERPRET"]
+
+
+def _parse_graph_impl(val: str) -> str:
+    """SCTOOLS_PALLAS_GRAPH -> config.graph_impl.  ``0``/``false``
+    restore the legacy gather path byte-for-byte (the escape hatch
+    docs/ARCHITECTURE.md "Graph kernels & layout" documents);
+    ``1``/``true`` force the Pallas kernels; explicit impl names pass
+    through.  Unknown values raise — silently running gather while
+    the bench artifact records the bogus name is the same trap the
+    other env knobs guard against."""
+    alias = {"0": "gather", "false": "gather", "1": "pallas",
+             "true": "pallas"}
+    impl = alias.get(val.strip().lower(), val.strip().lower())
+    if impl not in ("auto", "gather", "xla", "pallas"):
+        raise ValueError(
+            f"SCTOOLS_PALLAS_GRAPH={val!r}: use 0/1, auto, gather, "
+            f"xla or pallas")
+    return impl
+
+
+if os.environ.get("SCTOOLS_PALLAS_GRAPH"):
+    config.graph_impl = _parse_graph_impl(
+        os.environ["SCTOOLS_PALLAS_GRAPH"])
 
 
 @contextmanager

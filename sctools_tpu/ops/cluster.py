@@ -752,26 +752,30 @@ def _serial_sweeps(idx2, w2, labels, resolution, n_rounds,
 
 
 @register("cluster.phenograph", backend="tpu")
-def phenograph_tpu(data: CellData, n_iter: int = 30) -> CellData:
+def phenograph_tpu(data: CellData, n_iter: int = 30,
+                   jaccard_block: int = 1024) -> CellData:
     """PhenoGraph: reweight the kNN graph by neighbour-set Jaccard
     similarity, then detect communities (label propagation +
     modularity merge — see cluster.leiden_like for the divergence
     note vs true Louvain).  Requires neighbors.knn.  Adds
-    obs["phenograph"], obsp["jaccard"]."""
+    obs["phenograph"], obsp["jaccard"].  ``jaccard_block`` forwards
+    to ``graph.jaccard``'s row-tile size (results are identical for
+    every value; it used to be unreachable from here)."""
     from .graph import jaccard_tpu
 
     if "jaccard" not in data.obsp:
-        data = jaccard_tpu(data)
+        data = jaccard_tpu(data, block=jaccard_block)
     out = leiden_like_tpu(data, n_iter=n_iter, weight_key="jaccard")
     return _as_phenograph(data, out)
 
 
 @register("cluster.phenograph", backend="cpu")
-def phenograph_cpu(data: CellData, n_iter: int = 30) -> CellData:
+def phenograph_cpu(data: CellData, n_iter: int = 30,
+                   jaccard_block: int = 1024) -> CellData:
     from .graph import jaccard_cpu
 
     if "jaccard" not in data.obsp:
-        data = jaccard_cpu(data)
+        data = jaccard_cpu(data, block=jaccard_block)
     out = leiden_like_cpu(data, n_iter=n_iter, weight_key="jaccard")
     return _as_phenograph(data, out)
 

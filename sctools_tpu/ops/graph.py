@@ -95,7 +95,8 @@ def connectivities_arrays(knn_idx, knn_dist, mode: str = "umap"):
     return jnp.where(jnp.isfinite(d), w, 0.0)
 
 
-@register("graph.connectivities", backend="tpu")
+@register("graph.connectivities", backend="tpu", fusable=True,
+          sharding="cells")
 def connectivities_tpu(data: CellData, mode: str = "umap") -> CellData:
     """Adds obsp["connectivities"] (aligned with knn_indices)."""
     idx, dist = _require_knn(data)
@@ -180,15 +181,45 @@ def jaccard_arrays(knn_idx, block: int = 1024):
     return out.reshape(-1, k)[:n]
 
 
-@register("graph.jaccard", backend="tpu")
+def _fusable_unless_pallas(_params: dict) -> bool:
+    """Fusability predicate for ops whose tpu body dispatches into
+    the tiled graph-kernel family: when the resolved impl is the
+    Pallas kernels (real TPU), the op must stay an EAGER step — a
+    ``pl.pallas_call`` cannot be traced inside a mesh-sharded
+    (GSPMD ``in_shardings``) fused stage, and the kernel dominates
+    the op's wall anyway, so fusion loses little.  Off-TPU (the
+    blocked-XLA twins) the op fuses as usual.  Evaluated at plan
+    build time, like every fusability predicate."""
+    from .pallas_graph import resolved_impl
+
+    return resolved_impl() != "pallas"
+
+
+@register("graph.jaccard", backend="tpu",
+          fusable=_fusable_unless_pallas, sharding="cells")
 def jaccard_tpu(data: CellData, block: int = 1024) -> CellData:
-    """Adds obsp["jaccard"] (aligned with knn_indices)."""
+    """Adds obsp["jaccard"] (aligned with knn_indices).  Runs through
+    the tiled graph-kernel family (ops/pallas_graph.py): the banded
+    Pallas kernel on TPU, the legacy blocked equality-mask pass
+    elsewhere — counts are exact integers, so results are identical
+    on every impl.  ``block`` is the row-tile size."""
+    from .pallas_graph import jaccard as _jaccard_tiled
+
     idx, _ = _require_knn(data)
-    return data.with_obsp(jaccard=jaccard_arrays(idx, block=block))
+    band = data.uns.get("graph_bandwidth")
+    return data.with_obsp(jaccard=_jaccard_tiled(
+        idx, block=block,
+        band_rows=int(band) if band is not None else None))
 
 
 @register("graph.jaccard", backend="cpu")
-def jaccard_cpu(data: CellData, **_ignored) -> CellData:
+def jaccard_cpu(data: CellData, block: int = 1024) -> CellData:
+    """Numpy set oracle.  ``block`` is accepted for signature parity
+    with the tpu backend — it is the device path's row-tile size and
+    has no effect on the sequential oracle (results are identical for
+    every value); it used to be swallowed by ``**_ignored``, which
+    silently accepted typos too."""
+    del block  # tiling knob; the oracle is row-sequential
     idx = np.asarray(data.obsp["knn_indices"])[: data.n_cells]
     n, k = idx.shape
     out = np.zeros((n, k), np.float32)
@@ -211,11 +242,11 @@ def jaccard_cpu(data: CellData, **_ignored) -> CellData:
 
 
 @jax.jit
-def knn_matvec(knn_idx, weights, x):
-    """``P @ x`` where P is the (n, k)-edge-list sparse matrix.
-
-    x: (n, d).  Gather-weight-sum along k; O(n·k·d).
-    """
+def _knn_matvec_gather(knn_idx, weights, x):
+    """The legacy whole-graph gather path of ``knn_matvec`` — kept
+    registered as the correctness fallback the
+    ``SCTOOLS_PALLAS_GRAPH=0`` escape hatch restores (the tiled
+    family in ops/pallas_graph.py is the hot path)."""
     safe = jnp.where(knn_idx < 0, 0, knn_idx)
     w = jnp.where(knn_idx < 0, 0.0, weights)
     gathered = jnp.take(x, safe, axis=0)  # (n, k, d)
@@ -223,10 +254,30 @@ def knn_matvec(knn_idx, weights, x):
                       precision=jax.lax.Precision.HIGHEST)
 
 
+def knn_matvec(knn_idx, weights, x, band_rows: int | None = None,
+               impl: str | None = None):
+    """``P @ x`` where P is the (n, k)-edge-list sparse matrix.
+
+    x: (n, d).  Gather-weight-sum along k; O(n·k·d).  Dispatches to
+    the tiled graph-kernel family (ops/pallas_graph.py —
+    ``config.graph_impl``): the blocked-XLA twin is bitwise identical
+    to the legacy gather; the Pallas banded kernel agrees to f32
+    reduction-order ulps.  ``band_rows`` (from
+    ``uns['graph_bandwidth']`` after ``graph.reorder``) bounds the
+    Pallas banded sweep; pass it STATICALLY when calling from inside
+    an enclosing ``jax.jit``; so must ``impl`` (see
+    ``pallas_graph.matvec`` — jitted callers thread the resolved
+    impl statically or their cached traces ignore config flips)."""
+    from .pallas_graph import matvec
+
+    return matvec(knn_idx, weights, x, band_rows=band_rows, impl=impl)
+
+
 @partial(jax.jit, static_argnames=("n",))
-def knn_rmatvec(knn_idx, weights, x, n: int | None = None):
-    """``Pᵀ @ x`` via segment-sum over edges (adjoint of knn_matvec;
-    used for reverse-mode flows and left-eigenvector iterations)."""
+def _knn_rmatvec_segsum(knn_idx, weights, x, n: int | None = None):
+    """Legacy segment-sum path of ``knn_rmatvec`` (the xla/gather
+    impls of the tiled family share it — its (n, k, d) intermediate
+    is small for the d=1..T callers)."""
     n = n if n is not None else x.shape[0]
     safe = jnp.where(knn_idx < 0, n, knn_idx)  # dropped bin
     w = jnp.where(knn_idx < 0, 0.0, weights)
@@ -234,6 +285,19 @@ def knn_rmatvec(knn_idx, weights, x, n: int | None = None):
     flat = contrib.reshape(-1, x.shape[-1])
     out = jax.ops.segment_sum(flat, safe.reshape(-1), num_segments=n + 1)
     return out[:n]
+
+
+def knn_rmatvec(knn_idx, weights, x, n: int | None = None,
+                band_rows: int | None = None,
+                impl: str | None = None):
+    """``Pᵀ @ x`` via segment-sum over edges (adjoint of knn_matvec;
+    used for reverse-mode flows and left-eigenvector iterations).
+    Dispatches like :func:`knn_matvec` — the Pallas path runs the
+    transposed banded kernel."""
+    from .pallas_graph import rmatvec
+
+    return rmatvec(knn_idx, weights, x, n=n, band_rows=band_rows,
+                   impl=impl)
 
 
 @partial(jax.jit, static_argnames=("mode",))
@@ -290,7 +354,8 @@ def _symmetrized_weights(idx, w, block: int = 8192, mode: str = "average"):
     return out.reshape(-1, k)[:n]
 
 
-@register("graph.diffusion_operator", backend="tpu")
+@register("graph.diffusion_operator", backend="tpu", fusable=True,
+          sharding="cells")
 def diffusion_operator_tpu(data: CellData, symmetrize: bool = True) -> CellData:
     """Row-normalised diffusion weights from connectivities.
 
@@ -353,7 +418,9 @@ def diffusion_operator_cpu(data: CellData, symmetrize: bool = True) -> CellData:
 
 
 @register("impute.magic", backend="tpu", sharding="cells",
-          collective=True)
+          collective=True,
+          fusable=lambda p: (not p.get("mesh")
+                             and _fusable_unless_pallas(p)))
 def magic_tpu(data: CellData, t: int = 3, use_rep: str = "X",
               n_genes_out: int | None = None, mesh=None,
               strategy: str = "all_gather") -> CellData:
@@ -389,8 +456,11 @@ def magic_tpu(data: CellData, t: int = 3, use_rep: str = "X",
                               strategy=strategy)[:n]
         return data.with_obsm(X_magic=out).with_uns(magic_t=t)
 
+    band = data.uns.get("graph_bandwidth")
+    band = int(band) if band is not None else None
+
     def step(x, _):
-        return knn_matvec(idx, p, x), None
+        return knn_matvec(idx, p, x, band_rows=band), None
 
     out, _ = jax.lax.scan(step, Xd, None, length=t)
     return data.with_obsm(X_magic=out).with_uns(magic_t=t)
@@ -439,12 +509,19 @@ def _sym_normalized_edges(idx, w):
     return s, deg, inv_sqrt
 
 
-@partial(jax.jit, static_argnames=("n_comps", "n_iter"))
+@partial(jax.jit, static_argnames=("n_comps", "n_iter", "band_rows",
+                                   "graph_impl"))
 def diffusion_eigs(knn_idx, s_edges, key, n_comps: int = 15,
-                   n_iter: int = 60):
+                   n_iter: int = 60, band_rows: int | None = None,
+                   graph_impl: str | None = None):
     """Leading eigenpairs of the symmetric normalised operator S via
     subspace iteration with CholeskyQR2 + Rayleigh–Ritz (matrix-free:
-    only knn_matvec).  Ordered by descending eigenvalue."""
+    only knn_matvec).  Ordered by descending eigenvalue.
+    ``band_rows`` (static — the reordered graph's bandwidth from
+    ``graph.reorder``) bounds the banded matvec sweep on the Pallas
+    path; ``graph_impl`` (static) pins the tiled-family impl so a
+    ``configure(graph_impl=)`` flip re-keys this jit's cache instead
+    of being ignored by an earlier trace."""
     from .pca import cholesky_qr
 
     n = knn_idx.shape[0]
@@ -455,11 +532,14 @@ def diffusion_eigs(knn_idx, s_edges, key, n_comps: int = 15,
         # shift: (S + I)/2 maps spectrum to [0, 1] so the largest
         # *algebraic* eigenvalues dominate the iteration, not the
         # largest-magnitude (possibly negative) ones
-        V = 0.5 * (knn_matvec(knn_idx, s_edges, V) + V)
+        V = 0.5 * (knn_matvec(knn_idx, s_edges, V,
+                              band_rows=band_rows,
+                              impl=graph_impl) + V)
         return cholesky_qr(V), None
 
     V, _ = jax.lax.scan(step, V, None, length=n_iter)
-    SV = knn_matvec(knn_idx, s_edges, V)
+    SV = knn_matvec(knn_idx, s_edges, V, band_rows=band_rows,
+                    impl=graph_impl)
     H = jnp.dot(V.T, SV, preferred_element_type=jnp.float32,
                 precision=jax.lax.Precision.HIGHEST)
     evals, W = jnp.linalg.eigh(0.5 * (H + H.T))
@@ -481,10 +561,16 @@ def spectral_tpu(data: CellData, n_comps: int = 15, seed: int = 0,
         data = connectivities_tpu(data)
     idx, _ = _require_knn(data)
     w = jnp.asarray(data.obsp["connectivities"])[: data.n_cells]
+    from .pallas_graph import resolved_impl
+
     s, deg, inv_sqrt = _sym_normalized_edges(idx, w)
     extra = 1 if drop_first else 0
+    band = data.uns.get("graph_bandwidth")
     evals, phi = diffusion_eigs(idx, s, jax.random.PRNGKey(seed),
-                                n_comps=n_comps + extra)
+                                n_comps=n_comps + extra,
+                                band_rows=(int(band) if band is not None
+                                           else None),
+                                graph_impl=resolved_impl())
     psi = phi * inv_sqrt[:, None]
     psi = psi / jnp.maximum(jnp.linalg.norm(psi, axis=0, keepdims=True), 1e-12)
     if drop_first:
@@ -668,6 +754,212 @@ def paga_tpu(data: CellData, groups: str = "leiden") -> CellData:
 @register("graph.paga", backend="cpu")
 def paga_cpu(data: CellData, groups: str = "leiden") -> CellData:
     return _paga_impl(data, groups)
+
+
+# ----------------------------------------------------------------------
+# graph.reorder — one-shot locality pass (RCM over the kNN graph)
+# ----------------------------------------------------------------------
+
+
+def reorder_permutation(knn_idx, method: str = "rcm") -> np.ndarray:
+    """Row permutation (new → old) that clusters the kNN graph's
+    edges around the diagonal.  ``"rcm"`` is reverse Cuthill–McKee on
+    the symmetrised edge pattern (scipy's bandwidth-minimising
+    ordering — the AutoGNN-style hardware preprocessing step);
+    ``"natural"`` is the identity (tests / A-B baselines)."""
+    idx = np.asarray(knn_idx)
+    n, k = idx.shape
+    if method == "natural":
+        return np.arange(n, dtype=np.int64)
+    if method != "rcm":
+        raise ValueError(f"unknown reorder method {method!r}; "
+                         "use 'rcm' or 'natural'")
+    import scipy.sparse as sp
+    from scipy.sparse.csgraph import reverse_cuthill_mckee
+
+    rows = np.repeat(np.arange(n), k)
+    cols = idx.reshape(-1)
+    keep = cols >= 0
+    W = sp.csr_matrix(
+        (np.ones(int(keep.sum()), np.float32),
+         (rows[keep], cols[keep])), shape=(n, n))
+    W = (W + W.T).tocsr()
+    perm = np.asarray(reverse_cuthill_mckee(W, symmetric_mode=True))
+    return perm.astype(np.int64)
+
+
+def graph_bandwidth(knn_idx) -> int:
+    """Max |i − j| over the stored edges — the banded Pallas sweep's
+    window bound (0 for an edgeless graph)."""
+    idx = np.asarray(knn_idx)
+    n = idx.shape[0]
+    rows = np.repeat(np.arange(n), idx.shape[1]).reshape(idx.shape)
+    d = np.abs(idx - rows)[idx >= 0]
+    return int(d.max()) if d.size else 0
+
+
+def tile_density(knn_idx, block: int = 256) -> float:
+    """Fraction of stored edges within one ``block``-row band of the
+    diagonal — the locality the tiled kernels exploit (gauge
+    ``graph.tile_density``).  1.0 = every gather hits the diagonal
+    tile neighbourhood."""
+    idx = np.asarray(knn_idx)
+    n = idx.shape[0]
+    rows = np.repeat(np.arange(n), idx.shape[1]).reshape(idx.shape)
+    valid = idx >= 0
+    if not valid.any():
+        return 1.0
+    close = (np.abs(idx - rows) < block) & valid
+    return float(close.sum() / valid.sum())
+
+
+def invalidate_graph_layout_stats(data: CellData) -> CellData:
+    """Drop the graph-layout STATISTICS (``graph_bandwidth`` /
+    ``graph_tile_density``) from uns.  Every op that REPLACES
+    ``obsp['knn_indices']`` (neighbors.knn / bbknn / knn_multichip)
+    must call this: the band was measured on the old graph, and a
+    stale band would make the Pallas banded sweep silently skip any
+    new edge outside the old window — wrong results, invisible to
+    the CPU parity suite (the xla/gather impls ignore the band).
+    The permutation itself stays: it describes the ROW layout, which
+    a kNN rebuild does not change (``graph.restore_order`` can still
+    undo it); the rebuilt graph simply runs full-sweep until the
+    next ``graph.reorder``."""
+    if ("graph_bandwidth" not in data.uns
+            and "graph_tile_density" not in data.uns):
+        return data
+    uns = {k: v for k, v in data.uns.items()
+           if k not in ("graph_bandwidth", "graph_tile_density")}
+    return data.replace(uns=uns)
+
+
+def _remap_edge_values(arr: np.ndarray, inv: np.ndarray) -> np.ndarray:
+    """Old row ids → new row ids inside an index-valued obsp array
+    (-1 padding preserved)."""
+    safe = np.where(arr < 0, 0, arr)
+    return np.where(arr < 0, arr, inv[safe]).astype(arr.dtype)
+
+
+def _apply_permutation(data: CellData, perm: np.ndarray) -> CellData:
+    """Row-permute every per-cell field of ``data`` (new row i = old
+    row ``perm[i]``), remapping index-valued obsp arrays (names
+    ending ``indices``) into the new row space.  obsp is stashed and
+    re-attached around the ``data[perm]`` subset (which by design
+    drops pairwise graphs on cell subsets — a permutation is the one
+    subset that keeps them valid)."""
+    n = data.n_cells
+    perm = np.asarray(perm, np.int64)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(len(perm), dtype=np.int64)
+    obsp = data.obsp
+    base = data.replace(obsp={})[perm]
+    new_obsp = {}
+    for key, v in obsp.items():
+        a = np.asarray(v)[:n]
+        if a.dtype.kind in "iu" and key.endswith("indices"):
+            a = _remap_edge_values(a, inv)
+        new_obsp[key] = a[perm]
+    return base.replace(obsp=new_obsp)
+
+
+def _reorder_impl(data: CellData, method: str,
+                  block: int = 256) -> CellData:
+    import time
+
+    from ..utils import telemetry
+
+    if "graph_perm" in data.uns:
+        import warnings
+
+        warnings.warn(
+            "graph.reorder: data already carries a layout permutation "
+            "(uns['graph_perm']) — run graph.restore_order first; "
+            "returning the input unchanged", stacklevel=3)
+        return data
+    idx, _ = _require_knn(data)
+    idx_h = np.asarray(idx)
+    m = telemetry.default_registry()
+    t0 = time.perf_counter()
+    m.gauge("graph.tile_density", layout="natural").set(
+        tile_density(idx_h, block=block))
+    perm = reorder_permutation(idx_h, method=method)
+    out = _apply_permutation(data, perm)
+    new_idx = np.asarray(out.obsp["knn_indices"])
+    bw = graph_bandwidth(new_idx)
+    density = tile_density(new_idx, block=block)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(len(perm), dtype=np.int64)
+    out = out.with_uns(
+        graph_perm=perm.astype(np.int32),
+        graph_perm_inv=inv.astype(np.int32),
+        # plain python scalars ON PURPOSE: they ride plan-cache keys
+        # as opaque content (the band is baked statically into the
+        # compiled kernels, so a bandwidth change MUST be a cache
+        # miss), while the perm arrays stay traced leaves (layout-
+        # agnostic programs rightly hit across different perms)
+        graph_bandwidth=int(bw),
+        graph_tile_density=float(density),
+        graph_reorder_method=str(method))
+    m.gauge("graph.tile_density", layout="reordered").set(density)
+    m.counter("graph.reorder_s").inc(time.perf_counter() - t0)
+    return out
+
+
+@register("graph.reorder", backend="tpu")
+def reorder_tpu(data: CellData, method: str = "rcm",
+                block: int = 256) -> CellData:
+    """One-shot locality pass: permute rows (cells) so kNN
+    neighbours sit near the diagonal, making every downstream
+    iterative graph kernel sweep dense tiles instead of the whole
+    table (docs/ARCHITECTURE.md "Graph kernels & layout").  Computes
+    an RCM ordering from ``obsp['knn_indices']``, permutes
+    X/obs/obsm/layers/obsp (index-valued arrays remapped), and
+    records ``uns['graph_perm'/'graph_perm_inv'/'graph_bandwidth'/
+    'graph_tile_density']`` so kernels pick up the band, checkpoints
+    fingerprint the layout, and ``graph.restore_order`` can undo it
+    at the recipe boundary.  Host pass, identical on both backends;
+    ``block`` is the tile size the density gauge is scored against."""
+    return _reorder_impl(data, method, block)
+
+
+@register("graph.reorder", backend="cpu")
+def reorder_cpu(data: CellData, method: str = "rcm",
+                block: int = 256) -> CellData:
+    return _reorder_impl(data, method, block)
+
+
+def _restore_impl(data: CellData) -> CellData:
+    import time
+
+    from ..utils import telemetry
+
+    if "graph_perm" not in data.uns:
+        return data  # natural layout already — the boundary is a no-op
+    t0 = time.perf_counter()
+    inv = np.asarray(data.uns["graph_perm_inv"], np.int64)
+    out = _apply_permutation(data, inv)
+    uns = {k: v for k, v in out.uns.items()
+           if k not in ("graph_perm", "graph_perm_inv",
+                        "graph_bandwidth", "graph_tile_density",
+                        "graph_reorder_method")}
+    telemetry.default_registry().counter("graph.reorder_s").inc(
+        time.perf_counter() - t0)
+    return out.replace(uns=uns)
+
+
+@register("graph.restore_order", backend="tpu")
+def restore_order_tpu(data: CellData) -> CellData:
+    """Undo ``graph.reorder``: inverse-permute every per-cell field
+    back to the natural row order and drop the layout keys from uns —
+    the recipe-boundary step, so results leave the pipeline in the
+    caller's row order (bitwise round-trip, tests/
+    test_graph_reorder.py).  A no-op on natural-layout data."""
+    return _restore_impl(data)
+
+
+@register("graph.restore_order", backend="cpu")
+def restore_order_cpu(data: CellData) -> CellData:
+    return _restore_impl(data)
 
 
 # ----------------------------------------------------------------------

@@ -426,6 +426,9 @@ def knn_tpu(data: CellData, k: int = 15, metric: str = "cosine",
         n_cand=data.n_cells, exclude_self=exclude_self,
         query_block=query_block, cand_block=cand_block, refine=refine,
     )
+    from .graph import invalidate_graph_layout_stats
+
+    data = invalidate_graph_layout_stats(data)
     return data.with_obsp(knn_indices=idx, knn_distances=dist).with_uns(
         knn_k=k, knn_metric=metric
     )
@@ -458,6 +461,9 @@ def knn_cpu(data: CellData, k: int = 15, metric: str = "cosine",
     rep = np.asarray(_get_rep_cpu(data, use_rep), dtype=np.float64)
     idx, dist = knn_numpy(rep, rep, k=k, metric=metric,
                           exclude_self=exclude_self)
+    from .graph import invalidate_graph_layout_stats
+
+    data = invalidate_graph_layout_stats(data)
     return data.with_obsp(knn_indices=idx, knn_distances=dist).with_uns(
         knn_k=k, knn_metric=metric
     )
@@ -626,6 +632,9 @@ def bbknn_tpu(data: CellData, batch_key: str = "batch",
                           n_valid_cand=len(sel), refine=refine)
 
     (gi, gd), levels = _bbknn_driver(batch, n, k_within, search)
+    from .graph import invalidate_graph_layout_stats
+
+    data = invalidate_graph_layout_stats(data)
     return data.with_obsp(knn_indices=gi, knn_distances=gd).with_uns(
         knn_k=gi.shape[1], knn_metric=metric,
         bbknn_batches=levels, bbknn_k_within=k_within)
@@ -651,6 +660,9 @@ def bbknn_cpu(data: CellData, batch_key: str = "batch",
         return knn_numpy(rep, rep[sel], k=k, metric=metric)
 
     (gi, gd), levels = _bbknn_driver(batch, n, k_within, search)
+    from .graph import invalidate_graph_layout_stats
+
+    data = invalidate_graph_layout_stats(data)
     return data.with_obsp(knn_indices=gi, knn_distances=gd).with_uns(
         knn_k=gi.shape[1], knn_metric=metric,
         bbknn_batches=levels, bbknn_k_within=k_within)

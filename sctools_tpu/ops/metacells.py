@@ -49,13 +49,16 @@ def maxmin_sample(points: np.ndarray, m: int, seed: int = 0) -> np.ndarray:
     return np.asarray(chosen)
 
 
-@partial(jax.jit, static_argnames=("n_iter",))
-def seacells_arrays(knn_idx, kernel_w, init_idx, n_iter: int = 50):
+@partial(jax.jit, static_argnames=("n_iter", "graph_impl"))
+def seacells_arrays(knn_idx, kernel_w, init_idx, n_iter: int = 50,
+                    graph_impl: str | None = None):
     """Kernel archetypal analysis on the kNN kernel.
 
     knn_idx/kernel_w: (n, k) symmetric kernel edge list; init_idx:
     (m,) seed cells.  Returns (A (m, n) column-stochastic assignments,
-    B (n, m) column-stochastic archetypes).
+    B (n, m) column-stochastic archetypes).  ``graph_impl`` (static)
+    pins the tiled-family impl so config flips re-key this jit's
+    cache (pallas_graph.matvec's contract for jitted callers).
     """
     n, k = knn_idx.shape
     m = init_idx.shape[0]
@@ -63,10 +66,11 @@ def seacells_arrays(knn_idx, kernel_w, init_idx, n_iter: int = 50):
     from .graph import knn_matvec, knn_rmatvec
 
     def Kmat(V):  # K @ V — kernel is symmetric, edge list may not be;
-        return knn_matvec(knn_idx, kernel_w, V)
+        return knn_matvec(knn_idx, kernel_w, V, impl=graph_impl)
 
     def KTmat(V):
-        return knn_rmatvec(knn_idx, kernel_w, V, n=n)
+        return knn_rmatvec(knn_idx, kernel_w, V, n=n,
+                           impl=graph_impl)
 
     B0 = jnp.zeros((n, m)).at[init_idx, jnp.arange(m)].set(1.0)
     # A0: assign each cell to its most similar archetype (one kernel hop)
@@ -131,7 +135,10 @@ def seacells_tpu(data: CellData, n_metacells: int | None = None,
     data, idx, w = _sym_kernel(data, "tpu")
     emb = np.asarray(data.obsm[use_rep])[:n]
     init_idx = maxmin_sample(emb, n_metacells, seed=seed)
-    A, B = seacells_arrays(idx, w, jnp.asarray(init_idx), n_iter=n_iter)
+    from .pallas_graph import resolved_impl
+
+    A, B = seacells_arrays(idx, w, jnp.asarray(init_idx),
+                           n_iter=n_iter, graph_impl=resolved_impl())
     return _attach_metacells(data, A, B, init_idx)
 
 

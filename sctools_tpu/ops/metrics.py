@@ -73,18 +73,20 @@ def _values_chunk(M, n, lo, hi, xp):
     return xp.asarray(M)[:n, lo:hi]
 
 
-@partial(jax.jit, static_argnames=())
-def _auto_terms(idx, w, Xc, colsum_w):
+@partial(jax.jit, static_argnames=("graph_impl",))
+def _auto_terms(idx, w, Xc, colsum_w, graph_impl: str | None = None):
     """Per gene: (num_moran, num_geary, denom) for one value block.
     The edge sums ride graph.knn_matvec (gather-weight-sum; weights
-    already zeroed on -1 slots by the caller)."""
+    already zeroed on -1 slots by the caller).  ``graph_impl``
+    (static) pins the tiled-family impl so config flips re-key this
+    jit's cache."""
     from .graph import knn_matvec
 
     z = Xc - jnp.mean(Xc, axis=0, keepdims=True)
-    Wz = knn_matvec(idx, w, z)
+    Wz = knn_matvec(idx, w, z, impl=graph_impl)
     num_i = jnp.sum(z * Wz, axis=0)
     r = jnp.sum(w, axis=1)
-    Wx = knn_matvec(idx, w, Xc)
+    Wx = knn_matvec(idx, w, Xc, impl=graph_impl)
     num_c = (jnp.sum(r[:, None] * Xc * Xc, axis=0)
              + jnp.sum(colsum_w[:, None] * Xc * Xc, axis=0)
              - 2.0 * jnp.sum(Xc * Wx, axis=0))
@@ -113,8 +115,12 @@ def _metrics(data: CellData, use_rep, device):
         Xc = _values_chunk(M, data.n_cells, lo, hi,
                            jnp if device else np)
         if device:
+            from .pallas_graph import resolved_impl
+
             ni, nc, dn = _auto_terms(idx_d, w_d,
-                                     jnp.asarray(Xc, jnp.float32), cs_d)
+                                     jnp.asarray(Xc, jnp.float32),
+                                     cs_d,
+                                     graph_impl=resolved_impl())
             ni, nc, dn = (np.asarray(a, np.float64) for a in (ni, nc, dn))
         else:
             Xc = np.asarray(Xc, np.float64)

@@ -116,10 +116,12 @@ def directed_chain_arrays(knn_idx, ms_emb, pseudotime, beta: float = 4.0):
     drift while leaving every move reversible at reduced probability,
     which removes the trapdoor artifact and also guarantees the
     absorbing solve is nonsingular."""
+    from .pallas_graph import gather_rows
+
     n, k = knn_idx.shape
     safe = jnp.where(knn_idx < 0, 0, knn_idx)
     emb = jnp.asarray(ms_emb, jnp.float32)
-    diff = emb[:, None, :] - jnp.take(emb, safe, axis=0)
+    diff = emb[:, None, :] - gather_rows(emb, safe)
     d = jnp.sqrt(jnp.maximum(jnp.sum(diff * diff, axis=2), 0.0))
     d = jnp.where(knn_idx < 0, jnp.inf, d)
     finite = jnp.isfinite(d)
@@ -136,10 +138,16 @@ def directed_chain_arrays(knn_idx, ms_emb, pseudotime, beta: float = 4.0):
     return jnp.where(row > 0, w / jnp.maximum(row, 1e-12), 0.0)
 
 
-@partial(jax.jit, static_argnames=("n_iter",))
-def stationary_arrays(knn_idx, p_edges, n_iter: int = 100):
+@partial(jax.jit, static_argnames=("n_iter", "band_rows",
+                                   "graph_impl"))
+def stationary_arrays(knn_idx, p_edges, n_iter: int = 100,
+                      band_rows: int | None = None,
+                      graph_impl: str | None = None):
     """Stationary mass of the directed chain by power iteration of
-    Pᵀ (zero rows treated as self-loops)."""
+    Pᵀ (zero rows treated as self-loops).  ``band_rows`` (static)
+    bounds the banded rmatvec sweep after ``graph.reorder``;
+    ``graph_impl`` (static) pins the tiled-family impl so config
+    flips re-key the jit cache."""
     from .graph import knn_rmatvec
 
     n = knn_idx.shape[0]
@@ -147,16 +155,21 @@ def stationary_arrays(knn_idx, p_edges, n_iter: int = 100):
     self_mass = 1.0 - jnp.sum(jnp.where(knn_idx < 0, 0.0, p_edges), axis=1)
 
     def step(x, _):
-        x_new = knn_rmatvec(knn_idx, p_edges, x, n=n) + self_mass[:, None] * x
+        x_new = (knn_rmatvec(knn_idx, p_edges, x, n=n,
+                             band_rows=band_rows, impl=graph_impl)
+                 + self_mass[:, None] * x)
         return x_new / jnp.maximum(jnp.sum(x_new), 1e-12), None
 
     x, _ = jax.lax.scan(step, x, None, length=n_iter)
     return x[:, 0]
 
 
-@partial(jax.jit, static_argnames=("n_iter",))
+@partial(jax.jit, static_argnames=("n_iter", "band_rows",
+                                   "graph_impl"))
 def fate_probs_arrays(knn_idx, p_edges, terminal_onehot, is_terminal,
-                      n_iter: int = 5000, tol: float = 1e-6):
+                      n_iter: int = 5000, tol: float = 1e-6,
+                      band_rows: int | None = None,
+                      graph_impl: str | None = None):
     """Absorption probabilities of the pseudotime-directed chain.
 
     terminal_onehot: (n, T) — rows of terminal cells are one-hot over
@@ -179,7 +192,9 @@ def fate_probs_arrays(knn_idx, p_edges, terminal_onehot, is_terminal,
 
     def step(carry):
         B, i, _ = carry
-        Bn = knn_matvec(knn_idx, p_edges, B) + self_mass[:, None] * B
+        Bn = (knn_matvec(knn_idx, p_edges, B, band_rows=band_rows,
+                         impl=graph_impl)
+              + self_mass[:, None] * B)
         Bn = jnp.where(is_terminal[:, None], terminal_onehot, Bn)
         return Bn, i + 1, jnp.max(jnp.abs(Bn - B))
 
@@ -271,8 +286,13 @@ def palantir_tpu(data: CellData, root: int = 0, terminal_states=None,
     """Adds obs["palantir_pseudotime"], obs["palantir_entropy"],
     obsm["palantir_fate_probs"], uns["palantir_terminal_states"].
     Requires neighbors.knn (embed.spectral runs if missing)."""
+    from .pallas_graph import resolved_impl
+
     data, idx, ms = _prep_palantir(data, "tpu", n_eigs)
     n = data.n_cells
+    band = data.uns.get("graph_bandwidth")
+    band = int(band) if band is not None else None
+    gimpl = resolved_impl()
     idx_j = jnp.asarray(idx)
     elen = jnp.asarray(_edge_lengths(idx, ms))
     d = shortest_path_arrays(idx_j, elen, root, n_rounds=sp_rounds)
@@ -297,7 +317,8 @@ def palantir_tpu(data: CellData, root: int = 0, terminal_states=None,
 
     p = directed_chain_arrays(idx_j, jnp.asarray(ms), pt)
     if terminal_states is None:
-        pi = stationary_arrays(idx_j, p)
+        pi = stationary_arrays(idx_j, p, band_rows=band,
+                               graph_impl=gimpl)
         terminal_states = _find_terminal_states(
             idx, pi, np.asarray(pt), max_terminal=max_terminal,
             reachable=reach)
@@ -310,7 +331,8 @@ def palantir_tpu(data: CellData, root: int = 0, terminal_states=None,
     is_term = np.zeros(n, bool)
     is_term[terminal_states] = True
     B = fate_probs_arrays(idx_j, p, jnp.asarray(onehot),
-                          jnp.asarray(is_term), n_iter=fate_iter)
+                          jnp.asarray(is_term), n_iter=fate_iter,
+                          band_rows=band, graph_impl=gimpl)
     rowsum = jnp.sum(B, axis=1, keepdims=True)
     Bn = jnp.where(rowsum > 1e-6, B / jnp.maximum(rowsum, 1e-12), 1.0 / T)
     ent = -jnp.sum(jnp.where(Bn > 0, Bn * jnp.log(Bn), 0.0), axis=1)

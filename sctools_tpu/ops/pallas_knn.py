@@ -156,9 +156,11 @@ def _knn_kernel_binned(q_ref, c_ref, out_v_ref, out_i_ref, acc_v, acc_i,
             acc_v[:], acc_i[:], k,
             lambda t, v: out_v_ref.__setitem__((slice(None), t), v),
             lambda t, i_: out_i_ref.__setitem__((slice(None), t), i_))
-        for t in range(k, k_pad):
-            out_v_ref[:, t] = jnp.full((qb,), _NEG, jnp.float32)
-            out_i_ref[:, t] = jnp.full((qb,), -1, jnp.int32)
+        if k_pad > k:  # lane padding past the real k, in one store
+            out_v_ref[:, k:] = jnp.full((qb, k_pad - k), _NEG,
+                                        jnp.float32)
+            out_i_ref[:, k:] = jnp.full((qb, k_pad - k), -1,
+                                        jnp.int32)
 
 
 @functools.partial(

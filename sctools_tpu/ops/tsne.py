@@ -66,12 +66,13 @@ def _calibrate_p(dist2, perplexity, n_iter: int = 40, xp=np):
 
 
 @partial(jax.jit, static_argnames=("n_iter", "exaggeration_iter",
-                                   "block"))
+                                   "block", "graph_impl"))
 def tsne_layout_arrays(knn_idx, P, init, n_iter: int = 500,
                        exaggeration: float = 12.0,
                        exaggeration_iter: int = 100,
                        learning_rate: float = 200.0,
-                       block: int = 2048):
+                       block: int = 2048,
+                       graph_impl: str | None = None):
     """Optimise the t-SNE layout.
 
     knn_idx: (n, k) neighbour ids (-1 padding); P: (n, k) symmetrised
@@ -100,7 +101,16 @@ def tsne_layout_arrays(knn_idx, P, init, n_iter: int = 500,
 
         Per tile: W = 1/(1+d²) against ALL points (one MXU matmul for
         the cross term), then the force factors as
-        y_i·(Σ_j W²) − W²·Y (second matmul).  Returns ((n, d), Z)."""
+        y_i·(Σ_j W²) − W²·Y (second matmul).  Returns ((n, d), Z).
+        On a real TPU backend the whole sweep runs as ONE fused
+        Pallas kernel (ops/pallas_graph.tsne_repulsion — the score
+        tile never leaves VMEM); this blocked ``lax.map`` two-matmul
+        form is its XLA twin and the off-TPU path."""
+        from .pallas_graph import tsne_repulsion
+
+        fused = tsne_repulsion(y, n, impl=graph_impl)
+        if fused is not None:
+            return fused
         yn2 = jnp.sum(y * y, axis=1)
 
         def per_block(args):
@@ -132,8 +142,11 @@ def tsne_layout_arrays(knn_idx, P, init, n_iter: int = 500,
 
     def attraction(y):
         """Σ_j p_ij w_ij (y_i − y_j) over the sparse kNN edges, plus
-        the symmetric reaction (edges are stored directed)."""
-        yj = jnp.take(y, safe, axis=0)            # (n, k, d)
+        the symmetric reaction (edges are stored directed).  The edge
+        gather is row-block tiled (pallas_graph.gather_rows)."""
+        from .pallas_graph import gather_rows
+
+        yj = gather_rows(y, safe)                 # (n, k, d)
         diff = y[:, None, :] - yj
         d2 = jnp.sum(diff * diff, axis=2)
         coef = p / (1.0 + d2)                     # (n, k)
@@ -251,10 +264,13 @@ def tsne_tpu(data: CellData, n_components: int = 2,
     rng = np.random.default_rng(seed)
     init = (rng.standard_normal((n, n_components)) * 1e-4).astype(
         np.float32)
+    from .pallas_graph import resolved_impl
+
     y = tsne_layout_arrays(jnp.asarray(idx), jnp.asarray(P),
                            jnp.asarray(init), n_iter=n_iter,
                            exaggeration_iter=_exag_iters(n_iter),
-                           learning_rate=learning_rate)
+                           learning_rate=learning_rate,
+                           graph_impl=resolved_impl())
     return data.with_obsm(X_tsne=y).with_uns(tsne_perplexity=eff)
 
 

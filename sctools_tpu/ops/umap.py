@@ -31,6 +31,7 @@ import numpy as np
 
 from ..data.dataset import CellData
 from ..registry import register
+from .pallas_graph import gather_rows
 
 
 def fit_ab(min_dist: float = 0.1, spread: float = 1.0):
@@ -68,7 +69,7 @@ def umap_layout_arrays(knn_idx, weights, init, key, n_epochs: int = 200,
     def epoch(y, inp):
         step, ekey = inp
         alpha = lr * (1.0 - step / n_epochs)
-        yj = jnp.take(y, safe, axis=0)               # (n, k, d)
+        yj = gather_rows(y, safe)                    # (n, k, d)
         diff = y[:, None, :] - yj                    # (n, k, d)
         d2 = jnp.sum(diff * diff, axis=2)            # (n, k)
         # attractive force along edges:  dCE/dd² of -log Φ, scaled by w
@@ -83,8 +84,10 @@ def umap_layout_arrays(knn_idx, weights, init, key, n_epochs: int = 200,
         g = g + jax.ops.segment_sum(
             flat, safe.reshape(-1), num_segments=n)
         # negative sampling: n_neg uniform vertices per node per epoch
+        # (the repulsion inner loop — its gather rides the tiled
+        # family like the edge gather above)
         negs = jax.random.randint(ekey, (n, n_neg), 0, n)
-        yn = jnp.take(y, negs, axis=0)               # (n, m, d)
+        yn = gather_rows(y, negs)                    # (n, m, d)
         diff_n = y[:, None, :] - yn
         d2n = jnp.sum(diff_n * diff_n, axis=2)
         rep_coef = (2.0 * repulsion_strength * b
@@ -249,7 +252,7 @@ def fa2_layout_arrays(knn_idx, weights, init, key, n_epochs: int = 300,
     def epoch(y, inp):
         step, ekey = inp
         alpha = lr * (1.0 - step / n_epochs)
-        yj = jnp.take(y, safe, axis=0)
+        yj = gather_rows(y, safe)
         diff = y[:, None, :] - yj
         att = -(w[:, :, None] * diff)
         g = jnp.sum(att, axis=1)
@@ -257,7 +260,7 @@ def fa2_layout_arrays(knn_idx, weights, init, key, n_epochs: int = 300,
             (-att).reshape(-1, y.shape[1]), safe.reshape(-1),
             num_segments=n)
         negs = jax.random.randint(ekey, (n, n_neg), 0, n)
-        diff_n = y[:, None, :] - jnp.take(y, negs, axis=0)
+        diff_n = y[:, None, :] - gather_rows(y, negs)
         d2n = jnp.sum(diff_n * diff_n, axis=2)
         rep_c = (deg[:, None] * jnp.take(deg, negs)) / (eps + d2n)
         rep = jnp.clip(rep_c[:, :, None] * diff_n, -10.0, 10.0)

@@ -123,9 +123,11 @@ def _moments(data: CellData, device: bool, second: bool = False,
                 out["Mus"] = sm[:, 3 * g:]
             return data.with_layers(**out)
         denom = 1.0 + jnp.sum(w, axis=1, keepdims=True)
+        band = data.uns.get("graph_bandwidth")
+        band = int(band) if band is not None else None
 
         def smooth(X):
-            return (X + knn_matvec(idx, w, X)) / denom
+            return (X + knn_matvec(idx, w, X, band_rows=band)) / denom
 
         out = {"Ms": smooth(S), "Mu": smooth(U)}
         if second:

@@ -241,6 +241,9 @@ def knn_multichip_tpu(data: CellData, k: int = 15, metric: str = "cosine",
         rep, k=k, metric=metric, mesh=mesh, n_valid=data.n_cells,
         block=block, exclude_self=exclude_self, strategy=strategy,
     )
+    from ..ops.graph import invalidate_graph_layout_stats
+
+    data = invalidate_graph_layout_stats(data)
     return data.with_obsp(knn_indices=idx, knn_distances=dist).with_uns(
         knn_k=k, knn_metric=metric
     )

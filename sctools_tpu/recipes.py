@@ -320,6 +320,33 @@ def atlas_knn_pipeline(n_top_genes: int = 2000, n_components: int = 50,
     ])
 
 
+@_pipeline_recipe("graph_tail")
+def graph_tail_pipeline(t: int = 3, mode: str = "umap",
+                        reorder: bool = True,
+                        jaccard: bool = False) -> Pipeline:
+    """The post-kNN graph tail as ONE pipeline: [locality reorder] →
+    connectivities → [jaccard] → diffusion operator → MAGIC
+    imputation → [restore order].  With ``reorder=True`` (default)
+    the graph is RCM-permuted into dense tiles first — every
+    iterative kernel downstream sweeps a narrow band instead of the
+    whole table (the tiled family in ops/pallas_graph.py reads the
+    recorded bandwidth) — and the INVERSE permutation is applied at
+    the recipe boundary, so results leave in the caller's row order
+    (the round-trip is bitwise, tests/test_graph_reorder.py).
+    Requires neighbors.knn."""
+    steps: list = []
+    if reorder:
+        steps.append(("graph.reorder", {}))
+    steps.append(("graph.connectivities", {"mode": mode}))
+    if jaccard:
+        steps.append(("graph.jaccard", {}))
+    steps.append(("graph.diffusion_operator", {}))
+    steps.append(("impute.magic", {"t": t}))
+    if reorder:
+        steps.append(("graph.restore_order", {}))
+    return Pipeline(steps)
+
+
 @_pipeline_recipe("pearson_residuals")
 def pearson_residuals_pipeline(n_top_genes: int = 2000,
                                theta: float = 100.0,

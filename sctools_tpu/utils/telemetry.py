@@ -121,6 +121,19 @@ METRICS = {
                         "compute",
     "stream.stall_s": "counter: consumer seconds stalled waiting on "
                       "the prefetch queue (producer-bound stream)",
+    "graph.reorder_s": "counter: seconds spent computing + applying "
+                       "locality reorders (graph.reorder / "
+                       "graph.restore_order host passes)",
+    "graph.tile_density": "gauge: fraction of kNN edges within one "
+                          "row block of the diagonal (labels "
+                          "layout=natural|reordered) — the locality "
+                          "the tiled graph kernels exploit",
+    "graph.kernel_calls": "counter: tiled graph-kernel dispatches "
+                          "(labels kernel=, impl=) — one per "
+                          "execution from eager call sites, one per "
+                          "TRACE when the caller is inside an "
+                          "enclosing jit (the compiled program "
+                          "re-runs without re-dispatching)",
 }
 
 #: Fixed histogram bucket upper bounds (seconds), chosen to straddle

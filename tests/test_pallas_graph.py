@@ -1,0 +1,287 @@
+"""Tiled graph-kernel family (ops/pallas_graph.py): parity of every
+impl against the legacy gather path, the banded sweep, the dispatch
+policy and the ``SCTOOLS_PALLAS_GRAPH`` escape hatch.
+
+Tolerance model (docs/ARCHITECTURE.md "Graph kernels & layout"): the
+blocked-XLA twins are BITWISE equal to the gather path (identical
+per-row reduction order); the Pallas kernels accumulate across the
+banded window sweep instead of the k edge slots, so floats agree to
+f32 reduction-order ulps (pinned at 2e-5 absolute on unit-scale
+inputs); Jaccard is exact integers everywhere, so it is equal on
+every impl.  Off-TPU the kernels run in interpreter mode — numerics
+identical to the compiled kernel up to matmul precision, same
+contract as ops/pallas_knn.py.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sctools_tpu.config import _parse_graph_impl, config, configure
+from sctools_tpu.ops import graph as G
+from sctools_tpu.ops import pallas_graph as PG
+from sctools_tpu.utils import telemetry
+
+TOL = 2e-5
+
+
+def _graph(n=768, k=11, d=23, seed=0, frac_missing=0.06):
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(0, n, (n, k)).astype(np.int32)
+    idx[rng.random((n, k)) < frac_missing] = -1
+    w = rng.random((n, k)).astype(np.float32)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    return jnp.asarray(idx), jnp.asarray(w), jnp.asarray(x)
+
+
+def _banded_graph(n=1024, k=9, d=7, band=120, seed=1):
+    rng = np.random.default_rng(seed)
+    idx = np.arange(n)[:, None] + rng.integers(-band, band + 1, (n, k))
+    rows = np.arange(n)[:, None]
+    idx = np.where((idx >= 0) & (idx < n)
+                   & (np.abs(idx - rows) <= band), idx, -1)
+    w = rng.random((n, k)).astype(np.float32)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    return (jnp.asarray(idx.astype(np.int32)), jnp.asarray(w),
+            jnp.asarray(x), band)
+
+
+# ------------------------------------------------------------- matvec
+
+def test_blocked_xla_matvec_bitwise_vs_gather():
+    idx, w, x = _graph()
+    ref = np.asarray(G._knn_matvec_gather(idx, w, x))
+    with configure(graph_impl="xla"):
+        out = np.asarray(G.knn_matvec(idx, w, x))
+    assert np.array_equal(ref, out)
+
+
+def test_pallas_matvec_full_sweep_parity():
+    idx, w, x = _graph()
+    ref = np.asarray(G._knn_matvec_gather(idx, w, x))
+    with configure(graph_impl="pallas"):
+        out = np.asarray(G.knn_matvec(idx, w, x))
+    assert np.abs(ref - out).max() <= TOL
+
+
+def test_pallas_matvec_banded_sweep_parity():
+    """With a true bandwidth bound the kernel sweeps only the band —
+    results must match the full sweep exactly (every edge is inside
+    the window by construction)."""
+    idx, w, x, band = _banded_graph()
+    ref = np.asarray(G._knn_matvec_gather(idx, w, x))
+    with configure(graph_impl="pallas"):
+        out_band = np.asarray(G.knn_matvec(idx, w, x, band_rows=band))
+        out_full = np.asarray(G.knn_matvec(idx, w, x))
+    assert np.abs(ref - out_band).max() <= TOL
+    # banded and full sweeps visit the same in-range blocks in the
+    # same order for covered edges -> identical accumulation
+    assert np.array_equal(out_band, out_full)
+
+
+def test_pallas_rmatvec_parity():
+    idx, w, x = _graph(n=640, k=8, d=9)
+    ref = np.asarray(G._knn_rmatvec_segsum(idx, w, x))
+    with configure(graph_impl="pallas"):
+        out = np.asarray(G.knn_rmatvec(idx, w, x))
+    assert np.abs(ref - out).max() <= TOL
+
+
+def test_rmatvec_adjointness_all_impls():
+    """<P x, y> == <x, Pᵀ y> ties matvec and rmatvec together on
+    every impl — an rmatvec that silently dropped edges would break
+    it."""
+    idx, w, _ = _graph(n=384, k=7, d=1)
+    rng = np.random.default_rng(3)
+    xx = jnp.asarray(rng.standard_normal((384, 4)).astype(np.float32))
+    yy = jnp.asarray(rng.standard_normal((384, 4)).astype(np.float32))
+    for impl in ("gather", "xla", "pallas"):
+        with configure(graph_impl=impl):
+            lhs = float(jnp.sum(G.knn_matvec(idx, w, xx) * yy))
+            rhs = float(jnp.sum(xx * G.knn_rmatvec(idx, w, yy)))
+        assert abs(lhs - rhs) <= 5e-3, impl
+
+
+# ------------------------------------------------------------- jaccard
+
+@pytest.mark.parametrize("impl", ["gather", "xla", "pallas"])
+def test_jaccard_exact_on_every_impl(impl):
+    idx, _, _ = _graph(n=520, k=10)
+    ref = np.asarray(G.jaccard_arrays(idx))
+    with configure(graph_impl=impl):
+        out = np.asarray(PG.jaccard(idx))
+    assert np.array_equal(ref, out), impl
+
+
+def test_jaccard_block_size_invariant():
+    idx, _, _ = _graph(n=300, k=6, seed=5)
+    ref = np.asarray(G.jaccard_arrays(idx))
+    for impl in ("xla", "pallas"):
+        with configure(graph_impl=impl):
+            for blk in (64, 256):
+                assert np.array_equal(
+                    ref, np.asarray(PG.jaccard(idx, block=blk))), (
+                    impl, blk)
+
+
+def test_jaccard_op_level_cpu_accepts_and_ignores_block():
+    """The cpu oracle's old ``**_ignored`` swallowed ``block=``
+    silently; the explicit parameter is accepted and results are
+    identical for every value (it is a device tiling knob)."""
+    from sctools_tpu.data.synthetic import synthetic_counts
+
+    d = synthetic_counts(200, 48, density=0.1, n_clusters=3, seed=0)
+    d = jax.tree_util.tree_map(lambda v: v, d)  # host copy as-is
+    import sctools_tpu as sct
+
+    d = sct.apply("normalize.log1p", d, backend="cpu")
+    d = sct.apply("pca.randomized", d, backend="cpu", n_components=8)
+    d = sct.apply("neighbors.knn", d, backend="cpu", k=6)
+    a = sct.apply("graph.jaccard", d, backend="cpu", block=64)
+    b = sct.apply("graph.jaccard", d, backend="cpu", block=4096)
+    assert np.array_equal(np.asarray(a.obsp["jaccard"]),
+                          np.asarray(b.obsp["jaccard"]))
+    with pytest.raises(TypeError):
+        sct.apply("graph.jaccard", d, backend="cpu", blokc=64)
+
+
+# ----------------------------------------------------- t-SNE repulsion
+
+def test_pallas_tsne_repulsion_matches_dense_reference():
+    rng = np.random.default_rng(0)
+    n, dim = 300, 2
+    y = rng.standard_normal((n, dim)).astype(np.float32) * 3.0
+    # dense float64 oracle of the exact repulsion + Z
+    d2 = ((y[:, None, :] - y[None, :, :]).astype(np.float64) ** 2
+          ).sum(-1)
+    wm = 1.0 / (1.0 + d2)
+    np.fill_diagonal(wm, 0.0)
+    z_ref = wm.sum()
+    w2 = wm * wm
+    f_ref = y * w2.sum(1)[:, None] - w2 @ y.astype(np.float64)
+    with configure(graph_impl="pallas"):
+        out = PG.tsne_repulsion(jnp.asarray(y), n, block=128)
+    assert out is not None
+    f, z = out
+    assert abs(float(z) - z_ref) / z_ref <= 1e-4
+    assert np.abs(np.asarray(f) - f_ref).max() <= 1e-3
+
+
+def test_tsne_repulsion_dispatcher_declines_off_pallas():
+    with configure(graph_impl="xla"):
+        assert PG.tsne_repulsion(jnp.zeros((8, 2)), 8) is None
+    with configure(graph_impl="gather"):
+        assert PG.tsne_repulsion(jnp.zeros((8, 2)), 8) is None
+
+
+def test_tsne_layout_one_step_parity_pallas_vs_xla():
+    """One optimizer step of the full t-SNE layout with the Pallas
+    repulsion kernel agrees with the blocked-XLA twin to float
+    tolerance.  ONE step on purpose: the optimisation is chaotic, so
+    ulp-level force differences diverge into different (equally
+    valid) layouts over many iterations — per-step equivalence is
+    the meaningful contract, and the kernel itself is pinned against
+    a dense float64 oracle above.  ``graph_impl`` is a STATIC arg of
+    the layout jit, so the two arms are distinct cache entries by
+    construction."""
+    from sctools_tpu.ops.tsne import tsne_layout_arrays
+
+    rng = np.random.default_rng(0)
+    n, k = 192, 8
+    idx = rng.integers(0, n, (n, k)).astype(np.int32)
+    P = rng.random((n, k)).astype(np.float32)
+    P = P / P.sum()
+    init = (rng.standard_normal((n, 2)) * 1e-4).astype(np.float32)
+    ref = np.asarray(tsne_layout_arrays(
+        jnp.asarray(idx), jnp.asarray(P), jnp.asarray(init),
+        n_iter=1, block=64, graph_impl="xla"))
+    out = np.asarray(tsne_layout_arrays(
+        jnp.asarray(idx), jnp.asarray(P), jnp.asarray(init),
+        n_iter=1, block=64, graph_impl="pallas"))
+    assert np.abs(ref - out).max() <= 1e-4
+
+
+# ------------------------------------------------------------ gather_rows
+
+def test_gather_rows_matches_take():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((500, 6)).astype(np.float32))
+    idx = jnp.asarray(rng.integers(0, 500, (500, 9)))
+    ref = np.asarray(jnp.take(x, idx, axis=0))
+    for impl in ("gather", "xla", "pallas"):
+        with configure(graph_impl=impl):
+            assert np.array_equal(ref,
+                                  np.asarray(PG.gather_rows(x, idx)))
+
+
+# ------------------------------------------------------------- dispatch
+
+def test_env_escape_hatch_parse():
+    assert _parse_graph_impl("0") == "gather"
+    assert _parse_graph_impl("FALSE") == "gather"
+    assert _parse_graph_impl("1") == "pallas"
+    assert _parse_graph_impl("true") == "pallas"
+    assert _parse_graph_impl("xla") == "xla"
+    assert _parse_graph_impl("auto") == "auto"
+    with pytest.raises(ValueError):
+        _parse_graph_impl("fast")
+
+
+def test_auto_resolves_off_tpu_to_xla():
+    assert config.graph_impl == "auto"  # repo default
+    if config.interpret_mode():  # this CI box
+        assert PG.resolved_impl() == "xla"
+        assert config.resolved_graph_impl() == "xla"
+
+
+def test_kernel_calls_counter_ticks():
+    idx, w, x = _graph(n=128, k=4, d=3, seed=9)
+    m = telemetry.default_registry()
+
+    def calls():
+        return sum(v for kk, v in m.snapshot_compact().items()
+                   if kk.startswith("graph.kernel_calls"))
+
+    before = calls()
+    with configure(graph_impl="xla"):
+        G.knn_matvec(idx, w, x)
+        PG.jaccard(idx)
+    assert calls() >= before + 2
+
+
+def test_config_flip_rekeys_jitted_consumers():
+    """The escape-hatch staleness hazard: spectral's jitted
+    ``diffusion_eigs`` threads the RESOLVED impl as a static arg, so
+    switching ``graph_impl`` after a first run re-dispatches (new jit
+    key) instead of silently serving the old impl's cached trace on
+    identical shapes."""
+    from sctools_tpu.ops.graph import diffusion_eigs
+
+    idx, w, _ = _graph(n=256, k=6, d=1, seed=11)
+    m = telemetry.default_registry()
+
+    def calls(impl):
+        return m.snapshot_compact().get(
+            f"graph.kernel_calls{{impl={impl},kernel=matvec}}", 0.0)
+
+    key = jax.random.PRNGKey(0)
+    diffusion_eigs(idx, w, key, n_comps=3, n_iter=2,
+                   graph_impl="xla")
+    before = calls("gather")
+    # same shapes, flipped impl: MUST be a fresh trace on the legacy
+    # path, visible as a gather dispatch
+    diffusion_eigs(idx, w, key, n_comps=3, n_iter=2,
+                   graph_impl="gather")
+    assert calls("gather") > before
+
+
+def test_band_blocks_window_math():
+    # None -> full sweep
+    assert PG._band_blocks(None, 256, 10) == 9
+    # a band within one block still needs the +1 straddle margin
+    assert PG._band_blocks(100, 256, 10) == 2
+    assert PG._band_blocks(1024, 256, 10) == 5
+    # never wider than the table
+    assert PG._band_blocks(10**9, 256, 10) == 9

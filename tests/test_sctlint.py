@@ -147,6 +147,93 @@ def test_sct001_same_named_shard_map_bodies_each_resolve(tmp_path):
     assert r.violations[0].line > 10  # the SECOND body's sync
 
 
+def test_sct001_flags_host_sync_inside_pallas_kernel(tmp_path):
+    """A pallas_call kernel body is traced (Mosaic or interpreter) —
+    a host sync inside it fails at trace time; without kernel-body
+    coverage the whole graph/kNN kernel sweep would be a lint blind
+    spot."""
+    r = lint_src(tmp_path, """
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            s = jnp.sum(x_ref[:])
+            o_ref[:] = x_ref[:] * float(s)     # traced host sync
+        def run(x):
+            return pl.pallas_call(
+                kernel, out_shape=x)(x)
+        """, only=["SCT001"])
+    assert rule_ids(r) == ["SCT001"]
+    assert "kernel" in r.violations[0].message
+
+
+def test_sct002_flags_loop_inside_partial_bound_pallas_kernel(tmp_path):
+    """The ``kernel = functools.partial(_kernel, k=...)`` binding
+    idiom (ops/pallas_knn.py / ops/pallas_graph.py) must resolve to
+    the underlying def — a data-sized Python loop over jnp ops in a
+    kernel unrolls at trace time like in any jitted function."""
+    r = lint_src(tmp_path, """
+        from jax.experimental import pallas as pl
+
+        def _kernel(x_ref, o_ref, *, k):
+            acc = jnp.zeros_like(x_ref[:])
+            for t in range(64):                 # unrolls 64x
+                acc = acc + jnp.roll(x_ref[:], t)
+            o_ref[:] = acc
+        def run(x, k):
+            kernel = functools.partial(_kernel, k=k)
+            return pl.pallas_call(kernel, out_shape=x)(x)
+        """, only=["SCT002"])
+    assert rule_ids(r) == ["SCT002"]
+    assert "_kernel" in r.violations[0].message
+
+
+def test_pallas_kernel_branchy_partial_resolves_both(tmp_path):
+    """``functools.partial(_a if flag else _b, ...)`` binds one of
+    TWO kernels at runtime — both must be linted (the matvec /
+    rmatvec pair in ops/pallas_graph.py)."""
+    r = lint_src(tmp_path, """
+        from jax.experimental import pallas as pl
+
+        def _a(x_ref, o_ref, *, k):
+            o_ref[:] = x_ref[:] * float(jnp.sum(x_ref[:]))  # sync
+        def _b(x_ref, o_ref, *, k):
+            o_ref[:] = jnp.sum(x_ref[:]).item() * x_ref[:]  # sync
+        def run(x, k, transpose):
+            kernel = functools.partial(_a if transpose else _b, k=k)
+            return pl.pallas_call(kernel, out_shape=x)(x)
+        """, only=["SCT001"])
+    assert sorted(rule_ids(r)) == ["SCT001", "SCT001"]
+
+
+def test_sct003_skips_pallas_kernel_kwargs(tmp_path):
+    """Every partial-bound kernel kwarg is a compile-time Python
+    value — SCT003's missing-static heuristic must not fire on
+    kernel signatures (their static set is unknowable from the
+    decorator grammar, and ALL of it is static)."""
+    r = lint_src(tmp_path, """
+        from jax.experimental import pallas as pl
+
+        def _kernel(x_ref, o_ref, *, k, block, mode="fast"):
+            o_ref[:] = x_ref[:]
+        def run(x, k, block):
+            kernel = functools.partial(_kernel, k=k, block=block)
+            return pl.pallas_call(kernel, out_shape=x)(x)
+        """, only=["SCT003"])
+    assert rule_ids(r) == []
+
+
+def test_clean_pallas_kernel_not_flagged(tmp_path):
+    r = lint_src(tmp_path, """
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[:] = jnp.maximum(x_ref[:], 0.0)
+        def run(x):
+            return pl.pallas_call(kernel, out_shape=x)(x)
+        """, only=["SCT001", "SCT002"])
+    assert rule_ids(r) == []
+
+
 # ---------------------------------------------------------------------------
 # SCT002 — python loop in jit
 # ---------------------------------------------------------------------------

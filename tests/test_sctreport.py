@@ -132,6 +132,8 @@ def test_plan_cache_section_renders_when_plan_counters_exist(
     assert "mesh-change misses: 1" in out
     # the mesh_shrink ruling is named with its device transition
     assert "DEGRADE step 0 reason=mesh_shrink (8 -> 4 devices)" in out
+    # no graph.* series -> no graph section
+    assert "-- graph --" not in out
 
     # no plan counters -> no section
     (tmp_path / "metrics.json").write_text(json.dumps({
@@ -139,6 +141,38 @@ def test_plan_cache_section_renders_when_plan_counters_exist(
                                  "gauges": {}, "histograms": {}}}))
     assert main([str(tmp_path)]) == 0
     assert "-- plan cache --" not in capsys.readouterr().out
+
+
+def test_graph_section_renders_when_graph_series_exist(
+        tmp_path, capsys):
+    """metrics.json with ``graph.*`` series gets the graph-tail
+    section: kernel dispatch mix, reorder wall, tile-density pair."""
+    journal = (
+        '{"event": "run_start", "n_steps": 1, "backend": "tpu", '
+        '"steps": [{"index": 0, "name": "graph.reorder", '
+        '"fingerprint": "f"}]}\n'
+        '{"event": "attempt", "step": 0, "name": "graph.reorder", '
+        '"attempt": 1, "backend": "tpu", "status": "ok", '
+        '"wall_s": 0.1, "span_id": 1}\n'
+        '{"event": "run_completed", "degraded": false}\n')
+    (tmp_path / "journal.jsonl").write_text(journal)
+    (tmp_path / "metrics.json").write_text(json.dumps({
+        "schema": 1, "metrics": {"counters": {
+            "graph.kernel_calls{impl=xla,kernel=matvec}": 12.0,
+            "graph.kernel_calls{impl=xla,kernel=jaccard}": 2.0,
+            "graph.reorder_s": 0.231,
+        }, "gauges": {
+            "graph.tile_density{layout=natural}": 0.07,
+            "graph.tile_density{layout=reordered}": 0.41,
+        }, "histograms": {}}}))
+    assert main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "-- graph --" in out
+    assert "tiled kernel dispatches: 14" in out
+    assert "{impl=xla,kernel=matvec}" in out
+    assert "locality reorder wall: 0.231 s" in out
+    assert "{layout=natural}: 0.070" in out
+    assert "{layout=reordered}: 0.410" in out
 
 
 def test_digest_splits_runs_and_tracks_statuses():

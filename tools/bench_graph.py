@@ -1,0 +1,225 @@
+"""Graph-tail bench helper: tiled kernels + locality reorder vs the
+legacy gather path.
+
+Backs ``bench.py --phase graph``.  What it measures, per graph size
+(two sizes by default — env ``SCTOOLS_BENCH_GRAPH_CELLS`` takes a
+comma list; ``SCTOOLS_BENCH_GRAPH_DIMS/K/REPS/T`` size the rest):
+
+* **matvec** — one ``P @ X`` sweep over the (n, k) edge list: the
+  legacy whole-graph gather (``graph._knn_matvec_gather``) vs the
+  tiled family (``config.graph_impl`` resolved — the blocked-XLA
+  twin on this CPU box, the banded Pallas kernel on TPU) on the
+  RCM-reordered layout.
+* **magic** — a t-step diffusion scan (MAGIC's hot loop, the shape
+  ``velocity.moments`` and Palantir's power iterations share).
+* **jaccard** — the neighbour-set Jaccard pass (PhenoGraph's kernel).
+* **reorder** — the one-shot RCM cost itself, charged AGAINST the
+  tiled arm (the locality pass must pay for itself inside one phase
+  to count), plus the natural-vs-reordered tile-density delta.
+
+The acceptance gate (tests/test_bench_gates.py, ISSUE 8) is the
+PHASE-level wall ratio: total gather-path wall / (total tiled wall on
+the reordered layout + the reorder pass itself) >= 1.3x, with parity
+pinned in the same run — the blocked-XLA twin must be BITWISE equal
+to the gather path and Jaccard exactly equal (the Pallas kernels'
+ulp-level tolerance is covered by tests/test_pallas_graph.py; on this
+CPU box the resolved impl is the xla twin, so the bench's parity
+check is exact).
+
+The synthetic graph is cluster-structured (neighbours mostly within
+one of ``n_clusters`` communities, a few percent cross links, row
+order shuffled) — the locality profile of a real cell atlas after
+ingest, which is what makes RCM worth measuring; a uniformly random
+graph has no locality to recover and is the wrong model for cell
+data.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+
+def make_clustered_graph(n: int, k: int, d: int, n_clusters: int = 32,
+                         seed: int = 0, cross_frac: float = 0.03,
+                         missing_frac: float = 0.02):
+    """Synthetic clustered kNN edge list in SHUFFLED (natural-ingest)
+    row order: (idx (n, k) int32 with -1 padding, w (n, k) f32,
+    x (n, d) f32)."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, n_clusters, n)
+    idx = np.empty((n, k), np.int64)
+    for c in range(n_clusters):
+        m = np.flatnonzero(labels == c)
+        if len(m) == 0:
+            continue
+        idx[m] = m[rng.integers(0, len(m), (len(m), k))]
+    cross = rng.random((n, k)) < cross_frac
+    idx[cross] = rng.integers(0, n, int(cross.sum()))
+    idx[rng.random((n, k)) < missing_frac] = -1
+    w = rng.random((n, k)).astype(np.float32)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    return idx.astype(np.int32), w, x
+
+
+def _timed(fn, sync, reps: int):
+    out = fn()
+    sync(out)
+    walls = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn()
+        sync(out)
+        walls.append(time.perf_counter() - t0)
+    return float(np.median(walls)), out
+
+
+def _bench_one_size(jax, n: int, k: int, d: int, t: int,
+                    reps: int) -> dict:
+    import jax.numpy as jnp
+
+    from sctools_tpu.config import config
+    from sctools_tpu.ops import graph as G
+    from sctools_tpu.ops import pallas_graph as PG
+
+    idx, w, x = make_clustered_graph(n, k, d, seed=n)
+
+    def sync(v):
+        jax.block_until_ready(v)
+
+    idx_j, w_j, x_j = jnp.asarray(idx), jnp.asarray(w), jnp.asarray(x)
+
+    def _magic_chain(band, use_gather: bool):
+        # jitted once per arm: an EAGER lax.scan re-traces per call,
+        # which would time compilation, not the diffusion loop
+        @jax.jit
+        def chain(idx_a, w_a, x_a):
+            def step(y, _):
+                if use_gather:
+                    return G._knn_matvec_gather(idx_a, w_a, y), None
+                return G.knn_matvec(idx_a, w_a, y,
+                                    band_rows=band), None
+
+            out, _ = jax.lax.scan(step, x_a, None, length=t)
+            return out
+
+        return chain
+
+    magic_gather = _magic_chain(None, True)
+
+    # -- legacy gather arm (natural layout) ---------------------------
+    gather = {}
+    gather["matvec_s"], ref_mv = _timed(
+        lambda: G._knn_matvec_gather(idx_j, w_j, x_j), sync, reps)
+    gather["magic_s"], _ = _timed(
+        lambda: magic_gather(idx_j, w_j, x_j), sync, reps)
+    gather["jaccard_s"], ref_jc = _timed(
+        lambda: G.jaccard_arrays(idx_j), sync, reps)
+
+    # -- reorder (charged against the tiled arm) ----------------------
+    t0 = time.perf_counter()
+    perm = G.reorder_permutation(idx)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(n, dtype=np.int64)
+    idx_r = G._remap_edge_values(idx, inv)[perm]
+    reorder_s = time.perf_counter() - t0
+    w_r, x_r = w[perm], x[perm]
+    band = G.graph_bandwidth(idx_r)
+    density_nat = G.tile_density(idx)
+    density_reord = G.tile_density(idx_r)
+    idx_rj, w_rj, x_rj = (jnp.asarray(idx_r), jnp.asarray(w_r),
+                          jnp.asarray(x_r))
+
+    # -- tiled arm (resolved impl, reordered layout) ------------------
+    tiled = {}
+    magic_tiled = _magic_chain(band, False)
+    tiled["matvec_s"], out_mv_r = _timed(
+        lambda: G.knn_matvec(idx_rj, w_rj, x_rj, band_rows=band),
+        sync, reps)
+    tiled["magic_s"], _ = _timed(
+        lambda: magic_tiled(idx_rj, w_rj, x_rj), sync, reps)
+    tiled["jaccard_s"], out_jc_r = _timed(
+        lambda: PG.jaccard(idx_rj, band_rows=band), sync, reps)
+
+    # -- parity (same layout, so errors are comparable) ---------------
+    out_mv_nat = np.asarray(G.knn_matvec(idx_j, w_j, x_j))
+    mv_err = float(np.abs(out_mv_nat - np.asarray(ref_mv)).max())
+    # the reordered run must be the SAME numbers, permuted back
+    mv_reord_err = float(np.abs(
+        np.asarray(out_mv_r)[inv] - np.asarray(ref_mv)).max())
+    jc_nat = np.asarray(PG.jaccard(idx_j))
+    jc_equal = bool(np.array_equal(jc_nat, np.asarray(ref_jc)))
+    jc_reord_equal = bool(np.array_equal(
+        np.asarray(out_jc_r)[inv], np.asarray(ref_jc)))
+
+    gather_total = sum(gather.values())
+    tiled_total = sum(tiled.values())
+    return {
+        "n_cells": n, "k": k, "dims": d, "magic_t": t, "reps": reps,
+        "impl": config.resolved_graph_impl(),
+        "gather": {kk: round(v, 4) for kk, v in gather.items()},
+        "tiled_reordered": {kk: round(v, 4) for kk, v in tiled.items()},
+        "reorder_s": round(reorder_s, 4),
+        "bandwidth_natural": int(G.graph_bandwidth(idx)),
+        "bandwidth_reordered": int(band),
+        "tile_density_natural": round(density_nat, 4),
+        "tile_density_reordered": round(density_reord, 4),
+        "gather_total_s": round(gather_total, 4),
+        "tiled_total_s": round(tiled_total + reorder_s, 4),
+        "speedup": round(gather_total
+                         / max(tiled_total + reorder_s, 1e-9), 3),
+        "matvec_max_abs_err": mv_err,
+        "matvec_reordered_max_abs_err": mv_reord_err,
+        "jaccard_equal": jc_equal,
+        "jaccard_reordered_equal": jc_reord_equal,
+    }
+
+
+def run_graph_bench(jax, sizes=None, k: int | None = None,
+                    d: int | None = None, reps: int | None = None,
+                    t: int | None = None) -> dict:
+    """Tiled+reordered vs legacy-gather walls on the graph tail.
+
+    Returns a detail dict with per-size measurements and the
+    phase-level ``speedup_tiled_reordered`` (the acceptance gate:
+    >= 1.3x on the CI box; the reorder pass is charged against the
+    tiled arm)."""
+    if sizes is None:
+        sizes = tuple(
+            int(s) for s in os.environ.get(
+                "SCTOOLS_BENCH_GRAPH_CELLS", "8192,32768").split(","))
+    k = int(k or os.environ.get("SCTOOLS_BENCH_GRAPH_K", 16))
+    d = int(d or os.environ.get("SCTOOLS_BENCH_GRAPH_DIMS", 50))
+    reps = int(reps or os.environ.get("SCTOOLS_BENCH_GRAPH_REPS", 5))
+    t = int(t or os.environ.get("SCTOOLS_BENCH_GRAPH_T", 3))
+    per_size = [_bench_one_size(jax, n, k, d, t, reps)
+                for n in sizes]
+    gather_total = sum(s["gather_total_s"] for s in per_size)
+    tiled_total = sum(s["tiled_total_s"] for s in per_size)
+    from sctools_tpu.config import config
+
+    return {
+        "sizes": list(sizes), "k": k, "dims": d, "reps": reps,
+        "magic_t": t,
+        "impl": config.resolved_graph_impl(),
+        "per_size": per_size,
+        "gather_total_s": round(gather_total, 4),
+        "tiled_total_s": round(tiled_total, 4),
+        "speedup_tiled_reordered": round(
+            gather_total / max(tiled_total, 1e-9), 3),
+        "matvec_max_abs_err": max(
+            s["matvec_max_abs_err"] for s in per_size),
+        "matvec_reordered_max_abs_err": max(
+            s["matvec_reordered_max_abs_err"] for s in per_size),
+        "jaccard_equal": all(s["jaccard_equal"] for s in per_size),
+        "jaccard_reordered_equal": all(
+            s["jaccard_reordered_equal"] for s in per_size),
+        "tile_density_natural": per_size[-1]["tile_density_natural"],
+        "tile_density_reordered":
+            per_size[-1]["tile_density_reordered"],
+        "note": "tiled arm runs the layout-reordered graph and is "
+                "charged the one-shot RCM pass; gather arm is the "
+                "pre-ISSUE-8 path on the natural layout",
+    }

@@ -35,7 +35,13 @@
 #                     exercised on this CPU-only box): a second
 #                     sharded run on a REBUILT identical mesh must be
 #                     a pure cache hit — zero retraces
-#   7. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
+#   7. graph-parity   every impl of the tiled graph-kernel family
+#                     (gather / blocked-xla / interpreter-mode
+#                     pallas) must agree on a canned graph — bitwise
+#                     for the xla twin and jaccard, ulp-tolerance for
+#                     the Pallas kernels (docs/ARCHITECTURE.md
+#                     "Graph kernels & layout")
+#   8. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
 
 set -u -o pipefail
 
@@ -186,6 +192,55 @@ then
     :
 else
     echo "sharded-plan stage FAILED (rc=$?)"
+    fail=1
+fi
+
+stage "graph-parity (pallas / blocked-xla / gather agree on a canned graph)"
+if JAX_PLATFORMS=cpu python - <<'PYEOF'
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+
+from sctools_tpu.config import configure
+from sctools_tpu.ops import graph as G
+from sctools_tpu.ops import pallas_graph as PG
+
+rng = np.random.default_rng(7)
+n, k, d = 1024, 12, 20
+idx = rng.integers(0, n, (n, k)).astype(np.int32)
+idx[rng.random((n, k)) < 0.05] = -1
+w = rng.random((n, k)).astype(np.float32)
+x = rng.standard_normal((n, d)).astype(np.float32)
+idx_j, w_j, x_j = jnp.asarray(idx), jnp.asarray(w), jnp.asarray(x)
+
+ref_mv = np.asarray(G._knn_matvec_gather(idx_j, w_j, x_j))
+ref_rmv = np.asarray(G._knn_rmatvec_segsum(idx_j, w_j, x_j))
+ref_jc = np.asarray(G.jaccard_arrays(idx_j))
+with configure(graph_impl="xla"):
+    if not np.array_equal(
+            ref_mv, np.asarray(G.knn_matvec(idx_j, w_j, x_j))):
+        sys.exit("blocked-xla matvec is not bitwise-equal to gather")
+    if not np.array_equal(ref_jc, np.asarray(PG.jaccard(idx_j))):
+        sys.exit("slot-loop xla jaccard != legacy jaccard")
+with configure(graph_impl="pallas"):
+    e_mv = float(np.abs(
+        ref_mv - np.asarray(G.knn_matvec(idx_j, w_j, x_j))).max())
+    e_rmv = float(np.abs(
+        ref_rmv - np.asarray(G.knn_rmatvec(idx_j, w_j, x_j))).max())
+    if e_mv > 2e-5 or e_rmv > 2e-5:
+        sys.exit(f"pallas matvec/rmatvec parity out of tolerance: "
+                 f"{e_mv:.2e} / {e_rmv:.2e} (documented 2e-5)")
+    if not np.array_equal(ref_jc, np.asarray(PG.jaccard(idx_j))):
+        sys.exit("pallas jaccard != legacy jaccard")
+print(f"OK: gather == xla (bitwise), pallas within tolerance "
+      f"(matvec {e_mv:.1e}, rmatvec {e_rmv:.1e}), jaccard exact "
+      f"on all three impls")
+PYEOF
+then
+    :
+else
+    echo "graph-parity stage FAILED (rc=$?)"
     fail=1
 fi
 

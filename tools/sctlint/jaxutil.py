@@ -124,6 +124,31 @@ def iter_jitted_functions(tree: ast.Module,
                 break
 
 
+def _parent_map(tree: ast.Module) -> dict[int, ast.AST]:
+    parents: dict[int, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    return parents
+
+
+def _nearest_scope(parents: dict[int, ast.AST], node):
+    cur = parents.get(id(node))
+    while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)):
+        cur = parents.get(id(cur))
+    return cur
+
+
+def _defs_in_scope(parents, scope, name):
+    # defs named `name` whose NEAREST function scope is `scope`
+    # (a def inside a deeper nested function belongs to that one)
+    return [n for n in ast.walk(scope)
+            if isinstance(n, ast.FunctionDef) and n.name == name
+            and n is not scope
+            and _nearest_scope(parents, n) is scope]
+
+
 def shard_map_bodies(tree: ast.Module, aliases: dict[str, str],
                      seen_fn_ids: set[int]) -> list[JitInfo]:
     """Functions passed BY NAME as the body of a ``shard_map`` call —
@@ -143,26 +168,7 @@ def shard_map_bodies(tree: ast.Module, aliases: dict[str, str],
     silently escapes linting.  Bodies passed through a variable
     (``fn = ring if ... else gather``) stay invisible — heuristic,
     like everything here."""
-    parents: dict[int, ast.AST] = {}
-    for node in ast.walk(tree):
-        for child in ast.iter_child_nodes(node):
-            parents[id(child)] = node
-
-    def nearest_scope(node):
-        cur = parents.get(id(node))
-        while cur is not None and not isinstance(
-                cur, (ast.FunctionDef, ast.AsyncFunctionDef,
-                      ast.Module)):
-            cur = parents.get(id(cur))
-        return cur
-
-    def defs_in_scope(scope, name):
-        # defs named `name` whose NEAREST function scope is `scope`
-        # (a def inside a deeper nested function belongs to that one)
-        return [n for n in ast.walk(scope)
-                if isinstance(n, ast.FunctionDef) and n.name == name
-                and n is not scope and nearest_scope(n) is scope]
-
+    parents = _parent_map(tree)
     out: list[JitInfo] = []
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
@@ -173,17 +179,103 @@ def shard_map_bodies(tree: ast.Module, aliases: dict[str, str],
         if not node.args or not isinstance(node.args[0], ast.Name):
             continue
         fn = None
-        scope = nearest_scope(node)
+        scope = _nearest_scope(parents, node)
         while scope is not None:
-            cands = defs_in_scope(scope, node.args[0].id)
+            cands = _defs_in_scope(parents, scope, node.args[0].id)
             if cands:
                 fn = cands[-1]  # later def wins, like runtime
                 break
             scope = (None if isinstance(scope, ast.Module)
-                     else nearest_scope(scope))
+                     else _nearest_scope(parents, scope))
         if fn is not None and id(fn) not in seen_fn_ids:
             seen_fn_ids.add(id(fn))
             out.append(JitInfo(fn=fn, static_argnames=frozenset()))
+    return out
+
+
+def pallas_call_bodies(tree: ast.Module, aliases: dict[str, str],
+                       seen_fn_ids: set[int]) -> list[JitInfo]:
+    """Kernel functions passed as the body of a ``pl.pallas_call``
+    — by name, or bound through ``functools.partial(kernel, ...)``
+    (possibly via an intermediate ``kernel = functools.partial(...)``
+    assignment, this repo's idiom in ops/pallas_knn.py /
+    ops/pallas_graph.py).
+
+    A Pallas kernel body is TRACED — a host sync inside it is the
+    same SCT001 hazard as in any jitted function and a Python loop
+    over jnp ops unrolls identically (SCT002); without this, the
+    graph/kNN kernel sweep would be a lint blind spot.
+    ``static_argnames`` is ``None`` (unknown) on purpose: every
+    partial-bound kwarg of a kernel is a compile-time Python value,
+    so SCT003's missing-static heuristic must skip these (it skips
+    when the set is unreadable).  Matched by the trailing
+    ``pallas_call`` attribute so both ``pl.pallas_call`` and a direct
+    import resolve; kernels passed through anything other than a
+    name or a partial-of-a-name stay invisible — heuristic, like the
+    shard_map resolution above."""
+    parents = _parent_map(tree)
+
+    def _names_of(node: ast.AST) -> list[str]:
+        # a kernel expression: a bare name, or a conditional between
+        # names (`_a if transpose else _b` — both branches are
+        # kernels and both must be linted)
+        if isinstance(node, ast.Name):
+            return [node.id]
+        if isinstance(node, ast.IfExp):
+            return _names_of(node.body) + _names_of(node.orelse)
+        return []
+
+    def _partial_targets(call: ast.Call) -> list[str]:
+        fname = dotted(call.func, aliases)
+        if fname == "functools.partial" and call.args:
+            return _names_of(call.args[0])
+        return []
+
+    def resolve(scope0, name, depth=0) -> list[ast.FunctionDef]:
+        # every def with that name, plus every
+        # `name = functools.partial(fn, ..)` assignment's target —
+        # ALL candidates count (two branches may bind the same
+        # variable to different kernels)
+        if depth > 4:  # cyclic aliasing guard
+            return []
+        scope = scope0
+        while scope is not None:
+            found = list(_defs_in_scope(parents, scope, name))
+            for n in ast.walk(scope):
+                if not (isinstance(n, ast.Assign)
+                        and isinstance(n.value, ast.Call)):
+                    continue
+                if not any(isinstance(t, ast.Name) and t.id == name
+                           for t in n.targets):
+                    continue
+                if _nearest_scope(parents, n) is not scope:
+                    continue
+                for inner in _partial_targets(n.value):
+                    found.extend(resolve(scope, inner, depth + 1))
+            if found:
+                return found
+            scope = (None if isinstance(scope, ast.Module)
+                     else _nearest_scope(parents, scope))
+        return []
+
+    out: list[JitInfo] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func, aliases)
+        if not name or name.split(".")[-1] != "pallas_call":
+            continue
+        if not node.args:
+            continue
+        arg = node.args[0]
+        targets = (_names_of(arg) if not isinstance(arg, ast.Call)
+                   else _partial_targets(arg))
+        scope = _nearest_scope(parents, node)
+        for target in targets:
+            for fn in resolve(scope, target):
+                if id(fn) not in seen_fn_ids:
+                    seen_fn_ids.add(id(fn))
+                    out.append(JitInfo(fn=fn, static_argnames=None))
     return out
 
 
@@ -288,11 +380,15 @@ class ModuleInfo:
         self.aliases = import_aliases(tree)
         self.jitted: list[JitInfo] = list(
             iter_jitted_functions(tree, self.aliases))
-        # shard_map bodies are traced contexts too (SCT001/SCT002
-        # apply inside them) — appended after the decorator scan so a
-        # body that is ALSO jit-decorated keeps its static_argnames
-        self.jitted.extend(shard_map_bodies(
-            tree, self.aliases, {id(j.fn) for j in self.jitted}))
+        # shard_map bodies and pallas_call kernel bodies are traced
+        # contexts too (SCT001/SCT002 apply inside them) — appended
+        # after the decorator scan so a body that is ALSO
+        # jit-decorated keeps its static_argnames
+        seen_ids = {id(j.fn) for j in self.jitted}
+        self.jitted.extend(shard_map_bodies(tree, self.aliases,
+                                            seen_ids))
+        self.jitted.extend(pallas_call_bodies(tree, self.aliases,
+                                              seen_ids))
         self.registered: list[RegisteredImpl] = list(
             iter_registered_impls(tree, self.aliases))
         tpu_roots = [r.fn for r in self.registered

@@ -287,6 +287,11 @@ def render(run_dir: str, runs: list[dict], trace_d: dict | None,
         add("")
         L.extend(plan)
 
+    graph = graph_section(metrics)
+    if graph:
+        add("")
+        L.extend(graph)
+
     add("")
     add("-- metrics snapshot --")
     if metrics is None:
@@ -299,6 +304,41 @@ def render(run_dir: str, runs: list[dict], trace_d: dict | None,
             add(f"  {k:<56s} count={h.get('count')} "
                 f"sum={h.get('sum')} max={h.get('max')}")
     return "\n".join(L)
+
+
+def graph_section(metrics) -> list[str]:
+    """The graph-tail kernel digest, rendered only when the run
+    recorded ``graph.*`` series (a run that never touched the graph
+    tail has no section).  Shows the tiled-kernel dispatch mix, the
+    reorder cost, and the tile-density gauge pair — the
+    natural-vs-reordered locality delta the banded kernels ride."""
+    if metrics is None:
+        return []
+    m = metrics.get("metrics", metrics)
+    counters = {k: v for k, v in m.get("counters", {}).items()
+                if k.startswith("graph.")}
+    gauges = {k: v for k, v in m.get("gauges", {}).items()
+              if k.startswith("graph.")}
+    if not counters and not gauges:
+        return []
+    L = ["-- graph --"]
+    calls = {k: v for k, v in counters.items()
+             if k.startswith("graph.kernel_calls")}
+    if calls:
+        total = sum(calls.values())
+        L.append(f"  tiled kernel dispatches: {total:g}")
+        for k, v in sorted(calls.items()):
+            labels = k[k.find("{"):] if "{" in k else ""
+            L.append(f"    {labels:<44s} {v:g}")
+    if counters.get("graph.reorder_s") is not None:
+        L.append(f"  locality reorder wall: "
+                 f"{counters['graph.reorder_s']:.3f} s")
+    dens = {k: v for k, v in gauges.items()
+            if k.startswith("graph.tile_density")}
+    for k, v in sorted(dens.items()):
+        labels = k[k.find("{"):] if "{" in k else ""
+        L.append(f"  tile density {labels}: {v:.3f}")
+    return L
 
 
 def plan_cache_section(metrics) -> list[str]:
