@@ -35,9 +35,10 @@ from .data.io import (from_dense, from_scipy, read, read_10x_h5,
                       read_10x_mtx, read_csv, read_h5ad, read_loom,
                       read_mtx, read_text, write_h5ad, write_loom)
 from .plan import describe_plan, fused_pipeline
-from .recipes import recipe_pipeline, run_recipe
+from .recipes import recipe_pipeline, run_recipe, submit_recipe
 from .registry import Pipeline, Transform, apply, backends, names, register
 from .runner import ResilientRunner, RetryPolicy
+from .scheduler import RunRejected, RunScheduler, RunShed, TenantQuota
 from .compat import experimental, external, pp, tl  # scanpy-style namespaces
 from . import pl  # scanpy-style plotting namespace (host-side)
 from . import datasets  # offline sc.datasets subset

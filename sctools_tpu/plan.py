@@ -351,7 +351,7 @@ class FusedTransform:
                 "FusedTransform takes no per-call overrides — member "
                 "params are baked into the compiled program")
         fn = self._execute
-        if _registry._CALL_WRAPPERS:
+        if _registry._active_wrappers():
             # one wrapper application PER MEMBER op (first member
             # outermost): chaos faults fnmatch member names and keep
             # their Nth-call counting, the deadline token is checked
@@ -613,7 +613,7 @@ class ShardedCollective:
                 "ShardedCollective takes no per-call overrides — "
                 "member params are part of the plan")
         fn = self._execute
-        if _registry._CALL_WRAPPERS:
+        if _registry._active_wrappers():
             fn = _registry._wrap_call(self.member.name, self.backend, fn)
         return fn(data)
 

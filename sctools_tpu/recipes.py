@@ -122,6 +122,44 @@ def run_recipe(name: str, data: CellData, *, backend: str | None = None,
     return runner.run(data, backend=backend, resume=resume)
 
 
+def submit_recipe(scheduler, name: str, data: CellData, *,
+                  tenant: str = "default", priority: int = 0,
+                  deadline_s: float | None = None,
+                  backend: str | None = None,
+                  checkpoint_dir: str | None = None,
+                  step_deadline_s: float | None = None,
+                  fuse: bool = False, runner_kw: dict | None = None,
+                  **recipe_kw):
+    """Submit a named recipe to a :class:`~sctools_tpu.scheduler.
+    RunScheduler` — the multi-tenant form of :func:`run_recipe`.
+
+    Where ``run_recipe`` executes inline (one island per call), this
+    queues the recipe behind the scheduler's admission control:
+    bounded concurrency, per-tenant quotas, queue deadlines and load
+    shedding, with circuit-breaker state shared per backend across
+    every run in the pool.  Returns the scheduler's ``RunHandle``
+    immediately (``.result()`` blocks for the output); raises
+    ``scheduler.RunRejected`` when admission refuses the submission.
+
+    >>> with RunScheduler(max_concurrency=4) as sched:
+    ...     h = submit_recipe(sched, "seurat", data, tenant="lab-a",
+    ...                       priority=1, deadline_s=600,
+    ...                       backend="tpu", n_top_genes=2000)
+    ...     out = h.result()
+    """
+    kw = dict(runner_kw or {})
+    if checkpoint_dir is not None:
+        kw["checkpoint_dir"] = checkpoint_dir
+    if step_deadline_s is not None:
+        kw["step_deadline_s"] = step_deadline_s
+    if fuse:
+        kw["fuse"] = True
+    return scheduler.submit(recipe_pipeline(name, **recipe_kw), data,
+                            tenant=tenant, priority=priority,
+                            deadline_s=deadline_s, backend=backend,
+                            runner_kw=kw)
+
+
 @_pipeline_recipe("zheng17")
 def zheng17_pipeline(n_top_genes: int = 1000) -> Pipeline:
     """Zheng et al. 2017 (10x 1.3M-cell paper) steps: gene filter →

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import threading
 from typing import Any, Callable
 
 _REGISTRY: dict[str, dict[str, Callable]] = {}
@@ -80,31 +81,63 @@ DEFAULT_BACKEND = "tpu"
 # deadline check, then telemetry, so an op's recorded duration
 # includes an injected wedge and its deadline raise counts as that
 # op's error).
+#
+# Two scopes: GLOBAL wrappers (the default — chaos faults must fire
+# on every thread's calls) and THREAD-LOCAL wrappers
+# (``thread_local=True``).  The scheduler's worker pool runs several
+# ResilientRunners concurrently; each run's deadline check and
+# telemetry instrumentor install thread-locally so run A's wrappers
+# never wrap (or double-count) run B's op calls.  Thread-local
+# wrappers run OUTERMOST relative to globals — the same composition
+# a single-threaded runner always had (chaos innermost, telemetry
+# outermost).
 # ---------------------------------------------------------------------------
 
 _CALL_WRAPPERS: list[Callable[[str, str, Callable], Callable]] = []
+_TLS_WRAPPERS = threading.local()
 
 
-def push_call_wrapper(wrapper: Callable[[str, str, Callable], Callable]) -> None:
-    _CALL_WRAPPERS.append(wrapper)
+def _thread_wrappers() -> list:
+    ws = getattr(_TLS_WRAPPERS, "stack", None)
+    if ws is None:
+        ws = _TLS_WRAPPERS.stack = []
+    return ws
 
 
-def pop_call_wrapper(wrapper: Callable[[str, str, Callable], Callable]) -> None:
-    _CALL_WRAPPERS.remove(wrapper)
+def push_call_wrapper(wrapper: Callable[[str, str, Callable], Callable],
+                      thread_local: bool = False) -> None:
+    (_thread_wrappers() if thread_local else _CALL_WRAPPERS) \
+        .append(wrapper)
+
+
+def pop_call_wrapper(wrapper: Callable[[str, str, Callable], Callable],
+                     thread_local: bool = False) -> None:
+    (_thread_wrappers() if thread_local else _CALL_WRAPPERS) \
+        .remove(wrapper)
 
 
 @contextlib.contextmanager
-def call_wrapper(wrapper: Callable[[str, str, Callable], Callable]):
-    """Scoped installation: ``with call_wrapper(w): pipeline.run(...)``."""
-    push_call_wrapper(wrapper)
+def call_wrapper(wrapper: Callable[[str, str, Callable], Callable],
+                 thread_local: bool = False):
+    """Scoped installation: ``with call_wrapper(w): pipeline.run(...)``.
+    ``thread_local=True`` scopes the wrapper to the calling thread —
+    concurrent runs on other threads are not wrapped by it."""
+    push_call_wrapper(wrapper, thread_local=thread_local)
     try:
         yield
     finally:
-        pop_call_wrapper(wrapper)
+        pop_call_wrapper(wrapper, thread_local=thread_local)
+
+
+def _active_wrappers() -> list:
+    tls = getattr(_TLS_WRAPPERS, "stack", None)
+    if tls:
+        return _CALL_WRAPPERS + tls
+    return _CALL_WRAPPERS
 
 
 def _wrap_call(name: str, backend: str, fn: Callable) -> Callable:
-    for w in _CALL_WRAPPERS:
+    for w in _active_wrappers():
         fn = w(name, backend, fn)
     return fn
 
@@ -230,7 +263,7 @@ def describe(name: str) -> str:
 def apply(name: str, data, *args, backend: str = DEFAULT_BACKEND, **kw):
     """Apply a registered transform to ``data`` and return the result."""
     fn = get(name, backend)
-    if _CALL_WRAPPERS:
+    if _active_wrappers():
         fn = _wrap_call(name, backend, fn)
     return fn(data, *args, **kw)
 
@@ -254,7 +287,7 @@ class Transform:
     def __call__(self, data, **overrides):
         kw = {**self.params, **overrides}
         fn = self._fn
-        if _CALL_WRAPPERS:
+        if _active_wrappers():
             fn = _wrap_call(self.name, self.backend, fn)
         return fn(data, **kw)
 

@@ -91,6 +91,7 @@ import dataclasses
 import json
 import os
 import tempfile
+import threading as _threading
 import time
 import warnings
 
@@ -106,8 +107,16 @@ from .utils.failsafe import (DETERMINISTIC, FATAL, TRANSIENT,
                              StepDeadlineExceeded, check_deadline,
                              classify_child_result, classify_error,
                              current_deadline, deadline_scope,
-                             probe_device, run_isolated)
+                             default_breaker_registry, probe_device,
+                             run_isolated)
 from .utils.vclock import SYSTEM_CLOCK
+
+#: the backend runs degrade to when the accelerator is ruled
+#: unhealthy.  ONE definition: ResilientRunner's ``fallback_backend=``
+#: default and the scheduler's breaker-signature resolution both read
+#: it — if they disagreed, pool runs would silently stop sharing
+#: breaker state.
+DEFAULT_FALLBACK_BACKEND = "cpu"
 
 
 @dataclasses.dataclass
@@ -231,6 +240,26 @@ def _exec_step(in_path: str, name: str, backend: str, params: dict,
     return {"ok": True, "spans": trace.serialize_spans()}
 
 
+def run_backend_signature(pipeline: Pipeline, backend: str | None,
+                          fallback_backend: str | None = None) -> str:
+    """The backend signature a run's shared circuit breaker is keyed
+    by in ``failsafe.BreakerRegistry``: the run-level ``backend=``
+    override when given, else the pipeline's ACCELERATOR backend —
+    the first step backend that differs from ``fallback_backend``,
+    because that is the backend whose failures feed the breaker (a
+    mixed cpu+tpu pipeline must key "tpu", not whatever step 0 happens
+    to be).  One string per BACKEND, not per run — that is what lets
+    the first run to trip the tpu breaker short-circuit every other
+    run."""
+    if backend is not None:
+        return backend
+    steps = list(pipeline.steps)
+    for t in steps:
+        if fallback_backend is None or t.backend != fallback_backend:
+            return t.backend
+    return steps[0].backend if steps else _registry.DEFAULT_BACKEND
+
+
 def _deadline_wrap(name, backend, fn):
     """Registry call-wrapper: check the current cooperative deadline
     token before AND after every transform invocation.  Installed for
@@ -249,10 +278,13 @@ def _deadline_wrap(name, backend, fn):
 class _Journal:
     """Append-only JSONL event log.  One ``open/write/close`` per
     record: a killed run keeps every line written before the kill,
-    which is the whole point of a crash journal."""
+    which is the whole point of a crash journal.  Writes serialize on
+    an internal lock — the scheduler's workers share one journal and
+    write terminal events from their own threads."""
 
     def __init__(self, path: str | None):
         self.path = path
+        self._lock = _threading.Lock()
         if path:
             os.makedirs(os.path.dirname(os.path.abspath(path)),
                         exist_ok=True)
@@ -261,8 +293,9 @@ class _Journal:
         if not self.path:
             return
         rec = {"event": event, "ts": round(time.time(), 3), **fields}
-        with open(self.path, "a") as f:
-            f.write(json.dumps(rec) + "\n")
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
 
 
 class ResilientRunner:
@@ -308,12 +341,21 @@ class ResilientRunner:
         timeout.  Overrun → ``StepDeadlineExceeded`` (transient:
         journaled, retried, degradable).
     breaker : failsafe.CircuitBreaker | None
-        Accelerator circuit breaker; default
-        ``CircuitBreaker(failure_threshold=3, window_s=300,
-        cooldown_s=60)`` on the runner's clock.  OPEN short-circuits
-        accelerator attempts straight to the degrade ruling;
-        HALF_OPEN allows one probe, whose success closes the breaker
-        and un-degrades the run.
+        Accelerator circuit breaker.  ``None`` (the default) resolves
+        the run's backend signature in the PROCESS-SHARED
+        ``failsafe.default_breaker_registry()`` at ``run()`` time —
+        breaker state is per BACKEND, not per run, so two sequential
+        (or concurrent) runs against the same backend share trip
+        state: the first to trip it sends every other run straight to
+        the degrade ruling without a fresh retry storm, and every
+        breaker journal event names the registry ``signature`` that
+        ruled.  Pass ``CircuitBreaker(...)`` explicitly for the old
+        run-local isolation.  OPEN short-circuits accelerator
+        attempts (checked BEFORE the first attempt of every step)
+        straight to the degrade ruling; HALF_OPEN allows one
+        EXCLUSIVE probe across all sharers — a successful probe (or
+        probe-claimed accelerator attempt) closes the breaker and
+        un-degrades the run.
     clock : vclock.Clock
         Time source for backoff, deadlines and the breaker window
         (default: the system clock).  Tests share one
@@ -363,7 +405,7 @@ class ResilientRunner:
                  policy: RetryPolicy | None = None,
                  probe=None, preflight: bool = False,
                  probe_timeout_s: float = 90.0,
-                 fallback_backend: str | None = "cpu",
+                 fallback_backend: str | None = DEFAULT_FALLBACK_BACKEND,
                  isolate=(), isolate_timeout_s: float = 600.0,
                  isolate_stall_s: float = 240.0,
                  validate=None, chaos=None,
@@ -410,8 +452,11 @@ class ResilientRunner:
         self.chaos = chaos
         self.step_deadline_s = step_deadline_s
         self.clock = clock if clock is not None else SYSTEM_CLOCK
-        self.breaker = breaker if breaker is not None else \
-            CircuitBreaker(clock=self.clock)
+        # None → resolved per-run from the process-shared
+        # BreakerRegistry (keyed by the run's backend signature);
+        # an explicit CircuitBreaker keeps the old run-local state
+        self.breaker = breaker
+        self._breaker_explicit = breaker is not None
         self.sleep = sleep if sleep is not None else self.clock.sleep
         self.metrics = metrics if metrics is not None \
             else telemetry.default_registry()
@@ -435,6 +480,15 @@ class ResilientRunner:
         self._breaker_degraded = False
         self._spans = []
         self._inst.backend_override = None
+        if not self._breaker_explicit:
+            # per-BACKEND shared breaker: resolved lazily because the
+            # signature depends on the run's backend override.  The
+            # clock kwarg applies only if THIS run creates the
+            # breaker — later sharers inherit the first creator's.
+            self.breaker = default_breaker_registry().get(
+                run_backend_signature(self.pipeline, backend,
+                                      self.fallback_backend),
+                clock=self.clock)
         report = self.report = RunReport(
             status="pending", backend=backend,
             journal_path=self.journal.path, input_digest=dig,
@@ -516,9 +570,16 @@ class ResilientRunner:
         # by the token check on the way out of the op), then telemetry
         # outermost — so an op's recorded duration includes the wedge
         # and its raise is counted as that op's error
+        # deadline + telemetry wrappers install THREAD-LOCAL: under
+        # the scheduler's worker pool, concurrent runs must not wrap
+        # (or double-count) each other's op calls.  Chaos stays
+        # global — injected faults fire on every thread by design.
         try:
-            with chaos_ctx, _registry.call_wrapper(_deadline_wrap), \
-                    _registry.call_wrapper(self._inst.wrap):
+            with chaos_ctx, \
+                    _registry.call_wrapper(_deadline_wrap,
+                                           thread_local=True), \
+                    _registry.call_wrapper(self._inst.wrap,
+                                           thread_local=True):
                 for i in range(start, len(steps)):
                     data, degraded = self._run_step(
                         steps, i, data, backend, degraded, rng)
@@ -673,210 +734,361 @@ class ResilientRunner:
         sr = self.report.steps[i]
         attempt = 0        # monotonic across a fallback — the journal
         budget_used = 0    # join key must never repeat within a step
-        while True:
-            # breaker half-open (cooldown elapsed): ONE probe decides —
-            # success closes the breaker and un-degrades the run,
-            # failure re-opens it for another cooldown
-            if (degraded and self._breaker_degraded
-                    and self.breaker.state == CircuitBreaker.HALF_OPEN):
-                rec = self.probe()
-                self.journal.write("health_check",
-                                   where=f"step {i} half-open",
-                                   result=rec)
-                if rec.get("ok"):
-                    self.breaker.record_success()
+        probing = False    # this attempt holds the half-open probe slot
+        replanned = False  # last iteration re-planned on fewer devices
+        try:
+            while True:
+                # the SHARED breaker closed while this run was degraded
+                # (another sharer's probe succeeded): rejoin the
+                # accelerator — the pool-wide un-degrade contract.  With
+                # a run-local breaker this state is unreachable (only the
+                # run itself can close it), so the legacy path below is
+                # unchanged.
+                if (degraded and self._breaker_degraded
+                        and self.breaker.state == CircuitBreaker.CLOSED):
                     degraded = False
-                    self._breaker_degraded = False
-                    self.report.degraded = False
-                    self.report.backend = backend
-                    self.report.breaker = self.breaker.snapshot()
-                    self.journal.write("breaker_close", step=i)
-                    self.metrics.counter("runner.breaker_transitions",
-                                         to="close").inc()
-                    self._inst.backend_override = None
-                else:
-                    self.breaker.record_failure()  # half-open → open
-                    self.report.breaker = self.breaker.snapshot()
-                    self.journal.write("breaker_reopen", step=i,
-                                       reason=rec.get("reason"))
-                    self.metrics.counter("runner.breaker_transitions",
-                                         to="reopen").inc()
-            attempt += 1
-            budget_used += 1
-            b = self._target_backend(t, backend, degraded)
-            sr.backend = b
-            tok = (DeadlineToken(self.step_deadline_s, clock=self.clock,
-                                 label=f"step {i} ({t.name})")
-                   if self.step_deadline_s is not None else None)
-            err = None
-            with trace.span(f"runner:{t.name}",
-                            meta={"step": i, "attempt": attempt,
-                                  "backend": b}) as sp:
-                try:
-                    scope = (deadline_scope(tok) if tok is not None
-                             else contextlib.nullcontext())
-                    with scope:
-                        out = self._execute(t, data, b, i, steps)
-                        if tok is not None:
-                            tok.check()  # isolated steps bypass the
-                            # registry wrapper in THIS process
-                    if self.validate is not None:
-                        self.validate(i, t.name, out)
+                    self._note_breaker_close(i, backend, observed=True)
+                    budget_used = 0
+                # breaker half-open (cooldown elapsed): ONE probe decides —
+                # success closes the breaker and un-degrades the run,
+                # failure re-opens it for another cooldown.  The probe
+                # slot is EXCLUSIVE (try_acquire_probe): with the breaker
+                # shared per backend, contending runs must not probe-storm
+                # a recovering device — losers stay degraded until the
+                # winner's verdict lands.
+                if (degraded and self._breaker_degraded
+                        and self.breaker.state == CircuitBreaker.HALF_OPEN
+                        and self.breaker.try_acquire_probe()):
+                    probe_resolved = False
+                    try:
+                        rec = self.probe()
+                        self.journal.write("health_check",
+                                           where=f"step {i} half-open",
+                                           result=rec)
+                        if rec.get("ok"):
+                            self.breaker.record_success()
+                            probe_resolved = True
+                            degraded = False
+                            self._note_breaker_close(i, backend)
+                        else:
+                            self.breaker.record_failure()  # → open again
+                            probe_resolved = True
+                            self.report.breaker = self.breaker.snapshot()
+                            self.journal.write(
+                                "breaker_reopen", step=i,
+                                reason=rec.get("reason"),
+                                signature=self.breaker.signature)
+                            self.metrics.counter(
+                                "runner.breaker_transitions",
+                                to="reopen").inc()
+                    finally:
+                        # a probe (or journal write) that RAISED before
+                        # a verdict must not leave the shared breaker's
+                        # exclusive probe slot claimed forever — that
+                        # would wedge every sharer on the fallback
+                        # until process restart.  Conditional on
+                        # purpose: after a verdict the slot may already
+                        # belong to ANOTHER run, and an unconditional
+                        # release would wipe that claim.
+                        if not probe_resolved:
+                            self.breaker.release_probe()
+                # pre-attempt gate on the SHARED breaker: a breaker opened
+                # by another run (or another step) rules this run degraded
+                # BEFORE it burns a single accelerator attempt — that is
+                # the whole point of per-backend breaker state.  While
+                # HALF_OPEN, one run's attempt IS the probe (exclusive
+                # claim); everyone else keeps treating the breaker as
+                # open until the verdict lands.  A step that just
+                # RE-PLANNED on fewer devices bypasses the gate once: the
+                # mesh-shrink rung is that iteration's degrade ruling and
+                # the shrunk stage must actually be attempted (the breaker
+                # is usually still open at that moment — it is what
+                # triggered the shrink).
+                if replanned:
+                    replanned = False
+                elif not degraded and not probing:
+                    b_next = self._target_backend(t, backend, degraded)
+                    on_accel_next = (self.fallback_backend is not None
+                                     and b_next != self.fallback_backend)
+                    if on_accel_next:
+                        # state read + probe acquire under ONE lock
+                        # hold: another sharer's record_success
+                        # between the two would otherwise rule this
+                        # run degraded off a stale HALF_OPEN read and
+                        # journal a fallback whose breaker snapshot
+                        # contradicts it
+                        with self.breaker.lock:
+                            st = self.breaker.state
+                            if st == CircuitBreaker.HALF_OPEN:
+                                probing = \
+                                    self.breaker.try_acquire_probe()
+                        if st == CircuitBreaker.OPEN or (
+                                st == CircuitBreaker.HALF_OPEN
+                                and not probing):
+                            degraded = self._degrade_breaker_open(
+                                i, short_circuit=True)
+                            budget_used = 0
+                            continue
+                attempt += 1
+                budget_used += 1
+                b = self._target_backend(t, backend, degraded)
+                sr.backend = b
+                tok = (DeadlineToken(self.step_deadline_s, clock=self.clock,
+                                     label=f"step {i} ({t.name})")
+                       if self.step_deadline_s is not None else None)
+                err = None
+                with trace.span(f"runner:{t.name}",
+                                meta={"step": i, "attempt": attempt,
+                                      "backend": b}) as sp:
+                    try:
+                        scope = (deadline_scope(tok) if tok is not None
+                                 else contextlib.nullcontext())
+                        with scope:
+                            out = self._execute(t, data, b, i, steps)
+                            if tok is not None:
+                                tok.check()  # isolated steps bypass the
+                                # registry wrapper in THIS process
+                        if self.validate is not None:
+                            self.validate(i, t.name, out)
+                        if self.checkpoint_dir:
+                            # inside the classified block on purpose: the
+                            # save fetches device results to host, and a
+                            # device that died between compute and save
+                            # must be retried/degraded like any other
+                            # step failure — not leak a raw raise
+                            save_celldata(out, self._ckpt_path(steps, i),
+                                          fingerprint=sr.fingerprint)
+                            if self.chaos is not None:
+                                # silent on-disk corruption, injected after
+                                # a good save — only the next resume's
+                                # digest verify can catch it
+                                self.chaos.on_checkpoint(
+                                    t.name, self._ckpt_path(steps, i), b)
+                    except BaseException as e:  # noqa: BLE001 — reported,
+                        err = e                 # classified, re-raised below
+                self._spans.append(sp)
+                status = "ok" if err is None else "error"
+                self.metrics.counter("runner.attempts", status=status,
+                                     backend=b).inc()
+                self.metrics.histogram("runner.step_wall_s",
+                                       status=status).observe(sp.duration)
+                if err is None:
+                    if probing:
+                        # the probe-claimed accelerator attempt succeeded —
+                        # the device is back: close the SHARED breaker so
+                        # the whole pool returns to the accelerator
+                        self.breaker.record_success()
+                        self._note_breaker_close(i, backend,
+                                                 undegrade=False)
+                        probing = False
+                    sr.attempts.append(StepAttempt(
+                        attempt, b, "ok", round(sp.duration, 4), sp.id))
+                    sr.status = "completed"
+                    self.journal.write(
+                        "attempt", step=i, name=t.name, attempt=attempt,
+                        backend=b, status="ok",
+                        wall_s=round(sp.duration, 4), span_id=sp.id)
                     if self.checkpoint_dir:
-                        # inside the classified block on purpose: the
-                        # save fetches device results to host, and a
-                        # device that died between compute and save
-                        # must be retried/degraded like any other
-                        # step failure — not leak a raw raise
-                        save_celldata(out, self._ckpt_path(steps, i),
-                                      fingerprint=sr.fingerprint)
-                        if self.chaos is not None:
-                            # silent on-disk corruption, injected after
-                            # a good save — only the next resume's
-                            # digest verify can catch it
-                            self.chaos.on_checkpoint(
-                                t.name, self._ckpt_path(steps, i), b)
-                except BaseException as e:  # noqa: BLE001 — reported,
-                    err = e                 # classified, re-raised below
-            self._spans.append(sp)
-            status = "ok" if err is None else "error"
-            self.metrics.counter("runner.attempts", status=status,
-                                 backend=b).inc()
-            self.metrics.histogram("runner.step_wall_s",
-                                   status=status).observe(sp.duration)
-            if err is None:
+                        self.journal.write("checkpoint", step=i,
+                                           fingerprint=sr.fingerprint)
+                        self.metrics.counter("runner.checkpoint_writes") \
+                            .inc()
+                        try:
+                            self.metrics.counter("runner.checkpoint_bytes") \
+                                .inc(os.path.getsize(
+                                    self._ckpt_path(steps, i)))
+                        except OSError:
+                            pass  # stat raced a cleanup; the write event
+                            # above already proves the save happened
+                    return out, degraded
+
+                cls = classify_error(err)
                 sr.attempts.append(StepAttempt(
-                    attempt, b, "ok", round(sp.duration, 4), sp.id))
-                sr.status = "completed"
+                    attempt, b, "error", round(sp.duration, 4), sp.id,
+                    error=f"{type(err).__name__}: {err}", classified=cls))
                 self.journal.write(
                     "attempt", step=i, name=t.name, attempt=attempt,
-                    backend=b, status="ok",
+                    backend=b, status="error", classified=cls,
+                    error=f"{type(err).__name__}: {err}",
                     wall_s=round(sp.duration, 4), span_id=sp.id)
-                if self.checkpoint_dir:
-                    self.journal.write("checkpoint", step=i,
-                                       fingerprint=sr.fingerprint)
-                    self.metrics.counter("runner.checkpoint_writes") \
-                        .inc()
-                    try:
-                        self.metrics.counter("runner.checkpoint_bytes") \
-                            .inc(os.path.getsize(
-                                self._ckpt_path(steps, i)))
-                    except OSError:
-                        pass  # stat raced a cleanup; the write event
-                        # above already proves the save happened
-                return out, degraded
-
-            cls = classify_error(err)
-            sr.attempts.append(StepAttempt(
-                attempt, b, "error", round(sp.duration, 4), sp.id,
-                error=f"{type(err).__name__}: {err}", classified=cls))
-            self.journal.write(
-                "attempt", step=i, name=t.name, attempt=attempt,
-                backend=b, status="error", classified=cls,
-                error=f"{type(err).__name__}: {err}",
-                wall_s=round(sp.duration, 4), span_id=sp.id)
-            if isinstance(err, StepDeadlineExceeded):
-                # its own journal event: the acceptance contract is
-                # that a wedged step leaves a "deadline" record before
-                # any breaker/fallback ruling it feeds into
-                self.journal.write(
-                    "deadline", step=i, name=t.name, attempt=attempt,
-                    budget_s=self.step_deadline_s)
-                self.metrics.counter("runner.deadline_overruns").inc()
-            if cls == FATAL:
-                sr.status = "aborted"
-                self.report.status = "aborted"
-                self.journal.write("run_aborted", step=i,
-                                   error=type(err).__name__)
-                raise err
-            if cls == DETERMINISTIC:
-                # retrying replays the same raise — fail fast, and
-                # hand the caller the REAL exception, not a wrapper
+                if isinstance(err, StepDeadlineExceeded):
+                    # its own journal event: the acceptance contract is
+                    # that a wedged step leaves a "deadline" record before
+                    # any breaker/fallback ruling it feeds into
+                    self.journal.write(
+                        "deadline", step=i, name=t.name, attempt=attempt,
+                        budget_s=self.step_deadline_s)
+                    self.metrics.counter("runner.deadline_overruns").inc()
+                # FATAL / DETERMINISTIC while holding the probe slot:
+                # no device verdict — the slot is released by the
+                # enclosing finally (the ONE release point; releasing
+                # here too could, after another run re-claimed the
+                # freed slot, wipe THAT claim and let two probes run)
+                if cls == FATAL:
+                    sr.status = "aborted"
+                    self.report.status = "aborted"
+                    self.journal.write("run_aborted", step=i,
+                                       error=type(err).__name__)
+                    raise err
+                if cls == DETERMINISTIC:
+                    # retrying replays the same raise — fail fast, and
+                    # hand the caller the REAL exception, not a wrapper
+                    sr.status = "failed"
+                    self.report.status = "failed"
+                    self.journal.write("run_failed", step=i,
+                                       classified=cls)
+                    raise err
+                # transient: feed the breaker (accelerator attempts only —
+                # there is nothing to trip when already on the fallback).
+                # prev→now read-modify under breaker.lock: with the
+                # breaker shared across runs, two concurrent failures must
+                # produce exactly ONE breaker_open journal event, on the
+                # run whose failure actually tripped it.
+                on_accel = (self.fallback_backend is not None
+                            and b != self.fallback_backend)
+                if on_accel:
+                    # probe=probing: only the half-open probe HOLDER's
+                    # failure re-opens the breaker (and resolves the
+                    # slot); a non-holder's failure — an attempt that
+                    # started before the cooldown elapsed — counts
+                    # into the window without wiping another run's
+                    # in-flight probe claim
+                    with self.breaker.lock:
+                        prev = self.breaker.state
+                        now_state = self.breaker.record_failure(
+                            probe=probing)
+                    probing = False  # record_failure resolved the probe
+                    self.report.breaker = self.breaker.snapshot()
+                    if (now_state == CircuitBreaker.OPEN
+                            and prev != CircuitBreaker.OPEN):
+                        to = ("reopen" if prev == CircuitBreaker.HALF_OPEN
+                              else "open")
+                        if to == "reopen":
+                            # a probe-claimed attempt lied: half_open → open
+                            self.journal.write(
+                                "breaker_reopen", step=i,
+                                signature=self.breaker.signature)
+                        else:
+                            self.journal.write("breaker_open", step=i,
+                                               **self.breaker.snapshot())
+                        self.metrics.counter("runner.breaker_transitions",
+                                             to=to).inc()
+                if on_accel and not degraded and not self.breaker.allow():
+                    # breaker OPEN: skip the remaining retries AND the
+                    # probe — straight to the degrade ruling.  For a
+                    # mesh-sharded stage the ruling is RE-PLAN ON FEWER
+                    # DEVICES first (shrink, then single-device); only
+                    # when those rungs are spent does the run leave the
+                    # accelerator for the fallback backend.
+                    shrunk = self._replan_fewer_devices(steps, i, t)
+                    if shrunk is not None:
+                        t = shrunk
+                        budget_used = 0
+                        replanned = True
+                        continue
+                    degraded = self._degrade_breaker_open(i)
+                    budget_used = 0  # fresh budget on the fallback
+                    continue
+                # retry with backoff until the budget is spent, then let
+                # the health probe rule on a backend fallback
+                if budget_used < policy.max_attempts:
+                    d = policy.delay_s(budget_used, rng)
+                    self.journal.write("backoff", step=i, attempt=attempt,
+                                       delay_s=round(d, 4))
+                    self.metrics.counter("runner.retries").inc()
+                    self.sleep(d)
+                    continue
+                if not degraded:
+                    # mesh-sharded stage out of budget: before ruling the
+                    # whole backend unhealthy, RE-PLAN ON FEWER DEVICES —
+                    # shrink the mesh (half the devices), then the
+                    # single-device fused form; only when those rungs are
+                    # spent does the run fall through to the cpu fallback
+                    shrunk = self._replan_fewer_devices(steps, i, t)
+                    if shrunk is not None:
+                        t = shrunk
+                        budget_used = 0  # fresh budget on the smaller mesh
+                        replanned = True
+                        continue
+                if (not degraded and self.fallback_backend
+                        and b != self.fallback_backend):
+                    if self._rule_unhealthy(where=f"step {i}"):
+                        degraded = True  # report fields set by the ruling
+                        budget_used = 0  # fresh budget on the healthy backend
+                        continue
                 sr.status = "failed"
                 self.report.status = "failed"
-                self.journal.write("run_failed", step=i,
-                                   classified=cls)
-                raise err
-            # transient: feed the breaker (accelerator attempts only —
-            # there is nothing to trip when already on the fallback)
-            on_accel = (self.fallback_backend is not None
-                        and b != self.fallback_backend)
-            if on_accel:
-                prev = self.breaker.state
-                now_state = self.breaker.record_failure()
-                self.report.breaker = self.breaker.snapshot()
-                if (now_state == CircuitBreaker.OPEN
-                        and prev != CircuitBreaker.OPEN):
-                    self.journal.write("breaker_open", step=i,
-                                       **self.breaker.snapshot())
-                    self.metrics.counter("runner.breaker_transitions",
-                                         to="open").inc()
-            if on_accel and not degraded and not self.breaker.allow():
-                # breaker OPEN: skip the remaining retries AND the
-                # probe — straight to the degrade ruling.  For a
-                # mesh-sharded stage the ruling is RE-PLAN ON FEWER
-                # DEVICES first (shrink, then single-device); only
-                # when those rungs are spent does the run leave the
-                # accelerator for the fallback backend.
-                shrunk = self._replan_fewer_devices(steps, i, t)
-                if shrunk is not None:
-                    t = shrunk
-                    budget_used = 0
-                    continue
-                warnings.warn(
-                    "ResilientRunner: circuit breaker OPEN "
-                    f"({self.breaker.failure_threshold} transient "
-                    f"failures within {self.breaker.window_s:g}s) — "
-                    f"DEGRADING remaining steps to backend="
-                    f"{self.fallback_backend!r} without probing.  A "
-                    "successful probe after the cooldown closes the "
-                    "breaker and returns to the accelerator.",
-                    RuntimeWarning, stacklevel=2)
-                self.journal.write("fallback", where=f"step {i}",
-                                   backend=self.fallback_backend,
-                                   reason="breaker_open")
-                self.metrics.counter("runner.degrades",
-                                     reason="breaker_open").inc()
-                self._inst.backend_override = "degraded"
-                self.report.degraded = True
-                self.report.backend = self.fallback_backend
-                degraded = True
-                self._breaker_degraded = True
-                budget_used = 0  # fresh budget on the fallback
-                continue
-            # retry with backoff until the budget is spent, then let
-            # the health probe rule on a backend fallback
-            if budget_used < policy.max_attempts:
-                d = policy.delay_s(budget_used, rng)
-                self.journal.write("backoff", step=i, attempt=attempt,
-                                   delay_s=round(d, 4))
-                self.metrics.counter("runner.retries").inc()
-                self.sleep(d)
-                continue
-            if not degraded:
-                # mesh-sharded stage out of budget: before ruling the
-                # whole backend unhealthy, RE-PLAN ON FEWER DEVICES —
-                # shrink the mesh (half the devices), then the
-                # single-device fused form; only when those rungs are
-                # spent does the run fall through to the cpu fallback
-                shrunk = self._replan_fewer_devices(steps, i, t)
-                if shrunk is not None:
-                    t = shrunk
-                    budget_used = 0  # fresh budget on the smaller mesh
-                    continue
-            if (not degraded and self.fallback_backend
-                    and b != self.fallback_backend):
-                if self._rule_unhealthy(where=f"step {i}"):
-                    degraded = True  # report fields set by the ruling
-                    budget_used = 0  # fresh budget on the healthy backend
-                    continue
-            sr.status = "failed"
-            self.report.status = "failed"
-            self.journal.write("run_failed", step=i, classified=cls)
-            raise ResilientRunError(
-                f"step {i} ({t.name!r}) failed {attempt} times on "
-                f"backend {b!r}; last error: "
-                f"{type(err).__name__}: {err}", self.report) from err
+                self.journal.write("run_failed", step=i, classified=cls)
+                raise ResilientRunError(
+                    f"step {i} ({t.name!r}) failed {attempt} times on "
+                    f"backend {b!r}; last error: "
+                    f"{type(err).__name__}: {err}", self.report) from err
+        finally:
+            # resolve-or-release invariant for the SHARED breaker's
+            # exclusive half-open probe slot: every verdict path
+            # (record_success / record_failure / explicit release)
+            # clears `probing`, so this fires only when an exception
+            # escaped BETWEEN claim and verdict (journal write,
+            # metrics, validate ...).  A leaked claim would wedge
+            # every sharer on the fallback until process restart.
+            if probing:
+                self.breaker.release_probe()
+
+    def _note_breaker_close(self, i: int, backend,
+                            undegrade: bool = True,
+                            observed: bool = False) -> None:
+        """Bookkeeping for a breaker CLOSE this run ruled or observed
+        (the symmetric twin of ``_degrade_breaker_open`` — one place
+        for the journal/report/metrics close sequence).
+        ``observed=True`` means another sharer's probe closed it: the
+        close is journaled for THIS run's story but the transition
+        counter is not incremented (the closer already counted it).
+        ``undegrade=False`` is the probe-claimed-attempt path, where
+        the run was never degraded to begin with."""
+        self.report.breaker = self.breaker.snapshot()
+        self.journal.write("breaker_close", step=i, observed=observed,
+                           signature=self.breaker.signature)
+        if not observed:
+            self.metrics.counter("runner.breaker_transitions",
+                                 to="close").inc()
+        if undegrade:
+            self._breaker_degraded = False
+            self.report.degraded = False
+            self.report.backend = backend
+            self._inst.backend_override = None
+
+    def _degrade_breaker_open(self, i: int,
+                              short_circuit: bool = False) -> bool:
+        """The breaker-open degrade ruling: warn loudly, journal the
+        fallback (naming the registry breaker that ruled), flip the
+        run onto the fallback backend.  ``short_circuit=True`` marks
+        the pre-attempt path — a breaker opened by ANOTHER run ruled
+        this one degraded before it burned a single accelerator
+        attempt.  Returns the new degraded flag (always True)."""
+        warnings.warn(
+            "ResilientRunner: circuit breaker OPEN "
+            f"({self.breaker.failure_threshold} transient "
+            f"failures within {self.breaker.window_s:g}s"
+            + (f" on backend {self.breaker.signature!r}"
+               if self.breaker.signature else "")
+            + ") — DEGRADING remaining steps to backend="
+            f"{self.fallback_backend!r} without probing.  A "
+            "successful probe after the cooldown closes the "
+            "breaker and returns to the accelerator.",
+            RuntimeWarning, stacklevel=3)
+        self.journal.write("fallback", where=f"step {i}",
+                           backend=self.fallback_backend,
+                           reason="breaker_open",
+                           signature=self.breaker.signature,
+                           short_circuit=short_circuit)
+        self.metrics.counter("runner.degrades",
+                             reason="breaker_open").inc()
+        self._inst.backend_override = "degraded"
+        self.report.degraded = True
+        self.report.backend = self.fallback_backend
+        self.report.breaker = self.breaker.snapshot()
+        self._breaker_degraded = True
+        return True
 
     def _replan_fewer_devices(self, steps, i: int, t):
         """The sharded-stage degrade rung: re-plan step ``i`` on half

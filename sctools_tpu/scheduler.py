@@ -1,0 +1,669 @@
+"""Admission-controlled run scheduler: many runs, one process.
+
+The north star is a SERVICE — many small heterogeneous analyses from
+many tenants sharing one hot device — and ``run_recipe()`` alone is
+the wrong shape for it: every call is an island with unbounded
+concurrency, a fresh circuit breaker per run (ten concurrent runs
+each independently burn K failures rediscovering the same dead
+backend), no queueing, no quotas, and no way to shed load before the
+host falls over.  :class:`RunScheduler` is the admission-control and
+scheduling layer in front of ``runner.ResilientRunner``:
+
+* **Bounded concurrency** — a fixed worker pool (``max_concurrency``
+  threads); everything else waits in a priority/FIFO queue (higher
+  ``priority=`` first, FIFO within a priority).
+* **Per-tenant quotas** — each submission carries ``tenant=``; a
+  tenant has an in-flight cap (enforced at dispatch: an over-quota
+  tenant's work stays queued and CANNOT starve other tenants — lower
+  priority work from under-quota tenants dispatches past it) and a
+  queue-depth cap (enforced at admission:
+  :class:`RunRejected` ``reason="tenant_queue_quota"``).
+* **Queue deadlines** — a submission with ``deadline_s=`` whose
+  deadline would expire before it could plausibly START (estimated
+  from queue position and an EWMA of observed run walls) is rejected
+  AT ADMISSION (``reason="deadline_unmeetable"``) instead of timing
+  out mid-queue; a deadline that expires while queued anyway (the
+  estimate was optimistic) is shed at dispatch time
+  (``reason="deadline_expired"``).
+* **Load shedding** — when the queue would exceed
+  ``queue_high_water``, the LOWEST-priority queued item (tie-broken
+  toward the most queue-hogging tenant, then the youngest arrival)
+  is shed with a journaled ``shed`` event to make room for
+  higher-priority work; an arrival that is itself the lowest
+  priority is rejected (``reason="queue_full"``) — overload degrades
+  the cheapest work, not everyone.
+* **Shared failure state** — every worker resolves its circuit
+  breaker from one :class:`~sctools_tpu.utils.failsafe.BreakerRegistry`
+  (per BACKEND, not per run): the first run to trip the tpu breaker
+  short-circuits every queued run straight to the degrade ruling,
+  and one half-open probe success un-degrades the whole pool.
+* **Observability** — a JSONL journal (``submitted`` → ``admitted`` |
+  ``rejected``, then ``shed`` | ``run_completed`` | ``run_failed``
+  per ticket; every terminal state carries a reason) plus ``sched.*``
+  metrics in the shared ``MetricsRegistry``: queue-depth gauge,
+  admitted/rejected/shed counters labelled ``tenant=``/``reason=``,
+  and a queue-wait histogram.
+* **Chaos** — ``chaos=`` arms the same seeded ``ChaosMonkey`` for
+  every worker (activated once for the pool's lifetime, so faults
+  fire on every thread) AND gives admission its own fault channel:
+  ``reject_storm`` faults fire through ``ChaosMonkey.on_admission``,
+  so the shed/reject paths are tier-1 testable like device faults.
+
+All scheduling runs on the injectable clock (``utils/vclock.py``) —
+queue waits, deadline estimates and EWMA run walls move on a
+``VirtualClock`` in tests with zero real sleeps.  Thread-safety of
+the underlying layers is part of the contract: deadline tokens are
+thread-local, each runner's telemetry/deadline wrappers install
+thread-locally, and the shared breaker's transitions are atomic
+(``failsafe.CircuitBreaker.lock``).
+
+>>> from sctools_tpu.scheduler import RunScheduler
+>>> with RunScheduler(max_concurrency=2) as sched:
+...     h = sched.submit(seurat_pipeline(), data, tenant="lab-a",
+...                      priority=1, deadline_s=300, backend="tpu")
+...     out = h.result()
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import threading
+
+from .registry import Pipeline
+from .runner import (DEFAULT_FALLBACK_BACKEND, ResilientRunner,
+                     _Journal, run_backend_signature)
+from .utils import telemetry
+from .utils.failsafe import BreakerRegistry, default_breaker_registry
+from .utils.vclock import SYSTEM_CLOCK
+
+#: every submission ends in exactly ONE of these (the journal
+#: coherence contract the chaos soak asserts)
+TERMINAL_STATES = ("completed", "failed", "rejected", "shed")
+
+#: EWMA smoothing for observed run walls (the deadline estimator)
+_EWMA_ALPHA = 0.3
+
+
+class RunRejected(RuntimeError):
+    """A submission refused AT ADMISSION.  ``reason`` is machine-
+    readable (``tenant_queue_quota`` / ``deadline_unmeetable`` /
+    ``queue_full`` / ``reject_storm`` / ``scheduler_closed``) and
+    matches the journal record and the ``sched.rejected`` metric
+    label."""
+
+    def __init__(self, msg: str, *, reason: str,
+                 tenant: str | None = None):
+        super().__init__(msg)
+        self.reason = reason
+        self.tenant = tenant
+
+
+class RunShed(RunRejected):
+    """An ADMITTED submission dropped before it ran (load shedding,
+    expired queue deadline, scheduler shutdown).  Raised by
+    ``RunHandle.result()``; ``reason`` matches the journaled ``shed``
+    event."""
+
+
+class RunHandle:
+    """The caller's view of one admitted submission.
+
+    ``status`` moves ``queued`` → ``running`` → ``completed`` |
+    ``failed``, or ``queued`` → ``shed``.  ``result()`` blocks until
+    terminal and returns the run's output, re-raises the run's real
+    exception (``failed``), or raises :class:`RunShed`.  ``report``
+    carries the worker's ``RunReport`` once the run started —
+    per-step attempts, degrade rulings and the shared-breaker
+    snapshot, exactly as a direct ``ResilientRunner`` caller would
+    see them."""
+
+    def __init__(self, ticket: int, tenant: str, priority: int,
+                 deadline_s: float | None):
+        self.ticket = ticket
+        self.tenant = tenant
+        self.priority = priority
+        self.deadline_s = deadline_s
+        self.report = None
+        self.reason: str | None = None
+        self._status = "queued"
+        self._result = None
+        self._error: BaseException | None = None
+        self._terminal = threading.Event()
+
+    @property
+    def status(self) -> str:
+        return self._status
+
+    def done(self) -> bool:
+        return self._terminal.is_set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until the run is terminal; False on timeout."""
+        return self._terminal.wait(timeout)
+
+    def result(self, timeout: float | None = None):
+        if not self._terminal.wait(timeout):
+            raise TimeoutError(
+                f"run {self.ticket} (tenant {self.tenant!r}) not "
+                f"terminal after {timeout}s (status {self._status!r})")
+        if self._status == "completed":
+            return self._result
+        raise self._error
+
+    def _mark_running(self) -> None:
+        self._status = "running"
+
+    def _finish(self, status: str, result=None,
+                error: BaseException | None = None,
+                reason: str | None = None) -> None:
+        self._result = result
+        self._error = error
+        self.reason = reason
+        self._status = status
+        self._terminal.set()
+
+    def __repr__(self):
+        return (f"RunHandle(ticket={self.ticket}, "
+                f"tenant={self.tenant!r}, priority={self.priority}, "
+                f"status={self._status!r})")
+
+
+@dataclasses.dataclass
+class TenantQuota:
+    """Per-tenant admission limits.  ``max_in_flight`` bounds how many
+    of the tenant's runs execute concurrently (enforced at dispatch —
+    must be >= 1, or admitted work could never dispatch and shutdown
+    would wait on it forever); ``max_queued`` bounds its queue depth
+    (enforced at admission — 0 is legal and means "reject everything
+    from this tenant at the door")."""
+
+    max_in_flight: int = 2
+    max_queued: int = 8
+
+    def __post_init__(self):
+        if self.max_in_flight < 1:
+            raise ValueError(
+                "TenantQuota.max_in_flight must be >= 1 — a 0 quota "
+                "would admit work that can never dispatch (use "
+                "max_queued=0 to refuse a tenant at admission)")
+        if self.max_queued < 0:
+            raise ValueError("TenantQuota.max_queued must be >= 0")
+
+
+@dataclasses.dataclass
+class _QueueItem:
+    seq: int
+    tenant: str
+    priority: int
+    deadline_s: float | None
+    submitted_at: float
+    pipeline: Pipeline
+    data: object
+    backend: str | None
+    runner_kw: dict
+    handle: RunHandle
+
+    def sort_key(self):
+        # higher priority first, FIFO within a priority
+        return (-self.priority, self.seq)
+
+
+class RunScheduler:
+    """Bounded worker pool + admission-controlled priority queue in
+    front of ``ResilientRunner`` (module docstring has the full
+    contract).
+
+    Parameters
+    ----------
+    max_concurrency : int
+        Worker threads — the GLOBAL in-flight bound.
+    queue_high_water : int
+        Queue depth above which load shedding kicks in (shed the
+        lowest-priority queued item, or reject the arrival when it
+        is itself the lowest).
+    tenant_max_in_flight, tenant_max_queued : int
+        Default per-tenant quotas; ``quotas={tenant: TenantQuota}``
+        overrides individual tenants.
+    expected_run_s : float
+        Seed for the EWMA of observed run walls that the
+        ``deadline_s`` admission estimate uses; 0 disables
+        estimate-based rejection until the first run completes.
+    clock : vclock.Clock
+        Time source for queue waits, deadlines and the EWMA
+        (default: the system clock; tests share one VirtualClock
+        with runners, breakers and chaos).
+    metrics : telemetry.MetricsRegistry | None
+        Where ``sched.*`` series land; defaults to the process-wide
+        registry (shared with every runner the pool creates).
+    journal_path : str | None
+        JSONL admission/terminal journal; at ``shutdown()`` the
+        metrics snapshot is written next to it as ``metrics.json``
+        (the pair ``tools/sctreport.py`` renders a scheduler section
+        from).
+    breakers : failsafe.BreakerRegistry | None
+        Shared per-backend breaker state for every worker; defaults
+        to the process-wide ``default_breaker_registry()``.
+    chaos : ChaosMonkey | None
+        Armed ONCE for the pool's lifetime (faults fire on every
+        worker thread; the runner's own activation is a no-op while
+        the pool holds the hook) and consulted at admission for
+        ``reject_storm`` faults.
+    runner_defaults : dict | None
+        Keyword defaults for every ``ResilientRunner`` the pool
+        constructs (``policy=``, ``probe=``, ``step_deadline_s=`` …);
+        per-submission ``runner_kw`` overrides them.
+    """
+
+    def __init__(self, *, max_concurrency: int = 2,
+                 queue_high_water: int = 64,
+                 tenant_max_in_flight: int = 2,
+                 tenant_max_queued: int = 8,
+                 quotas: dict | None = None,
+                 expected_run_s: float = 0.0,
+                 clock=None, metrics=None,
+                 journal_path: str | None = None,
+                 breakers: BreakerRegistry | None = None,
+                 chaos=None, runner_defaults: dict | None = None):
+        if max_concurrency < 1:
+            raise ValueError("max_concurrency must be >= 1")
+        if queue_high_water < 1:
+            raise ValueError("queue_high_water must be >= 1")
+        self.max_concurrency = int(max_concurrency)
+        self.queue_high_water = int(queue_high_water)
+        # TenantQuota.__post_init__ validates everything constructed
+        # here — the defaults and any tuple-shaped overrides (an
+        # unvalidated max_in_flight=0 would admit work that can never
+        # dispatch and deadlock shutdown on it)
+        self._default_quota = TenantQuota(tenant_max_in_flight,
+                                          tenant_max_queued)
+        self._quotas = {t: (q if isinstance(q, TenantQuota)
+                            else TenantQuota(*q))
+                        for t, q in (quotas or {}).items()}
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
+        self.metrics = (metrics if metrics is not None
+                        else telemetry.default_registry())
+        self.journal = _Journal(journal_path)
+        self.breakers = (breakers if breakers is not None
+                         else default_breaker_registry())
+        self.chaos = chaos
+        self.runner_defaults = dict(runner_defaults or {})
+
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: list[_QueueItem] = []   # kept sorted by sort_key
+        self._queued_by_tenant: dict[str, int] = {}
+        self._running_total = 0
+        self._running_by_tenant: dict[str, int] = {}
+        self._seq = 0
+        self._closed = False
+        self._threads: list[threading.Thread] = []
+        self._ewma_run_s = float(expected_run_s)
+        self._stats = {
+            "submitted": 0, "admitted": 0, "rejected": 0, "shed": 0,
+            "completed": 0, "failed": 0,
+            "max_queue_depth": 0, "max_in_flight_total": 0,
+            "max_in_flight_by_tenant": {},
+        }
+        # audit trail for the shed-ordering contract: one
+        # (victim_priority, min_priority_left_in_queue) pair per shed
+        self._shed_audit: list[tuple[int, int | None]] = []
+        # the pool holds the chaos hook for its whole lifetime so a
+        # finishing run can never pop the wrapper out from under a
+        # concurrent one (the monkey's own activation is reentrant)
+        self._hooks = contextlib.ExitStack()
+        if chaos is not None:
+            self._hooks.enter_context(chaos.activate())
+
+    # -- context manager ------------------------------------------------
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        # always wait: popping the chaos hook / snapshotting metrics
+        # under still-running workers would change their behavior
+        # mid-run.  On the exception path the queue is shed so the
+        # wait is bounded by the in-flight runs only.
+        self.shutdown(wait=True, shed_queued=exc[0] is not None)
+        return False
+
+    # -- admission ------------------------------------------------------
+    def submit(self, pipeline: Pipeline, data, *, tenant: str = "default",
+               priority: int = 0, deadline_s: float | None = None,
+               backend: str | None = None,
+               runner_kw: dict | None = None) -> RunHandle:
+        """Admit one run (or refuse it, raising :class:`RunRejected`).
+
+        Admission rulings, in order: scheduler open → chaos
+        ``reject_storm`` → tenant queue quota → queue-deadline
+        feasibility → global high-water (shed a lower-priority victim
+        or reject the arrival).  An admitted run returns a
+        :class:`RunHandle`; its journal trail is
+        ``submitted`` → ``admitted`` → (``shed`` | ``run_completed``
+        | ``run_failed``)."""
+        with self._cv:
+            ticket = self._seq
+            self._seq += 1
+            self._stats["submitted"] += 1
+            self.journal.write(
+                "submitted", ticket=ticket, tenant=tenant,
+                priority=priority, deadline_s=deadline_s,
+                queue_depth=len(self._queue))
+            if self._closed:
+                self._reject(ticket, tenant, "scheduler_closed")
+            if self.chaos is not None and \
+                    self.chaos.on_admission(tenant, backend=backend):
+                self._reject(ticket, tenant, "reject_storm")
+            quota = self._quota(tenant)
+            if self._queued_by_tenant.get(tenant, 0) >= quota.max_queued:
+                self._reject(ticket, tenant, "tenant_queue_quota")
+            if deadline_s is not None:
+                est = self._estimate_start_wait_locked(priority, ticket)
+                if deadline_s <= 0 or est > deadline_s:
+                    self._reject(
+                        ticket, tenant, "deadline_unmeetable",
+                        detail=f"estimated start wait {est:g}s > "
+                               f"deadline {deadline_s:g}s")
+            if len(self._queue) >= self.queue_high_water:
+                victim = self._pick_victim_locked(priority)
+                if victim is None:
+                    self._reject(ticket, tenant, "queue_full")
+                self._shed_locked(victim, "queue_high_water")
+            handle = RunHandle(ticket, tenant, priority, deadline_s)
+            item = _QueueItem(ticket, tenant, int(priority), deadline_s,
+                              self.clock.monotonic(), pipeline, data,
+                              backend, dict(runner_kw or {}), handle)
+            self._insert_locked(item)
+            self._stats["admitted"] += 1
+            self.journal.write("admitted", ticket=ticket, tenant=tenant,
+                               priority=priority,
+                               queue_depth=len(self._queue))
+            self.metrics.counter("sched.admitted", tenant=tenant).inc()
+            self._ensure_workers_locked()
+            self._cv.notify()
+            return handle
+
+    def _quota(self, tenant: str) -> TenantQuota:
+        return self._quotas.get(tenant, self._default_quota)
+
+    def _reject(self, ticket: int, tenant: str, reason: str,
+                detail: str = ""):
+        self._stats["rejected"] += 1
+        self.journal.write("rejected", ticket=ticket, tenant=tenant,
+                           reason=reason)
+        self.metrics.counter("sched.rejected", tenant=tenant,
+                             reason=reason).inc()
+        raise RunRejected(
+            f"run {ticket} (tenant {tenant!r}) rejected at admission: "
+            f"{reason}" + (f" ({detail})" if detail else ""),
+            reason=reason, tenant=tenant)
+
+    def _insert_locked(self, item: _QueueItem) -> None:
+        # sorted insert; the queue is short (bounded by the high-water
+        # mark), so a linear scan beats heap bookkeeping under sheds
+        key = item.sort_key()
+        idx = len(self._queue)
+        for j, other in enumerate(self._queue):
+            if key < other.sort_key():
+                idx = j
+                break
+        self._queue.insert(idx, item)
+        self._queued_by_tenant[item.tenant] = \
+            self._queued_by_tenant.get(item.tenant, 0) + 1
+        self._note_queue_depth_locked()
+
+    def _remove_locked(self, item: _QueueItem) -> None:
+        self._queue.remove(item)
+        self._queued_by_tenant[item.tenant] -= 1
+        self._note_queue_depth_locked()
+
+    def _note_queue_depth_locked(self) -> None:
+        depth = len(self._queue)
+        self._stats["max_queue_depth"] = max(
+            self._stats["max_queue_depth"], depth)
+        self.metrics.gauge("sched.queue_depth").set(depth)
+
+    def _estimate_start_wait_locked(self, priority: int,
+                                    seq: int) -> float:
+        """How long a new (priority, seq) arrival would plausibly wait
+        before STARTING: queue position ahead of it over the worker
+        count, scaled by the EWMA of observed run walls.  Returns 0
+        while no wall has been observed (nothing to estimate from)."""
+        avg = self._ewma_run_s
+        if avg <= 0.0:
+            return 0.0
+        key = (-int(priority), seq)
+        ahead = sum(1 for it in self._queue if it.sort_key() < key)
+        free = self.max_concurrency - self._running_total
+        if ahead < max(0, free):
+            return 0.0
+        waves = (ahead - max(0, free)) // self.max_concurrency + 1
+        return waves * avg
+
+    def _pick_victim_locked(self, new_priority: int):
+        """The shed victim for an arriving ``new_priority`` run:
+        strictly-lower priority only (shedding an equal never helps
+        the arrival), lowest priority first, tie-broken toward the
+        tenant hogging the most queue, then the youngest arrival
+        (oldest work keeps its FIFO claim).  None → nothing to shed;
+        the arrival is rejected instead."""
+        cands = [it for it in self._queue if it.priority < new_priority]
+        if not cands:
+            return None
+        return min(cands, key=lambda it: (
+            it.priority,
+            -self._queued_by_tenant.get(it.tenant, 0),
+            -it.seq))
+
+    def _shed_locked(self, item: _QueueItem, reason: str) -> None:
+        self._remove_locked(item)
+        left = [it.priority for it in self._queue]
+        self._shed_audit.append((item.priority,
+                                 min(left) if left else None))
+        self._stats["shed"] += 1
+        self.journal.write("shed", ticket=item.seq, tenant=item.tenant,
+                           priority=item.priority, reason=reason,
+                           queue_depth=len(self._queue))
+        self.metrics.counter("sched.shed", tenant=item.tenant,
+                             reason=reason).inc()
+        item.handle._finish(
+            "shed", error=RunShed(
+                f"run {item.seq} (tenant {item.tenant!r}) shed while "
+                f"queued: {reason}", reason=reason, tenant=item.tenant),
+            reason=reason)
+
+    # -- dispatch -------------------------------------------------------
+    def _ensure_workers_locked(self) -> None:
+        while len(self._threads) < self.max_concurrency:
+            th = threading.Thread(
+                target=self._worker, daemon=True,
+                name=f"sct-sched-worker-{len(self._threads)}")
+            self._threads.append(th)
+            th.start()
+
+    def _pop_eligible_locked(self):
+        """The next runnable item: highest priority (FIFO within)
+        whose tenant is under its in-flight quota — an over-quota
+        tenant's head-of-queue work never blocks other tenants.
+        Items whose queue deadline expired are shed on the way.
+        Marks the winner running (counters + stats) before
+        returning it."""
+        now = self.clock.monotonic()
+        for it in [q for q in self._queue
+                   if q.deadline_s is not None
+                   and now - q.submitted_at >= q.deadline_s]:
+            self._shed_locked(it, "deadline_expired")
+        if self._running_total >= self.max_concurrency:
+            return None
+        for it in self._queue:
+            quota = self._quota(it.tenant)
+            if self._running_by_tenant.get(it.tenant, 0) \
+                    >= quota.max_in_flight:
+                continue
+            self._remove_locked(it)
+            self._running_total += 1
+            n = self._running_by_tenant.get(it.tenant, 0) + 1
+            self._running_by_tenant[it.tenant] = n
+            self._stats["max_in_flight_total"] = max(
+                self._stats["max_in_flight_total"], self._running_total)
+            per = self._stats["max_in_flight_by_tenant"]
+            per[it.tenant] = max(per.get(it.tenant, 0), n)
+            return it
+        return None
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                item = self._pop_eligible_locked()
+                while item is None:
+                    if self._closed and not self._queue:
+                        return
+                    self._cv.wait()
+                    item = self._pop_eligible_locked()
+                waited = self.clock.monotonic() - item.submitted_at
+                self.metrics.histogram("sched.queue_wait_s") \
+                    .observe(waited)
+                item.handle._mark_running()
+            t0 = self.clock.monotonic()
+            status, result, error = "completed", None, None
+            runner = None
+            try:
+                runner = self._make_runner(item)
+                result = runner.run(item.data, backend=item.backend)
+            except BaseException as e:  # noqa: BLE001 — the worker
+                # must survive anything a run raises (including
+                # chaos-injected process-death stand-ins); the error
+                # is kept for the handle, classified by the runner's
+                # own journal/report, and re-raised to the caller
+                # from RunHandle.result()
+                status, error = "failed", e
+            wall = self.clock.monotonic() - t0
+            if runner is not None:
+                item.handle.report = runner.report
+            with self._cv:
+                self._running_total -= 1
+                self._running_by_tenant[item.tenant] -= 1
+                self._ewma_run_s = (
+                    wall if self._ewma_run_s <= 0.0
+                    else (1 - _EWMA_ALPHA) * self._ewma_run_s
+                    + _EWMA_ALPHA * wall)
+                self._stats[status] += 1
+                self._cv.notify_all()
+            # terminal journal writes OUTSIDE the dispatch lock: disk
+            # latency must not stall other tenants' admission or other
+            # workers' dispatch.  Ordering is safe — this ticket's
+            # "admitted" line was flushed before the item ever entered
+            # the queue, and _Journal serializes concurrent appends.
+            if status == "completed":
+                self.journal.write(
+                    "run_completed", ticket=item.seq,
+                    tenant=item.tenant, wall_s=round(wall, 4),
+                    degraded=bool(runner.report.degraded))
+            else:
+                self.journal.write(
+                    "run_failed", ticket=item.seq,
+                    tenant=item.tenant, wall_s=round(wall, 4),
+                    error=f"{type(error).__name__}: {error}")
+            item.handle._finish(status, result=result, error=error,
+                                reason=None if error is None
+                                else type(error).__name__)
+
+    def _make_runner(self, item: _QueueItem) -> ResilientRunner:
+        kw = dict(self.runner_defaults)
+        kw.update(item.runner_kw)
+        kw.setdefault("clock", self.clock)
+        kw.setdefault("metrics", self.metrics)
+        if self.chaos is not None:
+            kw.setdefault("chaos", self.chaos)
+        if kw.get("breaker") is None:
+            # shared per-backend failure state — THE scheduler
+            # contract: resolve from this pool's registry, not a
+            # fresh run-local breaker (signature keyed by the run's
+            # accelerator backend, matching what feeds the breaker).
+            # An explicit breaker=None in runner kwargs means "use
+            # the default" — which, inside a pool, is THIS registry,
+            # never the runner's process-global fallback
+            kw["breaker"] = self.breakers.get(
+                run_backend_signature(
+                    item.pipeline, item.backend,
+                    kw.get("fallback_backend",
+                           DEFAULT_FALLBACK_BACKEND)),
+                clock=self.clock)
+        return ResilientRunner(item.pipeline, **kw)
+
+    # -- introspection / shutdown --------------------------------------
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def stats(self) -> dict:
+        """Counters and high-water marks for quota audits: submission
+        funnel totals, max observed global/per-tenant in-flight, max
+        queue depth, and the shed audit trail
+        ``(victim_priority, min_priority_left)`` — the soak's
+        shed-ordering oracle."""
+        with self._lock:
+            out = dict(self._stats)
+            out["max_in_flight_by_tenant"] = dict(
+                self._stats["max_in_flight_by_tenant"])
+            out["shed_audit"] = list(self._shed_audit)
+            out["queue_depth"] = len(self._queue)
+            out["ewma_run_s"] = self._ewma_run_s
+            out["breakers"] = self.breakers.snapshot()
+            return out
+
+    def shutdown(self, wait: bool = True, shed_queued: bool = False,
+                 timeout: float | None = None) -> bool:
+        """Stop admitting; drain (default) or shed the queue
+        (``shed_queued=True``, journaled ``reason="shutdown"``), join
+        the workers, release the chaos hook, and write the metrics
+        snapshot next to the journal (``metrics.json``) for
+        ``tools/sctreport.py``.  Idempotent; returns True when
+        teardown completed.  ``timeout`` bounds the TOTAL wait across
+        all workers.  With ``wait=False`` — or a timeout that expires
+        with workers still mid-run (returns False, with a warning) —
+        the hook release and the metrics snapshot are DEFERRED:
+        popping the chaos wrapper or snapshotting under live workers
+        would change in-flight behavior; call again with ``wait=True``
+        to finish teardown."""
+        with self._cv:
+            self._closed = True
+            if shed_queued:
+                for it in list(self._queue):
+                    self._shed_locked(it, "shutdown")
+            self._cv.notify_all()
+        if not wait:
+            return False
+        # SYSTEM clock on purpose (cf. failsafe.watch_process): these
+        # are REAL thread joins — a virtual clock would rule a healthy
+        # drain timed out instantly
+        deadline = (None if timeout is None
+                    else SYSTEM_CLOCK.monotonic() + timeout)
+        for th in self._threads:
+            th.join(None if deadline is None else
+                    max(0.0, deadline - SYSTEM_CLOCK.monotonic()))
+        if any(th.is_alive() for th in self._threads):
+            import warnings
+
+            warnings.warn(
+                f"RunScheduler.shutdown: workers still running after "
+                f"{timeout:g}s — teardown (chaos hook release, "
+                f"metrics snapshot) DEFERRED; call shutdown() again "
+                f"to finish.", RuntimeWarning, stacklevel=2)
+            return False
+        self._hooks.close()
+        if self.journal.path:
+            mpath = os.path.join(
+                os.path.dirname(os.path.abspath(self.journal.path)),
+                "metrics.json")
+            try:
+                self.metrics.write(mpath)
+            except OSError as e:
+                import warnings
+
+                warnings.warn(
+                    f"RunScheduler: could not write {mpath} "
+                    f"({type(e).__name__}: {e})", RuntimeWarning,
+                    stacklevel=2)
+        return True

@@ -25,6 +25,7 @@ import contextlib
 import dataclasses
 import fnmatch
 import random
+import threading
 
 import numpy as np
 
@@ -33,7 +34,14 @@ from .failsafe import TransientDeviceError, check_deadline
 from .vclock import SYSTEM_CLOCK
 
 MODES = ("unavailable", "hang", "wedge", "corrupt",
-         "corrupt_checkpoint", "crash", "kill")
+         "corrupt_checkpoint", "crash", "kill", "reject_storm")
+
+# which hook channel each mode fires on: most modes wrap the op CALL;
+# corrupt_checkpoint fires through the runner's on_checkpoint hook,
+# reject_storm through the scheduler's on_admission hook (where the
+# fault's ``op`` pattern matches TENANT names, not transform names)
+_MODE_CHANNEL = {"corrupt_checkpoint": "checkpoint",
+                 "reject_storm": "admission"}
 
 
 class ChaosCrash(BaseException):
@@ -124,12 +132,21 @@ class ChaosMonkey:
       quarantine path exists to catch on the next resume.
     * ``crash`` — raise :class:`ChaosCrash` (in-process stand-in for
       process death; aborts the whole run, testing resume).
+    * ``reject_storm`` — never fires on an op call; fires through
+      :meth:`on_admission` (the run scheduler consults it for every
+      ``submit()``) and makes admission REJECT the submission
+      (``RunRejected(reason="reject_storm")``).  The fault's ``op``
+      pattern matches TENANT names on this channel
+      (``Fault("tenant-a", "reject_storm", times=3)``), so the
+      shed/reject paths are testable under the same seeded spec as
+      device faults.
     * ``kill`` — ``os._exit(9)``: REAL process death.  Only meaningful
       inside a contained child (``failsafe.run_isolated``); in the
       parent process it takes the test runner down with it.
 
     ``calls`` counts invocations per op name (checkpoint saves count
-    separately under ``"<op>@checkpoint"``); ``injected`` logs every
+    separately under ``"<op>@checkpoint"``, admission consults under
+    ``"<tenant>@admission"``); ``injected`` logs every
     firing as ``{"op", "call", "mode", "backend"}`` — two monkeys with
     equal faults/seed driving the same workload produce identical
     logs (the determinism contract tier-1 pins).
@@ -147,14 +164,29 @@ class ChaosMonkey:
         self.calls: dict[str, int] = {}
         self.injected: list[dict] = []
         self._rng = random.Random(seed)
+        # one monkey serves every scheduler worker thread (the chaos
+        # wrapper is deliberately GLOBAL): the count-increment →
+        # fault-match → injected-log sequence must be atomic or
+        # concurrent calls lose counts and shift every Nth-call
+        # window.  Op execution itself never runs under this lock.
+        self._lock = threading.RLock()
+        # activation refcount: concurrent activate() calls (e.g. two
+        # pool workers whose runners both carry chaos=) must install
+        # the wrapper exactly once and pop it only when the LAST
+        # activation exits — an unguarded membership check could
+        # double-install, and a finishing run could strip the wrapper
+        # out from under a concurrent one
+        self._active = 0
 
     # -- picklable spec: forwards the monkey (with its call counts)
     # into failsafe.run_isolated children so Nth-call semantics span
     # the containment boundary
     def spec(self) -> dict:
+        with self._lock:
+            calls = dict(self.calls)
         return {"faults": [dataclasses.asdict(f) for f in self.faults],
                 "seed": self.seed, "hang_s": self.hang_s,
-                "wedge_s": self.wedge_s, "calls": dict(self.calls)}
+                "wedge_s": self.wedge_s, "calls": calls}
 
     @classmethod
     def from_spec(cls, spec: dict) -> "ChaosMonkey":
@@ -167,7 +199,29 @@ class ChaosMonkey:
         """Record that a contained child invoked ``name`` once (the
         parent's counter must advance even though the wrap ran in the
         child's process)."""
-        self.calls[name] = self.calls.get(name, 0) + 1
+        with self._lock:
+            self.calls[name] = self.calls.get(name, 0) + 1
+
+    def on_admission(self, tenant: str,
+                     backend: str | None = None) -> bool:
+        """Scheduler hook, consulted at every ``submit()``: True when
+        a matching ``reject_storm`` fault fires — the scheduler then
+        rejects the submission at admission.  On this channel the
+        fault's ``op`` pattern matches the TENANT name; call counting
+        is per tenant under ``"<tenant>@admission"``, so
+        ``on_call``/``times`` windows work exactly like device
+        faults."""
+        key = f"{tenant}@admission"
+        with self._lock:
+            call_no = self.calls.get(key, 0) + 1
+            self.calls[key] = call_no
+            f = self._firing(tenant, backend, call_no,
+                             channel="admission")
+            if f is None:
+                return False
+            self.injected.append({"op": tenant, "call": call_no,
+                                  "mode": f.mode, "backend": backend})
+        return True
 
     def on_checkpoint(self, name: str, path: str,
                       backend: str | None = None) -> bool:
@@ -178,13 +232,15 @@ class ChaosMonkey:
         damage is exactly the silent on-disk corruption that only the
         NEXT resume's digest verification can catch."""
         key = f"{name}@checkpoint"
-        call_no = self.calls.get(key, 0) + 1
-        self.calls[key] = call_no
-        f = self._firing(name, backend, call_no, channel="checkpoint")
-        if f is None:
-            return False
-        self.injected.append({"op": name, "call": call_no,
-                              "mode": f.mode, "backend": backend})
+        with self._lock:
+            call_no = self.calls.get(key, 0) + 1
+            self.calls[key] = call_no
+            f = self._firing(name, backend, call_no,
+                             channel="checkpoint")
+            if f is None:
+                return False
+            self.injected.append({"op": name, "call": call_no,
+                                  "mode": f.mode, "backend": backend})
         rng = random.Random((self.seed, name, call_no, "ckpt").__repr__())
         with open(path, "r+b") as fh:
             blob = bytearray(fh.read())
@@ -198,10 +254,10 @@ class ChaosMonkey:
     def _firing(self, name: str, backend: str, call_no: int,
                 channel: str = "call"):
         for f in self.faults:
-            # corrupt_checkpoint faults live on the checkpoint channel
-            # (fired by on_checkpoint), every other mode on the op-call
-            # channel — a fault never fires on the wrong one
-            if (f.mode == "corrupt_checkpoint") != (channel == "checkpoint"):
+            # every mode fires on exactly one hook channel (op call /
+            # checkpoint save / admission) — a fault never fires on
+            # the wrong one
+            if _MODE_CHANNEL.get(f.mode, "call") != channel:
                 continue
             if not fnmatch.fnmatchcase(name, f.op):
                 continue
@@ -218,13 +274,16 @@ class ChaosMonkey:
 
     def _wrap(self, name: str, backend: str, fn):
         def chaotic(data, *args, **kw):
-            call_no = self.calls.get(name, 0) + 1
-            self.calls[name] = call_no
-            f = self._firing(name, backend, call_no)
+            with self._lock:
+                call_no = self.calls.get(name, 0) + 1
+                self.calls[name] = call_no
+                f = self._firing(name, backend, call_no)
+                if f is not None:
+                    self.injected.append(
+                        {"op": name, "call": call_no,
+                         "mode": f.mode, "backend": backend})
             if f is None:
                 return fn(data, *args, **kw)
-            self.injected.append({"op": name, "call": call_no,
-                                  "mode": f.mode, "backend": backend})
             if f.mode == "unavailable":
                 raise TransientDeviceError(
                     f"chaos: UNAVAILABLE injected in {name!r} "
@@ -278,15 +337,22 @@ class ChaosMonkey:
         """Install into the transform registry for the enclosed block;
         every ``apply``/``Transform``/``Pipeline`` call is wrapped.
 
-        Reentrant: nested activation of the SAME monkey (e.g. a test's
-        ``with monkey.activate():`` around a runner that was also given
-        ``chaos=monkey``) installs the wrapper once — a double wrap
-        would double-count every call and shift Nth-call faults."""
-        if self._wrap in registry._CALL_WRAPPERS:
-            yield self
-            return
-        registry.push_call_wrapper(self._wrap)
+        Reentrant AND thread-safe via an activation refcount: nested
+        or concurrent activation of the SAME monkey (a test's ``with
+        monkey.activate():`` around a runner that was also given
+        ``chaos=monkey``, or two scheduler workers whose runners both
+        carry it) installs the wrapper once, and only the LAST exit
+        pops it — a double wrap would double-count every call and
+        shift Nth-call faults, and an early pop would strip fault
+        injection from a still-running concurrent run."""
+        with self._lock:
+            self._active += 1
+            if self._active == 1:
+                registry.push_call_wrapper(self._wrap)
         try:
             yield self
         finally:
-            registry.pop_call_wrapper(self._wrap)
+            with self._lock:
+                self._active -= 1
+                if self._active == 0:
+                    registry.pop_call_wrapper(self._wrap)

@@ -37,7 +37,16 @@ is recoverable in-process; containment means subprocesses + watchdogs.
   a sliding window the breaker OPENS and the runner short-circuits
   further accelerator attempts straight to the degrade ruling (no
   more probe storms); after a cooldown it HALF-OPENS and a single
-  successful probe closes it again.
+  successful probe closes it again.  Thread-safe, with an EXCLUSIVE
+  half-open probe slot (``try_acquire_probe``) so contending runs
+  never probe-storm a recovering device.
+* :class:`BreakerRegistry` — process-wide breakers keyed by backend
+  signature (one per BACKEND, not per run): the first run to trip
+  the tpu breaker short-circuits every concurrent/queued run, and
+  one half-open probe success un-degrades the whole pool.
+  ``ResilientRunner`` resolves its default breaker here; the run
+  scheduler (``sctools_tpu/scheduler.py``) hands every worker the
+  same registry.
 
 All scheduling here goes through the injectable clock
 (``utils/vclock.py``), so every recovery path is tier-1 testable with
@@ -248,9 +257,18 @@ def classify_child_result(res: dict, step: str) -> BaseException:
 # Cooperative per-step deadlines
 # ---------------------------------------------------------------------------
 
-#: innermost-last stack of active DeadlineTokens (single-threaded
-#: pipeline execution; the runner scopes one token per step attempt)
-_DEADLINES: list["DeadlineToken"] = []
+#: innermost-last stack of active DeadlineTokens, PER THREAD (the
+#: runner scopes one token per step attempt; with the scheduler's
+#: worker pool several runs execute concurrently, and thread A's
+#: deadline must never rule thread B's op overrun)
+_DEADLINES = threading.local()
+
+
+def _deadline_stack() -> list["DeadlineToken"]:
+    stack = getattr(_DEADLINES, "stack", None)
+    if stack is None:
+        stack = _DEADLINES.stack = []
+    return stack
 
 
 class DeadlineToken:
@@ -287,16 +305,19 @@ class DeadlineToken:
 
 @contextlib.contextmanager
 def deadline_scope(token: DeadlineToken):
-    """Make ``token`` the current deadline for the enclosed block."""
-    _DEADLINES.append(token)
+    """Make ``token`` the current deadline for the enclosed block
+    (on THIS thread — scopes never leak across scheduler workers)."""
+    stack = _deadline_stack()
+    stack.append(token)
     try:
         yield token
     finally:
-        _DEADLINES.remove(token)
+        stack.remove(token)
 
 
 def current_deadline() -> DeadlineToken | None:
-    return _DEADLINES[-1] if _DEADLINES else None
+    stack = _deadline_stack()
+    return stack[-1] if stack else None
 
 
 def check_deadline() -> None:
@@ -323,6 +344,24 @@ class CircuitBreaker:
     from the injectable clock, so tests drive it with a
     :class:`~sctools_tpu.utils.vclock.VirtualClock` and zero real
     sleeps.
+
+    THREAD-SAFE: one breaker instance is shared by every concurrent
+    run against the same backend (:class:`BreakerRegistry`), so all
+    state transitions and snapshots happen under ``self.lock`` (a
+    public, reentrant lock — callers that must observe a transition
+    atomically, e.g. the runner's did-THIS-failure-open-it check,
+    take it around their read-modify sequence).  The half-open probe
+    is EXCLUSIVE: :meth:`try_acquire_probe` hands the single probe
+    slot to exactly one caller per half-open episode; everyone else
+    keeps treating the breaker as open until the probe resolves
+    (``record_success`` closes / ``record_failure`` re-opens — both
+    release the slot, as does :meth:`release_probe` for a probe that
+    ended without a transient verdict).
+
+    ``signature`` names the backend this breaker guards when it came
+    from a :class:`BreakerRegistry` (``None`` for run-local
+    breakers); it rides in every snapshot so journals say WHICH
+    shared breaker ruled.
     """
 
     CLOSED = "closed"
@@ -331,64 +370,179 @@ class CircuitBreaker:
 
     def __init__(self, failure_threshold: int = 3,
                  window_s: float = 300.0, cooldown_s: float = 60.0,
-                 clock: Clock | None = None):
+                 clock: Clock | None = None,
+                 signature: str | None = None):
         if failure_threshold < 1:
             raise ValueError("failure_threshold must be >= 1")
         self.failure_threshold = failure_threshold
         self.window_s = float(window_s)
         self.cooldown_s = float(cooldown_s)
         self.clock = clock if clock is not None else SYSTEM_CLOCK
+        self.signature = signature
+        self.lock = threading.RLock()
         self._failures: list[float] = []
         self._state = self.CLOSED
         self._opened_at: float | None = None
+        self._probe_claimed = False
         self.opened_count = 0
 
     @property
     def state(self) -> str:
-        if self._state == self.OPEN and self._opened_at is not None \
-                and self.clock.monotonic() - self._opened_at \
-                >= self.cooldown_s:
-            self._state = self.HALF_OPEN
-        return self._state
+        with self.lock:
+            if self._state == self.OPEN and self._opened_at is not None \
+                    and self.clock.monotonic() - self._opened_at \
+                    >= self.cooldown_s:
+                self._state = self.HALF_OPEN
+                self._probe_claimed = False
+            return self._state
 
     def allow(self) -> bool:
         """May the caller attempt the accelerator right now?  False
         only while OPEN (cooldown not yet elapsed)."""
         return self.state != self.OPEN
 
-    def record_failure(self) -> str:
+    def try_acquire_probe(self) -> bool:
+        """Claim the single half-open probe slot.  True for exactly
+        ONE caller per half-open episode; False while not half-open
+        or while another caller's probe is in flight.  The claim is
+        released by ``record_success`` / ``record_failure`` /
+        ``release_probe``."""
+        with self.lock:
+            if self.state != self.HALF_OPEN or self._probe_claimed:
+                return False
+            self._probe_claimed = True
+            return True
+
+    def release_probe(self) -> None:
+        """Release a claimed probe slot WITHOUT a verdict (the probe
+        attempt died on a deterministic/fatal error that says nothing
+        about the device) — another caller may claim it."""
+        with self.lock:
+            self._probe_claimed = False
+
+    def record_failure(self, probe: bool = True) -> str:
         """Record one classified-transient failure; returns the new
-        state.  K failures inside the window trip CLOSED→OPEN; any
-        failure while HALF_OPEN re-opens (the probe lied)."""
-        now = self.clock.monotonic()
-        self._failures.append(now)
-        self._failures = [t for t in self._failures
-                          if now - t <= self.window_s]
-        st = self.state
-        if st == self.HALF_OPEN or (
-                st == self.CLOSED
-                and len(self._failures) >= self.failure_threshold):
-            self._state = self.OPEN
-            self._opened_at = now
-            self.opened_count += 1
-        return self.state
+        state.  K failures inside the window trip CLOSED→OPEN; a
+        PROBE failure while HALF_OPEN re-opens (the probe lied) and
+        releases the probe slot.
+
+        ``probe=False`` marks a failure from a caller that does NOT
+        hold the half-open probe slot (e.g. a shared-breaker run
+        whose attempt started before the cooldown elapsed): it counts
+        into the window but neither re-opens the breaker nor touches
+        another run's in-flight probe claim — in HALF_OPEN, only the
+        probe holder's verdict moves the state.  The default stays
+        ``True`` because the single-run breaker's only half-open
+        failure IS the probe verdict."""
+        with self.lock:
+            now = self.clock.monotonic()
+            self._failures.append(now)
+            self._failures = [t for t in self._failures
+                              if now - t <= self.window_s]
+            st = self.state
+            if (st == self.HALF_OPEN and probe) or (
+                    st == self.CLOSED
+                    and len(self._failures) >= self.failure_threshold):
+                self._state = self.OPEN
+                self._opened_at = now
+                self.opened_count += 1
+                self._probe_claimed = False
+            elif probe:
+                # a probe holder failing outside HALF_OPEN (state
+                # moved on under it) still releases its claim
+                self._probe_claimed = False
+            return self.state
 
     def record_success(self) -> str:
         """A successful probe (or accelerator attempt): close the
         breaker and clear the failure window."""
-        self._failures.clear()
-        self._state = self.CLOSED
-        self._opened_at = None
-        return self._state
+        with self.lock:
+            self._failures.clear()
+            self._state = self.CLOSED
+            self._opened_at = None
+            self._probe_claimed = False
+            return self._state
 
     def snapshot(self) -> dict:
-        """Journal/report-ready view of the breaker."""
-        return {"state": self.state,
-                "failures_in_window": len(self._failures),
-                "opened_count": self.opened_count,
-                "failure_threshold": self.failure_threshold,
-                "window_s": self.window_s,
-                "cooldown_s": self.cooldown_s}
+        """Journal/report-ready view of the breaker.  Atomic: taken
+        under the lock, so a concurrent ``record_failure`` can never
+        tear ``state`` apart from ``failures_in_window``."""
+        with self.lock:
+            return {"state": self.state,
+                    "failures_in_window": len(self._failures),
+                    "opened_count": self.opened_count,
+                    "failure_threshold": self.failure_threshold,
+                    "window_s": self.window_s,
+                    "cooldown_s": self.cooldown_s,
+                    "signature": self.signature}
+
+
+class BreakerRegistry:
+    """Process-wide circuit breakers, ONE PER BACKEND — not per run.
+
+    A fresh ``CircuitBreaker`` per ``ResilientRunner`` means ten
+    concurrent runs each independently burn K failures rediscovering
+    the same dead backend.  The registry keys breakers by a backend
+    signature (``"tpu"``, ``"cpu"``, …): the first run to trip the
+    tpu breaker short-circuits every queued run straight to the
+    degrade ruling, and one half-open probe success un-degrades the
+    whole pool.  ``get`` is get-or-create (creation kwargs — clock,
+    thresholds — apply on FIRST sight of a signature only);
+    ``snapshot`` is the report-ready view of every breaker.  The
+    clock is injectable per registry AND per ``get``, so tests drive
+    cooldowns on a ``VirtualClock`` with zero real sleeps.
+    """
+
+    def __init__(self, clock: Clock | None = None, **breaker_defaults):
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
+        self._defaults = dict(breaker_defaults)
+        self._lock = threading.Lock()
+        self._breakers: dict[str, CircuitBreaker] = {}
+
+    def get(self, signature: str, **kw) -> CircuitBreaker:
+        """The shared breaker for ``signature`` (get-or-create).
+        ``kw`` (``failure_threshold=``, ``clock=`` …) applies only
+        when this call creates the breaker — later callers share the
+        first creator's instance unchanged."""
+        signature = str(signature)
+        with self._lock:
+            b = self._breakers.get(signature)
+            if b is None:
+                merged = {**self._defaults, **kw}
+                merged.setdefault("clock", self.clock)
+                b = self._breakers[signature] = CircuitBreaker(
+                    signature=signature, **merged)
+            return b
+
+    def signatures(self) -> list[str]:
+        with self._lock:
+            return sorted(self._breakers)
+
+    def snapshot(self) -> dict:
+        """``{signature: breaker.snapshot()}`` for every breaker the
+        process has seen — the scheduler/report view of shared
+        failure state."""
+        with self._lock:
+            breakers = dict(self._breakers)
+        return {sig: b.snapshot() for sig, b in sorted(breakers.items())}
+
+    def reset(self) -> None:
+        """Drop every breaker (tests; a long-lived service that wants
+        to forget history).  Runs holding a breaker reference keep
+        it — they just stop sharing with future runs."""
+        with self._lock:
+            self._breakers.clear()
+
+
+#: the process-wide default registry — ``ResilientRunner`` resolves
+#: its breaker here (keyed by the run's backend) unless handed an
+#: explicit ``breaker=``; "process-wide" is the contract that makes
+#: breaker state shared PER BACKEND, not per run
+_DEFAULT_BREAKERS = BreakerRegistry()
+
+
+def default_breaker_registry() -> BreakerRegistry:
+    return _DEFAULT_BREAKERS
 
 
 def probe_device(timeout_s: float = 90.0, platform: str | None = None) -> dict:

@@ -70,6 +70,9 @@ EVENTS = frozenset({
     "resume", "resume_unverified_input", "resume_place_failed",
     # end-of-run telemetry artifacts
     "metrics_written", "trace_exported",
+    # scheduler admission funnel (sctools_tpu/scheduler.py; terminal
+    # run events reuse run_completed/run_failed with ticket= fields)
+    "submitted", "admitted", "rejected", "shed",
 })
 
 #: Every legal metric name → one-line meaning (the docs table).  Like
@@ -134,6 +137,20 @@ METRICS = {
                           "TRACE when the caller is inside an "
                           "enclosing jit (the compiled program "
                           "re-runs without re-dispatching)",
+    "sched.queue_depth": "gauge: runs waiting in the scheduler's "
+                         "admission queue (set on every queue "
+                         "mutation)",
+    "sched.admitted": "counter: submissions admitted to the queue "
+                      "(labels tenant=)",
+    "sched.rejected": "counter: submissions refused at admission "
+                      "(labels tenant=, reason= tenant_queue_quota|"
+                      "deadline_unmeetable|queue_full|reject_storm|"
+                      "scheduler_closed)",
+    "sched.shed": "counter: admitted runs dropped before running "
+                  "(labels tenant=, reason= queue_high_water|"
+                  "deadline_expired|shutdown)",
+    "sched.queue_wait_s": "histogram: admission-to-dispatch queue "
+                          "wait seconds (on the injectable clock)",
 }
 
 #: Fixed histogram bucket upper bounds (seconds), chosen to straddle

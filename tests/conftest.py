@@ -31,3 +31,19 @@ def pytest_sessionstart(session):
     assert jax.device_count() >= 8, (
         f"expected >=8 virtual devices, got {jax.device_count()}"
     )
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_shared_breakers():
+    """Breaker state is process-shared PER BACKEND by design
+    (failsafe.BreakerRegistry) — in production the whole point, in a
+    test session a leak: one test tripping the shared tpu breaker
+    would short-circuit every later runner test to the degrade
+    ruling.  Drop all shared breakers after each test."""
+    yield
+    from sctools_tpu.utils.failsafe import default_breaker_registry
+
+    default_breaker_registry().reset()

@@ -304,3 +304,78 @@ def test_spec_roundtrip_carries_wedge_s():
     monkey = ChaosMonkey([Fault("x", "wedge")], wedge_s=42.0)
     clone = ChaosMonkey.from_spec(monkey.spec())
     assert clone.wedge_s == 42.0
+
+
+# ------------------------------------------------------- reject_storm
+
+def test_reject_storm_fires_only_on_admission_channel():
+    """reject_storm lives on the admission channel: on_admission
+    matches the fault's op pattern against TENANT names with
+    on_call/times windows, while op-call wrapping never fires it —
+    and device faults never leak into admission."""
+    monkey = ChaosMonkey(
+        [Fault("tenant-*", "reject_storm", on_call=2, times=2),
+         Fault("test.*", "unavailable", times=-1)])
+    # admission: call 1 below the window, calls 2-3 fire, call 4 past
+    assert monkey.on_admission("tenant-a") is False
+    assert monkey.on_admission("tenant-a") is True
+    assert monkey.on_admission("tenant-a") is True
+    assert monkey.on_admission("tenant-a") is False
+    # per-tenant counting: a different tenant has its own window
+    assert monkey.on_admission("tenant-b") is False
+    assert monkey.on_admission("tenant-b") is True
+    # a tenant that never matches the pattern never fires
+    assert monkey.on_admission("other") is False
+    assert monkey.calls["tenant-a@admission"] == 4
+    storm = [f for f in monkey.injected
+             if f["mode"] == "reject_storm"]
+    assert [f["op"] for f in storm] == ["tenant-a", "tenant-a",
+                                       "tenant-b"]
+    # the unavailable fault (op-call channel) never fired on
+    # admission even though "test.*" would match nothing here anyway
+    assert all(f["mode"] == "reject_storm" for f in storm)
+
+
+def test_reject_storm_never_fires_on_op_calls():
+    """A reject_storm fault whose pattern happens to match an op name
+    must NOT fire when that op is invoked — channels are disjoint."""
+    from sctools_tpu import registry as reg
+
+    @reg.register("test.storm_victim", backend="cpu")
+    def _victim(data, **kw):
+        return data
+
+    try:
+        monkey = ChaosMonkey(
+            [Fault("test.storm_victim", "reject_storm", times=-1)])
+        with monkey.activate():
+            out = reg.apply("test.storm_victim", 41, backend="cpu")
+        assert out == 41                  # op ran untouched
+        assert monkey.injected == []
+        assert monkey.calls["test.storm_victim"] == 1
+    finally:
+        reg._REGISTRY.pop("test.storm_victim", None)
+        reg._DOCS.pop("test.storm_victim", None)
+
+
+def test_reject_storm_spec_round_trip():
+    """reject_storm faults and their admission call counts survive
+    the picklable spec round trip like every other mode."""
+    monkey = ChaosMonkey(
+        [Fault("tenant-a", "reject_storm", times=3)], seed=5)
+    assert monkey.on_admission("tenant-a") is True
+    clone = ChaosMonkey.from_spec(monkey.spec())
+    assert clone.calls["tenant-a@admission"] == 1
+    assert clone.on_admission("tenant-a") is True   # call 2, in window
+
+
+def test_reject_storm_backend_scoped():
+    """A backend-restricted reject_storm fault fires only for
+    submissions targeting that backend (the scheduler forwards the
+    submission's backend= into on_admission)."""
+    monkey = ChaosMonkey(
+        [Fault("t", "reject_storm", times=-1, backend="tpu")])
+    assert monkey.on_admission("t", backend="cpu") is False
+    assert monkey.on_admission("t", backend="tpu") is True
+    assert monkey.on_admission("t", backend=None) is False
+    assert monkey.injected[-1]["backend"] == "tpu"

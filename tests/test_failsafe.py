@@ -89,3 +89,173 @@ def test_child_stall_classified_transient_end_to_end():
     err = classify_child_result(out, "test.step")
     assert isinstance(err, TransientDeviceError)
     assert classify_error(err) == TRANSIENT
+
+
+# ------------------------------------------------- shared breaker registry
+
+def _registry_imports():
+    from sctools_tpu.utils.failsafe import (BreakerRegistry,
+                                            CircuitBreaker,
+                                            default_breaker_registry)
+    from sctools_tpu.utils.vclock import VirtualClock
+
+    return BreakerRegistry, CircuitBreaker, default_breaker_registry, \
+        VirtualClock
+
+
+def test_breaker_registry_shares_one_breaker_per_signature():
+    BreakerRegistry, CircuitBreaker, _, VirtualClock = \
+        _registry_imports()
+    clock = VirtualClock()
+    reg = BreakerRegistry(clock=clock, failure_threshold=2)
+    a = reg.get("tpu")
+    b = reg.get("tpu")
+    assert a is b                       # SHARED, not per-call
+    assert a.signature == "tpu"
+    assert a.clock is clock and a.failure_threshold == 2
+    other = reg.get("cpu")
+    assert other is not a and other.signature == "cpu"
+    # creation kwargs apply on FIRST sight only
+    again = reg.get("tpu", failure_threshold=99)
+    assert again is a and again.failure_threshold == 2
+    snap = reg.snapshot()
+    assert set(snap) == {"tpu", "cpu"}
+    assert snap["tpu"]["signature"] == "tpu"
+    assert reg.signatures() == ["cpu", "tpu"]
+    reg.reset()
+    assert reg.get("tpu") is not a      # fresh after reset
+
+
+def test_default_breaker_registry_is_process_wide():
+    _, _, default_breaker_registry, VirtualClock = _registry_imports()
+    reg = default_breaker_registry()
+    assert default_breaker_registry() is reg
+    br = reg.get("test-sig", clock=VirtualClock(),
+                 failure_threshold=1)
+    assert reg.get("test-sig") is br
+    # the conftest autouse fixture resets this registry per test —
+    # trip state must not leak across the suite
+    br.record_failure()
+    assert br.state == "open"
+
+
+def test_breaker_hammer_no_torn_snapshots_single_open():
+    """Threaded hammer over ONE shared breaker: concurrent
+    record_failure + snapshot never tear (state/opened_count/window
+    always mutually consistent), and the CLOSED->OPEN transition is
+    observed by EXACTLY one thread when detected under breaker.lock
+    (the runner's no-double-open-journal recipe)."""
+    import threading
+
+    BreakerRegistry, CircuitBreaker, _, VirtualClock = \
+        _registry_imports()
+    clock = VirtualClock()
+    reg = BreakerRegistry(clock=clock, failure_threshold=5,
+                          window_s=1e9, cooldown_s=1e9)
+    br = reg.get("tpu")
+    n_threads, n_each = 8, 50
+    opens = []
+    torn = []
+    barrier = threading.Barrier(n_threads)
+
+    def worker():
+        barrier.wait()
+        for _ in range(n_each):
+            with br.lock:
+                prev = br.state
+                now = br.record_failure()
+            if now == CircuitBreaker.OPEN \
+                    and prev != CircuitBreaker.OPEN:
+                opens.append(1)
+            snap = br.snapshot()
+            # invariants a torn snapshot would break
+            if snap["state"] == CircuitBreaker.OPEN \
+                    and snap["opened_count"] < 1:
+                torn.append(snap)
+            if snap["opened_count"] == 0 \
+                    and snap["failures_in_window"] \
+                    >= snap["failure_threshold"]:
+                torn.append(snap)
+
+    threads = [threading.Thread(target=worker)
+               for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not torn, torn[:3]
+    assert sum(opens) == 1              # no double-open events
+    assert br.opened_count == 1         # cooldown never elapsed
+    assert br.snapshot()["failures_in_window"] == n_threads * n_each
+
+
+def test_breaker_half_open_probe_exclusive_under_contention():
+    """Exactly ONE contender wins the half-open probe slot; the
+    slot is released by a verdict (success/failure) or an explicit
+    release, never by losing contenders."""
+    import threading
+
+    _, CircuitBreaker, _, VirtualClock = _registry_imports()
+    clock = VirtualClock()
+    br = CircuitBreaker(failure_threshold=1, cooldown_s=10.0,
+                        clock=clock)
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.try_acquire_probe()   # not half-open yet
+    clock.advance(11.0)
+    assert br.state == CircuitBreaker.HALF_OPEN
+
+    n = 8
+    wins: list = []
+    barrier = threading.Barrier(n)
+
+    def claim():
+        barrier.wait()
+        wins.append(br.try_acquire_probe())
+
+    threads = [threading.Thread(target=claim) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sum(wins) == 1               # probe exclusivity
+    # failed probe: reopens AND releases the slot for the next episode
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    assert br.opened_count == 2
+    clock.advance(11.0)
+    assert br.try_acquire_probe()       # new episode, new slot
+    # release without a verdict: someone else may claim
+    br.release_probe()
+    assert br.try_acquire_probe()
+    # success closes and releases; closed state never hands out probes
+    br.record_success()
+    assert br.state == CircuitBreaker.CLOSED
+    assert not br.try_acquire_probe()
+
+
+def test_non_holder_failure_neither_reopens_nor_wipes_probe_claim():
+    """In HALF_OPEN, only the probe HOLDER's verdict moves the state:
+    a shared-breaker run whose attempt started before the cooldown
+    elapsed records its failure into the window (probe=False) without
+    re-opening the breaker or releasing another run's in-flight
+    probe claim."""
+    _, CircuitBreaker, _, VirtualClock = _registry_imports()
+    clock = VirtualClock()
+    br = CircuitBreaker(failure_threshold=1, cooldown_s=10.0,
+                        window_s=1e6, clock=clock)
+    br.record_failure()                         # trip: OPEN
+    clock.advance(11.0)
+    assert br.state == CircuitBreaker.HALF_OPEN
+    assert br.try_acquire_probe()               # run C holds the slot
+    # run B (non-holder) fails mid-flight: window grows, no verdict
+    assert br.record_failure(probe=False) == CircuitBreaker.HALF_OPEN
+    assert br.opened_count == 1                 # NOT re-opened
+    assert not br.try_acquire_probe()           # C's claim intact
+    # the holder's verdict still rules as before
+    assert br.record_failure(probe=True) == CircuitBreaker.OPEN
+    assert br.opened_count == 2
+    clock.advance(11.0)
+    assert br.try_acquire_probe()               # fresh episode
+    br.record_success()
+    assert br.state == CircuitBreaker.CLOSED

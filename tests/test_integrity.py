@@ -141,7 +141,8 @@ def test_breaker_snapshot_is_journal_ready():
     snap = br.snapshot()
     assert snap == {"state": "closed", "failures_in_window": 0,
                     "opened_count": 0, "failure_threshold": 2,
-                    "window_s": 10.0, "cooldown_s": 5.0}
+                    "window_s": 10.0, "cooldown_s": 5.0,
+                    "signature": None}  # run-local: no registry key
     json.dumps(snap)  # must serialise straight into the journal
 
 

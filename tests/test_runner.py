@@ -956,3 +956,156 @@ def test_failed_run_still_writes_artifacts(tmp_path):
     # written for failed runs but never journaled after the verdict
     events = _journal(os.path.join(str(tmp_path), "journal.jsonl"))
     assert events[-1]["event"] == "run_failed"
+
+
+# ------------------------------------------- shared breaker (registry)
+
+def test_default_breaker_shared_across_sequential_runs():
+    """PR-9 satellite regression: a runner constructed WITHOUT
+    breaker= resolves the run's backend signature in the process-
+    shared BreakerRegistry — two sequential runs share trip state
+    (run 2's first failure trips the breaker run 1 fed), where the
+    old per-run default would have made run 2 start from zero."""
+    from sctools_tpu.utils.failsafe import default_breaker_registry
+    from sctools_tpu.utils.vclock import VirtualClock
+
+    clock = VirtualClock()
+    data, pipe = _data(), _pipe()
+    monkey = ChaosMonkey(
+        [Fault("normalize.log1p", "unavailable", times=-1,
+               backend="tpu")], clock=clock)
+    # pre-seed the shared tpu breaker with this test's clock +
+    # threshold (first-creation kwargs win; the conftest fixture
+    # resets the registry after every test)
+    shared = default_breaker_registry().get(
+        "tpu", clock=clock, failure_threshold=3, window_s=1e6,
+        cooldown_s=1e6)
+
+    def run_once():
+        r = _runner(pipe, probe=lambda: dict(DOWN_PROBE),
+                    policy=RetryPolicy(max_attempts=2, jitter=0.0),
+                    clock=clock)
+        with monkey.activate():
+            with pytest.warns(RuntimeWarning):
+                r.run(data, backend="tpu")
+        return r
+
+    r1 = run_once()
+    assert r1.breaker is shared          # resolved from the registry
+    assert r1.breaker.signature == "tpu"
+    # run 1: 2 transient tpu failures (budget spent), probe DOWN ->
+    # degraded by the PROBE, breaker fed but not yet tripped
+    assert r1.report.degraded
+    assert shared.state == CircuitBreaker.CLOSED
+    assert shared.snapshot()["failures_in_window"] == 2
+
+    r2 = run_once()
+    assert r2.breaker is shared          # SAME breaker, second runner
+    # run 2's FIRST failure is the shared window's third: the breaker
+    # trips and rules the degrade — no fresh retry storm
+    assert shared.state == CircuitBreaker.OPEN
+    assert shared.opened_count == 1
+    log1p = next(s for s in r2.report.steps
+                 if s.name == "normalize.log1p")
+    assert len([a for a in log1p.attempts
+                if a.backend == "tpu"]) == 1
+    assert r2.report.breaker["signature"] == "tpu"
+
+    # a runner with an EXPLICIT breaker keeps run-local isolation
+    r3 = _runner(pipe, breaker=CircuitBreaker(clock=clock),
+                 clock=clock)
+    r3.run(data, backend="cpu")
+    assert r3.breaker is not shared and r3.breaker.signature is None
+
+
+def test_open_shared_breaker_short_circuits_fresh_run():
+    """A run that STARTS with the shared breaker already open never
+    attempts the accelerator: the pre-attempt gate rules the degrade
+    (journalled fallback reason=breaker_open, short_circuit flag,
+    registry signature) before the first attempt."""
+    import json as _json
+    import tempfile
+
+    from sctools_tpu.utils.failsafe import default_breaker_registry
+    from sctools_tpu.utils.vclock import VirtualClock
+
+    clock = VirtualClock()
+    data, pipe = _data(), _pipe()
+    shared = default_breaker_registry().get(
+        "tpu", clock=clock, failure_threshold=1, cooldown_s=1e6)
+    shared.record_failure()              # trip it before any run
+    assert shared.state == CircuitBreaker.OPEN
+
+    jdir = tempfile.mkdtemp(prefix="sct_breaker_")
+    r = _runner(pipe, checkpoint_dir=jdir, clock=clock)
+    with pytest.warns(RuntimeWarning, match="circuit breaker OPEN"):
+        out = r.run(data, backend="tpu")
+    assert out is not None
+    assert r.report.degraded
+    # ZERO tpu attempts anywhere — every step short-circuited to cpu
+    assert all(a.backend == "cpu" for s in r.report.steps
+               for a in s.attempts)
+    with open(os.path.join(jdir, "journal.jsonl")) as f:
+        events = [_json.loads(line) for line in f]
+    fb = [e for e in events if e["event"] == "fallback"]
+    assert fb and fb[0]["reason"] == "breaker_open"
+    assert fb[0]["short_circuit"] is True
+    assert fb[0]["signature"] == "tpu"
+    assert shared.opened_count == 1      # the run never re-tripped it
+
+
+def test_degraded_run_rejoins_when_shared_breaker_closes_elsewhere():
+    """Pool un-degrade contract: a run degraded by the shared breaker
+    REJOINS the accelerator as soon as another sharer's probe closes
+    it — it does not ride the cpu fallback to completion."""
+    from sctools_tpu.utils.vclock import VirtualClock
+
+    clock = VirtualClock()
+    data, pipe = _data(), _pipe()
+    breaker = CircuitBreaker(failure_threshold=1, window_s=1e6,
+                             cooldown_s=1e6, clock=clock)
+    monkey = ChaosMonkey(
+        [Fault("normalize.library_size", "unavailable", times=1,
+               backend="tpu")], clock=clock)
+    n_steps = len(pipe.steps)
+    lib_idx = next(i for i, t in enumerate(pipe.steps)
+                   if t.name == "normalize.library_size")
+    closed_at = lib_idx + 1
+    assert closed_at < n_steps - 1   # steps remain to rejoin on
+
+    def close_later(i, name, out):
+        # stand-in for ANOTHER run's successful half-open probe
+        if i == closed_at:
+            breaker.record_success()
+
+    r = _runner(pipe, breaker=breaker, clock=clock,
+                validate=close_later)
+    with monkey.activate():
+        with pytest.warns(RuntimeWarning, match="circuit breaker OPEN"):
+            out = r.run(data, backend="tpu")
+    assert out is not None
+    # degraded at log1p (threshold 1), back on tpu after closed_at
+    assert not r.report.degraded     # rejoined before the run ended
+    backends = [s.attempts[-1].backend for s in r.report.steps]
+    assert backends[closed_at] == "cpu"       # still degraded there
+    assert all(b == "tpu" for b in backends[closed_at + 1:])
+    assert len(backends) == n_steps
+
+
+def test_backend_signature_prefers_accelerator_in_mixed_pipeline():
+    """A mixed cpu+tpu pipeline keys the shared breaker by the
+    ACCELERATOR backend (the one whose failures feed it), not by
+    whatever backend step 0 happens to bind."""
+    from sctools_tpu.registry import Pipeline, Transform
+    from sctools_tpu.runner import run_backend_signature
+
+    mixed = Pipeline([Transform("normalize.log1p", backend="cpu"),
+                      Transform("normalize.scale", backend="tpu")])
+    assert run_backend_signature(mixed, None, "cpu") == "tpu"
+    # run-level override always wins
+    assert run_backend_signature(mixed, "tpu", "cpu") == "tpu"
+    # an all-fallback pipeline falls back to step 0's backend
+    all_cpu = Pipeline([Transform("normalize.log1p", backend="cpu")])
+    assert run_backend_signature(all_cpu, None, "cpu") == "cpu"
+    # no fallback configured: first step wins (legacy behavior)
+    assert run_backend_signature(mixed, None, None) == "cpu"

@@ -1,0 +1,661 @@
+"""RunScheduler — admission control, quotas, deadlines, shedding,
+shared breaker state, and the deterministic chaos soak.  Everything
+runs on the injectable VirtualClock with ZERO real sleeps; worker
+threads are real (that is the thing under test) but only ever block
+on test-controlled gates or instantly-completing ops."""
+
+import json
+import threading
+
+import pytest
+
+from sctools_tpu.data.synthetic import synthetic_counts
+from sctools_tpu.recipes import submit_recipe
+from sctools_tpu.registry import Pipeline, register
+from sctools_tpu.runner import RetryPolicy
+from sctools_tpu.scheduler import (RunRejected, RunScheduler, RunShed,
+                                   TenantQuota)
+from sctools_tpu.utils.chaos import ChaosMonkey, Fault
+from sctools_tpu.utils.failsafe import BreakerRegistry, CircuitBreaker
+from sctools_tpu.utils.telemetry import MetricsRegistry
+from sctools_tpu.utils.vclock import VirtualClock
+
+OK_PROBE = {"ok": True, "device_kind": "test", "wall_s": 0.0}
+DOWN_PROBE = {"ok": False, "reason": "test-ruled-down"}
+
+# test-op side channels (reset per test by the fixture below)
+_GATES: dict = {}
+_ORDER: list = []
+
+
+@pytest.fixture(scope="module")
+def sched_ops():
+    """Scheduler test transforms under the reserved ``test.`` prefix,
+    removed on module teardown so registry-wide gates (docs coverage,
+    cpu/tpu parity) never see them."""
+    names = []
+
+    def reg(name, fn):
+        register(name, backend="cpu")(fn)
+        register(name, backend="tpu")(fn)
+        names.append(name)
+
+    reg("test.sa_ok", lambda data, **kw: data)
+    reg("test.sa_flaky", lambda data, **kw: data)   # chaos targets it
+    reg("test.sa_wedge", lambda data, **kw: data)   # chaos targets it
+    reg("test.sa_fatal", lambda data, **kw: data)   # chaos targets it
+
+    def _block(data, gate="default", **kw):
+        started = _GATES.get(gate + ":started")
+        if started is not None:
+            started.set()  # the test can wait until the worker is
+            # genuinely wedged before building its queue
+        _GATES[gate].wait(60)
+        return data
+
+    reg("test.sa_block", _block)
+
+    def _tag(data, tag=None, **kw):
+        _ORDER.append(tag)
+        return data
+
+    reg("test.sa_tag", _tag)
+
+    def _boom(data, **kw):
+        raise ValueError("test.sa_boom: deliberate shape mismatch")
+
+    reg("test.sa_boom", _boom)
+    yield
+    registry_mod = __import__("sctools_tpu.registry",
+                              fromlist=["_REGISTRY", "_DOCS"])
+    for n in names:
+        registry_mod._REGISTRY.pop(n, None)
+        registry_mod._DOCS.pop(n, None)
+
+
+@pytest.fixture(autouse=True)
+def _clear_side_channels():
+    _GATES.clear()
+    _ORDER.clear()
+    yield
+
+
+def _data():
+    return synthetic_counts(32, 16, density=0.2, seed=0)
+
+
+def _pipe(name, **params):
+    return Pipeline([(name, dict(params))])
+
+
+def _sched(clock, **kw):
+    kw.setdefault("metrics", MetricsRegistry(clock=clock))
+    kw.setdefault("breakers", BreakerRegistry(clock=clock))
+    defaults = kw.pop("runner_defaults", {})
+    defaults.setdefault("probe", lambda: dict(OK_PROBE))
+    return RunScheduler(clock=clock, runner_defaults=defaults, **kw)
+
+
+def _journal(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+# the per-ticket terminal-accounting contract is asserted by the
+# SAME checker the run_checks soak gate uses — one implementation,
+# two enforcement points (soak_smoke registers its ops inside main(),
+# so this import is registry-clean)
+from soak_smoke import check_journal_coherent as \
+    _check_journal_coherent  # noqa: E402
+
+
+# ------------------------------------------------------- basic dispatch
+
+def test_runs_complete_and_handle_resolves(sched_ops):
+    clock = VirtualClock()
+    data = _data()
+    with _sched(clock, max_concurrency=2) as s:
+        hs = [s.submit(_pipe("test.sa_ok"), data, tenant=f"t{i % 3}",
+                       backend="cpu") for i in range(6)]
+        outs = [h.result(timeout=60) for h in hs]
+    assert all(h.status == "completed" for h in hs)
+    assert all(o.X.shape == data.X.shape for o in outs)
+    st = s.stats()
+    assert st["admitted"] == st["completed"] == 6
+    assert st["max_in_flight_total"] <= 2
+
+
+def test_priority_then_fifo_dispatch_order(sched_ops):
+    clock = VirtualClock()
+    _GATES["g"] = threading.Event()
+    with _sched(clock, max_concurrency=1,
+                tenant_max_queued=10) as s:
+        blocker = s.submit(_pipe("test.sa_block", gate="g"), _data(),
+                           tenant="blk", priority=9, backend="cpu")
+        hs = [s.submit(_pipe("test.sa_tag", tag=tag), _data(),
+                       tenant="t", priority=pri, backend="cpu")
+              for tag, pri in
+              [("a", 0), ("b", 2), ("c", 2), ("d", 1)]]
+        _GATES["g"].set()
+        for h in hs:
+            h.result(timeout=60)
+        blocker.result(timeout=60)
+    # higher priority first, FIFO within a priority
+    assert _ORDER == ["b", "c", "d", "a"]
+
+
+def test_failed_run_resolves_handle_with_real_error(sched_ops, tmp_path):
+    clock = VirtualClock()
+    jpath = str(tmp_path / "journal.jsonl")
+    with _sched(clock, max_concurrency=1, journal_path=jpath) as s:
+        h = s.submit(_pipe("test.sa_boom"), _data(), tenant="t",
+                     backend="cpu")
+        with pytest.raises(ValueError, match="deliberate shape"):
+            h.result(timeout=60)
+    assert h.status == "failed" and h.reason == "ValueError"
+    assert h.report is not None and h.report.status == "failed"
+    events = [e["event"] for e in _journal(jpath) if "ticket" in e]
+    assert events == ["submitted", "admitted", "run_failed"]
+
+
+def test_submit_recipe_rides_the_scheduler(sched_ops):
+    clock = VirtualClock()
+    data = synthetic_counts(120, 60, n_clusters=3)
+    with _sched(clock, max_concurrency=1) as s:
+        h = submit_recipe(s, "seurat", data, tenant="lab-a",
+                          backend="cpu", n_top_genes=20, min_genes=1,
+                          min_cells=1)
+        out = h.result(timeout=120)
+    assert out.X.shape[1] == 20
+    assert h.report is not None and h.report.status == "completed"
+
+
+def test_submit_after_shutdown_rejected(sched_ops):
+    clock = VirtualClock()
+    s = _sched(clock, max_concurrency=1)
+    s.shutdown()
+    with pytest.raises(RunRejected) as ei:
+        s.submit(_pipe("test.sa_ok"), _data(), tenant="t")
+    assert ei.value.reason == "scheduler_closed"
+
+
+# ---------------------------------------------------------------- quotas
+
+def test_tenant_queue_quota_rejects_at_admission(sched_ops, tmp_path):
+    clock = VirtualClock()
+    m = MetricsRegistry(clock=clock)
+    _GATES["g"] = threading.Event()
+    jpath = str(tmp_path / "journal.jsonl")
+    with _sched(clock, max_concurrency=1, tenant_max_queued=2,
+                metrics=m, journal_path=jpath) as s:
+        blocker = s.submit(_pipe("test.sa_block", gate="g"), _data(),
+                           tenant="blk", backend="cpu")
+        h1 = s.submit(_pipe("test.sa_ok"), _data(), tenant="x",
+                      backend="cpu")
+        h2 = s.submit(_pipe("test.sa_ok"), _data(), tenant="x",
+                      backend="cpu")
+        with pytest.raises(RunRejected) as ei:
+            s.submit(_pipe("test.sa_ok"), _data(), tenant="x",
+                     backend="cpu")
+        assert ei.value.reason == "tenant_queue_quota"
+        assert ei.value.tenant == "x"
+        # another tenant is not affected by x's quota
+        h3 = s.submit(_pipe("test.sa_ok"), _data(), tenant="y",
+                      backend="cpu")
+        _GATES["g"].set()
+        for h in (blocker, h1, h2, h3):
+            h.result(timeout=60)
+    c = m.snapshot()["counters"]
+    assert c["sched.rejected{reason=tenant_queue_quota,tenant=x}"] == 1
+    assert c["sched.admitted{tenant=y}"] == 1
+    rejected = [e for e in _journal(jpath) if e["event"] == "rejected"]
+    assert len(rejected) == 1
+    assert rejected[0]["reason"] == "tenant_queue_quota"
+
+
+def test_tenant_in_flight_quota_does_not_starve_others(sched_ops):
+    clock = VirtualClock()
+    _GATES["g1"] = threading.Event()
+    _GATES["g2"] = threading.Event()
+    with _sched(clock, max_concurrency=2,
+                tenant_max_in_flight=1) as s:
+        hx1 = s.submit(_pipe("test.sa_block", gate="g1"), _data(),
+                       tenant="x", priority=5, backend="cpu")
+        # x's second run is HIGHER priority than y's but x is at its
+        # in-flight quota — y must dispatch past it (no head-of-line
+        # starvation)
+        hx2 = s.submit(_pipe("test.sa_block", gate="g2"), _data(),
+                       tenant="x", priority=5, backend="cpu")
+        hy = s.submit(_pipe("test.sa_ok"), _data(), tenant="y",
+                      priority=0, backend="cpu")
+        hy.result(timeout=60)
+        assert hx2.status == "queued"  # still waiting on x's quota
+        _GATES["g1"].set()
+        _GATES["g2"].set()
+        hx1.result(timeout=60)
+        hx2.result(timeout=60)
+    st = s.stats()
+    assert st["max_in_flight_by_tenant"]["x"] <= 1
+    assert st["max_in_flight_total"] <= 2
+
+
+# -------------------------------------------------------------- deadlines
+
+def test_deadline_unmeetable_rejected_at_admission(sched_ops):
+    clock = VirtualClock()
+    _GATES["g"] = threading.Event()
+    with _sched(clock, max_concurrency=1, tenant_max_queued=10,
+                expected_run_s=10.0) as s:
+        blocker = s.submit(_pipe("test.sa_block", gate="g"), _data(),
+                           tenant="blk", backend="cpu")
+        for _ in range(3):
+            s.submit(_pipe("test.sa_ok"), _data(), tenant="t",
+                     backend="cpu")
+        # 3 queued ahead x 10s EWMA on 1 worker >> 5s deadline:
+        # rejected AT ADMISSION, not timed out mid-queue
+        with pytest.raises(RunRejected) as ei:
+            s.submit(_pipe("test.sa_ok"), _data(), tenant="t2",
+                     deadline_s=5.0, backend="cpu")
+        assert ei.value.reason == "deadline_unmeetable"
+        # a non-positive deadline can never be met
+        with pytest.raises(RunRejected) as ei:
+            s.submit(_pipe("test.sa_ok"), _data(), tenant="t2",
+                     deadline_s=0.0, backend="cpu")
+        assert ei.value.reason == "deadline_unmeetable"
+        # a generous deadline is admitted
+        h = s.submit(_pipe("test.sa_ok"), _data(), tenant="t2",
+                     deadline_s=1000.0, backend="cpu")
+        _GATES["g"].set()
+        h.result(timeout=60)
+        blocker.result(timeout=60)
+
+
+def test_deadline_expired_in_queue_is_shed_at_dispatch(sched_ops,
+                                                      tmp_path):
+    clock = VirtualClock()
+    _GATES["g"] = threading.Event()
+    jpath = str(tmp_path / "journal.jsonl")
+    with _sched(clock, max_concurrency=1, journal_path=jpath) as s:
+        blocker = s.submit(_pipe("test.sa_block", gate="g"), _data(),
+                           tenant="blk", backend="cpu")
+        # admitted (no EWMA yet -> estimate 0), but the queue wait
+        # overruns the deadline while the worker is wedged
+        h = s.submit(_pipe("test.sa_ok"), _data(), tenant="t",
+                     deadline_s=5.0, backend="cpu")
+        clock.advance(10.0)
+        _GATES["g"].set()
+        blocker.result(timeout=60)
+        with pytest.raises(RunShed) as ei:
+            h.result(timeout=60)
+    assert ei.value.reason == "deadline_expired"
+    assert h.status == "shed" and h.reason == "deadline_expired"
+    shed = [e for e in _journal(jpath) if e["event"] == "shed"]
+    assert len(shed) == 1 and shed[0]["reason"] == "deadline_expired"
+
+
+# ----------------------------------------------------------- load shedding
+
+def test_high_water_sheds_lowest_priority_first(sched_ops, tmp_path):
+    clock = VirtualClock()
+    m = MetricsRegistry(clock=clock)
+    _GATES["g"] = threading.Event()
+    _GATES["g:started"] = threading.Event()
+    jpath = str(tmp_path / "journal.jsonl")
+    with _sched(clock, max_concurrency=1, queue_high_water=2,
+                tenant_max_queued=10, metrics=m,
+                journal_path=jpath) as s:
+        blocker = s.submit(_pipe("test.sa_block", gate="g"), _data(),
+                           tenant="blk", priority=9, backend="cpu")
+        # the blocker must be RUNNING (not queued) before the queue
+        # builds, or it would count toward the high-water mark
+        assert _GATES["g:started"].wait(60)
+        h_low = s.submit(_pipe("test.sa_ok"), _data(), tenant="t1",
+                         priority=0, backend="cpu")
+        h_mid = s.submit(_pipe("test.sa_ok"), _data(), tenant="t2",
+                         priority=1, backend="cpu")
+        # queue at high water: a HIGHER-priority arrival sheds the
+        # lowest-priority queued item to make room
+        h_high = s.submit(_pipe("test.sa_ok"), _data(), tenant="t3",
+                          priority=2, backend="cpu")
+        assert h_low.status == "shed"
+        with pytest.raises(RunShed) as ei:
+            h_low.result(timeout=1)
+        assert ei.value.reason == "queue_high_water"
+        # an arrival that is itself lowest-priority is rejected
+        with pytest.raises(RunRejected) as ej:
+            s.submit(_pipe("test.sa_ok"), _data(), tenant="t4",
+                     priority=0, backend="cpu")
+        assert ej.value.reason == "queue_full"
+        _GATES["g"].set()
+        h_mid.result(timeout=60)
+        h_high.result(timeout=60)
+        blocker.result(timeout=60)
+    st = s.stats()
+    assert st["shed"] == 1 and st["rejected"] == 1
+    # shed ordering audit: the victim was <= everything left queued
+    for victim_prio, min_left in st["shed_audit"]:
+        assert min_left is None or victim_prio <= min_left
+    c = m.snapshot()["counters"]
+    assert c["sched.shed{reason=queue_high_water,tenant=t1}"] == 1
+    assert c["sched.rejected{reason=queue_full,tenant=t4}"] == 1
+
+
+def test_shutdown_shed_queued(sched_ops):
+    clock = VirtualClock()
+    _GATES["g"] = threading.Event()
+    s = _sched(clock, max_concurrency=1)
+    blocker = s.submit(_pipe("test.sa_block", gate="g"), _data(),
+                       tenant="blk", backend="cpu")
+    h = s.submit(_pipe("test.sa_ok"), _data(), tenant="t",
+                 backend="cpu")
+    _GATES["g"].set()
+    s.shutdown(wait=True, shed_queued=True)
+    blocker.wait(timeout=60)
+    assert h.status in ("shed", "completed")  # raced the release
+    if h.status == "shed":
+        assert h.reason == "shutdown"
+
+
+# ------------------------------------------------------------ chaos hooks
+
+def test_reject_storm_chaos_rejects_then_admits(sched_ops, tmp_path):
+    clock = VirtualClock()
+    monkey = ChaosMonkey(
+        [Fault("tenant-x", "reject_storm", on_call=1, times=2)],
+        clock=clock)
+    jpath = str(tmp_path / "journal.jsonl")
+    with _sched(clock, max_concurrency=1, chaos=monkey,
+                journal_path=jpath) as s:
+        for _ in range(2):
+            with pytest.raises(RunRejected) as ei:
+                s.submit(_pipe("test.sa_ok"), _data(),
+                         tenant="tenant-x", backend="cpu")
+            assert ei.value.reason == "reject_storm"
+        # storm window over: the third submission is admitted
+        h = s.submit(_pipe("test.sa_ok"), _data(), tenant="tenant-x",
+                     backend="cpu")
+        # other tenants never matched the fault pattern
+        h2 = s.submit(_pipe("test.sa_ok"), _data(), tenant="tenant-y",
+                      backend="cpu")
+        h.result(timeout=60)
+        h2.result(timeout=60)
+    storms = [f for f in monkey.injected if f["mode"] == "reject_storm"]
+    assert [f["op"] for f in storms] == ["tenant-x", "tenant-x"]
+    _check_journal_coherent(jpath, 4)
+
+
+# ----------------------------------------------- shared breaker in the pool
+
+def test_shared_breaker_short_circuits_pool_and_recovers(sched_ops):
+    """The BreakerRegistry contract end-to-end: run 1 trips the tpu
+    breaker, run 2 (same pool) short-circuits to the degrade ruling
+    with ZERO fresh accelerator attempts, and after the cooldown one
+    probe-claimed attempt closes the breaker for everyone."""
+    clock = VirtualClock()
+    breakers = BreakerRegistry(clock=clock, failure_threshold=2,
+                               window_s=1e6, cooldown_s=100.0)
+    m = MetricsRegistry(clock=clock)
+    monkey = ChaosMonkey(
+        [Fault("test.sa_flaky", "unavailable", times=-1,
+               backend="tpu")], clock=clock)
+    with _sched(clock, max_concurrency=1, breakers=breakers, metrics=m,
+                chaos=monkey,
+                runner_defaults={
+                    "probe": lambda: dict(DOWN_PROBE),
+                    "policy": RetryPolicy(max_attempts=2, jitter=0.0),
+                }) as s:
+        with pytest.warns(RuntimeWarning):
+            h1 = s.submit(_pipe("test.sa_flaky"), _data(),
+                          tenant="a", backend="tpu")
+            h1.result(timeout=60)
+            # 2 tpu failures tripped the shared breaker; run 1
+            # degraded to cpu and completed
+            assert h1.report.degraded
+            br = breakers.get("tpu")
+            assert br.state == CircuitBreaker.OPEN
+            assert br.opened_count == 1
+            h2 = s.submit(_pipe("test.sa_flaky"), _data(),
+                          tenant="b", backend="tpu")
+            h2.result(timeout=60)
+        # run 2 never attempted the accelerator: pre-attempt
+        # short-circuit straight to the degrade ruling
+        assert h2.report.degraded
+        assert [a.backend for st in h2.report.steps
+                for a in st.attempts] == ["cpu"]
+        assert br.opened_count == 1  # no double trip
+        # cooldown elapses -> half-open; a clean run's probe-claimed
+        # accelerator attempt closes the breaker for the whole pool
+        clock.advance(101.0)
+        h3 = s.submit(_pipe("test.sa_ok"), _data(), tenant="c",
+                      backend="tpu")
+        out = h3.result(timeout=60)
+        assert out is not None
+        assert not h3.report.degraded
+        assert [a.backend for st in h3.report.steps
+                for a in st.attempts] == ["tpu"]
+        assert br.state == CircuitBreaker.CLOSED
+    c = m.snapshot()["counters"]
+    assert c["runner.breaker_transitions{to=open}"] == 1
+    assert c["runner.breaker_transitions{to=close}"] == 1
+    # journaled signature: the registry breaker that ruled
+    assert br.signature == "tpu"
+
+
+# ------------------------------------------------------------- chaos soak
+
+@pytest.mark.parametrize("seed", [0])
+def test_chaos_soak_acceptance(sched_ops, tmp_path, seed):
+    """The PR's acceptance soak: 200+ virtual-clock concurrent
+    submissions across 4+ tenants with injected transient / fatal /
+    wedge / reject_storm faults.  Quotas hold, shed ordering is
+    priority-correct, every submission terminates in exactly one of
+    {completed, rejected, shed, failed} with a journaled reason, the
+    shared tpu breaker opens EXACTLY once (queued runs short-circuit
+    to degrade — no fresh retry storms), and half-open recovery
+    un-degrades the pool.  Zero real sleeps."""
+    clock = VirtualClock()
+    m = MetricsRegistry(clock=clock)
+    breakers = BreakerRegistry(clock=clock, failure_threshold=3,
+                               window_s=1e9, cooldown_s=50_000.0)
+    monkey = ChaosMonkey(
+        [Fault("test.sa_flaky", "unavailable", times=-1,
+               backend="tpu"),
+         Fault("test.sa_wedge", "wedge", times=-1, backend="cpu"),
+         Fault("test.sa_fatal", "crash", times=-1),
+         Fault("t-storm", "reject_storm", on_call=1, times=10)],
+        seed=seed, clock=clock, wedge_s=10.0)
+    quotas = {"t-blk": TenantQuota(max_in_flight=3, max_queued=6)}
+    jpath = str(tmp_path / "journal.jsonl")
+    data = _data()
+    handles, rejections = [], []
+
+    def submit(s, pipe, tenant, **kw):
+        try:
+            handles.append(s.submit(pipe, data, tenant=tenant, **kw))
+        except RunRejected as e:
+            rejections.append(e)
+
+    with pytest.warns(RuntimeWarning):  # degrade warnings, by design
+        with _sched(clock, max_concurrency=3, queue_high_water=24,
+                    tenant_max_in_flight=2, tenant_max_queued=12,
+                    quotas=quotas, metrics=m, breakers=breakers,
+                    chaos=monkey, journal_path=jpath,
+                    runner_defaults={
+                        "probe": lambda: dict(DOWN_PROBE),
+                        "policy": RetryPolicy(max_attempts=2,
+                                              jitter=0.0),
+                    }) as s:
+            # phase 1 — fault storm: 170 submissions, 5 tenants
+            for i in range(170):
+                kind = i % 5
+                if kind == 0:
+                    submit(s, _pipe("test.sa_flaky"), "t-acc",
+                           backend="tpu")
+                elif kind == 1:
+                    submit(s, _pipe("test.sa_wedge"), "t-wedge",
+                           backend="cpu",
+                           runner_kw={"step_deadline_s": 5.0})
+                elif kind == 2:
+                    submit(s, _pipe("test.sa_fatal"), "t-fatal",
+                           backend="cpu")
+                elif kind == 3:
+                    submit(s, _pipe("test.sa_ok"), "t-storm",
+                           backend="cpu", priority=i % 3)
+                else:
+                    submit(s, _pipe("test.sa_ok"), "t-ok",
+                           backend="cpu", priority=i % 3,
+                           deadline_s=None if i % 6 else 1e6)
+            for h in list(handles):
+                assert h.wait(timeout=120)
+
+            # breaker: tripped exactly once, no fresh retry storms —
+            # the whole pool's tpu attempts stay near the threshold
+            br = breakers.get("tpu")
+            assert br.state == CircuitBreaker.OPEN
+            assert br.opened_count == 1
+            c = m.snapshot()["counters"]
+            assert c["runner.breaker_transitions{to=open}"] == 1
+            tpu_attempts = c.get(
+                "op.calls{backend=tpu,op=test.sa_flaky}", 0)
+            assert 3 <= tpu_attempts <= 8, tpu_attempts
+
+            # phase 2 — overload: wedge all 3 workers, flood past the
+            # high-water mark at mixed priorities
+            for k in range(3):
+                _GATES[f"blk{k}"] = threading.Event()
+                submit(s, _pipe("test.sa_block", gate=f"blk{k}"),
+                       "t-blk", priority=9, backend="cpu")
+            n_before_flood = len(handles) + len(rejections)
+            for i in range(40):
+                submit(s, _pipe("test.sa_ok"), f"t-f{i % 4}",
+                       backend="cpu", priority=i % 3)
+            for k in range(3):
+                _GATES[f"blk{k}"].set()
+            for h in list(handles):
+                assert h.wait(timeout=120)
+            assert len(handles) + len(rejections) - n_before_flood \
+                == 40
+
+            # phase 3 — recovery: cooldown elapses, one clean tpu run
+            # probes half-open and closes the breaker for the pool
+            clock.advance(50_001.0)
+            submit(s, _pipe("test.sa_ok"), "t-acc", backend="tpu")
+            rec = handles[-1]
+            assert rec.wait(timeout=120)
+            assert rec.status == "completed"
+            assert not rec.report.degraded
+            assert [a.backend for st in rec.report.steps
+                    for a in st.attempts] == ["tpu"]
+            assert br.state == CircuitBreaker.CLOSED
+            assert br.opened_count == 1
+
+    n_total = len(handles) + len(rejections)
+    assert n_total == 170 + 3 + 40 + 1 >= 200
+
+    # -- every submission terminal in exactly one of the four states
+    assert all(h.status in ("completed", "failed", "shed")
+               for h in handles)
+    by_status = {st: sum(1 for h in handles if h.status == st)
+                 for st in ("completed", "failed", "shed")}
+    assert by_status["completed"] > 0
+    assert by_status["failed"] > 0       # wedge + fatal tenants
+    assert len(rejections) >= 10         # reject_storm at minimum
+    storm = [e for e in rejections if e.reason == "reject_storm"]
+    assert len(storm) == 10 and all(e.tenant == "t-storm"
+                                    for e in storm)
+
+    # -- wedge/fatal failures carry the real error class
+    wedge_fail = [h for h in handles if h.tenant == "t-wedge"
+                  and h.status == "failed"]
+    assert wedge_fail and all(h.reason == "ResilientRunError"
+                              for h in wedge_fail)
+    fatal_fail = [h for h in handles if h.tenant == "t-fatal"
+                  and h.status == "failed"]
+    assert fatal_fail and all(h.reason == "ChaosCrash"
+                              for h in fatal_fail)
+
+    # -- quotas NEVER exceeded
+    st = s.stats()
+    assert st["max_in_flight_total"] <= 3
+    for tenant, peak in st["max_in_flight_by_tenant"].items():
+        limit = quotas.get(tenant,
+                           TenantQuota(2, 12)).max_in_flight
+        assert peak <= limit, (tenant, peak, limit)
+    assert st["max_queue_depth"] <= 24
+
+    # -- shed ordering priority-correct
+    for victim_prio, min_left in st["shed_audit"]:
+        assert min_left is None or victim_prio <= min_left
+
+    # -- journal complete and coherent for every ticket
+    by_ticket = _check_journal_coherent(jpath, n_total)
+    reasons = {e.get("reason") for e in _journal(jpath)
+               if e["event"] in ("rejected", "shed")}
+    assert reasons <= {"reject_storm", "tenant_queue_quota",
+                       "queue_full", "queue_high_water",
+                       "deadline_unmeetable", "deadline_expired",
+                       "shutdown"}
+
+    # -- zero real sleeps: all scheduling burned the virtual clock
+    assert clock.monotonic() > 50_000.0  # wedges + cooldown, virtual
+
+
+def test_zero_in_flight_quota_rejected_at_construction(sched_ops):
+    """max_in_flight=0 would admit work that can never dispatch and
+    deadlock shutdown — refused up front (max_queued=0 is the legal
+    way to refuse a tenant, at admission)."""
+    with pytest.raises(ValueError, match="max_in_flight"):
+        TenantQuota(max_in_flight=0)
+    with pytest.raises(ValueError, match="max_in_flight"):
+        RunScheduler(max_concurrency=1, tenant_max_in_flight=0)
+    with pytest.raises(ValueError, match="max_in_flight"):
+        RunScheduler(max_concurrency=1,
+                     quotas={"t": (0, 4)})  # tuple quotas re-wrapped
+    # max_queued=0: everything from the tenant is rejected at the door
+    clock = VirtualClock()
+    with _sched(clock, max_concurrency=1,
+                quotas={"t": TenantQuota(1, 0)}) as s:
+        with pytest.raises(RunRejected) as ei:
+            s.submit(_pipe("test.sa_ok"), _data(), tenant="t",
+                     backend="cpu")
+        assert ei.value.reason == "tenant_queue_quota"
+
+
+def test_raising_probe_releases_half_open_slot(sched_ops):
+    """A probe that RAISES mid-half-open must not leave the shared
+    breaker's exclusive probe slot claimed — that would wedge every
+    sharer on the fallback until process restart."""
+    from sctools_tpu.registry import Pipeline as _P
+    from sctools_tpu.runner import ResilientRunner
+
+    clock = VirtualClock()
+    breaker = CircuitBreaker(failure_threshold=1, window_s=1e6,
+                             cooldown_s=10.0, clock=clock)
+    monkey = ChaosMonkey(
+        [Fault("test.sa_flaky", "unavailable", times=1,
+               backend="tpu")], clock=clock)
+
+    def exploding_probe():
+        raise OSError("probe subprocess spawn failed")
+
+    def advance_past_cooldown(i, name, out):
+        # after step 0 completes (degraded), the cooldown elapses —
+        # step 1's loop finds the breaker HALF_OPEN and probes
+        if i == 0:
+            clock.advance(11.0)
+
+    pipe = _P([("test.sa_flaky", {}), ("test.sa_ok", {}),
+               ("test.sa_ok", {})])
+    r = ResilientRunner(pipe, breaker=breaker, clock=clock,
+                        probe=exploding_probe, sleep=lambda s: None,
+                        validate=advance_past_cooldown)
+    with monkey.activate():
+        with pytest.warns(RuntimeWarning):
+            with pytest.raises(OSError, match="spawn failed"):
+                # step 0 trips the breaker (threshold 1) -> degraded;
+                # cooldown elapses; step 1's half-open probe raises
+                r.run(_data(), backend="tpu")
+    # the slot was released despite the raise: a fresh claimant wins
+    clock.advance(11.0)
+    assert breaker.state == CircuitBreaker.HALF_OPEN
+    assert breaker.try_acquire_probe()

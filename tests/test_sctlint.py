@@ -495,6 +495,18 @@ def test_sct008_exempts_vclock_and_other_modules(tmp_path):
                              name="misc_module.py", prelude=False)) == []
 
 
+def test_sct008_covers_scheduler(tmp_path):
+    """The run scheduler's queue waits / deadline estimates must ride
+    the injectable clock like the rest of the resilience stack."""
+    r = lint_src(tmp_path, """
+        import time
+
+        def queue_wait(t0):
+            return time.monotonic() - t0
+        """, only=["SCT008"], name="scheduler.py", prelude=False)
+    assert rule_ids(r) == ["SCT008"]
+
+
 def test_sct008_suppressible_per_line(tmp_path):
     r = lint_src(tmp_path, """
         import time

@@ -259,3 +259,90 @@ def test_acceptance_chaos_run_recipe_report(tmp_path, capsys):
                 attempt_ids.add(e["span_id"])
     trace_ids = {e["args"]["span_id"] for e in slices}
     assert attempt_ids and attempt_ids <= trace_ids
+
+
+# ------------------------------------------------- scheduler section
+
+def _sched_metrics_doc():
+    return {"schema": 1, "metrics": {
+        "counters": {
+            "sched.admitted{tenant=lab-a}": 5.0,
+            "sched.admitted{tenant=lab-b}": 3.0,
+            "sched.rejected{reason=tenant_queue_quota,tenant=lab-a}":
+                2.0,
+            "sched.rejected{reason=deadline_unmeetable,tenant=lab-b}":
+                1.0,
+            "sched.shed{reason=queue_high_water,tenant=lab-b}": 1.0,
+        },
+        "gauges": {"sched.queue_depth": 0.0},
+        "histograms": {"sched.queue_wait_s": {
+            "count": 8, "sum": 4.0, "max": 2.0, "buckets": {}}},
+    }}
+
+
+def test_scheduler_section_renders_funnel_and_tenants():
+    from tools.sctreport import scheduler_section
+
+    L = scheduler_section(_sched_metrics_doc())
+    text = "\n".join(L)
+    assert L[0] == "-- scheduler --"
+    # funnel: submitted = admitted + rejected
+    assert "submitted 11" in text and "admitted 8" in text
+    assert "rejected 3" in text and "shed after admission 1" in text
+    # per-tenant table rows
+    assert "lab-a" in text and "lab-b" in text
+    # reasons named
+    assert "tenant_queue_quota=2" in text
+    assert "deadline_unmeetable=1" in text
+    assert "queue_high_water=1" in text
+    assert "queue wait: n=8 mean=0.5000s" in text
+
+
+def test_scheduler_section_absent_without_sched_series():
+    from tools.sctreport import scheduler_section
+
+    assert scheduler_section(None) == []
+    assert scheduler_section({"metrics": {"counters": {
+        "runner.retries": 3.0}}}) == []
+
+
+def test_report_includes_scheduler_section_from_run_dir(tmp_path,
+                                                       capsys):
+    """End-to-end: a RunScheduler journal + metrics.json pair renders
+    the scheduler section through the CLI (the artifact shape
+    shutdown() writes)."""
+    from sctools_tpu.data.synthetic import synthetic_counts
+    from sctools_tpu.registry import Pipeline, register
+    from sctools_tpu.scheduler import RunScheduler
+    from sctools_tpu.utils.failsafe import BreakerRegistry
+    from sctools_tpu.utils.telemetry import MetricsRegistry
+    from sctools_tpu.utils.vclock import VirtualClock
+
+    @register("test.rpt_ok", backend="cpu")
+    @register("test.rpt_ok", backend="tpu")
+    def _ok(data, **kw):
+        return data
+
+    try:
+        clock = VirtualClock()
+        jpath = str(tmp_path / "journal.jsonl")
+        with RunScheduler(max_concurrency=1, tenant_max_queued=1,
+                          clock=clock,
+                          metrics=MetricsRegistry(clock=clock),
+                          breakers=BreakerRegistry(clock=clock),
+                          journal_path=jpath) as s:
+            data = synthetic_counts(16, 8, seed=0)
+            hs = [s.submit(Pipeline([("test.rpt_ok", {})]), data,
+                           tenant="lab-a", backend="cpu")]
+            for h in hs:
+                h.result(timeout=60)
+        assert os.path.exists(str(tmp_path / "metrics.json"))
+        assert main([str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "-- scheduler --" in out
+        assert "admitted 1" in out and "lab-a" in out
+    finally:
+        from sctools_tpu import registry as reg
+
+        reg._REGISTRY.pop("test.rpt_ok", None)
+        reg._DOCS.pop("test.rpt_ok", None)

@@ -206,3 +206,37 @@ def test_vocabulary_covers_runner_usage():
             used_metrics.add(arg.value)
     assert used_events and used_events <= EVENTS
     assert used_metrics and used_metrics <= set(METRICS)
+
+
+def test_scheduler_vocabulary_covers_its_call_sites():
+    """Same contract for the scheduler module: every literal journal
+    event / metric name in scheduler.py is a member of the central
+    vocabulary (the AST mirror of sctlint SCT009), and the sched.*
+    names the PR introduced are all present."""
+    import ast
+    import inspect
+
+    import sctools_tpu.scheduler as scheduler_mod
+
+    tree = ast.parse(inspect.getsource(scheduler_mod))
+    used_events, used_metrics = set(), set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            continue
+        if f.attr == "write" and isinstance(f.value, ast.Attribute) \
+                and f.value.attr == "journal":
+            used_events.add(arg.value)
+        elif f.attr in ("counter", "gauge", "histogram", "timer"):
+            used_metrics.add(arg.value)
+    assert {"submitted", "admitted", "rejected", "shed",
+            "run_completed", "run_failed"} <= used_events <= EVENTS
+    assert {"sched.admitted", "sched.rejected", "sched.shed",
+            "sched.queue_depth", "sched.queue_wait_s"} \
+        <= used_metrics <= set(METRICS)

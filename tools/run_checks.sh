@@ -41,7 +41,16 @@
 #                     for the xla twin and jaccard, ulp-tolerance for
 #                     the Pallas kernels (docs/ARCHITECTURE.md
 #                     "Graph kernels & layout")
-#   8. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
+#   8. scheduler-soak python tests/soak_smoke.py — a canned
+#                     50-submission virtual-clock admission soak:
+#                     zero quota violations (global + per-tenant +
+#                     queue high-water), priority-correct shedding,
+#                     and a complete coherent journal (every ticket
+#                     submitted once and terminal exactly once) —
+#                     the admission-control layer's contract
+#                     (docs/ARCHITECTURE.md "Admission control &
+#                     scheduling")
+#   9. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
 
 set -u -o pipefail
 
@@ -72,6 +81,7 @@ fi
 stage "bare-clock guard (resilience modules use the injectable clock)"
 bare=$(grep -nE '\btime\.(sleep|monotonic)\b' \
         sctools_tpu/runner.py \
+        sctools_tpu/scheduler.py \
         sctools_tpu/utils/failsafe.py \
         sctools_tpu/utils/checkpoint.py \
         sctools_tpu/utils/chaos.py \
@@ -241,6 +251,14 @@ then
     :
 else
     echo "graph-parity stage FAILED (rc=$?)"
+    fail=1
+fi
+
+stage "scheduler-soak (50-submission admission soak: quotas + journal)"
+if JAX_PLATFORMS=cpu python tests/soak_smoke.py; then
+    :
+else
+    echo "scheduler-soak stage FAILED (rc=$?)"
     fail=1
 fi
 

@@ -24,9 +24,12 @@ from ..jaxutil import dotted, module_info
 # the repo-relative path tail, like SCT005); vclock.py is deliberately
 # absent — it IS the injection seam.  stream.py is listed for its
 # prefetch overlap/stall accounting: the double-buffer tests drive it
-# with a VirtualClock-timed fake packer and zero real sleeps.
+# with a VirtualClock-timed fake packer and zero real sleeps;
+# scheduler.py for its queue waits / deadline estimates / EWMA run
+# walls — the chaos soak drives hundreds of submissions on one
+# VirtualClock.
 _PATH_RE = re.compile(
-    r"(^|/)(runner|failsafe|checkpoint|chaos|stream)\.py$")
+    r"(^|/)(runner|failsafe|checkpoint|chaos|stream|scheduler)\.py$")
 
 _BANNED = {"time.sleep", "time.monotonic"}
 

@@ -282,6 +282,11 @@ def render(run_dir: str, runs: list[dict], trace_d: dict | None,
             f" attempt span(s) present in trace.json"
             f" ({trace_d['n_events']} trace events)")
 
+    sched = scheduler_section(metrics)
+    if sched:
+        add("")
+        L.extend(sched)
+
     plan = plan_cache_section(metrics)
     if plan:
         add("")
@@ -304,6 +309,81 @@ def render(run_dir: str, runs: list[dict], trace_d: dict | None,
             add(f"  {k:<56s} count={h.get('count')} "
                 f"sum={h.get('sum')} max={h.get('max')}")
     return "\n".join(L)
+
+
+def _parse_labels(key: str) -> tuple[str, dict]:
+    """``"sched.shed{reason=r,tenant=t}"`` → ``("sched.shed",
+    {"reason": "r", "tenant": "t"})`` (the registry's series-key
+    format; label VALUES here never contain ``,`` or ``=``)."""
+    if "{" not in key:
+        return key, {}
+    name, _, inner = key.partition("{")
+    labels = {}
+    for part in inner.rstrip("}").split(","):
+        k, _, v = part.partition("=")
+        labels[k] = v
+    return name, labels
+
+
+def scheduler_section(metrics) -> list[str]:
+    """The admission-control digest, rendered only when the run
+    recorded ``sched.*`` series (a run dir that never went through
+    the scheduler has no section).  Shows the admission funnel
+    (submitted → admitted → completed, with rejected/shed gone at
+    each gate), the per-tenant table, and the shed/reject reasons —
+    the overload story at a glance."""
+    if metrics is None:
+        return []
+    m = metrics.get("metrics", metrics)
+    counters = {k: v for k, v in m.get("counters", {}).items()
+                if k.startswith("sched.")}
+    if not counters:
+        return []
+    per_tenant: dict = {}
+    by_reason: dict = {}
+    totals = {"admitted": 0.0, "rejected": 0.0, "shed": 0.0}
+    for key, v in counters.items():
+        name, labels = _parse_labels(key)
+        kind = name.split(".", 1)[1]   # admitted | rejected | shed
+        if kind not in totals:
+            continue
+        totals[kind] += v
+        t = per_tenant.setdefault(labels.get("tenant", "?"),
+                                  {"admitted": 0.0, "rejected": 0.0,
+                                   "shed": 0.0})
+        t[kind] += v
+        if kind in ("rejected", "shed") and "reason" in labels:
+            r = by_reason.setdefault(kind, {})
+            r[labels["reason"]] = r.get(labels["reason"], 0.0) + v
+    submitted = totals["admitted"] + totals["rejected"]
+    L = ["-- scheduler --"]
+    L.append(f"  admission funnel: submitted {submitted:g} -> "
+             f"admitted {totals['admitted']:g} "
+             f"(rejected {totals['rejected']:g}, "
+             f"shed after admission {totals['shed']:g})")
+    gauges = {k: v for k, v in m.get("gauges", {}).items()
+              if k.startswith("sched.queue_depth")}
+    for k, v in sorted(gauges.items()):
+        L.append(f"  queue depth (last): {v:g}")
+    hists = m.get("histograms", {})
+    for k, h in sorted(hists.items()):
+        if k.startswith("sched.queue_wait_s"):
+            n = h.get("count", 0)
+            mean = (h.get("sum", 0.0) / n) if n else 0.0
+            L.append(f"  queue wait: n={n} mean={mean:.4f}s "
+                     f"max={h.get('max', 0.0):g}s")
+    L.append(f"  {'tenant':<20s} {'admitted':>9s} {'rejected':>9s} "
+             f"{'shed':>6s}")
+    for tenant in sorted(per_tenant):
+        t = per_tenant[tenant]
+        L.append(f"  {tenant:<20s} {t['admitted']:9g} "
+                 f"{t['rejected']:9g} {t['shed']:6g}")
+    for kind in ("rejected", "shed"):
+        if by_reason.get(kind):
+            reasons = ", ".join(f"{r}={v:g}" for r, v in
+                                sorted(by_reason[kind].items()))
+            L.append(f"  {kind} reasons: {reasons}")
+    return L
 
 
 def graph_section(metrics) -> list[str]:
