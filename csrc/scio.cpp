@@ -77,6 +77,48 @@ void scio_pack_ell_f32(const int64_t* indptr, const int32_t* indices,
   for (auto& w : workers) w.join();
 }
 
+// Multi-threaded CSR-chunk decode for the durable shard store
+// (sctools_tpu/data/shardstore.py): one stored shard is n_chunks CSR
+// chunk files owning disjoint row ranges of the same padded-ELL
+// output buffer.  Decoding them serially wastes the read scheduler's
+// coalesced-read win; here each chunk gets its own thread (chunks
+// never touch the same output bytes — row_offsets are disjoint).
+// indptrs/indices/datas are per-chunk array-of-pointer views;
+// chunk_rows[c] rows of chunk c land at out row row_offsets[c].
+// Caller pre-fills out_idx with the sentinel and out_val with zeros,
+// exactly like scio_pack_ell_f32.
+void scio_pack_ell_f32_chunks(const int64_t* const* indptrs,
+                              const int32_t* const* indices,
+                              const float* const* datas,
+                              const int64_t* chunk_rows,
+                              const int64_t* row_offsets,
+                              int64_t n_chunks, int64_t capacity,
+                              int32_t* out_idx, float* out_val) {
+  int64_t nt = (int64_t)std::thread::hardware_concurrency();
+  if (const char* env = std::getenv("SCTOOLS_PACK_THREADS")) {
+    nt = std::atoll(env);
+  }
+  nt = std::max<int64_t>(1, std::min<int64_t>(nt, 64));
+  auto decode_one = [&](int64_t c) {
+    pack_rows(indptrs[c], indices[c], datas[c], capacity,
+              out_idx + row_offsets[c] * capacity,
+              out_val + row_offsets[c] * capacity, 0, chunk_rows[c]);
+  };
+  if (nt <= 1 || n_chunks <= 1) {
+    for (int64_t c = 0; c < n_chunks; ++c) decode_one(c);
+    return;
+  }
+  const int64_t t_n = std::min<int64_t>(nt, n_chunks);
+  std::vector<std::thread> workers;
+  for (int64_t t = 1; t < t_n; ++t) {
+    workers.emplace_back([&decode_one, t, t_n, n_chunks]() {
+      for (int64_t c = t; c < n_chunks; c += t_n) decode_one(c);
+    });
+  }
+  for (int64_t c = 0; c < n_chunks; c += t_n) decode_one(c);
+  for (auto& w : workers) w.join();
+}
+
 // ---------------------------------------------------------------------
 // MatrixMarket parser.  Two-call protocol: scio_parse_mtx reads the
 // file into an internal buffer and returns a handle (>= 0) plus the

@@ -31,6 +31,8 @@ from . import (  # noqa: F401  (imports register transforms)
 from .config import config, configure
 from .data import CellData, SparseCells
 from .data.concat import concat
+from .data.shardstore import (ShardReadScheduler, ShardStore,
+                              StoreWriter, open_store, write_store)
 from .data.io import (from_dense, from_scipy, read, read_10x_h5,
                       read_10x_mtx, read_csv, read_h5ad, read_loom,
                       read_mtx, read_text, write_h5ad, write_loom)
@@ -79,4 +81,6 @@ __all__ = [
     "pp", "tl", "experimental", "external", "pl", "datasets", "queries",
     "ResilientRunner", "RetryPolicy", "recipe_pipeline", "run_recipe",
     "fused_pipeline", "describe_plan",
+    "ShardStore", "ShardReadScheduler", "StoreWriter", "open_store",
+    "write_store",
 ]

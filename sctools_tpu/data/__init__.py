@@ -1,8 +1,12 @@
 from .dataset import CellData
 from .sparse import SparseCells, gene_stats, gene_sum, row_sum, spmm, spmm_t
 from . import io, synthetic
+from .shardstore import (ShardReadScheduler, ShardStore, StoreWriter,
+                         open_store, write_store)
 
 __all__ = [
     "CellData", "SparseCells", "spmm", "spmm_t", "row_sum", "gene_sum",
     "gene_stats", "io", "synthetic",
+    "ShardStore", "ShardReadScheduler", "StoreWriter", "open_store",
+    "write_store",
 ]

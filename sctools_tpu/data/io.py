@@ -376,6 +376,60 @@ def read(path: str, **kw) -> CellData:
 
 
 # ----------------------------------------------------------------------
+# Durable shard-store chunks (out-of-core ingest tier)
+# ----------------------------------------------------------------------
+
+
+def write_csr_chunk(path: str, data, indices, indptr, shape,
+                    fingerprint: str | None = None) -> str:
+    """Write ONE shard-store chunk: a CSR row-slice as a checksummed
+    ``.npz`` carrying the checkpoint layer's ``_integrity/*`` keys
+    (content digest + schema + identity ``fingerprint``), atomic via
+    rename.  Returns the chunk's content digest (the manifest records
+    it, so a cross-wired chunk file — intact bytes, wrong slot — is
+    caught by manifest-vs-file digest comparison even though the file
+    self-verifies)."""
+    from ..utils.checkpoint import _content_digest, save_npz_verified
+
+    arrays = {
+        "data": np.ascontiguousarray(data),
+        "indices": np.ascontiguousarray(indices, np.int32),
+        "indptr": np.ascontiguousarray(indptr, np.int64),
+        "shape": np.asarray(shape, np.int64),
+    }
+    return save_npz_verified(path, fingerprint=fingerprint, **arrays)
+
+
+def read_csr_chunk(path: str, expect_fingerprint: str | None = None,
+                   expect_digest: str | None = None,
+                   verify: bool = True) -> tuple:
+    """Read-and-verify the twin of :func:`write_csr_chunk`.  Returns
+    ``(data, indices, indptr, shape)``.  ``verify=True`` (the default
+    — chunk reads feed hours-long ingests, trusting a damaged file is
+    never worth one skipped hash pass) re-hashes the payload and
+    checks the identity fingerprint, raising
+    ``CheckpointCorruptError`` with a machine-readable ``.reason`` on
+    unreadable bytes, digest/schema/fingerprint mismatch, or missing
+    integrity keys (every chunk is WRITTEN with them, so a digestless
+    chunk is truncated or foreign, not legacy).  ``expect_digest=``
+    (the manifest's recorded digest) additionally catches a
+    cross-wired file: intact bytes that self-verify but belong in a
+    different slot — all from the SAME single read.  The verify
+    ladder itself lives in ``checkpoint.load_npz_verified`` — ONE
+    integrity ruling for resume files and store chunks alike."""
+    from ..utils.checkpoint import _read_arrays, load_npz_verified
+
+    if verify:
+        arrays = load_npz_verified(
+            path, expect_fingerprint=expect_fingerprint,
+            require_digest=True, expect_digest=expect_digest)
+    else:
+        arrays = _read_arrays(path)
+    return (arrays["data"], arrays["indices"], arrays["indptr"],
+            tuple(int(x) for x in arrays["shape"]))
+
+
+# ----------------------------------------------------------------------
 # Shard streaming (out-of-core)
 # ----------------------------------------------------------------------
 

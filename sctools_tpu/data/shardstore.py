@@ -1,0 +1,847 @@
+"""Durable shard store + IO-failure domain for out-of-core ingest.
+
+At atlas scale the counts never fit host RAM and an ingest reads from
+real disks for hours (annbatch, PAPERS.md) — so the IO path needs the
+same failure-containment ladder PRs 1/3/8 built for the compute path.
+This module is that tier, in three layers:
+
+**Durable store** (:class:`ShardStore` / :class:`StoreWriter` /
+:func:`write_store`): a chunked on-disk format — one checksummed
+``.npz`` per CSR chunk (``data/io.py`` ``write_csr_chunk``, the
+checkpoint layer's ``_integrity/*`` conventions: content digest,
+schema version, identity fingerprint) plus a ``manifest.json``
+recording every chunk's digest, so THREE distinct failures are all
+caught before a bad byte reaches the device: damaged bytes (file
+digest mismatch), renamed/foreign files (fingerprint mismatch), and
+cross-wired intact files (manifest-vs-file digest mismatch).  A shard
+(the streaming unit, ``shard_rows`` cells) is several chunk files;
+the read path reassembles them with the native multi-threaded CSR
+decode (``csrc/scio.cpp`` ``scio_pack_ell_f32_chunks``, one thread
+per chunk) into one padded-ELL :class:`~.sparse.SparseCells` sharing
+the manifest's global ``capacity`` — one compiled program serves
+every shard.
+
+**Read scheduler** (:class:`ShardReadScheduler`): a reader pool above
+the store feeding N concurrent consumer streams.  Requests are served
+in ascending shard order across all consumers (approximate elevator
+order — two consumers near each other read the same disk region) and
+the chunks of one shard are one coalesced task read in file order.
+Decoded bytes in flight are bounded by ``ram_budget_bytes``: a
+consumer's lookahead submissions reserve their decoded size and stall
+when the budget is spent (one in-flight read per consumer is always
+allowed — progress beats the budget).  Every wait is driven off the
+injectable clock (``utils/vclock.py``), so the whole failure domain
+is tier-1 testable with zero real sleeps.
+
+**IO-failure domain** (inside the scheduler's ``_await_shard``): the
+read ladder mirrors the runner's containment ladder —
+
+* per-read deadline: an attempt past ``read_deadline_s`` is abandoned
+  and classified transient (a wedged NFS read must not wedge the
+  ingest);
+* classified retry: transient failures (injected ``io_error``, real
+  ``OSError(EIO)`` — ``failsafe.classify_error``) retry with
+  seeded-jitter backoff up to ``policy.max_attempts``;
+* slow-read hedging: a straggler past ``hedge_after_s`` gets a
+  duplicate read; the FIRST ready result wins (the straggler may
+  still beat the hedge);
+* quarantine: a digest/fingerprint/truncation failure is
+  DETERMINISTIC — the chunk file is moved (never deleted) to
+  ``quarantine/`` with a ``.reason.json`` sidecar
+  (``checkpoint.quarantine_checkpoint``), a ``shard_quarantined``
+  event is journaled, and the shard then fails or is skipped per
+  ``on_corrupt=``.
+
+Every terminated shard read lands in exactly one of {served,
+retried-then-served, hedged, quarantined} — counted in the
+``ingest.*`` metric family (SCT009 vocabulary).  Chaos modes
+``slow_read`` / ``truncate_shard`` / ``io_error`` fire through
+``ChaosMonkey.on_io`` (the scheduler consults it per chunk read), so
+the whole ladder is exercised deterministically on a
+:class:`~..utils.vclock.VirtualClock`.
+
+Resume composes from the pieces that already exist: the store's
+:meth:`ShardStore.source` is range-aware (``factory_from`` seeks), so
+``stream_stats``/``stream_pca`` shard-granular checkpoints (now
+verified through the same integrity layer) resume a killed ingest at
+the next unprocessed shard with bitwise-identical results.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import heapq
+import itertools
+import json
+import os
+import random
+import threading
+
+import numpy as np
+
+from ..config import config, round_up
+from ..utils import telemetry
+from ..utils.checkpoint import (CheckpointCorruptError,
+                                quarantine_checkpoint)
+from ..utils.failsafe import (TRANSIENT, TransientDeviceError,
+                              classify_error)
+from ..utils.vclock import SYSTEM_CLOCK
+from .sparse import SparseCells
+from .stream import ShardSource
+
+#: bump when the store layout changes incompatibly; manifests stamped
+#: newer than the reader understands are refused (never half-parsed)
+SHARDSTORE_SCHEMA = 1
+
+_MANIFEST = "manifest.json"
+_CHUNK_DIR = "chunks"
+
+
+class ShardCorruptError(RuntimeError):
+    """A store chunk failed integrity verification (damaged bytes,
+    truncation, fingerprint or manifest-digest mismatch).
+    Deterministic by classification — re-reading the same bytes fails
+    the same way, so the ruling is quarantine + fail/skip, never a
+    retry.  ``.chunk``/``.shard`` locate the failure, ``.path`` the
+    file, ``.reason`` the machine-readable why."""
+
+    def __init__(self, path: str, reason: str, chunk: int,
+                 shard: int | None = None):
+        super().__init__(f"chunk {chunk} ({path}): {reason}")
+        self.path = path
+        self.reason = reason
+        self.chunk = chunk
+        self.shard = shard
+
+
+def _chunk_fingerprint(index: int, n_genes: int,
+                       chunk_rows: int) -> str:
+    """Identity fingerprint a chunk file carries in its
+    ``_integrity/fingerprint`` slot: a pure function of the chunk's
+    SLOT (index + store geometry), so a renamed file fails
+    verification even before the manifest digest cross-check."""
+    key = f"shardstore/chunk{index:05d}/g{n_genes}/cr{chunk_rows}"
+    return hashlib.sha256(key.encode()).hexdigest()[:10]
+
+
+class StoreWriter:
+    """Append-only writer for a :class:`ShardStore` directory.
+
+    ``append(csr_block)`` takes arbitrary-sized CSR row blocks (a
+    generator can stream a store bigger than RAM into being) and
+    flushes full ``chunk_rows``-row chunk files as rows accumulate;
+    ``close()`` flushes the remainder and writes the manifest.  The
+    global ELL ``capacity`` (max nnz/row over the whole store, rounded
+    to the lane multiple) is discovered during the write and recorded
+    in the manifest, so every later read shares one compiled program.
+    """
+
+    def __init__(self, directory: str, n_genes: int, *,
+                 shard_rows: int = 65536, chunk_rows: int | None = None):
+        self.directory = directory
+        self.n_genes = int(n_genes)
+        self.shard_rows = round_up(int(shard_rows), config.sublane)
+        if chunk_rows is None:
+            chunk_rows = max(self.shard_rows // 4, 1)
+        self.chunk_rows = int(chunk_rows)
+        if self.shard_rows % self.chunk_rows:
+            raise ValueError(
+                f"shard_rows={self.shard_rows} must be a multiple of "
+                f"chunk_rows={self.chunk_rows} (a shard is a whole "
+                f"number of chunk files)")
+        os.makedirs(os.path.join(directory, _CHUNK_DIR), exist_ok=True)
+        self._pending = []          # buffered csr blocks
+        self._pending_rows = 0
+        self._chunks: list[dict] = []
+        self._n_cells = 0
+        self._max_nnz = 0
+        self._closed = False
+
+    def append(self, csr_block) -> None:
+        import scipy.sparse as sp
+
+        if self._closed:
+            raise ValueError("StoreWriter is closed")
+        block = sp.csr_matrix(csr_block)
+        if block.shape[1] != self.n_genes:
+            raise ValueError(
+                f"append: block has {block.shape[1]} genes, store has "
+                f"{self.n_genes}")
+        self._pending.append(block)
+        self._pending_rows += block.shape[0]
+        if self._pending_rows >= self.chunk_rows:
+            self._drain(final=False)
+
+    def _drain(self, final: bool) -> None:
+        """Emit every full chunk buffered so far (plus the remainder
+        when ``final``) from ONE vstacked buffer — each chunk is a
+        single row-slice copy, so a large ``append`` costs O(rows),
+        not the O(rows²) a per-chunk re-slice of the shrinking
+        remainder would."""
+        import scipy.sparse as sp
+
+        buf = (self._pending[0] if len(self._pending) == 1
+               else sp.vstack(self._pending, format="csr"))
+        a = 0
+        while buf.shape[0] - a >= self.chunk_rows:
+            self._write_chunk(buf[a: a + self.chunk_rows])
+            a += self.chunk_rows
+        if final and buf.shape[0] - a:
+            self._write_chunk(buf[a:])
+            a = buf.shape[0]
+        rest = buf[a:]
+        self._pending = [rest] if rest.shape[0] else []
+        self._pending_rows = int(rest.shape[0])
+
+    def _write_chunk(self, chunk) -> None:
+        chunk.sort_indices()
+        rows = chunk.shape[0]
+        index = len(self._chunks)
+        name = f"chunk-{index:05d}"
+        path = os.path.join(self.directory, _CHUNK_DIR, f"{name}.npz")
+        from .io import write_csr_chunk
+
+        digest = write_csr_chunk(
+            path, chunk.data.astype(np.float32, copy=False),
+            chunk.indices, chunk.indptr, chunk.shape,
+            fingerprint=_chunk_fingerprint(index, self.n_genes,
+                                           self.chunk_rows))
+        nnz_row = int(np.diff(chunk.indptr).max()) if rows else 0
+        self._max_nnz = max(self._max_nnz, nnz_row)
+        self._chunks.append({
+            "file": f"{_CHUNK_DIR}/{name}.npz", "rows": int(rows),
+            "row_start": int(self._n_cells), "nnz": int(chunk.nnz),
+            "digest": digest,
+        })
+        self._n_cells += rows
+
+    def close(self) -> "ShardStore":
+        if self._closed:
+            raise ValueError("StoreWriter already closed")
+        if self._pending_rows:
+            self._drain(final=True)
+        self._closed = True
+        capacity = max(round_up(max(self._max_nnz, 1),
+                                config.capacity_multiple),
+                       config.capacity_multiple)
+        manifest = {
+            "schema": SHARDSTORE_SCHEMA,
+            "n_cells": self._n_cells, "n_genes": self.n_genes,
+            "shard_rows": self.shard_rows,
+            "chunk_rows": self.chunk_rows,
+            "capacity": capacity, "max_nnz_row": self._max_nnz,
+            "dtype": "float32",
+            "chunks": self._chunks,
+            "store_digest": hashlib.sha256("".join(
+                c["digest"] for c in self._chunks).encode())
+            .hexdigest()[:16],
+        }
+        tmp = os.path.join(self.directory, _MANIFEST + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+        os.replace(tmp, os.path.join(self.directory, _MANIFEST))
+        return ShardStore(self.directory, manifest)
+
+
+def write_store(X, directory: str, *, shard_rows: int = 65536,
+                chunk_rows: int | None = None) -> "ShardStore":
+    """Write an in-memory CSR matrix as a durable shard store
+    (convenience over :class:`StoreWriter`; for matrices bigger than
+    RAM, stream blocks into ``StoreWriter.append`` instead)."""
+    X = X.tocsr()
+    w = StoreWriter(directory, X.shape[1], shard_rows=shard_rows,
+                    chunk_rows=chunk_rows)
+    step = w.chunk_rows
+    for s in range(0, X.shape[0], step):
+        w.append(X[s: s + step])
+    return w.close()
+
+
+class ShardStore:
+    """An opened durable shard store (see module docstring for the
+    on-disk format).  Cheap to open — the manifest is the only read;
+    chunk files are read (and verified) lazily per shard."""
+
+    def __init__(self, directory: str, manifest: dict):
+        self.directory = directory
+        self.manifest = manifest
+
+    @classmethod
+    def open(cls, directory: str) -> "ShardStore":
+        path = os.path.join(directory, _MANIFEST)
+        try:
+            with open(path) as f:
+                manifest = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise ShardCorruptError(
+                path, f"manifest unreadable ({type(e).__name__}: {e})",
+                chunk=-1) from e
+        schema = int(manifest.get("schema", 0))
+        if schema > SHARDSTORE_SCHEMA:
+            raise ShardCorruptError(
+                path, f"manifest schema {schema} newer than supported "
+                      f"{SHARDSTORE_SCHEMA}", chunk=-1)
+        for field in ("n_cells", "n_genes", "shard_rows", "chunk_rows",
+                      "capacity", "chunks"):
+            if field not in manifest:
+                raise ShardCorruptError(
+                    path, f"manifest missing field {field!r}", chunk=-1)
+        return cls(directory, manifest)
+
+    # -- geometry ------------------------------------------------------
+    @property
+    def n_cells(self) -> int:
+        return int(self.manifest["n_cells"])
+
+    @property
+    def n_genes(self) -> int:
+        return int(self.manifest["n_genes"])
+
+    @property
+    def shard_rows(self) -> int:
+        return int(self.manifest["shard_rows"])
+
+    @property
+    def chunk_rows(self) -> int:
+        return int(self.manifest["chunk_rows"])
+
+    @property
+    def capacity(self) -> int:
+        return int(self.manifest["capacity"])
+
+    @property
+    def n_chunks(self) -> int:
+        return len(self.manifest["chunks"])
+
+    @property
+    def n_shards(self) -> int:
+        return -(-self.n_cells // self.shard_rows)
+
+    def chunk_name(self, c: int) -> str:
+        """Basename (sans extension) chaos fault patterns match."""
+        return f"chunk-{c:05d}"
+
+    def chunk_path(self, c: int) -> str:
+        return os.path.join(self.directory,
+                            self.manifest["chunks"][c]["file"])
+
+    def chunk_range(self, shard: int) -> tuple[int, int]:
+        """Chunk indices ``[c0, c1)`` making up ``shard``."""
+        per = self.shard_rows // self.chunk_rows
+        return shard * per, min(self.n_chunks, (shard + 1) * per)
+
+    def shard_rows_of(self, shard: int) -> int:
+        return (min(self.n_cells, (shard + 1) * self.shard_rows)
+                - shard * self.shard_rows)
+
+    def shard_nbytes_est(self) -> int:
+        """Decoded padded-ELL bytes of one full shard (int32 ids +
+        f32 values) — the RAM-budget accounting unit."""
+        return self.shard_rows * self.capacity * 8
+
+    # -- reads ---------------------------------------------------------
+    def read_chunk_arrays(self, c: int, shard: int | None = None,
+                          verify: bool = True) -> tuple:
+        """Read + triple-verify one chunk file (self digest,
+        slot fingerprint, manifest digest).  Integrity failures raise
+        :class:`ShardCorruptError`."""
+        from .io import read_csr_chunk
+
+        rec = self.manifest["chunks"][c]
+        path = self.chunk_path(c)
+        try:
+            return read_csr_chunk(
+                path, verify=verify,
+                expect_fingerprint=_chunk_fingerprint(
+                    c, self.n_genes, self.chunk_rows),
+                expect_digest=rec["digest"])
+        except CheckpointCorruptError as e:
+            raise ShardCorruptError(path, e.reason, chunk=c,
+                                    shard=shard) from e
+
+    def read_shard(self, shard: int, verify: bool = True,
+                   on_chunk=None) -> SparseCells:
+        """Read every chunk of ``shard`` (coalesced, file order) and
+        decode into one padded-ELL :class:`SparseCells` via the native
+        multi-threaded chunk decode.  ``on_chunk(index, name, path)``
+        is called before each chunk read — the scheduler's chaos
+        consult hook, kept HERE so the plain and scheduled read paths
+        share one chunk loop (row arithmetic cannot diverge)."""
+        c0, c1 = self.chunk_range(shard)
+        chunks = []
+        for c in range(c0, c1):
+            if on_chunk is not None:
+                on_chunk(c, self.chunk_name(c), self.chunk_path(c))
+            data, indices, indptr, _shape = self.read_chunk_arrays(
+                c, shard=shard, verify=verify)
+            row0 = (self.manifest["chunks"][c]["row_start"]
+                    - shard * self.shard_rows)
+            chunks.append((indptr, indices, data, row0))
+        return self.assemble_shard(shard, chunks)
+
+    def assemble_shard(self, shard: int, chunks: list) -> SparseCells:
+        from ..native import pack_ell_chunks
+
+        rows = self.shard_rows_of(shard)
+        rows_padded = round_up(max(rows, 1), config.sublane)
+        indices, data = pack_ell_chunks(chunks, rows_padded,
+                                        self.capacity,
+                                        sentinel=self.n_genes)
+        return SparseCells(indices, data, rows, self.n_genes)
+
+    def quarantine_chunk(self, c: int, reason: str) -> str | None:
+        """Move chunk ``c`` aside (never delete) with a
+        ``.reason.json`` sidecar.  Returns the quarantined path, or
+        ``None`` when the file is already gone (a prior ruling moved
+        it — the quarantine is idempotent evidence-keeping, not a
+        second verdict)."""
+        path = self.chunk_path(c)
+        if not os.path.exists(path):
+            return None
+        return quarantine_checkpoint(path, reason)
+
+    # -- stream integration -------------------------------------------
+    def iter_shards(self, start_shard: int = 0, verify: bool = True):
+        """Plain (scheduler-less) shard iterator — serial verified
+        reads, no retry/hedge ladder."""
+        for i in range(start_shard, self.n_shards):
+            yield self.read_shard(i, verify=verify)
+
+    def source(self, scheduler: "ShardReadScheduler | None" = None,
+               prefetch: bool = True) -> ShardSource:
+        """A range-aware :class:`~.stream.ShardSource` over this store
+        — the streaming passes (``stream_stats`` / ``stream_pca`` /
+        ``stream_pipeline``) consume it unchanged, and their
+        shard-granular checkpoints resume by SEEKING (``factory_from``
+        starts mid-store without reading skipped shards).  With
+        ``scheduler=`` every read goes through the IO-failure domain
+        (retry/hedge/quarantine, RAM budget, locality order)."""
+        if scheduler is not None:
+            if scheduler.store is not self:
+                raise ValueError("scheduler serves a different store")
+            if scheduler.on_corrupt == "skip":
+                raise ValueError(
+                    "source(): on_corrupt='skip' would silently shift "
+                    "row offsets mid-stream; streaming passes need "
+                    "on_corrupt='fail' (use scheduler.iter_shards "
+                    "directly for skip-tolerant consumers)")
+            factory_from = scheduler.iter_shards
+        else:
+            factory_from = self.iter_shards
+        return ShardSource(
+            lambda: factory_from(0), self.n_cells, self.n_genes,
+            self.shard_rows, prefetch=prefetch,
+            factory_from=factory_from)
+
+
+def open_store(directory: str) -> ShardStore:
+    return ShardStore.open(directory)
+
+
+# ----------------------------------------------------------------------
+# Read scheduler (the IO-failure domain)
+# ----------------------------------------------------------------------
+
+_SKIPPED = object()
+
+
+class _PendingRead:
+    """One in-flight shard read.  The worker fills exactly one of
+    ``result``/``error`` and sets ``done_evt``; ``ready_at`` is the
+    (injectable-clock) instant the result becomes servable — a
+    chaos-slow read completes in real time but stays 'in flight' in
+    virtual time until then, which is what lets the hedge/SLO ladder
+    run deterministically with zero real sleeps."""
+
+    __slots__ = ("shard", "lock", "done_evt", "result", "error",
+                 "ready_at", "nbytes", "abandoned", "released",
+                 "holds_budget")
+
+    def __init__(self, shard: int, holds_budget: bool = False):
+        self.shard = shard
+        self.lock = threading.Lock()
+        self.done_evt = threading.Event()
+        self.result = None
+        self.error: BaseException | None = None
+        self.ready_at = 0.0
+        self.nbytes = 0
+        self.abandoned = False
+        self.released = False
+        self.holds_budget = holds_budget
+
+    def peek(self, clock):
+        """``("pending" | "error" | "ready" | "deferred", value)``."""
+        if not self.done_evt.is_set():
+            return "pending", None
+        with self.lock:
+            if self.error is not None:
+                return "error", self.error
+            if clock.monotonic() >= self.ready_at:
+                return "ready", self.result
+            return "deferred", self.ready_at
+
+
+class ShardReadScheduler:
+    """Locality-aware, failure-contained reader pool above a
+    :class:`ShardStore` (module docstring: layers 2 + 3).
+
+    Parameters
+    ----------
+    store : ShardStore
+    n_readers : int
+        Reader threads shared by every consumer stream.
+    ram_budget_bytes : int | None
+        Bound on decoded shard bytes in flight across ALL consumers
+        (``None`` = a small fixed lookahead).  Each consumer always
+        gets one in-flight read regardless — progress beats budget.
+    policy
+        Retry policy (``runner.RetryPolicy``-shaped: ``max_attempts``
+        + ``delay_s(attempt, rng)``); governs transient-failure
+        retries per shard read.
+    read_deadline_s / hedge_after_s : float | None
+        Per-read deadline (overrun = abandoned + classified
+        transient) and slow-read hedging SLO (straggler past it gets
+        a duplicate read, first ready result wins).  Both measured on
+        the injectable ``clock``.
+    on_corrupt : "fail" | "skip"
+        After the mandatory quarantine of a corrupt chunk: ``fail``
+        raises :class:`ShardCorruptError` (streaming passes — offsets
+        must not shift), ``skip`` drops the shard and records it in
+        ``.skipped``.
+    chaos : ChaosMonkey | None
+        Consulted per chunk read (``on_io`` — the IO fault channel).
+    journal
+        ``runner._Journal``-shaped object or a path; receives
+        ``shard_quarantined`` events.
+    """
+
+    def __init__(self, store: ShardStore, *, n_readers: int = 2,
+                 ram_budget_bytes: int | None = None,
+                 policy=None, read_deadline_s: float | None = None,
+                 hedge_after_s: float | None = None,
+                 on_corrupt: str = "fail",
+                 clock=None, metrics=None, chaos=None, journal=None,
+                 poll_s: float = 0.002):
+        if on_corrupt not in ("fail", "skip"):
+            raise ValueError("on_corrupt must be 'fail' or 'skip'")
+        self.store = store
+        self.n_readers = max(1, int(n_readers))
+        self.ram_budget_bytes = ram_budget_bytes
+        if policy is None:
+            from ..runner import RetryPolicy
+
+            policy = RetryPolicy(max_attempts=3, base_delay_s=0.05,
+                                 max_delay_s=2.0)
+        self.policy = policy
+        self.read_deadline_s = read_deadline_s
+        self.hedge_after_s = hedge_after_s
+        self.on_corrupt = on_corrupt
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
+        self.metrics = (metrics if metrics is not None
+                        else telemetry.default_registry())
+        self.chaos = chaos
+        self.journal = self._as_journal(journal)
+        self.poll_s = float(poll_s)
+        #: floor for real-time waits on an executing worker (virtual
+        #: time must NOT advance while we wait on real work — only
+        #: deferred/chaos waits burn the clock); the wait itself is
+        #: event-driven, so this is a clamp, not a polling quantum
+        self._min_wait_s = 0.001
+        self._max_wait_s = 60.0
+        self.skipped: list[int] = []
+        self._cv = threading.Condition()
+        self._heap: list = []
+        self._seq = itertools.count()
+        self._threads: list[threading.Thread] = []
+        self._stop = False
+        self._reserved = 0
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _as_journal(j):
+        if j is None or hasattr(j, "write"):
+            return j
+        from ..runner import _Journal
+
+        return _Journal(str(j))
+
+    # -- lifecycle -----------------------------------------------------
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def close(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads.clear()
+
+    def _ensure_workers(self) -> None:
+        with self._cv:
+            if self._stop:
+                raise ValueError("scheduler is closed")
+            while len(self._threads) < self.n_readers:
+                t = threading.Thread(target=self._worker, daemon=True)
+                t.start()
+                self._threads.append(t)
+
+    # -- RAM budget ----------------------------------------------------
+    def _try_reserve(self, nbytes: int) -> bool:
+        if self.ram_budget_bytes is None:
+            return True
+        with self._lock:
+            if self._reserved + nbytes > self.ram_budget_bytes:
+                return False
+            self._reserved += nbytes
+            return True
+
+    def _discard(self, req: _PendingRead) -> None:
+        """Release a request's budget reservation exactly once (only
+        lookahead submissions hold one — forced/retry/hedge reads are
+        progress-over-budget) and mark it abandoned so a worker that
+        hasn't started it yet skips the read."""
+        with req.lock:
+            req.abandoned = True
+            if req.released or not req.holds_budget:
+                req.released = True
+                return
+            req.released = True
+        if self.ram_budget_bytes is not None:
+            with self._lock:
+                self._reserved = max(
+                    0, self._reserved - self.store.shard_nbytes_est())
+
+    # -- worker side ---------------------------------------------------
+    def _submit(self, shard: int, priority: int = 1,
+                holds_budget: bool = False) -> _PendingRead:
+        req = _PendingRead(shard, holds_budget=holds_budget)
+        with self._cv:
+            # (priority, shard, seq): hedges (priority 0) jump the
+            # queue; otherwise ascending shard order across every
+            # consumer = the elevator/locality order
+            heapq.heappush(self._heap,
+                           (priority, shard, next(self._seq), req))
+            self._cv.notify()
+        return req
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                while not self._heap and not self._stop:
+                    self._cv.wait()
+                if not self._heap:
+                    return  # stopped and drained
+                _, _, _, req = heapq.heappop(self._heap)
+            if req.abandoned:
+                req.done_evt.set()
+                continue
+            self._execute(req)
+
+    def _execute(self, req: _PendingRead) -> None:
+        t0 = self.clock.monotonic()
+        slow = [0.0]
+
+        def consult(c, name, path):
+            if self.chaos is None:
+                return
+            f = self.chaos.on_io(name, path)
+            if f is None:
+                return
+            if f["mode"] == "io_error":
+                raise TransientDeviceError(
+                    f"chaos: injected io_error reading {name} "
+                    f"(shard {req.shard})")
+            if f["mode"] == "slow_read":
+                slow[0] += float(f["slow_s"])
+            # truncate_shard: the monkey damaged the file; the
+            # verified read rules it corrupt
+
+        try:
+            shard = self.store.read_shard(req.shard, on_chunk=consult)
+            with req.lock:
+                req.result = shard
+                req.nbytes = (shard.indices.nbytes + shard.data.nbytes)
+                req.ready_at = t0 + slow[0]
+        except BaseException as e:  # noqa: BLE001 — delivered to the
+            # consumer's ladder, which classifies and rules on it
+            with req.lock:
+                req.error = e
+                req.ready_at = t0
+        req.done_evt.set()
+
+    # -- consumer side -------------------------------------------------
+    def iter_shards(self, start_shard: int = 0):
+        """One consumer stream: yields decoded shards in order from
+        ``start_shard``, each read passing through the full IO ladder.
+        Multiple concurrent ``iter_shards`` generators share the
+        reader pool, the locality order and the RAM budget."""
+        self._ensure_workers()
+        n = self.store.n_shards
+        est = self.store.shard_nbytes_est()
+        window = max(1, min(8, (self.ram_budget_bytes // est)
+                            if self.ram_budget_bytes else 2))
+        pending: dict[int, _PendingRead] = {}
+        next_submit = start_shard
+        try:
+            for i in range(start_shard, n):
+                while next_submit < n and next_submit - i < window:
+                    if next_submit == i:
+                        reserved = False  # forced: progress > budget
+                    elif self._try_reserve(est):
+                        reserved = True
+                    else:
+                        break
+                    pending[next_submit] = self._submit(
+                        next_submit, holds_budget=reserved)
+                    next_submit += 1
+                shard = self._await_shard(i, pending.pop(i))
+                if shard is _SKIPPED:
+                    continue
+                yield shard
+        finally:
+            for r in pending.values():
+                self._discard(r)
+
+    def _await_shard(self, i: int, primary: _PendingRead):
+        t0 = self.clock.monotonic()
+        attempt_t0 = t0
+        rng = random.Random((self.policy.seed, "ingest", i).__repr__())
+        attempt = 1
+        retried = False
+        hedged = False
+        hedge: _PendingRead | None = None
+        errors: list[BaseException] = []
+
+        def resubmit():
+            nonlocal attempt, retried, attempt_t0, primary, hedge
+            attempt += 1
+            retried = True
+            self.metrics.counter("ingest.retries").inc()
+            self.clock.sleep(self.policy.delay_s(attempt - 1, rng))
+            attempt_t0 = self.clock.monotonic()
+            primary = self._submit(i)
+            hedge = None
+
+        while True:
+            served = err_req = None
+            for r in (primary, hedge):
+                if r is None:
+                    continue
+                st, val = r.peek(self.clock)
+                if st == "ready":
+                    served = (r, val)
+                    break
+                if st == "error" and err_req is None:
+                    err_req = (r, val)
+            if served is not None:
+                r, shard = served
+                outcome = ("hedged" if hedged
+                           else "retried" if retried else "served")
+                self.metrics.counter("ingest.reads",
+                                     outcome=outcome).inc()
+                self.metrics.counter("ingest.bytes").inc(r.nbytes)
+                self.metrics.histogram("ingest.read_wait_s").observe(
+                    self.clock.monotonic() - t0)
+                for other in (primary, hedge):
+                    if other is not None:
+                        self._discard(other)
+                return shard
+            if err_req is not None:
+                r, e = err_req
+                errors.append(e)
+                self._discard(r)
+                if r is hedge:
+                    hedge = None
+                else:
+                    primary = None
+                if primary is not None or hedge is not None:
+                    continue  # the twin read may still serve
+                # both attempts down: rule on the failure
+                corrupt = next((x for x in errors
+                                if isinstance(x, ShardCorruptError)),
+                               None)
+                if corrupt is not None:
+                    self._quarantine_ruling(i, corrupt)
+                    if self.on_corrupt == "fail":
+                        raise corrupt
+                    self.skipped.append(i)
+                    return _SKIPPED
+                if (classify_error(e) == TRANSIENT
+                        and attempt < self.policy.max_attempts):
+                    resubmit()
+                    continue
+                raise e
+            # nothing servable yet — hedge/deadline rulings, then wait
+            el = self.clock.monotonic() - attempt_t0
+            if (self.hedge_after_s is not None and not hedged
+                    and primary is not None and el >= self.hedge_after_s):
+                hedged = True
+                self.metrics.counter("ingest.hedges").inc()
+                hedge = self._submit(i, priority=0)
+                continue
+            if (self.read_deadline_s is not None
+                    and el >= self.read_deadline_s):
+                for r in (primary, hedge):
+                    if r is not None:
+                        self._discard(r)
+                primary = hedge = None
+                if attempt < self.policy.max_attempts:
+                    resubmit()
+                    continue
+                raise TransientDeviceError(
+                    f"ingest: shard {i} read exceeded its "
+                    f"{self.read_deadline_s:g}s deadline "
+                    f"{attempt} time(s) — abandoning the straggler")
+            self._wait_step(primary, hedge, attempt_t0)
+
+    def _wait_step(self, primary, hedge, attempt_t0) -> None:
+        """Block until something can change: an EVENT-DRIVEN real wait
+        on a worker still executing (virtual time must not race ahead
+        of real work; the timeout only exists so clock-based rulings
+        — hedge SLO, per-read deadline — get re-evaluated), or an
+        injectable-clock sleep when every in-flight result is merely
+        deferred (a chaos-slow read's virtual release time — the only
+        wait that burns clock time, which a VirtualClock burns
+        instantly)."""
+        in_flight = [r for r in (primary, hedge)
+                     if r is not None and not r.done_evt.is_set()]
+        if in_flight:
+            # wake exactly on completion; re-check early only when a
+            # ruling could fire before then
+            el = self.clock.monotonic() - attempt_t0
+            waits = [self._max_wait_s]
+            if self.hedge_after_s is not None and hedge is None:
+                waits.append(self.hedge_after_s - el)
+            if self.read_deadline_s is not None:
+                waits.append(self.read_deadline_s - el)
+            in_flight[0].done_evt.wait(max(min(waits),
+                                           self._min_wait_s))
+            return
+        # every in-flight result is merely DEFERRED (chaos-slow):
+        # sleep the clock straight to the next event — the earliest
+        # virtual release time or the next hedge/deadline boundary —
+        # in ONE sleep, not poll_s quanta (a 30s slow_read must not
+        # spin 15000 consumer iterations)
+        now = self.clock.monotonic()
+        candidates = [r.ready_at - now for r in (primary, hedge)
+                      if r is not None]
+        if self.hedge_after_s is not None and hedge is None \
+                and primary is not None:
+            candidates.append(attempt_t0 + self.hedge_after_s - now)
+        if self.read_deadline_s is not None:
+            candidates.append(attempt_t0 + self.read_deadline_s - now)
+        ahead = [c for c in candidates if c > 0.0]
+        self.clock.sleep(min(ahead) if ahead else self.poll_s)
+
+    def _quarantine_ruling(self, shard: int, e: ShardCorruptError):
+        dest = self.store.quarantine_chunk(e.chunk, e.reason)
+        self.metrics.counter("ingest.quarantines").inc()
+        if self.journal is not None:
+            self.journal.write("shard_quarantined", shard=shard,
+                               chunk=e.chunk, path=dest or e.path,
+                               reason=e.reason,
+                               policy=self.on_corrupt)

@@ -47,11 +47,70 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import warnings
+
 from ..config import config, round_up
 from ..utils import telemetry
+from ..utils.checkpoint import (CheckpointCorruptError,
+                                load_npz_verified, quarantine_checkpoint,
+                                save_npz_verified)
+from ..utils.failsafe import TRANSIENT, classify_error
 from ..utils.sync import hard_sync
 from ..utils.vclock import SYSTEM_CLOCK
 from .sparse import SparseCells, segment_reduce, spmm, spmm_t
+
+
+# ----------------------------------------------------------------------
+# Verified resume files (the streaming passes' checkpoints)
+# ----------------------------------------------------------------------
+
+#: identity fingerprints the pass checkpoints carry (a stream_pca file
+#: renamed onto the stats path fails verification instead of
+#: half-parsing); argument mismatches stay a ValueError — a checkpoint
+#: for different arguments is WRONG, not corrupt, and must not be
+#: quarantined
+_STATS_FP = "stream_stats-v1"
+_PCA_FP = "stream_pca-v1"
+
+
+def _save_resume_npz(path: str, fingerprint: str, **arrays) -> None:
+    """Write a streaming pass's resume state through the checkpoint
+    integrity layer (digest + schema + fingerprint, atomic rename).
+    The previous generation rotates to ``<path>.prev`` first — the
+    deterministic fallback shard: if the newest file is later ruled
+    corrupt, resume falls back ONE save (one shard of lost work)
+    instead of restarting the pass."""
+    if os.path.exists(path):
+        os.replace(path, path + ".prev")
+    save_npz_verified(path, fingerprint=fingerprint, **arrays)
+
+
+def _load_resume_npz(path: str, fingerprint: str) -> dict | None:
+    """Verify-then-load a resume file, falling back deterministically:
+    newest → ``.prev`` → ``None`` (fresh start).  A file that fails
+    verification — bit rot, a truncated write, chaos damage — is
+    QUARANTINED (moved beside the data with a ``.reason.json``
+    sidecar, never deleted) and the next candidate is tried.  Files
+    from before the integrity layer carry no digest and load as
+    legacy."""
+    for cand in (path, path + ".prev"):
+        if not os.path.exists(cand):
+            continue
+        try:
+            return load_npz_verified(cand, expect_fingerprint=fingerprint)
+        except CheckpointCorruptError as e:
+            dest = quarantine_checkpoint(cand, e.reason)
+            warnings.warn(
+                f"stream checkpoint {cand!r} failed verification "
+                f"({e.reason}) — quarantined to {dest!r}, resuming "
+                f"from an earlier shard", RuntimeWarning, stacklevel=3)
+    return None
+
+
+def _clear_resume_npz(path: str) -> None:
+    for cand in (path, path + ".prev"):
+        if os.path.exists(cand):
+            os.remove(cand)  # pass completed; resume state is stale
 
 
 # ----------------------------------------------------------------------
@@ -59,8 +118,22 @@ from .sparse import SparseCells, segment_reduce, spmm, spmm_t
 # ----------------------------------------------------------------------
 
 
+def _tag_shard_index(e: BaseException, idx: int) -> BaseException:
+    """Attach the failing shard's index to an exception surfacing out
+    of the prefetch worker (``.shard_index``; also an ``add_note`` on
+    pythons that have it) — the consumer sees WHERE the stream died
+    without the worker's stack."""
+    try:
+        e.shard_index = idx
+        if hasattr(e, "add_note"):
+            e.add_note(f"[stream] raised while producing shard {idx}")
+    except Exception:  # pragma: no cover - exotic exception types
+        pass
+    return e
+
+
 def _prefetch_iter(make_gen, depth: int = 2, prepare=None, clock=None,
-                   metrics=None):
+                   metrics=None, prepare_retries: int = 2):
     """Run a generator in a daemon worker thread, handing items over a
     bounded queue (``depth=2``: a DOUBLE-BUFFERED shard pipeline — the
     worker keeps shard N+1 fully prepared while the consumer computes
@@ -71,8 +144,17 @@ def _prefetch_iter(make_gen, depth: int = 2, prepare=None, clock=None,
     overlap the current shard's device compute, even when
     ``config.stream_sync`` drains the device between shards (the axon
     tunnel mode, where jax's own async dispatch is off the table).
-    Exceptions (from the generator or from ``prepare``) propagate to
-    the consumer at the point of the failed item.
+
+    Worker exceptions are CLASSIFIED (``failsafe.classify_error``)
+    before they reach the consumer: a transient IO failure inside
+    ``prepare`` (flaky-disk EIO, a dropped tunnel connection) gets up
+    to ``prepare_retries`` bounded in-worker retries on the
+    injectable clock (counted under ``ingest.retries``), so the
+    stream survives a blip without restarting the whole pass;
+    deterministic errors — and exhausted retries, and any
+    generator-side raise (a generator cannot be re-``next``-ed) —
+    surface immediately at the point of the failed item with the
+    shard index attached (``exc.shard_index``).
 
     Overlap accounting goes to ``metrics`` (default: the process-wide
     telemetry registry) on the injectable ``clock`` — tier-1 drives it
@@ -87,10 +169,27 @@ def _prefetch_iter(make_gen, depth: int = 2, prepare=None, clock=None,
     import threading
 
     clock = clock if clock is not None else SYSTEM_CLOCK
+    m = metrics if metrics is not None else telemetry.default_registry()
     q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
     stop = threading.Event()
     _END = object()
     _ERR = object()
+
+    def run_prepare(item, idx):
+        """``prepare`` with bounded in-worker retries for CLASSIFIED
+        transients only — a deterministic raise replays identically,
+        so retrying it would just delay the consumer's diagnosis."""
+        attempt = 0
+        while True:
+            try:
+                return prepare(item)
+            except Exception as e:
+                if (classify_error(e) != TRANSIENT
+                        or attempt >= prepare_retries):
+                    raise _tag_shard_index(e, idx)
+                attempt += 1
+                m.counter("ingest.retries").inc()
+                clock.sleep(min(0.05 * 2.0 ** (attempt - 1), 1.0))
 
     def put(item) -> bool:
         # stop-aware put: a consumer that abandons iteration (device
@@ -106,6 +205,7 @@ def _prefetch_iter(make_gen, depth: int = 2, prepare=None, clock=None,
 
     def worker():
         gen = make_gen()
+        produced = 0
         try:
             while True:
                 t0 = clock.monotonic()
@@ -113,14 +213,19 @@ def _prefetch_iter(make_gen, depth: int = 2, prepare=None, clock=None,
                     item = next(gen)
                 except StopIteration:
                     break
+                except BaseException as e:
+                    # generator-side raise: no retry possible (the
+                    # generator is dead) — tag the shard and surface
+                    raise _tag_shard_index(e, produced)
                 if prepare is not None:
-                    item = prepare(item)
+                    item = run_prepare(item, produced)
                 # production wall: generator work + prepare (decode +
                 # pack + device_put) — NOT time blocked on a full
                 # queue, which is the consumer's compute, not ours
                 work = clock.monotonic() - t0
                 if not put((None, item, work)):
                     return  # consumer gone; generator finalised here
+                produced += 1
         except BaseException as e:  # noqa: BLE001 - reraised below
             put((_ERR, e, 0.0))
         put(_END)
@@ -149,7 +254,6 @@ def _prefetch_iter(make_gen, depth: int = 2, prepare=None, clock=None,
             q.get_nowait()
         except queue.Empty:
             pass
-        m = metrics if metrics is not None else telemetry.default_registry()
         m.counter("stream.stall_s").inc(stall_total)
         m.counter("stream.overlap_s").inc(overlap_total)
 
@@ -395,16 +499,24 @@ def stream_stats(src: ShardSource, target_sum: float = 1e4,
     normalised log matrix (device accumulator).
 
     ``checkpoint=`` makes the pass RESUMABLE: after every shard the
-    fetched per-shard results are written atomically to the given
-    ``.npz`` path, and a rerun with the same arguments loads it, seeks
-    the source to the first unprocessed shard (range-aware sources
-    skip the read entirely — see ``ShardSource.iter_from``), and
-    finishes the pass.  This is the recovery story for the pass that
-    historically killed tunneled TPU workers mid-atlas: a crashed
-    process loses at most one shard of work.  The file is deleted on
-    successful completion.  Checkpointing forces a per-shard fetch
-    (the same drain ``config.stream_sync`` imposes on the tunnel), so
-    leave it off when failure recovery isn't worth a sync per shard.
+    fetched per-shard results are written through the checkpoint
+    INTEGRITY layer (content digest + schema + pass fingerprint,
+    atomic rename, previous generation rotated to ``.prev``) to the
+    given ``.npz`` path; a rerun with the same arguments
+    verify-loads it, seeks the source to the first unprocessed shard
+    (range-aware sources skip the read entirely — see
+    ``ShardSource.iter_from``), and finishes the pass.  A resume file
+    that fails verification — bit rot, a write truncated by the very
+    crash being recovered from — is QUARANTINED (moved beside the
+    data with a ``.reason.json`` sidecar, never deleted) and resume
+    falls back deterministically to the ``.prev`` generation (one
+    shard earlier), then to a fresh pass.  This is the recovery story
+    for the pass that historically killed tunneled TPU workers
+    mid-atlas: a crashed process loses at most one shard of work.
+    The files are deleted on successful completion.  Checkpointing
+    forces a per-shard fetch (the same drain ``config.stream_sync``
+    imposes on the tunnel), so leave it off when failure recovery
+    isn't worth a sync per shard.
     """
     if mito_mask is None:
         mito_mask = np.zeros(src.n_genes, bool)
@@ -413,8 +525,9 @@ def stream_stats(src: ShardSource, target_sum: float = 1e4,
     totals, ngenes, pct, shard_stats = [], [], [], []
     shard_sizes = []
     start_shard = 0
-    if checkpoint is not None and os.path.exists(checkpoint):
-        z = np.load(checkpoint)
+    z = (_load_resume_npz(checkpoint, _STATS_FP)
+         if checkpoint is not None else None)
+    if z is not None:
         meta_ok = (int(z["n_cells"]) == src.n_cells
                    and int(z["n_genes"]) == src.n_genes
                    and int(z["shard_rows"]) == src.shard_rows
@@ -435,21 +548,20 @@ def stream_stats(src: ShardSource, target_sum: float = 1e4,
             shard_sizes.append(int(n_i))
 
     def _save_checkpoint(next_shard):
-        tmp = checkpoint + ".tmp.npz"  # savez won't re-suffix this
-        np.savez(tmp,
-                 n_cells=src.n_cells, n_genes=src.n_genes,
-                 shard_rows=src.shard_rows, target_sum=target_sum,
-                 next_shard=next_shard,
-                 shard_sizes=np.asarray(shard_sizes, np.int64),
-                 totals=np.concatenate([np.asarray(t, np.float32)
-                                        for t in totals]),
-                 ngenes=np.concatenate([np.asarray(g, np.float32)
-                                        for g in ngenes]),
-                 pct=np.concatenate([np.asarray(m, np.float32)
-                                     for m in pct]),
-                 stats=np.stack([np.asarray(s, np.float32)
-                                 for s in shard_stats]))
-        os.replace(tmp, checkpoint)
+        _save_resume_npz(
+            checkpoint, _STATS_FP,
+            n_cells=src.n_cells, n_genes=src.n_genes,
+            shard_rows=src.shard_rows, target_sum=target_sum,
+            next_shard=next_shard,
+            shard_sizes=np.asarray(shard_sizes, np.int64),
+            totals=np.concatenate([np.asarray(t, np.float32)
+                                   for t in totals]),
+            ngenes=np.concatenate([np.asarray(g, np.float32)
+                                   for g in ngenes]),
+            pct=np.concatenate([np.asarray(m, np.float32)
+                                for m in pct]),
+            stats=np.stack([np.asarray(s, np.float32)
+                            for s in shard_stats]))
 
     for k, (offset, shard) in enumerate(src.iter_from(start_shard),
                                         start=start_shard):
@@ -503,8 +615,8 @@ def stream_stats(src: ShardSource, target_sum: float = 1e4,
         nnz += nnz_i
         n_acc += n_i
     n = src.n_cells
-    if checkpoint is not None and os.path.exists(checkpoint):
-        os.remove(checkpoint)  # pass completed; resume state is stale
+    if checkpoint is not None:
+        _clear_resume_npz(checkpoint)
     return {
         "total_counts": np.concatenate(totals),
         "n_genes": np.concatenate(ngenes),
@@ -756,8 +868,11 @@ def stream_pca(src: ShardSource, gene_idx: np.ndarray,
     floats, not the (n, L) Q — at 10M cells that array is GBs).  On
     resume, Q is recomputed from the carrier (one deterministic matvec
     sweep), then the rmatvec pass continues from the first unprocessed
-    shard: a crash loses at most one matvec sweep.  The file is
-    deleted on success.
+    shard: a crash loses at most one matvec sweep.  The state is
+    written through the checkpoint integrity layer exactly like
+    ``stream_stats``' (verify-on-load, corrupt file quarantined with
+    a reason sidecar, deterministic ``.prev`` fallback); the files
+    are deleted on success.
     """
     from ..ops.pca import cholesky_qr
 
@@ -785,8 +900,9 @@ def stream_pca(src: ShardSource, gene_idx: np.ndarray,
         return _assemble_rows(blocks, src.n_cells)
 
     start_round, start_shard, acc0 = 0, 0, None
-    if checkpoint is not None and os.path.exists(checkpoint):
-        z = np.load(checkpoint)
+    z = (_load_resume_npz(checkpoint, _PCA_FP)
+         if checkpoint is not None else None)
+    if z is not None:
         if not (int(z["n_cells"]) == src.n_cells
                 and int(z["g_sub"]) == g_sub and int(z["L"]) == L
                 and int(z["n_iter"]) == n_iter
@@ -821,13 +937,13 @@ def stream_pca(src: ShardSource, gene_idx: np.ndarray,
                     hard_sync(acc)
             if checkpoint is not None:
                 shard_i = offset // src.shard_rows
-                tmp = checkpoint + ".tmp.npz"
-                np.savez(tmp, n_cells=src.n_cells, g_sub=g_sub, L=L,
-                         n_iter=n_iter, target_sum=target_sum,
-                         round=rnd, next_shard=shard_i + 1,
-                         carrier=np.asarray(carrier),
-                         acc=np.asarray(acc))
-                os.replace(tmp, checkpoint)
+                _save_resume_npz(
+                    checkpoint, _PCA_FP,
+                    n_cells=src.n_cells, g_sub=g_sub, L=L,
+                    n_iter=n_iter, target_sum=target_sum,
+                    round=rnd, next_shard=shard_i + 1,
+                    carrier=np.asarray(carrier),
+                    acc=np.asarray(acc))
         return acc
 
     # rounds: carrier_r -> Q = qr(X c) -> z = rmatvec(Q);
@@ -847,8 +963,8 @@ def stream_pca(src: ShardSource, gene_idx: np.ndarray,
     scores = (Q @ U_b[:, :k]) * S[:k]
     components = Vt[:k].T
     explained = (S[:k] ** 2) / max(src.n_cells - 1, 1)
-    if checkpoint is not None and os.path.exists(checkpoint):
-        os.remove(checkpoint)
+    if checkpoint is not None:
+        _clear_resume_npz(checkpoint)
     return scores, components, explained
 
 

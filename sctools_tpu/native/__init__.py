@@ -112,6 +112,67 @@ def pack_ell(indptr, col_indices, data, rows_padded, capacity, sentinel):
     return _pack_ell_numpy(indptr, col_indices, data, rows_padded, capacity, sentinel)
 
 
+def pack_ell_chunks(chunks, rows_padded, capacity, sentinel):
+    """Decode several stored CSR chunks (disjoint row ranges of ONE
+    shard) into a single padded-ELL buffer — the shard store's read
+    path (``data/shardstore.py``).
+
+    ``chunks`` is a list of ``(indptr, col_indices, data, row_offset)``
+    tuples; chunk rows land at ``out[row_offset : row_offset + rows]``.
+    Native path: ``scio_pack_ell_f32_chunks`` runs one decode thread
+    per chunk (the memcpy loops never touch the same output bytes);
+    numpy fallback decodes serially.  Returns ``(indices, values)`` of
+    shape ``(rows_padded, capacity)``.
+    """
+    lib = _load_lib()
+    if (lib is not None and hasattr(lib, "scio_pack_ell_f32_chunks")
+            and all(np.asarray(d).dtype == np.float32
+                    for _, _, d, _ in chunks)):
+        out_idx = np.full((rows_padded, capacity), sentinel,
+                          dtype=np.int32)
+        out_val = np.zeros((rows_padded, capacity), dtype=np.float32)
+        n = len(chunks)
+        if n == 0:
+            return out_idx, out_val
+        # keep the contiguous per-chunk arrays alive for the call
+        indptrs = [np.ascontiguousarray(c[0], np.int64) for c in chunks]
+        colids = [np.ascontiguousarray(c[1], np.int32) for c in chunks]
+        datas = [np.ascontiguousarray(c[2], np.float32) for c in chunks]
+        rows = np.asarray([len(p) - 1 for p in indptrs], np.int64)
+        offs = np.asarray([c[3] for c in chunks], np.int64)
+        P64 = ctypes.POINTER(ctypes.c_int64)
+        P32 = ctypes.POINTER(ctypes.c_int32)
+        PF = ctypes.POINTER(ctypes.c_float)
+        indptr_ptrs = (P64 * n)(*[a.ctypes.data_as(P64)
+                                  for a in indptrs])
+        colid_ptrs = (P32 * n)(*[a.ctypes.data_as(P32) for a in colids])
+        data_ptrs = (PF * n)(*[a.ctypes.data_as(PF) for a in datas])
+        lib.scio_pack_ell_f32_chunks.restype = None
+        lib.scio_pack_ell_f32_chunks.argtypes = [
+            ctypes.POINTER(P64), ctypes.POINTER(P32), ctypes.POINTER(PF),
+            P64, P64, ctypes.c_int64, ctypes.c_int64, P32, PF,
+        ]
+        lib.scio_pack_ell_f32_chunks(
+            indptr_ptrs, colid_ptrs, data_ptrs,
+            rows.ctypes.data_as(P64), offs.ctypes.data_as(P64),
+            n, capacity,
+            out_idx.ctypes.data_as(P32), out_val.ctypes.data_as(PF),
+        )
+        return out_idx, out_val
+    # numpy fallback: serial per-chunk vectorised scatter into slices
+    dtype = (np.asarray(chunks[0][2]).dtype if chunks else np.float32)
+    out_idx = np.full((rows_padded, capacity), sentinel, dtype=np.int32)
+    out_val = np.zeros((rows_padded, capacity), dtype=dtype)
+    for indptr, col_indices, data, row0 in chunks:
+        rows = len(indptr) - 1
+        idx, val = _pack_ell_numpy(
+            np.asarray(indptr), np.asarray(col_indices),
+            np.asarray(data), rows, capacity, sentinel)
+        out_idx[row0: row0 + rows] = idx
+        out_val[row0: row0 + rows] = val
+    return out_idx, out_val
+
+
 def _pack_ell_numpy(indptr, col_indices, data, rows_padded, capacity, sentinel):
     n_rows = len(indptr) - 1
     nnz = np.diff(indptr)

@@ -24,6 +24,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import fnmatch
+import os
 import random
 import threading
 
@@ -34,14 +35,19 @@ from .failsafe import TransientDeviceError, check_deadline
 from .vclock import SYSTEM_CLOCK
 
 MODES = ("unavailable", "hang", "wedge", "corrupt",
-         "corrupt_checkpoint", "crash", "kill", "reject_storm")
+         "corrupt_checkpoint", "crash", "kill", "reject_storm",
+         "slow_read", "truncate_shard", "io_error")
 
 # which hook channel each mode fires on: most modes wrap the op CALL;
 # corrupt_checkpoint fires through the runner's on_checkpoint hook,
 # reject_storm through the scheduler's on_admission hook (where the
-# fault's ``op`` pattern matches TENANT names, not transform names)
+# fault's ``op`` pattern matches TENANT names, not transform names),
+# and the three IO modes through the shard-read scheduler's on_io
+# hook (pattern matches CHUNK file basenames, e.g. "chunk-00002")
 _MODE_CHANNEL = {"corrupt_checkpoint": "checkpoint",
-                 "reject_storm": "admission"}
+                 "reject_storm": "admission",
+                 "slow_read": "io", "truncate_shard": "io",
+                 "io_error": "io"}
 
 
 class ChaosCrash(BaseException):
@@ -143,6 +149,19 @@ class ChaosMonkey:
     * ``kill`` — ``os._exit(9)``: REAL process death.  Only meaningful
       inside a contained child (``failsafe.run_isolated``); in the
       parent process it takes the test runner down with it.
+    * ``slow_read`` / ``truncate_shard`` / ``io_error`` — the IO
+      channel (:meth:`on_io`, consulted by the shard-read scheduler
+      for every chunk read; the fault's ``op`` pattern matches CHUNK
+      file basenames like ``"chunk-00002"``).  ``truncate_shard``
+      damages the chunk file on disk (truncates it to half its bytes
+      — the partial-write/bit-rot failure the digest verify +
+      quarantine path exists to catch); ``slow_read`` and
+      ``io_error`` only RULE (the hook returns the firing mode plus
+      ``slow_s``) — the scheduler implements the semantics, because
+      it owns the injectable clock and the read concurrency: an
+      injected EIO raises transient and retries, a slow read defers
+      the result's virtual ready-time so the hedge/SLO ladder runs
+      with zero real sleeps.
 
     ``calls`` counts invocations per op name (checkpoint saves count
     separately under ``"<op>@checkpoint"``, admission consults under
@@ -153,12 +172,14 @@ class ChaosMonkey:
     """
 
     def __init__(self, faults, seed: int = 0, hang_s: float = 3600.0,
-                 sleep=None, clock=None, wedge_s: float | None = None):
+                 sleep=None, clock=None, wedge_s: float | None = None,
+                 slow_s: float = 30.0):
         self.faults = list(faults)
         self.seed = seed
         self.hang_s = hang_s
         self.clock = clock
         self.wedge_s = hang_s if wedge_s is None else wedge_s
+        self.slow_s = float(slow_s)
         self.sleep = (sleep if sleep is not None
                       else (clock or SYSTEM_CLOCK).sleep)
         self.calls: dict[str, int] = {}
@@ -186,12 +207,14 @@ class ChaosMonkey:
             calls = dict(self.calls)
         return {"faults": [dataclasses.asdict(f) for f in self.faults],
                 "seed": self.seed, "hang_s": self.hang_s,
-                "wedge_s": self.wedge_s, "calls": calls}
+                "wedge_s": self.wedge_s, "slow_s": self.slow_s,
+                "calls": calls}
 
     @classmethod
     def from_spec(cls, spec: dict) -> "ChaosMonkey":
         m = cls([Fault(**f) for f in spec["faults"]], seed=spec["seed"],
-                hang_s=spec["hang_s"], wedge_s=spec.get("wedge_s"))
+                hang_s=spec["hang_s"], wedge_s=spec.get("wedge_s"),
+                slow_s=spec.get("slow_s", 30.0))
         m.calls = dict(spec.get("calls", {}))
         return m
 
@@ -222,6 +245,41 @@ class ChaosMonkey:
             self.injected.append({"op": tenant, "call": call_no,
                                   "mode": f.mode, "backend": backend})
         return True
+
+    def on_io(self, name: str, path: str | None = None,
+              backend: str | None = None) -> dict | None:
+        """Shard-read hook, consulted by the ingest scheduler for
+        every chunk read attempt: returns ``None`` (healthy) or
+        ``{"mode": ..., "slow_s": ...}`` for a firing IO fault.  On
+        this channel the fault's ``op`` pattern matches the CHUNK
+        file basename (``"chunk-00002"``); call counting is per chunk
+        under ``"<chunk>@io"``, so ``on_call``/``times`` windows work
+        exactly like device faults (the retried read's SECOND attempt
+        consults again and falls outside a ``times=1`` window).
+
+        ``truncate_shard`` damages the file HERE (truncate to half
+        its bytes — like ``corrupt_checkpoint``, the monkey owns file
+        damage) and then lets the read proceed so the digest verify
+        rules it corrupt; ``slow_read``/``io_error`` only return the
+        ruling — the scheduler owns the clock and the concurrency, so
+        it implements the wait/raise semantics."""
+        key = f"{name}@io"
+        with self._lock:
+            call_no = self.calls.get(key, 0) + 1
+            self.calls[key] = call_no
+            f = self._firing(name, backend, call_no, channel="io")
+            if f is None:
+                return None
+            self.injected.append({"op": name, "call": call_no,
+                                  "mode": f.mode, "backend": backend})
+        if f.mode == "truncate_shard" and path is not None:
+            try:
+                size = os.path.getsize(path)
+                with open(path, "r+b") as fh:
+                    fh.truncate(max(size // 2, 1))
+            except OSError:
+                pass  # file already gone/quarantined: the ruling stands
+        return {"mode": f.mode, "slow_s": self.slow_s}
 
     def on_checkpoint(self, name: str, path: str,
                       backend: str | None = None) -> bool:

@@ -203,6 +203,70 @@ def _verify_arrays(arrays: dict,
             "fingerprint": fp}
 
 
+def save_npz_verified(path: str, *, fingerprint: str | None = None,
+                      **arrays) -> str:
+    """Write a plain dict of arrays as a checksummed ``.npz`` (atomic
+    rename) carrying the SAME ``_integrity/*`` keys as a CellData
+    checkpoint — content digest, :data:`CHECKPOINT_SCHEMA`, optional
+    identity ``fingerprint``.  This is the generic writer behind every
+    non-CellData durable file in the ingest tier: shard-store chunks
+    (``data/io.py`` ``write_csr_chunk``) and the streaming passes'
+    resume files (``data/stream.py``) all route here, so ONE integrity
+    convention covers the whole IO path.  Returns the content digest
+    (computed exactly once — a terabyte-scale store write must not
+    pay a second full hashing pass just to record digests in its
+    manifest)."""
+    out = {k: np.asarray(v) for k, v in arrays.items()}
+    digest = _content_digest(out)
+    out[f"{_INTEGRITY}digest"] = np.array(digest)
+    out[f"{_INTEGRITY}schema"] = np.array(CHECKPOINT_SCHEMA, np.int64)
+    out[f"{_INTEGRITY}fingerprint"] = np.array(fingerprint or "")
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, **out)
+    os.replace(tmp, path)
+    return digest
+
+
+def load_npz_verified(path: str, *,
+                      expect_fingerprint: str | None = None,
+                      require_digest: bool = False,
+                      expect_digest: str | None = None) -> dict:
+    """Read-and-verify the twin of :func:`save_npz_verified`: one pass
+    over the file feeds both the digest check and the returned array
+    dict (``_integrity/*`` keys stripped).  Any failure — unreadable
+    bytes, digest/schema/fingerprint mismatch — raises
+    :class:`CheckpointCorruptError` with a machine-readable
+    ``.reason``.  ``require_digest=True`` additionally rejects files
+    with NO integrity keys (shard-store chunks are always written
+    with them, so a digestless chunk is a truncated or foreign file,
+    not a legacy one; legacy resume files stay loadable by default).
+    ``expect_digest=`` (an externally recorded digest, e.g. a store
+    manifest's) catches the cross-wired-file case — intact bytes that
+    self-verify but belong in a different slot — from the same single
+    read."""
+    try:
+        arrays = _read_arrays(path)
+    except Exception as e:  # noqa: BLE001 — unreadable is an
+        # integrity ruling here, exactly as in load_celldata
+        raise CheckpointCorruptError(
+            path, f"unreadable ({type(e).__name__}: {e})") from e
+    chk = _verify_arrays(arrays, expect_fingerprint)
+    if not chk["ok"]:
+        raise CheckpointCorruptError(path, chk["reason"])
+    if require_digest and chk["reason"] == "legacy":
+        raise CheckpointCorruptError(
+            path, "missing integrity keys (digestless file where a "
+                  "verified one is required)")
+    if expect_digest:
+        stored = str(arrays.get(f"{_INTEGRITY}digest", ""))
+        if stored != expect_digest:
+            raise CheckpointCorruptError(
+                path, f"manifest digest mismatch (file {stored}, "
+                      f"manifest {expect_digest})")
+    return {k: v for k, v in arrays.items()
+            if not k.startswith(_INTEGRITY)}
+
+
 def verify_checkpoint(path: str,
                       expect_fingerprint: str | None = None) -> dict:
     """Re-hash a checkpoint before trusting it.

@@ -122,6 +122,10 @@ _TRANSIENT_MARKERS = (
     "broken pipe",
     "failed to connect",
     "heartbeat",
+    # host-IO transients (the ingest tier's disk-shaped failures): a
+    # flaky disk/NFS read raises OSError(EIO, "Input/output error") —
+    # worth retrying, unlike ENOENT/ENOSPC which recur identically
+    "input/output error",
 )
 
 _TRANSIENT_TYPES = (TransientDeviceError, TimeoutError, ConnectionError,

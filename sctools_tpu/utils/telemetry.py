@@ -73,6 +73,10 @@ EVENTS = frozenset({
     # scheduler admission funnel (sctools_tpu/scheduler.py; terminal
     # run events reuse run_completed/run_failed with ticket= fields)
     "submitted", "admitted", "rejected", "shed",
+    # ingest IO-failure domain (sctools_tpu/data/shardstore.py): a
+    # corrupt/truncated shard chunk was moved — never deleted — to
+    # quarantine/ with a .reason.json sidecar
+    "shard_quarantined",
 })
 
 #: Every legal metric name → one-line meaning (the docs table).  Like
@@ -151,6 +155,23 @@ METRICS = {
                   "deadline_expired|shutdown)",
     "sched.queue_wait_s": "histogram: admission-to-dispatch queue "
                           "wait seconds (on the injectable clock)",
+    "ingest.reads": "counter: shard reads served to a consumer "
+                    "(labels outcome= served|retried|hedged) — every "
+                    "terminated read lands in exactly one outcome "
+                    "(quarantined shards count under "
+                    "ingest.quarantines instead)",
+    "ingest.retries": "counter: shard-read attempts re-issued after a "
+                      "classified-transient IO failure (plus "
+                      "prefetch-worker prepare retries)",
+    "ingest.hedges": "counter: duplicate reads issued for stragglers "
+                     "past the hedge latency SLO (first result wins)",
+    "ingest.quarantines": "counter: corrupt/truncated shard chunks "
+                          "moved to quarantine/ (never deleted)",
+    "ingest.bytes": "counter: decoded padded-ELL bytes handed to "
+                    "consumers by the shard-read scheduler",
+    "ingest.read_wait_s": "histogram: consumer wait for a shard read "
+                          "(submission to first served result, on "
+                          "the injectable clock)",
 }
 
 #: Fixed histogram bucket upper bounds (seconds), chosen to straddle

@@ -1,0 +1,155 @@
+"""CI chaos-ingest smoke (tools/run_checks.sh stage 9).
+
+Drives the ingest IO-failure domain's three headline contracts on a
+temp-dir shard store, all on ONE VirtualClock with zero real sleeps:
+
+1. **truncate → quarantine**: a chaos-truncated chunk is moved (never
+   deleted) to ``quarantine/`` with a ``.reason.json`` sidecar and a
+   journaled ``shard_quarantined`` event;
+2. **slow disk still overlaps**: with every chunk read slowed by
+   chaos, the double-buffered prefetch still hides the (virtual) read
+   wall behind consumer compute — overlap efficiency
+   ``overlap/(overlap+stall) >= 0.8`` (the ROADMAP floor);
+3. **resume completes**: a stats pass crashed mid-ingest resumes from
+   its verified shard-granular checkpoint and finishes with results
+   identical to an uninterrupted pass.
+
+Run directly: ``JAX_PLATFORMS=cpu python tests/ingest_smoke.py``
+(exit 0 = all contracts hold).
+"""
+
+import dataclasses
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+import numpy as np
+
+# run as a plain script (CI stage 9): the script dir (tests/) is what
+# lands on sys.path, not the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="sctools_ingest_smoke_")
+    try:
+        return _run(tmp)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _run(tmp: str) -> int:
+    from sctools_tpu.data.shardstore import (ShardCorruptError,
+                                             ShardReadScheduler,
+                                             write_store)
+    from sctools_tpu.data.stream import _prefetch_iter, stream_stats
+    from sctools_tpu.data.synthetic import synthetic_counts
+    from sctools_tpu.utils.chaos import ChaosMonkey, Fault
+    from sctools_tpu.utils.telemetry import MetricsRegistry
+    from sctools_tpu.utils.vclock import VirtualClock
+
+    # 16 shards: the double buffer's warm-up stall (the first shard
+    # has nothing to hide behind) amortizes to ~1/16 of the wall, so
+    # the 0.8 floor has real margin
+    ds = synthetic_counts(4096, 256, density=0.08, n_clusters=4, seed=3)
+    store = write_store(ds.X, os.path.join(tmp, "store"),
+                        shard_rows=256, chunk_rows=64)
+    n_shards = store.n_shards
+
+    # -- 1. truncate -> quarantine (never delete) + journaled reason --
+    # on its OWN store copy: the quarantined file keeps the DAMAGED
+    # bytes as evidence, so this store is sacrificial
+    store1 = write_store(ds.X, os.path.join(tmp, "store1"),
+                         shard_rows=256, chunk_rows=64)
+    clk = VirtualClock()
+    monkey = ChaosMonkey([Fault("chunk-00010", "truncate_shard")],
+                         clock=clk)
+    jpath = os.path.join(tmp, "journal.jsonl")
+    sched = ShardReadScheduler(store1, clock=clk, chaos=monkey,
+                               on_corrupt="fail", journal=jpath)
+    failed = False
+    with sched:
+        try:
+            list(sched.iter_shards())
+        except ShardCorruptError as e:
+            failed = True
+            assert e.chunk == 10, e
+    assert failed, "truncated chunk was silently served"
+    qpath = os.path.join(store1.directory, "chunks", "quarantine",
+                         "chunk-00010.npz")
+    assert os.path.exists(qpath), "quarantine must keep the bytes"
+    assert os.path.exists(qpath + ".reason.json"), "no reason sidecar"
+    assert not os.path.exists(store1.chunk_path(10)), \
+        "corrupt chunk left in place"
+    events = [json.loads(line) for line in open(jpath)]
+    assert [e["event"] for e in events] == ["shard_quarantined"], events
+    assert events[0]["reason"], "quarantine reason must be journaled"
+    print(f"ingest_smoke: 1/3 truncate->quarantine OK "
+          f"(reason={events[0]['reason'][:40]!r}...)")
+
+    # -- 2. slow-disk chaos still meets the overlap floor -------------
+    clk2 = VirtualClock()
+    m2 = MetricsRegistry()
+    slow_s = 0.25  # per chunk; 4 chunks/shard => ~1s virtual per shard
+    monkey2 = ChaosMonkey([Fault("chunk-*", "slow_read", times=-1)],
+                          clock=clk2, slow_s=slow_s)
+    sched2 = ShardReadScheduler(store, clock=clk2, chaos=monkey2)
+    with sched2:
+        it = _prefetch_iter(lambda: sched2.iter_shards(), depth=2,
+                            clock=clk2, metrics=m2)
+        for _shard in it:
+            clk2.advance(3.0)  # consumer compute >> slowed read wall
+    c = m2.snapshot_compact()
+    overlap = c.get("stream.overlap_s", 0.0)
+    stall = c.get("stream.stall_s", 0.0)
+    eff = overlap / max(overlap + stall, 1e-9)
+    assert eff >= 0.8, (
+        f"slow-disk overlap efficiency {eff:.3f} < 0.8 floor "
+        f"(overlap={overlap:.2f}s stall={stall:.2f}s)")
+    print(f"ingest_smoke: 2/3 slow-disk overlap OK (efficiency "
+          f"{eff:.3f}, {n_shards} shards, {slow_s}s/chunk virtual)")
+
+    # -- 3. crashed stats pass resumes to identical results -----------
+    sched3 = ShardReadScheduler(store)
+    with sched3:
+        src = store.source(scheduler=sched3, prefetch=False)
+        want = stream_stats(src)
+
+        ck = os.path.join(tmp, "stats_ck.npz")
+        base_from = src.factory_from
+
+        def exploding_from(k):
+            def gen():
+                for i, s in enumerate(base_from(k), start=k):
+                    if i == 3:
+                        raise RuntimeError("smoke: crash at shard 3")
+                    yield s
+            return gen()
+
+        crashing = dataclasses.replace(
+            src, factory=lambda: exploding_from(0),
+            factory_from=exploding_from)
+        crashed = False
+        try:
+            stream_stats(crashing, checkpoint=ck)
+        except RuntimeError:
+            crashed = True
+        assert crashed and os.path.exists(ck), "no resume state"
+        got = stream_stats(src, checkpoint=ck)
+    for key in want:
+        np.testing.assert_allclose(got[key], want[key], rtol=1e-6,
+                                   err_msg=key)
+    assert not os.path.exists(ck), "resume state must self-delete"
+    assert clk.sleeps is not None  # virtual clocks only — no real waits
+    print("ingest_smoke: 3/3 crash->resume OK (identical results, "
+          "checkpoint consumed)")
+    print(f"ingest_smoke: ALL OK ({n_shards} shards, "
+          f"{store.n_chunks} chunks)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

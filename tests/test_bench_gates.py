@@ -177,3 +177,33 @@ def test_flops_and_bytes_take_max():
                       kind="TPU v5 lite")
     assert g["roofline_s"] > 1.0  # 1e15 / 197e12 ≈ 5.1 s
     assert g["implausible"] is True
+
+
+def test_ingest_overlap_efficiency_gate():
+    """The out-of-core ingest acceptance gate: a shard store 10x the
+    configured host-RAM budget must stream through the fused
+    streaming recipe at >= 0.8 overlap efficiency (measured by the
+    existing stream.overlap_s/stall_s counters in the sync-per-shard
+    regime, where the double buffer is the only overlap mechanism).
+    One re-measure is allowed before failing: this box has 2 cores
+    and CI neighbours."""
+    import jax
+
+    from tools.bench_ingest import run_ingest_bench
+
+    det = run_ingest_bench(jax)
+    if det["overlap_efficiency"] < 0.8:  # pragma: no cover - noisy box
+        det = run_ingest_bench(jax)
+    # the out-of-core contract itself: the store really was 10x the
+    # admitted in-flight budget, and every cell came out the far end
+    assert det["store_to_budget_ratio"] >= 10.0, det
+    assert det["cells_scored"] == det["n_cells"], det
+    assert det["overlap_efficiency"] >= 0.8, det
+    # the slow-disk chaos arm still completed the identical read plan
+    # (the delta is informational: straggler headroom of the buffer)
+    def total_reads(arm):
+        return sum(v for k, v in det[arm]["ingest_counters"].items()
+                   if k.startswith("ingest.reads"))
+
+    assert total_reads("slow_disk") == total_reads("clean") > 0, det
+    assert "slow_disk_efficiency_delta" in det

@@ -3,6 +3,8 @@ faults must inject the same failures at the same calls (the contract
 that makes every recovery test reproducible); nothing here sleeps a
 real clock."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -379,3 +381,56 @@ def test_reject_storm_backend_scoped():
     assert monkey.on_admission("t", backend="tpu") is True
     assert monkey.on_admission("t", backend=None) is False
     assert monkey.injected[-1]["backend"] == "tpu"
+
+
+def test_on_io_fires_only_on_io_channel(tmp_path):
+    """The three IO modes rule through on_io (pattern matches chunk
+    basenames); they NEVER fire on op calls, and op-channel modes
+    never fire on on_io."""
+    monkey = ChaosMonkey([
+        Fault("chunk-*", "io_error", times=-1),
+        Fault("chunk-*", "unavailable", times=-1),  # op channel only
+    ])
+    rule = monkey.on_io("chunk-00003")
+    assert rule == {"mode": "io_error", "slow_s": monkey.slow_s}
+    assert monkey.calls["chunk-00003@io"] == 1
+    assert monkey.injected[-1] == {"op": "chunk-00003", "call": 1,
+                                   "mode": "io_error", "backend": None}
+    # the io-mode fault must not leak onto the op-call channel
+    assert monkey._firing("chunk-00003", None, 1, channel="call").mode \
+        == "unavailable"
+
+
+def test_on_io_call_windows_per_chunk():
+    monkey = ChaosMonkey([Fault("chunk-00001", "io_error", on_call=2,
+                                times=1)])
+    assert monkey.on_io("chunk-00001") is None        # call 1
+    assert monkey.on_io("chunk-00000") is None        # other chunk
+    assert monkey.on_io("chunk-00001")["mode"] == "io_error"  # call 2
+    assert monkey.on_io("chunk-00001") is None        # window closed
+
+
+def test_on_io_truncate_damages_file_in_place(tmp_path):
+    p = str(tmp_path / "chunk-00000.npz")
+    payload = b"x" * 1000
+    with open(p, "wb") as f:
+        f.write(payload)
+    monkey = ChaosMonkey([Fault("chunk-00000", "truncate_shard")])
+    rule = monkey.on_io("chunk-00000", path=p)
+    assert rule["mode"] == "truncate_shard"
+    assert os.path.getsize(p) == 500  # truncated to half, not deleted
+    # a missing file never crashes the hook (already quarantined)
+    monkey2 = ChaosMonkey([Fault("gone", "truncate_shard")])
+    assert monkey2.on_io("gone", path=str(tmp_path / "gone.npz")) \
+        is not None
+
+
+def test_on_io_spec_round_trip_carries_slow_s():
+    monkey = ChaosMonkey([Fault("chunk-*", "slow_read", times=2)],
+                         slow_s=7.5)
+    assert monkey.on_io("chunk-00009")["slow_s"] == 7.5
+    clone = ChaosMonkey.from_spec(monkey.spec())
+    assert clone.slow_s == 7.5
+    assert clone.calls["chunk-00009@io"] == 1
+    assert clone.on_io("chunk-00009")["mode"] == "slow_read"  # call 2
+    assert clone.on_io("chunk-00009") is None                 # closed

@@ -507,6 +507,19 @@ def test_sct008_covers_scheduler(tmp_path):
     assert rule_ids(r) == ["SCT008"]
 
 
+def test_sct008_covers_shardstore(tmp_path):
+    """The ingest IO-failure ladder (per-read deadlines, retry
+    backoff, hedge SLOs) must ride the injectable clock — the whole
+    domain is tier-1 tested on one VirtualClock."""
+    r = lint_src(tmp_path, """
+        import time
+
+        def hedge_overdue(t0, slo):
+            return time.monotonic() - t0 > slo
+        """, only=["SCT008"], name="shardstore.py", prelude=False)
+    assert rule_ids(r) == ["SCT008"]
+
+
 def test_sct008_suppressible_per_line(tmp_path):
     r = lint_src(tmp_path, """
         import time

@@ -175,6 +175,53 @@ def test_graph_section_renders_when_graph_series_exist(
     assert "{layout=reordered}: 0.410" in out
 
 
+def test_ingest_section_renders_funnel_and_wait_digest(
+        tmp_path, capsys):
+    """metrics.json with ``ingest.*`` series gets the ingest section:
+    the read funnel (every read terminal in exactly one outcome),
+    retry/hedge counts, quarantine warning, and the read-wait
+    digest."""
+    journal = (
+        '{"event": "run_start", "n_steps": 1, "backend": "tpu", '
+        '"steps": [{"index": 0, "name": "stream.stats", '
+        '"fingerprint": "f"}]}\n'
+        '{"event": "shard_quarantined", "shard": 2, "chunk": 9, '
+        '"path": "q/chunk-00009.npz", "reason": "digest mismatch", '
+        '"policy": "skip"}\n'
+        '{"event": "run_completed", "degraded": false}\n')
+    (tmp_path / "journal.jsonl").write_text(journal)
+    (tmp_path / "metrics.json").write_text(json.dumps({
+        "schema": 1, "metrics": {"counters": {
+            "ingest.reads{outcome=served}": 12.0,
+            "ingest.reads{outcome=retried}": 2.0,
+            "ingest.reads{outcome=hedged}": 1.0,
+            "ingest.retries": 3.0, "ingest.hedges": 1.0,
+            "ingest.quarantines": 1.0, "ingest.bytes": 1048576.0,
+        }, "gauges": {}, "histograms": {
+            "ingest.read_wait_s": {"count": 15, "sum": 7.5,
+                                   "max": 2.25, "buckets": {}},
+        }}}))
+    assert main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "-- ingest --" in out
+    assert ("read funnel: 16 shard read(s) -> 12 served, 2 retried, "
+            "1 hedged, 1 quarantined") in out
+    assert "transient retries: 3" in out
+    assert "straggler hedges: 1" in out
+    assert "quarantined chunks: 1" in out
+    assert "decoded bytes served: " in out
+    assert "read wait: n=15 mean=0.5000s max=2.25s" in out
+
+
+def test_ingest_section_absent_without_ingest_series():
+    from tools.sctreport import ingest_section
+
+    assert ingest_section(None) == []
+    assert ingest_section({"metrics": {"counters": {"op.calls": 1.0},
+                                       "gauges": {},
+                                       "histograms": {}}}) == []
+
+
 def test_digest_splits_runs_and_tracks_statuses():
     events, bad = load_journal(os.path.join(FIXTURE, "journal.jsonl"))
     assert bad == 0

@@ -578,3 +578,176 @@ def test_stream_pca_row_chunked_matches_whole_shard(counts, src):
     ib, _ = knn_numpy(b.astype(np.float64), b.astype(np.float64), k=10,
                       metric="euclidean")
     assert recall_at_k(ia, ib) > 0.99
+
+
+def test_stream_stats_corrupt_checkpoint_quarantined_falls_back(
+        counts, src, tmp_path):
+    """ISSUE 10 satellite: the stats resume file now rides the
+    checkpoint integrity layer.  A corrupt newest generation is
+    QUARANTINED (moved with a .reason.json sidecar, never deleted)
+    and resume falls back deterministically to the .prev generation
+    — one shard earlier — finishing with correct results."""
+    import dataclasses
+
+    ck = str(tmp_path / "stats_ck.npz")
+    want = stream_stats(src)
+
+    reads = []
+    base_from = src.factory_from
+
+    def counting_from(k):
+        def gen():
+            for i, s in enumerate(base_from(k), start=k):
+                reads.append(i)
+                yield s
+        return gen()
+
+    def exploding_from(k):
+        def gen():
+            for i, s in enumerate(base_from(k), start=k):
+                if i == 3:
+                    raise RuntimeError("simulated crash at shard 3")
+                yield s
+        return gen()
+
+    crashing = dataclasses.replace(
+        src, factory=lambda: exploding_from(0),
+        factory_from=exploding_from)
+    with pytest.raises(RuntimeError, match="shard 3"):
+        stream_stats(crashing, checkpoint=ck)
+    assert os.path.exists(ck) and os.path.exists(ck + ".prev")
+
+    # bit-rot the newest generation: resume must NOT trust it
+    blob = bytearray(open(ck, "rb").read())
+    for i in range(0, len(blob), max(len(blob) // 16, 1)):
+        blob[i] ^= 0xFF
+    open(ck, "wb").write(bytes(blob))
+
+    counted = dataclasses.replace(
+        src, factory=lambda: counting_from(0),
+        factory_from=counting_from)
+    with pytest.warns(RuntimeWarning, match="quarantined"):
+        got = stream_stats(counted, checkpoint=ck)
+    # .prev held next_shard=2: resumed ONE shard earlier, not at 0
+    assert reads == [2, 3, 4]
+    for key in want:
+        np.testing.assert_allclose(got[key], want[key], rtol=1e-6,
+                                   err_msg=key)
+    # evidence preserved beside the data
+    qdir = str(tmp_path / "quarantine")
+    assert os.path.exists(os.path.join(qdir, "stats_ck.npz"))
+    assert os.path.exists(os.path.join(qdir,
+                                       "stats_ck.npz.reason.json"))
+    # both generations consumed on success
+    assert not os.path.exists(ck) and not os.path.exists(ck + ".prev")
+
+
+def test_stream_stats_checkpoint_carries_integrity_keys(counts, src,
+                                                        tmp_path):
+    import dataclasses
+
+    from sctools_tpu.utils.checkpoint import verify_checkpoint
+
+    ck = str(tmp_path / "stats_ck.npz")
+    base_from = src.factory_from
+
+    def exploding_from(k):
+        def gen():
+            for i, s in enumerate(base_from(k), start=k):
+                if i == 1:
+                    raise RuntimeError("crash")
+                yield s
+        return gen()
+
+    crashing = dataclasses.replace(
+        src, factory=lambda: exploding_from(0),
+        factory_from=exploding_from)
+    with pytest.raises(RuntimeError):
+        stream_stats(crashing, checkpoint=ck)
+    chk = verify_checkpoint(ck)
+    assert chk["ok"] and chk["reason"] is None  # digest, not legacy
+    assert chk["fingerprint"] == "stream_stats-v1"
+
+
+def test_prefetch_prepare_transient_retries_in_worker():
+    """Classified-transient prepare failures get bounded IN-WORKER
+    retries on the injectable clock (zero real sleeps) — the stream
+    survives an IO blip without restarting the pass."""
+    from sctools_tpu.data.stream import _prefetch_iter
+    from sctools_tpu.utils.failsafe import TransientDeviceError
+    from sctools_tpu.utils.telemetry import MetricsRegistry
+    from sctools_tpu.utils.vclock import VirtualClock
+
+    clk = VirtualClock()
+    m = MetricsRegistry()
+    blips = []
+
+    def gen():
+        yield from range(3)
+
+    def prepare(x):
+        if x == 1 and blips.count(1) < 2:
+            blips.append(1)
+            raise TransientDeviceError("UNAVAILABLE: disk blip")
+        return x
+
+    out = list(_prefetch_iter(gen, prepare=prepare, clock=clk,
+                              metrics=m))
+    assert out == [0, 1, 2]
+    assert m.snapshot_compact()["ingest.retries"] == 2
+    assert len(clk.sleeps) >= 2  # backoff scheduled, never slept
+
+
+def test_prefetch_transient_retries_exhaust_with_index():
+    from sctools_tpu.data.stream import _prefetch_iter
+    from sctools_tpu.utils.failsafe import TransientDeviceError
+    from sctools_tpu.utils.telemetry import MetricsRegistry
+    from sctools_tpu.utils.vclock import VirtualClock
+
+    def gen():
+        yield from range(2)
+
+    def prepare(x):
+        raise TransientDeviceError("UNAVAILABLE forever")
+
+    with pytest.raises(TransientDeviceError) as ei:
+        list(_prefetch_iter(gen, prepare=prepare, clock=VirtualClock(),
+                            metrics=MetricsRegistry(),
+                            prepare_retries=2))
+    assert ei.value.shard_index == 0
+
+
+def test_prefetch_deterministic_error_fails_fast_with_index():
+    """Deterministic prepare errors surface immediately — no retry
+    burn — with the failing shard's index attached."""
+    from sctools_tpu.data.stream import _prefetch_iter
+    from sctools_tpu.utils.telemetry import MetricsRegistry
+
+    m = MetricsRegistry()
+
+    def gen():
+        yield from range(3)
+
+    def prepare(x):
+        if x == 1:
+            raise ValueError("bad shard bytes")
+        return x
+
+    it = _prefetch_iter(gen, prepare=prepare, metrics=m)
+    assert next(it) == 0
+    with pytest.raises(ValueError, match="bad shard") as ei:
+        list(it)
+    assert ei.value.shard_index == 1
+    assert m.snapshot_compact().get("ingest.retries", 0) == 0
+
+
+def test_prefetch_generator_error_tagged():
+    from sctools_tpu.data.stream import _prefetch_iter
+
+    def bad():
+        yield "a"
+        raise RuntimeError("reader died")
+
+    with pytest.raises(RuntimeError, match="reader died") as ei:
+        list(_prefetch_iter(bad))
+    assert ei.value.shard_index == 1

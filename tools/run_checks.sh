@@ -50,7 +50,16 @@
 #                     the admission-control layer's contract
 #                     (docs/ARCHITECTURE.md "Admission control &
 #                     scheduling")
-#   9. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
+#   9. chaos-ingest   python tests/ingest_smoke.py — the IO-failure
+#                     domain's contract on a temp-dir shard store:
+#                     a truncated chunk is quarantined (never
+#                     deleted) with a journaled reason, a slow-disk
+#                     chaos run still meets the prefetch overlap
+#                     floor, and a crashed stats pass resumes to
+#                     identical results — all on one VirtualClock,
+#                     zero real sleeps (docs/ARCHITECTURE.md
+#                     "Out-of-core ingest")
+#  10. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
 
 set -u -o pipefail
 
@@ -86,7 +95,8 @@ bare=$(grep -nE '\btime\.(sleep|monotonic)\b' \
         sctools_tpu/utils/checkpoint.py \
         sctools_tpu/utils/chaos.py \
         sctools_tpu/utils/telemetry.py \
-        sctools_tpu/data/stream.py 2>/dev/null \
+        sctools_tpu/data/stream.py \
+        sctools_tpu/data/shardstore.py 2>/dev/null \
         | grep -v 'sctlint: disable=SCT008' || true)
 if [ -n "$bare" ]; then
     echo "bare time.sleep/time.monotonic in resilience modules" \
@@ -259,6 +269,14 @@ if JAX_PLATFORMS=cpu python tests/soak_smoke.py; then
     :
 else
     echo "scheduler-soak stage FAILED (rc=$?)"
+    fail=1
+fi
+
+stage "chaos-ingest (truncate->quarantine, slow-disk overlap, resume)"
+if JAX_PLATFORMS=cpu python tests/ingest_smoke.py; then
+    :
+else
+    echo "chaos-ingest stage FAILED (rc=$?)"
     fail=1
 fi
 

@@ -27,9 +27,12 @@ from ..jaxutil import dotted, module_info
 # with a VirtualClock-timed fake packer and zero real sleeps;
 # scheduler.py for its queue waits / deadline estimates / EWMA run
 # walls — the chaos soak drives hundreds of submissions on one
-# VirtualClock.
+# VirtualClock; shardstore.py for the ingest IO-failure ladder
+# (per-read deadlines, retry backoff, hedge SLOs, chaos-slow reads) —
+# the whole domain is tier-1 tested on one VirtualClock.
 _PATH_RE = re.compile(
-    r"(^|/)(runner|failsafe|checkpoint|chaos|stream|scheduler)\.py$")
+    r"(^|/)(runner|failsafe|checkpoint|chaos|stream|scheduler"
+    r"|shardstore)\.py$")
 
 _BANNED = {"time.sleep", "time.monotonic"}
 

@@ -297,6 +297,11 @@ def render(run_dir: str, runs: list[dict], trace_d: dict | None,
         add("")
         L.extend(graph)
 
+    ingest = ingest_section(metrics)
+    if ingest:
+        add("")
+        L.extend(ingest)
+
     add("")
     add("-- metrics snapshot --")
     if metrics is None:
@@ -418,6 +423,57 @@ def graph_section(metrics) -> list[str]:
     for k, v in sorted(dens.items()):
         labels = k[k.find("{"):] if "{" in k else ""
         L.append(f"  tile density {labels}: {v:.3f}")
+    return L
+
+
+def ingest_section(metrics) -> list[str]:
+    """The out-of-core ingest digest, rendered only when the run
+    recorded ``ingest.*`` series (a run that never streamed a shard
+    store has no section).  Shows the read funnel — every terminated
+    shard read lands in exactly one of served / retried-then-served /
+    hedged / quarantined — plus the retry/hedge counts, decoded
+    bytes, and the consumer read-wait digest: the IO-failure ladder's
+    story at a glance (docs/ARCHITECTURE.md "Out-of-core ingest")."""
+    if metrics is None:
+        return []
+    m = metrics.get("metrics", metrics)
+    counters = {k: v for k, v in m.get("counters", {}).items()
+                if k.startswith("ingest.")}
+    hists = {k: h for k, h in m.get("histograms", {}).items()
+             if k.startswith("ingest.")}
+    if not counters and not hists:
+        return []
+    L = ["-- ingest --"]
+    outcomes = {}
+    for k, v in counters.items():
+        name, labels = _parse_labels(k)
+        if name == "ingest.reads":
+            outcomes[labels.get("outcome", "?")] = v
+    quarantined = counters.get("ingest.quarantines", 0.0)
+    total = sum(outcomes.values()) + quarantined
+    if total:
+        parts = [f"{outcomes.get(o, 0.0):g} {o}"
+                 for o in ("served", "retried", "hedged")]
+        L.append(f"  read funnel: {total:g} shard read(s) -> "
+                 + ", ".join(parts)
+                 + f", {quarantined:g} quarantined")
+    if counters.get("ingest.retries"):
+        L.append(f"  transient retries: {counters['ingest.retries']:g}")
+    if counters.get("ingest.hedges"):
+        L.append(f"  straggler hedges: {counters['ingest.hedges']:g}")
+    if quarantined:
+        L.append(f"  (!) quarantined chunks: {quarantined:g} — bytes "
+                 f"preserved under quarantine/ with .reason.json "
+                 f"sidecars")
+    if counters.get("ingest.bytes"):
+        L.append(f"  decoded bytes served: "
+                 f"{counters['ingest.bytes']:g}")
+    for k, h in sorted(hists.items()):
+        if k.startswith("ingest.read_wait_s"):
+            n = h.get("count", 0)
+            mean = (h.get("sum", 0.0) / n) if n else 0.0
+            L.append(f"  read wait: n={n} mean={mean:.4f}s "
+                     f"max={h.get('max', 0.0):g}s")
     return L
 
 
