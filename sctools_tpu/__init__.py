@@ -41,6 +41,8 @@ from .recipes import recipe_pipeline, run_recipe, submit_recipe
 from .registry import Pipeline, Transform, apply, backends, names, register
 from .runner import ResilientRunner, RetryPolicy
 from .scheduler import RunRejected, RunScheduler, RunShed, TenantQuota
+from .federation import (FederatedBreakerRegistry, FederatedRunError,
+                         FederationSupervisor, TicketHandle)
 from .compat import experimental, external, pp, tl  # scanpy-style namespaces
 from . import pl  # scanpy-style plotting namespace (host-side)
 from . import datasets  # offline sc.datasets subset

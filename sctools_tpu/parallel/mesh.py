@@ -82,9 +82,131 @@ def mesh_signature(mesh: Mesh) -> tuple:
             tuple(int(d.id) for d in mesh.devices.flat))
 
 
+#: bring-up failure signatures that are TRANSIENT at the transport
+#: level: the coordinator's port still in TIME_WAIT from a previous
+#: incarnation, workers racing the coordinator's startup (connect
+#: refused / barrier deadline), and the usual socket noise in
+#: between.  A bounded retry gives the port time to free and the
+#: coordinator time to come up; anything else recurs identically and
+#: must surface immediately.
+_BRINGUP_TRANSIENT_MARKERS = (
+    "address already in use",
+    "address in use",
+    "failed to bind",
+    "bind failed",
+    "deadline exceeded",
+    "deadline_exceeded",
+    "timed out",
+    "timeout",
+    "unavailable",
+    "failed to connect",
+    "connection refused",
+    "connection reset",
+    "connection closed",
+    "socket closed",
+    "broken pipe",
+)
+
+
+def classify_bringup_error(exc: BaseException) -> str:
+    """``"transient"`` when a distributed bring-up failure is worth a
+    bounded retry (port in TIME_WAIT, coordinator not up yet, barrier
+    timeout), ``"deterministic"`` otherwise (misconfig recurs
+    identically — retrying only hides the actionable message)."""
+    msg = f"{type(exc).__name__}: {exc}".lower()
+    if any(m in msg for m in _BRINGUP_TRANSIENT_MARKERS):
+        return "transient"
+    return "deterministic"
+
+
+#: hosts a coordinator bind-probe can meaningfully test from this
+#: process (process 0 binds the coordinator locally; probing a
+#: remote host's NIC from here would always fail)
+_LOCAL_BIND_HOSTS = ("127.0.0.1", "localhost", "0.0.0.0", "::1", "")
+
+
+def _await_coordinator_port(host: str, port: int, attempts: int,
+                            retry_delay_s: float, clock) -> None:
+    """Bounded-retry bind probe of the coordinator port BEFORE jax
+    touches it.  This is not an optimization: jaxlib's coordinator
+    service SEGFAULTS the whole process when its gRPC listener cannot
+    bind (observed on jaxlib 0.4.36: rc=-11, "Address already in
+    use" on stderr) — there is no Python exception to classify after
+    the fact, so the port-in-use case must be ruled out up front.  A
+    port still in TIME_WAIT from a previous coordinator incarnation
+    frees within seconds, hence the retry; a port held by a LIVE
+    listener never frees, hence the bounded attempts + actionable
+    error."""
+    import socket
+
+    family = (socket.AF_INET6 if ":" in (host or "")
+              else socket.AF_INET)
+    last = None
+    for attempt in range(1, attempts + 1):
+        try:
+            with socket.socket(family) as s:
+                # match gRPC's own bind semantics: SO_REUSEADDR lets
+                # a TIME_WAIT port pass (gRPC would bind it too) but
+                # an actively-listening holder still refuses
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind((host or "127.0.0.1", port))
+            return
+        except OSError as e:
+            last = e
+        if attempt < attempts:
+            clock.sleep(retry_delay_s * attempt)
+    raise RuntimeError(
+        f"init_distributed: coordinator port {host or '127.0.0.1'}:"
+        f"{port} is still in use after {attempts} bind attempt(s) "
+        f"(last: {type(last).__name__}: {last}).  jax's coordinator "
+        "service CRASHES the process on a bind failure, so the "
+        "bring-up is refused here instead — pick a free port, or "
+        "raise attempts=/retry_delay_s= to wait out a TIME_WAIT "
+        "holder.") from last
+
+
+def _validate_bringup_args(coordinator_address, num_processes,
+                           process_id) -> None:
+    """Actionable misconfig errors BEFORE touching jax.distributed —
+    a bad argument must fail with advice, not a gRPC hang or an
+    opaque coordinator-side crash on a real pod."""
+    if (num_processes is None) != (process_id is None):
+        raise ValueError(
+            "init_distributed: pass num_processes and process_id "
+            "TOGETHER (got num_processes="
+            f"{num_processes!r}, process_id={process_id!r}) — every "
+            "process must agree on the cluster size, and a partial "
+            "spec makes jax fall back to cluster auto-detection for "
+            "the missing half")
+    if num_processes is not None:
+        if num_processes < 1:
+            raise ValueError(
+                f"init_distributed: num_processes={num_processes} "
+                "must be >= 1")
+        if not (0 <= process_id < num_processes):
+            raise ValueError(
+                f"init_distributed: process_id={process_id} out of "
+                f"range for num_processes={num_processes} — ids are "
+                "0-based and every process needs a distinct one "
+                f"(valid: 0..{num_processes - 1})")
+    if coordinator_address is not None:
+        host, sep, port = str(coordinator_address).rpartition(":")
+        if not sep or not host or not port.isdigit():
+            raise ValueError(
+                "init_distributed: coordinator_address="
+                f"{coordinator_address!r} is not 'host:port' — every "
+                "process passes the SAME address, the one process "
+                "whose process_id is 0 binds it (e.g. "
+                "'10.0.0.1:8476')")
+
+
 def init_distributed(coordinator_address: str | None = None,
                      num_processes: int | None = None,
-                     process_id: int | None = None) -> dict:
+                     process_id: int | None = None, *,
+                     attempts: int = 3,
+                     retry_delay_s: float = 2.0,
+                     timeout_s: float | None = None,
+                     clock=None) -> dict:
     """Multi-host SPMD bring-up (the reference's MPI_Init analogue).
 
     Wraps ``jax.distributed.initialize``: on managed TPU pods every
@@ -97,11 +219,44 @@ def init_distributed(coordinator_address: str | None = None,
     re-raises: a failed bring-up on a real pod must never silently
     fall back to num_processes=1 per host (each host would run the
     whole job independently and produce duplicated results).
+
+    The bring-up is HARDENED three ways (the federation tier respawns
+    worker processes, so re-joins against a half-torn-down coordinator
+    are the common case, not the exception):
+
+    * misconfig (mismatched ``process_id``/``num_processes``, a
+      malformed address) raises an ACTIONABLE ``ValueError`` before
+      jax is touched — never a gRPC hang;
+    * the coordinator-binding process (``process_id == 0`` with a
+      loopback/wildcard address) bind-probes its port first with the
+      same bounded retry — jaxlib's coordinator service segfaults the
+      process outright on a bind failure, so port-in-use must be
+      ruled out BEFORE jax touches the socket;
+    * transient bring-up failures (:func:`classify_bringup_error`) —
+      the coordinator's port still in TIME_WAIT, workers racing the
+      coordinator's startup — are retried up to ``attempts`` times
+      with a linear backoff on the injectable ``clock``
+      (``utils/vclock.py``; partial jax state is shut down between
+      attempts), then surface as a ``RuntimeError`` naming the
+      attempt count;
+    * ``timeout_s`` bounds how long each attempt's coordinator
+      handshake may block (jax's ``initialization_timeout``, default
+      300 s) so a dead coordinator is a classified failure, not a
+      five-minute hang.
+
     Returns {"process_id", "num_processes", "local_devices",
     "global_devices"}.
     """
     import os
 
+    from ..utils.vclock import SYSTEM_CLOCK
+
+    clock = clock if clock is not None else SYSTEM_CLOCK
+    if attempts < 1:
+        raise ValueError(f"init_distributed: attempts={attempts} "
+                         "must be >= 1")
+    _validate_bringup_args(coordinator_address, num_processes,
+                           process_id)
     bare_call = (coordinator_address is None and num_processes is None
                  and process_id is None)
     # pod-environment hints: when any of these exist, a failed bring-up
@@ -112,23 +267,67 @@ def init_distributed(coordinator_address: str | None = None,
         "MEGASCALE_COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
         "CLOUD_TPU_TASK_ID")) or (
         len(os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",")) > 1)
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes, process_id=process_id)
-    except RuntimeError as e:
-        benign = ("only be called once" in str(e)  # repeat call
-                  # bare late call on a plain single-process host
-                  # (backend already up, no pod to join)
-                  or (bare_call and not pod_env
-                      and "before any JAX" in str(e)))
-        if not benign:
-            raise
-    except ValueError as e:
-        # bare call, cluster auto-detection found nothing to join
-        if not (bare_call and not pod_env
-                and "coordinator_address" in str(e)):
-            raise
+    kw = {}
+    if timeout_s is not None:
+        kw["initialization_timeout"] = int(max(1, timeout_s))
+    if (coordinator_address is not None and process_id == 0):
+        # we are the process that BINDS the coordinator: rule out the
+        # port-in-use segfault before jax can hit it (probe only
+        # loopback/wildcard hosts — a pod's NIC address is bound by
+        # the runtime itself and cannot be probed generically)
+        host, _, port = str(coordinator_address).rpartition(":")
+        if host in _LOCAL_BIND_HOSTS:
+            _await_coordinator_port(host, int(port), attempts,
+                                    retry_delay_s, clock)
+    last_err: BaseException | None = None
+    for attempt in range(1, attempts + 1):
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id,
+                **kw)
+            last_err = None
+            break
+        except RuntimeError as e:
+            benign = ("only be called once" in str(e)  # repeat call
+                      # bare late call on a plain single-process host
+                      # (backend already up, no pod to join)
+                      or (bare_call and not pod_env
+                          and "before any JAX" in str(e)))
+            if benign:
+                last_err = None
+                break
+            last_err = e
+        except ValueError as e:
+            # bare call, cluster auto-detection found nothing to join
+            if bare_call and not pod_env \
+                    and "coordinator_address" in str(e):
+                last_err = None
+                break
+            last_err = e
+        if classify_bringup_error(last_err) != "transient" \
+                or attempt >= attempts:
+            break
+        # clear any partially-initialized distributed state so the
+        # retry starts clean (a half-connected client would make the
+        # next initialize raise "only be called once")
+        try:
+            jax.distributed.shutdown()
+        except Exception as cleanup_err:  # noqa: BLE001 — nothing was
+            # up to tear down; the retry's own failure is the signal
+            del cleanup_err
+        clock.sleep(retry_delay_s * attempt)
+    if last_err is not None:
+        if classify_bringup_error(last_err) == "transient":
+            raise RuntimeError(
+                f"init_distributed: bring-up failed {attempts} "
+                f"time(s) on a transient transport error (last: "
+                f"{type(last_err).__name__}: {last_err}).  The "
+                "coordinator port may be held by another process — "
+                "pick a free port, or raise attempts=/retry_delay_s= "
+                "if the coordinator is slow to start."
+            ) from last_err
+        raise last_err
     return {
         "process_id": jax.process_index(),
         "num_processes": jax.process_count(),
@@ -137,17 +336,94 @@ def init_distributed(coordinator_address: str | None = None,
     }
 
 
-def make_mesh(n_devices: int | None = None, axis_name: str = CELL_AXIS) -> Mesh:
+def coordination_sum(value: float, tag: str,
+                     timeout_s: float = 60.0) -> float:
+    """Sum one host-local float across every process of the cluster
+    through the coordination service's key-value store — the same DCN
+    control plane :func:`init_distributed` established, with NO
+    device collective involved.
+
+    This is the portable cross-process reduction for control-plane
+    scalars (row counts, checksums, bench gates): XLA backends that
+    cannot run cross-process computations (jax <= 0.4.x CPU raises
+    "Multiprocess computations aren't implemented") still carry it,
+    because only gRPC key-value traffic moves.  ``tag`` must be
+    unique per reduction (the KV namespace is cluster-global and
+    write-once per key).  Single-process (no distributed client):
+    returns ``value`` unchanged."""
+    from jax._src import distributed as _dist
+
+    client = getattr(_dist.global_state, "client", None)
+    n = jax.process_count()
+    if client is None or n <= 1:
+        return float(value)
+    pid = jax.process_index()
+    client.key_value_set(f"sctools/{tag}/{pid}", repr(float(value)))
+    total = 0.0
+    for i in range(n):
+        total += float(client.blocking_key_value_get(
+            f"sctools/{tag}/{i}", int(timeout_s * 1000)))
+    return total
+
+
+def make_mesh(n_devices: int | None = None, axis_name: str = CELL_AXIS,
+              devices=None) -> Mesh:
     """1-D mesh over the first ``n_devices`` GLOBAL devices (all by
-    default — after :func:`init_distributed` that spans every host)."""
-    devs = jax.devices()
-    if n_devices is not None:
-        if n_devices > len(devs):
+    default — after :func:`init_distributed` that spans every host).
+    ``devices=`` instead takes an EXPLICIT device list — the lost-host
+    degrade rung re-plans onto the surviving processes' devices, which
+    are not a prefix of ``jax.devices()``."""
+    if devices is not None:
+        if n_devices is not None:
             raise ValueError(
-                f"requested {n_devices} devices, have {len(devs)}"
-            )
-        devs = devs[:n_devices]
+                "make_mesh: pass n_devices or devices=, not both")
+        devs = list(devices)
+        if not devs:
+            raise ValueError("make_mesh: devices= is empty")
+    else:
+        devs = jax.devices()
+        if n_devices is not None:
+            if n_devices > len(devs):
+                raise ValueError(
+                    f"requested {n_devices} devices, have {len(devs)}"
+                )
+            devs = devs[:n_devices]
     return Mesh(np.array(devs), (axis_name,))
+
+
+def mesh_host_groups(mesh: Mesh) -> list[list]:
+    """The mesh's devices grouped by owning HOST (process), in mesh
+    order — the granularity the lost-host degrade rung drops at.
+
+    Grouping is by ``device.process_index`` (on a real multi-process
+    mesh each host contributes one group).  The single-process
+    host-platform harness (``--xla_force_host_platform_device_count``)
+    reports every virtual device as process 0, so the env override
+    ``SCTOOLS_MESH_HOSTS=N`` partitions the device list into N equal
+    contiguous groups instead — that is what lets CI drive the
+    host_lost rung on one box; it is ignored when the mesh already
+    spans multiple real processes."""
+    import os
+
+    devs = list(mesh.devices.flat)
+    by_proc: dict[int, list] = {}
+    for d in devs:
+        by_proc.setdefault(int(getattr(d, "process_index", 0)),
+                           []).append(d)
+    if len(by_proc) > 1:
+        return [by_proc[p] for p in sorted(by_proc)]
+    fake = os.environ.get("SCTOOLS_MESH_HOSTS", "")
+    if (fake.isdigit() and int(fake) > 1
+            and len(devs) % int(fake) == 0
+            and len(devs) == len(jax.devices())):
+        # only the FULL device set fake-splits: a mesh already shrunk
+        # by a host_lost rung is "one surviving host" (further
+        # degrades halve, exactly as a real single-host remainder
+        # would)
+        n = int(fake)
+        per = len(devs) // n
+        return [devs[i * per:(i + 1) * per] for i in range(n)]
+    return [devs]
 
 
 def cell_sharding(mesh: Mesh, ndim: int = 2,

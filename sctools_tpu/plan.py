@@ -330,13 +330,21 @@ class FusedTransform:
             [t.with_backend(backend) for t in self.members],
             backend, self.name, self.params)
 
-    def replan(self, n_devices: int | None):
+    def replan(self, n_devices: int | None, devices=None):
         """The same member chain planned for ``n_devices`` (``None``
-        or ``<= 1`` → the plain single-device fused stage).  Never
-        donates: the caller is the runner's degrade ladder, and a
-        re-planned attempt must be able to replay its input."""
-        mesh = (_pm().make_mesh(n_devices)
-                if n_devices is not None and n_devices > 1 else None)
+        or ``<= 1`` → the plain single-device fused stage), or — the
+        lost-host rung — for an EXPLICIT surviving-device list
+        (``devices=``; not a prefix of ``jax.devices()``, so a count
+        cannot express it).  Never donates: the caller is the
+        runner's degrade ladder, and a re-planned attempt must be
+        able to replay its input."""
+        if devices is not None:
+            mesh = (_pm().make_mesh(devices=list(devices))
+                    if len(devices) > 1 else None)
+        else:
+            mesh = (_pm().make_mesh(n_devices)
+                    if n_devices is not None and n_devices > 1
+                    else None)
         return FusedTransform(self.members, self.backend,
                               metrics=self.metrics, donate=False,
                               mesh=mesh)
@@ -599,10 +607,16 @@ class ShardedCollective:
         return Transform(self.member.name, backend=backend,
                          **self.member.params)
 
-    def replan(self, n_devices: int | None):
+    def replan(self, n_devices: int | None, devices=None):
         """The same collective op planned for ``n_devices`` devices
         (``None``/``<=1`` → a 1-device mesh: the op's collective body
-        still runs, with every collective a self-edge)."""
+        still runs, with every collective a self-edge), or for an
+        explicit surviving-device list (``devices=`` — the lost-host
+        rung)."""
+        if devices is not None:
+            return ShardedCollective(
+                self.member, _pm().make_mesh(devices=list(devices)),
+                self.metrics)
         n = n_devices if n_devices is not None and n_devices >= 1 else 1
         return ShardedCollective(self.member, _pm().make_mesh(n),
                                  self.metrics)

@@ -1091,8 +1091,13 @@ class ResilientRunner:
         return True
 
     def _replan_fewer_devices(self, steps, i: int, t):
-        """The sharded-stage degrade rung: re-plan step ``i`` on half
-        the devices (→ single-device fused when the mesh bottoms out).
+        """The sharded-stage degrade rungs.  A mesh spanning MULTIPLE
+        hosts first drops a whole host's device group and re-plans on
+        the survivors (``reason="host_lost"`` — on a pod, a device
+        failure usually means the HOST behind it is gone, and the
+        surviving processes' devices are the ones still answering);
+        a single-host mesh halves its devices (``reason=
+        "mesh_shrink"``, → single-device fused when it bottoms out).
         Returns the re-planned step (already swapped into ``steps``,
         fingerprints for ``i..`` refreshed — they embed the mesh
         signature, so checkpoints from the larger mesh never match
@@ -1105,8 +1110,20 @@ class ResilientRunner:
         n_dev = int(mesh.devices.size)
         if n_dev <= 1:
             return None
-        target = n_dev // 2 if n_dev // 2 > 1 else None
-        new_t = replan(target)
+        reason, kw = "mesh_shrink", {}
+        from_hosts = to_hosts = None
+        from .parallel.mesh import mesh_host_groups
+
+        groups = mesh_host_groups(mesh)
+        if len(groups) > 1:
+            survivors = self._surviving_host_devices(groups)
+            reason = "host_lost"
+            from_hosts, to_hosts = len(groups), len(groups) - 1
+            new_t = replan(None, devices=survivors)
+            kw = {"from_hosts": from_hosts, "to_hosts": to_hosts}
+        else:
+            target = n_dev // 2 if n_dev // 2 > 1 else None
+            new_t = replan(target)
         steps[i] = new_t
         for j in range(i, len(steps)):
             # the prefix chain embeds step i's mesh signature — every
@@ -1118,15 +1135,38 @@ class ResilientRunner:
         warnings.warn(
             f"ResilientRunner: sharded step {i} ({t.name!r}) exhausted "
             f"its retry budget on {n_dev} devices — RE-PLANNING on "
-            f"{to_dev} device(s) before ruling on a backend fallback.",
+            f"{to_dev} device(s)"
+            + (f" across {to_hosts} surviving host(s)"
+               if reason == "host_lost" else "")
+            + " before ruling on a backend fallback.",
             RuntimeWarning, stacklevel=3)
         self.journal.write(
-            "degrade", step=i, reason="mesh_shrink",
+            "degrade", step=i, reason=reason,
             from_devices=n_dev, to_devices=to_dev,
-            fingerprint=self.report.steps[i].fingerprint)
-        self.metrics.counter("runner.degrades",
-                             reason="mesh_shrink").inc()
+            fingerprint=self.report.steps[i].fingerprint, **kw)
+        self.metrics.counter("runner.degrades", reason=reason).inc()
         return new_t
+
+    @staticmethod
+    def _surviving_host_devices(groups) -> list:
+        """Which devices survive a lost-host ruling: drop the LAST
+        host group that holds no local-process device — the local
+        host is provably alive (this code is executing on it), and
+        without failure attribution the far end of the mesh is the
+        best guess for the lost one.  When every group is local (the
+        single-process harness's fake grouping) the last group drops."""
+        import jax
+
+        local_pi = jax.process_index()
+        drop = None
+        for g in reversed(groups):
+            if all(int(getattr(d, "process_index", 0)) != local_pi
+                   for d in g):
+                drop = g
+                break
+        if drop is None:
+            drop = groups[-1]
+        return [d for g in groups if g is not drop for d in g]
 
     # ------------------------------------------------------------------
     @staticmethod

@@ -36,18 +36,22 @@ from .vclock import SYSTEM_CLOCK
 
 MODES = ("unavailable", "hang", "wedge", "corrupt",
          "corrupt_checkpoint", "crash", "kill", "reject_storm",
-         "slow_read", "truncate_shard", "io_error")
+         "slow_read", "truncate_shard", "io_error",
+         "kill_worker", "lease_wedge")
 
 # which hook channel each mode fires on: most modes wrap the op CALL;
 # corrupt_checkpoint fires through the runner's on_checkpoint hook,
 # reject_storm through the scheduler's on_admission hook (where the
 # fault's ``op`` pattern matches TENANT names, not transform names),
-# and the three IO modes through the shard-read scheduler's on_io
-# hook (pattern matches CHUNK file basenames, e.g. "chunk-00002")
+# the three IO modes through the shard-read scheduler's on_io hook
+# (pattern matches CHUNK file basenames, e.g. "chunk-00002"), and the
+# two WORKER modes through the federation supervisor's on_worker hook
+# (pattern matches WORKER names, e.g. "w0" / "w*")
 _MODE_CHANNEL = {"corrupt_checkpoint": "checkpoint",
                  "reject_storm": "admission",
                  "slow_read": "io", "truncate_shard": "io",
-                 "io_error": "io"}
+                 "io_error": "io",
+                 "kill_worker": "worker", "lease_wedge": "worker"}
 
 
 class ChaosCrash(BaseException):
@@ -149,6 +153,19 @@ class ChaosMonkey:
     * ``kill`` — ``os._exit(9)``: REAL process death.  Only meaningful
       inside a contained child (``failsafe.run_isolated``); in the
       parent process it takes the test runner down with it.
+    * ``kill_worker`` / ``lease_wedge`` — the WORKER channel
+      (:meth:`on_worker`, consulted by the federation supervisor at
+      every heartbeat it receives; the fault's ``op`` pattern matches
+      WORKER names like ``"w0"``, counted per worker under
+      ``"<worker>@worker"``).  Both only RULE — the supervisor owns
+      the subprocess and the lease clock, so it implements the
+      semantics: ``kill_worker`` → SIGKILL the worker's pid (hard
+      host/process death mid-run; the reap → fence → requeue →
+      respawn ladder must recover every in-flight ticket);
+      ``lease_wedge`` → stop crediting that worker's heartbeats (the
+      worker is ALIVE but its lease goes stale — the split-brain
+      partition case: the supervisor must FENCE the old worker before
+      requeueing, or both could commit).
     * ``slow_read`` / ``truncate_shard`` / ``io_error`` — the IO
       channel (:meth:`on_io`, consulted by the shard-read scheduler
       for every chunk read; the fault's ``op`` pattern matches CHUNK
@@ -245,6 +262,30 @@ class ChaosMonkey:
             self.injected.append({"op": tenant, "call": call_no,
                                   "mode": f.mode, "backend": backend})
         return True
+
+    def on_worker(self, name: str,
+                  backend: str | None = None) -> dict | None:
+        """Federation-supervisor hook, consulted at every heartbeat
+        received from a worker: returns ``None`` (healthy) or
+        ``{"mode": "kill_worker" | "lease_wedge"}`` for a firing
+        worker fault.  On this channel the fault's ``op`` pattern
+        matches the WORKER name (``"w0"``, ``"w*"``); call counting
+        is per worker under ``"<worker>@worker"``, so
+        ``on_call``/``times`` windows count HEARTBEATS — a
+        ``Fault("w0", "kill_worker", on_call=3)`` kills w0 at its 3rd
+        heartbeat.  The hook only rules; the supervisor implements
+        the semantics (it owns the subprocess pid and the lease
+        clock), exactly like the on_io slow_read/io_error split."""
+        key = f"{name}@worker"
+        with self._lock:
+            call_no = self.calls.get(key, 0) + 1
+            self.calls[key] = call_no
+            f = self._firing(name, backend, call_no, channel="worker")
+            if f is None:
+                return None
+            self.injected.append({"op": name, "call": call_no,
+                                  "mode": f.mode, "backend": backend})
+        return {"mode": f.mode}
 
     def on_io(self, name: str, path: str | None = None,
               backend: str | None = None) -> dict | None:

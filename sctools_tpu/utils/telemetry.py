@@ -77,6 +77,17 @@ EVENTS = frozenset({
     # corrupt/truncated shard chunk was moved — never deleted — to
     # quarantine/ with a .reason.json sidecar
     "shard_quarantined",
+    # federation tier (sctools_tpu/federation.py): worker-process
+    # supervision.  assigned = ticket handed to a worker's inbox;
+    # worker_lost carries the dead worker's journal tail grafted in;
+    # requeued = an in-flight ticket moved back to the queue with a
+    # bumped epoch (the fencing guard: only the CURRENT epoch's
+    # result is ever accepted); commit_refused = a result from a
+    # fenced/stale epoch was refused — by the worker itself (it saw
+    # the fence before committing) or by the supervisor (epoch
+    # mismatch at acceptance)
+    "worker_spawned", "worker_lost", "worker_respawned",
+    "assigned", "requeued", "commit_refused",
 })
 
 #: Every legal metric name → one-line meaning (the docs table).  Like
@@ -172,6 +183,27 @@ METRICS = {
     "ingest.read_wait_s": "histogram: consumer wait for a shard read "
                           "(submission to first served result, on "
                           "the injectable clock)",
+    "fed.heartbeats": "counter: worker heartbeats credited by the "
+                      "federation supervisor (labels worker=) — a "
+                      "wedged worker's withheld beats are NOT counted",
+    "fed.lease_age_s": "histogram: worker lease age at each "
+                       "supervision check (on the injectable clock); "
+                       "ages past the lease timeout classify the "
+                       "worker process_lost",
+    "fed.workers_lost": "counter: workers ruled lost (labels reason= "
+                        "exited|lease_expired) — each is fenced, "
+                        "reaped and its in-flight tickets requeued",
+    "fed.requeues": "counter: in-flight tickets requeued off a lost "
+                    "worker with a bumped epoch (the new owner "
+                    "RESUMES from the checkpoint fingerprint — never "
+                    "replays completed stages)",
+    "fed.fenced_commits": "counter: results refused because they came "
+                          "from a fenced worker or a stale epoch "
+                          "(the at-most-once acceptance guard)",
+    "fed.breaker_syncs": "counter: remote breaker transitions applied "
+                         "from the cross-process transport (labels "
+                         "signature=, to= open|closed) — how one "
+                         "worker's trip short-circuits the pool",
 }
 
 #: Fixed histogram bucket upper bounds (seconds), chosen to straddle

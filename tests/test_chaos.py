@@ -434,3 +434,75 @@ def test_on_io_spec_round_trip_carries_slow_s():
     assert clone.calls["chunk-00009@io"] == 1
     assert clone.on_io("chunk-00009")["mode"] == "slow_read"  # call 2
     assert clone.on_io("chunk-00009") is None                 # closed
+
+
+def test_worker_modes_fire_only_on_worker_channel():
+    """kill_worker/lease_wedge live on the worker channel: on_worker
+    matches the fault's op pattern against WORKER names with
+    on_call/times windows counting heartbeats — and op-channel modes
+    never leak in."""
+    monkey = ChaosMonkey(
+        [Fault("w*", "kill_worker", on_call=3, times=1),
+         Fault("w1", "lease_wedge", on_call=2, times=-1),
+         Fault("w*", "unavailable", times=-1)])  # op channel only
+    # w0: beats 1-2 below the window, beat 3 kills, beat 4 past it
+    assert monkey.on_worker("w0") is None
+    assert monkey.on_worker("w0") is None
+    assert monkey.on_worker("w0") == {"mode": "kill_worker"}
+    assert monkey.on_worker("w0") is None
+    # w1: its own counter; the wedge fires first (listed rule order
+    # would give kill at beat 3, but the wedge window opens at 2)
+    assert monkey.on_worker("w1") is None
+    assert monkey.on_worker("w1") == {"mode": "lease_wedge"}
+    assert monkey.calls["w0@worker"] == 4
+    assert monkey.calls["w1@worker"] == 2
+    assert all(f["mode"] in ("kill_worker", "lease_wedge")
+               for f in monkey.injected)
+
+
+def test_worker_modes_never_fire_on_op_calls():
+    """A kill_worker fault whose pattern happens to match an op name
+    must NOT fire when that op is invoked — channels are disjoint
+    (an in-process op call is not a heartbeat)."""
+    from sctools_tpu import registry as reg
+
+    @reg.register("test.worker_victim", backend="cpu")
+    def _victim(data, **kw):
+        return data
+
+    try:
+        monkey = ChaosMonkey(
+            [Fault("test.worker_victim", "kill_worker", times=-1),
+             Fault("test.worker_victim", "lease_wedge", times=-1)])
+        with monkey.activate():
+            out = reg.apply("test.worker_victim", 17, backend="cpu")
+        assert out == 17                  # op ran untouched (no kill!)
+        assert monkey.injected == []
+        assert monkey.calls["test.worker_victim"] == 1
+    finally:
+        reg._REGISTRY.pop("test.worker_victim", None)
+        reg._DOCS.pop("test.worker_victim", None)
+
+
+def test_worker_modes_spec_round_trip():
+    """Worker faults and their heartbeat counts survive the picklable
+    spec round trip — the supervisor writes specs into config.json
+    for in-worker re-arming, so this is load-bearing."""
+    monkey = ChaosMonkey(
+        [Fault("w0", "kill_worker", on_call=2, times=1)], seed=9)
+    assert monkey.on_worker("w0") is None      # beat 1
+    clone = ChaosMonkey.from_spec(monkey.spec())
+    assert clone.calls["w0@worker"] == 1
+    assert clone.on_worker("w0") == {"mode": "kill_worker"}  # beat 2
+    assert clone.on_worker("w0") is None       # window closed
+
+
+def test_worker_mode_pattern_scopes_to_worker_names():
+    """Respawned incarnations carry a generation-qualified name
+    ("w0#1"): a bare "w0" fault never re-fires on them, while "w0*"
+    deliberately would — the pattern is the operator's choice."""
+    monkey = ChaosMonkey([Fault("w0", "kill_worker", times=-1)])
+    assert monkey.on_worker("w0") == {"mode": "kill_worker"}
+    assert monkey.on_worker("w0#1") is None
+    wide = ChaosMonkey([Fault("w0*", "kill_worker", times=-1)])
+    assert wide.on_worker("w0#1") == {"mode": "kill_worker"}

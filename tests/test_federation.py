@@ -1,0 +1,430 @@
+"""Pod-scale fault domains (``sctools_tpu/federation.py``): the
+cross-process breaker transport, the supervised worker pool, and the
+lost-worker ladder (fence → requeue → respawn → resume).
+
+Subprocess tests spawn REAL worker processes (each imports jax, so a
+few seconds of startup each) and are kept few and combined; every
+lease/age schedule runs on the injectable clock — the test process
+itself waits only on event-driven handles, never a poll sleep.
+"""
+
+import json
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from sctools_tpu.data.synthetic import synthetic_counts
+from sctools_tpu.federation import (FederatedBreakerRegistry,
+                                    FederatedRunError,
+                                    FederationSupervisor, TicketHandle,
+                                    worker_main, _Ticket, _Worker)
+from sctools_tpu.registry import Pipeline
+from sctools_tpu.scheduler import RunRejected, RunShed
+from sctools_tpu.utils.chaos import ChaosMonkey, Fault
+from sctools_tpu.utils.telemetry import MetricsRegistry
+from sctools_tpu.utils.vclock import VirtualClock
+
+from soak_smoke import check_journal_coherent
+
+
+def _data(n=64, g=32, seed=0):
+    return synthetic_counts(n, g, density=0.2, seed=seed)
+
+
+def _pipe():
+    return Pipeline([("normalize.library_size", {}),
+                     ("normalize.log1p", {}),
+                     ("qc.per_cell_metrics", {})], backend="tpu")
+
+
+def _events(fed_dir):
+    with open(os.path.join(fed_dir, "journal.jsonl")) as f:
+        return [json.loads(line) for line in f]
+
+
+# ------------------------------------------------------- breaker transport
+
+def test_federated_breaker_trip_and_close_propagate(tmp_path):
+    """One registry's trip forces every sharer open; one probe close
+    returns the whole pool — the PR-8 contract across processes."""
+    clk = VirtualClock()
+    A = FederatedBreakerRegistry(str(tmp_path), clock=clk, owner="wA",
+                                 failure_threshold=2, cooldown_s=30.0)
+    B = FederatedBreakerRegistry(str(tmp_path), clock=clk, owner="wB",
+                                 failure_threshold=2, cooldown_s=30.0)
+    a, b = A.get("tpu"), B.get("tpu")
+    assert a.allow() and b.allow()
+    a.record_failure()
+    assert b.allow()  # one failure: below threshold, nothing published
+    a.record_failure()
+    assert a.state == "open"
+    assert b.state == "open" and not b.allow()  # the trip crossed over
+    clk.advance(31.0)
+    assert b.state == "half_open"
+    assert b.try_acquire_probe()
+    # A is also half-open now, but B holds the CROSS-PROCESS claim
+    assert a.state == "half_open"
+    assert a.try_acquire_probe() is False
+    b.record_success()
+    assert b.state == "closed"
+    assert a.state == "closed" and a.allow()  # the close crossed back
+    assert a.snapshot()["fed_epoch"] == 2  # open + close transitions
+
+
+def test_federated_breaker_reopen_restarts_remote_cooldown(tmp_path):
+    clk = VirtualClock()
+    A = FederatedBreakerRegistry(str(tmp_path), clock=clk, owner="wA",
+                                 failure_threshold=1, cooldown_s=10.0)
+    B = FederatedBreakerRegistry(str(tmp_path), clock=clk, owner="wB",
+                                 failure_threshold=1, cooldown_s=10.0)
+    a, b = A.get("tpu"), B.get("tpu")
+    a.record_failure()
+    assert b.state == "open"
+    clk.advance(11.0)
+    assert a.state == "half_open"
+    assert a.try_acquire_probe()
+    a.record_failure()  # the probe lied: re-open, epoch bumps
+    # B saw the re-publication: open again with a FRESH local cooldown
+    assert b.state == "open" and not b.allow()
+    clk.advance(11.0)
+    assert b.state == "half_open"
+
+
+def test_clear_probe_claims_frees_a_dead_workers_claim(tmp_path):
+    clk = VirtualClock()
+    A = FederatedBreakerRegistry(str(tmp_path), clock=clk, owner="wA",
+                                 failure_threshold=1, cooldown_s=5.0)
+    B = FederatedBreakerRegistry(str(tmp_path), clock=clk, owner="wB",
+                                 failure_threshold=1, cooldown_s=5.0)
+    a, b = A.get("tpu"), B.get("tpu")
+    a.record_failure()
+    assert b.state == "open"  # B observes NOW: its cooldown starts
+    clk.advance(6.0)
+    assert a.try_acquire_probe()      # wA holds the claim file...
+    assert b.try_acquire_probe() is False
+    assert A.clear_probe_claims("wA") == 1  # ...then wA dies: fenced
+    assert b.try_acquire_probe()      # the pool recovers the slot
+
+
+def test_registry_snapshot_covers_remote_signatures(tmp_path):
+    clk = VirtualClock()
+    A = FederatedBreakerRegistry(str(tmp_path), clock=clk, owner="wA",
+                                 failure_threshold=1)
+    B = FederatedBreakerRegistry(str(tmp_path), clock=clk, owner="wB",
+                                 failure_threshold=1)
+    A.get("tpu").record_failure()
+    snap = B.snapshot()  # B never called get("tpu") itself
+    assert snap["tpu"]["state"] == "open"
+
+
+# ------------------------------------------------- fencing (no subprocess)
+
+def _fake_supervisor(tmp_path):
+    sup = FederationSupervisor(str(tmp_path), n_workers=1)
+    w = _Worker("w0", 0, os.path.join(str(tmp_path), "workers", "w0"))
+    os.makedirs(os.path.join(w.dir, "inbox"), exist_ok=True)
+    h = TicketHandle("t000000", "default", 0)
+    t = _Ticket(0, "default", 0, "tpu", [], {},
+                os.path.join(str(tmp_path), "tickets", "t000000"),
+                h, 0.0)
+    os.makedirs(t.dir, exist_ok=True)
+    sup._tickets[t.id] = t
+    sup._workers["w0"] = w
+    t.worker = w
+    w.in_flight.append(t)
+    return sup, w, t
+
+
+def test_stale_epoch_commit_is_refused(tmp_path):
+    """The fencing guard: a result tagged with a superseded epoch is
+    journaled ``commit_refused`` and does NOT terminate the ticket —
+    the current epoch's owner is the one that counts."""
+    sup, w, t = _fake_supervisor(tmp_path)
+    t.epoch = 1  # the supervisor already requeued past epoch 0
+    sup._on_done(w, {"ticket": t.id, "epoch": "0",
+                     "status": "completed"})
+    assert not t.handle.done()
+    evs = _events(str(tmp_path))
+    assert [e["event"] for e in evs] == ["commit_refused"]
+    assert evs[0]["by"] == "supervisor"
+    # the CURRENT epoch's commit is accepted exactly once
+    sup._on_done(w, {"ticket": t.id, "epoch": "1",
+                     "status": "completed"})
+    assert t.handle.done() and t.handle.status == "completed"
+
+
+def test_worker_refuses_commit_after_fence(tmp_path, capsys):
+    """Worker-side half of the fence: ``_run_assignment`` re-checks
+    the fence at the commit boundary and declines — no result files,
+    a ``refused`` protocol line instead."""
+    from sctools_tpu.federation import _run_assignment
+
+    tdir = tmp_path / "t"
+    tdir.mkdir()
+    from sctools_tpu.utils.checkpoint import save_celldata
+
+    save_celldata(_data(), str(tdir / "data.npz"))
+    (tdir / "ticket.json").write_text(json.dumps(
+        {"ticket": "t000000", "tenant": "x", "backend": "tpu",
+         "steps": [["normalize.log1p", "tpu", {}]], "runner_kw": {}}))
+
+    class _Handle:
+        def result(self):
+            return _data()
+
+    class _Sched:
+        def submit(self, *a, **kw):
+            return _Handle()
+
+    _run_assignment(_Sched(), {"ticket": "t000000", "epoch": 0,
+                               "dir": str(tdir)},
+                    str(tmp_path), fenced=lambda: True)
+    err = capsys.readouterr().err
+    assert "[fed] refused ticket=t000000 epoch=0" in err
+    assert not os.path.exists(str(tdir / "result-000.json"))
+    assert not os.path.exists(str(tdir / "result-000.npz"))
+
+
+def test_submit_admission_funnel(tmp_path):
+    """Federation-tier admission: tenant queue quota and reject_storm
+    refuse at the door with the journal trail of the in-process
+    scheduler."""
+    monkey = ChaosMonkey([Fault("stormy", "reject_storm", times=1)])
+    sup = FederationSupervisor(str(tmp_path), n_workers=1,
+                               tenant_max_queued=2, chaos=monkey)
+    sup._started = True  # admission only: never spawn real workers
+    d = _data()
+    with pytest.raises(RunRejected, match="reject_storm"):
+        sup.submit(_pipe(), d, tenant="stormy")
+    sup.submit(_pipe(), d, tenant="lab")
+    sup.submit(_pipe(), d, tenant="lab")
+    with pytest.raises(RunRejected, match="tenant_queue_quota"):
+        sup.submit(_pipe(), d, tenant="lab")
+    evs = [e["event"] for e in _events(str(tmp_path))]
+    assert evs.count("rejected") == 2
+    assert evs.count("admitted") == 2
+
+
+def test_high_water_sheds_lowest_priority(tmp_path):
+    sup = FederationSupervisor(str(tmp_path), n_workers=1,
+                               queue_high_water=2,
+                               tenant_max_queued=10)
+    sup._started = True
+    d = _data()
+    h_low = sup.submit(_pipe(), d, tenant="a", priority=0)
+    sup.submit(_pipe(), d, tenant="b", priority=1)
+    sup.submit(_pipe(), d, tenant="c", priority=2)  # sheds h_low
+    assert h_low.status == "shed"
+    with pytest.raises(RunShed):
+        h_low.result(timeout=0)
+    with pytest.raises(RunRejected, match="queue_full"):
+        sup.submit(_pipe(), d, tenant="d", priority=0)
+
+
+# --------------------------------------------------- subprocess acceptance
+
+def test_federation_chaos_soak_kill_and_wedge(tmp_path):
+    """THE acceptance soak: two supervised workers, one SIGKILLed by
+    chaos at its 3rd heartbeat, the other wedged (heartbeats
+    withheld — the split-brain partition).  Every submission is
+    terminal in exactly one journaled state, the killed/wedged
+    workers' in-flight runs are requeued and complete, the fenced
+    worker never double-commits, and every lease schedule ran on the
+    VirtualClock (the test never sleeps; it waits on event-driven
+    handles)."""
+    clk = VirtualClock()
+    m = MetricsRegistry(clock=clk)
+    monkey = ChaosMonkey([Fault("w0", "kill_worker", on_call=3),
+                          Fault("w1", "lease_wedge", on_call=3)])
+    d = _data()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        with FederationSupervisor(
+                str(tmp_path), n_workers=2, heartbeat_s=0.1,
+                poll_s=0.05, lease_timeout_s=30.0, clock=clk,
+                metrics=m, chaos=monkey, max_respawns=1,
+                tenant_max_queued=16,
+                runner_config={"assume_healthy": True}) as sup:
+            handles = [sup.submit(_pipe(), d, tenant=f"t{i % 3}")
+                       for i in range(8)]
+            # the wedge fires on a real heartbeat; wait for THAT
+            # event, then expire the wedged lease on the virtual
+            # clock — the live workers' next beats re-stamp
+            # themselves and run the supervision check
+            assert sup.wedge_observed.wait(timeout=90), \
+                "lease_wedge never fired"
+            clk.advance(31.0)
+            for h in handles:
+                out = h.result(timeout=180)
+                assert out.X is not None
+                assert h.status == "completed"
+    evs = _events(str(tmp_path))
+    names = [e["event"] for e in evs]
+    # both loss modes ran the full ladder
+    lost = [e for e in evs if e["event"] == "worker_lost"]
+    reasons = {e["reason"] for e in lost}
+    assert "exited" in reasons, names  # the SIGKILL reap
+    assert "lease_expired" in reasons, names  # the wedge ruling
+    assert all(e["classified"] == "process_lost" for e in lost)
+    assert any(e.get("journal_tail") for e in lost), \
+        "worker_lost must graft the dead worker's journal tail"
+    assert "worker_respawned" in names
+    # zero lost tickets: every submission terminal exactly once
+    check_journal_coherent(os.path.join(str(tmp_path),
+                                        "journal.jsonl"), 8)
+    # requeues happened and were charged to the metric
+    compact = m.snapshot_compact()
+    assert compact.get("fed.requeues", 0) >= 1
+    assert compact.get(
+        "fed.workers_lost{reason=lease_expired}", 0) == 1
+    # the fenced (wedged) worker never had a commit ACCEPTED after
+    # its fence: every accepted terminal is the ticket's current
+    # epoch (commit_refused events are allowed, acceptance is not)
+    done = [e for e in evs if e["event"] == "run_completed"]
+    assert len(done) == 8
+    # acceptance is epoch-guarded: every accepted terminal's epoch is
+    # the LAST epoch the supervisor journaled for that ticket (a
+    # fenced worker's stale-epoch commit can never be the accepted
+    # one).  NB the fence FILE is cleared again when the incarnation
+    # respawns — the journal, not the file, is the durable evidence.
+    last_epoch: dict = {}
+    for e in evs:
+        if e["event"] in ("assigned", "requeued"):
+            last_epoch[e["ticket"]] = e["epoch"]
+    for e in done:
+        assert e["epoch"] == last_epoch[e["ticket"]], e
+
+
+def test_crash_requeue_resumes_bitwise_identical(tmp_path):
+    """The at-most-once contract: a ticket SIGKILLed mid-fused-stage
+    (in-worker chaos ``kill`` inside the second fused stage) is
+    requeued onto the respawned worker, RESUMES from the checkpoint
+    fingerprint (journal proves resume, not replay) and produces
+    bitwise-identical results to an uninterrupted run."""
+    d = _data(96, 48, seed=3)
+    pipe = Pipeline([
+        ("normalize.library_size", {}),
+        ("normalize.log1p", {}),
+        ("qc.per_cell_metrics", {}),
+        ("qc.filter_cells", {"min_counts": 1.0}),  # fusion break
+        ("hvg.select", {"n_top": 16, "flavor": "dispersion"}),
+        ("normalize.scale", {"max_value": 10.0}),
+    ], backend="tpu")
+    kill_spec = ChaosMonkey(
+        [Fault("hvg.select", "kill", on_call=1)]).spec()
+
+    def run(fed, specs):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            with FederationSupervisor(
+                    str(fed), n_workers=1, heartbeat_s=0.1,
+                    poll_s=0.05, lease_timeout_s=120.0,
+                    max_respawns=1, chaos_specs=specs,
+                    runner_config={"assume_healthy": True,
+                                   "fuse": True}) as sup:
+                h = sup.submit(pipe, d, tenant="lab")
+                return h.result(timeout=240), h
+
+    out_kill, h_kill = run(tmp_path / "a", {"w0": kill_spec})
+    out_clean, _ = run(tmp_path / "b", {})
+
+    evs = _events(str(tmp_path / "a"))
+    names = [e["event"] for e in evs]
+    assert "worker_lost" in names and "requeued" in names
+    assert h_kill.epoch == 1  # completed by the requeued epoch
+    # RESUME, not replay: the respawned worker's runner resumed from
+    # the fingerprinted checkpoint the dead worker left behind
+    ckpt_journal = os.path.join(str(tmp_path / "a"), "tickets",
+                                "t000000", "ckpt", "journal.jsonl")
+    with open(ckpt_journal) as f:
+        run_evs = [json.loads(line) for line in f]
+    resumes = [e for e in run_evs if e["event"] == "resume"]
+    assert resumes, "the requeued run must resume from checkpoints"
+    assert resumes[-1]["from_step"] >= 0
+    # bitwise-identical to the uninterrupted run
+    assert np.array_equal(np.asarray(out_kill.X),
+                          np.asarray(out_clean.X))
+
+
+def test_breaker_trip_on_worker_a_short_circuits_worker_b(tmp_path):
+    """Federated admission to the accelerator: worker A's chaos trips
+    the shared tpu breaker; worker B — a DIFFERENT PROCESS — starts
+    its next run already degraded (journal ``fallback
+    reason=breaker_open short_circuit=true``, zero fresh tpu
+    attempts).  The cross-process transport is what carries it."""
+    d = _data()
+    storm = ChaosMonkey(
+        [Fault("normalize.log1p", "unavailable", times=-1,
+               backend="tpu")]).spec()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        with FederationSupervisor(
+                str(tmp_path), n_workers=2, heartbeat_s=0.1,
+                poll_s=0.05, lease_timeout_s=120.0,
+                chaos_specs={"w0": storm},
+                breaker_defaults={"failure_threshold": 2,
+                                  "cooldown_s": 600.0},
+                runner_config={
+                    "assume_healthy": True,
+                    "policy": {"max_attempts": 2,
+                               "base_delay_s": 0.01,
+                               "max_delay_s": 0.02}}) as sup:
+            # phase 1: one ticket on w0 trips the breaker (2 failing
+            # attempts reach the threshold), completes degraded
+            h0 = sup.submit(_pipe(), d, tenant="lab")
+            h0.result(timeout=180)
+            bpath = os.path.join(str(tmp_path), "breakers",
+                                 "tpu.json")
+            with open(bpath) as f:
+                assert json.load(f)["state"] == "open"
+            # phase 2: more tickets — both workers' runs now start
+            # under the remotely-opened breaker
+            hs = [sup.submit(_pipe(), d, tenant="lab")
+                  for _ in range(4)]
+            for h in hs:
+                h.result(timeout=180)
+            servers = {h.worker for h in hs}
+            assert "w1" in servers, servers  # B really served some
+            b_tickets = [h.ticket for h in hs if h.worker == "w1"]
+    # a w1-served run's OWN journal (the ticket's checkpoint dir)
+    # proves the pre-attempt short circuit in worker B's process
+    for tid in b_tickets:
+        with open(os.path.join(str(tmp_path), "tickets", tid,
+                               "ckpt", "journal.jsonl")) as f:
+            run_evs = [json.loads(line) for line in f]
+        sc = [e for e in run_evs if e["event"] == "fallback"
+              and e.get("reason") == "breaker_open"
+              and e.get("short_circuit")]
+        assert sc, (tid, [e["event"] for e in run_evs])
+        assert sc[0].get("signature") == "tpu"
+        # zero fresh accelerator attempts: the remote trip ruled the
+        # run degraded BEFORE it touched the backend
+        tpu_attempts = [e for e in run_evs if e["event"] == "attempt"
+                        and e.get("backend") == "tpu"]
+        assert not tpu_attempts, (tid, tpu_attempts)
+
+
+def test_worker_main_exits_fenced(tmp_path):
+    """A worker that starts under an existing fence stands down
+    immediately (exit code 3) without serving anything."""
+    fed = tmp_path / "fed"
+    wdir = fed / "workers" / "w9"
+    (wdir / "inbox").mkdir(parents=True)
+    (fed / "config.json").write_text(json.dumps(
+        {"heartbeat_s": 0.1, "poll_s": 0.05}))
+    (wdir / "fence.json").write_text(json.dumps({"reason": "test"}))
+    assert worker_main(str(fed), "w9", gen=0) == 3
+
+
+def test_shutdown_sheds_undispatched(tmp_path):
+    sup = FederationSupervisor(str(tmp_path), n_workers=1,
+                               tenant_max_queued=10)
+    sup._started = True  # no workers: nothing can dispatch
+    h = sup.submit(_pipe(), _data(), tenant="lab")
+    sup.shutdown(wait=True, timeout=5)
+    assert h.status == "shed"
+    assert h.reason == "shutdown"
+    with pytest.raises(RunShed):
+        h.result(timeout=0)

@@ -4,10 +4,19 @@
 JAX's distributed runtime works on CPU with a localhost coordinator,
 so the MPI_Init-analogue bring-up CAN run here: two fresh processes
 (4 virtual CPU devices each) join one cluster, every process sees all
-8 global devices, ``make_mesh()`` spans both hosts, and a
-``psum``-backed reduction over a cells-sharded global array returns
-the cross-process total on both sides.  This is the same code path a
-real pod takes over DCN — only the transport differs.
+8 global devices, ``make_mesh()`` spans both hosts and
+``mesh_host_groups`` sees the two process groups.  This is the same
+code path a real pod takes over DCN — only the transport differs.
+
+What CANNOT run here: jax 0.4.x's CPU backend refuses cross-process
+XLA computations outright (``INVALID_ARGUMENT: Multiprocess
+computations aren't implemented on the CPU backend`` — the
+pristine-HEAD failure this file used to carry).  The cross-host
+reduction therefore goes through ``coordination_sum`` — the
+coordination service's KV store, i.e. the SAME gRPC control plane
+the bring-up established — while each process proves local compute
+works under the distributed runtime with a plain jit.  On a real pod
+the data plane is exercised by the mesh-sharded plan tests instead.
 
 Children are spawned with PYTHONPATH REPLACED (the axon sitecustomize
 would hang interpreter startup when the tunnel is down — see
@@ -29,12 +38,14 @@ CHILD = textwrap.dedent("""
     import os, sys
     pid = int(sys.argv[1]); port = sys.argv[2]
     import numpy as np
-    import jax
+    import jax, jax.numpy as jnp
     from sctools_tpu.parallel.mesh import (
-        CELL_AXIS, init_distributed, make_mesh, cell_sharding)
+        CELL_AXIS, coordination_sum, init_distributed, make_mesh,
+        mesh_host_groups)
 
     info = init_distributed(f"127.0.0.1:{port}", num_processes=2,
-                            process_id=pid)
+                            process_id=pid, attempts=3,
+                            retry_delay_s=0.5, timeout_s=60)
     assert info["num_processes"] == 2, info
     assert info["process_id"] == pid, info
     assert info["local_devices"] == 4, info
@@ -42,42 +53,53 @@ CHILD = textwrap.dedent("""
 
     mesh = make_mesh()  # no argument: spans BOTH processes' devices
     assert mesh.devices.size == 8
+    groups = mesh_host_groups(mesh)
+    assert len(groups) == 2, [len(g) for g in groups]
+    assert all(len(g) == 4 for g in groups), [len(g) for g in groups]
 
-    # cross-host collective: rows 0..7 sharded one per device; the
-    # replicated global sum must come back identical on both hosts
-    sharding = cell_sharding(mesh, ndim=2)
-    rows = np.arange(8, dtype=np.float32)[:, None] * np.ones(
-        (1, 4), np.float32)
-    garr = jax.make_array_from_callback(
-        (8, 4), sharding, lambda idx: rows[idx])
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    total = jax.jit(lambda x: x.sum(),
-                    out_shardings=NamedSharding(mesh, P()))(garr)
-    # replicated output: every host holds the full value locally
-    got = float(total.addressable_shards[0].data)
-    assert got == 112.0, got  # sum(0..7) * 4
-    print(f"OK pid={pid} global={info['global_devices']} sum={got}",
+    # local compute under the distributed runtime: this process's
+    # rows (pid*4 .. pid*4+3), summed by a jitted program on its own
+    # devices — the part of the data plane the CPU backend DOES run
+    rows = (np.arange(4, dtype=np.float32) + 4 * pid)[:, None] \
+        * np.ones((1, 4), np.float32)
+    local = float(jax.jit(lambda x: x.sum())(jnp.asarray(rows)))
+    assert local == (6.0 if pid == 0 else 22.0) * 4, local
+
+    # cross-host reduction over the coordination service's KV store
+    # (the control plane init_distributed established): jax 0.4.x CPU
+    # cannot run cross-process XLA computations, so the total crosses
+    # hosts as gRPC KV traffic — same transport, no device collective
+    total = coordination_sum(local, "rowsum")
+    assert total == 112.0, total  # sum(0..7) * 4, both sides
+    print(f"OK pid={pid} global={info['global_devices']} sum={total}",
           flush=True)
 """)
 
 
-def test_init_distributed_two_processes(tmp_path):
+def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    script = tmp_path / "child.py"
-    script.write_text(CHILD)
-    env = {
+        return s.getsockname()[1]
+
+
+def _child_env() -> dict:
+    return {
         "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
         "HOME": os.environ.get("HOME", "/root"),
         "PYTHONPATH": REPO,  # REPLACED: no axon sitecustomize
         "JAX_PLATFORMS": "cpu",
         "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
     }
+
+
+def test_init_distributed_two_processes(tmp_path):
+    port = _free_port()
+    script = tmp_path / "child.py"
+    script.write_text(CHILD)
     procs = [subprocess.Popen(
         [sys.executable, str(script), str(i), str(port)],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-        text=True, env=env, cwd=REPO) for i in range(2)]
+        text=True, env=_child_env(), cwd=REPO) for i in range(2)]
     outs = []
     for p in procs:
         try:
@@ -90,3 +112,78 @@ def test_init_distributed_two_processes(tmp_path):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"child {i} failed:\n{out[-2000:]}"
         assert f"OK pid={i} global=8 sum=112.0" in out, out[-2000:]
+
+
+def test_init_distributed_refuses_held_coordinator_port(tmp_path):
+    """A coordinator port held by a LIVE listener is refused with an
+    actionable error after bounded bind attempts — NOT the jaxlib
+    segfault (rc=-11) that binding it from the coordinator service
+    produces."""
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    port = blocker.getsockname()[1]
+    child = textwrap.dedent(f"""
+        import jax
+        from sctools_tpu.parallel.mesh import init_distributed
+        from sctools_tpu.utils.vclock import VirtualClock
+        try:
+            init_distributed("127.0.0.1:{port}", num_processes=1,
+                             process_id=0, attempts=2,
+                             retry_delay_s=0.01, clock=VirtualClock())
+        except RuntimeError as e:
+            assert "still in use" in str(e), e
+            assert "2 bind attempt" in str(e), e
+            print("REFUSED", flush=True)
+        else:
+            print("NOT-REFUSED", flush=True)
+    """)
+    script = tmp_path / "held_port.py"
+    script.write_text(child)
+    try:
+        p = subprocess.run(
+            [sys.executable, str(script)], capture_output=True,
+            text=True, env=_child_env(), cwd=REPO, timeout=120)
+    finally:
+        blocker.close()
+    assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-2000:]
+    assert "REFUSED" in p.stdout, p.stdout
+
+
+def test_bringup_misconfig_is_actionable():
+    """Misconfig raises an actionable ValueError BEFORE jax.distributed
+    is touched — safe to assert in-process."""
+    from sctools_tpu.parallel.mesh import init_distributed
+
+    with pytest.raises(ValueError, match="out of range"):
+        init_distributed("127.0.0.1:1234", num_processes=2,
+                         process_id=5)
+    with pytest.raises(ValueError, match="TOGETHER"):
+        init_distributed("127.0.0.1:1234", num_processes=2)
+    with pytest.raises(ValueError, match="host:port"):
+        init_distributed("not-an-address", num_processes=2,
+                         process_id=0)
+    with pytest.raises(ValueError, match="attempts"):
+        init_distributed("127.0.0.1:1234", num_processes=2,
+                         process_id=0, attempts=0)
+
+
+def test_bringup_error_classification():
+    """The transient/deterministic split for catchable bring-up
+    failures: startup races retry, novel errors surface."""
+    from sctools_tpu.parallel.mesh import classify_bringup_error
+
+    transient = [
+        RuntimeError("DEADLINE_EXCEEDED: Barrier timed out"),
+        RuntimeError("UNAVAILABLE: failed to connect to all addresses"),
+        RuntimeError("Address already in use"),
+        ConnectionRefusedError("connection refused"),
+    ]
+    for e in transient:
+        assert classify_bringup_error(e) == "transient", e
+    deterministic = [
+        RuntimeError("invalid process id"),
+        ValueError("coordinator_address should be defined"),
+    ]
+    for e in deterministic:
+        assert classify_bringup_error(e) == "deterministic", e

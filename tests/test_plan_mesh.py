@@ -323,3 +323,85 @@ def test_mesh_requires_fuse():
         run_recipe("atlas_knn", _data(), mesh=make_mesh(2))
     with pytest.raises(ValueError, match="fuse=True"):
         ResilientRunner(_chain(), mesh=make_mesh(2))
+
+
+# ------------------------------------------------------------ lost-host rung
+
+def test_mesh_host_groups_fake_split_and_shrunk_mesh(monkeypatch):
+    """SCTOOLS_MESH_HOSTS partitions only the FULL device set (the
+    single-process harness's stand-in for per-process groups); a mesh
+    already shrunk below it reads as one surviving host."""
+    from sctools_tpu.parallel.mesh import mesh_host_groups
+
+    monkeypatch.setenv("SCTOOLS_MESH_HOSTS", "2")
+    groups = mesh_host_groups(make_mesh(8))
+    assert [len(g) for g in groups] == [4, 4]
+    assert mesh_host_groups(make_mesh(4)) and \
+        len(mesh_host_groups(make_mesh(4))) == 1
+    monkeypatch.delenv("SCTOOLS_MESH_HOSTS")
+    assert len(mesh_host_groups(make_mesh(8))) == 1  # all process 0
+
+
+def test_replan_explicit_devices():
+    """replan(devices=) builds the surviving-device mesh — not a
+    prefix of jax.devices(), which a count cannot express."""
+    import jax
+
+    ft = fused_pipeline(_chain(), mesh=make_mesh(8)).steps[0]
+    survivors = jax.devices()[4:]          # "host 0 died"
+    new = ft.replan(None, devices=survivors)
+    assert int(new.mesh.devices.size) == 4
+    assert [int(d.id) for d in new.mesh.devices.flat] == [4, 5, 6, 7]
+    single = ft.replan(None, devices=survivors[:1])
+    assert single.mesh is None             # 1 device -> plain fused
+
+
+def test_runner_lost_host_rung_before_mesh_shrink(tmp_path,
+                                                  monkeypatch):
+    """On a mesh spanning two (fake) hosts, the FIRST degrade rung
+    drops a whole host group (reason=host_lost, 8 -> 4 devices) and
+    the run completes on the survivors — before any halving or
+    backend fallback."""
+    monkeypatch.setenv("SCTOOLS_MESH_HOSTS", "2")
+    host = _data(300, 120)
+    mesh = make_mesh(8)
+    monkey = ChaosMonkey([Fault("normalize.log1p", "unavailable",
+                                times=3)])
+    r = ResilientRunner(_chain(), fuse=True, mesh=mesh, chaos=monkey,
+                        checkpoint_dir=str(tmp_path),
+                        probe=lambda: {"ok": True},
+                        sleep=lambda s: None)
+    out = _quiet_run(r, shard_celldata(host, mesh))
+    assert r.report.status == "completed"
+    evs = [json.loads(l) for l in
+           open(os.path.join(str(tmp_path), "journal.jsonl"))]
+    deg = [e for e in evs if e["event"] == "degrade"]
+    assert deg[0]["reason"] == "host_lost"
+    assert (deg[0]["from_devices"], deg[0]["to_devices"]) == (8, 4)
+    assert (deg[0]["from_hosts"], deg[0]["to_hosts"]) == (2, 1)
+    # the run stayed on the accelerator: no backend fallback ruled
+    assert not [e for e in evs if e["event"] == "fallback"]
+    assert out.X is not None
+
+
+def test_runner_host_lost_then_mesh_shrink_ladder(tmp_path,
+                                                  monkeypatch):
+    """A fault that outlives the host drop keeps descending the
+    ladder: host_lost (8 -> 4) first, then mesh_shrink halving on the
+    surviving single-host mesh (4 -> 2)."""
+    monkeypatch.setenv("SCTOOLS_MESH_HOSTS", "2")
+    host = _data(300, 120)
+    mesh = make_mesh(8)
+    monkey = ChaosMonkey([Fault("normalize.log1p", "unavailable",
+                                times=6)])
+    r = ResilientRunner(_chain(), fuse=True, mesh=mesh, chaos=monkey,
+                        checkpoint_dir=str(tmp_path),
+                        probe=lambda: {"ok": True},
+                        sleep=lambda s: None)
+    _quiet_run(r, shard_celldata(host, mesh))
+    assert r.report.status == "completed"
+    evs = [json.loads(l) for l in
+           open(os.path.join(str(tmp_path), "journal.jsonl"))]
+    reasons = [e["reason"] for e in evs if e["event"] == "degrade"]
+    assert reasons[0] == "host_lost"
+    assert "mesh_shrink" in reasons[1:]

@@ -520,6 +520,32 @@ def test_sct008_covers_shardstore(tmp_path):
     assert rule_ids(r) == ["SCT008"]
 
 
+def test_sct008_covers_federation(tmp_path):
+    """The federation tier's lease ages and heartbeat cadences must
+    ride the injectable clock — the worker-supervision soak runs on
+    one VirtualClock with zero real sleeps."""
+    r = lint_src(tmp_path, """
+        import time
+
+        def lease_age(last_beat):
+            return time.monotonic() - last_beat
+        """, only=["SCT008"], name="federation.py", prelude=False)
+    assert rule_ids(r) == ["SCT008"]
+
+
+def test_sct005_covers_federation(tmp_path):
+    """A silent broad except in the supervisor would swallow exactly
+    the worker-death signal the lost-worker ladder rules on."""
+    r = lint_src(tmp_path, """
+        def reap(proc):
+            try:
+                proc.wait()
+            except Exception:
+                pass
+        """, only=["SCT005"], name="federation.py", prelude=False)
+    assert rule_ids(r) == ["SCT005"]
+
+
 def test_sct008_suppressible_per_line(tmp_path):
     r = lint_src(tmp_path, """
         import time

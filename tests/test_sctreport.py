@@ -393,3 +393,87 @@ def test_report_includes_scheduler_section_from_run_dir(tmp_path,
 
         reg._REGISTRY.pop("test.rpt_ok", None)
         reg._DOCS.pop("test.rpt_ok", None)
+
+
+def test_federation_section_renders_and_joins(tmp_path, capsys):
+    """A federation journal + metrics pair renders the worker table,
+    the lost/respawned timeline, the breaker-sync counters, and the
+    merged-journal join check (every lost in-flight ticket requeued
+    and terminal)."""
+    evs = [
+        {"event": "worker_spawned", "ts": 1.0, "worker": "w0",
+         "gen": 0, "pid": 11},
+        {"event": "worker_spawned", "ts": 1.0, "worker": "w1",
+         "gen": 0, "pid": 12},
+        {"event": "submitted", "ts": 1.1, "ticket": "t000000",
+         "tenant": "lab", "priority": 0, "queue_depth": 0},
+        {"event": "admitted", "ts": 1.1, "ticket": "t000000",
+         "tenant": "lab", "priority": 0, "queue_depth": 1},
+        {"event": "assigned", "ts": 1.2, "ticket": "t000000",
+         "worker": "w0", "epoch": 0},
+        {"event": "worker_lost", "ts": 2.0, "worker": "w0", "gen": 0,
+         "reason": "lease_expired", "rc": None,
+         "classified": "process_lost", "in_flight": ["t000000"],
+         "lease_age_s": 31.0,
+         "journal_tail": [{"event": "admitted", "ticket": 0}]},
+        {"event": "requeued", "ts": 2.0, "ticket": "t000000",
+         "tenant": "lab", "from_worker": "w0", "epoch": 1},
+        {"event": "worker_respawned", "ts": 2.1, "worker": "w0",
+         "gen": 1, "pid": 13},
+        {"event": "commit_refused", "ts": 2.2, "ticket": "t000000",
+         "worker": "w0", "epoch": 0, "by": "supervisor"},
+        {"event": "assigned", "ts": 2.3, "ticket": "t000000",
+         "worker": "w1", "epoch": 1},
+        {"event": "run_completed", "ts": 3.0, "ticket": "t000000",
+         "tenant": "lab", "worker": "w1", "epoch": 1},
+    ]
+    with open(tmp_path / "journal.jsonl", "w") as f:
+        for e in evs:
+            f.write(json.dumps(e) + "\n")
+    with open(tmp_path / "metrics.json", "w") as f:
+        json.dump({"metrics": {"counters": {
+            "fed.heartbeats{worker=w0}": 4.0,
+            "fed.heartbeats{worker=w1}": 9.0,
+            "fed.requeues": 1.0,
+            "fed.workers_lost{reason=lease_expired}": 1.0,
+            "fed.breaker_syncs{signature=tpu,to=open}": 1.0,
+        }, "histograms": {
+            "fed.lease_age_s{worker=w0}": {"count": 4, "sum": 40.0,
+                                           "max": 31.0},
+        }}}, f)
+    assert main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "-- federation --" in out
+    assert "lease_expired" in out
+    assert "LOST w0" in out and "RESPAWN w0 -> gen 1" in out
+    assert "REQUEUE t000000 off w0 -> epoch 1" in out
+    assert "COMMIT REFUSED t000000" in out
+    assert "tpu" in out and "applied 1 time(s)" in out
+    assert ("merged-journal join: 1/1 lost in-flight ticket(s) "
+            "requeued and terminal") in out
+    assert ("grafted journal tails: 1/1") in out
+
+
+def test_federation_section_absent_without_fed_events():
+    from tools.sctreport import federation_section
+
+    assert federation_section([], None) == []
+    assert federation_section(
+        [{"event": "run_start", "ts": 1.0}], None) == []
+
+
+def test_federation_join_check_counts_unrequeued(tmp_path, capsys):
+    """A lost in-flight ticket that never re-appears is exactly a
+    lost run — the join check must show the shortfall."""
+    evs = [
+        {"event": "worker_lost", "ts": 2.0, "worker": "w0", "gen": 0,
+         "reason": "exited", "rc": -9, "classified": "process_lost",
+         "in_flight": ["t000007"], "journal_tail": []},
+    ]
+    with open(tmp_path / "journal.jsonl", "w") as f:
+        for e in evs:
+            f.write(json.dumps(e) + "\n")
+    assert main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert ("merged-journal join: 0/1 lost in-flight ticket(s) "
+            "requeued and terminal") in out

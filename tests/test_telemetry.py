@@ -240,3 +240,39 @@ def test_scheduler_vocabulary_covers_its_call_sites():
     assert {"sched.admitted", "sched.rejected", "sched.shed",
             "sched.queue_depth", "sched.queue_wait_s"} \
         <= used_metrics <= set(METRICS)
+
+
+def test_federation_vocabulary_covers_its_call_sites():
+    """Same contract for the federation tier: every literal journal
+    event / metric name in federation.py is a member of the central
+    vocabulary, and the fed.* names this PR introduced are all
+    present."""
+    import ast
+    import inspect
+
+    import sctools_tpu.federation as federation_mod
+
+    tree = ast.parse(inspect.getsource(federation_mod))
+    used_events, used_metrics = set(), set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            continue
+        if f.attr == "write" and isinstance(f.value, ast.Attribute) \
+                and f.value.attr == "journal":
+            used_events.add(arg.value)
+        elif f.attr in ("counter", "gauge", "histogram", "timer"):
+            used_metrics.add(arg.value)
+    assert {"worker_spawned", "worker_lost", "worker_respawned",
+            "assigned", "requeued", "commit_refused",
+            "submitted", "admitted", "rejected", "shed",
+            "run_completed", "run_failed"} <= used_events <= EVENTS
+    assert {"fed.heartbeats", "fed.lease_age_s", "fed.workers_lost",
+            "fed.requeues", "fed.fenced_commits",
+            "fed.breaker_syncs"} <= used_metrics <= set(METRICS)

@@ -59,7 +59,17 @@
 #                     identical results — all on one VirtualClock,
 #                     zero real sleeps (docs/ARCHITECTURE.md
 #                     "Out-of-core ingest")
-#  10. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
+#  10. federation     python tests/federation_smoke.py — the
+#                     pod-scale fault domain's contract: a 2-worker
+#                     supervised soak on VirtualClock-driven leases
+#                     with one kill_worker SIGKILL and one
+#                     lease_wedge partition — zero lost tickets
+#                     (every submission terminal exactly once), the
+#                     fenced old worker never double-commits, the
+#                     lost workers' journal tails grafted into
+#                     worker_lost (docs/ARCHITECTURE.md "Federated
+#                     fault domains")
+#  11. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
 
 set -u -o pipefail
 
@@ -91,6 +101,7 @@ stage "bare-clock guard (resilience modules use the injectable clock)"
 bare=$(grep -nE '\btime\.(sleep|monotonic)\b' \
         sctools_tpu/runner.py \
         sctools_tpu/scheduler.py \
+        sctools_tpu/federation.py \
         sctools_tpu/utils/failsafe.py \
         sctools_tpu/utils/checkpoint.py \
         sctools_tpu/utils/chaos.py \
@@ -277,6 +288,14 @@ if JAX_PLATFORMS=cpu python tests/ingest_smoke.py; then
     :
 else
     echo "chaos-ingest stage FAILED (rc=$?)"
+    fail=1
+fi
+
+stage "federation (2-worker supervised soak: SIGKILL + wedged lease)"
+if JAX_PLATFORMS=cpu python tests/federation_smoke.py; then
+    :
+else
+    echo "federation stage FAILED (rc=$?)"
     fail=1
 fi
 

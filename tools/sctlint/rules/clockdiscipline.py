@@ -29,10 +29,13 @@ from ..jaxutil import dotted, module_info
 # walls — the chaos soak drives hundreds of submissions on one
 # VirtualClock; shardstore.py for the ingest IO-failure ladder
 # (per-read deadlines, retry backoff, hedge SLOs, chaos-slow reads) —
-# the whole domain is tier-1 tested on one VirtualClock.
+# the whole domain is tier-1 tested on one VirtualClock;
+# federation.py for the worker-lease domain — lease ages, heartbeat
+# cadences and breaker-transport waits all move on the injectable
+# clock (real subprocess reaps stay event-driven, like watch_process).
 _PATH_RE = re.compile(
     r"(^|/)(runner|failsafe|checkpoint|chaos|stream|scheduler"
-    r"|shardstore)\.py$")
+    r"|shardstore|federation)\.py$")
 
 _BANNED = {"time.sleep", "time.monotonic"}
 

@@ -21,7 +21,7 @@ from ..jaxutil import dotted, module_info
 # vclock carries the breaker/deadline stack's injectable clock
 _PATH_RE = re.compile(
     r"(^|/)(runner|failsafe|checkpoint|chaos|trace|determinism|sync"
-    r"|vclock)\.py$")
+    r"|vclock|federation)\.py$")
 
 _BROAD = {"Exception", "BaseException"}
 

@@ -182,7 +182,8 @@ def fmt_wall(seconds: float) -> str:
 
 def render(run_dir: str, runs: list[dict], trace_d: dict | None,
            metrics: dict | None, bad_lines: int,
-           top: int = TOP_N_DEFAULT) -> str:
+           top: int = TOP_N_DEFAULT,
+           events: list[dict] | None = None) -> str:
     L: list[str] = []
     add = L.append
     add(f"== sctreport: {run_dir} ==")
@@ -282,6 +283,11 @@ def render(run_dir: str, runs: list[dict], trace_d: dict | None,
             f" attempt span(s) present in trace.json"
             f" ({trace_d['n_events']} trace events)")
 
+    fed = federation_section(events or [], metrics)
+    if fed:
+        add("")
+        L.extend(fed)
+
     sched = scheduler_section(metrics)
     if sched:
         add("")
@@ -328,6 +334,125 @@ def _parse_labels(key: str) -> tuple[str, dict]:
         k, _, v = part.partition("=")
         labels[k] = v
     return name, labels
+
+
+def federation_section(events: list[dict], metrics) -> list[str]:
+    """The worker-supervision digest, rendered only when the journal
+    holds federation events (``worker_spawned``/``worker_lost``/…).
+    Shows the worker table (incarnations, heartbeats, runs served,
+    requeues charged against it, loss reasons), the lost/respawned
+    timeline, the cross-process breaker-sync counters, and the
+    supervisor's MERGED-JOURNAL JOIN CHECK: every in-flight ticket a
+    lost worker took down must appear requeued and terminal in the
+    supervisor journal — a ticket missing from that join is exactly a
+    lost run."""
+    fed_events = [e for e in events if e["event"] in (
+        "worker_spawned", "worker_lost", "worker_respawned",
+        "assigned", "requeued", "commit_refused")]
+    if not fed_events:
+        return []
+    m = (metrics or {}).get("metrics", metrics or {})
+    counters = m.get("counters", {}) if isinstance(m, dict) else {}
+    hists = m.get("histograms", {}) if isinstance(m, dict) else {}
+
+    workers: dict = {}
+
+    def wrec(name):
+        return workers.setdefault(name, {
+            "gens": 0, "served": 0, "requeued_from": 0,
+            "lost": [], "beats": 0.0, "lease_max": None})
+
+    terminal_by_ticket: dict = {}
+    requeued_tickets = set()
+    for e in events:
+        ev = e["event"]
+        if ev == "worker_spawned":
+            wrec(e.get("worker", "?"))["gens"] += 1
+        elif ev == "worker_lost":
+            wrec(e.get("worker", "?"))["lost"].append(e)
+        elif ev == "requeued":
+            wrec(e.get("from_worker", "?"))["requeued_from"] += 1
+            requeued_tickets.add(e.get("ticket"))
+        elif ev == "run_completed" and "worker" in e:
+            wrec(e["worker"])["served"] += 1
+            terminal_by_ticket[e.get("ticket")] = "completed"
+        elif ev == "run_failed" and "worker" in e:
+            wrec(e["worker"])["served"] += 1
+            terminal_by_ticket[e.get("ticket")] = "failed"
+        elif ev == "shed":
+            terminal_by_ticket[e.get("ticket")] = "shed"
+    for key, v in counters.items():
+        name, labels = _parse_labels(key)
+        if name == "fed.heartbeats" and labels.get("worker"):
+            wrec(labels["worker"])["beats"] += v
+    for key, h in hists.items():
+        name, labels = _parse_labels(key)
+        if name == "fed.lease_age_s" and labels.get("worker"):
+            wrec(labels["worker"])["lease_max"] = h.get("max")
+
+    L = ["-- federation --"]
+    L.append(f"  {'worker':<10s} {'gens':>4s} {'beats':>6s} "
+             f"{'served':>6s} {'requeues':>8s} {'max lease':>10s}  "
+             f"lost")
+    for name in sorted(workers):
+        w = workers[name]
+        lost = ",".join(e.get("reason", "?") for e in w["lost"]) or "-"
+        lease = ("-" if w["lease_max"] is None
+                 else f"{w['lease_max']:.1f}s")
+        L.append(f"  {name:<10s} {w['gens']:4d} {w['beats']:6g} "
+                 f"{w['served']:6d} {w['requeued_from']:8d} "
+                 f"{lease:>10s}  {lost}")
+
+    timeline = [e for e in fed_events if e["event"] in (
+        "worker_lost", "worker_respawned", "requeued",
+        "commit_refused")]
+    if timeline:
+        L.append("  timeline:")
+        t0 = timeline[0].get("ts", 0.0)
+        for e in timeline:
+            dt = e.get("ts", t0) - t0
+            if e["event"] == "worker_lost":
+                L.append(f"    +{dt:6.2f}s LOST {e.get('worker')} "
+                         f"(gen {e.get('gen')}) reason="
+                         f"{e.get('reason')} in_flight="
+                         f"{e.get('in_flight')}")
+            elif e["event"] == "worker_respawned":
+                L.append(f"    +{dt:6.2f}s RESPAWN {e.get('worker')} "
+                         f"-> gen {e.get('gen')}")
+            elif e["event"] == "requeued":
+                L.append(f"    +{dt:6.2f}s REQUEUE {e.get('ticket')} "
+                         f"off {e.get('from_worker')} -> epoch "
+                         f"{e.get('epoch')}")
+            else:
+                L.append(f"    +{dt:6.2f}s COMMIT REFUSED "
+                         f"{e.get('ticket')} epoch={e.get('epoch')} "
+                         f"by={e.get('by')}")
+
+    syncs = {key: v for key, v in counters.items()
+             if _parse_labels(key)[0] == "fed.breaker_syncs"}
+    if syncs:
+        L.append("  cross-process breaker joins:")
+        for key in sorted(syncs):
+            _, labels = _parse_labels(key)
+            L.append(f"    {labels.get('signature', '?'):<12s} "
+                     f"{labels.get('to', '?'):<8s} applied "
+                     f"{syncs[key]:g} time(s)")
+
+    # the merged-journal join check: a lost worker's in-flight
+    # tickets must re-appear (requeued) and terminate exactly once
+    lost_in_flight = [t for e in events if e["event"] == "worker_lost"
+                      for t in (e.get("in_flight") or [])]
+    joined = [t for t in lost_in_flight
+              if t in requeued_tickets and t in terminal_by_ticket]
+    L.append(f"  merged-journal join: {len(joined)}/"
+             f"{len(lost_in_flight)} lost in-flight ticket(s) "
+             "requeued and terminal")
+    tails = sum(1 for e in events if e["event"] == "worker_lost"
+                and e.get("journal_tail"))
+    n_lost = sum(1 for e in events if e["event"] == "worker_lost")
+    L.append(f"  grafted journal tails: {tails}/{n_lost} "
+             "worker_lost event(s) carry the dead worker's tail")
+    return L
 
 
 def scheduler_section(metrics) -> list[str]:
@@ -569,7 +694,7 @@ def main(argv: list[str] | None = None) -> int:
         sys.stdout.write("\n")
         return 0
     text = render(args.run_dir, runs, trace_d, metrics, bad,
-                  top=args.top)
+                  top=args.top, events=events)
     if not text.strip():
         print("sctreport: rendered an empty report", file=sys.stderr)
         return 1
