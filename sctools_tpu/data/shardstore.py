@@ -679,26 +679,46 @@ class ShardReadScheduler:
         ``start_shard``, each read passing through the full IO ladder.
         Multiple concurrent ``iter_shards`` generators share the
         reader pool, the locality order and the RAM budget."""
-        self._ensure_workers()
+        yield from self.iter_order(range(start_shard,
+                                         self.store.n_shards))
+
+    def iter_order(self, order):
+        """Yield decoded shards in an EXPLICIT index order (each read
+        through the full IO ladder, sharing the pool/budget exactly
+        like :meth:`iter_shards`).  This is the epoch-randomness seam
+        for the out-of-core trainer: hand it a permuted-BLOCK order
+        (blocks shuffled, ascending within a block) and the lookahead
+        window's in-flight reads are still served in ascending shard
+        order by the elevator heap — randomness at epoch granularity,
+        coalesced reads at disk granularity."""
+        order = [int(i) for i in order]
         n = self.store.n_shards
+        for i in order:
+            if not 0 <= i < n:
+                raise IndexError(
+                    f"iter_order: shard {i} out of range "
+                    f"[0, {n})")
+        self._ensure_workers()
         est = self.store.shard_nbytes_est()
         window = max(1, min(8, (self.ram_budget_bytes // est)
                             if self.ram_budget_bytes else 2))
         pending: dict[int, _PendingRead] = {}
-        next_submit = start_shard
+        next_submit = 0
         try:
-            for i in range(start_shard, n):
-                while next_submit < n and next_submit - i < window:
-                    if next_submit == i:
+            for pos in range(len(order)):
+                while (next_submit < len(order)
+                       and next_submit - pos < window):
+                    if next_submit == pos:
                         reserved = False  # forced: progress > budget
                     elif self._try_reserve(est):
                         reserved = True
                     else:
                         break
                     pending[next_submit] = self._submit(
-                        next_submit, holds_budget=reserved)
+                        order[next_submit], holds_budget=reserved)
                     next_submit += 1
-                shard = self._await_shard(i, pending.pop(i))
+                shard = self._await_shard(order[pos],
+                                          pending.pop(pos))
                 if shard is _SKIPPED:
                     continue
                 yield shard

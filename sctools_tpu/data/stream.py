@@ -47,13 +47,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-import warnings
-
 from ..config import config, round_up
 from ..utils import telemetry
-from ..utils.checkpoint import (CheckpointCorruptError,
-                                load_npz_verified, quarantine_checkpoint,
-                                save_npz_verified)
+from ..utils.checkpoint import (clear_npz_generations,
+                                load_npz_generations,
+                                save_npz_generations)
 from ..utils.failsafe import TRANSIENT, classify_error
 from ..utils.sync import hard_sync
 from ..utils.vclock import SYSTEM_CLOCK
@@ -75,42 +73,23 @@ _PCA_FP = "stream_pca-v1"
 
 def _save_resume_npz(path: str, fingerprint: str, **arrays) -> None:
     """Write a streaming pass's resume state through the checkpoint
-    integrity layer (digest + schema + fingerprint, atomic rename).
-    The previous generation rotates to ``<path>.prev`` first — the
-    deterministic fallback shard: if the newest file is later ruled
-    corrupt, resume falls back ONE save (one shard of lost work)
-    instead of restarting the pass."""
-    if os.path.exists(path):
-        os.replace(path, path + ".prev")
-    save_npz_verified(path, fingerprint=fingerprint, **arrays)
+    integrity layer — generation-rotating verified npz
+    (:func:`~..utils.checkpoint.save_npz_generations`): if the newest
+    file is later ruled corrupt, resume falls back ONE save (one
+    shard of lost work) instead of restarting the pass."""
+    save_npz_generations(path, fingerprint=fingerprint, **arrays)
 
 
 def _load_resume_npz(path: str, fingerprint: str) -> dict | None:
-    """Verify-then-load a resume file, falling back deterministically:
-    newest → ``.prev`` → ``None`` (fresh start).  A file that fails
-    verification — bit rot, a truncated write, chaos damage — is
-    QUARANTINED (moved beside the data with a ``.reason.json``
-    sidecar, never deleted) and the next candidate is tried.  Files
-    from before the integrity layer carry no digest and load as
-    legacy."""
-    for cand in (path, path + ".prev"):
-        if not os.path.exists(cand):
-            continue
-        try:
-            return load_npz_verified(cand, expect_fingerprint=fingerprint)
-        except CheckpointCorruptError as e:
-            dest = quarantine_checkpoint(cand, e.reason)
-            warnings.warn(
-                f"stream checkpoint {cand!r} failed verification "
-                f"({e.reason}) — quarantined to {dest!r}, resuming "
-                f"from an earlier shard", RuntimeWarning, stacklevel=3)
-    return None
+    """Verify-then-load a resume file with the deterministic
+    newest → ``.prev`` → fresh fallback and quarantine-on-corruption
+    (:func:`~..utils.checkpoint.load_npz_generations` — the out-of-
+    core trainer shares the same convention)."""
+    return load_npz_generations(path, fingerprint=fingerprint)
 
 
 def _clear_resume_npz(path: str) -> None:
-    for cand in (path, path + ".prev"):
-        if os.path.exists(cand):
-            os.remove(cand)  # pass completed; resume state is stale
+    clear_npz_generations(path)  # pass completed; state is stale
 
 
 # ----------------------------------------------------------------------
@@ -133,7 +112,8 @@ def _tag_shard_index(e: BaseException, idx: int) -> BaseException:
 
 
 def _prefetch_iter(make_gen, depth: int = 2, prepare=None, clock=None,
-                   metrics=None, prepare_retries: int = 2):
+                   metrics=None, prepare_retries: int = 2,
+                   stall_counter=None, overlap_counter=None):
     """Run a generator in a daemon worker thread, handing items over a
     bounded queue (``depth=2``: a DOUBLE-BUFFERED shard pipeline — the
     worker keeps shard N+1 fully prepared while the consumer computes
@@ -164,6 +144,13 @@ def _prefetch_iter(make_gen, depth: int = 2, prepare=None, clock=None,
       (the stream is producer-bound: IO/pack/H2D is the bottleneck);
     * ``stream.overlap_s`` — producer work seconds hidden behind
       consumer compute (the overlap the double buffer exists to buy).
+
+    ``stall_counter``/``overlap_counter`` override WHERE the two
+    totals land (pass counter cells, not names — metric names must
+    stay literals at their call sites for the SCT009 vocabulary
+    check): the out-of-core trainer routes the same accounting into
+    ``train.stall_s``/``train.overlap_s`` so a training run's device-
+    feed efficiency is separable from any concurrent ingest.
     """
     import queue
     import threading
@@ -230,7 +217,8 @@ def _prefetch_iter(make_gen, depth: int = 2, prepare=None, clock=None,
             put((_ERR, e, 0.0))
         put(_END)
 
-    threading.Thread(target=worker, daemon=True).start()
+    th = threading.Thread(target=worker, daemon=True)
+    th.start()
     stall_total = 0.0
     overlap_total = 0.0
     try:
@@ -254,8 +242,17 @@ def _prefetch_iter(make_gen, depth: int = 2, prepare=None, clock=None,
             q.get_nowait()
         except queue.Empty:
             pass
-        m.counter("stream.stall_s").inc(stall_total)
-        m.counter("stream.overlap_s").inc(overlap_total)
+        # bounded join: an early-exiting consumer (preemption, a
+        # cancelled training job, a device error mid-stream) must not
+        # leave the worker mid-device_put while the process tears
+        # down the runtime under it (observed as a C++ abort at
+        # interpreter exit).  Normal completion joins instantly; a
+        # wedged read is abandoned to its daemon fate after the bound.
+        th.join(timeout=10.0)
+        (stall_counter if stall_counter is not None
+         else m.counter("stream.stall_s")).inc(stall_total)
+        (overlap_counter if overlap_counter is not None
+         else m.counter("stream.overlap_s")).inc(overlap_total)
 
 
 @dataclasses.dataclass

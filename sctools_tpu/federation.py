@@ -955,7 +955,15 @@ class FederationSupervisor:
         high-water (shed a lower-priority victim or reject the
         arrival) → admit.  Same journal shape as the in-process
         scheduler: ``submitted`` → ``admitted`` | ``rejected``, then
-        exactly one of ``shed`` | ``run_completed`` | ``run_failed``."""
+        exactly one of ``shed`` | ``run_completed`` | ``run_failed``.
+
+        String step params may carry the ``{ticket_dir}`` placeholder
+        (expanded worker-side to the per-ticket directory in the
+        shared fed dir) — how a long-running training step
+        (``model.scvi_stream``) gets a cursor-checkpoint path that a
+        REQUEUED epoch finds again, so a worker lost mid-epoch costs
+        at most ``checkpoint_every`` shards of training, never the
+        epoch."""
         if not self._started:
             raise RuntimeError("FederationSupervisor.submit before "
                                "start() — use it as a context manager")
@@ -1352,6 +1360,22 @@ def worker_main(fed_dir: str, worker_id: str, gen: int = 0) -> int:
     return rc
 
 
+def _subst_ticket_dir(params: dict, tdir: str) -> dict:
+    """Expand the ``{ticket_dir}`` placeholder in string-valued step
+    params to the per-ticket directory.  The seam that makes
+    REQUEUED TRAINING TICKETS resume from the training cursor: a
+    ``model.scvi_stream`` step submitted with
+    ``checkpoint="{ticket_dir}/train.npz"`` resolves to the SAME path
+    on whichever worker owns the epoch (the ticket dir lives in the
+    shared fed dir), so the respawned owner picks up the previous
+    owner's mid-epoch cursor instead of restarting the epoch —
+    exactly the runner-checkpoint at-most-once story, extended to
+    sub-step (shard-boundary) granularity."""
+    return {k: (v.replace("{ticket_dir}", tdir)
+                if isinstance(v, str) and "{ticket_dir}" in v else v)
+            for k, v in params.items()}
+
+
 def _run_assignment(sched, assign: dict, wdir: str, fenced) -> None:
     """Run one assignment through the worker's inner scheduler and
     commit the result under the assignment epoch (fence re-checked at
@@ -1368,7 +1392,8 @@ def _run_assignment(sched, assign: dict, wdir: str, fenced) -> None:
         _say("done", ticket=tid, epoch=epoch, status="failed")
         _say("noise", ticket=tid, load_error=type(e).__name__)
         return
-    pipeline = Pipeline([Transform(name, backend=backend, **params)
+    pipeline = Pipeline([Transform(name, backend=backend,
+                                   **_subst_ticket_dir(params, tdir))
                          for name, backend, params in spec["steps"]])
     runner_kw = dict(spec.get("runner_kw") or {})
     # the SHARED per-ticket checkpoint home: a requeued epoch RESUMES

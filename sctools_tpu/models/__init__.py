@@ -1,3 +1,4 @@
 """Deep probabilistic models.  Importing registers their transforms."""
 
 from . import scvi  # noqa: F401
+from . import train_stream  # noqa: F401

@@ -140,7 +140,15 @@ def elbo_fn(params, x, batch_oh, key, kl_weight=1.0):
 @partial(jax.jit, static_argnames=("n_steps", "batch_size"))
 def _train_epoch(params, opt_state, Xd, batch_oh, perm, key, kl_weight,
                  *, n_steps: int, batch_size: int):
-    """One epoch as a single compiled scan over minibatches."""
+    """One epoch as a single compiled scan over minibatches.
+
+    Also the out-of-core trainer's PER-SHARD program
+    (``models/train_stream.py``): there ``Xd`` is one decoded store
+    shard and ``perm`` samples its real rows, so the identical update
+    math serves both the in-RAM and the streaming path — the loss-
+    parity contract between them rests on this function being the
+    single implementation.  Uniform shard shapes mean one compiled
+    program serves every full shard."""
     tx = _make_tx()
 
     def step(carry, i):

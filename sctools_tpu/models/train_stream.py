@@ -1,0 +1,512 @@
+"""Preemption-tolerant out-of-core scVI training on a durable shard
+store — the workload rung the fault ladder never carried.
+
+Every rung built so far (retry → breaker → degrade → quarantine →
+requeue) protects runs that finish in seconds; *training* on a store
+that never fits host RAM (the annbatch story, PAPERS.md) means
+hours-long jobs where a crash, preemption or lost device mid-epoch is
+a certainty.  This module marries ``data/shardstore.py`` to the scvi
+trainer (``models/scvi.py``) into a crash-safe loop, in three layers:
+
+**Device feed** — each epoch walks the store in a PERMUTED-BLOCK
+shard order (:func:`epoch_shard_order`: blocks of consecutive shards
+shuffled per epoch, ascending within a block) so training sees fresh
+data order every epoch while the read scheduler's elevator heap still
+serves the in-flight window in ascending shard order — epoch-level
+randomness, coalesced disk reads.  Shards stream through the
+double-buffered prefetch worker (``data/stream.py``
+``_prefetch_iter``): native chunk decode + ``device_put`` + densify
+of shard N+1 overlap the compiled train scan on shard N, accounted in
+``train.overlap_s``/``train.stall_s``.  The per-shard program IS
+``models/scvi.py`` ``_train_epoch`` — the identical minibatch update
+math as the in-RAM path, which is what the loss-parity gate
+(``bench.py --phase train``) rests on.
+
+**Crash-safe cursor** — with ``checkpoint=``, optimizer state +
+params + the training cursor (epoch, position in the epoch's permuted
+order, global step, partial-epoch loss accumulators) are written
+through the checkpoint integrity layer after every
+``checkpoint_every`` shards (``utils/checkpoint.py``
+``save_npz_generations``: content digest + schema + identity
+fingerprint, atomic rename, previous generation rotated to
+``.prev``).  Every RNG input is a PURE FUNCTION of (seed, epoch,
+position/shard) — no sequential host RNG state survives only in
+memory — so a SIGKILL at ANY minibatch resumes from the last shard
+boundary and, in the deterministic regime, reaches params BITWISE
+IDENTICAL to an uninterrupted run (tier-1 pins this).  A corrupt
+training checkpoint is QUARANTINED (never deleted, reason sidecar)
+and resume falls back one generation — never a silent epoch restart.
+Argument mismatches stay ``ValueError``: a cursor for different
+hyperparameters is WRONG, not corrupt.
+
+**Cooperative preemption** — at every shard boundary the trainer
+polls ``failsafe.check_preempt()`` (plus an optional explicit
+``preempt=`` token).  A pending request — a high-priority serving run
+borrowing the device through ``RunScheduler``, a
+``RunHandle.cancel()``, or a chaos ``preempt`` fault — makes the
+trainer SAVE ITS CURSOR FIRST and then raise
+``failsafe.JobPreempted``: checkpoint-then-yield.  The scheduler
+requeues the ticket with its cursor (reason ``"cancelled"`` terminals
+it as shed instead); the next dispatch resumes from the cursor,
+journaled ``train_resume`` — no replayed shards, provable from the
+``train_shard`` (epoch, pos) pairs.  Device-failure rulings mid-epoch
+(breaker-open, mesh-shrink, host_lost) compose for free: the runner
+retries/degrades the training STEP, and the retried attempt re-enters
+here and resumes from the same cursor file.
+
+Journal events: ``train_resume`` → (``train_shard`` … ``train_epoch``
+| ``train_checkpoint``)* → (``preempted`` | completion).  Metrics:
+the ``train.*`` family (SCT009 vocabulary).  Every wait rides the
+injectable clock; chaos preemption counts shard-boundary polls, so
+the whole ladder is tier-1 testable with zero real sleeps.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import warnings
+
+import jax
+import numpy as np
+
+from ..data.shardstore import ShardStore
+from ..data.stream import _prefetch_iter
+from ..registry import register
+from ..utils import telemetry
+from ..utils.checkpoint import (clear_npz_generations,
+                                load_npz_generations,
+                                save_npz_generations)
+from ..utils.failsafe import JobPreempted, check_preempt
+from ..utils.vclock import SYSTEM_CLOCK
+from .scvi import _make_tx, _train_epoch, init_params
+
+#: identity fingerprint the cursor checkpoints carry (a foreign file
+#: renamed onto the cursor path fails verification instead of
+#: half-parsing); bump on incompatible cursor layout changes
+_CURSOR_FP = "scvi-stream-v1"
+
+
+def epoch_shard_order(n_shards: int, epoch: int, seed: int,
+                      block: int = 4) -> np.ndarray:
+    """The epoch's shard visit order: permuted at BLOCK granularity —
+    blocks of ``block`` consecutive shards are shuffled, order within
+    a block stays ascending.  Pure function of (seed, epoch), so a
+    resumed epoch recomputes the identical order from its cursor
+    alone.  Block permutation is the randomness/locality compromise:
+    the trainer sees a fresh data order every epoch, while the read
+    scheduler's lookahead window still holds near-consecutive shard
+    indices that its elevator heap serves in ascending disk order."""
+    if n_shards <= 0:
+        return np.zeros(0, np.int64)
+    block = max(1, int(block))
+    rng = np.random.default_rng([int(seed) & 0x7FFFFFFF, int(epoch),
+                                 0x5EED])
+    n_blocks = -(-n_shards // block)
+    out = []
+    for b in rng.permutation(n_blocks):
+        out.extend(range(b * block, min((b + 1) * block, n_shards)))
+    return np.asarray(out, np.int64)
+
+
+def _shard_perm(rows: int, take: int, seed: int, epoch: int,
+                shard: int) -> np.ndarray:
+    """Minibatch row sampling for one shard: a permutation of the
+    shard's REAL rows, derived from (seed, epoch, shard) — pure
+    function, so resume replays nothing and skips nothing."""
+    rng = np.random.default_rng([int(seed) & 0x7FFFFFFF, int(epoch),
+                                 int(shard), 0xBA7C4])
+    return rng.permutation(rows)[:take].astype(np.int32)
+
+
+def _as_journal(j):
+    if j is None or hasattr(j, "write"):
+        return j
+    from ..runner import _Journal
+
+    return _Journal(str(j))
+
+
+def _state_template(n_genes: int, n_latent: int, n_hidden: int):
+    """Params/opt-state pytrees with the run's exact structure (values
+    irrelevant) — the treedefs cursor checkpoints unflatten into."""
+    params = init_params(jax.random.PRNGKey(0), n_genes, 0,
+                         n_latent, n_hidden)
+    return params, _make_tx().init(params)
+
+
+def _pack_state(params, opt_state) -> dict:
+    out = {}
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(params)):
+        out[f"p{i:03d}"] = np.asarray(leaf)
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(opt_state)):
+        out[f"o{i:03d}"] = np.asarray(leaf)
+    return out
+
+
+def _unpack_state(z: dict, n_genes: int, n_latent: int,
+                  n_hidden: int):
+    pt, ot = _state_template(n_genes, n_latent, n_hidden)
+    p_leaves = [z[f"p{i:03d}"] for i in range(
+        len(jax.tree_util.tree_leaves(pt)))]
+    o_leaves = [z[f"o{i:03d}"] for i in range(
+        len(jax.tree_util.tree_leaves(ot)))]
+    params = jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(pt), p_leaves)
+    opt_state = jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(ot), o_leaves)
+    return params, opt_state
+
+
+class _Cursor:
+    """The mutable training position one checkpoint freezes: epoch,
+    position within the epoch's permuted shard order, global step,
+    and the partial-epoch loss accumulators (so a mid-epoch resume
+    reports the same epoch mean an uninterrupted run would)."""
+
+    __slots__ = ("epoch", "pos", "step", "loss_sum", "loss_steps",
+                 "history")
+
+    def __init__(self):
+        self.epoch = 0
+        self.pos = 0
+        self.step = 0
+        self.loss_sum = 0.0
+        self.loss_steps = 0
+        self.history: list[float] = []
+
+    def as_dict(self) -> dict:
+        return {"epoch": self.epoch, "pos": self.pos,
+                "step": self.step}
+
+
+def fit_scvi_stream(store, *, n_latent: int = 10, n_hidden: int = 128,
+                    epochs: int = 10, batch_size: int = 512,
+                    seed: int = 0, kl_warmup: int = 10,
+                    scheduler=None, checkpoint: str | None = None,
+                    checkpoint_every: int = 1, order_block: int = 4,
+                    prefetch: bool = True, prefetch_depth: int = 2,
+                    encode: bool = False, preempt=None,
+                    clock=None, metrics=None, journal=None) -> dict:
+    """Train the NB-VAE (``models/scvi.py`` generative model, no
+    batch covariate) out-of-core over a :class:`ShardStore` — the
+    module docstring has the crash/preemption contract.
+
+    Parameters
+    ----------
+    store : ShardStore | str
+        The durable shard store (or its directory).  The full counts
+        never materialise: at most ``prefetch_depth + 1`` decoded
+        dense shards are in flight.
+    scheduler : ShardReadScheduler | None
+        Route every shard read through the IO-failure ladder
+        (retry/hedge/quarantine, RAM budget); ``None`` reads the
+        store directly (still verified).
+    checkpoint : str | None
+        Cursor checkpoint path → the run is RESUMABLE (and
+        preemption keeps its progress).  ``None`` disables
+        checkpointing — a preemption then restarts from scratch.
+    checkpoint_every : int
+        Cursor save cadence in shards (1 = every shard boundary, the
+        SIGKILL-anywhere-bitwise-resume regime).
+    order_block : int
+        Shard-order permutation block (:func:`epoch_shard_order`).
+    encode : bool
+        After training, stream ONE more ascending pass encoding every
+        cell → ``latent`` (n_cells, n_latent) in the result.
+    preempt : failsafe.PreemptToken | None
+        Explicit preemption signal; the thread-local scope installed
+        by ``RunScheduler`` (``failsafe.check_preempt``) is always
+        polled as well.
+    journal
+        ``runner._Journal``-shaped object or a path; receives the
+        ``train_*``/``preempted`` events.
+
+    Returns ``{"params", "history", "epochs_run", "resumed_from",
+    "latent"}`` (``latent`` only with ``encode=True``).
+    """
+    if scheduler is not None:
+        want = os.path.realpath(store if isinstance(store, str)
+                                else store.directory)
+        if os.path.realpath(scheduler.store.directory) != want:
+            raise ValueError("scheduler serves a different store")
+        store = scheduler.store
+        if scheduler.on_corrupt == "skip":
+            # the same refusal as ShardStore.source(): a silently
+            # skipped shard would shift every later position under
+            # the cursor — wrong per-shard RNG/permutation, a journal
+            # naming the wrong shards, and a checkpoint no resume
+            # could trust.  Corruption must FAIL the step (the
+            # runner's retry re-enters from the cursor).
+            raise ValueError(
+                "fit_scvi_stream: on_corrupt='skip' would silently "
+                "shift shard positions under the training cursor; "
+                "use on_corrupt='fail'")
+    elif isinstance(store, str):
+        store = ShardStore.open(store)
+    clock = clock if clock is not None else SYSTEM_CLOCK
+    m = metrics if metrics is not None else telemetry.default_registry()
+    journal = _as_journal(journal)
+    n_shards = store.n_shards
+    n_genes = store.n_genes
+    if n_shards == 0:
+        raise ValueError("fit_scvi_stream: empty store")
+    checkpoint_every = max(1, int(checkpoint_every))
+
+    # ---- deterministic init (mirrors scvi._fit's key schedule, so
+    # the streaming and in-RAM paths start from identical params)
+    base = jax.random.PRNGKey(seed)
+    key, ki = jax.random.split(base)
+    tx = _make_tx()
+    cur = _Cursor()
+    params = opt_state = None
+    resumed_from = None
+
+    # ---- resume: verified cursor load, quarantine-fallback one
+    # generation, argument mismatch = ValueError (wrong, not corrupt)
+    z = (load_npz_generations(checkpoint, fingerprint=_CURSOR_FP)
+         if checkpoint is not None else None)
+    if z is not None:
+        want = dict(n_cells=store.n_cells, n_genes=n_genes,
+                    n_latent=n_latent, n_hidden=n_hidden,
+                    batch_size=batch_size, seed=seed,
+                    kl_warmup=kl_warmup, order_block=order_block)
+        got = {k: int(z[k]) for k in want}
+        if got != want:
+            raise ValueError(
+                f"fit_scvi_stream: checkpoint {checkpoint!r} was "
+                f"written for different arguments ({got} != {want}); "
+                f"delete it or pass a fresh path")
+        if str(z["store_digest"]) != str(
+                store.manifest.get("store_digest", "")):
+            raise ValueError(
+                f"fit_scvi_stream: checkpoint {checkpoint!r} belongs "
+                f"to a different store (digest mismatch); delete it "
+                f"or pass a fresh path")
+        params, opt_state = _unpack_state(z, n_genes, n_latent,
+                                          n_hidden)
+        cur.epoch = int(z["epoch"])
+        cur.pos = int(z["pos"])
+        cur.step = int(z["step"])
+        cur.loss_sum = float(z["loss_sum"])
+        cur.loss_steps = int(z["loss_steps"])
+        cur.history = [float(x) for x in z["history"]]
+        resumed_from = cur.as_dict()
+        m.counter("train.resumes").inc()
+        if journal is not None:
+            journal.write("train_resume", **cur.as_dict(),
+                          checkpoint=checkpoint)
+
+    last_saved = [None]
+
+    def save_cursor() -> None:
+        if checkpoint is None:
+            return
+        if last_saved[0] == (cur.epoch, cur.pos):
+            # already persisted at this exact cursor (a preemption
+            # right after a due save): a second write would rotate
+            # the REAL previous generation out of .prev, silently
+            # shortening the corrupt-checkpoint fallback to zero
+            return
+        last_saved[0] = (cur.epoch, cur.pos)
+        save_npz_generations(
+            checkpoint, fingerprint=_CURSOR_FP,
+            n_cells=store.n_cells, n_genes=n_genes,
+            n_latent=n_latent, n_hidden=n_hidden,
+            batch_size=batch_size, seed=seed, kl_warmup=kl_warmup,
+            order_block=order_block,
+            store_digest=str(store.manifest.get("store_digest", "")),
+            epoch=cur.epoch, pos=cur.pos, step=cur.step,
+            loss_sum=np.float64(cur.loss_sum),
+            loss_steps=cur.loss_steps,
+            # float64: the history round-trips through every
+            # preemption's checkpoint, and the loss-trajectory parity
+            # proof compares it against an uninterrupted run
+            history=np.asarray(cur.history, np.float64),
+            **_pack_state(params, opt_state))
+        m.counter("runner.checkpoint_writes").inc()
+        if journal is not None:
+            journal.write("train_checkpoint", **cur.as_dict())
+
+    if params is None:
+        params = init_params(ki, n_genes, 0, n_latent, n_hidden)
+        opt_state = tx.init(params)
+        # generation 0 is written BEFORE the first shard read: the
+        # prefetch worker runs reads AHEAD of the (JIT-compiling)
+        # first train step, so a SIGKILL early in the epoch can land
+        # with several reads done but no shard boundary reached —
+        # this save makes that window resume through the verified-
+        # cursor path too, never a silent start-over
+        save_cursor()
+    else:
+        # a fresh save at the resume cursor would rotate the REAL
+        # previous generation out of .prev (identical content,
+        # corrupt-checkpoint fallback shortened to zero) — the
+        # loaded cursor counts as already persisted
+        last_saved[0] = (cur.epoch, cur.pos)
+
+    def poll_preempt() -> str | None:
+        r = preempt.pending() if preempt is not None else None
+        return r or check_preempt()
+
+    def yield_now(reason: str) -> None:
+        if checkpoint is None:
+            warnings.warn(
+                "fit_scvi_stream: preempted without a checkpoint= — "
+                "progress is lost; the requeued run restarts from "
+                "scratch", RuntimeWarning, stacklevel=3)
+        else:
+            save_cursor()
+        m.counter("train.preemptions", reason=reason).inc()
+        if journal is not None:
+            journal.write("preempted", reason=reason,
+                          **cur.as_dict())
+        raise JobPreempted(
+            f"training yielded at epoch {cur.epoch} pos {cur.pos} "
+            f"({reason})", reason=reason, cursor=cur.as_dict())
+
+    import jax.numpy as jnp
+
+    def to_device_dense(sh):
+        # runs IN the prefetch worker: H2D + densify of shard N+1
+        # overlap the compiled train scan on shard N
+        d = sh.device_put()
+        return d.to_dense(), sh.n_cells
+
+    stall_c = m.counter("train.stall_s")
+    overlap_c = m.counter("train.overlap_s")
+
+    while cur.epoch < epochs:
+        ep = cur.epoch
+        order = epoch_shard_order(n_shards, ep, seed,
+                                  block=order_block)
+        klw = jnp.float32(min(1.0, (ep + 1) / max(kl_warmup, 1)))
+        ke = jax.random.fold_in(key, ep)
+        tail = [int(s) for s in order[cur.pos:]]
+
+        def feed(tail=tail):
+            if scheduler is not None:
+                yield from scheduler.iter_order(tail)
+            else:
+                for si in tail:
+                    yield store.read_shard(si)
+
+        it = (_prefetch_iter(feed, depth=prefetch_depth,
+                             prepare=to_device_dense, clock=clock,
+                             metrics=m, stall_counter=stall_c,
+                             overlap_counter=overlap_c)
+              if prefetch else
+              (to_device_dense(sh) for sh in feed()))
+        try:
+            for Xd, rows in it:
+                shard = int(order[cur.pos])
+                bs = min(batch_size, rows)
+                n_steps = max(rows // bs, 1)
+                perm = jnp.asarray(_shard_perm(
+                    rows, n_steps * bs, seed, ep, shard))
+                oh = jnp.zeros((Xd.shape[0], 0), jnp.float32)
+                ks = jax.random.fold_in(ke, cur.pos)
+                params, opt_state, loss = _train_epoch(
+                    params, opt_state, Xd, oh, perm, ks, klw,
+                    n_steps=n_steps, batch_size=bs)
+                # the fetch is the per-shard sync point: the journal
+                # and the cursor need host values anyway, and it makes
+                # the consumer wall real for the overlap accounting
+                loss_f = float(loss)
+                cur.loss_sum += loss_f * n_steps
+                cur.loss_steps += n_steps
+                cur.step += n_steps
+                cur.pos += 1
+                m.counter("train.steps").inc(n_steps)
+                m.counter("train.shards").inc()
+                # save BEFORE journaling the shard: a kill between the
+                # two leaves a journal gap, never a replayed shard —
+                # the (epoch, pos) uniqueness proof rests on this
+                # order AND on checkpoint_every=1; a coarser cadence
+                # trades it away (a kill between saves replays up to
+                # checkpoint_every-1 shards, honestly re-journaled as
+                # repeated pairs)
+                if (cur.pos % checkpoint_every == 0
+                        or cur.pos >= len(order)):
+                    save_cursor()
+                if journal is not None:
+                    journal.write("train_shard", epoch=ep,
+                                  pos=cur.pos - 1, shard=shard,
+                                  loss=round(loss_f, 6),
+                                  steps=n_steps)
+                r = poll_preempt()
+                if r is not None:
+                    yield_now(r)
+        finally:
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()  # stop the prefetch worker + flush counters
+        loss_ep = cur.loss_sum / max(cur.loss_steps, 1)
+        cur.history.append(loss_ep)
+        cur.epoch += 1
+        cur.pos = 0
+        cur.loss_sum = 0.0
+        cur.loss_steps = 0
+        m.counter("train.epochs").inc()
+        m.gauge("train.loss", epoch=ep).set(loss_ep)
+        save_cursor()
+        if journal is not None:
+            journal.write("train_epoch", epoch=ep,
+                          loss=round(loss_ep, 6), step=cur.step)
+
+    out = {"params": params, "history": np.asarray(cur.history,
+                                                   np.float64),
+           "epochs_run": cur.epoch, "resumed_from": resumed_from,
+           "latent": None}
+    if encode:
+        from .scvi import _encode
+
+        parts = []
+        it = (scheduler.iter_shards() if scheduler is not None
+              else store.iter_shards())
+        for sh in it:
+            d = sh.device_put()
+            oh = jnp.zeros((d.rows_padded, 0), jnp.float32)
+            parts.append(np.asarray(
+                _encode(params, d.to_dense(), oh))[: sh.n_cells])
+        out["latent"] = np.concatenate(parts, axis=0)
+    if checkpoint is not None:
+        clear_npz_generations(checkpoint)  # done; cursor is stale
+    return out
+
+
+@register("model.scvi_stream", backend="tpu")
+@register("model.scvi_stream", backend="cpu")
+def scvi_stream(data, store_dir: str = "", n_latent: int = 10,
+                n_hidden: int = 128, epochs: int = 10,
+                batch_size: int = 512, seed: int = 0,
+                kl_warmup: int = 10, checkpoint: str | None = None,
+                checkpoint_every: int = 1, order_block: int = 4,
+                encode: bool = False, journal: str | None = None):
+    """Train scVI OUT-OF-CORE on the durable shard store at
+    ``store_dir`` (see :func:`fit_scvi_stream` — permuted-block shard
+    order, prefetched device feed, mid-epoch checkpointed resume,
+    cooperative preemption).  The counts stream from disk, so
+    ``data`` is a carrier, not the training set: results land in its
+    uns — ``scvi_stream_elbo_history`` (negative ELBO per epoch),
+    ``scvi_stream_epochs`` and, with ``encode=True``,
+    ``scvi_stream_latent`` ((store n_cells, n_latent) posterior
+    means).  ``checkpoint=``/``journal=`` accept paths containing the
+    ``{ticket_dir}`` placeholder under federation (the worker
+    substitutes the per-ticket directory, so a REQUEUED training
+    ticket resumes from the previous owner's cursor).  One
+    registration serves both backends: the program is identical, only
+    the device differs.  Submitted through ``RunScheduler`` with
+    ``preemptible=True`` this is the long-running job the cooperative
+    preemption contract exists for."""
+    res = fit_scvi_stream(
+        ShardStore.open(store_dir), n_latent=n_latent,
+        n_hidden=n_hidden, epochs=epochs, batch_size=batch_size,
+        seed=seed, kl_warmup=kl_warmup, checkpoint=checkpoint,
+        checkpoint_every=checkpoint_every, order_block=order_block,
+        encode=encode, journal=journal)
+    uns = {"scvi_stream_elbo_history": res["history"],
+           "scvi_stream_epochs": np.int64(res["epochs_run"])}
+    if res["latent"] is not None:
+        uns["scvi_stream_latent"] = res["latent"]
+    return data.with_uns(**uns)

@@ -104,11 +104,11 @@ from .utils.checkpoint import (CheckpointCorruptError, data_digest,
                                step_fingerprint, latest_step)
 from .utils.failsafe import (DETERMINISTIC, FATAL, TRANSIENT,
                              CircuitBreaker, DeadlineToken,
-                             StepDeadlineExceeded, check_deadline,
-                             classify_child_result, classify_error,
-                             current_deadline, deadline_scope,
-                             default_breaker_registry, probe_device,
-                             run_isolated)
+                             JobPreempted, StepDeadlineExceeded,
+                             check_deadline, classify_child_result,
+                             classify_error, current_deadline,
+                             deadline_scope, default_breaker_registry,
+                             probe_device, run_isolated)
 from .utils.vclock import SYSTEM_CLOCK
 
 #: the backend runs degrade to when the accelerator is ruled
@@ -174,6 +174,9 @@ class StepReport:
 @dataclasses.dataclass
 class RunReport:
     status: str = "pending"   # pending|completed|failed|aborted
+    #                           |preempted (cooperative yield — the
+    #                           run is NOT terminal; it resumes from
+    #                           its cursor on the next dispatch)
     backend: str | None = None
     degraded: bool = False
     resumed_from: int | None = None
@@ -867,6 +870,22 @@ class ResilientRunner:
                     except BaseException as e:  # noqa: BLE001 — reported,
                         err = e                 # classified, re-raised below
                 self._spans.append(sp)
+                if isinstance(err, JobPreempted):
+                    # cooperative checkpoint-then-yield, NOT a failure:
+                    # the step saved its cursor before raising, so the
+                    # ruling is neither retry nor degrade — journal the
+                    # yield and hand the raise to the caller (the
+                    # scheduler requeues the ticket with its cursor;
+                    # reason="cancelled" terminals it as shed).  No
+                    # terminal run event: like a real preemption, the
+                    # journal's next line is the resumed run's
+                    # run_start.
+                    sr.status = "pending"
+                    self.report.status = "preempted"
+                    self.journal.write("preempted", step=i,
+                                       name=t.name, reason=err.reason,
+                                       cursor=err.cursor)
+                    raise err
                 status = "ok" if err is None else "error"
                 self.metrics.counter("runner.attempts", status=status,
                                      backend=b).inc()

@@ -43,11 +43,24 @@ scheduling layer in front of ``runner.ResilientRunner``:
   metrics in the shared ``MetricsRegistry``: queue-depth gauge,
   admitted/rejected/shed counters labelled ``tenant=``/``reason=``,
   and a queue-wait histogram.
+* **Cooperative preemption** — ``submit(..., preemptible=True)``
+  declares a long-running checkpoint-then-yield job (the out-of-core
+  trainer, ``models/train_stream.py``).  A strictly-higher-priority
+  arrival with every worker busy asks the lowest-priority running
+  preemptible job to yield (``failsafe.PreemptToken``, polled by the
+  job at its shard boundaries): the job saves its cursor, raises
+  ``JobPreempted``, is journaled ``preempted`` (NOT terminal) and
+  re-enters the queue — the next dispatch RESUMES from the cursor.
+  ``RunHandle.cancel()`` rides the same path (queued = shed
+  ``reason="cancelled"``; running = yield then terminal shed),
+  closing the "no way to stop a long job" gap.
 * **Chaos** — ``chaos=`` arms the same seeded ``ChaosMonkey`` for
   every worker (activated once for the pool's lifetime, so faults
   fire on every thread) AND gives admission its own fault channel:
   ``reject_storm`` faults fire through ``ChaosMonkey.on_admission``,
-  so the shed/reject paths are tier-1 testable like device faults.
+  so the shed/reject paths are tier-1 testable like device faults;
+  ``preempt`` faults fire through ``ChaosMonkey.on_worker`` at a
+  preemptible job's Nth shard-boundary poll.
 
 All scheduling runs on the injectable clock (``utils/vclock.py``) —
 queue waits, deadline estimates and EWMA run walls move on a
@@ -75,11 +88,15 @@ from .registry import Pipeline
 from .runner import (DEFAULT_FALLBACK_BACKEND, ResilientRunner,
                      _Journal, run_backend_signature)
 from .utils import telemetry
-from .utils.failsafe import BreakerRegistry, default_breaker_registry
+from .utils.failsafe import (BreakerRegistry, JobPreempted,
+                             PreemptToken, default_breaker_registry,
+                             preempt_scope)
 from .utils.vclock import SYSTEM_CLOCK
 
 #: every submission ends in exactly ONE of these (the journal
-#: coherence contract the chaos soak asserts)
+#: coherence contract the chaos soak asserts).  ``preempted`` is
+#: deliberately NOT terminal: a preempted ticket re-enters the queue
+#: with its cursor and still terminates exactly once later.
 TERMINAL_STATES = ("completed", "failed", "rejected", "shed")
 
 #: EWMA smoothing for observed run walls (the deadline estimator)
@@ -131,6 +148,24 @@ class RunHandle:
         self._result = None
         self._error: BaseException | None = None
         self._terminal = threading.Event()
+        self._cancel_cb = None  # wired by the owning scheduler
+
+    def cancel(self) -> bool:
+        """Cooperatively cancel this submission.  QUEUED: shed
+        immediately (journaled ``shed`` ``reason="cancelled"``,
+        ``result()`` raises :class:`RunShed`).  RUNNING: the same
+        checkpoint-then-yield path as preemption — the run's preempt
+        token is armed with ``reason="cancelled"`` and a job that
+        polls it (the out-of-core trainer does, at every shard
+        boundary) checkpoints, yields, and terminals as shed exactly
+        once.  Returns True when the cancellation was DELIVERED
+        (shed, or the running job's token armed); False when the run
+        is already terminal.  Cooperative by design: a running job
+        that never polls its token simply completes — cancellation
+        can close the "no way to stop a long job" gap only for jobs
+        built to stop at safe boundaries."""
+        cb = self._cancel_cb
+        return bool(cb is not None and cb(self))
 
     @property
     def status(self) -> str:
@@ -204,6 +239,15 @@ class _QueueItem:
     backend: str | None
     runner_kw: dict
     handle: RunHandle
+    #: declared long-running + checkpoint-then-yield capable: a
+    #: preemption victim when higher-priority work arrives with no
+    #: free worker (the job polls its token at its safe boundaries)
+    preemptible: bool = False
+    #: the run's cooperative preemption signal (fresh per dispatch —
+    #: a consumed yield must not instantly re-fire on the requeue)
+    token: PreemptToken | None = None
+    #: times this ticket checkpoint-then-yielded so far
+    preemptions: int = 0
 
     def sort_key(self):
         # higher priority first, FIFO within a priority
@@ -294,6 +338,7 @@ class RunScheduler:
         self._cv = threading.Condition(self._lock)
         self._queue: list[_QueueItem] = []   # kept sorted by sort_key
         self._queued_by_tenant: dict[str, int] = {}
+        self._running_items: list[_QueueItem] = []
         self._running_total = 0
         self._running_by_tenant: dict[str, int] = {}
         self._seq = 0
@@ -302,7 +347,7 @@ class RunScheduler:
         self._ewma_run_s = float(expected_run_s)
         self._stats = {
             "submitted": 0, "admitted": 0, "rejected": 0, "shed": 0,
-            "completed": 0, "failed": 0,
+            "completed": 0, "failed": 0, "preempted": 0,
             "max_queue_depth": 0, "max_in_flight_total": 0,
             "max_in_flight_by_tenant": {},
         }
@@ -332,7 +377,8 @@ class RunScheduler:
     def submit(self, pipeline: Pipeline, data, *, tenant: str = "default",
                priority: int = 0, deadline_s: float | None = None,
                backend: str | None = None,
-               runner_kw: dict | None = None) -> RunHandle:
+               runner_kw: dict | None = None,
+               preemptible: bool = False) -> RunHandle:
         """Admit one run (or refuse it, raising :class:`RunRejected`).
 
         Admission rulings, in order: scheduler open → chaos
@@ -340,8 +386,26 @@ class RunScheduler:
         feasibility → global high-water (shed a lower-priority victim
         or reject the arrival).  An admitted run returns a
         :class:`RunHandle`; its journal trail is
-        ``submitted`` → ``admitted`` → (``shed`` | ``run_completed``
-        | ``run_failed``)."""
+        ``submitted`` → ``admitted`` → (``preempted`` …)* →
+        (``shed`` | ``run_completed`` | ``run_failed``).
+
+        ``preemptible=True`` declares a LONG-RUNNING job that honours
+        the cooperative checkpoint-then-yield contract (it polls
+        ``failsafe.check_preempt()`` at its safe boundaries — the
+        out-of-core trainer does, at every shard boundary): when a
+        strictly-higher-priority submission arrives and every worker
+        is busy, the lowest-priority running preemptible job is asked
+        to yield; it saves its cursor, raises ``JobPreempted``, is
+        journaled ``preempted`` (NOT a terminal state) and RE-ENTERS
+        the queue — the next dispatch resumes from the cursor instead
+        of the job being shed or restarted.  Queue-wait accounting
+        and the ``deadline_s`` ruling restart PER SEGMENT on requeue:
+        wall the job spent running is progress (it holds a cursor),
+        not queue wait, and must not terminal-shed the resumed
+        segment as ``deadline_expired``.  A chaos ``preempt``
+        fault (consulted per shard-boundary poll through
+        ``ChaosMonkey.on_worker``, pattern = the tenant name) rules
+        the same yield deterministically."""
         with self._cv:
             ticket = self._seq
             self._seq += 1
@@ -371,9 +435,11 @@ class RunScheduler:
                     self._reject(ticket, tenant, "queue_full")
                 self._shed_locked(victim, "queue_high_water")
             handle = RunHandle(ticket, tenant, priority, deadline_s)
+            handle._cancel_cb = self._cancel
             item = _QueueItem(ticket, tenant, int(priority), deadline_s,
                               self.clock.monotonic(), pipeline, data,
-                              backend, dict(runner_kw or {}), handle)
+                              backend, dict(runner_kw or {}), handle,
+                              preemptible=bool(preemptible))
             self._insert_locked(item)
             self._stats["admitted"] += 1
             self.journal.write("admitted", ticket=ticket, tenant=tenant,
@@ -381,8 +447,50 @@ class RunScheduler:
                                queue_depth=len(self._queue))
             self.metrics.counter("sched.admitted", tenant=tenant).inc()
             self._ensure_workers_locked()
+            # high-priority arrival with every worker busy: ask the
+            # lowest-priority RUNNING preemptible job to checkpoint-
+            # then-yield — serving traffic borrows the device, the
+            # training job re-enters the queue with its cursor
+            # instead of being shed
+            if self._running_total >= self.max_concurrency:
+                victim = self._pick_preempt_victim_locked(priority)
+                if victim is not None:
+                    victim.token.request("priority")
             self._cv.notify()
             return handle
+
+    def _pick_preempt_victim_locked(self, new_priority: int):
+        """The running job to preempt for an arriving
+        ``new_priority`` submission: preemptible, strictly lower
+        priority (yielding an equal never helps the arrival), not
+        already asked to yield; lowest priority first, tie-broken
+        toward the youngest (oldest work keeps its claim, mirroring
+        the shed rule).  None → nobody to preempt; the arrival waits
+        its turn in the queue."""
+        cands = [it for it in self._running_items
+                 if it.preemptible and it.priority < new_priority
+                 and it.token is not None
+                 and it.token.requested() is None]
+        if not cands:
+            return None
+        return min(cands, key=lambda it: (it.priority, -it.seq))
+
+    def _cancel(self, handle: RunHandle) -> bool:
+        """``RunHandle.cancel()``'s implementation (see its docstring
+        for the contract).  Under the dispatch lock the handle's item
+        is in exactly one of {queue, running set, terminal}."""
+        with self._cv:
+            if handle.done():
+                return False
+            for it in self._queue:
+                if it.handle is handle:
+                    self._shed_locked(it, "cancelled")
+                    return True
+            for it in self._running_items:
+                if it.handle is handle and it.token is not None:
+                    it.token.request("cancelled")
+                    return True
+        return False
 
     def _quota(self, tenant: str) -> TenantQuota:
         return self._quotas.get(tenant, self._default_quota)
@@ -503,6 +611,13 @@ class RunScheduler:
                 continue
             self._remove_locked(it)
             self._running_total += 1
+            # a FRESH token per dispatch: the previous dispatch's
+            # consumed yield must not instantly re-preempt the
+            # resumed run (the chaos probe carries over — its
+            # per-tenant boundary-poll windows keep counting)
+            it.token = PreemptToken(
+                probe=self._preempt_probe(it.tenant))
+            self._running_items.append(it)
             n = self._running_by_tenant.get(it.tenant, 0) + 1
             self._running_by_tenant[it.tenant] = n
             self._stats["max_in_flight_total"] = max(
@@ -511,6 +626,23 @@ class RunScheduler:
             per[it.tenant] = max(per.get(it.tenant, 0), n)
             return it
         return None
+
+    def _preempt_probe(self, tenant: str):
+        """The chaos seam of a run's preempt token: each poll (= one
+        shard boundary of a preemptible job) consults the WORKER
+        fault channel under the tenant's name, so a ``preempt`` fault
+        with ``on_call=N`` yields the job at exactly its Nth
+        boundary — deterministic on one VirtualClock."""
+        if self.chaos is None:
+            return None
+
+        def probe():
+            f = self.chaos.on_worker(tenant)
+            if f is not None and f.get("mode") == "preempt":
+                return "preempt"
+            return None
+
+        return probe
 
     def _worker(self) -> None:
         while True:
@@ -527,10 +659,18 @@ class RunScheduler:
                 item.handle._mark_running()
             t0 = self.clock.monotonic()
             status, result, error = "completed", None, None
+            preempted: JobPreempted | None = None
             runner = None
             try:
-                runner = self._make_runner(item)
-                result = runner.run(item.data, backend=item.backend)
+                with preempt_scope(item.token):
+                    runner = self._make_runner(item)
+                    result = runner.run(item.data,
+                                        backend=item.backend)
+            except JobPreempted as e:
+                # cooperative checkpoint-then-yield: the job saved its
+                # cursor and stopped at a safe boundary.  NOT terminal
+                # (unless cancelled) — ruled below under the lock.
+                preempted = e
             except BaseException as e:  # noqa: BLE001 — the worker
                 # must survive anything a run raises (including
                 # chaos-injected process-death stand-ins); the error
@@ -544,17 +684,87 @@ class RunScheduler:
             with self._cv:
                 self._running_total -= 1
                 self._running_by_tenant[item.tenant] -= 1
-                self._ewma_run_s = (
-                    wall if self._ewma_run_s <= 0.0
-                    else (1 - _EWMA_ALPHA) * self._ewma_run_s
-                    + _EWMA_ALPHA * wall)
-                self._stats[status] += 1
+                self._running_items.remove(item)
+                if preempted is None:
+                    # a preempted segment's wall is partial work — it
+                    # must not drag the deadline estimator down
+                    self._ewma_run_s = (
+                        wall if self._ewma_run_s <= 0.0
+                        else (1 - _EWMA_ALPHA) * self._ewma_run_s
+                        + _EWMA_ALPHA * wall)
+                    self._stats[status] += 1
+                else:
+                    # a cancel() that landed BETWEEN the yield and
+                    # this requeue armed a token nobody will poll
+                    # again — honour it here or the handle never
+                    # terminals (the job's cursor is saved either
+                    # way)
+                    if (preempted.reason != "cancelled"
+                            and item.token.requested() == "cancelled"):
+                        preempted = JobPreempted(
+                            str(preempted), reason="cancelled",
+                            cursor=preempted.cursor)
+                    if preempted.reason != "cancelled":
+                        # journal the yield BEFORE the ticket re-
+                        # enters the queue (the same rule submit()
+                        # follows for 'admitted'): with >1 worker the
+                        # resumed segment can be dispatched the
+                        # instant _insert_locked returns, and its
+                        # events — even its terminal — must never
+                        # precede this line
+                        self.journal.write(
+                            "preempted", ticket=item.seq,
+                            tenant=item.tenant,
+                            priority=item.priority,
+                            reason=preempted.reason,
+                            cursor=preempted.cursor,
+                            wall_s=round(wall, 4),
+                            queue_depth=len(self._queue))
+                        # requeue WITH the cursor: the job re-enters
+                        # at its own priority/seq (FIFO claim kept)
+                        # and the next dispatch resumes where it
+                        # yielded.  submitted_at restarts — queue
+                        # wait and the deadline_s ruling are PER
+                        # SEGMENT (a job preempted past its original
+                        # deadline already holds a cursor; shedding
+                        # it for wall it spent RUNNING would punish
+                        # exactly the cooperative yield the contract
+                        # asks for)
+                        item.preemptions += 1
+                        self._stats["preempted"] += 1
+                        item.handle._status = "queued"
+                        item.submitted_at = self.clock.monotonic()
+                        self._insert_locked(item)
                 self._cv.notify_all()
             # terminal journal writes OUTSIDE the dispatch lock: disk
             # latency must not stall other tenants' admission or other
             # workers' dispatch.  Ordering is safe — this ticket's
             # "admitted" line was flushed before the item ever entered
             # the queue, and _Journal serializes concurrent appends.
+            if preempted is not None:
+                if preempted.reason == "cancelled":
+                    # the cancel ruling: journaled terminal exactly
+                    # once, as a shed — the job checkpointed, so a
+                    # later identical submission resumes its cursor
+                    self._stats["shed"] += 1
+                    self.journal.write(
+                        "shed", ticket=item.seq, tenant=item.tenant,
+                        priority=item.priority, reason="cancelled",
+                        queue_depth=self.queue_depth())
+                    self.metrics.counter(
+                        "sched.shed", tenant=item.tenant,
+                        reason="cancelled").inc()
+                    item.handle._finish(
+                        "shed", error=RunShed(
+                            f"run {item.seq} (tenant "
+                            f"{item.tenant!r}) cancelled while "
+                            f"running: checkpoint-then-yield "
+                            f"honoured", reason="cancelled",
+                            tenant=item.tenant),
+                        reason="cancelled")
+                # (the non-cancelled yield was journaled under the
+                # lock, before the requeue became dispatchable)
+                continue
             if status == "completed":
                 self.journal.write(
                     "run_completed", ticket=item.seq,

@@ -37,7 +37,7 @@ from .vclock import SYSTEM_CLOCK
 MODES = ("unavailable", "hang", "wedge", "corrupt",
          "corrupt_checkpoint", "crash", "kill", "reject_storm",
          "slow_read", "truncate_shard", "io_error",
-         "kill_worker", "lease_wedge")
+         "kill_worker", "lease_wedge", "preempt")
 
 # which hook channel each mode fires on: most modes wrap the op CALL;
 # corrupt_checkpoint fires through the runner's on_checkpoint hook,
@@ -45,13 +45,18 @@ MODES = ("unavailable", "hang", "wedge", "corrupt",
 # fault's ``op`` pattern matches TENANT names, not transform names),
 # the three IO modes through the shard-read scheduler's on_io hook
 # (pattern matches CHUNK file basenames, e.g. "chunk-00002"), and the
-# two WORKER modes through the federation supervisor's on_worker hook
-# (pattern matches WORKER names, e.g. "w0" / "w*")
+# WORKER-channel modes through on_worker — consulted by the
+# federation supervisor per heartbeat (kill_worker / lease_wedge,
+# pattern matches WORKER names like "w0") AND by the run scheduler's
+# preemption probe per SHARD BOUNDARY of a preemptible job (preempt,
+# pattern matches the submission's TENANT name; ``on_call=N`` = the
+# Nth boundary poll)
 _MODE_CHANNEL = {"corrupt_checkpoint": "checkpoint",
                  "reject_storm": "admission",
                  "slow_read": "io", "truncate_shard": "io",
                  "io_error": "io",
-                 "kill_worker": "worker", "lease_wedge": "worker"}
+                 "kill_worker": "worker", "lease_wedge": "worker",
+                 "preempt": "worker"}
 
 
 class ChaosCrash(BaseException):
@@ -166,6 +171,16 @@ class ChaosMonkey:
       worker is ALIVE but its lease goes stale — the split-brain
       partition case: the supervisor must FENCE the old worker before
       requeueing, or both could commit).
+    * ``preempt`` — the run scheduler's cooperative checkpoint-then-
+      yield ruling, also on the WORKER channel: the scheduler's
+      preemption probe consults :meth:`on_worker` at every SHARD
+      BOUNDARY of a running preemptible job (the fault's ``op``
+      pattern matches the submission's TENANT name, so
+      ``Fault("train-lab", "preempt", on_call=3)`` preempts at the
+      3rd boundary).  The mode only RULES — the trainer saves its
+      cursor checkpoint and raises ``JobPreempted``, the scheduler
+      requeues the ticket — so the whole preempt → requeue → resume
+      ladder runs on one VirtualClock with zero real sleeps.
     * ``slow_read`` / ``truncate_shard`` / ``io_error`` — the IO
       channel (:meth:`on_io`, consulted by the shard-read scheduler
       for every chunk read; the fault's ``op`` pattern matches CHUNK

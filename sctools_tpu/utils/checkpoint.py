@@ -267,6 +267,56 @@ def load_npz_verified(path: str, *,
             if not k.startswith(_INTEGRITY)}
 
 
+def save_npz_generations(path: str, fingerprint: str | None = None,
+                         **arrays) -> str:
+    """:func:`save_npz_verified` with GENERATION ROTATION: the
+    previous file at ``path`` rotates to ``<path>.prev`` first, so a
+    reader whose newest generation is later ruled corrupt falls back
+    exactly ONE save (one shard / one cursor step of lost work)
+    instead of restarting the whole pass.  This is the write half of
+    the resumable-pass convention shared by the streaming passes
+    (``data/stream.py``) and the out-of-core trainer
+    (``models/train_stream.py``).  Returns the content digest."""
+    if os.path.exists(path):
+        os.replace(path, path + ".prev")
+    return save_npz_verified(path, fingerprint=fingerprint, **arrays)
+
+
+def load_npz_generations(path: str,
+                         fingerprint: str | None = None) -> dict | None:
+    """Verify-then-load the newest surviving generation written by
+    :func:`save_npz_generations`, falling back deterministically:
+    newest → ``.prev`` → ``None`` (fresh start).  A candidate that
+    fails verification — bit rot, a write truncated by the very crash
+    being recovered from, chaos damage — is QUARANTINED
+    (:func:`quarantine_checkpoint`: moved beside the data with a
+    ``.reason.json`` sidecar, never deleted) and the next generation
+    is tried.  Files from before the integrity layer carry no digest
+    and load as legacy."""
+    for cand in (path, path + ".prev"):
+        if not os.path.exists(cand):
+            continue
+        try:
+            return load_npz_verified(cand,
+                                     expect_fingerprint=fingerprint)
+        except CheckpointCorruptError as e:
+            dest = quarantine_checkpoint(cand, e.reason)
+            warnings.warn(
+                f"checkpoint {cand!r} failed verification "
+                f"({e.reason}) — quarantined to {dest!r}, falling "
+                f"back a generation", RuntimeWarning, stacklevel=3)
+    return None
+
+
+def clear_npz_generations(path: str) -> None:
+    """Remove every generation at ``path`` (the pass/run completed;
+    its resume state is stale, keeping it would resume a finished
+    run)."""
+    for cand in (path, path + ".prev"):
+        if os.path.exists(cand):
+            os.remove(cand)
+
+
 def verify_checkpoint(path: str,
                       expect_fingerprint: str | None = None) -> dict:
     """Re-hash a checkpoint before trusting it.

@@ -93,6 +93,24 @@ class StepDeadlineExceeded(TransientDeviceError):
     other transient, it never fails the run outright."""
 
 
+class JobPreempted(Exception):
+    """A long-running job cooperatively YIELDED at a safe boundary
+    after checkpointing (preemption or cancellation — ``reason``
+    says which; ``cursor`` is the job's machine-readable resume
+    position).  Deliberately neither transient nor deterministic:
+    the runner journals it as ``preempted`` and re-raises WITHOUT
+    retrying (the job already saved its state and wants to stop),
+    and the scheduler's worker either requeues the ticket (the job
+    re-enters the queue with its cursor) or — ``reason ==
+    "cancelled"`` — terminals it as shed."""
+
+    def __init__(self, msg: str, *, reason: str = "preempt",
+                 cursor: dict | None = None):
+        super().__init__(msg)
+        self.reason = reason
+        self.cursor = cursor or {}
+
+
 class DeterministicChildError(RuntimeError):
     """An isolated child died raising a deterministic program error
     (a ``ValueError``-class traceback in its stderr tail).  Registered
@@ -330,6 +348,96 @@ def check_deadline() -> None:
     tok = current_deadline()
     if tok is not None:
         tok.check()
+
+
+# ---------------------------------------------------------------------------
+# Cooperative preemption (checkpoint-then-yield)
+# ---------------------------------------------------------------------------
+
+#: innermost-last stack of active PreemptTokens, PER THREAD (the
+#: scheduler scopes one token per dispatched run on its own worker
+#: thread; thread A's preemption must never yield thread B's job)
+_PREEMPTS = threading.local()
+
+
+def _preempt_stack() -> list["PreemptToken"]:
+    stack = getattr(_PREEMPTS, "stack", None)
+    if stack is None:
+        stack = _PREEMPTS.stack = []
+    return stack
+
+
+class PreemptToken:
+    """A cooperative checkpoint-then-yield signal for long-running
+    jobs.  COOPERATIVE like :class:`DeadlineToken`: nothing interrupts
+    a running step — the job polls :func:`check_preempt` at its safe
+    boundaries (the out-of-core trainer checks at every SHARD
+    boundary), and on a pending request it saves its cursor state and
+    raises :class:`JobPreempted`.
+
+    ``request(reason)`` arms the token (first reason wins —
+    ``"cancelled"`` is terminal for the scheduler, anything else
+    requeues).  ``probe`` is the chaos seam: an optional zero-arg
+    callable consulted on every poll that may return a reason string
+    (the scheduler wires it to ``ChaosMonkey.on_worker`` so a
+    ``preempt`` fault fires at the Nth shard boundary on one
+    VirtualClock with zero real sleeps)."""
+
+    def __init__(self, probe=None):
+        self.probe = probe
+        self._reason: str | None = None
+        self._lock = threading.Lock()
+
+    def request(self, reason: str = "preempt") -> None:
+        with self._lock:
+            if self._reason is None:
+                self._reason = reason
+
+    def requested(self) -> str | None:
+        """The armed reason WITHOUT consulting the chaos probe — the
+        scheduler's victim pick peeks here (a peek must not burn a
+        shard-boundary fault window)."""
+        with self._lock:
+            return self._reason
+
+    def pending(self) -> str | None:
+        """The pending yield reason, or ``None``.  Consults the chaos
+        probe (if any) before answering, so injected preemptions are
+        counted per poll — i.e. per shard boundary."""
+        if self._reason is None and self.probe is not None:
+            r = self.probe()
+            if r:
+                self.request(str(r))
+        with self._lock:
+            return self._reason
+
+
+@contextlib.contextmanager
+def preempt_scope(token: PreemptToken):
+    """Make ``token`` the current preemption signal for the enclosed
+    block (on THIS thread — scopes never leak across scheduler
+    workers)."""
+    stack = _preempt_stack()
+    stack.append(token)
+    try:
+        yield token
+    finally:
+        stack.remove(token)
+
+
+def current_preempt() -> PreemptToken | None:
+    stack = _preempt_stack()
+    return stack[-1] if stack else None
+
+
+def check_preempt() -> str | None:
+    """The pending yield reason of the innermost active token (or
+    ``None`` — including outside any :func:`preempt_scope`).  The
+    POLLING half only: the job decides when to act, because it must
+    checkpoint BEFORE raising :class:`JobPreempted` — that ordering
+    is the whole crash-safety contract."""
+    tok = current_preempt()
+    return tok.pending() if tok is not None else None
 
 
 # ---------------------------------------------------------------------------

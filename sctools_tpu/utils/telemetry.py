@@ -88,6 +88,17 @@ EVENTS = frozenset({
     # mismatch at acceptance)
     "worker_spawned", "worker_lost", "worker_respawned",
     "assigned", "requeued", "commit_refused",
+    # preemption-tolerant training (models/train_stream.py +
+    # scheduler.py cooperative preemption): preempted = a job
+    # checkpoint-then-yielded at a shard boundary (runner: step-level
+    # record; scheduler: the ticket re-enters the queue with its
+    # cursor — NOT a terminal state — or terminals as shed when the
+    # reason is "cancelled"); train_shard/train_epoch mark completed
+    # training units (the no-replayed-shards proof joins on their
+    # (epoch, pos) pairs), train_checkpoint a cursor save,
+    # train_resume a restart from a verified cursor checkpoint
+    "preempted", "train_shard", "train_epoch", "train_checkpoint",
+    "train_resume",
 })
 
 #: Every legal metric name → one-line meaning (the docs table).  Like
@@ -161,9 +172,10 @@ METRICS = {
                       "(labels tenant=, reason= tenant_queue_quota|"
                       "deadline_unmeetable|queue_full|reject_storm|"
                       "scheduler_closed)",
-    "sched.shed": "counter: admitted runs dropped before running "
-                  "(labels tenant=, reason= queue_high_water|"
-                  "deadline_expired|shutdown)",
+    "sched.shed": "counter: admitted runs dropped before running or "
+                  "cooperatively cancelled while running (labels "
+                  "tenant=, reason= queue_high_water|"
+                  "deadline_expired|shutdown|cancelled)",
     "sched.queue_wait_s": "histogram: admission-to-dispatch queue "
                           "wait seconds (on the injectable clock)",
     "ingest.reads": "counter: shard reads served to a consumer "
@@ -204,6 +216,28 @@ METRICS = {
                          "from the cross-process transport (labels "
                          "signature=, to= open|closed) — how one "
                          "worker's trip short-circuits the pool",
+    "train.steps": "counter: optimizer steps taken by the streaming "
+                   "trainer (one per minibatch inside the per-shard "
+                   "scan)",
+    "train.epochs": "counter: training epochs completed over the "
+                    "shard store",
+    "train.shards": "counter: shards trained through (one per "
+                    "completed per-shard scan — the unit the resume "
+                    "cursor moves in)",
+    "train.preemptions": "counter: checkpoint-then-yield rulings "
+                         "honoured at a shard boundary (labels "
+                         "reason= preempt|cancelled|priority|...)",
+    "train.resumes": "counter: training runs resumed from a verified "
+                     "cursor checkpoint (never a silent epoch "
+                     "restart)",
+    "train.overlap_s": "counter: shard decode + device_put seconds "
+                       "hidden behind the train step on the previous "
+                       "shard (the double-buffered device feed)",
+    "train.stall_s": "counter: trainer seconds stalled waiting on "
+                     "the shard feed (IO-bound training)",
+    "train.loss": "gauge: mean negative ELBO of the last completed "
+                  "epoch (labels epoch=) — the loss trajectory "
+                  "sctreport renders",
 }
 
 #: Fixed histogram bucket upper bounds (seconds), chosen to straddle

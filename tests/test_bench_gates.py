@@ -207,3 +207,32 @@ def test_ingest_overlap_efficiency_gate():
 
     assert total_reads("slow_disk") == total_reads("clean") > 0, det
     assert "slow_disk_efficiency_delta" in det
+
+
+def test_train_overlap_and_parity_gate():
+    """The out-of-core TRAINING acceptance gate (ISSUE 12): scvi
+    trained on a shard store 10x the configured host-RAM budget must
+    (a) keep the prefetched device feed >= 0.8 overlap-efficient
+    (train.overlap_s/stall_s — decode + device_put of shard N+1
+    hidden behind the compiled train scan on N) and (b) land its
+    final loss within 5% of the in-RAM path on the same data, seed
+    and hyperparameters (the per-shard program IS the in-RAM epoch
+    scan, so only the permutation granularity differs).  One
+    re-measure is allowed before failing: this box has 2 cores and
+    CI neighbours."""
+    import jax
+
+    from tools.bench_train import run_train_bench
+
+    det = run_train_bench(jax)
+    if det["overlap_efficiency"] < 0.8:  # pragma: no cover - noisy box
+        det = run_train_bench(jax)
+    # the out-of-core contract itself: the store really was 10x the
+    # admitted in-flight budget and training actually ran
+    assert det["store_to_budget_ratio"] >= 10.0, det
+    assert det["train_steps"] > 0, det
+    assert det["overlap_efficiency"] >= 0.8, det
+    # loss parity vs in-RAM, and both paths genuinely trained
+    assert det["final_loss_rel_diff"] <= 0.05, det
+    assert det["stream_loss_final"] < det["stream_loss_first"], det
+    assert det["inram_loss_final"] < det["inram_loss_first"], det

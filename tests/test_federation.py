@@ -348,6 +348,85 @@ def test_crash_requeue_resumes_bitwise_identical(tmp_path):
                           np.asarray(out_clean.X))
 
 
+def test_ticket_dir_placeholder_substitution():
+    from sctools_tpu.federation import _subst_ticket_dir
+
+    params = {"checkpoint": "{ticket_dir}/cursor.npz",
+              "journal": "{ticket_dir}/tj.jsonl",
+              "store_dir": "/data/store", "epochs": 3,
+              "note": "no placeholder here"}
+    out = _subst_ticket_dir(params, "/fed/tickets/t000001")
+    assert out["checkpoint"] == "/fed/tickets/t000001/cursor.npz"
+    assert out["journal"] == "/fed/tickets/t000001/tj.jsonl"
+    assert out["store_dir"] == "/data/store"      # untouched
+    assert out["epochs"] == 3                     # non-strings too
+    assert out["note"] == "no placeholder here"
+
+
+def test_training_ticket_resumes_from_cursor_via_ticket_dir(tmp_path):
+    """The requeued-training-ticket contract, end to end through a
+    REAL worker: a training cursor left mid-epoch in the ticket dir
+    (here by a preempted direct run — a requeue reuses the SAME dir,
+    so the seeding path is identical to what a lost worker leaves
+    behind) is found by the worker through the ``{ticket_dir}``
+    placeholder, resumed (journaled ``train_resume`` at the exact
+    cursor), and finished to the uninterrupted run's loss history
+    bitwise."""
+    from sctools_tpu.data.shardstore import write_store
+    from sctools_tpu.models.train_stream import fit_scvi_stream
+    from sctools_tpu.utils.failsafe import JobPreempted, PreemptToken
+
+    hyper = dict(n_latent=4, n_hidden=16, epochs=2, batch_size=128,
+                 seed=0)
+    ds = synthetic_counts(1024, 64, density=0.2, n_clusters=3, seed=0)
+    store = write_store(ds.X, str(tmp_path / "store"),
+                        shard_rows=256, chunk_rows=64)
+    ref = fit_scvi_stream(store, **hyper)
+
+    # phase A: yield a mid-epoch cursor into the (deterministic)
+    # first ticket's directory — exactly what a worker lost at pos 2
+    # would leave behind for the requeued epoch
+    fed = tmp_path / "fed"
+    tdir = fed / "tickets" / "t000000"
+    os.makedirs(tdir)
+    polls = [0]
+
+    def probe():
+        polls[0] += 1
+        return "preempt" if polls[0] == 2 else None
+
+    with pytest.raises(JobPreempted):
+        fit_scvi_stream(store, checkpoint=str(tdir / "cursor.npz"),
+                        preempt=PreemptToken(probe=probe), **hyper)
+    assert os.path.exists(tdir / "cursor.npz")
+
+    # phase B: a REAL worker picks the ticket up, substitutes the
+    # placeholder, and RESUMES instead of restarting the epoch
+    pipe = Pipeline([("model.scvi_stream",
+                      dict(store_dir=store.directory,
+                           checkpoint="{ticket_dir}/cursor.npz",
+                           journal="{ticket_dir}/tj.jsonl",
+                           **hyper))], backend="cpu")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        with FederationSupervisor(
+                str(fed), n_workers=1, heartbeat_s=0.1, poll_s=0.05,
+                lease_timeout_s=240.0,
+                runner_config={"assume_healthy": True}) as sup:
+            h = sup.submit(pipe, _data(8, 8, seed=1), tenant="lab")
+            out = h.result(timeout=300)
+    hist = np.asarray(out.uns["scvi_stream_elbo_history"])
+    assert np.array_equal(hist, np.asarray(ref["history"]))
+    tj = [json.loads(line) for line in open(tdir / "tj.jsonl")]
+    kinds = [e["event"] for e in tj]
+    assert "train_resume" in kinds, kinds
+    res = next(e for e in tj if e["event"] == "train_resume")
+    assert (res["epoch"], res["pos"]) == (0, 2)
+    pairs = [(e["epoch"], e["pos"]) for e in tj
+             if e["event"] == "train_shard"]
+    assert len(pairs) == len(set(pairs))  # no replayed shards
+
+
 def test_breaker_trip_on_worker_a_short_circuits_worker_b(tmp_path):
     """Federated admission to the accelerator: worker A's chaos trips
     the shared tpu breaker; worker B — a DIFFERENT PROCESS — starts

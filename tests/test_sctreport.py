@@ -222,6 +222,59 @@ def test_ingest_section_absent_without_ingest_series():
                                        "histograms": {}}}) == []
 
 
+def test_training_section_renders_timeline_and_rulings(
+        tmp_path, capsys):
+    """A run dir with ``train_*`` journal events + ``train.*`` series
+    gets the training section: epoch timeline with losses, every
+    preemption/resume ruling with its cursor, and the device-feed
+    overlap digest."""
+    journal = (
+        '{"event": "train_shard", "epoch": 0, "pos": 0, "shard": 2, '
+        '"loss": 270.5, "steps": 2}\n'
+        '{"event": "train_checkpoint", "epoch": 0, "pos": 1, '
+        '"step": 2}\n'
+        '{"event": "preempted", "reason": "priority", "epoch": 0, '
+        '"pos": 1, "step": 2}\n'
+        '{"event": "train_resume", "epoch": 0, "pos": 1, "step": 2, '
+        '"checkpoint": "c.npz"}\n'
+        '{"event": "train_epoch", "epoch": 0, "loss": 263.9, '
+        '"step": 8}\n'
+        '{"event": "train_epoch", "epoch": 1, "loss": 203.3, '
+        '"step": 16}\n')
+    (tmp_path / "journal.jsonl").write_text(journal)
+    (tmp_path / "metrics.json").write_text(json.dumps({
+        "schema": 1, "metrics": {"counters": {
+            "train.steps": 16.0, "train.shards": 8.0,
+            "train.epochs": 2.0,
+            "train.preemptions{reason=priority}": 1.0,
+            "train.resumes": 1.0,
+            "train.overlap_s": 0.9, "train.stall_s": 0.1,
+        }, "gauges": {"train.loss{epoch=1}": 203.3},
+            "histograms": {}}}))
+    assert main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "-- training --" in out
+    assert "progress: 2 epoch(s), 8 shard(s), 16 optimizer step(s)" \
+        in out
+    assert "epoch   0 loss=263.9" in out
+    assert "epoch   1 loss=203.3" in out
+    assert "PREEMPTED reason=priority" in out
+    assert "RESUME from cursor" in out
+    assert "preemptions honoured: 1" in out and "cursor resumes: 1" \
+        in out
+    assert "overlap 0.900s / stall 0.100s  (efficiency 90%)" in out
+
+
+def test_training_section_absent_without_train_series():
+    from tools.sctreport import training_section
+
+    assert training_section([], None) == []
+    assert training_section(
+        [{"event": "run_start"}],
+        {"metrics": {"counters": {"op.calls": 1.0}, "gauges": {},
+                     "histograms": {}}}) == []
+
+
 def test_digest_splits_runs_and_tracks_statuses():
     events, bad = load_journal(os.path.join(FIXTURE, "journal.jsonl"))
     assert bad == 0

@@ -1,0 +1,223 @@
+"""CI training smoke (tools/run_checks.sh stage 11).
+
+Drives the preemption-tolerant out-of-core trainer's three headline
+contracts on a temp-dir shard store:
+
+1. **SIGKILL → bitwise resume**: a child process training with a
+   cursor checkpoint is SIGKILLed at a RANDOMIZED shard read
+   (mid-epoch, between arbitrary minibatches); the parent resumes
+   from the cursor and finishes with params BITWISE IDENTICAL to an
+   uninterrupted run, and the merged journal proves no shard was
+   trained twice (unique ``train_shard`` (epoch, pos) pairs after a
+   ``train_resume``);
+2. **chaos preempt through the scheduler**: a ``preempt`` fault at
+   the Nth shard-boundary poll (one VirtualClock, zero real sleeps)
+   makes the training job checkpoint-then-yield, requeue, resume and
+   complete — journal: ``preempted`` (non-terminal) then exactly one
+   terminal, history identical to uninterrupted;
+3. **corrupt cursor → quarantine, fall back a generation**: byte
+   damage to the newest cursor checkpoint is caught by the digest
+   verify, the file is QUARANTINED (never deleted, reason sidecar)
+   and resume falls back to ``.prev`` — one shard of retraining,
+   never a silent epoch restart — still finishing bitwise-identical.
+
+Run directly: ``JAX_PLATFORMS=cpu python tests/train_smoke.py``
+(exit 0 = all contracts hold).
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+# run as a plain script (CI stage 11): the script dir (tests/) is
+# what lands on sys.path, not the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+HYPER = dict(n_latent=4, n_hidden=16, epochs=2, batch_size=128,
+             seed=0)
+
+_CHILD = """
+import os, signal, sys
+import sctools_tpu  # noqa: F401 - full package import, like a user
+from sctools_tpu.data.shardstore import ShardStore
+from sctools_tpu.models.train_stream import fit_scvi_stream
+
+store_dir, ck, jp, kill_after = (sys.argv[1], sys.argv[2],
+                                 sys.argv[3], int(sys.argv[4]))
+store = ShardStore.open(store_dir)
+orig = store.read_shard
+calls = [0]
+
+
+def killing(i, **kw):
+    calls[0] += 1
+    if calls[0] == kill_after:
+        os.kill(os.getpid(), signal.SIGKILL)  # hard death mid-epoch
+    return orig(i, **kw)
+
+
+store.read_shard = killing
+fit_scvi_stream(store, checkpoint=ck, journal=jp, n_latent=4,
+                n_hidden=16, epochs=2, batch_size=128, seed=0)
+"""
+
+
+def _leaves_equal(a, b) -> bool:
+    import jax
+
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb))
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="sctools_train_smoke_")
+    try:
+        return _run(tmp)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _run(tmp: str) -> int:
+    import random as _random
+
+    from sctools_tpu.data.shardstore import write_store
+    from sctools_tpu.data.synthetic import synthetic_counts
+    from sctools_tpu.models.train_stream import fit_scvi_stream
+    from sctools_tpu.registry import Pipeline
+    from sctools_tpu.scheduler import RunScheduler
+    from sctools_tpu.utils.chaos import ChaosMonkey, Fault
+    from sctools_tpu.utils.failsafe import (BreakerRegistry,
+                                            JobPreempted, PreemptToken)
+    from sctools_tpu.utils.telemetry import MetricsRegistry
+    from sctools_tpu.utils.vclock import VirtualClock
+
+    ds = synthetic_counts(1024, 64, density=0.2, n_clusters=3, seed=3)
+    store = write_store(ds.X, os.path.join(tmp, "store"),
+                        shard_rows=256, chunk_rows=64)
+    ref = fit_scvi_stream(store, **HYPER)  # the uninterrupted oracle
+
+    # -- 1. SIGKILL at a randomized shard read -> bitwise resume ------
+    reads_per_run = store.n_shards * (HYPER["epochs"] + 0)
+    kill_at = int(os.environ.get(
+        "SCTOOLS_TEST_TRAIN_KILL",
+        _random.SystemRandom().randint(2, reads_per_run - 1)))
+    ck = os.path.join(tmp, "cursor.npz")
+    jp = os.path.join(tmp, "train_journal.jsonl")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD, store.directory, ck, jp,
+         str(kill_at)],
+        env=env, capture_output=True, text=True, timeout=500)
+    assert proc.returncode == -signal.SIGKILL, (kill_at, proc.stderr)
+    assert os.path.exists(ck), (kill_at, "no cursor survived")
+    got = fit_scvi_stream(store, checkpoint=ck, journal=jp, **HYPER)
+    assert got["resumed_from"] is not None, kill_at
+    assert _leaves_equal(ref["params"], got["params"]), (
+        kill_at, "params diverged after SIGKILL resume")
+    assert np.array_equal(ref["history"], got["history"]), kill_at
+    assert not os.path.exists(ck), "cursor must self-delete"
+    events = [json.loads(line) for line in open(jp)]
+    kinds = [e["event"] for e in events]
+    assert "train_resume" in kinds, kinds
+    pairs = [(e["epoch"], e["pos"]) for e in events
+             if e["event"] == "train_shard"]
+    assert len(pairs) == len(set(pairs)), (
+        "journal shows a REPLAYED shard", kill_at, pairs)
+    resumed = got["resumed_from"]
+    print(f"train_smoke: 1/3 SIGKILL at read {kill_at} -> resumed "
+          f"from {resumed}, params bitwise-identical, "
+          f"{len(pairs)} unique train_shard events")
+
+    # -- 2. chaos preempt through the scheduler (VirtualClock) --------
+    clock = VirtualClock()
+    m = MetricsRegistry(clock=clock)
+    monkey = ChaosMonkey([Fault("train-lab", "preempt", on_call=3)],
+                         clock=clock)
+    sj = os.path.join(tmp, "sched_journal.jsonl")
+    ck2 = os.path.join(tmp, "cursor2.npz")
+    pipe = Pipeline([("model.scvi_stream",
+                      dict(store_dir=store.directory, checkpoint=ck2,
+                           **HYPER))])
+    placeholder = synthetic_counts(8, 8, density=0.3, seed=1)
+    with RunScheduler(max_concurrency=1, clock=clock, metrics=m,
+                      journal_path=sj,
+                      breakers=BreakerRegistry(clock=clock),
+                      chaos=monkey,
+                      runner_defaults={"probe": lambda:
+                                       {"ok": True}}) as sched:
+        h = sched.submit(pipe, placeholder, tenant="train-lab",
+                         backend="cpu", preemptible=True)
+        out = h.result(timeout=600)
+    hist = np.asarray(out.uns["scvi_stream_elbo_history"])
+    assert np.array_equal(hist, ref["history"]), (
+        "preempted+resumed history diverged")
+    sev = [json.loads(line) for line in open(sj)]
+    skinds = [e["event"] for e in sev]
+    assert skinds.count("preempted") == 1, skinds
+    from soak_smoke import check_journal_coherent
+
+    check_journal_coherent(sj, 1)  # terminal exactly once
+    assert [f["mode"] for f in monkey.injected] == ["preempt"]
+    print("train_smoke: 2/3 chaos preempt OK (yield at boundary 3, "
+          "requeued, resumed, terminal exactly once, zero real "
+          "sleeps)")
+
+    # -- 3. corrupt cursor -> quarantine + fall back a generation -----
+    ck3 = os.path.join(tmp, "ck3", "cursor3.npz")
+    os.makedirs(os.path.dirname(ck3))
+    tok = PreemptToken()
+    polls = [0]
+
+    def probe():
+        polls[0] += 1
+        return "preempt" if polls[0] == 3 else None
+
+    tok.probe = probe
+    try:
+        fit_scvi_stream(store, checkpoint=ck3, preempt=tok, **HYPER)
+        raise AssertionError("expected JobPreempted")
+    except JobPreempted:
+        pass
+    assert os.path.exists(ck3) and os.path.exists(ck3 + ".prev")
+    with open(ck3, "r+b") as f:  # damage the NEWEST generation
+        blob = bytearray(f.read())
+        for i in range(0, min(len(blob), 2048), 7):
+            blob[i] ^= 0xFF
+        f.seek(0)
+        f.write(blob)
+    import warnings as _warnings
+
+    with _warnings.catch_warnings(record=True) as wrec:
+        _warnings.simplefilter("always")
+        got3 = fit_scvi_stream(store, checkpoint=ck3, **HYPER)
+    assert any("quarantined" in str(w.message) for w in wrec), (
+        [str(w.message) for w in wrec])
+    qdir = os.path.join(os.path.dirname(ck3), "quarantine")
+    qfiles = os.listdir(qdir)
+    assert any(f.endswith(".reason.json") for f in qfiles), qfiles
+    assert any(not f.endswith(".json") for f in qfiles), qfiles
+    # fell back ONE generation (pos 2, not a silent epoch restart),
+    # and determinism still lands the identical params
+    assert got3["resumed_from"] == {"epoch": 0, "pos": 2, "step": 4}, \
+        got3["resumed_from"]
+    assert _leaves_equal(ref["params"], got3["params"])
+    print("train_smoke: 3/3 corrupt cursor OK (quarantined with "
+          f"reason sidecar, resumed from .prev at pos 2, params "
+          f"bitwise-identical)")
+    print(f"train_smoke: ALL OK ({store.n_shards} shards, "
+          f"{HYPER['epochs']} epochs)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,132 @@
+"""Training bench helper: out-of-core scvi epochs on a durable shard
+store under a capped host-RAM budget.
+
+This module backs ``bench.py --phase train``.  What it measures:
+
+* **out-of-core contract**: a temp-dir shard store whose decoded size
+  is **>= 10x the configured host-RAM budget** trains end-to-end
+  through :func:`~sctools_tpu.models.train_stream.fit_scvi_stream`
+  via the :class:`ShardReadScheduler` — lookahead reads are
+  budget-bounded, so at no point does more than ~budget of decoded
+  shard bytes sit in flight, and the dense training slabs exist only
+  ``prefetch_depth + 1`` shards at a time;
+* **overlap efficiency**: ``train.overlap_s / (overlap + stall)``
+  over the whole run — the fraction of shard read + verify + decode +
+  ``device_put`` + densify wall the double-buffered device feed hid
+  behind the compiled train scan.  The acceptance gate
+  (tests/test_bench_gates.py) requires **>= 0.8** (the ROADMAP floor
+  for the training flavor of the 10x-host-RAM scenario);
+* **loss parity vs the in-RAM path**: the same data, seed and
+  hyperparameters trained through ``model.scvi``'s in-memory loop —
+  the per-shard program IS the in-RAM epoch scan
+  (``models/scvi.py`` ``_train_epoch``), so the two loss trajectories
+  must land within a few percent (they are not bitwise: the stream
+  permutes shard-locally, the in-RAM path globally).  The gate
+  requires the FINAL losses within 5% relative and both paths'
+  loss to have actually decreased.
+
+Sized for the CI box via ``SCTOOLS_BENCH_TRAIN_CELLS/GENES/
+SHARD_ROWS/EPOCHS/BATCH``; real boxes can scale up.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import time
+
+
+def run_train_bench(jax, n_cells: int | None = None,
+                    n_genes: int | None = None,
+                    shard_rows: int | None = None,
+                    epochs: int | None = None,
+                    batch_size: int | None = None) -> dict:
+    """Store-10x-budget streaming training walls + overlap efficiency
+    + loss parity vs in-RAM.  Returns the detail dict the gate
+    reads."""
+    import numpy as np
+
+    import sctools_tpu as sct
+    from sctools_tpu.data.shardstore import (ShardReadScheduler,
+                                             write_store)
+    from sctools_tpu.data.synthetic import synthetic_counts
+    from sctools_tpu.models.train_stream import fit_scvi_stream
+    from sctools_tpu.utils.telemetry import MetricsRegistry
+
+    n = int(n_cells or os.environ.get("SCTOOLS_BENCH_TRAIN_CELLS",
+                                      16384))
+    g = int(n_genes or os.environ.get("SCTOOLS_BENCH_TRAIN_GENES",
+                                      128))
+    rows = int(shard_rows or os.environ.get(
+        "SCTOOLS_BENCH_TRAIN_SHARD_ROWS", 1024))
+    eps = int(epochs or os.environ.get("SCTOOLS_BENCH_TRAIN_EPOCHS",
+                                       3))
+    bs = int(batch_size or os.environ.get("SCTOOLS_BENCH_TRAIN_BATCH",
+                                          32))
+    # depth 3, not the default double buffer: one extra slot absorbs
+    # the decode-wall jitter of the 2-core CI box (measured 0.69 ->
+    # 0.94 efficiency; the slot costs one more decoded shard of RAM,
+    # still far inside the 10x budget story)
+    depth = int(os.environ.get("SCTOOLS_BENCH_TRAIN_DEPTH", 3))
+    hyper = dict(n_latent=8, n_hidden=64, epochs=eps, batch_size=bs,
+                 seed=0, kl_warmup=2)
+    host = synthetic_counts(n, g, density=0.08, n_clusters=8, seed=0)
+    tmp = tempfile.mkdtemp(prefix="sctools_bench_train_")
+    try:
+        # one chunk per shard, like the ingest bench: at CI sizes
+        # per-chunk zip-open overhead would measure npz bookkeeping,
+        # not the feed machinery
+        store = write_store(host.X, os.path.join(tmp, "store"),
+                            shard_rows=rows, chunk_rows=rows)
+        store_bytes = store.shard_nbytes_est() * store.n_shards
+        budget = max(store_bytes // 10, store.shard_nbytes_est())
+        ratio = store_bytes / budget
+
+        m = MetricsRegistry()
+        sched = ShardReadScheduler(store, n_readers=2,
+                                   ram_budget_bytes=budget, metrics=m)
+        t0 = time.perf_counter()
+        with sched:
+            res = fit_scvi_stream(store, scheduler=sched, metrics=m,
+                                  prefetch_depth=depth, **hyper)
+        stream_wall = time.perf_counter() - t0
+        c = m.snapshot_compact()
+        ov = c.get("train.overlap_s", 0.0)
+        st = c.get("train.stall_s", 0.0)
+        eff = ov / max(ov + st, 1e-9)
+        stream_hist = np.asarray(res["history"], np.float64)
+
+        # the in-RAM oracle: same data/seed/hyperparameters through
+        # model.scvi's single-program epoch scan
+        t0 = time.perf_counter()
+        inram = sct.apply("model.scvi", host, backend="cpu", **hyper)
+        inram_wall = time.perf_counter() - t0
+        inram_hist = np.asarray(inram.uns["scvi_elbo_history"],
+                                np.float64)
+        parity = abs(stream_hist[-1] - inram_hist[-1]) / abs(
+            inram_hist[-1])
+        return {
+            "n_cells": n, "n_genes": g, "shard_rows": rows,
+            "n_shards": store.n_shards, "epochs": eps,
+            "batch_size": bs,
+            "store_decoded_bytes": int(store_bytes),
+            "ram_budget_bytes": int(budget),
+            "store_to_budget_ratio": round(ratio, 2),
+            "stream_wall_s": round(stream_wall, 3),
+            "inram_wall_s": round(inram_wall, 3),
+            "overlap_s": round(ov, 4), "stall_s": round(st, 4),
+            "overlap_efficiency": round(eff, 4),
+            "train_steps": c.get("train.steps", 0.0),
+            "stream_loss_first": round(float(stream_hist[0]), 4),
+            "stream_loss_final": round(float(stream_hist[-1]), 4),
+            "inram_loss_first": round(float(inram_hist[0]), 4),
+            "inram_loss_final": round(float(inram_hist[-1]), 4),
+            "final_loss_rel_diff": round(float(parity), 5),
+            "stream_history": [round(float(x), 4)
+                               for x in stream_hist],
+            "inram_history": [round(float(x), 4)
+                              for x in inram_hist],
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)

@@ -69,7 +69,18 @@
 #                     lost workers' journal tails grafted into
 #                     worker_lost (docs/ARCHITECTURE.md "Federated
 #                     fault domains")
-#  11. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
+#  11. training      python tests/train_smoke.py — the preemption-
+#                     tolerant out-of-core trainer's contract: a
+#                     SIGKILL at a randomized shard read resumes from
+#                     the cursor to BITWISE-identical params with no
+#                     replayed shards, one chaos preempt through the
+#                     scheduler checkpoint-then-yields + requeues +
+#                     resumes on one VirtualClock, and a corrupted
+#                     cursor checkpoint is quarantined (never
+#                     deleted) with resume falling back exactly one
+#                     generation (docs/ARCHITECTURE.md "Resumable
+#                     training jobs")
+#  12. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
 
 set -u -o pipefail
 
@@ -107,7 +118,8 @@ bare=$(grep -nE '\btime\.(sleep|monotonic)\b' \
         sctools_tpu/utils/chaos.py \
         sctools_tpu/utils/telemetry.py \
         sctools_tpu/data/stream.py \
-        sctools_tpu/data/shardstore.py 2>/dev/null \
+        sctools_tpu/data/shardstore.py \
+        sctools_tpu/models/train_stream.py 2>/dev/null \
         | grep -v 'sctlint: disable=SCT008' || true)
 if [ -n "$bare" ]; then
     echo "bare time.sleep/time.monotonic in resilience modules" \
@@ -296,6 +308,14 @@ if JAX_PLATFORMS=cpu python tests/federation_smoke.py; then
     :
 else
     echo "federation stage FAILED (rc=$?)"
+    fail=1
+fi
+
+stage "training (SIGKILL->bitwise resume, chaos preempt, corrupt cursor)"
+if JAX_PLATFORMS=cpu python tests/train_smoke.py; then
+    :
+else
+    echo "training stage FAILED (rc=$?)"
     fail=1
 fi
 

@@ -32,10 +32,13 @@ from ..jaxutil import dotted, module_info
 # the whole domain is tier-1 tested on one VirtualClock;
 # federation.py for the worker-lease domain — lease ages, heartbeat
 # cadences and breaker-transport waits all move on the injectable
-# clock (real subprocess reaps stay event-driven, like watch_process).
+# clock (real subprocess reaps stay event-driven, like watch_process);
+# train_stream.py for the out-of-core trainer — its prefetch feed and
+# preemption polls ride the same injectable clock, so the whole
+# preempt → requeue → resume ladder runs on one VirtualClock.
 _PATH_RE = re.compile(
     r"(^|/)(runner|failsafe|checkpoint|chaos|stream|scheduler"
-    r"|shardstore|federation)\.py$")
+    r"|shardstore|federation|train_stream)\.py$")
 
 _BANNED = {"time.sleep", "time.monotonic"}
 

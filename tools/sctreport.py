@@ -147,6 +147,10 @@ def digest_run(run: list[dict]) -> dict:
                     steps[i]["status"] = "resumed"
         elif ev in ("resume_unverified_input", "resume_place_failed"):
             d["resume_notes"].append(e)
+        elif ev == "preempted":
+            # cooperative checkpoint-then-yield: this run SEGMENT
+            # ends here by design — the next run_start resumes it
+            d["outcome"] = "PREEMPTED (yielded; resumes from cursor)"
         elif ev in _TERMINAL:
             d["outcome"] = _TERMINAL[ev]
             if e.get("degraded"):
@@ -307,6 +311,11 @@ def render(run_dir: str, runs: list[dict], trace_d: dict | None,
     if ingest:
         add("")
         L.extend(ingest)
+
+    training = training_section(events or [], metrics)
+    if training:
+        add("")
+        L.extend(training)
 
     add("")
     add("-- metrics snapshot --")
@@ -599,6 +608,82 @@ def ingest_section(metrics) -> list[str]:
             mean = (h.get("sum", 0.0) / n) if n else 0.0
             L.append(f"  read wait: n={n} mean={mean:.4f}s "
                      f"max={h.get('max', 0.0):g}s")
+    return L
+
+
+def training_section(events: list[dict], metrics) -> list[str]:
+    """The out-of-core training digest, rendered only when the run
+    recorded ``train.*`` series or journaled ``train_*`` events (a
+    run that never trained has no section).  Shows the epoch timeline
+    with the loss trajectory, every preemption/cancellation and
+    resume ruling with its cursor (the checkpoint-then-yield story),
+    and the device-feed overlap efficiency — how much of the shard
+    decode + H2D wall hid behind the train step."""
+    m = (metrics or {}).get("metrics", metrics or {})
+    counters = m.get("counters", {}) if isinstance(m, dict) else {}
+    gauges = m.get("gauges", {}) if isinstance(m, dict) else {}
+    train_counters = {k: v for k, v in counters.items()
+                      if k.startswith("train.")}
+    train_events = [e for e in events if e["event"] in (
+        "train_resume", "train_shard", "train_epoch",
+        "train_checkpoint", "preempted")]
+    if not train_counters and not train_events:
+        return []
+    L = ["-- training --"]
+    steps = train_counters.get("train.steps", 0.0)
+    shards = train_counters.get("train.shards", 0.0)
+    epochs = train_counters.get("train.epochs", 0.0)
+    L.append(f"  progress: {epochs:g} epoch(s), {shards:g} shard(s), "
+             f"{steps:g} optimizer step(s)")
+
+    # epoch timeline: journal first (has per-epoch walls/steps), the
+    # train.loss{epoch=} gauges as the metrics-only fallback
+    ep_events = [e for e in train_events if e["event"] == "train_epoch"]
+    losses = {}
+    for k, v in gauges.items():
+        name, labels = _parse_labels(k)
+        if name == "train.loss" and "epoch" in labels:
+            losses[labels["epoch"]] = v
+    if ep_events:
+        L.append("  epoch timeline:")
+        for e in ep_events:
+            L.append(f"    epoch {e.get('epoch'):>3} "
+                     f"loss={e.get('loss')} "
+                     f"(cumulative steps {e.get('step')})")
+    elif losses:
+        L.append("  loss trajectory (train.loss gauges):")
+        for ep in sorted(losses, key=lambda x: int(x)):
+            L.append(f"    epoch {ep:>3} loss={losses[ep]:g}")
+
+    # preemption / resume rulings — the checkpoint-then-yield story
+    rulings = [e for e in train_events
+               if e["event"] in ("preempted", "train_resume")]
+    if rulings:
+        L.append("  preemption/resume rulings:")
+        for e in rulings:
+            cur = e.get("cursor") or {
+                k: e.get(k) for k in ("epoch", "pos", "step")
+                if e.get(k) is not None}
+            if e["event"] == "preempted":
+                L.append(f"    PREEMPTED reason={e.get('reason')} "
+                         f"at {cur}"
+                         + (f" (ticket {e['ticket']})"
+                            if "ticket" in e else ""))
+            else:
+                L.append(f"    RESUME from cursor {cur}")
+    n_pre = sum(v for k, v in train_counters.items()
+                if _parse_labels(k)[0] == "train.preemptions")
+    n_res = train_counters.get("train.resumes", 0.0)
+    if n_pre or n_res:
+        L.append(f"  preemptions honoured: {n_pre:g}    "
+                 f"cursor resumes: {n_res:g}")
+
+    ov = train_counters.get("train.overlap_s", 0.0)
+    st = train_counters.get("train.stall_s", 0.0)
+    if ov or st:
+        eff = ov / max(ov + st, 1e-9)
+        L.append(f"  device feed: overlap {ov:.3f}s / stall "
+                 f"{st:.3f}s  (efficiency {eff:.0%})")
     return L
 
 
