@@ -97,11 +97,32 @@ def _parse_fields(raw: str) -> dict:
     return out
 
 
+#: serializes protocol emission across worker threads (heartbeat
+#: thread + main loop).  ``print`` issues SEPARATE write calls for
+#: the text and the newline, so two threads could interleave mid-line
+#: — and the supervisor pump drops unparseable lines as worker noise,
+#: which for a ``done`` line meant a ticket stuck in_flight on a
+#: healthy worker forever (caught by the chaos soak; the result-file
+#: recovery on the supervision tick is the belt to this brace).
+_SAY_LOCK = threading.Lock()
+
+
 def _say(kind: str, **fields) -> None:
-    """Worker-side: emit one protocol line on stderr."""
+    """Worker-side: emit one protocol line on stderr (one atomic
+    write under the emission lock)."""
+    if kind == "done" and os.environ.get("SCT_FED_TEST_MUTE_DONE"):
+        # test hook: simulate the lost-commit-message transport fault
+        # (the worker still commits the result file and keeps
+        # beating) — exercises the supervisor's result-file recovery
+        return
     kv = " ".join(f"{k}={v}" for k, v in fields.items())
-    print(f"[fed] {kind}{(' ' + kv) if kv else ''}",
-          file=sys.stderr, flush=True)
+    line = f"[fed] {kind}{(' ' + kv) if kv else ''}\n"
+    with _SAY_LOCK:
+        # sanctioned write-under-lock: this lock exists solely to make
+        # the line+flush atomic against the heartbeat thread; it
+        # guards nothing else
+        sys.stderr.write(line)  # sctlint: disable=SCT011
+        sys.stderr.flush()  # sctlint: disable=SCT011
 
 
 # ---------------------------------------------------------------------------
@@ -150,6 +171,8 @@ class FederatedBreaker(CircuitBreaker):
     def _refresh(self) -> None:
         """Apply any unseen remote transition (caller holds the
         lock)."""
+        # sctlint: locked-by-caller — every caller (state property,
+        # record_*, snapshot) enters through `with self.lock:`
         try:
             with open(self._file) as f:
                 rec = json.load(f)
@@ -253,7 +276,10 @@ class FederatedBreaker(CircuitBreaker):
 
     def try_acquire_probe(self) -> bool:
         with self.lock:
-            if not super().try_acquire_probe():
+            # ownership transfer by design: a claimed slot OUTLIVES
+            # this method — the verdict paths (record_success /
+            # record_failure / release_probe) are its release
+            if not super().try_acquire_probe():  # sctlint: disable=SCT010
                 return False
             if self._claim_probe_file():
                 return True
@@ -277,35 +303,61 @@ class FederatedBreaker(CircuitBreaker):
 
     # -- probe claim file ----------------------------------------------
     def _claim_probe_file(self) -> bool:
-        for attempt in (1, 2):
-            try:
-                fd = os.open(self._probe_file,
-                             os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-                with os.fdopen(fd, "w") as f:
-                    json.dump({"owner": self._owner,
-                               "ts": round(time.time(), 3)}, f)
-                self._holds_probe_file = True
-                return True
-            except FileExistsError:
-                if attempt == 2:
-                    return False
-                # stale-claim break: the holder died without a
-                # verdict.  Wall-clock ages are FACTS about the file,
-                # not schedules — legal outside the injectable clock.
+        # the claim is made by LINKING a fully-written private record
+        # into place: the shared path either carries a complete owner
+        # record or does not exist, so a disk-full failure happens on
+        # the private temp and never leaves (or requires cleaning up)
+        # a half-written claim another process could misjudge
+        tmp = f"{self._probe_file}.{self._owner or os.getpid()}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"owner": self._owner,
+                           "ts": round(time.time(), 3)}, f)
+        except OSError:
+            with contextlib.suppress(OSError):
+                os.unlink(tmp)
+            return False
+        try:
+            for attempt in (1, 2):
                 try:
-                    with open(self._probe_file) as f:
-                        ts = float(json.load(f).get("ts", 0.0))
-                except (OSError, ValueError):
-                    ts = 0.0
-                if time.time() - ts < self._probe_stale_s:
-                    return False
-                try:
-                    os.unlink(self._probe_file)
+                    # ownership transfer on success: the claim file
+                    # outlives this method (released by
+                    # _drop_probe_file on the verdict paths, or
+                    # broken by the stale TTL)
+                    os.link(tmp, self._probe_file)
+                    self._holds_probe_file = True
+                    return True
+                except FileExistsError:
+                    if attempt == 2:
+                        return False
+                    # stale-claim break: the holder died without a
+                    # verdict.  Wall-clock ages are FACTS about the
+                    # file, not schedules — legal outside the
+                    # injectable clock.
+                    try:
+                        with open(self._probe_file) as f:
+                            ts = float(json.load(f).get("ts", 0.0))
+                    except (OSError, ValueError):
+                        ts = 0.0
+                    if time.time() - ts < self._probe_stale_s:
+                        return False
+                    # exactly ONE contender wins the break: rename is
+                    # the atomic claim on the break itself, so a
+                    # rival that also ruled the claim stale cannot
+                    # unlink the fresh claim we are about to make
+                    bpath = self._probe_file + ".break"
+                    try:
+                        os.rename(self._probe_file, bpath)
+                    except OSError:
+                        return False  # another contender broke it
+                    with contextlib.suppress(OSError):
+                        os.unlink(bpath)
                 except OSError:
-                    return False  # raced another breaker's break
-            except OSError:
-                return False  # store dir gone: claim locally only
-        return False
+                    return False  # store dir gone: claim locally only
+            return False
+        finally:
+            with contextlib.suppress(OSError):
+                os.unlink(tmp)
 
     def _drop_probe_file(self) -> None:
         self._holds_probe_file = False
@@ -456,7 +508,8 @@ class FederatedRunError(RuntimeError):
 class _Ticket:
     __slots__ = ("id", "seq", "tenant", "priority", "backend",
                  "steps", "runner_kw", "dir", "epoch", "handle",
-                 "worker", "submitted_at", "ready")
+                 "worker", "submitted_at", "ready", "committing",
+                 "accepted")
 
     def __init__(self, seq: int, tenant: str, priority: int,
                  backend, steps, runner_kw, tdir, handle, now):
@@ -473,6 +526,15 @@ class _Ticket:
         self.worker = None          # _Worker currently assigned, or None
         self.submitted_at = now
         self.ready = False          # data.npz + ticket.json on disk
+        #: a pump thread accepted this ticket's commit under the lock
+        #: and is finishing it OUTSIDE the lock — terminal belongs to
+        #: that thread alone (shed paths must keep their hands off)
+        self.committing = False
+        #: (worker_name, epoch) of the ACCEPTED commit — lets a
+        #: duplicate delivery of the same commit (result-file probe
+        #: vs the real `done` line) dedupe silently instead of being
+        #: journalled as a fencing refusal
+        self.accepted = None
 
     def sort_key(self):
         return (-self.priority, self.seq)
@@ -571,6 +633,11 @@ class FederationSupervisor:
         incarnations never inherit.
     """
 
+    #: the result-file recovery probe runs on every Nth supervision
+    #: tick (check_leases call) instead of every one — lease ruling
+    #: stays per-tick, the ENOENT-churning file probes do not
+    RECOVERY_EVERY_TICKS = 5
+
     def __init__(self, fed_dir: str, *, n_workers: int = 2,
                  worker_capacity: int = 1,
                  lease_timeout_s: float = 60.0,
@@ -629,6 +696,8 @@ class FederationSupervisor:
         self._seq = 0
         self._closed = False
         self._started = False
+        self._committing = 0  # tickets accepted, terminal pending
+        self._recovery_tick = 0  # supervision ticks since start
         self._workers: dict[str, _Worker] = {}
         self._monitor_stop = threading.Event()
         self._monitor = None
@@ -645,10 +714,21 @@ class FederationSupervisor:
             if self._started:
                 return self
             self._started = True
-            cpath = os.path.join(self.fed_dir, "config.json")
-            with open(cpath + ".tmp", "w") as f:
-                json.dump(self._config, f, indent=1)
-            os.replace(cpath + ".tmp", cpath)
+        # config write OUTSIDE the lock (SCT011: no file IO under the
+        # dispatch lock).  Safe: _started already claimed the one
+        # start, and the workers that read this file are only spawned
+        # below, after the rename lands
+        cpath = os.path.join(self.fed_dir, "config.json")
+        with open(cpath + ".tmp", "w") as f:
+            json.dump(self._config, f, indent=1)
+        os.replace(cpath + ".tmp", cpath)
+        with self._lock:
+            if self._closed:
+                # a concurrent shutdown() landed in the gap between
+                # claiming _started and this block: it saw an empty
+                # worker dict, so spawning now would leak processes
+                # nothing will ever stop
+                return self
             for i in range(self.n_workers):
                 self._spawn_locked(f"w{i}", gen=0)
         if self.monitor_interval_s is not None:
@@ -754,7 +834,8 @@ class FederationSupervisor:
             self._dispatch_locked()
         self.check_leases()
 
-    def _on_done(self, w: _Worker, fields: dict) -> None:
+    def _on_done(self, w: _Worker, fields: dict,
+                 recovered: bool = False) -> None:
         tid = fields.get("ticket", "")
         epoch = int(fields.get("epoch", -1))
         status = fields.get("status", "failed")
@@ -762,6 +843,8 @@ class FederationSupervisor:
             if w.wedged and not w.lost:
                 return  # partitioned: its messages never arrive
             if w.lost:
+                if recovered:
+                    return  # ruling raced the probe: requeue won
                 # a FENCED worker's commit DID arrive (the fence
                 # raced the run's tail) — refuse it on the record:
                 # this is the at-most-once evidence the docs promise
@@ -773,7 +856,14 @@ class FederationSupervisor:
             t = self._tickets.get(tid)
             if t is None:
                 return
-            if t.handle.done() or epoch != t.epoch or t.worker is not w:
+            if t.handle.done() or t.committing or epoch != t.epoch \
+                    or t.worker is not w:
+                if recovered or t.accepted == (w.name, epoch):
+                    # duplicate delivery of an ALREADY-ACCEPTED
+                    # commit (the result-file probe and the real
+                    # `done` line race each other): dedupe silently —
+                    # this is not fencing evidence
+                    return
                 # stale epoch / foreign worker: the fencing guard —
                 # this commit is REFUSED, the current owner's is the
                 # one that counts
@@ -782,34 +872,65 @@ class FederationSupervisor:
                     epoch=epoch, current_epoch=t.epoch, by="supervisor")
                 self.metrics.counter("fed.fenced_commits").inc()
                 return
+            # ACCEPT the commit under the lock (epoch checked, slot
+            # freed, terminal claimed via `committing` so no shed
+            # path touches the handle) ...
             w.in_flight.remove(t)
             w.served += 1
             t.worker = None
+            t.committing = True
+            t.accepted = (w.name, epoch)
+            self._committing += 1
             rpath = os.path.join(t.dir, f"result-{epoch:03d}")
+        # ... but resolve it OUTSIDE: the terminal journal append and
+        # the error-detail read are disk work, and disk latency under
+        # the dispatch lock stalls heartbeat crediting and every
+        # other tenant's dispatch (SCT011 — the same rule the
+        # in-process scheduler's worker follows).  Ordering is safe:
+        # this ticket's admitted/assigned lines were flushed before
+        # the worker ever saw it, and _Journal serializes appends.
+        # The handle RESOLVES in the finally: once accepted, nothing
+        # that can raise out here — a journal append on a full disk,
+        # a caller-injected metrics registry, the error-detail read —
+        # may strand the ticket non-terminal, so the try starts
+        # IMMEDIATELY after the accept and the verdict has a pure
+        # (no-IO) default before anything fallible runs.
+        extra = {"recovered": True} if recovered else {}
+        err = "worker-side failure"
+        if status == "completed":
+            verdict = ("completed", dict(result_path=rpath + ".npz"))
+        else:
+            verdict = ("failed", dict(
+                error=FederatedRunError(
+                    f"ticket {tid} failed on worker {w.name}: {err}"),
+                reason="run_failed"))
+        try:
+            if recovered:
+                self.metrics.counter("fed.recovered_commits").inc()
             if status == "completed":
                 self.journal.write("run_completed", ticket=tid,
                                    tenant=t.tenant, worker=w.name,
-                                   epoch=epoch)
-                t.handle.worker = w.name
-                t.handle._finish("completed",
-                                 result_path=rpath + ".npz")
+                                   epoch=epoch, **extra)
             else:
-                err = "worker-side failure"
-                try:
+                with contextlib.suppress(OSError, ValueError):
+                    # terse fallback; the worker journal has it all
                     with open(rpath + ".json") as f:
                         err = json.load(f).get("error", err)
-                except (OSError, ValueError):
-                    pass  # terse handle; the worker journal has it all
+                verdict = ("failed", dict(
+                    error=FederatedRunError(
+                        f"ticket {tid} failed on worker {w.name}: "
+                        f"{err}"), reason="run_failed"))
                 self.journal.write("run_failed", ticket=tid,
                                    tenant=t.tenant, worker=w.name,
-                                   epoch=epoch, error=err)
-                t.handle.worker = w.name
-                t.handle._finish(
-                    "failed", error=FederatedRunError(
-                        f"ticket {tid} failed on worker {w.name}: "
-                        f"{err}"), reason="run_failed")
-            self._note_idle_locked()
-            self._dispatch_locked()
+                                   epoch=epoch, error=err, **extra)
+        finally:
+            t.handle.worker = w.name
+            t.handle._finish(verdict[0], **verdict[1])
+            with self._lock:
+                t.committing = False
+                self._committing -= 1
+                self._note_idle_locked()
+                self._dispatch_locked()
 
     def _on_refused(self, w: _Worker, fields: dict) -> None:
         with self._lock:
@@ -840,10 +961,11 @@ class FederationSupervisor:
 
     # -- the lost-worker ladder ----------------------------------------
     def check_leases(self) -> None:
-        """Rule on every live worker's lease age (the supervision
-        tick).  Called from every credited heartbeat, from worker
-        exits, from the optional monitor thread — and directly by
-        tests after advancing a VirtualClock."""
+        """Rule on every live worker's lease age, then recover any
+        commit whose ``done`` line was lost in transit (the
+        supervision tick).  Called from every credited heartbeat,
+        from worker exits, from the optional monitor thread — and
+        directly by tests after advancing a VirtualClock."""
         with self._lock:
             now = self.clock.monotonic()
             for w in list(self._workers.values()):
@@ -854,6 +976,44 @@ class FederationSupervisor:
                                        worker=w.name).observe(age)
                 if age > self.lease_timeout_s:
                     self._lose_worker_locked(w, reason="lease_expired")
+            # decimated by a TICK COUNTER, not a clock grace: a
+            # clock-based threshold would never elapse on a
+            # VirtualClock that stops advancing — exactly the regime
+            # the chaos soaks run in — and the probe exists to heal
+            # without any further clock movement
+            self._recovery_tick += 1
+            if self._recovery_tick % self.RECOVERY_EVERY_TICKS:
+                return
+            # stopping workers stay INCLUDED: a done line lost during
+            # shutdown would otherwise turn committed work into a
+            # teardown shed (only wedged/lost workers' commits must
+            # wait for the lease ruling)
+            pending = [(w, t, t.epoch)
+                       for w in self._workers.values()
+                       if not (w.lost or w.wedged)
+                       for t in list(w.in_flight)]
+        # RESULT-FILE RECOVERY, outside the lock (file IO — SCT011):
+        # the atomic rename on the shared fed dir is the durable
+        # commit; the worker's stderr ``done`` line is only the
+        # doorbell.  A line lost in transit (mangled by interleaved
+        # worker output, a full pipe) used to wedge the ticket
+        # in_flight forever — the worker stays healthy, so no lease
+        # ever expires and nothing requeues.  Probing the result file
+        # of the ticket's CURRENT epoch heals any lost doorbell;
+        # ``_on_done`` re-checks every guard under the lock, so a
+        # probe that races the real line, a requeue or a fence is
+        # silently deduplicated (``recovered=True``).  Wedged workers
+        # are excluded: a partitioned worker's commit must wait for
+        # the lease ruling (its epoch is about to be superseded).
+        for w, t, epoch in pending:
+            rpath = os.path.join(t.dir, f"result-{epoch:03d}.json")
+            try:
+                with open(rpath) as f:
+                    status = json.load(f).get("status", "failed")
+            except (OSError, ValueError):
+                continue  # not committed (or mid-write): next tick
+            self._on_done(w, {"ticket": t.id, "epoch": epoch,
+                              "status": status}, recovered=True)
 
     def _journal_tail(self, w: _Worker, n: int = 8) -> list:
         """The dead worker's last journal records, grafted into its
@@ -1024,7 +1184,11 @@ class FederationSupervisor:
                 if not t.handle.done():  # a concurrent shed may have won
                     if t in self._queue:
                         self._queue.remove(t)
-                    self.journal.write(
+                    # deliberate in-lock terminal: the done-check,
+                    # queue removal and terminal must be atomic
+                    # against a concurrent shed, and this path only
+                    # runs when the disk already failed
+                    self.journal.write(  # sctlint: disable=SCT011
                         "run_failed", ticket=tid, tenant=tenant,
                         error=f"submit write failed: "
                               f"{type(e).__name__}: {e}")
@@ -1065,8 +1229,11 @@ class FederationSupervisor:
             t.priority, -queued_by_tenant[t.tenant], -t.seq))
 
     def _shed_locked(self, t: _Ticket, reason: str) -> None:
-        if t.handle.done():
-            return  # terminal exactly once: a concurrent path won
+        if t.handle.done() or t.committing:
+            # terminal exactly once: a concurrent path won (done), or
+            # a pump thread accepted the commit under the lock and is
+            # resolving it outside — the terminal is already claimed
+            return
         if t in self._queue:
             self._queue.remove(t)
         self.journal.write("shed", ticket=t.id, tenant=t.tenant,
@@ -1140,8 +1307,15 @@ class FederationSupervisor:
         return best
 
     def _note_idle_locked(self) -> None:
-        busy = self._queue or any(
-            w.in_flight for w in self._workers.values())
+        # a ticket mid-commit (accepted under the lock, being resolved
+        # outside it by a pump thread) is still BUSY: drain() must not
+        # release a caller before its handle goes terminal.  O(1) via
+        # the counter — self._tickets is never pruned, so scanning it
+        # here would put an O(all-tickets-ever) walk under the
+        # dispatch lock
+        busy = (self._queue
+                or self._committing > 0
+                or any(w.in_flight for w in self._workers.values()))
         if busy:
             self._all_idle.clear()
         else:
@@ -1150,7 +1324,7 @@ class FederationSupervisor:
     # -- introspection / shutdown ---------------------------------------
     def stats(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "queue_depth": len(self._queue),
                 "tickets": len(self._tickets),
                 "workers": {
@@ -1159,8 +1333,14 @@ class FederationSupervisor:
                              "beats": w.beats, "served": w.served,
                              "in_flight": [t.id for t in w.in_flight]}
                     for w in self._workers.values()},
-                "breakers": self.breakers.snapshot(),
             }
+        # breaker snapshot OUTSIDE the dispatch lock: the federated
+        # registry READS STATE FILES to cover breakers other
+        # processes tripped — file IO under the lock would starve
+        # heartbeat crediting and could rule a healthy worker
+        # process_lost off a slow disk (SCT011)
+        out["breakers"] = self.breakers.snapshot()
+        return out
 
     def drain(self, timeout: float | None = None) -> bool:
         """Block until every admitted ticket is terminal (REAL
@@ -1177,19 +1357,27 @@ class FederationSupervisor:
             if shed_queued:
                 for t in list(self._queue):
                     self._shed_locked(t, "shutdown")
+            stopping = []
             for w in self._workers.values():
                 if w.lost:
                     continue
                 w.stopping = True
-                try:
-                    with open(os.path.join(w.dir, "stop"), "w") as f:
-                        f.write("stop\n")
-                except OSError as e:
-                    warnings.warn(
-                        f"FederationSupervisor: stop file for "
-                        f"{w.name} failed ({type(e).__name__}: {e}) "
-                        "— will terminate instead", RuntimeWarning,
-                        stacklevel=2)
+                stopping.append(w)
+        # stop-file writes OUTSIDE the lock (SCT011: no file IO under
+        # the dispatch lock).  Safe unlocked: `stopping` was claimed
+        # under the lock, and a worker that loses its lease in the
+        # window simply ignores a stop file in a dir it no longer
+        # scans
+        for w in stopping:
+            try:
+                with open(os.path.join(w.dir, "stop"), "w") as f:
+                    f.write("stop\n")
+            except OSError as e:
+                warnings.warn(
+                    f"FederationSupervisor: stop file for "
+                    f"{w.name} failed ({type(e).__name__}: {e}) "
+                    "— will terminate instead", RuntimeWarning,
+                    stacklevel=2)
         self._monitor_stop.set()
         if not wait:
             return False

@@ -297,8 +297,12 @@ class _Journal:
             return
         rec = {"event": event, "ts": round(time.time(), 3), **fields}
         with self._lock:
-            with open(self.path, "a") as f:
-                f.write(json.dumps(rec) + "\n")
+            # the one sanctioned write-under-lock: THIS lock exists
+            # solely to serialize this append (concurrent workers
+            # share one journal file); it guards nothing else, so
+            # nothing can starve behind it but another append
+            with open(self.path, "a") as f:  # sctlint: disable=SCT011
+                f.write(json.dumps(rec) + "\n")  # sctlint: disable=SCT011
 
 
 class ResilientRunner:

@@ -597,6 +597,8 @@ class RunScheduler:
         Items whose queue deadline expired are shed on the way.
         Marks the winner running (counters + stats) before
         returning it."""
+        # sctlint: locked-by-caller — the _locked suffix contract:
+        # every caller holds self._cv (= self._lock)
         now = self.clock.monotonic()
         for it in [q for q in self._queue
                    if q.deadline_s is not None
@@ -820,8 +822,12 @@ class RunScheduler:
             out["shed_audit"] = list(self._shed_audit)
             out["queue_depth"] = len(self._queue)
             out["ewma_run_s"] = self._ewma_run_s
-            out["breakers"] = self.breakers.snapshot()
-            return out
+        # breaker snapshot OUTSIDE the dispatch lock: it takes the
+        # registry's and every breaker's lock (and, federated, reads
+        # files) — holding the dispatch lock across that would stall
+        # every worker's dispatch on a stats() caller (SCT011)
+        out["breakers"] = self.breakers.snapshot()
+        return out
 
     def shutdown(self, wait: bool = True, shed_queued: bool = False,
                  timeout: float | None = None) -> bool:

@@ -212,6 +212,11 @@ METRICS = {
     "fed.fenced_commits": "counter: results refused because they came "
                           "from a fenced worker or a stale epoch "
                           "(the at-most-once acceptance guard)",
+    "fed.recovered_commits": "counter: commits accepted from the "
+                             "result file on the supervision tick — "
+                             "the worker's `done` line was lost in "
+                             "transit (the rename is the record, the "
+                             "stderr line only the doorbell)",
     "fed.breaker_syncs": "counter: remote breaker transitions applied "
                          "from the cross-process transport (labels "
                          "signature=, to= open|closed) — how one "
@@ -238,6 +243,63 @@ METRICS = {
     "train.loss": "gauge: mean negative ELBO of the last completed "
                   "epoch (labels epoch=) — the loss trajectory "
                   "sctreport renders",
+}
+
+#: Per-module journal PROTOCOLS — which EVENTS members a module may
+#: emit, and which of them are TERMINAL for that module's lifecycle
+#: (every ticket/run must reach exactly one; chaos soaks assert the
+#: runtime half, sctlint SCT012 the static half: every emission site
+#: names a legal event for its module, and every declared terminal
+#: state has at least one emission site, so a refactor cannot
+#: silently drop the path that closes a ticket).  Keys are module
+#: basenames (matched on the repo-relative path tail, like SCT005/
+#: SCT008); the tables are AST-extracted by the linter, never
+#: imported.  Adding an event: put it in EVENTS, add it to its
+#: module's table here, then emit it (docs/GUIDE.md "Adding a journal
+#: event without breaking SCT012").
+JOURNAL_PROTOCOLS = {
+    # admission funnel: submitted -> admitted | rejected, then
+    # (preempted ...)* and exactly one terminal per ticket
+    "scheduler": {
+        "events": ["submitted", "admitted", "rejected", "shed",
+                   "preempted", "run_completed", "run_failed"],
+        "terminal": ["rejected", "shed", "run_completed",
+                     "run_failed"],
+    },
+    # the federated funnel adds worker supervision + fencing records;
+    # terminal-exactly-once must hold even when a worker dies mid-run
+    "federation": {
+        "events": ["submitted", "admitted", "rejected", "shed",
+                   "run_completed", "run_failed", "worker_spawned",
+                   "worker_lost", "worker_respawned", "assigned",
+                   "requeued", "commit_refused"],
+        "terminal": ["rejected", "shed", "run_completed",
+                     "run_failed"],
+    },
+    # per-run lifecycle: run_start -> attempts/rulings -> exactly one
+    # of the three verdicts (preempted is deliberately non-terminal)
+    "runner": {
+        "events": ["run_start", "attempt", "backoff", "deadline",
+                   "checkpoint", "breaker_open", "breaker_close",
+                   "breaker_reopen", "health_check", "fallback",
+                   "degrade", "quarantine", "resume",
+                   "resume_unverified_input", "resume_place_failed",
+                   "metrics_written", "trace_exported", "preempted",
+                   "run_completed", "run_failed", "run_aborted"],
+        "terminal": ["run_completed", "run_failed", "run_aborted"],
+    },
+    # train cursor events: shard/epoch progress + cursor saves; the
+    # epoch record is the unit the no-replayed-shards proof joins on
+    "train_stream": {
+        "events": ["train_shard", "train_epoch", "train_checkpoint",
+                   "train_resume", "preempted"],
+        "terminal": ["train_epoch"],
+    },
+    # the IO-failure domain journals only the quarantine verdict
+    "shardstore": {
+        "events": ["shard_quarantined"],
+        "terminal": ["shard_quarantined"],
+    },
 }
 
 #: Fixed histogram bucket upper bounds (seconds), chosen to straddle

@@ -155,6 +155,37 @@ def test_stale_epoch_commit_is_refused(tmp_path):
     assert t.handle.done() and t.handle.status == "completed"
 
 
+def test_duplicate_delivery_of_accepted_commit_dedupes_silently(
+        tmp_path):
+    """The result-file probe and the real ``done`` line race each
+    other by design; the LOSER is a duplicate delivery of an
+    ALREADY-ACCEPTED commit and must dedupe silently — journalling
+    it ``commit_refused`` would pollute the at-most-once fencing
+    evidence (and inflate ``fed.fenced_commits``) on every recovered
+    commit."""
+    m = MetricsRegistry()
+    sup, w, t = _fake_supervisor(tmp_path)
+    sup.metrics = m
+    sup._on_done(w, {"ticket": t.id, "epoch": "0",
+                     "status": "completed"})
+    assert t.handle.done() and t.handle.status == "completed"
+    # same commit delivered again (the doorbell arrived after the
+    # probe): silent — not a fencing event
+    sup._on_done(w, {"ticket": t.id, "epoch": "0",
+                     "status": "completed"})
+    evs = [e["event"] for e in _events(str(tmp_path))]
+    assert evs == ["run_completed"]
+    assert m.snapshot_compact().get("fed.fenced_commits", 0) == 0
+    # a genuinely foreign commit still refuses on the record
+    w2 = _Worker("w9", 0, os.path.join(str(tmp_path), "workers", "w9"))
+    sup._workers["w9"] = w2
+    sup._on_done(w2, {"ticket": t.id, "epoch": "0",
+                      "status": "completed"})
+    evs = [e["event"] for e in _events(str(tmp_path))]
+    assert evs == ["run_completed", "commit_refused"]
+    assert m.snapshot_compact().get("fed.fenced_commits", 0) == 1
+
+
 def test_worker_refuses_commit_after_fence(tmp_path, capsys):
     """Worker-side half of the fence: ``_run_assignment`` re-checks
     the fence at the commit boundary and declines — no result files,
@@ -295,6 +326,45 @@ def test_federation_chaos_soak_kill_and_wedge(tmp_path):
             last_epoch[e["ticket"]] = e["epoch"]
     for e in done:
         assert e["epoch"] == last_epoch[e["ticket"]], e
+
+
+def test_lost_done_line_recovers_from_result_file(tmp_path):
+    """The lost-doorbell regression (caught by the chaos soak): a
+    worker commits its result by atomic rename but the stderr
+    ``done`` line never reaches the supervisor — previously the
+    ticket sat in_flight forever on a HEALTHY worker (no lease ever
+    expires, nothing requeues).  The supervision tick now probes the
+    result file of every in-flight ticket's current epoch: the
+    rename is the durable record, the line only the doorbell.  The
+    ``SCT_FED_TEST_MUTE_DONE`` hook drops every done line
+    worker-side while the worker keeps beating and committing."""
+    m = MetricsRegistry()
+    env = dict(os.environ, SCT_FED_TEST_MUTE_DONE="1")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        with FederationSupervisor(
+                str(tmp_path), n_workers=1, heartbeat_s=0.1,
+                poll_s=0.05, lease_timeout_s=120.0, metrics=m,
+                env=env,
+                runner_config={"assume_healthy": True}) as sup:
+            handles = [sup.submit(_pipe(), _data(), tenant="lab")
+                       for _ in range(2)]
+            for h in handles:
+                out = h.result(timeout=180)
+                assert out.X is not None
+                assert h.status == "completed"
+    evs = _events(str(tmp_path))
+    done = [e for e in evs if e["event"] == "run_completed"]
+    assert len(done) == 2
+    # every acceptance came through the recovery path, on the record
+    assert all(e.get("recovered") for e in done), done
+    assert m.snapshot_compact().get("fed.recovered_commits", 0) == 2
+    # no worker was lost and nothing requeued: the worker stayed
+    # healthy the whole time — recovery is not the lost-worker ladder
+    names = [e["event"] for e in evs]
+    assert "worker_lost" not in names and "requeued" not in names
+    check_journal_coherent(os.path.join(str(tmp_path),
+                                        "journal.jsonl"), 2)
 
 
 def test_crash_requeue_resumes_bitwise_identical(tmp_path):

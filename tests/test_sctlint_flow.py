@@ -1,0 +1,851 @@
+"""The flow layer of sctlint: CFG construction (branches, loops,
+try/except/finally, with, early exits), the dataflow engine, the four
+concurrency-discipline rules SCT010-SCT013 (violating / clean /
+suppressed / baselined fixtures each — including the real PR-8 bug
+shapes as regression fixtures), and the incremental cache (hit
+identity, edited-file re-lint, poisoning guard, --jobs equivalence).
+"""
+
+import ast
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from tools.sctlint import Baseline, run_lint  # noqa: E402
+from tools.sctlint.baseline import assign_fingerprints  # noqa: E402
+from tools.sctlint.flow import build_cfg, dataflow  # noqa: E402
+
+
+def lint_src(tmp_path, src, only=None, name="snippet.py",
+             baseline=None):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return run_lint([str(p)], root=str(tmp_path), only=only,
+                    baseline=baseline, project_rules=False)
+
+
+def rule_ids(result):
+    return [v.rule for v in result.violations]
+
+
+def _fn(src):
+    return ast.parse(textwrap.dedent(src)).body[0]
+
+
+def _cfg(src):
+    return build_cfg(_fn(src))
+
+
+def _edges_into(cfg, kind):
+    """(src_kind, tag) pairs of every edge into a node of ``kind``."""
+    out = []
+    for n in cfg.nodes:
+        for s, tag in n.succs:
+            if s.kind == kind:
+                out.append((n.kind, tag))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CFG construction
+# ---------------------------------------------------------------------------
+
+def test_cfg_finally_reached_from_normal_and_exception_paths():
+    cfg = _cfg("""
+        def f():
+            try:
+                a()
+            finally:
+                b()
+        """)
+    tags = {tag for _, tag in _edges_into(cfg, "finally")}
+    # a() raising routes through the finally; a() completing too
+    assert "exc" in tags
+    assert "next" in tags
+
+
+def test_cfg_return_routes_through_enclosing_finally():
+    cfg = _cfg("""
+        def f():
+            try:
+                return a()
+            finally:
+                b()
+        """)
+    assert ("stmt", "return") in _edges_into(cfg, "finally")
+    # and the finally's fall-out reaches the function exit
+    fin_stmts = [n for n in cfg.nodes if n.kind == "stmt"
+                 and n.ast is not None and n.ast.lineno == 6]
+    assert any((cfg.exit, "next") in n.succs for n in fin_stmts)
+
+
+def test_cfg_loop_has_back_edge_and_false_exit():
+    cfg = _cfg("""
+        def f(xs):
+            while cond():
+                body()
+            after()
+        """)
+    tags = [tag for n in cfg.nodes for _, tag in n.succs]
+    assert "back" in tags
+    assert "false" in tags
+
+
+def test_cfg_break_and_continue_route_to_loop_boundaries():
+    cfg = _cfg("""
+        def f(xs):
+            for x in xs:
+                if x:
+                    break
+                continue
+        """)
+    tags = [tag for n in cfg.nodes for _, tag in n.succs]
+    assert "break" in tags
+    assert "continue" in tags
+
+
+def test_cfg_with_body_exception_bypasses_with_exit():
+    """A raise inside a with body must NOT flow through the with_exit
+    node — merging it there would conflate normal-path state onto the
+    raise exit (the FP that made a finally-protected push_call_wrapper
+    look leaky)."""
+    cfg = _cfg("""
+        def f(self):
+            with self._lock:
+                work()
+        """)
+    wexit = next(n for n in cfg.nodes if n.kind == "with_exit")
+    assert all(s is not cfg.raise_exit for s, _ in wexit.succs)
+    work = next(n for n in cfg.nodes if n.kind == "stmt"
+                and n.ast.lineno == 4)
+    assert (cfg.raise_exit, "exc") in work.succs
+
+
+def test_cfg_narrow_handler_may_propagate_broad_does_not():
+    narrow = _cfg("""
+        def f():
+            try:
+                a()
+            except ValueError:
+                h()
+        """)
+    # the dispatch node keeps an escape edge past a narrow handler
+    dispatch = next(n for n in narrow.nodes if n.kind == "dispatch")
+    assert any(s is narrow.raise_exit for s, _ in dispatch.succs)
+    broad = _cfg("""
+        def f():
+            try:
+                a()
+            except Exception:
+                h()
+        """)
+    dispatch = next(n for n in broad.nodes if n.kind == "dispatch")
+    assert all(s is not broad.raise_exit for s, _ in dispatch.succs)
+
+
+def test_cfg_nested_def_is_opaque():
+    cfg = _cfg("""
+        def f():
+            def inner():
+                raise ValueError()
+            return inner
+        """)
+    # the inner raise must not create an exc edge in f's CFG
+    tags = [tag for n in cfg.nodes for _, tag in n.succs]
+    assert "exc" not in tags
+
+
+def test_dataflow_fixpoint_over_loop_back_edge():
+    """A fact genned inside a loop body survives the back edge and is
+    visible at the loop head on the second pass (union merge to
+    fixpoint, not a single sweep)."""
+    cfg = _cfg("""
+        def f(xs):
+            for x in xs:
+                acquire()
+            after()
+        """)
+    acq = next(n for n in cfg.nodes if n.kind == "stmt"
+               and n.ast.lineno == 4)
+
+    def transfer(node, state):
+        state = state or frozenset()
+        if node is acq:
+            state = state | {"held"}
+        return state
+
+    states = dataflow(cfg, transfer)
+    head = next(n for n in cfg.nodes if n.kind == "test")
+    assert "held" in states[head]          # loop-carried
+    assert "held" in states[cfg.exit]      # escapes the loop
+
+
+# ---------------------------------------------------------------------------
+# SCT010 — resource pairing (incl. the PR-8 probe-slot regression)
+# ---------------------------------------------------------------------------
+
+def test_sct010_pr8_shape_probe_claim_leaks_on_raising_journal_write(
+        tmp_path):
+    """THE PR-8 bug: probe slot claimed, then a journal write between
+    claim and verdict raises — the slot leaks and every breaker
+    sharer is wedged on the fallback until process restart."""
+    r = lint_src(tmp_path, """
+        def probe_once(self):
+            if self.breaker.try_acquire_probe():
+                rec = self.probe()
+                self.journal.write("health_check", result=rec)
+                if rec.get("ok"):
+                    self.breaker.record_success()
+                else:
+                    self.breaker.record_failure()
+        """, only=["SCT010"])
+    assert rule_ids(r) == ["SCT010"]
+    assert "probe slot" in r.violations[0].message
+    assert "raising path" in r.violations[0].message
+
+
+def test_sct010_clean_resolve_or_release_finally(tmp_path):
+    """The runner's fixed idiom: conditional release in a finally
+    resolves every raising path — must NOT flag (the release is
+    guarded by a verdict flag the analysis cannot track; a release
+    anywhere in the finally body counts)."""
+    r = lint_src(tmp_path, """
+        def probe_once(self):
+            if self.breaker.try_acquire_probe():
+                resolved = False
+                try:
+                    rec = self.probe()
+                    self.journal.write("health_check", result=rec)
+                    if rec.get("ok"):
+                        self.breaker.record_success()
+                    else:
+                        self.breaker.record_failure()
+                    resolved = True
+                finally:
+                    if not resolved:
+                        self.breaker.release_probe()
+        """, only=["SCT010"])
+    assert rule_ids(r) == []
+
+
+def test_sct010_pr8_shape_pop_wrapper_without_finally(tmp_path):
+    """The PR-8 chaos-hook bug shape: push_call_wrapper paired with a
+    pop on the straight-line path only — any raise in between leaves
+    the wrapper installed for every later run."""
+    r = lint_src(tmp_path, """
+        def run_wrapped(self, w):
+            registry.push_call_wrapper(w)
+            out = self.pipeline.run()
+            registry.pop_call_wrapper(w)
+            return out
+        """, only=["SCT010"])
+    assert rule_ids(r) == ["SCT010"]
+    assert "call-wrapper hook" in r.violations[0].message
+
+
+def test_sct010_early_return_between_push_and_pop_flags(tmp_path):
+    r = lint_src(tmp_path, """
+        def run_wrapped(self, w, data):
+            registry.push_call_wrapper(w)
+            if not data:
+                return None
+            registry.pop_call_wrapper(w)
+        """, only=["SCT010"])
+    assert rule_ids(r) == ["SCT010"]
+    assert "early-return" in r.violations[0].message
+
+
+def test_sct010_clean_push_pop_in_try_finally_and_cm(tmp_path):
+    r = lint_src(tmp_path, """
+        import contextlib
+
+        def run_wrapped(self, w):
+            registry.push_call_wrapper(w)
+            try:
+                return self.pipeline.run()
+            finally:
+                registry.pop_call_wrapper(w)
+
+        def run_managed(self, chaos):
+            with chaos.activate():
+                return self.pipeline.run()
+
+        def run_stacked(self, chaos):
+            stack = contextlib.ExitStack()
+            stack.enter_context(chaos.activate())
+            return stack
+
+        def conditional(self):
+            ok = self.breaker.try_acquire_probe()
+            if not ok:
+                return None
+            try:
+                return self.probe()
+            finally:
+                self.breaker.release_probe()
+        """, only=["SCT010"])
+    assert rule_ids(r) == []
+
+
+def test_sct010_claim_file_leak_and_clean(tmp_path):
+    r = lint_src(tmp_path, """
+        import json
+        import os
+
+        def claim_bad(self, path):
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            with os.fdopen(fd, "w") as f:
+                json.dump({"owner": self.owner}, f)
+            return True
+
+        def claim_good(self, path):
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            try:
+                with os.fdopen(fd, "w") as f:
+                    json.dump({"owner": self.owner}, f)
+            finally:
+                os.unlink(path)
+            return True
+
+        def lockdir_good(self, lockdir):
+            os.mkdir(lockdir)
+            try:
+                self.publish()
+            finally:
+                os.rmdir(lockdir)
+        """, only=["SCT010"])
+    assert rule_ids(r) == ["SCT010"]
+    assert r.violations[0].line == 6  # claim_bad's os.open
+    assert "claim file" in r.violations[0].message
+
+
+def test_sct010_bare_activate_statement_flags(tmp_path):
+    r = lint_src(tmp_path, """
+        def arm(self, chaos):
+            chaos.activate()
+            return self.run()
+        """, only=["SCT010"])
+    assert rule_ids(r) == ["SCT010"]
+    assert "constructed and dropped" in r.violations[0].message
+
+
+def test_sct010_suppressed_ownership_transfer(tmp_path):
+    r = lint_src(tmp_path, """
+        def claim(self):
+            # ownership: verdict paths release
+            if not self.breaker.try_acquire_probe():  # sctlint: disable=SCT010
+                return False
+            return True
+        """, only=["SCT010"])
+    assert rule_ids(r) == []
+    assert [v.rule for v in r.suppressed] == ["SCT010"]
+
+
+# ---------------------------------------------------------------------------
+# SCT011 — lock-scope hygiene (incl. the PR-8 journal-under-lock shape)
+# ---------------------------------------------------------------------------
+
+def test_sct011_pr8_shape_terminal_journal_under_dispatch_lock(
+        tmp_path):
+    """The PR-8 review shape: a TERMINAL journal write while holding
+    the dispatch lock — disk latency stalls every tenant's admission
+    and every worker's dispatch."""
+    r = lint_src(tmp_path, """
+        def finish(self, item):
+            with self._lock:
+                self.journal.write("run_completed", ticket=item.seq)
+        """, only=["SCT011"])
+    assert rule_ids(r) == ["SCT011"]
+    assert "run_completed" in r.violations[0].message
+
+
+def test_sct011_allowlisted_funnel_events_in_lock_are_clean(tmp_path):
+    r = lint_src(tmp_path, """
+        def admit(self, ticket, tenant):
+            with self._cv:
+                self.journal.write("submitted", ticket=ticket)
+                self.journal.write("admitted", ticket=ticket)
+                self.metrics.counter("sched.admitted",
+                                     tenant=tenant).inc()
+            self.journal.write("run_completed", ticket=ticket)
+        """, only=["SCT011"])
+    assert rule_ids(r) == []
+
+
+def test_sct011_flags_io_snapshot_subprocess_and_callback(tmp_path):
+    r = lint_src(tmp_path, """
+        def bad(self, proc, on_done):
+            with self._lock:
+                snap = self.breakers.snapshot()
+                with open("x.json", "w") as f:
+                    pass
+                proc.wait(timeout=5)
+                on_done(snap)
+        """, only=["SCT011"])
+    msgs = " | ".join(v.message for v in r.violations)
+    assert len(r.violations) == 4
+    assert "snapshot" in msgs
+    assert "open()" in msgs
+    assert ".wait()" in msgs
+    assert "user callback" in msgs
+
+
+def test_sct011_clean_cv_wait_path_join_and_super_snapshot(tmp_path):
+    r = lint_src(tmp_path, """
+        import os
+
+        class A:
+            def worker(self):
+                with self._cv:
+                    self._cv.wait()
+                    p = os.path.join(self.root, "x")
+                    n = self._cv.notify_all()
+                return p, n
+
+            def snapshot(self):
+                with self.lock:
+                    snap = super().snapshot()
+                return snap
+        """, only=["SCT011"])
+    assert rule_ids(r) == []
+
+
+def test_sct011_inconsistent_lock_order_flags_both_sites(tmp_path):
+    r = lint_src(tmp_path, """
+        def a(self):
+            with self._lock:
+                with self.breaker.lock:
+                    pass
+
+        def b(self):
+            with self.breaker.lock:
+                with self._lock:
+                    pass
+        """, only=["SCT011"])
+    assert rule_ids(r) == ["SCT011", "SCT011"]
+    assert all("lock order" in v.message for v in r.violations)
+
+
+def test_sct011_consistent_nesting_is_clean(tmp_path):
+    r = lint_src(tmp_path, """
+        def a(self):
+            with self._lock:
+                with self.breaker.lock:
+                    pass
+
+        def b(self):
+            with self._lock:
+                with self.breaker.lock:
+                    pass
+        """, only=["SCT011"])
+    assert rule_ids(r) == []
+
+
+def test_sct011_suppressible_for_sanctioned_append_lock(tmp_path):
+    r = lint_src(tmp_path, """
+        def write(self, rec):
+            with self._lock:
+                with open(self.path, "a") as f:  # sctlint: disable=SCT011
+                    f.write(rec)  # sctlint: disable=SCT011
+        """, only=["SCT011"])
+    assert rule_ids(r) == []
+    assert len(r.suppressed) == 2
+
+
+def test_sct011_baselined_violation_passes(tmp_path):
+    src = """
+        def finish(self, item):
+            with self._lock:
+                self.journal.write("run_failed", ticket=item.seq)
+        """
+    first = lint_src(tmp_path, src, only=["SCT011"])
+    assert len(first.violations) == 1
+    b = Baseline.from_violations(
+        assign_fingerprints(first.violations),
+        default_reason="grandfathered for the fixture")
+    path = tmp_path / "bl.json"
+    b.save(str(path))
+    again = lint_src(tmp_path, src, only=["SCT011"],
+                     baseline=Baseline.load(str(path)))
+    assert again.ok
+    assert [v.rule for v in again.baselined] == ["SCT011"]
+
+
+# ---------------------------------------------------------------------------
+# SCT012 — journal-protocol conformance
+# ---------------------------------------------------------------------------
+
+def test_sct012_flags_foreign_event_in_scheduler_module(tmp_path):
+    # "backoff" is a runner-lifecycle event; a scheduler-named module
+    # emitting it merges two funnels in every report
+    r = lint_src(tmp_path, """
+        def worker(self):
+            self.journal.write("submitted", ticket=1)
+            self.journal.write("backoff", delay_s=0.1)
+        """, only=["SCT012"], name="scheduler.py")
+    bad = [v for v in r.violations if "backoff" in v.message]
+    assert len(bad) == 1
+    assert "protocol table" in bad[0].message
+
+
+def test_sct012_flags_missing_terminal_emission_sites(tmp_path):
+    r = lint_src(tmp_path, """
+        def admit(self):
+            self.journal.write("submitted", ticket=1)
+            self.journal.write("admitted", ticket=1)
+        """, only=["SCT012"], name="scheduler.py")
+    missing = {v.message.split("'")[1] for v in r.violations
+               if "no emission site" in v.message}
+    assert missing == {"rejected", "shed", "run_completed",
+                       "run_failed"}
+
+
+def test_sct012_clean_full_scheduler_lifecycle(tmp_path):
+    r = lint_src(tmp_path, """
+        def lifecycle(self, t):
+            self.journal.write("submitted", ticket=t)
+            self.journal.write("admitted", ticket=t)
+            self.journal.write("rejected", ticket=t)
+            self.journal.write("shed", ticket=t)
+            self.journal.write("preempted", ticket=t)
+            self.journal.write("run_completed", ticket=t)
+            self.journal.write("run_failed", ticket=t)
+        """, only=["SCT012"], name="scheduler.py")
+    assert rule_ids(r) == []
+
+
+def test_sct012_uncovered_modules_and_computed_names_skip(tmp_path):
+    r = lint_src(tmp_path, """
+        def anything(self, ev):
+            self.journal.write("backoff", delay_s=0.1)
+            self.journal.write(ev)
+        """, only=["SCT012"], name="misc_module.py")
+    assert rule_ids(r) == []
+
+
+def test_sct012_suppressible_per_line(tmp_path):
+    r = lint_src(tmp_path, """
+        def worker(self):
+            self.journal.write("submitted", ticket=1)
+            self.journal.write("rejected", ticket=1)
+            self.journal.write("shed", ticket=1)
+            self.journal.write("run_completed", ticket=1)
+            self.journal.write("run_failed", ticket=1)
+            self.journal.write("backoff", delay_s=0.1)  # sctlint: disable=SCT012
+        """, only=["SCT012"], name="scheduler.py")
+    assert rule_ids(r) == []
+    assert [v.rule for v in r.suppressed] == ["SCT012"]
+
+
+def test_sct012_protocol_tables_agree_with_live_vocabulary():
+    """The AST-extracted tables must match the importable module, and
+    every table must be a subset of EVENTS — the same live-agreement
+    contract SCT009's vocabulary has."""
+    from sctools_tpu.utils.telemetry import EVENTS, JOURNAL_PROTOCOLS
+    from tools.sctlint.rules.journalproto import _load_protocols
+
+    protocols = _load_protocols()
+    assert protocols is not None
+    assert set(protocols) == set(JOURNAL_PROTOCOLS)
+    for mod, table in JOURNAL_PROTOCOLS.items():
+        assert protocols[mod]["events"] == table["events"]
+        assert protocols[mod]["terminal"] == table["terminal"]
+        assert set(table["events"]) <= EVENTS
+        assert set(table["terminal"]) <= set(table["events"])
+
+
+# ---------------------------------------------------------------------------
+# SCT013 — guarded-field discipline
+# ---------------------------------------------------------------------------
+
+_SCT013_HYBRID = """
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._running = 0
+
+        def inc(self):
+            with self._lock:
+                self._running += 1
+
+        def dec(self):
+            self._running -= 1
+    """
+
+
+def test_sct013_flags_hybrid_guarded_and_bare_writes(tmp_path):
+    r = lint_src(tmp_path, _SCT013_HYBRID, only=["SCT013"])
+    assert rule_ids(r) == ["SCT013"]
+    v = r.violations[0]
+    assert "_running" in v.message
+    assert "dec()" in v.message
+
+
+def test_sct013_init_writes_and_all_guarded_are_clean(tmp_path):
+    r = lint_src(tmp_path, """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._running = 0
+                self._seq = 0
+
+            def inc(self):
+                with self._lock:
+                    self._running += 1
+                    self._seq += 1
+
+        class NoLocks:
+            def set(self, v):
+                self._v = v
+
+            def clear(self):
+                self._v = None
+        """, only=["SCT013"])
+    assert rule_ids(r) == []
+
+
+def test_sct013_locked_by_caller_annotation_exempts_helper(tmp_path):
+    r = lint_src(tmp_path, _SCT013_HYBRID.replace(
+        "def dec(self):",
+        "def dec(self):\n"
+        "            # sctlint: locked-by-caller\n"),
+        only=["SCT013"])
+    assert rule_ids(r) == []
+
+
+def test_sct013_annotation_in_nested_def_binds_innermost(tmp_path):
+    """A locked-by-caller comment inside a NESTED def must not exempt
+    the enclosing method — the annotation binds to the innermost
+    function containing its line."""
+    r = lint_src(tmp_path, """
+        import threading
+
+        class Pool:
+            def inc(self):
+                with self._lock:
+                    self._running += 1
+
+            def dec(self):
+                def helper():
+                    # sctlint: locked-by-caller
+                    self._other = 1
+                helper()
+                self._running -= 1
+        """, only=["SCT013"])
+    assert rule_ids(r) == ["SCT013"]
+    assert "_running" in r.violations[0].message
+
+
+def test_sct013_suppressible_per_line(tmp_path):
+    r = lint_src(tmp_path, _SCT013_HYBRID.replace(
+        "self._running -= 1",
+        "self._running -= 1  # sctlint: disable=SCT013"),
+        only=["SCT013"])
+    assert rule_ids(r) == []
+    assert [v.rule for v in r.suppressed] == ["SCT013"]
+
+
+# ---------------------------------------------------------------------------
+# every flow rule honours the baseline (grandfather-with-reason)
+# ---------------------------------------------------------------------------
+
+_BASELINABLE = {
+    # rule -> (fixture name, source, edit that moves the flagged line)
+    "SCT010": ("snippet.py", """
+        def run(self, w):
+            registry.push_call_wrapper(w)
+            out = self.pipeline.run()
+            registry.pop_call_wrapper(w)
+            return out
+        """, ("push_call_wrapper(w)", "push_call_wrapper(w, False)")),
+    "SCT011": ("snippet.py", """
+        def finish(self, item):
+            with self._lock:
+                self.journal.write("run_completed", ticket=item.seq)
+        """, ("ticket=item.seq", "ticket=item.ticket")),
+    "SCT012": ("scheduler.py", """
+        def worker(self):
+            self.journal.write("submitted", ticket=1)
+            self.journal.write("rejected", ticket=1)
+            self.journal.write("shed", ticket=1)
+            self.journal.write("run_completed", ticket=1)
+            self.journal.write("run_failed", ticket=1)
+            self.journal.write("backoff", delay_s=0.1)
+        """, ("delay_s=0.1", "delay_s=0.2")),
+    "SCT013": ("snippet.py", _SCT013_HYBRID,
+               ("self._running -= 1", "self._running -= 2")),
+}
+
+
+@pytest.mark.parametrize("rid", ["SCT010", "SCT011", "SCT012",
+                                 "SCT013"])
+def test_flow_rules_honour_the_baseline(tmp_path, rid):
+    name, src, (old, new) = _BASELINABLE[rid]
+    first = lint_src(tmp_path, src, only=[rid], name=name)
+    assert rule_ids(first) == [rid]
+    b = Baseline.from_violations(
+        assign_fingerprints(first.violations),
+        default_reason="grandfathered for the fixture")
+    path = tmp_path / "bl.json"
+    b.save(str(path))
+    again = lint_src(tmp_path, src, only=[rid], name=name,
+                     baseline=Baseline.load(str(path)))
+    assert again.ok, [v.format() for v in again.violations]
+    assert [v.rule for v in again.baselined] == [rid]
+    # and the baseline stays a ratchet: editing the flagged code
+    # makes the entry stale, which fails the run
+    edited = lint_src(tmp_path, src.replace(old, new), only=[rid],
+                      name=name, baseline=Baseline.load(str(path)))
+    assert not edited.ok
+
+
+# ---------------------------------------------------------------------------
+# incremental cache + --jobs
+# ---------------------------------------------------------------------------
+
+_CACHED_SRC = textwrap.dedent("""\
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        for i in range(100):
+            x = jnp.dot(x, x)
+        return x
+    """)
+
+
+def test_cache_hit_skips_analysis_and_edit_invalidates(tmp_path):
+    """The poisoning guard, both directions: an UNCHANGED file's
+    findings come from the cache (proven by poisoning the cached
+    entry and seeing the poison surface), and an EDITED file's digest
+    misses the cache (the poison disappears, the real findings
+    return)."""
+    src = tmp_path / "hot.py"
+    src.write_text(_CACHED_SRC)
+    cache_dir = str(tmp_path / "cache")
+    first = run_lint([str(src)], root=str(tmp_path), only=["SCT002"],
+                     project_rules=False, cache_dir=cache_dir)
+    assert rule_ids(first) == ["SCT002"]
+    # exactly one generation dir with exactly one entry
+    gens = os.listdir(cache_dir)
+    assert len(gens) == 1
+    entries = os.listdir(os.path.join(cache_dir, gens[0]))
+    assert len(entries) == 1
+    epath = os.path.join(cache_dir, gens[0], entries[0])
+    doc = json.load(open(epath))
+    doc["violations"][0]["message"] = "POISONED"
+    json.dump(doc, open(epath, "w"))
+    again = run_lint([str(src)], root=str(tmp_path), only=["SCT002"],
+                     project_rules=False, cache_dir=cache_dir)
+    assert again.violations[0].message == "POISONED"  # digest hit
+    # edit the file: digest moves, entry ignored, real analysis runs
+    src.write_text(_CACHED_SRC.replace("range(100)", "range(200)"))
+    edited = run_lint([str(src)], root=str(tmp_path), only=["SCT002"],
+                      project_rules=False, cache_dir=cache_dir)
+    assert rule_ids(edited) == ["SCT002"]
+    assert edited.violations[0].message != "POISONED"
+
+
+def test_cache_fingerprint_isolates_rule_selections(tmp_path):
+    src = tmp_path / "hot.py"
+    src.write_text(_CACHED_SRC)
+    cache_dir = str(tmp_path / "cache")
+    run_lint([str(src)], root=str(tmp_path), only=["SCT002"],
+             project_rules=False, cache_dir=cache_dir)
+    run_lint([str(src)], root=str(tmp_path), only=["SCT001"],
+             project_rules=False, cache_dir=cache_dir)
+    # different selections -> different fingerprint generations (a
+    # narrow run's empty findings can never mask a wide run's)
+    assert len(os.listdir(cache_dir)) == 2
+
+
+def test_cache_prunes_stale_generations_lru(tmp_path):
+    """Every rule/selection edit mints a new fingerprint generation
+    and nothing else deletes one — the LRU prune bounds the cache at
+    KEEP_GENERATIONS, never dropping the active generation."""
+    from tools.sctlint.cache import LintCache
+
+    src = tmp_path / "hot.py"
+    src.write_text(_CACHED_SRC)
+    cache_dir = str(tmp_path / "cache")
+    selections = ["SCT001", "SCT002", "SCT003", "SCT004", "SCT005",
+                  "SCT008"]
+    for rid in selections:
+        run_lint([str(src)], root=str(tmp_path), only=[rid],
+                 project_rules=False, cache_dir=cache_dir)
+    gens = os.listdir(cache_dir)
+    assert len(gens) == LintCache.KEEP_GENERATIONS
+    # the most recent selection's generation survived: its entry
+    # still serves a poisoning-proof digest hit
+    again = run_lint([str(src)], root=str(tmp_path),
+                     only=[selections[-1]], project_rules=False,
+                     cache_dir=cache_dir)
+    assert len(os.listdir(cache_dir)) == LintCache.KEEP_GENERATIONS
+    assert rule_ids(again) == rule_ids(
+        run_lint([str(src)], root=str(tmp_path),
+                 only=[selections[-1]], project_rules=False))
+
+
+def test_cache_preserves_suppressed_findings(tmp_path):
+    src = tmp_path / "hot.py"
+    src.write_text(_CACHED_SRC.replace(
+        "for i in range(100):",
+        "for i in range(100):  # sctlint: disable=SCT002"))
+    cache_dir = str(tmp_path / "cache")
+    first = run_lint([str(src)], root=str(tmp_path), only=["SCT002"],
+                     project_rules=False, cache_dir=cache_dir)
+    second = run_lint([str(src)], root=str(tmp_path), only=["SCT002"],
+                      project_rules=False, cache_dir=cache_dir)
+    for r in (first, second):
+        assert rule_ids(r) == []
+        assert [v.rule for v in r.suppressed] == ["SCT002"]
+
+
+def test_jobs_pool_matches_serial_results(tmp_path):
+    for i, body in enumerate((
+            "def a(self):\n"
+            "    with self._lock:\n"
+            "        self.journal.write('run_failed', t=1)\n",
+            _CACHED_SRC,
+            "x = 1\n")):
+        (tmp_path / f"m{i}.py").write_text(body)
+    serial = run_lint([str(tmp_path)], root=str(tmp_path),
+                      project_rules=False)
+    pooled = run_lint([str(tmp_path)], root=str(tmp_path),
+                      project_rules=False, jobs=2)
+    assert [v.to_json() for v in serial.violations] == \
+        [v.to_json() for v in pooled.violations]
+    assert len(serial.violations) >= 2  # SCT011 + SCT002 at least
+
+
+# ---------------------------------------------------------------------------
+# the production modules carry the documented annotations
+# ---------------------------------------------------------------------------
+
+def test_flow_rules_clean_on_production_modules():
+    """The acceptance contract: scheduler/federation/runner/chaos —
+    the modules whose PR-8-era bugs motivated the rules — lint clean
+    on SCT010-SCT013 with an EMPTY baseline (fixes in place,
+    deliberate exceptions annotated)."""
+    targets = [os.path.join(_ROOT, "sctools_tpu", p) for p in (
+        "scheduler.py", "federation.py", "runner.py",
+        os.path.join("utils", "chaos.py"),
+        os.path.join("utils", "failsafe.py"))]
+    r = run_lint(targets, root=_ROOT,
+                 only=["SCT010", "SCT011", "SCT012", "SCT013"],
+                 project_rules=False)
+    assert r.ok, [v.format() for v in r.violations]
+    # the deliberate exceptions are visible as suppressions, not holes
+    assert len(r.suppressed) >= 4

@@ -8,18 +8,26 @@
 #                                 # minutes — lint + parity + hygiene)
 #
 # Stages:
-#   1. sctlint        python -m tools.sctlint sctools_tpu
-#                     (AST rules SCT001-SCT006 + SCT008 bare-clock +
-#                      SCT009 telemetry vocabulary + parity SCT000 +
-#                      repo-hygiene SCT007; suppressions + baseline
-#                      honoured, stale baseline entries fail)
+#   1. sctlint        python -m tools.sctlint sctools_tpu --jobs 0
+#                     (the full registered rule set — per-line rules
+#                      SCT001-SCT009 plus the flow rules SCT010-SCT013
+#                      on the CFG layer, parity SCT000, repo-hygiene
+#                      SCT007; suppressions + baseline honoured, stale
+#                      baseline entries fail.  Incremental: findings
+#                      cached under .sctlint_cache/ keyed by file
+#                      digest + rule-set fingerprint, so unchanged
+#                      files cost a hash, not an analysis)
 #   2. tracked-bytecode guard (belt-and-braces duplicate of SCT007,
 #                     kept shell-side so the gate still catches it if
 #                     sctlint itself is broken)
-#   3. bare-clock guard (belt-and-braces duplicate of SCT008: the
+#   3. bare-clock     python -m tools.sctlint --select SCT008: the
 #                     resilience stack must schedule through the
-#                     injectable clock, utils/vclock.py, so deadline/
-#                     breaker/backoff tests never really sleep)
+#                     injectable clock (utils/vclock.py) so deadline/
+#                     breaker/backoff tests never really sleep.  Runs
+#                     THROUGH sctlint so the covered-module list has
+#                     exactly one source of truth (the rule's own
+#                     path set) — the old shell-side grep duplicated
+#                     it and drifted every time a module was added
 #   4. sctreport      python -m tools.sctreport on the committed
 #                     synthetic run fixture (journal + spans +
 #                     metrics); a non-zero exit OR an empty report
@@ -93,8 +101,8 @@ FAST=0
 fail=0
 stage() { printf '\n== %s ==\n' "$1"; }
 
-stage "sctlint (static analysis, rules SCT000-SCT009)"
-if ! JAX_PLATFORMS=cpu python -m tools.sctlint sctools_tpu; then
+stage "sctlint (static analysis, full registered rule set)"
+if ! JAX_PLATFORMS=cpu python -m tools.sctlint sctools_tpu --jobs 0; then
     fail=1
 fi
 
@@ -109,25 +117,19 @@ else
 fi
 
 stage "bare-clock guard (resilience modules use the injectable clock)"
-bare=$(grep -nE '\btime\.(sleep|monotonic)\b' \
-        sctools_tpu/runner.py \
-        sctools_tpu/scheduler.py \
-        sctools_tpu/federation.py \
-        sctools_tpu/utils/failsafe.py \
-        sctools_tpu/utils/checkpoint.py \
-        sctools_tpu/utils/chaos.py \
-        sctools_tpu/utils/telemetry.py \
-        sctools_tpu/data/stream.py \
-        sctools_tpu/data/shardstore.py \
-        sctools_tpu/models/train_stream.py 2>/dev/null \
-        | grep -v 'sctlint: disable=SCT008' || true)
-if [ -n "$bare" ]; then
+# one source of truth: SCT008's own covered-module list, via sctlint
+# (--no-project-rules: this stage re-checks ONE rule, not parity;
+# --no-cache: a fresh analysis, so a stale/poisoned cache hit in
+# stage 1 cannot blind this guard too)
+if JAX_PLATFORMS=cpu python -m tools.sctlint sctools_tpu \
+        --select SCT008 --no-project-rules --no-cache > /dev/null; then
+    echo "OK: deadlines/backoff/cooldowns go through the injectable clock"
+else
     echo "bare time.sleep/time.monotonic in resilience modules" \
          "(schedule through sctools_tpu/utils/vclock.py):"
-    echo "$bare"
+    JAX_PLATFORMS=cpu python -m tools.sctlint sctools_tpu \
+        --select SCT008 --no-project-rules --no-cache || true
     fail=1
-else
-    echo "OK: deadlines/backoff/cooldowns go through the injectable clock"
 fi
 
 stage "sctreport (run-report CLI on the committed run fixture)"

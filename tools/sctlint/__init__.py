@@ -15,6 +15,21 @@ sctlint turns them into machine-checked contracts:
 * ``SCT005`` broad ``except Exception`` in runner/failsafe paths
 * ``SCT006`` registry naming/docstring conventions
 * ``SCT007`` repo hygiene (no tracked __pycache__/*.pyc)
+* ``SCT008`` bare wall-clock scheduling in the resilience stack
+* ``SCT009`` journal/metric names from the central vocabulary
+
+...and, on the intra-procedural CFG layer (``flow.py``), the
+concurrency-discipline rules the scheduler/federation review history
+motivated:
+
+* ``SCT010`` acquire/release pairing on every path (probe slots,
+  call-wrapper hooks, O_EXCL/lockdir claim files)
+* ``SCT011`` lock-scope hygiene (no journal/snapshot/IO/subprocess/
+  callback work under a held lock; consistent lock order)
+* ``SCT012`` journal-protocol conformance (per-module lifecycle
+  tables, terminal-state emission coverage)
+* ``SCT013`` guarded-field discipline (no lock-guarded-here,
+  bare-there field writes)
 
 Usage::
 

@@ -1,0 +1,155 @@
+"""Incremental lint cache + parallel per-file analysis.
+
+The lint stage of ``tools/run_checks.sh`` runs on every push; with
+the flow rules (SCT010-SCT013) each file now costs a CFG build and a
+fixpoint walk per function, and the repo only grows.  Two levers keep
+the stage wall flat:
+
+* **Content-addressed cache** (``.sctlint_cache/`` at the repo root,
+  gitignored): per-file findings keyed by ``sha256(path + source)``
+  under a RULE-SET FINGERPRINT directory.  The fingerprint hashes
+  every ``tools/sctlint/**.py`` source, the vocabulary module the
+  rules read (``sctools_tpu/utils/telemetry.py`` — SCT009/SCT012
+  extract EVENTS/METRICS/JOURNAL_PROTOCOLS from it), and the selected
+  rule ids — editing a rule, the vocabulary, the selection, or the
+  file itself all miss the cache; nothing else can change a file's
+  findings (file rules are a pure function of one module's source).
+  Project rules (SCT000 parity, SCT007 hygiene) are never cached —
+  they read the registry and git, not files.
+* **``--jobs N``** — analyze cache-miss files in a process pool (AST
+  work is GIL-bound, so threads would serialize); each worker
+  re-parses its file and runs the file+flow rules, returning plain
+  dicts.
+
+Poisoning resistance is the tier-1-tested contract: an edited file
+re-lints (its digest moved), an unedited file's hit returns byte-
+identical findings, and a rule edit invalidates everything (the
+fingerprint moved).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import shutil
+
+#: bump to invalidate every cache on a schema change
+_SCHEMA = 1
+
+
+def ruleset_fingerprint(root: str, rule_ids) -> str:
+    """Hash of everything besides the linted file that can change a
+    file-scope finding: the linter's own sources, the vocabulary
+    module they extract tables from, and the active rule selection."""
+    h = hashlib.sha256(f"schema={_SCHEMA}".encode())
+    h.update(",".join(sorted(rule_ids)).encode())
+    lint_dir = os.path.dirname(os.path.abspath(__file__))
+    paths = []
+    for dirpath, dirnames, filenames in os.walk(lint_dir):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        paths.extend(os.path.join(dirpath, f)
+                     for f in filenames if f.endswith(".py"))
+    paths.append(os.path.join(root, "sctools_tpu", "utils",
+                              "telemetry.py"))
+    for p in sorted(paths):
+        h.update(p.encode())
+        try:
+            with open(p, "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(b"<unreadable>")
+    return h.hexdigest()[:16]
+
+
+def file_digest(path: str, source: str) -> str:
+    return hashlib.sha256(
+        f"{path}\0{source}".encode()).hexdigest()[:32]
+
+
+class LintCache:
+    """One fingerprint generation of the on-disk cache.  ``get`` /
+    ``put`` trade ``(violations, suppressed)`` dict-lists per file
+    digest; IO errors degrade to cache-off (a broken disk must never
+    break the lint)."""
+
+    #: generations kept by the LRU prune.  >1 on purpose: run_checks
+    #: alternates fingerprints (stage 1 full lint, stage 3 --select
+    #: SCT008), so keeping only the active one would thrash both.
+    KEEP_GENERATIONS = 4
+
+    def __init__(self, cache_dir: str, fingerprint: str):
+        self.dir = os.path.join(cache_dir, fingerprint)
+        self.hits = 0
+        self.misses = 0
+        # LRU-prune superseded generations: every rule/vocabulary/
+        # selection edit mints a new fingerprint dir, and nothing
+        # else ever deletes one — without a bound the cache grows by
+        # a full findings set per edit.  Touch the active generation,
+        # keep the newest K, drop the rest (best-effort: a concurrent
+        # lint whose generation was dropped just re-misses).
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            os.utime(self.dir)
+            gens = []
+            for name in os.listdir(cache_dir):
+                p = os.path.join(cache_dir, name)
+                try:
+                    gens.append((os.path.getmtime(p), p))
+                except OSError:
+                    continue
+            gens.sort(reverse=True)
+            for _, p in gens[self.KEEP_GENERATIONS:]:
+                shutil.rmtree(p, ignore_errors=True)
+        except OSError:
+            pass
+
+    def _path(self, digest: str) -> str:
+        return os.path.join(self.dir, digest + ".json")
+
+    def get(self, digest: str):
+        try:
+            with open(self._path(digest), encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            doc = None
+        if not isinstance(doc, dict):  # valid JSON but not an entry
+            self.misses += 1
+            return None
+        self.hits += 1
+        return doc.get("violations", []), doc.get("suppressed", [])
+
+    def put(self, digest: str, violations, suppressed) -> None:
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            tmp = self._path(digest) + f".tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"violations": violations,
+                           "suppressed": suppressed}, f)
+            os.replace(tmp, self._path(digest))
+        except OSError:
+            pass  # cache-off degrade: the findings were computed anyway
+
+
+def analyze_one(abspath: str, root: str, rule_ids: list[str]):
+    """Process-pool worker: lint ONE file with the given file/flow
+    rules, returning plain dicts.  Re-parses in the child (source
+    strings don't survive fork-free spawn cheaply, parsing is cheap,
+    and the rules are the expensive part)."""
+    # registers all rules in the child on first call
+    from . import core
+
+    try:
+        ctx = core.load_file(abspath, root)
+    except SyntaxError as e:
+        return {"error": f"{core._rel(abspath, root)}:{e.lineno or 0}: "
+                         f"syntax error: {e.msg}"}
+    except (OSError, UnicodeDecodeError) as e:
+        return {"error": f"{core._rel(abspath, root)}: unreadable: {e}"}
+    violations, suppressed = core.run_file_rules(ctx, rule_ids)
+    return {
+        "digest": file_digest(ctx.path, ctx.source),
+        "violations": [dataclasses.asdict(v) for v in violations],
+        "suppressed": [dataclasses.asdict(v) for v in suppressed],
+    }

@@ -19,6 +19,23 @@ def default_baseline_path(root: str) -> str:
     return os.path.join(root, "tools", "sctlint", "baseline.json")
 
 
+def default_cache_dir(root: str) -> str:
+    return os.path.join(root, ".sctlint_cache")
+
+
+def _rule_span() -> str:
+    """The rule-id range for help text, DERIVED from the registry —
+    a new rule module appears here (and in --list-rules) without
+    anyone remembering to edit a hardcoded string."""
+    ids = sorted(RULES)
+    return f"{ids[0]}-{ids[-1]}" if ids else "none"
+
+
+def _project_rule_ids() -> str:
+    return "/".join(sorted(r.id for r in RULES.values()
+                           if r.scope == "project")) or "none"
+
+
 def _parse_ids(s: str | None) -> list[str] | None:
     if s is None:
         return None
@@ -69,9 +86,9 @@ def _print_json(result: LintResult) -> None:
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.sctlint",
-        description="AST-based JAX correctness linter for sctools-tpu "
-                    "(rules SCT000-SCT009; see docs/ARCHITECTURE.md "
-                    "'Static analysis')")
+        description=f"AST+CFG correctness linter for sctools-tpu "
+                    f"(rules {_rule_span()}; see docs/ARCHITECTURE.md "
+                    f"'Static analysis')")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to lint (default: sctools_tpu)")
     ap.add_argument("--format", choices=("text", "json"), default="text")
@@ -83,12 +100,24 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--update-baseline", action="store_true",
                     help="rewrite the baseline from current hits, "
                          "keeping reasons for surviving entries")
-    ap.add_argument("--only", metavar="IDS",
-                    help="comma-separated rule ids to run")
-    ap.add_argument("--disable", metavar="IDS",
+    ap.add_argument("--only", "--select", dest="only", metavar="IDS",
+                    help=f"comma-separated rule ids to run "
+                         f"(registered: {_rule_span()})")
+    ap.add_argument("--disable", "--ignore", dest="disable",
+                    metavar="IDS",
                     help="comma-separated rule ids to skip")
     ap.add_argument("--no-project-rules", action="store_true",
-                    help="skip project-scope rules (SCT000/SCT007)")
+                    help=f"skip project-scope rules "
+                         f"({_project_rule_ids()})")
+    ap.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="analyze files in N worker processes "
+                         "(0 = one per CPU; default 1)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="disable the incremental findings cache "
+                         "(.sctlint_cache/, keyed by file digest + "
+                         "rule-set fingerprint)")
+    ap.add_argument("--cache-dir", metavar="PATH",
+                    help="cache location (default <root>/.sctlint_cache)")
     ap.add_argument("--show-baselined", action="store_true",
                     help="also print baselined hits (text format)")
     ap.add_argument("--list-rules", action="store_true")
@@ -113,10 +142,14 @@ def main(argv: list[str] | None = None) -> int:
 
 
 def _run(args, paths, root, only, disable, baseline_path) -> int:
+    cache_dir = (None if args.no_cache
+                 else args.cache_dir or default_cache_dir(root))
+    jobs = args.jobs if args.jobs > 0 else (os.cpu_count() or 1)
     if args.update_baseline:
         result = run_lint(paths, root=root, only=only, disable=disable,
                           baseline=None,
-                          project_rules=not args.no_project_rules)
+                          project_rules=not args.no_project_rules,
+                          cache_dir=cache_dir, jobs=jobs)
         old = Baseline.load(baseline_path)
         only_set = set(only) if only is not None else None
         disable_set = set(disable or ())
@@ -146,7 +179,8 @@ def _run(args, paths, root, only, disable, baseline_path) -> int:
                 else Baseline.load(baseline_path))
     result = run_lint(paths, root=root, only=only, disable=disable,
                       baseline=baseline,
-                      project_rules=not args.no_project_rules)
+                      project_rules=not args.no_project_rules,
+                      cache_dir=cache_dir, jobs=jobs)
     if args.format == "json":
         _print_json(result)
     else:

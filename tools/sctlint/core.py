@@ -1,11 +1,16 @@
 """The lint engine: rule registry, file contexts, suppression
 comments, and the orchestration that runs rules over a path set.
 
-Two rule scopes:
+Three rule scopes:
 
 * ``file`` rules get a :class:`FileContext` (one parsed module) and
   yield violations anchored to AST nodes.  Per-line ``# sctlint:
   disable=SCT0xx`` comments suppress them.
+* ``flow`` rules are file rules that additionally receive a
+  :class:`~tools.sctlint.flow.FileFlows` — the per-file function
+  index with shared, lazily-built control-flow graphs (built once
+  per function no matter how many flow rules run).  Same suppression
+  contract as file rules.
 * ``project`` rules get a :class:`ProjectContext` (the whole lint run)
   and check cross-file invariants — registry parity, repo hygiene.
   They have no source line to suppress on; exemptions go in the
@@ -90,7 +95,7 @@ class Rule:
     id: str
     name: str
     summary: str
-    scope: str  # "file" | "project"
+    scope: str  # "file" | "flow" | "project"
     check: Callable[..., Iterable[Violation]]
 
 
@@ -100,7 +105,7 @@ RULES: dict[str, Rule] = {}
 def rule(rule_id: str, name: str, summary: str, scope: str = "file"):
     """Decorator registering a rule's check function under ``rule_id``."""
 
-    if scope not in ("file", "project"):
+    if scope not in ("file", "flow", "project"):
         raise ValueError(f"unknown rule scope {scope!r}")
 
     def deco(fn):
@@ -248,17 +253,49 @@ def _sort_key(v: Violation):
     return (v.path, v.line, v.col, v.rule)
 
 
+def run_file_rules(ctx: FileContext, rule_ids: Iterable[str]
+                   ) -> tuple[list[Violation], list[Violation]]:
+    """Run the file/flow rules named by ``rule_ids`` over one parsed
+    module, split into (active, suppressed).  The unit the cache
+    stores and the process-pool workers compute."""
+    selected = sorted((RULES[i] for i in rule_ids if i in RULES),
+                      key=lambda r: r.id)
+    flows = None
+    active: list[Violation] = []
+    suppressed: list[Violation] = []
+    for r in selected:
+        if r.scope == "flow":
+            if flows is None:
+                from .flow import file_flows
+
+                flows = file_flows(ctx)
+            hits = r.check(ctx, flows)
+        elif r.scope == "file":
+            hits = r.check(ctx)
+        else:
+            continue
+        for v in hits:
+            (suppressed if ctx.is_suppressed(v) else active).append(v)
+    return active, suppressed
+
+
 def run_lint(paths: Iterable[str], *, root: str | None = None,
              only: Iterable[str] | None = None,
              disable: Iterable[str] | None = None,
              baseline: Baseline | None = None,
-             project_rules: bool = True) -> LintResult:
+             project_rules: bool = True,
+             cache_dir: str | None = None,
+             jobs: int = 1) -> LintResult:
     """Lint ``paths`` and split hits into active / suppressed /
     baselined, plus stale baseline entries.
 
     ``only``/``disable`` select rule ids.  ``project_rules=False``
     skips project-scope rules regardless of selection (unit tests lint
     synthetic snippets that have no project around them).
+    ``cache_dir`` enables the content-addressed findings cache
+    (``tools/sctlint/cache.py``); ``jobs > 1`` analyzes cache-miss
+    files in a process pool.  Neither changes findings — only where
+    and when the file rules execute.
     """
     paths = list(paths)  # iterated twice (scope prefixes + collection)
     root = root or repo_root()
@@ -267,7 +304,8 @@ def run_lint(paths: Iterable[str], *, root: str | None = None,
         if (only is None or r.id in set(only))
         and r.id not in set(disable or ())
     }
-    file_rules = sorted((r for r in active if r.scope == "file"),
+    file_rules = sorted((r for r in active
+                         if r.scope in ("file", "flow")),
                         key=lambda r: r.id)
     proj_rules = sorted((r for r in active if r.scope == "project"),
                         key=lambda r: r.id) if project_rules else []
@@ -293,12 +331,79 @@ def run_lint(paths: Iterable[str], *, root: str | None = None,
         except (OSError, UnicodeDecodeError) as e:
             errors.append(f"{_rel(ap, root)}: unreadable: {e}")
 
+    file_rule_ids = [r.id for r in file_rules]
+    cache = None
+    if cache_dir is not None:
+        from .cache import LintCache, ruleset_fingerprint
+
+        cache = LintCache(cache_dir,
+                          ruleset_fingerprint(root, file_rule_ids))
+
     raw: list[Violation] = []
     suppressed: list[Violation] = []
-    for ctx in contexts:
-        for r in file_rules:
-            for v in r.check(ctx):
-                (suppressed if ctx.is_suppressed(v) else raw).append(v)
+    misses: list[FileContext] = []
+    digests: dict[str, str] = {}
+    if cache is not None:
+        from .cache import file_digest
+
+        for ctx in contexts:
+            digests[ctx.path] = dig = file_digest(ctx.path, ctx.source)
+            hit = cache.get(dig)
+            if hit is not None:
+                try:
+                    vs = [Violation(**d) for d in hit[0]]
+                    ss = [Violation(**d) for d in hit[1]]
+                except TypeError:
+                    hit = None  # malformed entry: treat as a miss —
+                    # "a broken disk must never break the lint"
+            if hit is None:
+                misses.append(ctx)
+            else:
+                raw.extend(vs)
+                suppressed.extend(ss)
+    else:
+        misses = list(contexts)
+
+    analyzed: dict[str, tuple[list, list]] = {}
+    if jobs > 1 and len(misses) > 1:
+        import concurrent.futures as _fut
+        import multiprocessing as _mp
+
+        from .cache import analyze_one
+
+        # spawn, not fork: the lint may run inside a process that has
+        # already imported jax (pytest, a tooling script), and forking
+        # a multithreaded jax parent can deadlock the child
+        with _fut.ProcessPoolExecutor(
+                max_workers=jobs,
+                mp_context=_mp.get_context("spawn")) as pool:
+            chunk = max(1, len(misses) // (jobs * 4))
+            results = pool.map(analyze_one,
+                               [c.abspath for c in misses],
+                               [root] * len(misses),
+                               [file_rule_ids] * len(misses),
+                               chunksize=chunk)
+            for ctx, res in zip(misses, results):
+                if "error" in res:
+                    errors.append(res["error"])
+                    continue
+                vs = [Violation(**d) for d in res["violations"]]
+                ss = [Violation(**d) for d in res["suppressed"]]
+                analyzed[ctx.path] = (vs, ss)
+                raw.extend(vs)
+                suppressed.extend(ss)
+    else:
+        for ctx in misses:
+            vs, ss = run_file_rules(ctx, file_rule_ids)
+            analyzed[ctx.path] = (vs, ss)
+            raw.extend(vs)
+            suppressed.extend(ss)
+    if cache is not None:
+        for path, (vs, ss) in analyzed.items():
+            cache.put(digests[path],
+                      [dataclasses.asdict(v) for v in vs],
+                      [dataclasses.asdict(v) for v in ss])
+
     pctx = ProjectContext(root=root, files=contexts)
     for r in proj_rules:
         raw.extend(r.check(pctx))

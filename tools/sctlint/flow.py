@@ -1,0 +1,593 @@
+"""Intra-procedural control-flow analysis for the flow rules.
+
+The per-line rules (SCT001-SCT009) see one AST node at a time; the
+concurrency-discipline rules (SCT010-SCT013) need to reason about
+PATHS — "does this acquire reach a release on the raising path", "is
+this call made while a lock is held".  This module is the shared
+machinery:
+
+* :func:`build_cfg` — a small per-function control-flow graph:
+  statement-granularity nodes, edges tagged ``next``/``true``/
+  ``false``/``exc``/``back``, with branches, loops,
+  try/except/finally, ``with`` (enter/exit nodes on the normal path;
+  exception edges bypass the exit node — ``__exit__`` releases
+  nothing the flow rules track unless the with item IS the resource,
+  which is the managed form), early return/raise, break/continue.
+  ``finally`` bodies are built ONCE
+  and shared by every continuation that routes through them (normal
+  fall-through, exception propagation, early return, break) — the
+  standard merged-finally over-approximation: paths may conflate at a
+  finally, never disappear, which is the right bias for a may-leak
+  analysis.
+* :func:`dataflow` — a worklist fixpoint over a CFG with
+  union-merged ``frozenset`` states and optional edge-sensitive
+  refinement (how ``if x.try_acquire_probe():`` gains the held fact
+  only on the true edge).
+* :class:`FileFlows` — the per-file index handed to ``scope="flow"``
+  rules: every function (any nesting) with its qualname and owning
+  class, lazily-built CFGs shared across rules, and the
+  ``locked-by-caller`` annotation set.
+* Lexical lock helpers — :func:`lockish_items`, :func:`iter_lock_regions`
+  — for the rules whose "held" state is exactly ``with``-scoped
+  (SCT011/SCT013): lock lifetimes in this codebase are lexical by
+  convention, so the walk is exact there and the CFG is reserved for
+  the genuinely path-shaped question (SCT010).
+
+Everything is a heuristic over one function's AST — same contract as
+``jaxutil``: a rule misses code it cannot see (locks taken by a
+caller, resources handed across functions); it never crashes the
+lint.  The escape hatch for cross-function facts is the annotation
+contract: a ``# sctlint: locked-by-caller`` comment inside a function
+declares "every call site holds the lock" (SCT013 trusts it), and
+per-line ``# sctlint: disable=SCT01x`` handles ownership transfer and
+deliberate in-lock work.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Callable, Iterable, Iterator
+
+# ---------------------------------------------------------------------------
+# CFG
+# ---------------------------------------------------------------------------
+
+#: node kinds: entry/exit/raise_exit are synthetic; "stmt" is one
+#: statement; "test" an If/While test or For iter; "with_enter"/
+#: "with_exit" bracket a with body (exit doubles as its implicit
+#: finally); "finally" heads a finally body; "dispatch" fans an
+#: exception out to a try's handlers; "handler" heads one handler;
+#: "join" is a synthetic merge point (loop exits, after-try).
+NODE_KINDS = ("entry", "exit", "raise_exit", "stmt", "test",
+              "with_enter", "with_exit", "finally", "dispatch",
+              "handler", "join")
+
+
+class FlowNode:
+    __slots__ = ("idx", "ast", "kind", "succs")
+
+    def __init__(self, idx: int, node: ast.AST | None, kind: str):
+        self.idx = idx
+        self.ast = node
+        self.kind = kind
+        self.succs: list[tuple["FlowNode", str]] = []
+
+    def __repr__(self):
+        line = getattr(self.ast, "lineno", "-")
+        return f"<{self.kind}@{line} #{self.idx}>"
+
+
+@dataclasses.dataclass
+class _Fin:
+    """One finally (or with-exit) region: entry node, fall-through
+    nodes, and the continuation targets routed through it."""
+
+    entry: FlowNode
+    outs: set  # FlowNode
+    requests: set  # FlowNode
+
+
+class CFG:
+    """Control-flow graph of one function body (nested defs/lambdas
+    are opaque single statements — they get their own CFG)."""
+
+    def __init__(self, fn: ast.FunctionDef | ast.AsyncFunctionDef):
+        self.fn = fn
+        self.nodes: list[FlowNode] = []
+        b = _Builder(self)
+        self.entry = b.new(None, "entry")
+        self.exit = b.new(None, "exit")
+        self.raise_exit = b.new(None, "raise_exit")
+        b.build()
+
+    def preds(self) -> dict[FlowNode, list[tuple[FlowNode, str]]]:
+        out: dict[FlowNode, list] = {n: [] for n in self.nodes}
+        for n in self.nodes:
+            for s, tag in n.succs:
+                out[s].append((n, tag))
+        return out
+
+    def edges(self) -> list[tuple[FlowNode, FlowNode, str]]:
+        return [(n, s, tag) for n in self.nodes for s, tag in n.succs]
+
+
+_SCOPE_BARRIERS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                   ast.ClassDef)
+
+
+def walk_in_scope(node: ast.AST,
+                  include_root: bool = True) -> Iterator[ast.AST]:
+    """``ast.walk`` that does not descend into nested function/class
+    scopes — a call inside a nested ``def`` statement executes when
+    the closure runs, not when the ``def`` does.  When the ROOT is
+    itself a ``def``/``lambda``, only what executes at the def site
+    is walked (decorators and argument defaults), never the body."""
+    if include_root:
+        yield node
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        site = (node.decorator_list + node.args.defaults
+                + [d for d in node.args.kw_defaults if d is not None])
+        for sub in site:
+            yield from walk_in_scope(sub)
+        return
+    if isinstance(node, ast.Lambda):
+        return
+    if isinstance(node, ast.ClassDef):
+        # a class BODY does execute at the def site, but its function
+        # bodies do not — recurse normally (the barrier check below
+        # stops at each method)
+        pass
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            # still evaluate the child's def-site expressions
+            yield from walk_in_scope(child, include_root=False)
+            continue
+        yield from walk_in_scope(child)
+
+
+def walk_function_scope(fn) -> Iterator[ast.AST]:
+    """Every node in ``fn``'s own body scope (nested defs opaque) —
+    the right entry point when the root IS the function under
+    analysis."""
+    for stmt in fn.body:
+        yield from walk_in_scope(stmt)
+
+
+def _can_raise(stmt_or_expr: ast.AST) -> bool:
+    """May executing this (statement or expression) raise?  Heuristic:
+    it contains a call, a raise, or an assert — attribute/subscript
+    errors from plain data access are deliberately out of model."""
+    for n in walk_in_scope(stmt_or_expr):
+        if isinstance(n, (ast.Call, ast.Raise, ast.Assert, ast.Await)):
+            return True
+    return False
+
+
+_BROAD_HANDLER = {"Exception", "BaseException"}
+
+
+def _handler_is_broad(h: ast.ExceptHandler) -> bool:
+    if h.type is None:
+        return True
+    types = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+    for t in types:
+        name = t.attr if isinstance(t, ast.Attribute) else \
+            t.id if isinstance(t, ast.Name) else None
+        if name in _BROAD_HANDLER:
+            return True
+    return False
+
+
+class _Builder:
+    def __init__(self, cfg: CFG):
+        self.cfg = cfg
+        # frames
+        self._fins: list[_Fin] = []          # innermost last
+        self._all_fins: list[_Fin] = []
+        self._loops: list[tuple] = []        # (head, loop_exit, fin_depth)
+        self._excs: list[tuple] = []         # (target, fin_depth)
+
+    def new(self, node, kind) -> FlowNode:
+        n = FlowNode(len(self.cfg.nodes), node, kind)
+        self.cfg.nodes.append(n)
+        return n
+
+    def edge(self, src: FlowNode, dst: FlowNode, tag: str) -> None:
+        if (dst, tag) not in src.succs:
+            src.succs.append((dst, tag))
+
+    def _link(self, prevs: Iterable[tuple[FlowNode, str]],
+              dst: FlowNode) -> None:
+        for src, tag in prevs:
+            self.edge(src, dst, tag)
+
+    def route(self, src: FlowNode, ultimate: FlowNode,
+              depth: int, tag: str) -> None:
+        """Edge from ``src`` to ``ultimate`` through every finally
+        region deeper than ``depth`` (innermost first)."""
+        chain = self._fins[depth:]
+        if not chain:
+            self.edge(src, ultimate, tag)
+            return
+        self.edge(src, chain[-1].entry, tag)
+        prev = chain[-1]
+        for fin in reversed(chain[:-1]):
+            prev.requests.add(fin.entry)
+            prev = fin
+        prev.requests.add(ultimate)
+
+    def build(self) -> None:
+        cfg = self.cfg
+        self._excs.append((cfg.raise_exit, 0))
+        outs = self.stmts(cfg.fn.body, {(cfg.entry, "next")})
+        self._link(outs, cfg.exit)
+        # resolve finally fall-outs to every requested continuation
+        for fin in self._all_fins:
+            for o in fin.outs:
+                for t in fin.requests:
+                    self.edge(o, t, "next")
+
+    # -- statement dispatch ---------------------------------------------
+    def stmts(self, body, prevs) -> set:
+        for stmt in body:
+            prevs = self.stmt(stmt, prevs)
+        return prevs
+
+    def _exc_edge(self, node: FlowNode) -> None:
+        target, depth = self._excs[-1]
+        self.route(node, target, depth, "exc")
+
+    def _simple(self, stmt, prevs, kind="stmt") -> set:
+        n = self.new(stmt, kind)
+        self._link(prevs, n)
+        if _can_raise(stmt):
+            self._exc_edge(n)
+        return {(n, "next")}
+
+    def stmt(self, stmt, prevs) -> set:
+        if isinstance(stmt, ast.If):
+            return self._if(stmt, prevs)
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            return self._loop(stmt, prevs)
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, prevs)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._with(stmt, prevs)
+        if isinstance(stmt, ast.Return):
+            n = self.new(stmt, "stmt")
+            self._link(prevs, n)
+            if _can_raise(stmt):
+                self._exc_edge(n)
+            self.route(n, self.cfg.exit, 0, "return")
+            return set()
+        if isinstance(stmt, ast.Raise):
+            n = self.new(stmt, "stmt")
+            self._link(prevs, n)
+            self._exc_edge(n)
+            return set()
+        if isinstance(stmt, ast.Break):
+            n = self.new(stmt, "stmt")
+            self._link(prevs, n)
+            if self._loops:
+                head, loop_exit, depth = self._loops[-1]
+                self.route(n, loop_exit, depth, "break")
+            return set()
+        if isinstance(stmt, ast.Continue):
+            n = self.new(stmt, "stmt")
+            self._link(prevs, n)
+            if self._loops:
+                head, loop_exit, depth = self._loops[-1]
+                self.route(n, head, depth, "continue")
+            return set()
+        if isinstance(stmt, ast.Match):
+            return self._match(stmt, prevs)
+        return self._simple(stmt, prevs)
+
+    def _if(self, stmt: ast.If, prevs) -> set:
+        test = self.new(stmt, "test")
+        self._link(prevs, test)
+        if _can_raise(stmt.test):
+            self._exc_edge(test)
+        outs = self.stmts(stmt.body, {(test, "true")})
+        if stmt.orelse:
+            outs |= self.stmts(stmt.orelse, {(test, "false")})
+        else:
+            outs |= {(test, "false")}
+        return outs
+
+    def _loop(self, stmt, prevs) -> set:
+        head = self.new(stmt, "test")
+        self._link(prevs, head)
+        cond = stmt.test if isinstance(stmt, ast.While) else stmt.iter
+        if _can_raise(cond):
+            self._exc_edge(head)
+        loop_exit = self.new(stmt, "join")
+        self._loops.append((head, loop_exit, len(self._fins)))
+        body_outs = self.stmts(stmt.body, {(head, "true")})
+        self._loops.pop()
+        for src, tag in body_outs:
+            self.edge(src, head, "back")
+        if stmt.orelse:
+            else_outs = self.stmts(stmt.orelse, {(head, "false")})
+            self._link(else_outs, loop_exit)
+        else:
+            self.edge(head, loop_exit, "false")
+        return {(loop_exit, "next")}
+
+    def _match(self, stmt: ast.Match, prevs) -> set:
+        subj = self.new(stmt, "test")
+        self._link(prevs, subj)
+        if _can_raise(stmt.subject):
+            self._exc_edge(subj)
+        outs = {(subj, "false")}  # no case matched
+        for case in stmt.cases:
+            outs |= self.stmts(case.body, {(subj, "true")})
+        return outs
+
+    def _with(self, stmt, prevs) -> set:
+        # the with_exit node sits on the NORMAL path only; exception
+        # and return edges from the body bypass it and route straight
+        # outward.  __exit__ does run on those paths in reality, but
+        # modelling it as a shared finally would conflate normal-path
+        # state onto the raise exit (the merged-finally artefact) and
+        # flag resources that are in fact released — and nothing the
+        # flow rules track is released by a with __exit__ unless the
+        # with ITEM is the resource, which is the managed (never
+        # flagged) form.
+        enter = self.new(stmt, "with_enter")
+        self._link(prevs, enter)
+        if any(_can_raise(item.context_expr) for item in stmt.items):
+            self._exc_edge(enter)
+        wexit = self.new(stmt, "with_exit")
+        body_outs = self.stmts(stmt.body, {(enter, "next")})
+        self._link(body_outs, wexit)
+        return {(wexit, "next")}
+
+    def _try(self, stmt: ast.Try, prevs) -> set:
+        fin = None
+        if stmt.finalbody:
+            fentry = self.new(stmt, "finally")
+            # the finally body runs under OUTER frames (its own raises
+            # propagate past this try)
+            fouts = self.stmts(stmt.finalbody, {(fentry, "next")})
+            fin = _Fin(entry=fentry,
+                       outs={n for n, _ in fouts} or {fentry},
+                       requests=set())
+            self._fins.append(fin)
+            self._all_fins.append(fin)
+        after: set = set()
+        if stmt.handlers:
+            dispatch = self.new(stmt, "dispatch")
+            self._excs.append((dispatch, len(self._fins)))
+            body_outs = self.stmts(stmt.body, prevs)
+            self._excs.pop()
+            if stmt.orelse:
+                body_outs = self.stmts(stmt.orelse, body_outs)
+            after |= body_outs
+            for h in stmt.handlers:
+                hentry = self.new(h, "handler")
+                self.edge(dispatch, hentry, "exc")
+                after |= self.stmts(h.body, {(hentry, "next")})
+            if not any(_handler_is_broad(h) for h in stmt.handlers):
+                # may propagate past every (narrow) handler
+                target, depth = self._excs[-1]
+                self.route(dispatch, target, depth, "exc")
+        else:
+            after |= self.stmts(stmt.body, prevs)
+            if stmt.orelse:
+                after = self.stmts(stmt.orelse, after)
+        if fin is not None:
+            self._fins.pop()
+            self._link(after, fin.entry)
+            after_join = self.new(stmt, "join")
+            fin.requests.add(after_join)
+            return {(after_join, "next")}
+        return after
+
+
+def build_cfg(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> CFG:
+    return CFG(fn)
+
+
+# ---------------------------------------------------------------------------
+# Dataflow
+# ---------------------------------------------------------------------------
+
+def dataflow(cfg: CFG,
+             transfer: Callable[[FlowNode, frozenset], frozenset],
+             edge_refine: Callable[[FlowNode, str, frozenset],
+                                   frozenset] | None = None,
+             init: frozenset = frozenset(),
+             ) -> dict[FlowNode, frozenset]:
+    """Forward may-analysis to fixpoint: union merge at joins,
+    ``transfer`` per node, optional per-edge ``edge_refine`` (branch-
+    sensitive gen/kill on ``true``/``false`` edges).  Returns the
+    IN-state of every node (the exit nodes' in-states are the
+    answers)."""
+    in_states: dict[FlowNode, frozenset | None] = {
+        n: None for n in cfg.nodes}
+    in_states[cfg.entry] = init
+    work = [cfg.entry]
+    while work:
+        n = work.pop()
+        state = in_states[n]
+        out = transfer(n, state)
+        for succ, tag in n.succs:
+            es = edge_refine(n, tag, out) if edge_refine else out
+            old = in_states[succ]
+            new = es if old is None else old | es
+            if new != old:
+                in_states[succ] = new
+                work.append(succ)
+    return {n: (s if s is not None else frozenset())
+            for n, s in in_states.items()}
+
+
+# ---------------------------------------------------------------------------
+# Shared call heuristics
+# ---------------------------------------------------------------------------
+
+def call_tail(call: ast.Call) -> str | None:
+    """The last name component of a call's callee — ``a.b.c()`` ->
+    ``"c"``, ``f()`` -> ``"f"``."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def is_journal_write(call: ast.Call) -> bool:
+    """``journal.write(...)`` / ``self.journal.write(...)`` — the
+    one journal-receiver heuristic SCT011 and SCT012 share, so the
+    two rules can never disagree about what counts as a journal
+    append."""
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "write"):
+        return False
+    recv = f.value
+    return (isinstance(recv, ast.Name) and recv.id == "journal") or \
+        (isinstance(recv, ast.Attribute) and recv.attr == "journal")
+
+
+# ---------------------------------------------------------------------------
+# Lexical lock helpers
+# ---------------------------------------------------------------------------
+
+#: a ``with`` context expression counts as a lock when it is a bare
+#: name/attribute whose last component looks lock-like — the
+#: codebase's naming convention (`self._lock`, `self._cv`,
+#: `self.breaker.lock`, a bare `lock`).  Calls (`suppress(...)`,
+#: `chaos.activate()`) never match.
+_LOCKISH_RE = re.compile(
+    r"(^|_)(r?lock|cv|cond(ition)?|mutex)$", re.IGNORECASE)
+
+
+def _terminal_name(expr: ast.AST) -> str | None:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def is_lockish(expr: ast.AST) -> bool:
+    name = _terminal_name(expr)
+    return name is not None and bool(_LOCKISH_RE.search(name))
+
+
+def lockish_items(stmt) -> list[tuple[str, ast.AST]]:
+    """The lock-like context managers of a ``with`` statement, as
+    ``(source_text, expr)`` pairs."""
+    if not isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return []
+    out = []
+    for item in stmt.items:
+        if is_lockish(item.context_expr):
+            out.append((ast.unparse(item.context_expr),
+                        item.context_expr))
+    return out
+
+
+def iter_lock_regions(fn, held: tuple = ()) -> Iterator[tuple]:
+    """Yield ``(stmt, held_locks)`` for every statement in ``fn``'s
+    body (not descending into nested scopes), where ``held_locks`` is
+    the tuple of lock source-texts lexically held at that statement —
+    outermost first.  ``with`` statements themselves are yielded with
+    the locks held BEFORE their own acquisition (so lock-order rules
+    see the acquisition against the prior held set)."""
+    body = fn.body if hasattr(fn, "body") else fn
+    for stmt in body:
+        yield stmt, held
+        if isinstance(stmt, _SCOPE_BARRIERS):
+            continue
+        inner = held
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            inner = held + tuple(t for t, _ in lockish_items(stmt))
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if sub:
+                yield from iter_lock_regions(
+                    type("_B", (), {"body": sub})(), inner)
+        for h in getattr(stmt, "handlers", ()):
+            yield from iter_lock_regions(
+                type("_B", (), {"body": h.body})(), inner)
+        for case in getattr(stmt, "cases", ()):
+            yield from iter_lock_regions(
+                type("_B", (), {"body": case.body})(), inner)
+
+
+# ---------------------------------------------------------------------------
+# Per-file flow index (the scope="flow" rule input)
+# ---------------------------------------------------------------------------
+
+_LOCKED_BY_CALLER_RE = re.compile(
+    r"#\s*sctlint:\s*locked-by-caller\b")
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    fn: ast.FunctionDef | ast.AsyncFunctionDef
+    qualname: str
+    owner_class: ast.ClassDef | None
+    locked_by_caller: bool
+
+
+class FileFlows:
+    """Everything the flow rules need from one module, computed once:
+    every function with its qualname/owning class, lazily-built
+    (shared) CFGs, and the ``# sctlint: locked-by-caller`` annotation
+    set (a function-level declaration that every call site holds the
+    relevant lock — the cross-function escape hatch an intra-
+    procedural analysis needs)."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self._cfgs: dict[int, CFG] = {}
+        ann_lines = {i + 1 for i, line in enumerate(ctx.lines)
+                     if _LOCKED_BY_CALLER_RE.search(line)}
+        self.functions: list[FunctionInfo] = []
+        self._collect(ctx.tree, "", None)
+        # bind each annotation to the INNERMOST function containing
+        # its line — a locked-by-caller comment inside a nested def
+        # must not exempt the enclosing method's field writes
+        for ln in ann_lines:
+            best = None
+            for info in self.functions:
+                end = getattr(info.fn, "end_lineno", info.fn.lineno)
+                if info.fn.lineno <= ln <= end and (
+                        best is None or info.fn.lineno > best.fn.lineno):
+                    best = info
+            if best is not None:
+                best.locked_by_caller = True
+
+    def _collect(self, node, prefix, owner) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                self.functions.append(FunctionInfo(
+                    child, qual, owner, False))
+                self._collect(child, qual + ".", owner)
+            elif isinstance(child, ast.ClassDef):
+                self._collect(child, f"{prefix}{child.name}.", child)
+            else:
+                self._collect(child, prefix, owner)
+
+    def cfg(self, fn) -> CFG:
+        c = self._cfgs.get(id(fn))
+        if c is None:
+            c = self._cfgs[id(fn)] = build_cfg(fn)
+        return c
+
+
+def file_flows(ctx) -> FileFlows:
+    """Memoised :class:`FileFlows` for a FileContext (same pattern as
+    ``jaxutil.module_info`` — cached on the context itself)."""
+    flows = getattr(ctx, "_file_flows", None)
+    if flows is None:
+        flows = ctx._file_flows = FileFlows(ctx)
+    return flows

@@ -6,3 +6,7 @@ from . import registry_conv  # noqa: F401  SCT006
 from . import project  # noqa: F401   SCT000, SCT007
 from . import clockdiscipline  # noqa: F401  SCT008
 from . import vocab  # noqa: F401     SCT009
+from . import resource_pairing  # noqa: F401  SCT010 (flow)
+from . import lockscope  # noqa: F401  SCT011 (flow)
+from . import journalproto  # noqa: F401  SCT012
+from . import guardedfields  # noqa: F401  SCT013 (flow)

@@ -7,9 +7,9 @@ every resilience module schedules time through the injectable clock
 ``time.monotonic``.  ``time.time()`` stays legal everywhere — journal
 and sidecar timestamps are wall-clock *facts*, not *schedules*.
 ``vclock.py`` itself is exempt: its ``SystemClock`` is the one
-sanctioned home of the real calls.  ``tools/run_checks.sh`` carries a
-shell-side duplicate of this guard (belt and braces, like SCT007's
-bytecode check).
+sanctioned home of the real calls.  ``tools/run_checks.sh`` stage 3
+re-runs exactly this rule (``--select SCT008``), so the covered-module
+list below is the one source of truth for the CI guard too.
 """
 
 from __future__ import annotations
@@ -35,10 +35,13 @@ from ..jaxutil import dotted, module_info
 # clock (real subprocess reaps stay event-driven, like watch_process);
 # train_stream.py for the out-of-core trainer — its prefetch feed and
 # preemption polls ride the same injectable clock, so the whole
-# preempt → requeue → resume ladder runs on one VirtualClock.
+# preempt → requeue → resume ladder runs on one VirtualClock;
+# telemetry.py because every metric duration/histogram observation is
+# clock-injected (the old shell-side guard covered it — this list is
+# now the ONE source of truth for run_checks stage 3).
 _PATH_RE = re.compile(
     r"(^|/)(runner|failsafe|checkpoint|chaos|stream|scheduler"
-    r"|shardstore|federation|train_stream)\.py$")
+    r"|shardstore|federation|train_stream|telemetry)\.py$")
 
 _BANNED = {"time.sleep", "time.monotonic"}
 

@@ -1,0 +1,103 @@
+"""SCT013 — a field guarded by a lock somewhere must be guarded
+everywhere.
+
+The shared-state classes in the resilience stack (breakers, the
+scheduler, the federation supervisor) are explicit about their
+locking: every mutation of shared fields happens under ``self._lock``
+/ ``self.lock``.  The recurring regression is the HYBRID class — a
+field written under the lock on most paths and barehanded on one
+(usually a late-added helper), which is a data race the GIL hides
+until a preemption lands between the read and the write.  PR 8's
+review caught shared breaker state mutated outside its lock exactly
+this way.
+
+The rule, per class: collect every ``self.X = ...`` (and augmented /
+annotated / tuple-unpacked) assignment in the class's methods, note
+whether it is lexically inside a ``with <lock>:`` block, and flag
+every UNGUARDED write of a field that also has a guarded write.
+Exempt:
+
+* ``__init__`` / ``__post_init__`` / ``__new__`` — construction
+  happens before the object is shared;
+* functions annotated ``# sctlint: locked-by-caller`` — the
+  documented contract for helpers whose every call site already
+  holds the lock (the intra-procedural analysis cannot see the
+  caller's ``with``); the annotation is the audit trail;
+* per-line ``# sctlint: disable=SCT013`` for genuinely unshared
+  fields (set once before any thread can observe the object).
+
+Only attribute ASSIGNMENTS are tracked — ``self.xs.append(...)``
+mutations are invisible by design (tracking every aliasing mutation
+is interprocedural analysis, not linting).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import FileContext, rule
+from ..flow import FileFlows, iter_lock_regions
+
+_INIT_METHODS = frozenset({"__init__", "__post_init__", "__new__",
+                           "__init_subclass__"})
+
+
+def _self_targets(stmt: ast.stmt):
+    """Attribute names written on ``self`` by this statement."""
+    targets: list[ast.expr] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    out = []
+    stack = targets
+    while stack:
+        t = stack.pop()
+        if isinstance(t, (ast.Tuple, ast.List)):
+            stack.extend(t.elts)
+        elif isinstance(t, ast.Attribute) \
+                and isinstance(t.value, ast.Name) \
+                and t.value.id == "self":
+            out.append((t.attr, t))
+    return out
+
+
+@rule("SCT013", "guarded-field-discipline",
+      "a field written under `with self._lock` somewhere must not "
+      "also be written bare elsewhere in the same class (annotate "
+      "locked-by-caller helpers)", scope="flow")
+def check_guarded_fields(ctx: FileContext, flows: FileFlows):
+    by_class: dict[int, list] = {}
+    for info in flows.functions:
+        if info.owner_class is None:
+            continue
+        by_class.setdefault(id(info.owner_class), []).append(info)
+    for cid, infos in by_class.items():
+        # field -> {"guarded": [(node, lock, fn)], "bare": [...]}
+        writes: dict[str, dict] = {}
+        for info in infos:
+            exempt = (info.fn.name in _INIT_METHODS
+                      or info.locked_by_caller)
+            for stmt, held in iter_lock_regions(info.fn):
+                for field, node in _self_targets(stmt):
+                    rec = writes.setdefault(
+                        field, {"guarded": [], "bare": []})
+                    if held:
+                        rec["guarded"].append(
+                            (node, held[-1], info.fn.name))
+                    elif not exempt:
+                        rec["bare"].append((node, info.fn.name))
+        for field, rec in sorted(writes.items()):
+            if not rec["guarded"] or not rec["bare"]:
+                continue
+            lock = rec["guarded"][0][1]
+            gfn = rec["guarded"][0][2]
+            for node, fn_name in rec["bare"]:
+                yield ctx.violation(
+                    "SCT013", node,
+                    f"self.{field} is written under {lock} (in "
+                    f"{gfn}()) but bare here in {fn_name}() — a "
+                    f"data race the GIL hides; move the write under "
+                    f"the lock, or annotate the function "
+                    f"`# sctlint: locked-by-caller` if every call "
+                    f"site already holds it")

@@ -1,0 +1,142 @@
+"""SCT012 — per-module journal-protocol conformance.
+
+SCT009 answers "is this event name spelled right" against the global
+vocabulary; it cannot answer "may THIS module emit it" or "did the
+refactor drop the emission site that closes a ticket".  Both bugs
+shipped in the PR 8-11 era in draft form: a scheduler-shaped module
+emitting a runner-lifecycle event (two funnels' reports silently
+merge), and a terminal state declared in prose whose only emission
+site an edit removed (tickets that never terminal — the exact hang
+the chaos soaks exist to catch at runtime, caught here at lint
+time).
+
+The contract is declared machine-readably NEXT TO the vocabulary —
+``sctools_tpu/utils/telemetry.py`` ``JOURNAL_PROTOCOLS``: per module
+basename, the legal event set and the terminal subset.  This rule
+AST-extracts it (like SCT009 — sctlint executes no library code) and
+checks, for every covered module:
+
+* each ``journal.write("<literal>", ...)`` names an event in the
+  module's table (unknown-to-the-vocabulary literals are SCT009's
+  finding, not re-reported here);
+* every declared terminal state has at least one emission site in
+  the module.
+
+Linting ``telemetry.py`` itself additionally checks the tables are a
+subset of ``EVENTS`` — a protocol entry that names a non-event is a
+table typo.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from ..core import FileContext, repo_root, rule
+from ..flow import is_journal_write as _is_journal_write
+from .vocab import _load_vocab
+
+_PROTO: dict[str, dict | None] = {}
+
+
+def _load_protocols() -> dict | None:
+    """AST-extract ``JOURNAL_PROTOCOLS`` from telemetry.py (cached
+    per process); None — rule disabled — when missing/unreadable."""
+    path = os.path.join(repo_root(), "sctools_tpu", "utils",
+                        "telemetry.py")
+    if path in _PROTO:
+        return _PROTO[path]
+    out = None
+    try:
+        tree = ast.parse(open(path, encoding="utf-8").read())
+    except (OSError, SyntaxError):
+        _PROTO[path] = None
+        return None
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "JOURNAL_PROTOCOLS"
+                and isinstance(node.value, ast.Dict)):
+            continue
+        out = {}
+        for k, v in zip(node.value.keys, node.value.values):
+            if not (isinstance(k, ast.Constant) and isinstance(v, ast.Dict)):
+                continue
+            table = {}
+            for tk, tv in zip(v.keys, v.values):
+                if isinstance(tk, ast.Constant) \
+                        and isinstance(tv, (ast.List, ast.Tuple, ast.Set)):
+                    table[tk.value] = [
+                        e.value for e in tv.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)]
+            out[k.value] = table
+    _PROTO[path] = out
+    return out
+
+
+@rule("SCT012", "journal-protocol",
+      "journal emissions match the module's declared lifecycle table "
+      "(telemetry.JOURNAL_PROTOCOLS), and every declared terminal "
+      "state has an emission site")
+def check_journal_protocol(ctx: FileContext):
+    protocols = _load_protocols()
+    if not protocols:
+        return
+    # table self-check when linting the vocabulary module itself
+    if ctx.path.endswith("utils/telemetry.py"):
+        vocab = _load_vocab()
+        if vocab is not None:
+            events = vocab[0]
+            for mod, table in protocols.items():
+                for ev in table.get("events", []):
+                    if ev not in events:
+                        yield ctx.violation(
+                            "SCT012", ctx.tree,
+                            f"JOURNAL_PROTOCOLS[{mod!r}] lists "
+                            f"{ev!r}, which is not in EVENTS — "
+                            f"protocol tables must be a subset of "
+                            f"the vocabulary")
+                for ev in table.get("terminal", []):
+                    if ev not in table.get("events", []):
+                        yield ctx.violation(
+                            "SCT012", ctx.tree,
+                            f"JOURNAL_PROTOCOLS[{mod!r}] terminal "
+                            f"{ev!r} is not in its own event list")
+        return
+    path_re = re.compile(
+        r"(^|/)(" + "|".join(map(re.escape, sorted(protocols))) +
+        r")\.py$")
+    m = path_re.search(ctx.path)
+    if not m:
+        return
+    table = protocols[m.group(2)]
+    legal = set(table.get("events", []))
+    emitted: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and _is_journal_write(node)):
+            continue
+        arg = node.args[0] if node.args else None
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            continue  # computed names are SCT009's finding
+        emitted.add(arg.value)
+        if arg.value not in legal:
+            yield ctx.violation(
+                "SCT012", node,
+                f"journal event {arg.value!r} is not in the "
+                f"{m.group(2)} module's protocol table "
+                f"(telemetry.JOURNAL_PROTOCOLS) — emitting another "
+                f"module's lifecycle event silently merges two "
+                f"funnels in every report; add it to the table if "
+                f"this module legitimately owns it")
+    for ev in table.get("terminal", []):
+        if ev not in emitted:
+            yield ctx.violation(
+                "SCT012", ctx.tree,
+                f"declared terminal state {ev!r} has no emission "
+                f"site in this module — a lifecycle that cannot "
+                f"reach a declared terminal leaves tickets "
+                f"non-terminal forever (update the protocol table "
+                f"if the state moved elsewhere)")

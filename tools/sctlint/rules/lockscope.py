@@ -1,0 +1,196 @@
+"""SCT011 — no slow or re-entrant work while a threading lock is held.
+
+The dispatch locks in ``scheduler.py`` and ``federation.py`` gate
+heartbeat crediting, admission and worker dispatch for EVERY tenant:
+a disk write, a subprocess wait or a breaker snapshot performed while
+holding one turns disk latency into pool-wide starvation (at worst,
+an expired lease on a healthy worker).  PR 8 spent a review pass
+moving the scheduler's terminal journal writes out of the dispatch
+lock for exactly this reason; this rule makes the discipline
+machine-checked.
+
+Flagged while a lock is lexically held (``with self._lock:`` /
+``self._cv`` / ``breaker.lock`` — anything whose terminal name looks
+lock-like):
+
+* ``journal.write(...)`` — EXCEPT the admission-funnel events whose
+  relative order the journal-coherence contract pins to the queue
+  mutation itself (:data:`IN_LOCK_EVENTS`, the documented in-lock
+  appends: ``admitted`` must hit the file before the item becomes
+  dispatchable, etc.).  Terminal run events are never allowlisted —
+  they belong outside the lock, as the scheduler's worker does it.
+* state snapshots (``.snapshot()`` / ``.snapshot_compact()``) — they
+  take other locks (and, federated, read files).
+* file IO: ``open``, ``os.replace``/``unlink``/``mkdir``/... ,
+  ``json.dump``/``load``, ``pickle.dump``/``load``,
+  ``save_celldata``/``load_celldata``, any ``.write``/``.flush``.
+* subprocess work: anything ``subprocess.*``, ``.wait()`` /
+  ``.communicate()`` / ``.join()`` / ``.sleep()`` (waiting on the
+  held condition itself — ``self._cv.wait()`` — is exempt: that
+  RELEASES the lock by contract).
+* user callbacks: calling a bare parameter of the enclosing function
+  (the caller's code runs under your lock).
+
+Plus lock-ORDER consistency per module: when nested ``with`` blocks
+acquire lock B while holding lock A in one place and A while holding
+B in another, both sites are flagged — inconsistent acquisition
+order is the textbook deadlock.
+
+Deliberate exceptions (e.g. a journal's own append lock, which exists
+to serialize exactly that write) use the per-line suppression with a
+reason — that is the annotation contract, and it leaves an audit
+trail at the site.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import FileContext, rule
+from ..flow import (FileFlows, call_tail as _tail,
+                    is_journal_write as _is_journal_write,
+                    lockish_items, iter_lock_regions, walk_in_scope)
+from ..jaxutil import dotted, module_info
+
+#: journal events whose ordering contract REQUIRES the append to
+#: happen while the queue/dispatch lock is held: each must be on disk
+#: before the queue mutation it describes becomes observable to a
+#: concurrently-dispatching worker (e.g. a resumed segment's events
+#: must never precede its `preempted` line).  Terminal run events are
+#: deliberately absent — they are written outside the lock.
+IN_LOCK_EVENTS = frozenset({
+    "submitted", "admitted", "rejected", "shed", "preempted",
+    "requeued", "assigned", "worker_spawned", "worker_lost",
+    "worker_respawned", "commit_refused",
+})
+
+_SNAPSHOT_TAILS = frozenset({"snapshot", "snapshot_compact"})
+_BLOCKING_TAILS = frozenset({"wait", "join", "communicate", "sleep"})
+_IO_TAILS = frozenset({"write", "flush", "fsync", "dump", "load",
+                       "save_celldata", "load_celldata"})
+_IO_DOTTED = frozenset({
+    "os.replace", "os.rename", "os.mkdir", "os.makedirs",
+    "os.listdir", "os.unlink", "os.remove", "os.rmdir", "os.stat",
+    "os.open", "os.path.getsize", "shutil.copy", "shutil.copyfile",
+    "shutil.move", "shutil.rmtree",
+})
+
+
+def _stmt_exprs(stmt: ast.stmt):
+    """The expressions evaluated AT this statement (child statement
+    bodies are walked as their own region entries)."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        yield stmt.test
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        yield stmt.iter
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            yield item.context_expr
+    elif isinstance(stmt, ast.Match):
+        yield stmt.subject
+    elif isinstance(stmt, ast.Try):
+        return
+    elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+        return
+    else:
+        yield stmt
+
+
+def _banned_reason(call: ast.Call, aliases, params: set[str],
+                   held: tuple) -> str | None:
+    if _is_journal_write(call):
+        arg = call.args[0] if call.args else None
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            if arg.value in IN_LOCK_EVENTS:
+                return None
+            return (f"journal append ({arg.value!r}) — not one of the "
+                    f"ordering-mandated in-lock events; write it "
+                    f"after releasing the lock (terminal events "
+                    f"especially: disk latency under the dispatch "
+                    f"lock stalls every tenant)")
+        return ("journal append with a computed event — cannot be "
+                "checked against the in-lock allowlist; write it "
+                "after releasing the lock")
+    tail = _tail(call)
+    recv = call.func.value if isinstance(call.func, ast.Attribute) \
+        else None
+    if tail in _SNAPSHOT_TAILS:
+        # super().snapshot() extends the SAME object's snapshot under
+        # its own (reentrant) lock — not a foreign-lock acquisition
+        if isinstance(recv, ast.Call) \
+                and isinstance(recv.func, ast.Name) \
+                and recv.func.id == "super":
+            return None
+        return (f".{tail}() — snapshots take other locks (and, "
+                f"federated, read files); take them outside this one")
+    if tail in _BLOCKING_TAILS:
+        # waiting on the held condition variable RELEASES the lock —
+        # that is the sanctioned pattern, not a hazard
+        if recv is not None and ast.unparse(recv) in held:
+            return None
+        if tail == "join":
+            # path/string joins share the name with thread/process
+            # joins; only the latter block
+            name = dotted(call.func, aliases)
+            if (name and name.startswith(("os.path", "os.pathsep",
+                                          "os.sep"))) \
+                    or isinstance(recv, ast.Constant):
+                return None
+        return f".{tail}() — a blocking wait while the lock is held"
+    if isinstance(call.func, ast.Name) and call.func.id == "open":
+        return "open() — file IO while the lock is held"
+    if tail in _IO_TAILS:
+        return f".{tail}() — file IO while the lock is held"
+    name = dotted(call.func, aliases)
+    if name is not None:
+        if name in _IO_DOTTED:
+            return f"{name}() — file IO while the lock is held"
+        if name.startswith("subprocess."):
+            return f"{name}() — subprocess work while the lock is held"
+    if isinstance(call.func, ast.Name) and call.func.id in params:
+        return (f"{call.func.id}() is a parameter of the enclosing "
+                f"function — a user callback runs arbitrary code "
+                f"under your lock")
+    return None
+
+
+@rule("SCT011", "lock-scope-hygiene",
+      "no journal append (beyond the ordering-mandated allowlist), "
+      "snapshot, file IO, subprocess wait or user callback while a "
+      "threading lock is held; consistent lock order per module",
+      scope="flow")
+def check_lock_scope(ctx: FileContext, flows: FileFlows):
+    aliases = module_info(ctx).aliases
+    order_sites: dict[tuple, list] = {}  # (outer, inner) -> [node]
+    for info in flows.functions:
+        params = {a.arg for a in (
+            info.fn.args.posonlyargs + info.fn.args.args
+            + info.fn.args.kwonlyargs)} - {"self", "cls"}
+        for stmt, held in iter_lock_regions(info.fn):
+            if isinstance(stmt, (ast.With, ast.AsyncWith)) and held:
+                for text, expr in lockish_items(stmt):
+                    if text not in held:
+                        order_sites.setdefault(
+                            (held[-1], text), []).append(expr)
+            if not held:
+                continue
+            for root in _stmt_exprs(stmt):
+                for call in walk_in_scope(root):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    reason = _banned_reason(call, aliases, params,
+                                            held)
+                    if reason is not None:
+                        yield ctx.violation(
+                            "SCT011", call,
+                            f"while holding {held[-1]}: {reason}")
+    # inconsistent lock-acquisition order within the module
+    for (a, b), sites in sorted(order_sites.items()):
+        if (b, a) in order_sites and a < b:
+            for expr in sites + order_sites[(b, a)]:
+                yield ctx.violation(
+                    "SCT011", expr,
+                    f"inconsistent lock order in this module: both "
+                    f"{a} -> {b} and {b} -> {a} nestings exist — "
+                    f"pick one acquisition order (deadlock hazard)")

@@ -1,0 +1,280 @@
+"""SCT010 — leak-prone acquires must reach a release on every
+non-fatal path.
+
+The PR-8 review history is a catalogue of exactly one defect shape:
+an acquire whose release lives on the happy path only — a half-open
+probe slot claimed and then leaked when the journal write between
+claim and verdict raised (wedging every breaker sharer on the
+fallback until process restart), a ``push_call_wrapper`` whose pop
+was skipped by an early return (double-wrapping every later run), a
+lockdir/O_EXCL claim file left on disk by a raising write (stalling
+every contender until the stale TTL).  This rule walks each
+function's CFG (``tools/sctlint/flow.py``) with a per-path set of
+held resources and flags any acquire that can still be held at a
+function exit — normal or raising.
+
+Tracked resource kinds (acquire → matching releases):
+
+* breaker half-open probe slot: ``try_acquire_probe()`` →
+  ``release_probe`` / ``record_success`` / ``record_failure``
+* registry call-wrapper hook: ``push_call_wrapper`` →
+  ``pop_call_wrapper`` (the managed ``registry.call_wrapper(...)``
+  context manager never fires the rule)
+* claim files: ``os.open(..., O_EXCL...)`` and lockdir
+  ``os.mkdir(<...lock...>)`` → ``unlink``/``remove``/``rmdir``/
+  ``replace``
+
+A ``finally`` whose body contains a matching release (under any
+condition — the resolve-or-release idiom guards its release on a
+verdict flag the analysis cannot track) releases the kind for every
+path routed through it; that is the sanctioned shape, along with
+context managers.  Conditional acquires are branch-sensitive:
+``if b.try_acquire_probe():`` holds the slot only on the true edge,
+and ``ok = b.try_acquire_probe()`` / ``if not ok: return`` refines on
+the tested variable.  Ownership transfer (an acquire deliberately
+outliving the function — recorded on ``self`` and released elsewhere)
+is out of intra-procedural reach: suppress the acquire line with
+``# sctlint: disable=SCT010`` and a comment naming the releasing
+path.
+
+A ``ChaosMonkey.activate()``-style context manager called as a bare
+expression statement is also flagged — the CM is constructed and
+dropped, so nothing was installed and nothing will be popped.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import FileContext, rule
+from ..flow import (FileFlows, call_tail as _tail, dataflow,
+                    walk_function_scope, walk_in_scope)
+from ..jaxutil import dotted, module_info
+
+#: kind -> (set of acquire call tails)
+_ACQ_TAILS = {
+    "probe slot": {"try_acquire_probe"},
+    "call-wrapper hook": {"push_call_wrapper"},
+}
+#: kind -> release call tails
+_REL_TAILS = {
+    "probe slot": {"release_probe", "record_success", "record_failure"},
+    "call-wrapper hook": {"pop_call_wrapper"},
+    "claim file": {"unlink", "remove", "rmdir", "replace"},
+}
+#: context-manager factories whose bare-expression call is a
+#: constructed-and-dropped no-op (nothing installed, nothing popped)
+_CM_TAILS = {"activate"}
+
+
+def _is_claim_acquire(call: ast.Call, aliases) -> bool:
+    name = dotted(call.func, aliases)
+    if name == "os.open":
+        for sub in ast.walk(call):
+            if (isinstance(sub, ast.Attribute) and sub.attr == "O_EXCL") \
+                    or (isinstance(sub, ast.Name) and sub.id == "O_EXCL"):
+                return True
+        return False
+    if name == "os.mkdir" and call.args:
+        return "lock" in ast.unparse(call.args[0]).lower()
+    return False
+
+
+def _acquire_kind(call: ast.Call, aliases) -> str | None:
+    tail = _tail(call)
+    for kind, tails in _ACQ_TAILS.items():
+        if tail in tails:
+            return kind
+    if _is_claim_acquire(call, aliases):
+        return "claim file"
+    return None
+
+
+def _released_kinds(node: ast.AST) -> set[str]:
+    out = set()
+    for sub in walk_in_scope(node):
+        if isinstance(sub, ast.Call):
+            tail = _tail(sub)
+            for kind, tails in _REL_TAILS.items():
+                if tail in tails:
+                    out.add(kind)
+    return out
+
+
+def _polarity(expr: ast.AST, target: ast.Call,
+              neg: bool = False) -> str | None:
+    """On which edge of a test does ``target`` (an acquire call inside
+    ``expr``) hold true — "true", "false", or None (not in the
+    test)."""
+    if expr is target:
+        return "false" if neg else "true"
+    if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.Not):
+        return _polarity(expr.operand, target, not neg)
+    if isinstance(expr, ast.BoolOp):
+        for v in expr.values:
+            r = _polarity(v, target, neg)
+            if r is not None:
+                return r
+    return None
+
+
+def _test_expr(stmt) -> ast.AST | None:
+    if isinstance(stmt, (ast.If, ast.While)):
+        return stmt.test
+    return None
+
+
+def _managed_calls(stmt: ast.AST) -> set[int]:
+    """ids of calls that are arguments of an ``enter_context(...)``
+    call — an ExitStack owns their release."""
+    out: set[int] = set()
+    for sub in walk_in_scope(stmt):
+        if isinstance(sub, ast.Call) and _tail(sub) == "enter_context":
+            for arg in sub.args:
+                for inner in ast.walk(arg):
+                    if isinstance(inner, ast.Call):
+                        out.add(id(inner))
+    return out
+
+
+@rule("SCT010", "resource-pairing",
+      "leak-prone acquires (probe slot, call-wrapper push, O_EXCL/"
+      "lockdir claims) must reach a release on every path — finally "
+      "or context manager", scope="flow")
+def check_resource_pairing(ctx: FileContext, flows: FileFlows):
+    aliases = module_info(ctx).aliases
+    for info in flows.functions:
+        yield from _check_fn(ctx, flows, info.fn, aliases)
+    # constructed-and-dropped context managers: `x.activate()` as a
+    # bare statement installs nothing and will pop nothing
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call) \
+                and _tail(node.value) in _CM_TAILS:
+            yield ctx.violation(
+                "SCT010", node.value,
+                f"{_tail(node.value)}() called as a bare statement — "
+                f"the context manager is constructed and dropped, so "
+                f"nothing is installed (and nothing will be released);"
+                f" use `with ...:` or ExitStack.enter_context")
+
+
+def _check_fn(ctx, flows: FileFlows, fn, aliases):
+    # cheap pre-scan: functions with no acquire at all skip the CFG
+    acquires = [n for n in walk_function_scope(fn)
+                if isinstance(n, ast.Call)
+                and _acquire_kind(n, aliases) is not None]
+    if not acquires:
+        return
+    cfg = flows.cfg(fn)
+
+    # per-node gen/kill, precomputed
+    gens: dict[int, list] = {}   # node idx -> [(fact, edge_tag|None)]
+    kills: dict[int, set] = {}   # node idx -> kinds killed
+    fact_nodes: dict[tuple, ast.Call] = {}
+    for node in cfg.nodes:
+        stmt = node.ast
+        if stmt is None:
+            continue
+        if node.kind == "finally":
+            # a finally that releases a kind ANYWHERE in its body
+            # releases it for every path routed through (the resolve-
+            # or-release idiom conditions the release on a verdict
+            # flag this analysis cannot track)
+            rel = set()
+            for s in stmt.finalbody:
+                rel |= _released_kinds(s)
+            if rel:
+                kills[node.idx] = kills.get(node.idx, set()) | rel
+            continue
+        if node.kind not in ("stmt", "test", "with_enter"):
+            continue
+        scan_roots: list[ast.AST]
+        if node.kind == "test":
+            t = _test_expr(stmt)
+            scan_roots = [t] if t is not None else []
+        elif node.kind == "with_enter":
+            scan_roots = [i.context_expr for i in stmt.items]
+        else:
+            scan_roots = [stmt]
+        managed = set()
+        for root in scan_roots:
+            managed |= _managed_calls(root)
+        for root in scan_roots:
+            rel = _released_kinds(root)
+            if rel:
+                kills[node.idx] = kills.get(node.idx, set()) | rel
+            for call in walk_in_scope(root):
+                if not isinstance(call, ast.Call):
+                    continue
+                kind = _acquire_kind(call, aliases)
+                if kind is None or id(call) in managed:
+                    continue
+                if node.kind == "with_enter":
+                    continue  # `with acquire():` — managed
+                if isinstance(stmt, ast.Return):
+                    continue  # ownership transferred to the caller
+                condvar = None
+                edge = None
+                if node.kind == "test":
+                    edge = _polarity(scan_roots[0], call)
+                elif isinstance(stmt, ast.Assign) \
+                        and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Name) \
+                        and stmt.value is call:
+                    condvar = stmt.targets[0].id
+                fact = (kind, call.lineno, call.col_offset, condvar)
+                fact_nodes[fact[:3]] = call
+                gens.setdefault(node.idx, []).append((fact, edge))
+
+    def transfer(node, state):
+        if state is None:
+            state = frozenset()
+        k = kills.get(node.idx)
+        if k:
+            state = frozenset(f for f in state if f[0] not in k)
+        for fact, edge in gens.get(node.idx, ()):
+            if edge is None:
+                state = state | {fact}
+        return state
+
+    def edge_refine(node, tag, state):
+        for fact, edge in gens.get(node.idx, ()):
+            if edge is not None and edge == tag:
+                state = state | {fact}
+        # condvar refinement: `if ok:` / `if not ok:` drops facts
+        # bound to the tested name on the edge where it is falsy
+        if node.kind == "test":
+            t = _test_expr(node.ast)
+            name, falsy = None, None
+            if isinstance(t, ast.Name):
+                name, falsy = t.id, "false"
+            elif isinstance(t, ast.UnaryOp) \
+                    and isinstance(t.op, ast.Not) \
+                    and isinstance(t.operand, ast.Name):
+                name, falsy = t.operand.id, "true"
+            if name is not None and tag == falsy:
+                state = frozenset(f for f in state if f[3] != name)
+        # an acquire call that itself raises acquired nothing
+        if tag == "exc":
+            mine = {f[:3] for f, _ in gens.get(node.idx, ())}
+            state = frozenset(f for f in state if f[:3] not in mine)
+        return state
+
+    states = dataflow(cfg, transfer, edge_refine)
+    seen: set[tuple] = set()
+    for exit_node, how in ((cfg.raise_exit, "a raising path"),
+                           (cfg.exit, "an early-return/fall-through "
+                                      "path")):
+        for fact in sorted(states[exit_node]):
+            if fact[:3] in seen:
+                continue
+            seen.add(fact[:3])
+            kind = fact[0]
+            rel = "/".join(sorted(_REL_TAILS[kind]))
+            yield ctx.violation(
+                "SCT010", fact_nodes[fact[:3]],
+                f"{kind} acquired in {cfg.fn.name}() can leak on "
+                f"{how} — release it ({rel}) in a finally or a "
+                f"context manager; if ownership transfers out of "
+                f"this function, suppress with a comment naming the "
+                f"releasing path")
