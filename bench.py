@@ -1364,6 +1364,36 @@ def phase_train():
         flush_result(train={"error": repr(e)[:300]}, backend=backend)
 
 
+def phase_serve():
+    """Online annotation serving: a sustained randomly-sized query
+    stream against a resident reference model with one mid-stream
+    hot-swap.  The measurement lives in ``tools/bench_serve.py``; the
+    p99-latency / zero-retrace / >= 0.99 batch-agreement gates are
+    enforced by tests/test_bench_gates.py."""
+    acq = acquire_jax(min(DEVICE_TIMEOUT_S, max(remaining() - 20, 30)))
+    if acq["jax"] is None:
+        stage("serve.acquire_failed", hung=acq["hung"],
+              error=acq["error"], waited_s=round(acq["waited"], 1))
+        flush_result(error=f"acquire failed: "
+                           f"{'hung' if acq['hung'] else acq['error']}")
+        sys.exit(3)
+    jax, backend = acq["jax"], acq["backend"]
+    # no wrong-backend exit: the phase measures the serving STACK's
+    # latency (admission + plan-cache dispatch + bucket padding), a
+    # host-dominated path that is meaningful on cpu boxes by design
+    stage("serve.acquire", backend=backend)
+    try:
+        from tools.bench_serve import run_serve_bench
+
+        det = run_serve_bench(jax)
+        stage("serve", **{k: v for k, v in det.items()
+                          if not isinstance(v, (dict, list))})
+        flush_result(serve=det, backend=backend)
+    except Exception as e:
+        stage("serve.error", error=repr(e)[:300])
+        flush_result(serve={"error": repr(e)[:300]}, backend=backend)
+
+
 def phase_graph():
     """The post-kNN graph tail: tiled graph kernels (matvec / MAGIC
     diffusion / jaccard) + the RCM locality reorder vs the legacy
@@ -1485,7 +1515,7 @@ def main():
          "atlas": phase_atlas, "stream_io": phase_stream_io,
          "fusion": phase_fusion, "mesh": phase_mesh,
          "graph": phase_graph, "ingest": phase_ingest,
-         "train": phase_train}[args.phase]()
+         "train": phase_train, "serve": phase_serve}[args.phase]()
         return 0
 
     stage("start", budget_s=BUDGET_S, stall_s=STALL_S,
@@ -1567,6 +1597,17 @@ def main():
         if "train" in res:
             detail["train"] = res["train"]
         detail["phase_train"] = res.get("_phase")
+
+    if args.config is None and not tpu_dead and remaining() > 120:
+        # resident-state SERVING: a sustained randomly-sized query
+        # stream against a device-resident reference model, p99
+        # latency + zero retraces after warmup (incl. across a
+        # mid-stream hot-swap) + batch-pipeline label agreement
+        res = run_phase("serve", min(240.0, remaining() - 60))
+        note_tpu(res)
+        if "serve" in res:
+            detail["serve"] = res["serve"]
+        detail["phase_serve"] = res.get("_phase")
 
     atlas_route_env = {}
     if args.config is None and not tpu_dead and remaining() > 150:

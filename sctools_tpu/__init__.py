@@ -41,6 +41,8 @@ from .recipes import recipe_pipeline, run_recipe, submit_recipe
 from .registry import Pipeline, Transform, apply, backends, names, register
 from .runner import ResilientRunner, RetryPolicy
 from .scheduler import RunRejected, RunScheduler, RunShed, TenantQuota
+from . import serving  # noqa: F401  (registers serve.* transforms)
+from .serving import AnnotationService, build_reference_artifact
 from .federation import (FederatedBreakerRegistry, FederatedRunError,
                          FederationSupervisor, TicketHandle)
 from .compat import experimental, external, pp, tl  # scanpy-style namespaces
@@ -85,4 +87,5 @@ __all__ = [
     "fused_pipeline", "describe_plan",
     "ShardStore", "ShardReadScheduler", "StoreWriter", "open_store",
     "write_store",
+    "AnnotationService", "build_reference_artifact", "serving",
 ]

@@ -42,6 +42,102 @@ from ..data.dataset import CellData
 from ..data.sparse import SparseCells
 from ..registry import register
 
+#: identity fingerprint of the on-disk scvi/scanvi parameter artifact
+#: (:func:`save_model`/:func:`load_model`) — a foreign npz renamed
+#: onto a model path fails verification instead of half-parsing; bump
+#: on incompatible layout changes
+MODEL_FINGERPRINT = "scvi-model-v1"
+
+
+def flatten_params(params, prefix: str = "param") -> dict:
+    """Flatten an scvi/scanvi parameter pytree (nested dicts/lists of
+    arrays) into ``{"<prefix>/enc/000/w": ndarray, ...}`` — the
+    SELF-DESCRIBING key layout :func:`save_model` writes, shared with
+    the serving artifact (``sctools_tpu/serving.py`` embeds trained
+    params under ``scvi/...`` keys with the same encoding), so one
+    on-disk convention covers every durable model file instead of
+    ad-hoc param pickling."""
+    out: dict = {}
+
+    def rec(v, key):
+        if isinstance(v, dict):
+            for k in sorted(v):
+                rec(v[k], f"{key}/{k}")
+        elif isinstance(v, (list, tuple)):
+            for i, x in enumerate(v):
+                rec(x, f"{key}/{i:03d}")
+        else:
+            out[key] = np.asarray(v)
+
+    rec(params, prefix)
+    return out
+
+
+def unflatten_params(arrays: dict, prefix: str = "param"):
+    """Rebuild the parameter pytree :func:`flatten_params` encoded:
+    all-numeric key segments become list indices, everything else
+    dict keys; leaves come back as jax arrays ready for
+    ``_train_epoch``/``_encode``."""
+    root: dict = {}
+    for key in arrays:
+        if not key.startswith(prefix + "/"):
+            continue
+        parts = key[len(prefix) + 1:].split("/")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arrays[key]
+
+    def build(node):
+        if not isinstance(node, dict):
+            return jnp.asarray(node)
+        keys = list(node)
+        if keys and all(k.isdigit() for k in keys):
+            return [build(node[k]) for k in sorted(keys, key=int)]
+        return {k: build(node[k]) for k in sorted(keys)}
+
+    if not root:
+        raise ValueError(
+            f"unflatten_params: no {prefix!r}-prefixed keys — not a "
+            f"flatten_params() encoding")
+    return build(root)
+
+
+def save_model(params, path: str, *, meta: dict | None = None) -> str:
+    """Write a trained scvi/scanvi parameter pytree as a verified,
+    generation-rotated artifact: :func:`flatten_params` keys plus
+    ``meta/<k>`` scalars, through
+    ``checkpoint.save_npz_generations`` (content digest +
+    :data:`MODEL_FINGERPRINT` identity, atomic rename, previous
+    generation rotated to ``.prev``) — the SAME integrity/rollback
+    conventions the streaming trainer's cursors and the serving
+    artifacts ride.  Returns the content digest."""
+    from ..utils.checkpoint import save_npz_generations
+
+    arrays = flatten_params(params)
+    for k, v in (meta or {}).items():
+        arrays[f"meta/{k}"] = np.asarray(v)
+    return save_npz_generations(path, fingerprint=MODEL_FINGERPRINT,
+                                **arrays)
+
+
+def load_model(path: str):
+    """Verify-then-load a :func:`save_model` artifact: returns
+    ``(params, meta)``.  Any damage — bit rot, truncation, a foreign
+    file renamed onto the path — raises
+    ``checkpoint.CheckpointCorruptError`` from the digest/fingerprint
+    verify; callers that want the ``.prev``-generation fallback load
+    through ``checkpoint.load_npz_generations`` semantics (the
+    serving layer does, with quarantine + journal)."""
+    from ..utils.checkpoint import load_npz_verified
+
+    arrays = load_npz_verified(path,
+                               expect_fingerprint=MODEL_FINGERPRINT,
+                               require_digest=True)
+    meta = {k[len("meta/"):]: arrays[k]
+            for k in arrays if k.startswith("meta/")}
+    return unflatten_params(arrays), meta
+
 
 def _init_mlp(key, sizes):
     params = []
@@ -348,7 +444,8 @@ def scvi(data: CellData, n_latent: int = 10, n_hidden: int = 128,
          epochs: int = 40, batch_size: int = 512,
          batch_key: str | None = None, seed: int = 0,
          kl_warmup: int = 10, n_devices: int | None = None,
-         store_normalized: bool = False) -> CellData:
+         store_normalized: bool = False,
+         save_model_path: str | None = None) -> CellData:
     """Train the NB-VAE and embed every cell.  Adds obsm["X_scvi"]
     (the posterior mean latent), var["scvi_dispersion"], and
     uns["scvi_elbo_history"] (negative ELBO per epoch — should
@@ -360,7 +457,12 @@ def scvi(data: CellData, n_latent: int = 10, n_hidden: int = 128,
     during training (the final encode pass is currently unsharded).
     Run AFTER hvg subsetting (training densifies gene space) and
     BEFORE normalisation, or snapshot counts first
-    (``util.snapshot_layer``)."""
+    (``util.snapshot_layer``).  ``save_model_path`` additionally
+    writes the trained parameters as a verified on-disk artifact
+    (:func:`save_model`: digest + fingerprint + ``.prev`` rotation) —
+    the stable form the annotation service
+    (``sctools_tpu/serving.py``) and downstream tooling reload with
+    :func:`load_model`."""
     mesh = None
     if n_devices is not None and n_devices > 1:
         from ..parallel.mesh import make_mesh
@@ -369,6 +471,12 @@ def scvi(data: CellData, n_latent: int = 10, n_hidden: int = 128,
     latent, theta, history, params, (latent_d, batch_oh) = _fit(
         data, n_latent, n_hidden, epochs, batch_size, batch_key, seed,
         kl_warmup, mesh=mesh)
+    if save_model_path:
+        save_model(params, save_model_path,
+                   meta=dict(n_genes=data.n_genes,
+                             n_batches=batch_oh.shape[1],
+                             n_latent=n_latent, n_hidden=n_hidden,
+                             seed=seed))
     out = (data.with_obsm(X_scvi=latent)
            .with_var(scvi_dispersion=theta.astype(np.float32))
            .with_uns(scvi_elbo_history=np.asarray(history)))

@@ -385,6 +385,26 @@ def graph_tail_pipeline(t: int = 3, mode: str = "umap",
     return Pipeline(steps)
 
 
+@_pipeline_recipe("annotation_reference")
+def annotation_reference_pipeline(n_components: int = 50,
+                                  target_sum: float = 1e4) -> Pipeline:
+    """Prepare a reference atlas for the online annotation service
+    (``sctools_tpu/serving.py``): snapshot raw counts → library-size
+    normalise → log1p → randomized PCA.  Deliberately NO hvg subset
+    and no scale: the gene space must stay identical to what raw-count
+    queries arrive in (``serving.build_reference_artifact`` freezes
+    the loadings + mean + scores this produces, and the query kernel
+    applies the same normalise/log1p before projecting), and per-gene
+    z-scoring would need the reference's moments shipped to every
+    query for no annotation-accuracy win at serving scale."""
+    return Pipeline([
+        ("util.snapshot_layer", {"layer": "counts"}),
+        ("normalize.library_size", {"target_sum": target_sum}),
+        ("normalize.log1p", {}),
+        ("pca.randomized", {"n_components": n_components}),
+    ])
+
+
 @_pipeline_recipe("pearson_residuals")
 def pearson_residuals_pipeline(n_top_genes: int = 2000,
                                theta: float = 100.0,

@@ -137,13 +137,19 @@ class RunHandle:
     see them."""
 
     def __init__(self, ticket: int, tenant: str, priority: int,
-                 deadline_s: float | None):
+                 deadline_s: float | None, clock=None):
         self.ticket = ticket
         self.tenant = tenant
         self.priority = priority
         self.deadline_s = deadline_s
         self.report = None
         self.reason: str | None = None
+        #: the scheduler clock's reading at the terminal transition
+        #: (None until terminal) — composing layers (the annotation
+        #: service's latency accounting) read the REAL terminal time
+        #: here instead of their own collection time
+        self.finished_at: float | None = None
+        self._clock = clock
         self._status = "queued"
         self._result = None
         self._error: BaseException | None = None
@@ -196,6 +202,8 @@ class RunHandle:
         self._result = result
         self._error = error
         self.reason = reason
+        if self._clock is not None:
+            self.finished_at = self._clock.monotonic()
         self._status = status
         self._terminal.set()
 
@@ -434,7 +442,8 @@ class RunScheduler:
                 if victim is None:
                     self._reject(ticket, tenant, "queue_full")
                 self._shed_locked(victim, "queue_high_water")
-            handle = RunHandle(ticket, tenant, priority, deadline_s)
+            handle = RunHandle(ticket, tenant, priority, deadline_s,
+                               clock=self.clock)
             handle._cancel_cb = self._cancel
             item = _QueueItem(ticket, tenant, int(priority), deadline_s,
                               self.clock.monotonic(), pipeline, data,

@@ -1,0 +1,1404 @@
+"""Survivable online annotation service — resident reference-model
+state as a first-class fault domain.
+
+Every prior fault-tolerance rung (retry → breaker → degrade →
+quarantine → requeue → preempt) protects RUNS: work that arrives,
+executes, and leaves.  The query-to-reference scenario (the raw-count
+annotation survey, PAPERS.md) is a different traffic shape — a
+pre-trained reference model kept DEVICE-RESIDENT for hours while
+streams of small query batches map against it — and long-lived
+resident state fails in ways no run-shaped ladder covers: a corrupted
+model artifact, an evicted device buffer, a mid-traffic model
+upgrade.  :class:`AnnotationService` owns that state and serves three
+query kinds against it — label transfer (the ``integrate.ingest``
+contract: project into the reference PCA space, distance-weighted
+kNN vote), doublet flagging (Scrublet's simulated-neighbour
+enrichment, with the expensive doublet simulation done ONCE at
+artifact build — ``ops/doublet.py``'s machinery — so queries only pay
+a kNN), and marker scoring (``ops/score.py``'s expression-matched
+weight tables, frozen at build) — in three robustness layers:
+
+**Verified state lifecycle.**  The reference model is an on-disk
+artifact written through the checkpoint integrity layer
+(:func:`build_reference_artifact` → ``checkpoint.save_npz_generations``:
+content digest + the ``serving-model-v1`` identity fingerprint,
+atomic rename, previous generation rotated to ``.prev``).  Every load
+verifies before trusting; a corrupt generation is QUARANTINED — moved
+beside the data with a ``.reason.json`` sidecar, never deleted —
+journaled ``model_quarantined``, and the load falls back to ``.prev``
+(one build of lost freshness, never a dead service).  A residency
+HEALTH PROBE (are the device buffers still alive?) backs a degrade
+ladder for the resident state itself::
+
+    resident-on-device → re-place (host mirror → device)
+                       → reload-from-artifact (verified; quarantine +
+                         .prev on damage)
+                       → cpu (serve from host arrays)
+
+wired into the existing breaker machinery: device-placement failures
+feed the per-backend shared :class:`~sctools_tpu.utils.failsafe.
+CircuitBreaker`, and an OPEN breaker sends queries straight to the
+host rung without a placement storm.  Rungs taken are counted in
+``serve.state_reloads{reason=}``.
+
+**Epoch-guarded hot-swap.**  :meth:`AnnotationService.swap` loads and
+places the candidate artifact BESIDE the serving model, validates it
+against the artifact's own canary (a stored slice of reference cells
+with their expected labels — a model that cannot re-derive its own
+canary labels is corrupt or mismatched, whatever its digest says),
+and only then flips the serving epoch.  Queries are pinned to the
+epoch they were ADMITTED under — the previous epoch's model stays
+resident until the next swap, so an in-flight query never sees a
+mid-query tensor swap — and a failed canary (or a corrupt candidate)
+auto-rolls-back: the old epoch keeps serving, journaled
+``swap_rolled_back``.  Successful swaps journal ``model_swapped``.
+
+**Terminal-exactly-once queries.**  Admission rides the
+:class:`~sctools_tpu.scheduler.RunScheduler` — per-tenant quotas,
+queue-deadline feasibility, priority-correct shedding, per-query
+deadlines (``deadline_s=`` at admission + the runner's
+``step_deadline_s`` while executing), and the shared per-backend
+breaker — so every query terminates in exactly one of
+{completed, failed, rejected, shed} with a journaled reason (the
+scheduler's funnel contract), counted in ``serve.queries{outcome=}``.
+Chaos modes ``evict_state`` / ``corrupt_model`` fire on a dedicated
+serving channel (``ChaosMonkey.on_serving``, consulted once per query
+execution), so the whole ladder is tier-1 testable on one
+VirtualClock with zero real sleeps.
+
+**Shape bucketing (the low-latency half).**  Queries arrive in
+arbitrary small shapes; compiling per shape would retrace forever.
+Incoming batches are zero-padded to a small ladder of canonical
+bucket row counts (:data:`DEFAULT_BUCKETS`; padding rows are inert —
+every query kind is row-independent, and results are trimmed to the
+real row count), and the pure query math executes as a fused plan
+(``plan.FusedTransform`` over the ``serve.kernel`` op) whose inputs
+INCLUDE the model arrays — so the process-wide plan cache serves
+every query of a bucket after its first compile, across evictions,
+re-places and even hot-swaps to a same-shaped model (the arrays are
+inputs, not baked constants).  Zero retraces after warmup is CI-gated
+via the existing ``plan.cache_hits``/``plan.cache_misses`` counters
+(``bench.py --phase serve``).
+
+>>> import sctools_tpu as sct
+>>> ref = sct.run_recipe("annotation_reference", raw_ref)
+>>> sct.serving.build_reference_artifact(ref, "model.npz",
+...                                      labels_key="cell_type")
+>>> with sct.AnnotationService("model.npz", backend="tpu") as svc:
+...     t = svc.query(raw_query_counts, "label_transfer",
+...                   tenant="lab-a", deadline_s=30)
+...     print(t.result()["labels"])
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+import weakref
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .data.dataset import CellData
+from .plan import FusedTransform
+from .registry import Pipeline, Transform, register
+from .scheduler import RunRejected, RunScheduler
+from .utils import telemetry
+from .utils.checkpoint import (CheckpointCorruptError,
+                               load_npz_verified, quarantine_checkpoint,
+                               save_npz_generations)
+from .utils.failsafe import TRANSIENT, classify_error
+from .utils.vclock import SYSTEM_CLOCK
+
+#: identity fingerprint of the serving artifact — a foreign npz
+#: renamed onto the model path fails verification instead of
+#: half-parsing; bump on incompatible layout changes
+SERVING_MODEL_FP = "serving-model-v1"
+
+#: the query kinds :meth:`AnnotationService.query` serves
+QUERY_KINDS = ("label_transfer", "doublet_flag", "marker_score")
+
+#: canonical query-batch row counts (the shape-bucket ladder): an
+#: n-row query pads to the smallest bucket >= n, so every batch size
+#: in a bucket shares one compiled program; sizes past the ladder
+#: keep doubling (serving is for SMALL frequent queries — atlas-sized
+#: inputs belong on the batch pipeline)
+DEFAULT_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+#: artifact keys that become device-resident on place() (score-set
+#: weight tables join them dynamically under their "score/<name>"
+#: keys; canary/scvi payloads stay host-only)
+_DEVICE_KEYS = ("PCs", "pca_mean", "ref_scores", "label_codes",
+                "sim_scores")
+
+
+def bucket_rows(n: int, buckets=DEFAULT_BUCKETS) -> int:
+    """The canonical padded row count for an ``n``-row query batch:
+    the smallest bucket >= ``n``, doubling past the ladder's end."""
+    if n < 1:
+        raise ValueError("bucket_rows: need at least one query row")
+    for b in buckets:
+        if n <= b:
+            return int(b)
+    b = int(buckets[-1])
+    while b < n:
+        b *= 2
+    return b
+
+
+# ---------------------------------------------------------------------------
+# Artifact build
+# ---------------------------------------------------------------------------
+
+
+def _dense_rows(M, rows: np.ndarray) -> np.ndarray:
+    """Fetch selected rows of a counts matrix (scipy / numpy / packed
+    SparseCells / device array) as dense float32 — build-time only."""
+    import scipy.sparse as sp
+
+    if hasattr(M, "to_scipy_csr"):  # device-packed SparseCells
+        M = M.to_scipy_csr()
+    if sp.issparse(M):
+        return np.asarray(M[rows].todense(), np.float32)
+    return np.asarray(M, np.float32)[rows]
+
+
+def build_reference_artifact(ref: CellData, path: str, *,
+                             labels_key: str = "cell_type",
+                             score_sets: dict | None = None,
+                             n_canary: int = 64,
+                             sim_ratio: float = 1.0,
+                             max_sim: int = 4096,
+                             expected_rate: float = 0.06,
+                             ctrl_size: int = 50, n_bins: int = 25,
+                             target_sum: float = 1e4,
+                             log1p: bool = True,
+                             counts_layer: str = "counts",
+                             seed: int = 0, version: str = "v1",
+                             scvi_model=None) -> str:
+    """Freeze a fitted reference into the serving artifact.
+
+    ``ref`` must already carry the batch pipeline's PCA state
+    (``varm['PCs']`` + ``obsm['X_pca']`` + ``uns['pca_mean']`` — the
+    ``annotation_reference`` recipe produces exactly this shape) and
+    the label column ``obs[labels_key]``.  The artifact stores
+    everything a query needs, with the expensive parts done HERE, once:
+
+    * the projection state (loadings, mean, reference scores, label
+      codes + levels, gene names) for label transfer;
+    * simulated-doublet embeddings (``ops/doublet.py``'s pair
+      sampling + sum + normalise + project, on the raw counts in
+      ``layers[counts_layer]``) so a doublet query is one kNN against
+      resident state instead of a fresh simulation;
+    * one expression-matched ``(n_genes, 2)`` weight table per entry
+      of ``score_sets`` (``{name: gene list}``, ``ops/score.py``'s
+      control binning frozen at build);
+    * a CANARY — ``n_canary`` reference cells' raw counts with their
+      expected label codes — the self-check every load and every
+      hot-swap candidate must pass (:meth:`AnnotationService.swap`);
+    * optionally the trained scvi parameters (``scvi_model``: a
+      params pytree or a ``models.scvi.save_model`` path), embedded
+      under ``scvi/...`` keys with the same flatten encoding.
+
+    Written through ``checkpoint.save_npz_generations`` (digest +
+    :data:`SERVING_MODEL_FP` fingerprint, atomic rename, previous
+    generation rotated to ``.prev`` — the rollback target a corrupt
+    newer generation falls back to).  ``target_sum``/``log1p`` record
+    how queries must be normalised to match the reference's
+    preprocessing.  Returns the content digest."""
+    from .ops.doublet import _sample_pairs
+    from .ops.score import (_control_indices, _gene_means_host,
+                            _resolve_gene_indices, _score_weights)
+
+    n = ref.n_cells
+    if "PCs" not in ref.varm or "X_pca" not in ref.obsm:
+        raise ValueError(
+            "build_reference_artifact: reference needs varm['PCs'] + "
+            "obsm['X_pca'] (+ uns['pca_mean']) — run the "
+            "'annotation_reference' recipe (or pca.randomized) on it "
+            "first")
+    if labels_key not in ref.obs:
+        raise KeyError(
+            f"build_reference_artifact: obs has no {labels_key!r}")
+    PCs = np.asarray(ref.varm["PCs"], np.float32)
+    mu = np.asarray(ref.uns.get("pca_mean",
+                                np.zeros(ref.n_genes)), np.float32)
+    ref_scores = np.asarray(ref.obsm["X_pca"], np.float32)[:n]
+    raw = np.asarray(ref.obs[labels_key]).astype(str)[:n]
+    levels, codes = np.unique(raw, return_inverse=True)
+    # the canary and the simulated doublets must be built from RAW
+    # counts (the query kernel normalises them exactly once, like a
+    # real query) — silently using an already-normalised X would
+    # double-normalise both and bake a self-inconsistent artifact
+    if counts_layer is None:
+        counts = ref.X  # the caller asserts X itself holds raw counts
+    elif counts_layer in ref.layers:
+        counts = ref.layers[counts_layer]
+    else:
+        raise ValueError(
+            f"build_reference_artifact: reference has no "
+            f"layers[{counts_layer!r}] raw-counts snapshot — the "
+            f"'annotation_reference' recipe snapshots one before "
+            f"normalising; pass counts_layer=None only if X itself "
+            f"still holds raw counts")
+
+    arrays: dict = {
+        "PCs": PCs, "pca_mean": mu, "ref_scores": ref_scores,
+        "label_levels": levels.astype(str),
+        "label_codes": codes.astype(np.int32),
+        "target_sum": np.float64(target_sum),
+        "log1p": np.int64(bool(log1p)),
+        "expected_rate": np.float64(expected_rate),
+        "version": np.array(str(version)),
+    }
+    if "gene_name" in ref.var:
+        arrays["gene_names"] = np.asarray(
+            ref.var["gene_name"]).astype(str)
+
+    # simulated doublets, projected ONCE at build (ops/doublet.py's
+    # simulation; queries only pay the kNN against these embeddings)
+    n_sim = min(int(max_sim), max(1, int(round(sim_ratio * n))))
+    pairs = _sample_pairs(n, n_sim, seed)
+    D = (_dense_rows(counts, pairs[:, 0])
+         + _dense_rows(counts, pairs[:, 1]))
+    arrays["sim_scores"] = np.asarray(
+        _project_rows_host(D, PCs, mu, target_sum, log1p), np.float32)
+    arrays["sim_ratio"] = np.float64(n_sim / n)
+
+    # expression-matched score-set weight tables (ops/score.py's
+    # control binning, frozen against the REFERENCE's gene means)
+    names = sorted(score_sets or {})
+    arrays["score_set_names"] = np.asarray(names, dtype=str)
+    if names:
+        gm = _gene_means_host(ref)
+        for i, name in enumerate(names):
+            tgt = _resolve_gene_indices(ref, score_sets[name])
+            ctrl = _control_indices(gm, tgt, ctrl_size, n_bins,
+                                    seed + i)
+            arrays[f"score/{name}"] = _score_weights(
+                ref.n_genes, tgt, ctrl)
+
+    # the canary: reference cells whose labels the model must be able
+    # to re-derive (evenly spaced — covers the label space better
+    # than a prefix)
+    c = max(1, min(int(n_canary), n))
+    canary_idx = np.unique(np.linspace(0, n - 1, c).astype(np.int64))
+    arrays["canary_x"] = _dense_rows(counts, canary_idx)
+    arrays["canary_codes"] = codes[canary_idx].astype(np.int32)
+
+    if scvi_model is not None:
+        from .models.scvi import flatten_params, load_model
+
+        params = (load_model(scvi_model)[0]
+                  if isinstance(scvi_model, str) else scvi_model)
+        arrays.update(flatten_params(params, prefix="scvi"))
+
+    return save_npz_generations(path, fingerprint=SERVING_MODEL_FP,
+                                **arrays)
+
+
+def _project_rows_host(X: np.ndarray, PCs, mu, target_sum,
+                       log1p) -> np.ndarray:
+    """Host-side normalise + project of dense count rows (build-time
+    and the cpu-rung oracle; the traced twin lives in
+    :func:`serve_kernel`)."""
+    lib = X.sum(axis=1, keepdims=True)
+    Xn = X * (float(target_sum) / np.maximum(lib, 1.0))
+    if log1p:
+        Xn = np.log1p(Xn)
+    return (Xn - np.asarray(mu)[None, :]) @ np.asarray(PCs)
+
+
+# ---------------------------------------------------------------------------
+# The pure query kernel (fused-plan traced; model arrays are INPUTS)
+# ---------------------------------------------------------------------------
+
+
+def _normalize_traced(X, target_sum: float, log1p: bool):
+    lib = jnp.sum(X, axis=1, keepdims=True)
+    Xn = X * (target_sum / jnp.maximum(lib, 1.0))
+    return jnp.log1p(Xn) if log1p else Xn
+
+
+def _topk_neighbors(q, r, k: int, metric: str):
+    """(idx, dist) of each query row's k nearest reference rows — a
+    full (bucket, n_ref) distance matrix + ``lax.top_k``: one MXU
+    matmul, fully traceable, right-sized for serving buckets (large
+    references belong on the blocked batch kNN)."""
+    if metric == "cosine":
+        qn = q / jnp.maximum(
+            jnp.linalg.norm(q, axis=1, keepdims=True), 1e-12)
+        rn = r / jnp.maximum(
+            jnp.linalg.norm(r, axis=1, keepdims=True), 1e-12)
+        d = 1.0 - qn @ rn.T
+    else:
+        d2 = (jnp.sum(q * q, axis=1)[:, None]
+              + jnp.sum(r * r, axis=1)[None, :] - 2.0 * (q @ r.T))
+        d = jnp.sqrt(jnp.maximum(d2, 0.0))
+    neg, idx = jax.lax.top_k(-d, k)
+    return idx, -neg
+
+
+@register("serve.kernel", backend="tpu", fusable=True)
+@register("serve.kernel", backend="cpu", fusable=True)
+def serve_kernel(data: CellData, kind: str = "label_transfer",
+                 k: int = 15, metric: str = "cosine",
+                 n_levels: int = 0, target_sum: float = 1e4,
+                 log1p: bool = True, sim_ratio: float = 1.0,
+                 expected_rate: float = 0.06) -> CellData:
+    """The PURE per-query math, one jit-traceable pass over a
+    bucket-padded batch — the op the serving plan compiles
+    (``plan.FusedTransform``).  The resident model rides in as INPUT
+    leaves under ``uns`` (``serve_pcs``/``serve_mu``/``serve_ref``/
+    ``serve_codes``/``serve_sim``/``serve_weights``), never as baked
+    constants, so re-placed or hot-swapped same-shaped state hits the
+    plan cache with zero retraces.  Padding rows are inert (every
+    kind is row-independent); the service trims results to the real
+    row count.  Adds ``obs['serve_label_code'/'serve_label_conf']``
+    (label transfer), ``obs['serve_doublet']`` (doublet flag) or
+    ``obs['serve_score']`` (marker score), plus
+    ``obsm['serve_scores']`` for the projection kinds."""
+    from .ops.doublet import _doublet_likelihood
+
+    X = jnp.asarray(data.X, jnp.float32)
+    Xn = _normalize_traced(X, float(target_sum), bool(log1p))
+    obs = dict(data.obs)
+    if kind == "marker_score":
+        both = Xn @ jnp.asarray(data.uns["serve_weights"], jnp.float32)
+        obs["serve_score"] = (both[:, 0] - both[:, 1]).astype(
+            jnp.float32)
+        return CellData(data.X, obs=obs)
+    PCs = data.uns["serve_pcs"]
+    scores = (Xn - data.uns["serve_mu"][None, :]) @ PCs
+    if kind == "label_transfer":
+        idx, dist = _topk_neighbors(scores, data.uns["serve_ref"],
+                                    int(k), metric)
+        w = 1.0 / jnp.maximum(dist, 1e-12)
+        w = w / jnp.sum(w, axis=1, keepdims=True)
+        nb = data.uns["serve_codes"][idx]
+        votes = jnp.sum(
+            jax.nn.one_hot(nb, int(n_levels), dtype=jnp.float32)
+            * w[..., None], axis=1)
+        obs["serve_label_code"] = jnp.argmax(votes, axis=1).astype(
+            jnp.int32)
+        # weights sum to 1 per row, so the winning vote mass IS the
+        # confidence (matches integrate.ingest's <col>_confidence)
+        obs["serve_label_conf"] = jnp.max(votes, axis=1).astype(
+            jnp.float32)
+        return CellData(data.X, obs=obs,
+                        obsm={"serve_scores": scores})
+    # doublet_flag: Scrublet's simulated-neighbour enrichment against
+    # the embeddings frozen at artifact build
+    ref = data.uns["serve_ref"]
+    comb = jnp.concatenate([ref, data.uns["serve_sim"]], axis=0)
+    k_adj = max(1, int(round(int(k) * (1.0 + float(sim_ratio)))))
+    idx, _ = _topk_neighbors(scores, comb, k_adj, "euclidean")
+    n_sim_nb = jnp.sum((idx >= ref.shape[0]).astype(jnp.float32),
+                       axis=1)
+    q = (n_sim_nb + 1.0) / (k_adj + 2.0)
+    obs["serve_doublet"] = _doublet_likelihood(
+        q, float(sim_ratio), float(expected_rate)).astype(jnp.float32)
+    return CellData(data.X, obs=obs, obsm={"serve_scores": scores})
+
+
+def annotate_host(host: dict, X: np.ndarray, kind: str, *, k: int = 15,
+                  metric: str = "cosine") -> dict:
+    """Numpy twin of :func:`serve_kernel` — the residency ladder's cpu
+    rung AND the test oracle.  ``host`` is the artifact's array dict;
+    ``X`` dense raw counts (no bucket padding needed — host numpy has
+    no retrace to amortise).  Returns the kind's result arrays."""
+    from .ops.doublet import _doublet_likelihood
+
+    target_sum = float(host["target_sum"])
+    log1p = bool(int(host["log1p"]))
+    if kind == "marker_score":
+        lib = X.sum(axis=1, keepdims=True)
+        Xn = X * (target_sum / np.maximum(lib, 1.0))
+        if log1p:
+            Xn = np.log1p(Xn)
+        both = Xn @ np.asarray(host["serve_weights"], np.float64)
+        return {"score": (both[:, 0] - both[:, 1]).astype(np.float32)}
+    scores = _project_rows_host(X, host["PCs"], host["pca_mean"],
+                                target_sum, log1p)
+    if kind == "label_transfer":
+        idx, dist = _topk_host(scores, host["ref_scores"], k, metric)
+        w = 1.0 / np.maximum(dist, 1e-12)
+        w = w / w.sum(axis=1, keepdims=True)
+        codes = np.asarray(host["label_codes"])
+        L = int(np.asarray(host["label_levels"]).shape[0])
+        votes = np.zeros((len(idx), L), np.float64)
+        rows = np.repeat(np.arange(len(idx)), idx.shape[1])
+        np.add.at(votes, (rows, codes[idx].ravel()), w.ravel())
+        win = votes.argmax(axis=1)
+        return {"codes": win.astype(np.int32),
+                "confidence": votes[np.arange(len(idx)),
+                                    win].astype(np.float32),
+                "scores": scores.astype(np.float32)}
+    sim = np.asarray(host["sim_scores"])
+    ref = np.asarray(host["ref_scores"])
+    r = float(host["sim_ratio"])
+    k_adj = max(1, int(round(k * (1.0 + r))))
+    comb = np.concatenate([ref, sim], axis=0)
+    idx, _ = _topk_host(scores, comb, k_adj, "euclidean")
+    q = ((idx >= ref.shape[0]).sum(axis=1) + 1.0) / (k_adj + 2.0)
+    dbl = _doublet_likelihood(q, r, float(host["expected_rate"]))
+    return {"doublet_score": np.asarray(dbl, np.float32),
+            "scores": scores.astype(np.float32)}
+
+
+def _topk_host(q, r, k, metric):
+    q = np.asarray(q, np.float64)
+    r = np.asarray(r, np.float64)
+    if metric == "cosine":
+        qn = q / np.maximum(np.linalg.norm(q, axis=1, keepdims=True),
+                            1e-12)
+        rn = r / np.maximum(np.linalg.norm(r, axis=1, keepdims=True),
+                            1e-12)
+        d = 1.0 - qn @ rn.T
+    else:
+        d = np.sqrt(np.maximum(
+            (q * q).sum(1)[:, None] + (r * r).sum(1)[None, :]
+            - 2.0 * q @ r.T, 0.0))
+    idx = np.argsort(d, axis=1, kind="stable")[:, :k]
+    return idx, np.take_along_axis(d, idx, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Resident model state
+# ---------------------------------------------------------------------------
+
+
+class _ResidentModel:
+    """One artifact generation, resident: the host numpy mirror (the
+    re-place rung's source), the device arrays (deleted = evicted —
+    the residency probe checks ``is_deleted`` on every leaf), and the
+    parsed metadata.  Epoch identity belongs to the owning service."""
+
+    def __init__(self, arrays: dict, path: str, epoch: int,
+                 generation: str):
+        self.path = path
+        self.epoch = epoch
+        self.generation = generation
+        self._dev: dict | None = None
+        self._rehost(arrays)
+
+    def _rehost(self, arrays: dict) -> None:
+        missing = [k for k in ("PCs", "pca_mean", "ref_scores",
+                               "label_levels", "label_codes",
+                               "sim_scores", "canary_x",
+                               "canary_codes")
+                   if k not in arrays]
+        if missing:
+            raise ValueError(
+                f"serving artifact {self.path!r} is missing keys "
+                f"{missing} — not a build_reference_artifact() file")
+        self.version = str(arrays.get("version", ""))
+        self.levels = np.asarray(arrays["label_levels"]).astype(str)
+        self.score_sets = tuple(
+            np.asarray(arrays.get("score_set_names",
+                                  np.zeros(0, "U1"))).astype(str))
+        self.gene_names = (np.asarray(arrays["gene_names"]).astype(str)
+                           if "gene_names" in arrays else None)
+        self.n_genes = int(np.asarray(arrays["PCs"]).shape[0])
+        self.meta = {
+            "target_sum": float(arrays["target_sum"]),
+            "log1p": bool(int(arrays["log1p"])),
+            "sim_ratio": float(arrays["sim_ratio"]),
+            "expected_rate": float(arrays["expected_rate"]),
+            "n_levels": int(self.levels.shape[0]),
+        }
+        self._scvi_raw = {k: np.asarray(v) for k, v in arrays.items()
+                          if k.startswith("scvi/")}
+        keep = set(_DEVICE_KEYS) | {f"score/{s}"
+                                    for s in self.score_sets} \
+            | {"canary_x", "canary_codes", "target_sum", "log1p",
+               "sim_ratio", "expected_rate", "label_levels"}
+        self._host: dict | None = {
+            k: np.asarray(v) for k, v in arrays.items() if k in keep}
+
+    # -- residency ----------------------------------------------------
+    def has_host(self) -> bool:
+        return self._host is not None
+
+    def resident(self) -> bool:
+        """The residency health probe: device state present and no
+        buffer deleted out from under us (eviction, device restart,
+        chaos ``evict_state``).  Cheap — no device sync."""
+        d = self._dev
+        if d is None:
+            return False
+        return not any(getattr(a, "is_deleted", _never)()
+                       for a in d.values())
+
+    def place(self) -> None:
+        """Put the query-path arrays on device (the canary and scvi
+        payloads stay host-only — the canary enters through the
+        normal bucketized query path when needed)."""
+        host = self._host
+        if host is None:
+            raise RuntimeError(
+                "resident model has no host mirror to place")
+        dev_keys = set(_DEVICE_KEYS) | {f"score/{s}"
+                                        for s in self.score_sets}
+        self._dev = {k: jnp.asarray(host[k]) for k in dev_keys
+                     if k in host}
+
+    def evict(self) -> None:
+        """Drop the device residency (chaos ``evict_state``; also the
+        honest way to model a device restart): buffers are DELETED,
+        so an in-flight query racing the eviction fails transiently
+        and its retry re-enters the ladder."""
+        dev, self._dev = self._dev, None
+        for a in (dev or {}).values():
+            a.delete()
+
+    def drop_host(self) -> None:
+        """Forget the host mirror too (chaos ``corrupt_model`` pairs
+        this with on-disk damage, forcing the ladder all the way to
+        the verified artifact reload)."""
+        self._host = None
+
+    def device_arrays(self) -> dict:
+        if self._dev is None:
+            raise RuntimeError("resident model is not placed")
+        return self._dev
+
+    def host_arrays(self) -> dict:
+        if self._host is None:
+            raise RuntimeError("resident model has no host mirror")
+        return self._host
+
+    def scvi_params(self):
+        """The embedded scvi parameter pytree (``scvi_model=`` at
+        build), or ``None``."""
+        if not self._scvi_raw:
+            return None
+        from .models.scvi import unflatten_params
+
+        return unflatten_params(self._scvi_raw, prefix="scvi")
+
+
+def _never() -> bool:
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Registered query op (the scheduler-admitted step)
+# ---------------------------------------------------------------------------
+
+#: live services by name — how the registered ``serve.query`` op finds
+#: its service from hashable step params (weak: a dropped service
+#: must not be pinned by the registry)
+_SERVICES: "weakref.WeakValueDictionary[str, AnnotationService]" = \
+    weakref.WeakValueDictionary()
+#: guards the check-then-register sequence (two concurrent
+#: constructions of the same name must not both win — the loser's
+#: in-flight queries would silently resolve to the winner's models)
+_SERVICES_LOCK = threading.Lock()
+
+
+def _resolve_service(name: str) -> "AnnotationService":
+    svc = _SERVICES.get(name)
+    if svc is None:
+        raise ValueError(
+            f"serve.query: no live AnnotationService named {name!r} "
+            f"(known: {sorted(_SERVICES)})")
+    return svc
+
+
+@register("serve.query", backend="tpu")
+@register("serve.query", backend="cpu")
+def serve_query(data: CellData, service: str = "",
+                kind: str = "label_transfer", epoch: int = 0,
+                k: int = 15, metric: str = "cosine",
+                score_set: str = "") -> CellData:
+    """Execute one ADMITTED annotation query against the named
+    service's resident reference model, pinned to the epoch it was
+    admitted under (the hot-swap guard: a swap mid-queue never
+    changes the model a query runs on).  The scheduler dispatches
+    this as a normal retryable step, so transient resident-state
+    failures (an eviction racing the query) retry through the
+    residency ladder for free.  Adds the kind's ``serve_*`` outputs
+    plus ``uns['serve_epoch'/'serve_mode']``."""
+    svc = _resolve_service(service)
+    return svc._execute_query(data, kind, int(epoch), int(k), metric,
+                              score_set or None)
+
+
+# ---------------------------------------------------------------------------
+# Query tickets
+# ---------------------------------------------------------------------------
+
+
+class ServeTicket:
+    """The caller's view of one admitted query: a thin shell over the
+    scheduler's :class:`~sctools_tpu.scheduler.RunHandle` that trims
+    bucket padding, maps label codes back to level strings, and
+    accounts the terminal outcome into ``serve.queries{outcome=}`` /
+    ``serve.latency_s`` exactly once."""
+
+    def __init__(self, service: "AnnotationService", handle, *,
+                 n: int, kind: str, epoch: int, t0: float, levels):
+        self._service = service
+        self.handle = handle
+        self.n = n
+        self.kind = kind
+        self.epoch = epoch
+        self._t0 = t0
+        self._levels = levels
+        self._accounted = False
+
+    @property
+    def status(self) -> str:
+        return self.handle.status
+
+    def done(self) -> bool:
+        return self.handle.done()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self.handle.wait(timeout)
+
+    def result(self, timeout: float | None = None) -> dict:
+        """Block for the query's terminal state.  Completed → the
+        result dict (``labels``/``codes``/``confidence``/``scores``,
+        ``doublet_score`` or ``score``, trimmed to the real row
+        count, plus ``epoch``/``mode``).  Failed → re-raises the
+        run's real error; shed → raises
+        :class:`~sctools_tpu.scheduler.RunShed`."""
+        if not self.handle.wait(timeout):
+            raise TimeoutError(
+                f"query (ticket {self.handle.ticket}) not terminal "
+                f"after {timeout}s (status {self.status!r})")
+        self._service._account(self, self.handle.status)
+        out = self.handle.result()  # raises for failed/shed
+        return self._postprocess(out)
+
+    def _postprocess(self, out: CellData) -> dict:
+        n = self.n
+        res = {"kind": self.kind, "n": n,
+               "epoch": int(out.uns.get("serve_epoch", self.epoch)),
+               "mode": str(out.uns.get("serve_mode", "device"))}
+        if self.kind == "label_transfer":
+            codes = np.asarray(out.obs["serve_label_code"])[:n]
+            res["codes"] = codes
+            res["labels"] = np.asarray(self._levels)[codes]
+            res["confidence"] = np.asarray(
+                out.obs["serve_label_conf"])[:n]
+            res["scores"] = np.asarray(out.obsm["serve_scores"])[:n]
+        elif self.kind == "doublet_flag":
+            res["doublet_score"] = np.asarray(
+                out.obs["serve_doublet"])[:n]
+        else:
+            res["score"] = np.asarray(out.obs["serve_score"])[:n]
+        return res
+
+    def __repr__(self):
+        return (f"ServeTicket(kind={self.kind!r}, n={self.n}, "
+                f"epoch={self.epoch}, status={self.status!r})")
+
+
+# ---------------------------------------------------------------------------
+# The service
+# ---------------------------------------------------------------------------
+
+
+class AnnotationService:
+    """The survivable online annotation service (module docstring has
+    the full contract).
+
+    Parameters
+    ----------
+    artifact : str
+        Path of a :func:`build_reference_artifact` file.  Loads
+        VERIFIED: a corrupt current generation is quarantined (never
+        deleted, journaled ``model_quarantined``) and the ``.prev``
+        generation serves instead; with no loadable generation the
+        constructor raises.
+    name : str
+        The service's registry name — how admitted ``serve.query``
+        steps (hashable params only) find their way back here, and
+        the pattern chaos serving faults match.  Must be unique among
+        live services.
+    backend : str
+        The backend query pipelines are submitted under (and the
+        signature of the shared breaker the residency ladder feeds).
+    scheduler : RunScheduler | None
+        Admission layer to SHARE (its clock, metrics, journal, chaos
+        and breaker registry are adopted); ``None`` builds a private
+        one from the admission parameters below, shut down by
+        :meth:`close`.
+    max_concurrency, queue_high_water, tenant_max_in_flight,
+    tenant_max_queued, quotas :
+        Forwarded to the private scheduler (ignored when
+        ``scheduler=`` is given).
+    clock, metrics, journal_path, chaos, breakers, runner_defaults :
+        Plumbing for the private scheduler; the model-lifecycle
+        journal events land in the same file as the query funnel.
+    k, metric :
+        Default kNN width / distance for the projection query kinds.
+    buckets :
+        The shape-bucket ladder (:func:`bucket_rows`).
+    canary_threshold : float
+        Minimum canary label agreement a hot-swap candidate must
+        reach (:meth:`swap`); below it the swap auto-rolls-back.
+    query_deadline_s : float | None
+        Default per-query EXECUTION budget (the runner's
+        ``step_deadline_s``); admission-time queue deadlines are per
+        query via ``query(deadline_s=)``.
+    """
+
+    def __init__(self, artifact: str, *, name: str = "annot",
+                 backend: str = "tpu",
+                 scheduler: RunScheduler | None = None,
+                 max_concurrency: int = 2, queue_high_water: int = 64,
+                 tenant_max_in_flight: int = 2,
+                 tenant_max_queued: int = 8, quotas: dict | None = None,
+                 clock=None, metrics=None,
+                 journal_path: str | None = None, chaos=None,
+                 breakers=None, runner_defaults: dict | None = None,
+                 k: int = 15, metric: str = "cosine",
+                 buckets=DEFAULT_BUCKETS,
+                 canary_threshold: float = 0.9,
+                 query_deadline_s: float | None = None):
+        # reserve the name ATOMICALLY before any loading: a raced
+        # duplicate construction must fail here, not silently steal
+        # the name mid-flight
+        with _SERVICES_LOCK:
+            if name in _SERVICES:
+                raise ValueError(
+                    f"AnnotationService: a live service is already "
+                    f"named {name!r} — pick another name")
+            _SERVICES[name] = self
+        self.name = name
+        self.backend = backend
+        self.k = int(k)
+        self.metric = metric
+        self.buckets = tuple(buckets)
+        self.canary_threshold = float(canary_threshold)
+        if scheduler is not None:
+            # adopt the shared pool's plumbing wholesale: a service
+            # timing queries on a different clock than the scheduler
+            # admits them on would be incoherent
+            self._sched = scheduler
+            self._own_sched = False
+            self.clock = scheduler.clock
+            self.metrics = scheduler.metrics
+            self.chaos = scheduler.chaos
+            self._breakers = scheduler.breakers
+        else:
+            self.clock = clock if clock is not None else SYSTEM_CLOCK
+            self.metrics = (metrics if metrics is not None
+                            else telemetry.default_registry())
+            self.chaos = chaos
+            rd = dict(runner_defaults or {})
+            if query_deadline_s is not None:
+                rd.setdefault("step_deadline_s", query_deadline_s)
+            self._sched = RunScheduler(
+                max_concurrency=max_concurrency,
+                queue_high_water=queue_high_water,
+                tenant_max_in_flight=tenant_max_in_flight,
+                tenant_max_queued=tenant_max_queued, quotas=quotas,
+                clock=self.clock, metrics=self.metrics,
+                journal_path=journal_path, breakers=breakers,
+                chaos=chaos, runner_defaults=rd)
+            self._own_sched = True
+            self._breakers = self._sched.breakers
+        self.journal = self._sched.journal
+        self._breaker = self._breakers.get(backend, clock=self.clock)
+        self._state_lock = threading.Lock()
+        self._acct_lock = threading.Lock()
+        self._kernel_lock = threading.Lock()
+        self._kernels: dict = {}
+        self._outstanding: list[ServeTicket] = []
+        self._swap_lock = threading.Lock()
+        self._swap_claimed = False
+        self._closed = False
+
+        try:
+            arrays, gen = self._load_verified_arrays(artifact)
+            model = _ResidentModel(arrays, path=artifact, epoch=0,
+                                   generation=gen)
+            self._place_or_degrade(model)
+        except BaseException:
+            # a refused artifact must release the reserved name AND
+            # not leak the private pool's process-global chaos hook
+            # (RunScheduler.__init__ activated it; only shutdown
+            # releases it)
+            with _SERVICES_LOCK:
+                if _SERVICES.get(name) is self:
+                    del _SERVICES[name]
+            if self._own_sched:
+                self._sched.shutdown(wait=True)
+            raise
+        with self._state_lock:
+            self._epoch = 0
+            self._models = {0: model}
+        self.journal.write("model_loaded", epoch=0, generation=gen,
+                           version=model.version, reason="init")
+
+    # -- lifecycle -----------------------------------------------------
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def close(self, wait: bool = True) -> None:
+        """Stop admitting (private scheduler only), drain outstanding
+        tickets' accounting, and unregister the service name."""
+        self._closed = True
+        try:
+            if self._own_sched:
+                self._sched.shutdown(wait=wait)
+            self.drain(timeout=None if wait else 0.0)
+        finally:
+            with _SERVICES_LOCK:
+                if _SERVICES.get(self.name) is self:
+                    del _SERVICES[self.name]
+
+    def drain(self, timeout: float | None = None) -> None:
+        """Account every outstanding ticket that is (or becomes,
+        within ``timeout``) terminal — the sweep that keeps
+        ``serve.queries{outcome=}`` complete even for callers that
+        never touched their tickets.  Loops until the outstanding
+        list is empty (a query racing :meth:`close` past the closed
+        check is swept too) or a ticket stays non-terminal past
+        ``timeout``."""
+        while True:
+            with self._acct_lock:
+                pending = list(self._outstanding)
+            if not pending:
+                return
+            leftover = 0
+            for t in pending:
+                t.wait(timeout)
+                if t.done():
+                    self._account(t, t.handle.status)
+                else:
+                    leftover += 1
+            if leftover:
+                return  # timed out on these; a later drain can finish
+
+    # -- introspection -------------------------------------------------
+    @property
+    def epoch(self) -> int:
+        with self._state_lock:
+            return self._epoch
+
+    @property
+    def model_version(self) -> str:
+        with self._state_lock:
+            return self._models[self._epoch].version
+
+    def scvi_params(self):
+        """The serving model's embedded scvi parameters (or None)."""
+        with self._state_lock:
+            model = self._models[self._epoch]
+        return model.scvi_params()
+
+    def stats(self) -> dict:
+        with self._state_lock:
+            out = {"epoch": self._epoch,
+                   "version": self._models[self._epoch].version,
+                   "resident": self._models[self._epoch].resident(),
+                   "epochs_live": sorted(self._models)}
+        with self._acct_lock:
+            out["outstanding"] = len(self._outstanding)
+        # scheduler stats take its own locks (and breaker snapshots):
+        # composed OUTSIDE ours
+        out["scheduler"] = self._sched.stats()
+        return out
+
+    # -- admission -----------------------------------------------------
+    def query(self, X, kind: str = "label_transfer", *,
+              tenant: str = "default", priority: int = 0,
+              deadline_s: float | None = None, k: int | None = None,
+              score_set: str | None = None) -> ServeTicket:
+        """Admit one query batch (or refuse it — the scheduler's
+        :class:`~sctools_tpu.scheduler.RunRejected`, counted
+        ``outcome=rejected``).  ``X`` is raw counts — CellData, numpy,
+        scipy or a device array — with the model's gene space; it is
+        zero-padded to the shape bucket and submitted as one
+        ``serve.query`` step pinned to the CURRENT epoch.  Returns a
+        :class:`ServeTicket` immediately."""
+        if self._closed:
+            raise RuntimeError(
+                f"AnnotationService {self.name!r} is closed — a "
+                f"query would be admitted by the (shared) scheduler "
+                f"only to fail at dispatch")
+        # opportunistic sweep of already-terminal tickets: fire-and-
+        # forget callers (never touching their tickets) must not grow
+        # _outstanding — and pin every result payload — unboundedly
+        # until close(); done() is one Event check, no blocking
+        with self._acct_lock:
+            done_now = [t for t in self._outstanding
+                        if t.handle.done()]
+        for t in done_now:
+            self._account(t, t.handle.status)
+        if kind not in QUERY_KINDS:
+            raise ValueError(
+                f"query kind {kind!r}: use one of {QUERY_KINDS}")
+        with self._state_lock:
+            epoch = self._epoch
+            model = self._models[epoch]
+        if kind == "marker_score":
+            if not score_set:
+                raise ValueError(
+                    "marker_score queries need score_set= (one of "
+                    f"{model.score_sets})")
+            if score_set not in model.score_sets:
+                raise ValueError(
+                    f"unknown score_set {score_set!r}; the serving "
+                    f"model carries {model.score_sets}")
+        Xq, n = self._as_query_matrix(X, model)
+        bucket = bucket_rows(n, self.buckets)
+        Xp = np.zeros((bucket, Xq.shape[1]), np.float32)
+        Xp[:n] = Xq
+        data = CellData(Xp,
+                        obs={"serve_valid": np.arange(bucket) < n})
+        pipe = Pipeline([Transform(
+            "serve.query", backend=self.backend, service=self.name,
+            kind=kind, epoch=epoch,
+            k=int(k if k is not None else self.k),
+            metric=self.metric, score_set=score_set or "")])
+        t0 = self.clock.monotonic()
+        try:
+            handle = self._sched.submit(
+                pipe, data, tenant=tenant, priority=priority,
+                deadline_s=deadline_s, backend=self.backend)
+        except RunRejected:
+            self.metrics.counter("serve.queries",
+                                 outcome="rejected").inc()
+            raise
+        ticket = ServeTicket(self, handle, n=n, kind=kind,
+                             epoch=epoch, t0=t0, levels=model.levels)
+        with self._acct_lock:
+            self._outstanding.append(ticket)
+        return ticket
+
+    def _account(self, ticket: ServeTicket, outcome: str) -> None:
+        with self._acct_lock:
+            if ticket._accounted:
+                return
+            ticket._accounted = True
+            if ticket in self._outstanding:
+                self._outstanding.remove(ticket)
+        self.metrics.counter("serve.queries", outcome=outcome).inc()
+        if outcome == "completed":
+            # the handle's own terminal stamp (scheduler clock — the
+            # same clock, adopted), NOT the collection time: a caller
+            # sitting on a finished ticket must not inflate the
+            # latency histogram with its idle wall
+            t1 = (ticket.handle.finished_at
+                  if ticket.handle.finished_at is not None
+                  else self.clock.monotonic())
+            self.metrics.histogram("serve.latency_s").observe(
+                t1 - ticket._t0)
+
+    def _as_query_matrix(self, X, model: _ResidentModel):
+        import scipy.sparse as sp
+
+        n_trim = None
+        if isinstance(X, CellData):
+            n_trim = X.n_cells
+            if (model.gene_names is not None
+                    and "gene_name" in X.var):
+                qn = np.asarray(X.var["gene_name"]).astype(str)
+                if qn.shape == model.gene_names.shape \
+                        and not (qn == model.gene_names).all():
+                    bad = int(np.argmin(qn == model.gene_names))
+                    raise ValueError(
+                        "query/reference gene names differ (first "
+                        f"mismatch at {bad}) — align var spaces "
+                        "first (integrate.ingest's contract)")
+            X = X.X
+        if hasattr(X, "to_scipy_csr"):
+            X = X.to_scipy_csr()
+        if sp.issparse(X):
+            Xq = np.asarray(X.todense(), np.float32)
+        else:
+            Xq = np.asarray(X, np.float32)
+        if Xq.ndim == 1:
+            Xq = Xq[None, :]
+        if n_trim is not None:
+            Xq = Xq[:n_trim]
+        if Xq.shape[1] != model.n_genes:
+            raise ValueError(
+                f"query has {Xq.shape[1]} genes but the serving "
+                f"model was built over {model.n_genes} — queries "
+                f"must share the reference's gene space")
+        if Xq.shape[0] < 1:
+            raise ValueError("empty query batch")
+        return Xq, int(Xq.shape[0])
+
+    # -- verified artifact loads ---------------------------------------
+    def _load_verified_arrays(self, path: str):
+        """Newest loadable artifact generation, VERIFIED: current,
+        then ``.prev``.  A generation that fails the digest/
+        fingerprint verify is quarantined (never deleted) with a
+        journaled ``model_quarantined`` and the next one is tried.
+        Deliberately a local twin of
+        ``checkpoint.load_npz_generations`` rather than a call to it:
+        serving additionally REQUIRES integrity keys, must journal
+        the quarantine into the service's funnel, reports WHICH
+        generation served (the swap/rollback evidence), and raises —
+        not ``None`` — when nothing loads."""
+        last_reason = "no artifact file"
+        for cand, gen in ((path, "current"), (path + ".prev", "prev")):
+            if not os.path.exists(cand):
+                continue
+            try:
+                arrays = load_npz_verified(
+                    cand, expect_fingerprint=SERVING_MODEL_FP,
+                    require_digest=True)
+                return arrays, gen
+            except CheckpointCorruptError as e:
+                last_reason = e.reason
+                qpath = quarantine_checkpoint(cand, e.reason)
+                warnings.warn(
+                    f"AnnotationService: artifact generation "
+                    f"{cand!r} failed verification ({e.reason}) — "
+                    f"QUARANTINED to {qpath!r}, trying the previous "
+                    f"generation", RuntimeWarning, stacklevel=3)
+                self.journal.write("model_quarantined", path=qpath,
+                                   reason=e.reason, generation=gen)
+        raise CheckpointCorruptError(
+            path, f"no loadable artifact generation ({last_reason})")
+
+    def _place_or_degrade(self, model: _ResidentModel) -> None:
+        """Initial placement: a transiently-dead device must not kill
+        the constructor — the model stays host-resident (the cpu
+        rung) and the ladder re-places on a later query."""
+        try:
+            model.place()
+        except Exception as e:  # noqa: BLE001 — classified below
+            if classify_error(e) != TRANSIENT:
+                raise
+            self._breaker.record_failure()
+            warnings.warn(
+                f"AnnotationService: device placement failed "
+                f"transiently ({type(e).__name__}: {e}) — serving "
+                f"from host arrays until the ladder re-places.",
+                RuntimeWarning, stacklevel=3)
+
+    # -- the hot-swap --------------------------------------------------
+    def try_acquire_swap(self) -> bool:
+        """Claim the EXCLUSIVE swap slot (one model swap in flight at
+        a time; a second concurrent :meth:`swap` is refused rather
+        than queued).  True for exactly one caller until
+        :meth:`release_swap`; the pairing is machine-checked (sctlint
+        SCT010 tracks this claim like the breaker probe slot)."""
+        with self._swap_lock:
+            if self._swap_claimed:
+                return False
+            self._swap_claimed = True
+            return True
+
+    def release_swap(self) -> None:
+        with self._swap_lock:
+            self._swap_claimed = False
+
+    def swap(self, artifact: str) -> bool:
+        """Epoch-guarded hot-swap to a new artifact under live
+        traffic.
+
+        The candidate loads VERIFIED (corrupt → quarantine + its own
+        ``.prev``; nothing loadable → rolled back), is placed BESIDE
+        the serving model, and must re-derive its own canary labels
+        (agreement >= ``canary_threshold`` — the canary ran through
+        the same bucketized plan path real queries use, which also
+        pre-warms the plan cache for the new epoch).  Only then does
+        the serving epoch flip; queries admitted before the flip
+        complete on the model they were admitted under (the previous
+        epoch stays resident until the NEXT swap).  Returns True
+        (journal ``model_swapped``) or False on auto-rollback
+        (journal ``swap_rolled_back``; the old epoch keeps serving).
+        """
+        if self._closed:
+            raise RuntimeError(
+                f"AnnotationService {self.name!r} is closed")
+        if not self.try_acquire_swap():
+            raise RuntimeError(
+                "AnnotationService.swap: another swap is in flight")
+        try:
+            try:
+                arrays, gen = self._load_verified_arrays(artifact)
+                cand = _ResidentModel(arrays, path=artifact,
+                                      epoch=-1, generation=gen)
+            except (CheckpointCorruptError, ValueError) as e:
+                self.journal.write(
+                    "swap_rolled_back", reason="artifact_corrupt",
+                    error=str(e), epoch=self.epoch)
+                self.metrics.counter("serve.rollbacks").inc()
+                warnings.warn(
+                    f"AnnotationService.swap: candidate artifact "
+                    f"refused ({e}) — ROLLED BACK, the serving epoch "
+                    f"is unchanged.", RuntimeWarning, stacklevel=2)
+                return False
+            try:
+                cand.place()
+            except Exception as e:  # noqa: BLE001 — a device refusing
+                # the candidate's placement (flaky/evicted — the very
+                # regime operators swap in) is a ROLLBACK, not an
+                # unjournaled raise; the old epoch keeps serving and
+                # its own ladder handles the device
+                if classify_error(e) == TRANSIENT:
+                    self._breaker.record_failure()
+                self.journal.write(
+                    "swap_rolled_back", reason="placement_failed",
+                    error=f"{type(e).__name__}: {e}",
+                    epoch=self.epoch)
+                self.metrics.counter("serve.rollbacks").inc()
+                warnings.warn(
+                    f"AnnotationService.swap: candidate placement "
+                    f"failed ({type(e).__name__}: {e}) — ROLLED "
+                    f"BACK, the serving epoch is unchanged.",
+                    RuntimeWarning, stacklevel=2)
+                return False
+            try:
+                agreement = self._canary_agreement(cand)
+            except Exception as e:  # noqa: BLE001 — a canary that
+                # cannot even EXECUTE (candidate buffers evicted
+                # between place and validate, a kernel raise) refuses
+                # the candidate like a disagreement would: journaled
+                # rollback, old epoch keeps serving
+                if classify_error(e) == TRANSIENT:
+                    self._breaker.record_failure()
+                self.journal.write(
+                    "swap_rolled_back", reason="canary_failed",
+                    error=f"{type(e).__name__}: {e}",
+                    epoch=self.epoch)
+                self.metrics.counter("serve.rollbacks").inc()
+                warnings.warn(
+                    f"AnnotationService.swap: canary validation "
+                    f"raised ({type(e).__name__}: {e}) — ROLLED "
+                    f"BACK, the serving epoch is unchanged.",
+                    RuntimeWarning, stacklevel=2)
+                return False
+            if agreement < self.canary_threshold:
+                self.journal.write(
+                    "swap_rolled_back", reason="canary_disagreement",
+                    agreement=round(agreement, 4),
+                    candidate_version=cand.version, epoch=self.epoch)
+                self.metrics.counter("serve.rollbacks").inc()
+                warnings.warn(
+                    f"AnnotationService.swap: candidate "
+                    f"{cand.version!r} re-derived only "
+                    f"{agreement:.1%} of its own canary labels "
+                    f"(threshold {self.canary_threshold:.1%}) — "
+                    f"ROLLED BACK.", RuntimeWarning, stacklevel=2)
+                return False
+            with self._state_lock:
+                self._epoch += 1
+                cand.epoch = self._epoch
+                self._models[self._epoch] = cand
+                # keep exactly current + previous: in-flight queries
+                # are pinned to the epoch they were admitted under,
+                # and anything older has no admitted queries left by
+                # the time a SECOND swap lands (swaps are operator
+                # actions, not traffic)
+                for e in [e for e in self._models
+                          if e < self._epoch - 1]:
+                    del self._models[e]
+            self.journal.write("model_swapped", epoch=cand.epoch,
+                               version=cand.version, generation=gen,
+                               agreement=round(agreement, 4))
+            self.metrics.counter("serve.swaps").inc()
+            return True
+        finally:
+            self.release_swap()
+
+    def _canary_agreement(self, model: _ResidentModel) -> float:
+        """Label-transfer the model's own canary cells through the
+        bucketized plan path and score agreement with the recorded
+        codes.  Reference cells re-queried against their own model
+        land on themselves (distance ~0 dominates the vote), so a
+        healthy model scores ~1.0; garbage loadings or cross-wired
+        state cannot."""
+        host = model.host_arrays()
+        cx = np.asarray(host["canary_x"], np.float32)
+        bucket = bucket_rows(cx.shape[0], self.buckets)
+        Xp = np.zeros((bucket, cx.shape[1]), np.float32)
+        Xp[: cx.shape[0]] = cx
+        data = CellData(Xp, obs={"serve_valid":
+                                 np.arange(bucket) < cx.shape[0]})
+        out = self._run_plan(data, model, "label_transfer", self.k,
+                             self.metric, None)
+        pred = np.asarray(out.obs["serve_label_code"])[: cx.shape[0]]
+        return float(np.mean(pred == np.asarray(host["canary_codes"])))
+
+    # -- query execution (scheduler worker side) ------------------------
+    def _model_for(self, epoch: int) -> _ResidentModel:
+        with self._state_lock:
+            model = self._models.get(epoch)
+            current = self._epoch
+        if model is None:
+            raise RuntimeError(
+                f"serve.query: epoch {epoch} has been retired "
+                f"(serving epoch {current}) — the query outlived two "
+                f"hot-swaps; resubmit")
+        return model
+
+    def _execute_query(self, data: CellData, kind: str, epoch: int,
+                       k: int, metric: str,
+                       score_set: str | None) -> CellData:
+        model = self._model_for(epoch)
+        if self.chaos is not None:
+            ruling = self.chaos.on_serving(self.name, path=model.path,
+                                           backend=self.backend)
+            if ruling is not None:
+                self._apply_chaos_ruling(ruling, model)
+        mode = self._ensure_state(model)
+        if mode == "device":
+            out = self._run_plan(data, model, kind, k, metric,
+                                 score_set)
+        else:
+            out = self._run_host_query(data, model, kind, k, metric,
+                                       score_set)
+        return out.with_uns(serve_epoch=np.int64(epoch),
+                            serve_mode=np.array(mode))
+
+    def _apply_chaos_ruling(self, ruling: dict,
+                            model: _ResidentModel) -> None:
+        mode = ruling.get("mode")
+        if mode == "evict_state":
+            model.evict()
+        elif mode == "corrupt_model":
+            # the monkey already damaged the artifact bytes; dropping
+            # BOTH residency tiers forces the ladder all the way to
+            # the verified reload, where the damage is caught
+            model.evict()
+            model.drop_host()
+
+    def _ensure_state(self, model: _ResidentModel) -> str:
+        """The residency ladder (module docstring): returns
+        ``"device"`` or ``"host"`` — the mode this query executes in.
+        Raises when no rung can produce servable state (classified by
+        the runner like any other step failure)."""
+        if not self._breaker.allow():
+            # breaker OPEN (this service's or any pool sharer's trip):
+            # no placement storm — serve from host arrays outright
+            if model.has_host():
+                self.metrics.counter("serve.state_reloads",
+                                     reason="breaker_open").inc()
+                return "host"
+        if model.resident():
+            return "device"
+        if model.has_host():
+            # rung 2: re-place the evicted device state from the host
+            # mirror
+            try:
+                model.place()
+                self.metrics.counter("serve.state_reloads",
+                                     reason="replace").inc()
+                return "device"
+            except Exception as e:  # noqa: BLE001 — classified below
+                if classify_error(e) != TRANSIENT:
+                    raise
+                self._breaker.record_failure()
+                self.metrics.counter("serve.state_reloads",
+                                     reason="cpu").inc()
+                return "host"
+        # rung 3: the host mirror is gone too — verified reload from
+        # the artifact (corrupt generation → quarantine + .prev,
+        # journaled by _load_verified_arrays)
+        arrays, gen = self._load_verified_arrays(model.path)
+        model._rehost(arrays)
+        self.journal.write("model_loaded", epoch=model.epoch,
+                           generation=gen, version=model.version,
+                           reason="reload")
+        self.metrics.counter("serve.state_reloads",
+                             reason="artifact").inc()
+        if not self._breaker.allow():
+            # the reload rebuilt the host mirror, but the breaker is
+            # (still) OPEN: no per-query placement storm against a
+            # suspect device — serve host until a sharer's probe
+            # closes it
+            self.metrics.counter("serve.state_reloads",
+                                 reason="breaker_open").inc()
+            return "host"
+        try:
+            model.place()
+            return "device"
+        except Exception as e:  # noqa: BLE001 — classified below
+            if classify_error(e) != TRANSIENT:
+                raise
+            # rung 4: the device itself is refusing placement — feed
+            # the shared breaker and serve from the fresh host mirror
+            self._breaker.record_failure()
+            self.metrics.counter("serve.state_reloads",
+                                 reason="cpu").inc()
+            return "host"
+
+    def _kernel_for(self, model: _ResidentModel, kind: str, k: int,
+                    metric: str) -> FusedTransform:
+        m = model.meta
+        key = (self.backend, kind, int(k), metric, m["n_levels"],
+               m["target_sum"], m["log1p"], m["sim_ratio"],
+               m["expected_rate"])
+        with self._kernel_lock:
+            ft = self._kernels.get(key)
+            if ft is None:
+                ft = FusedTransform(
+                    [Transform("serve.kernel", backend=self.backend,
+                               kind=kind, k=int(k), metric=metric,
+                               n_levels=m["n_levels"],
+                               target_sum=m["target_sum"],
+                               log1p=m["log1p"],
+                               sim_ratio=m["sim_ratio"],
+                               expected_rate=m["expected_rate"])],
+                    self.backend, metrics=self.metrics)
+                self._kernels[key] = ft
+        return ft
+
+    def _run_plan(self, data: CellData, model: _ResidentModel,
+                  kind: str, k: int, metric: str,
+                  score_set: str | None) -> CellData:
+        """Execute the pure kernel as a fused plan: model arrays ride
+        as INPUT leaves (``uns``), so every same-shaped execution —
+        across queries, evictions, re-places and same-shaped swaps —
+        is a plan-cache hit (``plan.cache_hits``)."""
+        dev = model.device_arrays()
+        uns: dict = {}
+        if kind == "marker_score":
+            uns["serve_weights"] = dev[f"score/{score_set}"]
+        else:
+            uns["serve_pcs"] = dev["PCs"]
+            uns["serve_mu"] = dev["pca_mean"]
+            uns["serve_ref"] = dev["ref_scores"]
+            if kind == "label_transfer":
+                uns["serve_codes"] = dev["label_codes"]
+            else:
+                uns["serve_sim"] = dev["sim_scores"]
+        payload = CellData(data.X, obs=dict(data.obs), uns=uns)
+        return self._kernel_for(model, kind, k, metric)(payload)
+
+    def _run_host_query(self, data: CellData, model: _ResidentModel,
+                        kind: str, k: int, metric: str,
+                        score_set: str | None) -> CellData:
+        """The cpu rung: the numpy twin over the host mirror (results
+        match the device path to f32 tolerance; tests pin it)."""
+        host = dict(model.host_arrays())
+        if kind == "marker_score":
+            host["serve_weights"] = host[f"score/{score_set}"]
+        res = annotate_host(host, np.asarray(data.X, np.float32),
+                            kind, k=k, metric=metric)
+        obs = dict(data.obs)
+        obsm = {}
+        if kind == "label_transfer":
+            obs["serve_label_code"] = res["codes"]
+            obs["serve_label_conf"] = res["confidence"]
+            obsm["serve_scores"] = res["scores"]
+        elif kind == "doublet_flag":
+            obs["serve_doublet"] = res["doublet_score"]
+            obsm["serve_scores"] = res["scores"]
+        else:
+            obs["serve_score"] = res["score"]
+        return CellData(data.X, obs=obs, obsm=obsm)
+
+    def __repr__(self):
+        return (f"AnnotationService({self.name!r}, epoch={self.epoch},"
+                f" backend={self.backend!r})")

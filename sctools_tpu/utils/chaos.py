@@ -37,7 +37,8 @@ from .vclock import SYSTEM_CLOCK
 MODES = ("unavailable", "hang", "wedge", "corrupt",
          "corrupt_checkpoint", "crash", "kill", "reject_storm",
          "slow_read", "truncate_shard", "io_error",
-         "kill_worker", "lease_wedge", "preempt")
+         "kill_worker", "lease_wedge", "preempt",
+         "evict_state", "corrupt_model")
 
 # which hook channel each mode fires on: most modes wrap the op CALL;
 # corrupt_checkpoint fires through the runner's on_checkpoint hook,
@@ -50,13 +51,18 @@ MODES = ("unavailable", "hang", "wedge", "corrupt",
 # pattern matches WORKER names like "w0") AND by the run scheduler's
 # preemption probe per SHARD BOUNDARY of a preemptible job (preempt,
 # pattern matches the submission's TENANT name; ``on_call=N`` = the
-# Nth boundary poll)
+# Nth boundary poll), and the SERVING-channel modes through
+# on_serving — consulted by the annotation service once per QUERY
+# EXECUTION (evict_state / corrupt_model, pattern matches the SERVICE
+# name; ``on_call=N`` = the Nth query executed against the resident
+# model)
 _MODE_CHANNEL = {"corrupt_checkpoint": "checkpoint",
                  "reject_storm": "admission",
                  "slow_read": "io", "truncate_shard": "io",
                  "io_error": "io",
                  "kill_worker": "worker", "lease_wedge": "worker",
-                 "preempt": "worker"}
+                 "preempt": "worker",
+                 "evict_state": "serving", "corrupt_model": "serving"}
 
 
 class ChaosCrash(BaseException):
@@ -181,6 +187,21 @@ class ChaosMonkey:
       cursor checkpoint and raises ``JobPreempted``, the scheduler
       requeues the ticket — so the whole preempt → requeue → resume
       ladder runs on one VirtualClock with zero real sleeps.
+    * ``evict_state`` / ``corrupt_model`` — the SERVING channel
+      (:meth:`on_serving`, consulted by the annotation service
+      (``sctools_tpu/serving.py``) once per query execution; the
+      fault's ``op`` pattern matches the SERVICE name, counted per
+      service under ``"<service>@serving"``).  ``evict_state`` only
+      RULES — the service owns the resident buffers, so it implements
+      the semantics (delete the device-resident reference-model
+      arrays, the HBM-eviction / device-restart failure the residency
+      ladder's re-place rung exists for).  ``corrupt_model`` damages
+      the model ARTIFACT on disk here (XOR byte flips, like
+      ``corrupt_checkpoint`` — the monkey owns file damage) and the
+      service additionally drops its in-memory state, so the ladder's
+      reload-from-artifact rung meets the corrupt file and the digest
+      verify quarantines it + falls back to the ``.prev``
+      generation.
     * ``slow_read`` / ``truncate_shard`` / ``io_error`` — the IO
       channel (:meth:`on_io`, consulted by the shard-read scheduler
       for every chunk read; the fault's ``op`` pattern matches CHUNK
@@ -197,7 +218,8 @@ class ChaosMonkey:
 
     ``calls`` counts invocations per op name (checkpoint saves count
     separately under ``"<op>@checkpoint"``, admission consults under
-    ``"<tenant>@admission"``); ``injected`` logs every
+    ``"<tenant>@admission"``, serving consults under
+    ``"<service>@serving"``); ``injected`` logs every
     firing as ``{"op", "call", "mode", "backend"}`` — two monkeys with
     equal faults/seed driving the same workload produce identical
     logs (the determinism contract tier-1 pins).
@@ -300,6 +322,46 @@ class ChaosMonkey:
                 return None
             self.injected.append({"op": name, "call": call_no,
                                   "mode": f.mode, "backend": backend})
+        return {"mode": f.mode}
+
+    def on_serving(self, name: str, path: str | None = None,
+                   backend: str | None = None) -> dict | None:
+        """Annotation-service hook, consulted once per query executed
+        against the resident reference model: returns ``None``
+        (healthy) or ``{"mode": "evict_state" | "corrupt_model"}``
+        for a firing serving fault.  On this channel the fault's
+        ``op`` pattern matches the SERVICE name; call counting is per
+        service under ``"<service>@serving"``, so ``on_call``/
+        ``times`` windows count query executions.  ``corrupt_model``
+        damages the artifact file at ``path`` HERE (XOR byte flips,
+        deterministic from the seed — the monkey owns file damage,
+        like ``corrupt_checkpoint``); ``evict_state`` only rules —
+        the service owns the resident buffers and implements the
+        eviction."""
+        key = f"{name}@serving"
+        with self._lock:
+            call_no = self.calls.get(key, 0) + 1
+            self.calls[key] = call_no
+            f = self._firing(name, backend, call_no,
+                             channel="serving")
+            if f is None:
+                return None
+            self.injected.append({"op": name, "call": call_no,
+                                  "mode": f.mode, "backend": backend})
+        if f.mode == "corrupt_model" and path is not None \
+                and os.path.exists(path):
+            rng = random.Random((self.seed, name, call_no,
+                                 "model").__repr__())
+            try:
+                with open(path, "r+b") as fh:
+                    blob = bytearray(fh.read())
+                    if blob:
+                        for _ in range(min(16, len(blob))):
+                            blob[rng.randrange(len(blob))] ^= 0xFF
+                        fh.seek(0)
+                        fh.write(blob)
+            except OSError:
+                pass  # file already quarantined/moved: the ruling stands
         return {"mode": f.mode}
 
     def on_io(self, name: str, path: str | None = None,

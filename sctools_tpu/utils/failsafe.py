@@ -144,6 +144,13 @@ _TRANSIENT_MARKERS = (
     # flaky disk/NFS read raises OSError(EIO, "Input/output error") —
     # worth retrying, unlike ENOENT/ENOSPC which recur identically
     "input/output error",
+    # a compute that raced a buffer eviction ("Array has been
+    # deleted"): the serving tier's resident reference-model state can
+    # be evicted out from under an in-flight query (device restart,
+    # chaos evict_state) — the retried attempt re-enters the residency
+    # ladder, re-places the state and succeeds, so failing fast here
+    # would turn a survivable eviction into a lost query
+    "been deleted",
 )
 
 _TRANSIENT_TYPES = (TransientDeviceError, TimeoutError, ConnectionError,

@@ -99,6 +99,19 @@ EVENTS = frozenset({
     # train_resume a restart from a verified cursor checkpoint
     "preempted", "train_shard", "train_epoch", "train_checkpoint",
     "train_resume",
+    # resident-state serving (sctools_tpu/serving.py): the reference-
+    # model lifecycle.  model_loaded = a verified artifact generation
+    # became the resident model (initial load, reload after state
+    # loss, or the .prev fallback after a quarantine);
+    # model_quarantined = an artifact generation failed its digest/
+    # fingerprint verification and was moved — never deleted — to
+    # quarantine/ with a .reason.json sidecar; model_swapped = a
+    # canary-validated hot-swap flipped the serving epoch (in-flight
+    # queries complete on the epoch they were admitted under);
+    # swap_rolled_back = a candidate model was refused (corrupt
+    # artifact or canary disagreement) and the old epoch kept serving
+    "model_loaded", "model_quarantined", "model_swapped",
+    "swap_rolled_back",
 })
 
 #: Every legal metric name → one-line meaning (the docs table).  Like
@@ -243,6 +256,25 @@ METRICS = {
     "train.loss": "gauge: mean negative ELBO of the last completed "
                   "epoch (labels epoch=) — the loss trajectory "
                   "sctreport renders",
+    "serve.queries": "counter: annotation-service queries by terminal "
+                     "state (labels outcome= completed|failed|"
+                     "rejected|shed) — every query lands in exactly "
+                     "one outcome",
+    "serve.latency_s": "histogram: completed-query wall seconds from "
+                       "admission to terminal (on the injectable "
+                       "clock)",
+    "serve.swaps": "counter: canary-validated hot-swaps that flipped "
+                   "the serving epoch",
+    "serve.rollbacks": "counter: refused model swaps (corrupt "
+                       "candidate artifact or canary disagreement) — "
+                       "the old epoch kept serving",
+    "serve.state_reloads": "counter: residency-ladder rungs taken for "
+                           "resident reference-model state (labels "
+                           "reason= replace|artifact|breaker_open|"
+                           "cpu) — replace = re-place evicted device "
+                           "buffers from the host mirror, artifact = "
+                           "verified reload from disk, breaker_open/"
+                           "cpu = queries served from host arrays",
 }
 
 #: Per-module journal PROTOCOLS — which EVENTS members a module may
@@ -299,6 +331,18 @@ JOURNAL_PROTOCOLS = {
     "shardstore": {
         "events": ["shard_quarantined"],
         "terminal": ["shard_quarantined"],
+    },
+    # resident-state serving journals the MODEL lifecycle only; the
+    # per-query funnel (submitted -> admitted|rejected -> shed|
+    # run_completed|run_failed) is emitted by the scheduler the
+    # service admits through, into the same journal file.  No
+    # terminal: the model lifecycle is a ladder, not a ticket funnel
+    # (the queries' terminal-exactly-once contract lives in the
+    # scheduler's table).
+    "serving": {
+        "events": ["model_loaded", "model_quarantined",
+                   "model_swapped", "swap_rolled_back"],
+        "terminal": [],
     },
 }
 

@@ -236,3 +236,34 @@ def test_train_overlap_and_parity_gate():
     assert det["final_loss_rel_diff"] <= 0.05, det
     assert det["stream_loss_final"] < det["stream_loss_first"], det
     assert det["inram_loss_final"] < det["inram_loss_first"], det
+
+
+def test_serve_latency_retrace_and_agreement_gate():
+    """The resident-state serving acceptance gate (ISSUE 14): a
+    sustained randomly-sized query stream against the resident
+    reference model must (a) keep p99 admission->result latency
+    under the bound (default 250 ms on this 2-core box — measured
+    ~2.5 ms, the bound is headroom for CI neighbours; env
+    SCTOOLS_BENCH_SERVE_P99_MS overrides), (b) add ZERO plan-cache
+    retraces after warmup — INCLUDING across the mid-stream
+    hot-swap, because the model arrays enter the compiled kernels as
+    inputs, not baked constants — and (c) agree with the batch
+    pipeline (integrate.ingest, cpu oracle) on >= 0.99 of a held-out
+    query batch's labels.  One re-measure is allowed before failing:
+    this box has 2 cores and CI neighbours."""
+    import jax
+
+    from tools.bench_serve import run_serve_bench
+
+    p99_bound = float(os.environ.get("SCTOOLS_BENCH_SERVE_P99_MS",
+                                     250.0))
+    det = run_serve_bench(jax)
+    if det["latency_p99_ms"] > p99_bound:  # pragma: no cover - noisy
+        det = run_serve_bench(jax)
+    # the stream really ran, every query completed, the swap flipped
+    assert det["completed"] >= det["n_queries"], det
+    assert det["swap_epoch"] == 1, det
+    assert det["latency_p99_ms"] <= p99_bound, det
+    assert det["retraces_after_warmup"] == 0.0, det
+    assert det["plan_hits"] >= det["n_queries"], det
+    assert det["batch_agreement"] >= 0.99, det

@@ -506,3 +506,70 @@ def test_worker_mode_pattern_scopes_to_worker_names():
     assert monkey.on_worker("w0#1") is None
     wide = ChaosMonkey([Fault("w0*", "kill_worker", times=-1)])
     assert wide.on_worker("w0#1") == {"mode": "kill_worker"}
+
+
+def test_on_serving_fires_only_on_serving_channel():
+    """evict_state/corrupt_model rule through on_serving (pattern
+    matches SERVICE names, counted per service under
+    "<service>@serving"); they never fire on op calls, and op-channel
+    modes never fire on on_serving — channels are disjoint."""
+    monkey = ChaosMonkey([
+        Fault("svc*", "evict_state", times=-1),
+        Fault("svc*", "unavailable", times=-1),  # op channel only
+    ])
+    rule = monkey.on_serving("svc-a")
+    assert rule == {"mode": "evict_state"}
+    assert monkey.calls["svc-a@serving"] == 1
+    assert monkey.injected[-1] == {"op": "svc-a", "call": 1,
+                                   "mode": "evict_state",
+                                   "backend": None}
+    # the serving-mode fault must not leak onto the op-call channel
+    assert monkey._firing("svc-a", None, 1, channel="call").mode \
+        == "unavailable"
+    assert monkey._firing("svc-a", None, 1, channel="io") is None
+
+
+def test_on_serving_call_windows_per_service():
+    monkey = ChaosMonkey([Fault("svc", "evict_state", on_call=2,
+                                times=1)])
+    assert monkey.on_serving("svc") is None          # execution 1
+    assert monkey.on_serving("other") is None        # other service
+    assert monkey.on_serving("svc")["mode"] == "evict_state"
+    assert monkey.on_serving("svc") is None          # window closed
+
+
+def test_on_serving_corrupt_model_damages_artifact(tmp_path):
+    """corrupt_model damages the artifact bytes in place (never
+    deletes) — the integrity verify on the service's next reload is
+    what catches it; a missing file never crashes the hook."""
+    p = str(tmp_path / "model.npz")
+    payload = bytes(range(256)) * 8
+    with open(p, "wb") as f:
+        f.write(payload)
+    monkey = ChaosMonkey([Fault("svc", "corrupt_model")], seed=3)
+    assert monkey.on_serving("svc", path=p) == {"mode":
+                                                "corrupt_model"}
+    with open(p, "rb") as f:
+        damaged = f.read()
+    assert len(damaged) == len(payload) and damaged != payload
+    # deterministic damage: a clone with the same seed flips the
+    # same bytes
+    with open(p, "wb") as f:
+        f.write(payload)
+    ChaosMonkey([Fault("svc", "corrupt_model")], seed=3) \
+        .on_serving("svc", path=p)
+    with open(p, "rb") as f:
+        assert f.read() == damaged
+    gone = ChaosMonkey([Fault("svc", "corrupt_model")])
+    assert gone.on_serving("svc",
+                           path=str(tmp_path / "gone.npz")) is not None
+
+
+def test_serving_spec_round_trip_carries_serving_counts():
+    monkey = ChaosMonkey([Fault("svc", "evict_state", on_call=2,
+                                times=1)], seed=5)
+    assert monkey.on_serving("svc") is None          # execution 1
+    clone = ChaosMonkey.from_spec(monkey.spec())
+    assert clone.calls["svc@serving"] == 1
+    assert clone.on_serving("svc") == {"mode": "evict_state"}
+    assert clone.on_serving("svc") is None           # window closed

@@ -849,3 +849,64 @@ def test_flow_rules_clean_on_production_modules():
     assert r.ok, [v.format() for v in r.violations]
     # the deliberate exceptions are visible as suppressions, not holes
     assert len(r.suppressed) >= 4
+
+
+# ---------------------------------------------------------------------------
+# SCT010 — the serving hot-swap claim (the swap-epoch claim/release
+# pairing: an AnnotationService.swap() that leaks its exclusive slot
+# wedges every future model upgrade until process restart)
+# ---------------------------------------------------------------------------
+
+def test_sct010_swap_claim_leaks_on_raising_canary(tmp_path):
+    """The defect shape serving.swap() must never regress to: swap
+    slot claimed, then the candidate load / canary validation between
+    claim and verdict raises — release_swap only on the happy path."""
+    r = lint_src(tmp_path, """
+        def swap(self, artifact):
+            if self.try_acquire_swap():
+                cand = self._load_model(artifact)
+                agreement = self._canary_agreement(cand)
+                if agreement >= self.canary_threshold:
+                    self._flip_epoch(cand)
+                self.release_swap()
+        """, only=["SCT010"])
+    assert rule_ids(r) == ["SCT010"]
+    assert "swap claim" in r.violations[0].message
+    assert "raising path" in r.violations[0].message
+
+
+def test_sct010_swap_claim_early_return_leaks(tmp_path):
+    """A rollback path that returns before releasing leaks the claim
+    on the fall-through edge too."""
+    r = lint_src(tmp_path, """
+        def swap(self, artifact):
+            if not self.try_acquire_swap():
+                raise RuntimeError("swap in flight")
+            cand = self._load_model(artifact)
+            if cand is None:
+                return False
+            self._flip_epoch(cand)
+            self.release_swap()
+            return True
+        """, only=["SCT010"])
+    assert rule_ids(r) == ["SCT010"]
+    assert "swap claim" in r.violations[0].message
+
+
+def test_sct010_swap_claim_clean_finally(tmp_path):
+    """serving.py's real shape: the release lives in a finally, so
+    every rollback/raise path releases — must not flag."""
+    r = lint_src(tmp_path, """
+        def swap(self, artifact):
+            if not self.try_acquire_swap():
+                raise RuntimeError("swap in flight")
+            try:
+                cand = self._load_model(artifact)
+                if cand is None:
+                    return False
+                self._flip_epoch(cand)
+                return True
+            finally:
+                self.release_swap()
+        """, only=["SCT010"])
+    assert rule_ids(r) == []

@@ -530,3 +530,59 @@ def test_federation_join_check_counts_unrequeued(tmp_path, capsys):
     out = capsys.readouterr().out
     assert ("merged-journal join: 0/1 lost in-flight ticket(s) "
             "requeued and terminal") in out
+
+
+def test_serving_section_renders_funnel_and_lifecycle(tmp_path,
+                                                      capsys):
+    """A run dir with serve.* series + model-lifecycle journal events
+    gets the serving section: the query funnel, the latency digest,
+    the residency-ladder rung counts, and the state-lifecycle
+    timeline (loads, quarantines, swaps, rollbacks in order)."""
+    journal = (
+        '{"event": "run_start", "n_steps": 0, "ts": 10.0}\n'
+        '{"event": "model_loaded", "epoch": 0, "generation": '
+        '"current", "version": "v1", "reason": "init", "ts": 10.0}\n'
+        '{"event": "model_quarantined", "path": "q/model.npz", '
+        '"reason": "digest mismatch", "generation": "current", '
+        '"ts": 11.5}\n'
+        '{"event": "model_loaded", "epoch": 0, "generation": "prev", '
+        '"version": "v0", "reason": "reload", "ts": 11.6}\n'
+        '{"event": "model_swapped", "epoch": 1, "version": "v2", '
+        '"generation": "current", "agreement": 1.0, "ts": 12.0}\n'
+        '{"event": "swap_rolled_back", "epoch": 1, "reason": '
+        '"canary_disagreement", "agreement": 0.31, "ts": 13.0}\n'
+        '{"event": "run_completed", "ts": 14.0}\n')
+    (tmp_path / "journal.jsonl").write_text(journal)
+    (tmp_path / "metrics.json").write_text(json.dumps({
+        "schema": 1, "metrics": {"counters": {
+            "serve.queries{outcome=completed}": 17.0,
+            "serve.queries{outcome=rejected}": 2.0,
+            "serve.queries{outcome=shed}": 1.0,
+            "serve.state_reloads{reason=replace}": 1.0,
+            "serve.state_reloads{reason=artifact}": 1.0,
+            "serve.swaps": 1.0, "serve.rollbacks": 1.0,
+        }, "gauges": {}, "histograms": {
+            "serve.latency_s": {"count": 17, "sum": 3.4, "max": 0.9,
+                                "buckets": {"+inf": 17}}}}}))
+    assert main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "-- serving --" in out
+    assert ("query funnel: 20 quer(ies) -> 17 completed, 0 failed, "
+            "2 rejected, 1 shed") in out
+    assert "completed latency: n=17 mean=0.2000s max=0.9s" in out
+    assert "residency-ladder rungs: artifact=1, replace=1" in out
+    assert "hot-swaps: 1 flipped, 1 rolled back" in out
+    assert "QUARANTINED gen=current: digest mismatch" in out
+    assert "LOADED epoch=0 gen=prev version=v0 (reload)" in out
+    assert "SWAPPED -> epoch 1 version=v2 agreement=1.0" in out
+    assert "ROLLED BACK at epoch 1: canary_disagreement" in out
+
+
+def test_serving_section_absent_without_serve_series():
+    from tools.sctreport import serving_section
+
+    assert serving_section([], None) == []
+    assert serving_section(
+        [{"event": "run_start"}],
+        {"metrics": {"counters": {"sched.admitted{tenant=a}": 1.0},
+                     "gauges": {}, "histograms": {}}}) == []

@@ -1,5 +1,7 @@
 """model.scvi: the NB-VAE model family."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -269,3 +271,108 @@ def test_scanvi_validates():
     one = d.with_obs(cell_type=np.array(["a"] * 100))
     with pytest.raises(ValueError, match=">=2"):
         sct.apply("model.scanvi", one, backend="cpu", epochs=1)
+
+
+# ---------------------------------------------------------------------------
+# the stable on-disk model convention (save_model / load_model)
+# ---------------------------------------------------------------------------
+
+def test_save_load_model_round_trip(tmp_path):
+    """flatten/unflatten is a lossless bijection for scvi- AND
+    scanvi-shaped parameter pytrees (nested dicts/lists of arrays),
+    and the on-disk artifact verifies before it is trusted."""
+    import jax
+
+    from sctools_tpu.models.scvi import (init_params, load_model,
+                                         save_model)
+
+    params = init_params(jax.random.PRNGKey(3), 40, 2, n_latent=4,
+                         n_hidden=8)
+    # scanvi-shaped extras: a classifier head + class anchors
+    params["clf"] = [{"w": np.ones((4, 3), np.float32),
+                      "b": np.zeros((3,), np.float32)}]
+    params["prior_mu"] = np.zeros((3, 4), np.float32)
+    p = str(tmp_path / "model.npz")
+    save_model(params, p, meta={"n_genes": 40, "n_latent": 4})
+    got, meta = load_model(p)
+    la = jax.tree_util.tree_leaves(params)
+    lb = jax.tree_util.tree_leaves(got)
+    assert len(la) == len(lb)
+    assert all(np.array_equal(np.asarray(a), np.asarray(b))
+               for a, b in zip(la, lb))
+    assert int(meta["n_genes"]) == 40
+
+    # generation rotation: a re-save rotates the old file to .prev
+    save_model(params, p)
+    assert os.path.exists(p + ".prev")
+
+    # a foreign fingerprint is refused, never half-parsed
+    from sctools_tpu.utils.checkpoint import (CheckpointCorruptError,
+                                              save_npz_verified)
+
+    foreign = str(tmp_path / "foreign.npz")
+    save_npz_verified(foreign, fingerprint="other-v1",
+                      x=np.zeros(3))
+    with pytest.raises(CheckpointCorruptError):
+        load_model(foreign)
+
+
+def test_scvi_op_saves_model_artifact(tmp_path):
+    """model.scvi(save_model_path=) leaves a verified reloadable
+    artifact behind — the servable form of a trained reference."""
+    from sctools_tpu.models.scvi import _encode, load_model
+
+    d = synthetic_counts(200, 60, density=0.2, n_clusters=2, seed=0)
+    p = str(tmp_path / "scvi.npz")
+    out = sct.apply("model.scvi", d, backend="cpu", n_latent=4,
+                    n_hidden=16, epochs=2, batch_size=64,
+                    save_model_path=p)
+    params, meta = load_model(p)
+    assert int(meta["n_genes"]) == 60 and int(meta["n_latent"]) == 4
+    # the reloaded params reproduce the op's own embedding
+    import jax.numpy as jnp
+    import scipy.sparse as sp
+
+    X = jnp.asarray(np.asarray(d.X.todense() if sp.issparse(d.X)
+                               else d.X), jnp.float32)
+    oh = jnp.zeros((X.shape[0], 0), jnp.float32)
+    z = np.asarray(_encode(params, X, oh))
+    assert np.allclose(z, np.asarray(out.obsm["X_scvi"]), atol=1e-5)
+
+
+def test_serving_artifact_embeds_scvi_params(tmp_path):
+    """build_reference_artifact(scvi_model=) carries the trained
+    params inside the serving artifact under the same flatten
+    encoding, reloadable from the resident model."""
+    import jax
+
+    from sctools_tpu.models.scvi import init_params, save_model
+    from sctools_tpu.serving import AnnotationService, \
+        build_reference_artifact
+    from sctools_tpu.utils.vclock import VirtualClock
+
+    ref = synthetic_counts(200, 60, density=0.2, n_clusters=2, seed=0)
+    ref = ref.with_obs(cell_type=np.array(
+        ["a" if c == 0 else "b"
+         for c in np.asarray(ref.obs["cluster_true"])]))
+    fitted = sct.run_recipe("annotation_reference", ref,
+                            backend="cpu", n_components=8)
+    params = init_params(jax.random.PRNGKey(0), 60, 0, n_latent=4,
+                         n_hidden=8)
+    mp = str(tmp_path / "scvi.npz")
+    save_model(params, mp)
+    art = str(tmp_path / "serving.npz")
+    build_reference_artifact(fitted, art, labels_key="cell_type",
+                             scvi_model=mp, seed=0)
+    svc = AnnotationService(art, name="scvi_embed",
+                            clock=VirtualClock())
+    try:
+        got = svc.scvi_params()
+        assert got is not None
+        la = jax.tree_util.tree_leaves(params)
+        lb = jax.tree_util.tree_leaves(got)
+        assert len(la) == len(lb) and all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(la, lb))
+    finally:
+        svc.close()

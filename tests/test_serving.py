@@ -1,0 +1,674 @@
+"""serving.AnnotationService — resident reference-model state as a
+fault domain: verified artifact lifecycle (quarantine + .prev
+rollback), the residency health ladder, epoch-guarded hot-swap with
+canary auto-rollback, shape-bucketed plan-cached query kernels, and
+the terminal-exactly-once query funnel.  Everything timing-shaped
+runs on one VirtualClock — zero real sleeps."""
+
+import json
+import os
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import sctools_tpu as sct  # noqa: E402
+from sctools_tpu.data.synthetic import synthetic_counts  # noqa: E402
+from sctools_tpu.serving import (SERVING_MODEL_FP,  # noqa: E402
+                                 AnnotationService, annotate_host,
+                                 bucket_rows, build_reference_artifact)
+from sctools_tpu.utils.chaos import ChaosMonkey, Fault  # noqa: E402
+from sctools_tpu.utils.checkpoint import (  # noqa: E402
+    CheckpointCorruptError, load_npz_verified, save_npz_generations)
+from sctools_tpu.utils.telemetry import MetricsRegistry  # noqa: E402
+from sctools_tpu.utils.vclock import VirtualClock  # noqa: E402
+
+N_REF, N_GENES, N_COMPS = 768, 96, 16
+SCORE_GENES = [f"GENE{i}" for i in range(20, 50)]
+
+
+def _counter(m, name):
+    return m.snapshot_compact().get(name, 0.0)
+
+
+@pytest.fixture(scope="module")
+def fitted_ref():
+    ref = synthetic_counts(N_REF, N_GENES, density=0.15, n_clusters=4,
+                           seed=0)
+    labels = np.array([f"type{c}"
+                       for c in np.asarray(ref.obs["cluster_true"])])
+    ref = ref.with_obs(cell_type=labels)
+    return sct.run_recipe("annotation_reference", ref, backend="cpu",
+                          n_components=N_COMPS)
+
+
+@pytest.fixture(scope="module")
+def artifact(fitted_ref, tmp_path_factory):
+    """A two-generation artifact (current + .prev) with a score set."""
+    d = tmp_path_factory.mktemp("serving_artifact")
+    path = str(d / "model.npz")
+    build_reference_artifact(fitted_ref, path, labels_key="cell_type",
+                             score_sets={"prog": SCORE_GENES},
+                             seed=0, version="gen1")
+    build_reference_artifact(fitted_ref, path, labels_key="cell_type",
+                             score_sets={"prog": SCORE_GENES},
+                             seed=0, version="gen2")
+    assert os.path.exists(path + ".prev")
+    return path
+
+
+def _copy_artifact(artifact, dst):
+    import shutil
+
+    shutil.copy(artifact, dst)
+    return str(dst)
+
+
+def _service(artifact, tmp_path, name, clock=None, chaos=None, **kw):
+    clock = clock if clock is not None else VirtualClock()
+    m = MetricsRegistry(clock=clock)
+    kw.setdefault("runner_defaults", {"probe": lambda: {"ok": True}})
+    svc = AnnotationService(
+        artifact, name=name, backend="tpu", clock=clock, metrics=m,
+        journal_path=str(tmp_path / f"{name}_journal.jsonl"),
+        chaos=chaos, k=10, **kw)
+    return svc, m, clock
+
+
+def _query_batch(n, seed=9):
+    return synthetic_counts(n, N_GENES, density=0.15, n_clusters=4,
+                            seed=seed)
+
+
+def _events(svc):
+    with open(svc.journal.path) as f:
+        return [json.loads(line) for line in f]
+
+
+# ---------------------------------------------------------------------------
+# artifact + buckets
+# ---------------------------------------------------------------------------
+
+def test_bucket_rows_ladder():
+    assert bucket_rows(1) == 16
+    assert bucket_rows(16) == 16
+    assert bucket_rows(17) == 32
+    assert bucket_rows(4096) == 4096
+    assert bucket_rows(5000) == 8192  # doubles past the ladder
+    with pytest.raises(ValueError):
+        bucket_rows(0)
+
+
+def test_artifact_verified_round_trip(artifact):
+    arrays = load_npz_verified(artifact,
+                               expect_fingerprint=SERVING_MODEL_FP,
+                               require_digest=True)
+    assert str(arrays["version"]) == "gen2"
+    assert arrays["PCs"].shape == (N_GENES, N_COMPS)
+    assert arrays["ref_scores"].shape == (N_REF, N_COMPS)
+    assert arrays["sim_scores"].shape[1] == N_COMPS
+    assert arrays["canary_x"].shape[1] == N_GENES
+    assert "score/prog" in arrays
+    # a foreign fingerprint is refused — the identity contract
+    with pytest.raises(CheckpointCorruptError, match="fingerprint"):
+        load_npz_verified(artifact, expect_fingerprint="other-v1")
+
+
+def test_build_refuses_unfitted_reference(tmp_path):
+    raw = _query_batch(32)
+    with pytest.raises(ValueError, match="annotation_reference"):
+        build_reference_artifact(raw, str(tmp_path / "m.npz"),
+                                 labels_key="cluster_true")
+
+
+def test_corrupt_current_quarantines_and_serves_prev(artifact,
+                                                     tmp_path):
+    path = _copy_artifact(artifact, tmp_path / "model.npz")
+    import shutil
+
+    shutil.copy(artifact + ".prev", path + ".prev")
+    with open(path, "r+b") as f:  # damage the CURRENT generation
+        blob = bytearray(f.read())
+        for i in range(0, min(len(blob), 4096), 9):
+            blob[i] ^= 0xFF
+        f.seek(0)
+        f.write(blob)
+    with warnings.catch_warnings(record=True) as wrec:
+        warnings.simplefilter("always")
+        svc, m, clock = _service(path, tmp_path, "corrupt_current")
+    try:
+        assert any("QUARANTINED" in str(w.message) for w in wrec)
+        assert svc.model_version == "gen1"  # the .prev generation
+        qdir = tmp_path / "quarantine"
+        files = os.listdir(qdir)
+        assert any(f.endswith(".reason.json") for f in files), files
+        assert any(not f.endswith(".json") for f in files), files
+        ev = _events(svc)
+        kinds = [e["event"] for e in ev]
+        assert "model_quarantined" in kinds
+        loaded = [e for e in ev if e["event"] == "model_loaded"]
+        assert loaded and loaded[-1]["generation"] == "prev"
+        # ... and it SERVES
+        res = svc.query(_query_batch(8), "label_transfer") \
+            .result(timeout=300)
+        assert len(res["labels"]) == 8
+    finally:
+        svc.close()
+
+
+def test_no_loadable_generation_raises(artifact, tmp_path):
+    path = _copy_artifact(artifact, tmp_path / "model.npz")
+    with open(path, "r+b") as f:
+        f.truncate(100)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(CheckpointCorruptError,
+                           match="no loadable artifact generation"):
+            AnnotationService(path, name="no_gen",
+                              clock=VirtualClock())
+
+
+# ---------------------------------------------------------------------------
+# query kinds vs oracles
+# ---------------------------------------------------------------------------
+
+def test_label_transfer_agrees_with_batch_ingest(artifact, fitted_ref,
+                                                 tmp_path):
+    svc, m, clock = _service(artifact, tmp_path, "agree")
+    try:
+        q = _query_batch(128)
+        res = svc.query(q, "label_transfer").result(timeout=300)
+        qn = sct.apply("normalize.library_size", q, backend="cpu",
+                       target_sum=1e4)
+        qn = sct.apply("normalize.log1p", qn, backend="cpu")
+        ing = sct.apply("integrate.ingest", qn, backend="cpu",
+                        ref=fitted_ref.to_host(),
+                        obs=("cell_type",), k=10, metric="cosine")
+        batch = np.asarray(ing.obs["cell_type"]).astype(str)
+        assert np.mean(batch == res["labels"]) >= 0.99
+        assert res["confidence"].shape == (128,)
+        assert np.all(res["confidence"] > 0.0)
+        assert res["scores"].shape == (128, N_COMPS)
+    finally:
+        svc.close()
+
+
+def test_device_path_matches_host_oracle(artifact, tmp_path):
+    svc, m, clock = _service(artifact, tmp_path, "oracle")
+    try:
+        q = _query_batch(32, seed=11)
+        res = svc.query(q, "label_transfer").result(timeout=300)
+        host = dict(svc._models[svc.epoch].host_arrays())
+        import scipy.sparse as sp
+
+        X = np.asarray(q.X.todense() if sp.issparse(q.X) else q.X,
+                       np.float32)
+        ho = annotate_host(host, X, "label_transfer", k=10,
+                           metric="cosine")
+        agree = np.mean(ho["codes"] == res["codes"])
+        assert agree >= 0.95, agree  # f32 device vs f64 host tie edges
+        same = ho["codes"] == res["codes"]
+        assert np.allclose(ho["confidence"][same],
+                           res["confidence"][same], atol=2e-3)
+    finally:
+        svc.close()
+
+
+def test_doublet_flag_separates_simulated_doublets(artifact,
+                                                   fitted_ref,
+                                                   tmp_path):
+    svc, m, clock = _service(artifact, tmp_path, "doublet")
+    try:
+        counts = fitted_ref.layers["counts"]
+        import scipy.sparse as sp
+
+        D = np.asarray((counts[10:42] + counts[200:232]).todense()
+                       if sp.issparse(counts)
+                       else counts[10:42] + counts[200:232],
+                       np.float32)
+        singlets = np.asarray(counts[300:332].todense()
+                              if sp.issparse(counts)
+                              else counts[300:332], np.float32)
+        d_res = svc.query(D, "doublet_flag").result(timeout=300)
+        s_res = svc.query(singlets, "doublet_flag").result(timeout=300)
+        assert (d_res["doublet_score"].mean()
+                > 2.0 * s_res["doublet_score"].mean())
+    finally:
+        svc.close()
+
+
+def test_marker_score_matches_weight_table(artifact, tmp_path):
+    svc, m, clock = _service(artifact, tmp_path, "marker")
+    try:
+        q = _query_batch(24, seed=13)
+        res = svc.query(q, "marker_score",
+                        score_set="prog").result(timeout=300)
+        host = dict(svc._models[svc.epoch].host_arrays())
+        host["serve_weights"] = host["score/prog"]
+        import scipy.sparse as sp
+
+        X = np.asarray(q.X.todense() if sp.issparse(q.X) else q.X,
+                       np.float32)
+        ho = annotate_host(host, X, "marker_score")
+        assert np.allclose(res["score"], ho["score"], atol=1e-3)
+        with pytest.raises(ValueError, match="score_set"):
+            svc.query(q, "marker_score")
+        with pytest.raises(ValueError, match="unknown score_set"):
+            svc.query(q, "marker_score", score_set="nope")
+    finally:
+        svc.close()
+
+
+def test_query_input_validation(artifact, tmp_path):
+    svc, m, clock = _service(artifact, tmp_path, "validate")
+    try:
+        with pytest.raises(ValueError, match="gene"):
+            svc.query(np.zeros((4, N_GENES + 3), np.float32))
+        with pytest.raises(ValueError, match="kind"):
+            svc.query(np.zeros((4, N_GENES)), "unknown_kind")
+        # a single 1-D cell is a 1-row batch
+        one = np.asarray(_query_batch(1).X.todense()).ravel()
+        res = svc.query(one, "label_transfer").result(timeout=300)
+        assert res["n"] == 1 and len(res["labels"]) == 1
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# shape bucketing + plan cache
+# ---------------------------------------------------------------------------
+
+def test_zero_retraces_within_a_bucket(artifact, tmp_path):
+    svc, m, clock = _service(artifact, tmp_path, "buckets")
+    try:
+        svc.query(_query_batch(5, seed=1), "label_transfer") \
+            .result(timeout=300)  # warmup: compiles the 16-bucket
+        misses0 = _counter(m, "plan.cache_misses")
+        hits0 = _counter(m, "plan.cache_hits")
+        for n, seed in ((3, 2), (9, 3), (16, 4), (12, 5)):
+            svc.query(_query_batch(n, seed=seed), "label_transfer") \
+                .result(timeout=300)
+        assert _counter(m, "plan.cache_misses") == misses0, \
+            "a same-bucket query RETRACED"
+        assert _counter(m, "plan.cache_hits") == hits0 + 4
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# residency ladder
+# ---------------------------------------------------------------------------
+
+def test_eviction_replaces_from_host_mirror(artifact, tmp_path):
+    monkey = ChaosMonkey([Fault("evict", "evict_state", on_call=2)])
+    svc, m, clock = _service(artifact, tmp_path, "evict",
+                             chaos=monkey)
+    try:
+        svc.query(_query_batch(4), "label_transfer").result(timeout=300)
+        res = svc.query(_query_batch(4, seed=5),
+                        "label_transfer").result(timeout=300)
+        assert res["mode"] == "device"
+        assert [f["mode"] for f in monkey.injected] == ["evict_state"]
+        assert _counter(
+            m, "serve.state_reloads{reason=replace}") == 1.0
+    finally:
+        svc.close()
+
+
+def test_corrupt_model_quarantines_and_reloads_prev(artifact,
+                                                    tmp_path):
+    path = _copy_artifact(artifact, tmp_path / "model.npz")
+    import shutil
+
+    shutil.copy(artifact + ".prev", path + ".prev")
+    monkey = ChaosMonkey([Fault("corrupt", "corrupt_model",
+                                on_call=2)])
+    svc, m, clock = _service(path, tmp_path, "corrupt", chaos=monkey)
+    try:
+        svc.query(_query_batch(4), "label_transfer").result(timeout=300)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            res = svc.query(_query_batch(4, seed=5),
+                            "label_transfer").result(timeout=300)
+        assert res["mode"] == "device"
+        # the damaged CURRENT generation was quarantined — moved,
+        # never deleted — and .prev took over
+        qdir = tmp_path / "quarantine"
+        files = os.listdir(qdir)
+        assert any(f.endswith(".reason.json") for f in files)
+        assert not os.path.exists(path)  # moved aside, not in place
+        assert _counter(
+            m, "serve.state_reloads{reason=artifact}") == 1.0
+        ev = [e["event"] for e in _events(svc)]
+        assert "model_quarantined" in ev
+        assert ev.count("model_loaded") == 2  # init + ladder reload
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# epoch-guarded hot-swap
+# ---------------------------------------------------------------------------
+
+def test_swap_flips_epoch_and_pins_admitted_queries(artifact,
+                                                    fitted_ref,
+                                                    tmp_path):
+    art2 = str(tmp_path / "model2.npz")
+    build_reference_artifact(fitted_ref, art2, labels_key="cell_type",
+                             score_sets={"prog": SCORE_GENES},
+                             seed=1, version="next")
+    svc, m, clock = _service(artifact, tmp_path, "swap")
+    try:
+        pre = svc.query(_query_batch(8), "label_transfer")
+        assert svc.swap(art2) is True
+        post = svc.query(_query_batch(8), "label_transfer")
+        assert pre.result(timeout=300)["epoch"] == 0
+        assert post.result(timeout=300)["epoch"] == 1
+        assert svc.epoch == 1 and svc.model_version == "next"
+        ev = [e for e in _events(svc) if e["event"] == "model_swapped"]
+        assert len(ev) == 1 and ev[0]["agreement"] >= 0.9
+        assert _counter(m, "serve.swaps") == 1.0
+        # the swap also pre-warmed the new epoch's plan entries: the
+        # post-swap query's bucket shapes match → zero extra retraces
+        # for same-shaped models is covered by the bench gate
+    finally:
+        svc.close()
+
+
+def test_swap_rolls_back_on_canary_disagreement(artifact, tmp_path):
+    arrays = {k: np.asarray(v)
+              for k, v in np.load(artifact, allow_pickle=False).items()
+              if not k.startswith("_integrity/")}
+    arrays["PCs"] = np.zeros_like(arrays["PCs"])  # garbage loadings
+    bad = str(tmp_path / "bad.npz")
+    save_npz_generations(bad, fingerprint=SERVING_MODEL_FP, **arrays)
+    svc, m, clock = _service(artifact, tmp_path, "rollback")
+    try:
+        with warnings.catch_warnings(record=True) as wrec:
+            warnings.simplefilter("always")
+            assert svc.swap(bad) is False
+        assert any("ROLLED BACK" in str(w.message) for w in wrec)
+        assert svc.epoch == 0  # the old epoch kept serving
+        ev = [e for e in _events(svc)
+              if e["event"] == "swap_rolled_back"]
+        assert len(ev) == 1
+        assert ev[0]["reason"] == "canary_disagreement"
+        assert _counter(m, "serve.rollbacks") == 1.0
+        res = svc.query(_query_batch(4), "label_transfer") \
+            .result(timeout=300)
+        assert res["epoch"] == 0
+    finally:
+        svc.close()
+
+
+def test_swap_rolls_back_on_placement_failure(artifact, tmp_path,
+                                              monkeypatch):
+    """A device refusing the CANDIDATE's placement (the flaky-device
+    regime operators swap in) is a journaled rollback, not a raw
+    raise — the old epoch keeps serving on its own ladder."""
+    import sctools_tpu.serving as serving
+    from sctools_tpu.utils.failsafe import TransientDeviceError
+
+    svc, m, clock = _service(artifact, tmp_path, "swapplace")
+    try:
+        def refuse(self):
+            raise TransientDeviceError("chaos: placement refused")
+
+        monkeypatch.setattr(serving._ResidentModel, "place", refuse)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            assert svc.swap(artifact) is False
+        ev = [e for e in _events(svc)
+              if e["event"] == "swap_rolled_back"]
+        assert ev and ev[0]["reason"] == "placement_failed"
+        assert _counter(m, "serve.rollbacks") == 1.0
+        assert svc.epoch == 0
+    finally:
+        svc.close()
+
+
+def test_swap_rolls_back_on_raising_canary(artifact, tmp_path,
+                                           monkeypatch):
+    """A canary that cannot even EXECUTE (candidate buffers evicted
+    between place and validate) refuses the candidate like a
+    disagreement — journaled rollback, never an unjournaled raise."""
+    svc, m, clock = _service(artifact, tmp_path, "swapcanary")
+    try:
+        def boom(self, cand):
+            raise RuntimeError("Array has been deleted (chaos)")
+
+        monkeypatch.setattr(AnnotationService, "_canary_agreement",
+                            boom)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            assert svc.swap(artifact) is False
+        ev = [e for e in _events(svc)
+              if e["event"] == "swap_rolled_back"]
+        assert ev and ev[0]["reason"] == "canary_failed"
+        assert _counter(m, "serve.rollbacks") == 1.0
+        assert svc.epoch == 0
+    finally:
+        svc.close()
+
+
+def test_build_requires_raw_counts_snapshot(fitted_ref, tmp_path):
+    """An already-normalised reference without the counts snapshot is
+    refused (double-normalised canary/doublet embeddings would bake a
+    self-inconsistent artifact); counts_layer=None is the explicit
+    X-is-raw opt-out."""
+    stripped = fitted_ref.replace(layers={})
+    with pytest.raises(ValueError, match="raw-counts snapshot"):
+        build_reference_artifact(stripped, str(tmp_path / "m.npz"),
+                                 labels_key="cell_type")
+    # the explicit opt-out builds (content correctness is then the
+    # caller's assertion)
+    build_reference_artifact(stripped, str(tmp_path / "m2.npz"),
+                             labels_key="cell_type",
+                             counts_layer=None)
+
+
+def test_latency_measured_to_terminal_not_collection(artifact,
+                                                     tmp_path):
+    """serve.latency_s stamps the handle's TERMINAL transition: a
+    caller that sits on a finished ticket must not inflate the
+    histogram with its own idle wall."""
+    svc, m, clock = _service(artifact, tmp_path, "latency")
+    try:
+        t = svc.query(_query_batch(4), "label_transfer")
+        assert t.wait(timeout=300)
+        clock.advance(500.0)  # caller idles long after the terminal
+        t.result(timeout=1)
+        h = m.snapshot()["histograms"]["serve.latency_s"]
+        assert h["count"] == 1
+        assert h["max"] < 500.0, h
+    finally:
+        svc.close()
+
+
+def test_query_after_close_refused(artifact, tmp_path):
+    svc, m, clock = _service(artifact, tmp_path, "closedq")
+    svc.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        svc.query(_query_batch(4), "label_transfer")
+    with pytest.raises(RuntimeError, match="closed"):
+        svc.swap(artifact)
+
+
+def test_swap_rolls_back_on_corrupt_candidate(artifact, tmp_path):
+    bad = _copy_artifact(artifact, tmp_path / "cand.npz")
+    with open(bad, "r+b") as f:
+        f.truncate(200)
+    svc, m, clock = _service(artifact, tmp_path, "swapcorrupt")
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            assert svc.swap(bad) is False
+        ev = [e for e in _events(svc)
+              if e["event"] == "swap_rolled_back"]
+        assert ev and ev[0]["reason"] == "artifact_corrupt"
+        assert svc.epoch == 0
+    finally:
+        svc.close()
+
+
+def test_retired_epoch_fails_fast(artifact, fitted_ref, tmp_path):
+    art2 = str(tmp_path / "m2.npz")
+    art3 = str(tmp_path / "m3.npz")
+    for p, v in ((art2, "v2"), (art3, "v3")):
+        build_reference_artifact(fitted_ref, p, labels_key="cell_type",
+                                 seed=2, version=v)
+    svc, m, clock = _service(artifact, tmp_path, "retired")
+    try:
+        assert svc.swap(art2) and svc.swap(art3)
+        with pytest.raises(RuntimeError, match="retired"):
+            svc._execute_query(
+                sct.CellData(np.zeros((16, N_GENES), np.float32)),
+                "label_transfer", 0, 10, "cosine", None)
+    finally:
+        svc.close()
+
+
+def test_concurrent_swap_refused(artifact, tmp_path):
+    svc, m, clock = _service(artifact, tmp_path, "swapslot")
+    try:
+        assert svc.try_acquire_swap()
+        with pytest.raises(RuntimeError, match="in flight"):
+            svc.swap(artifact)
+        svc.release_swap()
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# admission funnel accounting
+# ---------------------------------------------------------------------------
+
+def test_rejected_queries_are_counted(artifact, tmp_path):
+    svc, m, clock = _service(
+        artifact, tmp_path, "reject",
+        quotas={"blocked": (1, 0)})  # max_queued=0: refuse at the door
+    try:
+        with pytest.raises(sct.RunRejected):
+            svc.query(_query_batch(4), "label_transfer",
+                      tenant="blocked")
+        assert _counter(m, "serve.queries{outcome=rejected}") == 1.0
+    finally:
+        svc.close()
+
+
+def test_close_drains_accounting(artifact, tmp_path):
+    svc, m, clock = _service(artifact, tmp_path, "drainacct")
+    t = svc.query(_query_batch(4), "label_transfer")
+    svc.close()  # caller never touched the ticket
+    assert _counter(m, "serve.queries{outcome=completed}") == 1.0
+    assert t.done()
+
+
+def test_service_name_collision_refused(artifact, tmp_path):
+    svc, m, clock = _service(artifact, tmp_path, "unique")
+    try:
+        with pytest.raises(ValueError, match="already named"):
+            AnnotationService(artifact, name="unique",
+                              clock=VirtualClock())
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance chaos soak
+# ---------------------------------------------------------------------------
+
+def test_acceptance_soak_eviction_corruption_swap_under_traffic(
+        artifact, fitted_ref, tmp_path):
+    """The PR's headline contract on ONE VirtualClock: multi-tenant
+    query traffic with an injected eviction, an injected artifact
+    corruption and one hot-swap — every query terminal in exactly one
+    of completed|failed|rejected|shed with a journaled reason, the
+    corrupt artifact quarantined (never deleted) with rollback to
+    .prev, every in-flight query completing on the model epoch it was
+    ADMITTED under, and post-swap label agreement vs the batch
+    pipeline holding.  Zero real sleeps."""
+    from soak_smoke import check_journal_coherent
+
+    path = _copy_artifact(artifact, tmp_path / "model.npz")
+    import shutil
+
+    shutil.copy(artifact + ".prev", path + ".prev")
+    art2 = str(tmp_path / "model_next.npz")
+    build_reference_artifact(fitted_ref, art2, labels_key="cell_type",
+                             score_sets={"prog": SCORE_GENES},
+                             seed=3, version="soak-next")
+    monkey = ChaosMonkey([
+        Fault("soak", "evict_state", on_call=4),
+        Fault("soak", "corrupt_model", on_call=9),
+    ])
+    svc, m, clock = _service(path, tmp_path, "soak", chaos=monkey,
+                             max_concurrency=2)
+    tenants = ("lab-a", "lab-b", "lab-c")
+    kinds = ("label_transfer", "doublet_flag", "marker_score")
+    tickets = []
+    submitted = 0
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for i in range(12):
+                kind = kinds[i % 3]
+                tickets.append(svc.query(
+                    _query_batch(3 + (i % 7), seed=100 + i), kind,
+                    tenant=tenants[i % 3],
+                    score_set="prog" if kind == "marker_score"
+                    else None))
+                submitted += 1
+            swapped = svc.swap(art2)
+            assert swapped is True
+            for i in range(6):
+                kind = kinds[i % 3]
+                tickets.append(svc.query(
+                    _query_batch(4 + i, seed=200 + i), kind,
+                    tenant=tenants[i % 3],
+                    score_set="prog" if kind == "marker_score"
+                    else None))
+                submitted += 1
+            results = [t.result(timeout=600) for t in tickets]
+        # ZERO dropped queries: chaos evicted the device state AND
+        # corrupted the artifact mid-traffic, yet every query
+        # completed (the ladder re-placed / quarantined + reloaded)
+        assert all(t.status == "completed" for t in tickets)
+        # ...and each ran on exactly the epoch it was admitted under
+        for t, r in zip(tickets, results):
+            assert r["epoch"] == t.epoch, (t, r["epoch"])
+        assert {t.epoch for t in tickets} == {0, 1}
+        # both injected faults actually fired
+        assert sorted(f["mode"] for f in monkey.injected) == \
+            ["corrupt_model", "evict_state"]
+        # the corrupt generation was quarantined, never deleted
+        qdir = tmp_path / "quarantine"
+        files = os.listdir(qdir)
+        assert any(f.endswith(".reason.json") for f in files)
+        assert any(not f.endswith(".json") for f in files)
+        # terminal exactly once, with a journaled reason, per ticket
+        svc.drain()
+        check_journal_coherent(svc.journal.path, submitted)
+        ev = [e["event"] for e in _events(svc)]
+        assert "model_swapped" in ev and "model_quarantined" in ev
+        # post-swap agreement vs the batch pipeline
+        q = _query_batch(96, seed=999)
+        res = svc.query(q, "label_transfer").result(timeout=300)
+        assert res["epoch"] == 1
+        qn = sct.apply("normalize.library_size", q, backend="cpu",
+                       target_sum=1e4)
+        qn = sct.apply("normalize.log1p", qn, backend="cpu")
+        ing = sct.apply("integrate.ingest", qn, backend="cpu",
+                        ref=fitted_ref.to_host(),
+                        obs=("cell_type",), k=10, metric="cosine")
+        batch = np.asarray(ing.obs["cell_type"]).astype(str)
+        assert np.mean(batch == res["labels"]) >= 0.99
+        # the funnel metrics agree with the journal
+        assert _counter(m, "serve.queries{outcome=completed}") == \
+            submitted + 1
+        assert _counter(m, "serve.swaps") == 1.0
+    finally:
+        svc.close()

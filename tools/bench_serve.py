@@ -1,0 +1,149 @@
+"""Serving bench helper: a sustained online-annotation query stream
+against a resident reference model.
+
+This module backs ``bench.py --phase serve``.  What it measures:
+
+* **query latency**: per-query admission→result roundtrip walls over
+  a sustained stream of randomly-sized small batches (the serving
+  traffic shape), p50/p99 reported; the acceptance gate
+  (tests/test_bench_gates.py) bounds p99;
+* **zero retraces after warmup**: every query pads to a shape bucket
+  and executes through the plan cache with the model arrays as
+  INPUTS, so after one warmup query per bucket the whole stream —
+  including a mid-stream HOT-SWAP to a same-shaped model — must add
+  zero ``plan.cache_misses``;
+* **label agreement vs the batch pipeline**: a held-out query batch
+  through the service must agree with ``integrate.ingest`` (the
+  batch label-transfer op, cpu oracle) on >= 0.99 of cells — the
+  recall gate that keeps the low-latency path honest.
+
+Sized for the CI box via ``SCTOOLS_BENCH_SERVE_CELLS/GENES/COMPS/
+QUERIES/MAXQ``; real boxes can scale up.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import time
+
+
+def run_serve_bench(jax) -> dict:
+    """Sustained query-stream walls + zero-retrace + agreement.
+    Returns the detail dict the gate reads."""
+    import numpy as np
+
+    import sctools_tpu as sct
+    from sctools_tpu.data.synthetic import synthetic_counts
+    from sctools_tpu.serving import (AnnotationService,
+                                     build_reference_artifact)
+    from sctools_tpu.utils.telemetry import MetricsRegistry
+
+    n_ref = int(os.environ.get("SCTOOLS_BENCH_SERVE_CELLS", 4096))
+    g = int(os.environ.get("SCTOOLS_BENCH_SERVE_GENES", 256))
+    comps = int(os.environ.get("SCTOOLS_BENCH_SERVE_COMPS", 32))
+    n_queries = int(os.environ.get("SCTOOLS_BENCH_SERVE_QUERIES", 120))
+    max_q = int(os.environ.get("SCTOOLS_BENCH_SERVE_MAXQ", 32))
+
+    ref = synthetic_counts(n_ref, g, density=0.1, n_clusters=6, seed=0)
+    labels = np.array([f"type{c}"
+                       for c in np.asarray(ref.obs["cluster_true"])])
+    ref = ref.with_obs(cell_type=labels)
+    fitted = sct.run_recipe("annotation_reference", ref, backend="cpu",
+                            n_components=comps)
+    tmp = tempfile.mkdtemp(prefix="sctools_bench_serve_")
+    try:
+        art = os.path.join(tmp, "model.npz")
+        build_reference_artifact(fitted, art, labels_key="cell_type",
+                                 seed=0, version="bench-v1")
+        art2 = os.path.join(tmp, "model_next.npz")
+        build_reference_artifact(fitted, art2, labels_key="cell_type",
+                                 seed=1, version="bench-v2")
+
+        m = MetricsRegistry()
+        # context-managed: an assert/raise mid-bench must still shut
+        # the private scheduler down (worker threads + the process-
+        # global chaos hook) and release the service name
+        with AnnotationService(
+                art, name="bench", backend="tpu", metrics=m,
+                journal_path=os.path.join(tmp, "journal.jsonl"),
+                max_concurrency=2, k=15,
+                runner_defaults={"probe": lambda: {"ok": True}}) \
+                as svc:
+            rng = np.random.default_rng(7)
+            pool = synthetic_counts(max(256, 2 * max_q), g, density=0.1,
+                                    n_clusters=6, seed=9)
+            import scipy.sparse as sp
+
+            pool_X = np.asarray(pool.X.todense()
+                                if sp.issparse(pool.X) else pool.X,
+                                np.float32)
+
+            def one_query(n_rows):
+                start = int(rng.integers(0, pool_X.shape[0] - n_rows))
+                X = pool_X[start:start + n_rows]
+                t0 = time.perf_counter()
+                svc.query(X, "label_transfer",
+                          tenant=f"lab-{int(rng.integers(3))}") \
+                    .result(timeout=600)
+                return time.perf_counter() - t0
+
+            # warmup: compile each bucket the stream will hit (16/32)
+            # plus the canary's bucket (64 — the mid-stream swap's canary
+            # validation runs through the same plan path) — after this
+            # the stream must add ZERO plan.cache_misses
+            sizes = rng.integers(1, max_q + 1, size=n_queries)
+            for b in (16, 32, 64):
+                one_query(b)
+            warm = m.snapshot_compact()
+            misses_warm = warm.get("plan.cache_misses", 0.0)
+
+            walls = []
+            t_stream = time.perf_counter()
+            for i, n_rows in enumerate(sizes):
+                walls.append(one_query(int(n_rows)))
+                if i == n_queries // 2:
+                    # hot-swap MID-STREAM: same-shaped model — the plan
+                    # cache must keep serving (arrays are inputs, not
+                    # baked constants), and traffic must not drop
+                    assert svc.swap(art2), "bench swap rolled back"
+            stream_wall = time.perf_counter() - t_stream
+            c = m.snapshot_compact()
+            retraces = c.get("plan.cache_misses", 0.0) - misses_warm
+            walls_arr = np.asarray(walls)
+
+            # agreement vs the batch pipeline on a held-out batch
+            q = synthetic_counts(256, g, density=0.1, n_clusters=6,
+                                 seed=31)
+            res = svc.query(q, "label_transfer").result(timeout=600)
+            qn = sct.apply("normalize.library_size", q, backend="cpu",
+                           target_sum=1e4)
+            qn = sct.apply("normalize.log1p", qn, backend="cpu")
+            ing = sct.apply("integrate.ingest", qn, backend="cpu",
+                            ref=fitted.to_host(), obs=("cell_type",),
+                            k=15, metric="cosine")
+            batch = np.asarray(ing.obs["cell_type"]).astype(str)
+            agreement = float(np.mean(batch == res["labels"]))
+            final_epoch = int(svc.epoch)  # the swap really flipped
+        return {
+            "n_ref": n_ref, "n_genes": g, "n_components": comps,
+            "n_queries": int(n_queries),
+            "max_query_rows": int(max_q),
+            "stream_wall_s": round(stream_wall, 3),
+            "queries_per_s": round(n_queries / max(stream_wall, 1e-9),
+                                   2),
+            "latency_p50_ms": round(
+                float(np.percentile(walls_arr, 50)) * 1e3, 3),
+            "latency_p99_ms": round(
+                float(np.percentile(walls_arr, 99)) * 1e3, 3),
+            "latency_max_ms": round(float(walls_arr.max()) * 1e3, 3),
+            "retraces_after_warmup": float(retraces),
+            "plan_hits": c.get("plan.cache_hits", 0.0),
+            "swap_epoch": final_epoch,
+            "completed": c.get("serve.queries{outcome=completed}",
+                               0.0),
+            "batch_agreement": round(agreement, 5),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)

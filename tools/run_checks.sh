@@ -88,7 +88,18 @@
 #                     deleted) with resume falling back exactly one
 #                     generation (docs/ARCHITECTURE.md "Resumable
 #                     training jobs")
-#  12. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
+#  12. serving       python tests/serving_smoke.py — the resident-
+#                     state serving fault domain's contract: a chaos-
+#                     corrupted model artifact is quarantined (never
+#                     deleted) with rollback to the .prev generation,
+#                     an eviction re-places the device state from the
+#                     host mirror, and one canary-validated hot-swap
+#                     under multi-tenant traffic drops zero queries —
+#                     every query terminal exactly once on the epoch
+#                     it was admitted under, one VirtualClock, zero
+#                     real sleeps (docs/ARCHITECTURE.md
+#                     "Resident-state serving")
+#  13. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
 
 set -u -o pipefail
 
@@ -318,6 +329,14 @@ if JAX_PLATFORMS=cpu python tests/train_smoke.py; then
     :
 else
     echo "training stage FAILED (rc=$?)"
+    fail=1
+fi
+
+stage "serving (corrupt artifact->.prev rollback, eviction, hot-swap)"
+if JAX_PLATFORMS=cpu python tests/serving_smoke.py; then
+    :
+else
+    echo "serving stage FAILED (rc=$?)"
     fail=1
 fi
 

@@ -38,10 +38,15 @@ from ..jaxutil import dotted, module_info
 # preempt → requeue → resume ladder runs on one VirtualClock;
 # telemetry.py because every metric duration/histogram observation is
 # clock-injected (the old shell-side guard covered it — this list is
-# now the ONE source of truth for run_checks stage 3).
+# now the ONE source of truth for run_checks stage 3);
+# serving.py for the annotation service — query latency accounting
+# and the residency/swap ladder all move on the scheduler's
+# injectable clock, so the chaos acceptance soak (eviction +
+# corruption + hot-swap under multi-tenant traffic) runs on one
+# VirtualClock with zero real sleeps.
 _PATH_RE = re.compile(
     r"(^|/)(runner|failsafe|checkpoint|chaos|stream|scheduler"
-    r"|shardstore|federation|train_stream|telemetry)\.py$")
+    r"|shardstore|federation|train_stream|telemetry|serving)\.py$")
 
 _BANNED = {"time.sleep", "time.monotonic"}
 

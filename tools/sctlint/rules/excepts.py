@@ -19,9 +19,14 @@ from ..jaxutil import dotted, module_info
 # resilience-path modules (matched on the repo-relative path tail so
 # synthetic test files named e.g. runner.py exercise the rule too);
 # vclock carries the breaker/deadline stack's injectable clock
+# serving.py joined with the annotation service: its residency ladder
+# classifies every placement/reload failure (transient feeds the
+# shared breaker, deterministic fails the query fast), so a silent
+# broad swallow there would hide exactly the rung evidence the
+# ladder's journal exists for
 _PATH_RE = re.compile(
     r"(^|/)(runner|failsafe|checkpoint|chaos|trace|determinism|sync"
-    r"|vclock|federation)\.py$")
+    r"|vclock|federation|serving)\.py$")
 
 _BROAD = {"Exception", "BaseException"}
 

@@ -55,12 +55,19 @@ from ..jaxutil import dotted, module_info
 _ACQ_TAILS = {
     "probe slot": {"try_acquire_probe"},
     "call-wrapper hook": {"push_call_wrapper"},
+    # the annotation service's exclusive hot-swap slot
+    # (serving.AnnotationService.try_acquire_swap): a swap that leaks
+    # its claim — a raising canary, a journal write between load and
+    # verdict — wedges every future model upgrade until restart,
+    # exactly the probe-slot defect shape
+    "swap claim": {"try_acquire_swap"},
 }
 #: kind -> release call tails
 _REL_TAILS = {
     "probe slot": {"release_probe", "record_success", "record_failure"},
     "call-wrapper hook": {"pop_call_wrapper"},
     "claim file": {"unlink", "remove", "rmdir", "replace"},
+    "swap claim": {"release_swap"},
 }
 #: context-manager factories whose bare-expression call is a
 #: constructed-and-dropped no-op (nothing installed, nothing popped)

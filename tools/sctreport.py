@@ -317,6 +317,11 @@ def render(run_dir: str, runs: list[dict], trace_d: dict | None,
         add("")
         L.extend(training)
 
+    serving = serving_section(events or [], metrics)
+    if serving:
+        add("")
+        L.extend(serving)
+
     add("")
     add("-- metrics snapshot --")
     if metrics is None:
@@ -684,6 +689,86 @@ def training_section(events: list[dict], metrics) -> list[str]:
         eff = ov / max(ov + st, 1e-9)
         L.append(f"  device feed: overlap {ov:.3f}s / stall "
                  f"{st:.3f}s  (efficiency {eff:.0%})")
+    return L
+
+
+def serving_section(events: list[dict], metrics) -> list[str]:
+    """The annotation-service digest, rendered only when the run
+    recorded ``serve.*`` series or journaled model-lifecycle events
+    (a run that never served has no section).  Shows the query funnel
+    (every query terminal in exactly one outcome), the completed-
+    query latency digest, the residency-ladder rung counts, and the
+    state-lifecycle timeline — loads, quarantines, hot-swaps and
+    rollbacks in journal order."""
+    m = (metrics or {}).get("metrics", metrics or {})
+    counters = m.get("counters", {}) if isinstance(m, dict) else {}
+    hists = m.get("histograms", {}) if isinstance(m, dict) else {}
+    serve_counters = {k: v for k, v in counters.items()
+                      if k.startswith("serve.")}
+    life = [e for e in events if e["event"] in (
+        "model_loaded", "model_quarantined", "model_swapped",
+        "swap_rolled_back")]
+    if not serve_counters and not life:
+        return []
+    L = ["-- serving --"]
+
+    outcomes: dict = {}
+    for k, v in serve_counters.items():
+        name, labels = _parse_labels(k)
+        if name == "serve.queries":
+            outcomes[labels.get("outcome", "?")] = v
+    if outcomes:
+        total = sum(outcomes.values())
+        parts = [f"{outcomes.get(o, 0.0):g} {o}"
+                 for o in ("completed", "failed", "rejected", "shed")]
+        L.append(f"  query funnel: {total:g} quer(ies) -> "
+                 + ", ".join(parts))
+    for k, h in sorted(hists.items()):
+        if k.startswith("serve.latency_s"):
+            n = h.get("count", 0)
+            mean = (h.get("sum", 0.0) / n) if n else 0.0
+            L.append(f"  completed latency: n={n} mean={mean:.4f}s "
+                     f"max={h.get('max', 0.0):g}s")
+    reloads = {k: v for k, v in serve_counters.items()
+               if _parse_labels(k)[0] == "serve.state_reloads"}
+    if reloads:
+        parts = []
+        for k in sorted(reloads):
+            _, labels = _parse_labels(k)
+            parts.append(f"{labels.get('reason', '?')}="
+                         f"{reloads[k]:g}")
+        L.append("  residency-ladder rungs: " + ", ".join(parts))
+    swaps = serve_counters.get("serve.swaps", 0.0)
+    rollbacks = serve_counters.get("serve.rollbacks", 0.0)
+    if swaps or rollbacks:
+        L.append(f"  hot-swaps: {swaps:g} flipped, {rollbacks:g} "
+                 f"rolled back")
+
+    if life:
+        L.append("  state lifecycle:")
+        t0 = life[0].get("ts", 0.0)
+        for e in life:
+            dt = e.get("ts", t0) - t0
+            if e["event"] == "model_loaded":
+                L.append(f"    +{dt:6.2f}s LOADED epoch="
+                         f"{e.get('epoch')} gen={e.get('generation')}"
+                         f" version={e.get('version')} "
+                         f"({e.get('reason')})")
+            elif e["event"] == "model_quarantined":
+                L.append(f"    +{dt:6.2f}s QUARANTINED "
+                         f"gen={e.get('generation')}: "
+                         f"{e.get('reason')} -> {e.get('path')}")
+            elif e["event"] == "model_swapped":
+                L.append(f"    +{dt:6.2f}s SWAPPED -> epoch "
+                         f"{e.get('epoch')} version="
+                         f"{e.get('version')} agreement="
+                         f"{e.get('agreement')}")
+            else:
+                L.append(f"    +{dt:6.2f}s ROLLED BACK at epoch "
+                         f"{e.get('epoch')}: {e.get('reason')}"
+                         + (f" (agreement {e.get('agreement')})"
+                            if e.get("agreement") is not None
+                            else ""))
     return L
 
 
