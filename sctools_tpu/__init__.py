@@ -36,6 +36,8 @@ from .data.shardstore import (ShardReadScheduler, ShardStore,
 from .data.io import (from_dense, from_scipy, read, read_10x_h5,
                       read_10x_mtx, read_csv, read_h5ad, read_loom,
                       read_mtx, read_text, write_h5ad, write_loom)
+from . import memory  # noqa: F401  (budget + estimate model)
+from .memory import MemoryBudget
 from .plan import describe_plan, fused_pipeline
 from .recipes import recipe_pipeline, run_recipe, submit_recipe
 from .registry import Pipeline, Transform, apply, backends, names, register
@@ -88,4 +90,5 @@ __all__ = [
     "ShardStore", "ShardReadScheduler", "StoreWriter", "open_store",
     "write_store",
     "AnnotationService", "build_reference_artifact", "serving",
+    "MemoryBudget", "memory",
 ]

@@ -1,0 +1,525 @@
+"""Device memory as a first-class fault domain: learned peak
+estimates, a budgeted reservation ledger, and the scopes that thread
+them through the stack.
+
+Every fault domain built so far — device crashes (runner), host RAM
+(shard store), processes (federation), resident state (serving) —
+managed a resource the process could observe failing.  Device memory
+was the blind spot: ``RunScheduler`` admission checked quotas and
+deadlines but two large admitted runs would happily co-schedule into
+one HBM and OOM, and ``failsafe.classify_error`` deliberately left
+``RESOURCE_EXHAUSTED`` out of the transient set so the only ruling
+for the canonical TPU production failure was fail-fast.  This module
+is the missing substrate, in three pieces:
+
+* :class:`MemoryEstimates` — the process-wide peak-memory model.
+  Every compiled plan-cache entry records the peak its XLA executable
+  actually declared (``compiled.memory_analysis()``, recorded by
+  ``plan.FusedTransform`` on the cache-miss path); everything else —
+  eager ops, host ops, stages not yet compiled — is estimated from
+  registry ``mem_cost=`` metadata applied to the input size
+  (:func:`step_estimate`).  The model is SELF-CORRECTING: an OOM
+  observed at runtime inflates the stored estimate
+  (:meth:`MemoryEstimates.inflate`, ×2 per observation), and the
+  correction outlives the pipeline object — a rebuilt identical
+  pipeline sees the inflated number, so the admission layer stops
+  believing an estimate the device already refuted.
+* :class:`MemoryBudget` — a per-backend reservation ledger.  Capacity
+  comes from the device's own ``memory_stats()['bytes_limit']`` when
+  the platform reports one, or the ``SCTOOLS_MEM_BUDGET_BYTES`` env
+  cap (how CI fakes an HBM on a CPU box).  Submissions RESERVE their
+  estimated peak at dispatch and release on terminal; residents hold
+  NAMED reservations so query traffic and training jobs contend for
+  what is actually left, not for the nameplate capacity —
+  service-lifetime residents (the serving tier's reference model) as
+  STANDING holds that also shrink what admission may ever promise,
+  run-scoped residents (the streaming trainer's feed buffers) as
+  dynamic holds that tighten dispatch fitting only.  ``set_pressure``
+  models a shrunken apparent budget (chaos ``mem_pressure``) without
+  touching the ledger.
+* :func:`budget_scope` / :func:`current_budget` — the thread-local
+  handoff (same shape as ``failsafe.deadline_scope``): the scheduler
+  worker installs its pool's budget around each dispatched run, so
+  code deep inside an op (``models/train_stream.py``'s device feed)
+  can take a named reservation against the pool's budget without
+  any parameter plumbing.
+
+Estimate keys deliberately bucket the input size to the next power of
+two: a rebuilt pipeline over the same data, or a same-bucket query
+batch, lands on the same key — which is what lets a compiled
+estimate (or an OOM correction) recorded under one run serve the
+admission ruling of the next.  Stages deep inside a long pipeline
+whose intermediate sizes diverge from the run input simply fall back
+to the ``mem_cost`` heuristic; the model is a budget guide, not an
+allocator, and the OOM containment ladder (``runner.py``) backstops
+every estimate it gets wrong.
+
+This module is importable without jax (capacity detection imports it
+lazily) and never sleeps or journals — callers own clocks and
+journals.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from . import registry as _registry
+from .utils import telemetry
+
+#: peak multiplier assumed for an op with no ``mem_cost=`` metadata:
+#: inputs resident + an output of the same size (the shape of most
+#: elementwise/normalise ops).  Registered metadata overrides it.
+DEFAULT_STEP_MULTIPLIER = 2.0
+
+#: multiplicative inflation applied to a stored estimate per observed
+#: OOM — the self-correction step.  Doubling converges in
+#: log2(true/estimated) observations and never oscillates (estimates
+#: only ever grow; a compiled re-record cannot deflate a correction).
+OOM_INFLATE_FACTOR = 2.0
+
+#: documented accuracy contract for the heuristic estimator: for the
+#: canned fused plans tier-1 pins, the ``mem_cost`` heuristic must be
+#: within this factor of ``compiled.memory_analysis()`` actuals
+#: (either direction).  Deliberately loose — the heuristic exists to
+#: rank runs for admission, the compiled record replaces it after
+#: first execution, and the OOM ladder backstops underestimates.
+HEURISTIC_ACCURACY_FACTOR = 16.0
+
+
+def size_bucket(nbytes: int) -> int:
+    """Input sizes bucket to the next power of two for estimate keys:
+    exact-byte keys would fragment the model across trivially
+    different inputs, while a 2× bucket still separates workloads
+    whose peaks meaningfully differ."""
+    n = max(int(nbytes), 1)
+    return 1 << (n - 1).bit_length()
+
+
+def data_nbytes(data) -> int:
+    """Total array bytes of a pytree (CellData, dict, array): the
+    input-size term every heuristic estimate scales from.  Opaque
+    leaves (strings, scalars) count nothing — they never land on
+    device."""
+    try:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(data)
+    except Exception:  # pragma: no cover - jax-free caller
+        leaves = [data]
+    total = 0
+    for v in leaves:
+        n = getattr(v, "nbytes", None)
+        if isinstance(n, (int, float)):
+            total += int(n)
+            continue
+        # scipy sparse leaves carry no .nbytes of their own — count
+        # their buffer triplet (a host CSR about to be densified or
+        # packed is exactly the input the estimate scales from)
+        for attr in ("data", "indices", "indptr"):
+            b = getattr(getattr(v, attr, None), "nbytes", None)
+            if isinstance(b, (int, float)):
+                total += int(b)
+    return total
+
+
+def _tok(v):
+    """Stable hashable token for a bound param value (estimate keys
+    must not retain arrays; mirrors plan._freeze without importing
+    jax at module load)."""
+    if isinstance(v, dict):
+        return ("d",) + tuple(sorted((k, _tok(x)) for k, x in v.items()))
+    if isinstance(v, (list, tuple, set, frozenset)):
+        items = sorted(v, key=repr) if isinstance(v, (set, frozenset)) \
+            else v
+        return (type(v).__name__,) + tuple(_tok(x) for x in items)
+    nb = getattr(v, "nbytes", None)
+    if nb is not None and hasattr(v, "shape"):
+        return ("nd", str(getattr(v, "dtype", "?")),
+                tuple(getattr(v, "shape", ())))
+    if isinstance(v, (bool, int, float, complex, str, bytes,
+                      type(None))):
+        return v
+    return ("r", type(v).__name__, repr(v))
+
+
+def _step_members(step):
+    """The member transforms of a step: a fused stage / unfused chain
+    exposes ``.members``, a plain Transform is its own single
+    member."""
+    members = getattr(step, "members", None)
+    if members:
+        return list(members)
+    return [step]
+
+
+def _step_kind(step) -> str:
+    """How the step holds its live set — the part of the estimate key
+    that distinguishes one compiled program (``fused``: every member
+    intermediate may be live at once) from an eager chain (``chain``/
+    ``eager``: intermediates free between members)."""
+    if getattr(step, "members", None):
+        if getattr(step, "mesh", None) is not None:
+            return "sharded"
+        cls = type(step).__name__
+        return "chain" if cls == "_UnfusedChain" else "fused"
+    return "eager"
+
+
+def step_sig(step, input_bytes: int) -> tuple:
+    """The estimate-store key for one pipeline step at one input-size
+    bucket: step kind + the (name, backend, params) member chain +
+    the bucketed input bytes.  Pure function of the step OBJECT's
+    declaration, so a rebuilt pipeline lands on the same key."""
+    members = tuple((m.name, m.backend, _tok(dict(m.params)))
+                    for m in _step_members(step))
+    return (_step_kind(step), members, size_bucket(input_bytes))
+
+
+def heuristic_estimate(step, input_bytes: int) -> int:
+    """Registry-metadata peak estimate for one step on
+    ``input_bytes`` of input.
+
+    * eager / collective step: ``input × mem_cost`` (a callable
+      ``mem_cost`` returns bytes outright, converted to an effective
+      multiplier here);
+    * fused stage: ``input × (1 + Σ (mᵢ − 1))`` — one compiled
+      program may hold every member's intermediates live at once;
+    * unfused chain: ``input × max(mᵢ)`` — intermediates free
+      between member dispatches, which is exactly why unfusing is
+      the OOM ladder's first rung.
+    """
+    input_bytes = max(int(input_bytes), 1)
+    members = _step_members(step)
+    mults = []
+    for m in members:
+        c = _registry.mem_cost_of(m.name, m.backend, m.params,
+                                  input_bytes=input_bytes)
+        if c is None:
+            mults.append(DEFAULT_STEP_MULTIPLIER)
+        elif c[0] == "bytes":
+            mults.append(max(float(c[1]) / input_bytes, 1.0))
+        else:
+            mults.append(float(c[1]))
+    kind = _step_kind(step)
+    if kind in ("fused", "sharded") and len(mults) > 1:
+        mult = 1.0 + sum(m - 1.0 for m in mults)
+    else:
+        mult = max(mults)
+    return int(input_bytes * max(mult, 1.0))
+
+
+class MemoryEstimates:
+    """The process-wide learned peak-memory model (module docstring).
+    Thread-safe; entries are ``{"bytes", "source", "corrections"}``
+    with ``source`` one of ``compiled`` (recorded from
+    ``memory_analysis()``), ``heuristic`` (never stored — computed on
+    demand) or ``corrected`` (inflated by an observed OOM; can only
+    grow)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._store: dict[tuple, dict] = {}
+
+    def record(self, sig: tuple, nbytes: int,
+               source: str = "compiled") -> int:
+        """Record a measured estimate.  A correction already in the
+        store is never DEFLATED by a later compiled record — the
+        device's refusal outranks the compiler's declaration."""
+        nbytes = int(nbytes)
+        with self._lock:
+            cur = self._store.get(sig)
+            if cur is not None and cur["corrections"] > 0:
+                if nbytes > cur["bytes"]:
+                    cur["bytes"] = nbytes
+                return cur["bytes"]
+            self._store[sig] = {"bytes": nbytes, "source": source,
+                                "corrections":
+                                    cur["corrections"] if cur else 0}
+            return nbytes
+
+    def get(self, sig: tuple) -> dict | None:
+        with self._lock:
+            e = self._store.get(sig)
+            return dict(e) if e is not None else None
+
+    def inflate(self, sig: tuple, base_bytes: int) -> int:
+        """The OOM self-correction: the stored estimate (or
+        ``base_bytes`` on first sight) inflates ×2 and is marked
+        corrected.  Returns the new estimate."""
+        with self._lock:
+            cur = self._store.get(sig)
+            base = max(int(base_bytes),
+                       cur["bytes"] if cur is not None else 0, 1)
+            new = int(base * OOM_INFLATE_FACTOR)
+            self._store[sig] = {
+                "bytes": new, "source": "corrected",
+                "corrections": (cur["corrections"] + 1
+                                if cur is not None else 1)}
+            return new
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {repr(k): dict(v) for k, v in self._store.items()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._store.clear()
+
+
+_DEFAULT_ESTIMATES = MemoryEstimates()
+
+
+def default_estimates() -> MemoryEstimates:
+    """The process-wide estimate store — 'process-wide' is the
+    contract that lets a compiled record (or OOM correction) from one
+    run serve the admission ruling of the next."""
+    return _DEFAULT_ESTIMATES
+
+
+def step_estimate(step, input_bytes: int,
+                  estimates: MemoryEstimates | None = None) -> dict:
+    """Best available peak estimate for one step:
+    ``{"bytes", "source"}`` — the learned store first (compiled /
+    corrected), the ``mem_cost`` heuristic otherwise."""
+    est = estimates if estimates is not None else _DEFAULT_ESTIMATES
+    rec = est.get(step_sig(step, input_bytes))
+    if rec is not None:
+        return {"bytes": rec["bytes"], "source": rec["source"]}
+    return {"bytes": heuristic_estimate(step, input_bytes),
+            "source": "heuristic"}
+
+
+def estimate_run_peak(pipeline, data=None, *, input_bytes: int | None
+                      = None, estimates: MemoryEstimates | None
+                      = None) -> dict:
+    """Peak-memory estimate for one run at admission time: the max
+    over its steps' estimates (steps execute sequentially — their
+    peaks never stack), floored at the input's own resident bytes.
+    Returns ``{"bytes", "per_step": [{name, bytes, source}]}``."""
+    if input_bytes is None:
+        input_bytes = data_nbytes(data) if data is not None else 1
+    input_bytes = max(int(input_bytes), 1)
+    per_step = []
+    peak = input_bytes
+    for t in getattr(pipeline, "steps", []):
+        e = step_estimate(t, input_bytes, estimates)
+        per_step.append({"name": getattr(t, "name", "?"), **e})
+        peak = max(peak, e["bytes"])
+    return {"bytes": int(peak), "per_step": per_step}
+
+
+# ---------------------------------------------------------------------------
+# The budget
+# ---------------------------------------------------------------------------
+
+
+def detect_budget_bytes() -> int | None:
+    """Device-memory capacity for this process: the
+    ``SCTOOLS_MEM_BUDGET_BYTES`` env cap when set (CI's fake HBM),
+    else the first local device's reported ``bytes_limit`` (real TPU
+    platforms report one; CPU reports nothing → ``None``, and a
+    budget cannot be constructed without an explicit capacity)."""
+    env = os.environ.get("SCTOOLS_MEM_BUDGET_BYTES")
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            raise ValueError(
+                f"SCTOOLS_MEM_BUDGET_BYTES={env!r} is not an integer "
+                f"byte count") from None
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:  # pragma: no cover - backend without stats
+        return None
+    if isinstance(stats, dict) and stats.get("bytes_limit"):
+        return int(stats["bytes_limit"])
+    return None
+
+
+class MemoryBudget:
+    """A per-backend device-memory reservation ledger (module
+    docstring).
+
+    Thread-safe.  Two reservation classes share one ledger:
+
+    * DYNAMIC — one per dispatched run (reserved by the scheduler
+      at dispatch, released at terminal or a preemption yield) or
+      per run-scoped resident (the trainer's feed window);
+    * STANDING (``standing=True``) — SERVICE-LIFETIME residents (the
+      serving model).  Standing bytes are additionally
+      subtracted from the capacity an ADMISSION ruling may promise
+      (:meth:`admissible_bytes`): a run whose estimate cannot fit
+      beside the residents at ZERO concurrency can never run here and
+      is refused ``over_memory`` at the door.
+
+    ``set_pressure(frac)`` shrinks the APPARENT capacity (chaos
+    ``mem_pressure``, or an operator modelling fragmentation) for
+    :meth:`fits` only — admission feasibility ignores pressure on
+    purpose (pressure is transient; refusing admission over it would
+    turn a soak blip into a hard reject).
+
+    Reserving the same name again REPLACES the previous amount (how
+    the serving tier tracks a model swap without a release window).
+    """
+
+    def __init__(self, capacity_bytes: int | None = None, *,
+                 name: str = "device", metrics=None):
+        if capacity_bytes is None:
+            capacity_bytes = detect_budget_bytes()
+        if capacity_bytes is None:
+            raise ValueError(
+                "MemoryBudget: no capacity — pass capacity_bytes=, "
+                "set SCTOOLS_MEM_BUDGET_BYTES, or run on a platform "
+                "whose devices report memory_stats()['bytes_limit']")
+        if capacity_bytes < 1:
+            raise ValueError("MemoryBudget: capacity must be >= 1 byte")
+        self.name = str(name)
+        self.capacity_bytes = int(capacity_bytes)
+        self.metrics = (metrics if metrics is not None
+                        else telemetry.default_registry())
+        self._lock = threading.RLock()
+        self._held: dict[str, dict] = {}   # name -> {bytes, tenant, standing}
+        self._pressure = 1.0
+        self.peak_reserved_bytes = 0
+        self.metrics.gauge("mem.budget_bytes").set(self.capacity_bytes)
+        self.metrics.gauge("mem.reserved_bytes").set(0)
+
+    # -- pressure ------------------------------------------------------
+    def set_pressure(self, frac: float) -> None:
+        """Shrink the apparent capacity to ``frac`` of nameplate for
+        dispatch-time :meth:`fits` rulings (chaos ``mem_pressure``).
+        Reservations already held are untouched."""
+        with self._lock:
+            self._pressure = min(max(float(frac), 0.0), 1.0)
+
+    def clear_pressure(self) -> None:
+        with self._lock:
+            self._pressure = 1.0
+
+    @property
+    def pressure(self) -> float:
+        with self._lock:
+            return self._pressure
+
+    # -- ledger --------------------------------------------------------
+    def _reserved_locked(self, standing_only: bool = False) -> int:
+        return sum(r["bytes"] for r in self._held.values()
+                   if r["standing"] or not standing_only)
+
+    def reserved_bytes(self) -> int:
+        with self._lock:
+            return self._reserved_locked()
+
+    def standing_bytes(self) -> int:
+        with self._lock:
+            return self._reserved_locked(standing_only=True)
+
+    def available_bytes(self) -> int:
+        """What a dispatch may still reserve right now — apparent
+        (pressure-scaled) capacity minus everything held."""
+        with self._lock:
+            return int(self.capacity_bytes * self._pressure) \
+                - self._reserved_locked()
+
+    def admissible_bytes(self) -> int:
+        """The largest estimate admission may promise to EVER run:
+        nameplate capacity minus the standing residents.  Pressure is
+        deliberately excluded (transient; see class docstring)."""
+        with self._lock:
+            return self.capacity_bytes \
+                - self._reserved_locked(standing_only=True)
+
+    def fits(self, nbytes: int) -> bool:
+        return int(nbytes) <= self.available_bytes()
+
+    def reserve(self, name: str, nbytes: int, *,
+                tenant: str | None = None,
+                standing: bool = False) -> int:
+        """Hold ``nbytes`` under ``name`` (replacing any previous
+        hold of that name).  Returns total reserved bytes after."""
+        nbytes = max(int(nbytes), 0)
+        with self._lock:
+            self._held[str(name)] = {"bytes": nbytes, "tenant": tenant,
+                                     "standing": bool(standing)}
+            total = self._reserved_locked()
+            if total > self.peak_reserved_bytes:
+                self.peak_reserved_bytes = total
+            # gauge set INSIDE the lock: two racing mutations setting
+            # it after release would leave the last writer's stale
+            # total standing until the next mutation
+            self.metrics.gauge("mem.reserved_bytes").set(total)
+        return total
+
+    def release(self, name: str) -> int:
+        """Drop the hold under ``name`` (idempotent).  Returns total
+        reserved bytes after."""
+        with self._lock:
+            self._held.pop(str(name), None)
+            total = self._reserved_locked()
+            self.metrics.gauge("mem.reserved_bytes").set(total)
+        return total
+
+    def holders(self) -> dict:
+        """Report-ready ledger view: ``{name: {bytes, tenant,
+        standing}}``."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._held.items()}
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"name": self.name,
+                    "capacity_bytes": self.capacity_bytes,
+                    "reserved_bytes": self._reserved_locked(),
+                    "standing_bytes":
+                        self._reserved_locked(standing_only=True),
+                    "peak_reserved_bytes": self.peak_reserved_bytes,
+                    "pressure": self._pressure,
+                    "holders": {k: dict(v)
+                                for k, v in self._held.items()}}
+
+    def __repr__(self):
+        s = self.snapshot()
+        return (f"MemoryBudget({self.name!r}, "
+                f"{s['reserved_bytes']}/{s['capacity_bytes']} bytes "
+                f"reserved, pressure={s['pressure']:g})")
+
+
+# ---------------------------------------------------------------------------
+# Thread-local budget handoff (the scheduler-worker → op seam)
+# ---------------------------------------------------------------------------
+
+_BUDGETS = threading.local()
+
+
+def _budget_stack() -> list:
+    stack = getattr(_BUDGETS, "stack", None)
+    if stack is None:
+        stack = _BUDGETS.stack = []
+    return stack
+
+
+class budget_scope:
+    """Make ``budget`` the current memory budget for the enclosed
+    block ON THIS THREAD (the scheduler worker installs its pool's
+    budget around each dispatched run; ``current_budget()`` deep
+    inside an op — the streaming trainer's feed — finds it without
+    parameter plumbing)."""
+
+    def __init__(self, budget: MemoryBudget | None):
+        self.budget = budget
+
+    def __enter__(self):
+        _budget_stack().append(self.budget)
+        return self.budget
+
+    def __exit__(self, *exc):
+        _budget_stack().remove(self.budget)
+        return False
+
+
+def current_budget() -> MemoryBudget | None:
+    stack = _budget_stack()
+    return stack[-1] if stack else None

@@ -70,6 +70,7 @@ import warnings
 import jax
 import numpy as np
 
+from .. import memory as _memory
 from ..data.shardstore import ShardStore
 from ..data.stream import _prefetch_iter
 from ..registry import register
@@ -187,7 +188,8 @@ def fit_scvi_stream(store, *, n_latent: int = 10, n_hidden: int = 128,
                     checkpoint_every: int = 1, order_block: int = 4,
                     prefetch: bool = True, prefetch_depth: int = 2,
                     encode: bool = False, preempt=None,
-                    clock=None, metrics=None, journal=None) -> dict:
+                    clock=None, metrics=None, journal=None,
+                    mem_budget=None) -> dict:
     """Train the NB-VAE (``models/scvi.py`` generative model, no
     batch covariate) out-of-core over a :class:`ShardStore` — the
     module docstring has the crash/preemption contract.
@@ -221,6 +223,23 @@ def fit_scvi_stream(store, *, n_latent: int = 10, n_hidden: int = 128,
     journal
         ``runner._Journal``-shaped object or a path; receives the
         ``train_*``/``preempted`` events.
+    mem_budget : memory.MemoryBudget | None
+        Device-memory budget the feed window holds a NAMED
+        reservation against for the training's lifetime
+        (``prefetch_depth + 1`` decoded dense shards — the
+        double-buffered device feed's live set), journaled
+        ``mem_reserved``/``mem_released``.  Deliberately DYNAMIC, not
+        standing: the hold is run-scoped (released when this call
+        returns or yields), so it tightens dispatch-time fit rulings
+        — beside the run's own admission reservation, conservatively
+        — without shrinking ``admissible_bytes()`` and permanently
+        shedding queued work that would fit the moment training ends
+        (only service-lifetime residents like the serving model are
+        standing).  ``None`` falls back to the thread's current
+        budget (``memory.current_budget()`` — installed by a
+        ``RunScheduler`` worker whose pool carries one), so a
+        scheduler-admitted training job contends honestly with
+        serving traffic without any parameter plumbing.
 
     Returns ``{"params", "history", "epochs_run", "resumed_from",
     "latent"}`` (``latent`` only with ``encode=True``).
@@ -376,100 +395,136 @@ def fit_scvi_stream(store, *, n_latent: int = 10, n_hidden: int = 128,
     stall_c = m.counter("train.stall_s")
     overlap_c = m.counter("train.overlap_s")
 
-    while cur.epoch < epochs:
-        ep = cur.epoch
-        order = epoch_shard_order(n_shards, ep, seed,
-                                  block=order_block)
-        klw = jnp.float32(min(1.0, (ep + 1) / max(kl_warmup, 1)))
-        ke = jax.random.fold_in(key, ep)
-        tail = [int(s) for s in order[cur.pos:]]
+    # the device feed's live set — up to prefetch_depth+1 decoded
+    # DENSE shards at once — holds a named DYNAMIC reservation
+    # against the memory budget (explicit mem_budget=, or the
+    # scheduler worker's thread-local budget_scope) for the
+    # training's lifetime, so serving queries sharing the device
+    # contend for what is actually left.  Dynamic on purpose: a
+    # run-scoped hold must tighten dispatch fitting, not the
+    # admission-feasibility floor (a STANDING hold would permanently
+    # shed queued work that fits the moment training ends).  Released
+    # on EVERY exit — completion, preemption yield, crash — by the
+    # finally below.
+    budget = (mem_budget if mem_budget is not None
+              else _memory.current_budget())
+    feed_name = f"train:feed:{id(cur)}"
+    feed_bytes = 0
+    feed_reserved = False
+    try:
+        if budget is not None:
+            # INSIDE the try: a raising journal append right after
+            # the reserve must still reach the release below, or the
+            # phantom hold starves a shared pool's dispatch forever
+            depth = prefetch_depth if prefetch else 0
+            feed_bytes = (depth + 1) * store.shard_rows * n_genes * 4
+            reserved = budget.reserve(feed_name, feed_bytes)
+            feed_reserved = True
+            if journal is not None:
+                journal.write("mem_reserved", name=feed_name,
+                              bytes=feed_bytes,
+                              reserved_total=reserved)
+        while cur.epoch < epochs:
+            ep = cur.epoch
+            order = epoch_shard_order(n_shards, ep, seed,
+                                      block=order_block)
+            klw = jnp.float32(min(1.0, (ep + 1) / max(kl_warmup, 1)))
+            ke = jax.random.fold_in(key, ep)
+            tail = [int(s) for s in order[cur.pos:]]
 
-        def feed(tail=tail):
-            if scheduler is not None:
-                yield from scheduler.iter_order(tail)
-            else:
-                for si in tail:
-                    yield store.read_shard(si)
+            def feed(tail=tail):
+                if scheduler is not None:
+                    yield from scheduler.iter_order(tail)
+                else:
+                    for si in tail:
+                        yield store.read_shard(si)
 
-        it = (_prefetch_iter(feed, depth=prefetch_depth,
-                             prepare=to_device_dense, clock=clock,
-                             metrics=m, stall_counter=stall_c,
-                             overlap_counter=overlap_c)
-              if prefetch else
-              (to_device_dense(sh) for sh in feed()))
-        try:
-            for Xd, rows in it:
-                shard = int(order[cur.pos])
-                bs = min(batch_size, rows)
-                n_steps = max(rows // bs, 1)
-                perm = jnp.asarray(_shard_perm(
-                    rows, n_steps * bs, seed, ep, shard))
-                oh = jnp.zeros((Xd.shape[0], 0), jnp.float32)
-                ks = jax.random.fold_in(ke, cur.pos)
-                params, opt_state, loss = _train_epoch(
-                    params, opt_state, Xd, oh, perm, ks, klw,
-                    n_steps=n_steps, batch_size=bs)
-                # the fetch is the per-shard sync point: the journal
-                # and the cursor need host values anyway, and it makes
-                # the consumer wall real for the overlap accounting
-                loss_f = float(loss)
-                cur.loss_sum += loss_f * n_steps
-                cur.loss_steps += n_steps
-                cur.step += n_steps
-                cur.pos += 1
-                m.counter("train.steps").inc(n_steps)
-                m.counter("train.shards").inc()
-                # save BEFORE journaling the shard: a kill between the
-                # two leaves a journal gap, never a replayed shard —
-                # the (epoch, pos) uniqueness proof rests on this
-                # order AND on checkpoint_every=1; a coarser cadence
-                # trades it away (a kill between saves replays up to
-                # checkpoint_every-1 shards, honestly re-journaled as
-                # repeated pairs)
-                if (cur.pos % checkpoint_every == 0
-                        or cur.pos >= len(order)):
-                    save_cursor()
-                if journal is not None:
-                    journal.write("train_shard", epoch=ep,
-                                  pos=cur.pos - 1, shard=shard,
-                                  loss=round(loss_f, 6),
-                                  steps=n_steps)
-                r = poll_preempt()
-                if r is not None:
-                    yield_now(r)
-        finally:
-            close = getattr(it, "close", None)
-            if close is not None:
-                close()  # stop the prefetch worker + flush counters
-        loss_ep = cur.loss_sum / max(cur.loss_steps, 1)
-        cur.history.append(loss_ep)
-        cur.epoch += 1
-        cur.pos = 0
-        cur.loss_sum = 0.0
-        cur.loss_steps = 0
-        m.counter("train.epochs").inc()
-        m.gauge("train.loss", epoch=ep).set(loss_ep)
-        save_cursor()
-        if journal is not None:
-            journal.write("train_epoch", epoch=ep,
-                          loss=round(loss_ep, 6), step=cur.step)
+            it = (_prefetch_iter(feed, depth=prefetch_depth,
+                                 prepare=to_device_dense, clock=clock,
+                                 metrics=m, stall_counter=stall_c,
+                                 overlap_counter=overlap_c)
+                  if prefetch else
+                  (to_device_dense(sh) for sh in feed()))
+            try:
+                for Xd, rows in it:
+                    shard = int(order[cur.pos])
+                    bs = min(batch_size, rows)
+                    n_steps = max(rows // bs, 1)
+                    perm = jnp.asarray(_shard_perm(
+                        rows, n_steps * bs, seed, ep, shard))
+                    oh = jnp.zeros((Xd.shape[0], 0), jnp.float32)
+                    ks = jax.random.fold_in(ke, cur.pos)
+                    params, opt_state, loss = _train_epoch(
+                        params, opt_state, Xd, oh, perm, ks, klw,
+                        n_steps=n_steps, batch_size=bs)
+                    # the fetch is the per-shard sync point: the
+                    # journal and the cursor need host values anyway,
+                    # and it makes the consumer wall real for the
+                    # overlap accounting
+                    loss_f = float(loss)
+                    cur.loss_sum += loss_f * n_steps
+                    cur.loss_steps += n_steps
+                    cur.step += n_steps
+                    cur.pos += 1
+                    m.counter("train.steps").inc(n_steps)
+                    m.counter("train.shards").inc()
+                    # save BEFORE journaling the shard: a kill between
+                    # the two leaves a journal gap, never a replayed
+                    # shard — the (epoch, pos) uniqueness proof rests
+                    # on this order AND on checkpoint_every=1; a
+                    # coarser cadence trades it away (a kill between
+                    # saves replays up to checkpoint_every-1 shards,
+                    # honestly re-journaled as repeated pairs)
+                    if (cur.pos % checkpoint_every == 0
+                            or cur.pos >= len(order)):
+                        save_cursor()
+                    if journal is not None:
+                        journal.write("train_shard", epoch=ep,
+                                      pos=cur.pos - 1, shard=shard,
+                                      loss=round(loss_f, 6),
+                                      steps=n_steps)
+                    r = poll_preempt()
+                    if r is not None:
+                        yield_now(r)
+            finally:
+                close = getattr(it, "close", None)
+                if close is not None:
+                    close()  # stop the prefetch worker + flush counters
+            loss_ep = cur.loss_sum / max(cur.loss_steps, 1)
+            cur.history.append(loss_ep)
+            cur.epoch += 1
+            cur.pos = 0
+            cur.loss_sum = 0.0
+            cur.loss_steps = 0
+            m.counter("train.epochs").inc()
+            m.gauge("train.loss", epoch=ep).set(loss_ep)
+            save_cursor()
+            if journal is not None:
+                journal.write("train_epoch", epoch=ep,
+                              loss=round(loss_ep, 6), step=cur.step)
 
-    out = {"params": params, "history": np.asarray(cur.history,
-                                                   np.float64),
-           "epochs_run": cur.epoch, "resumed_from": resumed_from,
-           "latent": None}
-    if encode:
-        from .scvi import _encode
+        out = {"params": params, "history": np.asarray(cur.history,
+                                                       np.float64),
+               "epochs_run": cur.epoch, "resumed_from": resumed_from,
+               "latent": None}
+        if encode:
+            from .scvi import _encode
 
-        parts = []
-        it = (scheduler.iter_shards() if scheduler is not None
-              else store.iter_shards())
-        for sh in it:
-            d = sh.device_put()
-            oh = jnp.zeros((d.rows_padded, 0), jnp.float32)
-            parts.append(np.asarray(
-                _encode(params, d.to_dense(), oh))[: sh.n_cells])
-        out["latent"] = np.concatenate(parts, axis=0)
+            parts = []
+            it = (scheduler.iter_shards() if scheduler is not None
+                  else store.iter_shards())
+            for sh in it:
+                d = sh.device_put()
+                oh = jnp.zeros((d.rows_padded, 0), jnp.float32)
+                parts.append(np.asarray(
+                    _encode(params, d.to_dense(), oh))[: sh.n_cells])
+            out["latent"] = np.concatenate(parts, axis=0)
+    finally:
+        if budget is not None and feed_reserved:
+            total = budget.release(feed_name)
+            if journal is not None:
+                journal.write("mem_released", name=feed_name,
+                              bytes=feed_bytes, reserved_total=total)
     if checkpoint is not None:
         clear_npz_generations(checkpoint)  # done; cursor is stale
     return out

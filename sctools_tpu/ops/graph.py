@@ -195,8 +195,22 @@ def _fusable_unless_pallas(_params: dict) -> bool:
     return resolved_impl() != "pallas"
 
 
+def _jaccard_mem_shrink(params: dict) -> dict | None:
+    """OOM-ladder middle rung (``registry mem_shrink=``): halve the
+    row-tile size — the device path's per-tile working set
+    (``(block, k, k)`` gathers and match masks) halves with it while
+    the result is bitwise unchanged (``block`` only tiles the rows).
+    Floor 64: below that the tile no longer dominates the live set."""
+    b = int(params.get("block", 1024))
+    if b <= 64:
+        return None
+    params["block"] = b // 2
+    return params
+
+
 @register("graph.jaccard", backend="tpu",
-          fusable=_fusable_unless_pallas, sharding="cells")
+          fusable=_fusable_unless_pallas, sharding="cells",
+          mem_cost=3.0, mem_shrink=_jaccard_mem_shrink)
 def jaccard_tpu(data: CellData, block: int = 1024) -> CellData:
     """Adds obsp["jaccard"] (aligned with knn_indices).  Runs through
     the tiled graph-kernel family (ops/pallas_graph.py): the banded

@@ -306,7 +306,8 @@ def _hvg_fusable(params: dict) -> bool:
             in ("seurat_v3", "dispersion", "seurat"))
 
 
-@register("hvg.select", backend="tpu", fusable=_hvg_fusable)
+@register("hvg.select", backend="tpu", fusable=_hvg_fusable,
+          mem_cost=2.5)
 def hvg_select_tpu(data: CellData, n_top: int = 2000,
                    flavor: str = "seurat_v3", subset: bool = False,
                    compact: bool = True,

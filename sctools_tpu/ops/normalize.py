@@ -66,7 +66,8 @@ def _he_gene_flag_device(x: SparseCells, totals, max_fraction):
     return segment_reduce(x, slot_vals, 1)[:, 0] > 0
 
 
-@register("normalize.library_size", backend="tpu", fusable=True)
+@register("normalize.library_size", backend="tpu", fusable=True,
+          mem_cost=2.5)
 def library_size_tpu(data: CellData, target_sum: float | None = 1e4,
                      exclude_highly_expressed: bool = False,
                      max_fraction: float = 0.05) -> CellData:
@@ -191,7 +192,8 @@ def log1p_cpu(data: CellData) -> CellData:
 # ----------------------------------------------------------------------
 
 
-@register("normalize.scale", backend="tpu", fusable=True)
+@register("normalize.scale", backend="tpu", fusable=True,
+          mem_cost=3.0)
 def scale_tpu(data: CellData, max_value: float | None = 10.0,
               zero_center: bool = True) -> CellData:
     """Per-gene standardisation (unit variance, optionally zero mean).
@@ -249,7 +251,8 @@ def _pearson_residuals_math(X_dense, totals, gene_sums, grand, theta,
     return xp.clip(Z, -c, c)
 
 
-@register("normalize.pearson_residuals", backend="tpu", fusable=True)
+@register("normalize.pearson_residuals", backend="tpu",
+          fusable=True, mem_cost=4.0)
 def pearson_residuals_tpu(data: CellData, theta: float = 100.0,
                           clip: float | None = None) -> CellData:
     """Analytic Pearson residuals of an NB offset model (Lause et al.

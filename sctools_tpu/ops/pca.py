@@ -128,7 +128,8 @@ def randomized_pca_arrays(X, key, n_components: int = 50, oversample: int = 10,
     return scores, components, explained, mu
 
 
-@register("pca.randomized", backend="tpu", fusable=True)
+@register("pca.randomized", backend="tpu", fusable=True,
+          mem_cost=4.0)
 def pca_randomized_tpu(data: CellData, n_components: int = 50,
                        oversample: int = 10, n_iter: int = 2,
                        center: bool = True, seed: int = 0,

@@ -76,6 +76,7 @@ import warnings
 import jax
 import numpy as np
 
+from . import memory as _mem_model
 from . import registry as _registry
 from .registry import Pipeline, Transform
 from .utils import telemetry, trace
@@ -254,6 +255,34 @@ def _pick_in_sharding(v, mesh, sig, n_dev: int):
     return _rule_sharding(getattr(v, "shape", ()), mesh, n_dev, "cells")
 
 
+def _aot_placement_refusal(e: BaseException) -> bool:
+    """True for the ONE error the AOT ``Compiled.__call__`` raises
+    that the dispatch path would have handled silently: an input
+    committed to a device/sharding the executable was not compiled
+    for (``jax.jit`` reshards it; the AOT call refuses).  Matched by
+    message because jax raises a plain ValueError — which must NOT be
+    confused with the trace-failure ValueErrors that rule a permanent
+    eager fallback."""
+    return (isinstance(e, ValueError)
+            and "Compiled object called with input sharding" in str(e))
+
+
+def _compiled_peak_bytes(compiled) -> int | None:
+    """Peak device bytes an XLA executable declares for one
+    invocation: arguments resident + outputs + the temp arena, minus
+    input/output aliasing (donated buffers are not double-counted).
+    ``None`` when the platform's executable exposes no analysis — the
+    caller falls back to the ``mem_cost`` heuristic."""
+    try:
+        ma = compiled.memory_analysis()
+        if ma is None:
+            return None
+        return int(ma.argument_size_in_bytes + ma.output_size_in_bytes
+                   + ma.temp_size_in_bytes - ma.alias_size_in_bytes)
+    except Exception:  # pragma: no cover - platform without analysis
+        return None
+
+
 class _StageProgram:
     """One compiled fused stage: the jitted callable plus the output
     reassembly spec captured at trace time.  ``out_map`` rebuilds the
@@ -262,13 +291,40 @@ class _StageProgram:
     gene names, uns scalars), ``("const", v)`` a value created during
     the trace."""
 
-    __slots__ = ("jitted", "out_treedef", "out_mask", "out_map")
+    __slots__ = ("jitted", "dispatch", "out_treedef", "out_mask",
+                 "out_map")
 
-    def __init__(self, jitted, out_treedef, out_mask, out_map):
+    def __init__(self, jitted, out_treedef, out_mask, out_map,
+                 dispatch=None):
         self.jitted = jitted
+        #: the jax.jit dispatch form, kept ONLY while ``jitted`` is an
+        #: AOT executable: a later call whose inputs arrive committed
+        #: to another device/sharding is refused by the AOT call but
+        #: re-placed by dispatch (see ``call``)
+        self.dispatch = dispatch
         self.out_treedef = out_treedef
         self.out_mask = out_mask
         self.out_map = out_map
+
+    def call(self, traced):
+        try:
+            return self.jitted(traced)
+        except Exception as e:
+            if not _aot_placement_refusal(e):
+                raise
+            # strictly-placed inputs the AOT executable refuses: the
+            # dispatch path re-places them — and keeps serving this
+            # entry from now on (one executable re-compile, once).
+            # Swap order matters under concurrency: ``jitted`` is
+            # written FIRST, so a racing caller that finds
+            # ``dispatch`` already consumed retries ``self.jitted``
+            # and gets the dispatch form the winner installed (a
+            # never-AOT program cannot raise the refusal at all).
+            dispatch = self.dispatch
+            if dispatch is not None:
+                self.jitted = dispatch
+                self.dispatch = None
+            return self.jitted(traced)
 
     def rebuild(self, out_traced, in_opaque):
         out_opaque = [in_opaque[j] if kind == "in" else v
@@ -329,6 +385,19 @@ class FusedTransform:
         return _UnfusedChain(
             [t.with_backend(backend) for t in self.members],
             backend, self.name, self.params)
+
+    def unfuse(self):
+        """The same member chain executed step by step on the SAME
+        backend — the OOM containment ladder's FIRST rung.  One fused
+        program holds every member's intermediates in one live set
+        (plus XLA's temp arena for the whole chain); the unfused
+        chain frees each member's intermediates before the next
+        dispatches, trading the fusion win back for peak-memory
+        headroom.  Results are identical; ``name``/``params`` are
+        kept, so journal records and checkpoint fingerprints stay
+        joined across the ruling."""
+        return _UnfusedChain(list(self.members), self.backend,
+                             self.name, self.params)
 
     def replan(self, n_devices: int | None, devices=None):
         """The same member chain planned for ``n_devices`` (``None``
@@ -461,7 +530,7 @@ class FusedTransform:
                               "cached": prog is not None}):
             if prog is not None:
                 m.counter("plan.cache_hits").inc()
-                out_traced = prog.jitted(traced)
+                out_traced = prog.call(traced)
                 m.counter("plan.fused_ops").inc(n_ops)
                 return prog.rebuild(out_traced, opaque)
             # miss: trace + compile + execute in one first call
@@ -501,8 +570,36 @@ class FusedTransform:
             if mesh is not None:
                 jit_kw["in_shardings"] = (in_shards,)
             jitted = jax.jit(fused, **jit_kw)
+            exec_fn = jitted
+            peak_bytes = None
             try:
-                out_traced = jitted(traced)
+                if mesh is None:
+                    # AOT lower → compile: ONE XLA compile serves both
+                    # execution and the PEAK-MEMORY ESTIMATE the
+                    # memory fault domain records per plan-cache entry
+                    # (compiled.memory_analysis(); the dispatch path
+                    # exposes no executable to ask).  Mesh-sharded
+                    # stages keep the dispatch path — an AOT call
+                    # refuses committed inputs arriving from another
+                    # mesh where jit reshards them, so their entries
+                    # carry the mem_cost heuristic instead.
+                    compiled = jitted.lower(traced).compile()
+                    peak_bytes = _compiled_peak_bytes(compiled)
+                    try:
+                        out_traced = compiled(traced)
+                        exec_fn = compiled
+                    except Exception as e:
+                        # the AOT call validates input placement
+                        # strictly (a ValueError that must NOT be
+                        # mistaken for a trace failure); the dispatch
+                        # path re-places — identical program, second
+                        # compile accepted.  Everything else re-raises
+                        # into the trace-failure ruling below.
+                        if not _aot_placement_refusal(e):
+                            raise
+                        out_traced = jitted(traced)
+                else:
+                    out_traced = jitted(traced)
             except (jax.errors.JAXTypeError, TypeError, ValueError,
                     NotImplementedError) as e:
                 # the chain does not trace (host sync / concretisation
@@ -519,26 +616,38 @@ class FusedTransform:
                     _CACHE[key] = _FALLBACK
                     _CACHE_META[key] = self._cache_meta(traced)
                 return self._run_eager(data)
+            if peak_bytes is not None:
+                # the learned estimate the admission layer consults:
+                # keyed by (stage chain, input-size bucket), so a
+                # rebuilt pipeline over same-bucket data reads the
+                # compiled number instead of the mem_cost heuristic
+                input_bytes = sum(int(v.nbytes) for v in traced)
+                _mem_model.default_estimates().record(
+                    _mem_model.step_sig(self, input_bytes),
+                    peak_bytes, source="compiled")
             out_opaque, out_treedef, out_mask = box["spec"]
             opaque_pos = {id(v): j for j, v in enumerate(opaque)}
             out_map = tuple(
                 ("in", opaque_pos[id(v)], None) if id(v) in opaque_pos
                 else ("const", -1, v)
                 for v in out_opaque)
-            prog = _StageProgram(jitted, out_treedef, out_mask, out_map)
+            prog = _StageProgram(
+                exec_fn, out_treedef, out_mask, out_map,
+                dispatch=jitted if exec_fn is not jitted else None)
             with _CACHE_LOCK:
                 _CACHE[key] = prog
-                _CACHE_META[key] = self._cache_meta(traced)
+                _CACHE_META[key] = self._cache_meta(traced, peak_bytes)
             m.counter("plan.fused_ops").inc(n_ops)
             return prog.rebuild(out_traced, opaque)
 
-    def _cache_meta(self, traced) -> dict:
+    def _cache_meta(self, traced, peak_bytes: int | None = None) -> dict:
         return {
             "ops": [t.name for t in self.members],
             "backend": self.backend,
             "shapes": [f"{tuple(v.shape)}:{v.dtype}" for v in traced],
             "mesh": (None if self.mesh is None
                      else self.params["mesh"]),
+            "peak_bytes": peak_bytes,
         }
 
 

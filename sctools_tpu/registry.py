@@ -62,6 +62,26 @@ _FUSABLE: dict[str, dict[str, object]] = {}
 _SHARDING: dict[str, dict[str, object]] = {}
 _COLLECTIVE: dict[str, dict[str, object]] = {}
 
+# memory-domain metadata (sctools_tpu/memory.py's estimate model and
+# the runner's OOM containment ladder):
+#
+# _MEM_COST: name -> backend -> number | callable(params, input_bytes)
+#   -> bytes.  Declares the op's PEAK device-memory footprint for the
+#   admission estimator: a number is a multiplier over the input's
+#   array bytes (2.0 = inputs resident + a same-sized output, the
+#   default for unregistered ops), a callable computes peak BYTES
+#   from the bound params and the input size.  Estimates learned from
+#   compiled programs (``memory_analysis()``) and OOM corrections
+#   override the heuristic once observed.
+#
+# _MEM_SHRINK: name -> backend -> callable(params) -> params | None.
+#   Declares how to RE-PLAN the op at a smaller live set — the OOM
+#   ladder's middle rung (halve a batch/tile/block param; return None
+#   when already at the floor).  Must preserve results: shrinking may
+#   only change HOW the op tiles its work, never what it computes.
+_MEM_COST: dict[str, dict[str, object]] = {}
+_MEM_SHRINK: dict[str, dict[str, object]] = {}
+
 DEFAULT_BACKEND = "tpu"
 
 # ---------------------------------------------------------------------------
@@ -151,8 +171,9 @@ class UnknownBackendError(KeyError):
 
 
 def register(name: str, backend: str = "tpu",
-             fusable=False, sharding=None,
-             collective=False) -> Callable[[Callable], Callable]:
+             fusable=False, sharding=None, collective=False,
+             mem_cost=None,
+             mem_shrink=None) -> Callable[[Callable], Callable]:
     """Decorator: register ``fn`` as the implementation of ``name`` for
     ``backend``.
 
@@ -174,6 +195,17 @@ def register(name: str, backend: str = "tpu",
     stage, threading the plan's mesh into the call, instead of
     tracing it under GSPMD.
 
+    ``mem_cost`` (number | ``callable(params, input_bytes) -> bytes``)
+    declares the op's peak device-memory footprint for the memory
+    fault domain's admission estimator (``sctools_tpu/memory.py``): a
+    number is a multiplier over the input's array bytes, a callable
+    computes peak bytes outright.  ``mem_shrink``
+    (``callable(params) -> params | None``) declares how to re-plan
+    the op at a smaller live set — the OOM containment ladder's
+    middle rung (halve a batch/tile param; ``None`` = at the floor).
+    A shrink must preserve results: it may change how the op tiles
+    its work, never what it computes.
+
     >>> @register("normalize.log1p", backend="tpu", fusable=True)
     ... def log1p_tpu(data, **kw): ...
     """
@@ -186,6 +218,10 @@ def register(name: str, backend: str = "tpu",
             _SHARDING.setdefault(name, {})[backend] = sharding
         if collective:
             _COLLECTIVE.setdefault(name, {})[backend] = collective
+        if mem_cost is not None:
+            _MEM_COST.setdefault(name, {})[backend] = mem_cost
+        if mem_shrink is not None:
+            _MEM_SHRINK.setdefault(name, {})[backend] = mem_shrink
         if fn.__doc__ and name not in _DOCS:
             _DOCS[name] = fn.__doc__
         return fn
@@ -228,6 +264,41 @@ def sharding_of(name: str, backend: str,
             f"transform {name!r} declared sharding={s!r}; "
             f"use 'cells' or 'replicated'")
     return s
+
+
+def mem_cost_of(name: str, backend: str, params: dict | None = None,
+                input_bytes: int | None = None):
+    """The op's declared peak-memory cost, or ``None`` when
+    unregistered.  Returns a tagged tuple: ``("mult", m)`` for a
+    numeric multiplier over input bytes, ``("bytes", n)`` for a
+    callable evaluated against the bound params and ``input_bytes``.
+    A callable with no ``input_bytes`` to evaluate against returns
+    ``None`` — the caller falls back to the default multiplier."""
+    c = _MEM_COST.get(name, {}).get(backend)
+    if c is None:
+        return None
+    if callable(c):
+        if input_bytes is None:
+            return None
+        return ("bytes", int(c(dict(params or {}), int(input_bytes))))
+    return ("mult", float(c))
+
+
+def mem_shrink_of(name: str, backend: str,
+                  params: dict | None = None) -> dict | None:
+    """Re-planned params for the op at a smaller live set (the OOM
+    ladder's middle rung), or ``None`` when the op registered no
+    ``mem_shrink`` or is already at its floor.  Identical returned
+    params also count as the floor — a 'shrink' that changes nothing
+    would loop the ladder forever."""
+    s = _MEM_SHRINK.get(name, {}).get(backend)
+    if s is None:
+        return None
+    old = dict(params or {})
+    new = s(dict(old))
+    if new is None or dict(new) == old:
+        return None
+    return dict(new)
 
 
 def get(name: str, backend: str = DEFAULT_BACKEND) -> Callable:

@@ -95,6 +95,7 @@ import threading as _threading
 import time
 import warnings
 
+from . import memory as _memory
 from . import registry as _registry
 from .registry import Pipeline, Transform
 from .utils import telemetry, trace
@@ -102,7 +103,7 @@ from .utils.checkpoint import (CheckpointCorruptError, data_digest,
                                load_celldata, quarantine_checkpoint,
                                save_celldata, step_filename,
                                step_fingerprint, latest_step)
-from .utils.failsafe import (DETERMINISTIC, FATAL, TRANSIENT,
+from .utils.failsafe import (DETERMINISTIC, FATAL, RESOURCE, TRANSIENT,
                              CircuitBreaker, DeadlineToken,
                              JobPreempted, StepDeadlineExceeded,
                              check_deadline, classify_child_result,
@@ -474,6 +475,7 @@ class ResilientRunner:
         self.journal = _Journal(journal_path)
         self.report = RunReport(journal_path=journal_path)
         self._input_digest: str | None = None
+        self._mem_input_bytes: int = 1
         self._breaker_degraded = False
         self._spans: list = []  # this run's attempt spans, for export
 
@@ -484,6 +486,11 @@ class ResilientRunner:
         steps = list(self.pipeline.steps)
         rng = random.Random(self.policy.seed)
         dig = self._input_digest = data_digest(data)
+        # the memory model's input-size term, measured ONCE at run
+        # start: every step's estimate key uses it, matching what the
+        # scheduler's admission estimate computed for the same data —
+        # an OOM correction recorded here is the one admission reads
+        self._mem_input_bytes = max(_memory.data_nbytes(data), 1)
         self._breaker_degraded = False
         self._spans = []
         self._inst.backend_override = None
@@ -942,6 +949,45 @@ class ResilientRunner:
                         "deadline", step=i, name=t.name, attempt=attempt,
                         budget_s=self.step_deadline_s)
                     self.metrics.counter("runner.deadline_overruns").inc()
+                if cls == RESOURCE:
+                    # device memory exhausted: neither retry (the
+                    # live set recurs at the same shapes) nor breaker
+                    # (the device is healthy, just full) — the OOM
+                    # CONTAINMENT LADDER rules: unfuse the stage
+                    # (smaller live set) → re-plan at a smaller
+                    # batch/tile (registered mem_shrink) → cpu
+                    # fallback; recurrence at the bottom rung is
+                    # ruled deterministic.  Every rung inflates the
+                    # stored peak estimate first (the self-correcting
+                    # model admission reads).
+                    if probing:
+                        # an OOM says nothing about the outage the
+                        # half-open probe was judging: release the
+                        # exclusive slot without a verdict so another
+                        # sharer can probe
+                        self.breaker.release_probe()
+                        probing = False
+                    rung, new_t = self._rule_oom(steps, i, t, b,
+                                                 degraded)
+                    if rung in ("unfuse", "replan"):
+                        t = new_t
+                        budget_used = 0
+                        replanned = True  # the re-planned form must
+                        # actually be attempted — bypass the breaker
+                        # gate once, like a mesh shrink
+                        continue
+                    if rung == "cpu":
+                        degraded = True
+                        budget_used = 0
+                        continue
+                    # bottom rung: OOM on the fallback backend (or no
+                    # fallback configured) — recurs identically, fail
+                    # fast with the real error
+                    sr.status = "failed"
+                    self.report.status = "failed"
+                    self.journal.write("run_failed", step=i,
+                                       classified=cls)
+                    raise err
                 # FATAL / DETERMINISTIC while holding the probe slot:
                 # no device verdict — the slot is released by the
                 # enclosing finally (the ONE release point; releasing
@@ -1112,6 +1158,149 @@ class ResilientRunner:
         self.report.breaker = self.breaker.snapshot()
         self._breaker_degraded = True
         return True
+
+    def _rule_oom(self, steps, i: int, t, b: str, degraded: bool):
+        """One RESOURCE-classified failure's containment ruling.
+
+        Always inflates the step's stored peak estimate first
+        (``memory.MemoryEstimates.inflate`` ×2 — the self-correcting
+        model: the next admission of this chain at this input bucket
+        believes the device, not the old estimate), then picks the
+        rung:
+
+        * ``unfuse`` — a fused stage with >1 member becomes the
+          step-by-step chain on the SAME backend (member
+          intermediates free between dispatches instead of sharing
+          one program's live set);
+        * ``replan`` — re-plan at a smaller live set via registered
+          ``mem_shrink`` metadata (halve a batch/tile param);
+          fingerprints ``i..`` refresh (the params changed, so
+          checkpoints from the larger plan never mix);
+        * ``cpu`` — remaining steps degrade to the fallback backend
+          (host memory is a different, bigger pool);
+        * ``fail`` — already on the fallback (or no fallback):
+          recurrence at the bottom rung replays identically, the
+          caller fails fast.
+
+        Journals ``degrade reason=oom rung=<rung>`` with the
+        before/after estimates; returns ``(rung, new_step | None)``.
+        """
+        input_bytes = self._mem_input_bytes
+        est = _memory.default_estimates()
+        before = _memory.step_estimate(t, input_bytes)["bytes"]
+        corrected = est.inflate(_memory.step_sig(t, input_bytes),
+                                before)
+        self.metrics.counter("mem.estimate_corrections").inc()
+        # unfuse/replan are SAME-BACKEND rungs — available whenever
+        # the step is not already on the fallback, even with
+        # fallback_backend=None (forbidding the cpu degrade must not
+        # degenerate the whole ladder to fail-fast); only the cpu
+        # rung needs a configured fallback
+        on_fallback = (self.fallback_backend is not None
+                       and b == self.fallback_backend)
+        rung, new_t = "fail", None
+        if not degraded and not on_fallback:
+            unfuse = getattr(t, "unfuse", None)
+            members = getattr(t, "members", None)
+            # a MESH-SHARDED stage never unfuses: the unfused chain
+            # runs single-device, CONCENTRATING the whole sharded
+            # input onto one device — a guaranteed re-OOM, the
+            # opposite of a smaller live set.  Sharded stages go
+            # straight to the replan rung (mesh-preserving) and the
+            # backend fallback.
+            if unfuse is not None and members is not None \
+                    and len(members) > 1 \
+                    and getattr(t, "mesh", None) is None:
+                rung, new_t = "unfuse", unfuse()
+            else:
+                new_t = self._shrink_step(t)
+                if new_t is not None:
+                    rung = "replan"
+                elif self.fallback_backend is not None:
+                    rung = "cpu"
+        self.metrics.counter("mem.oom_events", rung=rung).inc()
+        if rung == "fail":
+            warnings.warn(
+                f"ResilientRunner: step {i} ({t.name!r}) exhausted "
+                f"device memory on the BOTTOM ladder rung (backend "
+                f"{b!r}) — no rung left, failing fast (estimate "
+                f"corrected to {corrected} bytes).",
+                RuntimeWarning, stacklevel=3)
+            return rung, None
+        if new_t is not None:
+            steps[i] = new_t
+            for j in range(i, len(steps)):
+                # a shrink changes step i's params — every downstream
+                # fingerprint embeds them (unfuse keeps params: the
+                # recompute is then a no-op)
+                self.report.steps[j].fingerprint = step_fingerprint(
+                    steps, j, input_digest=self._input_digest)
+        after = (_memory.step_estimate(new_t, input_bytes)["bytes"]
+                 if new_t is not None else corrected)
+        warnings.warn(
+            f"ResilientRunner: step {i} ({t.name!r}) exhausted device "
+            f"memory — OOM ladder rung {rung!r} (estimate {before} "
+            f"-> {after} bytes, stored estimate corrected to "
+            f"{corrected}).",
+            RuntimeWarning, stacklevel=3)
+        self.journal.write(
+            "degrade", step=i, reason="oom", rung=rung,
+            from_bytes=int(before), to_bytes=int(after),
+            corrected_bytes=int(corrected),
+            fingerprint=self.report.steps[i].fingerprint)
+        self.metrics.counter("runner.degrades", reason="oom").inc()
+        if rung == "cpu":
+            # the backend-fallback bookkeeping the probe/breaker
+            # degrades share — minus the breaker (an OOM is not an
+            # outage; a sharer's probe must not un-degrade this run
+            # back into the same full device mid-run, so
+            # _breaker_degraded stays False)
+            self._inst.backend_override = "degraded"
+            self.report.degraded = True
+            self.report.backend = self.fallback_backend
+        return rung, new_t
+
+    @staticmethod
+    def _shrink_step(t):
+        """The OOM ladder's middle rung: the same step re-planned at
+        a smaller live set via registered ``mem_shrink`` metadata
+        (``registry.mem_shrink_of`` — halve a batch/tile/block
+        param).  For a chain, every member that declares a shrink
+        shrinks; returns ``None`` when nothing can (no metadata, or
+        every member at its floor)."""
+        from .plan import FusedTransform, ShardedCollective, \
+            _UnfusedChain
+
+        members = getattr(t, "members", None)
+        if members is None:
+            p2 = _registry.mem_shrink_of(t.name, t.backend, t.params)
+            if p2 is None:
+                return None
+            return Transform(t.name, backend=t.backend, **p2)
+        shrunk, any_shrunk = [], False
+        for m in members:
+            p2 = _registry.mem_shrink_of(m.name, m.backend, m.params)
+            if p2 is None:
+                shrunk.append(m)
+            else:
+                any_shrunk = True
+                shrunk.append(Transform(m.name, backend=m.backend,
+                                        **p2))
+        if not any_shrunk:
+            return None
+        if isinstance(t, ShardedCollective):
+            return ShardedCollective(shrunk[0], t.mesh,
+                                     metrics=t.metrics)
+        if isinstance(t, FusedTransform):
+            return FusedTransform(shrunk, t.backend, metrics=t.metrics,
+                                  donate=False, mesh=t.mesh)
+        if isinstance(t, _UnfusedChain):
+            # rebuilt params so checkpoint fingerprints track the
+            # shrunk member chain
+            return _UnfusedChain(
+                shrunk, t.backend, t.name,
+                {"ops": [(m.name, dict(m.params)) for m in shrunk]})
+        return None
 
     def _replan_fewer_devices(self, steps, i: int, t):
         """The sharded-stage degrade rungs.  A mesh spanning MULTIPLE

@@ -37,6 +37,25 @@ scheduling layer in front of ``runner.ResilientRunner``:
   (per BACKEND, not per run): the first run to trip the tpu breaker
   short-circuits every queued run straight to the degrade ruling,
   and one half-open probe success un-degrades the whole pool.
+* **Budgeted device memory** — with ``mem_budget=`` (a
+  :class:`~sctools_tpu.memory.MemoryBudget`), every submission's peak
+  memory is estimated at admission (learned compiled estimates + the
+  registry ``mem_cost`` heuristic, ``memory.estimate_run_peak``): an
+  estimate that cannot fit beside the standing residents at ZERO
+  concurrency is refused ``RunRejected(reason="over_memory")`` at the
+  door; an admitted run RESERVES its estimate at dispatch — work that
+  does not fit right now QUEUES instead of co-scheduling into an OOM
+  — and releases at terminal (or at a preemption yield).  Each
+  reservation is journaled ``mem_reserved``/``mem_released``; the
+  worker installs the budget thread-locally
+  (``memory.budget_scope``), so residents created inside ops — the
+  streaming trainer's feed window — hold NAMED reservations against
+  the same ledger (run-scoped holds stay dynamic; only
+  service-lifetime residents like the serving model are STANDING,
+  because standing bytes shrink what admission may ever promise).
+  Chaos ``mem_pressure`` (consulted per submission through
+  ``ChaosMonkey.on_memory``) shrinks the apparent budget for the
+  fault's window.
 * **Observability** — a JSONL journal (``submitted`` → ``admitted`` |
   ``rejected``, then ``shed`` | ``run_completed`` | ``run_failed``
   per ticket; every terminal state carries a reason) plus ``sched.*``
@@ -84,6 +103,7 @@ import dataclasses
 import os
 import threading
 
+from . import memory as _memory
 from .registry import Pipeline
 from .runner import (DEFAULT_FALLBACK_BACKEND, ResilientRunner,
                      _Journal, run_backend_signature)
@@ -106,9 +126,9 @@ _EWMA_ALPHA = 0.3
 class RunRejected(RuntimeError):
     """A submission refused AT ADMISSION.  ``reason`` is machine-
     readable (``tenant_queue_quota`` / ``deadline_unmeetable`` /
-    ``queue_full`` / ``reject_storm`` / ``scheduler_closed``) and
-    matches the journal record and the ``sched.rejected`` metric
-    label."""
+    ``queue_full`` / ``reject_storm`` / ``scheduler_closed`` /
+    ``over_memory``) and matches the journal record and the
+    ``sched.rejected`` metric label."""
 
     def __init__(self, msg: str, *, reason: str,
                  tenant: str | None = None):
@@ -256,6 +276,9 @@ class _QueueItem:
     token: PreemptToken | None = None
     #: times this ticket checkpoint-then-yielded so far
     preemptions: int = 0
+    #: estimated peak device-memory bytes (0 = no budget configured);
+    #: reserved at dispatch, released at terminal/yield
+    mem_bytes: int = 0
 
     def sort_key(self):
         # higher priority first, FIFO within a priority
@@ -301,7 +324,12 @@ class RunScheduler:
         Armed ONCE for the pool's lifetime (faults fire on every
         worker thread; the runner's own activation is a no-op while
         the pool holds the hook) and consulted at admission for
-        ``reject_storm`` faults.
+        ``reject_storm`` faults (plus ``mem_pressure`` against the
+        memory budget, when one is configured).
+    mem_budget : memory.MemoryBudget | None
+        Per-backend device-memory budget (module docstring).  ``None``
+        (the default) disables memory-aware admission entirely —
+        estimates are not even computed.
     runner_defaults : dict | None
         Keyword defaults for every ``ResilientRunner`` the pool
         constructs (``policy=``, ``probe=``, ``step_deadline_s=`` …);
@@ -317,7 +345,8 @@ class RunScheduler:
                  clock=None, metrics=None,
                  journal_path: str | None = None,
                  breakers: BreakerRegistry | None = None,
-                 chaos=None, runner_defaults: dict | None = None):
+                 chaos=None, runner_defaults: dict | None = None,
+                 mem_budget: "_memory.MemoryBudget | None" = None):
         if max_concurrency < 1:
             raise ValueError("max_concurrency must be >= 1")
         if queue_high_water < 1:
@@ -341,6 +370,7 @@ class RunScheduler:
                          else default_breaker_registry())
         self.chaos = chaos
         self.runner_defaults = dict(runner_defaults or {})
+        self.mem_budget = mem_budget
 
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -414,6 +444,58 @@ class RunScheduler:
         fault (consulted per shard-boundary poll through
         ``ChaosMonkey.on_worker``, pattern = the tenant name) rules
         the same yield deterministically."""
+        # the memory work runs BEFORE the dispatch lock: the chaos
+        # consult, the (possibly fused-form) estimate and the
+        # admissibility read depend only on (pipeline, data,
+        # runner_kw) — planning a pipeline / walking a large pytree
+        # under self._cv would stall every worker's dispatch behind
+        # each submission (the same discipline as the out-of-lock
+        # journal writes).  The admissibility read is re-checked at
+        # dispatch anyway (the over_memory shed sweep), so the tiny
+        # TOCTOU window is covered.
+        mem_bytes = 0
+        mem_refusal = None
+        if self.mem_budget is not None:
+            if self.chaos is not None:
+                # chaos mem_pressure: apparent budget shrinks to
+                # pressure_frac while the fault fires, restores when
+                # its window passes (consulted once per submission —
+                # deterministic on one VirtualClock)
+                ruling = self.chaos.on_memory(self.mem_budget.name,
+                                              backend=backend)
+                if ruling is not None and \
+                        ruling.get("mode") == "mem_pressure":
+                    self.mem_budget.set_pressure(
+                        ruling.get("pressure_frac", 0.5))
+                else:
+                    self.mem_budget.clear_pressure()
+            # estimate the pipeline AS THE RUNNER WILL RUN IT: a
+            # fuse=True submission executes fused stages, and the
+            # estimate store keys on the stage form — admission must
+            # read (and OOM corrections must feed) the same keys the
+            # runtime writes
+            est_pipeline = pipeline
+            rkw = {**self.runner_defaults, **(runner_kw or {})}
+            if rkw.get("fuse"):
+                from .plan import fused_pipeline as _fuse
+
+                # mesh included: a sharded submission's stages key
+                # their estimates under the sharded form
+                est_pipeline = _fuse(
+                    pipeline, no_fuse=rkw.get("isolate", ()),
+                    mesh=rkw.get("mesh"))
+            mem_bytes = _memory.estimate_run_peak(
+                est_pipeline, data)["bytes"]
+            admissible = self.mem_budget.admissible_bytes()
+            if mem_bytes > admissible:
+                # infeasible at ANY concurrency: the estimate cannot
+                # fit beside the standing residents even alone —
+                # refuse at the door instead of queueing work that
+                # can never dispatch
+                mem_refusal = (f"estimated peak {mem_bytes} bytes > "
+                               f"admissible {admissible} bytes "
+                               f"(capacity minus standing "
+                               f"reservations)")
         with self._cv:
             ticket = self._seq
             self._seq += 1
@@ -437,6 +519,9 @@ class RunScheduler:
                         ticket, tenant, "deadline_unmeetable",
                         detail=f"estimated start wait {est:g}s > "
                                f"deadline {deadline_s:g}s")
+            if mem_refusal is not None:
+                self._reject(ticket, tenant, "over_memory",
+                             detail=mem_refusal)
             if len(self._queue) >= self.queue_high_water:
                 victim = self._pick_victim_locked(priority)
                 if victim is None:
@@ -448,11 +533,13 @@ class RunScheduler:
             item = _QueueItem(ticket, tenant, int(priority), deadline_s,
                               self.clock.monotonic(), pipeline, data,
                               backend, dict(runner_kw or {}), handle,
-                              preemptible=bool(preemptible))
+                              preemptible=bool(preemptible),
+                              mem_bytes=int(mem_bytes))
             self._insert_locked(item)
             self._stats["admitted"] += 1
             self.journal.write("admitted", ticket=ticket, tenant=tenant,
                                priority=priority,
+                               mem_bytes=int(mem_bytes),
                                queue_depth=len(self._queue))
             self.metrics.counter("sched.admitted", tenant=tenant).inc()
             self._ensure_workers_locked()
@@ -602,10 +689,14 @@ class RunScheduler:
     def _pop_eligible_locked(self):
         """The next runnable item: highest priority (FIFO within)
         whose tenant is under its in-flight quota — an over-quota
-        tenant's head-of-queue work never blocks other tenants.
-        Items whose queue deadline expired are shed on the way.
-        Marks the winner running (counters + stats) before
-        returning it."""
+        tenant's head-of-queue work never blocks other tenants — and,
+        under a memory budget, whose estimated peak FITS what is left
+        (over-budget work queues instead of co-scheduling into an
+        OOM; smaller work may dispatch past it).  Items whose queue
+        deadline expired — or whose estimate can no longer EVER fit
+        beside the standing residents (they grew since admission) —
+        are shed on the way.  Marks the winner running (counters +
+        stats + memory reservation) before returning it."""
         # sctlint: locked-by-caller — the _locked suffix contract:
         # every caller holds self._cv (= self._lock)
         now = self.clock.monotonic()
@@ -613,6 +704,15 @@ class RunScheduler:
                    if q.deadline_s is not None
                    and now - q.submitted_at >= q.deadline_s]:
             self._shed_locked(it, "deadline_expired")
+        if self.mem_budget is not None:
+            # admission promised feasibility-at-zero-concurrency;
+            # standing residents that grew since then can break the
+            # promise — shed, or the item waits forever (and wedges
+            # a draining shutdown behind it).  ONE ledger read per
+            # poll, not per item: this runs under the dispatch lock
+            adm = self.mem_budget.admissible_bytes()
+            for it in [q for q in self._queue if q.mem_bytes > adm]:
+                self._shed_locked(it, "over_memory")
         if self._running_total >= self.max_concurrency:
             return None
         for it in self._queue:
@@ -620,6 +720,12 @@ class RunScheduler:
             if self._running_by_tenant.get(it.tenant, 0) \
                     >= quota.max_in_flight:
                 continue
+            if self.mem_budget is not None and \
+                    not self.mem_budget.fits(it.mem_bytes):
+                continue
+            if self.mem_budget is not None:
+                self.mem_budget.reserve(f"run:{it.seq}", it.mem_bytes,
+                                        tenant=it.tenant)
             self._remove_locked(it)
             self._running_total += 1
             # a FRESH token per dispatch: the previous dispatch's
@@ -668,12 +774,28 @@ class RunScheduler:
                 self.metrics.histogram("sched.queue_wait_s") \
                     .observe(waited)
                 item.handle._mark_running()
+            if self.mem_budget is not None:
+                # journaled OUTSIDE the dispatch lock (disk latency
+                # must not stall admission); per-ticket order holds —
+                # this thread owns the ticket until its terminal
+                self.journal.write(
+                    "mem_reserved", ticket=item.seq,
+                    tenant=item.tenant, bytes=item.mem_bytes,
+                    reserved_total=self.mem_budget.reserved_bytes(),
+                    budget_bytes=self.mem_budget.capacity_bytes)
             t0 = self.clock.monotonic()
             status, result, error = "completed", None, None
             preempted: JobPreempted | None = None
             runner = None
             try:
-                with preempt_scope(item.token):
+                # the pool's budget rides thread-locally into the run
+                # (memory.current_budget), so residents created deep
+                # inside an op — the streaming trainer's feed window —
+                # hold standing reservations against the same ledger
+                mem_scope = (_memory.budget_scope(self.mem_budget)
+                             if self.mem_budget is not None
+                             else contextlib.nullcontext())
+                with preempt_scope(item.token), mem_scope:
                     runner = self._make_runner(item)
                     result = runner.run(item.data,
                                         backend=item.backend)
@@ -692,10 +814,17 @@ class RunScheduler:
             wall = self.clock.monotonic() - t0
             if runner is not None:
                 item.handle.report = runner.report
+            released_total = None
             with self._cv:
                 self._running_total -= 1
                 self._running_by_tenant[item.tenant] -= 1
                 self._running_items.remove(item)
+                if self.mem_budget is not None:
+                    # release INSIDE the dispatch lock: a waiting
+                    # worker woken by the notify below must see the
+                    # freed bytes when it re-runs the fit check
+                    released_total = self.mem_budget.release(
+                        f"run:{item.seq}")
                 if preempted is None:
                     # a preempted segment's wall is partial work — it
                     # must not drag the deadline estimator down
@@ -752,6 +881,11 @@ class RunScheduler:
             # workers' dispatch.  Ordering is safe — this ticket's
             # "admitted" line was flushed before the item ever entered
             # the queue, and _Journal serializes concurrent appends.
+            if self.mem_budget is not None:
+                self.journal.write(
+                    "mem_released", ticket=item.seq,
+                    tenant=item.tenant, bytes=item.mem_bytes,
+                    reserved_total=released_total)
             if preempted is not None:
                 if preempted.reason == "cancelled":
                     # the cancel ruling: journaled terminal exactly
@@ -831,11 +965,13 @@ class RunScheduler:
             out["shed_audit"] = list(self._shed_audit)
             out["queue_depth"] = len(self._queue)
             out["ewma_run_s"] = self._ewma_run_s
-        # breaker snapshot OUTSIDE the dispatch lock: it takes the
-        # registry's and every breaker's lock (and, federated, reads
-        # files) — holding the dispatch lock across that would stall
-        # every worker's dispatch on a stats() caller (SCT011)
+        # breaker/budget snapshots OUTSIDE the dispatch lock: they
+        # take other locks (and, federated, read files) — holding the
+        # dispatch lock across that would stall every worker's
+        # dispatch on a stats() caller (SCT011)
         out["breakers"] = self.breakers.snapshot()
+        if self.mem_budget is not None:
+            out["mem_budget"] = self.mem_budget.snapshot()
         return out
 
     def shutdown(self, wait: bool = True, shed_queued: bool = False,
@@ -854,6 +990,12 @@ class RunScheduler:
         to finish teardown."""
         with self._cv:
             self._closed = True
+            if self.mem_budget is not None:
+                # admissions are over, so no later submission's chaos
+                # consult can end a mem_pressure episode — leaving it
+                # set would wedge the drain on queued work that fits
+                # the REAL budget
+                self.mem_budget.clear_pressure()
             if shed_queued:
                 for it in list(self._queue):
                     self._shed_locked(it, "shutdown")

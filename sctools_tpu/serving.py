@@ -109,7 +109,7 @@ from .utils import telemetry
 from .utils.checkpoint import (CheckpointCorruptError,
                                load_npz_verified, quarantine_checkpoint,
                                save_npz_generations)
-from .utils.failsafe import TRANSIENT, classify_error
+from .utils.failsafe import RESOURCE, TRANSIENT, classify_error
 from .utils.vclock import SYSTEM_CLOCK
 
 #: identity fingerprint of the serving artifact — a foreign npz
@@ -341,8 +341,8 @@ def _topk_neighbors(q, r, k: int, metric: str):
     return idx, -neg
 
 
-@register("serve.kernel", backend="tpu", fusable=True)
-@register("serve.kernel", backend="cpu", fusable=True)
+@register("serve.kernel", backend="tpu", fusable=True, mem_cost=3.0)
+@register("serve.kernel", backend="cpu", fusable=True, mem_cost=3.0)
 def serve_kernel(data: CellData, kind: str = "label_transfer",
                  k: int = 15, metric: str = "cosine",
                  n_levels: int = 0, target_sum: float = 1e4,
@@ -736,6 +736,14 @@ class AnnotationService:
     clock, metrics, journal_path, chaos, breakers, runner_defaults :
         Plumbing for the private scheduler; the model-lifecycle
         journal events land in the same file as the query funnel.
+    mem_budget : memory.MemoryBudget | None
+        Device-memory budget for the PRIVATE scheduler (with
+        ``scheduler=`` the pool's own budget is adopted instead).
+        When one is present — either way — the resident model holds a
+        named STANDING reservation sized to its placed device bytes
+        (updated on place / re-place / hot-swap, released at
+        :meth:`close`), so admission contends for what is actually
+        left of the device rather than the nameplate capacity.
     k, metric :
         Default kNN width / distance for the projection query kinds.
     buckets :
@@ -758,6 +766,7 @@ class AnnotationService:
                  clock=None, metrics=None,
                  journal_path: str | None = None, chaos=None,
                  breakers=None, runner_defaults: dict | None = None,
+                 mem_budget=None,
                  k: int = 15, metric: str = "cosine",
                  buckets=DEFAULT_BUCKETS,
                  canary_threshold: float = 0.9,
@@ -787,6 +796,11 @@ class AnnotationService:
             self.metrics = scheduler.metrics
             self.chaos = scheduler.chaos
             self._breakers = scheduler.breakers
+            # the pool's memory budget (when configured): the
+            # resident model holds a named STANDING reservation
+            # against it, so query traffic and training jobs contend
+            # for what is actually left of the device
+            self._mem_budget = getattr(scheduler, "mem_budget", None)
         else:
             self.clock = clock if clock is not None else SYSTEM_CLOCK
             self.metrics = (metrics if metrics is not None
@@ -802,12 +816,19 @@ class AnnotationService:
                 tenant_max_queued=tenant_max_queued, quotas=quotas,
                 clock=self.clock, metrics=self.metrics,
                 journal_path=journal_path, breakers=breakers,
-                chaos=chaos, runner_defaults=rd)
+                chaos=chaos, runner_defaults=rd,
+                mem_budget=mem_budget)
             self._own_sched = True
             self._breakers = self._sched.breakers
+            self._mem_budget = mem_budget
         self.journal = self._sched.journal
         self._breaker = self._breakers.get(backend, clock=self.clock)
         self._state_lock = threading.Lock()
+        # guards the standing reservation's closed-check-and-reserve
+        # against close()'s release: without it an in-flight query's
+        # re-place rung racing close() could re-reserve AFTER the
+        # release and leak the hold on a shared pool's budget forever
+        self._standing_lock = threading.Lock()
         self._acct_lock = threading.Lock()
         self._kernel_lock = threading.Lock()
         self._kernels: dict = {}
@@ -837,6 +858,7 @@ class AnnotationService:
             self._models = {0: model}
         self.journal.write("model_loaded", epoch=0, generation=gen,
                            version=model.version, reason="init")
+        self._update_standing_reservation()
 
     # -- lifecycle -----------------------------------------------------
     def __enter__(self):
@@ -848,16 +870,87 @@ class AnnotationService:
 
     def close(self, wait: bool = True) -> None:
         """Stop admitting (private scheduler only), drain outstanding
-        tickets' accounting, and unregister the service name."""
+        tickets' accounting, release the resident model's standing
+        memory reservation, and unregister the service name."""
         self._closed = True
         try:
             if self._own_sched:
                 self._sched.shutdown(wait=wait)
             self.drain(timeout=None if wait else 0.0)
         finally:
+            if self._mem_budget is not None:
+                # release under the standing lock: _closed is already
+                # set, so a racing _update_standing_reservation either
+                # ran before this (its hold is released here) or sees
+                # _closed under the lock and does nothing
+                with self._standing_lock:
+                    held = self._mem_budget.holders().get(
+                        self._standing_name())
+                    self._mem_budget.release(self._standing_name())
+                if held is not None:
+                    self.journal.write(
+                        "mem_released", standing=True,
+                        service=self.name, bytes=held["bytes"],
+                        reserved_total=
+                        self._mem_budget.reserved_bytes())
             with _SERVICES_LOCK:
                 if _SERVICES.get(self.name) is self:
                     del _SERVICES[self.name]
+
+    def _standing_name(self) -> str:
+        return f"serve:{self.name}:model"
+
+    def _update_standing_reservation(self) -> None:
+        """Size the resident model's STANDING reservation to the live
+        models' placed device bytes (current + previous epoch — both
+        stay resident across a swap).  Re-reserving the same name
+        REPLACES the amount, so place / re-place / swap / eviction
+        all converge on the truth; journaled only when the amount
+        actually moved."""
+        budget = self._mem_budget
+        if budget is None:
+            return
+        changed = None
+        # the model-set read AND the reserve commit share the standing
+        # lock (state lock nested inside — nothing nests the other
+        # way): a racing swap/re-place computing a STALE total must
+        # not commit it last and leave the ledger under-counting the
+        # resident bytes until the next placement event
+        with self._standing_lock:
+            with self._state_lock:
+                models = list(getattr(self, "_models", {}).values())
+            total = 0
+            for mo in models:
+                dev = mo._dev
+                if dev:
+                    total += sum(int(a.nbytes) for a in dev.values())
+            if self._closed:
+                # close() released (or is about to release) the hold
+                # under this same lock — re-reserving here would leak
+                # it on a shared pool's budget forever
+                return
+            prev = budget.holders().get(self._standing_name())
+            if prev is not None and prev["bytes"] == total:
+                return
+            if total > 0:
+                reserved = budget.reserve(self._standing_name(),
+                                          total, standing=True)
+                changed = ("reserve", total, reserved)
+            elif prev is not None:
+                reserved = budget.release(self._standing_name())
+                changed = ("release", prev["bytes"], reserved)
+        # journal OUTSIDE the lock (SCT011 discipline), with literal
+        # event names (SCT009)
+        if changed is not None:
+            kind, nbytes, reserved = changed
+            if kind == "reserve":
+                self.journal.write("mem_reserved", standing=True,
+                                   service=self.name, bytes=nbytes,
+                                   reserved_total=reserved)
+            else:
+                self.journal.write("mem_released", standing=True,
+                                   service=self.name, bytes=nbytes,
+                                   reserved_total=reserved)
 
     def drain(self, timeout: float | None = None) -> None:
         """Account every outstanding ticket that is (or becomes,
@@ -1068,19 +1161,33 @@ class AnnotationService:
         raise CheckpointCorruptError(
             path, f"no loadable artifact generation ({last_reason})")
 
+    def _rule_placement_failure(self, e: BaseException) -> str:
+        """ONE ruling for a resident-state placement/kernel failure
+        (three ladder sites share it, so breaker-feeding can never
+        diverge between them): transient outages feed the shared
+        breaker and rule the ``cpu`` host rung; RESOURCE means full,
+        not broken — the ``oom`` host rung, breaker untouched;
+        anything else re-raises (a program error must fail the
+        query, not hide behind the ladder)."""
+        cls = classify_error(e)
+        if cls not in (TRANSIENT, RESOURCE):
+            raise e
+        if cls == TRANSIENT:
+            self._breaker.record_failure()
+        return "cpu" if cls == TRANSIENT else "oom"
+
     def _place_or_degrade(self, model: _ResidentModel) -> None:
-        """Initial placement: a transiently-dead device must not kill
-        the constructor — the model stays host-resident (the cpu
-        rung) and the ladder re-places on a later query."""
+        """Initial placement: a transiently-dead device (or one with
+        no memory left — RESOURCE) must not kill the constructor —
+        the model stays host-resident (the cpu rung) and the ladder
+        re-places on a later query."""
         try:
             model.place()
         except Exception as e:  # noqa: BLE001 — classified below
-            if classify_error(e) != TRANSIENT:
-                raise
-            self._breaker.record_failure()
+            reason = self._rule_placement_failure(e)
             warnings.warn(
                 f"AnnotationService: device placement failed "
-                f"transiently ({type(e).__name__}: {e}) — serving "
+                f"({reason} rung: {type(e).__name__}: {e}) — serving "
                 f"from host arrays until the ladder re-places.",
                 RuntimeWarning, stacklevel=3)
 
@@ -1207,6 +1314,9 @@ class AnnotationService:
                                version=cand.version, generation=gen,
                                agreement=round(agreement, 4))
             self.metrics.counter("serve.swaps").inc()
+            # both epochs are now resident (in-flight queries pin the
+            # old one) — the standing reservation must say so
+            self._update_standing_reservation()
             return True
         finally:
             self.release_swap()
@@ -1294,13 +1404,12 @@ class AnnotationService:
                 model.place()
                 self.metrics.counter("serve.state_reloads",
                                      reason="replace").inc()
+                self._update_standing_reservation()
                 return "device"
             except Exception as e:  # noqa: BLE001 — classified below
-                if classify_error(e) != TRANSIENT:
-                    raise
-                self._breaker.record_failure()
+                reason = self._rule_placement_failure(e)
                 self.metrics.counter("serve.state_reloads",
-                                     reason="cpu").inc()
+                                     reason=reason).inc()
                 return "host"
         # rung 3: the host mirror is gone too — verified reload from
         # the artifact (corrupt generation → quarantine + .prev,
@@ -1322,15 +1431,14 @@ class AnnotationService:
             return "host"
         try:
             model.place()
+            self._update_standing_reservation()
             return "device"
         except Exception as e:  # noqa: BLE001 — classified below
-            if classify_error(e) != TRANSIENT:
-                raise
-            # rung 4: the device itself is refusing placement — feed
-            # the shared breaker and serve from the fresh host mirror
-            self._breaker.record_failure()
+            # rung 4: the device itself is refusing placement — serve
+            # from the fresh host mirror
+            reason = self._rule_placement_failure(e)
             self.metrics.counter("serve.state_reloads",
-                                 reason="cpu").inc()
+                                 reason=reason).inc()
             return "host"
 
     def _kernel_for(self, model: _ResidentModel, kind: str, k: int,

@@ -31,14 +31,16 @@ import threading
 import numpy as np
 
 from .. import registry
-from .failsafe import TransientDeviceError, check_deadline
+from .failsafe import (DeviceOOMError, TransientDeviceError,
+                       check_deadline)
 from .vclock import SYSTEM_CLOCK
 
 MODES = ("unavailable", "hang", "wedge", "corrupt",
          "corrupt_checkpoint", "crash", "kill", "reject_storm",
          "slow_read", "truncate_shard", "io_error",
          "kill_worker", "lease_wedge", "preempt",
-         "evict_state", "corrupt_model")
+         "evict_state", "corrupt_model",
+         "oom", "mem_pressure")
 
 # which hook channel each mode fires on: most modes wrap the op CALL;
 # corrupt_checkpoint fires through the runner's on_checkpoint hook,
@@ -55,14 +57,20 @@ MODES = ("unavailable", "hang", "wedge", "corrupt",
 # on_serving — consulted by the annotation service once per QUERY
 # EXECUTION (evict_state / corrupt_model, pattern matches the SERVICE
 # name; ``on_call=N`` = the Nth query executed against the resident
-# model)
+# model).  ``oom`` stays on the op CALL channel (a RESOURCE_EXHAUSTED
+# raise from a matching op — the canonical TPU production failure,
+# driving the runner's whole containment ladder); ``mem_pressure``
+# fires through on_memory — consulted by the run scheduler once per
+# SUBMISSION against its MemoryBudget's name, shrinking the apparent
+# budget for the fault's window.
 _MODE_CHANNEL = {"corrupt_checkpoint": "checkpoint",
                  "reject_storm": "admission",
                  "slow_read": "io", "truncate_shard": "io",
                  "io_error": "io",
                  "kill_worker": "worker", "lease_wedge": "worker",
                  "preempt": "worker",
-                 "evict_state": "serving", "corrupt_model": "serving"}
+                 "evict_state": "serving", "corrupt_model": "serving",
+                 "mem_pressure": "memory"}
 
 
 class ChaosCrash(BaseException):
@@ -151,6 +159,20 @@ class ChaosMonkey:
       every step-checkpoint save) and flips bytes of the file on
       disk — the bit-rot/truncation damage the digest verify +
       quarantine path exists to catch on the next resume.
+    * ``oom`` — raise :class:`~.failsafe.DeviceOOMError` with the
+      real XlaRuntimeError ``RESOURCE_EXHAUSTED: Out of memory``
+      message shape (classified :data:`~.failsafe.RESOURCE`): the
+      canonical TPU production failure, driving the runner's OOM
+      containment ladder (unfuse → re-plan smaller → cpu).  Restrict
+      with ``backend="tpu"`` so the cpu rung completes.
+    * ``mem_pressure`` — the MEMORY channel (:meth:`on_memory`,
+      consulted by the run scheduler once per submission under its
+      ``MemoryBudget``'s name; ``on_call``/``times`` windows count
+      submissions).  Only RULES — the scheduler shrinks the budget's
+      apparent capacity to the monkey's ``pressure_frac`` while the
+      fault fires and restores it when the window passes, so
+      dispatch-time fit rulings tighten mid-soak with zero real
+      sleeps.
     * ``crash`` — raise :class:`ChaosCrash` (in-process stand-in for
       process death; aborts the whole run, testing resume).
     * ``reject_storm`` — never fires on an op call; fires through
@@ -219,7 +241,8 @@ class ChaosMonkey:
     ``calls`` counts invocations per op name (checkpoint saves count
     separately under ``"<op>@checkpoint"``, admission consults under
     ``"<tenant>@admission"``, serving consults under
-    ``"<service>@serving"``); ``injected`` logs every
+    ``"<service>@serving"``, budget consults under
+    ``"<budget>@memory"``); ``injected`` logs every
     firing as ``{"op", "call", "mode", "backend"}`` — two monkeys with
     equal faults/seed driving the same workload produce identical
     logs (the determinism contract tier-1 pins).
@@ -227,13 +250,14 @@ class ChaosMonkey:
 
     def __init__(self, faults, seed: int = 0, hang_s: float = 3600.0,
                  sleep=None, clock=None, wedge_s: float | None = None,
-                 slow_s: float = 30.0):
+                 slow_s: float = 30.0, pressure_frac: float = 0.5):
         self.faults = list(faults)
         self.seed = seed
         self.hang_s = hang_s
         self.clock = clock
         self.wedge_s = hang_s if wedge_s is None else wedge_s
         self.slow_s = float(slow_s)
+        self.pressure_frac = float(pressure_frac)
         self.sleep = (sleep if sleep is not None
                       else (clock or SYSTEM_CLOCK).sleep)
         self.calls: dict[str, int] = {}
@@ -262,13 +286,15 @@ class ChaosMonkey:
         return {"faults": [dataclasses.asdict(f) for f in self.faults],
                 "seed": self.seed, "hang_s": self.hang_s,
                 "wedge_s": self.wedge_s, "slow_s": self.slow_s,
+                "pressure_frac": self.pressure_frac,
                 "calls": calls}
 
     @classmethod
     def from_spec(cls, spec: dict) -> "ChaosMonkey":
         m = cls([Fault(**f) for f in spec["faults"]], seed=spec["seed"],
                 hang_s=spec["hang_s"], wedge_s=spec.get("wedge_s"),
-                slow_s=spec.get("slow_s", 30.0))
+                slow_s=spec.get("slow_s", 30.0),
+                pressure_frac=spec.get("pressure_frac", 0.5))
         m.calls = dict(spec.get("calls", {}))
         return m
 
@@ -323,6 +349,30 @@ class ChaosMonkey:
             self.injected.append({"op": name, "call": call_no,
                                   "mode": f.mode, "backend": backend})
         return {"mode": f.mode}
+
+    def on_memory(self, name: str,
+                  backend: str | None = None) -> dict | None:
+        """Memory-budget hook, consulted by the run scheduler once
+        per SUBMISSION against its budget: returns ``None`` (no
+        pressure) or ``{"mode": "mem_pressure", "pressure_frac":
+        ...}`` for a firing fault.  On this channel the fault's
+        ``op`` pattern matches the BUDGET name (``MemoryBudget.name``,
+        default ``"device"``); call counting is per budget under
+        ``"<budget>@memory"``, so ``on_call``/``times`` windows count
+        submissions — deterministic on one VirtualClock.  The hook
+        only rules; the scheduler implements the semantics (it owns
+        the budget): apparent capacity shrinks to ``pressure_frac``
+        while the fault fires and restores when the window passes."""
+        key = f"{name}@memory"
+        with self._lock:
+            call_no = self.calls.get(key, 0) + 1
+            self.calls[key] = call_no
+            f = self._firing(name, backend, call_no, channel="memory")
+            if f is None:
+                return None
+            self.injected.append({"op": name, "call": call_no,
+                                  "mode": f.mode, "backend": backend})
+        return {"mode": f.mode, "pressure_frac": self.pressure_frac}
 
     def on_serving(self, name: str, path: str | None = None,
                    backend: str | None = None) -> dict | None:
@@ -463,6 +513,14 @@ class ChaosMonkey:
             if f.mode == "unavailable":
                 raise TransientDeviceError(
                     f"chaos: UNAVAILABLE injected in {name!r} "
+                    f"(call {call_no})")
+            if f.mode == "oom":
+                # the real jaxlib message shape, so the classifier's
+                # marker scan — not just the explicit type — is what
+                # tier-1 exercises
+                raise DeviceOOMError(
+                    f"chaos: RESOURCE_EXHAUSTED: Out of memory while "
+                    f"trying to allocate bytes in {name!r} "
                     f"(call {call_no})")
             if f.mode == "crash":
                 raise ChaosCrash(

@@ -20,10 +20,13 @@ is recoverable in-process; containment means subprocesses + watchdogs.
   resets the parent's stall timer; ``beat()`` is a cheap explicit
   pulse for long device waits).
 * :func:`classify_error` — the retryable-error taxonomy: is an
-  exception a TRANSIENT device condition (retry with backoff) or a
-  DETERMINISTIC program error (retrying re-raises the same thing)?
-  The runner (``sctools_tpu/runner.py``) routes every step failure
-  through this one function so the retry policy exists exactly once.
+  exception a TRANSIENT device condition (retry with backoff), a
+  DETERMINISTIC program error (retrying re-raises the same thing),
+  or a RESOURCE exhaustion (device memory — neither: answered by the
+  runner's OOM containment ladder, ``docs/ARCHITECTURE.md`` "Memory
+  fault domain")?  The runner (``sctools_tpu/runner.py``) routes
+  every step failure through this one function so the retry policy
+  exists exactly once.
 * :func:`classify_child_result` — the same taxonomy for a contained
   child's death: a deterministic traceback in the stderr tail FAILS
   FAST; only genuine device/timeout signatures (watchdog kills,
@@ -75,6 +78,15 @@ from .vclock import SYSTEM_CLOCK, Clock
 TRANSIENT = "transient"
 DETERMINISTIC = "deterministic"
 FATAL = "fatal"  # BaseException (process-death class): never retried
+#: device memory exhausted (XlaRuntimeError RESOURCE_EXHAUSTED — the
+#: canonical TPU production failure).  Deliberately NEITHER transient
+#: nor deterministic: a retry at the same shapes recurs (the live set
+#: is the live set — nothing 'recovers'), so blind retry only burns
+#: budget, but the error says nothing about program correctness
+#: either — the runner answers it with the OOM containment ladder
+#: (unfuse → re-plan smaller → cpu) instead of retry-or-fail-fast,
+#: and only a recurrence at the bottom rung is ruled deterministic.
+RESOURCE = "resource"
 
 
 class TransientDeviceError(RuntimeError):
@@ -111,6 +123,15 @@ class JobPreempted(Exception):
         self.cursor = cursor or {}
 
 
+class DeviceOOMError(RuntimeError):
+    """Device memory exhausted — the in-repo way to *assert* the
+    RESOURCE classification when the wrapped error type alone cannot
+    (jaxlib raises one XlaRuntimeError class for every status; chaos
+    ``oom`` faults raise this directly).  Classified
+    :data:`RESOURCE`, same as a real ``RESOURCE_EXHAUSTED``
+    message."""
+
+
 class DeterministicChildError(RuntimeError):
     """An isolated child died raising a deterministic program error
     (a ``ValueError``-class traceback in its stderr tail).  Registered
@@ -126,8 +147,10 @@ class DeterministicChildError(RuntimeError):
 # exact list is the round-1..5 crash corpus (bench.py history):
 # UNAVAILABLE / DEADLINE_EXCEEDED from a dead or unreachable tunnel
 # worker, ABORTED on worker restart, socket-level noise in between.
-# RESOURCE_EXHAUSTED is deliberately absent — an HBM OOM recurs at the
-# same shapes and must fail fast.
+# RESOURCE_EXHAUSTED is deliberately absent — an HBM OOM recurs at
+# the same shapes, so it is its own class (RESOURCE, matched by
+# _RESOURCE_MARKERS below) answered by the runner's containment
+# ladder, never by blind retry.
 _TRANSIENT_MARKERS = (
     "unavailable",
     "deadline_exceeded",
@@ -153,6 +176,23 @@ _TRANSIENT_MARKERS = (
     "been deleted",
 )
 
+# Substrings (lowercased) that mark an accelerator-runtime error as a
+# device-memory exhaustion.  The message corpus: jaxlib's
+# XlaRuntimeError("RESOURCE_EXHAUSTED: Out of memory while trying to
+# allocate N bytes."), the TPU allocator's "Ran out of memory in
+# memory space hbm. Used X of Y hbm.", and the BFC allocator's
+# "Resource exhausted: Out of memory" shape.  Checked BEFORE the
+# transient scan: an OOM message must never be mistaken for a
+# retryable outage (several carry "failed to allocate device buffer"
+# noise that says nothing transient).
+_RESOURCE_MARKERS = (
+    "resource_exhausted",
+    "resource exhausted",
+    "out of memory",
+    "ran out of memory",
+    "memory space hbm",
+)
+
 _TRANSIENT_TYPES = (TransientDeviceError, TimeoutError, ConnectionError,
                     InterruptedError)
 # Program errors: identical inputs give an identical raise — a retry
@@ -165,24 +205,30 @@ _DETERMINISTIC_TYPES = (ValueError, TypeError, KeyError, IndexError,
 
 
 def classify_error(exc: BaseException) -> str:
-    """Classify ``exc`` as :data:`TRANSIENT`, :data:`DETERMINISTIC`
-    or :data:`FATAL`.
+    """Classify ``exc`` as :data:`TRANSIENT`, :data:`DETERMINISTIC`,
+    :data:`RESOURCE` or :data:`FATAL`.
 
     Type beats message: known-transient types (timeouts, connection
-    drops, :class:`TransientDeviceError`) and known-deterministic
-    types (ValueError/TypeError/shape errors …) are decided outright;
-    only the remaining grey zone — jaxlib's single XlaRuntimeError
-    class carrying any gRPC status — falls through to the
-    status-marker message scan.  Unknown errors default to
-    DETERMINISTIC: failing fast on a novel error is cheap to diagnose,
-    retrying a permanent one is not."""
+    drops, :class:`TransientDeviceError`), the explicit
+    :class:`DeviceOOMError`, and known-deterministic types
+    (ValueError/TypeError/shape errors …) are decided outright; only
+    the remaining grey zone — jaxlib's single XlaRuntimeError class
+    carrying any gRPC status — falls through to the status-marker
+    message scan, RESOURCE markers first (an OOM message must never
+    read as a retryable outage).  Unknown errors default to
+    DETERMINISTIC: failing fast on a novel error is cheap to
+    diagnose, retrying a permanent one is not."""
     if not isinstance(exc, Exception):
         return FATAL
+    if isinstance(exc, DeviceOOMError):
+        return RESOURCE
     if isinstance(exc, _TRANSIENT_TYPES):
         return TRANSIENT
     if isinstance(exc, _DETERMINISTIC_TYPES):
         return DETERMINISTIC
     msg = f"{type(exc).__name__}: {exc}".lower()
+    if any(m in msg for m in _RESOURCE_MARKERS):
+        return RESOURCE
     if any(m in msg for m in _TRANSIENT_MARKERS):
         return TRANSIENT
     return DETERMINISTIC
@@ -237,6 +283,10 @@ def classify_child_result(res: dict, step: str) -> BaseException:
     * a deterministic exception type name terminates the stderr
       traceback — :class:`DeterministicChildError` (FAIL FAST; the
       child will raise the same thing on every retry).
+    * a RESOURCE_EXHAUSTED / out-of-memory signature in the tail —
+      :class:`DeviceOOMError` (the parent's runner answers with the
+      OOM containment ladder, exactly as it would for an in-process
+      OOM; mirrors the in-process marker scan).
     * a transient exception type name (the ``_TRANSIENT_TYPES``
       mirror: timeouts, connection drops), or any named exception
       with a transient device marker (``UNAVAILABLE`` …) in the
@@ -265,6 +315,10 @@ def classify_child_result(res: dict, step: str) -> BaseException:
                 f"isolated step {step!r} died on a deterministic "
                 f"{names[-1]} — failing fast, a retry replays the "
                 f"same raise {detail}")
+        if any(m in low for m in _RESOURCE_MARKERS):
+            return DeviceOOMError(
+                f"isolated step {step!r} died on device memory "
+                f"exhaustion ({names[-1]}) {detail}")
         if last in _TRANSIENT_CHILD_NAMES or \
                 any(m in low for m in _TRANSIENT_MARKERS):
             return TransientDeviceError(
@@ -273,6 +327,10 @@ def classify_child_result(res: dict, step: str) -> BaseException:
         return DeterministicChildError(
             f"isolated step {step!r} died on {names[-1]} — novel "
             f"error, failing fast {detail}")
+    if any(m in low for m in _RESOURCE_MARKERS):
+        return DeviceOOMError(
+            f"isolated step {step!r} died with an out-of-memory "
+            f"signature {detail}")
     if any(m in low for m in _TRANSIENT_MARKERS):
         return TransientDeviceError(
             f"isolated step {step!r} died with a device signature "

@@ -112,6 +112,15 @@ EVENTS = frozenset({
     # artifact or canary disagreement) and the old epoch kept serving
     "model_loaded", "model_quarantined", "model_swapped",
     "swap_rolled_back",
+    # memory fault domain (sctools_tpu/memory.py + scheduler/serving/
+    # train_stream): mem_reserved = bytes held against the per-backend
+    # MemoryBudget (a dispatched run's estimated peak, or a named
+    # resident — the serving model's STANDING hold, the trainer's
+    # run-scoped feed window); mem_released = the hold dropped (run
+    # terminal, preemption yield, resident retired).
+    # OOM containment rulings reuse the existing `degrade` event with
+    # reason="oom" + rung= + from/to estimates.
+    "mem_reserved", "mem_released",
 })
 
 #: Every legal metric name → one-line meaning (the docs table).  Like
@@ -184,11 +193,11 @@ METRICS = {
     "sched.rejected": "counter: submissions refused at admission "
                       "(labels tenant=, reason= tenant_queue_quota|"
                       "deadline_unmeetable|queue_full|reject_storm|"
-                      "scheduler_closed)",
+                      "scheduler_closed|over_memory)",
     "sched.shed": "counter: admitted runs dropped before running or "
                   "cooperatively cancelled while running (labels "
                   "tenant=, reason= queue_high_water|"
-                  "deadline_expired|shutdown|cancelled)",
+                  "deadline_expired|shutdown|cancelled|over_memory)",
     "sched.queue_wait_s": "histogram: admission-to-dispatch queue "
                           "wait seconds (on the injectable clock)",
     "ingest.reads": "counter: shard reads served to a consumer "
@@ -271,10 +280,27 @@ METRICS = {
     "serve.state_reloads": "counter: residency-ladder rungs taken for "
                            "resident reference-model state (labels "
                            "reason= replace|artifact|breaker_open|"
-                           "cpu) — replace = re-place evicted device "
-                           "buffers from the host mirror, artifact = "
-                           "verified reload from disk, breaker_open/"
-                           "cpu = queries served from host arrays",
+                           "cpu|oom) — replace = re-place evicted "
+                           "device buffers from the host mirror, "
+                           "artifact = verified reload from disk, "
+                           "breaker_open/cpu = queries served from "
+                           "host arrays, oom = device memory refused "
+                           "the placement or kernel",
+    "mem.budget_bytes": "gauge: the per-backend MemoryBudget's "
+                        "nameplate capacity (device "
+                        "memory_stats()['bytes_limit'] or the "
+                        "SCTOOLS_MEM_BUDGET_BYTES env cap)",
+    "mem.reserved_bytes": "gauge: bytes currently reserved against "
+                          "the budget (dispatched runs' estimated "
+                          "peaks + standing resident reservations), "
+                          "set on every ledger mutation",
+    "mem.oom_events": "counter: RESOURCE-classified step failures by "
+                      "the containment-ladder rung that answered "
+                      "them (labels rung= unfuse|replan|cpu|fail)",
+    "mem.estimate_corrections": "counter: stored peak-memory "
+                                "estimates inflated by an observed "
+                                "OOM (the self-correcting model's "
+                                "learning events)",
 }
 
 #: Per-module journal PROTOCOLS — which EVENTS members a module may
@@ -291,10 +317,13 @@ METRICS = {
 #: event without breaking SCT012").
 JOURNAL_PROTOCOLS = {
     # admission funnel: submitted -> admitted | rejected, then
-    # (preempted ...)* and exactly one terminal per ticket
+    # (preempted ...)* and exactly one terminal per ticket; with a
+    # MemoryBudget the dispatch/terminal pair also journals the
+    # ticket's reservation (mem_reserved/mem_released)
     "scheduler": {
         "events": ["submitted", "admitted", "rejected", "shed",
-                   "preempted", "run_completed", "run_failed"],
+                   "preempted", "run_completed", "run_failed",
+                   "mem_reserved", "mem_released"],
         "terminal": ["rejected", "shed", "run_completed",
                      "run_failed"],
     },
@@ -324,7 +353,8 @@ JOURNAL_PROTOCOLS = {
     # epoch record is the unit the no-replayed-shards proof joins on
     "train_stream": {
         "events": ["train_shard", "train_epoch", "train_checkpoint",
-                   "train_resume", "preempted"],
+                   "train_resume", "preempted",
+                   "mem_reserved", "mem_released"],
         "terminal": ["train_epoch"],
     },
     # the IO-failure domain journals only the quarantine verdict
@@ -341,7 +371,8 @@ JOURNAL_PROTOCOLS = {
     # scheduler's table).
     "serving": {
         "events": ["model_loaded", "model_quarantined",
-                   "model_swapped", "swap_rolled_back"],
+                   "model_swapped", "swap_rolled_back",
+                   "mem_reserved", "mem_released"],
         "terminal": [],
     },
 }

@@ -1,0 +1,199 @@
+"""Canned memory-fault-domain smoke — run_checks.sh gate.
+
+A fast, deterministic, virtual-clock smoke of the memory fault domain
+(``sctools_tpu/memory.py`` + the scheduler/runner wiring): a CAPPED
+FAKE BUDGET (via the ``SCTOOLS_MEM_BUDGET_BYTES`` env cap — the same
+knob CI uses to fake an HBM on a CPU box) admits a mixed-size
+multi-tenant soak under chaos ``oom`` and ``mem_pressure`` faults.
+Asserts:
+
+* ZERO unhandled OOMs: every oom-faulted run completes through a
+  containment-ladder rung (``mem.oom_events`` counts rungs, no ticket
+  terminals ``run_failed`` on a RESOURCE error);
+* the budget held: peak reserved bytes never exceed the cap, every
+  reservation released, an infeasible arrival refused ``over_memory``
+  at admission;
+* the journal is COMPLETE and coherent (every ticket terminal exactly
+  once — the shared ``soak_smoke.check_journal_coherent`` contract);
+* zero real sleeps: everything timing-shaped moves on one
+  VirtualClock.
+
+Deliberately NOT named ``test_*`` — pytest skips it; the CI stage
+runs ``python tests/mem_smoke.py`` (exit 0 = pass).  The full
+acceptance soak (serving + preemptible training + per-rung audits)
+lives in ``tests/test_memory.py``.
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import warnings
+
+# runnable as `python tests/mem_smoke.py` from the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# the env cap must be set BEFORE the budget is constructed — this IS
+# the detection path under test
+CAP = 1_000_000
+os.environ["SCTOOLS_MEM_BUDGET_BYTES"] = str(CAP)
+
+from sctools_tpu.data.synthetic import synthetic_counts  # noqa: E402
+from sctools_tpu.memory import MemoryBudget  # noqa: E402
+from sctools_tpu.registry import Pipeline, register  # noqa: E402
+from sctools_tpu.scheduler import (RunRejected,  # noqa: E402
+                                   RunScheduler)
+from sctools_tpu.utils.chaos import ChaosMonkey, Fault  # noqa: E402
+from sctools_tpu.utils.failsafe import BreakerRegistry  # noqa: E402
+from sctools_tpu.utils.telemetry import MetricsRegistry  # noqa: E402
+from sctools_tpu.utils.vclock import VirtualClock  # noqa: E402
+
+from soak_smoke import check_journal_coherent  # noqa: E402
+
+N_SUBMISSIONS = 13  # 12 admitted + 1 refused over_memory
+
+
+def _register_ops():
+    """Smoke fixture ops (registered inside run() — importing this
+    module must stay registry-clean)."""
+
+    def _cost(params, input_bytes):
+        return int(params.get("mem_bytes", input_bytes))
+
+    def _passthrough(data, **kw):
+        return data
+
+    def _shrink(params):
+        b = int(params.get("block", 256))
+        if b <= 32:
+            return None
+        params["block"] = b // 2
+        return params
+
+    for backend in ("cpu", "tpu"):
+        register("test.msmoke_sized", backend=backend,
+                 mem_cost=_cost)(_passthrough)
+        register("test.msmoke_fa", backend=backend,
+                 fusable=True)(_passthrough)
+        register("test.msmoke_fb", backend=backend,
+                 fusable=True)(_passthrough)
+        register("test.msmoke_shrink", backend=backend,
+                 mem_shrink=_shrink)(_passthrough)
+        register("test.msmoke_plain", backend=backend)(_passthrough)
+
+
+def fail(msg: str) -> "NoReturn":  # noqa: F821
+    print(f"mem_smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def run() -> int:
+    _register_ops()
+    clock = VirtualClock()
+    metrics = MetricsRegistry(clock=clock)
+    budget = MemoryBudget(name="hbm0", metrics=metrics)
+    if budget.capacity_bytes != CAP:
+        fail(f"env cap not detected: {budget.capacity_bytes}")
+    jdir = tempfile.mkdtemp(prefix="sct_mem_smoke_")
+    jpath = os.path.join(jdir, "journal.jsonl")
+    chaos = ChaosMonkey(
+        [Fault("test.msmoke_fa", "oom", backend="tpu", times=1),
+         Fault("test.msmoke_shrink", "oom", backend="tpu", times=1),
+         Fault("test.msmoke_plain", "oom", backend="tpu", times=-1),
+         Fault("hbm0", "mem_pressure", on_call=4, times=3)],
+        clock=clock)
+    sched = RunScheduler(
+        max_concurrency=3, clock=clock, metrics=metrics,
+        journal_path=jpath, breakers=BreakerRegistry(clock=clock),
+        chaos=chaos, mem_budget=budget,
+        runner_defaults={"sleep": lambda s: None,
+                         "probe": lambda: {"ok": True}})
+    data = synthetic_counts(48, 24, density=0.2, seed=0)
+
+    handles = []
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            handles.append(sched.submit(
+                Pipeline([("test.msmoke_fa", {}),
+                          ("test.msmoke_fb", {})]), data,
+                tenant="lab-a", backend="tpu",
+                runner_kw={"fuse": True}))
+            handles.append(sched.submit(
+                Pipeline([("test.msmoke_shrink", {"block": 256})]),
+                data, tenant="lab-b", backend="tpu"))
+            handles.append(sched.submit(
+                Pipeline([("test.msmoke_plain", {})]), data,
+                tenant="lab-c", backend="tpu"))
+            for i in range(9):
+                handles.append(sched.submit(
+                    Pipeline([("test.msmoke_sized",
+                               {"mem_bytes": 250_000 + 20_000 * i})]),
+                    data, tenant=f"t-{i % 3}", backend="cpu"))
+            try:
+                sched.submit(
+                    Pipeline([("test.msmoke_sized",
+                               {"mem_bytes": CAP * 5})]), data,
+                    tenant="greedy", backend="cpu")
+                fail("over-budget arrival was not rejected")
+            except RunRejected as e:
+                if e.reason != "over_memory":
+                    fail(f"wrong rejection reason: {e.reason}")
+            for h in handles:
+                h.result(timeout=120)
+        sched.shutdown(wait=True)
+
+        # -- zero unhandled OOMs: every oom-faulted run completed
+        # through a ladder rung, no ticket failed
+        with open(jpath) as f:
+            events = [json.loads(line) for line in f]
+        failed = [e for e in events if e["event"] == "run_failed"]
+        if failed:
+            fail(f"{len(failed)} run(s) failed — unhandled OOMs? "
+                 f"{failed}")
+        snap = metrics.snapshot_compact()
+        for rung in ("unfuse", "replan", "cpu"):
+            if snap.get(f"mem.oom_events{{rung={rung}}}", 0) < 1:
+                fail(f"ladder rung {rung!r} never fired")
+        oom_fired = sum(1 for f in chaos.injected
+                        if f["mode"] == "oom")
+        if oom_fired < 3:
+            fail(f"expected >=3 injected ooms, saw {oom_fired}")
+        if not any(f["mode"] == "mem_pressure"
+                   for f in chaos.injected):
+            fail("mem_pressure never fired")
+
+        # -- the budget held
+        if budget.peak_reserved_bytes > CAP:
+            fail(f"peak reserved {budget.peak_reserved_bytes} "
+                 f"exceeded the {CAP} cap")
+        if budget.reserved_bytes() != 0:
+            fail(f"{budget.reserved_bytes()} bytes still reserved "
+                 f"after drain")
+        declared = sum(e.get("mem_bytes", 0) for e in events
+                       if e["event"] == "admitted")
+        if declared <= 2 * CAP:
+            fail(f"soak under-subscribed the budget ({declared} "
+                 f"bytes admitted vs {CAP} cap)")
+
+        # -- journal coherent: every ticket terminal exactly once
+        check_journal_coherent(jpath, N_SUBMISSIONS)
+
+        # -- zero real sleeps: nothing moved the virtual clock but
+        # chaos/backoff, and backoff sleeps were injected no-ops
+        print(f"mem_smoke: OK — {len(handles)} run(s) + 1 refusal, "
+              f"peak reserved {budget.peak_reserved_bytes}/{CAP} "
+              f"bytes, rungs "
+              + ", ".join(f"{r}={snap.get(f'mem.oom_events{{rung={r}}}', 0):g}"
+                          for r in ("unfuse", "replan", "cpu"))
+              + f", virtual clock at {clock.monotonic():.1f}s")
+        return 0
+    finally:
+        shutil.rmtree(jdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(run())

@@ -1,0 +1,1022 @@
+"""Memory as a fault domain — the RESOURCE failure class, the learned
+peak-estimate model, the budgeted admission ledger, the runner's OOM
+containment ladder (unfuse → replan-smaller → cpu), standing resident
+reservations, and the memory-adversarial acceptance soak.  Everything
+timing-shaped runs on one VirtualClock — zero real sleeps."""
+
+import json
+import os
+import sys
+import threading
+import warnings
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import sctools_tpu as sct  # noqa: E402
+from sctools_tpu import memory  # noqa: E402
+from sctools_tpu.data.shardstore import write_store  # noqa: E402
+from sctools_tpu.data.synthetic import synthetic_counts  # noqa: E402
+from sctools_tpu.memory import (MemoryBudget,  # noqa: E402
+                                default_estimates, estimate_run_peak,
+                                heuristic_estimate, step_estimate,
+                                step_sig)
+from sctools_tpu.plan import fused_pipeline  # noqa: E402
+from sctools_tpu.registry import (Pipeline, Transform,  # noqa: E402
+                                  register)
+from sctools_tpu.runner import ResilientRunner  # noqa: E402
+from sctools_tpu.scheduler import (RunRejected,  # noqa: E402
+                                   RunScheduler)
+from sctools_tpu.serving import (AnnotationService,  # noqa: E402
+                                 build_reference_artifact)
+from sctools_tpu.utils.chaos import ChaosMonkey, Fault  # noqa: E402
+from sctools_tpu.utils.failsafe import (DETERMINISTIC,  # noqa: E402
+                                        RESOURCE, TRANSIENT,
+                                        BreakerRegistry,
+                                        DeviceOOMError,
+                                        classify_child_result,
+                                        classify_error)
+from sctools_tpu.utils.telemetry import MetricsRegistry  # noqa: E402
+from sctools_tpu.utils.vclock import VirtualClock  # noqa: E402
+
+from soak_smoke import check_journal_coherent  # noqa: E402
+
+OK_PROBE = {"ok": True, "device_kind": "test", "wall_s": 0.0}
+
+
+# ---------------------------------------------------------------------------
+# fixtures: test ops with memory metadata
+# ---------------------------------------------------------------------------
+
+def _declared_cost(params, input_bytes):
+    """mem_cost callable: the op declares its own peak outright."""
+    return int(params.get("mem_bytes", input_bytes))
+
+
+def _block_shrink(params):
+    b = int(params.get("block", 256))
+    if b <= 32:
+        return None
+    params["block"] = b // 2
+    return params
+
+
+@pytest.fixture(scope="module")
+def mem_ops():
+    """Memory-domain test transforms under the reserved ``test.``
+    prefix, removed on module teardown so registry-wide gates never
+    see them."""
+    names = []
+
+    def reg(name, fn, **meta):
+        register(name, backend="cpu", **meta)(fn)
+        register(name, backend="tpu", **meta)(fn)
+        names.append(name)
+
+    def _passthrough(data, **kw):
+        return data
+
+    # fusable pair — the unfuse rung's target
+    reg("test.mem_fa", _passthrough, fusable=True, mem_cost=3.0)
+    reg("test.mem_fb", _passthrough, fusable=True)
+    # shrinkable op — the replan rung's target (fusable so the
+    # full-walk test can drive unfuse → replan on one chain)
+    reg("test.mem_shrinkable", _passthrough, fusable=True,
+        mem_shrink=_block_shrink)
+    # declared-cost op — deterministic admission estimates
+    reg("test.mem_sized", _passthrough, mem_cost=_declared_cost)
+    # plain op — the cpu rung's target
+    reg("test.mem_plain", _passthrough)
+    yield
+    registry_mod = __import__("sctools_tpu.registry",
+                              fromlist=["_REGISTRY"])
+    for n in names:
+        registry_mod._REGISTRY.pop(n, None)
+        registry_mod._DOCS.pop(n, None)
+        registry_mod._FUSABLE.pop(n, None)
+        registry_mod._MEM_COST.pop(n, None)
+        registry_mod._MEM_SHRINK.pop(n, None)
+
+
+@pytest.fixture(autouse=True)
+def _reset_estimates():
+    """The estimate store is process-shared BY DESIGN (corrections
+    must outlive pipelines); across tests that is a leak — an
+    OOM-corrected estimate from one test would change another's
+    admission rulings."""
+    yield
+    default_estimates().reset()
+
+
+def _data(n=64, g=32):
+    return synthetic_counts(n, g, density=0.2, seed=0)
+
+
+def _journal(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+def _runner(pipe, clock, m, chaos=None, **kw):
+    kw.setdefault("sleep", lambda s: None)
+    kw.setdefault("probe", lambda: dict(OK_PROBE))
+    return ResilientRunner(pipe, clock=clock, metrics=m, chaos=chaos,
+                           **kw)
+
+
+# ---------------------------------------------------------------------------
+# RESOURCE classification — the XlaRuntimeError message-shape corpus
+# ---------------------------------------------------------------------------
+
+def test_classify_resource_message_shapes():
+    """jaxlib raises ONE XlaRuntimeError class for every status; the
+    message is the only signal.  This is the observed OOM corpus."""
+    for msg in (
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+        "12884901888 bytes.",
+        "RESOURCE_EXHAUSTED: Error allocating device buffer: "
+        "Attempting to allocate 1.20G. That was not possible.",
+        "Resource exhausted: Out of memory",
+        "Ran out of memory in memory space hbm. Used 16.20G of "
+        "15.48G hbm.",
+        "XlaRuntimeError: RESOURCE_EXHAUSTED: Allocation failure",
+    ):
+        assert classify_error(RuntimeError(msg)) == RESOURCE, msg
+
+
+def test_classify_resource_explicit_type_and_precedence():
+    # the explicit assertion type
+    assert classify_error(DeviceOOMError("chaos oom")) == RESOURCE
+    # TYPE beats message: a ValueError mentioning OOM is still a
+    # program error — retrying OR laddering it would be wrong
+    assert classify_error(ValueError("config asked for out of memory "
+                                     "stress")) == DETERMINISTIC
+    # RESOURCE markers beat transient markers: an OOM whose message
+    # also carries connection noise must not be blindly retried
+    assert classify_error(RuntimeError(
+        "RESOURCE_EXHAUSTED: out of memory; transfer aborted")) \
+        == RESOURCE
+    # the transient set is unchanged
+    assert classify_error(RuntimeError("UNAVAILABLE: socket closed")) \
+        == TRANSIENT
+
+
+def test_classify_child_oom_tail():
+    """An isolated child dying on an OOM classifies RESOURCE in the
+    parent — the ladder, not blind retry, answers contained OOMs
+    too."""
+    res = {"status": "crashed", "rc": 1, "wall_s": 0.1,
+           "stderr_tail": "Traceback (most recent call last):\n"
+                          "  ...\njaxlib.xla_extension.XlaRuntimeError:"
+                          " RESOURCE_EXHAUSTED: Out of memory while "
+                          "trying to allocate 8589934592 bytes."}
+    exc = classify_child_result(res, "hvg.select")
+    assert isinstance(exc, DeviceOOMError)
+    assert classify_error(exc) == RESOURCE
+    # no traceback but an OOM signature (TPU runtime abort text)
+    res2 = {"status": "crashed", "rc": -6, "wall_s": 0.1,
+            "stderr_tail": "Ran out of memory in memory space hbm."}
+    assert isinstance(classify_child_result(res2, "x"), DeviceOOMError)
+
+
+# ---------------------------------------------------------------------------
+# the estimate model
+# ---------------------------------------------------------------------------
+
+def test_heuristic_estimates_fused_vs_chain(mem_ops):
+    nbytes = 10_000
+    fa = Transform("test.mem_fa", backend="tpu")     # mem_cost 3.0
+    fb = Transform("test.mem_fb", backend="tpu")     # default 2.0
+    # eager: input × mem_cost
+    assert heuristic_estimate(fa, nbytes) == 30_000
+    assert heuristic_estimate(fb, nbytes) == 20_000
+    fused = fused_pipeline(Pipeline([fa, fb])).steps[0]
+    assert fused.name.startswith("fused:")
+    # fused: 1 + Σ(m−1) = 1 + 2 + 1 = 4 → every intermediate live
+    assert heuristic_estimate(fused, nbytes) == 40_000
+    # unfused chain: max(m) — intermediates free between members
+    assert heuristic_estimate(fused.unfuse(), nbytes) == 30_000
+
+
+def test_step_sig_stable_across_rebuilt_objects(mem_ops):
+    a = Transform("test.mem_fa", backend="tpu", k=3)
+    b = Transform("test.mem_fa", backend="tpu", k=3)
+    assert step_sig(a, 5000) == step_sig(b, 5000)
+    # same power-of-two bucket → same key; different bucket → not
+    assert step_sig(a, 5000) == step_sig(a, 8192)
+    assert step_sig(a, 5000) != step_sig(a, 9000)
+    # params separate keys
+    assert step_sig(a, 5000) != step_sig(
+        Transform("test.mem_fa", backend="tpu", k=4), 5000)
+
+
+def test_registry_mem_metadata_accessors(mem_ops):
+    from sctools_tpu.registry import mem_cost_of, mem_shrink_of
+
+    # numeric metadata → tagged multiplier
+    assert mem_cost_of("test.mem_fa", "tpu") == ("mult", 3.0)
+    # callable metadata needs input bytes; without them the caller
+    # falls back to the default multiplier
+    assert mem_cost_of("test.mem_sized", "tpu",
+                       {"mem_bytes": 777}, input_bytes=10) \
+        == ("bytes", 777)
+    assert mem_cost_of("test.mem_sized", "tpu",
+                       {"mem_bytes": 777}) is None
+    assert mem_cost_of("test.mem_plain", "tpu") is None
+    # shrink halves toward the floor; AT the floor it returns None —
+    # and so does a shrink that changes nothing (ladder must not loop)
+    assert mem_shrink_of("test.mem_shrinkable", "tpu",
+                         {"block": 256}) == {"block": 128}
+    assert mem_shrink_of("test.mem_shrinkable", "tpu",
+                         {"block": 32}) is None
+    assert mem_shrink_of("test.mem_plain", "tpu", {}) is None
+
+
+def test_estimate_run_peak_per_step(mem_ops):
+    data = _data(8, 4)
+    pipe = Pipeline([("test.mem_sized", {"mem_bytes": 9_000}),
+                     ("test.mem_fa", {})])
+    est = estimate_run_peak(pipe, data)
+    assert [s["name"] for s in est["per_step"]] == \
+        ["test.mem_sized", "test.mem_fa"]
+    assert est["per_step"][0]["bytes"] == 9_000
+    # the run peak is the max over steps (sequential execution),
+    # floored at the input's own resident bytes
+    assert est["bytes"] == max(s["bytes"] for s in est["per_step"])
+
+
+def test_estimate_record_and_inflate(mem_ops):
+    est = memory.MemoryEstimates()
+    t = Transform("test.mem_plain", backend="tpu")
+    sig = step_sig(t, 1000)
+    est.record(sig, 5000, source="compiled")
+    assert step_estimate(t, 1000, est) == {"bytes": 5000,
+                                           "source": "compiled"}
+    # inflate doubles and marks corrected
+    assert est.inflate(sig, 5000) == 10000
+    assert step_estimate(t, 1000, est)["source"] == "corrected"
+    # a later compiled record must NOT deflate a correction — the
+    # device's refusal outranks the compiler's declaration
+    est.record(sig, 4000, source="compiled")
+    assert step_estimate(t, 1000, est)["bytes"] == 10000
+
+
+def test_compiled_estimate_recorded_and_within_factor(mem_ops):
+    """The accuracy satellite: a canned fused plan's recorded
+    estimate comes from compiled.memory_analysis(), and the mem_cost
+    heuristic is within the documented HEURISTIC_ACCURACY_FACTOR of
+    it."""
+    from sctools_tpu.plan import cache_info, clear_plan_cache
+
+    clear_plan_cache()
+    data = _data(256, 64).device_put()
+    pipe = Pipeline([("normalize.library_size", {}),
+                     ("normalize.log1p", {})])
+    fused = fused_pipeline(pipe)
+    stage = fused.steps[0]
+    input_bytes = memory.data_nbytes(data)
+    heur = step_estimate(stage, input_bytes)
+    assert heur["source"] == "heuristic"
+    fused.run(data)
+    # the plan-cache entry recorded the compiled peak...
+    entries = [e for e in cache_info()["entries"]
+               if e.get("peak_bytes")]
+    assert entries, "no plan-cache entry recorded a peak estimate"
+    # ...and the estimate store serves it for a REBUILT stage.  The
+    # stage's traced input bytes differ from the CellData total by
+    # the opaque leaves — accept either the compiled record (same
+    # size bucket) or the heuristic (bucket moved), but the compiled
+    # number must exist in the store under the stage's own sig
+    rec = step_estimate(stage, input_bytes)
+    actual = entries[0]["peak_bytes"]
+    assert actual > 0
+    f = memory.HEURISTIC_ACCURACY_FACTOR
+    assert actual / f <= heur["bytes"] <= actual * f, (
+        f"heuristic {heur['bytes']} vs compiled {actual} outside "
+        f"the documented factor {f}")
+    assert rec["bytes"] > 0
+
+
+def test_oom_correction_persists_across_rebuilt_pipeline(mem_ops):
+    """The self-correction satellite: an OOM observed at runtime
+    inflates the stored estimate, and a REBUILT pipeline (fresh
+    Transform objects) sees the inflated number."""
+    clock = VirtualClock()
+    m = MetricsRegistry(clock=clock)
+    data = _data()
+    before = estimate_run_peak(
+        Pipeline([("test.mem_plain", {})]), data)["bytes"]
+    chaos = ChaosMonkey([Fault("test.mem_plain", "oom",
+                               backend="tpu", times=1)], clock=clock)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        _runner(Pipeline([("test.mem_plain", {})]), clock, m,
+                chaos=chaos).run(data, backend="tpu")
+    after = estimate_run_peak(
+        Pipeline([("test.mem_plain", {})]), data)["bytes"]
+    assert after >= 2 * before
+    snap = m.snapshot_compact()
+    assert snap.get("mem.estimate_corrections", 0) >= 1
+    assert snap.get("mem.oom_events{rung=cpu}", 0) == 1
+
+
+# ---------------------------------------------------------------------------
+# the budget
+# ---------------------------------------------------------------------------
+
+def test_budget_ledger_and_pressure():
+    m = MetricsRegistry()
+    b = MemoryBudget(1000, name="dev", metrics=m)
+    assert b.available_bytes() == 1000
+    b.reserve("run:1", 400, tenant="a")
+    b.reserve("resident", 300, standing=True)
+    assert b.reserved_bytes() == 700
+    assert b.standing_bytes() == 300
+    # admission feasibility excludes dynamic holds AND pressure
+    assert b.admissible_bytes() == 700
+    assert b.fits(300) and not b.fits(301)
+    b.set_pressure(0.5)  # apparent capacity 500 < held 700
+    assert not b.fits(1)
+    assert b.admissible_bytes() == 700  # pressure ignored on purpose
+    b.clear_pressure()
+    # re-reserving a name REPLACES the amount
+    b.reserve("run:1", 100, tenant="a")
+    assert b.reserved_bytes() == 400
+    b.release("run:1")
+    b.release("run:1")  # idempotent
+    assert b.reserved_bytes() == 300
+    assert b.peak_reserved_bytes == 700
+    snap = b.snapshot()
+    assert snap["holders"]["resident"]["standing"] is True
+    assert m.snapshot()["gauges"]["mem.budget_bytes"] == 1000
+
+
+def test_budget_env_cap_detection(monkeypatch):
+    monkeypatch.setenv("SCTOOLS_MEM_BUDGET_BYTES", "4096")
+    b = MemoryBudget()
+    assert b.capacity_bytes == 4096
+    monkeypatch.setenv("SCTOOLS_MEM_BUDGET_BYTES", "not-a-number")
+    with pytest.raises(ValueError):
+        MemoryBudget()
+    # CPU devices report no bytes_limit → explicit capacity required
+    monkeypatch.delenv("SCTOOLS_MEM_BUDGET_BYTES")
+    with pytest.raises(ValueError):
+        MemoryBudget()
+
+
+def test_budget_scope_thread_local():
+    b = MemoryBudget(100)
+    assert memory.current_budget() is None
+    with memory.budget_scope(b):
+        assert memory.current_budget() is b
+        seen = []
+        th = threading.Thread(
+            target=lambda: seen.append(memory.current_budget()))
+        th.start()
+        th.join()
+        assert seen == [None]  # never leaks across threads
+    assert memory.current_budget() is None
+
+
+# ---------------------------------------------------------------------------
+# the runner's OOM containment ladder
+# ---------------------------------------------------------------------------
+
+def test_oom_ladder_unfuse_rung(mem_ops, tmp_path):
+    clock = VirtualClock()
+    m = MetricsRegistry(clock=clock)
+    chaos = ChaosMonkey([Fault("test.mem_fa", "oom", backend="tpu",
+                               times=1)], clock=clock)
+    r = _runner(Pipeline([("test.mem_fa", {}), ("test.mem_fb", {})]),
+                clock, m, chaos=chaos, fuse=True,
+                checkpoint_dir=str(tmp_path / "ck"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out = r.run(_data(), backend="tpu")
+    assert out is not None and r.report.status == "completed"
+    assert not r.report.degraded  # stayed on the accelerator
+    degrades = [e for e in _journal(r.journal.path)
+                if e["event"] == "degrade"]
+    assert [e["rung"] for e in degrades] == ["unfuse"]
+    assert degrades[0]["reason"] == "oom"
+    assert degrades[0]["from_bytes"] > 0
+    # unfused chain peak < fused peak — the rung's whole point
+    assert degrades[0]["to_bytes"] < degrades[0]["from_bytes"]
+    assert m.snapshot_compact()["mem.oom_events{rung=unfuse}"] == 1
+
+
+def test_oom_ladder_replan_rung(mem_ops, tmp_path):
+    clock = VirtualClock()
+    m = MetricsRegistry(clock=clock)
+    chaos = ChaosMonkey([Fault("test.mem_shrinkable", "oom",
+                               backend="tpu", times=1)], clock=clock)
+    r = _runner(Pipeline([("test.mem_shrinkable", {"block": 256})]),
+                clock, m, chaos=chaos,
+                checkpoint_dir=str(tmp_path / "ck"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        r.run(_data(), backend="tpu")
+    assert r.report.status == "completed" and not r.report.degraded
+    degrades = [e for e in _journal(r.journal.path)
+                if e["event"] == "degrade"]
+    assert [e["rung"] for e in degrades] == ["replan"]
+    # the shrunk params moved the step fingerprint (checkpoints from
+    # the larger plan never mix)
+    assert degrades[0]["fingerprint"] == r.report.steps[0].fingerprint
+
+
+def test_oom_ladder_cpu_rung_and_bottom_fail(mem_ops, tmp_path):
+    clock = VirtualClock()
+    m = MetricsRegistry(clock=clock)
+    # tpu-only persistent OOM → cpu rung completes
+    chaos = ChaosMonkey([Fault("test.mem_plain", "oom",
+                               backend="tpu", times=-1)], clock=clock)
+    r = _runner(Pipeline([("test.mem_plain", {})]), clock, m,
+                chaos=chaos, checkpoint_dir=str(tmp_path / "ck1"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        r.run(_data(), backend="tpu")
+    assert r.report.status == "completed"
+    assert r.report.degraded and r.report.backend == "cpu"
+    assert [e["rung"] for e in _journal(r.journal.path)
+            if e["event"] == "degrade"] == ["cpu"]
+    # the OOM never fed the breaker — a full device is not an outage
+    assert r.report.breaker["state"] == "closed"
+    assert r.report.breaker["failures_in_window"] == 0
+
+    # both backends OOM → bottom-rung recurrence is deterministic
+    chaos2 = ChaosMonkey([Fault("test.mem_plain", "oom", times=-1)],
+                         clock=clock)
+    r2 = _runner(Pipeline([("test.mem_plain", {})]), clock, m,
+                 chaos=chaos2, checkpoint_dir=str(tmp_path / "ck2"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(DeviceOOMError):
+            r2.run(_data(), backend="tpu")
+    assert r2.report.status == "failed"
+    evs = _journal(r2.journal.path)
+    assert evs[-1]["event"] == "run_failed"
+    assert evs[-1]["classified"] == "resource"
+    snap = m.snapshot_compact()
+    assert snap["mem.oom_events{rung=fail}"] == 1
+
+
+def test_oom_ladder_sharded_stage_never_unfuses(mem_ops, tmp_path):
+    """A mesh-sharded fused stage must NOT take the unfuse rung: the
+    unfused chain runs single-device, concentrating the whole sharded
+    input onto one device — a guaranteed re-OOM.  Sharded stages go
+    straight past unfuse (replan when shrinkable, else cpu)."""
+    from sctools_tpu.parallel import make_mesh
+
+    clock = VirtualClock()
+    m = MetricsRegistry(clock=clock)
+    chaos = ChaosMonkey([Fault("test.mem_fa", "oom", backend="tpu",
+                               times=-1)], clock=clock)
+    r = _runner(Pipeline([("test.mem_fa", {}), ("test.mem_fb", {})]),
+                clock, m, chaos=chaos, fuse=True, mesh=make_mesh(2),
+                checkpoint_dir=str(tmp_path / "ck"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        r.run(_data(), backend="tpu")
+    assert r.report.status == "completed"
+    rungs = [e["rung"] for e in _journal(r.journal.path)
+             if e["event"] == "degrade" and e.get("reason") == "oom"]
+    assert "unfuse" not in rungs
+    assert rungs[-1] == "cpu"
+
+
+def test_oom_ladder_without_fallback_backend(mem_ops, tmp_path):
+    """unfuse/replan are SAME-backend rungs: a runner that forbids
+    the cpu degrade (fallback_backend=None) must still walk them —
+    only the cpu rung needs a fallback, and the bottom rung is then
+    fail instead."""
+    clock = VirtualClock()
+    m = MetricsRegistry(clock=clock)
+    chaos = ChaosMonkey([Fault("test.mem_fa", "oom", backend="tpu",
+                               times=1)], clock=clock)
+    r = _runner(Pipeline([("test.mem_fa", {}), ("test.mem_fb", {})]),
+                clock, m, chaos=chaos, fuse=True,
+                fallback_backend=None,
+                checkpoint_dir=str(tmp_path / "ck"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        r.run(_data(), backend="tpu")
+    assert r.report.status == "completed"
+    assert [e["rung"] for e in _journal(r.journal.path)
+            if e["event"] == "degrade"] == ["unfuse"]
+
+    # persistent OOM with no fallback: unfuse fires, then fail — the
+    # run never silently lands on a forbidden backend
+    chaos2 = ChaosMonkey([Fault("test.mem_*", "oom", backend="tpu",
+                                times=-1)], clock=clock)
+    r2 = _runner(Pipeline([("test.mem_fa", {}), ("test.mem_fb", {})]),
+                 clock, m, chaos=chaos2, fuse=True,
+                 fallback_backend=None,
+                 checkpoint_dir=str(tmp_path / "ck2"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(DeviceOOMError):
+            r2.run(_data(), backend="tpu")
+    rungs = [e["rung"] for e in _journal(r2.journal.path)
+             if e["event"] == "degrade"]
+    assert rungs == ["unfuse"]
+    assert r2.report.status == "failed"
+    assert all(a.backend == "tpu" for s in r2.report.steps
+               for a in s.attempts)
+
+
+def test_oom_ladder_full_walk_one_step(mem_ops, tmp_path):
+    """One fused step OOMing repeatedly walks EVERY rung in order:
+    unfuse → replan (twice — block 256→128→64) → cpu."""
+    clock = VirtualClock()
+    m = MetricsRegistry(clock=clock)
+    chaos = ChaosMonkey([Fault("test.mem_*", "oom", backend="tpu",
+                               times=-1)], clock=clock)
+    pipe = Pipeline([("test.mem_fa", {}),
+                     ("test.mem_shrinkable", {"block": 128})])
+    r = _runner(pipe, clock, m, chaos=chaos, fuse=True,
+                checkpoint_dir=str(tmp_path / "ck"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        r.run(_data(), backend="tpu")
+    assert r.report.status == "completed"
+    assert r.report.degraded and r.report.backend == "cpu"
+    rungs = [e["rung"] for e in _journal(r.journal.path)
+             if e["event"] == "degrade"]
+    # fused stage unfuses, the shrinkable member replans 128→64→32,
+    # then the step leaves the accelerator
+    assert rungs[0] == "unfuse"
+    assert rungs[-1] == "cpu"
+    assert "replan" in rungs
+
+
+# ---------------------------------------------------------------------------
+# budgeted admission
+# ---------------------------------------------------------------------------
+
+def _sched(clock, m, budget, jpath, chaos=None, **kw):
+    kw.setdefault("max_concurrency", 2)
+    return RunScheduler(
+        clock=clock, metrics=m, journal_path=jpath,
+        breakers=BreakerRegistry(clock=clock), chaos=chaos,
+        mem_budget=budget,
+        runner_defaults={"sleep": lambda s: None,
+                         "probe": lambda: dict(OK_PROBE)}, **kw)
+
+
+def test_admission_rejects_infeasible_run(mem_ops, tmp_path):
+    clock = VirtualClock()
+    m = MetricsRegistry(clock=clock)
+    budget = MemoryBudget(10_000, name="dev", metrics=m)
+    jpath = str(tmp_path / "journal.jsonl")
+    with _sched(clock, m, budget, jpath) as s:
+        with pytest.raises(RunRejected) as ei:
+            s.submit(Pipeline([("test.mem_sized",
+                                {"mem_bytes": 50_000})]),
+                     _data(8, 4), backend="cpu")
+        assert ei.value.reason == "over_memory"
+        # feasible work is untouched
+        h = s.submit(Pipeline([("test.mem_sized",
+                                {"mem_bytes": 5_000})]),
+                     _data(8, 4), backend="cpu")
+        h.result(timeout=60)
+    evs = _journal(jpath)
+    assert [e for e in evs if e["event"] == "rejected"][0]["reason"] \
+        == "over_memory"
+    assert m.snapshot_compact()[
+        "sched.rejected{reason=over_memory,tenant=default}"] == 1
+
+
+def test_over_budget_work_queues_not_co_schedules(mem_ops, tmp_path):
+    """Two runs that each fit but cannot fit TOGETHER serialize: the
+    second queues until the first releases — never an OOM-shaped
+    co-schedule, proven by the reservation high-water."""
+    clock = VirtualClock()
+    m = MetricsRegistry(clock=clock)
+    budget = MemoryBudget(10_000, name="dev", metrics=m)
+    gate = threading.Event()
+    started = threading.Event()
+
+    def _block(data, **kw):
+        started.set()
+        gate.wait(60)
+        return data
+
+    register("test.mem_block", backend="cpu",
+             mem_cost=_declared_cost)(_block)
+    register("test.mem_block", backend="tpu",
+             mem_cost=_declared_cost)(_block)
+    try:
+        jpath = str(tmp_path / "journal.jsonl")
+        with _sched(clock, m, budget, jpath, max_concurrency=4) as s:
+            h1 = s.submit(Pipeline([("test.mem_block",
+                                     {"mem_bytes": 6_000})]),
+                          _data(8, 4), tenant="a", backend="cpu")
+            assert started.wait(30)
+            h2 = s.submit(Pipeline([("test.mem_block",
+                                     {"mem_bytes": 6_000})]),
+                          _data(8, 4), tenant="b", backend="cpu")
+            assert h2.status == "queued"  # fits alone, not beside h1
+            gate.set()
+            h1.result(timeout=60)
+            h2.result(timeout=60)
+        assert budget.peak_reserved_bytes <= 10_000
+        assert budget.reserved_bytes() == 0
+        reserved = [e for e in _journal(jpath)
+                    if e["event"] == "mem_reserved"]
+        assert len(reserved) == 2
+        assert all(e["reserved_total"] <= 10_000 for e in reserved)
+    finally:
+        registry_mod = __import__("sctools_tpu.registry",
+                                  fromlist=["_REGISTRY"])
+        registry_mod._REGISTRY.pop("test.mem_block", None)
+        registry_mod._MEM_COST.pop("test.mem_block", None)
+
+
+def test_standing_growth_sheds_queued_over_memory(mem_ops, tmp_path):
+    """Admission promised feasibility-at-zero-concurrency; a standing
+    resident that lands AFTER admission can break the promise — the
+    queued item is shed ``over_memory`` instead of wedging the queue
+    (and any draining shutdown) forever."""
+    clock = VirtualClock()
+    m = MetricsRegistry(clock=clock)
+    budget = MemoryBudget(10_000, name="dev", metrics=m)
+    gate = threading.Event()
+    started = threading.Event()
+
+    def _block(data, **kw):
+        started.set()
+        gate.wait(60)
+        return data
+
+    register("test.mem_block2", backend="cpu",
+             mem_cost=_declared_cost)(_block)
+    register("test.mem_block2", backend="tpu",
+             mem_cost=_declared_cost)(_block)
+    try:
+        jpath = str(tmp_path / "journal.jsonl")
+        with _sched(clock, m, budget, jpath, max_concurrency=1) as s:
+            h1 = s.submit(Pipeline([("test.mem_block2",
+                                     {"mem_bytes": 2_000})]),
+                          _data(8, 4), backend="cpu")
+            assert started.wait(30)
+            h2 = s.submit(Pipeline([("test.mem_sized",
+                                     {"mem_bytes": 8_000})]),
+                          _data(8, 4), backend="cpu")
+            # a resident arrives while h2 queues: 8k no longer fits
+            # beside 5k standing at ANY concurrency
+            budget.reserve("resident", 5_000, standing=True)
+            gate.set()
+            h1.result(timeout=60)
+            with pytest.raises(RunRejected) as ei:
+                h2.result(timeout=60)
+            assert ei.value.reason == "over_memory"
+    finally:
+        registry_mod = __import__("sctools_tpu.registry",
+                                  fromlist=["_REGISTRY"])
+        registry_mod._REGISTRY.pop("test.mem_block2", None)
+        registry_mod._MEM_COST.pop("test.mem_block2", None)
+
+
+def test_chaos_mem_pressure_channel(mem_ops, tmp_path):
+    clock = VirtualClock()
+    monkey = ChaosMonkey([Fault("dev", "mem_pressure", on_call=2,
+                                times=1)], clock=clock,
+                         pressure_frac=0.25)
+    # channel disjointness: a memory-mode fault never fires on the
+    # op-call channel
+    assert monkey._firing("dev", None, 2, channel="call") is None
+    assert monkey.on_memory("dev") is None          # call 1
+    ruling = monkey.on_memory("dev")                # call 2: fires
+    assert ruling == {"mode": "mem_pressure", "pressure_frac": 0.25}
+    assert monkey.on_memory("dev") is None          # window passed
+    # spec round-trip carries pressure_frac
+    clone = ChaosMonkey.from_spec(monkey.spec())
+    assert clone.pressure_frac == 0.25
+
+    # end to end: the firing submit shrinks the apparent budget, the
+    # next submit restores it
+    m = MetricsRegistry(clock=clock)
+    budget = MemoryBudget(10_000, name="dev2", metrics=m)
+    chaos = ChaosMonkey([Fault("dev2", "mem_pressure", on_call=1,
+                               times=1)], clock=clock,
+                        pressure_frac=0.5)
+    with _sched(clock, m, budget, str(tmp_path / "j.jsonl"),
+                chaos=chaos) as s:
+        h1 = s.submit(Pipeline([("test.mem_sized",
+                                 {"mem_bytes": 100})]),
+                      _data(8, 4), backend="cpu")
+        assert budget.pressure == 0.5
+        h2 = s.submit(Pipeline([("test.mem_sized",
+                                 {"mem_bytes": 100})]),
+                      _data(8, 4), backend="cpu")
+        assert budget.pressure == 1.0
+        h1.result(timeout=60)
+        h2.result(timeout=60)
+    assert [f["mode"] for f in chaos.injected] == ["mem_pressure"]
+
+
+# ---------------------------------------------------------------------------
+# standing resident reservations
+# ---------------------------------------------------------------------------
+
+N_REF, N_GENES = 256, 48
+
+
+def _artifact(tmp_path):
+    ref = synthetic_counts(N_REF, N_GENES, density=0.15, n_clusters=3,
+                           seed=0)
+    labels = np.array([f"type{c}"
+                       for c in np.asarray(ref.obs["cluster_true"])])
+    ref = ref.with_obs(cell_type=labels)
+    fitted = sct.run_recipe("annotation_reference", ref,
+                            backend="cpu", n_components=8)
+    path = str(tmp_path / "model.npz")
+    build_reference_artifact(fitted, path, labels_key="cell_type",
+                             seed=0, version="v1")
+    return path
+
+
+def test_serving_model_holds_standing_reservation(mem_ops, tmp_path):
+    clock = VirtualClock()
+    m = MetricsRegistry(clock=clock)
+    budget = MemoryBudget(50_000_000, name="dev", metrics=m)
+    path = _artifact(tmp_path)
+    svc = AnnotationService(
+        path, name="memsvc", backend="tpu", clock=clock, metrics=m,
+        journal_path=str(tmp_path / "journal.jsonl"),
+        mem_budget=budget, k=5,
+        runner_defaults={"probe": lambda: dict(OK_PROBE)})
+    try:
+        held = budget.holders()
+        assert "serve:memsvc:model" in held
+        assert held["serve:memsvc:model"]["standing"] is True
+        assert held["serve:memsvc:model"]["bytes"] > 0
+        # admission headroom shrank by exactly the resident
+        assert budget.admissible_bytes() == \
+            budget.capacity_bytes - held["serve:memsvc:model"]["bytes"]
+        evs = _journal(str(tmp_path / "journal.jsonl"))
+        assert any(e["event"] == "mem_reserved" and e.get("standing")
+                   for e in evs)
+    finally:
+        svc.close()
+    assert "serve:memsvc:model" not in budget.holders()
+    evs = _journal(str(tmp_path / "journal.jsonl"))
+    assert any(e["event"] == "mem_released" and e.get("standing")
+               for e in evs)
+
+
+def test_train_feed_holds_named_run_reservation(mem_ops, tmp_path):
+    from sctools_tpu.models.train_stream import fit_scvi_stream
+
+    counts = synthetic_counts(256, 32, density=0.2, seed=0)
+    store = write_store(counts.X, str(tmp_path / "store"),
+                        shard_rows=64, chunk_rows=32)
+    budget = MemoryBudget(100_000_000, name="dev")
+    seen = {}
+    admissible_during = []
+
+    class _SpyJournal:
+        def write(self, event, **fields):
+            if event == "mem_reserved":
+                # run-scoped, so DYNAMIC: the hold tightens dispatch
+                # fitting but must not shrink the admission floor —
+                # a standing feed would permanently shed queued work
+                # that fits the moment training ends
+                admissible_during.append(budget.admissible_bytes())
+            if event.startswith("mem_"):
+                seen.setdefault(event, []).append(fields)
+
+    fit_scvi_stream(store, n_latent=2, n_hidden=8, epochs=1,
+                    batch_size=64, seed=0, mem_budget=budget,
+                    journal=_SpyJournal())
+    # reserved for the run's lifetime, released on completion
+    assert len(seen["mem_reserved"]) == 1
+    res = seen["mem_reserved"][0]
+    assert res["name"].startswith("train:feed:")
+    # (prefetch_depth + 1) dense shards
+    assert res["bytes"] == 3 * store.shard_rows * store.n_genes * 4
+    assert admissible_during == [budget.capacity_bytes]
+    assert len(seen["mem_released"]) == 1
+    assert budget.reserved_bytes() == 0
+    assert budget.peak_reserved_bytes == res["bytes"]
+
+
+# ---------------------------------------------------------------------------
+# sctreport memory section
+# ---------------------------------------------------------------------------
+
+def test_sctreport_memory_section(tmp_path):
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    from tools.sctreport import memory_section
+
+    events = [
+        {"event": "mem_reserved", "ticket": 0, "tenant": "lab-a",
+         "bytes": 600, "reserved_total": 600, "ts": 1.0},
+        {"event": "mem_reserved", "standing": True,
+         "service": "svc", "bytes": 300, "reserved_total": 900,
+         "ts": 1.5},
+        {"event": "mem_reserved", "name": "train:feed:1",
+         "bytes": 120, "reserved_total": 1020, "ts": 1.7},
+        {"event": "mem_released", "ticket": 0, "tenant": "lab-a",
+         "bytes": 600, "reserved_total": 300, "ts": 2.0},
+        {"event": "degrade", "step": 1, "reason": "oom",
+         "rung": "unfuse", "from_bytes": 4000, "to_bytes": 3000,
+         "corrected_bytes": 8000, "ts": 2.5},
+    ]
+    metrics = {"metrics": {
+        "counters": {"mem.oom_events{rung=unfuse}": 1.0,
+                     "mem.estimate_corrections": 1.0},
+        "gauges": {"mem.budget_bytes": 1000.0,
+                   "mem.reserved_bytes": 300.0},
+        "histograms": {},
+    }}
+    L = memory_section(events, metrics)
+    text = "\n".join(L)
+    assert L[0] == "-- memory --"
+    assert "budget 1000 bytes" in text
+    assert "high-water 1020" in text
+    assert "lab-a" in text
+    assert "svc" in text and "(standing)" in text
+    assert "train:feed:1" in text
+    assert "rung=unfuse" in text and "4000 -> 3000" in text
+    assert "corrected to 8000" in text
+    assert "estimate corrections (inflate-on-OOM): 1" in text
+    # absence contract: no mem series → no section
+    assert memory_section([], {"metrics": {"counters": {},
+                                           "gauges": {},
+                                           "histograms": {}}}) == []
+
+
+# ---------------------------------------------------------------------------
+# THE ACCEPTANCE SOAK — memory-adversarial multi-tenant traffic
+# ---------------------------------------------------------------------------
+
+def test_memory_adversarial_acceptance_soak(mem_ops, tmp_path):
+    """The PR's acceptance criteria, end to end on ONE VirtualClock
+    with zero real sleeps:
+
+    * >= 20 concurrent mixed-size submissions — serving queries from
+      three tenants through an AnnotationService sharing the pool,
+      one PREEMPTIBLE out-of-core training job, and ladder-driving
+      pipeline runs — under a budget that cannot hold half of their
+      summed estimates at once;
+    * chaos ``oom`` (tpu-only, several ops) and ``mem_pressure``
+      faults mid-soak;
+    * every ticket terminal exactly once with a journaled reason;
+    * peak reserved bytes never exceed the budget;
+    * at least one run COMPLETES through each containment-ladder
+      rung (unfuse, replan-smaller, cpu);
+    * an over-budget arrival is refused ``over_memory`` at admission.
+    """
+    clock = VirtualClock()
+    m = MetricsRegistry(clock=clock)
+    CAP = 40_000_000
+    budget = MemoryBudget(CAP, name="hbm0", metrics=m)
+    jpath = str(tmp_path / "journal.jsonl")
+    chaos = ChaosMonkey(
+        [Fault("test.mem_fa", "oom", backend="tpu", times=1),
+         Fault("test.mem_shrinkable", "oom", backend="tpu", times=1),
+         Fault("test.mem_plain", "oom", backend="tpu", times=-1),
+         Fault("hbm0", "mem_pressure", on_call=8, times=4)],
+        clock=clock, pressure_frac=0.6)
+    sched = RunScheduler(
+        max_concurrency=4, clock=clock, metrics=m,
+        journal_path=jpath, breakers=BreakerRegistry(clock=clock),
+        chaos=chaos, mem_budget=budget,
+        runner_defaults={"sleep": lambda s: None,
+                         "probe": lambda: dict(OK_PROBE)})
+
+    # the resident reference model (standing reservation)
+    svc = AnnotationService(_artifact(tmp_path), name="soaksvc",
+                            backend="tpu", scheduler=sched, k=5)
+
+    # the training store (tiny; the job is about the CONTRACT)
+    counts = synthetic_counts(256, 32, density=0.2, seed=1)
+    store_dir = str(tmp_path / "store")
+    write_store(counts.X, store_dir, shard_rows=64, chunk_rows=32)
+
+    handles, tickets, rejected = [], [], []
+    ladder_dirs = {}
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+
+        # 1 preemptible training job (low priority)
+        handles.append(sched.submit(
+            Pipeline([("model.scvi_stream",
+                       {"store_dir": store_dir, "n_latent": 2,
+                        "n_hidden": 8, "epochs": 1, "batch_size": 64,
+                        "seed": 0,
+                        "checkpoint": str(tmp_path / "cursor.npz")})]),
+            _data(8, 4), tenant="train-lab", priority=0,
+            backend="cpu", preemptible=True))
+
+        # 3 ladder-driving runs, one per rung, each with its own
+        # journal so the rung ruling is auditable
+        for nick, pipe, kw in [
+            ("unfuse", Pipeline([("test.mem_fa", {}),
+                                 ("test.mem_fb", {})]),
+             {"fuse": True}),
+            ("replan", Pipeline([("test.mem_shrinkable",
+                                  {"block": 256})]), {}),
+            ("cpu", Pipeline([("test.mem_plain", {})]), {}),
+        ]:
+            d = str(tmp_path / f"ladder_{nick}")
+            ladder_dirs[nick] = d
+            handles.append(sched.submit(
+                pipe, _data(), tenant=f"lab-{nick}", priority=1,
+                backend="tpu",
+                runner_kw={"checkpoint_dir": d, **kw}))
+
+        # 8 bulk analyses with DECLARED peaks — the runs whose summed
+        # estimates over-subscribe the budget 2×+, so dispatch must
+        # serialize them (at most ~3 × 12M fit in 40M at once)
+        for i in range(8):
+            handles.append(sched.submit(
+                Pipeline([("test.mem_sized",
+                           {"mem_bytes": 12_000_000})]),
+                _data(8, 4), tenant=f"bulk-{i % 2}", priority=1,
+                backend="cpu"))
+
+        # 16 serving queries, mixed sizes, three tenants, higher
+        # priority than the training job (it must yield, not block)
+        rng = np.random.default_rng(7)
+        for i in range(16):
+            n = int(rng.integers(3, 40))
+            q = synthetic_counts(n, N_GENES, density=0.15, seed=100 + i)
+            handles.append(svc.query(
+                q, "label_transfer", tenant=f"lab-{i % 3}",
+                priority=2))
+
+        # the over-budget arrival: refused at the door
+        with pytest.raises(RunRejected) as ei:
+            sched.submit(Pipeline([("test.mem_sized",
+                                    {"mem_bytes": CAP * 10})]),
+                         _data(8, 4), tenant="greedy", backend="cpu")
+        assert ei.value.reason == "over_memory"
+        rejected.append(ei.value)
+
+        # drain: every handle terminal
+        for h in handles:
+            obj = getattr(h, "handle", h)   # ServeTicket or RunHandle
+            assert obj.wait(timeout=300), obj
+            tickets.append(obj)
+        results = []
+        for h in handles:
+            results.append(h.result(timeout=10))
+        svc.close()
+        sched.shutdown(wait=True)
+
+    # --- every ticket terminal exactly once with a journaled reason
+    n_tickets = len(handles) + 1    # + the rejected arrival
+    assert n_tickets >= 21          # >= 20 submissions + rejection
+    by_ticket = check_journal_coherent(jpath, n_tickets)
+    assert len(by_ticket) == n_tickets
+
+    # --- the budget held: peak reserved never exceeded capacity
+    assert 0 < budget.peak_reserved_bytes <= CAP
+    assert budget.reserved_bytes() == 0   # everything released
+    evs = _journal(jpath)
+    for e in evs:
+        if e["event"] == "mem_reserved":
+            assert e["reserved_total"] <= CAP
+
+    # --- mixed sizes genuinely over-subscribed the budget: the
+    # summed admitted estimates (+ standing residents) could not have
+    # co-scheduled — the budget fits at most half of them at once
+    admitted_bytes = sum(e.get("mem_bytes", 0) for e in evs
+                         if e["event"] == "admitted")
+    assert admitted_bytes > 2 * CAP
+
+    # --- chaos fired on both memory channels
+    modes = {f["mode"] for f in chaos.injected}
+    assert "oom" in modes and "mem_pressure" in modes
+    assert budget.pressure == 1.0   # episode over by shutdown
+
+    # --- at least one run completed through EACH ladder rung
+    for nick, rung in [("unfuse", "unfuse"), ("replan", "replan"),
+                       ("cpu", "cpu")]:
+        run_evs = _journal(os.path.join(ladder_dirs[nick],
+                                        "journal.jsonl"))
+        rungs = [e["rung"] for e in run_evs if e["event"] == "degrade"
+                 and e.get("reason") == "oom"]
+        assert rung in rungs, (nick, rungs)
+        assert run_evs[-1]["event"] == "run_completed", nick
+    snap = m.snapshot_compact()
+    for rung in ("unfuse", "replan", "cpu"):
+        assert snap.get(f"mem.oom_events{{rung={rung}}}", 0) >= 1
+
+    # --- the training job terminal-completed (possibly after
+    # preemption yields) and its feed reservation is gone
+    train_result = results[0]
+    assert train_result.uns["scvi_stream_epochs"] == 1
+    assert not any(k.startswith("train:feed")
+                   for k in budget.holders())
+
+    # --- serving queries all completed on the resident model
+    for res in results[12:]:
+        assert res["labels"].shape[0] >= 1
+    assert snap.get("serve.queries{outcome=completed}", 0) == 16

@@ -96,9 +96,15 @@ def test_classify_error_taxonomy():
     # unknown errors fail fast, not retry
     assert classify_error(RuntimeError("novel weirdness")) \
         == DETERMINISTIC
-    # RESOURCE_EXHAUSTED recurs at the same shapes — never retried
+    # RESOURCE_EXHAUSTED recurs at the same shapes — never blindly
+    # retried; since the memory fault domain landed it is its own
+    # explicit class (the runner answers with the OOM containment
+    # ladder, not retry-or-fail-fast — tests/test_memory.py pins the
+    # message-shape corpus)
+    from sctools_tpu.utils.failsafe import RESOURCE
+
     assert classify_error(RuntimeError("RESOURCE_EXHAUSTED: HBM OOM")) \
-        == DETERMINISTIC
+        == RESOURCE
     assert classify_error(KeyboardInterrupt()) == FATAL
     assert classify_error(SystemExit(1)) == FATAL
     assert classify_error(ChaosCrash("preempted")) == FATAL

@@ -99,7 +99,19 @@
 #                     it was admitted under, one VirtualClock, zero
 #                     real sleeps (docs/ARCHITECTURE.md
 #                     "Resident-state serving")
-#  13. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
+#  13. memory        python tests/mem_smoke.py — the memory fault
+#                     domain's contract: a CAPPED fake budget
+#                     (SCTOOLS_MEM_BUDGET_BYTES) admits a mixed-size
+#                     multi-tenant soak under chaos oom +
+#                     mem_pressure — zero unhandled OOMs (every
+#                     oom-faulted run completes through a containment
+#                     -ladder rung: unfuse / replan-smaller / cpu),
+#                     peak reserved bytes never exceed the cap, an
+#                     infeasible arrival is refused over_memory at
+#                     admission, journal coherent, one VirtualClock
+#                     with zero real sleeps (docs/ARCHITECTURE.md
+#                     "Memory fault domain")
+#  14. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
 
 set -u -o pipefail
 
@@ -337,6 +349,14 @@ if JAX_PLATFORMS=cpu python tests/serving_smoke.py; then
     :
 else
     echo "serving stage FAILED (rc=$?)"
+    fail=1
+fi
+
+stage "memory (capped budget, chaos oom+mem_pressure, ladder rungs)"
+if JAX_PLATFORMS=cpu python tests/mem_smoke.py; then
+    :
+else
+    echo "memory stage FAILED (rc=$?)"
     fail=1
 fi
 

@@ -261,7 +261,11 @@ def render(run_dir: str, runs: list[dict], trace_d: dict | None,
                 f"reason={e.get('reason')}"
                 + (f" ({e.get('from_devices')} -> "
                    f"{e.get('to_devices')} devices)"
-                   if e.get("from_devices") is not None else ""))
+                   if e.get("from_devices") is not None else "")
+                + (f" rung={e.get('rung')} "
+                   f"({e.get('from_bytes')} -> {e.get('to_bytes')} "
+                   f"bytes)"
+                   if e.get("rung") is not None else ""))
         for e in r["quarantines"]:
             add(f"  run {ri}: QUARANTINE step {e.get('step')}: "
                 f"{e.get('reason')} -> {e.get('path')}")
@@ -321,6 +325,11 @@ def render(run_dir: str, runs: list[dict], trace_d: dict | None,
     if serving:
         add("")
         L.extend(serving)
+
+    mem = memory_section(events or [], metrics)
+    if mem:
+        add("")
+        L.extend(mem)
 
     add("")
     add("-- metrics snapshot --")
@@ -769,6 +778,98 @@ def serving_section(events: list[dict], metrics) -> list[str]:
                          + (f" (agreement {e.get('agreement')})"
                             if e.get("agreement") is not None
                             else ""))
+    return L
+
+
+def memory_section(events: list[dict], metrics) -> list[str]:
+    """The memory-fault-domain digest, rendered only when the run
+    recorded ``mem.*`` series or journaled reservation events (a run
+    with no memory budget has no section).  Shows the budget and its
+    reservation high-water (reconstructed from the journal's
+    ``mem_reserved``/``mem_released`` totals — a gauge only keeps its
+    last value), the per-tenant/standing reservation table, the OOM
+    rulings with their containment-ladder rung and the before/after
+    peak estimate, and the estimate-correction count — the
+    self-correcting model's learning events."""
+    m = (metrics or {}).get("metrics", metrics or {})
+    counters = m.get("counters", {}) if isinstance(m, dict) else {}
+    gauges = m.get("gauges", {}) if isinstance(m, dict) else {}
+    mem_counters = {k: v for k, v in counters.items()
+                    if k.startswith("mem.")}
+    res_events = [e for e in events
+                  if e["event"] in ("mem_reserved", "mem_released")]
+    ooms = [e for e in events if e["event"] == "degrade"
+            and e.get("reason") == "oom"]
+    if not mem_counters and not res_events and not ooms \
+            and "mem.budget_bytes" not in gauges:
+        return []
+    L = ["-- memory --"]
+
+    budget = gauges.get("mem.budget_bytes")
+    high_water = max((e.get("reserved_total", 0) or 0
+                      for e in res_events), default=None)
+    parts = []
+    if budget is not None:
+        parts.append(f"budget {budget:g} bytes")
+    if high_water is not None:
+        parts.append(f"reservation high-water {high_water:g} bytes"
+                     + (f" ({high_water / budget:.0%})"
+                        if budget else ""))
+    if parts:
+        L.append("  " + "  ·  ".join(parts))
+
+    # reservation table: per-ticket holds by tenant; NAMED residents
+    # (the serving model's standing hold, the trainer's run-scoped
+    # feed window) by name — a reservation without a ticket is a
+    # resident, whichever class it is
+    by_tenant: dict = {}
+    residents: dict = {}
+    for e in res_events:
+        if e["event"] != "mem_reserved":
+            continue
+        if "ticket" not in e:
+            key = e.get("service") or e.get("name") or "?"
+            residents[key] = (e.get("bytes", 0),
+                              bool(e.get("standing")))
+        else:
+            t = by_tenant.setdefault(e.get("tenant", "?"),
+                                     {"n": 0, "bytes": 0.0})
+            t["n"] += 1
+            t["bytes"] += e.get("bytes", 0) or 0
+    if by_tenant:
+        L.append(f"  {'tenant':<20s} {'reservations':>12s} "
+                 f"{'total bytes':>12s}")
+        for tenant in sorted(by_tenant):
+            t = by_tenant[tenant]
+            L.append(f"  {tenant:<20s} {t['n']:12d} "
+                     f"{t['bytes']:12g}")
+    if residents:
+        L.append("  named residents:")
+        for name in sorted(residents):
+            nbytes, is_standing = residents[name]
+            L.append(f"    {name:<34s} {nbytes:12g} bytes"
+                     + ("  (standing)" if is_standing else ""))
+
+    if ooms:
+        L.append("  OOM rulings (containment ladder):")
+        for e in ooms:
+            L.append(f"    step {e.get('step')}: rung="
+                     f"{e.get('rung', '?')} estimate "
+                     f"{e.get('from_bytes', '?')} -> "
+                     f"{e.get('to_bytes', '?')} bytes "
+                     f"(stored corrected to "
+                     f"{e.get('corrected_bytes', '?')})")
+    rungs = {k: v for k, v in mem_counters.items()
+             if _parse_labels(k)[0] == "mem.oom_events"}
+    if rungs:
+        parts = []
+        for k in sorted(rungs):
+            _, labels = _parse_labels(k)
+            parts.append(f"{labels.get('rung', '?')}={rungs[k]:g}")
+        L.append("  oom events by rung: " + ", ".join(parts))
+    corr = mem_counters.get("mem.estimate_corrections")
+    if corr:
+        L.append(f"  estimate corrections (inflate-on-OOM): {corr:g}")
     return L
 
 
