@@ -45,6 +45,8 @@ from .runner import ResilientRunner, RetryPolicy
 from .scheduler import RunRejected, RunScheduler, RunShed, TenantQuota
 from . import serving  # noqa: F401  (registers serve.* transforms)
 from .serving import AnnotationService, build_reference_artifact
+from . import factory  # noqa: F401  (registers data.append_store)
+from .factory import AnnotationFactory
 from .federation import (FederatedBreakerRegistry, FederatedRunError,
                          FederationSupervisor, TicketHandle)
 from .compat import experimental, external, pp, tl  # scanpy-style namespaces

@@ -156,6 +156,94 @@ class StoreWriter:
         self._n_cells = 0
         self._max_nnz = 0
         self._closed = False
+        # append_to() seeds these from the manifest being extended
+        self._base_capacity = 0
+        self._appends: list[dict] = []
+        self._append_label: str | None = None
+        self._append_row_start = 0
+        self._append_chunk_start = 0
+
+    @classmethod
+    def append_to(cls, store, *, label: str | None = None,
+                  n_genes: int | None = None,
+                  shard_rows: int | None = None,
+                  chunk_rows: int | None = None,
+                  verify_tail: bool = True) -> "StoreWriter":
+        """Reopen an existing store for appending NEW chunks.
+
+        The writer seeds its chunk ledger / row counters / nnz maximum
+        from the store's manifest and continues chunk numbering where
+        the store left off, so slot fingerprints stay a pure function
+        of (index, geometry).  The commit point is the atomic manifest
+        replace in :meth:`close` — a crash mid-append leaves orphan
+        chunk files beyond the committed manifest that a deterministic
+        redo overwrites byte-identically, which is what makes the
+        factory's ingest an at-most-once commit.
+
+        Refusals (all BEFORE any byte is written):
+
+        * the recorded ``store_digest`` must recompute from the
+          recorded chunk digests (a tampered/hand-edited manifest is
+          not a base to extend);
+        * any explicitly passed geometry (``n_genes`` / ``shard_rows``
+          / ``chunk_rows``) must match the manifest — the caller's
+          idea of the store and the store itself must agree;
+        * the committed store must end on a chunk boundary
+          (``n_cells % chunk_rows == 0``): a partial tail chunk would
+          shift every appended row's shard arithmetic;
+        * with ``verify_tail`` (default), the final committed chunk
+          file must pass full integrity verification — the chunk most
+          at risk of a torn previous append.
+
+        ``label=`` records an entry in the manifest's append ledger on
+        close (``{"label", "row_start", "rows", "chunk_start",
+        "n_chunks"}``); :meth:`ShardStore.append_labels` answers
+        "was this batch already committed?" for at-most-once ingest.
+        """
+        if isinstance(store, str):
+            store = ShardStore.open(store)
+        m = store.manifest
+        mpath = os.path.join(store.directory, _MANIFEST)
+        recomputed = hashlib.sha256("".join(
+            c["digest"] for c in m["chunks"]).encode()).hexdigest()[:16]
+        if recomputed != m.get("store_digest"):
+            raise ShardCorruptError(
+                mpath, "store_digest does not recompute from the "
+                       "recorded chunk digests — refusing to extend a "
+                       "tampered manifest", chunk=-1)
+        for name, got in (("n_genes", n_genes),
+                          ("shard_rows", shard_rows),
+                          ("chunk_rows", chunk_rows)):
+            if got is not None and int(got) != int(m[name]):
+                raise ValueError(
+                    f"append_to: {name}={got} does not match the "
+                    f"store's {name}={m[name]} — geometry is frozen "
+                    f"at creation")
+        if store.n_cells % store.chunk_rows:
+            raise ValueError(
+                f"append_to: store ends mid-chunk ({store.n_cells} "
+                f"cells, chunk_rows={store.chunk_rows}) — appending "
+                f"would shift shard arithmetic for every new row")
+        if verify_tail and m["chunks"]:
+            tail = len(m["chunks"]) - 1
+            from .io import read_csr_chunk
+            read_csr_chunk(
+                store.chunk_path(tail),
+                expect_fingerprint=_chunk_fingerprint(
+                    tail, store.n_genes, store.chunk_rows),
+                expect_digest=m["chunks"][tail]["digest"])
+        w = cls(store.directory, store.n_genes,
+                shard_rows=store.shard_rows,
+                chunk_rows=store.chunk_rows)
+        w._chunks = [dict(c) for c in m["chunks"]]
+        w._n_cells = store.n_cells
+        w._max_nnz = int(m.get("max_nnz_row", 0))
+        w._base_capacity = store.capacity
+        w._appends = [dict(a) for a in m.get("appends", [])]
+        w._append_label = label
+        w._append_row_start = store.n_cells
+        w._append_chunk_start = len(m["chunks"])
+        return w
 
     def append(self, csr_block) -> None:
         import scipy.sparse as sp
@@ -221,9 +309,20 @@ class StoreWriter:
         if self._pending_rows:
             self._drain(final=True)
         self._closed = True
+        # monotonically non-decreasing across appends: readers compiled
+        # against the old capacity must stay valid for old shards
         capacity = max(round_up(max(self._max_nnz, 1),
                                 config.capacity_multiple),
-                       config.capacity_multiple)
+                       config.capacity_multiple,
+                       self._base_capacity)
+        if self._append_label is not None:
+            self._appends.append({
+                "label": self._append_label,
+                "row_start": self._append_row_start,
+                "rows": self._n_cells - self._append_row_start,
+                "chunk_start": self._append_chunk_start,
+                "n_chunks": len(self._chunks) - self._append_chunk_start,
+            })
         manifest = {
             "schema": SHARDSTORE_SCHEMA,
             "n_cells": self._n_cells, "n_genes": self.n_genes,
@@ -232,6 +331,7 @@ class StoreWriter:
             "capacity": capacity, "max_nnz_row": self._max_nnz,
             "dtype": "float32",
             "chunks": self._chunks,
+            "appends": self._appends,
             "store_digest": hashlib.sha256("".join(
                 c["digest"] for c in self._chunks).encode())
             .hexdigest()[:16],
@@ -316,6 +416,14 @@ class ShardStore:
     @property
     def n_shards(self) -> int:
         return -(-self.n_cells // self.shard_rows)
+
+    def append_labels(self) -> list[str]:
+        """Labels of every committed append batch (the manifest's
+        append ledger, written by :meth:`StoreWriter.append_to` with
+        ``label=``) — the at-most-once guard for factory ingest: a
+        batch whose label is here is already durably committed."""
+        return [a["label"] for a in self.manifest.get("appends", [])
+                if a.get("label") is not None]
 
     def chunk_name(self, c: int) -> str:
         """Basename (sans extension) chaos fault patterns match."""

@@ -1,0 +1,501 @@
+"""The annotation factory — a continuously-learning serving loop.
+
+Every fault domain in the repo exists in isolation: durable shard
+ingest (``data/shardstore.py``), pod-scale federation
+(``federation.py``), preemptible streamed training
+(``models/train_stream.py`` + ``scheduler.py``), the survivable
+serving epoch (``serving.py``), the memory budget (``memory.py``).
+:class:`AnnotationFactory` composes them into ONE closed loop —
+annbatch's out-of-core training story fused with the raw-count
+annotation survey's production-annotation story (PAPERS.md): a
+service that keeps learning from fresh uploads without ever dropping
+a query.
+
+One **cycle** climbs four stages, each with its own durable cursor:
+
+=========  =============================  ==========================
+stage      durable commit point           resume evidence
+=========  =============================  ==========================
+ingest     shard-store manifest replace   append ledger label
+           (``StoreWriter.append_to``)    (at-most-once per batch)
+train      training-cursor checkpoint     cursor ``(epoch, pos)`` +
+           every shard boundary; params   store digest; params
+           artifact before cursor clear   content digest
+build      ``save_npz_generations``       artifact content digest
+           atomic rename
+swap       serving-epoch flip inside      service epoch + artifact
+           ``AnnotationService.swap``     version string
+=========  =============================  ==========================
+
+A factory killed ANYWHERE re-enters ``run_cycle`` on the same
+directory and resumes at the first uncommitted stage: committed work
+is never replayed (ingest batches dedup on the manifest's append
+ledger, training resumes bitwise from its cursor, a committed build
+is recognised by its recorded digest, a swap that landed before the
+crash is recognised by the service's resident version).  Stage state
+lives in ``cycles/c<N>/state.json``, written atomically
+(tmp + ``os.replace``) and **epoch-fenced**: constructing a factory
+on a directory bumps ``owner.json``'s epoch, and every state commit
+from a stale incarnation raises :class:`FactoryFencedError` instead
+of overwriting the new owner's progress — the same fencing ruling
+the federation supervisor applies to requeued tickets.
+
+Stage ROUTING is where the composition happens:
+
+* **ingest** runs as federation tickets (``data.append_store`` below)
+  when a supervisor is attached — a SIGKILLed ingest worker is
+  respawned, the ticket requeued, and the redo finds the batch label
+  already in the manifest ledger (or redoes a torn append
+  byte-identically: orphan chunk files beyond the committed manifest
+  are overwritten deterministically);
+* **retrain** is submitted through the SHARED
+  :class:`~.scheduler.RunScheduler` with ``preemptible=True`` — a
+  serving spike preempts training at a shard boundary
+  (checkpoint-then-yield), and the memory budget's admission ruling
+  applies to the trainer like any other job;
+* **build** freezes the trained parameters (loaded from the
+  digest-verified ``params_out`` artifact — the pytree never crosses
+  a worker boundary in memory) into a serving artifact via
+  :func:`~.serving.build_reference_artifact`;
+* **swap** canary-validates the candidate into the live service;
+  rollback (corrupt artifact, placement failure, canary
+  disagreement) terminals the cycle as ``swap_rolled_back`` with the
+  journaled reason — the old epoch keeps serving.
+
+Journal contract (``telemetry.JOURNAL_PROTOCOLS['factory']``): every
+record carries ``cycle=`` and NEVER ``ticket=`` — the factory is a
+stage ladder, not an admission funnel, and must not merge with the
+scheduler's terminal-exactly-once proof.  Events are journaled
+AFTER their stage's durable commit and deduped across resumes via
+the state file's ``journaled`` list (at-least-once across a crash in
+the tiny commit→journal window, exactly-once otherwise).
+
+Chaos: the factory consults :meth:`~.utils.chaos.ChaosMonkey
+.on_factory` once per stage ENTRY (``stage_crash`` faults match
+``"<factory>/<stage>"`` composites) and raises
+:class:`~.utils.chaos.ChaosCrash` — the deterministic in-process
+stand-in for a worker SIGKILLed BETWEEN stages, driving exactly the
+cross-domain resume seams the table above promises.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from .data.io import from_dense
+from .data.shardstore import ShardStore, StoreWriter
+from .recipes import run_recipe
+from .registry import Pipeline, register
+from .runner import _Journal
+from .utils.chaos import ChaosCrash
+
+
+class FactoryFencedError(RuntimeError):
+    """A stale factory incarnation tried to commit stage state after
+    a newer incarnation claimed the directory (``owner.json`` epoch
+    advanced).  The stale owner must stop — its view of the cycle is
+    no longer authoritative; the new owner resumes from the durable
+    cursors."""
+
+
+@register("data.append_store", backend="tpu")
+@register("data.append_store", backend="cpu")
+def append_store(data, store_dir: str = "", label: str = "",
+                 expect_genes: int = 0):
+    """Append ``data``'s counts to the durable shard store at
+    ``store_dir`` as ONE verified batch (``StoreWriter.append_to`` —
+    digest-verified reopen, atomic manifest commit), recording
+    ``label`` in the manifest's append ledger.  **At-most-once by
+    redo**: when the label is already in the ledger the append is
+    skipped — how a federation ticket requeued after a worker
+    SIGKILL (or a factory resuming a torn cycle) redoes the stage
+    without double-ingesting.  Results land in uns:
+    ``append_store_rows`` (0 when skipped), ``append_store_skipped``
+    and ``append_store_digest`` (the store digest AFTER the commit —
+    the training stage pins its cursor to it).  One registration
+    serves both backends: the work is host-side file IO."""
+    import scipy.sparse as sp
+
+    store = ShardStore.open(store_dir)
+    if label and label in store.append_labels():
+        return data.with_uns(
+            append_store_rows=np.int64(0),
+            append_store_skipped=np.bool_(True),
+            append_store_digest=str(store.manifest["store_digest"]))
+    w = StoreWriter.append_to(store, label=label or None,
+                              n_genes=(int(expect_genes) or None))
+    X = data.X
+    block = (X.tocsr() if sp.issparse(X)
+             else sp.csr_matrix(np.asarray(X)))
+    w.append(block)
+    out = w.close()
+    return data.with_uns(
+        append_store_rows=np.int64(block.shape[0]),
+        append_store_skipped=np.bool_(False),
+        append_store_digest=str(out.manifest["store_digest"]))
+
+
+class AnnotationFactory:
+    """Federation-supervised ingest → retrain → freeze → canary swap
+    (module docstring: stage/cursor table, routing, fencing).
+
+    Parameters
+    ----------
+    factory_dir : str
+        Durable home of the loop: ``owner.json`` (incarnation fence),
+        ``cycles/c<N>/`` (per-cycle state, training cursor, params
+        artifact, candidate serving artifact).
+    store_dir : str
+        The LIVE shard store ingest appends to and training streams
+        from.
+    service : AnnotationService
+        The live service the loop feeds.  The factory adopts its
+        journal, clock, metrics, chaos and shared scheduler (so
+        retraining contends with — and is preempted by — real query
+        traffic) unless overridden.
+    ref_source : callable
+        ``ref_source(store) -> CellData`` — the labelled reference
+        snapshot to freeze after retraining (raw counts in ``X``,
+        labels in ``obs[labels_key]``).  The factory runs the
+        ``annotation_reference`` recipe on it before the freeze.
+    supervisor : FederationSupervisor | None
+        When attached, every ingest batch runs as a federated ticket
+        (worker kill/wedge containment included); ``None`` appends
+        in-process through the same op function.
+    train_kw : dict | None
+        Hyperparameters forwarded to ``model.scvi_stream``
+        (``n_latent``, ``epochs``, ``batch_size``, ``seed``, ...).
+    train_priority / ingest_tenant / train_tenant
+        Funnel placement.  Training should sit BELOW query priority
+        so serving spikes preempt it at shard boundaries.
+    result_timeout_s : float
+        Real-time ceiling on any one ticket/run wait (the underlying
+        waits are event-driven; chaos tests advance a VirtualClock,
+        so terminals arrive fast in real time).
+    """
+
+    STAGES = ("ingest", "train", "build", "swap")
+
+    def __init__(self, factory_dir: str, *, store_dir: str, service,
+                 ref_source, name: str = "factory",
+                 supervisor=None, scheduler=None,
+                 labels_key: str = "cell_type",
+                 score_sets: dict | None = None,
+                 n_components: int = 30, backend: str = "cpu",
+                 train_kw: dict | None = None,
+                 train_tenant: str = "factory-train",
+                 train_priority: int = 0,
+                 ingest_tenant: str = "factory-ingest",
+                 ingest_priority: int = 0,
+                 canary_seed: int = 0,
+                 result_timeout_s: float = 300.0,
+                 chaos=None, journal=None):
+        self.factory_dir = str(factory_dir)
+        self.store_dir = str(store_dir)
+        self.service = service
+        self.supervisor = supervisor
+        self.scheduler = (scheduler if scheduler is not None
+                          else service.scheduler)
+        self.ref_source = ref_source
+        self.name = name
+        self.labels_key = labels_key
+        self.score_sets = dict(score_sets or {})
+        self.n_components = int(n_components)
+        self.backend = backend
+        self.train_kw = dict(train_kw or {})
+        self.train_tenant = train_tenant
+        self.train_priority = int(train_priority)
+        self.ingest_tenant = ingest_tenant
+        self.ingest_priority = int(ingest_priority)
+        self.canary_seed = int(canary_seed)
+        self.result_timeout_s = float(result_timeout_s)
+        self.chaos = chaos if chaos is not None else service.chaos
+        self.clock = service.clock
+        self.metrics = service.metrics
+        if journal is None:
+            self.journal = service.journal
+        elif isinstance(journal, str):
+            self.journal = _Journal(journal)
+        else:
+            self.journal = journal
+        os.makedirs(os.path.join(self.factory_dir, "cycles"),
+                    exist_ok=True)
+        # incarnation fence: claim the directory by bumping the owner
+        # epoch; every later state commit re-checks it, so a stale
+        # incarnation can never clobber the new owner's progress
+        self._owner_epoch = self._read_owner() + 1
+        self._write_json(os.path.join(self.factory_dir, "owner.json"),
+                         {"epoch": self._owner_epoch})
+
+    # -- durable state -------------------------------------------------
+    @staticmethod
+    def _write_json(path: str, obj: dict) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(obj, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+
+    def _read_owner(self) -> int:
+        path = os.path.join(self.factory_dir, "owner.json")
+        try:
+            with open(path) as f:
+                return int(json.load(f).get("epoch", 0))
+        except (OSError, ValueError, json.JSONDecodeError):
+            return 0
+
+    def _check_fence(self) -> None:
+        cur = self._read_owner()
+        if cur != self._owner_epoch:
+            raise FactoryFencedError(
+                f"factory {self.name!r} incarnation {self._owner_epoch}"
+                f" fenced by incarnation {cur} on {self.factory_dir} —"
+                f" refusing a stale stage commit")
+
+    def cycle_dir(self, cycle: int) -> str:
+        return os.path.join(self.factory_dir, "cycles",
+                            f"c{int(cycle):04d}")
+
+    def _state_path(self, cycle: int) -> str:
+        return os.path.join(self.cycle_dir(cycle), "state.json")
+
+    def load_state(self, cycle: int) -> dict:
+        """The cycle's committed stage state (``{}``-shaped fresh
+        record when none/unreadable — redo is safe, every stage's
+        WORK is idempotent by cursor/ledger/digest)."""
+        try:
+            with open(self._state_path(cycle)) as f:
+                return json.load(f)
+        except (OSError, ValueError, json.JSONDecodeError):
+            return {"journaled": [], "batches": {}}
+
+    def _commit_state(self, cycle: int, st: dict) -> None:
+        self._check_fence()
+        self._write_json(self._state_path(cycle), st)
+
+    def next_cycle(self) -> int:
+        """The cycle ``run_cycle`` should run next: the latest
+        non-terminal cycle (resume target) or one past the latest
+        terminal one."""
+        base = os.path.join(self.factory_dir, "cycles")
+        ids = sorted(int(d[1:]) for d in os.listdir(base)
+                     if d.startswith("c") and d[1:].isdigit())
+        if not ids:
+            return 0
+        last = ids[-1]
+        return last if not self.load_state(last).get("terminal") \
+            else last + 1
+
+    # -- journaling ----------------------------------------------------
+    # each factory event is journaled exactly once across resumes:
+    # _needs_journal gates the literal journal.write call site (SCT009/
+    # SCT012 check literals, so the write stays inline at each stage),
+    # _mark_journaled commits the dedup record right after the write —
+    # a crash inside that tiny window re-journals (at-least-once
+    # there, never lost)
+    def _needs_journal(self, st: dict, key: str) -> bool:
+        return key not in st.setdefault("journaled", [])
+
+    def _mark_journaled(self, cycle: int, st: dict, key: str) -> None:
+        st["journaled"].append(key)
+        self._commit_state(cycle, st)
+
+    def _enter_stage(self, cycle: int, stage: str) -> None:
+        """Chaos seam at every stage boundary: a firing
+        ``stage_crash`` fault dies HERE — after the previous stage's
+        durable commit, before this stage's first byte of work."""
+        if self.chaos is not None:
+            r = self.chaos.on_factory(self.name, stage,
+                                      backend=self.backend)
+            if r is not None and r.get("mode") == "stage_crash":
+                raise ChaosCrash(
+                    f"factory {self.name!r} killed entering stage "
+                    f"{stage!r} of cycle {cycle}")
+
+    # -- stages --------------------------------------------------------
+    def _append_batch(self, label: str, batch) -> dict:
+        params = dict(store_dir=self.store_dir, label=label,
+                      expect_genes=int(
+                          ShardStore.open(self.store_dir).n_genes))
+        if self.supervisor is not None:
+            pipe = Pipeline([("data.append_store", params)])
+            h = self.supervisor.submit(pipe, batch,
+                                       tenant=self.ingest_tenant,
+                                       priority=self.ingest_priority,
+                                       backend=self.backend)
+            out = h.result(timeout=self.result_timeout_s)
+        else:
+            out = append_store(batch, **params)
+        return {"label": label,
+                "rows": int(out.uns["append_store_rows"]),
+                "skipped": bool(out.uns["append_store_skipped"]),
+                "store_digest": str(out.uns["append_store_digest"])}
+
+    def _stage_ingest(self, cycle: int, st: dict, batches) -> None:
+        if "ingest" not in st:
+            self._enter_stage(cycle, "ingest")
+            done = st.setdefault("batches", {})
+            # batches run SEQUENTIALLY: the manifest replace is the
+            # commit point and append_to has no cross-writer lock —
+            # concurrency here would be a lost-update race, not a
+            # speedup (the appends are small against training wall)
+            for label, batch in batches:
+                if label not in done:
+                    done[label] = self._append_batch(label, batch)
+                    self._commit_state(cycle, st)
+            store = ShardStore.open(self.store_dir)
+            st["ingest"] = {
+                "labels": [label for label, _ in batches],
+                "store_digest": str(store.manifest["store_digest"]),
+                "n_cells": store.n_cells,
+            }
+            self._commit_state(cycle, st)
+        for label in st["ingest"]["labels"]:
+            info = st["batches"][label]
+            if self._needs_journal(st, f"ingest:{label}"):
+                self.journal.write(
+                    "ingest_committed", cycle=int(cycle),
+                    factory=self.name, label=label,
+                    rows=info["rows"], skipped=info["skipped"],
+                    store_digest=info["store_digest"])
+                self._mark_journaled(cycle, st, f"ingest:{label}")
+
+    def _stage_train(self, cycle: int, st: dict) -> None:
+        cdir = self.cycle_dir(cycle)
+        cursor = os.path.join(cdir, "cursor.npz")
+        params_out = os.path.join(cdir, "params.npz")
+        if "train" not in st:
+            self._enter_stage(cycle, "train")
+            if self._needs_journal(st, "retrain"):
+                self.journal.write(
+                    "retrain_triggered", cycle=int(cycle),
+                    factory=self.name, tenant=self.train_tenant,
+                    store_digest=st["ingest"]["store_digest"])
+                self._mark_journaled(cycle, st, "retrain")
+            kw = dict(self.train_kw)
+            kw.setdefault("checkpoint_every", 1)
+            pipe = Pipeline([("model.scvi_stream", dict(
+                store_dir=self.store_dir, checkpoint=cursor,
+                params_out=params_out, journal=self.journal.path,
+                **kw))])
+            h = self.scheduler.submit(
+                pipe, _carrier(), tenant=self.train_tenant,
+                priority=self.train_priority, backend=self.backend,
+                preemptible=True)
+            out = h.result(timeout=self.result_timeout_s)
+            st["train"] = {
+                "params": params_out,
+                "params_digest": str(
+                    out.uns["scvi_stream_params_digest"]),
+                "epochs": int(out.uns["scvi_stream_epochs"]),
+                "store_digest": st["ingest"]["store_digest"],
+            }
+            self._commit_state(cycle, st)
+
+    def _stage_build(self, cycle: int, st: dict) -> None:
+        cdir = self.cycle_dir(cycle)
+        artifact = os.path.join(cdir, "artifact.npz")
+        version = f"{self.name}-c{int(cycle):04d}"
+        if "build" not in st:
+            self._enter_stage(cycle, "build")
+            from .models.scvi import load_model
+
+            params, _meta = load_model(st["train"]["params"])
+            ref = self.ref_source(ShardStore.open(self.store_dir))
+            ref = run_recipe("annotation_reference", ref,
+                             backend=self.backend,
+                             n_components=self.n_components)
+            digest = build_reference_artifact_checked(
+                ref, artifact, labels_key=self.labels_key,
+                score_sets=self.score_sets, seed=self.canary_seed,
+                version=version, scvi_model=params)
+            st["build"] = {"artifact": artifact, "digest": digest,
+                           "version": version}
+            self._commit_state(cycle, st)
+        if self._needs_journal(st, "build"):
+            self.journal.write(
+                "artifact_built", cycle=int(cycle),
+                factory=self.name, digest=st["build"]["digest"],
+                version=st["build"]["version"])
+            self._mark_journaled(cycle, st, "build")
+
+    def _stage_swap(self, cycle: int, st: dict) -> None:
+        if "swap" not in st:
+            self._enter_stage(cycle, "swap")
+            version = st["build"]["version"]
+            if self.service.model_version == version:
+                # the flip landed before a crash took the factory
+                # down — recognise it by the resident version rather
+                # than re-swapping (a second flip would burn a
+                # serving epoch for nothing)
+                st["swap"] = {"ok": True,
+                              "epoch": int(self.service.epoch),
+                              "version": version,
+                              "agreement": None, "resumed": True}
+            else:
+                ok = self.service.swap(st["build"]["artifact"])
+                info = dict(self.service.last_swap or {})
+                info["ok"] = bool(ok)
+                info.setdefault("version", version)
+                st["swap"] = info
+            self._commit_state(cycle, st)
+        sw = st["swap"]
+        if sw.get("ok"):
+            if self._needs_journal(st, "swap"):
+                self.journal.write(
+                    "swap_promoted", cycle=int(cycle),
+                    factory=self.name, epoch=sw.get("epoch"),
+                    version=sw.get("version"),
+                    agreement=sw.get("agreement"))
+                self._mark_journaled(cycle, st, "swap")
+            st["terminal"] = "promoted"
+        else:
+            if self._needs_journal(st, "swap"):
+                self.journal.write(
+                    "swap_rolled_back", cycle=int(cycle),
+                    factory=self.name,
+                    reason=sw.get("reason", "unknown"),
+                    epoch=sw.get("epoch"),
+                    agreement=sw.get("agreement"))
+                self._mark_journaled(cycle, st, "swap")
+            st["terminal"] = "rolled_back"
+        self._commit_state(cycle, st)
+
+    # -- the loop ------------------------------------------------------
+    def run_cycle(self, batches, *, cycle: int | None = None) -> dict:
+        """Run (or RESUME) one full cycle over ``batches`` —
+        ``[(label, CellData), ...]`` of fresh raw-count uploads — and
+        return the terminal stage state.  Idempotent per cycle: a
+        terminal cycle returns its record untouched; a torn cycle
+        resumes at the first uncommitted stage (committed stages are
+        skipped, proven by their cursors — no re-ingest, no replayed
+        training shards, no rebuilt artifact, no double swap)."""
+        if cycle is None:
+            cycle = self.next_cycle()
+        cycle = int(cycle)
+        os.makedirs(self.cycle_dir(cycle), exist_ok=True)
+        st = self.load_state(cycle)
+        if st.get("terminal"):
+            return st
+        self._stage_ingest(cycle, st, list(batches))
+        self._stage_train(cycle, st)
+        self._stage_build(cycle, st)
+        self._stage_swap(cycle, st)
+        return st
+
+
+def _carrier():
+    """Minimal CellData vehicle for store-streaming pipeline steps
+    (the counts live on disk; the funnel still wants a dataset)."""
+    return from_dense(np.zeros((2, 2), np.float32))
+
+
+def build_reference_artifact_checked(ref, path, **kw):
+    """Thin indirection over :func:`~.serving.build_reference_artifact`
+    (imported lazily so ``factory`` never pulls the serving module's
+    jax surface at import time in journal-only tools)."""
+    from .serving import build_reference_artifact
+
+    return build_reference_artifact(ref, path, **kw)

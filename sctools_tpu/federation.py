@@ -708,6 +708,15 @@ class FederationSupervisor:
         #: VirtualClock past the lease timeout (no polling sleeps)
         self.wedge_observed = threading.Event()
 
+    @property
+    def journal_path(self) -> str:
+        """Path of the supervisor's merged journal
+        (``fed_dir/journal.jsonl``) — the one file
+        ``check_journal_coherent`` and ``sctreport`` read; dead
+        workers' journal tails are grafted in as ``journal_tail``
+        fields on their ``worker_lost`` records."""
+        return self.journal.path
+
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "FederationSupervisor":
         with self._lock:

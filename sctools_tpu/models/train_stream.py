@@ -189,7 +189,8 @@ def fit_scvi_stream(store, *, n_latent: int = 10, n_hidden: int = 128,
                     prefetch: bool = True, prefetch_depth: int = 2,
                     encode: bool = False, preempt=None,
                     clock=None, metrics=None, journal=None,
-                    mem_budget=None) -> dict:
+                    mem_budget=None,
+                    params_out: str | None = None) -> dict:
     """Train the NB-VAE (``models/scvi.py`` generative model, no
     batch covariate) out-of-core over a :class:`ShardStore` — the
     module docstring has the crash/preemption contract.
@@ -241,8 +242,19 @@ def fit_scvi_stream(store, *, n_latent: int = 10, n_hidden: int = 128,
         scheduler-admitted training job contends honestly with
         serving traffic without any parameter plumbing.
 
+    params_out : str | None
+        Persist the trained parameters as a digest-verified,
+        generation-rotated ``scvi.save_model`` artifact at this path
+        once training completes — BEFORE the cursor checkpoint is
+        cleared, so a kill between the two resumes from a
+        training-complete cursor and rewrites the identical artifact
+        (the factory's build stage trusts this file, never an
+        in-memory pytree that dies with the worker).  The content
+        digest lands in the result as ``params_digest``.
+
     Returns ``{"params", "history", "epochs_run", "resumed_from",
-    "latent"}`` (``latent`` only with ``encode=True``).
+    "latent"}`` (``latent`` only with ``encode=True``;
+    ``params_digest`` only with ``params_out=``).
     """
     if scheduler is not None:
         want = os.path.realpath(store if isinstance(store, str)
@@ -525,6 +537,16 @@ def fit_scvi_stream(store, *, n_latent: int = 10, n_hidden: int = 128,
             if journal is not None:
                 journal.write("mem_released", name=feed_name,
                               bytes=feed_bytes, reserved_total=total)
+    if params_out is not None:
+        # persist BEFORE clearing the cursor: a kill between the two
+        # resumes from a training-complete cursor and deterministically
+        # rewrites the identical artifact
+        from .scvi import save_model
+
+        out["params_digest"] = save_model(
+            params, params_out,
+            meta={"epochs": cur.epoch, "seed": seed,
+                  "n_latent": n_latent, "n_hidden": n_hidden})
     if checkpoint is not None:
         clear_npz_generations(checkpoint)  # done; cursor is stale
     return out
@@ -537,7 +559,8 @@ def scvi_stream(data, store_dir: str = "", n_latent: int = 10,
                 batch_size: int = 512, seed: int = 0,
                 kl_warmup: int = 10, checkpoint: str | None = None,
                 checkpoint_every: int = 1, order_block: int = 4,
-                encode: bool = False, journal: str | None = None):
+                encode: bool = False, journal: str | None = None,
+                params_out: str | None = None):
     """Train scVI OUT-OF-CORE on the durable shard store at
     ``store_dir`` (see :func:`fit_scvi_stream` — permuted-block shard
     order, prefetched device feed, mid-epoch checkpointed resume,
@@ -546,10 +569,15 @@ def scvi_stream(data, store_dir: str = "", n_latent: int = 10,
     uns — ``scvi_stream_elbo_history`` (negative ELBO per epoch),
     ``scvi_stream_epochs`` and, with ``encode=True``,
     ``scvi_stream_latent`` ((store n_cells, n_latent) posterior
-    means).  ``checkpoint=``/``journal=`` accept paths containing the
-    ``{ticket_dir}`` placeholder under federation (the worker
-    substitutes the per-ticket directory, so a REQUEUED training
-    ticket resumes from the previous owner's cursor).  One
+    means).  ``checkpoint=``/``journal=``/``params_out=`` accept
+    paths containing the ``{ticket_dir}`` placeholder under
+    federation (the worker substitutes the per-ticket directory, so a
+    REQUEUED training ticket resumes from the previous owner's
+    cursor).  ``params_out=`` persists the trained parameters as a
+    digest-verified ``scvi.save_model`` artifact — the durable
+    hand-off the annotation factory's build stage loads (the pytree
+    itself never crosses the worker boundary); its digest lands in
+    uns as ``scvi_stream_params_digest``.  One
     registration serves both backends: the program is identical, only
     the device differs.  Submitted through ``RunScheduler`` with
     ``preemptible=True`` this is the long-running job the cooperative
@@ -559,9 +587,11 @@ def scvi_stream(data, store_dir: str = "", n_latent: int = 10,
         n_hidden=n_hidden, epochs=epochs, batch_size=batch_size,
         seed=seed, kl_warmup=kl_warmup, checkpoint=checkpoint,
         checkpoint_every=checkpoint_every, order_block=order_block,
-        encode=encode, journal=journal)
+        encode=encode, journal=journal, params_out=params_out)
     uns = {"scvi_stream_elbo_history": res["history"],
            "scvi_stream_epochs": np.int64(res["epochs_run"])}
     if res["latent"] is not None:
         uns["scvi_stream_latent"] = res["latent"]
+    if "params_digest" in res:
+        uns["scvi_stream_params_digest"] = res["params_digest"]
     return data.with_uns(**uns)

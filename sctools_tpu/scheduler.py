@@ -411,6 +411,14 @@ class RunScheduler:
         self.shutdown(wait=True, shed_queued=exc[0] is not None)
         return False
 
+    @property
+    def journal_path(self) -> str | None:
+        """Path of the pool's journal file (``None`` when journaling
+        is disabled) — the file the factory's training step and the
+        report tooling read/extend, mirroring
+        ``FederationSupervisor.journal_path``."""
+        return self.journal.path
+
     # -- admission ------------------------------------------------------
     def submit(self, pipeline: Pipeline, data, *, tenant: str = "default",
                priority: int = 0, deadline_s: float | None = None,

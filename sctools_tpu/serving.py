@@ -835,6 +835,14 @@ class AnnotationService:
         self._outstanding: list[ServeTicket] = []
         self._swap_lock = threading.Lock()
         self._swap_claimed = False
+        #: outcome record of the most recent :meth:`swap` — on
+        #: success ``{"ok": True, "epoch", "version", "generation",
+        #: "agreement"}``, on rollback ``{"ok": False, "reason",
+        #: "epoch", ...}`` with the same fields the journal carries.
+        #: The annotation factory reads this to journal its own
+        #: cycle verdict without re-parsing the journal; swap is
+        #: exclusive (try_acquire_swap), so no torn reads.
+        self.last_swap: dict | None = None
         self._closed = False
 
         try:
@@ -976,6 +984,15 @@ class AnnotationService:
                 return  # timed out on these; a later drain can finish
 
     # -- introspection -------------------------------------------------
+    @property
+    def scheduler(self):
+        """The admission funnel this service runs queries through —
+        shared when one was passed at construction, else the
+        service-owned pool.  The annotation factory submits
+        retraining through exactly this object so training contends
+        with (and is preempted by) live query traffic."""
+        return self._sched
+
     @property
     def epoch(self) -> int:
         with self._state_lock:
@@ -1236,6 +1253,10 @@ class AnnotationService:
                 cand = _ResidentModel(arrays, path=artifact,
                                       epoch=-1, generation=gen)
             except (CheckpointCorruptError, ValueError) as e:
+                self.last_swap = {"ok": False,
+                                  "reason": "artifact_corrupt",
+                                  "error": str(e),
+                                  "epoch": self.epoch}
                 self.journal.write(
                     "swap_rolled_back", reason="artifact_corrupt",
                     error=str(e), epoch=self.epoch)
@@ -1254,6 +1275,10 @@ class AnnotationService:
                 # its own ladder handles the device
                 if classify_error(e) == TRANSIENT:
                     self._breaker.record_failure()
+                self.last_swap = {"ok": False,
+                                  "reason": "placement_failed",
+                                  "error": f"{type(e).__name__}: {e}",
+                                  "epoch": self.epoch}
                 self.journal.write(
                     "swap_rolled_back", reason="placement_failed",
                     error=f"{type(e).__name__}: {e}",
@@ -1274,6 +1299,10 @@ class AnnotationService:
                 # rollback, old epoch keeps serving
                 if classify_error(e) == TRANSIENT:
                     self._breaker.record_failure()
+                self.last_swap = {"ok": False,
+                                  "reason": "canary_failed",
+                                  "error": f"{type(e).__name__}: {e}",
+                                  "epoch": self.epoch}
                 self.journal.write(
                     "swap_rolled_back", reason="canary_failed",
                     error=f"{type(e).__name__}: {e}",
@@ -1286,6 +1315,11 @@ class AnnotationService:
                     RuntimeWarning, stacklevel=2)
                 return False
             if agreement < self.canary_threshold:
+                self.last_swap = {"ok": False,
+                                  "reason": "canary_disagreement",
+                                  "agreement": round(agreement, 4),
+                                  "candidate_version": cand.version,
+                                  "epoch": self.epoch}
                 self.journal.write(
                     "swap_rolled_back", reason="canary_disagreement",
                     agreement=round(agreement, 4),
@@ -1310,6 +1344,10 @@ class AnnotationService:
                 for e in [e for e in self._models
                           if e < self._epoch - 1]:
                     del self._models[e]
+            self.last_swap = {"ok": True, "epoch": cand.epoch,
+                              "version": cand.version,
+                              "generation": gen,
+                              "agreement": round(agreement, 4)}
             self.journal.write("model_swapped", epoch=cand.epoch,
                                version=cand.version, generation=gen,
                                agreement=round(agreement, 4))

@@ -40,7 +40,7 @@ MODES = ("unavailable", "hang", "wedge", "corrupt",
          "slow_read", "truncate_shard", "io_error",
          "kill_worker", "lease_wedge", "preempt",
          "evict_state", "corrupt_model",
-         "oom", "mem_pressure")
+         "oom", "mem_pressure", "stage_crash")
 
 # which hook channel each mode fires on: most modes wrap the op CALL;
 # corrupt_checkpoint fires through the runner's on_checkpoint hook,
@@ -62,7 +62,13 @@ MODES = ("unavailable", "hang", "wedge", "corrupt",
 # driving the runner's whole containment ladder); ``mem_pressure``
 # fires through on_memory — consulted by the run scheduler once per
 # SUBMISSION against its MemoryBudget's name, shrinking the apparent
-# budget for the fault's window.
+# budget for the fault's window.  ``stage_crash`` fires through
+# on_factory — consulted by the annotation factory once per stage
+# ENTRY (pattern matches "<factory>/<stage>" composites like
+# "fac/build"; ``on_call=N`` = the Nth entry into that stage), the
+# deterministic in-process stand-in for a worker SIGKILLed BETWEEN
+# pipeline stages — the cross-domain resume seam the factory's
+# cursor/fingerprint ladder exists for.
 _MODE_CHANNEL = {"corrupt_checkpoint": "checkpoint",
                  "reject_storm": "admission",
                  "slow_read": "io", "truncate_shard": "io",
@@ -70,7 +76,8 @@ _MODE_CHANNEL = {"corrupt_checkpoint": "checkpoint",
                  "kill_worker": "worker", "lease_wedge": "worker",
                  "preempt": "worker",
                  "evict_state": "serving", "corrupt_model": "serving",
-                 "mem_pressure": "memory"}
+                 "mem_pressure": "memory",
+                 "stage_crash": "factory"}
 
 
 class ChaosCrash(BaseException):
@@ -412,6 +419,33 @@ class ChaosMonkey:
                         fh.write(blob)
             except OSError:
                 pass  # file already quarantined/moved: the ruling stands
+        return {"mode": f.mode}
+
+    def on_factory(self, name: str, stage: str,
+                   backend: str | None = None) -> dict | None:
+        """Annotation-factory hook, consulted once per stage ENTRY of
+        a factory cycle: returns ``None`` (healthy) or ``{"mode":
+        "stage_crash"}`` for a firing fault.  On this channel the
+        fault's ``op`` pattern matches the ``"<factory>/<stage>"``
+        composite (``"fac/build"``, ``"*/swap"``); call counting is
+        per composite under ``"<factory>/<stage>@factory"``, so
+        ``on_call``/``times`` windows count entries into ONE stage —
+        a crash-on-first-entry fault dies exactly between the
+        previous stage's durable commit and this stage's first byte
+        of work.  The hook only rules; the factory implements the
+        semantics (it raises :class:`ChaosCrash`, and a fresh factory
+        on the same directory proves the between-stage resume)."""
+        key = f"{name}/{stage}@factory"
+        with self._lock:
+            call_no = self.calls.get(key, 0) + 1
+            self.calls[key] = call_no
+            f = self._firing(f"{name}/{stage}", backend, call_no,
+                             channel="factory")
+            if f is None:
+                return None
+            self.injected.append({"op": f"{name}/{stage}",
+                                  "call": call_no, "mode": f.mode,
+                                  "backend": backend})
         return {"mode": f.mode}
 
     def on_io(self, name: str, path: str | None = None,

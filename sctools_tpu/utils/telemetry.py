@@ -121,6 +121,21 @@ EVENTS = frozenset({
     # OOM containment rulings reuse the existing `degrade` event with
     # reason="oom" + rung= + from/to estimates.
     "mem_reserved", "mem_released",
+    # annotation factory (sctools_tpu/factory.py): the closed-loop
+    # ingest -> retrain -> freeze -> swap cycle.  Every record carries
+    # cycle= (NEVER ticket= — the factory's lifecycle is a stage
+    # ladder, not an admission funnel, and must not merge with the
+    # scheduler's terminal-exactly-once proof).  ingest_committed =
+    # a verified batch durably appended to the live shard store
+    # (manifest replace = the at-most-once commit point);
+    # retrain_triggered = streamed retraining submitted through the
+    # shared scheduler funnel; artifact_built = the retrained model
+    # frozen into a digest-verified reference artifact;
+    # swap_promoted = the canary-validated artifact became the live
+    # serving epoch (the factory-side record of serving's
+    # model_swapped; rollback reuses swap_rolled_back with cycle=)
+    "ingest_committed", "retrain_triggered", "artifact_built",
+    "swap_promoted",
 })
 
 #: Every legal metric name → one-line meaning (the docs table).  Like
@@ -374,6 +389,18 @@ JOURNAL_PROTOCOLS = {
                    "model_swapped", "swap_rolled_back",
                    "mem_reserved", "mem_released"],
         "terminal": [],
+    },
+    # the annotation factory's closed loop: each cycle climbs ingest
+    # -> retrain -> build -> swap, every record keyed cycle= (never
+    # ticket=), and terminals exactly once per cycle: swap_promoted
+    # on a canary-validated promotion, swap_rolled_back (with the
+    # journaled reason) when the candidate was refused and the old
+    # epoch kept serving
+    "factory": {
+        "events": ["ingest_committed", "retrain_triggered",
+                   "artifact_built", "swap_promoted",
+                   "swap_rolled_back"],
+        "terminal": ["swap_promoted", "swap_rolled_back"],
     },
 }
 

@@ -1,0 +1,200 @@
+"""CI annotation-factory smoke (tools/run_checks.sh stage 14).
+
+Drives one full ``AnnotationFactory`` cycle — federation-supervised
+ingest → preemptible retrain → artifact build → canary swap — on one
+VirtualClock with zero real sleeps, while three chaos faults fire:
+
+1. **kill_worker** on a federation ingest worker: the batch requeues
+   onto the survivor and the store's append ledger still records
+   every batch EXACTLY once (at-most-once commit at the manifest
+   replace);
+2. **preempt** on the retrain tenant: the streamed trainer yields at
+   a shard boundary through the shared ``RunScheduler`` funnel and
+   resumes from its cursor — the scheduler journal shows
+   ``preempted`` then exactly one terminal;
+3. **corrupt_model** on the live service mid-traffic: the residency
+   ladder quarantines the damaged generation and serves from
+   ``.prev`` — the query that hit it still completes.
+
+Exit criteria: cycle terminal ``promoted``, served epoch advanced,
+zero dropped queries, both journals terminal-exactly-once
+(``soak_smoke.check_journal_coherent``), factory journal carries the
+four lifecycle events with ``cycle=`` (never ``ticket=``).
+
+Run directly: ``JAX_PLATFORMS=cpu python tests/factory_smoke.py``
+(exit 0 = all contracts hold).
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import warnings
+
+import numpy as np
+import scipy.sparse as sp
+
+# run as a plain script (CI stage 14): the script dir (tests/) is
+# what lands on sys.path, not the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="sctools_factory_smoke_")
+    try:
+        return _run(tmp)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _run(tmp: str) -> int:
+    import sctools_tpu as sct
+    from sctools_tpu.data.shardstore import ShardStore, write_store
+    from sctools_tpu.data.synthetic import synthetic_counts
+    from sctools_tpu.factory import AnnotationFactory
+    from sctools_tpu.federation import FederationSupervisor
+    from sctools_tpu.serving import (AnnotationService,
+                                     build_reference_artifact)
+    from sctools_tpu.utils.chaos import ChaosMonkey, Fault
+    from sctools_tpu.utils.telemetry import MetricsRegistry
+    from sctools_tpu.utils.vclock import VirtualClock
+    from soak_smoke import check_journal_coherent
+
+    n_genes = 64
+    labels_all: list = []
+
+    def mk(n, seed):
+        d = synthetic_counts(n, n_genes, density=0.15, n_clusters=3,
+                             seed=seed)
+        return d.with_obs(cell_type=np.array(
+            [f"type{c}" for c in np.asarray(d.obs["cluster_true"])]))
+
+    base = mk(256, 0)
+    labels_all.extend(np.asarray(base.obs["cell_type"]).tolist())
+    store_dir = os.path.join(tmp, "store")
+    write_store(base.X.tocsr(), store_dir, shard_rows=128,
+                chunk_rows=64)
+
+    def ref_source(store):
+        X = sp.vstack([sh.to_scipy_csr() for sh in
+                       store.iter_shards()],
+                      format="csr")[: store.n_cells]
+        return sct.from_scipy(X,
+                              obs={"cell_type": np.array(labels_all)})
+
+    fitted = sct.run_recipe("annotation_reference",
+                            ref_source(ShardStore.open(store_dir)),
+                            backend="cpu", n_components=12)
+    art0 = os.path.join(tmp, "model.npz")
+    # two generations so a corrupt_model ruling has a .prev to fall
+    # back onto (serving_smoke's quarantine contract)
+    build_reference_artifact(fitted, art0, labels_key="cell_type",
+                             seed=0, version="gen0a")
+    build_reference_artifact(fitted, art0, labels_key="cell_type",
+                             seed=0, version="gen0")
+
+    clock = VirtualClock()
+    metrics = MetricsRegistry(clock=clock)
+    monkey = ChaosMonkey([
+        Fault("w0", "kill_worker", on_call=2),
+        Fault("factory-train", "preempt", on_call=2),
+        Fault("fx", "corrupt_model", on_call=2),
+    ], clock=clock)
+    jp = os.path.join(tmp, "journal.jsonl")
+    svc = AnnotationService(
+        art0, name="fx", backend="tpu", clock=clock,
+        metrics=metrics, journal_path=jp, chaos=monkey,
+        max_concurrency=2, k=10,
+        runner_defaults={"probe": lambda: {"ok": True}})
+
+    b1, b2 = mk(64, 11), mk(64, 12)
+    for b in (b1, b2):
+        labels_all.extend(np.asarray(b.obs["cell_type"]).tolist())
+    hyper = dict(n_latent=4, n_hidden=16, epochs=2, batch_size=128,
+                 seed=0)
+    fed_dir = os.path.join(tmp, "fed")
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with FederationSupervisor(
+                fed_dir, n_workers=2, heartbeat_s=0.1, poll_s=0.05,
+                lease_timeout_s=30.0, clock=clock, metrics=metrics,
+                chaos=monkey, max_respawns=1, tenant_max_queued=16,
+                runner_config={"assume_healthy": True}) as sup:
+            fac = AnnotationFactory(
+                os.path.join(tmp, "factory"), store_dir=store_dir,
+                service=svc, ref_source=ref_source, name="fx",
+                supervisor=sup, n_components=12, backend="cpu",
+                train_kw=hyper, result_timeout_s=240)
+            # a wedged lease (if chaos reroutes) must never need real
+            # time: advance the clock past the lease on observation
+            th = threading.Thread(
+                target=lambda: (sup.wedge_observed.wait(timeout=60)
+                                and clock.advance(31.0)),
+                daemon=True)
+            th.start()
+            tickets = [svc.query(mk(3 + i, 99 + i), "label_transfer",
+                                 tenant=f"lab-{i % 2}")
+                       for i in range(4)]
+            st = fac.run_cycle([("b1", b1), ("b2", b2)], cycle=0)
+            tickets.append(svc.query(mk(5, 77), "label_transfer",
+                                     tenant="lab-0"))
+            results = [t.result(timeout=600) for t in tickets]
+        svc.drain()
+
+    # -- 1. cycle promoted, ingest exactly-once despite kill ----------
+    assert st["terminal"] == "promoted", st
+    store = ShardStore.open(store_dir)
+    assert store.n_cells == 256 + 128, store.n_cells
+    assert store.append_labels() == ["b1", "b2"], store.append_labels()
+    fj = os.path.join(fed_dir, "journal.jsonl")
+    check_journal_coherent(fj, 2)
+    fkinds = [json.loads(line)["event"] for line in open(fj)]
+    assert "worker_lost" in fkinds, fkinds
+    print("factory_smoke: 1/3 kill_worker OK (batch requeued, append "
+          "ledger exactly-once, federation journal coherent)")
+
+    # -- 2. retrain preempted at a shard boundary, then promoted ------
+    ev = [json.loads(line) for line in open(jp)]
+    kinds = [e["event"] for e in ev]
+    # one preempted ruling from the scheduler (ticket-keyed) and one
+    # from the trainer itself (cursor-keyed) — same shared journal
+    assert sum(1 for e in ev if e["event"] == "preempted"
+               and "ticket" in e) == 1, kinds
+    assert "train_resume" in kinds, kinds
+    modes = sorted(f["mode"] for f in monkey.injected)
+    assert modes == ["corrupt_model", "kill_worker", "preempt"], modes
+    print("factory_smoke: 2/3 preempt OK (yield at shard boundary, "
+          "resumed from cursor, cycle still promoted)")
+
+    # -- 3. zero dropped queries + both journals coherent -------------
+    assert all(t.status == "completed" for t in tickets), \
+        [(t.kind, t.status) for t in tickets]
+    for t, r in zip(tickets, results):
+        assert r["epoch"] == t.epoch, (t.epoch, r["epoch"])
+    assert svc.epoch == 1 and svc.model_version == "fx-c0000", \
+        (svc.epoch, svc.model_version)
+    assert "model_quarantined" in kinds, kinds
+    # service journal carries queries + the retrain ticket
+    check_journal_coherent(jp, len(tickets) + 1)
+    fx = [e for e in ev if "cycle" in e]
+    fxkinds = [e["event"] for e in fx]
+    for k in ("ingest_committed", "retrain_triggered",
+              "artifact_built", "swap_promoted"):
+        assert k in fxkinds, fxkinds
+    assert all("ticket" not in e for e in fx), fx
+    svc.close()
+    print("factory_smoke: 3/3 lifecycle OK (zero dropped queries, "
+          "served epoch advanced to the fresh artifact, factory "
+          "events cycle-keyed, terminal-exactly-once, "
+          f"{len(clock.sleeps)} virtual sleeps, zero real sleeps)")
+    print("factory_smoke: ALL OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,458 @@
+"""The annotation factory (``factory.py``) — the closed
+ingest → retrain → freeze → canary-swap loop — plus the durable
+append path it stands on (``StoreWriter.append_to``).
+
+Covers the cross-domain seams no single-module suite reaches:
+
+* at-most-once ingest (manifest append ledger, torn-append redo);
+* between-stage crash resume (``stage_crash`` chaos after the train
+  commit and after the build commit) proven BITWISE from the merged
+  journal — no replayed training shards, params/artifact untouched;
+* incarnation fencing (``owner.json`` epoch);
+* forced canary disagreement and corrupt-candidate rollback — the
+  old epoch keeps serving;
+* the full-stack soak: kill + wedge + mem-pressure + corrupt +
+  preempt on ONE VirtualClock, zero dropped queries, both journals
+  terminal-exactly-once.
+
+The CI-stage variant lives in ``tests/factory_smoke.py``.
+"""
+
+import json
+import os
+import shutil
+import threading
+import warnings
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import sctools_tpu as sct
+from sctools_tpu.data.shardstore import (ShardCorruptError, ShardStore,
+                                         StoreWriter, write_store)
+from sctools_tpu.data.synthetic import synthetic_counts
+from sctools_tpu.factory import (AnnotationFactory, FactoryFencedError,
+                                 append_store)
+from sctools_tpu.federation import FederationSupervisor
+from sctools_tpu.memory import MemoryBudget
+from sctools_tpu.serving import (AnnotationService,
+                                 build_reference_artifact)
+from sctools_tpu.utils.chaos import ChaosCrash, ChaosMonkey, Fault
+from sctools_tpu.utils.telemetry import MetricsRegistry
+from sctools_tpu.utils.vclock import VirtualClock
+
+N_GENES = 64
+HYPER = dict(n_latent=4, n_hidden=16, epochs=2, batch_size=128,
+             seed=0)
+
+
+def mk(n, seed):
+    d = synthetic_counts(n, N_GENES, density=0.15, n_clusters=3,
+                         seed=seed)
+    return d.with_obs(cell_type=np.array(
+        [f"type{c}" for c in np.asarray(d.obs["cluster_true"])]))
+
+
+@pytest.fixture(scope="module")
+def seed_bundle(tmp_path_factory):
+    """Base store (256 cells) + a gen0 serving artifact (with a
+    ``.prev`` generation), built once and COPIED per test — every
+    test mutates its own store."""
+    root = tmp_path_factory.mktemp("factory_seed")
+    base = mk(256, 0)
+    write_store(base.X.tocsr(), str(root / "store"), shard_rows=128,
+                chunk_rows=64)
+    labels = [str(v) for v in np.asarray(base.obs["cell_type"])]
+    fitted = sct.run_recipe(
+        "annotation_reference",
+        sct.from_scipy(base.X.tocsr(),
+                       obs={"cell_type": np.array(labels)}),
+        backend="cpu", n_components=12)
+    art = str(root / "model.npz")
+    build_reference_artifact(fitted, art, labels_key="cell_type",
+                             seed=0, version="gen0a")
+    build_reference_artifact(fitted, art, labels_key="cell_type",
+                             seed=0, version="gen0")
+    return {"root": str(root), "labels": labels}
+
+
+class Rig:
+    """One test's live world: a private copy of the seed store +
+    artifact, a service on a VirtualClock, and a factory builder
+    whose ``ref_source`` tracks every ingested batch's labels."""
+
+    def __init__(self, tmp, seed, *, chaos=None, mem_budget=None):
+        self.tmp = str(tmp)
+        self.store_dir = os.path.join(self.tmp, "store")
+        shutil.copytree(os.path.join(seed["root"], "store"),
+                        self.store_dir)
+        self.art = os.path.join(self.tmp, "model.npz")
+        shutil.copy(os.path.join(seed["root"], "model.npz"), self.art)
+        shutil.copy(os.path.join(seed["root"], "model.npz") + ".prev",
+                    self.art + ".prev")
+        self.labels = list(seed["labels"])
+        self.clock = VirtualClock()
+        self.metrics = MetricsRegistry(clock=self.clock)
+        self.journal_path = os.path.join(self.tmp, "journal.jsonl")
+        self.svc = AnnotationService(
+            self.art, name="fx", backend="tpu", clock=self.clock,
+            metrics=self.metrics, journal_path=self.journal_path,
+            chaos=chaos, mem_budget=mem_budget, max_concurrency=2,
+            k=10, runner_defaults={"probe": lambda: {"ok": True}})
+
+    def batch(self, n, seed):
+        b = mk(n, seed)
+        self.labels.extend(np.asarray(b.obs["cell_type"]).tolist())
+        return b
+
+    def ref_source(self, store):
+        X = sp.vstack([sh.to_scipy_csr() for sh in
+                       store.iter_shards()],
+                      format="csr")[: store.n_cells]
+        return sct.from_scipy(
+            X, obs={"cell_type": np.array(self.labels)})
+
+    def factory(self, **kw):
+        kw.setdefault("n_components", 12)
+        kw.setdefault("backend", "cpu")
+        kw.setdefault("train_kw", HYPER)
+        kw.setdefault("result_timeout_s", 600)
+        return AnnotationFactory(
+            os.path.join(self.tmp, "factory"),
+            store_dir=self.store_dir, service=self.svc,
+            ref_source=self.ref_source, name="fx", **kw)
+
+    def events(self):
+        return [json.loads(line) for line in open(self.journal_path)]
+
+    def close(self):
+        self.svc.drain()
+        self.svc.close()
+
+
+# ------------------------------------------------ StoreWriter.append_to
+
+def _small_store(tmp_path, n=128):
+    d = synthetic_counts(n, 16, density=0.3, seed=1)
+    return write_store(d.X, str(tmp_path / "s"), shard_rows=64,
+                       chunk_rows=32)
+
+
+def test_append_to_extends_and_ledgers(tmp_path):
+    store = _small_store(tmp_path)
+    block = sp.csr_matrix(synthetic_counts(32, 16, density=0.3,
+                                           seed=2).X.tocsr())
+    w = StoreWriter.append_to(store, label="b1")
+    w.append(block)
+    out = w.close()
+    assert out.n_cells == 160
+    assert out.append_labels() == ["b1"]
+    led = out.manifest["appends"][0]
+    assert led["row_start"] == 128 and led["rows"] == 32
+    # the appended rows read back bitwise, through the verified path
+    got = sp.vstack([sh.to_scipy_csr() for sh in out.iter_shards()],
+                    format="csr")[128:160]
+    assert np.array_equal(got.toarray(), block.toarray())
+    # digest chain stays extendable: a second append still verifies
+    w2 = StoreWriter.append_to(out.directory, label="b2")
+    w2.append(block)
+    assert w2.close().append_labels() == ["b1", "b2"]
+
+
+def test_append_to_refuses_geometry_mismatch(tmp_path):
+    store = _small_store(tmp_path)
+    with pytest.raises(ValueError, match="geometry is frozen"):
+        StoreWriter.append_to(store, n_genes=17)
+    with pytest.raises(ValueError, match="geometry is frozen"):
+        StoreWriter.append_to(store, chunk_rows=64)
+
+
+def test_append_to_refuses_tampered_manifest(tmp_path):
+    store = _small_store(tmp_path)
+    mpath = os.path.join(store.directory, "manifest.json")
+    m = json.load(open(mpath))
+    m["store_digest"] = "0" * 16
+    json.dump(m, open(mpath, "w"))
+    with pytest.raises(ShardCorruptError, match="tampered manifest"):
+        StoreWriter.append_to(store.directory)
+
+
+def test_append_to_refuses_partial_tail(tmp_path):
+    store = _small_store(tmp_path)
+    w = StoreWriter.append_to(store)
+    w.append(sp.csr_matrix(np.ones((16, 16), np.float32)))
+    out = w.close()  # legal write, but leaves a 16-row tail chunk
+    assert out.n_cells == 144
+    with pytest.raises(ValueError, match="ends mid-chunk"):
+        StoreWriter.append_to(out.directory)
+
+
+def test_torn_append_redo_is_byte_identical(tmp_path):
+    """A crash between chunk flush and manifest commit leaves orphan
+    chunk files; the redo overwrites them deterministically and the
+    ledger records the batch ONCE."""
+    store = _small_store(tmp_path)
+    block = sp.csr_matrix(synthetic_counts(64, 16, density=0.3,
+                                           seed=3).X.tocsr())
+    w = StoreWriter.append_to(store, label="torn")
+    w.append(block)  # full chunks flush eagerly ...
+    orphan = os.path.join(store.directory, "chunks",
+                          "chunk-00004.npz")
+    assert os.path.exists(orphan)  # ... but the manifest is untouched
+    orphan_bytes = open(orphan, "rb").read()
+    assert ShardStore.open(store.directory).n_cells == 128
+    del w  # simulated death before close()
+
+    d = mk_cell(block)
+    out = append_store(d, store_dir=store.directory, label="torn")
+    assert int(out.uns["append_store_rows"]) == 64
+    assert not bool(out.uns["append_store_skipped"])
+    assert open(orphan, "rb").read() == orphan_bytes
+    store2 = ShardStore.open(store.directory)
+    assert store2.n_cells == 192
+    assert store2.append_labels() == ["torn"]
+    # the requeued ticket's SECOND redo dedups on the ledger
+    out2 = append_store(d, store_dir=store.directory, label="torn")
+    assert bool(out2.uns["append_store_skipped"])
+    assert int(out2.uns["append_store_rows"]) == 0
+    assert ShardStore.open(store.directory).n_cells == 192
+
+
+def mk_cell(block):
+    return sct.from_scipy(sp.csr_matrix(block))
+
+
+# ------------------------------------------------------- the full cycle
+
+def test_cycle_promotes_and_is_idempotent(seed_bundle, tmp_path):
+    rig = Rig(tmp_path, seed_bundle)
+    fac = rig.factory()
+    b1 = rig.batch(64, 11)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        t = rig.svc.query(mk(5, 90), "label_transfer", tenant="lab")
+        st = fac.run_cycle([("b1", b1)], cycle=0)
+        assert t.result(timeout=600)["epoch"] == 0
+
+    assert st["terminal"] == "promoted"
+    assert rig.svc.epoch == 1
+    assert rig.svc.model_version == "fx-c0000"
+    assert st["swap"]["agreement"] >= 0.9
+    assert ShardStore.open(rig.store_dir).n_cells == 320
+    # the trained cursor was pinned to the POST-ingest store digest
+    assert st["train"]["store_digest"] == st["ingest"]["store_digest"]
+    kinds = [e["event"] for e in rig.events() if "cycle" in e]
+    assert kinds == ["ingest_committed", "retrain_triggered",
+                     "artifact_built", "swap_promoted"]
+    assert all("ticket" not in e for e in rig.events()
+               if "cycle" in e)
+    # terminal cycles are inert; the next cycle id advances
+    again = fac.run_cycle([("b1", b1)], cycle=0)
+    assert again == st and rig.svc.epoch == 1
+    assert fac.next_cycle() == 1
+    rig.close()
+
+
+def test_resume_between_stage_seams_and_fencing(seed_bundle,
+                                                tmp_path):
+    """Kill after the train commit (entering build), then after the
+    build commit (entering swap); every incarnation resumes from the
+    durable cursors — no replayed training shards, params and
+    artifact byte-stable — and the fenced stale incarnation refuses
+    to commit."""
+    rig = Rig(tmp_path, seed_bundle)
+    monkey = ChaosMonkey([Fault("fx/build", "stage_crash", on_call=1),
+                          Fault("fx/swap", "stage_crash", on_call=1)],
+                         clock=rig.clock)
+    b1 = rig.batch(64, 11)
+    batches = [("b1", b1)]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        fac1 = rig.factory(chaos=monkey)
+        with pytest.raises(ChaosCrash, match="entering stage 'build'"):
+            fac1.run_cycle(batches, cycle=0)
+        st = fac1.load_state(0)
+        assert "train" in st and "build" not in st
+        shards_before = [(e["epoch"], e["pos"]) for e in rig.events()
+                         if e["event"] == "train_shard"]
+        pmtime = os.path.getmtime(
+            os.path.join(fac1.cycle_dir(0), "params.npz"))
+
+        fac2 = rig.factory(chaos=monkey)
+        with pytest.raises(ChaosCrash, match="entering stage 'swap'"):
+            fac2.run_cycle(batches, cycle=0)
+        st = fac2.load_state(0)
+        assert "build" in st and "swap" not in st
+        amtime = os.path.getmtime(
+            os.path.join(fac2.cycle_dir(0), "artifact.npz"))
+
+        # fac2's claim fenced fac1: its next commit must refuse
+        with pytest.raises(FactoryFencedError):
+            fac1.run_cycle(batches, cycle=0)
+
+        fac3 = rig.factory(chaos=monkey)
+        st = fac3.run_cycle(batches, cycle=0)
+
+    assert st["terminal"] == "promoted"
+    assert rig.svc.epoch == 1 and rig.svc.model_version == "fx-c0000"
+    ev = rig.events()
+    shards_after = [(e["epoch"], e["pos"]) for e in ev
+                    if e["event"] == "train_shard"]
+    assert shards_after == shards_before, "training shards replayed"
+    assert len(shards_after) == len(set(shards_after))
+    assert os.path.getmtime(
+        os.path.join(fac3.cycle_dir(0), "params.npz")) == pmtime
+    assert os.path.getmtime(
+        os.path.join(fac3.cycle_dir(0), "artifact.npz")) == amtime
+    kinds = [e["event"] for e in ev]
+    for k in ("ingest_committed", "retrain_triggered",
+              "artifact_built", "swap_promoted"):
+        assert kinds.count(k) == 1, (k, kinds)
+    assert [f["mode"] for f in monkey.injected] == \
+        ["stage_crash", "stage_crash"]
+    rig.close()
+
+
+def test_canary_disagreement_rolls_back(seed_bundle, tmp_path,
+                                        monkeypatch):
+    """A candidate whose loadings no longer match its recorded
+    reference scores fails its own canary; the swap rolls back and
+    the OLD epoch keeps serving."""
+    import sctools_tpu.factory as factory_mod
+
+    real = factory_mod.build_reference_artifact_checked
+
+    def poisoned(ref, path, **kw):
+        pcs = np.asarray(ref.varm["PCs"])
+        rng = np.random.default_rng(7)
+        bad = rng.normal(size=pcs.shape).astype(pcs.dtype)
+        return real(ref.with_varm(PCs=bad), path, **kw)
+
+    monkeypatch.setattr(factory_mod,
+                        "build_reference_artifact_checked", poisoned)
+    rig = Rig(tmp_path, seed_bundle)
+    fac = rig.factory()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        st = fac.run_cycle([("b1", rig.batch(64, 11))], cycle=0)
+        t = rig.svc.query(mk(5, 90), "label_transfer", tenant="lab")
+        assert t.result(timeout=600)["epoch"] == 0
+
+    assert st["terminal"] == "rolled_back"
+    assert st["swap"]["reason"] == "canary_disagreement"
+    assert st["swap"]["agreement"] < 0.9
+    assert rig.svc.epoch == 0 and rig.svc.model_version == "gen0"
+    rb = [e for e in rig.events()
+          if e["event"] == "swap_rolled_back" and "cycle" in e]
+    assert len(rb) == 1 and rb[0]["reason"] == "canary_disagreement"
+    # a rolled-back cycle is terminal: the loop moves on, it does
+    # not retry the poisoned candidate forever
+    assert fac.next_cycle() == 1
+    rig.close()
+
+
+def test_corrupt_candidate_rolls_back(seed_bundle, tmp_path):
+    """Crash entering swap, damage the built candidate on disk (the
+    torn-artifact window), resume: the digest check refuses the
+    candidate and the cycle terminals ``rolled_back``."""
+    rig = Rig(tmp_path, seed_bundle)
+    monkey = ChaosMonkey([Fault("fx/swap", "stage_crash", on_call=1)],
+                         clock=rig.clock)
+    b1 = rig.batch(64, 11)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        fac = rig.factory(chaos=monkey)
+        with pytest.raises(ChaosCrash):
+            fac.run_cycle([("b1", b1)], cycle=0)
+        artp = os.path.join(fac.cycle_dir(0), "artifact.npz")
+        blob = bytearray(open(artp, "rb").read())
+        for i in range(0, len(blob), max(1, len(blob) // 16)):
+            blob[i] ^= 0xFF
+        open(artp, "wb").write(bytes(blob))
+        st = rig.factory(chaos=monkey).run_cycle([("b1", b1)],
+                                                 cycle=0)
+    assert st["terminal"] == "rolled_back"
+    assert st["swap"]["reason"] == "artifact_corrupt"
+    assert rig.svc.epoch == 0 and rig.svc.model_version == "gen0"
+    rig.close()
+
+
+# -------------------------------------------------- the full-stack soak
+
+def test_factory_soak_full_stack(seed_bundle, tmp_path):
+    """Kill + wedge + mem-pressure + corrupt + preempt on ONE
+    VirtualClock: ingest rides federation tickets (worker killed,
+    lease wedged), the retrain is preempted by the shared funnel,
+    the live model is corrupted mid-traffic, and the memory budget
+    comes under chaos pressure — the cycle still promotes, zero
+    queries drop, and both journals are terminal-exactly-once."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from soak_smoke import check_journal_coherent
+
+    budget = MemoryBudget(500_000_000, name="hbm0")
+    chaos = ChaosMonkey([
+        Fault("w0", "kill_worker", on_call=2),
+        Fault("w1", "lease_wedge", on_call=2),
+        Fault("factory-train", "preempt", on_call=2),
+        Fault("fx", "corrupt_model", on_call=2),
+        Fault("hbm0", "mem_pressure", on_call=3, times=2),
+    ])
+    rig = Rig(tmp_path, seed_bundle, chaos=chaos, mem_budget=budget)
+    b1, b2 = rig.batch(64, 11), rig.batch(64, 12)
+    fed_dir = os.path.join(rig.tmp, "fed")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with FederationSupervisor(
+                fed_dir, n_workers=2, heartbeat_s=0.1, poll_s=0.05,
+                lease_timeout_s=30.0, clock=rig.clock,
+                metrics=rig.metrics, chaos=chaos, max_respawns=1,
+                tenant_max_queued=16,
+                runner_config={"assume_healthy": True}) as sup:
+            fac = rig.factory(supervisor=sup, result_timeout_s=240)
+            th = threading.Thread(
+                target=lambda: (sup.wedge_observed.wait(timeout=120)
+                                and rig.clock.advance(31.0)),
+                daemon=True)
+            th.start()
+            tickets = [rig.svc.query(mk(3 + i, 80 + i),
+                                     "label_transfer",
+                                     tenant=f"lab-{i % 2}")
+                       for i in range(4)]
+            st = fac.run_cycle([("b1", b1), ("b2", b2)], cycle=0)
+            tickets.append(rig.svc.query(mk(6, 70), "label_transfer",
+                                         tenant="lab-0"))
+            results = [t.result(timeout=600) for t in tickets]
+            th.join(timeout=10)
+
+    assert st["terminal"] == "promoted"
+    # every chaos leg actually fired
+    modes = sorted({f["mode"] for f in chaos.injected})
+    assert modes == ["corrupt_model", "kill_worker", "lease_wedge",
+                     "mem_pressure", "preempt"], modes
+    # zero dropped queries, each on its admitted epoch
+    assert all(t.status == "completed" for t in tickets)
+    for t, r in zip(tickets, results):
+        assert r["epoch"] == t.epoch
+    # the served epoch provably reflects the freshly-ingested data:
+    # the promoted artifact's version is this cycle's, its training
+    # ran on the post-ingest store digest, and the store grew
+    assert rig.svc.epoch == 1
+    assert rig.svc.model_version == "fx-c0000"
+    store = ShardStore.open(rig.store_dir)
+    assert store.n_cells == 256 + 128
+    assert store.append_labels() == ["b1", "b2"]
+    assert st["train"]["store_digest"] == \
+        str(store.manifest["store_digest"])
+    # both journals coherent: the federation funnel saw 2 tickets,
+    # the service funnel saw the queries + the retrain submission
+    check_journal_coherent(os.path.join(fed_dir, "journal.jsonl"), 2)
+    rig.svc.drain()
+    check_journal_coherent(rig.journal_path, len(tickets) + 1)
+    fkinds = [json.loads(line)["event"]
+              for line in open(os.path.join(fed_dir,
+                                            "journal.jsonl"))]
+    assert "worker_lost" in fkinds
+    rig.close()

@@ -586,3 +586,80 @@ def test_serving_section_absent_without_serve_series():
         [{"event": "run_start"}],
         {"metrics": {"counters": {"sched.admitted{tenant=a}": 1.0},
                      "gauges": {}, "histograms": {}}}) == []
+
+
+def test_factory_section_renders_cycles_and_join(tmp_path, capsys):
+    """A run dir whose journal carries cycle-keyed factory lifecycle
+    events gets the factory section: one stage-ladder line per cycle
+    (ingest batches -> retrain -> build -> terminal) and the
+    cross-domain join check — a promoted cycle whose retrain digest
+    matches the post-ingest store digest traces fully; a cycle whose
+    retrain ran on a STALE digest is flagged JOIN BROKEN."""
+    journal = (
+        '{"event": "ingest_committed", "cycle": 0, "factory": "fx", '
+        '"label": "b1", "rows": 64, "skipped": false, '
+        '"store_digest": "aaaa", "ts": 1.0}\n'
+        '{"event": "ingest_committed", "cycle": 0, "factory": "fx", '
+        '"label": "b2", "rows": 64, "skipped": true, '
+        '"store_digest": "bbbb", "ts": 1.5}\n'
+        '{"event": "retrain_triggered", "cycle": 0, "factory": "fx", '
+        '"tenant": "factory-train", "store_digest": "bbbb", '
+        '"ts": 2.0}\n'
+        '{"event": "artifact_built", "cycle": 0, "factory": "fx", '
+        '"digest": "dddd", "version": "fx-c0000", "ts": 3.0}\n'
+        '{"event": "swap_promoted", "cycle": 0, "factory": "fx", '
+        '"epoch": 1, "version": "fx-c0000", "agreement": 1.0, '
+        '"ts": 4.0}\n'
+        '{"event": "ingest_committed", "cycle": 1, "factory": "fx", '
+        '"label": "b3", "rows": 64, "skipped": false, '
+        '"store_digest": "cccc", "ts": 5.0}\n'
+        '{"event": "retrain_triggered", "cycle": 1, "factory": "fx", '
+        '"tenant": "factory-train", "store_digest": "bbbb", '
+        '"ts": 6.0}\n'
+        '{"event": "swap_rolled_back", "cycle": 1, "factory": "fx", '
+        '"reason": "canary_disagreement", "epoch": 1, '
+        '"agreement": 0.31, "ts": 7.0}\n')
+    (tmp_path / "journal.jsonl").write_text(journal)
+    assert main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "-- factory --" in out
+    assert ("fx cycle 0: 2 batch(es), 128 row(s) (1 redo-deduped) "
+            "-> retrained -> built fx-c0000 -> PROMOTED epoch 1 "
+            "(agreement 1.0)") in out
+    assert ("fx cycle 1: 1 batch(es), 64 row(s) -> retrained "
+            "-> NO artifact -> ROLLED BACK: canary_disagreement") \
+        in out
+    assert ("JOIN BROKEN: retrain digest is not the post-ingest "
+            "store digest") in out
+    assert ("cross-domain join: 1/2 cycle(s) fully traced (batch -> "
+            "retrain on post-ingest digest -> served epoch or "
+            "journaled rollback)") in out
+
+
+def test_factory_section_flags_open_cycle(tmp_path, capsys):
+    """A cycle that crashed before its terminal is named OPEN, not
+    hidden — the join check counts it as broken."""
+    journal = (
+        '{"event": "ingest_committed", "cycle": 3, "factory": "fx", '
+        '"label": "b9", "rows": 64, "skipped": false, '
+        '"store_digest": "eeee", "ts": 1.0}\n'
+        '{"event": "retrain_triggered", "cycle": 3, "factory": "fx", '
+        '"tenant": "factory-train", "store_digest": "eeee", '
+        '"ts": 2.0}\n')
+    (tmp_path / "journal.jsonl").write_text(journal)
+    assert main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "OPEN (no terminal journaled)" in out
+    assert "JOIN BROKEN: no terminal journaled" in out
+    assert "cross-domain join: 0/1 cycle(s) fully traced" in out
+
+
+def test_factory_section_absent_without_factory_events():
+    from tools.sctreport import factory_section
+
+    assert factory_section([], None) == []
+    # a SERVICE-level swap_rolled_back (no cycle key) is the serving
+    # section's story, not the factory's
+    assert factory_section(
+        [{"event": "swap_rolled_back", "reason": "x", "epoch": 1}],
+        None) == []

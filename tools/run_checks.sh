@@ -111,7 +111,20 @@
 #                     admission, journal coherent, one VirtualClock
 #                     with zero real sleeps (docs/ARCHITECTURE.md
 #                     "Memory fault domain")
-#  14. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
+#  14. factory       python tests/factory_smoke.py — the composed
+#                     continuously-learning annotation factory's
+#                     contract: one full ingest -> retrain -> build ->
+#                     canary-swap cycle on one VirtualClock while a
+#                     federation worker is SIGKILLed mid-ingest (batch
+#                     requeued, append ledger exactly-once), the
+#                     retrain tenant is preempted at a shard boundary
+#                     (cursor resume, no replayed shards), and the
+#                     live service's model is chaos-corrupted under
+#                     traffic (quarantine + .prev) — zero dropped
+#                     queries, served epoch advanced to the fresh
+#                     artifact, both journals terminal-exactly-once
+#                     (docs/ARCHITECTURE.md "The annotation factory")
+#  15. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
 
 set -u -o pipefail
 
@@ -357,6 +370,14 @@ if JAX_PLATFORMS=cpu python tests/mem_smoke.py; then
     :
 else
     echo "memory stage FAILED (rc=$?)"
+    fail=1
+fi
+
+stage "factory (ingest->retrain->canary swap under kill+preempt+corrupt)"
+if JAX_PLATFORMS=cpu python tests/factory_smoke.py; then
+    :
+else
+    echo "factory stage FAILED (rc=$?)"
     fail=1
 fi
 

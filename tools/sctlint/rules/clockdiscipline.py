@@ -43,10 +43,15 @@ from ..jaxutil import dotted, module_info
 # and the residency/swap ladder all move on the scheduler's
 # injectable clock, so the chaos acceptance soak (eviction +
 # corruption + hot-swap under multi-tenant traffic) runs on one
-# VirtualClock with zero real sleeps.
+# VirtualClock with zero real sleeps;
+# factory.py for the annotation factory — the closed loop's stage
+# polls and retrain waits ride the same injectable clock, so the
+# end-to-end composition soak (kill + wedge + oom + corrupt +
+# preempt) runs on one VirtualClock with zero real sleeps.
 _PATH_RE = re.compile(
     r"(^|/)(runner|failsafe|checkpoint|chaos|stream|scheduler"
-    r"|shardstore|federation|train_stream|telemetry|serving)\.py$")
+    r"|shardstore|federation|train_stream|telemetry|serving"
+    r"|factory)\.py$")
 
 _BANNED = {"time.sleep", "time.monotonic"}
 

@@ -24,9 +24,13 @@ from ..jaxutil import dotted, module_info
 # shared breaker, deterministic fails the query fast), so a silent
 # broad swallow there would hide exactly the rung evidence the
 # ladder's journal exists for
+# factory.py joined with the annotation factory: every stage failure
+# must surface as a journaled cycle verdict (swap_rolled_back with a
+# reason, or a classified re-raise) — a swallowed stage error leaves
+# the closed loop silently stuck between cursors
 _PATH_RE = re.compile(
     r"(^|/)(runner|failsafe|checkpoint|chaos|trace|determinism|sync"
-    r"|vclock|federation|serving)\.py$")
+    r"|vclock|federation|serving|factory)\.py$")
 
 _BROAD = {"Exception", "BaseException"}
 

@@ -331,6 +331,11 @@ def render(run_dir: str, runs: list[dict], trace_d: dict | None,
         add("")
         L.extend(mem)
 
+    fact = factory_section(events or [], metrics)
+    if fact:
+        add("")
+        L.extend(fact)
+
     add("")
     add("-- metrics snapshot --")
     if metrics is None:
@@ -870,6 +875,66 @@ def memory_section(events: list[dict], metrics) -> list[str]:
     corr = mem_counters.get("mem.estimate_corrections")
     if corr:
         L.append(f"  estimate corrections (inflate-on-OOM): {corr:g}")
+    return L
+
+
+def factory_section(events: list[dict], metrics) -> list[str]:
+    """The annotation-factory digest, rendered only when the journal
+    carries cycle-keyed factory lifecycle events (a run with no
+    factory has no section).  One line per cycle walks the stage
+    ladder — ingest → retrain → build → swap terminal — and the
+    CROSS-DOMAIN JOIN check below it verifies the composed pipeline's
+    end-to-end evidence: every ingested batch must trace to a retrain
+    pinned to the POST-ingest store digest and on to a served epoch,
+    or to a journaled rollback reason; anything else is flagged
+    ``JOIN BROKEN`` (an OPEN cycle — crashed before its terminal —
+    is named, not hidden)."""
+    fx = [e for e in events if "cycle" in e and e["event"] in (
+        "ingest_committed", "retrain_triggered", "artifact_built",
+        "swap_promoted", "swap_rolled_back")]
+    if not fx:
+        return []
+    L = ["-- factory --"]
+    cycles: dict = {}
+    for e in fx:
+        cycles.setdefault((str(e.get("factory", "?")),
+                           int(e["cycle"])), []).append(e)
+    joined = 0
+    for (name, cyc), evs in sorted(cycles.items()):
+        ing = [e for e in evs if e["event"] == "ingest_committed"]
+        ret = [e for e in evs if e["event"] == "retrain_triggered"]
+        art = [e for e in evs if e["event"] == "artifact_built"]
+        prom = [e for e in evs if e["event"] == "swap_promoted"]
+        roll = [e for e in evs if e["event"] == "swap_rolled_back"]
+        rows = sum(int(e.get("rows", 0)) for e in ing)
+        redone = sum(1 for e in ing if e.get("skipped"))
+        L.append(
+            f"  {name} cycle {cyc}: {len(ing)} batch(es), {rows:g} "
+            f"row(s)"
+            + (f" ({redone} redo-deduped)" if redone else "")
+            + (" -> retrained" if ret else " -> NO retrain")
+            + (f" -> built {art[0].get('version')}" if art
+               else " -> NO artifact")
+            + (f" -> PROMOTED epoch {prom[0].get('epoch')} "
+               f"(agreement {prom[0].get('agreement')})" if prom
+               else f" -> ROLLED BACK: {roll[0].get('reason')}"
+               if roll else " -> OPEN (no terminal journaled)"))
+        problems = []
+        if ing and not ret:
+            problems.append("ingested batches never retrained")
+        if (ing and ret and ret[0].get("store_digest")
+                != ing[-1].get("store_digest")):
+            problems.append(
+                "retrain digest is not the post-ingest store digest")
+        if not prom and not roll:
+            problems.append("no terminal journaled")
+        if problems:
+            L.append("    JOIN BROKEN: " + "; ".join(problems))
+        else:
+            joined += 1
+    L.append(f"  cross-domain join: {joined}/{len(cycles)} cycle(s) "
+             f"fully traced (batch -> retrain on post-ingest digest "
+             f"-> served epoch or journaled rollback)")
     return L
 
 
