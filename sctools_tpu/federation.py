@@ -170,9 +170,12 @@ class FederatedBreaker(CircuitBreaker):
     # -- remote sync ---------------------------------------------------
     def _refresh(self) -> None:
         """Apply any unseen remote transition (caller holds the
-        lock)."""
-        # sctlint: locked-by-caller — every caller (state property,
-        # record_*, snapshot) enters through `with self.lock:`
+        lock — a contract the call graph now PROVES, so no
+        locked-by-caller annotation is needed)."""
+        # sctlint: io-under-lock — reading the shared state file IS
+        # the sync step: it must happen inside the same lock hold as
+        # the ruling that consumes it, or a remote `open` could land
+        # between the read and the local decision
         try:
             with open(self._file) as f:
                 rec = json.load(f)
@@ -208,6 +211,10 @@ class FederatedBreaker(CircuitBreaker):
         lock is retried briefly, then the write proceeds anyway —
         last-writer-wins on a torn race beats wedging the breaker's
         caller on a dead locker."""
+        # sctlint: io-under-lock — the publish must be atomic with
+        # the local transition it mirrors: dropping the breaker lock
+        # between deciding `open` and writing it would let a sharer
+        # read the stale state and re-close a breaker we just tripped
         lockdir = self._file + ".lock"
         held = False
         for _ in range(50):
@@ -233,7 +240,11 @@ class FederatedBreaker(CircuitBreaker):
                 with open(tmp, "w") as f:
                     json.dump(rec, f)
                 os.replace(tmp, self._file)
-                self._seen_epoch = ep + 1
+                # deliberately NOT fence-checked: _publish ADVANCES
+                # the epoch (fetch-max-increment under the lockdir,
+                # last-writer-wins on a torn race per the docstring)
+                # rather than committing under an existing one
+                self._seen_epoch = ep + 1  # sctlint: disable=SCT016
             except OSError as e:
                 warnings.warn(
                     f"FederatedBreaker: could not publish {state!r} "
@@ -303,6 +314,10 @@ class FederatedBreaker(CircuitBreaker):
 
     # -- probe claim file ----------------------------------------------
     def _claim_probe_file(self) -> bool:
+        # sctlint: io-under-lock — the O_EXCL-style link IS the
+        # cross-process probe claim; it must be decided in the same
+        # lock hold that claimed the local slot, or two threads of
+        # one process could both believe they hold the probe
         # the claim is made by LINKING a fully-written private record
         # into place: the shared path either carries a complete owner
         # record or does not exist, so a disk-full failure happens on
@@ -360,6 +375,10 @@ class FederatedBreaker(CircuitBreaker):
                 os.unlink(tmp)
 
     def _drop_probe_file(self) -> None:
+        # sctlint: io-under-lock — releasing the claim file must be
+        # atomic with clearing the local flag: a gap would let a
+        # sharer win the claim while this process still thinks it
+        # holds the slot
         self._holds_probe_file = False
         try:
             os.unlink(self._probe_file)
@@ -419,6 +438,10 @@ class FederatedBreakerRegistry(BreakerRegistry):
         """Remove probe-claim files held by ``owner`` (a fenced/dead
         worker cannot deliver a verdict; leaving its claim would
         wedge every sharer on the fallback until the stale TTL)."""
+        # sctlint: io-under-lock — runs inside the lost-worker ruling
+        # (supervisor lock held): the claims must be gone before the
+        # ruling completes, or a respawned worker could collide with
+        # its predecessor's stale probe slot
         cleared = 0
         try:
             names = os.listdir(self.store_dir)
@@ -503,6 +526,14 @@ class FederatedRunError(RuntimeError):
     exhausted ladder).  Carries the worker-reported error text; the
     worker's journal under ``workers/<name>/journal.jsonl`` has the
     full attempt-by-attempt story."""
+
+
+class FederationFencedError(RuntimeError):
+    """A ticket was requeued while its previous incarnation could
+    still commit — the caller skipped the fence step (fence the
+    worker, record the refusal, or know the assignment never reached
+    an inbox) before bumping the epoch.  Raised by the supervisor's
+    own invariant check, never expected in normal operation."""
 
 
 class _Ticket:
@@ -763,6 +794,11 @@ class FederationSupervisor:
             self.check_leases()
 
     def _spawn_locked(self, name: str, gen: int) -> _Worker:
+        # sctlint: io-under-lock — preparing the worker dir (fence
+        # and stop markers REMOVED, inbox created) and registering
+        # the process must be one atomic step under the dispatch
+        # lock: a dispatch between the spawn and the bookkeeping
+        # would assign to a worker whose inbox does not exist yet
         wdir = os.path.join(self.fed_dir, "workers", name)
         os.makedirs(os.path.join(wdir, "inbox"), exist_ok=True)
         for stale in ("fence.json", "stop"):
@@ -1028,6 +1064,10 @@ class FederationSupervisor:
         """The dead worker's last journal records, grafted into its
         ``worker_lost`` event — the post-mortem a vanished process
         cannot give any other way."""
+        # sctlint: io-under-lock — the tail is read as part of the
+        # lost-worker ruling so the worker_lost record carries the
+        # evidence as of the ruling, not of some later state; the
+        # file is small (last n lines of a dead worker's journal)
         path = os.path.join(w.dir, "journal.jsonl")
         try:
             with open(path) as f:
@@ -1044,6 +1084,10 @@ class FederationSupervisor:
 
     def _lose_worker_locked(self, w: _Worker, reason: str,
                             rc=None) -> None:
+        # sctlint: io-under-lock — the fence write is the POINT of
+        # this function and must precede, in the same lock hold, the
+        # requeue it licenses (see FENCE FIRST below); the inbox
+        # purge likewise must be atomic with the respawn decision
         if w.lost:
             return
         w.lost = True
@@ -1105,6 +1149,16 @@ class FederationSupervisor:
         self._dispatch_locked()
 
     def _requeue_locked(self, t: _Ticket, from_worker: _Worker) -> None:
+        # the fence-before-requeue invariant, enforced: every caller
+        # must have detached the old incarnation (fence file written,
+        # worker-side refusal recorded, or the assignment never
+        # reached an inbox) before the epoch may move — a requeue
+        # with the old worker still attached is the double-commit
+        # race the epoch exists to prevent
+        if t.worker is not None:
+            raise FederationFencedError(
+                f"requeue of {t.id} while still assigned to "
+                f"{t.worker.name} — fence the worker first")
         t.epoch += 1
         t.handle.epoch = t.epoch
         t.handle._status = "queued"
@@ -1257,6 +1311,12 @@ class FederationSupervisor:
 
     # -- dispatch -------------------------------------------------------
     def _dispatch_locked(self) -> None:
+        # sctlint: io-under-lock — the assignment file write must be
+        # atomic with the in_flight/queue bookkeeping: dropping the
+        # lock between claiming the slot and landing the inbox file
+        # would let a concurrent lose/requeue see a worker "running"
+        # a ticket whose assignment does not exist yet (the write is
+        # one small JSON rename per dispatched ticket)
         if self._closed and not self._queue:
             return
         progress = True

@@ -704,9 +704,11 @@ class RunScheduler:
         deadline expired — or whose estimate can no longer EVER fit
         beside the standing residents (they grew since admission) —
         are shed on the way.  Marks the winner running (counters +
-        stats + memory reservation) before returning it."""
-        # sctlint: locked-by-caller — the _locked suffix contract:
-        # every caller holds self._cv (= self._lock)
+        stats + memory reservation) before returning it.
+
+        The ``_locked`` suffix contract (every caller holds
+        ``self._cv`` = ``self._lock``) is PROVEN by the call graph —
+        no locked-by-caller annotation needed."""
         now = self.clock.monotonic()
         for it in [q for q in self._queue
                    if q.deadline_s is not None

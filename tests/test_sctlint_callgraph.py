@@ -1,0 +1,379 @@
+"""The interprocedural layer: call-graph construction edge cases —
+name/attribute resolution, methods through self/class attributes,
+decorated functions, registry indirection and installed call
+wrappers, dynamic calls as EXPLICIT may-calls, lock qualification
+with Condition aliasing, escape analysis, and the component /
+summary-signature surface the incremental cache keys on.
+"""
+
+import os
+import sys
+import textwrap
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from tools.sctlint import core  # noqa: E402
+from tools.sctlint.callgraph import (  # noqa: E402
+    ast_signature, build_call_graph)
+
+
+def build(tmp_path, files):
+    ctxs = []
+    for name, src in sorted(files.items()):
+        p = tmp_path / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        ctxs.append(core.load_file(str(p), str(tmp_path)))
+    return build_call_graph(ctxs)
+
+
+def callee_keys(graph, caller_key):
+    out = set()
+    for site in graph.functions[caller_key].sites:
+        out.update(site.callees)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Name and attribute resolution
+# ---------------------------------------------------------------------------
+
+def test_module_function_and_import_resolution(tmp_path):
+    g = build(tmp_path, {
+        "a.py": """
+            from b import helper
+
+            def top():
+                helper()
+                local()
+
+            def local():
+                pass
+            """,
+        "b.py": """
+            def helper():
+                pass
+            """,
+    })
+    assert callee_keys(g, "a.py::top") == {"b.py::helper",
+                                           "a.py::local"}
+
+
+def test_method_resolution_via_self_and_class_attr(tmp_path):
+    g = build(tmp_path, {
+        "m.py": """
+            class Store:
+                def save(self):
+                    self._flush()
+
+                def _flush(self):
+                    pass
+
+            class Client:
+                def __init__(self):
+                    self.store = Store()
+
+                def run(self):
+                    self.store.save()
+                    Store.save(self.store)
+            """,
+    })
+    assert callee_keys(g, "m.py::Store.save") == {"m.py::Store._flush"}
+    # both the field-typed receiver and the class-object call resolve
+    assert callee_keys(g, "m.py::Client.run") == {"m.py::Store.save"}
+
+
+def test_inherited_method_resolves_through_mro(tmp_path):
+    g = build(tmp_path, {
+        "m.py": """
+            class Base:
+                def ping(self):
+                    pass
+
+            class Child(Base):
+                def go(self):
+                    self.ping()
+            """,
+    })
+    assert callee_keys(g, "m.py::Child.go") == {"m.py::Base.ping"}
+
+
+def test_nested_def_shadows_module_function(tmp_path):
+    g = build(tmp_path, {
+        "m.py": """
+            def work():
+                pass
+
+            def outer():
+                def work():
+                    inner_only()
+                work()
+
+            def inner_only():
+                pass
+            """,
+    })
+    # the CALL inside outer binds to the nested def, not the module fn
+    assert callee_keys(g, "m.py::outer") == {"m.py::outer.work"}
+
+
+# ---------------------------------------------------------------------------
+# Decorators and escapes
+# ---------------------------------------------------------------------------
+
+def test_benign_decorator_keeps_function_enumerable(tmp_path):
+    g = build(tmp_path, {
+        "m.py": """
+            import functools
+
+            class C:
+                @property
+                def state(self):
+                    return 1
+
+                @functools.cached_property
+                def heavy(self):
+                    return 2
+            """,
+    })
+    assert not g.functions["m.py::C.state"].escapes
+    assert not g.functions["m.py::C.heavy"].escapes
+
+
+def test_unknown_decorator_marks_escape(tmp_path):
+    g = build(tmp_path, {
+        "m.py": """
+            def fancy(fn):
+                return fn
+
+            @fancy
+            def wrapped():
+                pass
+            """,
+    })
+    assert g.functions["m.py::wrapped"].escapes
+
+
+def test_value_reference_marks_escape_call_does_not(tmp_path):
+    g = build(tmp_path, {
+        "m.py": """
+            def cb():
+                pass
+
+            def called():
+                pass
+
+            def run(reg):
+                reg.append(cb)
+                called()
+            """,
+    })
+    assert g.functions["m.py::cb"].escapes
+    assert not g.functions["m.py::called"].escapes
+
+
+# ---------------------------------------------------------------------------
+# Registry indirection and call wrappers
+# ---------------------------------------------------------------------------
+
+_REGISTRY = """
+    _IMPLS = {}
+    _WRAPPERS = []
+
+    def register(name, backend="cpu"):
+        def deco(fn):
+            _IMPLS[(name, backend)] = fn
+            return fn
+        return deco
+
+    def get(name, backend="cpu"):
+        return _IMPLS[(name, backend)]
+
+    def apply(name, data, backend="cpu", **kw):
+        return get(name, backend)(data, **kw)
+
+    def push_call_wrapper(w):
+        _WRAPPERS.append(w)
+    """
+
+_OPS = """
+    from registry import register
+
+    @register("op.sleepy", backend="cpu")
+    def sleepy_impl(data):
+        return data
+
+    @register("op.clean", backend="cpu")
+    def clean_impl(data):
+        return data
+    """
+
+
+def test_registry_apply_constant_name_fans_to_that_impl(tmp_path):
+    g = build(tmp_path, {
+        "registry.py": _REGISTRY, "ops.py": _OPS,
+        "use.py": """
+            import registry
+
+            def run(data):
+                return registry.apply("op.sleepy", data)
+            """,
+    })
+    callees = callee_keys(g, "use.py::run")
+    assert "ops.py::sleepy_impl" in callees
+    assert "ops.py::clean_impl" not in callees
+
+
+def test_registry_apply_dynamic_name_fans_to_all_impls(tmp_path):
+    g = build(tmp_path, {
+        "registry.py": _REGISTRY, "ops.py": _OPS,
+        "use.py": """
+            import registry
+
+            def run(name, data):
+                return registry.apply(name, data)
+            """,
+    })
+    callees = callee_keys(g, "use.py::run")
+    assert {"ops.py::sleepy_impl", "ops.py::clean_impl"} <= callees
+
+
+def test_registry_get_is_a_lookup_not_an_invocation(tmp_path):
+    g = build(tmp_path, {
+        "registry.py": _REGISTRY, "ops.py": _OPS,
+        "use.py": """
+            import registry
+
+            def fetch():
+                fn = registry.get("op.sleepy")
+                return fn
+            """,
+    })
+    # fetching the impl must not charge the site with calling it
+    assert "ops.py::sleepy_impl" not in callee_keys(g, "use.py::fetch")
+
+
+def test_push_call_wrapper_joins_every_dispatch_site(tmp_path):
+    g = build(tmp_path, {
+        "registry.py": _REGISTRY, "ops.py": _OPS,
+        "wrap.py": """
+            import registry
+
+            def my_wrapper(name, backend, fn):
+                return fn
+
+            def install():
+                registry.push_call_wrapper(my_wrapper)
+            """,
+        "use.py": """
+            import registry
+
+            def run(data):
+                return registry.apply("op.clean", data)
+            """,
+    })
+    assert "wrap.py::my_wrapper" in g.wrappers
+    assert g.functions["wrap.py::my_wrapper"].escapes
+    assert "wrap.py::my_wrapper" in callee_keys(g, "use.py::run")
+
+
+# ---------------------------------------------------------------------------
+# Explicit may-call
+# ---------------------------------------------------------------------------
+
+def test_dynamic_call_is_explicit_may_call(tmp_path):
+    g = build(tmp_path, {
+        "m.py": """
+            def run(callback, table):
+                callback()
+                table["x"]()
+            """,
+    })
+    sites = g.functions["m.py::run"].sites
+    assert sites and all(s.kind == "unresolved" and not s.callees
+                         for s in sites)
+    assert len(g.may_call_sites) == 2
+
+
+def test_external_and_builtin_calls_are_classified(tmp_path):
+    g = build(tmp_path, {
+        "m.py": """
+            import json
+
+            def run(data):
+                json.dumps(data)
+                len(data)
+            """,
+    })
+    kinds = {s.text: s.kind for s in g.functions["m.py::run"].sites}
+    assert kinds == {"json.dumps": "external", "len": "builtin"}
+
+
+# ---------------------------------------------------------------------------
+# Lock qualification
+# ---------------------------------------------------------------------------
+
+def test_held_locks_qualified_with_condition_alias(tmp_path):
+    g = build(tmp_path, {
+        "m.py": """
+            import threading
+
+            class Sched:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cv = threading.Condition(self._lock)
+
+                def poke(self):
+                    with self._cv:
+                        self._helper()
+
+                def kick(self):
+                    with self._lock:
+                        self._helper()
+
+                def _helper(self):
+                    pass
+            """,
+    })
+    held = {s.held for s in g.callers["m.py::Sched._helper"]}
+    # the condition variable canonicalises onto its underlying lock:
+    # both call sites hold the SAME qualified identity
+    assert held == {("m.Sched._lock",)}
+
+
+# ---------------------------------------------------------------------------
+# Cache surface: signatures and components
+# ---------------------------------------------------------------------------
+
+def test_ast_signature_ignores_comments_tracks_code(tmp_path):
+    import ast as astmod
+    s1 = ast_signature(astmod.parse("def f():\n    return 1\n"))
+    s2 = ast_signature(astmod.parse(
+        "def f():\n    # changed comment\n    return 1\n"))
+    s3 = ast_signature(astmod.parse("def f():\n    return 2\n"))
+    assert s1 == s2
+    assert s1 != s3
+
+
+def test_component_is_undirected_call_closure(tmp_path):
+    g = build(tmp_path, {
+        "a.py": """
+            from b import helper
+
+            def top():
+                helper()
+            """,
+        "b.py": """
+            def helper():
+                pass
+            """,
+        "c.py": """
+            def island():
+                pass
+            """,
+    })
+    assert g.component("a.py") == frozenset({"a.py", "b.py"})
+    assert g.component("b.py") == frozenset({"a.py", "b.py"})
+    assert g.component("c.py") == frozenset({"c.py"})

@@ -616,19 +616,28 @@ def test_sct013_init_writes_and_all_guarded_are_clean(tmp_path):
 
 
 def test_sct013_locked_by_caller_annotation_exempts_helper(tmp_path):
-    r = lint_src(tmp_path, _SCT013_HYBRID.replace(
+    """File-phase semantics: the annotation suppresses the bare-write
+    finding.  The program phase VERIFIES annotations (this one is on
+    a public method, hence unprovable) — covered separately below —
+    so the file phase is tested in isolation here."""
+    p = tmp_path / "snippet.py"
+    p.write_text(textwrap.dedent(_SCT013_HYBRID.replace(
         "def dec(self):",
         "def dec(self):\n"
-        "            # sctlint: locked-by-caller\n"),
-        only=["SCT013"])
+        "            # sctlint: locked-by-caller\n")))
+    r = run_lint([str(p)], root=str(tmp_path), only=["SCT013"],
+                 project_rules=False, program_rules=False)
     assert rule_ids(r) == []
 
 
 def test_sct013_annotation_in_nested_def_binds_innermost(tmp_path):
     """A locked-by-caller comment inside a NESTED def must not exempt
     the enclosing method — the annotation binds to the innermost
-    function containing its line."""
-    r = lint_src(tmp_path, """
+    function containing its line.  (File phase only: the program
+    phase would additionally flag the nested annotation as stale,
+    which the verifier tests below cover.)"""
+    p = tmp_path / "snippet.py"
+    p.write_text(textwrap.dedent("""
         import threading
 
         class Pool:
@@ -642,7 +651,9 @@ def test_sct013_annotation_in_nested_def_binds_innermost(tmp_path):
                     self._other = 1
                 helper()
                 self._running -= 1
-        """, only=["SCT013"])
+        """))
+    r = run_lint([str(p)], root=str(tmp_path), only=["SCT013"],
+                 project_rules=False, program_rules=False)
     assert rule_ids(r) == ["SCT013"]
     assert "_running" in r.violations[0].message
 
@@ -910,3 +921,420 @@ def test_sct010_swap_claim_clean_finally(tmp_path):
                 self.release_swap()
         """, only=["SCT010"])
     assert rule_ids(r) == []
+
+
+# ---------------------------------------------------------------------------
+# Whole-program phase: SCT014 / SCT015 / SCT016 and the SCT013 verifier
+# ---------------------------------------------------------------------------
+
+def lint_files(tmp_path, files, only=None, cache_dir=None, **kw):
+    """Multi-file variant of ``lint_src`` for program-scope rules —
+    call graphs only exist across files."""
+    paths = []
+    for name, src in sorted(files.items()):
+        p = tmp_path / name
+        p.write_text(textwrap.dedent(src))
+        paths.append(str(p))
+    return run_lint(paths, root=str(tmp_path), only=only,
+                    cache_dir=cache_dir, project_rules=False, **kw)
+
+
+_CG_LOCKS = """
+    import threading
+
+    DB_LOCK = threading.Lock()
+    IO_LOCK = threading.Lock()
+    """
+
+
+def test_sct014_cross_file_inversion_reports_both_witnesses(tmp_path):
+    r = lint_files(tmp_path, {
+        "locks.py": _CG_LOCKS,
+        "one.py": """
+            from locks import DB_LOCK, IO_LOCK
+
+            def forward():
+                with DB_LOCK:
+                    step()
+
+            def step():
+                with IO_LOCK:
+                    pass
+            """,
+        "two.py": """
+            from locks import DB_LOCK, IO_LOCK
+
+            def backward():
+                with IO_LOCK:
+                    other()
+
+            def other():
+                with DB_LOCK:
+                    pass
+            """,
+    }, only=["SCT014"])
+    assert rule_ids(r) == ["SCT014"]
+    msg = r.violations[0].message
+    assert "locks.DB_LOCK" in msg and "locks.IO_LOCK" in msg
+    # a deadlock report is only actionable with BOTH acquisition paths
+    assert "Witness 1" in msg and "Witness 2" in msg
+
+
+def test_sct014_consistent_order_is_clean(tmp_path):
+    r = lint_files(tmp_path, {
+        "locks.py": _CG_LOCKS,
+        "one.py": """
+            from locks import DB_LOCK, IO_LOCK
+
+            def forward():
+                with DB_LOCK:
+                    step()
+
+            def step():
+                with IO_LOCK:
+                    pass
+            """,
+        "two.py": """
+            from locks import DB_LOCK, IO_LOCK
+
+            def same_way():
+                with DB_LOCK:
+                    with IO_LOCK:
+                        pass
+            """,
+    }, only=["SCT014"])
+    assert rule_ids(r) == []
+
+
+def test_sct015_transitive_sleep_under_lock_depth_two(tmp_path):
+    r = lint_files(tmp_path, {
+        "svc.py": """
+            import threading
+            import time
+
+            class Svc:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def tick(self):
+                    with self._lock:
+                        self._level1()
+
+                def _level1(self):
+                    self._level2()
+
+                def _level2(self):
+                    time.sleep(0.1)
+            """,
+    }, only=["SCT015"])
+    assert rule_ids(r) == ["SCT015"]
+    msg = r.violations[0].message
+    # the finding names the op AND the call chain that reaches it
+    assert ".sleep()" in msg
+    assert "_level1" in msg and "_level2" in msg
+
+
+def test_sct015_sleep_outside_lock_is_clean(tmp_path):
+    r = lint_files(tmp_path, {
+        "svc.py": """
+            import threading
+            import time
+
+            class Svc:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def tick(self):
+                    with self._lock:
+                        n = self._count()
+                    self._level1()
+
+                def _count(self):
+                    return 0
+
+                def _level1(self):
+                    time.sleep(0.1)
+            """,
+    }, only=["SCT015"])
+    assert rule_ids(r) == []
+
+
+def test_sct015_io_under_lock_annotation_exempts_direct_ops(tmp_path):
+    r = lint_files(tmp_path, {
+        "svc.py": """
+            import threading
+
+            class Svc:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def publish(self, payload):
+                    with self._lock:
+                        self._write(payload)
+
+                def _write(self, payload):
+                    # sctlint: io-under-lock — the write must be
+                    # atomic with the state it serialises
+                    with open("state.json", "w") as f:
+                        f.write(payload)
+            """,
+    }, only=["SCT015"])
+    assert rule_ids(r) == []
+
+
+def test_sct015_cv_wait_on_held_condition_is_exempt(tmp_path):
+    r = lint_files(tmp_path, {
+        "svc.py": """
+            import threading
+
+            class Svc:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cv = threading.Condition(self._lock)
+
+                def drain(self):
+                    with self._cv:
+                        self._park()
+
+                def _park(self):
+                    self._cv.wait()
+            """,
+    }, only=["SCT015"])
+    assert rule_ids(r) == []
+
+
+_SCT016_BAD = """
+    class Factory:
+        def __init__(self):
+            self._owner_epoch = 0
+
+        def commit(self, ep, payload):
+            self._write(ep, payload)
+
+        def _write(self, ep, payload):
+            self._owner_epoch = ep
+    """
+
+
+def test_sct016_unfenced_epoch_write_across_call_boundary(tmp_path):
+    r = lint_files(tmp_path, {"factory.py": _SCT016_BAD},
+                   only=["SCT016"])
+    assert rule_ids(r) == ["SCT016"]
+    assert "_owner_epoch" in r.violations[0].message
+
+
+def test_sct016_caller_fence_guard_dominates_the_write(tmp_path):
+    r = lint_files(tmp_path, {
+        "factory.py": """
+            class FactoryFencedError(RuntimeError):
+                pass
+
+            class Factory:
+                def __init__(self):
+                    self._owner_epoch = 0
+
+                def commit(self, ep, payload):
+                    if ep < self._owner_epoch:
+                        raise FactoryFencedError(ep)
+                    self._write(ep, payload)
+
+                def _write(self, ep, payload):
+                    self._owner_epoch = ep
+            """,
+    }, only=["SCT016"])
+    assert rule_ids(r) == []
+
+
+def test_sct016_is_gated_to_epoch_fenced_modules(tmp_path):
+    # byte-identical code outside federation/serving/factory is NOT
+    # subject to the fence discipline
+    r = lint_files(tmp_path, {"other.py": _SCT016_BAD},
+                   only=["SCT016"])
+    assert rule_ids(r) == []
+
+
+def test_sct013_stale_annotation_is_flagged(tmp_path):
+    r = lint_files(tmp_path, {
+        "m.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def helper(self):
+                    # sctlint: locked-by-caller
+                    return self.n
+            """,
+    }, only=["SCT013"])
+    assert rule_ids(r) == ["SCT013"]
+    assert "stale" in r.violations[0].message
+
+
+def test_sct013_refuted_annotation_names_the_bad_call_site(tmp_path):
+    r = lint_files(tmp_path, {
+        "m.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self.n += 1
+
+                def _reset(self):
+                    # sctlint: locked-by-caller
+                    self.n = 0
+
+                def sweep(self):
+                    self._reset()
+            """,
+    }, only=["SCT013"])
+    assert rule_ids(r) == ["SCT013"]
+    msg = r.violations[0].message
+    assert "REFUTED" in msg and "sweep" in msg
+
+
+def test_sct013_public_annotation_is_unprovable(tmp_path):
+    r = lint_files(tmp_path, {
+        "m.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self.n += 1
+
+                def reset(self):
+                    # sctlint: locked-by-caller
+                    self.n = 0
+
+                def sweep(self):
+                    with self._lock:
+                        self.reset()
+            """,
+    }, only=["SCT013"])
+    assert rule_ids(r) == ["SCT013"]
+    assert "unprovable" in r.violations[0].message
+
+
+def test_sct013_proven_helper_discharges_file_finding(tmp_path):
+    # NO annotation at all: the file phase flags the bare write, the
+    # program phase proves every call site holds the lock and
+    # retracts the finding
+    r = lint_files(tmp_path, {
+        "m.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self.n += 1
+
+                def _reset(self):
+                    self.n = 0
+
+                def sweep(self):
+                    with self._lock:
+                        self._reset()
+            """,
+    }, only=["SCT013"])
+    assert rule_ids(r) == []
+    assert [v.rule for v in r.discharged] == ["SCT013"]
+
+
+def test_sct013_discharge_requires_the_program_phase(tmp_path):
+    src = {
+        "m.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self.n += 1
+
+                def _reset(self):
+                    self.n = 0
+
+                def sweep(self):
+                    with self._lock:
+                        self._reset()
+            """,
+    }
+    r = lint_files(tmp_path, src, only=["SCT013"],
+                   program_rules=False)
+    assert rule_ids(r) == ["SCT013"]
+    assert r.discharged == []
+
+
+# ---------------------------------------------------------------------------
+# Program cache: call-graph-aware invalidation
+# ---------------------------------------------------------------------------
+
+_CACHE_SVC = """
+    import threading
+
+    from helper import fetch
+
+    class Svc:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def tick(self):
+            with self._lock:
+                fetch()
+    """
+
+_CACHE_HELPER_CLEAN = """
+    def fetch():
+        return 1
+    """
+
+_CACHE_HELPER_SLEEPS = """
+    import time
+
+    def fetch():
+        time.sleep(0.5)
+    """
+
+
+def test_program_cache_replays_then_invalidates_callers(tmp_path):
+    files = {"svc.py": _CACHE_SVC, "helper.py": _CACHE_HELPER_CLEAN}
+    cache_dir = str(tmp_path / ".cache")
+    r1 = lint_files(tmp_path, files, only=["SCT015"],
+                    cache_dir=cache_dir)
+    assert rule_ids(r1) == []
+    assert sorted(r1.program_misses) == ["helper.py", "svc.py"]
+
+    # identical second run: full program-phase replay, no re-analysis
+    r2 = lint_files(tmp_path, files, only=["SCT015"],
+                    cache_dir=cache_dir)
+    assert r2.program_misses == []
+    assert r2.program_hits > 0
+    assert rule_ids(r2) == []
+
+    # edit ONLY the callee's body: the CALLER's cached verdict must
+    # be invalidated through the call-graph dependency edge, and the
+    # transitive finding must appear at the caller's lock region
+    files["helper.py"] = _CACHE_HELPER_SLEEPS
+    r3 = lint_files(tmp_path, files, only=["SCT015"],
+                    cache_dir=cache_dir)
+    assert "svc.py" in r3.program_misses
+    assert rule_ids(r3) == ["SCT015"]
+    assert r3.violations[0].path == "svc.py"

@@ -9,14 +9,25 @@
 #
 # Stages:
 #   1. sctlint        python -m tools.sctlint sctools_tpu --jobs 0
-#                     (the full registered rule set — per-line rules
-#                      SCT001-SCT009 plus the flow rules SCT010-SCT013
-#                      on the CFG layer, parity SCT000, repo-hygiene
-#                      SCT007; suppressions + baseline honoured, stale
-#                      baseline entries fail.  Incremental: findings
-#                      cached under .sctlint_cache/ keyed by file
-#                      digest + rule-set fingerprint, so unchanged
-#                      files cost a hash, not an analysis)
+#                     in WHOLE-PROGRAM mode (the full registered rule
+#                      set — per-line rules SCT001-SCT009, the flow
+#                      rules SCT010-SCT013 on the CFG layer, parity
+#                      SCT000, repo-hygiene SCT007, AND the program
+#                      phase: interprocedural call graph feeding
+#                      SCT014 lock-order cycles, SCT015 transitive
+#                      blocking-under-lock, SCT016 epoch-fence
+#                      discipline, plus the SCT013 annotation
+#                      verifier that discharges file findings the
+#                      graph proves safe.  Suppressions + baseline
+#                      honoured, stale baseline entries fail.
+#                      Incremental: per-file findings cached by file
+#                      digest + rule-set fingerprint; program-phase
+#                      verdicts cached with call-graph-aware deps so
+#                      editing a callee re-analyses its callers.
+#                      TIMING GUARD: the stage must finish in under
+#                      30s — the whole-program phase is designed to
+#                      stay summary-based, and a blowup here is a
+#                      regression in the analysis, not the code)
 #   2. tracked-bytecode guard (belt-and-braces duplicate of SCT007,
 #                     kept shell-side so the gate still catches it if
 #                     sctlint itself is broken)
@@ -137,9 +148,17 @@ FAST=0
 fail=0
 stage() { printf '\n== %s ==\n' "$1"; }
 
-stage "sctlint (static analysis, full registered rule set)"
+stage "sctlint (static analysis, whole-program: file + flow + call-graph rules)"
+SECONDS=0
 if ! JAX_PLATFORMS=cpu python -m tools.sctlint sctools_tpu --jobs 0; then
     fail=1
+fi
+if [ "$SECONDS" -ge 30 ]; then
+    echo "sctlint took ${SECONDS}s (budget <30s) — the whole-program" \
+         "phase must stay summary-based; profile before widening it"
+    fail=1
+else
+    echo "OK: sctlint finished in ${SECONDS}s (<30s budget)"
 fi
 
 stage "tracked bytecode guard"

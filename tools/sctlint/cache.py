@@ -36,7 +36,7 @@ import os
 import shutil
 
 #: bump to invalidate every cache on a schema change
-_SCHEMA = 1
+_SCHEMA = 2
 
 
 def ruleset_fingerprint(root: str, rule_ids) -> str:
@@ -130,6 +130,41 @@ class LintCache:
             os.replace(tmp, self._path(digest))
         except OSError:
             pass  # cache-off degrade: the findings were computed anyway
+
+    # -- program-phase entries (call-graph-aware invalidation) -----------
+    #
+    # A file's program-phase verdicts depend on OTHER files: a callee
+    # growing a time.sleep flips its callers' SCT015 verdicts, a
+    # caller dropping a fence flips its callee's SCT016 verdict.  So
+    # a program entry is addressed by PATH (not content digest) and
+    # carries, depfile-style, the file's own digest plus the summary
+    # signature of every file in its call-graph component; it is only
+    # valid when all of them still match.  The run replays program
+    # results only when EVERY file validates — a single stale file
+    # means the graph must be rebuilt anyway, and one whole-program
+    # pass refreshes every entry.
+
+    def _prog_path(self, path: str) -> str:
+        name = hashlib.sha256(path.encode()).hexdigest()[:32]
+        return os.path.join(self.dir, f"prog-{name}.json")
+
+    def get_program(self, path: str) -> dict | None:
+        try:
+            with open(self._prog_path(path), encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return doc if isinstance(doc, dict) else None
+
+    def put_program(self, path: str, entry: dict) -> None:
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            tmp = self._prog_path(path) + f".tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(entry, f)
+            os.replace(tmp, self._prog_path(path))
+        except OSError:
+            pass
 
 
 def analyze_one(abspath: str, root: str, rule_ids: list[str]):

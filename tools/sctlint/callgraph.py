@@ -1,0 +1,1041 @@
+"""Whole-program call graph over the linted module set.
+
+The flow rules (SCT010-SCT013) are one-function analyses that go
+blind at every call boundary; this module is the interprocedural
+layer the ``scope="program"`` rules stand on.  One pass over every
+parsed file builds:
+
+* a :class:`FuncNode` per function (any nesting) keyed
+  ``"path::qualname"``, carrying the per-function FACTS the program
+  rules consume — lock acquisitions with the locks held before them,
+  blocking/IO operations, epoch-attribute writes, fence-raising —
+  so a rule never re-walks an AST to learn what a callee does;
+* a :class:`CallSite` per syntactic call, with the QUALIFIED locks
+  lexically held at the site and the resolved callee keys.
+
+Resolution is deliberately name-and-type based, never executed:
+
+* bare-name calls resolve through enclosing nested defs, the
+  module's own functions/classes, and imports (absolute and
+  relative) into other linted modules;
+* method calls resolve through the receiver's inferred class —
+  ``self``/``cls``/``super()``, parameter annotations, locals bound
+  by ``x = ClassName(...)`` / ``x = self.field``, and field types
+  inferred from ``self.f = ClassName(...)`` assignments — walking
+  the in-program MRO;
+* registry indirection is modelled explicitly: ``@register("op", …)``
+  impls populate an op table, a call to ``registry.apply`` fans out
+  to the impls for its (constant) op name — or every impl when the
+  name is dynamic — plus every wrapper ever installed via
+  ``push_call_wrapper``/``call_wrapper`` (``registry.get`` is a
+  lookup, not an invocation: the later call through the fetched
+  value is an explicit may-call);
+* everything else is an EXPLICIT may-call: the site is kept, marked
+  ``unresolved``, and counted — rules choose their own policy for it
+  (and must document that choice) instead of silently treating
+  unknown as absent.
+
+Lock identities are qualified so the same lock names the same node
+across files: ``self._lock`` becomes ``pkg.mod.Class._lock`` (with
+``self._cv = threading.Condition(self._lock)`` canonicalised onto
+the underlying lock), a module-level lock becomes ``pkg.mod.LOCK``,
+and a function-local/parameter lock is scoped to its qualname.
+
+Same contract as the rest of sctlint: a heuristic over ASTs — a
+resolution miss loses an edge (recorded as may-call), never crashes
+the lint.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import dataclasses
+import hashlib
+import re
+from typing import Iterable
+
+from .flow import (FileFlows, FunctionInfo, file_flows, is_journal_write,
+                   is_lockish, lockish_items, walk_in_scope)
+from .jaxutil import iter_registered_impls
+
+_BUILTINS = frozenset(dir(builtins))
+
+
+def ast_signature(tree: ast.AST) -> str:
+    """Semantic signature of a parse tree: code changes flip it,
+    comment/whitespace edits do not.  The program cache keys a
+    file's results on the signatures of every file its verdicts
+    depend on (see :meth:`CallGraph.component`)."""
+    return hashlib.sha256(ast.dump(tree).encode()).hexdigest()[:16]
+
+#: attribute names that count as epoch-fenced state (SCT016's write
+#: set): ``epoch``, ``_epoch``, ``_seen_epoch``, ``_owner_epoch``...
+EPOCH_ATTR_RE = re.compile(r"(^|_)epochs?$")
+
+#: exception names that count as fence guards when raised
+FENCE_NAME_RE = re.compile(r"fence", re.IGNORECASE)
+
+_INIT_METHODS = frozenset({"__init__", "__post_init__", "__new__",
+                           "__init_subclass__"})
+
+#: decorators that do NOT capture the function into unknown call
+#: paths — anything else makes the function "escape" (its call sites
+#: are no longer enumerable from the graph)
+_BENIGN_DECORATORS = frozenset({
+    "property", "staticmethod", "classmethod", "cached_property",
+    "abstractmethod", "contextmanager", "override", "overload",
+    "wraps", "register", "setter", "getter", "deleter",
+})
+
+
+def _dec_tail(dec: ast.AST) -> str | None:
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    if isinstance(dec, ast.Attribute):
+        return dec.attr
+    if isinstance(dec, ast.Name):
+        return dec.id
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Facts
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Acquisition:
+    """One ``with <lock>:`` entry: the qualified lock and the
+    qualified locks already held when it is taken."""
+
+    lock: str
+    held: tuple
+    lineno: int
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockOp:
+    """One direct blocking/IO operation inside a function (mechanism
+    only — policy such as the journal in-lock allowlist or the
+    cv-wait exemption lives in the rules that consume these)."""
+
+    kind: str           # "blocking" | "io" | "subprocess" | "snapshot"
+                        # | "journal"
+    detail: str         # human-readable op ("time.sleep()", ...)
+    lineno: int
+    event: str | None = None    # journal event literal, if constant
+    cv_lock: str | None = None  # qualified lock when the op is a
+                                # .wait()/.sleep on a lock-like
+                                # receiver (the cv-wait exemption key)
+
+
+@dataclasses.dataclass
+class CallSite:
+    caller: str         # FuncNode key
+    lineno: int
+    col: int
+    text: str           # callee expression source
+    held: tuple         # qualified locks lexically held at the site
+    callees: tuple      # resolved FuncNode keys ("" when none)
+    kind: str           # "direct" | "registry" | "external"
+                        # | "builtin" | "unresolved"
+    call: ast.Call = dataclasses.field(repr=False, default=None)
+
+    @property
+    def unresolved(self) -> bool:
+        return self.kind == "unresolved"
+
+
+@dataclasses.dataclass
+class FuncNode:
+    key: str
+    path: str
+    module: str
+    qualname: str
+    info: FunctionInfo = dataclasses.field(repr=False)
+    owner: str | None           # owning class name, if a method
+    is_init: bool               # __init__-like (runs pre-sharing)
+    escapes: bool = False       # referenced as a value somewhere —
+                                # its call sites are not enumerable
+    raises_fence: bool = False  # raises a *Fence* exception
+    acquisitions: list = dataclasses.field(default_factory=list)
+    blocking: list = dataclasses.field(default_factory=list)
+    epoch_writes: list = dataclasses.field(default_factory=list)
+    sites: list = dataclasses.field(default_factory=list)
+
+    @property
+    def fn(self):
+        return self.info.fn
+
+    @property
+    def name(self) -> str:
+        return self.info.fn.name
+
+    @property
+    def private(self) -> bool:
+        n = self.name
+        return n.startswith("_") and not n.startswith("__")
+
+    @property
+    def display(self) -> str:
+        return f"{self.module}.{self.qualname}"
+
+
+@dataclasses.dataclass(frozen=True)
+class EpochWrite:
+    lineno: int
+    attr: str
+    target: str  # source text of the written attribute
+
+
+# ---------------------------------------------------------------------------
+# Per-file environment (imports, classes, module locks)
+# ---------------------------------------------------------------------------
+
+def module_name_of(path: str) -> str:
+    p = path[:-3] if path.endswith(".py") else path
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.strip("/").replace("/", ".")
+
+
+class _ClassInfo:
+    def __init__(self, env: "_FileEnv", node: ast.ClassDef,
+                 qualname: str):
+        self.env = env
+        self.node = node
+        self.name = node.name
+        self.qualname = qualname
+        self.methods: dict[str, str] = {}      # name -> FuncNode key
+        self.fields_raw: dict[str, ast.AST] = {}   # attr -> ctor expr
+        self.cond_alias: dict[str, str] = {}   # cv attr -> lock attr
+        self._bases: list | None = None        # resolved lazily
+        self._field_types: dict[str, "_ClassInfo | None"] = {}
+
+    @property
+    def lock_prefix(self) -> str:
+        return f"{self.env.module}.{self.name}"
+
+    def bases(self, graph: "CallGraph") -> list:
+        if self._bases is None:
+            self._bases = []
+            for b in self.node.bases:
+                ci = self.env.resolve_class_expr(b, graph)
+                if ci is not None:
+                    self._bases.append(ci)
+        return self._bases
+
+    def mro(self, graph: "CallGraph") -> list:
+        out, seen, stack = [], set(), [self]
+        while stack:
+            ci = stack.pop(0)
+            if id(ci) in seen:
+                continue
+            seen.add(id(ci))
+            out.append(ci)
+            stack = ci.bases(graph) + stack
+        return out
+
+    def lookup(self, attr: str, graph: "CallGraph") -> str | None:
+        for ci in self.mro(graph):
+            key = ci.methods.get(attr)
+            if key is not None:
+                return key
+        return None
+
+    def canon_lock_attr(self, attr: str) -> str:
+        seen = set()
+        while attr in self.cond_alias and attr not in seen:
+            seen.add(attr)
+            attr = self.cond_alias[attr]
+        return attr
+
+    def field_type(self, attr: str,
+                   graph: "CallGraph") -> "_ClassInfo | None":
+        if attr not in self._field_types:
+            self._field_types[attr] = None  # cycle guard
+            for ci in self.mro(graph):
+                expr = ci.fields_raw.get(attr)
+                if expr is not None:
+                    self._field_types[attr] = \
+                        ci.env.resolve_class_expr(expr, graph)
+                    break
+        return self._field_types[attr]
+
+
+class _FileEnv:
+    """One module's name-resolution environment."""
+
+    def __init__(self, ctx, flows: FileFlows):
+        self.ctx = ctx
+        self.flows = flows
+        self.path = ctx.path
+        self.module = module_name_of(ctx.path)
+        self.package = (self.module if ctx.path.endswith("__init__.py")
+                        else self.module.rpartition(".")[0])
+        self.imports: dict[str, str] = {}
+        self.funcs: dict[str, str] = {}        # top-level defs
+        self.classes: dict[str, _ClassInfo] = {}
+        self.class_by_node: dict[int, _ClassInfo] = {}
+        self.module_locks: dict[str, str] = {} # name -> qualified id
+        self.module_names: set[str] = set()    # every top-level bind
+        self._collect_imports(ctx.tree)
+        self._collect_defs()
+
+    # -- collection ------------------------------------------------------
+    def _collect_imports(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.imports[a.asname] = a.name
+                    else:
+                        self.imports[a.name.split(".")[0]] = \
+                            a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom):
+                if node.level == 0:
+                    base = node.module or ""
+                else:
+                    parts = self.package.split(".") if self.package \
+                        else []
+                    parts = parts[: len(parts) - (node.level - 1)] \
+                        if node.level > 1 else parts
+                    base = ".".join(parts)
+                    if node.module:
+                        base = f"{base}.{node.module}" if base \
+                            else node.module
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    target = f"{base}.{a.name}" if base else a.name
+                    self.imports[a.asname or a.name] = target
+
+    def _collect_defs(self) -> None:
+        for info in self.flows.functions:
+            if "." not in info.qualname and info.owner_class is None:
+                self.funcs[info.fn.name] = \
+                    f"{self.path}::{info.qualname}"
+        self._collect_classes(self.ctx.tree, "")
+        for stmt in self.ctx.tree.body:
+            for t in getattr(stmt, "targets",
+                             [getattr(stmt, "target", None)]):
+                if isinstance(t, ast.Name):
+                    self.module_names.add(t.id)
+                    if isinstance(getattr(stmt, "value", None),
+                                  ast.Call):
+                        tail = _dec_tail(stmt.value)
+                        if tail in ("Lock", "RLock", "Condition",
+                                    "Semaphore", "BoundedSemaphore") \
+                                or is_lockish(t):
+                            self.module_locks[t.id] = \
+                                f"{self.module}.{t.id}"
+                            # CV = threading.Condition(LOCK)
+                            if tail == "Condition" and stmt.value.args \
+                                    and isinstance(stmt.value.args[0],
+                                                   ast.Name):
+                                self.module_locks[t.id] = (
+                                    f"{self.module}."
+                                    f"{stmt.value.args[0].id}")
+
+    def _collect_classes(self, node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                qual = f"{prefix}{child.name}"
+                ci = _ClassInfo(self, child, qual)
+                self.classes[child.name] = ci
+                self.class_by_node[id(child)] = ci
+                self._collect_classes(child, qual + ".")
+        if isinstance(node, ast.Module):
+            # bind methods and scan field assignments once classes
+            # exist
+            for info in self.flows.functions:
+                oc = info.owner_class
+                if oc is None:
+                    continue
+                ci = self.class_by_node.get(id(oc))
+                if ci is None:
+                    continue
+                # direct methods only: "Class.method"
+                if info.qualname == f"{ci.qualname}.{info.fn.name}":
+                    ci.methods[info.fn.name] = \
+                        f"{self.path}::{info.qualname}"
+            for ci in self.class_by_node.values():
+                self._scan_fields(ci)
+
+    def _scan_fields(self, ci: _ClassInfo) -> None:
+        infos = [i for i in self.flows.functions
+                 if i.owner_class is ci.node]
+        infos.sort(key=lambda i: i.fn.name not in _INIT_METHODS)
+        for info in infos:
+            for stmt in ast.walk(info.fn):
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                for t in stmt.targets:
+                    if not (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id in ("self", "cls")):
+                        continue
+                    v = stmt.value
+                    if isinstance(v, ast.Call):
+                        tail = _dec_tail(v)
+                        if tail == "Condition" and v.args \
+                                and isinstance(v.args[0],
+                                               ast.Attribute) \
+                                and isinstance(v.args[0].value,
+                                               ast.Name) \
+                                and v.args[0].value.id == "self":
+                            ci.cond_alias[t.attr] = v.args[0].attr
+                        ci.fields_raw.setdefault(t.attr, v.func)
+
+    # -- resolution ------------------------------------------------------
+    def dotted(self, node: ast.AST) -> str | None:
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        parts.reverse()
+        head = self.imports.get(parts[0], parts[0])
+        return ".".join([head] + parts[1:])
+
+    def resolve_class_expr(self, expr: ast.AST,
+                           graph: "CallGraph") -> _ClassInfo | None:
+        """Resolve an expression naming a class (a base, a ctor
+        callee, an annotation) to its in-program _ClassInfo."""
+        if isinstance(expr, ast.Constant) and isinstance(expr.value,
+                                                         str):
+            try:
+                expr = ast.parse(expr.value, mode="eval").body
+            except SyntaxError:
+                return None
+        if isinstance(expr, ast.Name):
+            ci = self.classes.get(expr.id)
+            if ci is not None:
+                return ci
+            tgt = self.imports.get(expr.id)
+            if tgt is not None:
+                return graph.class_at(tgt)
+            return None
+        if isinstance(expr, ast.Attribute):
+            dn = self.dotted(expr)
+            return graph.class_at(dn) if dn else None
+        return None
+
+
+# ---------------------------------------------------------------------------
+# The graph
+# ---------------------------------------------------------------------------
+
+class CallGraph:
+    def __init__(self):
+        self.functions: dict[str, FuncNode] = {}
+        self.callers: dict[str, list[CallSite]] = {}
+        self.by_path: dict[str, list[str]] = {}
+        self.registered: dict[str, list[str]] = {}  # op -> impl keys
+        self.wrappers: list[str] = []
+        self.may_call_sites: list[CallSite] = []
+        self.envs: dict[str, _FileEnv] = {}
+        self._sigs: dict[str, str] = {}
+        self._components: dict[str, frozenset] | None = None
+
+    # -- lookups ---------------------------------------------------------
+    def class_at(self, dotted: str) -> _ClassInfo | None:
+        mod, _, attr = dotted.rpartition(".")
+        env = self._env_for_module(mod)
+        return env.classes.get(attr) if env else None
+
+    def func_at(self, dotted: str) -> str | None:
+        mod, _, attr = dotted.rpartition(".")
+        env = self._env_for_module(mod)
+        if env is not None and attr in env.funcs:
+            return env.funcs[attr]
+        # Class.method spelled module.Class.method
+        if env is None and "." in mod:
+            m2, _, cls = mod.rpartition(".")
+            env = self._env_for_module(m2)
+            if env is not None:
+                ci = env.classes.get(cls)
+                if ci is not None:
+                    return ci.lookup(attr, self)
+        return None
+
+    def _env_for_module(self, module: str) -> _FileEnv | None:
+        return self._by_module.get(module)
+
+    def node_at(self, path: str, lineno: int) -> FuncNode | None:
+        """The innermost function containing a source line."""
+        best = None
+        for key in self.by_path.get(path, ()):
+            fnode = self.functions[key]
+            fn = fnode.fn
+            end = getattr(fn, "end_lineno", fn.lineno)
+            if fn.lineno <= lineno <= end and (
+                    best is None or fn.lineno > best.fn.lineno):
+                best = fnode
+        return best
+
+    def qualify_in(self, key: str, lock_text: str) -> str:
+        """Qualify a lock's source text (e.g. ``self._cv``) in the
+        naming environment of function ``key``."""
+        fnode = self.functions[key]
+        env = self.envs[fnode.path]
+        try:
+            expr = ast.parse(lock_text, mode="eval").body
+        except SyntaxError:
+            return f"{fnode.module}.{lock_text}"
+        return _qualify_lock(expr, env, fnode, self)
+
+    # -- cache support ---------------------------------------------------
+    def summary_signature(self, path: str) -> str:
+        """Semantic signature of one file: the hash of its AST dump —
+        code changes flip it, comment/whitespace edits do not.  This
+        is what a dependent file's program-cache key incorporates."""
+        return self._sigs[path]
+
+    def component(self, path: str) -> frozenset:
+        """Every file connected to ``path`` through call edges, in
+        EITHER direction (a caller's fencing decides a callee's
+        SCT016 verdict just as a callee's blocking decides a caller's
+        SCT015 verdict), including ``path`` itself."""
+        if self._components is None:
+            adj: dict[str, set] = {p: set() for p in self.by_path}
+            for fnode in self.functions.values():
+                for site in fnode.sites:
+                    for ck in site.callees:
+                        cp = self.functions[ck].path
+                        if cp != fnode.path:
+                            adj.setdefault(fnode.path, set()).add(cp)
+                            adj.setdefault(cp, set()).add(fnode.path)
+            comps: dict[str, frozenset] = {}
+            for start in adj:
+                if start in comps:
+                    continue
+                seen, stack = {start}, [start]
+                while stack:
+                    for nb in adj.get(stack.pop(), ()):
+                        if nb not in seen:
+                            seen.add(nb)
+                            stack.append(nb)
+                fs = frozenset(seen)
+                for p in fs:
+                    comps[p] = fs
+            self._components = comps
+        return self._components.get(path, frozenset({path}))
+
+
+# ---------------------------------------------------------------------------
+# Lock qualification
+# ---------------------------------------------------------------------------
+
+def _qualify_lock(expr: ast.AST, env: _FileEnv, fnode: FuncNode,
+                  graph: CallGraph) -> str:
+    if isinstance(expr, ast.Name):
+        q = env.module_locks.get(expr.id)
+        if q is not None:
+            return q
+        # an IMPORTED lock keeps its source-module identity (with the
+        # source's Condition aliasing applied) — `from locks import
+        # DB_LOCK` in two files must name the same node
+        tgt = env.imports.get(expr.id)
+        if tgt is not None:
+            mod, _, name = tgt.rpartition(".")
+            src = graph._by_module.get(mod)
+            if src is not None:
+                sq = src.module_locks.get(name)
+                if sq is not None:
+                    return sq
+            return tgt
+        if expr.id in env.module_names:
+            return f"{env.module}.{expr.id}"
+        return f"{env.module}.{fnode.qualname}.{expr.id}"
+    if isinstance(expr, ast.Attribute):
+        recv = expr.value
+        if isinstance(recv, ast.Name) and recv.id in ("self", "cls"):
+            ci = env.class_by_node.get(id(
+                fnode.info.owner_class)) if fnode.info.owner_class \
+                else None
+            if ci is not None:
+                return f"{ci.lock_prefix}." \
+                       f"{ci.canon_lock_attr(expr.attr)}"
+        ci = _infer_type(recv, env, fnode, graph, {})
+        if ci is not None:
+            return f"{ci.lock_prefix}.{ci.canon_lock_attr(expr.attr)}"
+        dn = env.dotted(expr)
+        if dn is not None:
+            return dn
+    try:
+        return f"{env.module}.{ast.unparse(expr)}"
+    except Exception:
+        return f"{env.module}.<lock>"
+
+
+def _infer_type(expr: ast.AST, env: _FileEnv, fnode: FuncNode,
+                graph: CallGraph, locals_: dict) -> _ClassInfo | None:
+    """Instance type of an expression, best-effort."""
+    if isinstance(expr, ast.Name):
+        if expr.id in ("self", "cls") and fnode.info.owner_class \
+                is not None:
+            return env.class_by_node.get(id(fnode.info.owner_class))
+        if expr.id in locals_:
+            return locals_[expr.id]
+        ann = _param_annotation(fnode.fn, expr.id)
+        if ann is not None:
+            return env.resolve_class_expr(ann, graph)
+        return None
+    if isinstance(expr, ast.Attribute):
+        base = _infer_type(expr.value, env, fnode, graph, locals_)
+        if base is not None:
+            return base.field_type(expr.attr, graph)
+        return None
+    if isinstance(expr, ast.Call):
+        # super() -> first base of the owner
+        if isinstance(expr.func, ast.Name) and expr.func.id == "super":
+            owner = env.class_by_node.get(id(
+                fnode.info.owner_class)) if fnode.info.owner_class \
+                else None
+            if owner is not None:
+                bases = owner.bases(graph)
+                return bases[0] if bases else None
+        return env.resolve_class_expr(expr.func, graph)
+    return None
+
+
+def _param_annotation(fn, name: str) -> ast.AST | None:
+    for a in (fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs):
+        if a.arg == name:
+            return a.annotation
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Blocking-op classification (mechanism; policy lives in the rules)
+# ---------------------------------------------------------------------------
+
+def _block_of(call: ast.Call, env: _FileEnv, fnode: FuncNode,
+              graph: CallGraph) -> BlockOp | None:
+    # single source of truth for the op sets: SCT011's
+    from .rules.lockscope import (_BLOCKING_TAILS, _IO_DOTTED,
+                                  _IO_TAILS, _SNAPSHOT_TAILS)
+
+    ln = call.lineno
+    if is_journal_write(call):
+        arg = call.args[0] if call.args else None
+        event = arg.value if isinstance(arg, ast.Constant) \
+            and isinstance(arg.value, str) else None
+        return BlockOp("journal", "journal.write()", ln, event=event)
+    f = call.func
+    tail = f.attr if isinstance(f, ast.Attribute) else \
+        f.id if isinstance(f, ast.Name) else None
+    recv = f.value if isinstance(f, ast.Attribute) else None
+    if tail in _SNAPSHOT_TAILS:
+        if isinstance(recv, ast.Call) \
+                and isinstance(recv.func, ast.Name) \
+                and recv.func.id == "super":
+            return None
+        return BlockOp("snapshot", f".{tail}()", ln)
+    if tail in _BLOCKING_TAILS:
+        dn = env.dotted(f)
+        if tail == "join" and (
+                (dn and dn.startswith(("os.path", "os.pathsep",
+                                       "os.sep")))
+                or isinstance(recv, ast.Constant)):
+            return None
+        cv = None
+        if recv is not None and is_lockish(recv):
+            cv = _qualify_lock(recv, env, fnode, graph)
+        return BlockOp("blocking", f".{tail}()", ln, cv_lock=cv)
+    if isinstance(f, ast.Name) and f.id == "open":
+        return BlockOp("io", "open()", ln)
+    if tail in _IO_TAILS:
+        return BlockOp("io", f".{tail}()", ln)
+    dn = env.dotted(f)
+    if dn is not None:
+        if dn in _IO_DOTTED:
+            return BlockOp("io", f"{dn}()", ln)
+        if dn.startswith("subprocess."):
+            return BlockOp("subprocess", f"{dn}()", ln)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Build
+# ---------------------------------------------------------------------------
+
+def _hdr_exprs(stmt: ast.stmt):
+    """Expressions evaluated AT a statement (child bodies are walked
+    as their own regions — same shape as SCT011's region walk)."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        yield stmt.test
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        yield stmt.iter
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            yield item.context_expr
+    elif isinstance(stmt, ast.Match):
+        yield stmt.subject
+    elif isinstance(stmt, (ast.Try, ast.FunctionDef,
+                           ast.AsyncFunctionDef, ast.ClassDef)):
+        return
+    else:
+        yield stmt
+
+
+_SCOPE_STMTS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+
+
+class _Builder:
+    def __init__(self, contexts):
+        self.graph = CallGraph()
+        self.contexts = list(contexts)
+
+    def build(self) -> CallGraph:
+        g = self.graph
+        envs = []
+        for ctx in self.contexts:
+            flows = file_flows(ctx)
+            env = _FileEnv(ctx, flows)
+            envs.append(env)
+            g.envs[ctx.path] = env
+            g._sigs[ctx.path] = ast_signature(ctx.tree)
+        g._by_module = {e.module: e for e in envs}
+        # pass 1: nodes + registry table (needs every module indexed
+        # before any call resolves)
+        for env in envs:
+            keys = []
+            for info in env.flows.functions:
+                key = f"{env.path}::{info.qualname}"
+                fnode = FuncNode(
+                    key=key, path=env.path, module=env.module,
+                    qualname=info.qualname, info=info,
+                    owner=(info.owner_class.name
+                           if info.owner_class is not None else None),
+                    is_init=info.fn.name in _INIT_METHODS)
+                g.functions[key] = fnode
+                keys.append(key)
+            g.by_path[env.path] = keys
+            by_fn_id = {id(i.fn): f"{env.path}::{i.qualname}"
+                        for i in env.flows.functions}
+            aliases = {k: v for k, v in env.imports.items()}
+            for impl in iter_registered_impls(env.ctx.tree, aliases):
+                key = by_fn_id.get(id(impl.fn))
+                if key is not None and impl.name is not None:
+                    g.registered.setdefault(impl.name, []).append(key)
+        # pass 1.5: wrapper installs — every registry-dispatch site
+        # fans out to every installed wrapper, so the wrapper table
+        # must be complete before any site resolves
+        for env in envs:
+            for key in g.by_path[env.path]:
+                fnode = g.functions[key]
+                nested = self._nested_index(env, fnode)
+                for n in ast.walk(fnode.fn):
+                    if isinstance(n, ast.Call):
+                        self._wrapper_install(env, fnode, n, {},
+                                              nested)
+        # pass 2: per-function facts + call sites
+        for env in envs:
+            for key in g.by_path[env.path]:
+                self._analyze(env, g.functions[key])
+            self._module_level_escapes(env)
+        for fnode in g.functions.values():
+            for site in fnode.sites:
+                for ck in site.callees:
+                    g.callers.setdefault(ck, []).append(site)
+                if site.unresolved:
+                    g.may_call_sites.append(site)
+        return g
+
+    # -- per-function ----------------------------------------------------
+    def _analyze(self, env: _FileEnv, fnode: FuncNode) -> None:
+        g = self.graph
+        fn = fnode.fn
+        for dec in fn.decorator_list:
+            if _dec_tail(dec) not in _BENIGN_DECORATORS:
+                fnode.escapes = True
+        locals_: dict[str, _ClassInfo] = {}
+        nested = self._nested_index(env, fnode)
+
+        def resolve_call(call: ast.Call):
+            return self._resolve_call(env, fnode, call, locals_,
+                                      nested)
+
+        def handle_expr(root: ast.AST, held: tuple) -> None:
+            func_node_ids = set()
+            for n in walk_in_scope(root):
+                if isinstance(n, ast.Call):
+                    for sub in ast.walk(n.func):
+                        func_node_ids.add(id(sub))
+            for n in walk_in_scope(root):
+                if isinstance(n, ast.Call):
+                    kind, callees = resolve_call(n)
+                    try:
+                        text = ast.unparse(n.func)
+                    except Exception:
+                        text = "<call>"
+                    site = CallSite(
+                        caller=fnode.key, lineno=n.lineno,
+                        col=n.col_offset, text=text, held=held,
+                        callees=tuple(callees), kind=kind, call=n)
+                    fnode.sites.append(site)
+                    op = _block_of(n, env, fnode, g)
+                    if op is not None:
+                        fnode.blocking.append(op)
+                    self._wrapper_install(env, fnode, n, locals_)
+                elif isinstance(n, (ast.Name, ast.Attribute)) \
+                        and id(n) not in func_node_ids \
+                        and not isinstance(getattr(n, "ctx", None),
+                                           (ast.Store, ast.Del)):
+                    tgt = self._resolve_value(env, fnode, n, locals_,
+                                              nested)
+                    if tgt is not None:
+                        g.functions[tgt].escapes = True
+
+        def track_local(stmt: ast.stmt) -> None:
+            if isinstance(stmt, ast.Assign) \
+                    and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                t = _infer_type(stmt.value, env, fnode, self.graph,
+                                locals_)
+                if t is not None:
+                    locals_[stmt.targets[0].id] = t
+            if isinstance(stmt, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)):
+                targets = stmt.targets if isinstance(
+                    stmt, ast.Assign) else [stmt.target]
+                for t in targets:
+                    for sub in ast.walk(t):
+                        if isinstance(sub, ast.Attribute) \
+                                and EPOCH_ATTR_RE.search(sub.attr):
+                            try:
+                                txt = ast.unparse(sub)
+                            except Exception:
+                                txt = sub.attr
+                            fnode.epoch_writes.append(EpochWrite(
+                                stmt.lineno, sub.attr, txt))
+
+        def rec(body, held: tuple) -> None:
+            for stmt in body:
+                if isinstance(stmt, _SCOPE_STMTS) \
+                        or isinstance(stmt, ast.Lambda):
+                    # nested defs analyzed as their own FuncNodes;
+                    # decorators/defaults evaluated here
+                    if isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        for d in stmt.decorator_list:
+                            handle_expr(d, held)
+                    continue
+                if isinstance(stmt, ast.Raise):
+                    exc = stmt.exc
+                    nm = _dec_tail(exc) if exc is not None else None
+                    if nm and FENCE_NAME_RE.search(nm):
+                        fnode.raises_fence = True
+                track_local(stmt)
+                for root in _hdr_exprs(stmt):
+                    handle_expr(root, held)
+                inner = held
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    for text, expr in lockish_items(stmt):
+                        q = _qualify_lock(expr, env, fnode,
+                                          self.graph)
+                        fnode.acquisitions.append(
+                            Acquisition(q, inner, stmt.lineno))
+                        inner = inner + (q,)
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, field, None)
+                    if sub:
+                        rec(sub, inner)
+                for h in getattr(stmt, "handlers", ()):
+                    rec(h.body, inner)
+                for case in getattr(stmt, "cases", ()):
+                    rec(case.body, inner)
+
+        rec(fn.body, ())
+
+    def _nested_index(self, env: _FileEnv,
+                      fnode: FuncNode) -> dict[str, str]:
+        """Defs visible from inside this function through enclosing
+        function scopes (innermost wins)."""
+        out: dict[str, str] = {}
+        parts = fnode.qualname.split(".")
+        prefixes = [".".join(parts[:i]) for i in
+                    range(len(parts), 0, -1)]
+        # only FUNCTION ancestors provide visible names — a class
+        # scope does not (methods are not bare names to each other)
+        prefixes = [p for p in prefixes
+                    if f"{env.path}::{p}" in self.graph.functions]
+        for key in self.graph.by_path.get(env.path, ()):
+            other = self.graph.functions[key]
+            head, _, name = other.qualname.rpartition(".")
+            for pref in reversed(prefixes):
+                if head == pref and name not in out:
+                    out[name] = key
+        return out
+
+    # -- call/value resolution -------------------------------------------
+    def _resolve_call(self, env, fnode, call, locals_, nested):
+        g = self.graph
+        f = call.func
+        if isinstance(f, ast.Name):
+            nm = f.id
+            if nm in nested:  # enclosing defs shadow module names
+                return "direct", [nested[nm]]
+            if nm in env.funcs:
+                return self._maybe_registry(env, call,
+                                            env.funcs[nm])
+            if nm in env.classes:
+                return self._ctor(env.classes[nm])
+            tgt = env.imports.get(nm)
+            if tgt is not None:
+                return self._resolve_dotted_target(env, call, tgt)
+            if isinstance(fnode.fn, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                params = {a.arg for a in (
+                    fnode.fn.args.posonlyargs + fnode.fn.args.args
+                    + fnode.fn.args.kwonlyargs)}
+                if nm in params:
+                    return "unresolved", []
+            if nm in _BUILTINS and nm not in env.module_names:
+                return "builtin", []
+            return "unresolved", []
+        if isinstance(f, ast.Attribute):
+            recv = f.value
+            # receiver-typed method call
+            ci = _infer_type(recv, env, fnode, g, locals_)
+            if ci is not None:
+                key = ci.lookup(f.attr, g)
+                if key is not None:
+                    return self._maybe_registry(env, call, key)
+                return "unresolved", []
+            # class-object method: ClassName.method(obj, ...)
+            if isinstance(recv, ast.Name):
+                cio = env.classes.get(recv.id)
+                if cio is not None:
+                    key = cio.lookup(f.attr, g)
+                    return ("direct", [key]) if key else \
+                        ("unresolved", [])
+            dn = env.dotted(f)
+            if dn is not None:
+                mod = dn.rpartition(".")[0]
+                if self._in_program(mod):
+                    return self._resolve_dotted_target(env, call, dn)
+                head = dn.split(".")[0]
+                if head in env.imports:
+                    # rooted at an import that is not a program
+                    # module (os.replace, json.dump, ...)
+                    return "external", []
+            # method on a literal receiver: a str/list/dict builtin
+            if isinstance(recv, (ast.Constant, ast.JoinedStr,
+                                 ast.List, ast.Dict, ast.Set,
+                                 ast.Tuple)):
+                return "external", []
+            return "unresolved", []
+        return "unresolved", []
+
+    def _in_program(self, dotted: str) -> bool:
+        g = self.graph
+        while dotted:
+            if dotted in g._by_module:
+                return True
+            dotted = dotted.rpartition(".")[0]
+        return False
+
+    def _resolve_dotted_target(self, env, call, dotted):
+        g = self.graph
+        key = g.func_at(dotted)
+        if key is not None:
+            return self._maybe_registry(env, call, key)
+        ci = g.class_at(dotted)
+        if ci is not None:
+            return self._ctor(ci)
+        if self._in_program(dotted.rpartition(".")[0]) or \
+                self._in_program(dotted):
+            return "unresolved", []
+        return "external", []
+
+    def _ctor(self, ci: _ClassInfo):
+        key = ci.lookup("__init__", self.graph)
+        return ("direct", [key]) if key is not None else \
+            ("external", [])
+
+    def _maybe_registry(self, env, call, key):
+        """A resolved program function; if it is the registry's
+        dispatch surface, fan out to impls + installed wrappers.
+        Only ``apply`` INVOKES the impl — ``get`` merely fetches it
+        as a value (the later ``fn(...)`` through a variable/field is
+        an explicit may-call), so fanning ``get`` out as call edges
+        would charge the lookup site with every impl's behaviour."""
+        g = self.graph
+        fnode = g.functions[key]
+        if fnode.module.endswith("registry") \
+                and fnode.qualname == "apply":
+            arg = call.args[0] if call.args else None
+            if isinstance(arg, ast.Constant) and isinstance(arg.value,
+                                                            str):
+                impls = list(g.registered.get(arg.value, ()))
+            else:
+                impls = [k for ks in g.registered.values()
+                         for k in ks]
+            return "registry", [key] + impls + list(g.wrappers)
+        return "direct", [key]
+
+    def _wrapper_install(self, env, fnode, call, locals_,
+                         nested=None) -> None:
+        """``push_call_wrapper(w)`` / ``call_wrapper(w)``: record the
+        wrapper function — it becomes a callee of every registry
+        dispatch site."""
+        f = call.func
+        tail = f.attr if isinstance(f, ast.Attribute) else \
+            f.id if isinstance(f, ast.Name) else None
+        if tail not in ("push_call_wrapper", "call_wrapper"):
+            return
+        if not call.args:
+            return
+        tgt = self._resolve_value(env, fnode, call.args[0], locals_,
+                                  nested or {})
+        if tgt is not None and tgt not in self.graph.wrappers:
+            self.graph.wrappers.append(tgt)
+            self.graph.functions[tgt].escapes = True
+
+    def _resolve_value(self, env, fnode, expr, locals_, nested):
+        """A bare (non-call) reference to a program function, or
+        None.  Used for escapes and wrapper installation."""
+        g = self.graph
+        if isinstance(expr, ast.Name):
+            nm = expr.id
+            if nm in nested:
+                return nested[nm]
+            if nm in env.funcs:
+                return env.funcs[nm]
+            tgt = env.imports.get(nm)
+            if tgt is not None:
+                return g.func_at(tgt)
+            return None
+        if isinstance(expr, ast.Attribute):
+            ci = _infer_type(expr.value, env, fnode, g, locals_)
+            if ci is not None:
+                return ci.lookup(expr.attr, g)
+            dn = env.dotted(expr)
+            if dn is not None and self._in_program(
+                    dn.rpartition(".")[0]):
+                return g.func_at(dn)
+        return None
+
+    def _module_level_escapes(self, env: _FileEnv) -> None:
+        """Value references at module level (thread targets, atexit
+        hooks, decorator tables) also make a function escape."""
+        g = self.graph
+        for stmt in env.ctx.tree.body:
+            if isinstance(stmt, _SCOPE_STMTS):
+                continue
+            for n in walk_in_scope(stmt):
+                if isinstance(n, ast.Name) \
+                        and not isinstance(n.ctx, (ast.Store,
+                                                   ast.Del)) \
+                        and n.id in env.funcs:
+                    g.functions[env.funcs[n.id]].escapes = True
+
+
+def build_call_graph(contexts: Iterable) -> CallGraph:
+    """Build the whole-program call graph over parsed FileContexts."""
+    return _Builder(contexts).build()

@@ -36,6 +36,12 @@ def _project_rule_ids() -> str:
                            if r.scope == "project")) or "none"
 
 
+def _program_rule_ids() -> str:
+    return "/".join(sorted(r.id for r in RULES.values()
+                           if r.scope == "program"
+                           or r.program_check is not None)) or "none"
+
+
 def _parse_ids(s: str | None) -> list[str] | None:
     if s is None:
         return None
@@ -60,9 +66,11 @@ def _print_text(result: LintResult, show_baselined: bool) -> None:
         print(f"{e.path}:{e.line}: {e.rule} stale baseline entry "
               f"(code no longer matches: {e.code!r}) — run "
               f"--update-baseline")
+    discharged = (f"{len(result.discharged)} discharged, "
+                  if result.discharged else "")
     print(f"sctlint: {len(result.violations)} violation(s), "
           f"{len(result.baselined)} baselined, "
-          f"{len(result.suppressed)} suppressed, "
+          f"{len(result.suppressed)} suppressed, {discharged}"
           f"{len(result.stale_baseline)} stale baseline entr"
           f"{'y' if len(result.stale_baseline) == 1 else 'ies'}, "
           f"{len(result.errors)} error(s) "
@@ -76,6 +84,7 @@ def _print_json(result: LintResult) -> None:
         "violations": [v.to_json() for v in result.violations],
         "baselined": [v.to_json() for v in result.baselined],
         "suppressed": [v.to_json() for v in result.suppressed],
+        "discharged": [v.to_json() for v in result.discharged],
         "stale_baseline": [e.to_json() for e in result.stale_baseline],
         "errors": result.errors,
     }
@@ -109,6 +118,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--no-project-rules", action="store_true",
                     help=f"skip project-scope rules "
                          f"({_project_rule_ids()})")
+    ap.add_argument("--no-program-rules", action="store_true",
+                    help=f"skip the whole-program phase — call-graph "
+                         f"rules and program extensions "
+                         f"({_program_rule_ids()}); also disables "
+                         f"call-graph discharge of file findings")
     ap.add_argument("--jobs", type=int, default=1, metavar="N",
                     help="analyze files in N worker processes "
                          "(0 = one per CPU; default 1)")
@@ -149,6 +163,7 @@ def _run(args, paths, root, only, disable, baseline_path) -> int:
         result = run_lint(paths, root=root, only=only, disable=disable,
                           baseline=None,
                           project_rules=not args.no_project_rules,
+                          program_rules=not args.no_program_rules,
                           cache_dir=cache_dir, jobs=jobs)
         old = Baseline.load(baseline_path)
         only_set = set(only) if only is not None else None
@@ -180,6 +195,7 @@ def _run(args, paths, root, only, disable, baseline_path) -> int:
     result = run_lint(paths, root=root, only=only, disable=disable,
                       baseline=baseline,
                       project_rules=not args.no_project_rules,
+                      program_rules=not args.no_program_rules,
                       cache_dir=cache_dir, jobs=jobs)
     if args.format == "json":
         _print_json(result)

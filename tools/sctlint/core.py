@@ -1,7 +1,7 @@
 """The lint engine: rule registry, file contexts, suppression
 comments, and the orchestration that runs rules over a path set.
 
-Three rule scopes:
+Four rule scopes:
 
 * ``file`` rules get a :class:`FileContext` (one parsed module) and
   yield violations anchored to AST nodes.  Per-line ``# sctlint:
@@ -11,6 +11,17 @@ Three rule scopes:
   index with shared, lazily-built control-flow graphs (built once
   per function no matter how many flow rules run).  Same suppression
   contract as file rules.
+* ``program`` rules get a :class:`ProgramContext` — the whole-program
+  call graph (:mod:`tools.sctlint.callgraph`) plus every file's
+  ``FileFlows`` — and check interprocedural invariants: lock-order
+  cycles (SCT014), blocking work reached transitively under a lock
+  (SCT015), epoch-fence discipline (SCT016).  Their violations are
+  anchored to real source lines, so the per-line suppression
+  contract applies unchanged.  A ``flow`` rule can also register a
+  PROGRAM EXTENSION under its own id (:func:`program_extension`) to
+  refine its file-phase verdicts with call-graph evidence — SCT013
+  uses this to verify ``locked-by-caller`` annotations and to
+  DISCHARGE file-phase findings the graph proves safe.
 * ``project`` rules get a :class:`ProjectContext` (the whole lint run)
   and check cross-file invariants — registry parity, repo hygiene.
   They have no source line to suppress on; exemptions go in the
@@ -90,13 +101,58 @@ class ProjectContext:
         return any(f.path.startswith(prefix) for f in self.files)
 
 
+@dataclasses.dataclass
+class ProgramContext:
+    """What a ``scope="program"`` rule (or a flow rule's program
+    extension) receives: the whole-program call graph, every parsed
+    file, and the file phase's active findings (so an extension can
+    refine them).  ``discharge()`` retracts a file-phase violation
+    the call graph has PROVEN safe — the finding is dropped from the
+    run as if the file rule had never emitted it, and recorded on
+    the result for transparency."""
+
+    root: str
+    files: list[FileContext]
+    graph: object  # callgraph.CallGraph
+    #: path -> ACTIVE file-phase violations of that file
+    file_violations: dict[str, list[Violation]]
+    discharged: list[Violation] = dataclasses.field(
+        default_factory=list)
+
+    def __post_init__(self):
+        self.by_path = {f.path: f for f in self.files}
+
+    def ctx(self, path: str) -> FileContext | None:
+        return self.by_path.get(path)
+
+    def flows(self, path: str):
+        from .flow import file_flows
+
+        c = self.by_path.get(path)
+        return file_flows(c) if c is not None else None
+
+    def violation(self, rule_id: str, path: str, lineno: int,
+                  message: str, col: int = 0) -> Violation:
+        c = self.by_path.get(path)
+        code = ""
+        if c is not None and 0 < lineno <= len(c.lines):
+            code = c.lines[lineno - 1].strip()
+        return Violation(rule_id, path, lineno, col, message, code)
+
+    def discharge(self, v: Violation) -> None:
+        self.discharged.append(v)
+
+
 @dataclasses.dataclass(frozen=True)
 class Rule:
     id: str
     name: str
     summary: str
-    scope: str  # "file" | "flow" | "project"
+    scope: str  # "file" | "flow" | "program" | "project"
     check: Callable[..., Iterable[Violation]]
+    #: for file/flow rules only: an optional whole-program refinement
+    #: pass run under the SAME rule id (see :func:`program_extension`)
+    program_check: Callable[..., Iterable[Violation]] | None = None
 
 
 RULES: dict[str, Rule] = {}
@@ -105,13 +161,33 @@ RULES: dict[str, Rule] = {}
 def rule(rule_id: str, name: str, summary: str, scope: str = "file"):
     """Decorator registering a rule's check function under ``rule_id``."""
 
-    if scope not in ("file", "flow", "project"):
+    if scope not in ("file", "flow", "program", "project"):
         raise ValueError(f"unknown rule scope {scope!r}")
 
     def deco(fn):
         if rule_id in RULES:
             raise ValueError(f"duplicate rule id {rule_id}")
         RULES[rule_id] = Rule(rule_id, name, summary, scope, fn)
+        return fn
+
+    return deco
+
+
+def program_extension(rule_id: str):
+    """Attach a program-phase pass to an ALREADY-REGISTERED file/flow
+    rule, reporting under the same id.  The extension receives the
+    :class:`ProgramContext` and may both yield new violations (e.g.
+    "this locked-by-caller annotation is refuted") and
+    ``pctx.discharge()`` file-phase ones the graph proves safe."""
+
+    def deco(fn):
+        base = RULES.get(rule_id)
+        if base is None:
+            raise ValueError(f"no rule {rule_id} to extend")
+        if base.program_check is not None:
+            raise ValueError(f"{rule_id} already has a program "
+                             f"extension")
+        RULES[rule_id] = dataclasses.replace(base, program_check=fn)
         return fn
 
     return deco
@@ -239,6 +315,16 @@ class LintResult:
     errors: list[str]
     n_files: int
     scope: LintScope | None = None
+    #: file-phase findings retracted by a program extension (the call
+    #: graph proved the hazard cannot occur — e.g. every call site of
+    #: a private helper holds the guarding lock)
+    discharged: list = dataclasses.field(default_factory=list)
+    #: paths whose program-phase results had to be recomputed this
+    #: run (empty when the phase replayed entirely from cache or did
+    #: not run); the incremental-cache tests key off this
+    program_misses: list = dataclasses.field(default_factory=list)
+    #: files whose program-phase results replayed from cache
+    program_hits: int = 0
 
     @property
     def ok(self) -> bool:
@@ -279,11 +365,40 @@ def run_file_rules(ctx: FileContext, rule_ids: Iterable[str]
     return active, suppressed
 
 
+def run_program_phase(root: str, contexts: list[FileContext],
+                      prog_rules: list[Rule], ext_rules: list[Rule],
+                      file_active: dict[str, list[Violation]],
+                      ) -> tuple[list[Violation], list[Violation],
+                                 list[Violation], object]:
+    """Build the call graph and run every program rule / program
+    extension over it.  Returns ``(active, suppressed, discharged)``
+    — program violations honour the per-line suppression comments of
+    the file they anchor to."""
+    from .callgraph import build_call_graph
+
+    graph = build_call_graph(contexts)
+    pctx = ProgramContext(root=root, files=contexts, graph=graph,
+                          file_violations=file_active)
+    active: list[Violation] = []
+    suppressed: list[Violation] = []
+    checks = [(r.id, r.check) for r in prog_rules] + \
+        [(r.id, r.program_check) for r in ext_rules]
+    for _, check in sorted(checks, key=lambda t: t[0]):
+        for v in check(pctx) or ():
+            c = pctx.by_path.get(v.path)
+            if c is not None and c.is_suppressed(v):
+                suppressed.append(v)
+            else:
+                active.append(v)
+    return active, suppressed, pctx.discharged, graph
+
+
 def run_lint(paths: Iterable[str], *, root: str | None = None,
              only: Iterable[str] | None = None,
              disable: Iterable[str] | None = None,
              baseline: Baseline | None = None,
              project_rules: bool = True,
+             program_rules: bool = True,
              cache_dir: str | None = None,
              jobs: int = 1) -> LintResult:
     """Lint ``paths`` and split hits into active / suppressed /
@@ -291,11 +406,15 @@ def run_lint(paths: Iterable[str], *, root: str | None = None,
 
     ``only``/``disable`` select rule ids.  ``project_rules=False``
     skips project-scope rules regardless of selection (unit tests lint
-    synthetic snippets that have no project around them).
-    ``cache_dir`` enables the content-addressed findings cache
-    (``tools/sctlint/cache.py``); ``jobs > 1`` analyzes cache-miss
-    files in a process pool.  Neither changes findings — only where
-    and when the file rules execute.
+    synthetic snippets that have no project around them);
+    ``program_rules=False`` likewise skips the whole-program phase
+    (call-graph rules SCT014-SCT016 and the SCT013 annotation
+    verifier).  ``cache_dir`` enables the content-addressed findings
+    cache (``tools/sctlint/cache.py``) — including the call-graph-
+    aware program-result cache, whose per-file keys incorporate the
+    summary signatures of every file the verdict depends on;
+    ``jobs > 1`` analyzes cache-miss files in a process pool.  None
+    of these change findings — only where and when rules execute.
     """
     paths = list(paths)  # iterated twice (scope prefixes + collection)
     root = root or repo_root()
@@ -309,6 +428,11 @@ def run_lint(paths: Iterable[str], *, root: str | None = None,
                         key=lambda r: r.id)
     proj_rules = sorted((r for r in active if r.scope == "project"),
                         key=lambda r: r.id) if project_rules else []
+    prog_only = sorted((r for r in active if r.scope == "program"),
+                       key=lambda r: r.id) if program_rules else []
+    prog_ext = sorted((r for r in active
+                       if r.program_check is not None),
+                      key=lambda r: r.id) if program_rules else []
 
     errors: list[str] = []
     contexts: list[FileContext] = []
@@ -404,6 +528,81 @@ def run_lint(paths: Iterable[str], *, root: str | None = None,
                       [dataclasses.asdict(v) for v in vs],
                       [dataclasses.asdict(v) for v in ss])
 
+    # ---- whole-program phase (call graph + SCT014-016 + SCT013
+    # verification), with depfile-style call-graph-aware caching ----
+    discharged: list[Violation] = []
+    prog_misses: list[str] = []
+    prog_hits = 0
+    if (prog_only or prog_ext) and contexts:
+        file_active: dict[str, list[Violation]] = {}
+        for v in raw:
+            file_active.setdefault(v.path, []).append(v)
+        ast_sigs: dict[str, str] = {}
+        ok_entries: dict[str, tuple] = {}
+        if cache is not None:
+            from .callgraph import ast_signature
+
+            ast_sigs = {c.path: ast_signature(c.tree)
+                        for c in contexts}
+            for c in contexts:
+                dig = digests.get(c.path)
+                e = cache.get_program(c.path)
+                deps = e.get("deps") if isinstance(e, dict) else None
+                if (e is None or dig is None or e.get("digest") != dig
+                        or not isinstance(deps, dict)
+                        or any(ast_sigs.get(dep) != sig
+                               for dep, sig in deps.items())):
+                    prog_misses.append(c.path)
+                    continue
+                try:
+                    ok_entries[c.path] = (
+                        [Violation(**d)
+                         for d in e.get("violations") or []],
+                        [Violation(**d)
+                         for d in e.get("suppressed") or []],
+                        [Violation(**d)
+                         for d in e.get("discharged") or []])
+                except TypeError:
+                    prog_misses.append(c.path)
+        if cache is not None and not prog_misses:
+            prog_hits = len(ok_entries)
+            for pv, ps, pd in ok_entries.values():
+                raw.extend(pv)
+                suppressed.extend(ps)
+                discharged.extend(pd)
+        else:
+            if cache is None:
+                prog_misses = [c.path for c in contexts]
+            pa, ps, pd, graph = run_program_phase(
+                root, contexts, prog_only, prog_ext, file_active)
+            raw.extend(pa)
+            suppressed.extend(ps)
+            discharged.extend(pd)
+            if cache is not None:
+                by_p: dict[str, dict] = {
+                    c.path: {"violations": [], "suppressed": [],
+                             "discharged": []} for c in contexts}
+                for key, vs in (("violations", pa),
+                                ("suppressed", ps),
+                                ("discharged", pd)):
+                    for v in vs:
+                        if v.path in by_p:
+                            by_p[v.path][key].append(
+                                dataclasses.asdict(v))
+                for c in contexts:
+                    entry = by_p[c.path]
+                    entry["digest"] = digests[c.path]
+                    entry["deps"] = {
+                        p: ast_sigs[p]
+                        for p in graph.component(c.path)
+                        if p in ast_sigs}
+                    entry["deps"].setdefault(c.path,
+                                             ast_sigs[c.path])
+                    cache.put_program(c.path, entry)
+    if discharged:
+        drop = set(discharged)
+        raw = [v for v in raw if v not in drop]
+
     pctx = ProjectContext(root=root, files=contexts)
     for r in proj_rules:
         raw.extend(r.check(pctx))
@@ -439,4 +638,7 @@ def run_lint(paths: Iterable[str], *, root: str | None = None,
 
     return LintResult(violations=violations, suppressed=suppressed,
                       baselined=baselined, stale_baseline=stale,
-                      errors=errors, n_files=len(contexts), scope=scope)
+                      errors=errors, n_files=len(contexts),
+                      scope=scope, discharged=discharged,
+                      program_misses=prog_misses,
+                      program_hits=prog_hits)

@@ -401,12 +401,18 @@ def dataflow(cfg: CFG,
              edge_refine: Callable[[FlowNode, str, frozenset],
                                    frozenset] | None = None,
              init: frozenset = frozenset(),
+             merge: Callable[[frozenset, frozenset],
+                             frozenset] | None = None,
              ) -> dict[FlowNode, frozenset]:
-    """Forward may-analysis to fixpoint: union merge at joins,
+    """Forward analysis to fixpoint: ``merge`` at joins (union by
+    default — a may-analysis; pass ``frozenset.intersection`` for a
+    must-analysis, e.g. "a fence check dominates this write"),
     ``transfer`` per node, optional per-edge ``edge_refine`` (branch-
-    sensitive gen/kill on ``true``/``false`` edges).  Returns the
-    IN-state of every node (the exit nodes' in-states are the
-    answers)."""
+    sensitive gen/kill on ``true``/``false`` edges).  Unvisited
+    predecessors contribute nothing to a join (None is the identity
+    for either merge — top for intersection, bottom for union), so
+    the same worklist serves both directions.  Returns the IN-state
+    of every node (the exit nodes' in-states are the answers)."""
     in_states: dict[FlowNode, frozenset | None] = {
         n: None for n in cfg.nodes}
     in_states[cfg.entry] = init
@@ -418,7 +424,12 @@ def dataflow(cfg: CFG,
         for succ, tag in n.succs:
             es = edge_refine(n, tag, out) if edge_refine else out
             old = in_states[succ]
-            new = es if old is None else old | es
+            if old is None:
+                new = es
+            elif merge is not None:
+                new = merge(old, es)
+            else:
+                new = old | es
             if new != old:
                 in_states[succ] = new
                 work.append(succ)
@@ -527,6 +538,8 @@ def iter_lock_regions(fn, held: tuple = ()) -> Iterator[tuple]:
 
 _LOCKED_BY_CALLER_RE = re.compile(
     r"#\s*sctlint:\s*locked-by-caller\b")
+_IO_UNDER_LOCK_RE = re.compile(
+    r"#\s*sctlint:\s*io-under-lock\b")
 
 
 @dataclasses.dataclass
@@ -535,35 +548,58 @@ class FunctionInfo:
     qualname: str
     owner_class: ast.ClassDef | None
     locked_by_caller: bool
+    #: line of the locked-by-caller comment (for the SCT013 verifier
+    #: to anchor its verdict on), None when unannotated
+    locked_by_caller_line: int | None = None
+    #: ``# sctlint: io-under-lock`` — a function-level declaration
+    #: that this helper's DIRECT blocking/IO operations are a
+    #: deliberate, ordering-mandated part of an under-lock protocol
+    #: (SCT015 exempts the function's own operations but still
+    #: propagates through its callees); the comment is the audit
+    #: trail, same contract as per-line suppressions
+    io_under_lock: bool = False
 
 
 class FileFlows:
     """Everything the flow rules need from one module, computed once:
     every function with its qualname/owning class, lazily-built
-    (shared) CFGs, and the ``# sctlint: locked-by-caller`` annotation
-    set (a function-level declaration that every call site holds the
-    relevant lock — the cross-function escape hatch an intra-
-    procedural analysis needs)."""
+    (shared) CFGs, and the function-level annotation sets
+    (``# sctlint: locked-by-caller`` — every call site holds the
+    relevant lock, now VERIFIED against the call graph by the SCT013
+    program extension — and ``# sctlint: io-under-lock`` — this
+    helper's direct IO is a deliberate under-lock protocol step,
+    honoured by SCT015)."""
 
     def __init__(self, ctx):
         self.ctx = ctx
         self._cfgs: dict[int, CFG] = {}
-        ann_lines = {i + 1 for i, line in enumerate(ctx.lines)
+        lbc_lines = {i + 1 for i, line in enumerate(ctx.lines)
                      if _LOCKED_BY_CALLER_RE.search(line)}
+        io_lines = {i + 1 for i, line in enumerate(ctx.lines)
+                    if _IO_UNDER_LOCK_RE.search(line)}
         self.functions: list[FunctionInfo] = []
         self._collect(ctx.tree, "", None)
         # bind each annotation to the INNERMOST function containing
         # its line — a locked-by-caller comment inside a nested def
         # must not exempt the enclosing method's field writes
-        for ln in ann_lines:
-            best = None
-            for info in self.functions:
-                end = getattr(info.fn, "end_lineno", info.fn.lineno)
-                if info.fn.lineno <= ln <= end and (
-                        best is None or info.fn.lineno > best.fn.lineno):
-                    best = info
+        for ln in lbc_lines:
+            best = self._innermost(ln)
             if best is not None:
                 best.locked_by_caller = True
+                best.locked_by_caller_line = ln
+        for ln in io_lines:
+            best = self._innermost(ln)
+            if best is not None:
+                best.io_under_lock = True
+
+    def _innermost(self, ln: int) -> FunctionInfo | None:
+        best = None
+        for info in self.functions:
+            end = getattr(info.fn, "end_lineno", info.fn.lineno)
+            if info.fn.lineno <= ln <= end and (
+                    best is None or info.fn.lineno > best.fn.lineno):
+                best = info
+        return best
 
     def _collect(self, node, prefix, owner) -> None:
         for child in ast.iter_child_nodes(node):

@@ -9,4 +9,7 @@ from . import vocab  # noqa: F401     SCT009
 from . import resource_pairing  # noqa: F401  SCT010 (flow)
 from . import lockscope  # noqa: F401  SCT011 (flow)
 from . import journalproto  # noqa: F401  SCT012
-from . import guardedfields  # noqa: F401  SCT013 (flow)
+from . import guardedfields  # noqa: F401  SCT013 (flow + program ext)
+from . import lockorder  # noqa: F401  SCT014 (program)
+from . import blockreach  # noqa: F401  SCT015 (program)
+from . import epochfence  # noqa: F401  SCT016 (program)

@@ -1,0 +1,152 @@
+"""SCT015 — a call made under a held lock must not REACH a blocking
+operation through any call path.
+
+SCT011 polices the lock body lexically: a ``time.sleep`` or file
+write directly inside ``with self._lock:`` is flagged on sight.  The
+escape hatch that survives it is one level of indirection — the body
+calls ``self._flush()`` and the sleep lives in ``_flush``.  This rule
+closes that hatch with the call graph: every function gets a
+bottom-up summary of the blocking operations reachable from its body
+(direct ops plus its callees' summaries, each carrying the call
+chain that reaches it), and every call site that is lexically under
+a lock checks its callees' summaries.
+
+Division of labour with SCT011 is strict: depth 0 (an op directly in
+the locked body) is SCT011's finding and is NOT re-reported here;
+SCT015 fires only through at least one call edge, and its message
+prints the chain (``_flush -> _write_json -> json.dump``) so the fix
+target is obvious.
+
+Deliberate policy steps keep their existing outs, applied at the
+SITE where the lock is held: journal writes whose event literal is
+in ``IN_LOCK_EVENTS`` are the under-lock protocol; ``cv.wait()``
+on a condition variable whose underlying lock IS one of the held
+locks is how condition variables work.  A function annotated
+``# sctlint: io-under-lock`` declares its DIRECT blocking ops to be
+deliberate protocol steps (auditable at the annotation); ops it
+merely reaches through further calls still propagate.
+"""
+
+from __future__ import annotations
+
+from ..core import ProgramContext, rule
+from ..flow import is_journal_write
+from . import lockscope
+
+#: per (kind, detail) only the first chain is kept, and summaries are
+#: truncated — a function reaching 40 distinct ops tells the reviewer
+#: nothing more than one reaching 8
+_MAX_OPS = 8
+_MAX_DEPTH = 12
+
+
+def _summaries(graph) -> dict:
+    """function key -> tuple of reachable ops, each
+    ``(kind, detail, event, cv_lock, chain)`` where chain is the
+    call-site frames from the function down to the op."""
+    memo: dict = {}
+    stack: set = set()
+
+    def reach(key: str, depth: int):
+        if key in memo:
+            return memo[key]
+        if key in stack or depth > _MAX_DEPTH:
+            return ()  # cycle / runaway: under-approximate this arm
+        fnode = graph.functions.get(key)
+        if fnode is None:
+            return ()
+        stack.add(key)
+        ops: dict = {}
+        for op in fnode.blocking:
+            if fnode.info is not None and fnode.info.io_under_lock:
+                continue  # declared deliberate; audit at the annotation
+            ops.setdefault((op.kind, op.detail),
+                           (op.kind, op.detail, op.event, op.cv_lock,
+                            (f"{op.detail} ({fnode.path}:{op.lineno})",)))
+        for site in fnode.sites:
+            # a journal append is already summarised as its own
+            # "journal" BlockOp carrying the event literal — the
+            # policy decision (allowlist) belongs to that op, so the
+            # journal IMPLEMENTATION's internals (it opens and
+            # fsyncs its file, that is what a durable journal is)
+            # must not propagate as independent IO
+            if site.call is not None and is_journal_write(site.call):
+                continue
+            for callee in site.callees:
+                if callee == key:
+                    continue
+                frame = (f"{graph.functions[callee].display} "
+                         f"({fnode.path}:{site.lineno})"
+                         if callee in graph.functions else callee)
+                for kind, detail, event, cv, chain in reach(
+                        callee, depth + 1):
+                    if (kind, detail) not in ops and \
+                            len(chain) < _MAX_DEPTH:
+                        ops[(kind, detail)] = (
+                            kind, detail, event, cv,
+                            (frame,) + chain)
+            if len(ops) >= _MAX_OPS:
+                break
+        stack.discard(key)
+        memo[key] = tuple(list(ops.values())[:_MAX_OPS])
+        return memo[key]
+
+    for key in graph.functions:
+        reach(key, 0)
+    return memo
+
+
+def _banned(op, held) -> str | None:
+    """Policy filter mirroring SCT011's outs; returns the reason text
+    or None if the op is an allowed protocol step."""
+    kind, detail, event, cv_lock, chain = op
+    if kind == "journal":
+        if event is not None and event in lockscope.IN_LOCK_EVENTS:
+            return None
+        ev = event or "<dynamic>"
+        return (f"journal write of non-allowlisted event "
+                f"'{ev}' via {' -> '.join(chain)}")
+    if kind == "blocking" and detail.endswith(".wait()") and \
+            cv_lock is not None and cv_lock in held:
+        return None  # cv.wait on a held lock: releases while waiting
+    noun = {"snapshot": "snapshot (lock-taking walk)",
+            "blocking": "blocking call",
+            "io": "file I/O",
+            "subprocess": "subprocess"}.get(kind, kind)
+    return f"{noun} {detail} via {' -> '.join(chain)}"
+
+
+@rule("SCT015", "transitive-blocking-under-lock",
+      "a call made while a lock is lexically held must not reach "
+      "time.sleep / subprocess / file I/O / wait() through any call "
+      "path (depth >= 1; direct ops are SCT011's)",
+      scope="program")
+def check_blocking_reach(pctx: ProgramContext):
+    graph = pctx.graph
+    memo = _summaries(graph)
+    for fnode in graph.functions.values():
+        for site in fnode.sites:
+            if not site.held or not site.callees:
+                continue
+            # journal-append sites are SCT011's (lexical event
+            # allowlist); their implementation does not propagate
+            if site.call is not None and is_journal_write(site.call):
+                continue
+            for callee in site.callees:
+                hit = None
+                for op in memo.get(callee, ()):
+                    reason = _banned(op, site.held)
+                    if reason is not None:
+                        hit = reason
+                        break
+                if hit is not None:
+                    lock = site.held[-1]
+                    yield pctx.violation(
+                        "SCT015", fnode.path, site.lineno,
+                        f"call to {site.text}() while holding "
+                        f"{lock} reaches a {hit} — move the slow "
+                        f"work outside the lock, or annotate the "
+                        f"helper '# sctlint: io-under-lock' if this "
+                        f"is a deliberate protocol step",
+                        col=site.col)
+                    break  # one finding per call site is enough

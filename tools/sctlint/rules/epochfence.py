@@ -1,0 +1,243 @@
+"""SCT016 — writes to epoch-fenced state must be dominated by a
+fence check on every path, interprocedurally.
+
+The federation/serving/factory stack uses epoch counters to make
+stale writers harmless: an incarnation that lost the baton may still
+be running, and the one thing it must not do is commit state under
+the new incarnation's feet.  The protocol is check-then-commit — a
+fence comparison (or a ``*FencedError``-raising guard, or a
+filesystem claim acquire) must happen-before the epoch write on
+EVERY control-flow path, and the check is allowed to live in a
+CALLER: ``swap()`` verifies the fence once and then calls three
+helpers that each bump an epoch field.
+
+So the rule has two tiers:
+
+* **local dominance** — a must-dataflow over the writer's CFG
+  (intersection at joins: a check on one branch does not cover the
+  other) where a node GENERATES the fence fact if it contains a
+  fence-named call or a call resolving to a ``*Fence*``-raising
+  function, an ``if`` whose branch raises a ``*Fence*`` error, a
+  comparison touching an epoch-named attribute, a claim-style
+  acquire (``try_acquire*``, ``os.open(..., O_EXCL)``), or a
+  fence-named string/attribute (the journal/counter vocabulary of
+  the fence protocol, e.g. ``"fence.json"``);
+* **entry fencing** — when the write is not locally dominated, every
+  in-program call site of the writer must itself be fenced (the
+  site's IN-state in the caller's own analysis, or the caller's
+  entry recursively).  ``__init__``-like callers are fenced by
+  construction (the object is not shared yet), cycles resolve
+  optimistically, and a writer that ESCAPES as a value or has no
+  in-program callers cannot be proven — the violation message shows
+  one concrete unfenced entry chain.
+
+Scope is deliberately the three modules that own fenced state
+(``federation.py``, ``serving.py``, ``factory.py``) — epoch counters
+elsewhere (training step counters, AnnData metadata) are plain data,
+and fencing vocabulary would be noise there.  Callers are followed
+into ANY module; only the WRITE location is gated.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from ..callgraph import EPOCH_ATTR_RE, FENCE_NAME_RE
+from ..core import ProgramContext, rule
+from ..flow import call_tail, dataflow, walk_in_scope
+
+#: only writes in these modules are policed
+_GATED = frozenset({"federation.py", "serving.py", "factory.py"})
+
+_F = frozenset({"F"})
+
+
+def _node_exprs(node):
+    """The expressions a CFG node actually evaluates — headers only
+    for compound statements, so a fence check inside an ``if`` body
+    is attributed to the body's own node, not the test's."""
+    st = node.ast
+    if st is None:
+        return ()
+    if node.kind == "stmt":
+        return (st,)
+    if node.kind == "test":
+        if isinstance(st, (ast.If, ast.While)):
+            return (st.test,)
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            return (st.iter,)
+        if isinstance(st, ast.Match):
+            return (st.subject,)
+        return ()
+    if node.kind == "with_enter":
+        return tuple(it.context_expr for it in st.items)
+    if node.kind == "handler":
+        return (st.type,) if st.type is not None else ()
+    return ()
+
+
+def _raises_fence_shallow(body) -> bool:
+    for s in body:
+        if isinstance(s, ast.Raise) and s.exc is not None:
+            exc = s.exc.func if isinstance(s.exc, ast.Call) else s.exc
+            nm = exc.attr if isinstance(exc, ast.Attribute) else \
+                exc.id if isinstance(exc, ast.Name) else ""
+            if FENCE_NAME_RE.search(nm):
+                return True
+    return False
+
+
+def _generates_fence(node, graph, site_by_call) -> bool:
+    # an if-guard whose branch raises a *Fence* error fences BOTH
+    # edges: true raises, false means the check passed
+    st = node.ast
+    if node.kind == "test" and isinstance(st, ast.If) and (
+            _raises_fence_shallow(st.body)
+            or _raises_fence_shallow(st.orelse)):
+        return True
+    for root in _node_exprs(node):
+        for sub in walk_in_scope(root):
+            if isinstance(sub, ast.Call):
+                tail = call_tail(sub)
+                if tail and (FENCE_NAME_RE.search(tail)
+                             or tail.startswith("try_acquire")):
+                    return True
+                site = site_by_call.get(id(sub))
+                if site is not None:
+                    for key in site.callees:
+                        cal = graph.functions.get(key)
+                        if cal is not None and (
+                                cal.raises_fence
+                                or FENCE_NAME_RE.search(cal.name)):
+                            return True
+                # claim-style acquire: os.open(..., O_EXCL)
+                for a in ast.walk(sub):
+                    if (isinstance(a, ast.Attribute)
+                            and a.attr == "O_EXCL") or (
+                            isinstance(a, ast.Name)
+                            and a.id == "O_EXCL"):
+                        return True
+            elif isinstance(sub, ast.Compare):
+                for part in ast.walk(sub):
+                    nm = part.attr if isinstance(part, ast.Attribute) \
+                        else part.id if isinstance(part, ast.Name) \
+                        else None
+                    if nm is not None and EPOCH_ATTR_RE.search(nm):
+                        return True
+            elif isinstance(sub, ast.Constant) and \
+                    isinstance(sub.value, str) and \
+                    FENCE_NAME_RE.search(sub.value):
+                return True
+            else:
+                nm = sub.attr if isinstance(sub, ast.Attribute) else \
+                    sub.id if isinstance(sub, ast.Name) else None
+                if nm is not None and FENCE_NAME_RE.search(nm):
+                    return True
+    return False
+
+
+def _fenced_lines(fnode, flows, graph) -> dict[int, bool]:
+    """line -> is the fence fact established at that line on ALL
+    paths (IN-state of the must-dataflow, or generated by the line's
+    own statement).  Lines shared by several CFG nodes take the
+    conservative AND."""
+    cfg = flows.cfg(fnode.fn)
+    site_by_call = {id(s.call): s for s in fnode.sites
+                    if s.call is not None}
+    gen = {n: _generates_fence(n, graph, site_by_call)
+           for n in cfg.nodes}
+    ins = dataflow(cfg,
+                   lambda n, s: s | _F if gen[n] else s,
+                   merge=frozenset.intersection)
+    lines: dict[int, bool] = {}
+    for n in cfg.nodes:
+        ln = getattr(n.ast, "lineno", None)
+        if ln is None:
+            continue
+        f = ("F" in ins[n]) or gen[n]
+        lines[ln] = f if ln not in lines else (lines[ln] and f)
+    return lines
+
+
+@rule("SCT016", "epoch-fence-discipline",
+      "every write to epoch-fenced state in federation/serving/"
+      "factory must be dominated by a fence check (or *FencedError-"
+      "raising guard) on all CFG paths, where the check may live in "
+      "a caller — verified interprocedurally over the call graph",
+      scope="program")
+def check_epoch_fence(pctx: ProgramContext):
+    graph = pctx.graph
+    lines_memo: dict = {}
+
+    def fenced_lines(fnode):
+        got = lines_memo.get(fnode.key)
+        if got is None:
+            got = lines_memo[fnode.key] = _fenced_lines(
+                fnode, pctx.flows(fnode.path), graph)
+        return got
+
+    entry_memo: dict = {}
+
+    def entry_fenced(key: str, stack: frozenset):
+        """(fenced?, one failing entry chain).  Greatest fixpoint:
+        cycles resolve optimistically (a recursive helper is fenced
+        if every OUTSIDE entry into the cycle is)."""
+        got = entry_memo.get(key)
+        if got is not None:
+            return got
+        if key in stack:
+            return True, ()
+        f = graph.functions.get(key)
+        if f is None:
+            return False, ("<unresolved caller>",)
+        if f.is_init:
+            entry_memo[key] = (True, ())
+            return entry_memo[key]
+        if f.escapes:
+            entry_memo[key] = (False, (
+                f"{f.display} escapes as a value — its call sites "
+                f"cannot be enumerated",))
+            return entry_memo[key]
+        sites = graph.callers.get(key, ())
+        if not sites:
+            entry_memo[key] = (False, (
+                f"{f.display} has no in-program call sites (treated "
+                f"as an external entry point)",))
+            return entry_memo[key]
+        for site in sites:
+            caller = graph.functions.get(site.caller)
+            if caller is None or caller.is_init:
+                continue  # pre-sharing: fenced by construction
+            if fenced_lines(caller).get(site.lineno, False):
+                continue
+            ok, chain = entry_fenced(caller.key, stack | {key})
+            if ok:
+                continue
+            entry_memo[key] = (False, (
+                f"unfenced entry via {caller.display} "
+                f"({caller.path}:{site.lineno})",) + chain)
+            return entry_memo[key]
+        entry_memo[key] = (True, ())
+        return entry_memo[key]
+
+    for key in sorted(graph.functions):
+        fnode = graph.functions[key]
+        if not fnode.epoch_writes or fnode.is_init or \
+                os.path.basename(fnode.path) not in _GATED:
+            continue
+        local = fenced_lines(fnode)
+        for w in fnode.epoch_writes:
+            if local.get(w.lineno, False):
+                continue
+            ok, chain = entry_fenced(key, frozenset())
+            if ok:
+                continue
+            via = "; ".join(chain[:3])
+            yield pctx.violation(
+                "SCT016", fnode.path, w.lineno,
+                f"write to epoch-fenced state `{w.target}` in "
+                f"{fnode.display} is not dominated by a fence check "
+                f"on all paths ({via}) — compare against the owner/"
+                f"seen epoch or call a *FencedError-raising guard "
+                f"before committing")

@@ -35,7 +35,7 @@ from __future__ import annotations
 
 import ast
 
-from ..core import FileContext, rule
+from ..core import FileContext, ProgramContext, program_extension, rule
 from ..flow import FileFlows, iter_lock_regions
 
 _INIT_METHODS = frozenset({"__init__", "__post_init__", "__new__",
@@ -101,3 +101,158 @@ def check_guarded_fields(ctx: FileContext, flows: FileFlows):
                     f"the lock, or annotate the function "
                     f"`# sctlint: locked-by-caller` if every call "
                     f"site already holds it")
+
+
+# ---------------------------------------------------------------------------
+# Program extension: VERIFY the annotations instead of trusting them
+# ---------------------------------------------------------------------------
+
+def _bare_guard_locks(fnode, flows, graph) -> dict:
+    """For a function: field -> qualified lock, for every field the
+    function writes BARE that is lock-guarded elsewhere in its class.
+    These are the locks a locked-by-caller contract promises."""
+    info = fnode.info
+    if info.owner_class is None:
+        return {}
+    guards: dict = {}  # field -> (lock text, guarded-writer key)
+    for other in flows.functions:
+        if other.owner_class is not info.owner_class:
+            continue
+        okey = f"{fnode.path}::{other.qualname}"
+        for stmt, held in iter_lock_regions(other.fn):
+            if not held:
+                continue
+            for field, _node in _self_targets(stmt):
+                guards.setdefault(field, (held[-1], okey))
+    locks: dict = {}
+    for stmt, held in iter_lock_regions(info.fn):
+        if held:
+            continue
+        for field, _node in _self_targets(stmt):
+            g = guards.get(field)
+            if g is not None and field not in locks:
+                locks[field] = graph.qualify_in(g[1], g[0])
+    return locks
+
+
+def _holds_at_entry(key, lock, graph, stack) -> bool:
+    """Every in-program call site of ``key`` holds ``lock`` — either
+    lexically at the site, or because the caller itself provably
+    holds it at entry (recursive, cycle-optimistic), or because the
+    caller is ``__init__``-like (the object is not shared yet)."""
+    if key in stack:
+        return True
+    sites = graph.callers.get(key, ())
+    if not sites:
+        return False
+    for site in sites:
+        if lock in site.held:
+            continue
+        caller = graph.functions.get(site.caller)
+        if caller is None:
+            return False
+        if caller.is_init:
+            continue
+        if not _holds_at_entry(caller.key, lock, graph,
+                               stack | {key}):
+            return False
+    return True
+
+
+def _verdict(fnode, lock, graph):
+    """("proven" | "refuted" | "unprovable", detail).  Proof requires
+    the full enumeration guarantee: a PRIVATE, non-escaping function
+    whose every resolved call site holds the lock.  Public functions
+    stay unprovable on principle — tests and downstream users call
+    them without the lock, and the call graph cannot see that."""
+    if not fnode.private:
+        return "unprovable", (
+            "the function is public — out-of-program callers are "
+            "possible")
+    if fnode.escapes:
+        return "unprovable", (
+            "the function escapes as a value — its call sites "
+            "cannot be enumerated")
+    sites = graph.callers.get(fnode.key, ())
+    if not sites:
+        return "unprovable", "no in-program call sites were found"
+    for site in sites:
+        if lock in site.held:
+            continue
+        caller = graph.functions.get(site.caller)
+        if caller is not None and caller.is_init:
+            continue
+        if caller is not None and _holds_at_entry(
+                caller.key, lock, graph, frozenset({fnode.key})):
+            continue
+        where = (f"{caller.display} ({caller.path}:{site.lineno})"
+                 if caller is not None else site.caller)
+        return "refuted", (
+            f"call site {where} does not hold {lock}")
+    return "proven", ""
+
+
+@program_extension("SCT013")
+def verify_locked_by_caller(pctx: ProgramContext):
+    """Whole-program pass under the SCT013 id, two jobs:
+
+    1. **Verify** every ``# sctlint: locked-by-caller`` annotation
+       against the call graph: stale ones (no bare writes to guarded
+       fields left) and refuted/unprovable ones (a call site that
+       does not hold the lock, an escaping function, a public
+       function) are flagged at the annotation line.  Proven
+       annotations stay silent — but see (2): they are also now
+       redundant.
+    2. **Discharge** file-phase SCT013 findings the graph proves
+       safe: bare writes in a private, non-escaping function whose
+       every call site holds the guarding lock.  This replaces the
+       annotation with a proof — new helpers need no annotation at
+       all when their call sites are clean."""
+    graph = pctx.graph
+    for fctx in pctx.files:
+        flows = pctx.flows(fctx.path)
+        if flows is None:
+            continue
+        for info in flows.functions:
+            if not info.locked_by_caller or \
+                    info.locked_by_caller_line is None:
+                continue
+            key = f"{fctx.path}::{info.qualname}"
+            fnode = graph.functions.get(key)
+            if fnode is None:
+                continue
+            ln = info.locked_by_caller_line
+            locks = _bare_guard_locks(fnode, flows, graph)
+            if not locks:
+                yield pctx.violation(
+                    "SCT013", fctx.path, ln,
+                    f"stale locked-by-caller annotation on "
+                    f"{info.qualname}(): it has no bare writes to "
+                    f"lock-guarded fields — delete the annotation")
+                continue
+            for field, lock in sorted(locks.items()):
+                verdict, detail = _verdict(fnode, lock, graph)
+                if verdict == "proven":
+                    continue
+                label = {"refuted": "REFUTED",
+                         "unprovable": "unprovable"}[verdict]
+                yield pctx.violation(
+                    "SCT013", fctx.path, ln,
+                    f"locked-by-caller annotation on "
+                    f"{info.qualname}() is {label} for self.{field} "
+                    f"(guarded by {lock}): {detail} — fix the call "
+                    f"site or replace the annotation with a per-"
+                    f"line suppression stating why")
+        # (2) discharge: file findings proven safe without annotation
+        for v in pctx.file_violations.get(fctx.path, ()):
+            if v.rule != "SCT013":
+                continue
+            fnode = graph.node_at(fctx.path, v.line)
+            if fnode is None:
+                continue
+            locks = _bare_guard_locks(fnode, flows, graph)
+            if not locks:
+                continue
+            if all(_verdict(fnode, lock, graph)[0] == "proven"
+                   for lock in locks.values()):
+                pctx.discharge(v)

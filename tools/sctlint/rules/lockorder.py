@@ -1,0 +1,158 @@
+"""SCT014 — interprocedural lock-acquisition order must be acyclic.
+
+SCT011 already flags inconsistent nesting of two ``with`` blocks
+inside ONE module, where both orders are lexically visible.  The
+deadlock that survives that check is the split one: thread 1 holds
+the scheduler's dispatch lock and calls into a helper that takes the
+memory budget's lock, while thread 2 holds the budget lock inside a
+callback that re-enters the scheduler — no single function, or even
+single file, ever shows both orders.
+
+This rule sees it by construction:
+
+1. propagate the lexically-held lock sets (the same qualified
+   identities SCT013's class analysis uses) over every call edge to
+   a fixpoint — ``HeldIn(f)`` is every lock some caller chain holds
+   when ``f`` runs, each with the first witness chain that put it
+   there;
+2. every ``with <lock B>:`` taken while A is held (lexically or via
+   ``HeldIn``) is an edge A -> B in the lock-acquisition graph;
+3. a cycle in that graph is a potential deadlock.  Each cycle is
+   reported ONCE, anchored on one of its acquisition sites, with the
+   witness path for every edge in the message — for the common
+   two-lock inversion that is exactly the two call chains a reviewer
+   needs to see.
+
+May-call sites (unresolved dynamic calls) propagate nothing — the
+over-approximation is explicit in the graph, and treating "unknown
+callee" as "acquires everything" would flag every lock in the
+program.  The cost of that choice is bounded honestly: an edge the
+resolver cannot see is an edge this rule cannot check.
+"""
+
+from __future__ import annotations
+
+from ..core import ProgramContext, rule
+
+#: witness chains longer than this are cut off — a deadlock witness
+#: with eight frames is noise, and the fixpoint must terminate even
+#: on adversarial graphs
+_MAX_CHAIN = 8
+
+
+def _held_in(graph) -> dict:
+    """lock -> first witness chain, per function key.  A chain is a
+    tuple of ``"module.qual (path:line)"`` call-site frames from the
+    function that lexically held the lock down to this one."""
+    held: dict[str, dict] = {k: {} for k in graph.functions}
+    work = list(graph.functions)
+    while work:
+        ck = work.pop()
+        caller = graph.functions[ck]
+        inherited = held[ck]
+        for site in caller.sites:
+            if not site.callees:
+                continue
+            frame = f"{caller.display} ({caller.path}:{site.lineno})"
+            for callee in site.callees:
+                d = held.get(callee)
+                if d is None:
+                    continue
+                grew = False
+                for lock in site.held:
+                    if lock not in d:
+                        d[lock] = (frame,)
+                        grew = True
+                for lock, chain in inherited.items():
+                    if lock not in d and len(chain) < _MAX_CHAIN:
+                        d[lock] = chain + (frame,)
+                        grew = True
+                if grew:
+                    work.append(callee)
+    return held
+
+
+def _acquisition_edges(graph, held_in):
+    """(A, B) -> (witness text, anchor path, anchor line), first
+    witness wins."""
+    edges: dict[tuple, tuple] = {}
+    for fnode in graph.functions.values():
+        for acq in fnode.acquisitions:
+            site = f"{fnode.display} ({fnode.path}:{acq.lineno})"
+            for a in acq.held:
+                if a != acq.lock and (a, acq.lock) not in edges:
+                    edges[(a, acq.lock)] = (
+                        f"{a} -> {acq.lock} at {site}",
+                        fnode.path, acq.lineno)
+            for a, chain in held_in[fnode.key].items():
+                if a == acq.lock or a in acq.held:
+                    continue
+                if (a, acq.lock) not in edges:
+                    via = " -> ".join(chain)
+                    edges[(a, acq.lock)] = (
+                        f"{a} -> {acq.lock} at {site} "
+                        f"(held via {via})",
+                        fnode.path, acq.lineno)
+    return edges
+
+
+@rule("SCT014", "interprocedural-lock-order",
+      "the whole-program lock-acquisition graph (lexical holds "
+      "propagated over call edges) must be acyclic — a cycle is a "
+      "potential deadlock, reported with a witness path per edge",
+      scope="program")
+def check_lock_order(pctx: ProgramContext):
+    graph = pctx.graph
+    held_in = _held_in(graph)
+    edges = _acquisition_edges(graph, held_in)
+
+    # enumerate cycles: 2-cycles directly (the textbook inversion),
+    # longer ones via SCC + one simple cycle per component
+    reported: set = set()
+    for (a, b), (w_ab, path, line) in sorted(edges.items()):
+        if (b, a) not in edges or a >= b:
+            continue
+        w_ba = edges[(b, a)][0]
+        reported.update({a, b})
+        yield pctx.violation(
+            "SCT014", path, line,
+            f"lock-order cycle: {a} and {b} are acquired in both "
+            f"orders — potential deadlock.  Witness 1: {w_ab}.  "
+            f"Witness 2: {w_ba}.  Pick one global acquisition "
+            f"order")
+
+    # longer cycles: iterative Tarjan is overkill here — the lock
+    # graph is tiny; a DFS per unreported node finds a back edge
+    adj: dict[str, list] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, []).append(b)
+    seen_cycles: set = set()
+    for start in sorted(adj):
+        if start in reported:
+            continue
+        stack = [(start, (start,))]
+        visited = set()
+        while stack:
+            node, trail = stack.pop()
+            for nxt in adj.get(node, ()):
+                if nxt == start and len(trail) > 2:
+                    cyc = frozenset(trail)
+                    if cyc & reported or cyc in seen_cycles:
+                        continue
+                    seen_cycles.add(cyc)
+                    ws = []
+                    ring = trail + (start,)
+                    for i in range(len(ring) - 1):
+                        e = edges.get((ring[i], ring[i + 1]))
+                        if e:
+                            ws.append(e[0])
+                    _, path, line = edges[(trail[-1], start)]
+                    yield pctx.violation(
+                        "SCT014", path, line,
+                        f"lock-order cycle through "
+                        f"{' -> '.join(ring)} — potential deadlock."
+                        f"  Witnesses: {'; '.join(ws)}")
+                elif nxt not in visited and nxt not in trail \
+                        and len(trail) < _MAX_CHAIN:
+                    visited.add(nxt)
+                    stack.append((nxt, trail + (nxt,)))
