@@ -475,6 +475,18 @@ class AnnotationFactory:
         if cycle is None:
             cycle = self.next_cycle()
         cycle = int(cycle)
+        due = self.next_cycle()
+        if cycle > due:
+            # overlap refusal: a later cycle must not start while an
+            # earlier one is live (non-terminal) — two cycles
+            # interleaving their ingest cursors and swap verdicts on
+            # one directory is exactly the double-promote shape the
+            # incarnation fence exists to rule out
+            raise ValueError(
+                f"AnnotationFactory {self.name!r}: refusing to start "
+                f"cycle {cycle} while cycle {due} is live "
+                f"(non-terminal) — finish or roll back cycle {due} "
+                f"first")
         os.makedirs(self.cycle_dir(cycle), exist_ok=True)
         st = self.load_state(cycle)
         if st.get("terminal"):

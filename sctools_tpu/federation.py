@@ -31,10 +31,21 @@ ladder ACROSS process boundaries:
   ``scheduler.RunScheduler``: every ticket is terminal in exactly one
   of ``completed | failed | rejected | shed`` even when its worker
   died mid-run), and per-backend circuit-breaker state crosses
-  processes through :class:`FederatedBreakerRegistry` — a file-backed
-  transport with the same ``BreakerRegistry`` API, so one worker's
-  breaker trip short-circuits every OTHER worker's admission to the
-  accelerator (the PR-8 pre-attempt gate, now pool-wide).
+  processes through :class:`FederatedBreakerRegistry` — file-plane
+  state files and/or ``breaker`` messages on a
+  :class:`~sctools_tpu.transport.SocketTransport`, same
+  ``BreakerRegistry`` API either way — so one worker's breaker trip
+  short-circuits every OTHER worker's admission to the accelerator
+  (the PR-8 pre-attempt gate, now pool-wide).
+* **Transports** — every worker↔supervisor message (heartbeat, done
+  doorbell, refusal, breaker transition) rides the
+  ``sctools_tpu.transport`` seam: the stderr line protocol
+  (``FileTransport``) by default, length-prefixed TCP frames
+  (``SocketTransport``, ``transport="socket"``) where workers span
+  hosts without a shared stderr — with graceful degradation (a lost
+  doorbell falls back to the result-file probe, a partitioned
+  worker's breakers go LOCAL-ONLY, leases ride out delay up to
+  ``lease_timeout_s``) and epoch-fenced reconciliation on heal.
 * **Chaos** — ``kill_worker`` (SIGKILL at the Nth heartbeat) and
   ``lease_wedge`` (worker alive, heartbeats withheld: the split-brain
   partition) fire through ``ChaosMonkey.on_worker``, so the whole
@@ -70,6 +81,8 @@ import warnings
 from .registry import Pipeline, Transform
 from .runner import DEFAULT_FALLBACK_BACKEND, _Journal
 from .scheduler import RunRejected, RunShed, TERMINAL_STATES  # noqa: F401
+from .transport import (FileTransport, SocketTransport,
+                        LINE_RE, parse_fields)
 from .utils import telemetry
 from .utils.checkpoint import load_celldata, save_celldata
 from .utils.failsafe import BreakerRegistry, CircuitBreaker
@@ -82,47 +95,30 @@ from .utils.vclock import SYSTEM_CLOCK
 PROCESS_LOST = "process_lost"
 
 #: worker → supervisor protocol: one stderr line per event, pumped by
-#: a per-worker thread.  Anything not matching is worker noise (jax
-#: logging etc.) and deliberately does NOT refresh the lease — only
-#: explicit beats prove the worker LOOP is alive, not just the
-#: process.
-_LINE_RE = re.compile(r"^\[fed\] ([a-z_]+)((?: [a-z_]+=\S+)*)\s*$")
+#: a per-worker thread.  The codec lives in ``sctools_tpu.transport``
+#: (the FileTransport wire format); anything not matching is worker
+#: noise (jax logging etc.) and deliberately does NOT refresh the
+#: lease — only explicit beats prove the worker LOOP is alive, not
+#: just the process.
+_LINE_RE = LINE_RE
+_parse_fields = parse_fields
 
-
-def _parse_fields(raw: str) -> dict:
-    out = {}
-    for part in raw.split():
-        k, _, v = part.partition("=")
-        out[k] = v
-    return out
-
-
-#: serializes protocol emission across worker threads (heartbeat
-#: thread + main loop).  ``print`` issues SEPARATE write calls for
-#: the text and the newline, so two threads could interleave mid-line
-#: — and the supervisor pump drops unparseable lines as worker noise,
-#: which for a ``done`` line meant a ticket stuck in_flight on a
-#: healthy worker forever (caught by the chaos soak; the result-file
-#: recovery on the supervision tick is the belt to this brace).
-_SAY_LOCK = threading.Lock()
+#: the worker's default message plane: one protocol line per message
+#: on stderr (read by the supervisor's per-worker pump thread), with
+#: emission serialized across the heartbeat thread and the main loop
+#: by the transport's internal lock.
+_SAY_TRANSPORT = FileTransport("worker")
 
 
 def _say(kind: str, **fields) -> None:
-    """Worker-side: emit one protocol line on stderr (one atomic
-    write under the emission lock)."""
+    """Worker-side: emit one protocol message on the default
+    (stderr-line) transport."""
     if kind == "done" and os.environ.get("SCT_FED_TEST_MUTE_DONE"):
         # test hook: simulate the lost-commit-message transport fault
         # (the worker still commits the result file and keeps
         # beating) — exercises the supervisor's result-file recovery
         return
-    kv = " ".join(f"{k}={v}" for k, v in fields.items())
-    line = f"[fed] {kind}{(' ' + kv) if kv else ''}\n"
-    with _SAY_LOCK:
-        # sanctioned write-under-lock: this lock exists solely to make
-        # the line+flush atomic against the heartbeat thread; it
-        # guards nothing else
-        sys.stderr.write(line)  # sctlint: disable=SCT011
-        sys.stderr.flush()  # sctlint: disable=SCT011
+    _SAY_TRANSPORT.send("supervisor", kind, **fields)
 
 
 # ---------------------------------------------------------------------------
@@ -152,20 +148,41 @@ class FederatedBreaker(CircuitBreaker):
     ``.probe`` claim file (O_EXCL) backs the local claim, released by
     the verdict paths; a claim older than ``probe_stale_s``
     (wall-clock fact) is broken — its owner died without a verdict.
+
+    ``store_dir=None`` drops the file plane entirely (no shared
+    filesystem): transitions then replicate only through the
+    registry's transport (``on_transition``) and inbound
+    :meth:`apply_remote` messages, and the probe slot is exclusive
+    within this process only.
     """
 
-    def __init__(self, *args, store_dir: str, owner: str = "",
-                 metrics=None, probe_stale_s: float = 600.0, **kw):
+    def __init__(self, *args, store_dir: str | None, owner: str = "",
+                 metrics=None, journal=None,
+                 probe_stale_s: float = 600.0, on_transition=None,
+                 **kw):
         super().__init__(*args, **kw)
         self._dir = store_dir
         self._owner = owner
         self._metrics = metrics
+        self._journal = journal
         self._probe_stale_s = float(probe_stale_s)
-        base = _safe_name(self.signature)
-        self._file = os.path.join(store_dir, base + ".json")
-        self._probe_file = os.path.join(store_dir, base + ".probe")
+        if store_dir is None:
+            self._file = None
+            self._probe_file = None
+        else:
+            base = _safe_name(self.signature)
+            self._file = os.path.join(store_dir, base + ".json")
+            self._probe_file = os.path.join(store_dir, base + ".probe")
         self._holds_probe_file = False
         self._seen_epoch = 0
+        #: ``on_transition(signature, state, epoch)`` — the registry's
+        #: transport broadcast.  NEVER called under the breaker lock:
+        #: _publish RECORDS the transition in _pending_remote and the
+        #: verdict paths flush after release (a transport send retries
+        #: and backs off — that latency must not serialize every
+        #: sharer of this breaker)
+        self._on_transition = on_transition
+        self._pending_remote: list[tuple[str, int]] = []
 
     # -- remote sync ---------------------------------------------------
     def _refresh(self) -> None:
@@ -176,6 +193,8 @@ class FederatedBreaker(CircuitBreaker):
         # the sync step: it must happen inside the same lock hold as
         # the ruling that consumes it, or a remote `open` could land
         # between the read and the local decision
+        if self._file is None:
+            return  # no file plane: apply_remote is the only inbound
         try:
             with open(self._file) as f:
                 rec = json.load(f)
@@ -215,6 +234,15 @@ class FederatedBreaker(CircuitBreaker):
         # the local transition it mirrors: dropping the breaker lock
         # between deciding `open` and writing it would let a sharer
         # read the stale state and re-close a breaker we just tripped
+        if self._file is None:
+            # no file plane: the epoch still advances (the transport
+            # peers fence on it) and the transition queues for the
+            # out-of-lock broadcast
+            # deliberately NOT fence-checked: same advance-the-epoch
+            # semantics as the file path below
+            self._seen_epoch += 1  # sctlint: disable=SCT016
+            self._pending_remote.append((state, self._seen_epoch))
+            return
         lockdir = self._file + ".lock"
         held = False
         for _ in range(50):
@@ -245,6 +273,7 @@ class FederatedBreaker(CircuitBreaker):
                 # last-writer-wins on a torn race per the docstring)
                 # rather than committing under an existing one
                 self._seen_epoch = ep + 1  # sctlint: disable=SCT016
+                self._pending_remote.append((state, ep + 1))
             except OSError as e:
                 warnings.warn(
                     f"FederatedBreaker: could not publish {state!r} "
@@ -273,7 +302,8 @@ class FederatedBreaker(CircuitBreaker):
                 self._publish("open")
             if probe and self._holds_probe_file:
                 self._drop_probe_file()
-            return st
+        self._notify_remote()
+        return st
 
     def record_success(self) -> str:
         with self.lock:
@@ -283,7 +313,52 @@ class FederatedBreaker(CircuitBreaker):
                 self._publish("closed")
             if self._holds_probe_file:
                 self._drop_probe_file()
-            return st
+        self._notify_remote()
+        return st
+
+    def _notify_remote(self) -> None:
+        """Broadcast transitions queued by ``_publish`` — AFTER the
+        breaker lock is released: a transport send retries with
+        backoff, and that latency must never serialize the sharers."""
+        with self.lock:
+            pending, self._pending_remote = self._pending_remote, []
+        cb = self._on_transition
+        if cb is None:
+            return
+        for state, epoch in pending:
+            cb(self.signature, state, epoch)
+
+    def apply_remote(self, state: str, epoch: int,
+                     owner: str = "") -> bool:
+        """Apply a transition delivered over a TRANSPORT — the
+        socket-plane twin of ``_refresh``.  Epoch-fenced: a
+        transition at or below the last seen epoch is REFUSED
+        (returns False) — how a claimant that kept publishing behind
+        a partition loses on heal instead of double-committing its
+        stale verdict — and an accepted one replays the file plane's
+        open/closed semantics (fresh LOCAL cooldown on ``open``)."""
+        if state not in ("open", "closed"):
+            return False  # unknown state word: refuse, don't guess
+        ep = int(epoch)
+        with self.lock:
+            if ep <= self._seen_epoch:
+                return False  # at/behind the fence: refused on arrival
+            self._seen_epoch = ep
+            if state == "open":
+                self._state = self.OPEN
+                self._opened_at = self.clock.monotonic()
+                self._probe_claimed = False
+                self.opened_count += 1
+            elif self._state != self.CLOSED:
+                self._failures.clear()
+                self._state = self.CLOSED
+                self._opened_at = None
+                self._probe_claimed = False
+        if self._metrics is not None:
+            self._metrics.counter("fed.breaker_syncs",
+                                  signature=self.signature,
+                                  to=state).inc()
+        return True
 
     def try_acquire_probe(self) -> bool:
         with self.lock:
@@ -323,6 +398,10 @@ class FederatedBreaker(CircuitBreaker):
         # record or does not exist, so a disk-full failure happens on
         # the private temp and never leaves (or requires cleaning up)
         # a half-written claim another process could misjudge
+        if self._probe_file is None:
+            # no file plane: the local slot (already claimed by the
+            # caller) is the only probe exclusivity there is
+            return True
         tmp = f"{self._probe_file}.{self._owner or os.getpid()}.tmp"
         try:
             with open(tmp, "w") as f:
@@ -351,9 +430,10 @@ class FederatedBreaker(CircuitBreaker):
                     # injectable clock.
                     try:
                         with open(self._probe_file) as f:
-                            ts = float(json.load(f).get("ts", 0.0))
+                            stale_rec = json.load(f)
+                        ts = float(stale_rec.get("ts", 0.0))
                     except (OSError, ValueError):
-                        ts = 0.0
+                        stale_rec, ts = {}, 0.0
                     if time.time() - ts < self._probe_stale_s:
                         return False
                     # exactly ONE contender wins the break: rename is
@@ -367,6 +447,16 @@ class FederatedBreaker(CircuitBreaker):
                         return False  # another contender broke it
                     with contextlib.suppress(OSError):
                         os.unlink(bpath)
+                    # the audit line the crash-between-claim-and-
+                    # verdict window used to lack: WHO held the slot,
+                    # for how long, and who swept it
+                    if self._journal is not None:
+                        self._journal.write(
+                            "probe_reclaimed",
+                            signature=self.signature, reason="stale",
+                            prev_owner=str(stale_rec.get("owner", "")),
+                            by=self._owner,
+                            age_s=round(time.time() - ts, 3))
                 except OSError:
                     return False  # store dir gone: claim locally only
             return False
@@ -380,6 +470,8 @@ class FederatedBreaker(CircuitBreaker):
         # sharer win the claim while this process still thinks it
         # holds the slot
         self._holds_probe_file = False
+        if self._probe_file is None:
+            return
         try:
             os.unlink(self._probe_file)
         except OSError:
@@ -393,15 +485,37 @@ class FederatedBreakerRegistry(BreakerRegistry):
     scheduler and every worker accept it unchanged).  ``owner`` names
     this process in published transitions and probe claims, so the
     supervisor can clear a dead worker's claims
-    (:meth:`clear_probe_claims`)."""
+    (:meth:`clear_probe_claims`).
 
-    def __init__(self, store_dir: str, clock=None, owner: str = "",
-                 metrics=None, **breaker_defaults):
+    Two replication planes compose (either may be absent):
+
+    * the FILE plane — ``store_dir`` state files, exactly as before;
+      ``store_dir=None`` turns it off (no shared filesystem).
+    * the TRANSPORT plane — give it a ``transport`` and ``peers``,
+      and every local transition is broadcast as a ``breaker``
+      message after the verdict; inbound messages land through
+      :meth:`apply_remote`, epoch-fenced per breaker so a stale
+      claimant's verdict published behind a partition is refused on
+      heal.  The transport's ``on_rejoin`` hook is wired to
+      :meth:`sync_peer`: the first delivery after a partition
+      re-offers the full state, epoch-max wins — the no-split-brain
+      reconciliation step.
+    """
+
+    def __init__(self, store_dir: str | None, clock=None,
+                 owner: str = "", metrics=None, journal=None,
+                 transport=None, peers=(), **breaker_defaults):
         super().__init__(clock=clock, **breaker_defaults)
-        self.store_dir = str(store_dir)
-        os.makedirs(self.store_dir, exist_ok=True)
+        self.store_dir = None if store_dir is None else str(store_dir)
+        if self.store_dir is not None:
+            os.makedirs(self.store_dir, exist_ok=True)
         self.owner = owner
         self.metrics = metrics
+        self.journal = journal
+        self.transport = transport
+        self.peers = tuple(peers or ())
+        if transport is not None and transport.on_rejoin is None:
+            transport.on_rejoin = self.sync_peer
 
     def get(self, signature: str, **kw) -> CircuitBreaker:
         signature = str(signature)
@@ -412,19 +526,61 @@ class FederatedBreakerRegistry(BreakerRegistry):
                 merged.setdefault("clock", self.clock)
                 b = self._breakers[signature] = FederatedBreaker(
                     signature=signature, store_dir=self.store_dir,
-                    owner=self.owner, metrics=self.metrics, **merged)
+                    owner=self.owner, metrics=self.metrics,
+                    journal=self.journal,
+                    on_transition=(self._broadcast if self.transport
+                                   is not None else None), **merged)
             return b
+
+    # -- the transport plane -------------------------------------------
+    def _broadcast(self, signature: str, state: str,
+                   epoch: int) -> None:
+        """Send one local transition to every peer (called by the
+        breaker's verdict paths AFTER its lock is released).  A send
+        that gives up is fine: the peer is partitioned, keeps making
+        LOCAL-ONLY decisions, and :meth:`sync_peer` re-offers
+        everything on heal."""
+        for peer in self.peers:
+            self.transport.send(peer, "breaker", sig=signature,
+                                state=state, epoch=epoch,
+                                owner=self.owner)
+
+    def apply_remote(self, signature: str, state: str, epoch: int,
+                     owner: str = "") -> bool:
+        """Inbound transport plane: route a peer's transition to its
+        breaker, which fences it by epoch (True = applied)."""
+        return self.get(str(signature)).apply_remote(
+            str(state), epoch, owner=owner)
+
+    def sync_peer(self, peer: str) -> None:
+        """Re-offer every known breaker's state at its current epoch
+        to ``peer`` — the receiver's epoch fence accepts what is news
+        and refuses what is stale, so sending is always safe.  Wired
+        as the transport's ``on_rejoin`` hook: healing a partition
+        IS a sync."""
+        if self.transport is None:
+            return
+        for sig in self.signatures():
+            b = self.get(sig)
+            with b.lock:
+                ep = b._seen_epoch
+                state = "open" if b._state != b.CLOSED else "closed"
+            if ep > 0:
+                self.transport.send(peer, "breaker", sig=sig,
+                                    state=state, epoch=ep,
+                                    owner=self.owner)
 
     def signatures(self) -> list[str]:
         """Every signature this registry has seen — locally OR
         published to the store by another process."""
         local = set(super().signatures())
-        try:
-            for fn in os.listdir(self.store_dir):
-                if fn.endswith(".json") and not fn.endswith(".tmp"):
-                    local.add(fn[:-5])
-        except OSError:
-            pass  # store dir gone: local view is all there is
+        if self.store_dir is not None:
+            try:
+                for fn in os.listdir(self.store_dir):
+                    if fn.endswith(".json") and not fn.endswith(".tmp"):
+                        local.add(fn[:-5])
+            except OSError:
+                pass  # store dir gone: local view is all there is
         return sorted(local)
 
     def snapshot(self) -> dict:
@@ -442,6 +598,8 @@ class FederatedBreakerRegistry(BreakerRegistry):
         # (supervisor lock held): the claims must be gone before the
         # ruling completes, or a respawned worker could collide with
         # its predecessor's stale probe slot
+        if self.store_dir is None:
+            return 0  # no file plane: no claim files to sweep
         cleared = 0
         try:
             names = os.listdir(self.store_dir)
@@ -457,6 +615,14 @@ class FederatedBreakerRegistry(BreakerRegistry):
                 if rec.get("owner") == owner:
                     os.unlink(path)
                     cleared += 1
+                    if self.journal is not None:
+                        self.journal.write(
+                            "probe_reclaimed", signature=fn[:-6],
+                            reason="owner_lost", prev_owner=owner,
+                            by=self.owner,
+                            age_s=round(time.time()
+                                        - float(rec.get("ts", 0.0)),
+                                        3))
             except (OSError, ValueError):
                 continue  # racing claim churn: nothing of ours here
         return cleared
@@ -619,6 +785,18 @@ class FederationSupervisor:
         breaker transport and the supervisor journal all live here.
     n_workers, worker_capacity : int
         Pool size and per-worker concurrent-assignment bound.
+    transport : str
+        ``"file"`` (default): worker messages ride the stderr line
+        protocol, parsed by the per-worker pump thread.
+        ``"socket"``: the supervisor listens on a
+        :class:`~sctools_tpu.transport.SocketTransport`; workers
+        connect to the address in ``config.json`` and push the same
+        protocol messages as length-prefixed frames (tagged with
+        their ``gen`` so a fenced predecessor behind a healed
+        partition is refused on the record), and their breaker
+        transitions ride the same socket, epoch-fenced by
+        :meth:`FederatedBreakerRegistry.apply_remote`.  The stderr
+        pipe stays attached for noise draining and exit detection.
     lease_timeout_s : float
         Lease age (on ``clock``) past which a worker with no credited
         heartbeat is ruled :data:`PROCESS_LOST`.  Must comfortably
@@ -671,6 +849,7 @@ class FederationSupervisor:
 
     def __init__(self, fed_dir: str, *, n_workers: int = 2,
                  worker_capacity: int = 1,
+                 transport: str = "file",
                  lease_timeout_s: float = 60.0,
                  heartbeat_s: float = 1.0, poll_s: float = 0.25,
                  tenant_max_queued: int = 16,
@@ -710,16 +889,36 @@ class FederationSupervisor:
         self.env = env
         self.journal = _Journal(os.path.join(self.fed_dir,
                                              "journal.jsonl"))
+        if transport not in ("file", "socket"):
+            raise ValueError(f"unknown transport {transport!r} "
+                             f"(file | socket)")
+        self.transport_kind = transport
+        #: socket mode: the supervisor listens, workers connect from
+        #: config.json's address and push the same protocol messages
+        #: the stderr pump would have parsed.  The stderr pipe stays
+        #: attached either way — it drains worker noise and its EOF
+        #: is still how the reap path notices an exit.
+        self.transport = None
+        if transport == "socket":
+            self.transport = SocketTransport(
+                "supervisor", clock=self.clock, journal=self.journal,
+                metrics=self.metrics,
+                on_message=self._on_net_message)
         self.breakers = FederatedBreakerRegistry(
             os.path.join(self.fed_dir, "breakers"), clock=self.clock,
             owner="supervisor", metrics=self.metrics,
-            **(breaker_defaults or {}))
+            journal=self.journal, **(breaker_defaults or {}))
         self._config = {
             "heartbeat_s": self.heartbeat_s, "poll_s": self.poll_s,
             "breaker": dict(breaker_defaults or {}),
             "runner": dict(runner_config or {}),
             "init_module": init_module,
             "chaos_specs": dict(chaos_specs or {}),
+            "transport": ({"kind": "socket",
+                           "host": self.transport.host,
+                           "port": self.transport.port}
+                          if self.transport is not None
+                          else {"kind": "file"}),
         }
         self._lock = threading.RLock()
         self._queue: list[_Ticket] = []
@@ -848,6 +1047,45 @@ class FederationSupervisor:
                                      OSError):
                 w.proc.wait(timeout=30)
             self._on_exit(w)
+
+    def _on_net_message(self, frm: str, kind: str,
+                        fields: dict) -> None:
+        """Socket-mode twin of the pump parse: runs on a transport
+        receiver thread.  Messages carry ``gen`` — the socket plane's
+        fencing evidence: a predecessor incarnation still talking
+        through a healed partition must not refresh the CURRENT
+        incarnation's lease, and its commit is refused on the
+        record (the same at-most-once story the epoch guard tells,
+        one layer earlier)."""
+        if kind == "breaker":
+            # breaker transitions self-fence by EPOCH inside
+            # apply_remote, so they are deliberately gen-independent:
+            # a true state transition is news no matter which
+            # incarnation reports it
+            self.breakers.apply_remote(
+                fields.get("sig", ""), fields.get("state", ""),
+                int(fields.get("epoch", 0)),
+                owner=str(fields.get("owner", frm)))
+            return
+        with self._lock:
+            w = self._workers.get(frm)
+            stale = (w is None
+                     or int(fields.get("gen", w.gen)) != w.gen)
+        if stale:
+            if kind == "done" and w is not None:
+                self.journal.write(
+                    "commit_refused",
+                    ticket=str(fields.get("ticket", "")), worker=frm,
+                    epoch=int(fields.get("epoch", -1)),
+                    by="supervisor", reason="stale_gen")
+                self.metrics.counter("fed.fenced_commits").inc()
+            return
+        if kind in ("beat", "hello"):
+            self._on_beat(w)
+        elif kind == "done":
+            self._on_done(w, fields)
+        elif kind == "refused":
+            self._on_refused(w, fields)
 
     def _on_beat(self, w: _Worker) -> None:
         with self._lock:
@@ -1409,6 +1647,8 @@ class FederationSupervisor:
         # heartbeat crediting and could rule a healthy worker
         # process_lost off a slow disk (SCT011)
         out["breakers"] = self.breakers.snapshot()
+        if self.transport is not None:
+            out["transport"] = self.transport.stats()
         return out
 
     def drain(self, timeout: float | None = None) -> bool:
@@ -1480,6 +1720,8 @@ class FederationSupervisor:
                     self._shed_locked(t, "shutdown")
         if self._monitor is not None:
             self._monitor.join(timeout=10)
+        if self.transport is not None:
+            self.transport.close()
         mpath = os.path.join(self.fed_dir, "metrics.json")
         try:
             self.metrics.write(mpath)
@@ -1549,17 +1791,48 @@ def worker_main(fed_dir: str, worker_id: str, gen: int = 0) -> int:
 
             chaos = ChaosMonkey.from_spec(spec)
             break
+    #: the worker's own journal: the inner scheduler appends run
+    #: lifecycle here; in socket mode the transport's net_* records
+    #: and the breakers' probe audit land in the same file
+    #: (`_Journal` appends are line-atomic across instances)
+    wjournal = _Journal(os.path.join(wdir, "journal.jsonl"))
+    tcfg = cfg.get("transport") or {}
+    net = None
+    if tcfg.get("kind") == "socket":
+        net = SocketTransport(worker_id, chaos=chaos,
+                              journal=wjournal, seed=gen)
+        net.connect("supervisor", tcfg["host"], int(tcfg["port"]))
+
+    def say(kind: str, **fields) -> None:
+        """The worker's message plane: stderr lines by default, the
+        socket when config.json says so.  Socket messages carry this
+        incarnation's ``gen`` (the supervisor refuses a stale gen's
+        commit) and beats never retry — a lost beat is healed by the
+        next one, while done/refused spend the full retry budget
+        (and even a gave-up degrades to the result-file probe)."""
+        if net is None:
+            _say(kind, **fields)
+            return
+        if kind == "done" and os.environ.get("SCT_FED_TEST_MUTE_DONE"):
+            return  # same lost-doorbell test hook as the file plane
+        fields.setdefault("gen", gen)
+        net.send("supervisor", kind,
+                 retries=0 if kind in ("beat", "noise") else None,
+                 **fields)
+
     breakers = FederatedBreakerRegistry(
         os.path.join(fed_dir, "breakers"), owner=worker_id,
+        journal=wjournal, transport=net,
+        peers=("supervisor",) if net is not None else (),
         **(cfg.get("breaker") or {}))
-    _say("hello", pid=os.getpid(), gen=gen)
+    say("hello", pid=os.getpid(), gen=gen)
     stop_beats = threading.Event()
     seq = [0]
 
     def _beats():
         while not stop_beats.wait(heartbeat_s):
             seq[0] += 1
-            _say("beat", seq=seq[0])
+            say("beat", seq=seq[0])
 
     hb = threading.Thread(target=_beats, daemon=True,
                           name="sct-fed-heartbeat")
@@ -1588,7 +1861,7 @@ def worker_main(fed_dir: str, worker_id: str, gen: int = 0) -> int:
             try:
                 names = sorted(os.listdir(inbox))
             except OSError as e:
-                _say("noise", inbox_error=type(e).__name__)
+                say("noise", inbox_error=type(e).__name__)
             ran = False
             for fn in names:
                 if not fn.endswith(".json"):
@@ -1599,7 +1872,7 @@ def worker_main(fed_dir: str, worker_id: str, gen: int = 0) -> int:
                         assign = json.load(f)
                 except (OSError, ValueError):
                     continue  # partial write: next scan reads it whole
-                _run_assignment(sched, assign, wdir, fenced)
+                _run_assignment(sched, assign, wdir, fenced, say=say)
                 with contextlib.suppress(OSError):
                     os.unlink(apath)
                 ran = True
@@ -1614,6 +1887,8 @@ def worker_main(fed_dir: str, worker_id: str, gen: int = 0) -> int:
         stop_beats.set()
         sched.shutdown(wait=True, timeout=60)
         hb.join(timeout=5)
+        if net is not None:
+            net.close()
     return rc
 
 
@@ -1633,10 +1908,12 @@ def _subst_ticket_dir(params: dict, tdir: str) -> dict:
             for k, v in params.items()}
 
 
-def _run_assignment(sched, assign: dict, wdir: str, fenced) -> None:
+def _run_assignment(sched, assign: dict, wdir: str, fenced,
+                    say=_say) -> None:
     """Run one assignment through the worker's inner scheduler and
     commit the result under the assignment epoch (fence re-checked at
-    the commit boundary)."""
+    the commit boundary).  ``say`` is the worker's message plane
+    (stderr lines or the socket transport)."""
     tid, epoch, tdir = assign["ticket"], assign["epoch"], assign["dir"]
     try:
         with open(os.path.join(tdir, "ticket.json")) as f:
@@ -1646,8 +1923,8 @@ def _run_assignment(sched, assign: dict, wdir: str, fenced) -> None:
         # an unreadable ticket must still reach a TERMINAL state —
         # going silent here would leave the handle blocked forever
         # (the worker keeps heartbeating, so no lease ever expires)
-        _say("done", ticket=tid, epoch=epoch, status="failed")
-        _say("noise", ticket=tid, load_error=type(e).__name__)
+        say("done", ticket=tid, epoch=epoch, status="failed")
+        say("noise", ticket=tid, load_error=type(e).__name__)
         return
     pipeline = Pipeline([Transform(name, backend=backend,
                                    **_subst_ticket_dir(params, tdir))
@@ -1673,7 +1950,7 @@ def _run_assignment(sched, assign: dict, wdir: str, fenced) -> None:
         # the supervisor revoked this worker's lease while the run
         # executed (split-brain partition): DO NOT COMMIT — the
         # requeued epoch's owner is the one that counts
-        _say("refused", ticket=tid, epoch=epoch)
+        say("refused", ticket=tid, epoch=epoch)
         return
     rbase = os.path.join(tdir, f"result-{epoch:03d}")
     try:
@@ -1688,5 +1965,5 @@ def _run_assignment(sched, assign: dict, wdir: str, fenced) -> None:
         # terminal verdict for this epoch: report it failed so the
         # supervisor resolves the handle instead of waiting forever
         status = "failed"
-        _say("noise", ticket=tid, commit_error=type(e).__name__)
-    _say("done", ticket=tid, epoch=epoch, status=status)
+        say("noise", ticket=tid, commit_error=type(e).__name__)
+    say("done", ticket=tid, epoch=epoch, status=status)

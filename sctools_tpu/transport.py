@@ -1,0 +1,497 @@
+"""The network as a fault domain: message transports for federation.
+
+Every cross-process notification in the stack — worker heartbeats,
+ticket-commit doorbells, federated breaker transitions — is a
+MESSAGE, and until now every message rode one of two implicit
+transports: a worker's stderr pipe (the ``[fed]`` line protocol) or
+the shared filesystem (breaker state files).  This module names the
+seam: a :class:`Transport` delivers ``(kind, fields)`` messages to a
+named peer, and the callers' contracts are written against the seam,
+not the medium.
+
+Two implementations:
+
+* :class:`FileTransport` — the existing behaviour, refactored behind
+  the seam: one protocol line per message on a byte stream (the
+  worker's stderr), parsed by the supervisor's pump thread.  Loss
+  semantics unchanged: a mangled line is worker noise, and the
+  durable artifact (result file, breaker state file) remains the
+  commit of record.
+* :class:`SocketTransport` — length-prefixed JSON frames over TCP on
+  localhost: per-peer sequence numbers for at-most-once delivery
+  (duplicates are acked but never re-delivered), bounded send/ack
+  timeouts, seeded-jitter retry/backoff (the runner's
+  :class:`~sctools_tpu.runner.RetryPolicy` schedule on the
+  injectable clock), and per-peer partition tracking.
+
+The headline invariant is GRACEFUL DEGRADATION, not delivery: a
+``send`` that exhausts its retries returns ``False`` and journals
+``net_gave_up`` — it never raises, never blocks unboundedly, and the
+caller's existing ladder takes over (a lost beat is healed by the
+next beat; a lost ``done`` doorbell by the supervisor's result-file
+probe; an unreachable breaker sharer by LOCAL-ONLY decisions until
+the partition heals and epochs reconcile).  The first gave-up
+against a previously-reachable peer journals ``net_partition_entered``;
+the next successful delivery journals ``net_rejoin`` and fires the
+``on_rejoin`` hook (the breaker registry re-syncs its state there,
+epoch-max wins — the no-split-brain proof sctreport's ``-- network --``
+section joins on).
+
+Chaos: every send attempt consults :meth:`ChaosMonkey.on_network`
+(``net_drop`` / ``net_delay`` / ``net_dup`` / ``net_partition``,
+windows specced ``"<peer>@net"``).  The faults are ruled BEFORE the
+real socket is touched, so a partition soak burns no real timeouts:
+drop/partition fail the attempt instantly, delay advances the
+injectable clock, dup puts the frame on the wire twice and the
+receiver's sequence dedup proves at-most-once.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import random
+import re
+import socket
+import struct
+import sys
+import threading
+
+from .runner import RetryPolicy
+from .utils.failsafe import classify_error
+from .utils.vclock import SYSTEM_CLOCK
+
+# ---------------------------------------------------------------------------
+# The line codec (the FileTransport wire format)
+# ---------------------------------------------------------------------------
+
+#: one protocol line per message on the byte stream.  Anything not
+#: matching is peer noise (jax logging etc.) and deliberately does
+#: NOT count as a message — only explicit protocol lines carry state.
+LINE_RE = re.compile(r"^\[fed\] ([a-z_]+)((?: [a-z_]+=\S+)*)\s*$")
+
+
+def parse_fields(raw: str) -> dict:
+    """Decode the ``k=v`` tail of a protocol line."""
+    out = {}
+    for part in raw.split():
+        k, _, v = part.partition("=")
+        out[k] = v
+    return out
+
+
+def encode_line(kind: str, **fields) -> str:
+    """One protocol line (newline-terminated) for ``kind``/fields."""
+    kv = " ".join(f"{k}={v}" for k, v in fields.items())
+    return f"[fed] {kind}{(' ' + kv) if kv else ''}\n"
+
+
+def decode_line(line: str) -> tuple[str, dict] | None:
+    """Parse one stream line; ``None`` for non-protocol noise."""
+    m = LINE_RE.match(line.strip())
+    if m is None:
+        return None
+    return m.group(1), parse_fields(m.group(2))
+
+
+# ---------------------------------------------------------------------------
+# The transport seam
+# ---------------------------------------------------------------------------
+
+class Transport:
+    """Delivers ``(kind, fields)`` messages to named peers.
+
+    ``send`` is best-effort with bounded latency: ``True`` means the
+    message reached the peer (or, for stream transports, the stream),
+    ``False`` means delivery was abandoned and the caller's
+    degradation ladder owns recovery.  A transport never raises out
+    of ``send`` and never blocks past its configured timeouts."""
+
+    name = ""
+
+    def send(self, peer: str, kind: str, retries: int | None = None,
+             **fields) -> bool:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    def stats(self) -> dict:
+        return {}
+
+
+class FileTransport(Transport):
+    """The shared-filesystem-era message plane, behind the seam: one
+    protocol line per message on a byte stream (default: this
+    process's stderr, read by the federation supervisor's per-worker
+    pump thread).  The stream IS the peer — ``peer`` is accepted for
+    interface parity and ignored.
+
+    Loss semantics are the stream's: a line mangled in transit is
+    dropped by the reader as noise, which is exactly why the durable
+    artifacts (result files, breaker state files) stay the commit of
+    record and this plane stays a doorbell."""
+
+    def __init__(self, name: str = "", stream=None):
+        self.name = name
+        self._stream = stream
+        # serializes emission across caller threads (heartbeat thread
+        # + main loop): ``print`` issues SEPARATE write calls for the
+        # text and the newline, so two threads could interleave
+        # mid-line — and the supervisor pump drops unparseable lines
+        # as noise, which for a ``done`` line meant a ticket stuck
+        # in_flight on a healthy worker forever (caught by the chaos
+        # soak; the result-file recovery probe is the belt to this
+        # brace)
+        self._lock = threading.Lock()
+        self._sent = 0
+
+    def send(self, peer: str, kind: str, retries: int | None = None,
+             **fields) -> bool:
+        line = encode_line(kind, **fields)
+        stream = self._stream if self._stream is not None else sys.stderr
+        with self._lock:
+            # sanctioned write-under-lock: this lock exists solely to
+            # make the line+flush atomic against the caller's other
+            # threads; it guards nothing else
+            try:
+                stream.write(line)  # sctlint: disable=SCT011
+                stream.flush()  # sctlint: disable=SCT011
+            except (OSError, ValueError):
+                return False  # stream gone (teardown): the ladder owns it
+            self._sent += 1
+        return True
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"sent": self._sent}
+
+
+def _frame(obj: dict) -> bytes:
+    blob = json.dumps(obj, separators=(",", ":")).encode()
+    return struct.pack(">I", len(blob)) + blob
+
+
+def _recv_exact(conn: socket.socket, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            return None  # peer closed mid-frame
+        buf += chunk
+    return buf
+
+
+def _read_frame(conn: socket.socket) -> dict | None:
+    head = _recv_exact(conn, 4)
+    if head is None:
+        return None
+    (size,) = struct.unpack(">I", head)
+    if size > 1 << 22:  # 4 MiB: a notification plane, not a data plane
+        return None
+    body = _recv_exact(conn, size)
+    if body is None:
+        return None
+    try:
+        obj = json.loads(body)
+    except ValueError:
+        return None
+    return obj if isinstance(obj, dict) else None
+
+
+class SocketTransport(Transport):
+    """Length-prefixed JSON frames over TCP on localhost.
+
+    Frames carry ``{v, from, inst, seq, kind, fields}``; the receiver
+    acks every frame with ``{ack: seq}`` on the same connection and
+    delivers each ``(from, inst, seq)`` at most once — ``inst`` is a
+    per-process incarnation tag, so a respawned worker restarting its
+    sequence numbers is a NEW sender, never a replay.  ``send`` is
+    synchronous per peer (a per-peer lock serializes frames in
+    sequence order): write the frame, wait for the matching ack under
+    ``ack_timeout_s``, and on failure retry up to ``retries`` times
+    with the :class:`~sctools_tpu.runner.RetryPolicy` seeded-jitter
+    schedule on the injectable ``clock``.  Real socket errors are
+    classified through the ``failsafe`` taxonomy and recorded on the
+    retry/gave-up journal records.
+
+    Telemetry (the ``JOURNAL_PROTOCOLS['transport']`` contract):
+    every message terminals exactly once — ``net_sent`` (delivered +
+    acked) or ``net_gave_up`` (abandoned; the caller degrades) — with
+    ``net_retry`` records in between; the first gave-up against a
+    reachable-until-now peer journals ``net_partition_entered``, the
+    next delivery ``net_rejoin`` (and fires ``on_rejoin(peer)``, the
+    breaker registry's epoch-reconcile hook).  ``net.rtt_ms``
+    observes send-to-ack latency, ``net.retries`` counts re-issued
+    attempts.
+    """
+
+    def __init__(self, name: str, *, clock=None, journal=None,
+                 metrics=None, chaos=None, host: str = "127.0.0.1",
+                 ack_timeout_s: float = 5.0, retries: int = 3,
+                 backoff: RetryPolicy | None = None, seed: int = 0,
+                 on_message=None, on_rejoin=None):
+        self.name = name
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
+        self.journal = journal
+        self.metrics = metrics
+        self.chaos = chaos
+        self.ack_timeout_s = float(ack_timeout_s)
+        self.retries = int(retries)
+        self.backoff = backoff if backoff is not None else RetryPolicy(
+            base_delay_s=0.05, max_delay_s=1.0, jitter=0.5, seed=seed)
+        self.seed = int(seed)
+        #: ``on_message(from_name, kind, fields)`` — called on a
+        #: receiver thread for every first-time delivery
+        self.on_message = on_message
+        #: ``on_rejoin(peer)`` — called (off the sender's thread of
+        #: control, but synchronously within ``send``) when a
+        #: partitioned peer becomes reachable again
+        self.on_rejoin = on_rejoin
+        #: per-process incarnation tag: a restarted sender must never
+        #: look like a replay of its predecessor's sequence numbers
+        self._inst = f"{os.getpid()}.{id(self):x}"
+        self._lock = threading.Lock()
+        self._peers: dict[str, tuple[str, int]] = {}
+        self._conns: dict[str, socket.socket] = {}
+        # one lock per peer: frames toward a peer must hit the wire
+        # in sequence order (the receiver's at-most-once dedup drops
+        # seq <= last-seen, so an out-of-order retry would be acked
+        # and silently lost) — but two different peers' exchanges
+        # never serialize against each other
+        self._peer_locks: dict[str, threading.Lock] = {}
+        self._send_seq: dict[str, int] = {}
+        self._recv_seq: dict[tuple[str, str], int] = {}
+        self._partitioned: set[str] = set()
+        self._counts: dict[str, dict] = {}
+        self._closed = False
+        self._threads: list[threading.Thread] = []
+        self._listener = socket.create_server((host, 0))
+        self.host, self.port = self._listener.getsockname()[:2]
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name=f"sct-net-accept-{name}")
+        t.start()
+        self._threads.append(t)
+
+    # -- receive side ---------------------------------------------------
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed: shutdown
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True,
+                                 name=f"sct-net-serve-{self.name}")
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn: socket.socket) -> None:
+        with contextlib.suppress(OSError), conn:
+            while True:
+                frame = _read_frame(conn)
+                if frame is None:
+                    return  # EOF / unframeable garbage: drop the conn
+                frm = str(frame.get("from", ""))
+                inst = str(frame.get("inst", ""))
+                seq = int(frame.get("seq", 0))
+                # ack FIRST, duplicates included: the sender's retry
+                # loop only stops on the ack, and a dup means a
+                # previous ack was lost in transit
+                conn.sendall(_frame({"ack": seq}))
+                with self._lock:
+                    last = self._recv_seq.get((frm, inst), 0)
+                    if seq <= last:
+                        continue  # at-most-once: seen it, ack was enough
+                    self._recv_seq[(frm, inst)] = seq
+                    cb = self.on_message
+                if cb is not None:
+                    cb(frm, str(frame.get("kind", "")),
+                       dict(frame.get("fields") or {}))
+
+    # -- send side ------------------------------------------------------
+    def connect(self, peer: str, host: str, port: int) -> None:
+        """Register ``peer``'s listening address; the connection
+        itself is opened lazily on the first send (and re-opened
+        after any wire failure)."""
+        with self._lock:
+            self._peers[peer] = (host, int(port))
+            self._peer_locks.setdefault(peer, threading.Lock())
+
+    def peers(self) -> list[str]:
+        with self._lock:
+            return sorted(self._peers)
+
+    def _wire_send(self, peer: str, payload: bytes, seq: int,
+                   dup: bool = False) -> bool:
+        """One real attempt: frame on the wire, wait for the matching
+        ack.  Any wire failure drops the cached connection (the next
+        attempt reconnects) and reports False."""
+        conn = self._conns.get(peer)
+        try:
+            if conn is None:
+                addr = self._peers[peer]
+                conn = socket.create_connection(
+                    addr, timeout=self.ack_timeout_s)
+                self._conns[peer] = conn
+            conn.settimeout(self.ack_timeout_s)
+            conn.sendall(payload)
+            if dup:
+                conn.sendall(payload)  # chaos net_dup: same seq twice
+            while True:
+                ack = _read_frame(conn)
+                if ack is None:
+                    raise OSError("connection closed awaiting ack")
+                got = int(ack.get("ack", -1))
+                if got >= seq:
+                    return True
+                # stale ack (a prior attempt's dup): keep reading
+        except OSError:
+            self._conns.pop(peer, None)
+            with contextlib.suppress(OSError):
+                if conn is not None:
+                    conn.close()
+            return False
+        except KeyError:
+            return False  # never connected to this peer
+
+    def send(self, peer: str, kind: str, retries: int | None = None,
+             **fields) -> bool:
+        if self._closed:
+            return False
+        with self._lock:
+            plock = self._peer_locks.setdefault(peer, threading.Lock())
+        # the exchange runs under the per-peer lock (wire order is a
+        # correctness invariant — see _peer_locks); everything with
+        # its own latency or lock (journal appends, metrics, the
+        # on_rejoin hook) is RECORDED during the exchange and emitted
+        # after release, so one peer's slow disk never serializes
+        # another peer's sends
+        with plock:
+            out = self._exchange(peer, kind, retries, fields)
+        seq = out["seq"]
+        if self.metrics is not None:
+            for _ in out["retried"]:
+                self.metrics.counter("net.retries", peer=peer).inc()
+            if out["sent"]:
+                self.metrics.histogram("net.rtt_ms", peer=peer).observe(
+                    out["rtt_ms"])
+        if self.journal is not None:
+            for attempt, err in out["retried"]:
+                self.journal.write("net_retry", peer=peer, kind=kind,
+                                   seq=seq, attempt=attempt, error=err)
+            if out["rejoined"]:
+                self.journal.write("net_rejoin", peer=peer, kind=kind,
+                                   seq=seq)
+            if out["sent"]:
+                self.journal.write("net_sent", peer=peer, kind=kind,
+                                   seq=seq, attempt=out["attempt"],
+                                   rtt_ms=round(out["rtt_ms"], 3))
+            else:
+                self.journal.write("net_gave_up", peer=peer, kind=kind,
+                                   seq=seq, attempts=out["attempt"],
+                                   error=out["error"])
+                if out["entered"]:
+                    self.journal.write("net_partition_entered",
+                                       peer=peer, kind=kind, seq=seq)
+        if out["rejoined"] and self.on_rejoin is not None:
+            self.on_rejoin(peer)
+        return out["sent"]
+
+    def _exchange(self, peer: str, kind: str, retries: int | None,
+                  fields: dict) -> dict:
+        """The attempt loop (caller holds the per-peer lock): returns
+        the outcome record ``send`` journals after release."""
+        # sctlint: io-under-lock — the clock.sleep sites below (chaos
+        # net_delay, retry backoff) are ordering-mandated under the
+        # per-peer lock: releasing it mid-message would let a later
+        # seq overtake this one on the wire and be deduped as its
+        # replay.  Free under a VirtualClock (zero real sleeps in
+        # soaks); bounded by ack_timeout_s and the backoff cap live.
+        with self._lock:
+            seq = self._send_seq.get(peer, 0) + 1
+            self._send_seq[peer] = seq
+            counts = self._counts.setdefault(
+                peer, {"sent": 0, "retries": 0, "gave_up": 0})
+        payload = _frame({"v": 1, "from": self.name,
+                          "inst": self._inst, "seq": seq,
+                          "kind": kind, "fields": fields})
+        attempts = (self.retries if retries is None
+                    else int(retries)) + 1
+        rng = random.Random((self.seed, self.name, peer, seq).__repr__())
+        out = {"seq": seq, "sent": False, "attempt": attempts,
+               "rtt_ms": 0.0, "error": None, "retried": [],
+               "entered": False, "rejoined": False}
+        for attempt in range(1, attempts + 1):
+            ruling = (self.chaos.on_network(peer)
+                      if self.chaos is not None else None)
+            mode = ruling["mode"] if ruling is not None else None
+            t0 = self.clock.monotonic()
+            if mode in ("net_drop", "net_partition"):
+                # ruled unreachable BEFORE the real socket: the frame
+                # never exists, no real timeout is burned
+                ok, err = False, f"chaos:{mode}"
+            else:
+                if mode == "net_delay":
+                    # injected latency on the INJECTABLE clock
+                    self.clock.sleep(float(ruling["delay_s"]))
+                try:
+                    ok = self._wire_send(peer, payload, seq,
+                                         dup=(mode == "net_dup"))
+                    err = None if ok else "wire"
+                except Exception as e:  # pragma: no cover — belt: the
+                    # wire layer already catches OSError; classify
+                    # anything exotic and treat the attempt as lost
+                    ok = False
+                    err = f"{classify_error(e)}:{type(e).__name__}"
+            if ok:
+                out["sent"] = True
+                out["attempt"] = attempt
+                out["rtt_ms"] = (self.clock.monotonic() - t0) * 1000.0
+                with self._lock:
+                    counts["sent"] += 1
+                    if peer in self._partitioned:
+                        self._partitioned.discard(peer)
+                        out["rejoined"] = True
+                return out
+            out["error"] = err
+            if attempt < attempts:
+                out["retried"].append((attempt, err))
+                with self._lock:
+                    counts["retries"] += 1
+                # seeded-jitter backoff on the injectable clock
+                self.clock.sleep(self.backoff.delay_s(attempt, rng))
+        with self._lock:
+            counts["gave_up"] += 1
+            if peer not in self._partitioned:
+                self._partitioned.add(peer)
+                out["entered"] = True
+        return out
+
+    # -- introspection / shutdown ---------------------------------------
+    def partitioned(self, peer: str) -> bool:
+        """True while ``peer`` is in an open partition window (the
+        last send gave up and no delivery has succeeded since) — the
+        signal callers use to go LOCAL-ONLY instead of wedging."""
+        with self._lock:
+            return peer in self._partitioned
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"peers": {p: dict(c)
+                              for p, c in self._counts.items()},
+                    "partitioned": sorted(self._partitioned)}
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            conns = list(self._conns.values())
+            self._conns.clear()
+        with contextlib.suppress(OSError):
+            self._listener.close()
+        for conn in conns:
+            with contextlib.suppress(OSError):
+                conn.close()

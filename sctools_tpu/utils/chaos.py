@@ -40,7 +40,8 @@ MODES = ("unavailable", "hang", "wedge", "corrupt",
          "slow_read", "truncate_shard", "io_error",
          "kill_worker", "lease_wedge", "preempt",
          "evict_state", "corrupt_model",
-         "oom", "mem_pressure", "stage_crash")
+         "oom", "mem_pressure", "stage_crash",
+         "net_drop", "net_delay", "net_dup", "net_partition")
 
 # which hook channel each mode fires on: most modes wrap the op CALL;
 # corrupt_checkpoint fires through the runner's on_checkpoint hook,
@@ -68,7 +69,17 @@ MODES = ("unavailable", "hang", "wedge", "corrupt",
 # "fac/build"; ``on_call=N`` = the Nth entry into that stage), the
 # deterministic in-process stand-in for a worker SIGKILLed BETWEEN
 # pipeline stages — the cross-domain resume seam the factory's
-# cursor/fingerprint ladder exists for.
+# cursor/fingerprint ladder exists for.  The four ``net_*`` modes
+# fire through on_network — consulted by a Transport
+# (sctools_tpu/transport.py) once per SEND ATTEMPT toward a peer
+# (pattern matches the PEER name, windows specced ``"<peer>@net"``;
+# ``on_call=N`` = the Nth attempt toward that peer): net_drop loses
+# the attempt, net_delay defers it by ``slow_s`` on the transport's
+# injectable clock, net_dup delivers the frame twice (the per-peer
+# sequence dedup must make it at-most-once), net_partition fails
+# EVERY attempt inside the window (the split-brain case: breakers go
+# LOCAL-ONLY, leases ride to lease_timeout_s, heal reconciles by
+# epoch).
 _MODE_CHANNEL = {"corrupt_checkpoint": "checkpoint",
                  "reject_storm": "admission",
                  "slow_read": "io", "truncate_shard": "io",
@@ -77,7 +88,9 @@ _MODE_CHANNEL = {"corrupt_checkpoint": "checkpoint",
                  "preempt": "worker",
                  "evict_state": "serving", "corrupt_model": "serving",
                  "mem_pressure": "memory",
-                 "stage_crash": "factory"}
+                 "stage_crash": "factory",
+                 "net_drop": "net", "net_delay": "net",
+                 "net_dup": "net", "net_partition": "net"}
 
 
 class ChaosCrash(BaseException):
@@ -244,12 +257,22 @@ class ChaosMonkey:
       injected EIO raises transient and retries, a slow read defers
       the result's virtual ready-time so the hedge/SLO ladder runs
       with zero real sleeps.
+    * ``net_drop`` / ``net_delay`` / ``net_dup`` / ``net_partition``
+      — the NETWORK channel (:meth:`on_network`, consulted by a
+      ``Transport`` once per send attempt toward a peer; the fault's
+      ``op`` pattern matches the PEER name, counted per peer under
+      ``"<peer>@net"``).  All four only RULE — the transport owns
+      the socket and the injectable clock, so it implements the
+      semantics (drop the attempt / defer it ``slow_s`` on the clock
+      / frame it twice / fail every attempt in the window), which is
+      what keeps partition soaks at zero real sleeps.
 
     ``calls`` counts invocations per op name (checkpoint saves count
     separately under ``"<op>@checkpoint"``, admission consults under
     ``"<tenant>@admission"``, serving consults under
     ``"<service>@serving"``, budget consults under
-    ``"<budget>@memory"``); ``injected`` logs every
+    ``"<budget>@memory"``, send attempts under ``"<peer>@net"``);
+    ``injected`` logs every
     firing as ``{"op", "call", "mode", "backend"}`` — two monkeys with
     equal faults/seed driving the same workload produce identical
     logs (the determinism contract tier-1 pins).
@@ -447,6 +470,36 @@ class ChaosMonkey:
                                   "call": call_no, "mode": f.mode,
                                   "backend": backend})
         return {"mode": f.mode}
+
+    def on_network(self, peer: str,
+                   backend: str | None = None) -> dict | None:
+        """Transport hook, consulted once per SEND ATTEMPT toward a
+        peer: returns ``None`` (the attempt goes out clean) or
+        ``{"mode": ..., "delay_s": ...}`` for a firing network fault.
+        On this channel the fault's ``op`` pattern matches the PEER
+        name (``"supervisor"``, ``"w*"``); call counting is per peer
+        under ``"<peer>@net"``, so ``on_call``/``times`` windows
+        count send attempts — a retried send's SECOND attempt
+        consults again, which is how a ``net_drop times=1`` burst
+        loses exactly one frame and the retry heals it.  The hook
+        only RULES — the transport owns the socket and the injectable
+        clock, so it implements the semantics: ``net_drop`` loses
+        this attempt (no frame on the wire), ``net_delay`` defers it
+        by ``delay_s`` on the transport's clock before sending,
+        ``net_dup`` puts the frame on the wire twice (the receiver's
+        per-peer sequence dedup must deliver it once),
+        ``net_partition`` fails every attempt in the window as if the
+        peer were unreachable."""
+        key = f"{peer}@net"
+        with self._lock:
+            call_no = self.calls.get(key, 0) + 1
+            self.calls[key] = call_no
+            f = self._firing(peer, backend, call_no, channel="net")
+            if f is None:
+                return None
+            self.injected.append({"op": peer, "call": call_no,
+                                  "mode": f.mode, "backend": backend})
+        return {"mode": f.mode, "delay_s": self.slow_s}
 
     def on_io(self, name: str, path: str | None = None,
               backend: str | None = None) -> dict | None:

@@ -136,6 +136,28 @@ EVENTS = frozenset({
     # model_swapped; rollback reuses swap_rolled_back with cycle=)
     "ingest_committed", "retrain_triggered", "artifact_built",
     "swap_promoted",
+    # network fault domain (sctools_tpu/transport.py): the message-
+    # transport plane federation/breaker protocols ride on.  Every
+    # record carries peer= (NEVER ticket= — transport messages are a
+    # notification plane, not the admission funnel, and must not
+    # merge with the scheduler's terminal-exactly-once proof).
+    # net_sent = a frame was delivered and acknowledged (terminal for
+    # the message); net_retry = a send attempt timed out / was
+    # dropped and a seeded-jitter backoff rescheduled it; net_gave_up
+    # = retries exhausted, the message was abandoned (terminal — the
+    # caller degrades: leases ride to lease_timeout_s, commits fall
+    # back to the result-file probe, breakers go LOCAL-ONLY);
+    # net_partition_entered = the first gave-up against a reachable-
+    # until-now peer opened a partition window; net_rejoin = the next
+    # successful delivery healed it (breaker registries reconcile by
+    # epoch under this record — the no-split-brain proof joins
+    # entered/rejoin pairs)
+    "net_sent", "net_retry", "net_gave_up",
+    "net_partition_entered", "net_rejoin",
+    # file-transport breaker claim audit (federation.py): a stale
+    # .probe claim file (its owner died mid-probe, claim older than
+    # the lease timeout) was swept so the HALF_OPEN probe slot frees
+    "probe_reclaimed",
 })
 
 #: Every legal metric name → one-line meaning (the docs table).  Like
@@ -316,6 +338,13 @@ METRICS = {
                                 "estimates inflated by an observed "
                                 "OOM (the self-correcting model's "
                                 "learning events)",
+    "net.rtt_ms": "histogram: socket-transport send-to-ack round "
+                  "trip milliseconds (labels peer=) — real wall "
+                  "time on localhost, virtual time under injected "
+                  "net_delay",
+    "net.retries": "counter: socket-transport send attempts "
+                   "re-issued after a timeout/drop (labels peer=) — "
+                   "seeded-jitter backoff on the injectable clock",
 }
 
 #: Per-module journal PROTOCOLS — which EVENTS members a module may
@@ -348,7 +377,7 @@ JOURNAL_PROTOCOLS = {
         "events": ["submitted", "admitted", "rejected", "shed",
                    "run_completed", "run_failed", "worker_spawned",
                    "worker_lost", "worker_respawned", "assigned",
-                   "requeued", "commit_refused"],
+                   "requeued", "commit_refused", "probe_reclaimed"],
         "terminal": ["rejected", "shed", "run_completed",
                      "run_failed"],
     },
@@ -401,6 +430,17 @@ JOURNAL_PROTOCOLS = {
                    "artifact_built", "swap_promoted",
                    "swap_rolled_back"],
         "terminal": ["swap_promoted", "swap_rolled_back"],
+    },
+    # the network message plane: every message keyed peer= terminals
+    # exactly once — net_sent (delivered + acked) or net_gave_up
+    # (retries exhausted; the caller's degradation ladder takes
+    # over).  net_retry records each re-issued attempt in between;
+    # partition windows are the entered/rejoin pair sctreport's
+    # convergence check joins on.
+    "transport": {
+        "events": ["net_sent", "net_retry", "net_gave_up",
+                   "net_partition_entered", "net_rejoin"],
+        "terminal": ["net_sent", "net_gave_up"],
     },
 }
 

@@ -3,6 +3,7 @@ faults must inject the same failures at the same calls (the contract
 that makes every recovery test reproducible); nothing here sleeps a
 real clock."""
 
+import json
 import os
 
 import numpy as np
@@ -573,3 +574,64 @@ def test_serving_spec_round_trip_carries_serving_counts():
     assert clone.calls["svc@serving"] == 1
     assert clone.on_serving("svc") == {"mode": "evict_state"}
     assert clone.on_serving("svc") is None           # window closed
+
+
+# --------------------------------------------------- the network channel
+
+@pytest.mark.parametrize("mode", ["net_drop", "net_delay", "net_dup",
+                                  "net_partition", "stage_crash",
+                                  "mem_pressure"])
+def test_spec_round_trip_is_byte_identical(mode):
+    """Every mode's spec survives serialize -> parse -> re-serialize
+    BYTE-IDENTICALLY: the supervisor writes specs into config.json
+    and workers re-arm from them, so any drift (a dropped field, a
+    float re-formatted, a reordered key) would silently change the
+    fault plan across the process boundary."""
+    monkey = ChaosMonkey([Fault("supervisor", mode, on_call=2,
+                                times=3, backend="tpu")],
+                         seed=7, slow_s=0.25, pressure_frac=0.4,
+                         wedge_s=12.0)
+    first = json.dumps(monkey.spec(), sort_keys=True)
+    clone = ChaosMonkey.from_spec(json.loads(first))
+    second = json.dumps(clone.spec(), sort_keys=True)
+    assert first == second
+
+
+def test_on_network_rules_per_peer_attempts():
+    """net faults count SEND ATTEMPTS per peer under ``<peer>@net``;
+    the window is deterministic in attempt numbers and scoped to the
+    matching peer only."""
+    monkey = ChaosMonkey([Fault("supervisor", "net_drop", on_call=2,
+                                times=2)])
+    assert monkey.on_network("supervisor") is None       # attempt 1
+    r = monkey.on_network("supervisor")                  # attempt 2
+    assert r is not None and r["mode"] == "net_drop"
+    # another peer's attempts ride a SEPARATE counter: w1 is at
+    # attempt 1, below the window
+    assert monkey.on_network("w1") is None
+    assert monkey.on_network("supervisor")["mode"] == "net_drop"
+    assert monkey.on_network("supervisor") is None       # window shut
+    assert monkey.calls["supervisor@net"] == 4
+    assert monkey.calls["w1@net"] == 1
+
+
+def test_on_network_delay_carries_slow_s():
+    monkey = ChaosMonkey([Fault("*", "net_delay", times=1)],
+                         slow_s=2.5)
+    assert monkey.on_network("supervisor") == {"mode": "net_delay",
+                                               "delay_s": 2.5}
+    assert monkey.on_network("supervisor") is None
+
+
+def test_net_spec_round_trip_continues_attempt_counts():
+    """An in-flight net window survives the spec round trip — the
+    federation worker re-arms its transport's monkey from
+    config.json, and the clone must pick up mid-window."""
+    monkey = ChaosMonkey([Fault("supervisor", "net_partition",
+                                on_call=2, times=2)], seed=3)
+    assert monkey.on_network("supervisor") is None       # attempt 1
+    clone = ChaosMonkey.from_spec(monkey.spec())
+    assert clone.calls["supervisor@net"] == 1
+    assert clone.on_network("supervisor")["mode"] == "net_partition"
+    assert clone.on_network("supervisor")["mode"] == "net_partition"
+    assert clone.on_network("supervisor") is None        # window shut

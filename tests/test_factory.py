@@ -456,3 +456,79 @@ def test_factory_soak_full_stack(seed_bundle, tmp_path):
                                             "journal.jsonl"))]
     assert "worker_lost" in fkinds
     rig.close()
+
+
+# ------------------------------------------- cycle vs operator races
+
+def test_cycle_racing_manual_swap_never_double_promotes(seed_bundle,
+                                                        tmp_path):
+    """A running cycle races a manual operator ``service.swap()`` of
+    the SAME candidate: the resumed cycle RECOGNISES the resident
+    version instead of re-flipping (exactly one serving epoch
+    burned, one ``model_swapped``, one ``swap_promoted``), and the
+    stale incarnation — the race's loser — is fenced loudly, never a
+    silent double promote."""
+    rig = Rig(tmp_path, seed_bundle)
+    monkey = ChaosMonkey([Fault("fx/swap", "stage_crash", on_call=1)])
+    batches = [("b1", rig.batch(64, 21))]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        fac1 = rig.factory(chaos=monkey)
+        with pytest.raises(ChaosCrash, match="entering stage 'swap'"):
+            fac1.run_cycle(batches, cycle=0)
+        candidate = fac1.load_state(0)["build"]["artifact"]
+        # the operator's manual swap wins the race to the flip
+        assert rig.svc.swap(candidate)
+        assert rig.svc.epoch == 1
+        # a fresh incarnation resumes the torn cycle...
+        fac2 = rig.factory()
+        # ...which fences the crashed one: the loser cannot sneak a
+        # second promote in
+        with pytest.raises(FactoryFencedError):
+            fac1.run_cycle(batches, cycle=0)
+        st = fac2.run_cycle(batches, cycle=0)
+
+    assert st["terminal"] == "promoted"
+    assert st["swap"].get("resumed") is True  # recognised, not redone
+    assert rig.svc.epoch == 1                 # ONE epoch, not two
+    assert rig.svc.model_version == "fx-c0000"
+    ev = rig.events()
+    kinds = [e["event"] for e in ev]
+    assert kinds.count("swap_promoted") == 1
+    swaps = [e for e in ev if e["event"] == "model_swapped"
+             and e.get("reason") != "init"]
+    assert len(swaps) == 1                    # the manual flip only
+    assert not [e for e in ev if e["event"] == "swap_rolled_back"]
+    rig.close()
+
+
+def test_overlapping_cycle_refused_while_predecessor_live(seed_bundle,
+                                                          tmp_path):
+    """Cycle N+1 refuses to start while cycle N is live
+    (non-terminal): the overlap is refused at entry, the resume
+    target stays N, and only N's terminal unlocks N+1."""
+    rig = Rig(tmp_path, seed_bundle)
+    monkey = ChaosMonkey([Fault("fx/build", "stage_crash",
+                                on_call=1)])
+    batches = [("b1", rig.batch(64, 31))]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        fac1 = rig.factory(chaos=monkey)
+        with pytest.raises(ChaosCrash,
+                           match="entering stage 'build'"):
+            fac1.run_cycle(batches, cycle=0)
+        # cycle 0 is torn, not terminal: it IS the resume target
+        fac2 = rig.factory()
+        assert fac2.next_cycle() == 0
+        with pytest.raises(ValueError, match="cycle 0 is live"):
+            fac2.run_cycle([("b2", rig.batch(64, 32))], cycle=1)
+        # no half-started cycle-1 residue survives the refusal
+        assert not os.path.exists(fac2.cycle_dir(1))
+        # finishing cycle 0 unlocks cycle 1
+        st = fac2.run_cycle(batches, cycle=0)
+        assert st["terminal"] == "promoted"
+        assert fac2.next_cycle() == 1
+        st1 = fac2.run_cycle([("b2", rig.batch(64, 32))], cycle=1)
+        assert st1["terminal"] == "promoted"
+    assert rig.svc.epoch == 2
+    rig.close()

@@ -663,3 +663,67 @@ def test_factory_section_absent_without_factory_events():
     assert factory_section(
         [{"event": "swap_rolled_back", "reason": "x", "epoch": 1}],
         None) == []
+
+
+def test_network_section_renders_totals_windows_and_convergence(
+        tmp_path, capsys):
+    """A run dir whose journal carries transport ``net_*`` events
+    gets the network section: per-peer delivery totals, partition
+    windows with BOTH timestamps, and the convergence check — an
+    unhealed window is an explicit OPEN PARTITION line, never
+    hidden."""
+    journal = (
+        '{"event": "net_sent", "peer": "supervisor", "kind": "beat", '
+        '"seq": 1, "attempt": 1, "rtt_ms": 0.4, "ts": 100.0}\n'
+        '{"event": "net_retry", "peer": "supervisor", "kind": "done", '
+        '"seq": 2, "attempt": 1, "error": "chaos:net_drop", '
+        '"ts": 100.1}\n'
+        '{"event": "net_gave_up", "peer": "supervisor", '
+        '"kind": "beat", "seq": 3, "attempts": 1, '
+        '"error": "chaos:net_partition", "ts": 100.2}\n'
+        '{"event": "net_partition_entered", "peer": "supervisor", '
+        '"kind": "beat", "seq": 3, "ts": 100.2}\n'
+        '{"event": "net_sent", "peer": "supervisor", "kind": "beat", '
+        '"seq": 4, "attempt": 1, "rtt_ms": 0.3, "ts": 140.0}\n'
+        '{"event": "net_rejoin", "peer": "supervisor", "kind": '
+        '"beat", "seq": 4, "ts": 140.0}\n'
+        '{"event": "net_gave_up", "peer": "w9", "kind": "breaker", '
+        '"seq": 1, "attempts": 4, "error": "wire", "ts": 150.0}\n'
+        '{"event": "net_partition_entered", "peer": "w9", '
+        '"kind": "breaker", "seq": 1, "ts": 150.0}\n')
+    (tmp_path / "journal.jsonl").write_text(journal)
+    (tmp_path / "metrics.json").write_text(json.dumps({
+        "schema": 1, "metrics": {"counters": {
+            "net.retries{peer=supervisor}": 1.0,
+        }, "gauges": {}, "histograms": {
+            "net.rtt_ms{peer=supervisor}": {
+                "count": 2, "sum": 0.7, "max": 1.25,
+                "buckets": {"+inf": 2}}}}}))
+    assert main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "-- network --" in out
+    assert "supervisor        2        1        1     1.2ms" in out
+    assert "w9                0        0        1         -" in out
+    assert "partition windows:" in out
+    assert ("+  0.00s supervisor: entered, healed + 39.80s "
+            "(39.80s cut off)") in out
+    assert ("+ 49.80s w9: entered — OPEN PARTITION "
+            "(no net_rejoin journaled)") in out
+    assert ("partition convergence: 1/2 window(s) healed "
+            "(net_rejoin) — (!) 1 OPEN at end of journal") in out
+
+
+def test_network_section_absent_without_net_events():
+    from tools.sctreport import network_section
+
+    assert network_section([], None) == []
+    # a run with federation traffic but NO transport events renders
+    # no network section — and net metrics alone (without journal
+    # evidence) do not conjure one either
+    assert network_section(
+        [{"event": "worker_spawned", "worker": "w0", "gen": 0}],
+        {"metrics": {"counters": {"net.retries{peer=s}": 1.0},
+                     "gauges": {},
+                     "histograms": {"net.rtt_ms{peer=s}": {
+                         "count": 1, "sum": 0.1, "max": 0.1,
+                         "buckets": {"+inf": 1}}}}}) == []

@@ -1,0 +1,537 @@
+"""The network fault domain (``sctools_tpu/transport.py``): the line
+codec behind the file plane, socket delivery with at-most-once dedup,
+chaos-driven retry/partition ladders on the injectable clock, the
+socket-plane breaker registry (epoch fencing, stale-claimant refusal
+on heal, local-only degradation), the SIGKILL-mid-probe audit line,
+and the ACCEPTANCE partition soak — a socket-mode federation
+surviving net_partition + net_delay + net_drop + kill_worker on one
+``VirtualClock`` with every ticket terminal exactly once.
+
+Waits in this process are event-driven (callbacks set events,
+completion handles block) or bounded polls against REAL subprocess /
+receiver-thread progress; every schedule (backoff, chaos delay,
+cooldown) runs on the injectable clock.
+"""
+
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import warnings
+
+from sctools_tpu.federation import (FederatedBreakerRegistry,
+                                    FederationSupervisor)
+from sctools_tpu.transport import (LINE_RE, FileTransport,
+                                   SocketTransport, decode_line,
+                                   encode_line, parse_fields)
+from sctools_tpu.utils.chaos import ChaosMonkey, Fault
+from sctools_tpu.utils.telemetry import MetricsRegistry
+from sctools_tpu.utils.vclock import VirtualClock
+
+from soak_smoke import check_journal_coherent
+
+
+class Journal:
+    """In-memory journal stub: same ``write(event, **fields)`` shape
+    as the runner's ``_Journal``, no file."""
+
+    def __init__(self):
+        self.events = []
+        self._lock = threading.Lock()
+
+    def write(self, event, **fields):
+        with self._lock:
+            self.events.append({"event": event, **fields})
+
+    def named(self, event):
+        with self._lock:
+            return [e for e in self.events if e["event"] == event]
+
+
+def wait_until(pred, timeout=10.0, what="condition"):
+    """Bounded poll against another thread/process's progress."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ---------------------------------------------------------------- codec
+
+def test_line_codec_round_trip():
+    line = encode_line("done", ticket="t-0001", epoch=2, gen=1)
+    assert line == "[fed] done ticket=t-0001 epoch=2 gen=1\n"
+    kind, fields = decode_line(line)
+    assert kind == "done"
+    assert fields == {"ticket": "t-0001", "epoch": "2", "gen": "1"}
+    # the supervisor pump's regex and the codec agree byte-for-byte
+    m = LINE_RE.match(line.strip())
+    assert m is not None
+    assert m.group(1) == "done"
+    assert parse_fields(m.group(2)) == fields
+
+
+def test_decode_rejects_noise():
+    assert decode_line("Traceback (most recent call last):\n") is None
+    assert decode_line("[fed] \n") is None
+    assert decode_line("") is None
+    kind, fields = decode_line("[fed] beat\n")
+    assert (kind, fields) == ("beat", {})
+
+
+def test_file_transport_writes_legacy_lines():
+    buf = io.StringIO()
+    t = FileTransport("w0", stream=buf)
+    assert t.send("supervisor", "beat", seq=3)
+    assert t.send("supervisor", "hello", pid=42, gen=0)
+    assert buf.getvalue() == ("[fed] beat seq=3\n"
+                              "[fed] hello pid=42 gen=0\n")
+    assert t.stats() == {"sent": 2}
+
+
+def test_file_transport_survives_closed_stream():
+    buf = io.StringIO()
+    buf.close()
+    t = FileTransport("w0", stream=buf)
+    assert t.send("supervisor", "beat", seq=1) is False  # never raises
+
+
+# --------------------------------------------------------- socket plane
+
+def _pair(clock=None, chaos=None, journal=None, metrics=None,
+          retries=None, seed=0):
+    """A connected (sender, receiver, received, delivered-event)
+    quad: the receiver records every delivered message."""
+    received = []
+    got = threading.Event()
+
+    def on_message(frm, kind, fields):
+        received.append((frm, kind, fields))
+        got.set()
+
+    rx = SocketTransport("rx", on_message=on_message)
+    kw = {} if retries is None else {"retries": retries}
+    tx = SocketTransport("tx", clock=clock, chaos=chaos,
+                         journal=journal, metrics=metrics, seed=seed,
+                         **kw)
+    tx.connect("rx", rx.host, rx.port)
+    return tx, rx, received, got
+
+
+def test_socket_send_delivers_and_acks():
+    tx, rx, received, got = _pair()
+    try:
+        assert tx.send("rx", "hello", pid=7, gen=0)
+        assert got.wait(timeout=10)
+        assert received == [("tx", "hello", {"pid": 7, "gen": 0})]
+        assert tx.stats()["peers"]["rx"]["sent"] == 1
+    finally:
+        tx.close()
+        rx.close()
+
+
+def test_net_dup_delivered_exactly_once():
+    """The frame rides the wire twice; the per-peer sequence dedup
+    makes delivery at-most-once."""
+    monkey = ChaosMonkey([Fault("rx", "net_dup", on_call=1, times=1)])
+    tx, rx, received, got = _pair(chaos=monkey)
+    try:
+        assert tx.send("rx", "done", ticket="t1")
+        assert tx.send("rx", "beat", seq=1)  # flushes any stray ack
+        wait_until(lambda: any(r[1] == "beat" for r in received),
+                   what="the follow-up delivery")
+        assert [r[1] for r in received] == ["done", "beat"]
+    finally:
+        tx.close()
+        rx.close()
+
+
+def test_retry_heals_net_drop_on_virtual_clock():
+    clock = VirtualClock()
+    journal = Journal()
+    metrics = MetricsRegistry(clock=clock)
+    monkey = ChaosMonkey([Fault("rx", "net_drop", on_call=1, times=1)])
+    tx, rx, received, got = _pair(clock=clock, chaos=monkey,
+                                  journal=journal, metrics=metrics)
+    try:
+        assert tx.send("rx", "done", ticket="t1")
+        assert got.wait(timeout=10)
+        (retry,) = journal.named("net_retry")
+        assert retry["error"] == "chaos:net_drop"
+        (sent,) = journal.named("net_sent")
+        assert sent["attempt"] == 2
+        # the backoff slept on the INJECTABLE clock only
+        assert clock.sleeps and max(clock.sleeps) > 0
+        compact = metrics.snapshot_compact()
+        assert compact.get("net.retries{peer=rx}") == 1
+    finally:
+        tx.close()
+        rx.close()
+
+
+def test_net_delay_rides_virtual_clock():
+    clock = VirtualClock()
+    journal = Journal()
+    monkey = ChaosMonkey([Fault("rx", "net_delay", on_call=1,
+                                times=1)], slow_s=5.0)
+    tx, rx, received, got = _pair(clock=clock, chaos=monkey,
+                                  journal=journal)
+    try:
+        t0 = time.time()
+        assert tx.send("rx", "beat", seq=1)
+        assert time.time() - t0 < 2.0  # the 5s were virtual
+        assert 5.0 in clock.sleeps
+        (sent,) = journal.named("net_sent")
+        assert sent["attempt"] == 1
+    finally:
+        tx.close()
+        rx.close()
+
+
+def test_partition_entered_once_then_rejoin():
+    clock = VirtualClock()
+    journal = Journal()
+    rejoined = []
+    monkey = ChaosMonkey([Fault("rx", "net_partition", on_call=1,
+                                times=3)])
+    tx, rx, received, got = _pair(clock=clock, chaos=monkey,
+                                  journal=journal, retries=0)
+    tx.on_rejoin = rejoined.append
+    try:
+        for _ in range(3):
+            assert tx.send("rx", "beat", seq=1) is False
+        assert tx.partitioned("rx")
+        # entered is a TRANSITION, not a per-failure event
+        assert len(journal.named("net_gave_up")) == 3
+        assert len(journal.named("net_partition_entered")) == 1
+        assert journal.named("net_gave_up")[0]["error"] == \
+            "chaos:net_partition"
+        # the window passed: the next delivery heals on the record
+        assert tx.send("rx", "beat", seq=2)
+        assert not tx.partitioned("rx")
+        assert len(journal.named("net_rejoin")) == 1
+        assert rejoined == ["rx"]
+        assert tx.stats()["partitioned"] == []
+    finally:
+        tx.close()
+        rx.close()
+
+
+def test_send_to_unknown_peer_degrades():
+    journal = Journal()
+    tx = SocketTransport("tx", journal=journal, retries=0,
+                         clock=VirtualClock())
+    try:
+        assert tx.send("ghost", "beat", seq=1) is False  # never raises
+        assert len(journal.named("net_gave_up")) == 1
+        assert tx.partitioned("ghost")
+    finally:
+        tx.close()
+
+
+# ----------------------------------------- breaker sync over the socket
+
+def _registry_pair(clk, chaos_a=None, chaos_b=None):
+    """Two fs-less (store_dir=None) registries joined both ways by
+    SocketTransports: the shared filesystem is gone, the socket is
+    the only replication plane."""
+    ja, jb = Journal(), Journal()
+    holder = {}
+
+    def to_b(frm, kind, fields):
+        holder["B"].apply_remote(fields["sig"], fields["state"],
+                                 fields["epoch"],
+                                 owner=fields.get("owner", frm))
+
+    def to_a(frm, kind, fields):
+        holder["A"].apply_remote(fields["sig"], fields["state"],
+                                 fields["epoch"],
+                                 owner=fields.get("owner", frm))
+
+    ta = SocketTransport("wA", clock=clk, journal=ja, chaos=chaos_a,
+                         retries=0, on_message=to_a)
+    tb = SocketTransport("wB", clock=clk, journal=jb, chaos=chaos_b,
+                         retries=0, on_message=to_b)
+    ta.connect("wB", tb.host, tb.port)
+    tb.connect("wA", ta.host, ta.port)
+    A = FederatedBreakerRegistry(None, clock=clk, owner="wA",
+                                 transport=ta, peers=("wB",),
+                                 failure_threshold=2, cooldown_s=30.0)
+    B = FederatedBreakerRegistry(None, clock=clk, owner="wB",
+                                 transport=tb, peers=("wA",),
+                                 failure_threshold=2, cooldown_s=30.0)
+    holder["A"], holder["B"] = A, B
+    return A, B, ta, tb, ja, jb
+
+
+def test_breaker_trip_and_close_cross_the_socket():
+    """The PR-8 file-plane contract holds with NO shared filesystem:
+    trip on A forces B open; B's probe close returns A."""
+    clk = VirtualClock()
+    A, B, ta, tb, ja, jb = _registry_pair(clk)
+    try:
+        a, b = A.get("tpu"), B.get("tpu")
+        a.record_failure()
+        assert b.state == "closed"  # below threshold: nothing sent
+        a.record_failure()
+        assert a.state == "open"
+        wait_until(lambda: b.state == "open", what="open to cross")
+        clk.advance(31.0)
+        assert b.state == "half_open"
+        assert b.try_acquire_probe()
+        b.record_success()
+        assert b.state == "closed"
+        wait_until(lambda: a.state == "closed",
+                   what="close to cross back")
+        assert a.snapshot()["fed_epoch"] == 2
+    finally:
+        ta.close()
+        tb.close()
+
+
+def test_apply_remote_is_epoch_fenced():
+    clk = VirtualClock()
+    B = FederatedBreakerRegistry(None, clock=clk, owner="wB",
+                                 failure_threshold=2, cooldown_s=30.0)
+    b = B.get("tpu")
+    assert b.apply_remote("open", 1) is True
+    assert b.state == "open"
+    assert b.apply_remote("closed", 2) is True
+    assert b.state == "closed"
+    # at/behind the fence: refused on arrival, state untouched
+    assert b.apply_remote("open", 2) is False
+    assert b.apply_remote("open", 1) is False
+    assert b.apply_remote("open", 0) is False
+    assert b.state == "closed"
+    # garbage never advances the fence
+    assert b.apply_remote("wedged", 99) is False
+    assert b.apply_remote("open", 3) is True
+
+
+def test_partitioned_breaker_goes_local_only_then_heals_by_epoch():
+    """The split-brain proof, end to end on the socket plane: A is
+    partitioned and keeps making LOCAL-ONLY breaker decisions; B
+    moves on (open epoch 1 → probe → closed epoch 2); on heal A's
+    stale ``open`` (epoch 1) is REFUSED by B's fence and A converges
+    to B's newer verdict instead."""
+    clk = VirtualClock()
+    # the partition cuts BOTH directions: A sends once inside it
+    # (the open broadcast), B twice (its open AND closed broadcasts)
+    chaos_a = ChaosMonkey([Fault("wB", "net_partition", on_call=1,
+                                 times=1)])
+    chaos_b = ChaosMonkey([Fault("wA", "net_partition", on_call=1,
+                                 times=2)])
+    A, B, ta, tb, ja, jb = _registry_pair(clk, chaos_a=chaos_a,
+                                          chaos_b=chaos_b)
+    try:
+        a, b = A.get("tpu"), B.get("tpu")
+        # A trips its tpu breaker DURING the partition: the broadcast
+        # gives up, A's decision stands locally
+        a.record_failure()
+        a.record_failure()
+        assert a.state == "open"          # local-only decision held
+        assert ta.partitioned("wB")
+        assert len(ja.named("net_partition_entered")) == 1
+        assert b.state == "closed"        # the trip never arrived
+        # meanwhile B (the other side of the cut) advances the SAME
+        # signature past A's epoch: open (1) then closed (2) — both
+        # broadcasts toward A give up inside B's window
+        b.record_failure()
+        b.record_failure()
+        assert b.state == "open"
+        clk.advance(31.0)
+        assert b.try_acquire_probe()
+        b.record_success()
+        assert b.state == "closed"
+        assert b._seen_epoch == 2
+        # still split-brained (the shared clock advance elapsed A's
+        # local cooldown too, so its open has aged into half_open)
+        assert a.state != "closed"
+        # the window has passed: A's next delivery heals the
+        # partition, on_rejoin re-offers A's state — and B's fence
+        # REFUSES the stale claimant (epoch 1 < 2)
+        A.sync_peer("wB")
+        assert len(ja.named("net_rejoin")) == 1
+        assert b.state == "closed"
+        assert b._seen_epoch == 2
+        # convergence the other way: B re-offers, A accepts the
+        # newer epoch and drops its stale open
+        B.sync_peer("wA")
+        wait_until(lambda: a.state == "closed",
+                   what="A to converge to B's verdict")
+        assert a._seen_epoch == 2
+    finally:
+        ta.close()
+        tb.close()
+
+
+# ------------------------------------------- probe audit (file plane)
+
+_CLAIMANT = r"""
+import json, os, sys, time
+sys.path.insert(0, {root!r})
+from sctools_tpu.federation import FederatedBreakerRegistry
+from sctools_tpu.utils.vclock import VirtualClock
+
+clk = VirtualClock()
+R = FederatedBreakerRegistry({store!r}, clock=clk, owner="victim",
+                             failure_threshold=1, cooldown_s=5.0)
+b = R.get("tpu")
+b.record_failure()
+clk.advance(6.0)
+assert b.try_acquire_probe()
+print("CLAIMED", flush=True)
+time.sleep(600)  # never reaches a verdict: SIGKILLed mid-probe
+"""
+
+
+def test_probe_reclaimed_journaled_after_sigkill_mid_probe(tmp_path):
+    """A claimant SIGKILLed between the probe claim and its verdict
+    leaves a .probe file; the survivor breaks the stale claim AND
+    journals the audit line the crash window used to lack."""
+    store = str(tmp_path / "breakers")
+    code = _CLAIMANT.format(
+        root=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), store=store)
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "CLAIMED"
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+        assert os.path.exists(os.path.join(store, "tpu.probe"))
+        journal = Journal()
+        clk = VirtualClock()
+        R = FederatedBreakerRegistry(store, clock=clk, owner="wB",
+                                     journal=journal,
+                                     failure_threshold=1,
+                                     cooldown_s=5.0,
+                                     probe_stale_s=0.05)
+        b = R.get("tpu")
+        assert b.state == "open"  # the victim's trip is on the file
+        clk.advance(6.0)
+        time.sleep(0.2)  # age the claim past the (tiny) stale TTL
+        assert b.try_acquire_probe()  # broke the dead claim
+        (rec,) = journal.named("probe_reclaimed")
+        assert rec["reason"] == "stale"
+        assert rec["prev_owner"] == "victim"
+        assert rec["by"] == "wB"
+        assert rec["age_s"] >= 0.05
+        assert rec["signature"] == "tpu"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=30)
+
+
+def test_clear_probe_claims_journals_owner_lost(tmp_path):
+    journal = Journal()
+    clk = VirtualClock()
+    R = FederatedBreakerRegistry(str(tmp_path), clock=clk, owner="sup",
+                                 journal=journal, failure_threshold=1,
+                                 cooldown_s=5.0)
+    b = R.get("tpu")
+    b.record_failure()
+    clk.advance(6.0)
+    assert b.try_acquire_probe()
+    assert R.clear_probe_claims("sup") == 1
+    (rec,) = journal.named("probe_reclaimed")
+    assert rec["reason"] == "owner_lost"
+    assert rec["prev_owner"] == "sup"
+
+
+# -------------------------------------------------- acceptance soak
+
+def test_partition_soak_socket_federation(tmp_path):
+    """ACCEPTANCE: a 2-worker socket-mode federation survives
+    net_partition + net_delay + net_drop (worker w1's link) plus a
+    kill_worker SIGKILL (w0) on one ``VirtualClock``: every ticket
+    reaches a terminal exactly once, the partitioned worker's
+    journal shows entered→rejoin convergence, no stale-gen commit
+    is accepted, and zero real sleeps in the supervision
+    schedules."""
+    from sctools_tpu.data.synthetic import synthetic_counts
+    from sctools_tpu.registry import Pipeline
+
+    clock = VirtualClock()
+    metrics = MetricsRegistry(clock=clock)
+    monkey = ChaosMonkey([Fault("w0", "kill_worker", on_call=3)])
+    w1_net = ChaosMonkey([
+        Fault("supervisor", "net_partition", on_call=3, times=8),
+        Fault("supervisor", "net_delay", on_call=13, times=2),
+        Fault("supervisor", "net_drop", on_call=17, times=1),
+    ], slow_s=0.2).spec()
+    data = synthetic_counts(64, 32, density=0.2, seed=0)
+    pipe = Pipeline([("normalize.library_size", {}),
+                     ("normalize.log1p", {}),
+                     ("qc.per_cell_metrics", {})], backend="tpu")
+    n = 8
+    w1_journal = os.path.join(str(tmp_path), "workers", "w1",
+                              "journal.jsonl")
+
+    def w1_events():
+        try:
+            with open(w1_journal) as f:
+                return [json.loads(line) for line in f]
+        except (OSError, ValueError):
+            return []
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        with FederationSupervisor(
+                str(tmp_path), n_workers=2, transport="socket",
+                heartbeat_s=0.1, poll_s=0.05, lease_timeout_s=120.0,
+                clock=clock, metrics=metrics, chaos=monkey,
+                chaos_specs={"w1": w1_net}, max_respawns=1,
+                tenant_max_queued=16,
+                runner_config={"assume_healthy": True}) as sup:
+            handles = [sup.submit(pipe, data, tenant=f"t{i % 3}")
+                       for i in range(n)]
+            for h in handles:
+                h.result(timeout=240)
+                assert h.status == "completed", (h.ticket, h.status)
+
+            def windows_healed():
+                evs = w1_events()
+                entered = sum(e["event"] == "net_partition_entered"
+                              for e in evs)
+                rejoin = sum(e["event"] == "net_rejoin" for e in evs)
+                dropped = any(
+                    e["event"] in ("net_retry", "net_gave_up")
+                    and str(e.get("error", "")).endswith("net_drop")
+                    for e in evs)
+                return entered >= 1 and entered == rejoin and dropped
+
+            # the workers keep beating: wait (bounded, against real
+            # subprocess progress) until every chaos window provably
+            # fired AND healed on w1's record
+            wait_until(windows_healed, timeout=25.0,
+                       what="w1's partition windows to heal")
+
+    jpath = os.path.join(str(tmp_path), "journal.jsonl")
+    check_journal_coherent(jpath, n)
+    with open(jpath) as f:
+        evs = [json.loads(line) for line in f]
+    # the SIGKILL ladder ran
+    assert any(e["event"] == "worker_lost" for e in evs)
+    assert any(e["event"] == "worker_respawned" for e in evs)
+    # fencing: every accepted terminal is the ticket's LATEST epoch
+    last_epoch = {}
+    for e in evs:
+        if e["event"] in ("assigned", "requeued"):
+            last_epoch[e["ticket"]] = e["epoch"]
+    for e in evs:
+        if e["event"] == "run_completed":
+            assert e["epoch"] == last_epoch.get(e["ticket"]), e
+    # w1's transport degraded and healed on the record
+    w1 = w1_events()
+    entered = [e for e in w1 if e["event"] == "net_partition_entered"]
+    rejoin = [e for e in w1 if e["event"] == "net_rejoin"]
+    assert len(entered) >= 1
+    assert len(entered) == len(rejoin)

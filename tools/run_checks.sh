@@ -135,7 +135,20 @@
 #                     queries, served epoch advanced to the fresh
 #                     artifact, both journals terminal-exactly-once
 #                     (docs/ARCHITECTURE.md "The annotation factory")
-#  15. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
+#  15. network       python tests/net_smoke.py — the transport fault
+#                     domain's contract: a 2-worker federation run in
+#                     socket mode (workers dial the supervisor's TCP
+#                     listener; breaker verdicts ride the same frames)
+#                     while chaos injects one net_partition window and
+#                     one net_drop burst on the shared VirtualClock —
+#                     every ticket reaches a terminal exactly once,
+#                     both supervisor and worker journals are
+#                     coherent, the partitioned worker's breakers
+#                     degrade to local-only and provably reconverge
+#                     after heal (net_rejoin journaled), zero real
+#                     sleeps (docs/ARCHITECTURE.md "Network fault
+#                     domain")
+#  16. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
 
 set -u -o pipefail
 
@@ -397,6 +410,14 @@ if JAX_PLATFORMS=cpu python tests/factory_smoke.py; then
     :
 else
     echo "factory stage FAILED (rc=$?)"
+    fail=1
+fi
+
+stage "network (socket federation: net_partition + net_drop, converged heal)"
+if JAX_PLATFORMS=cpu python tests/net_smoke.py; then
+    :
+else
+    echo "network stage FAILED (rc=$?)"
     fail=1
 fi
 

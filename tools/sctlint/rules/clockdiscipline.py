@@ -51,7 +51,7 @@ from ..jaxutil import dotted, module_info
 _PATH_RE = re.compile(
     r"(^|/)(runner|failsafe|checkpoint|chaos|stream|scheduler"
     r"|shardstore|federation|train_stream|telemetry|serving"
-    r"|factory)\.py$")
+    r"|factory|transport)\.py$")
 
 _BANNED = {"time.sleep", "time.monotonic"}
 

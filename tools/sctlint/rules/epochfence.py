@@ -48,7 +48,8 @@ from ..core import ProgramContext, rule
 from ..flow import call_tail, dataflow, walk_in_scope
 
 #: only writes in these modules are policed
-_GATED = frozenset({"federation.py", "serving.py", "factory.py"})
+_GATED = frozenset({"federation.py", "serving.py", "factory.py",
+                    "transport.py"})
 
 _F = frozenset({"F"})
 

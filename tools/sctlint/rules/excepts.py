@@ -30,7 +30,7 @@ from ..jaxutil import dotted, module_info
 # the closed loop silently stuck between cursors
 _PATH_RE = re.compile(
     r"(^|/)(runner|failsafe|checkpoint|chaos|trace|determinism|sync"
-    r"|vclock|federation|serving|factory)\.py$")
+    r"|vclock|federation|serving|factory|transport)\.py$")
 
 _BROAD = {"Exception", "BaseException"}
 

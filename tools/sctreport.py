@@ -336,6 +336,11 @@ def render(run_dir: str, runs: list[dict], trace_d: dict | None,
         add("")
         L.extend(fact)
 
+    netw = network_section(events or [], metrics)
+    if netw:
+        add("")
+        L.extend(netw)
+
     add("")
     add("-- metrics snapshot --")
     if metrics is None:
@@ -935,6 +940,85 @@ def factory_section(events: list[dict], metrics) -> list[str]:
     L.append(f"  cross-domain join: {joined}/{len(cycles)} cycle(s) "
              f"fully traced (batch -> retrain on post-ingest digest "
              f"-> served epoch or journaled rollback)")
+    return L
+
+
+def network_section(events: list[dict], metrics) -> list[str]:
+    """The transport-plane digest, rendered only when the journal
+    carries ``net_*`` events (a run that never pushed messages over a
+    network transport has no section).  Per-peer delivery totals, the
+    partition timeline with BOTH timestamps (entry and heal — an
+    unhealed window is printed as OPEN PARTITION, never hidden), and
+    the convergence check the no-split-brain story rests on: every
+    ``net_partition_entered`` must be matched by a later
+    ``net_rejoin`` for that peer or show up as an explicit open
+    window in the count."""
+    net = [e for e in events if e["event"] in (
+        "net_sent", "net_retry", "net_gave_up",
+        "net_partition_entered", "net_rejoin")]
+    if not net:
+        return []
+    m = (metrics or {}).get("metrics", metrics or {})
+    hists = m.get("histograms", {}) if isinstance(m, dict) else {}
+
+    peers: dict = {}
+
+    def prec(name):
+        return peers.setdefault(name, {"sent": 0, "retries": 0,
+                                       "gave_up": 0, "rtt_max": None})
+
+    windows: list[list] = []   # [peer, entered_ts, healed_ts | None]
+    open_by_peer: dict = {}
+    for e in net:
+        p = prec(e.get("peer", "?"))
+        ev = e["event"]
+        if ev == "net_sent":
+            p["sent"] += 1
+        elif ev == "net_retry":
+            p["retries"] += 1
+        elif ev == "net_gave_up":
+            p["gave_up"] += 1
+        elif ev == "net_partition_entered":
+            open_by_peer.setdefault(e.get("peer", "?"),
+                                    []).append(len(windows))
+            windows.append([e.get("peer", "?"),
+                            e.get("ts", 0.0), None])
+        elif ev == "net_rejoin":
+            idxs = open_by_peer.get(e.get("peer", "?")) or []
+            if idxs:
+                windows[idxs.pop(0)][2] = e.get("ts", 0.0)
+    for key, h in hists.items():
+        name, labels = _parse_labels(key)
+        if name == "net.rtt_ms" and labels.get("peer"):
+            prec(labels["peer"])["rtt_max"] = h.get("max")
+
+    L = ["-- network --"]
+    L.append(f"  {'peer':<12s} {'sent':>6s} {'retries':>8s} "
+             f"{'gave up':>8s} {'max rtt':>9s}")
+    for name in sorted(peers):
+        p = peers[name]
+        rtt = ("-" if p["rtt_max"] is None
+               else f"{p['rtt_max']:.1f}ms")
+        L.append(f"  {name:<12s} {p['sent']:6d} {p['retries']:8d} "
+                 f"{p['gave_up']:8d} {rtt:>9s}")
+    if windows:
+        L.append("  partition windows:")
+        t0 = windows[0][1]
+        for peer, entered, healed in windows:
+            if healed is None:
+                L.append(f"    +{entered - t0:6.2f}s {peer}: entered"
+                         f" — OPEN PARTITION (no net_rejoin "
+                         f"journaled)")
+            else:
+                L.append(f"    +{entered - t0:6.2f}s {peer}: "
+                         f"entered, healed +{healed - t0:6.2f}s "
+                         f"({healed - entered:.2f}s cut off)")
+    healed_n = sum(1 for w in windows if w[2] is not None)
+    open_n = len(windows) - healed_n
+    L.append(f"  partition convergence: {healed_n}/{len(windows)} "
+             f"window(s) healed (net_rejoin)"
+             + (f" — (!) {open_n} OPEN at end of journal"
+                if open_n else ""))
     return L
 
 
