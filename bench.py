@@ -164,7 +164,19 @@ def _metrics_glimpse():
     if mod is None:
         return None
     try:
-        return mod.default_registry().snapshot_compact() or None
+        snap = mod.default_registry().snapshot_compact()
+        if not snap:
+            return None
+        # derived plan-cache hit rate: raw hit/miss counters diff
+        # awkwardly between stages, the ratio reads at a glance
+        hits = sum(v for k, v in snap.items()
+                   if k.startswith("plan.cache_hits"))
+        misses = sum(v for k, v in snap.items()
+                     if k.startswith("plan.cache_misses"))
+        if hits or misses:
+            snap["plan.cache_hit_rate"] = round(
+                hits / (hits + misses), 4)
+        return snap
     except Exception:  # a stage line must never die on telemetry
         return None
 
@@ -1887,6 +1899,13 @@ def main():
             + "docs/PERF.md — a dead tunnel at run time does not "
             + "retract them")
     detail["backend"] = backend
+    # final fleet glimpse: the orchestrator's own registry (plan-cache
+    # hit rate, obs.* counters, sched/serve families) rides the BENCH
+    # json so a run's telemetry survives even when the stage journal
+    # is discarded; None (key absent) when telemetry never loaded
+    fleet_final = _metrics_glimpse()
+    if fleet_final:
+        detail["fleet"] = fleet_final
     stage("done", total_s=round(time.time() - T_START, 1))
     print(json.dumps(headline, default=float), flush=True)
     return 0

@@ -315,7 +315,8 @@ class AnnotationFactory:
                     f"{stage!r} of cycle {cycle}")
 
     # -- stages --------------------------------------------------------
-    def _append_batch(self, label: str, batch) -> dict:
+    def _append_batch(self, label: str, batch,
+                      trace_id: str = "") -> dict:
         params = dict(store_dir=self.store_dir, label=label,
                       expect_genes=int(
                           ShardStore.open(self.store_dir).n_genes))
@@ -324,7 +325,8 @@ class AnnotationFactory:
             h = self.supervisor.submit(pipe, batch,
                                        tenant=self.ingest_tenant,
                                        priority=self.ingest_priority,
-                                       backend=self.backend)
+                                       backend=self.backend,
+                                       trace_id=trace_id or None)
             out = h.result(timeout=self.result_timeout_s)
         else:
             out = append_store(batch, **params)
@@ -343,7 +345,9 @@ class AnnotationFactory:
             # speedup (the appends are small against training wall)
             for label, batch in batches:
                 if label not in done:
-                    done[label] = self._append_batch(label, batch)
+                    done[label] = self._append_batch(
+                        label, batch,
+                        trace_id=st.get("trace_id", ""))
                     self._commit_state(cycle, st)
             store = ShardStore.open(self.store_dir)
             st["ingest"] = {
@@ -359,7 +363,8 @@ class AnnotationFactory:
                     "ingest_committed", cycle=int(cycle),
                     factory=self.name, label=label,
                     rows=info["rows"], skipped=info["skipped"],
-                    store_digest=info["store_digest"])
+                    store_digest=info["store_digest"],
+                    trace_id=st.get("trace_id", ""))
                 self._mark_journaled(cycle, st, f"ingest:{label}")
 
     def _stage_train(self, cycle: int, st: dict) -> None:
@@ -372,7 +377,8 @@ class AnnotationFactory:
                 self.journal.write(
                     "retrain_triggered", cycle=int(cycle),
                     factory=self.name, tenant=self.train_tenant,
-                    store_digest=st["ingest"]["store_digest"])
+                    store_digest=st["ingest"]["store_digest"],
+                    trace_id=st.get("trace_id", ""))
                 self._mark_journaled(cycle, st, "retrain")
             kw = dict(self.train_kw)
             kw.setdefault("checkpoint_every", 1)
@@ -383,7 +389,8 @@ class AnnotationFactory:
             h = self.scheduler.submit(
                 pipe, _carrier(), tenant=self.train_tenant,
                 priority=self.train_priority, backend=self.backend,
-                preemptible=True)
+                preemptible=True,
+                trace_id=st.get("trace_id") or None)
             out = h.result(timeout=self.result_timeout_s)
             st["train"] = {
                 "params": params_out,
@@ -418,7 +425,8 @@ class AnnotationFactory:
             self.journal.write(
                 "artifact_built", cycle=int(cycle),
                 factory=self.name, digest=st["build"]["digest"],
-                version=st["build"]["version"])
+                version=st["build"]["version"],
+                trace_id=st.get("trace_id", ""))
             self._mark_journaled(cycle, st, "build")
 
     def _stage_swap(self, cycle: int, st: dict) -> None:
@@ -448,7 +456,8 @@ class AnnotationFactory:
                     "swap_promoted", cycle=int(cycle),
                     factory=self.name, epoch=sw.get("epoch"),
                     version=sw.get("version"),
-                    agreement=sw.get("agreement"))
+                    agreement=sw.get("agreement"),
+                    trace_id=st.get("trace_id", ""))
                 self._mark_journaled(cycle, st, "swap")
             st["terminal"] = "promoted"
         else:
@@ -458,7 +467,8 @@ class AnnotationFactory:
                     factory=self.name,
                     reason=sw.get("reason", "unknown"),
                     epoch=sw.get("epoch"),
-                    agreement=sw.get("agreement"))
+                    agreement=sw.get("agreement"),
+                    trace_id=st.get("trace_id", ""))
                 self._mark_journaled(cycle, st, "swap")
             st["terminal"] = "rolled_back"
         self._commit_state(cycle, st)
@@ -491,6 +501,15 @@ class AnnotationFactory:
         st = self.load_state(cycle)
         if st.get("terminal"):
             return st
+        if not st.get("trace_id"):
+            # one trace context per CYCLE, minted at admission and
+            # committed before any stage work: a resumed cycle reuses
+            # the same id, so ingest tickets, the training run and the
+            # swap all join into one fleet trace across crashes
+            from .scheduler import new_trace_id
+
+            st["trace_id"] = new_trace_id()
+            self._commit_state(cycle, st)
         self._stage_ingest(cycle, st, list(batches))
         self._stage_train(cycle, st)
         self._stage_build(cycle, st)

@@ -80,10 +80,11 @@ import warnings
 
 from .registry import Pipeline, Transform
 from .runner import DEFAULT_FALLBACK_BACKEND, _Journal
-from .scheduler import RunRejected, RunShed, TERMINAL_STATES  # noqa: F401
+from .scheduler import (RunRejected, RunShed,  # noqa: F401
+                        TERMINAL_STATES, new_trace_id)
 from .transport import (FileTransport, SocketTransport,
-                        LINE_RE, parse_fields)
-from .utils import telemetry
+                        LINE_RE, LOSSY_KINDS, parse_fields)
+from .utils import telemetry, trace
 from .utils.checkpoint import load_celldata, save_celldata
 from .utils.failsafe import BreakerRegistry, CircuitBreaker
 from .utils.vclock import SYSTEM_CLOCK
@@ -706,10 +707,11 @@ class _Ticket:
     __slots__ = ("id", "seq", "tenant", "priority", "backend",
                  "steps", "runner_kw", "dir", "epoch", "handle",
                  "worker", "submitted_at", "ready", "committing",
-                 "accepted")
+                 "accepted", "trace_id")
 
     def __init__(self, seq: int, tenant: str, priority: int,
-                 backend, steps, runner_kw, tdir, handle, now):
+                 backend, steps, runner_kw, tdir, handle, now,
+                 trace_id: str = ""):
         self.id = f"t{seq:06d}"
         self.seq = seq
         self.tenant = tenant
@@ -732,6 +734,11 @@ class _Ticket:
         #: vs the real `done` line) dedupe silently instead of being
         #: journalled as a fencing refusal
         self.accepted = None
+        #: the admission-stamped trace context: every supervisor
+        #: journal record about this ticket carries it, the spec
+        #: ships it to whichever worker owns the epoch, and the
+        #: worker's spans come back keyed on it
+        self.trace_id = trace_id
 
     def sort_key(self):
         return (-self.priority, self.seq)
@@ -872,6 +879,8 @@ class FederationSupervisor:
                     exist_ok=True)
         os.makedirs(os.path.join(self.fed_dir, "workers"),
                     exist_ok=True)
+        os.makedirs(os.path.join(self.fed_dir, "obs"),
+                    exist_ok=True)
         self.n_workers = int(n_workers)
         self.worker_capacity = int(worker_capacity)
         self.lease_timeout_s = float(lease_timeout_s)
@@ -889,6 +898,12 @@ class FederationSupervisor:
         self.env = env
         self.journal = _Journal(os.path.join(self.fed_dir,
                                              "journal.jsonl"))
+        #: the FLEET registry: every worker's lossy obs deltas merge
+        #: here keyed ``worker=``, ticked on the supervisor's
+        #: injectable clock and flushed tick-stamped under ``obs/`` —
+        #: a worker SIGKILLed mid-run leaves its series up to its
+        #: last delivered frame (the trail the post-mortem reads)
+        self.fleet = telemetry.MetricsRegistry(clock=self.clock)
         if transport not in ("file", "socket"):
             raise ValueError(f"unknown transport {transport!r} "
                              f"(file | socket)")
@@ -1042,6 +1057,8 @@ class FederationSupervisor:
                     self._on_done(w, fields)
                 elif kind == "refused":
                     self._on_refused(w, fields)
+                elif kind == "obs":
+                    self._on_obs(w, fields)
         finally:
             with contextlib.suppress(subprocess.TimeoutExpired,
                                      OSError):
@@ -1072,7 +1089,13 @@ class FederationSupervisor:
             stale = (w is None
                      or int(fields.get("gen", w.gen)) != w.gen)
         if stale:
-            if kind == "done" and w is not None:
+            if kind == "obs":
+                # a fenced predecessor's telemetry must not pollute
+                # the fleet trail under the CURRENT incarnation's
+                # worker= label — dropped on the record, never merged
+                self.metrics.counter("obs.dropped",
+                                     reason="stale_gen").inc()
+            elif kind == "done" and w is not None:
                 self.journal.write(
                     "commit_refused",
                     ticket=str(fields.get("ticket", "")), worker=frm,
@@ -1086,6 +1109,8 @@ class FederationSupervisor:
             self._on_done(w, fields)
         elif kind == "refused":
             self._on_refused(w, fields)
+        elif kind == "obs":
+            self._on_obs(w, fields)
 
     def _on_beat(self, w: _Worker) -> None:
         with self._lock:
@@ -1193,7 +1218,8 @@ class FederationSupervisor:
             if status == "completed":
                 self.journal.write("run_completed", ticket=tid,
                                    tenant=t.tenant, worker=w.name,
-                                   epoch=epoch, **extra)
+                                   epoch=epoch, trace_id=t.trace_id,
+                                   **extra)
             else:
                 with contextlib.suppress(OSError, ValueError):
                     # terse fallback; the worker journal has it all
@@ -1205,7 +1231,8 @@ class FederationSupervisor:
                         f"{err}"), reason="run_failed"))
                 self.journal.write("run_failed", ticket=tid,
                                    tenant=t.tenant, worker=w.name,
-                                   epoch=epoch, error=err, **extra)
+                                   epoch=epoch, error=err,
+                                   trace_id=t.trace_id, **extra)
         finally:
             t.handle.worker = w.name
             t.handle._finish(verdict[0], **verdict[1])
@@ -1233,6 +1260,35 @@ class FederationSupervisor:
                 t.worker = None
                 self._requeue_locked(t, from_worker=w)
                 self._dispatch_locked()
+
+    def _on_obs(self, w: _Worker, fields: dict) -> None:
+        """One LOSSY obs frame: merge the worker's metric delta into
+        the fleet registry.  Never refreshes the lease (only explicit
+        beats prove the worker LOOP is alive), never raises back into
+        the pump/receiver thread, and a malformed or stale frame is
+        dropped on the record (``obs.dropped``) — the cost of any
+        loss is exactly that frame's window of samples, which the
+        worker's cursor already gave up at export time."""
+        with self._lock:
+            if w.lost or w.wedged:
+                # a partitioned/fenced worker's telemetry is dropped
+                # like every other message of its incarnation
+                self.metrics.counter("obs.dropped",
+                                     reason="partitioned").inc()
+                return
+        try:
+            delta = json.loads(str(fields.get("delta", "")))
+        except ValueError:
+            self.metrics.counter("obs.dropped", reason="decode").inc()
+            return
+        try:
+            self.fleet.merge_delta(delta, worker=w.name)
+        except (TypeError, ValueError, KeyError, AttributeError):
+            # boundary mismatch / wrong shape: refuse the frame, keep
+            # the trail — obs must degrade, never propagate
+            self.metrics.counter("obs.dropped", reason="merge").inc()
+            return
+        self.metrics.counter("obs.frames", worker=w.name).inc()
 
     def _on_exit(self, w: _Worker) -> None:
         with self._lock:
@@ -1275,6 +1331,10 @@ class FederationSupervisor:
                        for w in self._workers.values()
                        if not (w.lost or w.wedged)
                        for t in list(w.in_flight)]
+        # the fleet trail flush rides the same decimated tick as the
+        # recovery probe (file IO — outside the lock, SCT011): one
+        # tick-stamped snapshot under obs/ per Nth supervision tick
+        self._flush_obs()
         # RESULT-FILE RECOVERY, outside the lock (file IO — SCT011):
         # the atomic rename on the shared fed dir is the durable
         # commit; the worker's stderr ``done`` line is only the
@@ -1297,6 +1357,25 @@ class FederationSupervisor:
                 continue  # not committed (or mid-write): next tick
             self._on_done(w, {"ticket": t.id, "epoch": epoch,
                               "status": status}, recovered=True)
+
+    def _flush_obs(self) -> None:
+        """Tick the fleet registry and land the trail as a durable
+        tick-stamped snapshot (``obs/fleet-<tick>.json``, atomic
+        rename).  A worker already ruled lost keeps its merged series
+        in every later flush — death truncates a trail, it never
+        erases one."""
+        rec = self.fleet.tick()
+        path = os.path.join(self.fed_dir, "obs",
+                            f"fleet-{int(rec['tick']):06d}.json")
+        try:
+            self.fleet.write(path, series=True)
+        except OSError as e:
+            warnings.warn(
+                f"FederationSupervisor: could not flush {path} "
+                f"({type(e).__name__}: {e}) — the in-memory trail "
+                "still has the series", RuntimeWarning, stacklevel=2)
+            return
+        self.metrics.counter("obs.flushes").inc()
 
     def _journal_tail(self, w: _Worker, n: int = 8) -> list:
         """The dead worker's last journal records, grafted into its
@@ -1403,14 +1482,16 @@ class FederationSupervisor:
         self._queue.append(t)
         self._queue.sort(key=_Ticket.sort_key)
         self.journal.write("requeued", ticket=t.id, tenant=t.tenant,
-                           from_worker=from_worker.name, epoch=t.epoch)
+                           from_worker=from_worker.name, epoch=t.epoch,
+                           trace_id=t.trace_id)
         self.metrics.counter("fed.requeues").inc()
 
     # -- admission ------------------------------------------------------
     def submit(self, pipeline: Pipeline, data, *,
                tenant: str = "default", priority: int = 0,
                backend: str | None = None,
-               runner_kw: dict | None = None) -> TicketHandle:
+               runner_kw: dict | None = None,
+               trace_id: str | None = None) -> TicketHandle:
         """Admit one federated run (or refuse it: ``RunRejected``).
         Funnel: open → chaos ``reject_storm`` → tenant queue quota →
         high-water (shed a lower-priority victim or reject the
@@ -1430,31 +1511,43 @@ class FederationSupervisor:
                                "start() — use it as a context manager")
         steps = [(t.name, t.backend, dict(t.params))
                  for t in pipeline.steps]
+        # the trace context is minted HERE, at federated admission —
+        # the id every record about this ticket joins on, across the
+        # supervisor journal, the owning worker's journal, the inner
+        # runner's records and the returned span tree
+        if not trace_id:
+            trace_id = new_trace_id()
         with self._lock:
             seq = self._seq
             self._seq += 1
             tid = f"t{seq:06d}"
             self.journal.write("submitted", ticket=tid, tenant=tenant,
                                priority=priority,
-                               queue_depth=len(self._queue))
+                               queue_depth=len(self._queue),
+                               trace_id=trace_id)
             if self._closed:
-                self._reject(tid, tenant, "scheduler_closed")
+                self._reject(tid, tenant, "scheduler_closed",
+                             trace_id=trace_id)
             if self.chaos is not None and \
                     self.chaos.on_admission(tenant, backend=backend):
-                self._reject(tid, tenant, "reject_storm")
+                self._reject(tid, tenant, "reject_storm",
+                             trace_id=trace_id)
             queued = sum(1 for q in self._queue if q.tenant == tenant)
             if queued >= self.tenant_max_queued:
-                self._reject(tid, tenant, "tenant_queue_quota")
+                self._reject(tid, tenant, "tenant_queue_quota",
+                             trace_id=trace_id)
             if len(self._queue) >= self.queue_high_water:
                 victim = self._pick_victim_locked(priority)
                 if victim is None:
-                    self._reject(tid, tenant, "queue_full")
+                    self._reject(tid, tenant, "queue_full",
+                                 trace_id=trace_id)
                 self._shed_locked(victim, "queue_high_water")
             tdir = os.path.join(self.fed_dir, "tickets", tid)
             handle = TicketHandle(tid, tenant, int(priority))
+            handle.trace_id = trace_id
             t = _Ticket(seq, tenant, priority, backend, steps,
                         dict(runner_kw or {}), tdir, handle,
-                        self.clock.monotonic())
+                        self.clock.monotonic(), trace_id=trace_id)
             self._tickets[tid] = t
             # queued immediately (not-yet-ready: dispatch skips it)
             # so quota/high-water accounting stays exact while the
@@ -1466,7 +1559,8 @@ class FederationSupervisor:
             self._all_idle.clear()
             self.journal.write("admitted", ticket=tid, tenant=tenant,
                                priority=priority,
-                               queue_depth=len(self._queue))
+                               queue_depth=len(self._queue),
+                               trace_id=trace_id)
             self.metrics.counter("sched.admitted", tenant=tenant).inc()
             self.metrics.gauge("sched.queue_depth").set(
                 len(self._queue))
@@ -1475,7 +1569,8 @@ class FederationSupervisor:
             save_celldata(data, os.path.join(tdir, "data.npz"))
             spec = {"ticket": tid, "tenant": tenant,
                     "priority": int(priority), "backend": backend,
-                    "steps": steps, "runner_kw": dict(runner_kw or {})}
+                    "steps": steps, "runner_kw": dict(runner_kw or {}),
+                    "trace_id": trace_id}
             with open(os.path.join(tdir, "ticket.json.tmp"), "w") as f:
                 json.dump(spec, f)
             os.replace(os.path.join(tdir, "ticket.json.tmp"),
@@ -1492,7 +1587,8 @@ class FederationSupervisor:
                     self.journal.write(  # sctlint: disable=SCT011
                         "run_failed", ticket=tid, tenant=tenant,
                         error=f"submit write failed: "
-                              f"{type(e).__name__}: {e}")
+                              f"{type(e).__name__}: {e}",
+                        trace_id=trace_id)
                     t.handle._finish(
                         "failed", error=FederatedRunError(
                             f"ticket {tid}: could not write the "
@@ -1505,9 +1601,10 @@ class FederationSupervisor:
             self._dispatch_locked()
         return handle
 
-    def _reject(self, tid: str, tenant: str, reason: str) -> None:
+    def _reject(self, tid: str, tenant: str, reason: str,
+                trace_id: str = "") -> None:
         self.journal.write("rejected", ticket=tid, tenant=tenant,
-                           reason=reason)
+                           reason=reason, trace_id=trace_id)
         self.metrics.counter("sched.rejected", tenant=tenant,
                              reason=reason).inc()
         raise RunRejected(
@@ -1539,7 +1636,8 @@ class FederationSupervisor:
             self._queue.remove(t)
         self.journal.write("shed", ticket=t.id, tenant=t.tenant,
                            priority=t.priority, reason=reason,
-                           queue_depth=len(self._queue))
+                           queue_depth=len(self._queue),
+                           trace_id=t.trace_id)
         self.metrics.counter("sched.shed", tenant=t.tenant,
                              reason=reason).inc()
         t.handle._finish("shed", error=RunShed(
@@ -1596,7 +1694,8 @@ class FederationSupervisor:
                     self._requeue_locked(t, from_worker=w)
                     continue
                 self.journal.write("assigned", ticket=t.id,
-                                   worker=w.name, epoch=t.epoch)
+                                   worker=w.name, epoch=t.epoch,
+                                   trace_id=t.trace_id)
                 progress = True
 
     def _pick_worker_locked(self):
@@ -1730,7 +1829,46 @@ class FederationSupervisor:
                 f"FederationSupervisor: could not write {mpath} "
                 f"({type(e).__name__}: {e})", RuntimeWarning,
                 stacklevel=2)
+        # the fleet trail's FINAL flush and the merged Perfetto
+        # timeline: both best-effort — observability must degrade,
+        # never turn a clean shutdown into a failure
+        self._flush_obs()
+        try:
+            self._export_fleet_trace()
+        except (OSError, ValueError) as e:
+            warnings.warn(
+                f"FederationSupervisor: fleet trace export failed "
+                f"({type(e).__name__}: {e})", RuntimeWarning,
+                stacklevel=2)
         return ok
+
+    def _export_fleet_trace(self) -> str | None:
+        """Merge the span trees every terminal ticket's owning worker
+        returned through the result-file handoff with the
+        supervisor's own spans into ONE Perfetto timeline
+        (``fed_dir/trace.json``, one pid per process) — the whole
+        fleet on one ruler.  Returns the path, or ``None`` when no
+        process recorded a span."""
+        with self._lock:
+            accepted = [(t.accepted[0], t.accepted[1], t.dir)
+                        for t in self._tickets.values()
+                        if t.accepted is not None]
+        by_worker: dict[str, list] = {}
+        for wname, epoch, tdir in accepted:
+            rpath = os.path.join(tdir, f"result-{epoch:03d}.json")
+            try:
+                with open(rpath) as f:
+                    spans = json.load(f).get("spans") or []
+            except (OSError, ValueError):
+                continue  # a lost result file costs its own spans only
+            by_worker.setdefault(wname, []).extend(spans)
+        processes = [("supervisor", trace.all_spans())]
+        processes += [(f"worker:{name}", spans)
+                      for name, spans in sorted(by_worker.items())]
+        if not any(spans for _, spans in processes):
+            return None
+        return trace.export_fleet_trace(
+            os.path.join(self.fed_dir, "trace.json"), processes)
 
 
 # ---------------------------------------------------------------------------
@@ -1796,11 +1934,17 @@ def worker_main(fed_dir: str, worker_id: str, gen: int = 0) -> int:
     #: and the breakers' probe audit land in the same file
     #: (`_Journal` appends are line-atomic across instances)
     wjournal = _Journal(os.path.join(wdir, "journal.jsonl"))
+    #: the worker's OWN registry (not the process default): the inner
+    #: scheduler, transport and breakers all record here, and the
+    #: heartbeat thread ships its ticks to the supervisor as lossy
+    #: obs deltas — the worker side of the fleet trail
+    wmetrics = telemetry.MetricsRegistry()
     tcfg = cfg.get("transport") or {}
     net = None
     if tcfg.get("kind") == "socket":
         net = SocketTransport(worker_id, chaos=chaos,
-                              journal=wjournal, seed=gen)
+                              journal=wjournal, metrics=wmetrics,
+                              seed=gen)
         net.connect("supervisor", tcfg["host"], int(tcfg["port"]))
 
     def say(kind: str, **fields) -> None:
@@ -1817,7 +1961,7 @@ def worker_main(fed_dir: str, worker_id: str, gen: int = 0) -> int:
             return  # same lost-doorbell test hook as the file plane
         fields.setdefault("gen", gen)
         net.send("supervisor", kind,
-                 retries=0 if kind in ("beat", "noise") else None,
+                 retries=0 if kind in LOSSY_KINDS else None,
                  **fields)
 
     breakers = FederatedBreakerRegistry(
@@ -1830,9 +1974,27 @@ def worker_main(fed_dir: str, worker_id: str, gen: int = 0) -> int:
     seq = [0]
 
     def _beats():
+        # the heartbeat cadence doubles as the obs-shipping cadence:
+        # tick the local trail, export only what changed, and ship it
+        # as a LOSSY frame (zero retries on the socket — a dropped
+        # frame costs its own window of samples and nothing else).
+        # Any obs failure degrades to noise: telemetry must never
+        # stop the heartbeat that keeps this worker's lease alive.
         while not stop_beats.wait(heartbeat_s):
             seq[0] += 1
             say("beat", seq=seq[0])
+            try:
+                wmetrics.tick()
+                delta = wmetrics.snapshot_delta()
+                if (delta["counters"] or delta["gauges"]
+                        or delta["histograms"]):
+                    say("obs", seq=seq[0],
+                        delta=json.dumps(delta,
+                                         separators=(",", ":")))
+            except Exception as e:  # noqa: BLE001 — obs is lossy by
+                # contract: a telemetry fault must degrade to worker
+                # noise, never kill the heartbeat thread
+                say("noise", obs_error=type(e).__name__)
 
     hb = threading.Thread(target=_beats, daemon=True,
                           name="sct-fed-heartbeat")
@@ -1850,7 +2012,7 @@ def worker_main(fed_dir: str, worker_id: str, gen: int = 0) -> int:
         max_concurrency=1, queue_high_water=1_000_000,
         tenant_max_in_flight=1_000_000, tenant_max_queued=1_000_000,
         journal_path=os.path.join(wdir, "journal.jsonl"),
-        breakers=breakers, chaos=chaos,
+        metrics=wmetrics, breakers=breakers, chaos=chaos,
         runner_defaults=_build_runner_defaults(cfg))
     try:
         while True:
@@ -1934,12 +2096,14 @@ def _run_assignment(sched, assign: dict, wdir: str, fenced,
     # from the previous owner's fingerprinted checkpoints — at-most-
     # once execution for completed stages, never a replay
     runner_kw.setdefault("checkpoint_dir", os.path.join(tdir, "ckpt"))
+    tr_id = str(spec.get("trace_id") or "")
     status, error = "completed", None
     out = None
     try:
         h = sched.submit(pipeline, data, tenant=spec["tenant"],
                          backend=spec.get("backend"),
-                         runner_kw=runner_kw)
+                         runner_kw=runner_kw,
+                         trace_id=tr_id or None)
         out = h.result()
     except BaseException as e:  # noqa: BLE001 — the worker loop must
         # survive anything a run raises; the verdict is committed as
@@ -1953,12 +2117,21 @@ def _run_assignment(sched, assign: dict, wdir: str, fenced,
         say("refused", ticket=tid, epoch=epoch)
         return
     rbase = os.path.join(tdir, f"result-{epoch:03d}")
+    # the span-tree handoff: this ticket's spans (keyed by the
+    # admission trace_id the runner stamped into span meta) ride the
+    # result file back to the supervisor, which merges every
+    # process's trees into one Perfetto timeline at shutdown
+    spans = []
+    if tr_id:
+        spans = [s for s in trace.serialize_spans(trace.all_spans())
+                 if (s.get("meta") or {}).get("trace_id") == tr_id]
     try:
         if status == "completed":
             save_celldata(out, rbase + ".npz")
         with open(rbase + ".json.tmp", "w") as f:
             json.dump({"status": status, "error": error,
-                       "epoch": epoch, "ts": round(time.time(), 3)}, f)
+                       "epoch": epoch, "ts": round(time.time(), 3),
+                       "trace_id": tr_id, "spans": spans}, f)
         os.replace(rbase + ".json.tmp", rbase + ".json")
     except OSError as e:
         # a failed COMMIT (disk full, result dir gone) is still a

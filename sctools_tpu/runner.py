@@ -286,8 +286,12 @@ class _Journal:
     an internal lock — the scheduler's workers share one journal and
     write terminal events from their own threads."""
 
-    def __init__(self, path: str | None):
+    def __init__(self, path: str | None, bound: dict | None = None):
         self.path = path
+        #: fields stamped onto EVERY record (the runner binds
+        #: ``trace_id=`` here, so a run's whole journal joins the
+        #: fleet trace without each write site repeating it)
+        self.bound = dict(bound) if bound else {}
         self._lock = _threading.Lock()
         if path:
             os.makedirs(os.path.dirname(os.path.abspath(path)),
@@ -296,7 +300,8 @@ class _Journal:
     def write(self, event: str, **fields) -> None:
         if not self.path:
             return
-        rec = {"event": event, "ts": round(time.time(), 3), **fields}
+        rec = {"event": event, "ts": round(time.time(), 3),
+               **self.bound, **fields}
         with self._lock:
             # the one sanctioned write-under-lock: THIS lock exists
             # solely to serialize this append (concurrent workers
@@ -420,7 +425,8 @@ class ResilientRunner:
                  step_deadline_s: float | None = None,
                  breaker: CircuitBreaker | None = None,
                  clock=None, sleep=None, metrics=None,
-                 fuse: bool = False, mesh=None):
+                 fuse: bool = False, mesh=None,
+                 trace_id: str | None = None):
         if mesh is not None and not fuse:
             raise ValueError(
                 "ResilientRunner(mesh=...) shards fused execution "
@@ -472,7 +478,13 @@ class ResilientRunner:
         # degrade ruling's "degraded" label to THIS run, even when the
         # metrics registry is the process-shared default
         self._inst = telemetry.CallInstrumentor(self.metrics)
-        self.journal = _Journal(journal_path)
+        # the admission-stamped causal id: bound onto every journal
+        # record of this run and into every attempt span's meta, the
+        # end-to-end join key of the fleet observability plane
+        self.trace_id = trace_id
+        self.journal = _Journal(
+            journal_path,
+            bound={"trace_id": trace_id} if trace_id else None)
         self.report = RunReport(journal_path=journal_path)
         self._input_digest: str | None = None
         self._mem_input_bytes: int = 1
@@ -851,9 +863,10 @@ class ResilientRunner:
                                      label=f"step {i} ({t.name})")
                        if self.step_deadline_s is not None else None)
                 err = None
-                with trace.span(f"runner:{t.name}",
-                                meta={"step": i, "attempt": attempt,
-                                      "backend": b}) as sp:
+                meta = {"step": i, "attempt": attempt, "backend": b}
+                if self.trace_id:
+                    meta["trace_id"] = self.trace_id
+                with trace.span(f"runner:{t.name}", meta=meta) as sp:
                     try:
                         scope = (deadline_scope(tok) if tok is not None
                                  else contextlib.nullcontext())

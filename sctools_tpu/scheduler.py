@@ -102,6 +102,7 @@ import contextlib
 import dataclasses
 import os
 import threading
+import uuid
 
 from . import memory as _memory
 from .registry import Pipeline
@@ -121,6 +122,14 @@ TERMINAL_STATES = ("completed", "failed", "rejected", "shed")
 
 #: EWMA smoothing for observed run walls (the deadline estimator)
 _EWMA_ALPHA = 0.3
+
+
+def new_trace_id() -> str:
+    """A fresh admission-stamped causal id.  Opaque and globally
+    unique — it joins journal records and span metadata across every
+    process a ticket touches (supervisor, worker, runner), so it must
+    never collide across the fleet; nothing ever parses it."""
+    return f"tr-{uuid.uuid4().hex[:16]}"
 
 
 class RunRejected(RuntimeError):
@@ -157,11 +166,14 @@ class RunHandle:
     see them."""
 
     def __init__(self, ticket: int, tenant: str, priority: int,
-                 deadline_s: float | None, clock=None):
+                 deadline_s: float | None, clock=None,
+                 trace_id: str | None = None):
         self.ticket = ticket
         self.tenant = tenant
         self.priority = priority
         self.deadline_s = deadline_s
+        #: the admission-stamped causal id (fleet trace join key)
+        self.trace_id = trace_id
         self.report = None
         self.reason: str | None = None
         #: the scheduler clock's reading at the terminal transition
@@ -267,6 +279,9 @@ class _QueueItem:
     backend: str | None
     runner_kw: dict
     handle: RunHandle
+    #: the admission-stamped causal id: rides every journal record of
+    #: this ticket and into the runner's span metadata
+    trace_id: str = ""
     #: declared long-running + checkpoint-then-yield capable: a
     #: preemption victim when higher-priority work arrives with no
     #: free worker (the job polls its token at its safe boundaries)
@@ -346,7 +361,8 @@ class RunScheduler:
                  journal_path: str | None = None,
                  breakers: BreakerRegistry | None = None,
                  chaos=None, runner_defaults: dict | None = None,
-                 mem_budget: "_memory.MemoryBudget | None" = None):
+                 mem_budget: "_memory.MemoryBudget | None" = None,
+                 slo_objectives=None):
         if max_concurrency < 1:
             raise ValueError("max_concurrency must be >= 1")
         if queue_high_water < 1:
@@ -398,6 +414,18 @@ class RunScheduler:
         self._hooks = contextlib.ExitStack()
         if chaos is not None:
             self._hooks.enter_context(chaos.activate())
+        # SLO rulings over the admission funnel, on by default: the
+        # monitor journals slo_breach/slo_recovered into THIS journal
+        # and is poked (rate-limited, outside the dispatch lock) from
+        # the worker loop.  slo_objectives=() disables it.
+        from .slo import SLOMonitor, scheduler_objectives
+
+        objectives = (scheduler_objectives()
+                      if slo_objectives is None else slo_objectives)
+        self.slo = (SLOMonitor(self.metrics, journal=self.journal,
+                               clock=self.clock,
+                               objectives=objectives)
+                    if objectives else None)
 
     # -- context manager ------------------------------------------------
     def __enter__(self):
@@ -424,7 +452,8 @@ class RunScheduler:
                priority: int = 0, deadline_s: float | None = None,
                backend: str | None = None,
                runner_kw: dict | None = None,
-               preemptible: bool = False) -> RunHandle:
+               preemptible: bool = False,
+               trace_id: str | None = None) -> RunHandle:
         """Admit one run (or refuse it, raising :class:`RunRejected`).
 
         Admission rulings, in order: scheduler open → chaos
@@ -504,6 +533,14 @@ class RunScheduler:
                                f"admissible {admissible} bytes "
                                f"(capacity minus standing "
                                f"reservations)")
+        # the causal id is stamped AT ADMISSION — every journal record
+        # of this ticket (here, in a federation worker, in the runner)
+        # and every span the run records carries it, so the whole
+        # fleet journey joins on one key.  Callers that already hold
+        # one (the federation worker re-dispatching a ticket, the
+        # serving tier) pass it through instead.
+        if not trace_id:
+            trace_id = new_trace_id()
         with self._cv:
             ticket = self._seq
             self._seq += 1
@@ -511,36 +548,43 @@ class RunScheduler:
             self.journal.write(
                 "submitted", ticket=ticket, tenant=tenant,
                 priority=priority, deadline_s=deadline_s,
+                trace_id=trace_id,
                 queue_depth=len(self._queue))
             if self._closed:
-                self._reject(ticket, tenant, "scheduler_closed")
+                self._reject(ticket, tenant, "scheduler_closed",
+                             trace_id=trace_id)
             if self.chaos is not None and \
                     self.chaos.on_admission(tenant, backend=backend):
-                self._reject(ticket, tenant, "reject_storm")
+                self._reject(ticket, tenant, "reject_storm",
+                             trace_id=trace_id)
             quota = self._quota(tenant)
             if self._queued_by_tenant.get(tenant, 0) >= quota.max_queued:
-                self._reject(ticket, tenant, "tenant_queue_quota")
+                self._reject(ticket, tenant, "tenant_queue_quota",
+                             trace_id=trace_id)
             if deadline_s is not None:
                 est = self._estimate_start_wait_locked(priority, ticket)
                 if deadline_s <= 0 or est > deadline_s:
                     self._reject(
                         ticket, tenant, "deadline_unmeetable",
+                        trace_id=trace_id,
                         detail=f"estimated start wait {est:g}s > "
                                f"deadline {deadline_s:g}s")
             if mem_refusal is not None:
                 self._reject(ticket, tenant, "over_memory",
-                             detail=mem_refusal)
+                             trace_id=trace_id, detail=mem_refusal)
             if len(self._queue) >= self.queue_high_water:
                 victim = self._pick_victim_locked(priority)
                 if victim is None:
-                    self._reject(ticket, tenant, "queue_full")
+                    self._reject(ticket, tenant, "queue_full",
+                                 trace_id=trace_id)
                 self._shed_locked(victim, "queue_high_water")
             handle = RunHandle(ticket, tenant, priority, deadline_s,
-                               clock=self.clock)
+                               clock=self.clock, trace_id=trace_id)
             handle._cancel_cb = self._cancel
             item = _QueueItem(ticket, tenant, int(priority), deadline_s,
                               self.clock.monotonic(), pipeline, data,
                               backend, dict(runner_kw or {}), handle,
+                              trace_id=trace_id,
                               preemptible=bool(preemptible),
                               mem_bytes=int(mem_bytes))
             self._insert_locked(item)
@@ -548,6 +592,7 @@ class RunScheduler:
             self.journal.write("admitted", ticket=ticket, tenant=tenant,
                                priority=priority,
                                mem_bytes=int(mem_bytes),
+                               trace_id=trace_id,
                                queue_depth=len(self._queue))
             self.metrics.counter("sched.admitted", tenant=tenant).inc()
             self._ensure_workers_locked()
@@ -600,10 +645,10 @@ class RunScheduler:
         return self._quotas.get(tenant, self._default_quota)
 
     def _reject(self, ticket: int, tenant: str, reason: str,
-                detail: str = ""):
+                detail: str = "", trace_id: str = ""):
         self._stats["rejected"] += 1
         self.journal.write("rejected", ticket=ticket, tenant=tenant,
-                           reason=reason)
+                           reason=reason, trace_id=trace_id)
         self.metrics.counter("sched.rejected", tenant=tenant,
                              reason=reason).inc()
         raise RunRejected(
@@ -676,6 +721,7 @@ class RunScheduler:
         self._stats["shed"] += 1
         self.journal.write("shed", ticket=item.seq, tenant=item.tenant,
                            priority=item.priority, reason=reason,
+                           trace_id=item.trace_id,
                            queue_depth=len(self._queue))
         self.metrics.counter("sched.shed", tenant=item.tenant,
                              reason=reason).inc()
@@ -866,6 +912,7 @@ class RunScheduler:
                             "preempted", ticket=item.seq,
                             tenant=item.tenant,
                             priority=item.priority,
+                            trace_id=item.trace_id,
                             reason=preempted.reason,
                             cursor=preempted.cursor,
                             wall_s=round(wall, 4),
@@ -905,6 +952,7 @@ class RunScheduler:
                     self.journal.write(
                         "shed", ticket=item.seq, tenant=item.tenant,
                         priority=item.priority, reason="cancelled",
+                        trace_id=item.trace_id,
                         queue_depth=self.queue_depth())
                     self.metrics.counter(
                         "sched.shed", tenant=item.tenant,
@@ -924,21 +972,29 @@ class RunScheduler:
                 self.journal.write(
                     "run_completed", ticket=item.seq,
                     tenant=item.tenant, wall_s=round(wall, 4),
+                    trace_id=item.trace_id,
                     degraded=bool(runner.report.degraded))
             else:
                 self.journal.write(
                     "run_failed", ticket=item.seq,
                     tenant=item.tenant, wall_s=round(wall, 4),
+                    trace_id=item.trace_id,
                     error=f"{type(error).__name__}: {error}")
             item.handle._finish(status, result=result, error=error,
                                 reason=None if error is None
                                 else type(error).__name__)
+            # SLO rulings ride the worker loop's cadence — evaluated
+            # OUTSIDE the dispatch lock (journal appends inside), and
+            # rate-limited on the injectable clock
+            if self.slo is not None:
+                self.slo.maybe_evaluate()
 
     def _make_runner(self, item: _QueueItem) -> ResilientRunner:
         kw = dict(self.runner_defaults)
         kw.update(item.runner_kw)
         kw.setdefault("clock", self.clock)
         kw.setdefault("metrics", self.metrics)
+        kw.setdefault("trace_id", item.trace_id or None)
         if self.chaos is not None:
             kw.setdefault("chaos", self.chaos)
         if kw.get("breaker") is None:

@@ -770,7 +770,8 @@ class AnnotationService:
                  k: int = 15, metric: str = "cosine",
                  buckets=DEFAULT_BUCKETS,
                  canary_threshold: float = 0.9,
-                 query_deadline_s: float | None = None):
+                 query_deadline_s: float | None = None,
+                 slo_objectives=None):
         # reserve the name ATOMICALLY before any loading: a raced
         # duplicate construction must fail here, not silently steal
         # the name mid-flight
@@ -822,6 +823,19 @@ class AnnotationService:
             self._breakers = self._sched.breakers
             self._mem_budget = mem_budget
         self.journal = self._sched.journal
+        # serving-tier SLOs, on by default: p99-style query latency
+        # and the error budget, ruled over the shared registry's
+        # time-series trail and journaled into the query-funnel
+        # journal.  slo_objectives=() disables; maybe_evaluate rides
+        # the per-query accounting path (rate-limited, lock-free).
+        from .slo import SLOMonitor, serving_objectives
+
+        objectives = (serving_objectives()
+                      if slo_objectives is None else slo_objectives)
+        self.slo = (SLOMonitor(self.metrics, journal=self.journal,
+                               clock=self.clock,
+                               objectives=objectives)
+                    if objectives else None)
         self._breaker = self._breakers.get(backend, clock=self.clock)
         self._state_lock = threading.Lock()
         # guards the standing reservation's closed-check-and-reserve
@@ -1026,7 +1040,8 @@ class AnnotationService:
     def query(self, X, kind: str = "label_transfer", *,
               tenant: str = "default", priority: int = 0,
               deadline_s: float | None = None, k: int | None = None,
-              score_set: str | None = None) -> ServeTicket:
+              score_set: str | None = None,
+              trace_id: str | None = None) -> ServeTicket:
         """Admit one query batch (or refuse it — the scheduler's
         :class:`~sctools_tpu.scheduler.RunRejected`, counted
         ``outcome=rejected``).  ``X`` is raw counts — CellData, numpy,
@@ -1076,9 +1091,14 @@ class AnnotationService:
             metric=self.metric, score_set=score_set or "")])
         t0 = self.clock.monotonic()
         try:
+            # the causal id is stamped at THIS admission (or passed
+            # through from an upstream caller — the factory's cycle):
+            # the scheduler journals it on the whole query funnel and
+            # the runner carries it into span metadata
             handle = self._sched.submit(
                 pipe, data, tenant=tenant, priority=priority,
-                deadline_s=deadline_s, backend=self.backend)
+                deadline_s=deadline_s, backend=self.backend,
+                trace_id=trace_id)
         except RunRejected:
             self.metrics.counter("serve.queries",
                                  outcome="rejected").inc()
@@ -1107,6 +1127,10 @@ class AnnotationService:
                   else self.clock.monotonic())
             self.metrics.histogram("serve.latency_s").observe(
                 t1 - ticket._t0)
+        # SLO rulings ride the accounting cadence (rate-limited on
+        # the injectable clock; a no-op between intervals)
+        if self.slo is not None:
+            self.slo.maybe_evaluate()
 
     def _as_query_matrix(self, X, model: _ResidentModel):
         import scipy.sparse as sp

@@ -1,0 +1,314 @@
+"""SLO burn-rate rulings over the telemetry time-series trail.
+
+The fleet observability plane's third leg (docs/ARCHITECTURE.md
+"Observability"): :class:`SLOMonitor` evaluates DECLARED objectives —
+serving p99 latency, admission availability, query error budget —
+against the :class:`~sctools_tpu.utils.telemetry.MetricsRegistry`
+ring-buffer trail, and journals ``slo_breach`` / ``slo_recovered`` as
+first-class events.  An operator is TOLD the budget is burning while
+the run is alive, instead of discovering it in a post-mortem report.
+
+Burn rate is the SRE-workbook quantity: the fraction of events in a
+window that violated the objective, divided by the objective's error
+budget (``1 - target``).  A burn rate of 1.0 spends exactly the
+budget over the objective's period; 10x spends it ten times too
+fast.  Rulings use the standard TWO-WINDOW guard: a breach opens only
+when the FAST window (sensitive, quick to recover) AND the SLOW
+window (resistant to blips) both exceed ``burn_threshold`` — a
+single slow query cannot page, and a real regression cannot hide
+behind an old quiet hour.  The breach closes (``slo_recovered``)
+when the fast window's burn drops below 1.0: the budget has stopped
+burning faster than allotted.  Every breach pairs with exactly one
+recovery — the window-close contract sctreport's fleet section joins
+on.
+
+Everything here runs on the INJECTABLE clock (the registry's own) —
+zero real sleeps, so a VirtualClock drives a whole breach/recovery
+cycle in a test without waiting out a window.  ``time.time()``
+appears only as the journal-FACT wall stamp.  No device arrays are
+ever touched: evaluation reads Python scalars out of tick records,
+so the obs hot path cannot introduce a device sync.
+
+>>> mon = SLOMonitor(metrics, journal=journal,
+...                  objectives=serving_objectives())
+>>> mon.maybe_evaluate()          # rate-limited; hot paths call this
+[("slo_breach", "serving_p99_latency")]
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from .utils.telemetry import MetricsRegistry, split_series_key
+from .utils.vclock import Clock
+
+#: threshold alignment epsilon: a bucket whose upper bound equals the
+#: objective threshold (within float noise) counts as GOOD
+_EPS = 1e-12
+
+
+@dataclass(frozen=True)
+class SeriesSel:
+    """Selects metric series by name plus a label subset: matches
+    every series whose name equals ``name`` and whose labels contain
+    all of ``labels`` (a ``(("k", "v"), ...)`` tuple)."""
+
+    name: str
+    labels: tuple = ()
+
+    def matches(self, key: str) -> bool:
+        n, lb = split_series_key(key)
+        return n == self.name and all(lb.get(k) == v
+                                      for k, v in self.labels)
+
+
+@dataclass(frozen=True)
+class Objective:
+    """One declared service-level objective.
+
+    ``kind="latency"``: over each window, the fraction of ``metric``
+    histogram observations above ``threshold_s`` is the bad fraction
+    (the histogram's fixed bucket ladder is the measurement — align
+    ``threshold_s`` with a bucket bound or the nearest lower bound
+    rules).  ``kind="ratio"``: the bad fraction is
+    ``bad / (good + bad)`` over the selected counter deltas.
+
+    ``target`` is the SLO fraction (0.99 → a 1% error budget);
+    ``burn_threshold`` is the burn rate BOTH windows must exceed to
+    open a breach."""
+
+    name: str
+    kind: str  # "latency" | "ratio"
+    target: float = 0.99
+    fast_window_s: float = 60.0
+    slow_window_s: float = 300.0
+    burn_threshold: float = 2.0
+    # kind="latency"
+    metric: str = ""
+    threshold_s: float = 0.0
+    # kind="ratio"
+    good: SeriesSel | None = None
+    bad: tuple = ()
+
+    def __post_init__(self):
+        if self.kind not in ("latency", "ratio"):
+            raise ValueError(f"unknown objective kind {self.kind!r}")
+        if not 0.0 < self.target < 1.0:
+            raise ValueError("target must be a fraction in (0, 1) — "
+                             "the error budget is 1 - target")
+        if self.kind == "latency" and not self.metric:
+            raise ValueError("latency objective needs metric=")
+        if self.kind == "ratio" and self.good is None:
+            raise ValueError("ratio objective needs good=")
+
+
+def serving_objectives(latency_slo_s: float = 0.05,
+                       target: float = 0.99) -> tuple:
+    """The serving tier's default objectives: p99-style latency (the
+    fraction of completed queries over ``latency_slo_s`` must stay
+    within the error budget) and the query error budget (failed/shed
+    outcomes vs completed)."""
+    return (
+        Objective(name="serving_p99_latency", kind="latency",
+                  metric="serve.latency_s",
+                  threshold_s=latency_slo_s, target=target),
+        Objective(name="serving_error_budget", kind="ratio",
+                  good=SeriesSel("serve.queries",
+                                 (("outcome", "completed"),)),
+                  bad=(SeriesSel("serve.queries",
+                                 (("outcome", "failed"),)),
+                       SeriesSel("serve.queries",
+                                 (("outcome", "shed"),))),
+                  target=target),
+    )
+
+
+def scheduler_objectives(target: float = 0.99) -> tuple:
+    """The admission funnel's default objective: availability —
+    rejections (any reason) burn the budget against admissions."""
+    return (
+        Objective(name="admission_availability", kind="ratio",
+                  good=SeriesSel("sched.admitted"),
+                  bad=(SeriesSel("sched.rejected"),),
+                  target=target),
+    )
+
+
+class SLOMonitor:
+    """Evaluates objectives over the registry's time-series trail and
+    journals breach/recovery rulings.
+
+    The monitor owns no schedule: hot paths call
+    :meth:`maybe_evaluate` (rate-limited on the injectable clock,
+    default once per second), supervision loops may call
+    :meth:`evaluate` directly.  Each evaluation first ticks the
+    registry (rate-limited too), so the trail always reaches "now"
+    before a window is read.  Journaling is optional — without a
+    journal the rulings still land in ``slo.burn_rate`` /
+    ``slo.breaches`` metrics and the returned list."""
+
+    def __init__(self, metrics: MetricsRegistry, journal=None,
+                 clock: Clock | None = None, objectives=(),
+                 eval_interval_s: float = 1.0,
+                 tick_interval_s: float | None = None):
+        self.metrics = metrics
+        self.journal = journal
+        self.clock = clock if clock is not None else metrics.clock
+        self.objectives = tuple(objectives)
+        self.eval_interval_s = float(eval_interval_s)
+        self.tick_interval_s = (float(tick_interval_s)
+                                if tick_interval_s is not None
+                                else self.eval_interval_s)
+        self._lock = threading.Lock()
+        self._last_eval: float | None = None
+        # objective name -> {"breached": bool, "since": mono,
+        #                    "since_wall": wall}
+        self._state: dict = {}
+
+    # -- public entry points ---------------------------------------------
+    def maybe_evaluate(self) -> list:
+        """:meth:`evaluate` if ``eval_interval_s`` has elapsed on the
+        injectable clock since the last evaluation (else ``[]``) —
+        cheap enough for admission/terminal hot paths."""
+        now = self.clock.monotonic()
+        with self._lock:
+            if self._last_eval is not None and \
+                    now - self._last_eval < self.eval_interval_s:
+                return []
+            self._last_eval = now
+        return self.evaluate()
+
+    def evaluate(self) -> list:
+        """Tick the trail, measure every objective's fast/slow burn
+        rates, rule breaches open/closed.  Returns
+        ``[(ruling, objective_name), ...]`` for rulings made NOW."""
+        self.metrics.maybe_tick(self.tick_interval_s)
+        series = self.metrics.series()
+        if not series:
+            return []
+        latest = series[-1]
+        rulings = []
+        # journal writes deferred past the lock (SCT011): one list
+        # per event so each write site keeps its literal name (SCT009)
+        pending_breach = []
+        pending_recover = []
+        with self._lock:
+            for obj in self.objectives:
+                fast = self._burn(obj, series, latest,
+                                  obj.fast_window_s)
+                slow = self._burn(obj, series, latest,
+                                  obj.slow_window_s)
+                self.metrics.gauge("slo.burn_rate",
+                                   objective=obj.name,
+                                   window="fast").set(fast)
+                self.metrics.gauge("slo.burn_rate",
+                                   objective=obj.name,
+                                   window="slow").set(slow)
+                st = self._state.setdefault(
+                    obj.name, {"breached": False})
+                if not st["breached"] \
+                        and fast >= obj.burn_threshold \
+                        and slow >= obj.burn_threshold:
+                    st["breached"] = True
+                    st["since"] = latest["t"]
+                    st["since_wall"] = round(time.time(), 3)
+                    self.metrics.counter(
+                        "slo.breaches", objective=obj.name).inc()
+                    pending_breach.append(
+                        dict(objective=obj.name,
+                             target=obj.target,
+                             burn_fast=round(fast, 3),
+                             burn_slow=round(slow, 3),
+                             fast_window_s=obj.fast_window_s,
+                             slow_window_s=obj.slow_window_s))
+                    rulings.append(("slo_breach", obj.name))
+                elif st["breached"] and fast < 1.0:
+                    st["breached"] = False
+                    window_s = latest["t"] - st.get("since",
+                                                    latest["t"])
+                    pending_recover.append(
+                        dict(objective=obj.name,
+                             target=obj.target,
+                             burn_fast=round(fast, 3),
+                             burn_slow=round(slow, 3),
+                             breach_window_s=round(window_s, 6)))
+                    rulings.append(("slo_recovered", obj.name))
+        if self.journal is not None:
+            for fields in pending_breach:
+                self.journal.write("slo_breach", **fields)
+            for fields in pending_recover:
+                self.journal.write("slo_recovered", **fields)
+        return rulings
+
+    def breached(self, name: str) -> bool:
+        with self._lock:
+            st = self._state.get(name)
+            return bool(st and st["breached"])
+
+    # -- window math -----------------------------------------------------
+    @staticmethod
+    def _basis(series: list, latest: dict, window_s: float) -> dict:
+        """The tick that anchors a window: the NEWEST tick at least
+        ``window_s`` old (partial windows fall back to the oldest
+        tick — a short trail measures what it has, it does not
+        fabricate a quiet past)."""
+        cutoff = latest["t"] - window_s
+        basis = series[0]
+        for rec in series:
+            if rec["t"] <= cutoff:
+                basis = rec
+            else:
+                break
+        return basis
+
+    def _burn(self, obj: Objective, series: list, latest: dict,
+              window_s: float) -> float:
+        basis = self._basis(series, latest, window_s)
+        if obj.kind == "latency":
+            good, bad = self._latency_counts(obj, basis, latest)
+        else:
+            good, bad = self._ratio_counts(obj, basis, latest)
+        total = good + bad
+        if total <= 0:
+            return 0.0
+        return (bad / total) / (1.0 - obj.target)
+
+    @staticmethod
+    def _latency_counts(obj: Objective, basis: dict,
+                        latest: dict) -> tuple:
+        good = bad = 0
+        basis_h = basis.get("histograms", {})
+        for key, h in latest.get("histograms", {}).items():
+            name, _ = split_series_key(key)
+            if name != obj.metric:
+                continue
+            prev = basis_h.get(key)
+            counts = h["counts"]
+            pcounts = (prev["counts"] if prev
+                       else [0] * len(counts))
+            delta = [a - b for a, b in zip(counts, pcounts)]
+            bounds = h["buckets"]
+            for bound, d in zip(bounds, delta):
+                if bound <= obj.threshold_s + _EPS:
+                    good += d
+                else:
+                    bad += d
+            bad += delta[-1]  # the +inf bucket is always bad
+        return good, bad
+
+    @staticmethod
+    def _ratio_counts(obj: Objective, basis: dict,
+                      latest: dict) -> tuple:
+        basis_c = basis.get("counters", {})
+        latest_c = latest.get("counters", {})
+
+        def total(sel: SeriesSel) -> float:
+            return sum(v - basis_c.get(k, 0.0)
+                       for k, v in latest_c.items()
+                       if sel.matches(k))
+
+        good = total(obj.good)
+        bad = sum(total(sel) for sel in obj.bad)
+        return good, bad

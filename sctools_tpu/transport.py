@@ -99,6 +99,19 @@ def decode_line(line: str) -> tuple[str, dict] | None:
 # The transport seam
 # ---------------------------------------------------------------------------
 
+#: LOSSY frame kinds: periodic signals whose next emission supersedes
+#: a lost one — a heartbeat, worker noise, and the ``obs`` telemetry
+#: delta frames the fleet observability plane ships.  ``send`` gives
+#: these ZERO retries by default: re-delivering a stale beat or a
+#: stale metrics delta is worse than dropping it (the next one
+#: carries fresher state), and the obs plane in particular must never
+#: block a worker's heartbeat cadence behind a retry schedule.  A
+#: dropped lossy frame still degrades per the net ladder (it counts a
+#: gave-up and can open/heal a partition window) — it just is not
+#: fought for.
+LOSSY_KINDS = frozenset({"beat", "noise", "obs"})
+
+
 class Transport:
     """Delivers ``(kind, fields)`` messages to named peers.
 
@@ -360,6 +373,8 @@ class SocketTransport(Transport):
              **fields) -> bool:
         if self._closed:
             return False
+        if retries is None and kind in LOSSY_KINDS:
+            retries = 0  # lossy class: the next frame supersedes this one
         with self._lock:
             plock = self._peer_locks.setdefault(peer, threading.Lock())
         # the exchange runs under the per-peer lock (wire order is a

@@ -43,6 +43,7 @@ telemetry being on).
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import os
@@ -158,6 +159,14 @@ EVENTS = frozenset({
     # .probe claim file (its owner died mid-probe, claim older than
     # the lease timeout) was swept so the HALF_OPEN probe slot frees
     "probe_reclaimed",
+    # SLO rulings (sctools_tpu/slo.py): a declared objective's error
+    # budget started burning faster than its fast+slow windows allow
+    # (slo_breach, with the measured burn rates and the window), and
+    # the later record that closed the breach window once the fast
+    # window cooled (slo_recovered — every breach must eventually pair
+    # with exactly one recovery, the windows-close contract sctreport
+    # joins on)
+    "slo_breach", "slo_recovered",
 })
 
 #: Every legal metric name → one-line meaning (the docs table).  Like
@@ -345,6 +354,24 @@ METRICS = {
     "net.retries": "counter: socket-transport send attempts "
                    "re-issued after a timeout/drop (labels peer=) — "
                    "seeded-jitter backoff on the injectable clock",
+    "obs.ticks": "counter: time-series ticks recorded into this "
+                 "registry's bounded ring buffer (one per tick(), on "
+                 "the injectable clock)",
+    "obs.frames": "counter: obs delta frames merged into the fleet "
+                  "registry by the supervisor-side aggregator "
+                  "(labels worker=)",
+    "obs.dropped": "counter: obs delta frames discarded instead of "
+                   "merged (labels reason= stale_gen|decode|merge) — "
+                   "obs is a lossy plane, a dropped frame is a "
+                   "counted non-event, never an error",
+    "obs.flushes": "counter: tick-stamped fleet snapshots durably "
+                   "written under obs/ by the supervisor",
+    "slo.burn_rate": "gauge: latest measured error-budget burn rate "
+                     "per objective window (labels objective=, "
+                     "window= fast|slow) — 1.0 burns the whole "
+                     "budget in exactly the objective's period",
+    "slo.breaches": "counter: slo_breach rulings journaled (labels "
+                    "objective=)",
 }
 
 #: Per-module journal PROTOCOLS — which EVENTS members a module may
@@ -442,6 +469,17 @@ JOURNAL_PROTOCOLS = {
                    "net_partition_entered", "net_rejoin"],
         "terminal": ["net_sent", "net_gave_up"],
     },
+    # SLO burn-rate rulings (sctools_tpu/slo.py): every record keyed
+    # objective= (never ticket= — an objective window aggregates many
+    # tickets and must not merge with the admission funnel's
+    # terminal-exactly-once proof).  A breach window opens with
+    # slo_breach (fast AND slow burn rates over threshold) and closes
+    # with exactly one slo_recovered once the fast window cools —
+    # the terminal here is the window's, not a ticket's.
+    "slo": {
+        "events": ["slo_breach", "slo_recovered"],
+        "terminal": ["slo_recovered"],
+    },
 }
 
 #: Fixed histogram bucket upper bounds (seconds), chosen to straddle
@@ -452,8 +490,35 @@ JOURNAL_PROTOCOLS = {
 DURATION_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5,
                     1.0, 5.0, 10.0, 30.0, 60.0, 300.0)
 
+#: Millisecond-scale latency ladder for paths whose p99 lives well
+#: below DURATION_BUCKETS' first rung (a resident-model serving query
+#: completes in ~2.5 ms; on the coarse ladder its whole distribution
+#: collapses into two buckets and a p99 estimate is meaningless).
+#: Spans 0.1 ms – 2.5 s.  Same fixed-boundary contract as
+#: DURATION_BUCKETS: snapshots merge bucket-by-bucket only because
+#: the boundaries never move.
+LATENCY_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                   0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+
+#: Per-metric bucket presets.  ``histogram(name)`` call sites that do
+#: not pass ``buckets=`` get the preset ladder for ``name`` (falling
+#: back to DURATION_BUCKETS), so EVERY call site of a preset metric
+#: agrees on boundaries without repeating them — get-or-create keeps
+#: the first creation's buckets, and the preset makes the first
+#: creation the same everywhere.
+BUCKET_PRESETS = {
+    "serve.latency_s": LATENCY_BUCKETS,
+    "sched.queue_wait_s": LATENCY_BUCKETS,
+}
+
 #: metrics.json layout version (bump on incompatible change)
 SNAPSHOT_SCHEMA = 1
+
+#: default bounded ring-buffer capacity for time-series ticks — at
+#: the federation supervisor's per-supervision-tick cadence this
+#: holds minutes of trail; the ring discards the oldest tick, never
+#: blocks a recorder
+SERIES_CAPACITY = 240
 
 
 # ---------------------------------------------------------------------------
@@ -542,12 +607,51 @@ class Histogram:
             return {"count": self.count, "sum": round(self.sum, 6),
                     "max": round(self.max, 6), "buckets": cum}
 
+    def merge(self, d: dict) -> None:
+        """Fold a delta doc (``count``/``sum``/``max`` plus RAW
+        per-bucket ``counts`` on the SAME boundaries) into this
+        histogram — the fleet aggregator's cross-process add.
+        Boundary mismatch raises: fixed buckets are the merge
+        precondition, a silent re-bin would fabricate latencies."""
+        bounds = tuple(float(b) for b in (d.get("buckets")
+                                          or self.buckets))
+        if bounds != self.buckets:
+            raise ValueError(
+                "histogram merge across differing bucket boundaries: "
+                f"{bounds} vs {self.buckets}")
+        counts = d.get("counts") or [0] * len(self.counts)
+        if len(counts) != len(self.counts):
+            raise ValueError("histogram merge: bucket count mismatch")
+        with self._lock:
+            for i, c in enumerate(counts):
+                self.counts[i] += int(c)
+            self.count += int(d.get("count", 0))
+            self.sum += float(d.get("sum", 0.0))
+            if float(d.get("max", 0.0)) > self.max:
+                self.max = float(d.get("max", 0.0))
+
 
 def _series_key(name: str, labels: dict) -> str:
     if not labels:
         return name
     inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
     return f"{name}{{{inner}}}"
+
+
+def split_series_key(key: str) -> tuple:
+    """Inverse of the series-key encoding:
+    ``"name{a=b,c=d}"`` → ``("name", {"a": "b", "c": "d"})``.  The
+    fleet aggregator uses it to re-label another process's series
+    with ``worker=`` before merging."""
+    if "{" not in key or not key.endswith("}"):
+        return key, {}
+    name, _, inner = key.partition("{")
+    labels = {}
+    for part in inner[:-1].split(","):
+        if part:
+            k, _, v = part.partition("=")
+            labels[k] = v
+    return name, labels
 
 
 class MetricsRegistry:
@@ -563,12 +667,23 @@ class MetricsRegistry:
     updates and snapshots never tear.
     """
 
-    def __init__(self, clock: Clock | None = None):
+    def __init__(self, clock: Clock | None = None,
+                 series_capacity: int = SERIES_CAPACITY):
         self.clock = clock if clock is not None else SYSTEM_CLOCK
         self._lock = threading.RLock()
         self._counters: dict[str, Counter] = {}
         self._gauges: dict[str, Gauge] = {}
         self._histograms: dict[str, Histogram] = {}
+        # time-series trail: bounded ring of tick records plus the
+        # snapshot_delta() cursors (last exported value per series)
+        self._ticks: collections.deque = collections.deque(
+            maxlen=max(1, int(series_capacity)))
+        self._tick_seq = 0
+        self._last_tick_t: float | None = None
+        self._delta_seq = 0
+        self._delta_counters: dict[str, float] = {}
+        self._delta_gauges: dict[str, float] = {}
+        self._delta_hists: dict[str, tuple] = {}
 
     # -- series accessors ------------------------------------------------
     def counter(self, name: str, **labels) -> Counter:
@@ -587,8 +702,10 @@ class MetricsRegistry:
                 g = self._gauges[key] = Gauge(lock=self._lock)
         return g
 
-    def histogram(self, name: str, buckets=DURATION_BUCKETS,
+    def histogram(self, name: str, buckets=None,
                   **labels) -> Histogram:
+        if buckets is None:
+            buckets = BUCKET_PRESETS.get(name, DURATION_BUCKETS)
         key = _series_key(name, labels)
         with self._lock:
             h = self._histograms.get(key)
@@ -607,6 +724,120 @@ class MetricsRegistry:
             yield h
         finally:
             h.observe(self.clock.monotonic() - t0)
+
+    # -- time series -----------------------------------------------------
+    def tick(self) -> dict:
+        """Record one time-series tick — the full state of every
+        series, stamped with the injectable clock AND wall time — into
+        the bounded ring buffer.  Telemetry as a TRAIL: a process
+        SIGKILLed mid-run has its series up to the last tick, not just
+        a final number it never got to write.  Histograms keep RAW
+        per-bucket counts here (cheap windowed deltas for the SLO
+        burn-rate math); ``time.time()`` is the journal-FACT wall
+        stamp, scheduling stays on ``self.clock``."""
+        with self._lock:
+            self.counter("obs.ticks").inc()
+            self._tick_seq += 1
+            rec = {
+                "tick": self._tick_seq,
+                "t": round(self.clock.monotonic(), 6),
+                "wall": round(time.time(), 3),
+                "counters": {k: c.value
+                             for k, c in self._counters.items()},
+                "gauges": {k: g.value
+                           for k, g in self._gauges.items()},
+                "histograms": {
+                    k: {"count": h.count, "sum": round(h.sum, 6),
+                        "max": round(h.max, 6),
+                        "buckets": list(h.buckets),
+                        "counts": list(h.counts)}
+                    for k, h in self._histograms.items()},
+            }
+            self._ticks.append(rec)
+            self._last_tick_t = rec["t"]
+            return rec
+
+    def maybe_tick(self, interval_s: float):
+        """``tick()`` if at least ``interval_s`` has elapsed on the
+        injectable clock since the last one (else ``None``) — the
+        rate-limited form hot paths call without owning a schedule."""
+        with self._lock:
+            if self._last_tick_t is not None and \
+                    self.clock.monotonic() - self._last_tick_t \
+                    < interval_s:
+                return None
+            return self.tick()
+
+    def series(self) -> list:
+        """The ring-buffer trail, oldest tick first."""
+        with self._lock:
+            return list(self._ticks)
+
+    def snapshot_delta(self) -> dict:
+        """Cheap incremental export: only series that CHANGED since
+        the previous ``snapshot_delta()`` call, with counter/histogram
+        values as deltas (gauges as last value).  This is the payload
+        workers ship to the supervisor on the lossy obs plane — small
+        because idle series drop out, and mergeable because histogram
+        deltas ride raw fixed-boundary bucket counts.
+
+        The cursor advances on export, so a LOST frame loses that
+        window's increments — by design: obs is lossy-tolerant, the
+        next full snapshot/tick still has the true totals locally."""
+        with self._lock:
+            self._delta_seq += 1
+            out = {"seq": self._delta_seq,
+                   "t": round(self.clock.monotonic(), 6),
+                   "wall": round(time.time(), 3),
+                   "counters": {}, "gauges": {}, "histograms": {}}
+            for k, c in self._counters.items():
+                prev = self._delta_counters.get(k, 0.0)
+                if c.value != prev:
+                    out["counters"][k] = round(c.value - prev, 6)
+                    self._delta_counters[k] = c.value
+            for k, g in self._gauges.items():
+                if self._delta_gauges.get(k) != g.value:
+                    out["gauges"][k] = g.value
+                    self._delta_gauges[k] = g.value
+            for k, h in self._histograms.items():
+                prev = self._delta_hists.get(k)
+                if prev is None or h.count != prev[0]:
+                    pc, ps, pcounts = prev if prev is not None else (
+                        0, 0.0, [0] * len(h.counts))
+                    out["histograms"][k] = {
+                        "count": h.count - pc,
+                        "sum": round(h.sum - ps, 6),
+                        "max": round(h.max, 6),
+                        "buckets": list(h.buckets),
+                        "counts": [a - b for a, b
+                                   in zip(h.counts, pcounts)],
+                    }
+                    self._delta_hists[k] = (h.count, h.sum,
+                                            list(h.counts))
+            return out
+
+    def merge_delta(self, delta: dict, **extra_labels) -> None:
+        """Apply a ``snapshot_delta()`` doc from ANOTHER process into
+        this registry, re-labelling every series with
+        ``extra_labels`` (the fleet aggregator passes ``worker=``).
+        Counters add, gauges overwrite, histograms fold bucket-by-
+        bucket (same fixed boundaries or :meth:`Histogram.merge`
+        raises)."""
+        for key, v in (delta.get("counters") or {}).items():
+            name, labels = split_series_key(key)
+            labels.update(extra_labels)
+            if v > 0:
+                self.counter(name, **labels).inc(v)
+        for key, v in (delta.get("gauges") or {}).items():
+            name, labels = split_series_key(key)
+            labels.update(extra_labels)
+            self.gauge(name, **labels).set(v)
+        for key, d in (delta.get("histograms") or {}).items():
+            name, labels = split_series_key(key)
+            labels.update(extra_labels)
+            bounds = tuple(float(b) for b in (d.get("buckets")
+                                              or DURATION_BUCKETS))
+            self.histogram(name, buckets=bounds, **labels).merge(d)
 
     # -- snapshots -------------------------------------------------------
     def snapshot(self) -> dict:
@@ -627,13 +858,17 @@ class MetricsRegistry:
         with self._lock:
             return {k: c.value for k, c in sorted(self._counters.items())}
 
-    def write(self, path: str) -> str:
+    def write(self, path: str, series: bool = False) -> str:
         """Atomically write the snapshot as ``metrics.json`` (tmp +
         rename — a crash mid-write must not leave a half file where
-        sctreport looks)."""
+        sctreport looks).  ``series=True`` embeds the ring-buffer
+        trail too — the tick-stamped form the federation supervisor
+        flushes under ``obs/``."""
         doc = {"schema": SNAPSHOT_SCHEMA,
                "written_at": round(time.time(), 3),
                "metrics": self.snapshot()}
+        if series:
+            doc["series"] = self.series()
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(doc, f, indent=1, sort_keys=True)
@@ -645,6 +880,13 @@ class MetricsRegistry:
             self._counters.clear()
             self._gauges.clear()
             self._histograms.clear()
+            self._ticks.clear()
+            self._tick_seq = 0
+            self._last_tick_t = None
+            self._delta_seq = 0
+            self._delta_counters.clear()
+            self._delta_gauges.clear()
+            self._delta_hists.clear()
 
 
 #: the process-wide default registry ("process-wide" is the contract:

@@ -222,14 +222,21 @@ def report(all_threads: bool = True) -> str:
 # Chrome/Perfetto trace_event export
 # ---------------------------------------------------------------------------
 
-def trace_events(span_list: list[Span] | None = None) -> list[dict]:
+def trace_events(span_list: list[Span] | None = None,
+                 pid: int = 1,
+                 process_name: str | None = None) -> list[dict]:
     """Flatten span trees into Chrome ``trace_event`` complete events
     (``ph: "X"``; ts/dur in microseconds, rebased so the earliest
     span starts at 0).  ``None`` exports every collected thread, one
     ``tid`` per thread with a thread-name metadata record.  Children
     are clamped inside their parent's [ts, ts+dur] window so float
     rounding can never make a trace viewer rule a child "outside" the
-    stage that ran it."""
+    stage that ran it.
+
+    ``pid``/``process_name`` label the emitted events as one PROCESS
+    row — the federated-merge seam: each fleet member gets its own
+    pid (plus a ``process_name`` metadata record) so the whole fleet
+    renders as separate process tracks in one timeline."""
     groups = ([(threading.current_thread().name, list(span_list))]
               if span_list is not None else _threads())
     starts = [s.start for _, roots in groups for s in roots]
@@ -237,6 +244,9 @@ def trace_events(span_list: list[Span] | None = None) -> list[dict]:
         return []
     t0 = min(starts)
     events: list[dict] = []
+    if process_name is not None:
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": process_name}})
 
     def emit(s: Span, tid: int, lo: float, hi: float):
         ts = max((s.start - t0) * 1e6, lo)
@@ -246,14 +256,14 @@ def trace_events(span_list: list[Span] | None = None) -> list[dict]:
         events.append({
             "name": s.name, "cat": "span", "ph": "X",
             "ts": round(ts, 3), "dur": round(end - ts, 3),
-            "pid": 1, "tid": tid,
+            "pid": pid, "tid": tid,
             "args": {"span_id": s.id, **s.meta},
         })
         for c in s.children:
             emit(c, tid, ts, end)
 
     for tid, (tname, roots) in enumerate(groups):
-        events.append({"name": "thread_name", "ph": "M", "pid": 1,
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
                        "tid": tid, "args": {"name": tname}})
         for root in roots:
             emit(root, tid, 0.0, None)
@@ -295,6 +305,45 @@ def export_trace(path: str, span_list: list[Span] | None = None,
                       not in seen]
             events = old + events
     doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+    return path
+
+
+def fleet_trace_events(processes) -> list[dict]:
+    """The federated merge: flatten MANY processes' span trees into
+    one trace_event stream, one ``pid`` (and ``process_name``
+    metadata record) per fleet member.
+
+    ``processes`` is ``[(process_name, parts), ...]`` where ``parts``
+    is a list of root :class:`Span` objects or serialized span dicts
+    (the :func:`serialize_spans` wire form the federation result-file
+    handoff carries).  Each process is rebased to its OWN earliest
+    span: ``perf_counter`` epochs are not comparable across
+    processes, so per-process zero is the honest alignment — the
+    trace shows each member's internal causality, and the journal's
+    wall-clock ``ts`` fields remain the cross-process ordering
+    record.  Members with no spans are skipped (no empty rows)."""
+    events: list[dict] = []
+    for pid, (pname, parts) in enumerate(processes, start=1):
+        roots = [p if isinstance(p, Span) else span_from_dict(p)
+                 for p in (parts or ())]
+        if not roots:
+            continue
+        events.extend(trace_events(roots, pid=pid,
+                                   process_name=str(pname)))
+    return events
+
+
+def export_fleet_trace(path: str, processes) -> str:
+    """Write the federated merge of ``processes`` (see
+    :func:`fleet_trace_events`) as one Perfetto-loadable
+    ``trace.json`` — the whole fleet on one timeline.  Atomic tmp +
+    rename; returns ``path``."""
+    doc = {"traceEvents": fleet_trace_events(processes),
+           "displayTimeUnit": "ms"}
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(doc, f)

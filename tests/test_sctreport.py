@@ -563,13 +563,15 @@ def test_serving_section_renders_funnel_and_lifecycle(tmp_path,
             "serve.swaps": 1.0, "serve.rollbacks": 1.0,
         }, "gauges": {}, "histograms": {
             "serve.latency_s": {"count": 17, "sum": 3.4, "max": 0.9,
-                                "buckets": {"+inf": 17}}}}}))
+                                "buckets": {"0.1": 9, "0.5": 16,
+                                            "1": 17, "+inf": 17}}}}}))
     assert main([str(tmp_path)]) == 0
     out = capsys.readouterr().out
     assert "-- serving --" in out
     assert ("query funnel: 20 quer(ies) -> 17 completed, 0 failed, "
             "2 rejected, 1 shed") in out
-    assert "completed latency: n=17 mean=0.2000s max=0.9s" in out
+    assert ("completed latency: n=17 mean=0.2000s p50<=0.1s "
+            "p99<=1s max=0.9s") in out
     assert "residency-ladder rungs: artifact=1, replace=1" in out
     assert "hot-swaps: 1 flipped, 1 rolled back" in out
     assert "QUARANTINED gen=current: digest mismatch" in out
@@ -727,3 +729,138 @@ def test_network_section_absent_without_net_events():
                      "histograms": {"net.rtt_ms{peer=s}": {
                          "count": 1, "sum": 0.1, "max": 0.1,
                          "buckets": {"+inf": 1}}}}}) == []
+
+
+def test_fleet_section_renders_trail_slo_and_join(tmp_path, capsys):
+    """An obs/ snapshot trail + SLO rulings + worker journals render
+    the fleet section: per-worker merged series (the dead worker's
+    included), the breach/recovery timeline, and the trace-context
+    join over terminal tickets."""
+    evs = [
+        {"event": "submitted", "ts": 1.0, "ticket": "t000000",
+         "tenant": "lab", "priority": 0, "queue_depth": 0,
+         "trace_id": "tr-aaaa"},
+        {"event": "slo_breach", "ts": 2.0,
+         "objective": "serving_p99_latency", "target": 0.99,
+         "burn_fast": 48.0, "burn_slow": 12.0,
+         "fast_window_s": 60.0, "slow_window_s": 300.0},
+        {"event": "slo_recovered", "ts": 9.5,
+         "objective": "serving_p99_latency", "target": 0.99,
+         "burn_fast": 0.2, "burn_slow": 3.1,
+         "breach_window_s": 7.5},
+        {"event": "run_completed", "ts": 10.0, "ticket": "t000000",
+         "tenant": "lab", "worker": "w1", "epoch": 0,
+         "trace_id": "tr-aaaa"},
+    ]
+    with open(tmp_path / "journal.jsonl", "w") as f:
+        for e in evs:
+            f.write(json.dumps(e) + "\n")
+    obs = tmp_path / "obs"
+    obs.mkdir()
+    for tick, n_ticks in ((1, 1), (2, 3)):
+        with open(obs / f"fleet-{tick:06d}.json", "w") as f:
+            json.dump({"metrics": {
+                "counters": {"sched.admitted{tenant=lab,worker=w0}": 2.0,
+                             "sched.admitted{tenant=lab,worker=w1}": 1.0},
+                "gauges": {},
+                "histograms": {"net.rtt_ms{peer=supervisor,worker=w0}": {
+                    "count": 3, "sum": 1.2, "max": 0.9,
+                    "buckets": {"+inf": 3}}},
+            }, "series": [{"tick": i} for i in range(n_ticks)]}, f)
+    wdir = tmp_path / "workers" / "w1"
+    wdir.mkdir(parents=True)
+    with open(wdir / "journal.jsonl", "w") as f:
+        f.write(json.dumps({"event": "submitted", "ticket": "t000000",
+                            "trace_id": "tr-aaaa"}) + "\n")
+    assert main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "-- fleet --" in out
+    assert ("trail: 2 snapshot(s) under obs/, 3 tick(s) in the "
+            "latest (fleet-000002.json)") in out
+    assert "worker w0: 2 merged series" in out
+    assert "worker w1: 1 merged series" in out
+    assert "BREACH serving_p99_latency burn fast=48.0 slow=12.0" in out
+    assert "RECOVERED serving_p99_latency after 7.5s" in out
+    assert "breach windows: 1/1 closed (slo_recovered)" in out
+    assert "OPEN at end of journal" not in out
+    assert ("trace-context join: 1/1 terminal ticket(s) trace "
+            "end-to-end (supervisor -> worker journal)") in out
+    assert "JOIN BROKEN" not in out
+
+
+def test_fleet_section_absent_without_obs_series(tmp_path, capsys):
+    """REPORT HONESTY: a run that never shipped an obs frame has NO
+    fleet section — no obs/ dir, an empty one, and an unreadable
+    latest snapshot all mean 'no fleet plane', never a fabricated
+    all-quiet digest.  The committed fixture run predates the obs
+    plane and must stay fleet-free too."""
+    from tools.sctreport import fleet_section
+
+    assert fleet_section(str(tmp_path), []) == []          # no obs/
+    (tmp_path / "obs").mkdir()
+    assert fleet_section(str(tmp_path), []) == []          # empty obs/
+    (tmp_path / "obs" / "fleet-000001.json").write_text("NOT JSON")
+    assert fleet_section(str(tmp_path), []) == []          # unreadable
+    assert fleet_section(FIXTURE, []) == []                # the fixture
+    with open(tmp_path / "journal.jsonl", "w") as f:
+        f.write(json.dumps({"event": "run_start", "n_steps": 0,
+                            "backend": "cpu", "steps": []}) + "\n")
+        f.write(json.dumps({"event": "run_completed",
+                            "degraded": False}) + "\n")
+    assert main([str(tmp_path)]) == 0
+    assert "-- fleet --" not in capsys.readouterr().out
+
+
+def test_fleet_section_join_broken_is_never_hidden(tmp_path, capsys):
+    """REPORT HONESTY: a terminal ticket whose trace_id resolves in
+    no worker journal renders JOIN BROKEN — a vanished trace context
+    is a finding, not a blank."""
+    evs = [
+        {"event": "run_completed", "ts": 3.0, "ticket": "t000001",
+         "tenant": "lab", "worker": "w0", "epoch": 0,
+         "trace_id": "tr-gone"},
+        {"event": "run_failed", "ts": 4.0, "ticket": "t000002",
+         "tenant": "lab", "worker": "w0", "epoch": 0},
+    ]
+    with open(tmp_path / "journal.jsonl", "w") as f:
+        for e in evs:
+            f.write(json.dumps(e) + "\n")
+    obs = tmp_path / "obs"
+    obs.mkdir()
+    with open(obs / "fleet-000001.json", "w") as f:
+        json.dump({"metrics": {"counters": {}, "gauges": {},
+                   "histograms": {}}, "series": []}, f)
+    wdir = tmp_path / "workers" / "w0"
+    wdir.mkdir(parents=True)
+    with open(wdir / "journal.jsonl", "w") as f:
+        f.write(json.dumps({"event": "submitted", "ticket": "t9",
+                            "trace_id": "tr-other"}) + "\n")
+    assert main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert ("trace-context join: 0/2 terminal ticket(s) trace "
+            "end-to-end (supervisor -> worker journal)") in out
+    assert ("JOIN BROKEN: ticket t000001 (run_completed) "
+            "trace_id=tr-gone resolves in no worker journal") in out
+    # a terminal with NO trace context at all is the same finding
+    assert ("JOIN BROKEN: ticket t000002 (run_failed) trace_id=- "
+            "resolves in no worker journal") in out
+
+
+def test_latency_digest_quantiles_from_bucket_ladder():
+    """The ms-scale preset buckets exist so p50/p99 read off the
+    cumulative ladder; an empty or tail-heavy histogram says so
+    instead of fabricating a number."""
+    from tools.sctreport import _hist_quantile, _latency_digest
+
+    h = {"count": 100, "sum": 1.2, "max": 0.8,
+         "buckets": {"0.001": 10, "0.01": 60, "0.1": 99,
+                     "0.25": 99, "+inf": 100}}
+    assert _hist_quantile(h, 0.5) == 0.01
+    assert _hist_quantile(h, 0.99) == 0.1
+    assert _hist_quantile(h, 0.999) is None  # lives in +inf
+    d = _latency_digest(h)
+    assert "n=100" in d and "p50<=0.01s" in d and "p99<=0.1s" in d
+    assert "max=0.8s" in d
+    assert _hist_quantile({"count": 0, "buckets": {}}, 0.5) is None
+    assert "p50>bucket ladder" in _latency_digest(
+        {"count": 5, "sum": 4.0, "max": 1.0, "buckets": {"+inf": 5}})

@@ -1,0 +1,214 @@
+"""SLO burn-rate rulings (sctools_tpu/slo.py).  Every window here is
+VirtualClock arithmetic — a whole breach/recovery cycle runs with
+zero real sleeps — and rulings are asserted three ways at once:
+return value, journal record, metric series."""
+
+import pytest
+
+from sctools_tpu.slo import (Objective, SeriesSel, SLOMonitor,
+                             scheduler_objectives,
+                             serving_objectives)
+from sctools_tpu.utils.telemetry import MetricsRegistry
+from sctools_tpu.utils.vclock import VirtualClock
+
+
+class FakeJournal:
+    def __init__(self):
+        self.records = []
+
+    def write(self, event, **fields):
+        self.records.append({"event": event, **fields})
+
+
+def _monitor(objectives, clock=None, journal=None):
+    clock = clock or VirtualClock()
+    m = MetricsRegistry(clock=clock)
+    return SLOMonitor(m, journal=journal, clock=clock,
+                      objectives=objectives), m, clock
+
+
+LAT = Objective(name="p99", kind="latency", metric="serve.latency_s",
+                threshold_s=0.25, target=0.99, fast_window_s=60.0,
+                slow_window_s=300.0, burn_threshold=2.0)
+
+
+# ----------------------------------------------------------- objectives
+
+def test_objective_declarations_are_validated():
+    with pytest.raises(ValueError, match="kind"):
+        Objective(name="x", kind="vibes")
+    with pytest.raises(ValueError, match="fraction"):
+        Objective(name="x", kind="latency", metric="m", target=1.0)
+    with pytest.raises(ValueError, match="metric="):
+        Objective(name="x", kind="latency")
+    with pytest.raises(ValueError, match="good="):
+        Objective(name="x", kind="ratio")
+
+
+def test_default_objective_sets_cover_serving_and_admission():
+    names = {o.name for o in serving_objectives()}
+    assert names == {"serving_p99_latency", "serving_error_budget"}
+    (adm,) = scheduler_objectives()
+    assert adm.kind == "ratio"
+    assert adm.good == SeriesSel("sched.admitted")
+
+
+def test_series_selector_matches_label_subset():
+    sel = SeriesSel("serve.queries", (("outcome", "failed"),))
+    assert sel.matches("serve.queries{outcome=failed,tenant=a}")
+    assert not sel.matches("serve.queries{outcome=completed}")
+    assert not sel.matches("serve.errors{outcome=failed}")
+
+
+# -------------------------------------------------- latency state machine
+
+def test_latency_breach_opens_and_recovers_exactly_once():
+    journal = FakeJournal()
+    mon, m, clock = _monitor([LAT], journal=journal)
+    lat = m.histogram("serve.latency_s")
+    for _ in range(50):
+        lat.observe(0.01)
+    clock.advance(2.0)
+    assert mon.evaluate() == []  # healthy baseline: no ruling
+    for _ in range(50):
+        lat.observe(0.5)  # regression: 50% over a 1% budget
+    clock.advance(2.0)
+    assert mon.evaluate() == [("slo_breach", "p99")]
+    assert mon.breached("p99")
+    assert mon.evaluate() == []  # an open breach does not re-rule
+    for _ in range(500):
+        lat.observe(0.01)
+    clock.advance(61.0)  # age the bad window out of FAST
+    assert mon.evaluate() == [("slo_recovered", "p99")]
+    assert not mon.breached("p99")
+    events = [r["event"] for r in journal.records]
+    assert events == ["slo_breach", "slo_recovered"]
+    breach, recover = journal.records
+    assert breach["burn_fast"] >= 2.0 and breach["burn_slow"] >= 2.0
+    assert breach["fast_window_s"] == 60.0
+    assert recover["burn_fast"] < 1.0
+    assert recover["breach_window_s"] > 0
+    snap = m.snapshot()
+    assert snap["counters"]["slo.breaches{objective=p99}"] == 1
+    assert snap["gauges"]["slo.burn_rate{objective=p99,window=fast}"] \
+        < 1.0
+
+
+def test_two_window_guard_blocks_a_blip():
+    """A fast-window spike diluted across the slow window must NOT
+    page: both windows have to exceed the burn threshold."""
+    mon, m, clock = _monitor([LAT])
+    lat = m.histogram("serve.latency_s")
+    for _ in range(6):  # 6 healthy ticks spanning > slow_window_s
+        for _ in range(100):
+            lat.observe(0.01)
+        clock.advance(70.0)
+        assert mon.evaluate() == []
+    for _ in range(5):
+        lat.observe(0.5)  # the blip: fast burn 5x, slow burn ~0.8x
+    for _ in range(95):
+        lat.observe(0.01)
+    clock.advance(10.0)
+    assert mon.evaluate() == []
+    assert not mon.breached("p99")
+
+
+def test_breach_holds_until_fast_burn_below_one():
+    """Recovery closes on fast burn < 1.0, not merely below the
+    breach threshold — the budget must have STOPPED burning."""
+    mon, m, clock = _monitor([LAT])
+    lat = m.histogram("serve.latency_s")
+    mon.evaluate()  # anchor tick — a window needs a basis to diff
+    for _ in range(50):
+        lat.observe(0.5)
+    clock.advance(2.0)
+    assert mon.evaluate() == [("slo_breach", "p99")]
+    # 1.5% bad over a 1% budget: burn 1.5 — under the threshold but
+    # still burning faster than allotted
+    for _ in range(3):
+        lat.observe(0.5)
+    for _ in range(197):
+        lat.observe(0.01)
+    clock.advance(61.0)
+    assert mon.evaluate() == []
+    assert mon.breached("p99")
+    for _ in range(400):
+        lat.observe(0.01)
+    clock.advance(61.0)
+    assert mon.evaluate() == [("slo_recovered", "p99")]
+
+
+def test_threshold_aligned_bucket_bound_counts_good():
+    """An observation landing exactly on the threshold's bucket bound
+    is GOOD — the ladder measures <=, the epsilon guards float
+    noise."""
+    obj = Objective(name="q", kind="latency",
+                    metric="sched.queue_wait_s", threshold_s=0.25,
+                    target=0.5, burn_threshold=1.5)
+    mon, m, clock = _monitor([obj])
+    h = m.histogram("sched.queue_wait_s")
+    for _ in range(10):
+        h.observe(0.25)  # exactly the bound
+    clock.advance(2.0)
+    assert mon.evaluate() == []
+
+
+# --------------------------------------------------- ratio state machine
+
+def test_ratio_objective_rules_admission_availability():
+    journal = FakeJournal()
+    mon, m, clock = _monitor(list(scheduler_objectives(target=0.9)),
+                             journal=journal)
+    m.counter("sched.admitted", tenant="a").inc(99)
+    m.counter("sched.rejected", tenant="a",
+              reason="queue_full").inc(1)
+    clock.advance(2.0)
+    assert mon.evaluate() == []  # 1% bad on a 10% budget: burn 0.1
+    m.counter("sched.rejected", tenant="a",
+              reason="queue_full").inc(40)
+    clock.advance(2.0)
+    assert mon.evaluate() == [("slo_breach",
+                               "admission_availability")]
+    m.counter("sched.admitted", tenant="a").inc(2000)
+    clock.advance(61.0)
+    assert mon.evaluate() == [("slo_recovered",
+                               "admission_availability")]
+    assert [r["event"] for r in journal.records] \
+        == ["slo_breach", "slo_recovered"]
+
+
+def test_empty_window_burns_nothing():
+    mon, m, clock = _monitor(list(serving_objectives()))
+    clock.advance(2.0)
+    assert mon.evaluate() == []  # no series at all: no ruling
+    m.counter("serve.queries", outcome="completed").inc(5)
+    clock.advance(2.0)
+    assert mon.evaluate() == []  # all-good traffic: burn 0
+
+
+# ------------------------------------------------------------ scheduling
+
+def test_maybe_evaluate_rate_limits_on_injectable_clock():
+    mon, m, clock = _monitor([LAT], journal=FakeJournal())
+    mon.evaluate()  # anchor tick
+    clock.advance(2.0)
+    m.histogram("serve.latency_s").observe(0.5)
+    mon.maybe_evaluate()
+    assert mon.maybe_evaluate() == []  # rate-limited, no re-ruling
+    clock.advance(1.0)
+    # past the interval it evaluates again (breach already open, so
+    # no new ruling — but the burn gauges refresh)
+    mon.maybe_evaluate()
+    assert mon.breached("p99")
+    assert clock.sleeps == []  # nothing here ever really slept
+
+
+def test_rulings_work_without_a_journal():
+    mon, m, clock = _monitor([LAT], journal=None)
+    mon.evaluate()  # anchor tick
+    for _ in range(10):
+        m.histogram("serve.latency_s").observe(0.5)
+    clock.advance(2.0)
+    assert mon.evaluate() == [("slo_breach", "p99")]
+    assert m.snapshot()["counters"]["slo.breaches{objective=p99}"] \
+        == 1

@@ -276,3 +276,109 @@ def test_federation_vocabulary_covers_its_call_sites():
     assert {"fed.heartbeats", "fed.lease_age_s", "fed.workers_lost",
             "fed.requeues", "fed.fenced_commits",
             "fed.breaker_syncs"} <= used_metrics <= set(METRICS)
+
+
+# ---------------------------------------------------- time-series trail
+
+def test_tick_trail_is_a_bounded_ring():
+    clock = VirtualClock()
+    m = MetricsRegistry(clock=clock, series_capacity=3)
+    for i in range(5):
+        m.counter("op.calls", op="a").inc()
+        clock.advance(1.0)
+        m.tick()
+    trail = m.series()
+    assert [r["tick"] for r in trail] == [3, 4, 5]  # oldest dropped
+    assert trail[-1]["t"] == 5.0  # stamped on the INJECTABLE clock
+    # ticking is itself observable — the trail proves its own cadence
+    assert m.snapshot()["counters"]["obs.ticks"] == 5
+    assert trail[-1]["counters"]["op.calls{op=a}"] == 5
+
+
+def test_maybe_tick_rate_limits_on_injectable_clock():
+    clock = VirtualClock()
+    m = MetricsRegistry(clock=clock)
+    assert m.maybe_tick(1.0) is not None  # first tick always lands
+    assert m.maybe_tick(1.0) is None      # rate-limited
+    clock.advance(1.0)
+    assert m.maybe_tick(1.0) is not None
+
+
+def test_snapshot_delta_ships_only_changed_series():
+    clock = VirtualClock()
+    m = MetricsRegistry(clock=clock)
+    m.counter("sched.admitted", tenant="lab").inc(2)
+    m.gauge("sched.queue_depth").set(4)
+    m.histogram("serve.latency_s").observe(0.01)
+    d1 = m.snapshot_delta()
+    assert d1["counters"] == {"sched.admitted{tenant=lab}": 2.0}
+    assert d1["gauges"] == {"sched.queue_depth": 4}
+    assert d1["histograms"]["serve.latency_s"]["count"] == 1
+    # nothing changed: every family empty — idle workers ship nothing
+    d2 = m.snapshot_delta()
+    assert not d2["counters"] and not d2["gauges"] \
+        and not d2["histograms"]
+    # only the touched series returns, as a DELTA not a total
+    m.counter("sched.admitted", tenant="lab").inc(3)
+    d3 = m.snapshot_delta()
+    assert d3["counters"] == {"sched.admitted{tenant=lab}": 3.0}
+    assert not d3["gauges"] and not d3["histograms"]
+
+
+def test_merge_delta_relabels_and_folds_per_worker():
+    clock = VirtualClock()
+    fleet = MetricsRegistry(clock=clock)
+    w0, w1 = MetricsRegistry(), MetricsRegistry()
+    for w in (w0, w1):
+        w.counter("sched.admitted", tenant="lab").inc()
+        w.histogram("serve.latency_s").observe(0.01)
+    fleet.merge_delta(w0.snapshot_delta(), worker="w0")
+    fleet.merge_delta(w1.snapshot_delta(), worker="w1")
+    w0.counter("sched.admitted", tenant="lab").inc(2)
+    fleet.merge_delta(w0.snapshot_delta(), worker="w0")  # adds
+    snap = fleet.snapshot()
+    assert snap["counters"]["sched.admitted{tenant=lab,worker=w0}"] \
+        == 3
+    assert snap["counters"]["sched.admitted{tenant=lab,worker=w1}"] \
+        == 1
+    assert snap["histograms"]["serve.latency_s{worker=w0}"][
+        "count"] == 1
+    # mismatched bucket ladders must refuse to fold, not corrupt
+    with pytest.raises(ValueError, match="bucket"):
+        fleet.merge_delta({"histograms": {"serve.latency_s{worker=w0}":
+                          {"count": 1, "sum": 0.1, "max": 0.1,
+                           "buckets": [1.0, 2.0], "counts": [1, 0, 0]}}})
+
+
+def test_lost_delta_frame_loses_only_its_window():
+    """The obs plane's loss contract: the cursor advances on export,
+    so a dropped frame forfeits that window's increments at the
+    AGGREGATOR — while the worker's local totals stay true."""
+    w = MetricsRegistry()
+    fleet = MetricsRegistry()
+    w.counter("op.calls", op="a").inc(5)
+    w.snapshot_delta()  # exported, then lost on the wire
+    w.counter("op.calls", op="a").inc(2)
+    fleet.merge_delta(w.snapshot_delta(), worker="w0")
+    assert fleet.snapshot()["counters"]["op.calls{op=a,worker=w0}"] \
+        == 2  # the lost window is gone, not double-counted
+    assert w.snapshot()["counters"]["op.calls{op=a}"] == 7
+
+
+def test_latency_bucket_presets_resolve_by_metric_name():
+    from sctools_tpu.utils.telemetry import (BUCKET_PRESETS,
+                                             LATENCY_BUCKETS)
+
+    m = MetricsRegistry(clock=VirtualClock())
+    assert m.histogram("serve.latency_s").buckets == LATENCY_BUCKETS
+    assert m.histogram("sched.queue_wait_s").buckets \
+        == LATENCY_BUCKETS
+    assert m.histogram("op.duration_s").buckets == DURATION_BUCKETS
+    assert set(BUCKET_PRESETS) == {"serve.latency_s",
+                                   "sched.queue_wait_s"}
+    # ms-scale resolution: the ladder starts well under 1ms and the
+    # preset is the FIXED boundary contract merge() depends on
+    assert LATENCY_BUCKETS[0] <= 0.0001
+    h = m.histogram("serve.latency_s")
+    h.observe(0.0004)
+    assert h.to_dict()["buckets"]["0.0005"] == 1

@@ -148,7 +148,20 @@
 #                     after heal (net_rejoin journaled), zero real
 #                     sleeps (docs/ARCHITECTURE.md "Network fault
 #                     domain")
-#  16. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
+#  16. observability  python tests/obs_smoke.py — the fleet
+#                     observability plane's contract: a 2-worker
+#                     socket federation soak under kill_worker +
+#                     a net_drop burst aimed at the lossy obs frames —
+#                     the SIGKILLed worker's time series survive in
+#                     the durable obs/fleet-*.json trail, obs loss
+#                     degrades (journaled) without wedging a ticket,
+#                     one injected latency regression rules exactly
+#                     one slo_breach -> slo_recovered window on the
+#                     VirtualClock, and the merged Perfetto trace
+#                     joins every completed ticket's trace_id
+#                     end-to-end (docs/ARCHITECTURE.md
+#                     "Observability")
+#  17. tier-1 pytest  JAX_PLATFORMS=cpu python -m pytest tests/ -m 'not slow'
 
 set -u -o pipefail
 
@@ -418,6 +431,14 @@ if JAX_PLATFORMS=cpu python tests/net_smoke.py; then
     :
 else
     echo "network stage FAILED (rc=$?)"
+    fail=1
+fi
+
+stage "observability (obs frames + SLO burn window + merged fleet trace)"
+if JAX_PLATFORMS=cpu python tests/obs_smoke.py; then
+    :
+else
+    echo "observability stage FAILED (rc=$?)"
     fail=1
 fi
 

@@ -47,11 +47,15 @@ from ..jaxutil import dotted, module_info
 # factory.py for the annotation factory — the closed loop's stage
 # polls and retrain waits ride the same injectable clock, so the
 # end-to-end composition soak (kill + wedge + oom + corrupt +
-# preempt) runs on one VirtualClock with zero real sleeps.
+# preempt) runs on one VirtualClock with zero real sleeps;
+# slo.py for burn-rate rulings — breach/recovery windows are measured
+# against the registry's tick trail, so the whole SLO state machine
+# must advance on the injected clock to be testable without waiting
+# out a real slow window.
 _PATH_RE = re.compile(
     r"(^|/)(runner|failsafe|checkpoint|chaos|stream|scheduler"
     r"|shardstore|federation|train_stream|telemetry|serving"
-    r"|factory|transport)\.py$")
+    r"|factory|transport|slo)\.py$")
 
 _BANNED = {"time.sleep", "time.monotonic"}
 

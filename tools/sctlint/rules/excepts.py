@@ -28,9 +28,12 @@ from ..jaxutil import dotted, module_info
 # must surface as a journaled cycle verdict (swap_rolled_back with a
 # reason, or a classified re-raise) — a swallowed stage error leaves
 # the closed loop silently stuck between cursors
+# slo.py joined with the observability plane: a swallowed evaluator
+# error would silently stop burn-rate rulings, which is itself an
+# availability breach nobody gets paged for
 _PATH_RE = re.compile(
     r"(^|/)(runner|failsafe|checkpoint|chaos|trace|determinism|sync"
-    r"|vclock|federation|serving|factory|transport)\.py$")
+    r"|vclock|federation|serving|factory|transport|slo)\.py$")
 
 _BROAD = {"Exception", "BaseException"}
 

@@ -341,6 +341,11 @@ def render(run_dir: str, runs: list[dict], trace_d: dict | None,
         add("")
         L.extend(netw)
 
+    fleet = fleet_section(run_dir, events or [])
+    if fleet:
+        add("")
+        L.extend(fleet)
+
     add("")
     add("-- metrics snapshot --")
     if metrics is None:
@@ -367,6 +372,142 @@ def _parse_labels(key: str) -> tuple[str, dict]:
         k, _, v = part.partition("=")
         labels[k] = v
     return name, labels
+
+
+def _hist_quantile(h: dict, q: float):
+    """Upper-bound quantile estimate from the cumulative ``le``
+    bucket map a metrics snapshot carries: the smallest bucket bound
+    holding at least ``q`` of the observations.  ``None`` when the
+    histogram is empty or the target count lives in the ``+inf``
+    bucket (the ladder tops out below this tail — report that, don't
+    fabricate a number)."""
+    total = h.get("count", 0)
+    buckets = h.get("buckets") or {}
+    if not total or not buckets:
+        return None
+    target = q * total
+    finite = sorted(((float(b), c) for b, c in buckets.items()
+                     if b != "+inf"), key=lambda bc: bc[0])
+    for bound, cum in finite:
+        if cum >= target:
+            return bound
+    return None
+
+
+def _latency_digest(h: dict) -> str:
+    """``n= mean= p50= p99= max=`` for one latency histogram — the
+    bucket-ladder percentiles the ms-scale preset buckets exist
+    for."""
+    n = h.get("count", 0)
+    mean = (h.get("sum", 0.0) / n) if n else 0.0
+    parts = [f"n={n}", f"mean={mean:.4f}s"]
+    for q, label in ((0.5, "p50"), (0.99, "p99")):
+        v = _hist_quantile(h, q)
+        parts.append(f"{label}<={v:g}s" if v is not None
+                     else f"{label}>bucket ladder")
+    parts.append(f"max={h.get('max', 0.0):g}s")
+    return " ".join(parts)
+
+
+def fleet_section(run_dir: str, events: list[dict]) -> list[str]:
+    """The fleet observability digest, rendered only when the run dir
+    holds ``obs/`` fleet snapshots (a run that never shipped an obs
+    frame has no section — absence means 'no fleet plane', not 'all
+    quiet').  Reads the LATEST tick-stamped snapshot for the
+    per-worker merged-series counts and the tick-trail length (the
+    lossy telemetry plane's delivery evidence — a SIGKILLed worker's
+    series stay in every later snapshot), renders the SLO ruling
+    timeline (``slo_breach``/``slo_recovered`` with measured burn
+    rates, every breach expected to close), and finishes with the
+    TRACE-CONTEXT JOIN check: every terminal ticket's ``trace_id``
+    must resolve in some worker journal under ``workers/`` — a
+    terminal whose trace context vanished renders ``JOIN BROKEN``,
+    never hidden."""
+    obs_dir = os.path.join(run_dir, "obs")
+    try:
+        snaps = sorted(fn for fn in os.listdir(obs_dir)
+                       if fn.startswith("fleet-")
+                       and fn.endswith(".json"))
+    except OSError:
+        return []
+    if not snaps:
+        return []
+    latest = load_optional_json(os.path.join(obs_dir, snaps[-1]))
+    if latest is None:
+        return []
+    m = latest.get("metrics", latest)
+    series = latest.get("series") or []
+    L = ["-- fleet --"]
+    L.append(f"  trail: {len(snaps)} snapshot(s) under obs/, "
+             f"{len(series)} tick(s) in the latest ({snaps[-1]})")
+    per_worker: dict = {}
+    for family in ("counters", "gauges", "histograms"):
+        for k in (m.get(family) or {}):
+            _, labels = _parse_labels(k)
+            if labels.get("worker"):
+                w = per_worker.setdefault(labels["worker"], 0)
+                per_worker[labels["worker"]] = w + 1
+    for w in sorted(per_worker):
+        L.append(f"  worker {w}: {per_worker[w]} merged series")
+
+    slo = [e for e in events
+           if e["event"] in ("slo_breach", "slo_recovered")]
+    if slo:
+        L.append("  slo rulings:")
+        t0 = slo[0].get("ts", 0.0)
+        for e in slo:
+            dt = e.get("ts", t0) - t0
+            if e["event"] == "slo_breach":
+                L.append(f"    +{dt:6.2f}s BREACH "
+                         f"{e.get('objective')} burn fast="
+                         f"{e.get('burn_fast')} slow="
+                         f"{e.get('burn_slow')} "
+                         f"(target {e.get('target')})")
+            else:
+                L.append(f"    +{dt:6.2f}s RECOVERED "
+                         f"{e.get('objective')} after "
+                         f"{e.get('breach_window_s')}s (burn fast="
+                         f"{e.get('burn_fast')})")
+        breaches = sum(1 for e in slo if e["event"] == "slo_breach")
+        closed = sum(1 for e in slo if e["event"] == "slo_recovered")
+        open_n = breaches - closed
+        L.append(f"  breach windows: {closed}/{breaches} closed "
+                 f"(slo_recovered)"
+                 + (f" — (!) {open_n} OPEN at end of journal"
+                    if open_n > 0 else ""))
+
+    terms = [e for e in events
+             if e["event"] in ("run_completed", "run_failed")
+             and e.get("ticket")]
+    if terms:
+        wtids: set = set()
+        wroot = os.path.join(run_dir, "workers")
+        try:
+            names = sorted(os.listdir(wroot))
+        except OSError:
+            names = []
+        for name in names:
+            jpath = os.path.join(wroot, name, "journal.jsonl")
+            if not os.path.isfile(jpath):
+                continue
+            try:
+                wevents, _ = load_journal(jpath)
+            except OSError:
+                continue
+            wtids |= {e.get("trace_id") for e in wevents}
+        wtids -= {None, ""}
+        broken = [e for e in terms
+                  if not e.get("trace_id")
+                  or e["trace_id"] not in wtids]
+        L.append(f"  trace-context join: {len(terms) - len(broken)}/"
+                 f"{len(terms)} terminal ticket(s) trace end-to-end "
+                 f"(supervisor -> worker journal)")
+        for e in broken:
+            L.append(f"    JOIN BROKEN: ticket {e.get('ticket')} "
+                     f"({e['event']}) trace_id="
+                     f"{e.get('trace_id') or '-'} resolves in no "
+                     f"worker journal")
+    return L
 
 
 def federation_section(events: list[dict], metrics) -> list[str]:
@@ -531,10 +672,7 @@ def scheduler_section(metrics) -> list[str]:
     hists = m.get("histograms", {})
     for k, h in sorted(hists.items()):
         if k.startswith("sched.queue_wait_s"):
-            n = h.get("count", 0)
-            mean = (h.get("sum", 0.0) / n) if n else 0.0
-            L.append(f"  queue wait: n={n} mean={mean:.4f}s "
-                     f"max={h.get('max', 0.0):g}s")
+            L.append("  queue wait: " + _latency_digest(h))
     L.append(f"  {'tenant':<20s} {'admitted':>9s} {'rejected':>9s} "
              f"{'shed':>6s}")
     for tenant in sorted(per_tenant):
@@ -744,10 +882,7 @@ def serving_section(events: list[dict], metrics) -> list[str]:
                  + ", ".join(parts))
     for k, h in sorted(hists.items()):
         if k.startswith("serve.latency_s"):
-            n = h.get("count", 0)
-            mean = (h.get("sum", 0.0) / n) if n else 0.0
-            L.append(f"  completed latency: n={n} mean={mean:.4f}s "
-                     f"max={h.get('max', 0.0):g}s")
+            L.append("  completed latency: " + _latency_digest(h))
     reloads = {k: v for k, v in serve_counters.items()
                if _parse_labels(k)[0] == "serve.state_reloads"}
     if reloads:
