@@ -36,6 +36,8 @@ from .data.shardstore import (ShardReadScheduler, ShardStore,
 from .data.io import (from_dense, from_scipy, read, read_10x_h5,
                       read_10x_mtx, read_csv, read_h5ad, read_loom,
                       read_mtx, read_text, write_h5ad, write_loom)
+from . import buckets  # noqa: F401  (shape-bucket policy + masks)
+from .buckets import pad_to_bucket, trim_from_bucket
 from . import memory  # noqa: F401  (budget + estimate model)
 from .memory import MemoryBudget
 from .plan import describe_plan, fused_pipeline

@@ -1,0 +1,404 @@
+"""Shape bucketing: pad whole datasets to canonical bucket shapes.
+
+Every differently-shaped upload that reaches :func:`plan.fused_pipeline`
+traces and compiles its own XLA program — the cost rapids-singlecell
+pays per GPU batch shape and annbatch pays at terabyte scale.  Serving
+solved the QUERY half with a row-bucket ladder (PR 13: an n-row query
+pads to the smallest bucket >= n, so every size in a bucket shares one
+compiled program).  This module is the RECIPE half: pad whole
+``CellData`` containers (cells AND genes) to bucket shapes, with an
+explicit validity mask the mask-aware op family respects, so arbitrary
+uploads hit one hot plan cache.
+
+Policy
+------
+One ladder (:data:`DEFAULT_BUCKETS`, 16..4096 then doubling) serves
+rows, genes and queries — serving's private ladder is now a re-export
+of this one.  ``SparseCells`` capacity buckets to powers of two of the
+lane multiple (128) for the same reason: capacity is a traced-shape
+dimension that would otherwise retrace per upload nnz profile.
+
+Mask convention
+---------------
+``pad_to_bucket`` zero-pads X/obs/var-aligned leaves and records the
+validity mask in ``uns``:
+
+* ``uns["bucket_row_mask"]``  — (bucket_rows,)  bool, True = real cell
+* ``uns["bucket_col_mask"]``  — (bucket_genes,) bool, True = real gene
+* ``uns["bucket_n_cells"]``   — 0-d int32, the true cell count
+* ``uns["bucket_n_genes"]``   — 0-d int32, the true gene count
+
+All four are NUMERIC leaves, so the plan cache keys them by
+shape/dtype — they are TRACED inputs to the compiled program, never
+baked constants.  Two uploads landing in the same bucket therefore
+share one cache entry; the mask values flow in as runtime data.
+Ops registered ``mask_aware=`` (see :mod:`sctools_tpu.registry`)
+consult :func:`masks_of` and switch to masked reductions /
+count-corrected moments so padded results match unpadded results on
+the valid region.
+
+Non-numeric annotation (gene-name strings, categorical labels) would
+defeat the cache — opaque leaves are keyed by CONTENT digest — so
+``pad_to_bucket`` stashes them host-side in the returned
+:class:`BucketInfo` and ``trim_from_bucket`` restores them along with
+cutting every leaf back to the true shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple
+
+import numpy as np
+
+from .config import config, round_up
+from .data.dataset import CellData
+from .data.sparse import SparseCells
+from .utils import telemetry
+
+#: the canonical shape-bucket ladder — serving's query ladder is this
+#: same tuple (one constant to tune, one test surface); sizes past the
+#: end keep doubling
+DEFAULT_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+#: uns keys carrying the validity mask (traced leaves — see module doc)
+ROW_MASK_KEY = "bucket_row_mask"
+COL_MASK_KEY = "bucket_col_mask"
+N_CELLS_KEY = "bucket_n_cells"
+N_GENES_KEY = "bucket_n_genes"
+MASK_KEYS = (ROW_MASK_KEY, COL_MASK_KEY, N_CELLS_KEY, N_GENES_KEY)
+
+
+def bucket_for(n: int, buckets=DEFAULT_BUCKETS) -> int:
+    """Smallest bucket >= ``n``; doubles past the ladder's end."""
+    if n < 1:
+        raise ValueError("bucket_for: need at least one row/column")
+    for b in buckets:
+        if n <= b:
+            return int(b)
+    b = int(buckets[-1])
+    while b < n:
+        b *= 2
+    return b
+
+
+def capacity_bucket(capacity: int) -> int:
+    """Bucketed ELL capacity: the next power-of-two multiple of the
+    lane multiple (128).  Capacity is a traced-shape dim that varies
+    with each upload's nnz profile — left unbucketed it would retrace
+    per upload even when rows/genes bucket identically."""
+    b = int(config.capacity_multiple)
+    c = round_up(max(int(capacity), 1), b)
+    while b < c:
+        b *= 2
+    return b
+
+
+class BucketMasks(NamedTuple):
+    """The validity mask quadruple a mask-aware op consumes.
+
+    ``row``/``col`` are boolean arrays over the BUCKET shape;
+    ``n_cells``/``n_genes`` are 0-d integer counts (traced — use them
+    in arithmetic, never ``int()`` them inside jit).
+    """
+
+    row: Any  # (bucket_rows,) bool
+    col: Any  # (bucket_genes,) bool
+    n_cells: Any  # () int32
+    n_genes: Any  # () int32
+
+
+def masks_of(data) -> BucketMasks | None:
+    """The dataset's bucket validity masks, or None when the data is
+    not bucketized.  The single dispatch point of the mask-aware
+    convention: ops branch on ``masks_of(data) is not None`` at trace
+    time (key presence is part of the treedef, so the branch is
+    stable per cache entry)."""
+    uns = getattr(data, "uns", None)
+    if not uns or ROW_MASK_KEY not in uns:
+        return None
+    try:
+        return BucketMasks(uns[ROW_MASK_KEY], uns[COL_MASK_KEY],
+                           uns[N_CELLS_KEY], uns[N_GENES_KEY])
+    except KeyError as e:  # partial mask set = a corrupted container
+        raise ValueError(
+            f"bucketized data is missing mask key {e} — "
+            f"pad_to_bucket writes all of {MASK_KEYS}") from None
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketInfo:
+    """Everything ``trim_from_bucket`` needs to undo a pad: the true
+    shape, the bucket shape, and the stashed non-numeric annotation
+    (kept host-side so opaque content never enters the plan key)."""
+
+    n_cells: int
+    n_genes: int
+    bucket_cells: int
+    bucket_genes: int
+    stashed: dict  # (section, key) -> value
+
+    @property
+    def pad_rows(self) -> int:
+        return self.bucket_cells - self.n_cells
+
+    @property
+    def pad_genes(self) -> int:
+        return self.bucket_genes - self.n_genes
+
+
+def _is_numeric_array(v) -> bool:
+    dt = getattr(v, "dtype", None)
+    return (dt is not None and getattr(dt, "kind", "?") in "biufc"
+            and not (isinstance(v, np.ndarray) and dt.kind == "O"))
+
+
+def _xp(a):
+    """numpy for host arrays, jax.numpy for device arrays — padding at
+    admission time must not force a host→device transfer."""
+    if isinstance(a, np.ndarray):
+        return np
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _pad_axis(a, axis: int, target: int):
+    """Zero-pad ``a`` along ``axis`` to length ``target``."""
+    cur = a.shape[axis]
+    if cur == target:
+        return a
+    if cur > target:
+        raise ValueError(f"leaf axis {axis} is {cur}, exceeds the "
+                         f"{target} bucket")
+    xp = _xp(a)
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, target - cur)
+    return xp.pad(a, widths)
+
+
+def _pad_sparse(X: SparseCells, bucket_cells: int,
+                bucket_genes: int) -> SparseCells:
+    """Re-shape a padded-ELL matrix onto the bucket: rows pad with
+    sentinel rows, capacity buckets to a pow2 lane multiple, and every
+    existing sentinel (``== n_genes``) is REWRITTEN to the new
+    one-past-the-end (``== bucket_genes``) — a stale sentinel would
+    read as a real entry of gene ``n_genes`` and corrupt every
+    segment reduction."""
+    ind, dat = X.indices, X.data
+    xp = _xp(ind)
+    old_sent, new_sent = X.n_genes, bucket_genes
+    if old_sent != new_sent:
+        ind = xp.where(ind == old_sent, np.int32(new_sent),
+                       ind).astype(np.int32)
+    cap = capacity_bucket(X.capacity)
+    if cap != X.capacity:
+        ind = _pad_axis(ind, 1, cap)
+        # freshly padded slots arrive as 0 (gene 0) — sentinel them
+        pad = xp.arange(cap) >= X.capacity
+        ind = xp.where(pad[None, :], np.int32(new_sent), ind)
+        dat = _pad_axis(dat, 1, cap)
+    if X.rows_padded != bucket_cells:
+        if X.rows_padded > bucket_cells:
+            raise ValueError(
+                f"SparseCells rows_padded={X.rows_padded} exceeds the "
+                f"{bucket_cells} bucket")
+        extra = bucket_cells - X.rows_padded
+        ind = xp.concatenate(
+            [ind, xp.full((extra, cap), new_sent, np.int32)])
+        dat = xp.concatenate([dat, xp.zeros((extra, cap), dat.dtype)])
+    # n_cells/n_genes become the BUCKET dims (static aux data shared by
+    # every upload in the bucket); the true counts live in the mask
+    return SparseCells(ind, dat, bucket_cells, bucket_genes)
+
+
+def _derive_mito(var: dict):
+    """qc's mito fallback reads gene-name STRINGS at trace time; those
+    are stashed (opaque), so bake the boolean column it derives — same
+    predicate as ops/qc._mito_mask."""
+    if "mito" in var or "gene_name" not in var:
+        return None
+    names = np.asarray(var["gene_name"])
+    if names.dtype.kind not in ("U", "S", "O"):
+        return None
+    return np.char.startswith(np.char.upper(names.astype(str)), "MT-")
+
+
+def pad_to_bucket(data: CellData, *, cell_buckets=DEFAULT_BUCKETS,
+                  gene_buckets=DEFAULT_BUCKETS, metrics=None
+                  ) -> tuple[CellData, BucketInfo]:
+    """Pad ``data`` (cells AND genes) to its bucket shape.
+
+    Returns ``(padded, info)``: ``padded`` carries the validity mask in
+    ``uns`` (see module doc) and only numeric annotation; ``info``
+    holds the stashed non-numeric leaves and the true shape for
+    :func:`trim_from_bucket`.  Works on host (numpy/scipy) or device
+    (jax) containers without changing residency.
+    """
+    import scipy.sparse as sp
+
+    n, g = int(data.n_cells), int(data.n_genes)
+    br = bucket_for(n, cell_buckets)
+    bg = bucket_for(g, gene_buckets)
+    stashed: dict = {}
+
+    X = data.X
+    if sp.issparse(X):
+        X = SparseCells.from_scipy_csr(X)
+    if isinstance(X, SparseCells):
+        Xp = _pad_sparse(X, br, bg)
+    else:
+        Xp = _pad_axis(_pad_axis(X, 0, br), 1, bg)
+
+    var_in = dict(data.var)
+    mito = _derive_mito(var_in)
+    if mito is not None:
+        var_in["mito"] = mito
+
+    def split(section: str, d: dict, pad_fn):
+        out = {}
+        for k, v in d.items():
+            if _is_numeric_array(v):
+                out[k] = pad_fn(v)
+            else:
+                stashed[(section, k)] = v
+        return out
+
+    obs = split("obs", data.obs, lambda v: _pad_axis(v, 0, br))
+    var = split("var", var_in, lambda v: _pad_axis(v, 0, bg))
+    obsm = split("obsm", data.obsm, lambda v: _pad_axis(v, 0, br))
+    varm = split("varm", data.varm, lambda v: _pad_axis(v, 0, bg))
+    obsp = split("obsp", data.obsp,
+                 lambda v: _pad_axis(_pad_axis(v, 0, br), 1, br))
+    layers = split(
+        "layers", data.layers,
+        lambda v: (_pad_sparse(v, br, bg) if isinstance(v, SparseCells)
+                   else _pad_axis(_pad_axis(v, 0, br), 1, bg)))
+    uns = split("uns", data.uns, lambda v: v)
+
+    uns[ROW_MASK_KEY] = np.arange(br) < n
+    uns[COL_MASK_KEY] = np.arange(bg) < g
+    uns[N_CELLS_KEY] = np.asarray(n, np.int32)
+    uns[N_GENES_KEY] = np.asarray(g, np.int32)
+
+    m = metrics if metrics is not None else telemetry.default_registry()
+    m.counter("bucket.pad_rows").inc(br - n)
+    m.gauge("bucket.pad_frac", axis="cells").set((br - n) / br)
+    m.gauge("bucket.pad_frac", axis="genes").set((bg - g) / bg)
+    m.counter("bucket.hits", bucket=f"{br}x{bg}").inc()
+
+    padded = CellData(Xp, obs=obs, var=var, obsm=obsm, varm=varm,
+                      obsp=obsp, uns=uns, layers=layers)
+    return padded, BucketInfo(n_cells=n, n_genes=g, bucket_cells=br,
+                              bucket_genes=bg, stashed=stashed)
+
+
+def _trim_axis(a, axis: int, target: int):
+    if getattr(a, "ndim", 0) <= axis or a.shape[axis] <= target:
+        return a
+    sl = [slice(None)] * a.ndim
+    sl[axis] = slice(0, target)
+    return a[tuple(sl)]
+
+
+def _trim_sparse(X: SparseCells, n: int, g: int) -> SparseCells:
+    """Undo :func:`_pad_sparse`: cut padding rows back to the sublane
+    multiple and rewrite the bucket sentinel to ``g``.  Capacity stays
+    at its bucket (harmless: trailing slots are sentinel)."""
+    rows = round_up(max(n, 1), config.sublane)
+    ind = _trim_axis(X.indices, 0, rows)
+    dat = _trim_axis(X.data, 0, rows)
+    xp = _xp(ind)
+    if X.n_genes != g:
+        ind = xp.where(ind == X.n_genes, np.int32(g),
+                       ind).astype(np.int32)
+    return SparseCells(ind, dat, n, g)
+
+
+def trim_from_bucket(data: CellData, info: BucketInfo) -> CellData:
+    """Cut a bucketized result back to its true shape and restore the
+    stashed non-numeric annotation.  uns arrays whose leading axis
+    matches a bucket dim (op outputs like ``pca_mean``) are trimmed by
+    the same rule as var/obs."""
+    n, g = info.n_cells, info.n_genes
+    br, bg = info.bucket_cells, info.bucket_genes
+
+    X = data.X
+    if isinstance(X, SparseCells):
+        Xt = _trim_sparse(X, n, g)
+    else:
+        Xt = _trim_axis(_trim_axis(X, 0, n), 1, g)
+
+    def cut(d: dict, fn):
+        return {k: fn(v) for k, v in d.items()}
+
+    def cut_uns(v):
+        if _is_numeric_array(v) and getattr(v, "ndim", 0) >= 1:
+            if v.shape[0] == br:
+                return _trim_axis(v, 0, n)
+            if v.shape[0] == bg:
+                return _trim_axis(v, 0, g)
+        return v
+
+    obs = cut(data.obs, lambda v: _trim_axis(v, 0, n))
+    var = cut(data.var, lambda v: _trim_axis(v, 0, g))
+    obsm = cut(data.obsm, lambda v: _trim_axis(v, 0, n))
+    varm = cut(data.varm, lambda v: _trim_axis(v, 0, g))
+    obsp = cut(data.obsp,
+               lambda v: _trim_axis(_trim_axis(v, 0, n), 1, n))
+    layers = cut(
+        data.layers,
+        lambda v: (_trim_sparse(v, n, g) if isinstance(v, SparseCells)
+                   else _trim_axis(_trim_axis(v, 0, n), 1, g)))
+    uns = {k: cut_uns(v) for k, v in data.uns.items()
+           if k not in MASK_KEYS}
+
+    for (section, k), v in info.stashed.items():
+        locals_map = {"obs": obs, "var": var, "obsm": obsm,
+                      "varm": varm, "obsp": obsp, "uns": uns,
+                      "layers": layers}
+        locals_map[section].setdefault(k, v)
+
+    return CellData(Xt, obs=obs, var=var, obsm=obsm, varm=varm,
+                    obsp=obsp, uns=uns, layers=layers)
+
+
+class TrimmingHandle:
+    """Proxy around a scheduler :class:`RunHandle` whose ``result()``
+    trims the bucket-padded output back to the caller's true shape.
+
+    ``submit_recipe(..., bucketize=True)`` pads BEFORE admission (so
+    the scheduler's memory estimate reads the bucket shape the device
+    will actually hold) and hands this back so the caller never sees
+    padding.  Everything else (``status``/``done``/``wait``/``cancel``/
+    ``ticket``…) delegates to the wrapped handle.
+    """
+
+    def __init__(self, handle, info: BucketInfo):
+        self._handle = handle
+        self._info = info
+
+    def result(self, timeout: float | None = None):
+        return trim_from_bucket(self._handle.result(timeout), self._info)
+
+    def __getattr__(self, name):
+        return getattr(self._handle, name)
+
+
+def validate_bucketizable(pipeline, backend: str) -> None:
+    """Raise naming the first step that is not registered mask-aware —
+    a non-mask-aware op would silently fold padding rows/genes into
+    its reductions."""
+    from . import registry
+
+    for t in getattr(pipeline, "transforms", pipeline):
+        name = getattr(t, "name", None) or t[0]
+        params = getattr(t, "params", None)
+        if params is None:
+            params = t[1] if len(t) > 1 else {}
+        if not registry.is_mask_aware(name, backend, params):
+            raise ValueError(
+                f"bucketize=True: step {name!r} (backend={backend}) is "
+                f"not registered mask_aware — it would fold padding "
+                f"into its reductions; run it unbucketized or register "
+                f"a mask-aware adapter")

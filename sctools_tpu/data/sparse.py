@@ -367,7 +367,8 @@ def gene_stats(x: SparseCells) -> tuple[jax.Array, jax.Array, jax.Array]:
 
 
 @jax.jit
-def gene_moments(x: SparseCells) -> tuple[jax.Array, jax.Array, jax.Array]:
+def gene_moments(x: SparseCells, n_valid=None
+                 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Per-gene (mean, CENTERED second moment Σ(x−μ)², nnz) across
     valid cells, cancellation-free.
 
@@ -379,6 +380,12 @@ def gene_moments(x: SparseCells) -> tuple[jax.Array, jax.Array, jax.Array]:
     unlike ``ss − n·μ²``, which loses all precision for genes with
     ``μ² ≫ var`` (housekeeping genes on raw counts).  Same scheme as
     the streaming stats pass (data/stream.py _shard_stats).
+
+    ``n_valid`` (TRACED scalar) overrides the static ``x.n_cells`` as
+    the population count — the bucket-mask path (buckets.py), where
+    ``x.n_cells`` is the bucket row count and padding rows are fully
+    sentinel (they already drop out of the slot sums; only the
+    divisions and the zeros term see the count).
     """
     n_cells = x.n_cells
 
@@ -389,7 +396,12 @@ def gene_moments(x: SparseCells) -> tuple[jax.Array, jax.Array, jax.Array]:
 
     out1 = segment_reduce(x, slot_sums, 2)  # (no dead Σx² slot here)
     s, nnz = out1[:, 0], out1[:, 1]
-    mu = s / max(n_cells, 1)
+    if n_valid is None:
+        mu = s / max(n_cells, 1)
+        n = n_cells
+    else:
+        n = jnp.asarray(n_valid, s.dtype)
+        mu = s / jnp.maximum(n, 1.0)
     mu_pad = jnp.concatenate([mu, jnp.zeros((1,), mu.dtype)])
 
     def slot_sq(ind, dat, row_offset):
@@ -399,5 +411,5 @@ def gene_moments(x: SparseCells) -> tuple[jax.Array, jax.Array, jax.Array]:
         return (d * d)[:, :, None]
 
     m2 = segment_reduce(x, slot_sq, 1)[:, 0]
-    m2 = m2 + jnp.maximum(n_cells - nnz, 0.0) * mu * mu
+    m2 = m2 + jnp.maximum(n - nnz, 0.0) * mu * mu
     return mu, m2, nnz

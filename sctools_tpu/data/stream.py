@@ -871,7 +871,7 @@ def stream_pca(src: ShardSource, gene_idx: np.ndarray,
     a reason sidecar, deterministic ``.prev`` fallback); the files
     are deleted on success.
     """
-    from ..ops.pca import cholesky_qr
+    from ..ops.pca import _sketch_omega, cholesky_qr
 
     gene_idx = np.asarray(gene_idx)
     g_sub = len(gene_idx)
@@ -912,7 +912,10 @@ def stream_pca(src: ShardSource, gene_idx: np.ndarray,
         carrier = jnp.asarray(z["carrier"])
         acc0 = jnp.asarray(z["acc"])
     else:
-        carrier = jax.random.normal(key, (g_sub, L), jnp.float32)
+        # the per-gene fold_in sketch shared with randomized_pca_arrays:
+        # row i depends only on (key, i), so the streaming and
+        # in-memory runs start from the SAME carrier for the same key
+        carrier = _sketch_omega(key, g_sub, L, jnp.float32)
 
     def rmatvec_all(Q, rnd, acc=None, first_shard=0):
         acc = (jnp.zeros((g_sub, Q.shape[1]), jnp.float32)

@@ -31,6 +31,8 @@ from ..data.dataset import CellData
 from ..data.sparse import SparseCells
 from ..registry import register
 
+from .. import buckets as _buckets
+
 
 # ----------------------------------------------------------------------
 # Gene subsetting (shared with qc.filter_genes).
@@ -114,22 +116,37 @@ def select_genes_device(data: CellData, gene_idx: np.ndarray,
 # ----------------------------------------------------------------------
 
 
-def _gene_moments_tpu(X):
+def _gene_moments_tpu(X, n_valid=None, row_valid=None):
     """Per-gene mean, (ddof=1) variance, and nnz over cells;
     sparse-aware.  The sparse path uses the cancellation-free centered
     two-pass (``gene_moments``) — ``ss − n·μ²`` in f32 loses all
     precision for genes with μ² ≫ var, which on raw counts is most
-    housekeeping genes (round-4 fix, mirrors the streaming stats)."""
+    housekeeping genes (round-4 fix, mirrors the streaming stats).
+
+    ``n_valid``/``row_valid`` (TRACED count / bucket row mask) switch
+    to count-corrected moments on bucketized data (buckets.py):
+    padding rows contribute zero sums but must not inflate the
+    population count or the dense centered squares."""
     if isinstance(X, SparseCells):
         from ..data.sparse import gene_moments
 
-        mean, m2, nnz = gene_moments(X)
-        var = m2 / max(X.n_cells - 1, 1)
+        mean, m2, nnz = gene_moments(X, n_valid=n_valid)
+        if n_valid is None:
+            var = m2 / max(X.n_cells - 1, 1)
+        else:
+            var = m2 / jnp.maximum(
+                jnp.asarray(n_valid, m2.dtype) - 1.0, 1.0)
     else:
         X = jnp.asarray(X)
-        n = X.shape[0]
-        mean = jnp.mean(X, axis=0)
-        var = jnp.var(X, axis=0, ddof=1)
+        if n_valid is None:
+            mean = jnp.mean(X, axis=0)
+            var = jnp.var(X, axis=0, ddof=1)
+        else:
+            nv = jnp.asarray(n_valid, X.dtype)
+            mean = jnp.sum(X, axis=0) / jnp.maximum(nv, 1.0)
+            d = jnp.where(jnp.asarray(row_valid)[:, None],
+                          X - mean[None, :], 0.0)
+            var = jnp.sum(d * d, axis=0) / jnp.maximum(nv - 1.0, 1.0)
         nnz = jnp.sum(X != 0, axis=0).astype(mean.dtype)
     return mean, jnp.maximum(var, 0.0), nnz
 
@@ -258,8 +275,13 @@ def _fit_mean_var_trend(mean, var, xp):
 
 
 def _seurat_v3_scores_from_stats(mean, var, clipped_ssq, n, xp):
-    """Standardised variance given the clipped second moment."""
-    std_var = clipped_ssq / max(n - 1, 1)
+    """Standardised variance given the clipped second moment.
+    ``n`` may be a TRACED scalar (bucket-mask path)."""
+    if hasattr(n, "dtype"):
+        std_var = clipped_ssq / xp.maximum(
+            xp.asarray(n, clipped_ssq.dtype) - 1.0, 1.0)
+    else:
+        std_var = clipped_ssq / max(n - 1, 1)
     return xp.where((mean > 0) & (var > 0), std_var, 0.0)
 
 
@@ -307,7 +329,7 @@ def _hvg_fusable(params: dict) -> bool:
 
 
 @register("hvg.select", backend="tpu", fusable=_hvg_fusable,
-          mem_cost=2.5)
+          mem_cost=2.5, mask_aware=_hvg_fusable)
 def hvg_select_tpu(data: CellData, n_top: int = 2000,
                    flavor: str = "seurat_v3", subset: bool = False,
                    compact: bool = True,
@@ -319,19 +341,30 @@ def hvg_select_tpu(data: CellData, n_top: int = 2000,
     point, like the reference's shard repack).  ``batch_key`` scores
     each batch separately and rank-combines (scanpy semantics: genes
     variable in MORE batches win, median per-batch rank breaks ties;
-    adds ``highly_variable_nbatches``)."""
+    adds ``highly_variable_nbatches``).
+
+    Mask-aware for the fusable flavors (same predicate as fusability:
+    no subset, no batch_key, moment-based scoring): moments are
+    count-corrected with the TRACED valid count, the seurat_v3 clip
+    and zeros term use it too, and padding genes score ``-inf`` so
+    they can never displace a real gene from the top-``n_top`` set."""
     if batch_key is not None:
         return _hvg_batched(
             data, n_top, flavor, subset, compact, batch_key,
             lambda d: hvg_select_tpu(d, n_top=n_top, flavor=flavor),
             select_genes_device)
     X = data.X
+    masks = _buckets.masks_of(data)
+    n_valid = None if masks is None else masks.n_cells
+    row_valid = None if masks is None else masks.row
     if flavor == "seurat_v3":
-        mean, var, nnz = _gene_moments_tpu(X)
-        n = data.n_cells
+        mean, var, nnz = _gene_moments_tpu(X, n_valid=n_valid,
+                                           row_valid=row_valid)
+        n = data.n_cells if masks is None else n_valid
         reg_var = _fit_mean_var_trend(mean, var, jnp)
         reg_std = jnp.sqrt(reg_var)
-        clip = jnp.sqrt(jnp.asarray(float(n)))
+        clip = (jnp.sqrt(jnp.asarray(float(n))) if masks is None
+                else jnp.sqrt(jnp.asarray(n, jnp.float32)))
         if isinstance(X, SparseCells):
             # clipped standardised second moment via one chunked
             # segment pass: sum_c min(clip, (x - mu)/sigma)^2 =
@@ -364,7 +397,8 @@ def hvg_select_tpu(data: CellData, n_top: int = 2000,
         score = _seurat_v3_scores_from_stats(mean, var, ssq, n, jnp)
     elif flavor in ("dispersion", "seurat"):
         # "seurat" is scanpy's name for exactly this ranking
-        mean, var, _ = _gene_moments_tpu(X)
+        mean, var, _ = _gene_moments_tpu(X, n_valid=n_valid,
+                                         row_valid=row_valid)
         score = _dispersion_scores(mean, var, jnp)
     elif flavor == "cell_ranger":
         mean, var, _ = _gene_moments_tpu(X)
@@ -383,6 +417,10 @@ def hvg_select_tpu(data: CellData, n_top: int = 2000,
     else:
         raise ValueError(f"unknown hvg flavor {flavor!r}")
 
+    if masks is not None:
+        # padding genes sort LAST: a zero score ties real unexpressed
+        # genes and could steal a top-n_top slot from them
+        score = jnp.where(jnp.asarray(masks.col), score, -jnp.inf)
     order = jnp.argsort(-score, stable=True)
     rank = jnp.empty_like(order).at[order].set(jnp.arange(data.n_genes))
     highly = rank < n_top

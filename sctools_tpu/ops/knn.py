@@ -24,6 +24,8 @@ from ..config import config, round_up
 from ..data.dataset import CellData
 from ..registry import register
 
+from .. import buckets as _buckets
+
 
 def _prep(points, metric, dtype):
     points = jnp.asarray(points)
@@ -413,19 +415,37 @@ def _refine_sorted_jit(query, cand, cand_idx, *, k, metric):
     return idxs, dists
 
 
-@register("neighbors.knn", backend="tpu")
+@register("neighbors.knn", backend="tpu", mask_aware=True)
 def knn_tpu(data: CellData, k: int = 15, metric: str = "cosine",
             use_rep: str = "X_pca", exclude_self: bool = False,
             query_block: int | None = None,
             cand_block: int | None = None, refine: int = 0) -> CellData:
     """Adds obsp["knn_indices"], obsp["knn_distances"]; uns["knn_k"],
-    uns["knn_metric"]."""
+    uns["knn_metric"].
+
+    Mask-aware: on bucket-padded data (buckets.py) the TRACED valid
+    count feeds ``n_valid_cand`` — padded candidate columns score -inf
+    before every top-k merge, so valid rows get bitwise the neighbours
+    of the unpadded run (extra all--inf candidate blocks can never
+    displace a real hit), while padded query rows are post-masked to
+    index -1 / distance 0.  Passing ``n_valid_cand`` routes to the XLA
+    impl, which is the point: one bucket shape = one compiled program.
+    """
     rep = _get_rep(data, use_rep)
+    masks = _buckets.masks_of(data)
     idx, dist = knn_arrays(
         rep, rep, k=k, metric=metric, n_query=data.n_cells,
         n_cand=data.n_cells, exclude_self=exclude_self,
         query_block=query_block, cand_block=cand_block, refine=refine,
+        n_valid_cand=None if masks is None else masks.n_cells,
     )
+    if masks is not None:
+        # knn_arrays pads queries to a row_block multiple, which may
+        # exceed the bucket row count — rebuild the validity test over
+        # the returned rows from the traced count instead of the mask
+        valid = jnp.arange(idx.shape[0]) < jnp.asarray(masks.n_cells)
+        idx = jnp.where(valid[:, None], idx, -1)
+        dist = jnp.where(valid[:, None], dist, 0.0)
     from .graph import invalidate_graph_layout_stats
 
     data = invalidate_graph_layout_stats(data)

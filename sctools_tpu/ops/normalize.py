@@ -18,15 +18,17 @@ from ..data.dataset import CellData
 from ..data.sparse import SparseCells, row_sum
 from ..registry import register
 
+from .. import buckets as _buckets
+
 # ----------------------------------------------------------------------
 # normalize.library_size
 # ----------------------------------------------------------------------
 
 
-def _library_size_sparse(x: SparseCells, target_sum):
+def _library_size_sparse(x: SparseCells, target_sum, row_valid=None):
     totals = row_sum(x)
     if target_sum is None:
-        valid = x.row_mask()
+        valid = x.row_mask() if row_valid is None else row_valid
         target = jnp.nanmedian(jnp.where(valid, totals, jnp.nan))
     else:
         target = jnp.asarray(target_sum, x.data.dtype)
@@ -34,10 +36,16 @@ def _library_size_sparse(x: SparseCells, target_sum):
     return x.with_data(x.data * scale[:, None]), totals
 
 
-def _library_size_dense(x: jax.Array, target_sum):
+def _library_size_dense(x: jax.Array, target_sum, row_valid=None):
     totals = jnp.sum(x, axis=1)
     if target_sum is None:
-        target = jnp.median(totals)
+        if row_valid is None:
+            target = jnp.median(totals)
+        else:
+            # bucket-mask path: padding rows (totals == 0) must not
+            # drag the median down
+            target = jnp.nanmedian(
+                jnp.where(row_valid, totals, jnp.nan))
     else:
         target = jnp.asarray(target_sum, x.dtype)
     scale = jnp.where(totals > 0, target / jnp.maximum(totals, 1e-12), 0.0)
@@ -67,7 +75,7 @@ def _he_gene_flag_device(x: SparseCells, totals, max_fraction):
 
 
 @register("normalize.library_size", backend="tpu", fusable=True,
-          mem_cost=2.5)
+          mem_cost=2.5, mask_aware=True)
 def library_size_tpu(data: CellData, target_sum: float | None = 1e4,
                      exclude_highly_expressed: bool = False,
                      max_fraction: float = 0.05) -> CellData:
@@ -76,8 +84,15 @@ def library_size_tpu(data: CellData, target_sum: float | None = 1e4,
     (scanpy ``normalize_total`` parity): genes taking more than
     ``max_fraction`` of ANY cell's counts are left out of the size
     computation — so one hyper-abundant transcript cannot deflate
-    every other gene of its cell — but are still scaled."""
+    every other gene of its cell — but are still scaled.
+
+    Mask-aware: per-row rescaling leaves zero padding rows zero
+    (``scale == 0`` at ``totals == 0``); the one cross-row statistic,
+    the ``target_sum=None`` median, restricts to the bucket row
+    mask."""
     X = data.X
+    masks = _buckets.masks_of(data)
+    row_valid = None if masks is None else jnp.asarray(masks.row)
     if isinstance(X, SparseCells):
         if exclude_highly_expressed:
             totals_all = row_sum(X)
@@ -88,7 +103,8 @@ def library_size_tpu(data: CellData, target_sum: float | None = 1e4,
                 X.data * jnp.take(table, X.indices), axis=1)
             totals = totals_all - he_counts
             if target_sum is None:
-                valid = X.row_mask()
+                valid = (X.row_mask() if row_valid is None
+                         else row_valid)
                 target = jnp.nanmedian(
                     jnp.where(valid, totals, jnp.nan))
             else:
@@ -98,7 +114,8 @@ def library_size_tpu(data: CellData, target_sum: float | None = 1e4,
             Xs = X.with_data(X.data * scale[:, None])
             return (data.with_X(Xs).with_obs(library_size=totals)
                     .with_var(highly_expressed=he))
-        Xs, totals = _library_size_sparse(X, target_sum)
+        Xs, totals = _library_size_sparse(X, target_sum,
+                                          row_valid=row_valid)
     else:
         Xd = jnp.asarray(X)
         if exclude_highly_expressed:
@@ -106,14 +123,20 @@ def library_size_tpu(data: CellData, target_sum: float | None = 1e4,
             frac = Xd / jnp.maximum(totals_all[:, None], 1e-12)
             he = jnp.any(frac > max_fraction, axis=0)
             totals = jnp.sum(jnp.where(he[None, :], 0.0, Xd), axis=1)
-            target = (jnp.median(totals) if target_sum is None
-                      else jnp.asarray(target_sum, Xd.dtype))
+            if target_sum is not None:
+                target = jnp.asarray(target_sum, Xd.dtype)
+            elif row_valid is None:
+                target = jnp.median(totals)
+            else:
+                target = jnp.nanmedian(
+                    jnp.where(row_valid, totals, jnp.nan))
             scale = jnp.where(totals > 0,
                               target / jnp.maximum(totals, 1e-12), 0.0)
             return (data.with_X(Xd * scale[:, None])
                     .with_obs(library_size=totals)
                     .with_var(highly_expressed=he))
-        Xs, totals = _library_size_dense(Xd, target_sum)
+        Xs, totals = _library_size_dense(Xd, target_sum,
+                                         row_valid=row_valid)
     return data.with_X(Xs).with_obs(library_size=totals)
 
 
@@ -162,10 +185,13 @@ def library_size_cpu(data: CellData, target_sum: float | None = 1e4,
 # ----------------------------------------------------------------------
 
 
-@register("normalize.log1p", backend="tpu", fusable=True)
+@register("normalize.log1p", backend="tpu", fusable=True,
+          mask_aware=True)
 def log1p_tpu(data: CellData) -> CellData:
     """``x -> log(1 + x)`` elementwise.  On the sparse layout this maps
-    only stored values (log1p(0) == 0, so sparsity is preserved)."""
+    only stored values (log1p(0) == 0, so sparsity is preserved).
+    Mask-aware for free: elementwise with a zero fixed point, so
+    bucket padding stays zero."""
     X = data.X
     if isinstance(X, SparseCells):
         X = X.with_data(jnp.log1p(X.data))
@@ -193,23 +219,40 @@ def log1p_cpu(data: CellData) -> CellData:
 
 
 @register("normalize.scale", backend="tpu", fusable=True,
-          mem_cost=3.0)
+          mem_cost=3.0, mask_aware=True)
 def scale_tpu(data: CellData, max_value: float | None = 10.0,
               zero_center: bool = True) -> CellData:
     """Per-gene standardisation (unit variance, optionally zero mean).
 
     Densifies: meant for the post-HVG matrix (n_cells × ~2k genes).
+
+    Mask-aware: on bucketized data the moments are count-corrected
+    (divide by the TRACED valid count, padding rows contribute zero
+    sums) and the standardised padding rows are re-zeroed —
+    ``(0 - mean)/std`` would otherwise turn inert padding into dense
+    junk that downstream reductions would fold in.
     """
     X = data.X
+    masks = _buckets.masks_of(data)
     if isinstance(X, SparseCells):
         X = X.to_dense()
     X = jnp.asarray(X)
-    mean = jnp.mean(X, axis=0)
-    var = jnp.var(X, axis=0)
+    if masks is None:
+        mean = jnp.mean(X, axis=0)
+        var = jnp.var(X, axis=0)
+    else:
+        n = jnp.maximum(jnp.asarray(masks.n_cells, X.dtype), 1.0)
+        mean = jnp.sum(X, axis=0) / n  # padding rows are zero
+        rm = jnp.asarray(masks.row)[:, None]
+        d = jnp.where(rm, X - mean[None, :], 0.0)
+        var = jnp.sum(d * d, axis=0) / n
     std = jnp.sqrt(jnp.maximum(var, 1e-12))
     Xs = (X - mean) / std if zero_center else X / std
     if max_value is not None:
         Xs = jnp.clip(Xs, -max_value, max_value)
+    if masks is not None:
+        Xs = jnp.where(jnp.asarray(masks.row)[:, None], Xs, 0.0)
+        Xs = jnp.where(jnp.asarray(masks.col)[None, :], Xs, 0.0)
     return data.with_X(Xs).with_var(scale_mean=mean, scale_std=std)
 
 
@@ -247,12 +290,20 @@ def _pearson_residuals_math(X_dense, totals, gene_sums, grand, theta,
     mu = (totals[:, None] * gene_sums[None, :]) / xp.maximum(grand, 1e-12)
     denom = xp.sqrt(mu + mu * mu / theta)
     Z = (X_dense - mu) / xp.maximum(denom, 1e-12)
-    c = float(np.sqrt(n_cells)) if clip is None else float(clip)
+    if clip is not None:
+        c = float(clip)
+    elif hasattr(n_cells, "dtype") or not isinstance(n_cells, (int, float)):
+        # bucket-mask path: the valid count is a TRACED scalar — keep
+        # the sqrt on device so the clip bound never bakes into the
+        # compiled program
+        c = xp.sqrt(xp.asarray(n_cells, X_dense.dtype))
+    else:
+        c = float(np.sqrt(n_cells))
     return xp.clip(Z, -c, c)
 
 
 @register("normalize.pearson_residuals", backend="tpu",
-          fusable=True, mem_cost=4.0)
+          fusable=True, mem_cost=4.0, mask_aware=True)
 def pearson_residuals_tpu(data: CellData, theta: float = 100.0,
                           clip: float | None = None) -> CellData:
     """Analytic Pearson residuals of an NB offset model (Lause et al.
@@ -263,13 +314,23 @@ def pearson_residuals_tpu(data: CellData, theta: float = 100.0,
     (``totals``/``gene_sums``) are computed sparsely; only the residual
     matrix itself is dense, which it must be (residuals of zeros are
     nonzero).  Pure VPU work: one rank-1 outer product + elementwise.
+
+    Mask-aware: padding margins are zero so padded residuals read 0
+    (``mu = 0`` and the denominator floor keeps 0/0 at 0); the default
+    ``sqrt(n)`` clip switches to the TRACED valid count, and padding
+    rows are explicitly re-zeroed as belt-and-braces.
     """
     X = data.X
+    masks = _buckets.masks_of(data)
     Xd = X.to_dense() if isinstance(X, SparseCells) else jnp.asarray(X)
     totals = jnp.sum(Xd, axis=1)
     gene_sums = jnp.sum(Xd, axis=0)
+    n = Xd.shape[0] if masks is None else masks.n_cells
     Z = _pearson_residuals_math(Xd, totals, gene_sums, jnp.sum(totals),
-                                theta, clip, Xd.shape[0], jnp)
+                                theta, clip, n, jnp)
+    if masks is not None:
+        Z = jnp.where(jnp.asarray(masks.row)[:, None], Z, 0.0)
+        Z = jnp.where(jnp.asarray(masks.col)[None, :], Z, 0.0)
     return data.with_X(Z).with_uns(pearson_theta=theta)
 
 

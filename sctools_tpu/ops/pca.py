@@ -25,6 +25,8 @@ from ..data.dataset import CellData
 from ..data.sparse import SparseCells, gene_sum, row_sum, spmm, spmm_t
 from ..registry import register
 
+from .. import buckets as _buckets
+
 
 def _warn_if_narrowed(n_components: int, data) -> None:
     lim = min(data.n_cells, data.n_genes)
@@ -37,26 +39,41 @@ def _warn_if_narrowed(n_components: int, data) -> None:
             stacklevel=3)
 
 
-def _center_matvec(X, mu, V):
-    """(X - 1 μᵀ) @ V with padded rows forced to zero."""
+def _center_matvec(X, mu, V, row_valid=None):
+    """(X - 1 μᵀ) @ V with padded rows forced to zero.  ``row_valid``
+    (TRACED bucket row mask, buckets.py) overrides the static row
+    mask — centering writes ``-μᵀV`` into every padded row, which must
+    not leak into the iteration's inner products."""
     if isinstance(X, SparseCells):
+        rm = X.row_mask() if row_valid is None else row_valid
         out = spmm(X, V) - jnp.outer(jnp.ones(X.rows_padded, V.dtype), mu @ V)
-        return jnp.where(X.row_mask()[:, None], out, 0.0)
-    return X @ V - jnp.outer(jnp.ones(X.shape[0], V.dtype), mu @ V)
+        return jnp.where(rm[:, None], out, 0.0)
+    out = X @ V - jnp.outer(jnp.ones(X.shape[0], V.dtype), mu @ V)
+    if row_valid is not None:
+        out = jnp.where(row_valid[:, None], out, 0.0)
+    return out
 
 
-def _center_rmatvec(X, mu, Q):
+def _center_rmatvec(X, mu, Q, row_valid=None):
     """(X - 1 μᵀ)ᵀ @ Q; assumes padded rows of Q are zero."""
     if isinstance(X, SparseCells):
-        colsum = jnp.sum(jnp.where(X.row_mask()[:, None], Q, 0.0), axis=0)
+        rm = X.row_mask() if row_valid is None else row_valid
+        colsum = jnp.sum(jnp.where(rm[:, None], Q, 0.0), axis=0)
         return spmm_t(X, Q) - jnp.outer(mu, colsum)
     return X.T @ Q - jnp.outer(mu, jnp.sum(Q, axis=0))
 
 
-def _gene_mean(X) -> jax.Array:
+def _gene_mean(X, n_valid=None) -> jax.Array:
     if isinstance(X, SparseCells):
-        return gene_sum(X) / X.n_cells
-    return jnp.mean(X, axis=0)
+        if n_valid is None:
+            return gene_sum(X) / X.n_cells
+        return gene_sum(X) / jnp.maximum(
+            jnp.asarray(n_valid, X.data.dtype), 1.0)
+    if n_valid is None:
+        return jnp.mean(X, axis=0)
+    # bucketized dense: padding rows are zero, only the count corrects
+    return jnp.sum(X, axis=0) / jnp.maximum(
+        jnp.asarray(n_valid, X.dtype), 1.0)
 
 
 def cholesky_qr(Y: jax.Array, iters: int = 2) -> jax.Array:
@@ -90,16 +107,36 @@ def _orthonormalize(Y, method: str):
     return Q
 
 
+def _sketch_omega(key, G: int, L: int, dtype) -> jax.Array:
+    """Random sketch matrix with PER-GENE streams: row g is drawn from
+    ``fold_in(key, g)`` rather than slicing one (G, L) draw.  This makes
+    omega's first G₀ rows independent of G, so a dataset padded from G₀
+    to a gene bucket G sees bitwise the same sketch on its valid genes —
+    padded gene rows multiply all-zero columns and contribute nothing.
+    """
+    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+        key, jnp.arange(G, dtype=jnp.uint32))
+    return jax.vmap(lambda kg: jax.random.normal(kg, (L,), dtype))(keys)
+
+
 @partial(jax.jit, static_argnames=("n_components", "oversample", "n_iter",
                                    "center", "qr_method"))
 def randomized_pca_arrays(X, key, n_components: int = 50, oversample: int = 10,
                           n_iter: int = 2, center: bool = True,
-                          qr_method: str = "cholesky"):
+                          qr_method: str = "cholesky",
+                          row_valid=None, n_valid=None):
     """Core randomized PCA.  X: SparseCells or dense (n, G).
 
     Returns (scores (rows, k), components (G, k), explained_var (k,),
     mean (G,)).  ``qr_method``: "cholesky" (CholeskyQR2; row-sharding
     friendly, default) or "householder" (jnp.linalg.qr).
+
+    ``row_valid``/``n_valid`` (traced bucket row mask + valid-row count,
+    see buckets.py) restrict the factorization to the valid rows of a
+    bucket-padded matrix.  Note the sketch width L clamps to the BUCKET
+    dims, not the valid dims: when the valid region is narrower than
+    ``n_components + oversample`` the trailing components are garbage
+    directions, exactly like an unpadded matrix of bucket width.
     """
     G = X.n_genes if isinstance(X, SparseCells) else X.shape[1]
     n = X.n_cells if isinstance(X, SparseCells) else X.shape[0]
@@ -110,38 +147,55 @@ def randomized_pca_arrays(X, key, n_components: int = 50, oversample: int = 10,
     L = min(n_components + oversample, G, n)
     k = min(n_components, L)
     dtype = X.data.dtype if isinstance(X, SparseCells) else X.dtype
-    mu = _gene_mean(X) if center else jnp.zeros((G,), dtype)
+    mu = _gene_mean(X, n_valid) if center else jnp.zeros((G,), dtype)
 
-    omega = jax.random.normal(key, (G, L), dtype)
-    Y = _center_matvec(X, mu, omega)  # (rows, L)
+    omega = _sketch_omega(key, G, L, dtype)
+    Y = _center_matvec(X, mu, omega, row_valid)  # (rows, L)
     Q = _orthonormalize(Y, qr_method)
     for _ in range(n_iter):
-        Z = _center_rmatvec(X, mu, Q)  # (G, L)
+        Z = _center_rmatvec(X, mu, Q, row_valid)  # (G, L)
         Qz = _orthonormalize(Z, qr_method)
-        Y = _center_matvec(X, mu, Qz)
+        Y = _center_matvec(X, mu, Qz, row_valid)
         Q = _orthonormalize(Y, qr_method)
-    B = _center_rmatvec(X, mu, Q).T  # (L, G)
+    B = _center_rmatvec(X, mu, Q, row_valid).T  # (L, G)
     U_b, S, Vt = jnp.linalg.svd(B, full_matrices=False)
     scores = (Q @ U_b[:, :k]) * S[:k]
     components = Vt[:k].T  # (G, k)
-    explained = (S[:k] ** 2) / max(n - 1, 1)
+    if n_valid is None:
+        explained = (S[:k] ** 2) / max(n - 1, 1)
+    else:
+        explained = (S[:k] ** 2) / jnp.maximum(
+            jnp.asarray(n_valid, S.dtype) - 1.0, 1.0)
     return scores, components, explained, mu
 
 
 @register("pca.randomized", backend="tpu", fusable=True,
-          mem_cost=4.0)
+          mem_cost=4.0, mask_aware=True)
 def pca_randomized_tpu(data: CellData, n_components: int = 50,
                        oversample: int = 10, n_iter: int = 2,
                        center: bool = True, seed: int = 0,
                        qr_method: str = "cholesky") -> CellData:
     """Adds obsm["X_pca"], varm["PCs"], uns["pca_explained_variance"].
     Requesting more components than min(n_cells, n_genes) returns the
-    achievable width with a warning (the sketch clamp below)."""
+    achievable width with a warning (the sketch clamp below).
+
+    Mask-aware: bucket-padded rows never enter the factorization (the
+    centered matvec zeroes them, so the Q basis and scores are zero
+    there) and the per-gene sketch streams make the valid-gene rows of
+    omega independent of the gene bucket.  Padded results agree with
+    unpadded up to iterative-solver tolerance (the L-row Gram/SVD
+    reductions run over bucket-shaped operands whose padding is zero —
+    same values, reassociated arithmetic).
+    """
     _warn_if_narrowed(n_components, data)
     key = jax.random.PRNGKey(seed)
+    masks = _buckets.masks_of(data)
+    row_valid = None if masks is None else jnp.asarray(masks.row)
+    n_valid = None if masks is None else masks.n_cells
     scores, comps, expl, mu = randomized_pca_arrays(
         data.X, key, n_components=n_components, oversample=oversample,
         n_iter=n_iter, center=center, qr_method=qr_method,
+        row_valid=row_valid, n_valid=n_valid,
     )
     return data.with_obsm(X_pca=scores).with_varm(PCs=comps).with_uns(
         pca_explained_variance=expl, pca_mean=mu,

@@ -18,6 +18,8 @@ from ..data.dataset import CellData
 from ..data.sparse import SparseCells, gene_stats
 from ..registry import register
 
+from .. import buckets as _buckets
+
 
 def _mito_mask(data: CellData):
     if "mito" in data.var:
@@ -28,7 +30,8 @@ def _mito_mask(data: CellData):
     return None
 
 
-@register("qc.per_cell_metrics", backend="tpu", fusable=True)
+@register("qc.per_cell_metrics", backend="tpu", fusable=True,
+          mask_aware=True)
 def per_cell_metrics_tpu(data: CellData, mito_mask=None,
                          percent_top: tuple = ()) -> CellData:
     """Adds obs: ``n_genes``, ``total_counts``, ``pct_counts_mt``;
@@ -36,7 +39,12 @@ def per_cell_metrics_tpu(data: CellData, mito_mask=None,
     (scanpy ``calculate_qc_metrics`` semantics: share of a cell's
     counts captured by its N highest-count genes — opt-in, e.g.
     ``percent_top=(50, 100)``).  On the ELL layout the per-cell top-N
-    is one ``lax.top_k`` over the capacity axis."""
+    is one ``lax.top_k`` over the capacity axis.
+
+    Mask-aware for free: every metric is a per-row reduction, and
+    bucket-padding rows/genes contribute only zeros (sentinel slots /
+    zero columns), so padded rows read 0 and the valid region is
+    untouched (buckets.py convention)."""
     X = data.X
     if mito_mask is None:
         mito_mask = _mito_mask(data)
@@ -124,20 +132,34 @@ def per_cell_metrics_cpu(data: CellData, mito_mask=None,
     )
 
 
-@register("qc.per_gene_metrics", backend="tpu", fusable=True)
+@register("qc.per_gene_metrics", backend="tpu", fusable=True,
+          mask_aware=True)
 def per_gene_metrics_tpu(data: CellData) -> CellData:
-    """Adds var: ``n_cells``, ``total_counts``, ``mean_counts``."""
+    """Adds var: ``n_cells``, ``total_counts``, ``mean_counts``.
+
+    Mask-aware: on bucketized data the sums already exclude padding
+    (sentinel slots / zero rows); only the mean's population count
+    switches to the TRACED valid-cell count."""
     X = data.X
+    masks = _buckets.masks_of(data)
     if isinstance(X, SparseCells):
         s, _, n = gene_stats(X)
         n_cells_by = n.astype(jnp.int32)
         total = s
-        mean = s / X.n_cells
+        if masks is None:
+            mean = s / X.n_cells
+        else:
+            mean = s / jnp.maximum(
+                jnp.asarray(masks.n_cells, s.dtype), 1.0)
     else:
         X = jnp.asarray(X)
         n_cells_by = jnp.sum(X > 0, axis=0).astype(jnp.int32)
         total = jnp.sum(X, axis=0)
-        mean = total / X.shape[0]
+        if masks is None:
+            mean = total / X.shape[0]
+        else:
+            mean = total / jnp.maximum(
+                jnp.asarray(masks.n_cells, total.dtype), 1.0)
     return data.with_var(n_cells=n_cells_by, total_counts=total, mean_counts=mean)
 
 
@@ -381,7 +403,8 @@ def filter_genes_cpu(data: CellData, min_cells: int | None = 3,
     return data.replace(X=X, var=var, varm=varm, layers=layers)
 
 
-@register("util.snapshot_layer", backend="tpu", fusable=True)
+@register("util.snapshot_layer", backend="tpu", fusable=True,
+          mask_aware=True)
 @register("util.snapshot_layer", backend="cpu")
 def snapshot_layer(data: CellData, layer: str = "counts") -> CellData:
     """Copy the CURRENT X into ``layers[layer]`` — the Pipeline-friendly

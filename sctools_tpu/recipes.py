@@ -55,7 +55,7 @@ def recipe_pipeline(name: str, **kw) -> Pipeline:
 def run_recipe(name: str, data: CellData, *, backend: str | None = None,
                checkpoint_dir: str | None = None, resume: bool = True,
                step_deadline_s: float | None = None,
-               fuse: bool = False, mesh=None,
+               fuse: bool = False, mesh=None, bucketize: bool = False,
                runner_kw: dict | None = None, **recipe_kw) -> CellData:
     """Run a named recipe under the resilient execution layer.
 
@@ -102,6 +102,18 @@ def run_recipe(name: str, data: CellData, *, backend: str | None = None,
     — and arms the runner's fewer-devices degrade rung
     (docs/GUIDE.md "Making a recipe fast", multi-device walkthrough).
 
+    ``bucketize=True`` pads the input to the nearest shape bucket
+    before running (``buckets.pad_to_bucket``) and trims the padding
+    off the result: every differently-shaped upload that lands in the
+    same bucket reuses the SAME compiled programs (with ``fuse=True``,
+    the plan cache keys on the bucket shape and the validity mask rides
+    along as a traced leaf).  Every step of the recipe must be
+    registered ``mask_aware`` or this raises up front, naming the
+    offending step — see docs/ARCHITECTURE.md "Shape bucketing".
+    Checkpoints taken under ``bucketize=True`` fingerprint the PADDED
+    data (mask included), so resuming with a different true shape in
+    the same bucket recomputes rather than reusing a stale result.
+
     >>> out = run_recipe("seurat", data, backend="tpu",
     ...                  checkpoint_dir="ck/", step_deadline_s=900,
     ...                  n_top_genes=2000)
@@ -114,12 +126,24 @@ def run_recipe(name: str, data: CellData, *, backend: str | None = None,
         # silently-discarded deadline budget is exactly the kind of
         # config drift the journal exists to rule out
         kw["step_deadline_s"] = step_deadline_s
+    pipeline = recipe_pipeline(name, **recipe_kw)
+    info = None
+    if bucketize:
+        from . import buckets
+
+        buckets.validate_bucketizable(pipeline, backend or "tpu")
+        data, info = buckets.pad_to_bucket(data)
     # mesh without fuse raises in the ResilientRunner constructor —
     # the guard lives on the mechanism, so direct runner users get it
-    runner = ResilientRunner(recipe_pipeline(name, **recipe_kw),
+    runner = ResilientRunner(pipeline,
                              checkpoint_dir=checkpoint_dir, fuse=fuse,
                              mesh=mesh, **kw)
-    return runner.run(data, backend=backend, resume=resume)
+    out = runner.run(data, backend=backend, resume=resume)
+    if info is not None:
+        from . import buckets
+
+        out = buckets.trim_from_bucket(out, info)
+    return out
 
 
 def submit_recipe(scheduler, name: str, data: CellData, *,
@@ -128,7 +152,8 @@ def submit_recipe(scheduler, name: str, data: CellData, *,
                   backend: str | None = None,
                   checkpoint_dir: str | None = None,
                   step_deadline_s: float | None = None,
-                  fuse: bool = False, runner_kw: dict | None = None,
+                  fuse: bool = False, bucketize: bool = False,
+                  runner_kw: dict | None = None,
                   **recipe_kw):
     """Submit a named recipe to a :class:`~sctools_tpu.scheduler.
     RunScheduler` — the multi-tenant form of :func:`run_recipe`.
@@ -146,6 +171,13 @@ def submit_recipe(scheduler, name: str, data: CellData, *,
     ...                       priority=1, deadline_s=600,
     ...                       backend="tpu", n_top_genes=2000)
     ...     out = h.result()
+
+    ``bucketize=True`` (see :func:`run_recipe`) pads to the shape
+    bucket BEFORE admission — deliberately, so the scheduler's memory
+    estimate charges the bucket shape the device will actually hold,
+    not the smaller true shape — and returns a
+    :class:`~sctools_tpu.buckets.TrimmingHandle` whose ``result()``
+    trims the padding back off.
     """
     kw = dict(runner_kw or {})
     if checkpoint_dir is not None:
@@ -154,10 +186,22 @@ def submit_recipe(scheduler, name: str, data: CellData, *,
         kw["step_deadline_s"] = step_deadline_s
     if fuse:
         kw["fuse"] = True
-    return scheduler.submit(recipe_pipeline(name, **recipe_kw), data,
-                            tenant=tenant, priority=priority,
-                            deadline_s=deadline_s, backend=backend,
-                            runner_kw=kw)
+    pipeline = recipe_pipeline(name, **recipe_kw)
+    info = None
+    if bucketize:
+        from . import buckets
+
+        buckets.validate_bucketizable(pipeline, backend or "tpu")
+        data, info = buckets.pad_to_bucket(data)
+    h = scheduler.submit(pipeline, data,
+                         tenant=tenant, priority=priority,
+                         deadline_s=deadline_s, backend=backend,
+                         runner_kw=kw)
+    if info is not None:
+        from .buckets import TrimmingHandle
+
+        return TrimmingHandle(h, info)
+    return h
 
 
 @_pipeline_recipe("zheng17")

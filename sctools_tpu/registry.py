@@ -82,6 +82,18 @@ _COLLECTIVE: dict[str, dict[str, object]] = {}
 _MEM_COST: dict[str, dict[str, object]] = {}
 _MEM_SHRINK: dict[str, dict[str, object]] = {}
 
+# _MASK_AWARE: name -> backend -> True | predicate(params)->bool.
+# Declares the implementation honours the bucket-validity convention
+# (sctools_tpu/buckets.py): when the data carries bucket masks the op
+# restricts every reduction to valid rows/genes (masked medians,
+# count-corrected moments, neighbor candidates clipped to valid rows)
+# so padded results equal unpadded results on the valid region.  A
+# predicate gates parameterisations that change shapes (e.g.
+# hvg.select's subset=True materialisation) out of the bucketized
+# path.  recipes.run_recipe(bucketize=True) refuses pipelines with a
+# non-mask-aware step.
+_MASK_AWARE: dict[str, dict[str, object]] = {}
+
 DEFAULT_BACKEND = "tpu"
 
 # ---------------------------------------------------------------------------
@@ -173,7 +185,8 @@ class UnknownBackendError(KeyError):
 def register(name: str, backend: str = "tpu",
              fusable=False, sharding=None, collective=False,
              mem_cost=None,
-             mem_shrink=None) -> Callable[[Callable], Callable]:
+             mem_shrink=None,
+             mask_aware=False) -> Callable[[Callable], Callable]:
     """Decorator: register ``fn`` as the implementation of ``name`` for
     ``backend``.
 
@@ -206,6 +219,13 @@ def register(name: str, backend: str = "tpu",
     A shrink must preserve results: it may change how the op tiles
     its work, never what it computes.
 
+    ``mask_aware`` (True | ``predicate(params) -> bool``) declares the
+    implementation honours the bucket-validity convention
+    (``sctools_tpu/buckets.py``): on data carrying bucket masks it
+    restricts reductions to valid rows/genes so padded results equal
+    unpadded results on the valid region.  The gate
+    ``recipes.run_recipe(bucketize=True)`` checks before padding.
+
     >>> @register("normalize.log1p", backend="tpu", fusable=True)
     ... def log1p_tpu(data, **kw): ...
     """
@@ -222,6 +242,8 @@ def register(name: str, backend: str = "tpu",
             _MEM_COST.setdefault(name, {})[backend] = mem_cost
         if mem_shrink is not None:
             _MEM_SHRINK.setdefault(name, {})[backend] = mem_shrink
+        if mask_aware:
+            _MASK_AWARE.setdefault(name, {})[backend] = mask_aware
         if fn.__doc__ and name not in _DOCS:
             _DOCS[name] = fn.__doc__
         return fn
@@ -237,6 +259,18 @@ def is_fusable(name: str, backend: str, params: dict | None = None) -> bool:
     if callable(f):
         return bool(f(dict(params or {})))
     return bool(f)
+
+
+def is_mask_aware(name: str, backend: str,
+                  params: dict | None = None) -> bool:
+    """True when the ``(name, backend)`` implementation declared it
+    honours the bucket-validity mask convention
+    (``register(..., mask_aware=...)``) for these bound parameters —
+    the bucketized-recipe eligibility test."""
+    a = _MASK_AWARE.get(name, {}).get(backend, False)
+    if callable(a):
+        return bool(a(dict(params or {})))
+    return bool(a)
 
 
 def is_collective(name: str, backend: str,

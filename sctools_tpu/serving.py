@@ -124,8 +124,11 @@ QUERY_KINDS = ("label_transfer", "doublet_flag", "marker_score")
 #: n-row query pads to the smallest bucket >= n, so every batch size
 #: in a bucket shares one compiled program; sizes past the ladder
 #: keep doubling (serving is for SMALL frequent queries — atlas-sized
-#: inputs belong on the batch pipeline)
-DEFAULT_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+#: inputs belong on the batch pipeline).  The ladder is OWNED by
+#: ``sctools_tpu.buckets`` — serving's query buckets are one instance
+#: of the repo-wide shape-bucket policy the recipe path also pads to.
+from .buckets import DEFAULT_BUCKETS  # noqa: E402  (re-export)
+from .buckets import bucket_for as _bucket_for  # noqa: E402
 
 #: artifact keys that become device-resident on place() (score-set
 #: weight tables join them dynamically under their "score/<name>"
@@ -136,16 +139,12 @@ _DEVICE_KEYS = ("PCs", "pca_mean", "ref_scores", "label_codes",
 
 def bucket_rows(n: int, buckets=DEFAULT_BUCKETS) -> int:
     """The canonical padded row count for an ``n``-row query batch:
-    the smallest bucket >= ``n``, doubling past the ladder's end."""
+    the smallest bucket >= ``n``, doubling past the ladder's end.
+    Thin alias of :func:`sctools_tpu.buckets.bucket_for` kept for the
+    serving API surface."""
     if n < 1:
         raise ValueError("bucket_rows: need at least one query row")
-    for b in buckets:
-        if n <= b:
-            return int(b)
-    b = int(buckets[-1])
-    while b < n:
-        b *= 2
-    return b
+    return _bucket_for(n, buckets)
 
 
 # ---------------------------------------------------------------------------

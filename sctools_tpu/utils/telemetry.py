@@ -203,6 +203,16 @@ METRICS = {
                       "fused stages (the dispatch loop they skipped)",
     "plan.fallbacks": "counter: fused stages that failed to trace and "
                       "fell back to eager step-by-step execution",
+    "bucket.pad_rows": "counter: padding rows added by "
+                       "buckets.pad_to_bucket across all admissions — "
+                       "the rows the device computes and throws away",
+    "bucket.pad_frac": "gauge: padding fraction of the most recent "
+                       "pad_to_bucket (labels axis= cells|genes) — "
+                       "sustained high values mean the bucket ladder "
+                       "is too coarse for the traffic",
+    "bucket.hits": "counter: datasets padded into each bucket shape "
+                   "(labels bucket= <rows>x<genes>) — the occupancy "
+                   "histogram sctreport's buckets section renders",
     "plan.sharded_stages": "counter: mesh-sharded stage executions "
                            "(GSPMD-fused or collective-bodied)",
     "plan.reshards_avoided": "counter: sharded-stage input leaves that "

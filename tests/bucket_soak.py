@@ -1,0 +1,227 @@
+"""Canned shape-bucketing acceptance soak — run_checks.sh gate.
+
+ISSUE 20's acceptance scenario: hundreds of RANDOMLY-SHAPED concurrent
+``annotation_reference`` recipe runs through :class:`RunScheduler`
+under the admission + memory funnel with chaos (transient device
+faults + ``mem_pressure``), all timing on one VirtualClock.  Every
+upload pads into a shape bucket at submit (``submit_recipe(...,
+bucketize=True)``) so the whole soak executes a HANDFUL of compiled
+programs.  Asserts:
+
+* **plan-cache hit rate >= 0.9 after warmup**: one warmup run per
+  occupied bucket compiles its plans; the soak itself must then be
+  nearly all cache hits (the entire point of bucketing);
+* **p99 admission-to-terminal latency bounded + reported**: real-time
+  journal timestamps, admitted -> terminal per ticket;
+* **journal COMPLETE and coherent**: every ticket submitted once and
+  terminal exactly once (shared ``soak_smoke.check_journal_coherent``
+  contract), ZERO unhandled failures (no ``run_failed``) despite the
+  injected faults;
+* **bucket-shaped memory estimates**: every admitted run in the same
+  bucket declares the SAME ``mem_bytes`` — admission charges the
+  shape the device will actually hold, not the smaller true shape;
+* **every result trimmed** back to its upload's true shape.
+
+Deliberately NOT named ``test_*`` — pytest skips it; the CI stage
+runs ``python tests/bucket_soak.py`` (exit 0 = pass).  Padded-vs-
+unpadded numerical parity lives in ``tests/test_buckets.py``.
+"""
+
+import collections
+import json
+import os
+import shutil
+import sys
+import tempfile
+import warnings
+
+# runnable as `python tests/bucket_soak.py` from the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# the env cap must be set BEFORE the budget is constructed; generous
+# enough that nothing is refused over_memory (refusals are coherent
+# but this soak wants every ticket to complete)
+CAP = 256_000_000
+os.environ["SCTOOLS_MEM_BUDGET_BYTES"] = str(CAP)
+
+import numpy as np  # noqa: E402
+
+from sctools_tpu import buckets, recipes  # noqa: E402
+from sctools_tpu.data.synthetic import synthetic_counts  # noqa: E402
+from sctools_tpu.memory import MemoryBudget  # noqa: E402
+from sctools_tpu.scheduler import RunScheduler  # noqa: E402
+from sctools_tpu.utils.chaos import ChaosMonkey, Fault  # noqa: E402
+from sctools_tpu.utils.failsafe import BreakerRegistry  # noqa: E402
+from sctools_tpu.utils.telemetry import MetricsRegistry  # noqa: E402
+from sctools_tpu.utils.vclock import VirtualClock  # noqa: E402
+
+from soak_smoke import check_journal_coherent  # noqa: E402
+
+N_RUNS = int(os.environ.get("SCTOOLS_BUCKET_SOAK_RUNS", 220))
+WAVE = 20           # concurrent submissions in flight per wave
+P99_BOUND_S = 120.0  # real-seconds bound on admission->terminal p99
+HIT_RATE_FLOOR = 0.9
+
+
+def fail(msg: str) -> "NoReturn":  # noqa: F821
+    print(f"bucket_soak: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> int:
+    rng = np.random.default_rng(0)
+    shapes = [(int(rng.integers(80, 500)), int(rng.integers(100, 250)))
+              for _ in range(N_RUNS)]
+    combos = sorted({(buckets.bucket_for(n), buckets.bucket_for(g))
+                     for n, g in shapes})
+
+    # -- warmup: compile each occupied bucket's plans once, inline ----
+    for i, (br, bg) in enumerate(combos):
+        d = synthetic_counts(br - 1, bg - 1, density=0.1, n_clusters=3,
+                             seed=9000 + i)
+        recipes.run_recipe("annotation_reference", d, backend="tpu",
+                           fuse=True, bucketize=True)
+
+    clock = VirtualClock()
+    metrics = MetricsRegistry(clock=clock)
+    budget = MemoryBudget(name="hbm0", metrics=metrics)
+    jdir = tempfile.mkdtemp(prefix="sct_bucket_soak_")
+    jpath = os.path.join(jdir, "journal.jsonl")
+    # single-shot transient faults, spaced out: ``times=N`` fires on N
+    # CONSECUTIVE matching calls, and a retried step's attempts are
+    # exactly such consecutive calls — a 3-shot fault would eat all
+    # three attempts of one unlucky run and surface as run_failed
+    chaos = ChaosMonkey(
+        [Fault("pca.randomized", "unavailable", backend="tpu",
+               on_call=3, times=1),
+         Fault("pca.randomized", "unavailable", backend="tpu",
+               on_call=60, times=1),
+         Fault("normalize.log1p", "unavailable", backend="tpu",
+               on_call=7, times=1),
+         Fault("normalize.log1p", "unavailable", backend="tpu",
+               on_call=120, times=1),
+         Fault("hbm0", "mem_pressure", on_call=9, times=3)],
+        clock=clock)
+    # the default failure_threshold=3 would let the five injected
+    # transient faults OPEN the shared tpu breaker and silently
+    # degrade the whole pool to cpu (the VirtualClock never reaches
+    # the cooldown) — which bypasses the plan cache this soak exists
+    # to measure; raise it so faults are absorbed by per-step retries
+    breakers = BreakerRegistry(clock=clock, failure_threshold=25)
+    sched = RunScheduler(
+        max_concurrency=4, clock=clock, metrics=metrics,
+        journal_path=jpath, breakers=breakers,
+        chaos=chaos, mem_budget=budget,
+        runner_defaults={"sleep": lambda s: None,
+                         "probe": lambda: {"ok": True}})
+
+    ticket_bucket: dict = {}
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            done = 0
+            for wave_start in range(0, N_RUNS, WAVE):
+                wave = []
+                for i in range(wave_start,
+                               min(wave_start + WAVE, N_RUNS)):
+                    n, g = shapes[i]
+                    d = synthetic_counts(n, g, density=0.1,
+                                         n_clusters=3, seed=i)
+                    h = recipes.submit_recipe(
+                        sched, "annotation_reference", d,
+                        tenant=f"lab-{i % 5}", priority=i % 3,
+                        backend="tpu", fuse=True, bucketize=True)
+                    ticket_bucket[h.ticket] = (
+                        buckets.bucket_for(n), buckets.bucket_for(g))
+                    wave.append((h, n, g))
+                for h, n, g in wave:
+                    out = h.result(timeout=300)
+                    if (out.n_cells, out.n_genes) != (n, g):
+                        fail(f"result not trimmed: got "
+                             f"{out.n_cells}x{out.n_genes}, "
+                             f"expected {n}x{g}")
+                    if np.asarray(out.obsm["X_pca"]).shape[0] != n:
+                        fail("X_pca rows != true cell count")
+                done += len(wave)
+        sched.shutdown(wait=True)
+
+        # -- plan-cache hit rate after warmup ------------------------
+        # the scheduler threads ITS registry through to the plan
+        # layer, so the soak's hit/miss counters live there (the
+        # warmup's misses went to the default registry); the plan
+        # cache itself is process-global, which is why the warmup
+        # compiles carry over
+        c = metrics.snapshot_compact()
+        soak_hits = c.get("plan.cache_hits", 0.0)
+        soak_misses = c.get("plan.cache_misses", 0.0)
+        rate = soak_hits / max(soak_hits + soak_misses, 1.0)
+        if rate < HIT_RATE_FLOOR:
+            fail(f"plan-cache hit rate {rate:.3f} < {HIT_RATE_FLOOR} "
+                 f"({soak_hits:g} hits / {soak_misses:g} misses over "
+                 f"{N_RUNS} runs in {len(combos)} buckets)")
+
+        # -- journal: coherent, zero unhandled failures, latency -----
+        with open(jpath) as f:
+            events = [json.loads(line) for line in f]
+        failed = [e for e in events if e["event"] == "run_failed"]
+        if failed:
+            fail(f"{len(failed)} unhandled run failure(s): "
+                 f"{failed[:3]}")
+        check_journal_coherent(jpath, N_RUNS)
+        admitted_ts, terminal_ts = {}, {}
+        for e in events:
+            t = e.get("ticket")
+            if e["event"] == "admitted":
+                admitted_ts[t] = e["ts"]
+            elif e["event"] in ("run_completed", "run_failed", "shed"):
+                terminal_ts[t] = e["ts"]
+        lats = sorted(terminal_ts[t] - admitted_ts[t]
+                      for t in admitted_ts if t in terminal_ts)
+        if len(lats) != N_RUNS:
+            fail(f"{len(lats)} admission->terminal latencies, "
+                 f"expected {N_RUNS}")
+        p50 = lats[len(lats) // 2]
+        p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))]
+        if p99 > P99_BOUND_S:
+            fail(f"p99 admission->terminal {p99:.2f}s exceeds the "
+                 f"{P99_BOUND_S}s bound")
+
+        # -- chaos actually fired ------------------------------------
+        if not any(f["mode"] == "unavailable" for f in chaos.injected):
+            fail("no transient fault fired")
+        if not any(f["mode"] == "mem_pressure"
+                   for f in chaos.injected):
+            fail("mem_pressure never fired")
+
+        # -- bucket-shaped admission estimates -----------------------
+        by_bucket = collections.defaultdict(set)
+        for e in events:
+            if e["event"] == "admitted":
+                b = ticket_bucket.get(e["ticket"])
+                if b is not None and "mem_bytes" in e:
+                    by_bucket[b].add(int(e["mem_bytes"]))
+        if not by_bucket:
+            fail("no admitted event carried mem_bytes")
+        uneven = {b: v for b, v in by_bucket.items() if len(v) != 1}
+        if uneven:
+            fail(f"same-bucket runs declared different memory "
+                 f"estimates (true shape leaked into admission): "
+                 f"{uneven}")
+
+        occupancy = collections.Counter(ticket_bucket.values())
+        print(f"bucket_soak: OK — {N_RUNS} randomly-shaped runs in "
+              f"{len(combos)} bucket(s) "
+              f"{dict((f'{r}x{g}', c) for (r, g), c in sorted(occupancy.items()))}, "
+              f"hit rate {rate:.3f} ({soak_hits:g}h/{soak_misses:g}m), "
+              f"latency p50 {p50 * 1e3:.0f}ms p99 {p99 * 1e3:.0f}ms, "
+              f"{len([f for f in chaos.injected])} fault(s) injected, "
+              f"journal coherent, 0 failures")
+        return 0
+    finally:
+        shutil.rmtree(jdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

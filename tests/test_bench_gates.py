@@ -267,3 +267,26 @@ def test_serve_latency_retrace_and_agreement_gate():
     assert det["retraces_after_warmup"] == 0.0, det
     assert det["plan_hits"] >= det["n_queries"], det
     assert det["batch_agreement"] >= 0.99, det
+
+
+def test_buckets_stage_speedup_and_retrace_gate():
+    """ISSUE 20's acceptance gate: bench's ``buckets`` phase must show
+    N differently-shaped uploads through the bucketized fused recipe
+    >= 1.3x faster than tracing per shape, with exactly ONE compile in
+    the bucketized arm (every subsequent shape a plan-cache hit) and
+    one compile PER SHAPE in the per-shape arm.  One re-measure before
+    failing: 2 cores, CI neighbours."""
+    import jax
+
+    from tools.bench_buckets import run_bucket_bench
+
+    det = run_bucket_bench(jax)
+    # compile counts only hold on a process-fresh plan cache — pin
+    # them from the FIRST measurement, before any re-measure
+    assert det["compiles_pershape"] == det["n_shapes"], det
+    assert det["compiles_bucketized"] == 1, det
+    if det["speedup"] < 1.3:  # pragma: no cover - noisy box
+        # fresh seed: same-process re-measure must draw new shapes or
+        # the first call's cached plans zero the timing contrast
+        det = run_bucket_bench(jax, seed=1)
+    assert det["speedup"] >= 1.3, det

@@ -1,0 +1,290 @@
+"""Shape bucketing (sctools_tpu.buckets): ladder edges, pad/trim
+round-trip, and the mask-aware op family's padded-vs-unpadded PARITY
+contract — every op registered ``mask_aware`` must produce, on a
+bucket-padded dataset, the same answer on the valid region as the
+unpadded run.  Bitwise where the math is reassociation-free (qc,
+library_size, log1p, pearson residuals, kNN neighbour indices); a
+small documented tolerance where it is not (scale's cross-row moment
+reassociation, hvg's score arithmetic, pca's iterative randomized
+solver).  docs/ARCHITECTURE.md "Shape bucketing" states the contract.
+"""
+
+import numpy as np
+import pytest
+
+from sctools_tpu import registry
+from sctools_tpu.buckets import (
+    COL_MASK_KEY, MASK_KEYS, ROW_MASK_KEY, TrimmingHandle, bucket_for,
+    capacity_bucket, masks_of, pad_to_bucket, trim_from_bucket,
+    validate_bucketizable)
+from sctools_tpu.data.dataset import CellData
+from sctools_tpu.data.sparse import SparseCells
+from sctools_tpu.data.synthetic import synthetic_counts
+from sctools_tpu.recipes import recipe_pipeline
+from sctools_tpu.utils.checkpoint import data_digest
+from sctools_tpu.utils.telemetry import MetricsRegistry
+
+N, G = 300, 190  # true shape; buckets to 512 x 256
+
+
+def _dataset(n=N, g=G, seed=0):
+    d = synthetic_counts(n, g, density=0.1, n_clusters=3, seed=seed)
+    d.X = SparseCells.from_scipy_csr(d.X)
+    return d
+
+
+def _pair(seed=0, **pad_kw):
+    """(unpadded, padded, info) over the same upload."""
+    plain = _dataset(seed=seed)
+    padded, info = pad_to_bucket(_dataset(seed=seed), **pad_kw)
+    return plain, padded, info
+
+
+def _dense_x(d):
+    X = d.X
+    if hasattr(X, "to_scipy_csr"):
+        return np.asarray(X.to_scipy_csr().toarray())
+    return np.asarray(X)
+
+
+def _run_both(op, params, seed=0):
+    """Apply one registered tpu op to the unpadded upload and to the
+    padded+trimmed one; return (plain_out, trimmed_out)."""
+    plain, padded, info = _pair(seed=seed)
+    out_plain = registry.apply(op, plain, backend="tpu", **params)
+    out_trim = trim_from_bucket(
+        registry.apply(op, padded, backend="tpu", **params), info)
+    return out_plain, out_trim
+
+
+# -- ladder ----------------------------------------------------------
+
+def test_bucket_for_ladder_edges():
+    assert bucket_for(1) == 16
+    assert bucket_for(16) == 16
+    assert bucket_for(17) == 32
+    assert bucket_for(4096) == 4096
+    assert bucket_for(4097) == 8192  # doubles past the ladder's end
+    with pytest.raises(ValueError):
+        bucket_for(0)
+
+
+def test_capacity_bucket_pow2_of_lane():
+    assert capacity_bucket(1) == 128
+    assert capacity_bucket(128) == 128
+    assert capacity_bucket(129) == 256
+    assert capacity_bucket(300) == 512
+
+
+# -- mask plumbing ---------------------------------------------------
+
+def test_masks_of_unbucketized_is_none():
+    assert masks_of(_dataset()) is None
+
+
+def test_masks_of_partial_mask_set_raises():
+    d = _dataset()
+    d.uns[ROW_MASK_KEY] = np.ones(512, dtype=bool)  # no COL/N keys
+    with pytest.raises(ValueError, match=COL_MASK_KEY):
+        masks_of(d)
+
+
+def test_pad_records_full_mask_quadruple():
+    _, padded, info = _pair()
+    for k in MASK_KEYS:
+        assert k in padded.uns, k
+    m = masks_of(padded)
+    assert int(m.n_cells) == N and int(m.n_genes) == G
+    assert m.row.shape == (info.bucket_cells,)
+    assert m.col.shape == (info.bucket_genes,)
+    assert int(np.sum(m.row)) == N and int(np.sum(m.col)) == G
+    # padding rows of the ELL container are fully sentinel — sparse
+    # segment reductions exclude them with no masking at all
+    assert padded.X.n_cells == info.bucket_cells == 512
+    assert padded.X.n_genes == info.bucket_genes == 256
+
+
+def test_pad_trim_round_trip_restores_everything():
+    plain, padded, info = _pair()
+    assert info.pad_rows == 512 - N and info.pad_genes == 256 - G
+    # gene-name strings are opaque: stashed host-side, NOT in the
+    # padded (traced) container
+    assert "gene_name" not in padded.var
+    out = trim_from_bucket(padded, info)
+    assert (out.n_cells, out.n_genes) == (N, G)
+    np.testing.assert_array_equal(_dense_x(out)[:N, :G],
+                                  _dense_x(plain)[:N, :G])
+    np.testing.assert_array_equal(out.obs["cluster_true"],
+                                  plain.obs["cluster_true"])
+    np.testing.assert_array_equal(out.var["gene_name"],
+                                  plain.var["gene_name"])
+    for k in MASK_KEYS:
+        assert k not in out.uns, k
+
+
+def test_pad_derives_mito_from_stashed_gene_names():
+    d = _dataset()
+    del d.var["mito"]  # force the derivation path
+    names = np.asarray(d.var["gene_name"]).astype(object).copy()
+    names[3] = "MT-CO1"
+    d.var["gene_name"] = names
+    padded, info = pad_to_bucket(d)
+    mito = np.asarray(padded.var["mito"])
+    assert mito.dtype == np.bool_ and mito.shape == (256,)
+    expect = np.char.startswith(np.char.upper(names.astype(str)),
+                                "MT-")
+    np.testing.assert_array_equal(mito[:G], expect)
+    assert mito[3] and not mito[G:].any()
+
+
+def test_pad_emits_bucket_telemetry():
+    reg = MetricsRegistry()
+    pad_to_bucket(_dataset(), metrics=reg)
+    snap = reg.snapshot_compact()
+    assert snap.get("bucket.pad_rows") == 512 - N
+    assert snap.get("bucket.hits{bucket=512x256}") == 1
+    gauges = reg.snapshot()["gauges"]
+    assert gauges.get("bucket.pad_frac{axis=cells}") == pytest.approx(
+        (512 - N) / 512)
+    assert gauges.get("bucket.pad_frac{axis=genes}") == pytest.approx(
+        (256 - G) / 256)
+
+
+# -- padded-vs-unpadded parity, bitwise family -----------------------
+
+@pytest.mark.parametrize("op,params", [
+    ("qc.per_cell_metrics", {}),
+    ("qc.per_gene_metrics", {}),
+    ("normalize.library_size", {"target_sum": 1e4}),
+    ("normalize.library_size", {"target_sum": None}),  # traced median
+    ("normalize.log1p", {}),
+    ("normalize.pearson_residuals", {}),
+])
+def test_parity_bitwise_on_valid_region(op, params):
+    out_plain, out_trim = _run_both(op, params)
+    np.testing.assert_array_equal(_dense_x(out_trim)[:N, :G],
+                                  _dense_x(out_plain)[:N, :G],
+                                  err_msg=f"{op} X mismatch")
+    for sec, n in (("obs", N), ("var", G)):
+        a, b = getattr(out_plain, sec), getattr(out_trim, sec)
+        for k in a:
+            if k in b:
+                np.testing.assert_array_equal(
+                    np.asarray(b[k])[:n], np.asarray(a[k])[:n],
+                    err_msg=f"{op} {sec}[{k}]")
+
+
+def test_parity_scale_moment_tolerance():
+    # scale's mean/var moments reassociate across the (padded) row
+    # extent — measured ~1e-6 relative on this data, gated at 1e-5
+    out_plain, out_trim = _run_both("normalize.scale", {})
+    np.testing.assert_allclose(_dense_x(out_trim)[:N, :G],
+                               _dense_x(out_plain)[:N, :G],
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_parity_hvg_same_selection():
+    out_plain, out_trim = _run_both(
+        "hvg.select", {"n_top": 50, "flavor": "seurat_v3",
+                       "subset": False})
+    np.testing.assert_array_equal(
+        np.asarray(out_trim.var["highly_variable"])[:G],
+        np.asarray(out_plain.var["highly_variable"])[:G])
+    np.testing.assert_allclose(
+        np.asarray(out_trim.var["hvg_score"])[:G],
+        np.asarray(out_plain.var["hvg_score"])[:G],
+        rtol=1e-4, atol=1e-4)
+
+
+def test_parity_pca_iterative_tolerance():
+    # randomized PCA is an ITERATIVE solver: the padded run does the
+    # same math over a larger (masked-to-zero) extent, so scores agree
+    # to solver tolerance, not bitwise — measured ~5e-4 on scores of
+    # scale ~20 here; documented in docs/ARCHITECTURE.md
+    out_plain, out_trim = _run_both("pca.randomized",
+                                    {"n_components": 16})
+    sp = np.asarray(out_plain.obsm["X_pca"])[:N]
+    st = np.asarray(out_trim.obsm["X_pca"])[:N]
+    scale = np.max(np.abs(sp))
+    assert np.max(np.abs(sp - st)) < 5e-3 * max(scale, 1.0)
+
+
+def test_parity_knn_indices_bitwise():
+    # identical representation on both arms isolates the kNN op's own
+    # mask handling: padded candidates must never displace real hits
+    rng = np.random.default_rng(0)
+    rep = rng.normal(size=(N, 16)).astype(np.float32)
+    plain = _dataset()
+    plain.obsm["X_pca"] = rep
+    padded, info = pad_to_bucket(_dataset())
+    padded.obsm["X_pca"] = np.zeros((512, 16), dtype=np.float32)
+    padded.obsm["X_pca"][:N] = rep
+    out_plain = registry.apply("neighbors.knn", plain, backend="tpu",
+                               k=10)
+    out_pad = registry.apply("neighbors.knn", padded, backend="tpu",
+                             k=10)
+    np.testing.assert_array_equal(
+        np.asarray(out_pad.obsp["knn_indices"])[:N],
+        np.asarray(out_plain.obsp["knn_indices"])[:N])
+    # padded query rows are post-masked to -1
+    assert (np.asarray(out_pad.obsp["knn_indices"])[N:512] == -1).all()
+    out_trim = trim_from_bucket(out_pad, info)
+    assert np.asarray(out_trim.obsp["knn_indices"]).shape[0] == N
+
+
+# -- eligibility + registry accessor ---------------------------------
+
+def test_validate_bucketizable_names_offending_step():
+    with pytest.raises(ValueError, match="qc.filter_genes"):
+        validate_bucketizable(recipe_pipeline("zheng17"), "tpu")
+    validate_bucketizable(recipe_pipeline("annotation_reference"),
+                          "tpu")  # all mask-aware: must not raise
+
+
+def test_is_mask_aware_accessor():
+    assert registry.is_mask_aware("normalize.log1p", "tpu")
+    assert not registry.is_mask_aware("qc.filter_genes", "tpu")
+    assert not registry.is_mask_aware("normalize.log1p", "cpu")
+    # hvg's flag is a PREDICATE over bound params — subset=True
+    # materialises a data-dependent shape and opts out
+    assert registry.is_mask_aware("hvg.select", "tpu",
+                                  {"subset": False})
+    assert not registry.is_mask_aware("hvg.select", "tpu",
+                                      {"subset": True})
+
+
+# -- checkpoint identity + handle ------------------------------------
+
+def test_checkpoint_digest_distinguishes_true_shapes():
+    # two uploads in the SAME bucket must not share checkpoint
+    # identity: the mask (true counts) is part of the hashed input
+    pa, _ = pad_to_bucket(_dataset(seed=1))
+    pb, _ = pad_to_bucket(
+        synthetic_counts(437, 155, density=0.1, n_clusters=3, seed=1))
+    assert pa.X.n_cells == 512 and pb.n_cells == 512
+    assert data_digest(pa) != data_digest(pb)
+
+
+def test_trimming_handle_trims_and_delegates():
+    _, padded, info = _pair()
+
+    class FakeHandle:
+        ticket = "t-42"
+
+        def result(self, timeout=None):
+            return padded
+
+    h = TrimmingHandle(FakeHandle(), info)
+    assert h.ticket == "t-42"  # attribute passthrough
+    out = h.result(timeout=5)
+    assert (out.n_cells, out.n_genes) == (N, G)
+    assert ROW_MASK_KEY not in out.uns
+
+
+def test_trim_restores_annotation_after_op():
+    # the full recipe path: op output still trims + restores strings
+    _, padded, info = _pair()
+    out = trim_from_bucket(
+        registry.apply("normalize.log1p", padded, backend="tpu"), info)
+    assert "gene_name" in out.var
+    assert np.asarray(out.var["gene_name"]).shape == (G,)

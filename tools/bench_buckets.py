@@ -1,0 +1,95 @@
+"""Shape-bucketing bench helper: bucketized vs per-shape tracing walls.
+
+This module backs ``bench.py --phase buckets``.  What it measures:
+
+* **per-shape arm**: N synthetic uploads, every one a DIFFERENT true
+  shape, run through the fused ``annotation_reference`` recipe
+  unbucketized — each distinct shape traces and compiles its own
+  plans (the cost rapids-singlecell pays per batch shape);
+* **bucketized arm**: the same N shapes with ``bucketize=True`` — all
+  of them pad into one shape bucket, so only the FIRST compiles and
+  the rest are plan-cache hits;
+* **speedup**: per-shape wall / bucketized wall.  The acceptance gate
+  (tests/test_bench_gates.py) requires >= 1.3x — on any box where
+  tracing is non-trivial relative to these small executions the real
+  ratio is far higher, and the gate mostly guards against the bucket
+  path accidentally retracing per shape (speedup would collapse
+  to ~1.0).
+
+The two arms cannot contaminate each other's plan cache: every
+per-shape trace keys on its own true shape, the bucketized traces key
+on the bucket shape + mask leaves, and no true shape equals the
+bucket dims.
+
+Sized for the CI box via ``SCTOOLS_BENCH_BUCKETS_SHAPES``; real boxes
+can scale up.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+def run_bucket_bench(jax, seed: int = 0) -> dict:
+    """Bucketized-vs-per-shape walls + retrace counts.  Returns the
+    detail dict the gate reads.  ``seed`` varies the shape draw — a
+    re-measure in the SAME process must use a fresh seed, or the first
+    call's cached plans zero out the second call's compile counts."""
+    import numpy as np
+
+    from sctools_tpu import recipes
+    from sctools_tpu.data.synthetic import synthetic_counts
+    from sctools_tpu.utils import telemetry
+
+    n_shapes = int(os.environ.get("SCTOOLS_BENCH_BUCKETS_SHAPES", 8))
+    m = telemetry.default_registry()
+
+    def misses():
+        return m.snapshot_compact().get("plan.cache_misses", 0.0)
+
+    # distinct true shapes, all inside the 512x256 bucket, none equal
+    # to the bucket dims (keeps the per-shape arm's plan keys disjoint
+    # from the bucketized arm's)
+    rng = np.random.default_rng(seed)
+    shapes = set()
+    while len(shapes) < n_shapes:
+        shapes.add((int(rng.integers(260, 500)),
+                    int(rng.integers(140, 250))))
+    shapes = sorted(shapes)
+    uploads = [synthetic_counts(n, g, density=0.1, n_clusters=3,
+                                seed=1000 * seed + 100 + i)
+               for i, (n, g) in enumerate(shapes)]
+
+    m0 = misses()
+    t0 = time.time()
+    for d in uploads:
+        recipes.run_recipe("annotation_reference", d, backend="tpu",
+                           fuse=True, n_components=16)
+    wall_pershape = time.time() - t0
+    compiles_pershape = misses() - m0
+
+    m1 = misses()
+    t1 = time.time()
+    outs = []
+    for d in uploads:
+        outs.append(recipes.run_recipe(
+            "annotation_reference", d, backend="tpu", fuse=True,
+            bucketize=True, n_components=16))
+    wall_bucketized = time.time() - t1
+    compiles_bucketized = misses() - m1
+
+    # sanity: every output trimmed back to its true shape
+    for out, (n, g) in zip(outs, shapes):
+        assert (out.n_cells, out.n_genes) == (n, g), (
+            f"trim returned {out.n_cells}x{out.n_genes}, "
+            f"expected {n}x{g}")
+
+    return {
+        "n_shapes": n_shapes,
+        "wall_pershape_s": round(wall_pershape, 3),
+        "wall_bucketized_s": round(wall_bucketized, 3),
+        "speedup": round(wall_pershape / max(wall_bucketized, 1e-9), 2),
+        "compiles_pershape": int(compiles_pershape),
+        "compiles_bucketized": int(compiles_bucketized),
+    }

@@ -48,6 +48,12 @@
 #                     the fused-execution layer's zero-retrace
 #                     contract (docs/ARCHITECTURE.md "Execution
 #                     plans & fusion")
+#   5b. buckets       the RECIPE half of the same contract: two
+#                     synthetic uploads with different true shapes pad
+#                     into one shape bucket (buckets.pad_to_bucket),
+#                     so the second run is a 100% plan-cache hit and
+#                     both trim back to their true shapes
+#                     (docs/ARCHITECTURE.md "Shape bucketing")
 #   6. sharded-plan   the SAME contract for mesh-sharded stages, on an
 #                     8-device host-platform mesh (XLA_FLAGS forces
 #                     the virtual devices, so the mesh path is
@@ -67,6 +73,14 @@
 #                     and a complete coherent journal (every ticket
 #                     submitted once and terminal exactly once) —
 #                     the admission-control layer's contract
+#   8b. bucket-soak   python tests/bucket_soak.py — hundreds of
+#                     randomly-shaped concurrent bucketized recipe
+#                     runs through RunScheduler under chaos
+#                     (transient faults + mem_pressure): plan-cache
+#                     hit rate >= 0.9 after warmup, bounded p99
+#                     admission-to-terminal, same-bucket runs declare
+#                     identical admission mem_bytes, coherent journal
+#                     with zero unhandled failures
 #                     (docs/ARCHITECTURE.md "Admission control &
 #                     scheduling")
 #   9. chaos-ingest   python tests/ingest_smoke.py — the IO-failure
@@ -268,6 +282,61 @@ else
     fail=1
 fi
 
+stage "buckets (two differently-shaped uploads share one bucket's plans)"
+if JAX_PLATFORMS=cpu python - <<'PYEOF'
+import sys
+
+import numpy as np
+
+from sctools_tpu import recipes
+from sctools_tpu.data.synthetic import synthetic_counts
+from sctools_tpu.utils import telemetry
+
+m = telemetry.default_registry()
+
+
+def counters():
+    c = m.snapshot_compact()
+    return (c.get("plan.cache_hits", 0.0),
+            c.get("plan.cache_misses", 0.0))
+
+
+# two synthetic uploads with DIFFERENT true shapes, same 512x256 bucket
+d1 = synthetic_counts(300, 190, density=0.1, n_clusters=3, seed=1)
+d2 = synthetic_counts(437, 155, density=0.1, n_clusters=3, seed=2)
+o1 = recipes.run_recipe("annotation_reference", d1, backend="tpu",
+                        fuse=True, bucketize=True)
+hits1, misses1 = counters()
+if misses1 < 1:
+    sys.exit("first bucketized run compiled no fused stage")
+o2 = recipes.run_recipe("annotation_reference", d2, backend="tpu",
+                        fuse=True, bucketize=True)
+hits2, misses2 = counters()
+if misses2 != misses1:
+    sys.exit(f"second SHAPE retraced despite sharing the bucket: "
+             f"cache_misses {misses1} -> {misses2}")
+if hits2 <= hits1:
+    sys.exit("second bucketized run recorded no plan-cache hits")
+for out, d in ((o1, d1), (o2, d2)):
+    if (out.n_cells, out.n_genes) != (d.n_cells, d.n_genes):
+        sys.exit(f"trim returned {out.n_cells}x{out.n_genes}, "
+                 f"expected {d.n_cells}x{d.n_genes}")
+    if np.asarray(out.obsm["X_pca"]).shape[0] != d.n_cells:
+        sys.exit("X_pca not trimmed to the true cell count")
+occ = {k: v for k, v in m.snapshot_compact().items()
+       if k.startswith("bucket.hits")}
+if occ.get("bucket.hits{bucket=512x256}", 0) < 2:
+    sys.exit(f"expected both uploads in the 512x256 bucket, got {occ}")
+print(f"OK: 300x190 and 437x155 shared the 512x256 bucket "
+      f"({int(hits2 - hits1)} cached stage(s), 0 retraces)")
+PYEOF
+then
+    :
+else
+    echo "buckets stage FAILED (rc=$?)"
+    fail=1
+fi
+
 stage "sharded-plan (second sharded run on a rebuilt mesh: zero retraces)"
 if JAX_PLATFORMS=cpu \
    XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
@@ -375,6 +444,14 @@ if JAX_PLATFORMS=cpu python tests/soak_smoke.py; then
     :
 else
     echo "scheduler-soak stage FAILED (rc=$?)"
+    fail=1
+fi
+
+stage "bucket-soak (220 randomly-shaped bucketized runs under chaos)"
+if JAX_PLATFORMS=cpu python tests/bucket_soak.py; then
+    :
+else
+    echo "bucket-soak stage FAILED (rc=$?)"
     fail=1
 fi
 

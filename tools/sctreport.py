@@ -306,6 +306,11 @@ def render(run_dir: str, runs: list[dict], trace_d: dict | None,
         add("")
         L.extend(plan)
 
+    bkt = buckets_section(metrics)
+    if bkt:
+        add("")
+        L.extend(bkt)
+
     graph = graph_section(metrics)
     if graph:
         add("")
@@ -1193,6 +1198,58 @@ def plan_cache_section(metrics) -> list[str]:
     if plan.get("plan.fused_ops"):
         L.append(f"  member ops executed inside fused stages: "
                  f"{plan['plan.fused_ops']:g}")
+    return L
+
+
+def buckets_section(metrics) -> list[str]:
+    """The shape-bucketing digest, rendered only when the run padded
+    datasets into buckets (``bucket.*`` series present — a run that
+    never bucketized has no section).  Shows per-bucket occupancy,
+    total padding waste, the last-seen padding fractions per axis, and
+    the plan-cache hit rate those buckets bought (the reason the
+    padding waste is worth paying)."""
+    if metrics is None:
+        return []
+    m = metrics.get("metrics", metrics)
+    counters = m.get("counters", {})
+    gauges = m.get("gauges", {})
+    occ = {k: v for k, v in counters.items()
+           if k.startswith("bucket.hits")}
+    if not occ and "bucket.pad_rows" not in counters:
+        return []
+    L = ["-- buckets --"]
+    total = sum(occ.values())
+    if occ:
+        L.append(f"  datasets bucketized: {total:g}")
+        L.append(f"  {'bucket':<14s} {'count':>6s} {'share':>7s}")
+
+        def _dims(key):  # "bucket.hits{bucket=512x256}" -> (512, 256)
+            lab = key.split("bucket=", 1)[-1].rstrip("}")
+            try:
+                r, g = lab.split("x")
+                return (int(r), int(g))
+            except ValueError:
+                return (1 << 62, 0)
+
+        for k in sorted(occ, key=_dims):
+            lab = k.split("bucket=", 1)[-1].rstrip("}")
+            L.append(f"  {lab:<14s} {occ[k]:6g} "
+                     f"{occ[k] / total:7.0%}")
+    pad_rows = counters.get("bucket.pad_rows")
+    if pad_rows is not None:
+        L.append(f"  padding rows paid: {pad_rows:g}")
+    fr = gauges.get("bucket.pad_frac{axis=cells}")
+    fg = gauges.get("bucket.pad_frac{axis=genes}")
+    if fr is not None or fg is not None:
+        L.append(f"  last pad fraction: cells "
+                 f"{'-' if fr is None else format(fr, '.0%')}, genes "
+                 f"{'-' if fg is None else format(fg, '.0%')}")
+    hits = counters.get("plan.cache_hits", 0.0)
+    misses = counters.get("plan.cache_misses", 0.0)
+    if hits + misses:
+        L.append(f"  plan-cache hit rate bought: "
+                 f"{hits / (hits + misses):.0%} "
+                 f"({hits:g} hits / {misses:g} compiles)")
     return L
 
 
